@@ -1,0 +1,5 @@
+"""seaweedfs_tpu — a TPU-native distributed object/file store with the
+capabilities of SeaweedFS, whose Reed-Solomon erasure-coding pipeline runs as
+a batched GF(2^8) matmul on TPU via JAX. See SURVEY.md for the blueprint."""
+
+__version__ = "0.1.0"

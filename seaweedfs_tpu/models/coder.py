@@ -1,0 +1,49 @@
+"""ErasureCoder plugin surface — the seam between storage I/O and compute.
+
+The reference hard-wires klauspost/reedsolomon behind 4 call points
+(SURVEY.md section 2: New/Encode/Reconstruct/ReconstructData). Here that seam
+is an explicit interface with two interchangeable backends:
+
+  * "cpu" — numpy table-based GF(256) (ops/rs_cpu.py), the reference oracle
+  * "tpu"/"jax" — bitsliced GF(2) matmul on the MXU (ops/rs_jax.py)
+
+Both must produce byte-identical output; tests/test_rs_codec.py enforces it.
+"""
+
+from __future__ import annotations
+
+from typing import Protocol, runtime_checkable
+
+import numpy as np
+
+
+@runtime_checkable
+class ErasureCoder(Protocol):
+    data_shards: int
+    parity_shards: int
+    total_shards: int
+
+    def encode_parity(self, data): ...
+
+    def encode(self, shards): ...
+
+    def reconstruct(self, shards) -> dict[int, np.ndarray]: ...
+
+    def reconstruct_data(self, shards) -> dict[int, np.ndarray]: ...
+
+    def verify(self, shards) -> bool: ...
+
+
+def new_coder(
+    data_shards: int = 10, parity_shards: int = 4, backend: str = "tpu"
+) -> ErasureCoder:
+    """reedsolomon.New(data, parity) equivalent with a backend switch."""
+    if backend in ("tpu", "jax"):
+        from ..ops.rs_jax import RSCodecJax
+
+        return RSCodecJax(data_shards, parity_shards)
+    if backend in ("cpu", "numpy"):
+        from ..ops.rs_cpu import RSCodecCPU
+
+        return RSCodecCPU(data_shards, parity_shards)
+    raise ValueError(f"unknown erasure coder backend {backend!r}")

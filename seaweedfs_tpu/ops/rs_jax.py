@@ -1,0 +1,229 @@
+"""TPU-native Reed-Solomon codec: GF(2^8) matmul as a bitsliced GF(2) matmul.
+
+The reference's hot loop (`enc.Encode` on 14x256KB buffers,
+/root/reference/weed/storage/erasure_coding/ec_encoder.go:162-192) is
+parity[m, B] = G[m, k] (x) data[k, B] over GF(256). TPUs have no carry-less
+byte multiply, but every GF(256) constant c acts on a byte x as an 8x8 bit
+matrix over GF(2):  bits(c*x) = M_c @ bits(x) mod 2,  with
+M_c[i, j] = bit_i(c * 2^j).  Stacking those per-coefficient blocks turns the
+whole shard computation into ONE dense GF(2) matmul:
+
+    parity_bits[8m, B] = BigM[8m, 8k] @ data_bits[8k, B]  mod 2
+
+which maps straight onto the MXU as an int8 x int8 -> int32 dot followed by
+`& 1`. The matrix is tiny (<= 128x256 for RS(32, ...)) and constant-folded
+per geometry; B (bytes per shard in a batch) is the large dimension.
+
+This one primitive serves the library's whole 4-call surface
+(Encode / Reconstruct / ReconstructData / Verify): encode uses the parity
+generator block, reconstruction uses host-inverted decode matrices
+(gf256.decode_matrix_for) — inverses are unique, so outputs stay
+bit-identical to the Go path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import gf256
+
+# The byte axis is padded up to the next power-of-two multiple of this before
+# the jitted matmul and sliced after. Bounds XLA recompilation to O(log B)
+# distinct shapes (needle intervals have arbitrary sizes) and keeps the lane
+# dimension tile-aligned.
+_BYTE_BUCKET = 512
+
+
+def _bucket(b: int) -> int:
+    if b <= _BYTE_BUCKET:
+        n = 8
+        while n < b:
+            n *= 2
+        return n
+    n = _BYTE_BUCKET
+    while n < b:
+        n *= 2
+    return n
+
+
+def _pad_bytes(data, b: int):
+    padded = _bucket(b)
+    if padded == b:
+        return data
+    return jnp.pad(data, ((0, 0), (0, padded - b)))
+
+
+def gf_matrix_to_bits(m: np.ndarray) -> np.ndarray:
+    """Expand a GF(256) matrix [R, C] to its GF(2) action matrix [8R, 8C].
+
+    Block (r, c) is the 8x8 bit matrix of the constant m[r, c]:
+    out[8r+i, 8c+j] = bit_i(m[r,c] * 2^j).
+    """
+    m = np.asarray(m, dtype=np.uint8)
+    r, c = m.shape
+    powers = np.array([1 << j for j in range(8)], dtype=np.uint8)  # [8]
+    # prod[r, c, j] = m[r,c] * 2^j in GF(256)
+    prod = gf256.gf_mul_vec(m[:, :, None], powers[None, None, :])
+    # bits[r, c, i, j] = bit i of prod[r, c, j]
+    bits = (prod[:, :, None, :] >> np.arange(8)[None, None, :, None]) & 1
+    big = bits.transpose(0, 2, 1, 3).reshape(8 * r, 8 * c)
+    return big.astype(np.int8)
+
+
+def _unpack_bits(data: jax.Array) -> jax.Array:
+    """[k, B] uint8 -> [8k, B] int8 of 0/1; row 8d+j is bit j of shard d."""
+    k, b = data.shape
+    shifts = jnp.arange(8, dtype=jnp.uint8)[None, :, None]
+    bits = (data[:, None, :] >> shifts) & jnp.uint8(1)
+    return bits.reshape(8 * k, b).astype(jnp.int8)
+
+
+def _pack_bits(bits: jax.Array) -> jax.Array:
+    """[8r, B] int (0/1) -> [r, B] uint8."""
+    r8, b = bits.shape
+    bits = bits.reshape(r8 // 8, 8, b).astype(jnp.uint8)
+    shifts = jnp.arange(8, dtype=jnp.uint8)[None, :, None]
+    return jnp.bitwise_xor.reduce(bits << shifts, axis=1)
+
+
+def gf_matmul_bits(matrix_bits: jax.Array, data: jax.Array) -> jax.Array:
+    """out[R, B] = GFmat([R,C]) (x) data[C, B], with matrix given in bit form.
+
+    matrix_bits: [8R, 8C] int8 (from gf_matrix_to_bits)
+    data:        [C, B] uint8
+    returns:     [R, B] uint8
+    """
+    bits = _unpack_bits(data)  # [8C, B] int8
+    acc = jax.lax.dot_general(
+        matrix_bits,
+        bits,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return _pack_bits(acc & 1)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _encode_jit(data: jax.Array, data_shards: int, parity_shards: int) -> jax.Array:
+    gp = gf256.parity_matrix(data_shards, parity_shards)
+    big = jnp.asarray(gf_matrix_to_bits(gp))  # constant-folded per geometry
+    return gf_matmul_bits(big, data)
+
+
+@jax.jit
+def _apply_matrix_jit(matrix_bits: jax.Array, data: jax.Array) -> jax.Array:
+    return gf_matmul_bits(matrix_bits, data)
+
+
+class RSCodecJax:
+    """klauspost-compatible RS codec with a JAX/TPU execution backend.
+
+    Mirrors the 4-call surface the reference uses
+    (SURVEY.md section 2; /root/reference/weed/storage/store_ec.go:384):
+    encode / reconstruct / reconstruct_data / verify, operating on
+    [total, B] or [k, B] uint8 arrays rather than Go byte-slice lists.
+    """
+
+    def __init__(self, data_shards: int = 10, parity_shards: int = 4):
+        if data_shards <= 0 or parity_shards < 0:
+            raise ValueError("bad geometry")
+        if data_shards + parity_shards > 256:
+            raise ValueError("at most 256 total shards in GF(256)")
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+
+    # -- Encode ------------------------------------------------------------
+
+    def encode_parity(self, data: np.ndarray | jax.Array) -> jax.Array:
+        """data [k, B] uint8 -> parity [m, B] uint8 (device array)."""
+        data = jnp.asarray(data, dtype=jnp.uint8)
+        assert data.shape[0] == self.data_shards, data.shape
+        b = data.shape[1]
+        out = _encode_jit(_pad_bytes(data, b), self.data_shards, self.parity_shards)
+        return out[:, :b]
+
+    def encode(self, shards: np.ndarray | jax.Array) -> jax.Array:
+        """shards [total, B]: fills parity rows from data rows, returns all."""
+        shards = jnp.asarray(shards, dtype=jnp.uint8)
+        assert shards.shape[0] == self.total_shards, shards.shape
+        parity = self.encode_parity(shards[: self.data_shards])
+        return jnp.concatenate([shards[: self.data_shards], parity], axis=0)
+
+    # -- Reconstruct -------------------------------------------------------
+
+    @functools.lru_cache(maxsize=256)
+    def _decode_bits(self, present: tuple[int, ...]) -> tuple[jax.Array, tuple[int, ...]]:
+        dec, used = gf256.decode_matrix_for(
+            self.data_shards, self.parity_shards, list(present)
+        )
+        return jnp.asarray(gf_matrix_to_bits(dec)), tuple(used)
+
+    def reconstruct_data(
+        self, shards: dict[int, np.ndarray] | list[np.ndarray | None]
+    ) -> dict[int, jax.Array]:
+        """Recompute all missing DATA shards from any k survivors.
+
+        `shards`: dict shard_id -> [B] bytes, or list with None for missing.
+        Returns {shard_id: [B] uint8} for every previously-missing data shard.
+        """
+        present = self._as_dict(shards)
+        missing_data = [
+            i for i in range(self.data_shards) if i not in present
+        ]
+        if not missing_data:
+            return {}
+        dec_bits, used = self._decode_bits(tuple(sorted(present.keys())))
+        stacked = jnp.stack([jnp.asarray(present[i], jnp.uint8) for i in used])
+        b = stacked.shape[1]
+        data = _apply_matrix_jit(dec_bits, _pad_bytes(stacked, b))[:, :b]
+        return {i: data[i] for i in missing_data}
+
+    def reconstruct(
+        self, shards: dict[int, np.ndarray] | list[np.ndarray | None]
+    ) -> dict[int, jax.Array]:
+        """Recompute ALL missing shards (data and parity) from any k survivors."""
+        present = self._as_dict(shards)
+        missing = [i for i in range(self.total_shards) if i not in present]
+        if not missing:
+            return {}
+        dec_bits, used = self._decode_bits(tuple(sorted(present.keys())))
+        stacked = jnp.stack([jnp.asarray(present[i], jnp.uint8) for i in used])
+        b = stacked.shape[1]
+        data = _apply_matrix_jit(dec_bits, _pad_bytes(stacked, b))[:, :b]  # [k, B]
+        out: dict[int, jax.Array] = {}
+        need_parity = any(i >= self.data_shards for i in missing)
+        parity = self.encode_parity(data) if need_parity else None
+        for i in missing:
+            if i < self.data_shards:
+                out[i] = data[i]
+            else:
+                out[i] = parity[i - self.data_shards]
+        return out
+
+    def verify(self, shards: np.ndarray | jax.Array) -> bool:
+        """True iff parity rows match the data rows."""
+        shards = jnp.asarray(shards, dtype=jnp.uint8)
+        parity = self.encode_parity(shards[: self.data_shards])
+        return bool(jnp.array_equal(parity, shards[self.data_shards:]))
+
+    # ----------------------------------------------------------------------
+
+    def _as_dict(self, shards) -> dict[int, np.ndarray]:
+        if isinstance(shards, dict):
+            return dict(shards)
+        return {i: s for i, s in enumerate(shards) if s is not None}
+
+    def __hash__(self):  # for lru_cache on methods
+        return hash((self.data_shards, self.parity_shards))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, RSCodecJax)
+            and self.data_shards == other.data_shards
+            and self.parity_shards == other.parity_shards
+        )

@@ -1,0 +1,257 @@
+"""EC shard-file pipelines: encode a .dat into .ecNN shards, rebuild missing
+shards, and decode shards back into a .dat/.idx.
+
+Behavioral equivalent of the reference's
+weed/storage/erasure_coding/ec_encoder.go (WriteEcFiles, RebuildEcFiles,
+encodeDatFile, rebuildEcFiles) and ec_decoder.go (WriteDatFile,
+WriteIdxFileFromEcIndex, FindDatFileSize) — with a TPU-first execution
+design: where the Go path is strictly serial (256KB read -> SIMD encode ->
+14 writes, ec_encoder.go:57,162-192), we stream large slabs and overlap host
+file I/O with device compute. JAX dispatch is asynchronous, so the pattern
+
+    read slab -> launch encode -> write previous slab's shards -> block on parity
+
+keeps disk and TPU busy simultaneously. Shard bytes are independent of batch
+size (parity is a per-byte-column GF matmul), so output files stay
+bit-identical to the reference's 256KB batching.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+import numpy as np
+
+from . import idx as idx_mod
+from . import needle_map, types
+from .ec_locate import Geometry
+
+# Per-shard slab size for the pipelined encoder. 4MB/shard => 40MB host reads
+# per step for RS(10,4); divides 1GB and 1MB evenly.
+DEFAULT_BATCH_SIZE = 4 * 1024 * 1024
+# The reference's own buffer size, used when exact loop replication is wanted.
+REFERENCE_BATCH_SIZE = 256 * 1024
+
+
+def _pick_batch(block_size: int, requested: int) -> int:
+    """Largest batch <= requested that divides block_size (the reference
+    requires blockSize %% bufferSize == 0, ec_encoder.go:124)."""
+    if block_size <= requested:
+        return block_size
+    b = requested
+    while block_size % b != 0:
+        b //= 2
+    return max(b, 1)
+
+
+def _read_padded(f, offset: int, length: int, buf: np.ndarray) -> None:
+    """ReadAt with zero fill past EOF (ec_encoder.go:165-177)."""
+    f.seek(offset)
+    got = f.readinto(memoryview(buf)[:length])
+    if got is None:
+        got = 0
+    if got < length:
+        buf[got:length] = 0
+
+
+def generate_ec_files(
+    base_file_name: str,
+    coder,
+    geo: Geometry = Geometry(),
+    batch_size: int = DEFAULT_BATCH_SIZE,
+) -> None:
+    """<base>.dat -> <base>.ec00..ecNN (WriteEcFiles / generateEcFiles /
+    encodeDatFile, ec_encoder.go:56-87,194-231).
+
+    `coder` must expose encode_parity(data[k, B] uint8) -> parity[m, B]
+    (models.coder.ErasureCoder).
+    """
+    k, m = geo.data_shards, geo.parity_shards
+    dat_path = base_file_name + ".dat"
+    dat_size = os.path.getsize(dat_path)
+
+    outs = [open(geo.shard_file_name(base_file_name, i), "wb") for i in range(k + m)]
+    pending: tuple[np.ndarray, object, int] | None = None  # (data, parity_future, nbytes)
+
+    def flush(p) -> None:
+        data, parity_fut, nbytes = p
+        for i in range(k):
+            outs[i].write(memoryview(data[i])[:nbytes])
+        parity = np.asarray(parity_fut)  # blocks until device done
+        for j in range(m):
+            outs[k + j].write(memoryview(parity[j])[:nbytes])
+
+    try:
+        with open(dat_path, "rb") as f:
+            processed = 0
+            for block_size in _row_schedule(geo, dat_size):
+                batch = _pick_batch(block_size, batch_size)
+                for b in range(0, block_size, batch):
+                    # fresh zeros each batch: rows fully past EOF stay zero,
+                    # short reads are zero-padded by _read_padded
+                    data = np.zeros((k, batch), dtype=np.uint8)
+                    for i in range(k):
+                        start = processed + block_size * i + b
+                        if start < dat_size:
+                            _read_padded(f, start, min(batch, dat_size - start), data[i])
+                    parity_fut = coder.encode_parity(data)
+                    if pending is not None:
+                        flush(pending)
+                    pending = (data, parity_fut, batch)
+                processed += block_size * k
+            if pending is not None:
+                flush(pending)
+                pending = None
+    finally:
+        for f2 in outs:
+            f2.close()
+
+
+def _row_schedule(geo: Geometry, dat_size: int):
+    """Yield the per-row block sizes encodeDatFile walks (ec_encoder.go:214-229):
+    strict-> large rows while remaining > large_row, then small rows while > 0."""
+    n_large, n_small = geo.row_counts(dat_size)
+    for _ in range(n_large):
+        yield geo.large_block
+    for _ in range(n_small):
+        yield geo.small_block
+
+
+def write_ec_files(base_file_name: str, coder, geo: Geometry = Geometry()) -> None:
+    """WriteEcFiles equivalent (ec_encoder.go:56-59)."""
+    generate_ec_files(base_file_name, coder, geo)
+
+
+def write_sorted_file_from_idx(base_file_name: str, ext: str = ".ecx") -> None:
+    needle_map.write_sorted_file_from_idx(base_file_name, ext)
+
+
+def rebuild_ec_files(
+    base_file_name: str,
+    coder,
+    geo: Geometry = Geometry(),
+    batch_size: int = DEFAULT_BATCH_SIZE,
+) -> list[int]:
+    """Regenerate missing .ecNN files from the survivors
+    (RebuildEcFiles / generateMissingEcFiles / rebuildEcFiles,
+    ec_encoder.go:61-63,89-118,233-287). Returns the rebuilt shard ids."""
+    total = geo.total_shards
+    have = [os.path.exists(geo.shard_file_name(base_file_name, i)) for i in range(total)]
+    missing = [i for i in range(total) if not have[i]]
+    if not missing:
+        return []
+    present = [i for i in range(total) if have[i]]
+    if len(present) < geo.data_shards:
+        raise ValueError(
+            f"too many shards missing: have {len(present)}, need {geo.data_shards}"
+        )
+
+    ins = {i: open(geo.shard_file_name(base_file_name, i), "rb") for i in present}
+    outs = {i: open(geo.shard_file_name(base_file_name, i), "wb") for i in missing}
+    try:
+        offset = 0
+        while True:
+            bufs: dict[int, np.ndarray] = {}
+            n = None
+            for i in present:
+                ins[i].seek(offset)
+                chunk = ins[i].read(batch_size)
+                if n is None:
+                    n = len(chunk)
+                elif len(chunk) != n:
+                    raise IOError(
+                        f"ec shard size mismatch: expected {n} got {len(chunk)}"
+                    )
+                bufs[i] = np.frombuffer(chunk, dtype=np.uint8)
+            if not n:
+                break
+            rebuilt = coder.reconstruct(bufs)
+            for i in missing:
+                outs[i].write(np.asarray(rebuilt[i], dtype=np.uint8).tobytes())
+            offset += n
+    finally:
+        for f in ins.values():
+            f.close()
+        for f in outs.values():
+            f.close()
+    return missing
+
+
+# -- Decode back to a plain volume (ec_decoder.go) ---------------------------
+
+
+def find_dat_file_size(
+    base_file_name: str,
+    version: int = types.CURRENT_VERSION,
+) -> int:
+    """True .dat length = max(offset + actual_size) over live .ecx entries
+    (FindDatFileSize, ec_decoder.go:48-70)."""
+    dat_size = 0
+    ids, offs, sizes = idx_mod.read_index_file(base_file_name + ".ecx")
+    for i in range(len(ids)):
+        size = int(sizes[i])
+        if types.size_is_deleted(size):
+            continue
+        entry_stop = types.stored_to_actual_offset(int(offs[i])) + types.actual_size(
+            size, version
+        )
+        dat_size = max(dat_size, entry_stop)
+    return dat_size
+
+
+def write_dat_file(
+    base_file_name: str,
+    dat_file_size: int,
+    geo: Geometry = Geometry(),
+    shard_file_names: list[str] | None = None,
+) -> None:
+    """Re-interleave data shards .ec00..ec<k-1> into <base>.dat
+    (WriteDatFile, ec_decoder.go:153-201). Note the reference's large-row
+    loop here is `>=` where the encoder's is strict `>` — replicated as-is,
+    quirk included."""
+    k = geo.data_shards
+    names = shard_file_names or [geo.shard_file_name(base_file_name, i) for i in range(k)]
+    ins = [open(names[i], "rb") for i in range(k)]
+    try:
+        with open(base_file_name + ".dat", "wb") as out:
+            remaining = dat_file_size
+            while remaining >= k * geo.large_block:
+                for i in range(k):
+                    chunk = ins[i].read(geo.large_block)
+                    if len(chunk) != geo.large_block:
+                        raise IOError(f"short large block from {names[i]}")
+                    out.write(chunk)
+                    remaining -= geo.large_block
+            while remaining > 0:
+                for i in range(k):
+                    take = min(remaining, geo.small_block)
+                    if take <= 0:
+                        break
+                    chunk = ins[i].read(take)
+                    if len(chunk) != take:
+                        raise IOError(f"short small block from {names[i]}")
+                    out.write(chunk)
+                    remaining -= take
+    finally:
+        for f in ins:
+            f.close()
+
+
+def write_idx_file_from_ec_index(base_file_name: str) -> None:
+    """Reconstruct <base>.idx from .ecx + .ecj tombstones
+    (WriteIdxFileFromEcIndex, ec_decoder.go:18-43): copy .ecx, then append a
+    tombstone entry per journaled deletion."""
+    ecx = base_file_name + ".ecx"
+    with open(ecx, "rb") as f:
+        payload = f.read()
+    extra = b""
+    ecj = base_file_name + ".ecj"
+    if os.path.exists(ecj):
+        with open(ecj, "rb") as f:
+            j = f.read()
+        for i in range(0, len(j) - 7, types.NEEDLE_ID_SIZE):
+            nid = int.from_bytes(j[i : i + 8], "big")
+            extra += types.pack_needle_map_entry(nid, 0, types.TOMBSTONE_FILE_SIZE)
+    with open(base_file_name + ".idx", "wb") as f:
+        f.write(payload + extra)

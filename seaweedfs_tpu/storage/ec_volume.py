@@ -1,0 +1,197 @@
+"""EC volume runtime: sorted-index needle lookup, deletion journal, and
+needle reads across shard files (with degraded-mode reconstruction).
+
+Behavioral equivalent of the reference's ec_volume.go / ec_volume_delete.go /
+store_ec.go read path (SearchNeedleFromSortedIndex ec_volume.go:230-255,
+DeleteNeedleFromEcx / RebuildEcxFile ec_volume_delete.go:27-98,
+ReadEcShardNeedle store_ec.go:136-176).
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from . import types
+from .ec_locate import Geometry, locate_data
+
+
+class NotFoundError(KeyError):
+    pass
+
+
+def search_needle_from_sorted_index(
+    ecx_file, ecx_file_size: int, needle_id: int, process_fn=None
+) -> tuple[int, int]:
+    """Binary-search the sorted .ecx for needle_id -> (stored_offset, size).
+
+    process_fn(file, entry_offset) is invoked on hit before returning
+    (used to tombstone in place). Raises NotFoundError on miss.
+    (ec_volume.go:230-255)
+    """
+    lo, hi = 0, ecx_file_size // types.NEEDLE_MAP_ENTRY_SIZE
+    while lo < hi:
+        mid = (lo + hi) // 2
+        ecx_file.seek(mid * types.NEEDLE_MAP_ENTRY_SIZE)
+        buf = ecx_file.read(types.NEEDLE_MAP_ENTRY_SIZE)
+        key, offset, size = types.unpack_needle_map_entry(buf)
+        if key == needle_id:
+            if process_fn is not None:
+                process_fn(ecx_file, mid * types.NEEDLE_MAP_ENTRY_SIZE)
+            return offset, size
+        if key < needle_id:
+            lo = mid + 1
+        else:
+            hi = mid
+    raise NotFoundError(f"needle {needle_id:x} not found in ecx")
+
+
+def mark_needle_deleted(ecx_file, entry_offset: int) -> None:
+    """Write size=-1 tombstone in place at entry_offset+12
+    (MarkNeedleDeleted, ec_volume_delete.go:13-25)."""
+    ecx_file.seek(entry_offset + types.NEEDLE_ID_SIZE + types.OFFSET_SIZE)
+    ecx_file.write(
+        types.size_to_u32(types.TOMBSTONE_FILE_SIZE).to_bytes(4, "big")
+    )
+
+
+def delete_needle_from_ecx(base_file_name: str, needle_id: int) -> None:
+    """Tombstone the .ecx entry in place and append the id to the .ecj journal
+    (DeleteNeedleFromEcx, ec_volume_delete.go:27-49). Missing needle is a no-op."""
+    ecx_path = base_file_name + ".ecx"
+    size = os.path.getsize(ecx_path)
+    with open(ecx_path, "r+b") as f:
+        try:
+            search_needle_from_sorted_index(f, size, needle_id, mark_needle_deleted)
+        except NotFoundError:
+            return
+    with open(base_file_name + ".ecj", "ab") as j:
+        j.write(needle_id.to_bytes(8, "big"))
+
+
+def rebuild_ecx_file(base_file_name: str) -> None:
+    """Replay the .ecj journal into .ecx tombstones, then remove the journal
+    (RebuildEcxFile, ec_volume_delete.go:51-98)."""
+    ecj_path = base_file_name + ".ecj"
+    if not os.path.exists(ecj_path):
+        return
+    ecx_path = base_file_name + ".ecx"
+    ecx_size = os.path.getsize(ecx_path)
+    with open(ecx_path, "r+b") as ecx, open(ecj_path, "rb") as ecj:
+        while True:
+            buf = ecj.read(types.NEEDLE_ID_SIZE)
+            if len(buf) != types.NEEDLE_ID_SIZE:
+                break
+            nid = int.from_bytes(buf, "big")
+            try:
+                search_needle_from_sorted_index(ecx, ecx_size, nid, mark_needle_deleted)
+            except NotFoundError:
+                pass
+    os.remove(ecj_path)
+
+
+class EcVolume:
+    """Read-side runtime over a local set of shard files.
+
+    Single-process analogue of EcVolume + Store.ReadEcShardNeedle
+    (store_ec.go:136): looks up the needle in .ecx, maps it to shard
+    intervals, reads from local shard files, and — when shards are missing —
+    reconstructs the interval bytes from any k survivors through the coder
+    (the degraded path of store_ec.go:339-393).
+    """
+
+    def __init__(
+        self,
+        base_file_name: str,
+        coder,
+        geo: Geometry = Geometry(),
+        version: int = types.CURRENT_VERSION,
+    ):
+        self.base = base_file_name
+        self.coder = coder
+        self.geo = geo
+        self.version = version
+        self.ecx_path = base_file_name + ".ecx"
+        # unbuffered: in-place tombstoning writes through other handles must
+        # be visible immediately (BufferedReader can serve stale bytes after
+        # an intra-buffer seek)
+        self._ecx_file = open(self.ecx_path, "rb", buffering=0)
+        self._ecx_size = os.path.getsize(self.ecx_path)
+        self.shard_files: dict[int, object] = {}
+        for i in range(geo.total_shards):
+            p = geo.shard_file_name(base_file_name, i)
+            if os.path.exists(p):
+                self.shard_files[i] = open(p, "rb")
+        if not self.shard_files:
+            raise FileNotFoundError(f"no shards for {base_file_name}")
+        any_shard = next(iter(self.shard_files.values()))
+        any_shard.seek(0, 2)
+        self.shard_size = any_shard.tell()
+
+    def close(self) -> None:
+        for f in self.shard_files.values():
+            f.close()
+        self.shard_files.clear()
+        self._ecx_file.close()
+
+    # dat size as the EC runtime derives it: k * shard file size
+    # (LocateEcShardNeedleInterval, ec_volume.go:218-224)
+    @property
+    def dat_size_estimate(self) -> int:
+        return self.geo.data_shards * self.shard_size
+
+    def find_needle(self, needle_id: int) -> tuple[int, int]:
+        """-> (actual_offset, size). Raises NotFoundError if absent; a
+        tombstoned needle is returned with its negative size (callers check
+        types.size_is_deleted, as read_needle_blob does)."""
+        stored_off, nsize = search_needle_from_sorted_index(
+            self._ecx_file, self._ecx_size, needle_id
+        )
+        return types.stored_to_actual_offset(stored_off), nsize
+
+    def read_needle_blob(self, needle_id: int) -> bytes:
+        """Read the full on-disk needle record (header..padding) for a needle."""
+        offset, size = self.find_needle(needle_id)
+        if types.size_is_deleted(size):
+            raise NotFoundError(f"needle {needle_id:x} deleted")
+        length = types.actual_size(size, self.version)
+        return self.read_extent(offset, length)
+
+    def read_extent(self, offset: int, length: int) -> bytes:
+        """Read arbitrary .dat-space extent through the shard layout."""
+        intervals = locate_data(self.geo, self.dat_size_estimate, offset, length)
+        out = bytearray()
+        for iv in intervals:
+            shard_id, shard_off = iv.to_shard_id_and_offset(self.geo)
+            out += self._read_interval(shard_id, shard_off, iv.size)
+        return bytes(out)
+
+    def _read_interval(self, shard_id: int, shard_off: int, size: int) -> bytes:
+        f = self.shard_files.get(shard_id)
+        if f is not None:
+            f.seek(shard_off)
+            data = f.read(size)
+            if len(data) == size:
+                return data
+            data += b"\0" * (size - len(data))
+            return data
+        # degraded: rebuild this interval from any k surviving shards
+        # (recoverOneRemoteEcShardInterval, store_ec.go:339-393)
+        bufs: dict[int, np.ndarray] = {}
+        for i, sf in self.shard_files.items():
+            if len(bufs) == self.geo.data_shards:
+                break
+            sf.seek(shard_off)
+            chunk = sf.read(size)
+            chunk += b"\0" * (size - len(chunk))
+            bufs[i] = np.frombuffer(chunk, dtype=np.uint8)
+        if len(bufs) < self.geo.data_shards:
+            raise IOError(
+                f"cannot reconstruct shard {shard_id}: only {len(bufs)} shards available"
+            )
+        rebuilt = self.coder.reconstruct_data(bufs)
+        return np.asarray(rebuilt[shard_id], dtype=np.uint8).tobytes()
+
+    def delete_needle(self, needle_id: int) -> None:
+        delete_needle_from_ecx(self.base, needle_id)

@@ -1,0 +1,93 @@
+"""Core storage types: NeedleId / Offset / Size / Cookie and their codecs.
+
+Wire-compatible with the reference's on-disk formats
+(/root/reference/weed/storage/types/needle_types.go,
+offset_4bytes.go, needle_id_type.go; all integers big-endian per
+weed/util/bytes.go). Offsets are stored as uint32 in units of
+NEEDLE_PADDING_SIZE (8) bytes, capping volumes at 32GB (4-byte offset build).
+"""
+
+from __future__ import annotations
+
+import struct
+
+NEEDLE_ID_SIZE = 8
+OFFSET_SIZE = 4
+SIZE_SIZE = 4
+COOKIE_SIZE = 4
+DATA_SIZE_SIZE = 4
+TIMESTAMP_SIZE = 8
+NEEDLE_HEADER_SIZE = COOKIE_SIZE + NEEDLE_ID_SIZE + SIZE_SIZE  # 16
+NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE  # 16
+NEEDLE_PADDING_SIZE = 8
+TOMBSTONE_FILE_SIZE = -1  # Size(-1) tombstone marker
+NEEDLE_ID_EMPTY = 0
+MAX_POSSIBLE_VOLUME_SIZE = 4 * 1024 * 1024 * 1024 * 8  # 32GB
+
+_U32 = struct.Struct(">I")
+_U64 = struct.Struct(">Q")
+
+
+def size_is_deleted(size: int) -> bool:
+    return size < 0 or size == TOMBSTONE_FILE_SIZE
+
+
+def size_is_valid(size: int) -> bool:
+    return size > 0 and size != TOMBSTONE_FILE_SIZE
+
+
+def offset_to_stored(actual_offset: int) -> int:
+    """Byte offset -> stored uint32 (units of 8 bytes)."""
+    return (actual_offset // NEEDLE_PADDING_SIZE) & 0xFFFFFFFF
+
+
+def stored_to_actual_offset(stored: int) -> int:
+    return stored * NEEDLE_PADDING_SIZE
+
+
+def size_to_u32(size: int) -> int:
+    """int32 Size -> uint32 wire value (two's complement)."""
+    return size & 0xFFFFFFFF
+
+
+def u32_to_size(v: int) -> int:
+    """uint32 wire value -> signed int32 Size."""
+    return v - (1 << 32) if v & 0x80000000 else v
+
+
+def pack_needle_map_entry(needle_id: int, stored_offset: int, size: int) -> bytes:
+    """16-byte .idx/.ecx entry: id(8) + offset(4) + size(4), big-endian."""
+    return _U64.pack(needle_id) + _U32.pack(stored_offset) + _U32.pack(size_to_u32(size))
+
+
+def unpack_needle_map_entry(b: bytes) -> tuple[int, int, int]:
+    """-> (needle_id, stored_offset, signed size)."""
+    (nid,) = _U64.unpack_from(b, 0)
+    (off,) = _U32.unpack_from(b, 8)
+    (sz,) = _U32.unpack_from(b, 12)
+    return nid, off, u32_to_size(sz)
+
+
+NEEDLE_CHECKSUM_SIZE = 4
+VERSION1, VERSION2, VERSION3 = 1, 2, 3
+CURRENT_VERSION = VERSION3
+
+
+def padding_length(size: int, version: int = CURRENT_VERSION) -> int:
+    """Needle padding is always 1..8 bytes — when the record is already
+    8-aligned the reference still appends a full 8
+    (needle_read.go PaddingLength:197-203)."""
+    body = NEEDLE_HEADER_SIZE + size + NEEDLE_CHECKSUM_SIZE
+    if version == VERSION3:
+        body += TIMESTAMP_SIZE
+    return NEEDLE_PADDING_SIZE - (body % NEEDLE_PADDING_SIZE)
+
+
+def actual_size(size: int, version: int = CURRENT_VERSION) -> int:
+    """Total bytes a needle occupies in the .dat file
+    (needle_read.go GetActualSize:300 = header + body + checksum
+    [+ timestamp for v3] + padding)."""
+    body = NEEDLE_HEADER_SIZE + size + NEEDLE_CHECKSUM_SIZE
+    if version == VERSION3:
+        body += TIMESTAMP_SIZE
+    return body + padding_length(size, version)

@@ -1,0 +1,16 @@
+import os
+
+# Tests run on a virtual 8-device CPU mesh; the real TPU is reserved for
+# bench.py. The container's sitecustomize registers the remote "axon" TPU
+# plugin at interpreter start (and pins JAX_PLATFORMS=axon), so plain env
+# vars are too late / overridden — switch platforms through jax.config
+# before any backend is instantiated.
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,277 @@
+"""End-to-end EC pipeline tests — the port of the reference's oracle
+(/root/reference/weed/storage/erasure_coding/ec_test.go): encode a real
+volume with shrunken block sizes, then walk every live needle and assert the
+bytes read back through LocateData + shard files equal the .dat bytes, plus
+reconstruct every interval from a random k-of-n shard subset.
+
+Runs against the reference's committed fixture volume (1.dat/1.idx) when
+present, and always against a synthetic volume.
+"""
+
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.models.coder import new_coder
+from seaweedfs_tpu.storage import ec_files, idx, needle_map, types
+from seaweedfs_tpu.storage.ec_locate import Geometry, locate_data
+from seaweedfs_tpu.storage import ec_volume as ecv
+
+# ec_test.go:16-19 shrunken geometry
+TEST_GEO = Geometry(large_block=10000, small_block=100)
+REF_FIXTURE = "/root/reference/weed/storage/erasure_coding/1"
+
+
+def _make_synthetic_volume(base: str, n_needles=40, seed=0) -> None:
+    """Write a .dat of concatenated fake needle records + matching .idx.
+    EC operates below the needle codec, so records are opaque padded blobs."""
+    rng = np.random.default_rng(seed)
+    # 8-byte superblock stand-in: offset 0 is never a needle (a zero stored
+    # offset means "deleted" to the needle-map replay, ec_encoder.go:298)
+    dat = bytearray(b"\x03" + bytes(7))
+    entries = []
+    for i in range(1, n_needles + 1):
+        size = int(rng.integers(1, 4000))
+        total = types.actual_size(size)
+        offset = len(dat)
+        blob = rng.integers(0, 256, total).astype(np.uint8).tobytes()
+        dat += blob
+        entries.append((i, types.offset_to_stored(offset), size))
+    with open(base + ".dat", "wb") as f:
+        f.write(bytes(dat))
+    ids = np.array([e[0] for e in entries], np.uint64)
+    offs = np.array([e[1] for e in entries], np.uint32)
+    sizes = np.array([e[2] for e in entries], np.int32)
+    with open(base + ".idx", "wb") as f:
+        f.write(idx.pack_index_arrays(ids, offs, sizes))
+
+
+def _read_ec_interval(base, geo, dat_size, offset, size):
+    """Read .dat extent [offset, offset+size) back through the shard files."""
+    out = bytearray()
+    for iv in locate_data(geo, dat_size, offset, size):
+        shard_id, shard_off = iv.to_shard_id_and_offset(geo)
+        with open(geo.shard_file_name(base, shard_id), "rb") as f:
+            f.seek(shard_off)
+            out += f.read(iv.size)
+    return bytes(out)
+
+
+def _reconstruct_interval_from_subset(base, geo, coder, shard_id, shard_off, size, rng):
+    """readFromOtherEcFiles (ec_test.go:143-174): reconstruct one shard's
+    interval from a random k-subset of the other shards."""
+    chosen = []
+    while len(chosen) < geo.data_shards:
+        n = int(rng.integers(0, geo.total_shards))
+        if n == shard_id or n in chosen:
+            continue
+        chosen.append(n)
+    bufs = {}
+    for i in chosen:
+        with open(geo.shard_file_name(base, i), "rb") as f:
+            f.seek(shard_off)
+            chunk = f.read(size)
+        bufs[i] = np.frombuffer(chunk, np.uint8)
+    rec = coder.reconstruct_data(bufs) if shard_id < geo.data_shards else coder.reconstruct(bufs)
+    return np.asarray(rec[shard_id]).tobytes()
+
+
+def _validate_volume(base, geo, coder, check_subsets=True):
+    """validateFiles (ec_test.go:44-72)."""
+    db = needle_map.read_needle_map(base + ".idx")
+    dat_size = os.path.getsize(base + ".dat")
+    with open(base + ".dat", "rb") as dat:
+        rng = np.random.default_rng(42)
+        count = 0
+        for nid, stored_off, size in db.sorted_entries():
+            offset = types.stored_to_actual_offset(stored_off)
+            dat.seek(offset)
+            want = dat.read(size)
+            got = _read_ec_interval(base, geo, dat_size, offset, size)
+            assert got == want, f"needle {nid:x} mismatch via shard read"
+            if check_subsets:
+                for iv in locate_data(geo, dat_size, offset, size):
+                    shard_id, shard_off = iv.to_shard_id_and_offset(geo)
+                    rec = _reconstruct_interval_from_subset(
+                        base, geo, coder, shard_id, shard_off, iv.size, rng
+                    )
+                    with open(geo.shard_file_name(base, shard_id), "rb") as f:
+                        f.seek(shard_off)
+                        assert rec == f.read(iv.size), (
+                            f"reconstructed interval mismatch needle {nid:x}"
+                        )
+            count += 1
+        assert count > 0
+
+
+@pytest.fixture(params=["tpu", "cpu"])
+def coder(request):
+    return new_coder(10, 4, request.param)
+
+
+def test_encode_validate_synthetic(tmp_path, coder):
+    base = str(tmp_path / "7")
+    _make_synthetic_volume(base)
+    ec_files.generate_ec_files(base, coder, TEST_GEO, batch_size=50)
+    ec_files.write_sorted_file_from_idx(base)
+    _validate_volume(base, TEST_GEO, coder)
+
+
+@pytest.mark.skipif(
+    not os.path.exists(REF_FIXTURE + ".dat"), reason="reference fixture absent"
+)
+def test_encode_validate_reference_fixture(tmp_path, coder):
+    """The reference's own committed 2.5MB fixture volume, bufferSize=50
+    (TestEncodingDecoding, ec_test.go:21-42)."""
+    base = str(tmp_path / "1")
+    shutil.copy(REF_FIXTURE + ".dat", base + ".dat")
+    shutil.copy(REF_FIXTURE + ".idx", base + ".idx")
+    ec_files.generate_ec_files(base, coder, TEST_GEO, batch_size=50)
+    ec_files.write_sorted_file_from_idx(base)
+    _validate_volume(base, TEST_GEO, coder, check_subsets=False)
+
+
+def test_batch_size_invariance(tmp_path):
+    """Shard files must be bit-identical regardless of batch size — this is
+    what licenses the TPU path's large slabs vs the reference's 256KB."""
+    coder = new_coder(10, 4, "tpu")
+    base1 = str(tmp_path / "a")
+    base2 = str(tmp_path / "b")
+    _make_synthetic_volume(base1, seed=3)
+    shutil.copy(base1 + ".dat", base2 + ".dat")
+    shutil.copy(base1 + ".idx", base2 + ".idx")
+    ec_files.generate_ec_files(base1, coder, TEST_GEO, batch_size=50)
+    ec_files.generate_ec_files(base2, coder, TEST_GEO, batch_size=10000)
+    for i in range(14):
+        with open(TEST_GEO.shard_file_name(base1, i), "rb") as f1, open(
+            TEST_GEO.shard_file_name(base2, i), "rb"
+        ) as f2:
+            assert f1.read() == f2.read(), f"shard {i} differs across batch sizes"
+
+
+def test_shard_sizes_match_row_schedule(tmp_path):
+    coder = new_coder(10, 4, "cpu")
+    base = str(tmp_path / "s")
+    _make_synthetic_volume(base, seed=5)
+    dat_size = os.path.getsize(base + ".dat")
+    ec_files.generate_ec_files(base, coder, TEST_GEO, batch_size=50)
+    want = TEST_GEO.shard_size(dat_size)
+    for i in range(14):
+        assert os.path.getsize(TEST_GEO.shard_file_name(base, i)) == want
+
+
+def test_rebuild_missing_shards(tmp_path):
+    """ec.rebuild path: delete shards, regenerate, byte-compare
+    (BASELINE config #3 semantics)."""
+    coder = new_coder(10, 4, "tpu")
+    base = str(tmp_path / "r")
+    _make_synthetic_volume(base, seed=7)
+    ec_files.generate_ec_files(base, coder, TEST_GEO, batch_size=100)
+    originals = {}
+    for i in (0, 5, 13):
+        p = TEST_GEO.shard_file_name(base, i)
+        with open(p, "rb") as f:
+            originals[i] = f.read()
+        os.remove(p)
+    rebuilt = ec_files.rebuild_ec_files(base, coder, TEST_GEO, batch_size=1 << 20)
+    assert sorted(rebuilt) == [0, 5, 13]
+    for i, want in originals.items():
+        with open(TEST_GEO.shard_file_name(base, i), "rb") as f:
+            assert f.read() == want, f"rebuilt shard {i} differs"
+
+
+def test_rebuild_too_many_missing(tmp_path):
+    coder = new_coder(10, 4, "cpu")
+    base = str(tmp_path / "t")
+    _make_synthetic_volume(base, seed=8)
+    ec_files.generate_ec_files(base, coder, TEST_GEO, batch_size=100)
+    for i in range(5):
+        os.remove(TEST_GEO.shard_file_name(base, i))
+    with pytest.raises(ValueError):
+        ec_files.rebuild_ec_files(base, coder, TEST_GEO)
+
+
+def test_decode_roundtrip(tmp_path):
+    """encode -> decode back to .dat must reproduce the original bytes up to
+    the ecx-derived size (WriteDatFile/FindDatFileSize, ec_decoder.go)."""
+    coder = new_coder(10, 4, "tpu")
+    base = str(tmp_path / "d")
+    _make_synthetic_volume(base, seed=9)
+    with open(base + ".dat", "rb") as f:
+        original = f.read()
+    ec_files.generate_ec_files(base, coder, TEST_GEO, batch_size=100)
+    ec_files.write_sorted_file_from_idx(base)
+    dat_size = ec_files.find_dat_file_size(base)
+    assert dat_size == len(original)  # synthetic volume is dense
+    os.remove(base + ".dat")
+    ec_files.write_dat_file(base, dat_size, TEST_GEO)
+    with open(base + ".dat", "rb") as f:
+        assert f.read() == original
+
+
+def test_deletion_journal_and_ecx_rebuild(tmp_path):
+    coder = new_coder(10, 4, "cpu")
+    base = str(tmp_path / "j")
+    _make_synthetic_volume(base, seed=10, n_needles=20)
+    ec_files.generate_ec_files(base, coder, TEST_GEO, batch_size=100)
+    ec_files.write_sorted_file_from_idx(base)
+    vol = ecv.EcVolume(base, coder, TEST_GEO)
+    # needle 5 present, then deleted
+    blob = vol.read_needle_blob(5)
+    assert len(blob) > 0
+    vol.delete_needle(5)
+    with pytest.raises(ecv.NotFoundError):
+        vol.read_needle_blob(5)
+    # journal holds the id
+    with open(base + ".ecj", "rb") as f:
+        assert int.from_bytes(f.read(8), "big") == 5
+    # idx reconstruction appends a tombstone entry
+    ec_files.write_idx_file_from_ec_index(base)
+    ids, offs, sizes = idx.read_index_file(base + ".idx")
+    assert int(ids[-1]) == 5 and int(sizes[-1]) == types.TOMBSTONE_FILE_SIZE
+    # replaying the journal removes it and keeps the tombstone in .ecx
+    ecv.rebuild_ecx_file(base)
+    assert not os.path.exists(base + ".ecj")
+    vol2 = ecv.EcVolume(base, coder, TEST_GEO)
+    with pytest.raises(ecv.NotFoundError):
+        vol2.read_needle_blob(5)
+    vol.close()
+    vol2.close()
+
+
+def test_degraded_read(tmp_path):
+    """Reads still return correct bytes with 4 shards gone
+    (store_ec.go:339 degraded path)."""
+    coder = new_coder(10, 4, "tpu")
+    base = str(tmp_path / "g")
+    _make_synthetic_volume(base, seed=11)
+    ec_files.generate_ec_files(base, coder, TEST_GEO, batch_size=100)
+    ec_files.write_sorted_file_from_idx(base)
+    vol = ecv.EcVolume(base, coder, TEST_GEO)
+    want = {nid: vol.read_needle_blob(nid) for nid in (1, 7, 25)}
+    vol.close()
+    for i in (0, 3, 9, 12):
+        os.remove(TEST_GEO.shard_file_name(base, i))
+    vol = ecv.EcVolume(base, coder, TEST_GEO)
+    for nid, blob in want.items():
+        assert vol.read_needle_blob(nid) == blob, f"degraded read needle {nid}"
+    vol.close()
+
+
+def test_locate_data_reference_cases():
+    """TestLocateData (ec_test.go:189-200) pinned cases."""
+    geo = TEST_GEO
+    intervals = locate_data(geo, 10 * 10000 + 1, 10 * 10000, 1)
+    assert len(intervals) == 1
+    iv = intervals[0]
+    assert (iv.block_index, iv.inner_block_offset, iv.size, iv.is_large_block) == (
+        0, 0, 1, False,
+    )
+    assert iv.large_block_rows_count == 1
+    # spanning read across large->small boundary
+    intervals = locate_data(
+        geo, 10 * 10000 + 1, 10 * 10000 // 2 + 100, 10 * 10000 + 1 - 10 * 10000 // 2 - 100
+    )
+    assert sum(i.size for i in intervals) == 10 * 10000 + 1 - 10 * 10000 // 2 - 100
