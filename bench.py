@@ -1,0 +1,95 @@
+"""Headline benchmark: EC encode throughput (GB/s per chip), RS(10,4).
+
+Measures the framework's JAX/TPU Reed-Solomon encode kernel — the
+replacement for the reference's single-stream klauspost/reedsolomon loop
+(/root/reference/weed/storage/erasure_coding/ec_encoder.go:162-192; see
+BASELINE.md: no published EC throughput, target is >=8x the Go SSSE3 path).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "GB/s", "vs_baseline": N}
+
+`value`    — data GB/s through the device encode kernel (steady state).
+`vs_baseline` — ratio vs the CPU reference path measured on this host
+  (native C++ codec if built, else the numpy table path), standing in for
+  the reference's Go/SSSE3 single-stream encoder.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _bench_device(data_shards: int = 10, parity_shards: int = 4,
+                  col_bytes: int = 8 * 1024 * 1024, iters: int = 8) -> float:
+    """Data GB/s of the jitted encode kernel, input resident on device."""
+    import jax
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ops import gf256
+    from seaweedfs_tpu.ops.rs_jax import gf_matmul_bits, gf_matrix_to_bits
+
+    parity_bits = jnp.asarray(
+        gf_matrix_to_bits(gf256.parity_matrix(data_shards, parity_shards))
+    )
+
+    @jax.jit
+    def encode(data):
+        return gf_matmul_bits(parity_bits, data)
+
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(
+        rng.integers(0, 256, size=(data_shards, col_bytes), dtype=np.uint8)
+    )
+    encode(data).block_until_ready()  # compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = encode(data)
+    out.block_until_ready()
+    dt = time.perf_counter() - t0
+    total = data_shards * col_bytes * iters
+    return total / dt / 1e9
+
+
+def _bench_cpu_reference(data_shards: int = 10, parity_shards: int = 4) -> float:
+    """GB/s of the host CPU reference path (stand-in for klauspost Go/SSSE3)."""
+    col_bytes = 2 * 1024 * 1024
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=(data_shards, col_bytes), dtype=np.uint8)
+    try:
+        from seaweedfs_tpu.ops.rs_native import RSCodecNative
+
+        coder = RSCodecNative(data_shards, parity_shards)
+    except Exception:
+        from seaweedfs_tpu.ops.rs_cpu import RSCodecCPU
+
+        coder = RSCodecCPU(data_shards, parity_shards)
+    coder.encode_parity(data)  # warm
+    iters = 4
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        coder.encode_parity(data)
+    dt = time.perf_counter() - t0
+    return data_shards * col_bytes * iters / dt / 1e9
+
+
+def main() -> None:
+    device_gbps = _bench_device()
+    cpu_gbps = _bench_cpu_reference()
+    print(
+        json.dumps(
+            {
+                "metric": "ec_encode_rs10_4_GBps_per_chip",
+                "value": round(device_gbps, 3),
+                "unit": "GB/s",
+                "vs_baseline": round(device_gbps / cpu_gbps, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -107,6 +107,16 @@ def gf_matmul_bits(matrix_bits: jax.Array, data: jax.Array) -> jax.Array:
     return _pack_bits(acc & 1)
 
 
+@functools.lru_cache(maxsize=1024)
+def decode_matrix_bits(
+    data_shards: int, parity_shards: int, present: tuple[int, ...]
+) -> tuple[np.ndarray, tuple[int, ...]]:
+    """Cached bit-form decode matrix for a survivor set: host Gauss-Jordan
+    inversion + gf_matrix_to_bits run once per (geometry, survivor set)."""
+    dec, used = gf256.decode_matrix_for(data_shards, parity_shards, list(present))
+    return gf_matrix_to_bits(dec), tuple(used)
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2))
 def _encode_jit(data: jax.Array, data_shards: int, parity_shards: int) -> jax.Array:
     gp = gf256.parity_matrix(data_shards, parity_shards)
@@ -148,20 +158,17 @@ class RSCodecJax:
         return out[:, :b]
 
     def encode(self, shards: np.ndarray | jax.Array) -> jax.Array:
-        """shards [total, B]: fills parity rows from data rows, returns all."""
+        """[k, B] data or [total, B] shards: fills parity rows, returns all."""
         shards = jnp.asarray(shards, dtype=jnp.uint8)
-        assert shards.shape[0] == self.total_shards, shards.shape
+        assert shards.shape[0] in (self.data_shards, self.total_shards), shards.shape
         parity = self.encode_parity(shards[: self.data_shards])
         return jnp.concatenate([shards[: self.data_shards], parity], axis=0)
 
     # -- Reconstruct -------------------------------------------------------
 
-    @functools.lru_cache(maxsize=256)
     def _decode_bits(self, present: tuple[int, ...]) -> tuple[jax.Array, tuple[int, ...]]:
-        dec, used = gf256.decode_matrix_for(
-            self.data_shards, self.parity_shards, list(present)
-        )
-        return jnp.asarray(gf_matrix_to_bits(dec)), tuple(used)
+        bits, used = decode_matrix_bits(self.data_shards, self.parity_shards, present)
+        return jnp.asarray(bits), used
 
     def reconstruct_data(
         self, shards: dict[int, np.ndarray] | list[np.ndarray | None]

@@ -1,0 +1,75 @@
+"""ShardedCoder over the virtual 8-device CPU mesh (conftest forces
+XLA_FLAGS=--xla_force_host_platform_device_count=8)."""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops.rs_cpu import RSCodecCPU
+from seaweedfs_tpu.parallel.mesh import ShardedCoder, make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh()
+
+
+@pytest.fixture(scope="module")
+def coder(mesh):
+    return ShardedCoder(10, 4, mesh=mesh)
+
+
+def test_mesh_has_8_devices(mesh):
+    assert mesh.devices.size == 8
+
+
+def test_sharded_encode_matches_cpu(coder):
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(10, 5000), dtype=np.uint8)  # odd B
+    ref = RSCodecCPU(10, 4).encode_parity(data)
+    got = np.asarray(coder.encode_parity(data))
+    assert got.shape == (4, 5000)
+    assert np.array_equal(got, ref)
+
+
+def test_sharded_reconstruct(coder):
+    rng = np.random.default_rng(1)
+    data = rng.integers(0, 256, size=(10, 2048), dtype=np.uint8)
+    shards = np.asarray(coder.encode(data))
+    survivors = {i: shards[i] for i in range(14) if i not in (1, 4, 10, 12)}
+    rebuilt = coder.reconstruct(survivors)
+    for i in (1, 4, 10, 12):
+        assert np.array_equal(np.asarray(rebuilt[i]), shards[i])
+
+
+def test_parity_checksum_zero_then_nonzero(coder):
+    rng = np.random.default_rng(2)
+    data = rng.integers(0, 256, size=(10, 1024), dtype=np.uint8)
+    shards = np.asarray(coder.encode(data)).copy()
+    assert int(np.asarray(coder.parity_checksum(shards))) == 0
+    shards[3, 100] ^= 0xFF
+    assert int(np.asarray(coder.parity_checksum(shards))) != 0
+
+
+def test_alt_geometries(mesh):
+    for k, m in ((6, 3), (12, 4)):
+        c = ShardedCoder(k, m, mesh=mesh)
+        rng = np.random.default_rng(k)
+        data = rng.integers(0, 256, size=(k, 999), dtype=np.uint8)
+        ref = RSCodecCPU(k, m).encode_parity(data)
+        assert np.array_equal(np.asarray(c.encode_parity(data)), ref)
+
+
+def test_graft_entry_single_chip():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = np.asarray(fn(*args))
+    ref = RSCodecCPU(10, 4).encode_parity(args[0])
+    assert np.array_equal(out[10:], ref)
+    assert np.array_equal(out[:10], args[0])
+
+
+def test_graft_dryrun_multichip():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
