@@ -1,0 +1,42 @@
+"""CRC32-Castagnoli needle checksums.
+
+The reference uses Go's hash/crc32 Castagnoli table
+(/root/reference/weed/storage/needle/crc.go:12) for every needle's data
+checksum. google_crc32c provides the same polynomial (0x1EDC6F41,
+hardware-accelerated); a small table fallback keeps the package importable
+without it.
+"""
+
+from __future__ import annotations
+
+try:
+    import google_crc32c
+
+    def crc32c(data: bytes, value: int = 0) -> int:
+        return google_crc32c.extend(value, bytes(data))
+
+except ImportError:  # pragma: no cover - fallback for stripped environments
+    _POLY = 0x82F63B78  # reversed 0x1EDC6F41
+
+    def _make_table() -> list[int]:
+        table = []
+        for i in range(256):
+            c = i
+            for _ in range(8):
+                c = (c >> 1) ^ _POLY if c & 1 else c >> 1
+            table.append(c)
+        return table
+
+    _TABLE = _make_table()
+
+    def crc32c(data: bytes, value: int = 0) -> int:
+        c = value ^ 0xFFFFFFFF
+        for b in bytes(data):
+            c = _TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
+        return c ^ 0xFFFFFFFF
+
+
+def crc_value_legacy(crc: int) -> int:
+    """The deprecated CRC.Value() transform (crc.go:25-27); read-side accepts
+    either this or the raw value for backward compatibility."""
+    return (((crc >> 15) | (crc << 17)) + 0xA282EAD8) & 0xFFFFFFFF

@@ -15,10 +15,7 @@ import numpy as np
 
 from . import types
 from .ec_locate import Geometry, locate_data
-
-
-class NotFoundError(KeyError):
-    pass
+from .errors import NotFoundError
 
 
 def search_needle_from_sorted_index(

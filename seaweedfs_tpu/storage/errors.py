@@ -1,0 +1,14 @@
+"""Shared storage error types (one definition for Volume and EcVolume paths,
+mirroring the sentinel errors of /root/reference/weed/storage/volume_write.go:15-17)."""
+
+
+class NotFoundError(KeyError):
+    """Needle id absent (ErrorNotFound)."""
+
+
+class DeletedError(KeyError):
+    """Needle exists only as a tombstone (ErrorDeleted)."""
+
+
+class CookieMismatch(ValueError):
+    """Request cookie does not match the stored needle's cookie."""

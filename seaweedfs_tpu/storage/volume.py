@@ -1,0 +1,500 @@
+"""Volume: append-only .dat + .idx needle store.
+
+Behavioral equivalent of the reference's Volume runtime
+(/root/reference/weed/storage/volume.go, volume_write.go, volume_read.go,
+volume_loading.go, volume_checking.go, volume_vacuum.go,
+needle_map_memory.go). One volume = superblock + appended needle records in
+`.dat`, with a 16-byte-per-entry `.idx` log replayed into an in-memory map
+at load.
+
+Concurrency: one writer lock per volume (the reference serializes through
+`dataFileAccessLock`); all reads use os.pread on the same descriptor — no
+shared seek state — so they are safe against concurrent appends.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+
+from . import types
+from .errors import CookieMismatch, DeletedError, NotFoundError
+from .needle import Needle, needle_body_length
+from .super_block import SuperBlock
+from .ttl import EMPTY_TTL
+
+
+@dataclass
+class NeedleValue:
+    offset: int  # stored units (8-byte quanta)
+    size: int  # signed
+
+
+class NeedleMap:
+    """In-memory id -> (offset, size) map backed by an append-only .idx log
+    (needle_map_memory.go: NewCompactNeedleMap/doLoading/Put/Get/Delete)."""
+
+    def __init__(self, idx_path: str):
+        self.idx_path = idx_path
+        self._m: dict[int, NeedleValue] = {}
+        self.max_file_key = 0
+        self.file_counter = 0
+        self.file_byte_counter = 0
+        self.deletion_counter = 0
+        self.deletion_byte_counter = 0
+        self._idx_file = open(idx_path, "ab")
+        if os.path.getsize(idx_path):
+            self._load()
+
+    def _load(self) -> None:
+        from . import idx as idx_mod
+
+        ids, offs, sizes = idx_mod.read_index_file(self.idx_path)
+        for i in range(len(ids)):
+            key, off, size = int(ids[i]), int(offs[i]), int(sizes[i])
+            self.max_file_key = max(self.max_file_key, key)
+            self.file_counter += 1
+            if off != 0 and types.size_is_valid(size):
+                old = self._m.get(key)
+                self._m[key] = NeedleValue(off, size)
+                self.file_byte_counter += size
+                if old is not None and old.offset != 0 and types.size_is_valid(old.size):
+                    self.deletion_counter += 1
+                    self.deletion_byte_counter += old.size
+            else:
+                old = self._m.pop(key, None)
+                self.deletion_counter += 1
+                if old is not None:
+                    self.deletion_byte_counter += max(old.size, 0)
+
+    def put(self, key: int, stored_offset: int, size: int) -> None:
+        old = self._m.get(key)
+        self._m[key] = NeedleValue(stored_offset, size)
+        self.max_file_key = max(self.max_file_key, key)
+        self.file_counter += 1
+        self.file_byte_counter += max(size, 0)
+        if old is not None and old.offset != 0 and types.size_is_valid(old.size):
+            self.deletion_counter += 1
+            self.deletion_byte_counter += old.size
+        self._append(key, stored_offset, size)
+
+    def get(self, key: int) -> NeedleValue | None:
+        return self._m.get(key)
+
+    def delete(self, key: int, stored_offset: int) -> int:
+        old = self._m.pop(key, None)
+        deleted = old.size if old is not None and types.size_is_valid(old.size) else 0
+        self.deletion_counter += 1
+        self.deletion_byte_counter += deleted
+        self._append(key, stored_offset, types.TOMBSTONE_FILE_SIZE)
+        return deleted
+
+    def _append(self, key: int, off: int, size: int) -> None:
+        self._idx_file.write(types.pack_needle_map_entry(key, off, size))
+        self._idx_file.flush()
+
+    def __len__(self) -> int:
+        return len(self._m)
+
+    def __iter__(self):
+        return iter(self._m.items())
+
+    @property
+    def content_size(self) -> int:
+        return self.file_byte_counter
+
+    def close(self) -> None:
+        self._idx_file.close()
+
+    def destroy(self) -> None:
+        self.close()
+        os.remove(self.idx_path)
+
+
+class Volume:
+    """One append-only needle volume (volume.go:26-60)."""
+
+    def __init__(
+        self,
+        dirname: str,
+        collection: str,
+        vid: int,
+        *,
+        replica_placement=None,
+        ttl=EMPTY_TTL,
+        version: int = types.CURRENT_VERSION,
+        preallocate: int = 0,
+    ):
+        self.dir = dirname
+        self.collection = collection
+        self.id = vid
+        self.read_only = False
+        self.last_append_at_ns = 0
+        self.last_modified_ts_seconds = 0
+        self.is_compacting = False
+        self._lock = threading.RLock()
+        base = self.file_name()
+        dat_exists = os.path.exists(base + ".dat")
+        if dat_exists:
+            self._dat = open(base + ".dat", "r+b")
+            self.super_block = SuperBlock.from_file(self._dat)
+        else:
+            from .super_block import ReplicaPlacement
+
+            self._dat = open(base + ".dat", "w+b")
+            self.super_block = SuperBlock(
+                version=version,
+                replica_placement=replica_placement or ReplicaPlacement(),
+                ttl=ttl,
+            )
+            self._dat.write(self.super_block.to_bytes())
+            self._dat.flush()
+        self.nm = NeedleMap(base + ".idx")
+        if dat_exists:
+            self.check_and_fix_integrity()
+
+    # -- naming ------------------------------------------------------------
+
+    def file_name(self) -> str:
+        prefix = f"{self.collection}_" if self.collection else ""
+        return os.path.join(self.dir, f"{prefix}{self.id}")
+
+    @property
+    def version(self) -> int:
+        return self.super_block.version
+
+    @property
+    def ttl(self):
+        return self.super_block.ttl
+
+    # -- size / stats ------------------------------------------------------
+
+    def data_size(self) -> int:
+        self._dat.seek(0, 2)
+        return self._dat.tell()
+
+    def content_size(self) -> int:
+        return self.nm.content_size
+
+    def deleted_size(self) -> int:
+        return self.nm.deletion_byte_counter
+
+    def file_count(self) -> int:
+        return len(self.nm)
+
+    def deleted_count(self) -> int:
+        return self.nm.deletion_counter
+
+    def garbage_level(self) -> float:
+        """deleted bytes / total content bytes (volume_vacuum hook)."""
+        if self.content_size() == 0:
+            return 0.0
+        return self.nm.deletion_byte_counter / self.content_size()
+
+    # -- write path --------------------------------------------------------
+
+    def _pread(self, offset: int, length: int) -> bytes:
+        return os.pread(self._dat.fileno(), length, offset)
+
+    def _read_header_at(self, offset: int) -> Needle:
+        b = self._pread(offset, types.NEEDLE_HEADER_SIZE)
+        if len(b) < types.NEEDLE_HEADER_SIZE:
+            raise EOFError("short needle header")
+        return Needle.parse_header(b)
+
+    def write_needle(self, n: Needle, check_cookie: bool = True) -> tuple[int, int, bool]:
+        """Append a needle (doWriteRequest, volume_write.go:127-176).
+        -> (offset_bytes, size, is_unchanged)."""
+        with self._lock:
+            if self.read_only:
+                raise IOError(f"volume {self.id} is read only")
+            if self._is_file_unchanged(n):
+                return 0, len(n.data), True
+            nv = self.nm.get(n.id)
+            if nv is not None:
+                existing = self._read_header_at(
+                    types.stored_to_actual_offset(nv.offset)
+                )
+                if n.cookie == 0 and not check_cookie:
+                    n.cookie = existing.cookie
+                if existing.cookie != n.cookie:
+                    raise CookieMismatch(f"mismatching cookie {n.cookie:x}")
+            n.update_append_at_ns(self.last_append_at_ns)
+            offset = self._append_record(n)
+            self.last_append_at_ns = n.append_at_ns
+            if nv is None or types.stored_to_actual_offset(nv.offset) < offset:
+                self.nm.put(n.id, types.offset_to_stored(offset), n.size)
+            if self.last_modified_ts_seconds < n.last_modified:
+                self.last_modified_ts_seconds = n.last_modified
+            return offset, n.size, False
+
+    def _append_record(self, n: Needle) -> int:
+        self._dat.seek(0, 2)
+        offset = self._dat.tell()
+        if offset % types.NEEDLE_PADDING_SIZE != 0:
+            # realign a torn tail (Needle.Append alignment guard)
+            offset += types.NEEDLE_PADDING_SIZE - (offset % types.NEEDLE_PADDING_SIZE)
+            self._dat.seek(offset)
+        blob = n.to_bytes(self.version)  # also computes n.size
+        if offset + len(blob) > types.MAX_POSSIBLE_VOLUME_SIZE:
+            # past 32GB the 4-byte stored offset would wrap -> corruption
+            raise IOError(
+                f"volume size limit {types.MAX_POSSIBLE_VOLUME_SIZE} exceeded"
+            )
+        try:
+            self._dat.write(blob)
+            self._dat.flush()
+        except OSError:
+            self._dat.truncate(offset)
+            raise
+        return offset
+
+    def _is_file_unchanged(self, n: Needle) -> bool:
+        """Dedup same-content rewrite (isFileUnchanged, volume_write.go:32-52)."""
+        if str(self.ttl):
+            return False
+        nv = self.nm.get(n.id)
+        if nv is None or nv.offset == 0 or not types.size_is_valid(nv.size):
+            return False
+        try:
+            old = self._read_record(nv)
+        except IOError:
+            return False
+        return (
+            old.cookie == n.cookie
+            and old.checksum == n.checksum
+            and old.data == n.data
+        )
+
+    def delete_needle(self, needle_id: int, cookie: int | None = None) -> int:
+        """Append a zero-size deletion marker + tombstone the map
+        (doDeleteRequest, volume_write.go:209-230). -> freed size."""
+        with self._lock:
+            if self.read_only:
+                raise IOError(f"volume {self.id} is read only")
+            nv = self.nm.get(needle_id)
+            if nv is None or not types.size_is_valid(nv.size):
+                return 0
+            if cookie is not None:
+                existing = self._read_header_at(
+                    types.stored_to_actual_offset(nv.offset)
+                )
+                if existing.cookie != cookie:
+                    raise CookieMismatch("cookie mismatch on delete")
+            size = nv.size
+            marker = Needle(id=needle_id, cookie=cookie or 0)
+            marker.update_append_at_ns(self.last_append_at_ns)
+            offset = self._append_record(marker)
+            self.last_append_at_ns = marker.append_at_ns
+            self.nm.delete(needle_id, types.offset_to_stored(offset))
+            return size
+
+    # -- read path ---------------------------------------------------------
+
+    def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:
+        """readNeedle (volume_read.go:19-72): map lookup, record read, CRC,
+        cookie + TTL checks."""
+        nv = self.nm.get(needle_id)
+        if nv is None or nv.offset == 0:
+            raise NotFoundError(f"needle {needle_id:x} not found")
+        if types.size_is_deleted(nv.size):
+            raise DeletedError(f"needle {needle_id:x} deleted")
+        n = self._read_record(nv)
+        if cookie is not None and n.cookie != cookie:
+            raise CookieMismatch(
+                f"cookie mismatch: read {n.cookie:x} expected {cookie:x}"
+            )
+        if n.has_expired():
+            raise NotFoundError(f"needle {needle_id:x} expired")
+        return n
+
+    def _read_record(self, nv: NeedleValue) -> Needle:
+        offset = types.stored_to_actual_offset(nv.offset)
+        length = types.actual_size(nv.size, self.version)
+        blob = self._pread(offset, length)
+        if len(blob) < length:
+            raise IOError("short needle read")
+        return Needle.from_bytes(blob, self.version, expected_size=nv.size)
+
+    def read_needle_blob(self, offset: int, size: int) -> bytes:
+        """Raw record bytes (ReadNeedleBlob) for replication/EC streaming."""
+        length = types.actual_size(size, self.version)
+        blob = self._pread(offset, length)
+        if len(blob) < length:
+            raise IOError("short needle blob read")
+        return blob
+
+    # -- integrity (volume_checking.go) ------------------------------------
+
+    def check_and_fix_integrity(self) -> None:
+        """Startup repair (CheckAndFixVolumeDataIntegrity, volume_checking.go:17):
+        verify the last .idx entry points at a well-formed record in .dat;
+        truncate torn appends off both files."""
+        from . import idx as idx_mod
+
+        if not os.path.getsize(self.nm.idx_path):
+            return
+        ids, offs, sizes = idx_mod.read_index_file(self.nm.idx_path)
+        dat_size = self.data_size()
+        keep = len(ids)
+        while keep > 0:
+            off = types.stored_to_actual_offset(int(offs[keep - 1]))
+            size = int(sizes[keep - 1])
+            if size == types.TOMBSTONE_FILE_SIZE:
+                break  # tombstones carry the deletion-marker offset; trust them
+            end = off + types.actual_size(max(size, 0), self.version)
+            if end <= dat_size and self._verify_needle_at(off, int(ids[keep - 1]), size):
+                break
+            keep -= 1
+        if keep < len(ids):
+            with open(self.nm.idx_path, "r+b") as f:
+                f.truncate(keep * types.NEEDLE_MAP_ENTRY_SIZE)
+            # drop torn .dat tail past the last good record
+            if keep:
+                off = types.stored_to_actual_offset(int(offs[keep - 1]))
+                size = int(sizes[keep - 1])
+                end = off + types.actual_size(max(size, 0), self.version)
+            else:
+                end = self.super_block.block_size
+            self._dat.truncate(end)
+            self._dat.flush()
+            # reload the map from the repaired idx
+            self.nm.close()
+            self.nm = NeedleMap(self.nm.idx_path)
+
+    def _verify_needle_at(self, offset: int, needle_id: int, size: int) -> bool:
+        """verifyNeedleIntegrity (volume_checking.go:88): id matches and the
+        record parses with a valid CRC."""
+        try:
+            n = self._read_header_at(offset)
+            if n.id != needle_id:
+                return False
+            if size >= 0 and n.size != size:
+                return False
+            blob = self._pread(offset, types.actual_size(n.size, self.version))
+            Needle.from_bytes(blob, self.version)
+            return True
+        except (IOError, EOFError, ValueError):
+            return False
+
+    # -- scanning ----------------------------------------------------------
+
+    def scan_needles(self, strict: bool = True):
+        """Yield (needle, offset) for every record in .dat append order
+        (ScanVolumeFile semantics). A partial record at EOF ends the scan;
+        an unparsable record mid-file raises IOError when `strict` (the
+        reference aborts compaction on scan errors rather than silently
+        truncating)."""
+        offset = self.super_block.block_size
+        dat_size = self.data_size()
+        while offset + types.NEEDLE_HEADER_SIZE <= dat_size:
+            n = self._read_header_at(offset)
+            total = types.NEEDLE_HEADER_SIZE + needle_body_length(
+                max(n.size, 0), self.version
+            )
+            if offset + total > dat_size:
+                return  # torn tail
+            blob = self._pread(offset, total)
+            try:
+                full = Needle.from_bytes(blob, self.version, check_crc=False)
+            except (IOError, ValueError) as e:
+                if strict:
+                    raise IOError(
+                        f"volume {self.id}: corrupt record at offset {offset}: {e}"
+                    )
+                return
+            yield full, offset
+            offset += total
+
+    # -- vacuum (volume_vacuum.go) -----------------------------------------
+
+    def compact(self) -> None:
+        """Compact2 (volume_vacuum.go:67): copy live needles into .cpd/.cpx."""
+        with self._lock:
+            self.is_compacting = True
+            self._compact_idx_snapshot = os.path.getsize(self.nm.idx_path)
+        try:
+            base = self.file_name()
+            new_sb = self.super_block.bump_compaction()
+            with open(base + ".cpd", "wb") as dst:
+                dst.write(new_sb.to_bytes())
+                from .needle_map import MemDb
+
+                newdb = MemDb()
+                for n, _off in self.scan_needles():
+                    nv = self.nm.get(n.id)
+                    if nv is None or types.size_is_deleted(nv.size):
+                        continue
+                    if types.stored_to_actual_offset(nv.offset) != _off:
+                        continue  # superseded by a later rewrite
+                    if n.has_expired():
+                        continue
+                    new_off = dst.tell()
+                    dst.write(n.to_bytes(self.version))
+                    newdb.set(n.id, types.offset_to_stored(new_off), n.size)
+            with open(base + ".cpx", "wb") as f:
+                f.write(newdb.to_sorted_bytes())
+        except BaseException:
+            self.is_compacting = False
+            raise
+
+    def commit_compact(self) -> None:
+        """CommitCompact (volume_vacuum.go:102): catch up writes since the
+        snapshot (makeupDiff), atomically swap .cpd/.cpx into place."""
+        base = self.file_name()
+        with self._lock:
+            self._makeup_diff(base + ".cpd", base + ".cpx")
+            self._dat.close()
+            self.nm.close()
+            os.replace(base + ".cpd", base + ".dat")
+            os.replace(base + ".cpx", base + ".idx")
+            self._dat = open(base + ".dat", "r+b")
+            self.super_block = SuperBlock.from_file(self._dat)
+            self.nm = NeedleMap(base + ".idx")
+            self.is_compacting = False
+
+    def _makeup_diff(self, cpd: str, cpx: str) -> None:
+        """Replay .idx entries appended after the compaction snapshot onto
+        the compacted copies (makeupDiff, volume_vacuum.go:200-280)."""
+        from .needle_map import read_needle_map
+
+        with open(self.nm.idx_path, "rb") as f:
+            f.seek(self._compact_idx_snapshot)
+            tail = f.read()
+        if not tail:
+            return
+        newdb = read_needle_map(cpx)
+        with open(cpd, "r+b") as dst:
+            for i in range(0, len(tail) - 15, types.NEEDLE_MAP_ENTRY_SIZE):
+                key, off, size = types.unpack_needle_map_entry(
+                    tail[i : i + types.NEEDLE_MAP_ENTRY_SIZE]
+                )
+                if off != 0 and types.size_is_valid(size):
+                    nv = NeedleValue(off, size)
+                    n = self._read_record(nv)
+                    dst.seek(0, 2)
+                    new_off = dst.tell()
+                    dst.write(n.to_bytes(self.version))
+                    newdb.set(key, types.offset_to_stored(new_off), n.size)
+                else:
+                    newdb.delete(key)
+        with open(cpx, "wb") as f:
+            f.write(newdb.to_sorted_bytes())
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def close(self) -> None:
+        with self._lock:
+            self._dat.close()
+            self.nm.close()
+
+    def destroy(self) -> None:
+        """Remove every file of this volume (Destroy, volume_write.go:55-85)."""
+        base = self.file_name()
+        self.close()
+        for ext in (".dat", ".idx", ".vif", ".sdx", ".cpd", ".cpx", ".note"):
+            try:
+                os.remove(base + ext)
+            except FileNotFoundError:
+                pass

@@ -1,0 +1,151 @@
+"""Needle wire-format, TTL, CRC, file-id codecs."""
+
+import pytest
+
+from seaweedfs_tpu.storage import types
+from seaweedfs_tpu.storage.crc import crc32c, crc_value_legacy
+from seaweedfs_tpu.storage.file_id import (
+    FileId,
+    format_needle_id_cookie,
+    parse_file_id,
+)
+from seaweedfs_tpu.storage.needle import (
+    CrcError,
+    Needle,
+    needle_body_length,
+)
+from seaweedfs_tpu.storage.super_block import ReplicaPlacement, SuperBlock
+from seaweedfs_tpu.storage.ttl import TTL
+
+
+def test_crc32c_known_vector():
+    # RFC 3720 iSCSI test vector: crc32c of 32 zero bytes
+    assert crc32c(b"\x00" * 32) == 0x8A9136AA
+    assert crc32c(b"123456789") == 0xE3069283
+
+
+def test_needle_roundtrip_v3_full():
+    n = Needle.create(
+        0x1234, 0xDEADBEEF, b"hello world" * 10,
+        name=b"f.txt", mime=b"text/plain", pairs=b'{"a":"b"}',
+        last_modified=1_700_000_000, ttl=TTL.parse("3h"),
+    )
+    n.update_append_at_ns(0)
+    blob = n.to_bytes(types.VERSION3)
+    assert len(blob) % types.NEEDLE_PADDING_SIZE == 0
+    assert len(blob) == types.actual_size(n.size, types.VERSION3)
+    m = Needle.from_bytes(blob, types.VERSION3)
+    assert (m.id, m.cookie) == (0x1234, 0xDEADBEEF)
+    assert m.data == b"hello world" * 10
+    assert m.name == b"f.txt" and m.mime == b"text/plain"
+    assert m.pairs == b'{"a":"b"}'
+    assert m.last_modified == 1_700_000_000
+    assert str(m.ttl) == "3h"
+    assert m.append_at_ns == n.append_at_ns
+
+
+def test_needle_roundtrip_v2_minimal():
+    n = Needle.create(7, 1, b"x", last_modified=100)
+    blob = n.to_bytes(types.VERSION2)
+    m = Needle.from_bytes(blob, types.VERSION2)
+    assert m.data == b"x" and m.id == 7
+
+
+def test_needle_roundtrip_v1():
+    n = Needle(id=9, cookie=3, data=b"abc")
+    from seaweedfs_tpu.storage.crc import crc32c as c
+
+    n.checksum = c(b"abc")
+    blob = n.to_bytes(types.VERSION1)
+    m = Needle.from_bytes(blob, types.VERSION1)
+    assert m.data == b"abc"
+
+
+def test_needle_crc_detects_corruption():
+    n = Needle.create(1, 2, b"payload data here", last_modified=50)
+    blob = bytearray(n.to_bytes(types.VERSION3))
+    blob[types.NEEDLE_HEADER_SIZE + 5] ^= 0xFF
+    with pytest.raises(CrcError):
+        Needle.from_bytes(bytes(blob), types.VERSION3)
+
+
+def test_needle_legacy_crc_value_accepted():
+    n = Needle.create(1, 2, b"data", last_modified=50)
+    blob = bytearray(n.to_bytes(types.VERSION3))
+    legacy = crc_value_legacy(crc32c(b"data"))
+    pos = types.NEEDLE_HEADER_SIZE + n.size
+    blob[pos : pos + 4] = legacy.to_bytes(4, "big")
+    m = Needle.from_bytes(bytes(blob), types.VERSION3)
+    assert m.data == b"data"
+
+
+def test_empty_data_needle():
+    n = Needle(id=5, cookie=0)
+    blob = n.to_bytes(types.VERSION3)
+    assert len(blob) == types.actual_size(0, types.VERSION3)
+    m = Needle.from_bytes(blob, types.VERSION3)
+    assert m.size == 0 and m.data == b""
+
+
+def test_body_length_matches_actual_size():
+    for size in (0, 1, 7, 8, 100, 65535):
+        for v in (types.VERSION2, types.VERSION3):
+            assert types.NEEDLE_HEADER_SIZE + needle_body_length(size, v) == (
+                types.actual_size(size, v)
+            )
+
+
+def test_ttl_codec():
+    for s in ("3m", "4h", "5d", "6w", "7M", "8y"):
+        t = TTL.parse(s)
+        assert str(t) == s
+        assert TTL.from_bytes(t.to_bytes()) == t
+        assert TTL.from_uint32(t.to_uint32()) == t
+    assert TTL.parse("90") == TTL.parse("90m")
+    assert str(TTL.parse("")) == ""
+
+
+def test_replica_placement():
+    rp = ReplicaPlacement.parse("012")
+    assert rp.diff_dc_count == 0 and rp.diff_rack_count == 1 and rp.same_rack_count == 2
+    assert rp.copy_count == 4
+    assert ReplicaPlacement.from_byte(rp.to_byte()) == rp
+    with pytest.raises(ValueError):
+        ReplicaPlacement.parse("5")
+
+
+def test_super_block_roundtrip(tmp_path):
+    sb = SuperBlock(
+        version=3,
+        replica_placement=ReplicaPlacement.parse("001"),
+        ttl=TTL.parse("1d"),
+        compaction_revision=7,
+    )
+    p = tmp_path / "x.dat"
+    p.write_bytes(sb.to_bytes())
+    with open(p, "rb") as f:
+        got = SuperBlock.from_file(f)
+    assert got == sb
+    assert len(sb.to_bytes()) == 8
+
+
+def test_file_id_format():
+    # leading zero BYTES of the key are trimmed; cookie keeps 8 hex chars
+    assert format_needle_id_cookie(0x0163, 0x7037D6FF) == "01637037d6ff"
+    fid = FileId(3, 0x0163, 0x7037D6FF)
+    assert str(fid) == "3,01637037d6ff"
+    back = parse_file_id("3,01637037d6ff")
+    assert back == fid
+
+
+def test_file_id_extension_and_delta():
+    fid = parse_file_id("7,12b1638c2f.jpg")
+    assert fid.volume_id == 7
+    assert fid.key == 0x12 and fid.cookie == 0xB1638C2F
+    fid2 = parse_file_id("7,12b1638c2f_3")
+    assert fid2.key == 0x12 + 3
+    with pytest.raises(ValueError):
+        parse_file_id("7,b1638c2f")  # only cookie chars, too short
+    # full zero key
+    s = format_needle_id_cookie(0, 0xAABBCCDD)
+    assert s == "aabbccdd"

@@ -1,0 +1,168 @@
+"""Volume engine: write/read/delete, dedup, reload, torn-tail repair, vacuum."""
+
+import os
+
+import pytest
+
+from seaweedfs_tpu.storage import types
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.super_block import ReplicaPlacement
+from seaweedfs_tpu.storage.ttl import TTL
+from seaweedfs_tpu.storage.volume import (
+    CookieMismatch,
+    DeletedError,
+    NotFoundError,
+    Volume,
+)
+
+
+def make_needle(nid, data, cookie=0xABC, **kw):
+    return Needle.create(nid, cookie, data, last_modified=1_700_000_000, **kw)
+
+
+@pytest.fixture
+def vol(tmp_path):
+    v = Volume(str(tmp_path), "", 1)
+    yield v
+    v.close()
+
+
+def test_write_read_roundtrip(vol):
+    offset, size, unchanged = vol.write_needle(make_needle(1, b"hello"))
+    assert not unchanged and offset == 8  # right after superblock
+    n = vol.read_needle(1)
+    assert n.data == b"hello"
+    assert n.cookie == 0xABC
+
+
+def test_cookie_check(vol):
+    vol.write_needle(make_needle(2, b"data"))
+    with pytest.raises(CookieMismatch):
+        vol.read_needle(2, cookie=0x999)
+    assert vol.read_needle(2, cookie=0xABC).data == b"data"
+
+
+def test_dedup_unchanged_write(vol):
+    vol.write_needle(make_needle(3, b"same"))
+    size_before = vol.data_size()
+    _, _, unchanged = vol.write_needle(make_needle(3, b"same"))
+    assert unchanged
+    assert vol.data_size() == size_before
+
+
+def test_overwrite_and_delete(vol):
+    vol.write_needle(make_needle(4, b"v1"))
+    vol.write_needle(make_needle(4, b"version2"))
+    assert vol.read_needle(4).data == b"version2"
+    freed = vol.delete_needle(4, cookie=0xABC)
+    assert freed > 0
+    with pytest.raises((NotFoundError, DeletedError)):
+        vol.read_needle(4)
+    assert vol.delete_needle(4) == 0  # idempotent
+
+
+def test_write_cookie_mismatch_rejected(vol):
+    vol.write_needle(make_needle(5, b"a", cookie=1))
+    with pytest.raises(CookieMismatch):
+        vol.write_needle(make_needle(5, b"b", cookie=2))
+
+
+def test_reload_from_disk(tmp_path):
+    v = Volume(str(tmp_path), "col", 7, replica_placement=ReplicaPlacement.parse("001"))
+    for i in range(1, 20):
+        v.write_needle(make_needle(i, f"data-{i}".encode()))
+    v.delete_needle(5)
+    v.close()
+
+    v2 = Volume(str(tmp_path), "col", 7)
+    assert v2.super_block.replica_placement == ReplicaPlacement.parse("001")
+    for i in range(1, 20):
+        if i == 5:
+            with pytest.raises(KeyError):
+                v2.read_needle(i)
+        else:
+            assert v2.read_needle(i).data == f"data-{i}".encode()
+    v2.close()
+
+
+def test_torn_tail_repair(tmp_path):
+    v = Volume(str(tmp_path), "", 9)
+    for i in range(1, 6):
+        v.write_needle(make_needle(i, b"x" * 100))
+    good_size = v.data_size()
+    v.write_needle(make_needle(6, b"y" * 500))
+    v.close()
+    # tear the last record halfway
+    base = v.file_name()
+    with open(base + ".dat", "r+b") as f:
+        f.truncate(good_size + 37)
+    v2 = Volume(str(tmp_path), "", 9)
+    assert v2.data_size() == good_size
+    for i in range(1, 6):
+        assert v2.read_needle(i).data == b"x" * 100
+    with pytest.raises(KeyError):
+        v2.read_needle(6)
+    # volume still writable after repair
+    v2.write_needle(make_needle(6, b"z" * 20))
+    assert v2.read_needle(6).data == b"z" * 20
+    v2.close()
+
+
+def test_vacuum_compaction(tmp_path):
+    v = Volume(str(tmp_path), "", 11)
+    for i in range(1, 31):
+        v.write_needle(make_needle(i, bytes([i]) * 1000))
+    for i in range(1, 21):
+        v.delete_needle(i)
+    v.write_needle(make_needle(50, b"late"))
+    assert v.garbage_level() > 0.5
+    size_before = v.data_size()
+    v.compact()
+    # a write that lands *during* compaction must survive the commit
+    v.write_needle(make_needle(51, b"during-compact"))
+    v.delete_needle(30)
+    v.commit_compact()
+    assert v.data_size() < size_before
+    assert v.super_block.compaction_revision == 1
+    for i in range(21, 30):
+        assert v.read_needle(i).data == bytes([i]) * 1000
+    assert v.read_needle(50).data == b"late"
+    assert v.read_needle(51).data == b"during-compact"
+    for i in list(range(1, 21)) + [30]:
+        with pytest.raises(KeyError):
+            v.read_needle(i)
+    # compacted volume reloads cleanly
+    v.close()
+    v2 = Volume(str(tmp_path), "", 11)
+    assert v2.read_needle(51).data == b"during-compact"
+    v2.close()
+
+
+def test_ttl_expiry(tmp_path):
+    v = Volume(str(tmp_path), "", 13, ttl=TTL.parse("1m"))
+    n = make_needle(1, b"short-lived", ttl=TTL.parse("1m"))
+    n.last_modified = 1_000_000  # long past
+    n.set_flag(0x10)
+    v.write_needle(n)
+    with pytest.raises(NotFoundError):
+        v.read_needle(1)
+    v.close()
+
+
+def test_needle_map_counters(vol):
+    vol.write_needle(make_needle(1, b"aaaa"))
+    vol.write_needle(make_needle(2, b"bbbb"))
+    vol.delete_needle(1)
+    assert vol.file_count() == 1
+    assert vol.deleted_count() == 1
+    assert vol.nm.max_file_key == 2
+
+
+def test_destroy(tmp_path):
+    v = Volume(str(tmp_path), "", 21)
+    v.write_needle(make_needle(1, b"x"))
+    base = v.file_name()
+    assert os.path.exists(base + ".dat")
+    v.destroy()
+    assert not os.path.exists(base + ".dat")
+    assert not os.path.exists(base + ".idx")
