@@ -1,0 +1,138 @@
+// Native GF(2^8) Reed-Solomon kernels for the host CPU path.
+//
+// This is the framework's native-performance equivalent of the SIMD assembly
+// inside klauspost/reedsolomon v1.11.7 (the library the reference invokes at
+// /root/reference/weed/storage/erasure_coding/ec_encoder.go:198).  The hot
+// primitive is a GF(2^8) matrix multiply
+//
+//     out[m, B] = M[m, k] (*) data[k, B]     over GF(256)/0x11D
+//
+// computed with the hi/lo nibble-table split the Go assembly uses: for a
+// coefficient c, c*x == LO_c[x & 0xF] ^ HI_c[x >> 4].  The 16-entry tables
+// per coefficient keep the inner loop to two table lookups and one XOR per
+// byte; g++ -O3 autovectorizes it with pshufb-style byte shuffles where the
+// target ISA has them.
+//
+// Exported C ABI (used from Python via ctypes, see ops/rs_native.py):
+//   swfs_gf_matmul(matrix, m, k, data, b, out)
+//   swfs_gf_matmul_xor(matrix, m, k, data, b, out)   // out ^= M (*) data
+//   swfs_crc32c(data, n, seed)                        // CRC-32C (Castagnoli)
+
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+constexpr uint32_t kPoly = 0x11D;
+
+struct MulTable {
+    // mul[c][x] = c * x over GF(2^8)/0x11D
+    uint8_t mul[256][256];
+    MulTable() {
+        for (int c = 0; c < 256; ++c) {
+            for (int x = 0; x < 256; ++x) {
+                uint32_t a = static_cast<uint32_t>(c), b = static_cast<uint32_t>(x), p = 0;
+                while (b) {
+                    if (b & 1) p ^= a;
+                    a <<= 1;
+                    if (a & 0x100) a ^= kPoly;
+                    b >>= 1;
+                }
+                mul[c][x] = static_cast<uint8_t>(p);
+            }
+        }
+    }
+};
+
+const MulTable kTables;
+
+// One coefficient's nibble tables, built on the fly (64 bytes; stays in L1).
+struct Nibbles {
+    uint8_t lo[16];
+    uint8_t hi[16];
+    explicit Nibbles(uint8_t c) {
+        for (int i = 0; i < 16; ++i) {
+            lo[i] = kTables.mul[c][i];
+            hi[i] = kTables.mul[c][i << 4];
+        }
+    }
+};
+
+inline void axpy(uint8_t c, const uint8_t* __restrict src, uint8_t* __restrict dst,
+                 int64_t n) {
+    if (c == 0) return;
+    if (c == 1) {
+        for (int64_t j = 0; j < n; ++j) dst[j] ^= src[j];
+        return;
+    }
+    const Nibbles t(c);
+    for (int64_t j = 0; j < n; ++j) {
+        const uint8_t x = src[j];
+        dst[j] ^= static_cast<uint8_t>(t.lo[x & 0xF] ^ t.hi[x >> 4]);
+    }
+}
+
+}  // namespace
+
+extern "C" {
+
+// out[m, b] = matrix[m, k] (*) data[k, b]; all row-major, contiguous.
+void swfs_gf_matmul(const uint8_t* matrix, int m, int k, const uint8_t* data,
+                    int64_t b, uint8_t* out) {
+    for (int r = 0; r < m; ++r) {
+        uint8_t* dst = out + static_cast<int64_t>(r) * b;
+        std::memset(dst, 0, static_cast<size_t>(b));
+        for (int c = 0; c < k; ++c) {
+            axpy(matrix[r * k + c], data + static_cast<int64_t>(c) * b, dst, b);
+        }
+    }
+}
+
+// out[m, b] ^= matrix[m, k] (*) data[k, b] — for streaming accumulation.
+void swfs_gf_matmul_xor(const uint8_t* matrix, int m, int k, const uint8_t* data,
+                        int64_t b, uint8_t* out) {
+    for (int r = 0; r < m; ++r) {
+        uint8_t* dst = out + static_cast<int64_t>(r) * b;
+        for (int c = 0; c < k; ++c) {
+            axpy(matrix[r * k + c], data + static_cast<int64_t>(c) * b, dst, b);
+        }
+    }
+}
+
+// CRC-32C (Castagnoli), slice-by-8 — needle checksum (storage/crc.py) hot path.
+static uint32_t crc32c_table[8][256];
+static bool crc32c_init_done = false;
+
+static void crc32c_init() {
+    const uint32_t poly = 0x82F63B78u;  // reflected Castagnoli
+    for (uint32_t i = 0; i < 256; ++i) {
+        uint32_t crc = i;
+        for (int j = 0; j < 8; ++j)
+            crc = (crc >> 1) ^ ((crc & 1) ? poly : 0);
+        crc32c_table[0][i] = crc;
+    }
+    for (uint32_t i = 0; i < 256; ++i)
+        for (int t = 1; t < 8; ++t)
+            crc32c_table[t][i] =
+                (crc32c_table[t - 1][i] >> 8) ^ crc32c_table[0][crc32c_table[t - 1][i] & 0xFF];
+    crc32c_init_done = true;
+}
+
+uint32_t swfs_crc32c(const uint8_t* data, int64_t n, uint32_t seed) {
+    if (!crc32c_init_done) crc32c_init();
+    uint32_t crc = ~seed;
+    int64_t i = 0;
+    for (; i + 8 <= n; i += 8) {
+        uint64_t word;
+        std::memcpy(&word, data + i, 8);
+        word ^= crc;  // little-endian assumed (x86/arm64)
+        crc = crc32c_table[7][word & 0xFF] ^ crc32c_table[6][(word >> 8) & 0xFF] ^
+              crc32c_table[5][(word >> 16) & 0xFF] ^ crc32c_table[4][(word >> 24) & 0xFF] ^
+              crc32c_table[3][(word >> 32) & 0xFF] ^ crc32c_table[2][(word >> 40) & 0xFF] ^
+              crc32c_table[1][(word >> 48) & 0xFF] ^ crc32c_table[0][(word >> 56) & 0xFF];
+    }
+    for (; i < n; ++i) crc = (crc >> 8) ^ crc32c_table[0][(crc ^ data[i]) & 0xFF];
+    return ~crc;
+}
+
+}  // extern "C"

@@ -26,10 +26,14 @@ class RSCodecCPU:
         self.total_shards = data_shards + parity_shards
         self._gp = gf256.parity_matrix(data_shards, parity_shards)
 
+    def _matmul(self, matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+        """GF(256) matmul hook — overridden by the native C++ backend."""
+        return gf256.gf_matmul(matrix, data)
+
     def encode_parity(self, data: np.ndarray) -> np.ndarray:
         data = np.asarray(data, dtype=np.uint8)
         assert data.shape[0] == self.data_shards
-        return gf256.gf_matmul(self._gp, data)
+        return self._matmul(self._gp, data)
 
     def encode(self, shards: np.ndarray) -> np.ndarray:
         shards = np.asarray(shards, dtype=np.uint8).copy()
@@ -45,7 +49,7 @@ class RSCodecCPU:
             self.data_shards, self.parity_shards, sorted(present.keys())
         )
         stacked = np.stack([np.asarray(present[i], np.uint8) for i in used])
-        data = gf256.gf_matmul(dec, stacked)
+        data = self._matmul(dec, stacked)
         out = {}
         parity = None
         for i in missing:

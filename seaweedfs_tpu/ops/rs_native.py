@@ -1,0 +1,103 @@
+"""Native (C++) Reed-Solomon codec — the host-CPU performance path.
+
+Framework-native equivalent of the SIMD kernels inside klauspost/reedsolomon
+(the library the reference links; /root/reference/go.mod:62,
+/root/reference/weed/storage/erasure_coding/ec_encoder.go:198).  The GF(2^8)
+matmul lives in ops/native/rs.cpp; this module builds it on first use with
+g++ (no pip deps), loads it via ctypes, and exposes the same codec surface
+as RSCodecCPU so the two are drop-in interchangeable.
+
+Matrices still come from ops/gf256.py, so parity stays bit-identical across
+the numpy, native, and JAX/TPU backends.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+import numpy as np
+
+from . import gf256
+from .rs_cpu import RSCodecCPU
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_SRC = os.path.join(_NATIVE_DIR, "rs.cpp")
+_SO = os.path.join(_NATIVE_DIR, "librs_swfs.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> None:
+    cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO]
+    native = cmd[:1] + ["-march=native"] + cmd[1:]
+    try:
+        subprocess.run(native, check=True, capture_output=True)
+    except (subprocess.CalledProcessError, FileNotFoundError):
+        subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load_library() -> ctypes.CDLL:
+    """Build (if stale) and load the native kernel library."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            _build()
+        lib = ctypes.CDLL(_SO)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.swfs_gf_matmul.argtypes = [u8p, ctypes.c_int, ctypes.c_int, u8p,
+                                       ctypes.c_int64, u8p]
+        lib.swfs_gf_matmul.restype = None
+        lib.swfs_gf_matmul_xor.argtypes = lib.swfs_gf_matmul.argtypes
+        lib.swfs_gf_matmul_xor.restype = None
+        lib.swfs_crc32c.argtypes = [u8p, ctypes.c_int64, ctypes.c_uint32]
+        lib.swfs_crc32c.restype = ctypes.c_uint32
+        _lib = lib
+        return lib
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8))
+
+
+def gf_matmul_native(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+    """out[m, B] = matrix[m, k] (*) data[k, B] over GF(256), in C++."""
+    lib = load_library()
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    m, k = matrix.shape
+    kk, b = data.shape
+    assert k == kk, (matrix.shape, data.shape)
+    out = np.empty((m, b), dtype=np.uint8)
+    lib.swfs_gf_matmul(_ptr(matrix), m, k, _ptr(data), b, _ptr(out))
+    return out
+
+
+def crc32c_native(data: bytes | np.ndarray, seed: int = 0) -> int:
+    lib = load_library()
+    a = np.frombuffer(data, dtype=np.uint8) if isinstance(data, (bytes, bytearray, memoryview)) else np.ascontiguousarray(data, np.uint8)
+    return int(lib.swfs_crc32c(_ptr(a), a.size, seed & 0xFFFFFFFF))
+
+
+class RSCodecNative(RSCodecCPU):
+    """RSCodecCPU with the GF matmul routed through the C++ kernel."""
+
+    def __init__(self, data_shards: int = 10, parity_shards: int = 4):
+        load_library()  # fail fast if the toolchain is missing
+        super().__init__(data_shards, parity_shards)
+
+    def _matmul(self, matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+        return gf_matmul_native(matrix, data)
+
+
+def available() -> bool:
+    try:
+        load_library()
+        return True
+    except Exception:
+        return False

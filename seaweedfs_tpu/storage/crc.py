@@ -15,25 +15,32 @@ try:
     def crc32c(data: bytes, value: int = 0) -> int:
         return google_crc32c.extend(value, bytes(data))
 
-except ImportError:  # pragma: no cover - fallback for stripped environments
-    _POLY = 0x82F63B78  # reversed 0x1EDC6F41
+except ImportError:
+    try:  # native C++ slice-by-8 kernel (ops/native/rs.cpp)
+        from ..ops.rs_native import crc32c_native
 
-    def _make_table() -> list[int]:
-        table = []
-        for i in range(256):
-            c = i
-            for _ in range(8):
-                c = (c >> 1) ^ _POLY if c & 1 else c >> 1
-            table.append(c)
-        return table
+        def crc32c(data: bytes, value: int = 0) -> int:
+            return crc32c_native(data, value)
 
-    _TABLE = _make_table()
+    except Exception:  # pragma: no cover - fallback for stripped environments
+        _POLY = 0x82F63B78  # reversed 0x1EDC6F41
 
-    def crc32c(data: bytes, value: int = 0) -> int:
-        c = value ^ 0xFFFFFFFF
-        for b in bytes(data):
-            c = _TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
-        return c ^ 0xFFFFFFFF
+        def _make_table() -> list[int]:
+            table = []
+            for i in range(256):
+                c = i
+                for _ in range(8):
+                    c = (c >> 1) ^ _POLY if c & 1 else c >> 1
+                table.append(c)
+            return table
+
+        _TABLE = _make_table()
+
+        def crc32c(data: bytes, value: int = 0) -> int:
+            c = value ^ 0xFFFFFFFF
+            for b in bytes(data):
+                c = _TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
+            return c ^ 0xFFFFFFFF
 
 
 def crc_value_legacy(crc: int) -> int:

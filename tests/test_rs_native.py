@@ -1,0 +1,73 @@
+"""Native C++ codec must agree byte-for-byte with the numpy reference path.
+
+(The numpy path is itself pinned against the JAX/TPU backend in
+test_rs_codec.py, so all three backends form one bit-identity equivalence
+class — the property SURVEY.md §7 requires of every ErasureCoder plugin.)
+"""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import rs_native
+from seaweedfs_tpu.ops.rs_cpu import RSCodecCPU
+
+pytestmark = pytest.mark.skipif(
+    not rs_native.available(), reason="native toolchain unavailable"
+)
+
+
+@pytest.mark.parametrize("k,m", [(10, 4), (6, 3), (12, 4), (3, 2)])
+def test_encode_matches_numpy(k, m):
+    rng = np.random.default_rng(42)
+    data = rng.integers(0, 256, size=(k, 4096 + 13), dtype=np.uint8)
+    cpu = RSCodecCPU(k, m)
+    nat = rs_native.RSCodecNative(k, m)
+    np.testing.assert_array_equal(cpu.encode_parity(data), nat.encode_parity(data))
+
+
+def test_reconstruct_matches_numpy():
+    rng = np.random.default_rng(7)
+    k, m = 10, 4
+    cpu = RSCodecCPU(k, m)
+    nat = rs_native.RSCodecNative(k, m)
+    shards = cpu.encode(
+        np.concatenate(
+            [rng.integers(0, 256, size=(k, 999), dtype=np.uint8),
+             np.zeros((m, 999), np.uint8)]
+        )
+    )
+    lost = [0, 5, 11, 13]
+    present = {i: shards[i] for i in range(k + m) if i not in lost}
+    got = nat.reconstruct(dict(present))
+    for i in lost:
+        np.testing.assert_array_equal(got[i], shards[i])
+    got_d = nat.reconstruct_data(dict(present))
+    assert sorted(got_d) == [0, 5]
+    assert nat.verify(shards)
+
+
+def test_crc32c_matches_python():
+    import zlib
+
+    from seaweedfs_tpu.storage import crc as crc_mod
+
+    rng = np.random.default_rng(3)
+    for n in (0, 1, 7, 8, 9, 4096, 100003):
+        buf = rng.integers(0, 256, size=n, dtype=np.uint8).tobytes()
+        assert rs_native.crc32c_native(buf) == crc_mod.crc32c(buf)
+
+
+def test_native_is_faster_than_numpy():
+    import time
+
+    rng = np.random.default_rng(0)
+    data = rng.integers(0, 256, size=(10, 1 << 20), dtype=np.uint8)
+    cpu, nat = RSCodecCPU(10, 4), rs_native.RSCodecNative(10, 4)
+    cpu.encode_parity(data); nat.encode_parity(data)  # warm
+
+    def t(f):
+        t0 = time.perf_counter()
+        f(data)
+        return time.perf_counter() - t0
+
+    assert t(nat.encode_parity) < t(cpu.encode_parity)
