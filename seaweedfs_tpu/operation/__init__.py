@@ -1,0 +1,129 @@
+"""Client-side verbs: assign, upload, delete, submit.
+
+Rebuild of /root/reference/weed/operation/ — `Assign`
+(assign_file_id.go:37), `Upload`/`UploadData` with gzip + retry
+(upload_content.go:85,134-160), `DeleteFiles` (delete_content.go), and
+`SubmitFiles` (submit.go:45).
+"""
+
+from __future__ import annotations
+
+import gzip
+import time
+from dataclasses import dataclass, field
+
+import requests
+
+from ..pb import master_pb2, rpc
+
+COMPRESS_MIN = 128  # don't bother gzipping tiny payloads
+
+
+@dataclass
+class AssignResult:
+    fid: str = ""
+    url: str = ""
+    public_url: str = ""
+    count: int = 0
+    error: str = ""
+    replicas: list = field(default_factory=list)
+
+
+def assign(master: str, *, count: int = 1, collection: str = "",
+           replication: str = "", ttl: str = "", data_center: str = "") -> AssignResult:
+    stub = rpc.master_stub(rpc.grpc_address(master))
+    resp = stub.Assign(master_pb2.AssignRequest(
+        count=count, collection=collection, replication=replication,
+        ttl=ttl, data_center=data_center), timeout=30)
+    if resp.error:
+        return AssignResult(error=resp.error)
+    return AssignResult(
+        fid=resp.fid, url=resp.location.url,
+        public_url=resp.location.public_url, count=resp.count,
+        replicas=[l.url for l in resp.replicas],
+    )
+
+
+@dataclass
+class UploadResult:
+    name: str = ""
+    size: int = 0
+    etag: str = ""
+    error: str = ""
+
+
+def upload_data(url: str, data: bytes, *, filename: str = "",
+                mime: str = "application/octet-stream", ttl: str = "",
+                compress: bool = True, retries: int = 3) -> UploadResult:
+    """PUT needle bytes to a volume server (UploadData w/ retry,
+    upload_content.go:85,134)."""
+    headers = {"Content-Type": mime or "application/octet-stream"}
+    body = data
+    if (compress and len(data) >= COMPRESS_MIN and _compressible(mime)):
+        gz = gzip.compress(data, 3)
+        if len(gz) < len(data) * 0.9:
+            body = gz
+            headers["Content-Encoding"] = "gzip"
+    if ttl:
+        url += ("&" if "?" in url else "?") + f"ttl={ttl}"
+    last: Exception | None = None
+    for attempt in range(retries):
+        try:
+            r = requests.put(url, data=body, headers=headers, timeout=60)
+            if r.status_code < 300:
+                j = r.json()
+                return UploadResult(name=j.get("name", filename),
+                                    size=j.get("size", len(data)),
+                                    etag=j.get("eTag", ""))
+            last = IOError(f"{r.status_code}: {r.text[:200]}")
+        except requests.RequestException as e:
+            last = e
+        time.sleep(0.2 * (attempt + 1))
+    return UploadResult(error=str(last))
+
+
+def _compressible(mime: str) -> bool:
+    if mime.startswith("text/") or mime.endswith(("json", "xml", "javascript")):
+        return True
+    return mime in ("", "application/octet-stream")
+
+
+def delete_files(master: str, fids: list[str]) -> list[dict]:
+    """Group fids by volume location and fan out BatchDelete RPCs
+    (delete_content.go DeleteFilesAtOneVolumeServer)."""
+    from ..pb import volume_server_pb2 as vs
+    from ..wdclient import MasterClient
+
+    mc = MasterClient(master)
+    by_server: dict[str, list[str]] = {}
+    results = []
+    for fid in fids:
+        try:
+            urls = mc.lookup_file_id(fid)
+        except LookupError as e:
+            results.append({"fid": fid, "error": str(e)})
+            continue
+        server = urls[0].split("//", 1)[1].split("/", 1)[0]
+        by_server.setdefault(server, []).append(fid)
+    for server, server_fids in by_server.items():
+        stub = rpc.volume_stub(rpc.grpc_address(server))
+        resp = stub.BatchDelete(
+            vs.BatchDeleteRequest(file_ids=server_fids), timeout=60)
+        for res in resp.results:
+            results.append({"fid": res.file_id, "size": res.size,
+                            "error": res.error or None})
+    return results
+
+
+def submit(master: str, data: bytes, *, filename: str = "",
+           collection: str = "", replication: str = "", ttl: str = "",
+           mime: str = "") -> dict:
+    """assign + upload in one call (SubmitFiles, submit.go:45)."""
+    a = assign(master, collection=collection, replication=replication, ttl=ttl)
+    if a.error:
+        return {"error": a.error}
+    r = upload_data(f"http://{a.url}/{a.fid}", data, filename=filename,
+                    mime=mime, ttl=ttl)
+    if r.error:
+        return {"error": r.error}
+    return {"fid": a.fid, "url": a.url, "size": r.size, "eTag": r.etag}

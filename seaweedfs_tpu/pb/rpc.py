@@ -1,0 +1,210 @@
+"""gRPC plumbing: service descriptors, generic stubs/servicers, channels.
+
+The reference centralizes its gRPC conventions in
+/root/reference/weed/pb/grpc_client_server.go — 1GB max message size (:27),
+keepalive (:47-60), and a process-wide cache of client connections keyed by
+address (:95-122). This module provides the same, plus a generic stub /
+servicer builder (protoc's Python gRPC plugin is not in this environment,
+so service classes are derived from descriptor tables instead of generated
+code — the wire format is identical).
+
+Convention kept from the reference: a server's gRPC port is its HTTP port
++ 10000 (weed/pb/server_address.go).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent import futures
+
+import grpc
+
+from . import filer_pb2, master_pb2, volume_server_pb2
+
+MAX_MESSAGE_SIZE = 1 << 30  # grpc_client_server.go:27
+GRPC_PORT_DELTA = 10000
+
+_CHANNEL_OPTIONS = [
+    ("grpc.max_send_message_length", MAX_MESSAGE_SIZE),
+    ("grpc.max_receive_message_length", MAX_MESSAGE_SIZE),
+    ("grpc.keepalive_time_ms", 30_000),
+    ("grpc.keepalive_timeout_ms", 20_000),
+]
+
+
+def _m(name, req, resp, *, cs=False, ss=False):
+    return {"name": name, "req": req, "resp": resp, "cs": cs, "ss": ss}
+
+
+# -- service descriptors ---------------------------------------------------
+
+M = master_pb2
+V = volume_server_pb2
+F = filer_pb2
+
+MASTER_SERVICE = ("master_pb.Seaweed", [
+    _m("SendHeartbeat", M.Heartbeat, M.HeartbeatResponse, cs=True, ss=True),
+    _m("KeepConnected", M.KeepConnectedRequest, M.KeepConnectedResponse, cs=True, ss=True),
+    _m("LookupVolume", M.LookupVolumeRequest, M.LookupVolumeResponse),
+    _m("Assign", M.AssignRequest, M.AssignResponse),
+    _m("Statistics", M.StatisticsRequest, M.StatisticsResponse),
+    _m("CollectionList", M.CollectionListRequest, M.CollectionListResponse),
+    _m("CollectionDelete", M.CollectionDeleteRequest, M.CollectionDeleteResponse),
+    _m("VolumeList", M.VolumeListRequest, M.VolumeListResponse),
+    _m("LookupEcVolume", M.LookupEcVolumeRequest, M.LookupEcVolumeResponse),
+    _m("VacuumVolume", M.VacuumVolumeRequest, M.VacuumVolumeResponse),
+    _m("GetMasterConfiguration", M.GetMasterConfigurationRequest, M.GetMasterConfigurationResponse),
+    _m("LeaseAdminToken", M.LeaseAdminTokenRequest, M.LeaseAdminTokenResponse),
+    _m("ReleaseAdminToken", M.ReleaseAdminTokenRequest, M.ReleaseAdminTokenResponse),
+    _m("Ping", M.PingRequest, M.PingResponse),
+])
+
+VOLUME_SERVICE = ("volume_server_pb.VolumeServer", [
+    _m("BatchDelete", V.BatchDeleteRequest, V.BatchDeleteResponse),
+    _m("VacuumVolumeCheck", V.VacuumVolumeCheckRequest, V.VacuumVolumeCheckResponse),
+    _m("VacuumVolumeCompact", V.VacuumVolumeCompactRequest, V.VacuumVolumeCompactResponse, ss=True),
+    _m("VacuumVolumeCommit", V.VacuumVolumeCommitRequest, V.VacuumVolumeCommitResponse),
+    _m("VacuumVolumeCleanup", V.VacuumVolumeCleanupRequest, V.VacuumVolumeCleanupResponse),
+    _m("DeleteCollection", V.DeleteCollectionRequest, V.DeleteCollectionResponse),
+    _m("AllocateVolume", V.AllocateVolumeRequest, V.AllocateVolumeResponse),
+    _m("VolumeSyncStatus", V.VolumeSyncStatusRequest, V.VolumeSyncStatusResponse),
+    _m("VolumeIncrementalCopy", V.VolumeIncrementalCopyRequest, V.VolumeIncrementalCopyResponse, ss=True),
+    _m("VolumeMount", V.VolumeMountRequest, V.VolumeMountResponse),
+    _m("VolumeUnmount", V.VolumeUnmountRequest, V.VolumeUnmountResponse),
+    _m("VolumeDelete", V.VolumeDeleteRequest, V.VolumeDeleteResponse),
+    _m("VolumeMarkReadonly", V.VolumeMarkReadonlyRequest, V.VolumeMarkReadonlyResponse),
+    _m("VolumeMarkWritable", V.VolumeMarkWritableRequest, V.VolumeMarkWritableResponse),
+    _m("VolumeConfigure", V.VolumeConfigureRequest, V.VolumeConfigureResponse),
+    _m("VolumeStatus", V.VolumeStatusRequest, V.VolumeStatusResponse),
+    _m("VolumeCopy", V.VolumeCopyRequest, V.VolumeCopyResponse, ss=True),
+    _m("ReadVolumeFileStatus", V.ReadVolumeFileStatusRequest, V.ReadVolumeFileStatusResponse),
+    _m("CopyFile", V.CopyFileRequest, V.CopyFileResponse, ss=True),
+    _m("ReadNeedleBlob", V.ReadNeedleBlobRequest, V.ReadNeedleBlobResponse),
+    _m("WriteNeedleBlob", V.WriteNeedleBlobRequest, V.WriteNeedleBlobResponse),
+    _m("ReadAllNeedles", V.ReadAllNeedlesRequest, V.ReadAllNeedlesResponse, ss=True),
+    _m("VolumeTailSender", V.VolumeTailSenderRequest, V.VolumeTailSenderResponse, ss=True),
+    _m("VolumeTailReceiver", V.VolumeTailReceiverRequest, V.VolumeTailReceiverResponse),
+    _m("VolumeEcShardsGenerate", V.VolumeEcShardsGenerateRequest, V.VolumeEcShardsGenerateResponse),
+    _m("VolumeEcShardsRebuild", V.VolumeEcShardsRebuildRequest, V.VolumeEcShardsRebuildResponse),
+    _m("VolumeEcShardsCopy", V.VolumeEcShardsCopyRequest, V.VolumeEcShardsCopyResponse),
+    _m("VolumeEcShardsDelete", V.VolumeEcShardsDeleteRequest, V.VolumeEcShardsDeleteResponse),
+    _m("VolumeEcShardsMount", V.VolumeEcShardsMountRequest, V.VolumeEcShardsMountResponse),
+    _m("VolumeEcShardsUnmount", V.VolumeEcShardsUnmountRequest, V.VolumeEcShardsUnmountResponse),
+    _m("VolumeEcShardRead", V.VolumeEcShardReadRequest, V.VolumeEcShardReadResponse, ss=True),
+    _m("VolumeEcBlobDelete", V.VolumeEcBlobDeleteRequest, V.VolumeEcBlobDeleteResponse),
+    _m("VolumeEcShardsToVolume", V.VolumeEcShardsToVolumeRequest, V.VolumeEcShardsToVolumeResponse),
+    _m("VolumeServerStatus", V.VolumeServerStatusRequest, V.VolumeServerStatusResponse),
+    _m("VolumeServerLeave", V.VolumeServerLeaveRequest, V.VolumeServerLeaveResponse),
+    _m("Ping", V.PingRequest, V.PingResponse),
+])
+
+FILER_SERVICE = ("filer_pb.SeaweedFiler", [
+    _m("LookupDirectoryEntry", F.LookupDirectoryEntryRequest, F.LookupDirectoryEntryResponse),
+    _m("ListEntries", F.ListEntriesRequest, F.ListEntriesResponse, ss=True),
+    _m("CreateEntry", F.CreateEntryRequest, F.CreateEntryResponse),
+    _m("UpdateEntry", F.UpdateEntryRequest, F.UpdateEntryResponse),
+    _m("AppendToEntry", F.AppendToEntryRequest, F.AppendToEntryResponse),
+    _m("DeleteEntry", F.DeleteEntryRequest, F.DeleteEntryResponse),
+    _m("AtomicRenameEntry", F.AtomicRenameEntryRequest, F.AtomicRenameEntryResponse),
+    _m("AssignVolume", F.AssignVolumeRequest, F.AssignVolumeResponse),
+    _m("LookupVolume", F.LookupVolumeRequest, F.LookupVolumeResponse),
+    _m("CollectionList", F.CollectionListRequest, F.CollectionListResponse),
+    _m("DeleteCollection", F.DeleteCollectionRequest, F.DeleteCollectionResponse),
+    _m("Statistics", F.StatisticsRequest, F.StatisticsResponse),
+    _m("GetFilerConfiguration", F.GetFilerConfigurationRequest, F.GetFilerConfigurationResponse),
+    _m("SubscribeMetadata", F.SubscribeMetadataRequest, F.SubscribeMetadataResponse, ss=True),
+    _m("SubscribeLocalMetadata", F.SubscribeMetadataRequest, F.SubscribeMetadataResponse, ss=True),
+    _m("KvGet", F.KvGetRequest, F.KvGetResponse),
+    _m("KvPut", F.KvPutRequest, F.KvPutResponse),
+    _m("Ping", F.PingRequest, F.PingResponse),
+])
+
+
+# -- generic stub / servicer -----------------------------------------------
+
+class Stub:
+    """Callable-per-method client stub built from a service descriptor."""
+
+    def __init__(self, channel: grpc.Channel, service):
+        full_name, methods = service
+        for m in methods:
+            path = f"/{full_name}/{m['name']}"
+            if m["cs"] and m["ss"]:
+                fn = channel.stream_stream(path, m["req"].SerializeToString, m["resp"].FromString)
+            elif m["ss"]:
+                fn = channel.unary_stream(path, m["req"].SerializeToString, m["resp"].FromString)
+            elif m["cs"]:
+                fn = channel.stream_unary(path, m["req"].SerializeToString, m["resp"].FromString)
+            else:
+                fn = channel.unary_unary(path, m["req"].SerializeToString, m["resp"].FromString)
+            setattr(self, m["name"], fn)
+
+
+def add_servicer(server: grpc.Server, service, servicer) -> None:
+    """Register `servicer` (an object with one method per RPC name) for the
+    given descriptor on a grpc.Server."""
+    full_name, methods = service
+    handlers = {}
+    for m in methods:
+        behavior = getattr(servicer, m["name"])
+        kw = dict(request_deserializer=m["req"].FromString,
+                  response_serializer=m["resp"].SerializeToString)
+        if m["cs"] and m["ss"]:
+            h = grpc.stream_stream_rpc_method_handler(behavior, **kw)
+        elif m["ss"]:
+            h = grpc.unary_stream_rpc_method_handler(behavior, **kw)
+        elif m["cs"]:
+            h = grpc.stream_unary_rpc_method_handler(behavior, **kw)
+        else:
+            h = grpc.unary_unary_rpc_method_handler(behavior, **kw)
+        handlers[m["name"]] = h
+    server.add_generic_rpc_handlers(
+        (grpc.method_handlers_generic_handler(full_name, handlers),)
+    )
+
+
+def new_server(max_workers: int = 32) -> grpc.Server:
+    return grpc.server(
+        futures.ThreadPoolExecutor(max_workers=max_workers),
+        options=_CHANNEL_OPTIONS,
+    )
+
+
+# -- channel cache (grpc_client_server.go:95-122) --------------------------
+
+_channels: dict[str, grpc.Channel] = {}
+_channels_lock = threading.Lock()
+
+
+def cached_channel(address: str) -> grpc.Channel:
+    with _channels_lock:
+        ch = _channels.get(address)
+        if ch is None:
+            ch = grpc.insecure_channel(address, options=_CHANNEL_OPTIONS)
+            _channels[address] = ch
+        return ch
+
+
+def reset_channels() -> None:
+    with _channels_lock:
+        for ch in _channels.values():
+            ch.close()
+        _channels.clear()
+
+
+def grpc_address(http_address: str) -> str:
+    """HTTP host:port -> gRPC host:port (+10000 convention)."""
+    host, _, port = http_address.rpartition(":")
+    return f"{host}:{int(port) + GRPC_PORT_DELTA}"
+
+
+def master_stub(address: str) -> Stub:
+    return Stub(cached_channel(address), MASTER_SERVICE)
+
+
+def volume_stub(address: str) -> Stub:
+    return Stub(cached_channel(address), VOLUME_SERVICE)
+
+
+def filer_stub(address: str) -> Stub:
+    return Stub(cached_channel(address), FILER_SERVICE)

@@ -1,0 +1,484 @@
+"""Master server: cluster brain — heartbeat ingest, assignment, EC lookup.
+
+Rebuild of /root/reference/weed/server/master_server.go +
+master_grpc_server.go + master_server_handlers.go. Serves:
+
+* gRPC (master_pb.Seaweed): SendHeartbeat bidirectional stream (:61),
+  Assign, LookupVolume, LookupEcVolume, VolumeList, Statistics,
+  CollectionList/Delete, KeepConnected membership push (:250),
+  LeaseAdminToken (shell cluster lock), Ping.
+* HTTP on the master port: /dir/assign (master_server_handlers.go:102),
+  /dir/lookup, /vol/vacuum, /col/delete, /cluster/status, /dir/status,
+  /metrics (Prometheus text).
+
+Single-master deployment is the default; multi-master leadership is a
+pluggable hook (is_leader / leader_address) the same way the reference
+gates every mutating RPC on `Topo.IsLeader()`.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import grpc
+
+from ..pb import master_pb2, rpc
+from ..sequence import new_sequencer
+from ..storage.file_id import parse_file_id
+from ..storage.super_block import ReplicaPlacement
+from ..storage.ttl import EMPTY_TTL, TTL
+from ..topology import Topology, VolumeGrowth
+from ..topology.topology import EcShardInfo, VolumeInfo
+from ..utils import glog
+from ..utils.stats import MASTER_RECEIVED_HEARTBEATS, master_metrics_text
+
+
+class MasterServer:
+    def __init__(self, *, ip: str = "localhost", port: int = 9333,
+                 volume_size_limit_mb: int = 30_000,
+                 default_replication: str = "000",
+                 pulse_seconds: int = 5,
+                 sequencer_type: str = "memory",
+                 garbage_threshold: float = 0.3,
+                 allocate_fn=None):
+        self.ip = ip
+        self.port = port
+        self.grpc_port = port + rpc.GRPC_PORT_DELTA
+        self.default_replication = default_replication
+        self.garbage_threshold = garbage_threshold
+        self.topo = Topology(
+            volume_size_limit=volume_size_limit_mb * 1024 * 1024,
+            pulse_seconds=pulse_seconds,
+            sequencer=new_sequencer(sequencer_type),
+        )
+        self.growth = VolumeGrowth(self.topo, allocate_fn=allocate_fn)
+        self._grow_lock = threading.Lock()
+        self._admin_locks: dict[str, tuple[int, int, str]] = {}  # name -> (token, ts, client)
+        self._admin_lock_mu = threading.Lock()
+        self._keepalive_clients: dict[str, queue.Queue] = {}
+        self._keepalive_mu = threading.Lock()
+        self._grpc_server = None
+        self._http_server = None
+        self._vacuum_thread = None
+        self._stop = threading.Event()
+
+    # -- leadership (single-master default) --------------------------------
+
+    def is_leader(self) -> bool:
+        return True
+
+    @property
+    def address(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, *, vacuum_interval: float = 60.0) -> None:
+        self._grpc_server = rpc.new_server()
+        rpc.add_servicer(self._grpc_server, rpc.MASTER_SERVICE, MasterGrpc(self))
+        self._grpc_server.add_insecure_port(f"[::]:{self.grpc_port}")
+        self._grpc_server.start()
+        self._http_server = ThreadingHTTPServer(
+            ("", self.port), _make_http_handler(self)
+        )
+        threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
+        self._vacuum_thread = threading.Thread(
+            target=self._vacuum_loop, args=(vacuum_interval,), daemon=True
+        )
+        self._vacuum_thread.start()
+        glog.info(f"master started on {self.address} (grpc :{self.grpc_port})")
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._http_server:
+            self._http_server.shutdown()
+        if self._grpc_server:
+            self._grpc_server.stop(grace=0.5)
+
+    # -- assignment --------------------------------------------------------
+
+    def assign(self, *, count: int = 1, replication: str = "",
+               collection: str = "", ttl: str = "", data_center: str = "",
+               rack: str = "", data_node: str = "") -> dict:
+        rp = ReplicaPlacement.parse(replication or self.default_replication)
+        t = TTL.parse(ttl) if ttl else EMPTY_TTL
+        vl = self.topo.get_layout(collection, rp, t)
+        if vl.active_count() == 0:
+            with self._grow_lock:  # single grower, like vgCh serialization
+                if vl.active_count() == 0:
+                    self.growth.grow(
+                        collection, rp, t,
+                        count=self.growth.default_count(rp),
+                        data_center=data_center, rack=rack, data_node=data_node,
+                    )
+        try:
+            fid, n, locations = self.topo.pick_for_write(collection, rp, t, count=count)
+        except ValueError as e:
+            return {"error": str(e)}
+        primary = locations[0]
+        return {
+            "fid": fid,
+            "count": n,
+            "url": primary.url,
+            "publicUrl": primary.public_url,
+            "replicas": locations[1:],
+            "location": primary,
+        }
+
+    # -- heartbeat ingest --------------------------------------------------
+
+    def handle_heartbeat(self, hb: master_pb2.Heartbeat, dn=None):
+        from ..topology.topology import DataNode
+
+        MASTER_RECEIVED_HEARTBEATS.inc()
+        if dn is None:
+            dn = DataNode(
+                ip=hb.ip, port=hb.port, public_url=hb.public_url,
+                grpc_port=hb.grpc_port or hb.port + rpc.GRPC_PORT_DELTA,
+                data_center=hb.data_center or "DefaultDataCenter",
+                rack=hb.rack or "DefaultRack",
+            )
+            dn = self.topo.register_node(dn)
+        dn.last_seen = time.time()
+        if hb.max_volume_counts:
+            dn.max_volume_count = sum(hb.max_volume_counts.values())
+        if hb.max_file_key:
+            dn.max_file_key = hb.max_file_key
+            self.topo.sequence.set_max(hb.max_file_key)
+        new_vids, gone_vids = [], []
+        if hb.volumes or hb.has_no_volumes:
+            before = set(dn.volumes)
+            self.topo.sync_node_volumes(dn, [VolumeInfo.from_pb(v) for v in hb.volumes])
+            after = set(dn.volumes)
+            new_vids, gone_vids = sorted(after - before), sorted(before - after)
+        for v in hb.new_volumes:
+            self.topo.register_volume(VolumeInfo(
+                id=v.id, collection=v.collection,
+                replica_placement=ReplicaPlacement.from_byte(v.replica_placement),
+                ttl=TTL.from_uint32(v.ttl), version=v.version or 3,
+            ), dn)
+            new_vids.append(v.id)
+        for v in hb.deleted_volumes:
+            if v.id in dn.volumes:
+                self.topo._unregister_volume(dn.volumes[v.id], dn)
+                gone_vids.append(v.id)
+        if hb.ec_shards or hb.has_no_ec_shards:
+            self.topo.sync_node_ec_shards(dn, [
+                EcShardInfo(e.id, e.collection, e.ec_index_bits)
+                for e in hb.ec_shards
+            ])
+        for e in hb.new_ec_shards:
+            self.topo.register_ec_shards(
+                EcShardInfo(e.id, e.collection, e.ec_index_bits), dn
+            )
+        for e in hb.deleted_ec_shards:
+            self.topo.unregister_ec_shards(e.id, dn, e.ec_index_bits)
+        if new_vids or gone_vids:
+            self._broadcast_location(dn, new_vids, gone_vids)
+        return dn
+
+    def _broadcast_location(self, dn, new_vids, deleted_vids) -> None:
+        msg = master_pb2.KeepConnectedResponse(
+            volume_location=master_pb2.VolumeLocation(
+                url=dn.url, public_url=dn.public_url, grpc_port=dn.grpc_port,
+                data_center=dn.data_center,
+                new_vids=new_vids, deleted_vids=deleted_vids,
+                leader=self.address,
+            )
+        )
+        with self._keepalive_mu:
+            for q in self._keepalive_clients.values():
+                q.put(msg)
+
+    # -- vacuum driver (topology_vacuum.go) --------------------------------
+
+    def _vacuum_loop(self, interval: float) -> None:
+        while not self._stop.wait(interval):
+            try:
+                self.vacuum_once(self.garbage_threshold)
+            except Exception as e:  # noqa: BLE001 - keep the driver alive
+                glog.warning(f"vacuum pass failed: {e}")
+
+    def vacuum_once(self, threshold: float, volume_id: int = 0) -> int:
+        """One scan: compact+commit every volume whose garbage ratio exceeds
+        `threshold` on all replicas. -> volumes vacuumed."""
+        from ..pb import volume_server_pb2 as vs
+
+        done = 0
+        for vl in list(self.topo.layouts.values()):
+            for vid, nodes in list(vl.locations.items()):
+                if volume_id and vid != volume_id:
+                    continue
+                try:
+                    ratios = []
+                    for dn in nodes:
+                        stub = rpc.volume_stub(dn.grpc_address)
+                        r = stub.VacuumVolumeCheck(
+                            vs.VacuumVolumeCheckRequest(volume_id=vid), timeout=30)
+                        ratios.append(r.garbage_ratio)
+                    if not ratios or min(ratios) < threshold:
+                        continue
+                    vl.set_volume_unavailable(vid)
+                    for dn in nodes:
+                        stub = rpc.volume_stub(dn.grpc_address)
+                        for _ in stub.VacuumVolumeCompact(
+                                vs.VacuumVolumeCompactRequest(volume_id=vid),
+                                timeout=3600):
+                            pass
+                    for dn in nodes:
+                        stub = rpc.volume_stub(dn.grpc_address)
+                        stub.VacuumVolumeCommit(
+                            vs.VacuumVolumeCommitRequest(volume_id=vid), timeout=600)
+                    done += 1
+                except grpc.RpcError as e:
+                    glog.warning(f"vacuum volume {vid}: {e.code()}")
+        return done
+
+
+# -- gRPC servicer ---------------------------------------------------------
+
+class MasterGrpc:
+    def __init__(self, ms: MasterServer):
+        self.ms = ms
+
+    def SendHeartbeat(self, request_iterator, context):
+        ms = self.ms
+        dn = None
+        try:
+            for hb in request_iterator:
+                dn = ms.handle_heartbeat(hb, dn)
+                yield master_pb2.HeartbeatResponse(
+                    volume_size_limit=ms.topo.volume_size_limit,
+                    leader=ms.address,
+                )
+        finally:
+            # stream break = node presumed down (defer-unregister path)
+            if dn is not None:
+                ms.topo.unregister_node(dn.url)
+
+    def KeepConnected(self, request_iterator, context):
+        ms = self.ms
+        first = next(iter(request_iterator), None)
+        if first is None:
+            return
+        key = f"{first.client_type}@{first.client_address}#{id(context)}"
+        q: queue.Queue = queue.Queue()
+        with ms._keepalive_mu:
+            ms._keepalive_clients[key] = q
+        try:
+            # initial full picture: every node with its volumes
+            for dn in ms.topo.alive_nodes():
+                yield master_pb2.KeepConnectedResponse(
+                    volume_location=master_pb2.VolumeLocation(
+                        url=dn.url, public_url=dn.public_url,
+                        grpc_port=dn.grpc_port, data_center=dn.data_center,
+                        new_vids=sorted(dn.volumes),
+                        new_ec_vids=sorted(dn.ec_shards),
+                        leader=ms.address,
+                    )
+                )
+            while context.is_active():
+                try:
+                    yield q.get(timeout=1.0)
+                except queue.Empty:
+                    continue
+        finally:
+            with ms._keepalive_mu:
+                ms._keepalive_clients.pop(key, None)
+
+    def LookupVolume(self, request, context):
+        resp = master_pb2.LookupVolumeResponse()
+        for vof in request.volume_or_file_ids:
+            entry = resp.volume_id_locations.add(volume_or_file_id=vof)
+            try:
+                vid_str = vof.split(",")[0]
+                vid = int(vid_str)
+            except ValueError:
+                entry.error = f"unknown volume id {vof}"
+                continue
+            nodes = self.ms.topo.lookup(request.collection, vid)
+            if not nodes:
+                entry.error = f"volume {vid} not found"
+                continue
+            for dn in nodes:
+                entry.locations.append(dn.to_location())
+        return resp
+
+    def Assign(self, request, context):
+        r = self.ms.assign(
+            count=int(request.count) or 1, replication=request.replication,
+            collection=request.collection, ttl=request.ttl,
+            data_center=request.data_center, rack=request.rack,
+            data_node=request.data_node,
+        )
+        if "error" in r:
+            return master_pb2.AssignResponse(error=r["error"])
+        return master_pb2.AssignResponse(
+            fid=r["fid"], count=r["count"],
+            location=r["location"].to_location(),
+            replicas=[dn.to_location() for dn in r["replicas"]],
+        )
+
+    def Statistics(self, request, context):
+        total, used, files = self.ms.topo.statistics(request.collection)
+        return master_pb2.StatisticsResponse(
+            total_size=total, used_size=used, file_count=files
+        )
+
+    def CollectionList(self, request, context):
+        return master_pb2.CollectionListResponse(
+            collections=[master_pb2.Collection(name=c)
+                         for c in self.ms.topo.collections()]
+        )
+
+    def CollectionDelete(self, request, context):
+        from ..pb import volume_server_pb2 as vs
+
+        for dn in self.ms.topo.alive_nodes():
+            try:
+                rpc.volume_stub(dn.grpc_address).DeleteCollection(
+                    vs.DeleteCollectionRequest(collection=request.name), timeout=60)
+            except grpc.RpcError:
+                pass
+        return master_pb2.CollectionDeleteResponse()
+
+    def VolumeList(self, request, context):
+        return master_pb2.VolumeListResponse(
+            topology_info=self.ms.topo.to_topology_info(),
+            volume_size_limit_mb=self.ms.topo.volume_size_limit // (1024 * 1024),
+        )
+
+    def LookupEcVolume(self, request, context):
+        shard_locs = self.ms.topo.lookup_ec_shards(request.volume_id)
+        if not shard_locs:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"ec volume {request.volume_id} not found")
+        resp = master_pb2.LookupEcVolumeResponse(volume_id=request.volume_id)
+        for sid in sorted(shard_locs):
+            entry = resp.shard_id_locations.add(shard_id=sid)
+            for dn in shard_locs[sid]:
+                entry.locations.append(dn.to_location())
+        return resp
+
+    def VacuumVolume(self, request, context):
+        self.ms.vacuum_once(request.garbage_threshold or 0.0001,
+                            volume_id=request.volume_id)
+        return master_pb2.VacuumVolumeResponse()
+
+    def GetMasterConfiguration(self, request, context):
+        return master_pb2.GetMasterConfigurationResponse(
+            leader=self.ms.address,
+            default_replication=self.ms.default_replication,
+            volume_size_limit_m_b=self.ms.topo.volume_size_limit // (1024 * 1024),
+        )
+
+    def LeaseAdminToken(self, request, context):
+        ms = self.ms
+        now = time.time_ns()
+        with ms._admin_lock_mu:
+            cur = ms._admin_locks.get(request.lock_name)
+            if cur is not None:
+                token, ts, client = cur
+                expired = now - ts > 60e9
+                if not expired and request.previous_token != token:
+                    context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                                  f"lock is held by {client}")
+            token = now
+            ms._admin_locks[request.lock_name] = (token, now, request.client_name)
+            return master_pb2.LeaseAdminTokenResponse(token=token, lock_ts_ns=now)
+
+    def ReleaseAdminToken(self, request, context):
+        with self.ms._admin_lock_mu:
+            cur = self.ms._admin_locks.get(request.lock_name)
+            if cur is not None and cur[0] == request.previous_token:
+                del self.ms._admin_locks[request.lock_name]
+        return master_pb2.ReleaseAdminTokenResponse()
+
+    def Ping(self, request, context):
+        now = time.time_ns()
+        return master_pb2.PingResponse(
+            start_time_ns=now, remote_time_ns=now, stop_time_ns=time.time_ns()
+        )
+
+
+# -- HTTP plane ------------------------------------------------------------
+
+def _make_http_handler(ms: MasterServer):
+    class Handler(BaseHTTPRequestHandler):
+        def log_message(self, fmt, *args):  # route to glog, not stderr
+            glog.v(2, f"master http: {fmt % args}")
+
+        def _json(self, obj, code: int = 200) -> None:
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            u = urlparse(self.path)
+            q = {k: v[0] for k, v in parse_qs(u.query).items()}
+            if u.path == "/dir/assign":
+                r = ms.assign(
+                    count=int(q.get("count", 1)),
+                    replication=q.get("replication", ""),
+                    collection=q.get("collection", ""),
+                    ttl=q.get("ttl", ""),
+                    data_center=q.get("dataCenter", ""),
+                    rack=q.get("rack", ""),
+                )
+                if "error" in r:
+                    return self._json(r, 404)
+                return self._json({
+                    "fid": r["fid"], "count": r["count"],
+                    "url": r["url"], "publicUrl": r["publicUrl"],
+                })
+            if u.path == "/dir/lookup":
+                vof = q.get("volumeId", q.get("fileId", ""))
+                try:
+                    vid = int(str(vof).split(",")[0])
+                except ValueError:
+                    return self._json({"error": f"bad volumeId {vof}"}, 400)
+                nodes = ms.topo.lookup(q.get("collection", ""), vid)
+                if not nodes:
+                    return self._json(
+                        {"volumeOrFileId": vof, "error": "not found"}, 404)
+                return self._json({
+                    "volumeOrFileId": vof,
+                    "locations": [
+                        {"url": n.url, "publicUrl": n.public_url} for n in nodes
+                    ],
+                })
+            if u.path in ("/dir/status", "/cluster/status"):
+                total, used, files = ms.topo.statistics()
+                return self._json({
+                    "IsLeader": ms.is_leader(), "Leader": ms.address,
+                    "Topology": {
+                        "Max": total, "Size": used, "FileCount": files,
+                        "DataNodes": sorted(ms.topo.nodes),
+                    },
+                })
+            if u.path == "/vol/vacuum":
+                n = ms.vacuum_once(float(q.get("garbageThreshold", 0.0001)))
+                return self._json({"vacuumed": n})
+            if u.path == "/col/delete":
+                return self._json({"error": "use gRPC CollectionDelete"}, 400)
+            if u.path == "/metrics":
+                body = master_metrics_text().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            self._json({"error": "not found"}, 404)
+
+        do_POST = do_GET
+
+    return Handler

@@ -1,0 +1,969 @@
+"""Volume server: HTTP data plane + gRPC admin plane + master heartbeat.
+
+Rebuild of /root/reference/weed/server/volume_server.go,
+volume_server_handlers_{read,write}.go, volume_grpc_*.go and
+volume_grpc_client_to_master.go:50-92. The data plane speaks HTTP
+(PUT/GET/DELETE of "/vid,fid" needles, replica fan-out with
+`?type=replicate`); the admin plane is gRPC (vacuum, allocate, mount,
+copy, tail, and the nine erasure-coding RPCs whose shard math runs on the
+JAX/TPU coder).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+import grpc
+import numpy as np
+
+from ..pb import master_pb2, rpc, volume_server_pb2 as vs
+from ..storage import types
+from ..storage.ec_files import (
+    find_dat_file_size,
+    rebuild_ec_files,
+    write_dat_file,
+    write_ec_files,
+    write_idx_file_from_ec_index,
+    write_sorted_file_from_idx,
+)
+from ..storage.ec_locate import Geometry, locate_data
+from ..storage.ec_volume import EcVolume, delete_needle_from_ecx
+from ..storage.errors import CookieMismatch, DeletedError, NotFoundError
+from ..storage.file_id import parse_file_id
+from ..storage.needle import Needle
+from ..storage.store import Store
+from ..storage.ttl import TTL
+from ..utils import glog
+from ..utils.stats import (
+    VOLUME_SERVER_EC_ENCODE_BYTES,
+    VOLUME_SERVER_REQUEST_HISTOGRAM,
+    VOLUME_SERVER_VOLUME_COUNTER,
+    gather,
+)
+
+BUFFER_SIZE_LIMIT = 2 * 1024 * 1024  # streaming chunk (volume_grpc_copy.go:25)
+
+
+class VolumeServer:
+    def __init__(self, *, directories: list[str], master: str,
+                 ip: str = "localhost", port: int = 8080,
+                 public_url: str = "", data_center: str = "", rack: str = "",
+                 max_volume_counts: list[int] | None = None,
+                 pulse_seconds: int = 5, coder=None,
+                 ec_geometry: Geometry = Geometry()):
+        self.ip = ip
+        self.port = port
+        self.grpc_port = port + rpc.GRPC_PORT_DELTA
+        self.master = master  # HTTP address; gRPC is +10000
+        self.master_grpc = rpc.grpc_address(master)
+        self.pulse_seconds = pulse_seconds
+        self.ec_geometry = ec_geometry
+        self.store = Store(
+            directories, coder=coder, max_volume_counts=max_volume_counts,
+            ip=ip, port=port, public_url=public_url, grpc_port=self.grpc_port,
+            data_center=data_center, rack=rack,
+        )
+        self.volume_size_limit = 30_000 * 1024 * 1024
+        self._grpc_server = None
+        self._http_server = None
+        self._stop = threading.Event()
+        self._hb_wake = threading.Event()
+        # vid -> {shard_id: [addresses]} with expiry (store_ec.go:238 cache)
+        self._ec_loc_cache: dict[int, tuple[float, dict[int, list[str]]]] = {}
+
+    @property
+    def address(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._grpc_server = rpc.new_server()
+        rpc.add_servicer(self._grpc_server, rpc.VOLUME_SERVICE, VolumeGrpc(self))
+        self._grpc_server.add_insecure_port(f"[::]:{self.grpc_port}")
+        self._grpc_server.start()
+        self._http_server = ThreadingHTTPServer(
+            ("", self.port), _make_http_handler(self)
+        )
+        threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
+        threading.Thread(target=self._heartbeat_loop, daemon=True).start()
+        glog.info(f"volume server started on {self.address} (grpc :{self.grpc_port})")
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._hb_wake.set()
+        if self._http_server:
+            self._http_server.shutdown()
+        if self._grpc_server:
+            self._grpc_server.stop(grace=0.5)
+        self.store.close()
+
+    # -- heartbeat client (volume_grpc_client_to_master.go:50-92) ----------
+
+    def _heartbeat_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._do_heartbeat()
+            except grpc.RpcError as e:
+                glog.v(1, f"heartbeat to {self.master} failed: {e.code()}")
+            if not self._stop.is_set():
+                self._stop.wait(1.0)
+
+    def _do_heartbeat(self) -> None:
+        stub = rpc.master_stub(self.master_grpc)
+
+        def requests():
+            while not self._stop.is_set():
+                yield self.store.collect_heartbeat()
+                self._hb_wake.wait(self.pulse_seconds)
+                self._hb_wake.clear()
+
+        for resp in stub.SendHeartbeat(requests()):
+            if resp.volume_size_limit:
+                self.volume_size_limit = resp.volume_size_limit
+            VOLUME_SERVER_VOLUME_COUNTER.set(
+                sum(len(l.volumes) for l in self.store.locations)
+            )
+            if self._stop.is_set():
+                return
+
+    def trigger_heartbeat(self) -> None:
+        self._hb_wake.set()
+
+    # -- needle read incl. EC (store.go:410 / store_ec.go:136) -------------
+
+    def read_needle(self, vid: int, needle_id: int, cookie: int | None):
+        v = self.store.find_volume(vid)
+        if v is not None:
+            return v.read_needle(needle_id, cookie)
+        ev = self.store.find_ec_volume(vid)
+        if ev is not None:
+            return self._read_ec_needle(ev, vid, needle_id, cookie)
+        raise NotFoundError(f"volume {vid} not found")
+
+    def _read_ec_needle(self, ev: EcVolume, vid: int, needle_id: int,
+                        cookie: int | None) -> Needle:
+        offset, size = ev.find_needle(needle_id)
+        if types.size_is_deleted(size):
+            raise DeletedError(f"needle {needle_id:x} deleted")
+        length = types.actual_size(size, ev.version)
+        blob = self._read_ec_extent(ev, vid, offset, length)
+        n = Needle.from_bytes(blob, ev.version, expected_size=size)
+        if cookie is not None and n.cookie != cookie:
+            raise CookieMismatch("cookie mismatch on EC read")
+        return n
+
+    def _read_ec_extent(self, ev: EcVolume, vid: int, offset: int, length: int) -> bytes:
+        """readEcShardIntervals (store_ec.go:176): local shard file, else
+        remote peer holding the shard, else reconstruct from any k."""
+        intervals = locate_data(ev.geo, ev.dat_size_estimate, offset, length)
+        out = bytearray()
+        for iv in intervals:
+            sid, soff = iv.to_shard_id_and_offset(ev.geo)
+            out += self._read_ec_interval(ev, vid, sid, soff, iv.size)
+        return bytes(out)
+
+    def _read_ec_interval(self, ev: EcVolume, vid: int, sid: int,
+                          soff: int, size: int) -> bytes:
+        f = ev.shard_files.get(sid)
+        if f is not None:
+            data = os.pread(f.fileno(), size, soff)
+            return data + b"\0" * (size - len(data))
+        locs = self._lookup_ec_shards(vid)
+        for addr in locs.get(sid, []):
+            if addr == self.address:
+                continue
+            try:
+                return self._remote_shard_read(addr, vid, sid, soff, size)
+            except grpc.RpcError:
+                continue
+        # degraded: gather k intervals from local+remote shards in parallel
+        # (recoverOneRemoteEcShardInterval, store_ec.go:339-393)
+        return self._reconstruct_interval(ev, vid, sid, soff, size, locs)
+
+    def _remote_shard_read(self, addr: str, vid: int, sid: int,
+                           soff: int, size: int) -> bytes:
+        stub = rpc.volume_stub(rpc.grpc_address(addr))
+        buf = bytearray()
+        for resp in stub.VolumeEcShardRead(vs.VolumeEcShardReadRequest(
+                volume_id=vid, shard_id=sid, offset=soff, size=size), timeout=60):
+            buf += resp.data
+        buf += b"\0" * (size - len(buf))
+        return bytes(buf)
+
+    def _reconstruct_interval(self, ev: EcVolume, vid: int, sid: int,
+                              soff: int, size: int,
+                              locs: dict[int, list[str]]) -> bytes:
+        geo = ev.geo
+        bufs: dict[int, np.ndarray] = {}
+        for i, f in ev.shard_files.items():
+            data = os.pread(f.fileno(), size, soff)
+            bufs[i] = np.frombuffer(data + b"\0" * (size - len(data)), np.uint8)
+
+        missing = [
+            i for i in range(geo.total_shards)
+            if i not in bufs and i != sid and locs.get(i)
+        ]
+
+        def fetch(i):
+            for addr in locs.get(i, []):
+                if addr == self.address:
+                    continue
+                try:
+                    return i, np.frombuffer(
+                        self._remote_shard_read(addr, vid, i, soff, size), np.uint8)
+                except grpc.RpcError:
+                    continue
+            return i, None
+
+        if len(bufs) < geo.data_shards and missing:
+            with ThreadPoolExecutor(max_workers=8) as ex:
+                for i, arr in ex.map(fetch, missing):
+                    if arr is not None:
+                        bufs[i] = arr
+                    if len(bufs) >= geo.data_shards:
+                        break
+        if len(bufs) < geo.data_shards:
+            raise IOError(
+                f"ec volume {vid}: only {len(bufs)} shards reachable, "
+                f"need {geo.data_shards}")
+        rebuilt = self.store.coder.reconstruct({i: b for i, b in bufs.items()})
+        if sid in rebuilt:
+            return np.asarray(rebuilt[sid], np.uint8).tobytes()
+        return bufs[sid].tobytes()
+
+    def _lookup_ec_shards(self, vid: int) -> dict[int, list[str]]:
+        """cachedLookupEcShardLocations (store_ec.go:238), 10s TTL."""
+        now = time.time()
+        cached = self._ec_loc_cache.get(vid)
+        if cached and cached[0] > now:
+            return cached[1]
+        out: dict[int, list[str]] = {}
+        try:
+            stub = rpc.master_stub(self.master_grpc)
+            resp = stub.LookupEcVolume(
+                master_pb2.LookupEcVolumeRequest(volume_id=vid), timeout=10)
+            for sl in resp.shard_id_locations:
+                out[sl.shard_id] = [l.url for l in sl.locations]
+        except grpc.RpcError as e:
+            glog.v(1, f"LookupEcVolume {vid}: {e.code()}")
+        self._ec_loc_cache[vid] = (now + 10.0, out)
+        return out
+
+    # -- replication (topology/store_replicate.go:24) ----------------------
+
+    def replicate_write(self, fid: str, body: bytes, params: dict,
+                        locations: list[str]) -> None:
+        import requests as rq
+
+        def send(addr):
+            url = f"http://{addr}/{fid}?type=replicate"
+            for k, v in params.items():
+                url += f"&{k}={v}"
+            r = rq.put(url, data=body, timeout=30)
+            if r.status_code >= 300:
+                raise IOError(f"replica write to {addr}: {r.status_code}")
+
+        with ThreadPoolExecutor(max_workers=4) as ex:
+            list(ex.map(send, [a for a in locations if a != self.address]))
+
+    def lookup_volume_locations(self, vid: int) -> list[str]:
+        try:
+            stub = rpc.master_stub(self.master_grpc)
+            resp = stub.LookupVolume(
+                master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)]),
+                timeout=10)
+            for e in resp.volume_id_locations:
+                return [l.url for l in e.locations]
+        except grpc.RpcError:
+            pass
+        return []
+
+
+# -- gRPC admin servicer ---------------------------------------------------
+
+class VolumeGrpc:
+    def __init__(self, srv: VolumeServer):
+        self.srv = srv
+        self.store = srv.store
+
+    # ---- batch delete
+
+    def BatchDelete(self, request, context):
+        resp = vs.BatchDeleteResponse()
+        for fid in request.file_ids:
+            res = resp.results.add(file_id=fid)
+            try:
+                f = parse_file_id(fid)
+                cookie = None if request.skip_cookie_check else f.cookie
+                res.size = self.store.delete_needle(f.volume_id, f.key, cookie)
+                res.status = 202
+            except Exception as e:  # noqa: BLE001
+                res.status, res.error = 500, str(e)
+        return resp
+
+    # ---- vacuum
+
+    def VacuumVolumeCheck(self, request, context):
+        v = self._volume(request.volume_id, context)
+        return vs.VacuumVolumeCheckResponse(garbage_ratio=v.garbage_level())
+
+    def VacuumVolumeCompact(self, request, context):
+        v = self._volume(request.volume_id, context)
+        v.compact()
+        yield vs.VacuumVolumeCompactResponse(processed_bytes=v.data_size())
+
+    def VacuumVolumeCommit(self, request, context):
+        v = self._volume(request.volume_id, context)
+        v.commit_compact()
+        return vs.VacuumVolumeCommitResponse(
+            is_read_only=v.read_only, volume_size=v.data_size())
+
+    def VacuumVolumeCleanup(self, request, context):
+        v = self._volume(request.volume_id, context)
+        base = v.file_name()
+        for ext in (".cpd", ".cpx"):
+            try:
+                os.remove(base + ext)
+            except FileNotFoundError:
+                pass
+        v.is_compacting = False
+        return vs.VacuumVolumeCleanupResponse()
+
+    # ---- collections / allocation
+
+    def DeleteCollection(self, request, context):
+        self.store.delete_collection(request.collection)
+        self.srv.trigger_heartbeat()
+        return vs.DeleteCollectionResponse()
+
+    def AllocateVolume(self, request, context):
+        self.store.add_volume(
+            request.volume_id, request.collection,
+            request.replication, request.ttl,
+        )
+        self.srv.trigger_heartbeat()
+        return vs.AllocateVolumeResponse()
+
+    # ---- status / sync
+
+    def VolumeSyncStatus(self, request, context):
+        v = self._volume(request.volume_id, context)
+        return vs.VolumeSyncStatusResponse(
+            volume_id=v.id, collection=v.collection,
+            replication=str(v.super_block.replica_placement),
+            ttl=str(v.ttl), tail_offset=v.data_size(),
+            compact_revision=v.super_block.compaction_revision,
+            idx_file_size=os.path.getsize(v.nm.idx_path),
+        )
+
+    def VolumeIncrementalCopy(self, request, context):
+        """Stream .dat bytes appended at/after since_ns (volume_backup.go
+        binary-search semantics, linear scan here)."""
+        v = self._volume(request.volume_id, context)
+        start = None
+        for n, off in v.scan_needles():
+            if n.append_at_ns >= request.since_ns:
+                start = off
+                break
+        if start is None:
+            return
+        size = v.data_size()
+        while start < size:
+            chunk = v._pread(start, min(BUFFER_SIZE_LIMIT, size - start))
+            if not chunk:
+                break
+            yield vs.VolumeIncrementalCopyResponse(file_content=chunk)
+            start += len(chunk)
+
+    # ---- mount / unmount / delete / readonly
+
+    def VolumeMount(self, request, context):
+        self.store.mount_volume(request.volume_id)
+        self.srv.trigger_heartbeat()
+        return vs.VolumeMountResponse()
+
+    def VolumeUnmount(self, request, context):
+        self.store.unmount_volume(request.volume_id)
+        self.srv.trigger_heartbeat()
+        return vs.VolumeUnmountResponse()
+
+    def VolumeDelete(self, request, context):
+        try:
+            self.store.delete_volume(request.volume_id, request.only_empty)
+        except NotFoundError:
+            pass
+        self.srv.trigger_heartbeat()
+        return vs.VolumeDeleteResponse()
+
+    def VolumeMarkReadonly(self, request, context):
+        self._volume(request.volume_id, context).read_only = True
+        self.srv.trigger_heartbeat()
+        return vs.VolumeMarkReadonlyResponse()
+
+    def VolumeMarkWritable(self, request, context):
+        self._volume(request.volume_id, context).read_only = False
+        self.srv.trigger_heartbeat()
+        return vs.VolumeMarkWritableResponse()
+
+    def VolumeConfigure(self, request, context):
+        from ..storage.super_block import ReplicaPlacement
+
+        v = self._volume(request.volume_id, context)
+        v.super_block.replica_placement = ReplicaPlacement.parse(request.replication)
+        return vs.VolumeConfigureResponse()
+
+    def VolumeStatus(self, request, context):
+        v = self._volume(request.volume_id, context)
+        return vs.VolumeStatusResponse(
+            is_read_only=v.read_only, volume_size=v.data_size(),
+            file_count=v.file_count(), file_deleted_count=v.deleted_count(),
+        )
+
+    # ---- copy
+
+    def VolumeCopy(self, request, context):
+        """Pull a whole volume from source_data_node (volume_grpc_copy.go)."""
+        vid = request.volume_id
+        if self.store.has_volume(vid):
+            context.abort(grpc.StatusCode.ALREADY_EXISTS, f"volume {vid} exists")
+        src = rpc.volume_stub(rpc.grpc_address(request.source_data_node))
+        status = src.ReadVolumeFileStatus(
+            vs.ReadVolumeFileStatusRequest(volume_id=vid), timeout=30)
+        loc = self.store._pick_location()
+        base = loc.base_name(status.collection, vid)
+        total = 0
+        for ext in (".dat", ".idx"):
+            with open(base + ext, "wb") as f:
+                for chunk in src.CopyFile(vs.CopyFileRequest(
+                        volume_id=vid, ext=ext, collection=status.collection,
+                        stop_offset=(status.dat_file_size if ext == ".dat" else 0)),
+                        timeout=3600):
+                    f.write(chunk.file_content)
+                    total += len(chunk.file_content)
+            yield vs.VolumeCopyResponse(processed_bytes=total)
+        self.store.mount_volume(vid)
+        self.srv.trigger_heartbeat()
+        v = self.store.find_volume(vid)
+        yield vs.VolumeCopyResponse(last_append_at_ns=v.last_append_at_ns)
+
+    def ReadVolumeFileStatus(self, request, context):
+        v = self._volume(request.volume_id, context)
+        base = v.file_name()
+        return vs.ReadVolumeFileStatusResponse(
+            volume_id=v.id, collection=v.collection,
+            dat_file_size=v.data_size(),
+            idx_file_size=os.path.getsize(base + ".idx"),
+            file_count=v.file_count(),
+            compaction_revision=v.super_block.compaction_revision,
+        )
+
+    def CopyFile(self, request, context):
+        """Stream any volume/EC file by extension in 2MB chunks."""
+        vid, ext = request.volume_id, request.ext
+        path = None
+        for loc in self.store.locations:
+            vols, ecs = loc.scan()
+            col = request.collection
+            cand = loc.base_name(col, vid) + ext
+            if os.path.exists(cand):
+                path = cand
+                break
+            # collection may be unknown to caller: scan both maps
+            if vid in vols and os.path.exists(loc.base_name(vols[vid][0], vid) + ext):
+                path = loc.base_name(vols[vid][0], vid) + ext
+                break
+            if vid in ecs and os.path.exists(loc.base_name(ecs[vid][0], vid) + ext):
+                path = loc.base_name(ecs[vid][0], vid) + ext
+                break
+        if path is None:
+            if request.ignore_source_file_not_found:
+                return
+            context.abort(grpc.StatusCode.NOT_FOUND, f"{vid}{ext} not found")
+        stop = request.stop_offset or os.path.getsize(path)
+        sent = 0
+        with open(path, "rb") as f:
+            while sent < stop:
+                chunk = f.read(min(BUFFER_SIZE_LIMIT, stop - sent))
+                if not chunk:
+                    break
+                yield vs.CopyFileResponse(file_content=chunk)
+                sent += len(chunk)
+
+    # ---- needle blob
+
+    def ReadNeedleBlob(self, request, context):
+        v = self._volume(request.volume_id, context)
+        blob = v.read_needle_blob(request.offset, request.size)
+        return vs.ReadNeedleBlobResponse(needle_blob=blob)
+
+    def WriteNeedleBlob(self, request, context):
+        v = self._volume(request.volume_id, context)
+        n = Needle.from_bytes(request.needle_blob, v.version, check_crc=False)
+        v.write_needle(n, check_cookie=False)
+        return vs.WriteNeedleBlobResponse()
+
+    def ReadAllNeedles(self, request, context):
+        for vid in request.volume_ids:
+            v = self.store.find_volume(vid)
+            if v is None:
+                continue
+            for n, off in v.scan_needles():
+                nv = v.nm.get(n.id)
+                if nv is None or types.size_is_deleted(nv.size):
+                    continue
+                if types.stored_to_actual_offset(nv.offset) != off:
+                    continue
+                yield vs.ReadAllNeedlesResponse(
+                    volume_id=vid, needle_id=n.id, cookie=n.cookie,
+                    needle_blob=n.data,
+                )
+
+    # ---- tail
+
+    def VolumeTailSender(self, request, context):
+        v = self._volume(request.volume_id, context)
+        deadline = time.time() + (request.idle_timeout_seconds or 2)
+        since = request.since_ns
+        while time.time() < deadline and context.is_active():
+            progressed = False
+            for n, _off in v.scan_needles():
+                if n.append_at_ns <= since:
+                    continue
+                since = n.append_at_ns
+                progressed = True
+                blob = n.to_bytes(v.version)
+                yield vs.VolumeTailSenderResponse(
+                    needle_header=blob[:types.NEEDLE_HEADER_SIZE],
+                    needle_body=blob[types.NEEDLE_HEADER_SIZE:],
+                )
+            if progressed:
+                deadline = time.time() + (request.idle_timeout_seconds or 2)
+            else:
+                time.sleep(0.1)
+        yield vs.VolumeTailSenderResponse(is_last_chunk=True)
+
+    def VolumeTailReceiver(self, request, context):
+        v = self._volume(request.volume_id, context)
+        src = rpc.volume_stub(rpc.grpc_address(request.source_volume_server))
+        for resp in src.VolumeTailSender(vs.VolumeTailSenderRequest(
+                volume_id=request.volume_id, since_ns=request.since_ns,
+                idle_timeout_seconds=request.idle_timeout_seconds), timeout=600):
+            if resp.is_last_chunk:
+                break
+            n = Needle.from_bytes(resp.needle_header + resp.needle_body,
+                                  v.version, check_crc=False)
+            v.write_needle(n, check_cookie=False)
+        return vs.VolumeTailReceiverResponse()
+
+    # ---- erasure coding (volume_grpc_erasure_coding.go) ------------------
+
+    def VolumeEcShardsGenerate(self, request, context):
+        """.dat -> .ec00.. + .ecx + .vif (handler :38-81). The stripe math
+        runs through the store's (TPU) coder."""
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        if request.collection and v.collection != request.collection:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, "collection mismatch")
+        geo = self.srv.ec_geometry
+        if request.data_shards:
+            geo = Geometry(data_shards=request.data_shards,
+                           parity_shards=request.parity_shards or 4,
+                           large_block=geo.large_block,
+                           small_block=geo.small_block)
+        coder = self.store.coder
+        if (coder.data_shards, coder.parity_shards) != (geo.data_shards,
+                                                        geo.parity_shards):
+            from ..models.coder import new_coder
+
+            coder = new_coder(geo.data_shards, geo.parity_shards)
+        base = v.file_name()
+        t0 = time.perf_counter()
+        write_ec_files(base, coder, geo)
+        write_sorted_file_from_idx(base)
+        from ..storage.ec_volume import save_volume_info
+
+        save_volume_info(base, {
+            "version": v.version,
+            "dataShards": geo.data_shards, "parityShards": geo.parity_shards,
+            "largeBlock": geo.large_block, "smallBlock": geo.small_block,
+        })
+        VOLUME_SERVER_EC_ENCODE_BYTES.inc(v.data_size())
+        glog.v(0, f"ec encode vol {v.id}: {v.data_size()} bytes in "
+                  f"{time.perf_counter() - t0:.2f}s")
+        return vs.VolumeEcShardsGenerateResponse()
+
+    def VolumeEcShardsRebuild(self, request, context):
+        """Regenerate missing .ecXX from survivors (handler :84-123)."""
+        base = self._ec_base(request.volume_id, request.collection, context)
+        geo = self._ec_geo(base)
+        coder = self._geo_coder(geo)
+        rebuilt = rebuild_ec_files(base, coder, geo)
+        from ..storage.ec_volume import rebuild_ecx_file
+
+        rebuild_ecx_file(base)
+        self.srv.trigger_heartbeat()
+        return vs.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
+
+    def VolumeEcShardsCopy(self, request, context):
+        """Pull shard files from source_data_node (handler :126-177)."""
+        loc = self.store.locations[0]
+        base = loc.base_name(request.collection, request.volume_id)
+        src = rpc.volume_stub(rpc.grpc_address(request.source_data_node))
+        exts = [f".ec{sid:02d}" for sid in request.shard_ids]
+        if request.copy_ecx_file:
+            exts.append(".ecx")
+        if request.copy_ecj_file:
+            exts.append(".ecj")
+        if request.copy_vif_file:
+            exts.append(".vif")
+        for ext in exts:
+            with open(base + ext, "wb") as f:
+                for chunk in src.CopyFile(vs.CopyFileRequest(
+                        volume_id=request.volume_id, ext=ext,
+                        collection=request.collection, is_ec_volume=True,
+                        ignore_source_file_not_found=(ext == ".ecj")),
+                        timeout=3600):
+                    f.write(chunk.file_content)
+            if ext == ".ecj" and os.path.getsize(base + ext) == 0:
+                os.remove(base + ext)
+        return vs.VolumeEcShardsCopyResponse()
+
+    def VolumeEcShardsDelete(self, request, context):
+        """Remove local shard files; drop index files once no shard remains
+        (handler :181-264)."""
+        for loc in self.store.locations:
+            base = loc.base_name(request.collection, request.volume_id)
+            if not os.path.exists(base + ".ecx"):
+                continue
+            for sid in request.shard_ids:
+                try:
+                    os.remove(base + f".ec{sid:02d}")
+                except FileNotFoundError:
+                    pass
+            geo = self._ec_geo(base)
+            if not any(os.path.exists(base + f".ec{i:02d}")
+                       for i in range(geo.total_shards)):
+                for ext in (".ecx", ".ecj", ".vif"):
+                    try:
+                        os.remove(base + ext)
+                    except FileNotFoundError:
+                        pass
+        self.srv.trigger_heartbeat()
+        return vs.VolumeEcShardsDeleteResponse()
+
+    def VolumeEcShardsMount(self, request, context):
+        self.store.mount_ec_shards(
+            request.volume_id, request.collection, list(request.shard_ids))
+        self.srv.trigger_heartbeat()
+        return vs.VolumeEcShardsMountResponse()
+
+    def VolumeEcShardsUnmount(self, request, context):
+        self.store.unmount_ec_shards(request.volume_id, list(request.shard_ids))
+        self.srv.trigger_heartbeat()
+        return vs.VolumeEcShardsUnmountResponse()
+
+    def VolumeEcShardRead(self, request, context):
+        """Stream a shard extent in 2MB messages (handler :309-375)."""
+        ev = self.store.find_ec_volume(request.volume_id)
+        if ev is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"ec volume {request.volume_id} not mounted")
+        f = ev.shard_files.get(request.shard_id)
+        if f is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"shard {request.shard_id} not on this server")
+        if request.file_key:
+            _off, size = ev.find_needle(request.file_key)
+            if types.size_is_deleted(size):
+                yield vs.VolumeEcShardReadResponse(is_deleted=True)
+                return
+        remaining = request.size
+        off = request.offset
+        while remaining > 0:
+            chunk = os.pread(f.fileno(), min(BUFFER_SIZE_LIMIT, remaining), off)
+            if not chunk:
+                break
+            yield vs.VolumeEcShardReadResponse(data=chunk)
+            off += len(chunk)
+            remaining -= len(chunk)
+
+    def VolumeEcBlobDelete(self, request, context):
+        """Tombstone a needle in a mounted EC volume (handler :377-405)."""
+        ev = self.store.find_ec_volume(request.volume_id)
+        if ev is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, "ec volume not mounted")
+        ev.delete_needle(request.file_key)
+        return vs.VolumeEcBlobDeleteResponse()
+
+    def VolumeEcShardsToVolume(self, request, context):
+        """Decode .ec00-.ec09 back into .dat/.idx (handler :407-446)."""
+        base = self._ec_base(request.volume_id, request.collection, context)
+        geo = self._ec_geo(base)
+        missing = [i for i in range(geo.data_shards)
+                   if not os.path.exists(base + f".ec{i:02d}")]
+        if missing:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          f"missing data shards {missing}")
+        from ..storage.ec_volume import load_volume_info
+
+        version = load_volume_info(base).get("version", types.CURRENT_VERSION)
+        dat_size = find_dat_file_size(base, version)
+        write_dat_file(base, dat_size, geo)
+        write_idx_file_from_ec_index(base)
+        self.store.mount_volume(request.volume_id)
+        self.srv.trigger_heartbeat()
+        return vs.VolumeEcShardsToVolumeResponse()
+
+    # ---- status / leave / ping
+
+    def VolumeServerStatus(self, request, context):
+        resp = vs.VolumeServerStatusResponse(
+            version="seaweedfs-tpu 0.1", data_center=self.store.data_center,
+            rack=self.store.rack)
+        for loc in self.store.locations:
+            st = os.statvfs(loc.directory)
+            all_b = st.f_blocks * st.f_frsize
+            free_b = st.f_bavail * st.f_frsize
+            resp.disk_statuses.append(vs.DiskStatus(
+                dir=loc.directory, all=all_b, free=free_b, used=all_b - free_b,
+                percent_free=100.0 * free_b / all_b if all_b else 0.0,
+                percent_used=100.0 * (all_b - free_b) / all_b if all_b else 0.0,
+            ))
+        return resp
+
+    def VolumeServerLeave(self, request, context):
+        self.srv._stop.set()
+        self.srv._hb_wake.set()
+        return vs.VolumeServerLeaveResponse()
+
+    def Ping(self, request, context):
+        now = time.time_ns()
+        return vs.PingResponse(start_time_ns=now, remote_time_ns=now,
+                               stop_time_ns=time.time_ns())
+
+    # ---- helpers
+
+    def _volume(self, vid: int, context):
+        v = self.store.find_volume(vid)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"volume {vid} not found")
+        return v
+
+    def _ec_base(self, vid: int, collection: str, context) -> str:
+        for loc in self.store.locations:
+            base = loc.base_name(collection, vid)
+            if os.path.exists(base + ".ecx") or os.path.exists(base + ".ec00"):
+                return base
+        context.abort(grpc.StatusCode.NOT_FOUND, f"ec volume {vid} not found")
+
+    def _ec_geo(self, base: str) -> Geometry:
+        from ..storage.ec_volume import load_volume_info
+
+        d = self.srv.ec_geometry
+        info = load_volume_info(base)
+        return Geometry(
+            data_shards=info.get("dataShards", d.data_shards),
+            parity_shards=info.get("parityShards", d.parity_shards),
+            large_block=info.get("largeBlock", d.large_block),
+            small_block=info.get("smallBlock", d.small_block),
+        )
+
+    def _geo_coder(self, geo: Geometry):
+        coder = self.store.coder
+        if (coder.data_shards, coder.parity_shards) == (geo.data_shards,
+                                                        geo.parity_shards):
+            return coder
+        from ..models.coder import new_coder
+
+        return new_coder(geo.data_shards, geo.parity_shards)
+
+
+# -- HTTP data plane -------------------------------------------------------
+
+def _make_http_handler(srv: VolumeServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            glog.v(2, f"volume http: {fmt % args}")
+
+        def _reply(self, code: int, body: bytes = b"",
+                   content_type: str = "application/json", headers=None) -> None:
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            if body and self.command != "HEAD":
+                self.wfile.write(body)
+
+        def _json(self, obj, code: int = 200, headers=None) -> None:
+            self._reply(code, json.dumps(obj).encode(), headers=headers)
+
+        # -- GET/HEAD (volume_server_handlers_read.go:31)
+
+        def do_GET(self):
+            u = urlparse(self.path)
+            if u.path == "/status":
+                vols = {}
+                for loc in srv.store.locations:
+                    for vid, v in loc.volumes.items():
+                        vols[vid] = {"size": v.data_size(),
+                                     "collection": v.collection,
+                                     "fileCount": v.file_count(),
+                                     "readOnly": v.read_only}
+                return self._json({"Version": "seaweedfs-tpu", "Volumes": vols})
+            if u.path == "/metrics":
+                return self._reply(200, gather().encode(),
+                                   "text/plain; version=0.0.4")
+            if u.path == "/healthz":
+                return self._json({"ok": True})
+            with VOLUME_SERVER_REQUEST_HISTOGRAM.time(type="read"):
+                self._serve_needle(u)
+
+        do_HEAD = do_GET
+
+        def _serve_needle(self, u):
+            try:
+                fid = parse_file_id(u.path.lstrip("/"))
+            except ValueError as e:
+                return self._json({"error": str(e)}, 400)
+            try:
+                n = srv.read_needle(fid.volume_id, fid.key, fid.cookie)
+            except (NotFoundError, DeletedError):
+                return self._reply(404)
+            except CookieMismatch:
+                return self._reply(404)
+            except IOError as e:
+                return self._json({"error": str(e)}, 500)
+            data = n.data
+            headers = {"ETag": f'"{n.etag()}"'}
+            if n.last_modified:
+                headers["Last-Modified"] = time.strftime(
+                    "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified))
+            ctype = n.mime.decode() if n.mime else "application/octet-stream"
+            if n.is_compressed:
+                import gzip as _gz
+
+                if "gzip" in (self.headers.get("Accept-Encoding") or ""):
+                    headers["Content-Encoding"] = "gzip"
+                else:
+                    data = _gz.decompress(data)
+            self._reply(200, data, ctype, headers)
+
+        # -- PUT/POST (volume_server_handlers_write.go:18)
+
+        def do_PUT(self):
+            with VOLUME_SERVER_REQUEST_HISTOGRAM.time(type="write"):
+                self._handle_write()
+
+        do_POST = do_PUT
+
+        def _handle_write(self):
+            u = urlparse(self.path)
+            q = {k: v[0] for k, v in parse_qs(u.query).items()}
+            try:
+                fid = parse_file_id(u.path.lstrip("/"))
+            except ValueError as e:
+                return self._json({"error": str(e)}, 400)
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length)
+            name, data = _extract_upload(self.headers, body)
+            ttl = TTL.parse(q["ttl"]) if q.get("ttl") else None
+            n = Needle.create(
+                fid.key, fid.cookie, data,
+                name=name or b"",
+                mime=(self.headers.get("Content-Type") or "").encode()
+                if not _is_multipart(self.headers) else b"",
+                ttl=ttl or TTL.parse(""),
+                is_compressed=(self.headers.get("Content-Encoding") == "gzip"),
+            )
+            try:
+                _off, size, unchanged = srv.store.write_needle(fid.volume_id, n)
+            except NotFoundError as e:
+                return self._json({"error": str(e)}, 404)
+            except CookieMismatch as e:
+                return self._json({"error": str(e)}, 403)
+            except IOError as e:
+                return self._json({"error": str(e)}, 500)
+            if q.get("type") != "replicate":
+                locs = srv.lookup_volume_locations(fid.volume_id)
+                if len(locs) > 1:
+                    try:
+                        srv.replicate_write(
+                            u.path.lstrip("/"), body,
+                            {k: v for k, v in q.items() if k != "type"}, locs)
+                    except IOError as e:
+                        return self._json({"error": f"replication: {e}"}, 500)
+            self._json({"name": (name or b"").decode(errors="replace"),
+                        "size": size, "eTag": n.etag()}, 201)
+
+        # -- DELETE
+
+        def do_DELETE(self):
+            u = urlparse(self.path)
+            q = {k: v[0] for k, v in parse_qs(u.query).items()}
+            try:
+                fid = parse_file_id(u.path.lstrip("/"))
+            except ValueError as e:
+                return self._json({"error": str(e)}, 400)
+            try:
+                size = srv.store.delete_needle(fid.volume_id, fid.key, fid.cookie)
+            except NotFoundError:
+                # EC volumes: tombstone through the EC path
+                ev = srv.store.find_ec_volume(fid.volume_id)
+                if ev is None:
+                    return self._json({"size": 0}, 404)
+                ev.delete_needle(fid.key)
+                return self._json({"size": 0}, 202)
+            except CookieMismatch as e:
+                return self._json({"error": str(e)}, 403)
+            if q.get("type") != "replicate":
+                for addr in srv.lookup_volume_locations(fid.volume_id):
+                    if addr == srv.address:
+                        continue
+                    try:
+                        import requests as rq
+
+                        rq.delete(f"http://{addr}{u.path}?type=replicate",
+                                  timeout=30)
+                    except Exception:  # noqa: BLE001
+                        pass
+            self._json({"size": size}, 202)
+
+    return Handler
+
+
+def _is_multipart(headers) -> bool:
+    return "multipart/form-data" in (headers.get("Content-Type") or "")
+
+
+def _extract_upload(headers, body: bytes) -> tuple[bytes, bytes]:
+    """-> (filename, data). Accepts raw bodies or multipart/form-data (the
+    reference's upload client posts multipart; ours sends raw by default)."""
+    ctype = headers.get("Content-Type") or ""
+    if "multipart/form-data" not in ctype:
+        return b"", body
+    import email
+    import email.policy
+
+    msg = email.message_from_bytes(
+        b"Content-Type: " + ctype.encode() + b"\r\n\r\n" + body,
+        policy=email.policy.HTTP,
+    )
+    for part in msg.iter_parts():
+        fname = part.get_filename()
+        payload = part.get_payload(decode=True)
+        if payload is not None:
+            return (fname or "").encode(), payload
+    return b"", b""

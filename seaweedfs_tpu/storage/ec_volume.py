@@ -18,6 +18,24 @@ from .ec_locate import Geometry, locate_data
 from .errors import NotFoundError
 
 
+def load_volume_info(base_file_name: str) -> dict:
+    """Read the .vif sidecar (JSON VolumeInfo; {} when absent)."""
+    import json
+
+    try:
+        with open(base_file_name + ".vif") as f:
+            return json.load(f)
+    except (FileNotFoundError, ValueError):
+        return {}
+
+
+def save_volume_info(base_file_name: str, info: dict) -> None:
+    import json
+
+    with open(base_file_name + ".vif", "w") as f:
+        json.dump(info, f)
+
+
 def search_needle_from_sorted_index(
     ecx_file, ecx_file_size: int, needle_id: int, process_fn=None
 ) -> tuple[int, int]:
@@ -102,11 +120,23 @@ class EcVolume:
         self,
         base_file_name: str,
         coder,
-        geo: Geometry = Geometry(),
-        version: int = types.CURRENT_VERSION,
+        geo: Geometry | None = None,
+        version: int | None = None,
     ):
         self.base = base_file_name
         self.coder = coder
+        # .vif records geometry + needle version (the reference stores a
+        # VolumeInfo protobuf there, ec_volume.go:66-71; ours is JSON)
+        vif = load_volume_info(base_file_name)
+        if geo is None:
+            geo = Geometry(
+                data_shards=vif.get("dataShards", Geometry.data_shards),
+                parity_shards=vif.get("parityShards", Geometry.parity_shards),
+                large_block=vif.get("largeBlock", Geometry.large_block),
+                small_block=vif.get("smallBlock", Geometry.small_block),
+            )
+        if version is None:
+            version = vif.get("version", types.CURRENT_VERSION)
         self.geo = geo
         self.version = version
         self.ecx_path = base_file_name + ".ecx"

@@ -1,0 +1,4 @@
+from .topology import DataNode, Topology, VolumeLayout
+from .volume_growth import VolumeGrowth
+
+__all__ = ["DataNode", "Topology", "VolumeLayout", "VolumeGrowth"]

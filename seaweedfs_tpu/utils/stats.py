@@ -1,0 +1,160 @@
+"""Prometheus-style metrics registry (reference: /root/reference/weed/stats/
+metrics.go — central Gather registry :31, per-subsystem counters/gauges/
+histograms :164-260, pull endpoint StartMetricsServer :293).
+
+Dependency-free: counters, gauges and cumulative histograms rendered in the
+Prometheus text exposition format; servers mount the output at /metrics.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+_REGISTRY: list["_Metric"] = []
+_REG_MU = threading.Lock()
+
+_BUCKETS = [0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1, 3, 10]
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        with _REG_MU:
+            _REGISTRY.append(self)
+
+    def render(self) -> str:
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str):
+        super().__init__(name, help_)
+        self._values: dict[tuple, float] = {}
+
+    def inc(self, n: float = 1, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = self._values.get(key, 0) + n
+
+    def value(self, **labels) -> float:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            return self._values.get(key, 0)
+
+    def render(self) -> str:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            if not self._values:
+                out.append(f"{self.name} 0")
+            for key, val in sorted(self._values.items()):
+                out.append(f"{self.name}{_fmt_labels(key)} {val}")
+        return "\n".join(out)
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def set(self, v: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            self._values[key] = v
+
+    def dec(self, n: float = 1, **labels) -> None:
+        self.inc(-n, **labels)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str, buckets=None):
+        super().__init__(name, help_)
+        self.buckets = list(buckets or _BUCKETS)
+        self._counts: dict[tuple, list[int]] = {}
+        self._sums: dict[tuple, float] = {}
+        self._totals: dict[tuple, int] = {}
+
+    def observe(self, v: float, **labels) -> None:
+        key = tuple(sorted(labels.items()))
+        with self._lock:
+            counts = self._counts.setdefault(key, [0] * len(self.buckets))
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    counts[i] += 1
+            self._sums[key] = self._sums.get(key, 0) + v
+            self._totals[key] = self._totals.get(key, 0) + 1
+
+    def time(self, **labels):
+        return _Timer(self, labels)
+
+    def render(self) -> str:
+        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        with self._lock:
+            for key in sorted(self._counts):
+                cum = 0
+                for i, b in enumerate(self.buckets):
+                    cum = self._counts[key][i]
+                    lk = key + (("le", str(b)),)
+                    out.append(f"{self.name}_bucket{_fmt_labels(lk)} {cum}")
+                lk = key + (("le", "+Inf"),)
+                out.append(f"{self.name}_bucket{_fmt_labels(lk)} {self._totals[key]}")
+                out.append(f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]}")
+                out.append(f"{self.name}_count{_fmt_labels(key)} {self._totals[key]}")
+        return "\n".join(out)
+
+
+class _Timer:
+    def __init__(self, hist: Histogram, labels: dict):
+        self.hist = hist
+        self.labels = labels
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self.t0, **self.labels)
+
+
+def _fmt_labels(key: tuple) -> str:
+    if not key:
+        return ""
+    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    return "{" + inner + "}"
+
+
+def gather() -> str:
+    """Render every registered metric (stats.Gather equivalent)."""
+    with _REG_MU:
+        metrics = list(_REGISTRY)
+    return "\n".join(m.render() for m in metrics) + "\n"
+
+
+# -- the metric families the reference defines (metrics_names.go) ----------
+
+MASTER_RECEIVED_HEARTBEATS = Counter(
+    "SeaweedFS_master_received_heartbeats", "Number of heartbeats received.")
+MASTER_VOLUME_LAYOUT_WRITABLE = Gauge(
+    "SeaweedFS_master_volume_layout_writable", "Writable volumes per layout.")
+VOLUME_SERVER_REQUEST_HISTOGRAM = Histogram(
+    "SeaweedFS_volumeServer_request_seconds", "Request latency by type.")
+VOLUME_SERVER_VOLUME_COUNTER = Gauge(
+    "SeaweedFS_volumeServer_volumes", "Volumes managed by this server.")
+VOLUME_SERVER_EC_ENCODE_BYTES = Counter(
+    "SeaweedFS_volumeServer_ec_encode_bytes", "Bytes erasure-encoded.")
+VOLUME_SERVER_EC_DEVICE_SECONDS = Counter(
+    "SeaweedFS_volumeServer_ec_device_seconds", "Device time in EC kernels.")
+FILER_REQUEST_HISTOGRAM = Histogram(
+    "SeaweedFS_filer_request_seconds", "Filer request latency by type.")
+S3_REQUEST_HISTOGRAM = Histogram(
+    "SeaweedFS_s3_request_seconds", "S3 gateway latency by action.")
+
+
+def master_metrics_text() -> str:
+    return gather()

@@ -1,0 +1,158 @@
+"""Cluster client: master connection + cached volume-location map.
+
+Rebuild of /root/reference/weed/wdclient/ — `MasterClient` keeps a vidMap
+cache of volume id -> locations (vid_map.go:72, masterclient.go:44's
+5-generation cache becomes a single TTL'd dict; the generations existed to
+bound Go map churn) and `LookupFileIdWithFallback` (masterclient.go:59).
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+
+import grpc
+
+from ..pb import master_pb2, rpc
+from ..storage.file_id import parse_file_id
+
+
+class Location:
+    __slots__ = ("url", "public_url", "grpc_port", "data_center")
+
+    def __init__(self, url: str, public_url: str = "", grpc_port: int = 0,
+                 data_center: str = ""):
+        self.url = url
+        self.public_url = public_url or url
+        self.grpc_port = grpc_port
+        self.data_center = data_center
+
+    @property
+    def grpc_address(self) -> str:
+        host = self.url.rsplit(":", 1)[0]
+        return f"{host}:{self.grpc_port}" if self.grpc_port else rpc.grpc_address(self.url)
+
+
+class MasterClient:
+    """vid -> [Location] cache with master lookup fallback."""
+
+    def __init__(self, masters: list[str] | str, *, cache_ttl: float = 10 * 60):
+        if isinstance(masters, str):
+            masters = [m for m in masters.split(",") if m]
+        self.masters = masters
+        self.cache_ttl = cache_ttl
+        self._vid_cache: dict[int, tuple[float, list[Location]]] = {}
+        self._ec_vid_cache: dict[int, tuple[float, dict[int, list[Location]]]] = {}
+        self._lock = threading.Lock()
+        self._leader = masters[0] if masters else ""
+
+    @property
+    def current_master(self) -> str:
+        return self._leader
+
+    def _stub(self):
+        return rpc.master_stub(rpc.grpc_address(self._leader))
+
+    # -- volume lookup -----------------------------------------------------
+
+    def add_location(self, vid: int, loc: Location) -> None:
+        with self._lock:
+            exp, locs = self._vid_cache.get(vid, (0, []))
+            if all(l.url != loc.url for l in locs):
+                locs.append(loc)
+            self._vid_cache[vid] = (time.time() + self.cache_ttl, locs)
+
+    def delete_location(self, vid: int, url: str) -> None:
+        with self._lock:
+            entry = self._vid_cache.get(vid)
+            if not entry:
+                return
+            exp, locs = entry
+            locs = [l for l in locs if l.url != url]
+            if locs:
+                self._vid_cache[vid] = (exp, locs)
+            else:
+                del self._vid_cache[vid]
+
+    def lookup_volume(self, vid: int) -> list[Location]:
+        now = time.time()
+        with self._lock:
+            entry = self._vid_cache.get(vid)
+            if entry and entry[0] > now and entry[1]:
+                return list(entry[1])
+        resp = self._stub().LookupVolume(
+            master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)]),
+            timeout=10)
+        locs = []
+        for e in resp.volume_id_locations:
+            if e.error:
+                raise LookupError(e.error)
+            locs = [Location(l.url, l.public_url, l.grpc_port, l.data_center)
+                    for l in e.locations]
+        with self._lock:
+            self._vid_cache[vid] = (now + self.cache_ttl, locs)
+        return locs
+
+    def lookup_file_id(self, fid: str) -> list[str]:
+        """-> http URLs serving this fid (LookupFileIdWithFallback)."""
+        f = parse_file_id(fid)
+        locs = self.lookup_volume(f.volume_id)
+        if not locs:
+            raise LookupError(f"volume {f.volume_id} has no locations")
+        random.shuffle(locs)
+        return [f"http://{l.url}/{fid}" for l in locs]
+
+    def lookup_ec_volume(self, vid: int) -> dict[int, list[Location]]:
+        now = time.time()
+        with self._lock:
+            entry = self._ec_vid_cache.get(vid)
+            if entry and entry[0] > now:
+                return dict(entry[1])
+        resp = self._stub().LookupEcVolume(
+            master_pb2.LookupEcVolumeRequest(volume_id=vid), timeout=10)
+        out = {
+            sl.shard_id: [Location(l.url, l.public_url, l.grpc_port)
+                          for l in sl.locations]
+            for sl in resp.shard_id_locations
+        }
+        with self._lock:
+            self._ec_vid_cache[vid] = (now + self.cache_ttl, out)
+        return out
+
+    # -- keep-connected stream (masterclient.go KeepConnected) -------------
+
+    def keep_connected(self, client_type: str = "client",
+                       on_update=None, stop_event: threading.Event | None = None):
+        """Blocking stream consumer: applies VolumeLocation updates to the
+        cache; reconnects on error until stop_event is set."""
+        stop = stop_event or threading.Event()
+        while not stop.is_set():
+            try:
+                stub = self._stub()
+
+                def reqs():
+                    yield master_pb2.KeepConnectedRequest(
+                        client_type=client_type, client_address="self")
+                    while not stop.is_set():
+                        time.sleep(1)
+                    return
+
+                for resp in stub.KeepConnected(reqs()):
+                    vl = resp.volume_location
+                    if vl.url:
+                        if vl.leader:
+                            self._leader = vl.leader
+                        loc = Location(vl.url, vl.public_url, vl.grpc_port,
+                                       vl.data_center)
+                        for vid in vl.new_vids:
+                            self.add_location(vid, loc)
+                        for vid in vl.deleted_vids:
+                            self.delete_location(vid, vl.url)
+                    if on_update is not None:
+                        on_update(resp)
+                    if stop.is_set():
+                        break
+            except grpc.RpcError:
+                if stop.wait(1.0):
+                    break
