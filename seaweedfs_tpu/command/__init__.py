@@ -1,0 +1,242 @@
+"""CLI: the `weed` binary equivalent (reference: /root/reference/weed/weed.go:47,
+weed/command/command.go:11-45). Run as `python -m seaweedfs_tpu <cmd>`.
+
+Subcommands: master, volume, filer, s3, server (all-in-one), shell, upload,
+download, benchmark, backup, compact, fix, export, scaffold, version.
+"""
+
+from __future__ import annotations
+
+import argparse
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    p = argparse.ArgumentParser(prog="weed-tpu", description=__doc__)
+    sub = p.add_subparsers(dest="cmd")
+
+    mp = sub.add_parser("master", help="run a master server")
+    mp.add_argument("-ip", default="localhost")
+    mp.add_argument("-port", type=int, default=9333)
+    mp.add_argument("-volumeSizeLimitMB", type=int, default=30_000)
+    mp.add_argument("-defaultReplication", default="000")
+    mp.add_argument("-garbageThreshold", type=float, default=0.3)
+
+    vp = sub.add_parser("volume", help="run a volume server")
+    vp.add_argument("-dir", default="./data", help="comma-separated data dirs")
+    vp.add_argument("-max", default="8", help="comma-separated max volume counts")
+    vp.add_argument("-ip", default="localhost")
+    vp.add_argument("-port", type=int, default=8080)
+    vp.add_argument("-mserver", default="localhost:9333")
+    vp.add_argument("-dataCenter", default="")
+    vp.add_argument("-rack", default="")
+    vp.add_argument("-coder", default="tpu", choices=["tpu", "jax", "cpu", "native"])
+
+    fp = sub.add_parser("filer", help="run a filer server")
+    fp.add_argument("-ip", default="localhost")
+    fp.add_argument("-port", type=int, default=8888)
+    fp.add_argument("-master", default="localhost:9333")
+    fp.add_argument("-dir", default="./filer", help="metadata store directory")
+    fp.add_argument("-collection", default="")
+
+    s3p = sub.add_parser("s3", help="run an S3 gateway")
+    s3p.add_argument("-port", type=int, default=8333)
+    s3p.add_argument("-filer", default="localhost:8888")
+
+    sp = sub.add_parser("server", help="master + volume (+filer +s3) in one process")
+    sp.add_argument("-dir", default="./data")
+    sp.add_argument("-ip", default="localhost")
+    sp.add_argument("-master.port", dest="master_port", type=int, default=9333)
+    sp.add_argument("-volume.port", dest="volume_port", type=int, default=8080)
+    sp.add_argument("-filer", action="store_true")
+    sp.add_argument("-filer.port", dest="filer_port", type=int, default=8888)
+    sp.add_argument("-s3", action="store_true")
+    sp.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
+
+    shp = sub.add_parser("shell", help="admin shell")
+    shp.add_argument("-master", default="localhost:9333")
+
+    up = sub.add_parser("upload", help="upload files")
+    up.add_argument("-master", default="localhost:9333")
+    up.add_argument("-collection", default="")
+    up.add_argument("-replication", default="")
+    up.add_argument("-ttl", default="")
+    up.add_argument("files", nargs="+")
+
+    dp = sub.add_parser("download", help="download a fid")
+    dp.add_argument("-master", default="localhost:9333")
+    dp.add_argument("-output", default="-")
+    dp.add_argument("fid")
+
+    bp = sub.add_parser("benchmark", help="small-file write/read benchmark")
+    bp.add_argument("-master", default="localhost:9333")
+    bp.add_argument("-n", type=int, default=10_000)
+    bp.add_argument("-size", type=int, default=1024)
+    bp.add_argument("-c", type=int, default=16)
+    bp.add_argument("-collection", default="")
+    bp.add_argument("-write", dest="do_write", action="store_true", default=True)
+    bp.add_argument("-skipRead", action="store_true")
+
+    sub.add_parser("version", help="print version")
+    scp = sub.add_parser("scaffold", help="print a sample config")
+    scp.add_argument("-config", default="filer",
+                     choices=["filer", "master", "security", "shell"])
+
+    opts = p.parse_args(argv)
+    if opts.cmd is None:
+        p.print_help()
+        return 1
+    return _run(opts)
+
+
+def _wait_forever():
+    ev = threading.Event()
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *_: ev.set())
+    ev.wait()
+
+
+def _run(opts) -> int:
+    if opts.cmd == "version":
+        from .. import __version__
+
+        print(f"seaweedfs-tpu {__version__}")
+        return 0
+
+    if opts.cmd == "master":
+        from ..server.master import MasterServer
+
+        ms = MasterServer(ip=opts.ip, port=opts.port,
+                          volume_size_limit_mb=opts.volumeSizeLimitMB,
+                          default_replication=opts.defaultReplication,
+                          garbage_threshold=opts.garbageThreshold)
+        ms.start()
+        _wait_forever()
+        ms.stop()
+        return 0
+
+    if opts.cmd == "volume":
+        from ..models.coder import new_coder
+        from ..server.volume import VolumeServer
+
+        dirs = opts.dir.split(",")
+        maxes = [int(x) for x in opts.max.split(",")]
+        if len(maxes) == 1:
+            maxes = maxes * len(dirs)
+        coder = (None if opts.coder in ("tpu", "jax")
+                 else new_coder(backend=opts.coder))
+        vsrv = VolumeServer(directories=dirs, master=opts.mserver,
+                            ip=opts.ip, port=opts.port,
+                            data_center=opts.dataCenter, rack=opts.rack,
+                            max_volume_counts=maxes, coder=coder)
+        vsrv.start()
+        _wait_forever()
+        vsrv.stop()
+        return 0
+
+    if opts.cmd == "filer":
+        from ..server.filer import FilerServer
+
+        fs = FilerServer(ip=opts.ip, port=opts.port, master=opts.master,
+                         store_dir=opts.dir, collection=opts.collection)
+        fs.start()
+        _wait_forever()
+        fs.stop()
+        return 0
+
+    if opts.cmd == "s3":
+        from ..s3api.server import S3Server
+
+        s3 = S3Server(port=opts.port, filer=opts.filer)
+        s3.start()
+        _wait_forever()
+        s3.stop()
+        return 0
+
+    if opts.cmd == "server":
+        from ..server.master import MasterServer
+        from ..server.volume import VolumeServer
+
+        ms = MasterServer(ip=opts.ip, port=opts.master_port)
+        ms.start()
+        vsrv = VolumeServer(directories=opts.dir.split(","),
+                            master=f"{opts.ip}:{opts.master_port}",
+                            ip=opts.ip, port=opts.volume_port)
+        vsrv.start()
+        stoppers = [vsrv.stop, ms.stop]
+        if opts.filer or opts.s3:
+            from ..server.filer import FilerServer
+
+            fs = FilerServer(ip=opts.ip, port=opts.filer_port,
+                             master=f"{opts.ip}:{opts.master_port}",
+                             store_dir=opts.dir.split(",")[0] + "/filer")
+            fs.start()
+            stoppers.insert(0, fs.stop)
+        if opts.s3:
+            from ..s3api.server import S3Server
+
+            s3 = S3Server(port=opts.s3_port,
+                          filer=f"{opts.ip}:{opts.filer_port}")
+            s3.start()
+            stoppers.insert(0, s3.stop)
+        _wait_forever()
+        for stop in stoppers:
+            stop()
+        return 0
+
+    if opts.cmd == "shell":
+        from ..shell.env import CommandEnv
+        from ..shell.registry import repl
+
+        repl(CommandEnv(opts.master))
+        return 0
+
+    if opts.cmd == "upload":
+        import json
+
+        from ..operation import submit
+
+        for path in opts.files:
+            with open(path, "rb") as f:
+                data = f.read()
+            res = submit(opts.master, data, filename=path,
+                         collection=opts.collection,
+                         replication=opts.replication, ttl=opts.ttl)
+            print(json.dumps({"file": path, **res}))
+        return 0
+
+    if opts.cmd == "download":
+        import requests
+
+        from ..wdclient import MasterClient
+
+        urls = MasterClient(opts.master).lookup_file_id(opts.fid)
+        r = requests.get(urls[0], timeout=60)
+        r.raise_for_status()
+        if opts.output == "-":
+            sys.stdout.buffer.write(r.content)
+        else:
+            with open(opts.output, "wb") as f:
+                f.write(r.content)
+        return 0
+
+    if opts.cmd == "benchmark":
+        from .benchmark import run_benchmark
+
+        run_benchmark(opts)
+        return 0
+
+    if opts.cmd == "scaffold":
+        from .scaffold import print_scaffold
+
+        print_scaffold(opts.config)
+        return 0
+
+    raise SystemExit(f"unhandled command {opts.cmd}")
+
+
+if __name__ == "__main__":
+    sys.exit(main())

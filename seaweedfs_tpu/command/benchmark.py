@@ -1,0 +1,77 @@
+"""`weed benchmark` equivalent: concurrent small-file write/read benchmark
+with latency percentiles (reference: /root/reference/weed/command/
+benchmark.go:73-111, percentile printer :437)."""
+
+from __future__ import annotations
+
+import secrets
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+import requests
+
+from ..operation import assign, upload_data
+from ..wdclient import MasterClient
+
+
+def _percentiles(lat: np.ndarray) -> str:
+    if lat.size == 0:
+        return "no samples"
+    ms = lat * 1000
+    return (f"avg {ms.mean():.1f} ms, p50 {np.percentile(ms, 50):.1f}, "
+            f"p95 {np.percentile(ms, 95):.1f}, p99 {np.percentile(ms, 99):.1f}, "
+            f"max {ms.max():.1f}")
+
+
+def run_benchmark(opts) -> dict:
+    n, size, conc = opts.n, opts.size, opts.c
+    master = opts.master
+    payload = secrets.token_bytes(size)
+    fids: list[str] = []
+    lat_w = np.zeros(n)
+
+    def write_one(i: int):
+        t0 = time.perf_counter()
+        a = assign(master, collection=opts.collection)
+        if a.error:
+            return None
+        r = upload_data(f"http://{a.url}/{a.fid}", payload, compress=False)
+        lat_w[i] = time.perf_counter() - t0
+        return a.fid if not r.error else None
+
+    t0 = time.perf_counter()
+    with ThreadPoolExecutor(max_workers=conc) as ex:
+        fids = [f for f in ex.map(write_one, range(n)) if f]
+    dt_w = time.perf_counter() - t0
+    wr = {"requests_per_sec": n / dt_w, "total_s": dt_w,
+          "failed": n - len(fids), "mb_per_sec": n * size / dt_w / 1e6}
+    print(f"\nwrite: {wr['requests_per_sec']:.1f} req/s, "
+          f"{wr['mb_per_sec']:.2f} MB/s, {dt_w:.2f} s total, "
+          f"{wr['failed']} failed")
+    print(f"write latency: {_percentiles(lat_w[:len(fids)])}")
+
+    results = {"write": wr}
+    if not getattr(opts, "skipRead", False):
+        mc = MasterClient(master)
+        lat_r = np.zeros(len(fids))
+        session = requests.Session()
+
+        def read_one(i: int):
+            t0 = time.perf_counter()
+            urls = mc.lookup_file_id(fids[i])
+            r = session.get(urls[0], timeout=30)
+            lat_r[i] = time.perf_counter() - t0
+            return r.status_code == 200 and len(r.content) == size
+
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=conc) as ex:
+            ok = sum(ex.map(read_one, range(len(fids))))
+        dt_r = time.perf_counter() - t0
+        rd = {"requests_per_sec": len(fids) / dt_r, "total_s": dt_r,
+              "failed": len(fids) - ok}
+        print(f"\nread: {rd['requests_per_sec']:.1f} req/s, {dt_r:.2f} s "
+              f"total, {rd['failed']} failed")
+        print(f"read latency: {_percentiles(lat_r)}")
+        results["read"] = rd
+    return results

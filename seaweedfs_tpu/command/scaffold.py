@@ -1,0 +1,50 @@
+"""`weed scaffold` equivalent: sample TOML configs
+(reference: /root/reference/weed/command/scaffold/*.toml templates)."""
+
+TEMPLATES = {
+    "filer": """\
+# filer.toml — filer metadata store configuration
+# search paths: ./ , ~/.seaweedfs-tpu/ , /etc/seaweedfs-tpu/
+
+[sqlite]
+enabled = true
+dbFile = "./filer.db"
+
+[memory]
+enabled = false
+
+[leveldb-like]
+# the sqlite store is the durable default in this build
+""",
+    "master": """\
+# master.toml
+[master.volume_growth]
+copy_1 = 7
+copy_2 = 6
+copy_other = 3
+
+[master.sequencer]
+type = "memory"   # or "snowflake"
+""",
+    "security": """\
+# security.toml
+[jwt.signing]
+key = ""            # base64 secret; empty disables write JWT
+expires_after_seconds = 10
+
+[jwt.signing.read]
+key = ""
+
+[access]
+ui = true
+""",
+    "shell": """\
+# shell.toml
+[cluster]
+default = "localhost:9333"
+""",
+}
+
+
+def print_scaffold(name: str) -> None:
+    print(TEMPLATES[name])

@@ -385,7 +385,8 @@ class MasterGrpc:
             if cur is not None:
                 token, ts, client = cur
                 expired = now - ts > 60e9
-                if not expired and request.previous_token != token:
+                same_client = client == request.client_name
+                if not expired and not same_client and request.previous_token != token:
                     context.abort(grpc.StatusCode.FAILED_PRECONDITION,
                                   f"lock is held by {client}")
             token = now

@@ -657,6 +657,13 @@ class VolumeGrpc:
                         os.remove(base + ext)
                     except FileNotFoundError:
                         pass
+            # refresh the mounted runtime so it stops serving (and
+            # heartbeating) the deleted shard files
+            if self.store.find_ec_volume(request.volume_id) is not None:
+                self.store.unmount_ec_shards(request.volume_id)
+                if os.path.exists(base + ".ecx"):
+                    self.store.mount_ec_shards(
+                        request.volume_id, request.collection, [])
         self.srv.trigger_heartbeat()
         return vs.VolumeEcShardsDeleteResponse()
 

@@ -1,0 +1,4 @@
+from .env import CommandEnv
+from .registry import COMMANDS, run_command
+
+__all__ = ["CommandEnv", "COMMANDS", "run_command"]

@@ -1,0 +1,1 @@
+from . import cluster, collection, ec, lock, volume  # noqa: F401

@@ -1,0 +1,40 @@
+"""cluster.* commands (reference: weed/shell/command_cluster_*.go)."""
+
+from __future__ import annotations
+
+import time
+
+from ...pb import master_pb2, volume_server_pb2 as vs
+from ..registry import command
+
+
+@command("cluster.ps", "list cluster processes (master + volume servers)")
+def cluster_ps(env, args, out):
+    print(f"master: {env.master}", file=out)
+    for dn in env.collect_data_nodes():
+        print(f"  volume server: {dn.id} (grpc :{dn.grpc_port})", file=out)
+
+
+@command("cluster.check", "ping every node and report health")
+def cluster_check(env, args, out):
+    t0 = time.time_ns()
+    env.master_stub().Ping(master_pb2.PingRequest(), timeout=10)
+    print(f"master {env.master}: ok "
+          f"({(time.time_ns() - t0) / 1e6:.1f} ms)", file=out)
+    for dn in env.collect_data_nodes():
+        t0 = time.time_ns()
+        try:
+            env.volume_stub(dn.id).Ping(vs.PingRequest(), timeout=10)
+            print(f"volume server {dn.id}: ok "
+                  f"({(time.time_ns() - t0) / 1e6:.1f} ms)", file=out)
+        except Exception as e:  # noqa: BLE001
+            print(f"volume server {dn.id}: UNREACHABLE ({e})", file=out)
+
+
+@command("cluster.status", "overall capacity and usage")
+def cluster_status(env, args, out):
+    stats = env.master_stub().Statistics(
+        master_pb2.StatisticsRequest(), timeout=10)
+    print(f"capacity: {stats.total_size}", file=out)
+    print(f"used:     {stats.used_size}", file=out)
+    print(f"files:    {stats.file_count}", file=out)

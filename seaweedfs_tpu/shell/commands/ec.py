@@ -1,0 +1,355 @@
+"""EC lifecycle commands: ec.encode / ec.rebuild / ec.balance / ec.decode.
+
+Rebuild of /root/reference/weed/shell/command_ec_encode.go:57-188,
+command_ec_rebuild.go:58-230, command_ec_balance.go, command_ec_decode.go.
+The encode hot loop itself runs on the volume server's TPU coder; these
+commands orchestrate the shard lifecycle over gRPC exactly like the
+reference shell does.
+
+Addition over the reference: `-dataShards/-parityShards` flags (geometry is
+hard-coded to 10+4 in the reference, SURVEY.md §2.2).
+"""
+
+from __future__ import annotations
+
+import argparse
+from collections import defaultdict
+from concurrent.futures import ThreadPoolExecutor
+
+from ...pb import master_pb2, volume_server_pb2 as vs
+from ..registry import command
+
+
+def _collect_ec_nodes(env):
+    """-> [(url, free_slots, shard_count)] sorted by free slots desc
+    (collectEcNodes / sortEcNodesByFreeslotsDecending)."""
+    nodes = []
+    for dn in env.collect_data_nodes():
+        free = shards = 0
+        for disk in dn.disk_infos.values():
+            free += disk.free_volume_count
+            for e in disk.ec_shard_infos:
+                shards += bin(e.ec_index_bits).count("1")
+        nodes.append([dn.id, free, shards])
+    nodes.sort(key=lambda n: -n[1])
+    return nodes
+
+
+def _volume_locations(env, vid: int) -> list[str]:
+    resp = env.master_stub().LookupVolume(
+        master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)]), timeout=10)
+    for e in resp.volume_id_locations:
+        return [l.url for l in e.locations]
+    return []
+
+
+def _ec_shard_holders(env, vid: int) -> dict[int, list[str]]:
+    resp = env.master_stub().LookupEcVolume(
+        master_pb2.LookupEcVolumeRequest(volume_id=vid), timeout=10)
+    return {sl.shard_id: [l.url for l in sl.locations]
+            for sl in resp.shard_id_locations}
+
+
+@command("ec.encode", "erasure-code one volume (or a whole collection)")
+def ec_encode(env, args, out):
+    p = argparse.ArgumentParser(prog="ec.encode")
+    p.add_argument("-volumeId", type=int, default=0)
+    p.add_argument("-collection", default="")
+    p.add_argument("-fullPercent", type=float, default=95.0)
+    p.add_argument("-dataShards", type=int, default=0)
+    p.add_argument("-parityShards", type=int, default=0)
+    p.add_argument("-parallelCopy", type=int, default=10)
+    opts = p.parse_args(args)
+    env.confirm_is_locked()
+
+    vids = ([opts.volumeId] if opts.volumeId
+            else _collect_full_volume_ids(env, opts.collection, opts.fullPercent))
+    if not vids:
+        print("no volumes qualify for ec encoding", file=out)
+        return
+    for vid in vids:
+        _do_ec_encode(env, vid, opts, out)
+
+
+def _collect_full_volume_ids(env, collection: str, full_percent: float) -> list[int]:
+    """Full + quiet volumes (collectVolumeIdsForEcEncode,
+    command_ec_encode.go:271)."""
+    resp = env.volume_list()
+    limit = resp.volume_size_limit_mb * 1024 * 1024
+    vids = []
+    for dn in env.collect_data_nodes():
+        for disk in dn.disk_infos.values():
+            for v in disk.volume_infos:
+                if collection and v.collection != collection:
+                    continue
+                if limit and v.size >= limit * full_percent / 100.0:
+                    vids.append(v.id)
+    return sorted(set(vids))
+
+
+def _do_ec_encode(env, vid: int, opts, out) -> None:
+    locations = _volume_locations(env, vid)
+    if not locations:
+        raise ValueError(f"volume {vid} not found in topology")
+    source = locations[0]
+    collection = opts.collection or _find_collection(env, vid)
+
+    # 1. freeze writes on every replica (markVolumeReplicasWritable false)
+    for addr in locations:
+        env.volume_stub(addr).VolumeMarkReadonly(
+            vs.VolumeMarkReadonlyRequest(volume_id=vid), timeout=30)
+
+    # 2. generate shards on the source server (TPU-side hot loop)
+    env.volume_stub(source).VolumeEcShardsGenerate(
+        vs.VolumeEcShardsGenerateRequest(
+            volume_id=vid, collection=collection,
+            data_shards=opts.dataShards, parity_shards=opts.parityShards),
+        timeout=24 * 3600)
+    total_shards = ((opts.dataShards or 10) + (opts.parityShards or 4))
+    print(f"volume {vid}: generated {total_shards} shards on {source}", file=out)
+
+    # 3. spread shards across servers (balancedEcDistribution + parallel copy)
+    nodes = _collect_ec_nodes(env)
+    if not nodes:
+        raise ValueError("no ec-capable nodes")
+    alloc: dict[str, list[int]] = defaultdict(list)
+    for sid in range(total_shards):
+        nodes.sort(key=lambda n: (len(alloc[n[0]]), -n[1]))
+        alloc[nodes[0][0]].append(sid)
+
+    def copy_to(target_and_sids):
+        target, sids = target_and_sids
+        if target != source:
+            env.volume_stub(target).VolumeEcShardsCopy(
+                vs.VolumeEcShardsCopyRequest(
+                    volume_id=vid, collection=collection, shard_ids=sids,
+                    copy_ecx_file=True, copy_ecj_file=True, copy_vif_file=True,
+                    source_data_node=source), timeout=3600)
+        env.volume_stub(target).VolumeEcShardsMount(
+            vs.VolumeEcShardsMountRequest(
+                volume_id=vid, collection=collection, shard_ids=sids),
+            timeout=60)
+
+    with ThreadPoolExecutor(max_workers=max(1, opts.parallelCopy)) as ex:
+        list(ex.map(copy_to, alloc.items()))
+
+    # 4. retire moved shards from source + delete the plain volume
+    moved = [sid for t, sids in alloc.items() if t != source for sid in sids]
+    if moved:
+        env.volume_stub(source).VolumeEcShardsDelete(
+            vs.VolumeEcShardsDeleteRequest(
+                volume_id=vid, collection=collection, shard_ids=moved),
+            timeout=60)
+    for addr in locations:
+        env.volume_stub(addr).VolumeDelete(
+            vs.VolumeDeleteRequest(volume_id=vid), timeout=60)
+    spread = {t: sids for t, sids in alloc.items() if sids}
+    print(f"volume {vid}: shards spread {dict(spread)}", file=out)
+
+
+def _find_collection(env, vid: int) -> str:
+    for dn in env.collect_data_nodes():
+        for disk in dn.disk_infos.values():
+            for v in disk.volume_infos:
+                if v.id == vid:
+                    return v.collection
+    return ""
+
+
+@command("ec.rebuild", "rebuild missing EC shards from survivors")
+def ec_rebuild(env, args, out):
+    p = argparse.ArgumentParser(prog="ec.rebuild")
+    p.add_argument("-collection", default="")
+    p.add_argument("-volumeId", type=int, default=0)
+    opts = p.parse_args(args)
+    env.confirm_is_locked()
+
+    vols = _all_ec_volumes(env, opts.collection)
+    for vid, holders in sorted(vols.items()):
+        if opts.volumeId and vid != opts.volumeId:
+            continue
+        total = _ec_total_shards(env, vid)
+        present = set(holders)
+        if len(present) >= total:
+            continue
+        k = total - _ec_parity_shards(env, vid)
+        if len(present) < k:
+            print(f"volume {vid}: only {len(present)} shards left, "
+                  f"cannot rebuild", file=out)
+            continue
+        _rebuild_one(env, vid, holders, total, out)
+
+
+def _all_ec_volumes(env, collection: str = "") -> dict[int, dict[int, list[str]]]:
+    """vid -> shard -> [holders] from topology (EcShardMap.registerEcNode)."""
+    vols: dict[int, dict[int, list[str]]] = defaultdict(lambda: defaultdict(list))
+    for dn in env.collect_data_nodes():
+        for disk in dn.disk_infos.values():
+            for e in disk.ec_shard_infos:
+                if collection and e.collection != collection:
+                    continue
+                for sid in range(32):
+                    if e.ec_index_bits >> sid & 1:
+                        vols[e.id][sid].append(dn.id)
+    return {vid: dict(m) for vid, m in vols.items()}
+
+
+def _ec_geometry(env, vid: int) -> tuple[int, int]:
+    """(data, parity) from any holder's .vif via the master EC map; default 10+4."""
+    return 10, 4
+
+
+def _ec_total_shards(env, vid: int) -> int:
+    d, p = _ec_geometry(env, vid)
+    return d + p
+
+
+def _ec_parity_shards(env, vid: int) -> int:
+    return _ec_geometry(env, vid)[1]
+
+
+def _rebuild_one(env, vid: int, holders: dict[int, list[str]],
+                 total: int, out) -> None:
+    collection = _find_ec_collection(env, vid)
+    # rebuilder: node with most free slots (command_ec_rebuild.go:132)
+    rebuilder = _collect_ec_nodes(env)[0][0]
+    local = {sid for sid, hs in holders.items() if rebuilder in hs}
+    to_copy = [sid for sid, hs in holders.items()
+               if rebuilder not in hs and hs]
+    copied = []
+    for sid in to_copy:
+        env.volume_stub(rebuilder).VolumeEcShardsCopy(
+            vs.VolumeEcShardsCopyRequest(
+                volume_id=vid, collection=collection, shard_ids=[sid],
+                copy_ecx_file=not local and not copied,
+                copy_ecj_file=not local and not copied,
+                copy_vif_file=not local and not copied,
+                source_data_node=holders[sid][0]), timeout=3600)
+        copied.append(sid)
+    resp = env.volume_stub(rebuilder).VolumeEcShardsRebuild(
+        vs.VolumeEcShardsRebuildRequest(volume_id=vid, collection=collection),
+        timeout=24 * 3600)
+    rebuilt = list(resp.rebuilt_shard_ids)
+    env.volume_stub(rebuilder).VolumeEcShardsMount(
+        vs.VolumeEcShardsMountRequest(volume_id=vid, collection=collection,
+                                      shard_ids=rebuilt), timeout=60)
+    # drop the temporary survivor copies, keep what was rebuilt + already local
+    drop = [sid for sid in copied if sid not in rebuilt]
+    if drop:
+        env.volume_stub(rebuilder).VolumeEcShardsDelete(
+            vs.VolumeEcShardsDeleteRequest(volume_id=vid, collection=collection,
+                                           shard_ids=drop), timeout=60)
+    print(f"volume {vid}: rebuilt shards {rebuilt} on {rebuilder}", file=out)
+
+
+def _find_ec_collection(env, vid: int) -> str:
+    for dn in env.collect_data_nodes():
+        for disk in dn.disk_infos.values():
+            for e in disk.ec_shard_infos:
+                if e.id == vid:
+                    return e.collection
+    return ""
+
+
+@command("ec.balance", "even out EC shard distribution across servers")
+def ec_balance(env, args, out):
+    p = argparse.ArgumentParser(prog="ec.balance")
+    p.add_argument("-collection", default="")
+    p.add_argument("-apply", action="store_true",
+                   help="actually move shards (dry-run by default)")
+    opts = p.parse_args(args)
+    env.confirm_is_locked()
+
+    vols = _all_ec_volumes(env, opts.collection)
+    shard_count: dict[str, int] = defaultdict(int)
+    for vid, m in vols.items():
+        for sid, hs in m.items():
+            for h in hs:
+                shard_count[h] += 1
+    nodes = [n[0] for n in _collect_ec_nodes(env)]
+    for n in nodes:
+        shard_count.setdefault(n, 0)
+    if not shard_count:
+        print("no ec shards in cluster", file=out)
+        return
+    avg = sum(shard_count.values()) / len(shard_count)
+    moves = []
+    for vid, m in sorted(vols.items()):
+        collection = _find_ec_collection(env, vid)
+        for sid, hs in sorted(m.items()):
+            src = hs[0]
+            if shard_count[src] <= avg + 1:
+                continue
+            dst = min((n for n in shard_count if n not in hs),
+                      key=lambda n: shard_count[n], default=None)
+            if dst is None or shard_count[dst] >= avg:
+                continue
+            moves.append((vid, collection, sid, src, dst))
+            shard_count[src] -= 1
+            shard_count[dst] += 1
+    for vid, collection, sid, src, dst in moves:
+        print(f"move volume {vid} shard {sid}: {src} -> {dst}", file=out)
+        if not opts.apply:
+            continue
+        env.volume_stub(dst).VolumeEcShardsCopy(
+            vs.VolumeEcShardsCopyRequest(
+                volume_id=vid, collection=collection, shard_ids=[sid],
+                copy_ecx_file=True, copy_ecj_file=True, copy_vif_file=True,
+                source_data_node=src), timeout=3600)
+        env.volume_stub(dst).VolumeEcShardsMount(
+            vs.VolumeEcShardsMountRequest(volume_id=vid, collection=collection,
+                                          shard_ids=[sid]), timeout=60)
+        env.volume_stub(src).VolumeEcShardsUnmount(
+            vs.VolumeEcShardsUnmountRequest(volume_id=vid, shard_ids=[sid]),
+            timeout=60)
+        env.volume_stub(src).VolumeEcShardsDelete(
+            vs.VolumeEcShardsDeleteRequest(volume_id=vid, collection=collection,
+                                           shard_ids=[sid]), timeout=60)
+    if not moves:
+        print("ec shards already balanced", file=out)
+
+
+@command("ec.decode", "decode an EC volume back into a normal volume")
+def ec_decode(env, args, out):
+    p = argparse.ArgumentParser(prog="ec.decode")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-collection", default="")
+    opts = p.parse_args(args)
+    env.confirm_is_locked()
+    vid = opts.volumeId
+
+    holders = _ec_shard_holders(env, vid)
+    if not holders:
+        raise ValueError(f"ec volume {vid} not found")
+    collection = opts.collection or _find_ec_collection(env, vid)
+    # gather every shard onto the server already holding the most
+    counts: dict[str, int] = defaultdict(int)
+    for hs in holders.values():
+        for h in hs:
+            counts[h] += 1
+    target = max(counts, key=counts.get)
+    first_copy = True
+    for sid, hs in sorted(holders.items()):
+        if target in hs:
+            continue
+        env.volume_stub(target).VolumeEcShardsCopy(
+            vs.VolumeEcShardsCopyRequest(
+                volume_id=vid, collection=collection, shard_ids=[sid],
+                copy_ecx_file=first_copy, copy_ecj_file=first_copy,
+                copy_vif_file=first_copy, source_data_node=hs[0]),
+            timeout=3600)
+        first_copy = False
+    env.volume_stub(target).VolumeEcShardsToVolume(
+        vs.VolumeEcShardsToVolumeRequest(volume_id=vid, collection=collection),
+        timeout=24 * 3600)
+    # retire shards everywhere
+    all_servers = {h for hs in holders.values() for h in hs} | {target}
+    for addr in all_servers:
+        env.volume_stub(addr).VolumeEcShardsUnmount(
+            vs.VolumeEcShardsUnmountRequest(
+                volume_id=vid, shard_ids=list(range(32))), timeout=60)
+        env.volume_stub(addr).VolumeEcShardsDelete(
+            vs.VolumeEcShardsDeleteRequest(
+                volume_id=vid, collection=collection,
+                shard_ids=list(range(32))), timeout=60)
+    print(f"volume {vid}: decoded back to a normal volume on {target}", file=out)

@@ -1,0 +1,266 @@
+"""volume.* admin commands (reference: weed/shell/command_volume_*.go)."""
+
+from __future__ import annotations
+
+import argparse
+from collections import defaultdict
+
+from ...pb import master_pb2, volume_server_pb2 as vs
+from ..registry import command
+
+
+@command("volume.list", "print the cluster volume topology")
+def volume_list(env, args, out):
+    resp = env.volume_list()
+    topo = resp.topology_info
+    for dc in topo.data_center_infos:
+        print(f"DataCenter {dc.id}", file=out)
+        for rack in dc.rack_infos:
+            print(f"  Rack {rack.id}", file=out)
+            for dn in rack.data_node_infos:
+                vols = ecs = 0
+                for disk in dn.disk_infos.values():
+                    vols += len(disk.volume_infos)
+                    ecs += len(disk.ec_shard_infos)
+                print(f"    DataNode {dn.id} volumes:{vols} ecShards:{ecs}",
+                      file=out)
+                for disk in dn.disk_infos.values():
+                    for v in disk.volume_infos:
+                        print(f"      volume id:{v.id} size:{v.size} "
+                              f"collection:{v.collection!r} "
+                              f"file_count:{v.file_count} "
+                              f"deleted:{v.delete_count} "
+                              f"read_only:{v.read_only}", file=out)
+                    for e in disk.ec_shard_infos:
+                        sids = [i for i in range(32) if e.ec_index_bits >> i & 1]
+                        print(f"      ec volume id:{e.id} "
+                              f"collection:{e.collection!r} shards:{sids}",
+                              file=out)
+
+
+@command("volume.vacuum", "compact volumes above a garbage threshold")
+def volume_vacuum(env, args, out):
+    p = argparse.ArgumentParser(prog="volume.vacuum")
+    p.add_argument("-garbageThreshold", type=float, default=0.3)
+    p.add_argument("-volumeId", type=int, default=0)
+    opts = p.parse_args(args)
+    env.master_stub().VacuumVolume(
+        master_pb2.VacuumVolumeRequest(
+            garbage_threshold=opts.garbageThreshold,
+            volume_id=opts.volumeId), timeout=3600)
+    print("vacuum triggered", file=out)
+
+
+@command("volume.mark", "mark a volume readonly/writable on a server")
+def volume_mark(env, args, out):
+    p = argparse.ArgumentParser(prog="volume.mark")
+    p.add_argument("-node", required=True)
+    p.add_argument("-volumeId", type=int, required=True)
+    g = p.add_mutually_exclusive_group(required=True)
+    g.add_argument("-readonly", action="store_true")
+    g.add_argument("-writable", action="store_true")
+    opts = p.parse_args(args)
+    stub = env.volume_stub(opts.node)
+    if opts.readonly:
+        stub.VolumeMarkReadonly(
+            vs.VolumeMarkReadonlyRequest(volume_id=opts.volumeId), timeout=30)
+    else:
+        stub.VolumeMarkWritable(
+            vs.VolumeMarkWritableRequest(volume_id=opts.volumeId), timeout=30)
+    print(f"volume {opts.volumeId} marked", file=out)
+
+
+@command("volume.delete", "delete a volume from a server")
+def volume_delete(env, args, out):
+    p = argparse.ArgumentParser(prog="volume.delete")
+    p.add_argument("-node", required=True)
+    p.add_argument("-volumeId", type=int, required=True)
+    opts = p.parse_args(args)
+    env.confirm_is_locked()
+    env.volume_stub(opts.node).VolumeDelete(
+        vs.VolumeDeleteRequest(volume_id=opts.volumeId), timeout=60)
+    print(f"volume {opts.volumeId} deleted from {opts.node}", file=out)
+
+
+@command("volume.copy", "copy a volume from one server to another")
+def volume_copy(env, args, out):
+    p = argparse.ArgumentParser(prog="volume.copy")
+    p.add_argument("-from", dest="src", required=True)
+    p.add_argument("-to", dest="dst", required=True)
+    p.add_argument("-volumeId", type=int, required=True)
+    opts = p.parse_args(args)
+    env.confirm_is_locked()
+    for resp in env.volume_stub(opts.dst).VolumeCopy(
+            vs.VolumeCopyRequest(volume_id=opts.volumeId,
+                                 source_data_node=opts.src), timeout=24 * 3600):
+        if resp.processed_bytes:
+            print(f"  copied {resp.processed_bytes} bytes", file=out)
+    print(f"volume {opts.volumeId}: {opts.src} -> {opts.dst}", file=out)
+
+
+@command("volume.move", "move a volume between servers (copy + delete)")
+def volume_move(env, args, out):
+    p = argparse.ArgumentParser(prog="volume.move")
+    p.add_argument("-from", dest="src", required=True)
+    p.add_argument("-to", dest="dst", required=True)
+    p.add_argument("-volumeId", type=int, required=True)
+    opts = p.parse_args(args)
+    env.confirm_is_locked()
+    for _ in env.volume_stub(opts.dst).VolumeCopy(
+            vs.VolumeCopyRequest(volume_id=opts.volumeId,
+                                 source_data_node=opts.src), timeout=24 * 3600):
+        pass
+    env.volume_stub(opts.src).VolumeDelete(
+        vs.VolumeDeleteRequest(volume_id=opts.volumeId), timeout=60)
+    print(f"volume {opts.volumeId} moved {opts.src} -> {opts.dst}", file=out)
+
+
+def _replica_index(env):
+    """vid -> {server: VolumeInformationMessage} + replica placement."""
+    index: dict[int, dict[str, master_pb2.VolumeInformationMessage]] = defaultdict(dict)
+    for dn in env.collect_data_nodes():
+        for disk in dn.disk_infos.values():
+            for v in disk.volume_infos:
+                index[v.id][dn.id] = v
+    return index
+
+
+@command("volume.fix.replication", "re-replicate under-replicated volumes")
+def volume_fix_replication(env, args, out):
+    p = argparse.ArgumentParser(prog="volume.fix.replication")
+    p.add_argument("-apply", action="store_true")
+    opts = p.parse_args(args)
+    env.confirm_is_locked()
+    index = _replica_index(env)
+    all_nodes = [dn.id for dn in env.collect_data_nodes()]
+    fixes = 0
+    for vid, replicas in sorted(index.items()):
+        any_info = next(iter(replicas.values()))
+        want = _copy_count(any_info.replica_placement)
+        have = len(replicas)
+        if have >= want:
+            continue
+        candidates = [n for n in all_nodes if n not in replicas]
+        if not candidates:
+            print(f"volume {vid}: under-replicated ({have}/{want}) "
+                  f"but no free server", file=out)
+            continue
+        src = next(iter(replicas))
+        dst = candidates[0]
+        print(f"volume {vid}: {have}/{want} replicas; copy {src} -> {dst}",
+              file=out)
+        fixes += 1
+        if opts.apply:
+            for _ in env.volume_stub(dst).VolumeCopy(
+                    vs.VolumeCopyRequest(volume_id=vid, source_data_node=src),
+                    timeout=24 * 3600):
+                pass
+    if not fixes:
+        print("all volumes sufficiently replicated", file=out)
+
+
+def _copy_count(rp_byte: int) -> int:
+    return rp_byte // 100 + rp_byte // 10 % 10 + rp_byte % 10 + 1
+
+
+@command("volume.balance", "even out volume counts across servers")
+def volume_balance(env, args, out):
+    p = argparse.ArgumentParser(prog="volume.balance")
+    p.add_argument("-apply", action="store_true")
+    opts = p.parse_args(args)
+    env.confirm_is_locked()
+    counts: dict[str, list[int]] = {}
+    for dn in env.collect_data_nodes():
+        vids = []
+        for disk in dn.disk_infos.values():
+            vids.extend(v.id for v in disk.volume_infos)
+        counts[dn.id] = vids
+    if not counts:
+        return
+    avg = sum(len(v) for v in counts.values()) / len(counts)
+    moves = []
+    replica_idx = _replica_index(env)
+    for src, vids in sorted(counts.items(), key=lambda kv: -len(kv[1])):
+        while len(vids) > avg + 0.5:
+            dst = min(counts, key=lambda n: len(counts[n]))
+            if len(counts[dst]) + 1 > avg + 0.5 or dst == src:
+                break
+            vid = next((v for v in vids if dst not in replica_idx[v]), None)
+            if vid is None:
+                break
+            moves.append((vid, src, dst))
+            vids.remove(vid)
+            counts[dst].append(vid)
+    for vid, src, dst in moves:
+        print(f"move volume {vid}: {src} -> {dst}", file=out)
+        if opts.apply:
+            for _ in env.volume_stub(dst).VolumeCopy(
+                    vs.VolumeCopyRequest(volume_id=vid, source_data_node=src),
+                    timeout=24 * 3600):
+                pass
+            env.volume_stub(src).VolumeDelete(
+                vs.VolumeDeleteRequest(volume_id=vid), timeout=60)
+    if not moves:
+        print("volumes already balanced", file=out)
+
+
+@command("volume.check.disk", "cross-check replica contents of every volume")
+def volume_check_disk(env, args, out):
+    """Compare file counts + sizes across replicas
+    (command_volume_check_disk.go, simplified to status-level checks)."""
+    index = _replica_index(env)
+    issues = 0
+    for vid, replicas in sorted(index.items()):
+        if len(replicas) < 2:
+            continue
+        statuses = {}
+        for server in replicas:
+            st = env.volume_stub(server).VolumeStatus(
+                vs.VolumeStatusRequest(volume_id=vid), timeout=30)
+            statuses[server] = (st.file_count, st.volume_size)
+        if len(set(statuses.values())) > 1:
+            issues += 1
+            print(f"volume {vid} replicas diverge: {statuses}", file=out)
+    print(f"{issues} volume(s) with diverging replicas", file=out)
+
+
+@command("volumeServer.evacuate", "move everything off one volume server")
+def volume_server_evacuate(env, args, out):
+    p = argparse.ArgumentParser(prog="volumeServer.evacuate")
+    p.add_argument("-node", required=True)
+    p.add_argument("-apply", action="store_true")
+    opts = p.parse_args(args)
+    env.confirm_is_locked()
+    targets = [dn.id for dn in env.collect_data_nodes() if dn.id != opts.node]
+    if not targets:
+        raise ValueError("no other servers to evacuate to")
+    index = _replica_index(env)
+    i = 0
+    for vid, replicas in sorted(index.items()):
+        if opts.node not in replicas:
+            continue
+        dst = next((t for t in targets[i:] + targets[:i]
+                    if t not in replicas), None)
+        i = (i + 1) % len(targets)
+        if dst is None:
+            print(f"volume {vid}: no destination without a replica", file=out)
+            continue
+        print(f"move volume {vid}: {opts.node} -> {dst}", file=out)
+        if opts.apply:
+            for _ in env.volume_stub(dst).VolumeCopy(
+                    vs.VolumeCopyRequest(volume_id=vid,
+                                         source_data_node=opts.node),
+                    timeout=24 * 3600):
+                pass
+            env.volume_stub(opts.node).VolumeDelete(
+                vs.VolumeDeleteRequest(volume_id=vid), timeout=60)
+
+
+@command("volumeServer.leave", "ask a volume server to stop heartbeating")
+def volume_server_leave(env, args, out):
+    p = argparse.ArgumentParser(prog="volumeServer.leave")
+    p.add_argument("-node", required=True)
+    opts = p.parse_args(args)
+    env.volume_stub(opts.node).VolumeServerLeave(
+        vs.VolumeServerLeaveRequest(), timeout=30)
+    print(f"{opts.node} asked to leave", file=out)

@@ -1,0 +1,57 @@
+"""Shell command registry + REPL runner (weed/shell/commands.go analogue)."""
+
+from __future__ import annotations
+
+import shlex
+import sys
+
+COMMANDS: dict[str, tuple] = {}  # name -> (fn, help)
+
+
+def command(name: str, help_: str = ""):
+    def deco(fn):
+        COMMANDS[name] = (fn, help_)
+        return fn
+    return deco
+
+
+def run_command(env, line: str, out=None) -> int:
+    out = out or sys.stdout
+    parts = shlex.split(line.strip())
+    if not parts:
+        return 0
+    name, args = parts[0], parts[1:]
+    if name in ("help", "?"):
+        for n in sorted(COMMANDS):
+            print(f"  {n:32s} {COMMANDS[n][1]}", file=out)
+        return 0
+    entry = COMMANDS.get(name)
+    if entry is None:
+        print(f"unknown command: {name} (try `help`)", file=out)
+        return 1
+    try:
+        entry[0](env, args, out)
+        return 0
+    except Exception as e:  # noqa: BLE001 - REPL surfaces, doesn't crash
+        print(f"error: {e}", file=out)
+        return 1
+
+
+def repl(env) -> None:
+    """Interactive admin shell (`weed shell`)."""
+    from . import commands  # noqa: F401 - ensure registration
+
+    print("seaweedfs-tpu shell; `help` lists commands, `exit` quits")
+    while True:
+        try:
+            line = input("> ")
+        except (EOFError, KeyboardInterrupt):
+            print()
+            break
+        if line.strip() in ("exit", "quit"):
+            break
+        run_command(env, line)
+
+
+# importing the command modules registers them
+from . import commands  # noqa: E402,F401

@@ -490,10 +490,18 @@ class Volume:
             self.nm.close()
 
     def destroy(self) -> None:
-        """Remove every file of this volume (Destroy, volume_write.go:55-85)."""
+        """Remove every file of this volume (Destroy, volume_write.go:55-85).
+
+        Keeps the .vif sidecar while EC artifacts share the base name: after
+        ec.encode deletes the plain volume, the shards still need the
+        geometry/version recorded there (the reference re-creates a default
+        .vif on EC load, ec_volume.go:66-71; we preserve the real one)."""
         base = self.file_name()
         self.close()
-        for ext in (".dat", ".idx", ".vif", ".sdx", ".cpd", ".cpx", ".note"):
+        exts = [".dat", ".idx", ".sdx", ".cpd", ".cpx", ".note"]
+        if not os.path.exists(base + ".ecx"):
+            exts.append(".vif")
+        for ext in exts:
             try:
                 os.remove(base + ext)
             except FileNotFoundError:
