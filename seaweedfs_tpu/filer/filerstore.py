@@ -1,0 +1,56 @@
+"""FilerStore SPI + registry.
+
+Rebuild of /root/reference/weed/filer/filerstore.go:21-44 — the 9-method
+KV/list interface every metadata backend implements, with stores registered
+by name (the reference registers 21 backends via init(); this build ships
+memory, sqlite, and leveldb-file flavors and keeps the same seam open).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Protocol
+
+from .entry import Entry
+
+
+class FilerStore(Protocol):
+    name: str
+
+    def insert_entry(self, entry: Entry) -> None: ...
+
+    def update_entry(self, entry: Entry) -> None: ...
+
+    def find_entry(self, full_path: str) -> Entry | None: ...
+
+    def delete_entry(self, full_path: str) -> None: ...
+
+    def delete_folder_children(self, full_path: str) -> None: ...
+
+    def list_directory_entries(
+        self, dir_path: str, start_file_name: str = "",
+        include_start: bool = False, limit: int = 1024,
+        prefix: str = "",
+    ) -> Iterator[Entry]: ...
+
+    def kv_get(self, key: bytes) -> bytes | None: ...
+
+    def kv_put(self, key: bytes, value: bytes) -> None: ...
+
+    def close(self) -> None: ...
+
+
+_REGISTRY: dict[str, type] = {}
+
+
+def register_store(name: str, cls: type) -> None:
+    _REGISTRY[name] = cls
+
+
+def get_store(name: str, **kwargs) -> FilerStore:
+    from .stores import memory, sqlite  # noqa: F401 - registration side effect
+
+    cls = _REGISTRY.get(name)
+    if cls is None:
+        raise ValueError(f"unknown filer store {name!r} "
+                         f"(available: {sorted(_REGISTRY)})")
+    return cls(**kwargs)

@@ -1,0 +1,460 @@
+"""Filer server: HTTP namespace gateway + gRPC metadata API.
+
+Rebuild of /root/reference/weed/server/filer_server.go +
+filer_server_handlers_{read,write,write_autochunk}.go + filer_grpc_server*.go.
+
+HTTP plane: POST/PUT auto-chunks the body (autoChunk,
+filer_server_handlers_write_autochunk.go:24): assign fid per chunk, upload
+to volume servers, then save the entry. GET streams chunks back through the
+resolved view (StreamContent, stream.go:69); directories list as JSON.
+DELETE removes entries (recursive with ?recursive=true) and GCs chunks.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, unquote, urlparse
+
+import grpc
+import requests as rq
+
+from ..filer import Attr, Entry, Filer
+from ..filer.filechunks import etag as chunks_etag, total_size, view_from_chunks
+from ..filer.filer import NotEmpty, NotFound, normalize
+from ..filer.filerstore import get_store
+from ..operation import assign, delete_files, upload_data
+from ..pb import filer_pb2, master_pb2, rpc
+from ..utils import glog
+from ..utils.stats import FILER_REQUEST_HISTOGRAM, gather
+from ..wdclient import MasterClient
+
+CHUNK_SIZE = 4 * 1024 * 1024  # maxMB default (command/filer.go)
+
+
+class FilerServer:
+    def __init__(self, *, ip: str = "localhost", port: int = 8888,
+                 master: str = "localhost:9333", store_dir: str = "",
+                 store: str = "sqlite", collection: str = "",
+                 replication: str = "", chunk_size: int = CHUNK_SIZE):
+        self.ip = ip
+        self.port = port
+        self.grpc_port = port + rpc.GRPC_PORT_DELTA
+        self.master = master
+        self.collection = collection
+        self.replication = replication
+        self.chunk_size = chunk_size
+        if store == "sqlite":
+            import os
+
+            db = ":memory:"
+            if store_dir:
+                os.makedirs(store_dir, exist_ok=True)
+                db = os.path.join(store_dir, "filer.db")
+            self.filer = Filer(get_store("sqlite", db_path=db))
+        else:
+            self.filer = Filer(get_store(store))
+        self.master_client = MasterClient(master)
+        self._http_server = None
+        self._grpc_server = None
+        self._session = rq.Session()
+
+    @property
+    def address(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+    def start(self) -> None:
+        self._grpc_server = rpc.new_server()
+        rpc.add_servicer(self._grpc_server, rpc.FILER_SERVICE, FilerGrpc(self))
+        self._grpc_server.add_insecure_port(f"[::]:{self.grpc_port}")
+        self._grpc_server.start()
+        self._http_server = ThreadingHTTPServer(
+            ("", self.port), _make_http_handler(self))
+        threading.Thread(target=self._http_server.serve_forever,
+                         daemon=True).start()
+        glog.info(f"filer started on {self.address} (grpc :{self.grpc_port})")
+
+    def stop(self) -> None:
+        if self._http_server:
+            self._http_server.shutdown()
+        if self._grpc_server:
+            self._grpc_server.stop(grace=0.5)
+        self.filer.store.close()
+
+    # -- chunk IO ----------------------------------------------------------
+
+    def save_chunk(self, data: bytes, *, ttl: str = "") -> filer_pb2.FileChunk:
+        a = assign(self.master, collection=self.collection,
+                   replication=self.replication, ttl=ttl)
+        if a.error:
+            raise IOError(f"assign: {a.error}")
+        r = upload_data(f"http://{a.url}/{a.fid}", data, ttl=ttl)
+        if r.error:
+            raise IOError(f"upload: {r.error}")
+        return filer_pb2.FileChunk(
+            file_id=a.fid, size=len(data),
+            modified_ts_ns=time.time_ns(), e_tag=r.etag,
+        )
+
+    def write_file(self, path: str, body: bytes, *, mime: str = "",
+                   ttl: str = "", mode: int = 0o660) -> Entry:
+        """autoChunk + saveAsChunk + CreateEntry."""
+        chunks = []
+        md5 = hashlib.md5()
+        for off in range(0, len(body), self.chunk_size) or [0]:
+            piece = body[off:off + self.chunk_size]
+            md5.update(piece)
+            c = self.save_chunk(piece, ttl=ttl)
+            c.offset = off
+            chunks.append(c)
+        now = int(time.time())
+        entry = Entry(
+            full_path=normalize(path),
+            attr=Attr(mtime=now, crtime=now, mode=mode, mime=mime,
+                      md5=md5.digest(),
+                      ttl_sec=_ttl_seconds(ttl)),
+            chunks=chunks,
+        )
+        old_fids = []
+        try:
+            old = self.filer.find_entry(entry.full_path)
+            old_fids = [c.file_id for c in old.chunks]
+        except NotFound:
+            pass
+        self.filer.create_entry(entry)
+        if old_fids:
+            self._gc_chunks(old_fids)
+        return entry
+
+    def read_file(self, entry: Entry, offset: int = 0,
+                  size: int | None = None) -> bytes:
+        if entry.content:
+            end = len(entry.content) if size is None else offset + size
+            return entry.content[offset:end]
+        out = bytearray()
+        for view in view_from_chunks(entry.chunks, offset,
+                                     size if size is not None
+                                     else total_size(entry.chunks) - offset):
+            urls = self.master_client.lookup_file_id(view.file_id)
+            last_err = None
+            for url in urls:
+                try:
+                    r = self._session.get(
+                        url, timeout=60,
+                        headers={"Range":
+                                 f"bytes={view.chunk_offset}-"
+                                 f"{view.chunk_offset + view.size - 1}"}
+                        if not view.is_full_chunk else {})
+                    if r.status_code in (200, 206):
+                        data = r.content
+                        if r.status_code == 200 and not view.is_full_chunk:
+                            data = data[view.chunk_offset:
+                                        view.chunk_offset + view.size]
+                        out += data
+                        break
+                except rq.RequestException as e:
+                    last_err = e
+            else:
+                raise IOError(f"chunk {view.file_id} unreadable: {last_err}")
+        return bytes(out)
+
+    def _gc_chunks(self, fids: list[str]) -> None:
+        if not fids:
+            return
+        try:
+            delete_files(self.master, fids)
+        except Exception as e:  # noqa: BLE001 - GC is best-effort
+            glog.warning(f"chunk gc failed: {e}")
+
+
+def _ttl_seconds(ttl: str) -> int:
+    if not ttl:
+        return 0
+    from ..storage.ttl import TTL
+
+    return TTL.parse(ttl).minutes() * 60
+
+
+# -- gRPC servicer ---------------------------------------------------------
+
+class FilerGrpc:
+    def __init__(self, srv: FilerServer):
+        self.srv = srv
+        self.filer = srv.filer
+
+    def LookupDirectoryEntry(self, request, context):
+        try:
+            e = self.filer.find_entry(
+                request.directory.rstrip("/") + "/" + request.name)
+        except NotFound:
+            context.abort(grpc.StatusCode.NOT_FOUND, "not found")
+        return filer_pb2.LookupDirectoryEntryResponse(entry=e.to_pb())
+
+    def ListEntries(self, request, context):
+        limit = request.limit or 1024
+        for e in self.filer.list_entries(
+                request.directory, request.start_from_file_name,
+                request.inclusive_start_from, limit, request.prefix):
+            yield filer_pb2.ListEntriesResponse(entry=e.to_pb())
+
+    def CreateEntry(self, request, context):
+        e = Entry.from_pb(request.directory, request.entry)
+        try:
+            self.filer.create_entry(e, o_excl=request.o_excl,
+                                    skip_parents=request.skip_check_parent_directory)
+        except Exception as err:  # noqa: BLE001
+            return filer_pb2.CreateEntryResponse(error=str(err))
+        return filer_pb2.CreateEntryResponse()
+
+    def UpdateEntry(self, request, context):
+        e = Entry.from_pb(request.directory, request.entry)
+        try:
+            self.filer.update_entry(e)
+        except NotFound:
+            context.abort(grpc.StatusCode.NOT_FOUND, "not found")
+        return filer_pb2.UpdateEntryResponse()
+
+    def AppendToEntry(self, request, context):
+        path = request.directory.rstrip("/") + "/" + request.entry_name
+        try:
+            e = self.filer.find_entry(path)
+        except NotFound:
+            e = Entry(full_path=path,
+                      attr=Attr(mtime=int(time.time()),
+                                crtime=int(time.time())))
+            self.filer.create_entry(e)
+        offset = e.size()
+        for c in request.chunks:
+            c.offset = offset
+            offset += c.size
+            e.chunks.append(c)
+        self.filer.update_entry(e)
+        return filer_pb2.AppendToEntryResponse()
+
+    def DeleteEntry(self, request, context):
+        path = request.directory.rstrip("/") + "/" + request.name
+        try:
+            fids = self.filer.delete_entry(
+                path, recursive=request.is_recursive,
+                is_delete_data=request.is_delete_data)
+            if request.is_delete_data and fids:
+                self.srv._gc_chunks(fids)
+        except NotFound:
+            pass
+        except NotEmpty as e:
+            return filer_pb2.DeleteEntryResponse(error=str(e))
+        return filer_pb2.DeleteEntryResponse()
+
+    def AtomicRenameEntry(self, request, context):
+        try:
+            self.filer.rename(
+                request.old_directory.rstrip("/") + "/" + request.old_name,
+                request.new_directory.rstrip("/") + "/" + request.new_name)
+        except NotFound:
+            context.abort(grpc.StatusCode.NOT_FOUND, "source not found")
+        return filer_pb2.AtomicRenameEntryResponse()
+
+    def AssignVolume(self, request, context):
+        a = assign(self.srv.master, count=max(request.count, 1),
+                   collection=request.collection or self.srv.collection,
+                   replication=request.replication or self.srv.replication,
+                   data_center=request.data_center)
+        if a.error:
+            return filer_pb2.AssignVolumeResponse(error=a.error)
+        return filer_pb2.AssignVolumeResponse(
+            file_id=a.fid, count=a.count,
+            collection=request.collection or self.srv.collection,
+            replication=request.replication or self.srv.replication,
+            location=filer_pb2.Location(url=a.url, public_url=a.public_url),
+        )
+
+    def LookupVolume(self, request, context):
+        resp = filer_pb2.LookupVolumeResponse()
+        for vid_str in request.volume_ids:
+            try:
+                locs = self.srv.master_client.lookup_volume(int(vid_str))
+            except (LookupError, ValueError):
+                continue
+            ll = filer_pb2.Locations()
+            for l in locs:
+                ll.locations.append(filer_pb2.Location(
+                    url=l.url, public_url=l.public_url,
+                    grpc_port=l.grpc_port, data_center=l.data_center))
+            resp.locations_map[vid_str].CopyFrom(ll)
+        return resp
+
+    def CollectionList(self, request, context):
+        stub = rpc.master_stub(rpc.grpc_address(self.srv.master))
+        mresp = stub.CollectionList(master_pb2.CollectionListRequest(
+            include_normal_volumes=request.include_normal_volumes,
+            include_ec_volumes=request.include_ec_volumes), timeout=10)
+        return filer_pb2.CollectionListResponse(
+            collections=[filer_pb2.Collection(name=c.name)
+                         for c in mresp.collections])
+
+    def DeleteCollection(self, request, context):
+        stub = rpc.master_stub(rpc.grpc_address(self.srv.master))
+        stub.CollectionDelete(master_pb2.CollectionDeleteRequest(
+            name=request.collection), timeout=60)
+        return filer_pb2.DeleteCollectionResponse()
+
+    def Statistics(self, request, context):
+        stub = rpc.master_stub(rpc.grpc_address(self.srv.master))
+        m = stub.Statistics(master_pb2.StatisticsRequest(
+            collection=request.collection), timeout=10)
+        return filer_pb2.StatisticsResponse(
+            total_size=m.total_size, used_size=m.used_size,
+            file_count=m.file_count)
+
+    def GetFilerConfiguration(self, request, context):
+        return filer_pb2.GetFilerConfigurationResponse(
+            masters=[self.srv.master], collection=self.srv.collection,
+            replication=self.srv.replication,
+            max_mb=self.srv.chunk_size // (1024 * 1024),
+            dir_buckets="/buckets", signature=self.filer.signature,
+            version="seaweedfs-tpu 0.1", cluster_id="")
+
+    def SubscribeMetadata(self, request, context):
+        since = request.since_ns
+        prefixes = list(request.path_prefixes) or (
+            [request.path_prefix] if request.path_prefix else [])
+        while context.is_active():
+            events, since = self.filer.read_events(since, timeout=1.0)
+            for msg in events:
+                if request.until_ns and msg.ts_ns > request.until_ns:
+                    return
+                if prefixes and not any(
+                        msg.directory.startswith(p) for p in prefixes):
+                    continue
+                yield msg
+
+    SubscribeLocalMetadata = SubscribeMetadata
+
+    def KvGet(self, request, context):
+        v = self.filer.store.kv_get(request.key)
+        if v is None:
+            return filer_pb2.KvGetResponse(error="not found")
+        return filer_pb2.KvGetResponse(value=v)
+
+    def KvPut(self, request, context):
+        self.filer.store.kv_put(request.key, request.value)
+        return filer_pb2.KvPutResponse()
+
+    def Ping(self, request, context):
+        now = time.time_ns()
+        return filer_pb2.PingResponse(start_time_ns=now, remote_time_ns=now,
+                                      stop_time_ns=time.time_ns())
+
+
+# -- HTTP plane ------------------------------------------------------------
+
+def _make_http_handler(srv: FilerServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            glog.v(2, f"filer http: {fmt % args}")
+
+        def _reply(self, code: int, body: bytes = b"",
+                   ctype: str = "application/json", headers=None):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            if body and self.command != "HEAD":
+                self.wfile.write(body)
+
+        def _json(self, obj, code=200):
+            self._reply(code, json.dumps(obj).encode())
+
+        def _path_q(self):
+            u = urlparse(self.path)
+            return unquote(u.path), {k: v[0] for k, v in
+                                     parse_qs(u.query).items()}
+
+        def do_GET(self):
+            path, q = self._path_q()
+            if path == "/metrics":
+                return self._reply(200, gather().encode(),
+                                   "text/plain; version=0.0.4")
+            if path == "/healthz":
+                return self._json({"ok": True})
+            with FILER_REQUEST_HISTOGRAM.time(type="read"):
+                try:
+                    entry = srv.filer.find_entry(path)
+                except NotFound:
+                    return self._json({"error": "not found"}, 404)
+                if entry.is_directory:
+                    limit = int(q.get("limit", 1000))
+                    entries = [{
+                        "FullPath": e.full_path,
+                        "Mtime": e.attr.mtime, "Crtime": e.attr.crtime,
+                        "Mode": e.attr.mode, "Mime": e.attr.mime,
+                        "IsDirectory": e.is_directory,
+                        "FileSize": e.size(),
+                    } for e in srv.filer.list_entries(
+                        path, q.get("lastFileName", ""), limit=limit)]
+                    return self._json({
+                        "Path": path, "Entries": entries,
+                        "ShouldDisplayLoadMore": len(entries) >= limit,
+                    })
+                rng_h = self.headers.get("Range")
+                size = entry.size()
+                if rng_h and rng_h.startswith("bytes="):
+                    lo, _, hi = rng_h[6:].partition("-")
+                    start = int(lo)
+                    stop = int(hi) + 1 if hi else size
+                    data = srv.read_file(entry, start, stop - start)
+                    return self._reply(
+                        206, data, entry.attr.mime or "application/octet-stream",
+                        {"Content-Range": f"bytes {start}-{stop - 1}/{size}"})
+                data = srv.read_file(entry)
+                headers = {"ETag": f'"{chunks_etag(entry.chunks)}"'}
+                if entry.attr.md5:
+                    headers["Content-MD5"] = entry.attr.md5.hex()
+                return self._reply(
+                    200, data, entry.attr.mime or "application/octet-stream",
+                    headers)
+
+        do_HEAD = do_GET
+
+        def do_PUT(self):
+            path, q = self._path_q()
+            with FILER_REQUEST_HISTOGRAM.time(type="write"):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length)
+                ctype = self.headers.get("Content-Type") or ""
+                if "multipart/form-data" in ctype:
+                    from .volume import _extract_upload
+
+                    fname, body = _extract_upload(self.headers, body)
+                    if path.endswith("/") and fname:
+                        path = path + fname.decode(errors="replace")
+                    ctype = ""
+                try:
+                    entry = srv.write_file(path, body, mime=ctype,
+                                           ttl=q.get("ttl", ""))
+                except IOError as e:
+                    return self._json({"error": str(e)}, 500)
+                self._json({"name": entry.name, "size": entry.size()}, 201)
+
+        do_POST = do_PUT
+
+        def do_DELETE(self):
+            path, q = self._path_q()
+            recursive = q.get("recursive") == "true"
+            try:
+                fids = srv.filer.delete_entry(path, recursive=recursive)
+            except NotFound:
+                return self._reply(204)
+            except NotEmpty as e:
+                return self._json({"error": str(e)}, 409)
+            srv._gc_chunks(fids)
+            self._reply(204)
+
+    return Handler

@@ -862,10 +862,19 @@ def _make_http_handler(srv: VolumeServer):
             if n.is_compressed:
                 import gzip as _gz
 
-                if "gzip" in (self.headers.get("Accept-Encoding") or ""):
+                rng = self.headers.get("Range")
+                if "gzip" in (self.headers.get("Accept-Encoding") or "") and not rng:
                     headers["Content-Encoding"] = "gzip"
                 else:
                     data = _gz.decompress(data)
+            rng = self.headers.get("Range")
+            if rng and rng.startswith("bytes="):
+                lo, _, hi = rng[6:].partition("-")
+                start = int(lo or 0)
+                stop = int(hi) + 1 if hi else len(data)
+                stop = min(stop, len(data))
+                headers["Content-Range"] = f"bytes {start}-{stop - 1}/{len(data)}"
+                return self._reply(206, data[start:stop], ctype, headers)
             self._reply(200, data, ctype, headers)
 
         # -- PUT/POST (volume_server_handlers_write.go:18)

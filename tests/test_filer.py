@@ -1,0 +1,221 @@
+"""Filer tests: store SPI, chunk interval math, and the HTTP/gRPC namespace
+over a live in-process cluster (SURVEY.md §2.5)."""
+
+import io
+import socket
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from seaweedfs_tpu.filer import Attr, Entry, Filer
+from seaweedfs_tpu.filer.filechunks import (
+    non_overlapping_visible_intervals,
+    total_size,
+    view_from_chunks,
+)
+from seaweedfs_tpu.filer.filerstore import get_store
+from seaweedfs_tpu.filer.filer import NotEmpty, NotFound
+from seaweedfs_tpu.pb import filer_pb2, rpc
+from seaweedfs_tpu.server.filer import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+# -- pure store/chunk tests ------------------------------------------------
+
+@pytest.mark.parametrize("store_name", ["memory", "sqlite"])
+def test_store_crud_and_listing(store_name):
+    store = get_store(store_name)
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/a/b/c.txt", attr=Attr(mtime=1)))
+    assert f.find_entry("/a/b/c.txt").attr.mtime == 1
+    assert f.find_entry("/a/b").is_directory  # auto-created parent
+    for i in range(5):
+        f.create_entry(Entry(full_path=f"/a/b/f{i}", attr=Attr(mtime=i)))
+    names = [e.name for e in f.list_entries("/a/b")]
+    assert names == ["c.txt", "f0", "f1", "f2", "f3", "f4"]
+    names = [e.name for e in f.list_entries("/a/b", start="f1")]
+    assert names == ["f2", "f3", "f4"]
+    names = [e.name for e in f.list_entries("/a/b", prefix="f")]
+    assert len(names) == 5
+    with pytest.raises(NotEmpty):
+        f.delete_entry("/a/b")
+    f.delete_entry("/a/b", recursive=True)
+    with pytest.raises(NotFound):
+        f.find_entry("/a/b/c.txt")
+    # kv
+    store.kv_put(b"k", b"v")
+    assert store.kv_get(b"k") == b"v"
+
+
+def test_rename_subtree():
+    f = Filer(get_store("memory"))
+    f.create_entry(Entry(full_path="/x/1"))
+    f.create_entry(Entry(full_path="/x/sub/2"))
+    f.rename("/x", "/y")
+    assert f.find_entry("/y/1")
+    assert f.find_entry("/y/sub/2")
+    with pytest.raises(NotFound):
+        f.find_entry("/x/1")
+
+
+def _chunk(fid, offset, size, ts):
+    return filer_pb2.FileChunk(file_id=fid, offset=offset, size=size,
+                               modified_ts_ns=ts)
+
+
+def test_visible_intervals_shadowing():
+    # chunk B (newer) overwrites the middle of chunk A
+    a = _chunk("a", 0, 100, 1)
+    b = _chunk("b", 30, 20, 2)
+    iv = non_overlapping_visible_intervals([a, b])
+    assert [(s, e, c.file_id) for s, e, c in iv] == [
+        (0, 30, "a"), (30, 50, "b"), (50, 100, "a")]
+    assert total_size([a, b]) == 100
+    views = view_from_chunks([a, b], 20, 40)
+    assert [(v.file_id, v.chunk_offset, v.size, v.logical_offset)
+            for v in views] == [("a", 20, 10, 20), ("b", 0, 20, 30),
+                                ("a", 50, 10, 50)]
+
+
+def test_metadata_event_log():
+    f = Filer(get_store("memory"))
+    t0 = time.time_ns()
+    f.create_entry(Entry(full_path="/d/x"))
+    f.delete_entry("/d/x")
+    events, cursor = f.read_events(t0)
+    kinds = [(bool(m.event_notification.old_entry.name),
+              bool(m.event_notification.new_entry.name)) for m in events
+             if "/d" == m.directory]
+    assert (False, True) in kinds  # create
+    assert (True, False) in kinds  # delete
+    assert cursor > t0
+
+
+# -- live cluster ----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport, volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("vol"))],
+        master=f"localhost:{mport}", ip="localhost", port=_free_port(),
+        pulse_seconds=1)
+    vsrv.start()
+    fsrv = FilerServer(ip="localhost", port=_free_port(),
+                       master=f"localhost:{mport}",
+                       store_dir=str(tmp_path_factory.mktemp("filer")),
+                       chunk_size=64 * 1024)
+    fsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    yield master, vsrv, fsrv
+    fsrv.stop()
+    vsrv.stop()
+    master.stop()
+    rpc.reset_channels()
+
+
+def test_filer_http_roundtrip(cluster):
+    _, _, fsrv = cluster
+    base = f"http://{fsrv.address}"
+    rng = np.random.default_rng(5)
+    # multi-chunk file (chunk_size 64k, write 200k)
+    payload = rng.integers(0, 256, size=200_000, dtype=np.uint8).tobytes()
+    r = requests.put(f"{base}/docs/big.bin", data=payload, timeout=60,
+                     headers={"Content-Type": "application/x-test"})
+    assert r.status_code == 201, r.text
+    got = requests.get(f"{base}/docs/big.bin", timeout=60)
+    assert got.status_code == 200
+    assert got.content == payload
+    assert got.headers["Content-Type"] == "application/x-test"
+
+    # range read spanning a chunk boundary
+    got = requests.get(f"{base}/docs/big.bin", timeout=60,
+                       headers={"Range": "bytes=60000-70000"})
+    assert got.status_code == 206
+    assert got.content == payload[60000:70001]
+
+    # directory listing
+    lst = requests.get(f"{base}/docs/", timeout=30).json()
+    assert [e["FullPath"] for e in lst["Entries"]] == ["/docs/big.bin"]
+    assert lst["Entries"][0]["FileSize"] == len(payload)
+
+    # overwrite GCs old chunks and serves new content
+    r = requests.put(f"{base}/docs/big.bin", data=b"tiny", timeout=60)
+    assert r.status_code == 201
+    assert requests.get(f"{base}/docs/big.bin", timeout=30).content == b"tiny"
+
+    # delete
+    assert requests.delete(f"{base}/docs/big.bin", timeout=30).status_code == 204
+    assert requests.get(f"{base}/docs/big.bin", timeout=30).status_code == 404
+
+
+def test_filer_grpc_surface(cluster):
+    _, _, fsrv = cluster
+    stub = rpc.filer_stub(rpc.grpc_address(fsrv.address))
+    # create via gRPC
+    e = filer_pb2.Entry(name="hello.txt", is_directory=False,
+                        content=b"inline content")
+    e.attributes.mtime = int(time.time())
+    resp = stub.CreateEntry(filer_pb2.CreateEntryRequest(
+        directory="/grpc", entry=e), timeout=10)
+    assert not resp.error
+    lk = stub.LookupDirectoryEntry(filer_pb2.LookupDirectoryEntryRequest(
+        directory="/grpc", name="hello.txt"), timeout=10)
+    assert lk.entry.content == b"inline content"
+    # inline content served over HTTP too
+    got = requests.get(f"http://{fsrv.address}/grpc/hello.txt", timeout=30)
+    assert got.content == b"inline content"
+    # listing stream
+    names = [r.entry.name for r in stub.ListEntries(
+        filer_pb2.ListEntriesRequest(directory="/grpc"), timeout=10)]
+    assert names == ["hello.txt"]
+    # rename
+    stub.AtomicRenameEntry(filer_pb2.AtomicRenameEntryRequest(
+        old_directory="/grpc", old_name="hello.txt",
+        new_directory="/grpc", new_name="renamed.txt"), timeout=10)
+    assert requests.get(f"http://{fsrv.address}/grpc/renamed.txt",
+                        timeout=30).status_code == 200
+    # config
+    conf = stub.GetFilerConfiguration(
+        filer_pb2.GetFilerConfigurationRequest(), timeout=10)
+    assert conf.masters
+
+
+def test_filer_subscribe_metadata(cluster):
+    _, _, fsrv = cluster
+    stub = rpc.filer_stub(rpc.grpc_address(fsrv.address))
+    since = time.time_ns()
+    got = []
+
+    import threading
+
+    def consume():
+        for msg in stub.SubscribeMetadata(
+                filer_pb2.SubscribeMetadataRequest(
+                    client_name="t", path_prefix="/sub", since_ns=since),
+                timeout=10):
+            got.append(msg)
+            if len(got) >= 2:
+                break
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.3)
+    requests.put(f"http://{fsrv.address}/sub/a.txt", data=b"one", timeout=30)
+    requests.delete(f"http://{fsrv.address}/sub/a.txt", timeout=30)
+    t.join(timeout=10)
+    assert len(got) >= 2
+    assert got[0].event_notification.new_entry.name == "a.txt"
