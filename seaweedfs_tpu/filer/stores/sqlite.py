@@ -35,20 +35,34 @@ CREATE TABLE IF NOT EXISTS kv (
 class SqliteStore:
     name = "sqlite"
 
+    _mem_seq = 0
+
     def __init__(self, db_path: str = ":memory:", **_):
+        self._uri = False
+        if db_path == ":memory:":
+            # per-connection private :memory: DBs won't do — every server
+            # thread must see one namespace. Use a named shared-cache DB and
+            # pin it with an anchor connection.
+            SqliteStore._mem_seq += 1
+            db_path = (f"file:filer_mem_{id(self)}_{SqliteStore._mem_seq}"
+                       f"?mode=memory&cache=shared")
+            self._uri = True
         self._db_path = db_path
         self._local = threading.local()
         self._lock = threading.Lock()
-        # a dedicated init connection so the schema exists before workers
-        self._conn().executescript(_SCHEMA)
-        self._conn().commit()
+        self._anchor = sqlite3.connect(db_path, uri=self._uri,
+                                       check_same_thread=False)
+        self._anchor.executescript(_SCHEMA)
+        self._anchor.commit()
 
     def _conn(self) -> sqlite3.Connection:
         c = getattr(self._local, "conn", None)
         if c is None:
-            c = sqlite3.connect(self._db_path, check_same_thread=False)
+            c = sqlite3.connect(self._db_path, uri=self._uri,
+                                check_same_thread=False)
             c.execute("PRAGMA journal_mode=WAL")
             c.execute("PRAGMA synchronous=NORMAL")
+            c.execute("PRAGMA busy_timeout=5000")
             self._local.conn = c
         return c
 
@@ -126,6 +140,7 @@ class SqliteStore:
         if c is not None:
             c.close()
             self._local.conn = None
+        self._anchor.close()
 
 
 register_store("sqlite", SqliteStore)

@@ -1,0 +1,176 @@
+"""AWS Signature V4 verification for the S3 gateway.
+
+Rebuild of /root/reference/weed/s3api/auth_signature_v4.go +
+auth_credentials.go: identities hold (accessKey, secretKey, actions);
+requests are verified by recomputing the V4 signature over the canonical
+request. Anonymous access is allowed when no identities are configured
+(the reference behaves the same with an empty s3 config).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import urllib.parse
+from dataclasses import dataclass, field
+
+
+@dataclass
+class Identity:
+    name: str
+    access_key: str
+    secret_key: str
+    actions: list[str] = field(default_factory=lambda: ["Admin"])
+
+    def allows(self, action: str, bucket: str = "") -> bool:
+        for a in self.actions:
+            if a == "Admin":
+                return True
+            a_name, _, a_bucket = a.partition(":")
+            if a_name != action:
+                continue
+            if not a_bucket or a_bucket == bucket or (
+                    a_bucket.endswith("*") and bucket.startswith(a_bucket[:-1])):
+                return True
+        return False
+
+
+class AuthError(Exception):
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+class IdentityAccessManagement:
+    def __init__(self, identities: list[Identity] | None = None):
+        self.identities = {i.access_key: i for i in (identities or [])}
+
+    @property
+    def enabled(self) -> bool:
+        return bool(self.identities)
+
+    def lookup(self, access_key: str) -> Identity:
+        ident = self.identities.get(access_key)
+        if ident is None:
+            raise AuthError("InvalidAccessKeyId",
+                            "The access key Id you provided does not exist")
+        return ident
+
+    def authenticate(self, method: str, path: str, query: str,
+                     headers, payload_hash: str) -> Identity | None:
+        """-> Identity, or None for allowed anonymous access."""
+        if not self.enabled:
+            return None
+        auth = headers.get("Authorization", "")
+        if auth.startswith("AWS4-HMAC-SHA256 "):
+            return self._verify_v4(auth, method, path, query, headers,
+                                   payload_hash)
+        qs = urllib.parse.parse_qs(query)
+        if "X-Amz-Signature" in qs:
+            return self._verify_presigned(method, path, qs, headers)
+        raise AuthError("AccessDenied", "Anonymous access is disabled")
+
+    # -- header auth -------------------------------------------------------
+
+    def _verify_v4(self, auth: str, method: str, path: str, query: str,
+                   headers, payload_hash: str) -> Identity:
+        fields = {}
+        for part in auth[len("AWS4-HMAC-SHA256 "):].split(","):
+            k, _, v = part.strip().partition("=")
+            fields[k] = v
+        try:
+            cred = fields["Credential"]
+            signed_headers = fields["SignedHeaders"].split(";")
+            given_sig = fields["Signature"]
+        except KeyError as e:
+            raise AuthError("AuthorizationHeaderMalformed", f"missing {e}")
+        access_key, date, region, service, _ = _split_credential(cred)
+        ident = self.lookup(access_key)
+        amz_date = headers.get("x-amz-date") or headers.get("X-Amz-Date") or ""
+        creq = _canonical_request(method, path, query, headers,
+                                  signed_headers, payload_hash)
+        sig = _signature(ident.secret_key, amz_date, date, region, service, creq)
+        if not hmac.compare_digest(sig, given_sig):
+            raise AuthError("SignatureDoesNotMatch",
+                            "The request signature we calculated does not "
+                            "match the signature you provided")
+        return ident
+
+    # -- presigned URLs ----------------------------------------------------
+
+    def _verify_presigned(self, method: str, path: str, qs: dict,
+                          headers) -> Identity:
+        cred = qs["X-Amz-Credential"][0]
+        access_key, date, region, service, _ = _split_credential(cred)
+        ident = self.lookup(access_key)
+        signed_headers = qs["X-Amz-SignedHeaders"][0].split(";")
+        given_sig = qs["X-Amz-Signature"][0]
+        amz_date = qs["X-Amz-Date"][0]
+        # canonical query excludes the signature itself
+        pairs = []
+        for k in sorted(qs):
+            if k == "X-Amz-Signature":
+                continue
+            for v in qs[k]:
+                pairs.append(f"{_uri_encode(k)}={_uri_encode(v)}")
+        creq = _canonical_request(method, path, "&".join(pairs), headers,
+                                  signed_headers, "UNSIGNED-PAYLOAD",
+                                  query_is_canonical=True)
+        sig = _signature(ident.secret_key, amz_date, date, region, service, creq)
+        if not hmac.compare_digest(sig, given_sig):
+            raise AuthError("SignatureDoesNotMatch", "presigned signature mismatch")
+        return ident
+
+
+def _split_credential(cred: str):
+    parts = cred.split("/")
+    if len(parts) != 5:
+        raise AuthError("AuthorizationHeaderMalformed", f"bad credential {cred}")
+    return parts  # access_key, date, region, service, aws4_request
+
+
+def _uri_encode(s: str, keep_slash: bool = False) -> str:
+    safe = "-_.~" + ("/" if keep_slash else "")
+    return urllib.parse.quote(s, safe=safe)
+
+
+def _canonical_request(method: str, path: str, query: str, headers,
+                       signed_headers: list[str], payload_hash: str,
+                       query_is_canonical: bool = False) -> str:
+    if query_is_canonical:
+        cq = query
+    else:
+        qs = urllib.parse.parse_qs(query, keep_blank_values=True)
+        pairs = []
+        for k in sorted(qs):
+            for v in sorted(qs[k]):
+                pairs.append(f"{_uri_encode(k)}={_uri_encode(v)}")
+        cq = "&".join(pairs)
+    chdrs = ""
+    for h in signed_headers:
+        v = headers.get(h, "")
+        chdrs += f"{h}:{' '.join(v.split())}\n"
+    return "\n".join([
+        method,
+        _uri_encode(path, keep_slash=True),
+        cq,
+        chdrs,
+        ";".join(signed_headers),
+        payload_hash,
+    ])
+
+
+def _signature(secret: str, amz_date: str, date: str, region: str,
+               service: str, canonical_request: str) -> str:
+    scope = f"{date}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical_request.encode()).hexdigest(),
+    ])
+    def h(key, msg):
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+    k = h(("AWS4" + secret).encode(), date)
+    k = h(k, region)
+    k = h(k, service)
+    k = h(k, "aws4_request")
+    return hmac.new(k, string_to_sign.encode(), hashlib.sha256).hexdigest()

@@ -1,0 +1,629 @@
+"""S3-compatible gateway over the filer.
+
+Rebuild of /root/reference/weed/s3api/ (s3api_server.go router,
+s3api_bucket_handlers.go, s3api_object_handlers.go, filer_multipart.go,
+s3api_object_tagging_handlers.go). Buckets are filer directories under
+/buckets/<name>; object bytes are filer entries. Multipart parts are staged
+under /buckets/.uploads/<uploadId> and merged into one chunk list at
+complete time — chunk fids are re-based, bytes are never copied (the same
+trick filer_multipart.go:COMPLETEMULTIPARTUPLOAD uses).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+import time
+import urllib.parse
+import uuid
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import grpc
+
+from ..pb import filer_pb2, rpc
+from ..utils import glog
+from ..utils.stats import S3_REQUEST_HISTOGRAM
+from .auth import AuthError, Identity, IdentityAccessManagement
+
+BUCKETS_DIR = "/buckets"
+UPLOADS_DIR = "/buckets/.uploads"
+S3_NS = "http://s3.amazonaws.com/doc/2006-03-01/"
+
+
+class S3Error(Exception):
+    def __init__(self, status: int, code: str, message: str):
+        super().__init__(message)
+        self.status = status
+        self.code = code
+
+
+class S3Server:
+    def __init__(self, *, port: int = 8333, filer: str = "localhost:8888",
+                 identities: list[Identity] | None = None):
+        self.port = port
+        self.filer = filer
+        self.filer_grpc = rpc.grpc_address(filer)
+        self.iam = IdentityAccessManagement(identities)
+        self._http_server = None
+        import requests as rq
+
+        self._session = rq.Session()
+
+    def start(self) -> None:
+        self._http_server = ThreadingHTTPServer(
+            ("", self.port), _make_handler(self))
+        threading.Thread(target=self._http_server.serve_forever,
+                         daemon=True).start()
+        glog.info(f"s3 gateway on :{self.port} -> filer {self.filer}")
+
+    def stop(self) -> None:
+        if self._http_server:
+            self._http_server.shutdown()
+
+    # -- filer plumbing ----------------------------------------------------
+
+    def stub(self):
+        return rpc.filer_stub(self.filer_grpc)
+
+    def find_entry(self, directory: str, name: str) -> filer_pb2.Entry | None:
+        try:
+            return self.stub().LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory=directory, name=name), timeout=10).entry
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                return None
+            raise
+
+    def list_dir(self, directory: str, start: str = "", limit: int = 1000,
+                 prefix: str = "", include_start=False):
+        try:
+            for resp in self.stub().ListEntries(
+                    filer_pb2.ListEntriesRequest(
+                        directory=directory, prefix=prefix,
+                        start_from_file_name=start,
+                        inclusive_start_from=include_start,
+                        limit=limit), timeout=30):
+                yield resp.entry
+        except grpc.RpcError as e:
+            if e.code() != grpc.StatusCode.NOT_FOUND:
+                raise
+
+    def put_object(self, bucket: str, key: str, body: bytes,
+                   content_type: str = "") -> str:
+        """-> etag. Streams through the filer HTTP autochunker."""
+        url = (f"http://{self.filer}{BUCKETS_DIR}/{bucket}/"
+               + urllib.parse.quote(key))
+        r = self._session.put(
+            url, data=body,
+            headers={"Content-Type": content_type or "application/octet-stream"},
+            timeout=600)
+        if r.status_code >= 300:
+            raise S3Error(500, "InternalError", f"filer PUT: {r.status_code}")
+        return hashlib.md5(body).hexdigest()
+
+    def get_object(self, bucket: str, key: str, range_header: str = ""):
+        url = (f"http://{self.filer}{BUCKETS_DIR}/{bucket}/"
+               + urllib.parse.quote(key))
+        headers = {"Range": range_header} if range_header else {}
+        r = self._session.get(url, headers=headers, timeout=600)
+        if r.status_code == 404:
+            raise S3Error(404, "NoSuchKey", "The specified key does not exist.")
+        if r.status_code >= 300:
+            raise S3Error(500, "InternalError", f"filer GET: {r.status_code}")
+        return r
+
+    def delete_object(self, bucket: str, key: str) -> None:
+        dir_, _, name = f"{BUCKETS_DIR}/{bucket}/{key}".rpartition("/")
+        self.stub().DeleteEntry(filer_pb2.DeleteEntryRequest(
+            directory=dir_, name=name, is_delete_data=True,
+            is_recursive=True), timeout=60)
+
+
+# -- XML helpers -----------------------------------------------------------
+
+def _el(parent, tag, text=None):
+    e = ET.SubElement(parent, tag)
+    if text is not None:
+        e.text = str(text)
+    return e
+
+
+def _xml_bytes(root: ET.Element) -> bytes:
+    return (b'<?xml version="1.0" encoding="UTF-8"?>'
+            + ET.tostring(root))
+
+
+def _iso(ts: int) -> str:
+    return time.strftime("%Y-%m-%dT%H:%M:%S.000Z", time.gmtime(ts or 0))
+
+
+# -- request handler -------------------------------------------------------
+
+def _make_handler(srv: S3Server):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            glog.v(2, f"s3 http: {fmt % args}")
+
+        # ---- plumbing
+
+        def _send(self, status: int, body: bytes = b"",
+                  ctype: str = "application/xml", headers=None):
+            headers = dict(headers or {})
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            if "Content-Length" not in headers:
+                headers["Content-Length"] = str(len(body))
+            self.send_header("x-amz-request-id", uuid.uuid4().hex[:16])
+            for k, v in headers.items():
+                self.send_header(k, v)
+            self.end_headers()
+            if body and self.command != "HEAD":
+                self.wfile.write(body)
+
+        def _error(self, err: S3Error):
+            root = ET.Element("Error")
+            _el(root, "Code", err.code)
+            _el(root, "Message", str(err))
+            self._send(err.status, _xml_bytes(root))
+
+        def _route(self):
+            u = urllib.parse.urlparse(self.path)
+            path = urllib.parse.unquote(u.path)
+            q = urllib.parse.parse_qs(u.query, keep_blank_values=True)
+            parts = path.lstrip("/").split("/", 1)
+            bucket = parts[0]
+            key = parts[1] if len(parts) > 1 else ""
+            return bucket, key, q, u
+
+        def _body(self) -> bytes:
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length) if length else b""
+            if self.headers.get("x-amz-content-sha256") == \
+                    "STREAMING-AWS4-HMAC-SHA256-PAYLOAD":
+                body = _decode_chunked_signing(body)
+            return body
+
+        def _auth(self, u) -> None:
+            payload_hash = self.headers.get("x-amz-content-sha256",
+                                            "UNSIGNED-PAYLOAD")
+            try:
+                srv.iam.authenticate(self.command, u.path, u.query,
+                                     self.headers, payload_hash)
+            except AuthError as e:
+                raise S3Error(403, e.code, str(e))
+
+        # ---- verbs
+
+        def do_GET(self):
+            self._dispatch("GET")
+
+        def do_HEAD(self):
+            self._dispatch("HEAD")
+
+        def do_PUT(self):
+            self._dispatch("PUT")
+
+        def do_POST(self):
+            self._dispatch("POST")
+
+        def do_DELETE(self):
+            self._dispatch("DELETE")
+
+        def _dispatch(self, verb: str):
+            bucket, key, q, u = self._route()
+            try:
+                with S3_REQUEST_HISTOGRAM.time(action=f"{verb.lower()}"):
+                    self._auth(u)
+                    if not bucket:
+                        return self._service(verb)
+                    if not key:
+                        return self._bucket(verb, bucket, q)
+                    return self._object(verb, bucket, key, q)
+            except S3Error as e:
+                self._error(e)
+            except Exception as e:  # noqa: BLE001
+                glog.error(f"s3 {verb} {self.path}: {e}")
+                self._error(S3Error(500, "InternalError", str(e)))
+
+        # ---- service level
+
+        def _service(self, verb: str):
+            if verb != "GET":
+                raise S3Error(405, "MethodNotAllowed", "unsupported")
+            root = ET.Element("ListAllMyBucketsResult", xmlns=S3_NS)
+            owner = _el(root, "Owner")
+            _el(owner, "ID", "seaweedfs-tpu")
+            buckets = _el(root, "Buckets")
+            for e in srv.list_dir(BUCKETS_DIR):
+                if not e.is_directory or e.name.startswith("."):
+                    continue
+                b = _el(buckets, "Bucket")
+                _el(b, "Name", e.name)
+                _el(b, "CreationDate", _iso(e.attributes.crtime))
+            self._send(200, _xml_bytes(root))
+
+        # ---- bucket level
+
+        def _bucket(self, verb: str, bucket: str, q):
+            if verb == "PUT":
+                srv.stub().CreateEntry(filer_pb2.CreateEntryRequest(
+                    directory=BUCKETS_DIR,
+                    entry=_dir_entry(bucket)), timeout=10)
+                return self._send(200, headers={"Location": f"/{bucket}"})
+            if verb in ("GET", "HEAD"):
+                entry = srv.find_entry(BUCKETS_DIR, bucket)
+                if entry is None:
+                    raise S3Error(404, "NoSuchBucket",
+                                  "The specified bucket does not exist")
+                if verb == "HEAD":
+                    return self._send(200)
+                if "uploads" in q:
+                    return self._list_multipart_uploads(bucket)
+                return self._list_objects(bucket, q)
+            if verb == "DELETE":
+                resp = srv.stub().DeleteEntry(filer_pb2.DeleteEntryRequest(
+                    directory=BUCKETS_DIR, name=bucket,
+                    is_delete_data=True, is_recursive=True), timeout=60)
+                if resp.error:
+                    raise S3Error(409, "BucketNotEmpty", resp.error)
+                return self._send(204)
+            if verb == "POST" and "delete" in q:
+                return self._multi_delete(bucket)
+            raise S3Error(405, "MethodNotAllowed", "unsupported bucket op")
+
+        def _list_objects(self, bucket: str, q):
+            prefix = q.get("prefix", [""])[0]
+            delimiter = q.get("delimiter", [""])[0]
+            max_keys = int(q.get("max-keys", ["1000"])[0])
+            v2 = q.get("list-type", [""])[0] == "2"
+            marker = (q.get("continuation-token", [""])[0] if v2
+                      else q.get("marker", [""])[0])
+            start_after = q.get("start-after", [""])[0]
+            if start_after > marker:
+                marker = start_after
+
+            contents, common = [], set()
+            truncated, next_marker = _walk(
+                srv, f"{BUCKETS_DIR}/{bucket}", "", prefix, delimiter,
+                marker, max_keys, contents, common)
+
+            tag = "ListBucketResult"
+            root = ET.Element(tag, xmlns=S3_NS)
+            _el(root, "Name", bucket)
+            _el(root, "Prefix", prefix)
+            _el(root, "MaxKeys", max_keys)
+            if delimiter:
+                _el(root, "Delimiter", delimiter)
+            _el(root, "IsTruncated", "true" if truncated else "false")
+            if v2:
+                _el(root, "KeyCount", len(contents))
+                if truncated:
+                    _el(root, "NextContinuationToken", next_marker)
+            elif truncated:
+                _el(root, "NextMarker", next_marker)
+            for key, entry in contents:
+                c = _el(root, "Contents")
+                _el(c, "Key", key)
+                _el(c, "LastModified", _iso(entry.attributes.mtime))
+                _el(c, "ETag", f'"{_entry_etag(entry)}"')
+                _el(c, "Size", entry.attributes.file_size)
+                _el(c, "StorageClass", "STANDARD")
+            for p in sorted(common):
+                cp = _el(root, "CommonPrefixes")
+                _el(cp, "Prefix", p)
+            self._send(200, _xml_bytes(root))
+
+        def _multi_delete(self, bucket: str):
+            body = self._body()
+            root = ET.fromstring(body)
+            ns = root.tag.partition("}")[0] + "}" if "}" in root.tag else ""
+            result = ET.Element("DeleteResult", xmlns=S3_NS)
+            for obj in root.findall(f"{ns}Object"):
+                key = obj.find(f"{ns}Key").text
+                try:
+                    srv.delete_object(bucket, key)
+                    d = _el(result, "Deleted")
+                    _el(d, "Key", key)
+                except Exception as e:  # noqa: BLE001
+                    er = _el(result, "Error")
+                    _el(er, "Key", key)
+                    _el(er, "Code", "InternalError")
+                    _el(er, "Message", str(e))
+            self._send(200, _xml_bytes(result))
+
+        # ---- object level
+
+        def _object(self, verb: str, bucket: str, key: str, q):
+            if srv.find_entry(BUCKETS_DIR, bucket) is None:
+                raise S3Error(404, "NoSuchBucket",
+                              "The specified bucket does not exist")
+            if "tagging" in q:
+                return self._tagging(verb, bucket, key)
+            if "uploads" in q and verb == "POST":
+                return self._initiate_multipart(bucket, key)
+            if "uploadId" in q:
+                upload_id = q["uploadId"][0]
+                if verb == "PUT" and "partNumber" in q:
+                    return self._upload_part(bucket, key, upload_id,
+                                             int(q["partNumber"][0]))
+                if verb == "POST":
+                    return self._complete_multipart(bucket, key, upload_id)
+                if verb == "DELETE":
+                    return self._abort_multipart(bucket, key, upload_id)
+                if verb == "GET":
+                    return self._list_parts(bucket, key, upload_id)
+
+            if verb == "PUT":
+                src = self.headers.get("x-amz-copy-source")
+                if src:
+                    return self._copy_object(bucket, key, src)
+                body = self._body()
+                etag = srv.put_object(bucket, key, body,
+                                      self.headers.get("Content-Type", ""))
+                return self._send(200, headers={"ETag": f'"{etag}"'})
+            if verb in ("GET", "HEAD"):
+                if verb == "HEAD":
+                    dir_, _, name = f"{BUCKETS_DIR}/{bucket}/{key}".rpartition("/")
+                    entry = srv.find_entry(dir_, name)
+                    if entry is None or entry.is_directory:
+                        raise S3Error(404, "NoSuchKey", "not found")
+                    return self._send(200, headers={
+                        "Content-Length": str(entry.attributes.file_size),
+                        "ETag": f'"{_entry_etag(entry)}"',
+                        "Last-Modified": time.strftime(
+                            "%a, %d %b %Y %H:%M:%S GMT",
+                            time.gmtime(entry.attributes.mtime)),
+                    })
+                r = srv.get_object(bucket, key,
+                                   self.headers.get("Range", ""))
+                headers = {}
+                if "Content-Range" in r.headers:
+                    headers["Content-Range"] = r.headers["Content-Range"]
+                if "ETag" in r.headers:
+                    headers["ETag"] = r.headers["ETag"]
+                return self._send(r.status_code, r.content,
+                                  r.headers.get("Content-Type",
+                                                "application/octet-stream"),
+                                  headers)
+            if verb == "DELETE":
+                srv.delete_object(bucket, key)
+                return self._send(204)
+            raise S3Error(405, "MethodNotAllowed", "unsupported object op")
+
+        def _copy_object(self, bucket: str, key: str, src: str):
+            src = urllib.parse.unquote(src.lstrip("/"))
+            sbucket, _, skey = src.partition("/")
+            r = srv.get_object(sbucket, skey)
+            etag = srv.put_object(bucket, key, r.content,
+                                  r.headers.get("Content-Type", ""))
+            root = ET.Element("CopyObjectResult", xmlns=S3_NS)
+            _el(root, "ETag", f'"{etag}"')
+            _el(root, "LastModified", _iso(int(time.time())))
+            self._send(200, _xml_bytes(root))
+
+        # ---- tagging (stored as extended attrs, s3api_object_tagging)
+
+        def _tagging(self, verb: str, bucket: str, key: str):
+            dir_, _, name = f"{BUCKETS_DIR}/{bucket}/{key}".rpartition("/")
+            entry = srv.find_entry(dir_, name)
+            if entry is None:
+                raise S3Error(404, "NoSuchKey", "not found")
+            if verb == "GET":
+                root = ET.Element("Tagging", xmlns=S3_NS)
+                ts = _el(root, "TagSet")
+                for k, v in sorted(entry.extended.items()):
+                    if k.startswith("x-amz-tag-"):
+                        t = _el(ts, "Tag")
+                        _el(t, "Key", k[len("x-amz-tag-"):])
+                        _el(t, "Value", v.decode())
+                return self._send(200, _xml_bytes(root))
+            if verb == "PUT":
+                body = self._body()
+                root = ET.fromstring(body)
+                ns = root.tag.partition("}")[0] + "}" if "}" in root.tag else ""
+                for k in [k for k in entry.extended
+                          if k.startswith("x-amz-tag-")]:
+                    del entry.extended[k]
+                for tag in root.iter(f"{ns}Tag"):
+                    k = tag.find(f"{ns}Key").text
+                    v = tag.find(f"{ns}Value").text or ""
+                    entry.extended[f"x-amz-tag-{k}"] = v.encode()
+                srv.stub().UpdateEntry(filer_pb2.UpdateEntryRequest(
+                    directory=dir_, entry=entry), timeout=10)
+                return self._send(200)
+            if verb == "DELETE":
+                for k in [k for k in entry.extended
+                          if k.startswith("x-amz-tag-")]:
+                    del entry.extended[k]
+                srv.stub().UpdateEntry(filer_pb2.UpdateEntryRequest(
+                    directory=dir_, entry=entry), timeout=10)
+                return self._send(204)
+            raise S3Error(405, "MethodNotAllowed", "unsupported tagging op")
+
+        # ---- multipart (filer_multipart.go)
+
+        def _initiate_multipart(self, bucket: str, key: str):
+            upload_id = uuid.uuid4().hex
+            meta = json.dumps({"bucket": bucket, "key": key,
+                               "content_type":
+                               self.headers.get("Content-Type", "")}).encode()
+            e = _dir_entry(upload_id)
+            e.extended["upload-meta"] = meta
+            srv.stub().CreateEntry(filer_pb2.CreateEntryRequest(
+                directory=UPLOADS_DIR, entry=e), timeout=10)
+            root = ET.Element("InitiateMultipartUploadResult", xmlns=S3_NS)
+            _el(root, "Bucket", bucket)
+            _el(root, "Key", key)
+            _el(root, "UploadId", upload_id)
+            self._send(200, _xml_bytes(root))
+
+        def _upload_part(self, bucket: str, key: str, upload_id: str,
+                         part_number: int):
+            if srv.find_entry(UPLOADS_DIR, upload_id) is None:
+                raise S3Error(404, "NoSuchUpload", "upload not found")
+            body = self._body()
+            url = (f"http://{srv.filer}{UPLOADS_DIR}/{upload_id}/"
+                   f"{part_number:04d}.part")
+            r = srv._session.put(url, data=body, timeout=600)
+            if r.status_code >= 300:
+                raise S3Error(500, "InternalError", "part upload failed")
+            self._send(200, headers={
+                "ETag": f'"{hashlib.md5(body).hexdigest()}"'})
+
+        def _complete_multipart(self, bucket: str, key: str, upload_id: str):
+            updir = f"{UPLOADS_DIR}/{upload_id}"
+            meta_entry = srv.find_entry(UPLOADS_DIR, upload_id)
+            if meta_entry is None:
+                raise S3Error(404, "NoSuchUpload", "upload not found")
+            meta = json.loads(meta_entry.extended.get("upload-meta", b"{}"))
+            parts = sorted(
+                (e for e in srv.list_dir(updir) if e.name.endswith(".part")),
+                key=lambda e: e.name)
+            chunks, offset = [], 0
+            for p in parts:
+                for c in p.chunks:
+                    nc = filer_pb2.FileChunk()
+                    nc.CopyFrom(c)
+                    nc.offset = offset + c.offset
+                    chunks.append(nc)
+                offset += p.attributes.file_size
+            final = filer_pb2.Entry(name=key.rsplit("/", 1)[-1])
+            final.chunks.extend(chunks)
+            final.attributes.mtime = int(time.time())
+            final.attributes.file_size = offset
+            final.attributes.mime = meta.get("content_type", "")
+            dir_ = f"{BUCKETS_DIR}/{bucket}/{key}".rpartition("/")[0]
+            resp = srv.stub().CreateEntry(filer_pb2.CreateEntryRequest(
+                directory=dir_, entry=final), timeout=30)
+            if resp.error:
+                raise S3Error(500, "InternalError", resp.error)
+            # drop the staging dir but keep the chunks (owned by the object now)
+            srv.stub().DeleteEntry(filer_pb2.DeleteEntryRequest(
+                directory=UPLOADS_DIR, name=upload_id,
+                is_delete_data=False, is_recursive=True), timeout=60)
+            root = ET.Element("CompleteMultipartUploadResult", xmlns=S3_NS)
+            _el(root, "Location", f"/{bucket}/{key}")
+            _el(root, "Bucket", bucket)
+            _el(root, "Key", key)
+            _el(root, "ETag", f'"{hashlib.md5(str(offset).encode()).hexdigest()}-{len(parts)}"')
+            self._send(200, _xml_bytes(root))
+
+        def _abort_multipart(self, bucket: str, key: str, upload_id: str):
+            srv.stub().DeleteEntry(filer_pb2.DeleteEntryRequest(
+                directory=UPLOADS_DIR, name=upload_id,
+                is_delete_data=True, is_recursive=True), timeout=60)
+            self._send(204)
+
+        def _list_parts(self, bucket: str, key: str, upload_id: str):
+            updir = f"{UPLOADS_DIR}/{upload_id}"
+            root = ET.Element("ListPartsResult", xmlns=S3_NS)
+            _el(root, "Bucket", bucket)
+            _el(root, "Key", key)
+            _el(root, "UploadId", upload_id)
+            for e in srv.list_dir(updir):
+                if not e.name.endswith(".part"):
+                    continue
+                p = _el(root, "Part")
+                _el(p, "PartNumber", int(e.name.split(".")[0]))
+                _el(p, "Size", e.attributes.file_size)
+                _el(p, "LastModified", _iso(e.attributes.mtime))
+            self._send(200, _xml_bytes(root))
+
+        def _list_multipart_uploads(self, bucket: str):
+            root = ET.Element("ListMultipartUploadsResult", xmlns=S3_NS)
+            _el(root, "Bucket", bucket)
+            for e in srv.list_dir(UPLOADS_DIR):
+                meta = json.loads(e.extended.get("upload-meta", b"{}"))
+                if meta.get("bucket") != bucket:
+                    continue
+                u = _el(root, "Upload")
+                _el(u, "Key", meta.get("key", ""))
+                _el(u, "UploadId", e.name)
+                _el(u, "Initiated", _iso(e.attributes.crtime))
+            self._send(200, _xml_bytes(root))
+
+    return Handler
+
+
+def _dir_entry(name: str) -> filer_pb2.Entry:
+    e = filer_pb2.Entry(name=name, is_directory=True)
+    now = int(time.time())
+    e.attributes.crtime = now
+    e.attributes.mtime = now
+    e.attributes.file_mode = 0o770 | 0o40000
+    return e
+
+
+def _entry_etag(entry: filer_pb2.Entry) -> str:
+    if entry.attributes.md5:
+        return entry.attributes.md5.hex()
+    if len(entry.chunks) == 1:
+        return entry.chunks[0].e_tag or entry.chunks[0].file_id
+    return hashlib.md5(
+        b"".join((c.e_tag or c.file_id).encode() for c in entry.chunks)
+    ).hexdigest()
+
+
+def _walk(srv: S3Server, base_dir: str, rel: str, prefix: str,
+          delimiter: str, marker: str, max_keys: int,
+          contents: list, common: set) -> tuple[bool, str]:
+    """Depth-first object listing with prefix/delimiter semantics
+    (s3api_objects_list_handlers.go doListFilerEntries)."""
+    truncated = False
+    next_marker = ""
+    for entry in srv.list_dir(base_dir, limit=10_000):
+        key = f"{rel}{entry.name}"
+        if entry.is_directory:
+            sub = key + "/"
+            if prefix and not (sub.startswith(prefix) or prefix.startswith(sub)):
+                continue
+            if delimiter == "/" and sub.startswith(prefix):
+                # collapse at the first delimiter after the prefix
+                tail = sub[len(prefix):]
+                if "/" in tail[:-1] or tail:
+                    common.add(prefix + tail.split("/")[0] + "/")
+                    continue
+            t, m = _walk(srv, f"{base_dir}/{entry.name}", sub, prefix,
+                         delimiter, marker, max_keys, contents, common)
+            if t:
+                return True, m
+            continue
+        if prefix and not key.startswith(prefix):
+            continue
+        if marker and key <= marker:
+            continue
+        if delimiter == "/":
+            tail = key[len(prefix):]
+            if "/" in tail:
+                common.add(prefix + tail.split("/")[0] + "/")
+                continue
+        if len(contents) >= max_keys:
+            return True, next_marker
+        contents.append((key, entry))
+        next_marker = key
+    return truncated, next_marker
+
+
+def _decode_chunked_signing(body: bytes) -> bytes:
+    """Strip aws-chunked transfer encoding (sigv4 streaming uploads)."""
+    out = bytearray()
+    i = 0
+    while i < len(body):
+        j = body.find(b"\r\n", i)
+        if j < 0:
+            break
+        header = body[i:j].split(b";")[0]
+        try:
+            n = int(header, 16)
+        except ValueError:
+            break
+        if n == 0:
+            break
+        out += body[j + 2:j + 2 + n]
+        i = j + 2 + n + 2
+    return bytes(out)
