@@ -867,6 +867,15 @@ def _make_http_handler(srv: VolumeServer):
                     headers["Content-Encoding"] = "gzip"
                 else:
                     data = _gz.decompress(data)
+            # on-read image transforms (volume_server_handlers_read.go:294)
+            q = {k: v[0] for k, v in parse_qs(u.query).items()}
+            if ("width" in q or "height" in q) and (
+                    ctype.startswith("image/") or not ctype):
+                from ..images import resized
+
+                data, _, _ = resized(
+                    data, int(q.get("width", 0)), int(q.get("height", 0)),
+                    q.get("mode", ""))
             rng = self.headers.get("Range")
             if rng and rng.startswith("bytes="):
                 lo, _, hi = rng[6:].partition("-")

@@ -1,0 +1,112 @@
+"""Tiered chunk cache: memory LRU + optional on-disk tier.
+
+Rebuild of /root/reference/weed/util/chunk_cache/ (chunk_cache.go routes
+small chunks to an in-memory cache and larger ones to disk-backed caches;
+this build keeps the same two-tier shape with an OrderedDict LRU and a
+directory of fid-named files).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+
+
+class MemoryCache:
+    def __init__(self, capacity_bytes: int = 64 * 1024 * 1024):
+        self.capacity = capacity_bytes
+        self._used = 0
+        self._data: OrderedDict[str, bytes] = OrderedDict()
+        self._lock = threading.Lock()
+
+    def get(self, key: str) -> bytes | None:
+        with self._lock:
+            v = self._data.get(key)
+            if v is not None:
+                self._data.move_to_end(key)
+            return v
+
+    def put(self, key: str, value: bytes) -> None:
+        if len(value) > self.capacity:
+            return
+        with self._lock:
+            old = self._data.pop(key, None)
+            if old is not None:
+                self._used -= len(old)
+            self._data[key] = value
+            self._used += len(value)
+            while self._used > self.capacity:
+                _, evicted = self._data.popitem(last=False)
+                self._used -= len(evicted)
+
+
+class DiskCache:
+    def __init__(self, directory: str, capacity_bytes: int = 1 << 30):
+        self.dir = directory
+        self.capacity = capacity_bytes
+        os.makedirs(directory, exist_ok=True)
+        self._lock = threading.Lock()
+
+    def _path(self, key: str) -> str:
+        h = hashlib.sha1(key.encode()).hexdigest()
+        return os.path.join(self.dir, h)
+
+    def get(self, key: str) -> bytes | None:
+        try:
+            with open(self._path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def put(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._evict_if_needed(len(value))
+            tmp = self._path(key) + ".tmp"
+            with open(tmp, "wb") as f:
+                f.write(value)
+            os.replace(tmp, self._path(key))
+
+    def _evict_if_needed(self, incoming: int) -> None:
+        entries = []
+        total = 0
+        for name in os.listdir(self.dir):
+            p = os.path.join(self.dir, name)
+            try:
+                st = os.stat(p)
+            except FileNotFoundError:
+                continue
+            entries.append((st.st_atime, st.st_size, p))
+            total += st.st_size
+        entries.sort()
+        while total + incoming > self.capacity and entries:
+            _, size, p = entries.pop(0)
+            try:
+                os.remove(p)
+            except FileNotFoundError:
+                pass
+            total -= size
+
+
+class TieredChunkCache:
+    """Small chunks in memory, large on disk (chunk_cache.go thresholds)."""
+
+    def __init__(self, mem_bytes: int = 64 * 1024 * 1024,
+                 disk_dir: str | None = None, disk_bytes: int = 1 << 30,
+                 mem_threshold: int = 1024 * 1024):
+        self.mem = MemoryCache(mem_bytes)
+        self.disk = DiskCache(disk_dir, disk_bytes) if disk_dir else None
+        self.mem_threshold = mem_threshold
+
+    def get(self, fid: str) -> bytes | None:
+        v = self.mem.get(fid)
+        if v is None and self.disk is not None:
+            v = self.disk.get(fid)
+        return v
+
+    def put(self, fid: str, value: bytes) -> None:
+        if len(value) < self.mem_threshold or self.disk is None:
+            self.mem.put(fid, value)
+        else:
+            self.disk.put(fid, value)

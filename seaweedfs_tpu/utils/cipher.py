@@ -1,0 +1,27 @@
+"""AES-256-GCM chunk encryption (reference: weed/util/cipher.go —
+Encrypt/Decrypt with a random key per chunk, key stored in the chunk's
+metadata, never on the volume server)."""
+
+from __future__ import annotations
+
+import os
+
+from cryptography.hazmat.primitives.ciphers.aead import AESGCM
+
+KEY_SIZE = 32
+NONCE_SIZE = 12
+
+
+def gen_cipher_key() -> bytes:
+    return os.urandom(KEY_SIZE)
+
+
+def encrypt(data: bytes, key: bytes) -> bytes:
+    """nonce || ciphertext+tag, like cipher.go Encrypt."""
+    nonce = os.urandom(NONCE_SIZE)
+    return nonce + AESGCM(key).encrypt(nonce, data, None)
+
+
+def decrypt(blob: bytes, key: bytes) -> bytes:
+    nonce, ct = blob[:NONCE_SIZE], blob[NONCE_SIZE:]
+    return AESGCM(key).decrypt(nonce, ct, None)

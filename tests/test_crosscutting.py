@@ -1,0 +1,143 @@
+"""Cross-cutting subsystem tests: security (JWT/guard), compression,
+cipher, chunk cache, images, query, sequence, stats (SURVEY.md §2.6)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.images import fix_jpg_orientation, is_image, resized
+from seaweedfs_tpu.query import query_csv, query_json
+from seaweedfs_tpu.security import (
+    Guard,
+    JwtError,
+    decode_jwt,
+    encode_jwt,
+    gen_write_jwt,
+    verify_fid_jwt,
+)
+from seaweedfs_tpu.sequence import MemorySequencer, SnowflakeSequencer
+from seaweedfs_tpu.utils import stats
+from seaweedfs_tpu.utils.chunk_cache import TieredChunkCache
+from seaweedfs_tpu.utils.cipher import decrypt, encrypt, gen_cipher_key
+from seaweedfs_tpu.utils.compression import (
+    gunzip_data,
+    gzip_data,
+    is_gzippable,
+    maybe_decompress,
+    unzstd_data,
+    zstd_data,
+)
+
+
+def test_jwt_roundtrip_and_fid_scope():
+    key = b"secret-key"
+    tok = gen_write_jwt(key, "3,01637037d6")
+    verify_fid_jwt(tok, key, "3,01637037d6")
+    with pytest.raises(JwtError):
+        verify_fid_jwt(tok, key, "4,deadbeef01")
+    with pytest.raises(JwtError):
+        verify_fid_jwt(tok, b"wrong-key", "3,01637037d6")
+    expired = encode_jwt({"exp": int(time.time()) - 5, "fid": "x"}, key)
+    with pytest.raises(JwtError):
+        decode_jwt(expired, key)
+
+
+def test_guard_whitelist():
+    g = Guard(whitelist=["10.0.0.0/8", "192.168.1.5"])
+    assert g.is_allowed("10.1.2.3")
+    assert g.is_allowed("192.168.1.5")
+    assert not g.is_allowed("192.168.1.6")
+    assert Guard().is_allowed("8.8.8.8")  # open when empty
+
+
+def test_compression():
+    data = b"aaaa" * 1000
+    assert gunzip_data(gzip_data(data)) == data
+    assert maybe_decompress(gzip_data(data)) == data
+    assert maybe_decompress(data) == data
+    assert unzstd_data(zstd_data(data)) == data
+    assert maybe_decompress(zstd_data(data)) == data
+    assert is_gzippable(ext=".txt")
+    assert not is_gzippable(ext=".jpg")
+    assert not is_gzippable(mime="video/mp4")
+
+
+def test_cipher_roundtrip():
+    key = gen_cipher_key()
+    blob = encrypt(b"sensitive bytes", key)
+    assert blob != b"sensitive bytes"
+    assert decrypt(blob, key) == b"sensitive bytes"
+    with pytest.raises(Exception):
+        decrypt(blob, gen_cipher_key())
+
+
+def test_chunk_cache_tiers(tmp_path):
+    c = TieredChunkCache(mem_bytes=10_000, disk_dir=str(tmp_path),
+                         mem_threshold=1000)
+    c.put("small", b"x" * 100)
+    c.put("large", b"y" * 5000)
+    assert c.get("small") == b"x" * 100
+    assert c.get("large") == b"y" * 5000
+    assert c.mem.get("large") is None  # went to disk tier
+    assert c.get("absent") is None
+    # LRU eviction
+    for i in range(200):
+        c.put(f"k{i}", b"z" * 900)
+    assert c.get("small") is None
+
+
+def test_images_resize():
+    from PIL import Image
+    import io as _io
+
+    img = Image.new("RGB", (100, 50), (200, 10, 10))
+    buf = _io.BytesIO()
+    img.save(buf, format="PNG")
+    data = buf.getvalue()
+    assert is_image("image/png")
+    out, w, h = resized(data, width=50)
+    assert (w, h) == (50, 25)
+    assert Image.open(_io.BytesIO(out)).size == (50, 25)
+    # non-image passthrough
+    assert fix_jpg_orientation(b"not an image") == b"not an image"
+
+
+def test_query_json_and_csv():
+    docs = b'{"a": 1, "b": {"c": "x"}}\n{"a": 5, "b": {"c": "y"}}\n'
+    out = query_json(docs, where="a > 2")
+    assert out == [{"a": 5, "b": {"c": "y"}}]
+    out = query_json(docs, select=["b.c"], where="a = 1")
+    assert out == [{"b.c": "x"}]
+    csv_data = b"name,age\nalice,30\nbob,25\n"
+    out = query_csv(csv_data, where="age >= 30")
+    assert out == [{"name": "alice", "age": 30}]
+    out = query_csv(csv_data, select=["name"], limit=1)
+    assert out == [{"name": "alice"}]
+
+
+def test_sequencers():
+    m = MemorySequencer()
+    a = m.next_file_id(3)
+    b = m.next_file_id(1)
+    assert b == a + 3
+    m.set_max(1000)
+    assert m.next_file_id(1) == 1001
+    s = SnowflakeSequencer(node_id=5)
+    ids = {s.next_file_id() for _ in range(100)}
+    assert len(ids) == 100
+    assert all(i > 0 for i in ids)
+
+
+def test_stats_render():
+    c = stats.Counter("test_counter_total", "help text")
+    c.inc(3, method="GET")
+    g = stats.Gauge("test_gauge", "gauge")
+    g.set(7)
+    h = stats.Histogram("test_hist_seconds", "hist")
+    h.observe(0.002, type="read")
+    text = stats.gather()
+    assert 'test_counter_total{method="GET"} 3' in text
+    assert "test_gauge 7" in text
+    assert "test_hist_seconds_count" in text
+    assert "# TYPE test_hist_seconds histogram" in text
