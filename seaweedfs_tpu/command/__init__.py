@@ -80,6 +80,14 @@ def main(argv=None) -> int:
     bp.add_argument("-write", dest="do_write", action="store_true", default=True)
     bp.add_argument("-skipRead", action="store_true")
 
+    mnt = sub.add_parser("mount", help="FUSE-mount a filer path")
+    mnt.add_argument("-filer", default="localhost:8888")
+    mnt.add_argument("-dir", required=True, help="mount point")
+    mnt.add_argument("-chunkSizeLimitMB", type=int, default=2)
+    mnt.add_argument("-collection", default="")
+    mnt.add_argument("-replication", default="")
+    mnt.add_argument("-cacheDir", default="")
+
     sub.add_parser("version", help="print version")
     scp = sub.add_parser("scaffold", help="print a sample config")
     scp.add_argument("-config", default="filer",
@@ -227,6 +235,20 @@ def _run(opts) -> int:
         from .benchmark import run_benchmark
 
         run_benchmark(opts)
+        return 0
+
+    if opts.cmd == "mount":
+        from ..mount import WFS, mount
+        from ..pb import rpc
+
+        wfs = WFS(rpc.grpc_address(opts.filer),
+                  chunk_size=opts.chunkSizeLimitMB * 1024 * 1024,
+                  collection=opts.collection, replication=opts.replication,
+                  cache_dir=opts.cacheDir or None)
+        try:
+            mount(wfs, opts.dir)
+        finally:
+            wfs.close()
         return 0
 
     if opts.cmd == "scaffold":

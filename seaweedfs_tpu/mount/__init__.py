@@ -1,0 +1,16 @@
+"""FUSE mount layer (rebuild of /root/reference/weed/mount/).
+
+WFS is the filesystem core (inode-addressed ops over the filer gRPC API);
+fuse_binding adapts it to a kernel mount when a libfuse wrapper exists.
+"""
+
+from .fuse_binding import fuse_available, mount
+from .inode_to_path import ROOT_INODE, InodeToPath
+from .meta_cache import MetaCache
+from .page_writer import MemChunk, UploadPipeline
+from .weedfs import WFS, FileHandle, FuseError
+
+__all__ = [
+    "WFS", "FileHandle", "FuseError", "InodeToPath", "ROOT_INODE",
+    "MetaCache", "MemChunk", "UploadPipeline", "fuse_available", "mount",
+]

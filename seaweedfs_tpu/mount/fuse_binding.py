@@ -1,0 +1,128 @@
+"""Kernel FUSE binding for WFS, gated on an available libfuse wrapper.
+
+The reference mounts via go-fuse v2 (/root/reference/weed/mount/weedfs.go,
+weed/command/mount_std.go). This environment ships no fusepy/libfuse
+Python wrapper, so the binding is optional: `mount()` raises a clear error
+when no backend is importable, and everything above it (WFS) is exercised
+in-process instead (tests/test_mount.py).
+"""
+
+from __future__ import annotations
+
+from .weedfs import WFS
+
+
+def fuse_available() -> bool:
+    try:
+        import fuse  # noqa: F401  (fusepy)
+
+        return hasattr(fuse, "FUSE")
+    except Exception:
+        return False
+
+
+def mount(wfs: WFS, mountpoint: str, *, foreground: bool = True) -> None:
+    """Mount `wfs` at `mountpoint` via fusepy, if present."""
+    if not fuse_available():
+        raise RuntimeError(
+            "no FUSE backend available (fusepy/libfuse not installed); "
+            "use the WFS API directly or the weed-tpu filer/S3/WebDAV "
+            "frontends")
+    import functools
+
+    import fuse
+
+    from .weedfs import FuseError
+
+    def _errno_bridge(fn):
+        """fusepy only honors errnos raised as FuseOSError (an OSError);
+        translate WFS's FuseError so ENOENT/EEXIST/ENODATA/... survive."""
+
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            try:
+                return fn(*args, **kwargs)
+            except FuseError as e:
+                raise fuse.FuseOSError(e.errno) from e
+
+        return wrapped
+
+    class _OpsMeta(type(fuse.Operations)):
+        def __new__(mcs, name, bases, ns):
+            for k, v in list(ns.items()):
+                if callable(v) and not k.startswith("_"):
+                    ns[k] = _errno_bridge(v)
+            return super().__new__(mcs, name, bases, ns)
+
+    class _Ops(fuse.Operations,
+               metaclass=_OpsMeta):  # pragma: no cover - needs a kernel
+        def __init__(self, w: WFS):
+            self.w = w
+
+        def _ino(self, path: str) -> int:
+            return self.w.path_inode(path)
+
+        def getattr(self, path, fh=None):
+            ino = self._ino(path)
+            e = self.w.getattr(ino)
+            a = e.attr
+            return {"st_mode": a.mode,
+                    "st_size": self.w.entry_size(ino, e),
+                    "st_mtime": a.mtime, "st_ctime": a.crtime,
+                    "st_uid": a.uid, "st_gid": a.gid,
+                    "st_nlink": max(1, e.hard_link_counter)}
+
+        def readdir(self, path, fh):
+            return [".", ".."] + [e.name
+                                  for e in self.w.readdir(self._ino(path))]
+
+        def create(self, path, mode, fi=None):
+            parent, name = path.rsplit("/", 1)
+            _, _, fh = self.w.create(self._ino(parent or "/"), name, mode)
+            return fh
+
+        def open(self, path, flags):
+            return self.w.open(self._ino(path))
+
+        def read(self, path, size, offset, fh):
+            return self.w.read(fh, offset, size)
+
+        def write(self, path, data, offset, fh):
+            return self.w.write(fh, offset, data)
+
+        def flush(self, path, fh):
+            self.w.flush(fh)
+
+        def release(self, path, fh):
+            self.w.release(fh)
+
+        def mkdir(self, path, mode):
+            parent, name = path.rsplit("/", 1)
+            self.w.mkdir(self._ino(parent or "/"), name, mode)
+
+        def rmdir(self, path):
+            parent, name = path.rsplit("/", 1)
+            self.w.rmdir(self._ino(parent or "/"), name)
+
+        def unlink(self, path):
+            parent, name = path.rsplit("/", 1)
+            self.w.unlink(self._ino(parent or "/"), name)
+
+        def rename(self, old, new):
+            op, on = old.rsplit("/", 1)
+            np_, nn = new.rsplit("/", 1)
+            self.w.rename(self._ino(op or "/"), on,
+                          self._ino(np_ or "/"), nn)
+
+        def truncate(self, path, length, fh=None):
+            self.w.setattr(self._ino(path), size=length)
+
+        def symlink(self, target, source):
+            parent, name = target.rsplit("/", 1)
+            self.w.symlink(self._ino(parent or "/"), name, source)
+
+        def readlink(self, path):
+            return self.w.readlink(self._ino(path))
+
+    fuse.FUSE(_Ops(wfs), mountpoint, foreground=foreground,
+              nothreads=False, allow_other=False)

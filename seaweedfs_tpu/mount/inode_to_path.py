@@ -1,0 +1,129 @@
+"""Bidirectional inode <-> path map for the mount layer.
+
+Rebuild of /root/reference/weed/mount/inode_to_path.go: the kernel speaks
+inodes, the filer speaks paths. Inodes are allocated on first lookup,
+reference-counted by kernel LOOKUP/FORGET, and re-pointed on rename.
+Hard links share one inode across several paths (the reference tracks one
+path per inode and moves it; we keep a path set, first path wins for
+inode->path resolution, matching weedfs_link.go semantics).
+"""
+
+from __future__ import annotations
+
+import threading
+
+ROOT_INODE = 1
+
+
+class InodeEntry:
+    __slots__ = ("paths", "nlookup", "is_directory")
+
+    def __init__(self, path: str, is_directory: bool):
+        self.paths: list[str] = [path]
+        self.nlookup = 1
+        self.is_directory = is_directory
+
+
+class InodeToPath:
+    def __init__(self, root: str = "/"):
+        self._lock = threading.Lock()
+        self._path2inode: dict[str, int] = {root: ROOT_INODE}
+        self._inode2entry: dict[int, InodeEntry] = {
+            ROOT_INODE: InodeEntry(root, True)}
+        self._inode2entry[ROOT_INODE].nlookup = 1 << 30  # root never forgotten
+        self._next = ROOT_INODE + 1
+
+    def lookup(self, path: str, is_directory: bool = False) -> int:
+        """Assign (or bump) the inode for a path (inode_to_path.go Lookup)."""
+        with self._lock:
+            ino = self._path2inode.get(path)
+            if ino is None:
+                ino = self._next
+                self._next += 1
+                self._path2inode[path] = ino
+                self._inode2entry[ino] = InodeEntry(path, is_directory)
+            else:
+                self._inode2entry[ino].nlookup += 1
+            return ino
+
+    def get_path(self, inode: int) -> str:
+        with self._lock:
+            e = self._inode2entry.get(inode)
+            if e is None or not e.paths:
+                raise KeyError(f"unknown inode {inode}")
+            return e.paths[0]
+
+    def get_inode(self, path: str) -> int | None:
+        with self._lock:
+            return self._path2inode.get(path)
+
+    def has_path(self, path: str) -> bool:
+        with self._lock:
+            return path in self._path2inode
+
+    def add_path(self, inode: int, path: str) -> None:
+        """Hard link: second path aliasing an existing inode."""
+        with self._lock:
+            self._path2inode[path] = inode
+            e = self._inode2entry[inode]
+            if path not in e.paths:
+                e.paths.append(path)
+            e.nlookup += 1
+
+    def remove_path(self, path: str) -> None:
+        """Unlink one path; the inode survives while other links remain."""
+        with self._lock:
+            ino = self._path2inode.pop(path, None)
+            if ino is None:
+                return
+            e = self._inode2entry.get(ino)
+            if e is not None:
+                if path in e.paths:
+                    e.paths.remove(path)
+                if not e.paths:
+                    del self._inode2entry[ino]
+
+    def move_path(self, old: str, new: str) -> None:
+        """Rename: keep the inode, re-point the path (MovePath). Any entry
+        previously at `new` is dropped (rename-over)."""
+        with self._lock:
+            ino = self._path2inode.pop(old, None)
+            target_ino = self._path2inode.pop(new, None)
+            if target_ino is not None and target_ino != ino:
+                te = self._inode2entry.get(target_ino)
+                if te is not None and new in te.paths:
+                    te.paths.remove(new)
+                    if not te.paths:
+                        del self._inode2entry[target_ino]
+            if ino is None:
+                return
+            self._path2inode[new] = ino
+            e = self._inode2entry[ino]
+            e.paths = [new if p == old else p for p in e.paths]
+            # children of a renamed directory are re-pointed lazily by the
+            # caller walking them; directory rename moves the subtree paths
+            if e.is_directory:
+                prefix = old + "/"
+                moved = [p for p in self._path2inode if p.startswith(prefix)]
+                for p in moved:
+                    cino = self._path2inode.pop(p)
+                    np_ = new + p[len(old):]
+                    self._path2inode[np_] = cino
+                    ce = self._inode2entry[cino]
+                    ce.paths = [np_ if q == p else q for q in ce.paths]
+
+    def forget(self, inode: int, nlookup: int = 1) -> None:
+        """Kernel FORGET: drop refs; free the mapping at zero (Forget)."""
+        with self._lock:
+            e = self._inode2entry.get(inode)
+            if e is None:
+                return
+            e.nlookup -= nlookup
+            if e.nlookup <= 0 and inode != ROOT_INODE:
+                for p in e.paths:
+                    self._path2inode.pop(p, None)
+                del self._inode2entry[inode]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._inode2entry)

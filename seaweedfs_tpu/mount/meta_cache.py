@@ -1,0 +1,117 @@
+"""Local metadata cache for the mount layer.
+
+Rebuild of /root/reference/weed/mount/meta_cache/: directory listings and
+entry attributes are cached locally (the reference uses a LevelDB dir; we
+use the filer-store SPI so any registered store works) and kept fresh by
+subscribing to the filer's metadata event stream
+(meta_cache_subscribe.go SubscribeMetaEvents).
+"""
+
+from __future__ import annotations
+
+import threading
+
+from ..filer.entry import Entry
+from ..filer.filer import normalize, parent_of
+from ..filer.filerstore import get_store
+from ..pb import filer_pb2, rpc
+
+
+class MetaCache:
+    def __init__(self, store_name: str = "memory"):
+        self._store = get_store(store_name)
+        self._visited: set[str] = set()
+        self._lock = threading.RLock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+
+    # -- local CRUD mirror -------------------------------------------------
+
+    def insert(self, entry: Entry) -> None:
+        with self._lock:
+            self._store.insert_entry(entry)
+
+    def update(self, entry: Entry) -> None:
+        with self._lock:
+            if self._store.find_entry(entry.full_path) is None:
+                self._store.insert_entry(entry)
+            else:
+                self._store.update_entry(entry)
+
+    def delete(self, path: str) -> None:
+        with self._lock:
+            e = self._store.find_entry(path)
+            if e is not None and e.is_directory:
+                self._store.delete_folder_children(path)
+                self._visited = {v for v in self._visited
+                                 if v != path and not v.startswith(path + "/")}
+            self._store.delete_entry(path)
+
+    def find(self, path: str) -> Entry | None:
+        with self._lock:
+            return self._store.find_entry(normalize(path))
+
+    def list_dir(self, path: str, start: str = "", limit: int = 1 << 20):
+        with self._lock:
+            return list(self._store.list_directory_entries(
+                normalize(path), start_file_name=start, limit=limit))
+
+    def mark_visited(self, dir_path: str) -> None:
+        with self._lock:
+            self._visited.add(normalize(dir_path))
+
+    def is_visited(self, dir_path: str) -> bool:
+        with self._lock:
+            return normalize(dir_path) in self._visited
+
+    def invalidate(self, path: str) -> None:
+        with self._lock:
+            self._visited.discard(normalize(path))
+
+    # -- event application (meta_cache_subscribe.go) -----------------------
+
+    def apply_event(self, resp: filer_pb2.SubscribeMetadataResponse) -> None:
+        ev = resp.event_notification
+        directory = resp.directory
+        old_has = ev.HasField("old_entry")
+        new_has = ev.HasField("new_entry")
+        if old_has:
+            old_path = directory.rstrip("/") + "/" + ev.old_entry.name
+            self.delete(normalize(old_path))
+        if new_has:
+            new_dir = ev.new_parent_path or directory
+            entry = Entry.from_pb(new_dir, ev.new_entry)
+            # only mirror into dirs we have listed; others fetch on demand
+            if self.is_visited(new_dir) or self.find(entry.full_path) is not None:
+                self.update(entry)
+
+    # -- remote subscription ----------------------------------------------
+
+    def subscribe(self, filer_grpc_address: str, *, client_name: str = "mount",
+                  since_ns: int = 0, path_prefix: str = "/") -> None:
+        """Tail the filer's SubscribeMetadata stream in a daemon thread."""
+        def run():
+            stub = rpc.filer_stub(filer_grpc_address)
+            cursor = since_ns
+            while not self._stop.is_set():
+                try:
+                    req = filer_pb2.SubscribeMetadataRequest(
+                        client_name=client_name, path_prefix=path_prefix,
+                        since_ns=cursor)
+                    for resp in stub.SubscribeMetadata(req):
+                        if self._stop.is_set():
+                            return
+                        self.apply_event(resp)
+                        cursor = max(cursor, resp.ts_ns)
+                except Exception:
+                    if self._stop.wait(0.5):
+                        return
+
+        self._thread = threading.Thread(target=run, daemon=True)
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+
+
+__all__ = ["MetaCache", "parent_of"]

@@ -1,0 +1,196 @@
+"""Dirty-page chunked writer + upload pipeline for the mount layer.
+
+Rebuild of /root/reference/weed/mount/page_writer/ (upload_pipeline.go:42
+UploadPipeline, page_chunk_mem.go MemChunk, chunk_interval_list.go) and
+dirty_pages_chunked.go: writes land in fixed-size memory chunks addressed
+by logical chunk index; a chunk that becomes fully written is sealed and
+uploaded in the background; flush seals everything and waits. Reads that
+hit dirty pages are served from memory until the upload completes.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from dataclasses import dataclass, field
+
+
+@dataclass
+class WrittenInterval:
+    start: int  # offsets within the chunk
+    stop: int
+    ts_ns: int
+
+
+class MemChunk:
+    """One chunk-size window of the file held in memory
+    (page_chunk_mem.go)."""
+
+    def __init__(self, logic_index: int, chunk_size: int):
+        self.logic_index = logic_index
+        self.chunk_size = chunk_size
+        self.buf = bytearray(chunk_size)
+        self.intervals: list[WrittenInterval] = []
+
+    def write(self, data: bytes, off_in_chunk: int, ts_ns: int) -> None:
+        self.buf[off_in_chunk:off_in_chunk + len(data)] = data
+        self.intervals.append(
+            WrittenInterval(off_in_chunk, off_in_chunk + len(data), ts_ns))
+
+    def written_size(self) -> int:
+        return sum(e - s for s, e in self.continuous_intervals())
+
+    def is_complete(self) -> bool:
+        ivs = self.continuous_intervals()
+        return ivs == [(0, self.chunk_size)]
+
+    def continuous_intervals(self) -> list[tuple[int, int]]:
+        """Merged written ranges (chunk_interval_list.go)."""
+        out: list[list[int]] = []
+        for iv in sorted(self.intervals, key=lambda i: i.start):
+            if out and iv.start <= out[-1][1]:
+                out[-1][1] = max(out[-1][1], iv.stop)
+            else:
+                out.append([iv.start, iv.stop])
+        return [(s, e) for s, e in out]
+
+    def read_at(self, buf: memoryview, chunk_off: int, min_ts_ns: int = 0
+                ) -> list[tuple[int, int]]:
+        """Copy written bytes overlapping [chunk_off, chunk_off+len(buf))
+        into buf; returns the covered [start, stop) ranges in buf coords."""
+        covered = []
+        for iv in sorted(self.intervals, key=lambda i: i.ts_ns):
+            if iv.ts_ns < min_ts_ns:
+                continue
+            s = max(iv.start, chunk_off)
+            e = min(iv.stop, chunk_off + len(buf))
+            if s >= e:
+                continue
+            buf[s - chunk_off:e - chunk_off] = self.buf[s:e]
+            covered.append((s - chunk_off, e - chunk_off))
+        return covered
+
+
+class UploadPipeline:
+    """Writable chunks -> sealed chunks -> background uploads
+    (upload_pipeline.go:42; SaveDataAt :58, seal-on-full :160).
+
+    save_fn(data: bytes, file_offset: int, ts_ns: int) is called once per
+    continuous interval of each sealed chunk, from worker threads; it is
+    responsible for uploading and recording the resulting FileChunk.
+    """
+
+    def __init__(self, chunk_size: int, save_fn, *, concurrency: int = 8):
+        self.chunk_size = chunk_size
+        self.save_fn = save_fn
+        self._lock = threading.Lock()
+        self._writable: dict[int, MemChunk] = {}
+        self._sealed: dict[int, MemChunk] = {}   # kept for reads in flight
+        self._futures: list[Future] = []
+        self._pool = ThreadPoolExecutor(max_workers=concurrency,
+                                        thread_name_prefix="page-upload")
+        self.last_err: Exception | None = None
+
+    # -- write path --------------------------------------------------------
+
+    def save_data_at(self, data: bytes, offset: int, ts_ns: int) -> None:
+        n = len(data)
+        pos = 0
+        while pos < n:
+            logic = (offset + pos) // self.chunk_size
+            in_chunk = (offset + pos) % self.chunk_size
+            take = min(n - pos, self.chunk_size - in_chunk)
+            with self._lock:
+                chunk = self._writable.get(logic)
+                if chunk is None:
+                    chunk = MemChunk(logic, self.chunk_size)
+                    self._writable[logic] = chunk
+                chunk.write(data[pos:pos + take], in_chunk, ts_ns)
+                if chunk.is_complete():
+                    self._seal_locked(logic)
+            pos += take
+
+    def _seal_locked(self, logic: int) -> None:
+        chunk = self._writable.pop(logic, None)
+        if chunk is None:
+            return
+        self._sealed[logic] = chunk
+        fut = self._pool.submit(self._upload, chunk)
+        self._futures.append(fut)
+
+    def _upload(self, chunk: MemChunk) -> None:
+        base = chunk.logic_index * self.chunk_size
+        try:
+            for s, e in chunk.continuous_intervals():
+                ts = max((iv.ts_ns for iv in chunk.intervals
+                          if iv.start < e and iv.stop > s), default=0)
+                self.save_fn(bytes(chunk.buf[s:e]), base + s, ts)
+        except Exception as err:  # surfaced on flush
+            self.last_err = err
+        finally:
+            with self._lock:
+                if self._sealed.get(chunk.logic_index) is chunk:
+                    del self._sealed[chunk.logic_index]
+
+    # -- read-your-writes --------------------------------------------------
+
+    def maybe_read_data_at(self, buf: memoryview, offset: int
+                           ) -> list[tuple[int, int]]:
+        """Fill buf from dirty pages; returns covered [start, stop) ranges
+        in buf coords (merged, sorted)."""
+        covered: list[tuple[int, int]] = []
+        n = len(buf)
+        pos = 0
+        while pos < n:
+            logic = (offset + pos) // self.chunk_size
+            in_chunk = (offset + pos) % self.chunk_size
+            take = min(n - pos, self.chunk_size - in_chunk)
+            with self._lock:
+                chunks = [c for c in (self._sealed.get(logic),
+                                      self._writable.get(logic))
+                          if c is not None]
+            for c in chunks:
+                for s, e in c.read_at(buf[pos:pos + take], in_chunk):
+                    covered.append((pos + s, pos + e))
+            pos += take
+        covered.sort()
+        merged: list[list[int]] = []
+        for s, e in covered:
+            if merged and s <= merged[-1][1]:
+                merged[-1][1] = max(merged[-1][1], e)
+            else:
+                merged.append([s, e])
+        return [(s, e) for s, e in merged]
+
+    def max_written_offset(self) -> int:
+        """Furthest file offset any dirty page reaches (for getattr size)."""
+        out = 0
+        with self._lock:
+            for group in (self._writable, self._sealed):
+                for logic, c in group.items():
+                    ivs = c.continuous_intervals()
+                    if ivs:
+                        out = max(out, logic * self.chunk_size + ivs[-1][1])
+        return out
+
+    def dirty_size(self) -> int:
+        with self._lock:
+            return sum(c.written_size() for c in self._writable.values())
+
+    # -- flush -------------------------------------------------------------
+
+    def flush(self) -> None:
+        """Seal all writable chunks and wait for every upload
+        (FlushAll)."""
+        with self._lock:
+            for logic in sorted(self._writable):
+                self._seal_locked(logic)
+            futures, self._futures = self._futures, []
+        for f in futures:
+            f.result()
+        if self.last_err is not None:
+            err, self.last_err = self.last_err, None
+            raise err
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False, cancel_futures=True)

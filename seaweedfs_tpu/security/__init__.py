@@ -86,16 +86,18 @@ class Guard:
     expires_sec: int = 10
 
     def _networks(self):
-        nets = []
-        for item in self.whitelist:
-            try:
-                if "/" in item:
-                    nets.append(ipaddress.ip_network(item, strict=False))
-                else:
-                    nets.append(ipaddress.ip_network(item + "/32"))
-            except ValueError:
-                continue
-        return nets
+        if not hasattr(self, "_nets"):
+            nets = []
+            for item in self.whitelist:
+                try:
+                    if "/" in item:
+                        nets.append(ipaddress.ip_network(item, strict=False))
+                    else:
+                        nets.append(ipaddress.ip_network(item + "/32"))
+                except ValueError:
+                    continue
+            self._nets = nets
+        return self._nets
 
     def is_allowed(self, remote_ip: str) -> bool:
         if not self.whitelist:

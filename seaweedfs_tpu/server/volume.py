@@ -858,7 +858,8 @@ def _make_http_handler(srv: VolumeServer):
             if n.last_modified:
                 headers["Last-Modified"] = time.strftime(
                     "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified))
-            ctype = n.mime.decode() if n.mime else "application/octet-stream"
+            stored_mime = n.mime.decode() if n.mime else ""
+            ctype = stored_mime or "application/octet-stream"
             if n.is_compressed:
                 import gzip as _gz
 
@@ -870,7 +871,7 @@ def _make_http_handler(srv: VolumeServer):
             # on-read image transforms (volume_server_handlers_read.go:294)
             q = {k: v[0] for k, v in parse_qs(u.query).items()}
             if ("width" in q or "height" in q) and (
-                    ctype.startswith("image/") or not ctype):
+                    stored_mime.startswith("image/") or not stored_mime):
                 from ..images import resized
 
                 data, _, _ = resized(

@@ -48,6 +48,10 @@ class DiskCache:
         self.capacity = capacity_bytes
         os.makedirs(directory, exist_ok=True)
         self._lock = threading.Lock()
+        self._total = sum(
+            os.stat(os.path.join(directory, n)).st_size
+            for n in os.listdir(directory)
+            if os.path.isfile(os.path.join(directory, n)))
 
     def _path(self, key: str) -> str:
         h = hashlib.sha1(key.encode()).hexdigest()
@@ -62,13 +66,21 @@ class DiskCache:
 
     def put(self, key: str, value: bytes) -> None:
         with self._lock:
-            self._evict_if_needed(len(value))
-            tmp = self._path(key) + ".tmp"
+            if self._total + len(value) > self.capacity:
+                self._evict(len(value))
+            path = self._path(key)
+            try:
+                self._total -= os.stat(path).st_size  # overwrite
+            except FileNotFoundError:
+                pass
+            tmp = path + ".tmp"
             with open(tmp, "wb") as f:
                 f.write(value)
-            os.replace(tmp, self._path(key))
+            os.replace(tmp, path)
+            self._total += len(value)
 
-    def _evict_if_needed(self, incoming: int) -> None:
+    def _evict(self, incoming: int) -> None:
+        """LRU-by-atime scan; only runs once the running total overflows."""
         entries = []
         total = 0
         for name in os.listdir(self.dir):
@@ -87,6 +99,7 @@ class DiskCache:
             except FileNotFoundError:
                 pass
             total -= size
+        self._total = total
 
 
 class TieredChunkCache:

@@ -1,0 +1,273 @@
+"""Mount layer tests: inode map, page writer, and WFS over a live
+in-process cluster (SURVEY.md §2.6 FUSE mount, §3.6 FUSE write path)."""
+
+import socket
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.mount import (
+    ROOT_INODE,
+    WFS,
+    FuseError,
+    InodeToPath,
+    MemChunk,
+    UploadPipeline,
+)
+from seaweedfs_tpu.pb import rpc
+from seaweedfs_tpu.server.filer import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+# -- inode map -------------------------------------------------------------
+
+def test_inode_to_path_lookup_forget():
+    m = InodeToPath()
+    a = m.lookup("/a", True)
+    b = m.lookup("/a/b")
+    assert a != b and a != ROOT_INODE
+    assert m.get_path(b) == "/a/b"
+    assert m.lookup("/a/b") == b  # second lookup bumps refcount
+    m.forget(b)  # one ref remains
+    assert m.get_path(b) == "/a/b"
+    m.forget(b)
+    with pytest.raises(KeyError):
+        m.get_path(b)
+
+
+def test_inode_to_path_rename_moves_subtree():
+    m = InodeToPath()
+    d = m.lookup("/d", True)
+    f = m.lookup("/d/f")
+    m.move_path("/d", "/e")
+    assert m.get_path(d) == "/e"
+    assert m.get_path(f) == "/e/f"
+    assert m.get_inode("/d/f") is None
+
+
+def test_inode_hard_links_share_inode():
+    m = InodeToPath()
+    f = m.lookup("/f")
+    m.add_path(f, "/g")
+    assert m.get_inode("/g") == f
+    m.remove_path("/f")
+    assert m.get_path(f) == "/g"  # inode survives while a link remains
+
+
+# -- page writer -----------------------------------------------------------
+
+def test_mem_chunk_intervals_and_read():
+    c = MemChunk(0, 100)
+    c.write(b"a" * 10, 0, 1)
+    c.write(b"b" * 10, 20, 2)
+    assert c.continuous_intervals() == [(0, 10), (20, 30)]
+    assert not c.is_complete()
+    c.write(b"c" * 100, 0, 3)
+    assert c.is_complete()
+    buf = memoryview(bytearray(5))
+    c.read_at(buf, 18)
+    assert bytes(buf) == b"ccccc"
+
+
+def test_upload_pipeline_seal_flush_and_read_back():
+    saved = []
+
+    def save(data, offset, ts):
+        saved.append((offset, data))
+
+    p = UploadPipeline(16, save, concurrency=2)
+    p.save_data_at(b"x" * 16, 0, 1)     # full chunk -> sealed immediately
+    p.save_data_at(b"y" * 5, 16, 2)     # partial, flushed later
+    p.save_data_at(b"z" * 3, 30, 3)     # second interval in chunk 1
+    buf = memoryview(bytearray(8))
+    covered = p.maybe_read_data_at(buf, 14)
+    assert covered and covered[0] == (0, 7)
+    assert bytes(buf[:7]) == b"xxyyyyy"
+    p.flush()
+    # the 3-byte write at 30 straddles the chunk-1/chunk-2 boundary
+    assert sorted(saved) == [(0, b"x" * 16), (16, b"y" * 5),
+                             (30, b"zz"), (32, b"z")]
+    p.close()
+
+
+def test_upload_pipeline_overlapping_writes_latest_wins():
+    saved = {}
+
+    def save(data, offset, ts):
+        saved[offset] = data
+
+    p = UploadPipeline(64, save, concurrency=1)
+    p.save_data_at(b"a" * 10, 0, 1)
+    p.save_data_at(b"B" * 4, 3, 2)
+    p.flush()
+    assert saved[0] == b"aaaBBBBaaa"
+    p.close()
+
+
+# -- live cluster ----------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def wfs(tmp_path_factory):
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport, volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("vol"))],
+        master=f"localhost:{mport}", ip="localhost", port=_free_port(),
+        pulse_seconds=1)
+    vsrv.start()
+    fsrv = FilerServer(ip="localhost", port=_free_port(),
+                       master=f"localhost:{mport}",
+                       store_dir=str(tmp_path_factory.mktemp("filer")),
+                       chunk_size=64 * 1024)
+    fsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    w = WFS(rpc.grpc_address(fsrv.address), chunk_size=32 * 1024)
+    yield w
+    w.close()
+    fsrv.stop()
+    vsrv.stop()
+    master.stop()
+    rpc.reset_channels()
+
+
+def test_wfs_mkdir_create_write_read(wfs):
+    dino, _ = wfs.mkdir(ROOT_INODE, "docs")
+    ino, entry, fh = wfs.create(dino, "hello.txt", 0o644)
+    wfs.write(fh, 0, b"hello ")
+    wfs.write(fh, 6, b"world")
+    assert wfs.read(fh, 0, 100) == b"hello world"  # read-your-writes
+    wfs.flush(fh)
+    wfs.release(fh)
+    # fresh handle reads from volume servers through the chunk cache
+    fh2 = wfs.open(ino)
+    assert wfs.read(fh2, 0, 100) == b"hello world"
+    assert wfs.read(fh2, 6, 5) == b"world"
+    wfs.release(fh2)
+    e = wfs.getattr(ino)
+    assert e.size() == 11
+
+
+def test_wfs_multi_chunk_file(wfs):
+    rng = np.random.default_rng(0)
+    payload = rng.integers(0, 256, size=100_000, dtype=np.uint8).tobytes()
+    dino, _ = wfs.mkdir(ROOT_INODE, "big")
+    ino, _, fh = wfs.create(dino, "blob", 0o644)
+    # write out of order in odd-sized pieces (chunk_size is 32k)
+    for off in range(0, len(payload), 7001):
+        wfs.write(fh, off, payload[off:off + 7001])
+    wfs.flush(fh)
+    wfs.release(fh)
+    fh = wfs.open(ino)
+    assert wfs.read(fh, 0, len(payload)) == payload
+    assert wfs.read(fh, 40_000, 1000) == payload[40_000:41_000]
+    wfs.release(fh)
+
+
+def test_wfs_readdir_rename_unlink(wfs):
+    dino, _ = wfs.mkdir(ROOT_INODE, "work")
+    for name in ("a", "b", "c"):
+        _, _, fh = wfs.create(dino, name)
+        wfs.write(fh, 0, name.encode())
+        wfs.flush(fh)
+        wfs.release(fh)
+    names = sorted(e.name for e in wfs.readdir(dino))
+    assert names == ["a", "b", "c"]
+    wfs.rename(dino, "a", dino, "a2")
+    names = sorted(e.name for e in wfs.readdir(dino))
+    assert names == ["a2", "b", "c"]
+    ino = wfs.path_inode("/work/a2")
+    fh = wfs.open(ino)
+    assert wfs.read(fh, 0, 10) == b"a"
+    wfs.release(fh)
+    wfs.unlink(dino, "b")
+    with pytest.raises(FuseError):
+        wfs.lookup(dino, "b")
+
+
+def test_wfs_truncate(wfs):
+    dino, _ = wfs.mkdir(ROOT_INODE, "trunc")
+    ino, _, fh = wfs.create(dino, "f")
+    wfs.write(fh, 0, b"0123456789")
+    wfs.flush(fh)
+    wfs.release(fh)
+    wfs.setattr(ino, size=4)
+    fh = wfs.open(ino)
+    assert wfs.read(fh, 0, 10) == b"0123"
+    wfs.release(fh)
+
+
+def test_wfs_symlink_xattr(wfs):
+    dino, _ = wfs.mkdir(ROOT_INODE, "meta")
+    ino, _ = wfs.symlink(dino, "lnk", "/meta/target")
+    assert wfs.readlink(ino) == "/meta/target"
+    fino, _, fh = wfs.create(dino, "file")
+    wfs.flush(fh)
+    wfs.release(fh)
+    wfs.setxattr(fino, "user.tag", b"v1")
+    assert wfs.getxattr(fino, "user.tag") == b"v1"
+    assert wfs.listxattr(fino) == ["user.tag"]
+    wfs.removexattr(fino, "user.tag")
+    with pytest.raises(FuseError):
+        wfs.getxattr(fino, "user.tag")
+
+
+def test_wfs_hard_link(wfs):
+    dino, _ = wfs.mkdir(ROOT_INODE, "links")
+    ino, _, fh = wfs.create(dino, "orig")
+    wfs.write(fh, 0, b"payload")
+    wfs.flush(fh)
+    wfs.release(fh)
+    lino, linked = wfs.link(ino, dino, "alias")
+    assert lino == ino
+    fh = wfs.open(wfs.path_inode("/links/alias"))
+    assert wfs.read(fh, 0, 10) == b"payload"
+    wfs.release(fh)
+
+
+def test_wfs_rmdir_nonempty_fails(wfs):
+    dino, _ = wfs.mkdir(ROOT_INODE, "full")
+    _, _, fh = wfs.create(dino, "kid")
+    wfs.flush(fh)
+    wfs.release(fh)
+    with pytest.raises(FuseError):  # POSIX: ENOTEMPTY, never recursive
+        wfs.rmdir(ROOT_INODE, "full")
+    assert wfs.path_inode("/full/kid")  # child survived
+
+
+def test_wfs_rename_with_open_handle(wfs):
+    dino, _ = wfs.mkdir(ROOT_INODE, "rn")
+    ino, _, fh = wfs.create(dino, "before")
+    wfs.write(fh, 0, b"first")
+    wfs.rename(dino, "before", dino, "after")
+    wfs.write(fh, 5, b"+more")  # written after the rename
+    wfs.flush(fh)
+    wfs.release(fh)
+    fh2 = wfs.open(wfs.path_inode("/rn/after"))
+    assert wfs.read(fh2, 0, 20) == b"first+more"
+    wfs.release(fh2)
+
+
+def test_wfs_getattr_includes_dirty_size(wfs):
+    dino, _ = wfs.mkdir(ROOT_INODE, "dirty")
+    ino, _, fh = wfs.create(dino, "f")
+    wfs.write(fh, 0, b"x" * 1000)  # buffered, not yet uploaded
+    e = wfs.getattr(ino)
+    assert wfs.entry_size(ino, e) == 1000
+    wfs.flush(fh)
+    wfs.release(fh)
+
+
+def test_wfs_statfs(wfs):
+    st = wfs.statfs()
+    assert st["total"] >= 0
