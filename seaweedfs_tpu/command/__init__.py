@@ -80,6 +80,19 @@ def main(argv=None) -> int:
     bp.add_argument("-write", dest="do_write", action="store_true", default=True)
     bp.add_argument("-skipRead", action="store_true")
 
+    wd = sub.add_parser("webdav", help="run a WebDAV gateway")
+    wd.add_argument("-port", type=int, default=7333)
+    wd.add_argument("-filer", default="localhost:8888")
+    wd.add_argument("-filer.path", dest="filer_path", default="/")
+
+    ip_ = sub.add_parser("iam", help="run an IAM API server")
+    ip_.add_argument("-port", type=int, default=8111)
+    ip_.add_argument("-filer", default="localhost:8888")
+
+    mqp = sub.add_parser("mq.broker", help="run a message-queue broker")
+    mqp.add_argument("-filer", default="localhost:8888")
+    mqp.add_argument("-port", type=int, default=17777)
+
     mnt = sub.add_parser("mount", help="FUSE-mount a filer path")
     mnt.add_argument("-filer", default="localhost:8888")
     mnt.add_argument("-dir", required=True, help="mount point")
@@ -235,6 +248,37 @@ def _run(opts) -> int:
         from .benchmark import run_benchmark
 
         run_benchmark(opts)
+        return 0
+
+    if opts.cmd == "webdav":
+        from ..server.webdav import WebDavServer
+
+        wd = WebDavServer(port=opts.port, filer=opts.filer,
+                          base_dir=opts.filer_path)
+        wd.start()
+        _wait_forever()
+        wd.stop()
+        return 0
+
+    if opts.cmd == "iam":
+        from ..iamapi import IamServer
+
+        iam = IamServer(port=opts.port, filer=opts.filer)
+        iam.start()
+        _wait_forever()
+        iam.stop()
+        return 0
+
+    if opts.cmd == "mq.broker":
+        from ..mq import Broker, MqHttpServer
+
+        broker = Broker(filer=opts.filer)
+        broker.load_from_filer()
+        http = MqHttpServer(broker, port=opts.port)
+        http.start()
+        _wait_forever()
+        http.stop()
+        broker.flush_to_filer()
         return 0
 
     if opts.cmd == "mount":

@@ -1,0 +1,31 @@
+"""FTP gateway stub.
+
+Parity with /root/reference/weed/ftpd/ (81 LoC): the reference wires
+fclairamb/ftpserverlib but ships as a work-in-progress stub; this build
+mirrors that status. No FTP server library is baked into this image, so
+`FtpServer.start` raises with guidance toward the working frontends.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+
+@dataclass
+class FtpServerOptions:
+    port: int = 8021
+    filer: str = "localhost:8888"
+    passive_port_start: int = 30000
+    passive_port_stop: int = 30100
+
+
+class FtpServer:
+    """Placeholder matching weed/ftpd/ftpd.go's WIP server."""
+
+    def __init__(self, options: FtpServerOptions | None = None):
+        self.options = options or FtpServerOptions()
+
+    def start(self) -> None:
+        raise NotImplementedError(
+            "the FTP gateway is a stub (the reference's weed/ftpd is too); "
+            "use the S3, WebDAV, HTTP filer, or mount frontends")

@@ -1,0 +1,366 @@
+"""IAM API gateway: AWS IAM-compatible endpoints managing S3 identities.
+
+Rebuild of /root/reference/weed/iamapi/ (iamapi_server.go,
+iamapi_management_handlers.go): a form-encoded `Action=` query API whose
+state is the S3 identity list, persisted in the filer at
+/etc/iam/identity.json (the reference keeps the same path) and pushed
+live into an attached S3 gateway's IdentityAccessManagement.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..pb import filer_pb2, rpc
+from ..s3api.auth import Identity
+from ..utils import glog
+
+IAM_CONFIG_DIR = "/etc/iam"
+IAM_CONFIG_FILE = "identity.json"
+
+# s3 policy action -> identity action verb (policy mapping in
+# iamapi_management_handlers.go GetActions)
+_POLICY_ACTIONS = {
+    "s3:GetObject": "Read",
+    "s3:ListBucket": "List",
+    "s3:PutObject": "Write",
+    "s3:DeleteObject": "Write",
+    "s3:PutObjectTagging": "Tagging",
+    "s3:GetObjectTagging": "Read",
+    "s3:*": "Admin",
+    "*": "Admin",
+}
+
+
+class IamConfigStore:
+    """Identities <-> /etc/iam/identity.json in the filer."""
+
+    def __init__(self, filer: str):
+        self.filer = filer
+
+    @property
+    def _stub(self):
+        return rpc.filer_stub(rpc.grpc_address(self.filer))
+
+    def load(self) -> list[Identity]:
+        try:
+            resp = self._stub.LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory=IAM_CONFIG_DIR, name=IAM_CONFIG_FILE),
+                timeout=10)
+        except Exception:
+            return []
+        if not resp.entry.content:
+            return []
+        conf = json.loads(resp.entry.content)
+        out = []
+        for ident in conf.get("identities", []):
+            creds = (ident.get("credentials") or [{}])[0]
+            out.append(Identity(
+                name=ident.get("name", ""),
+                access_key=creds.get("accessKey", ""),
+                secret_key=creds.get("secretKey", ""),
+                actions=ident.get("actions", [])))
+        return out
+
+    def save(self, identities: list[Identity]) -> None:
+        conf = {"identities": [
+            {"name": i.name,
+             "credentials": [{"accessKey": i.access_key,
+                              "secretKey": i.secret_key}],
+             "actions": i.actions}
+            for i in identities]}
+        entry = filer_pb2.Entry(name=IAM_CONFIG_FILE,
+                                content=json.dumps(conf, indent=2).encode())
+        entry.attributes.file_mode = 0o600
+        entry.attributes.mtime = int(time.time())
+        stub = self._stub
+        # CreateEntry upserts in our filer; parents are auto-created
+        stub.CreateEntry(filer_pb2.CreateEntryRequest(
+            directory=IAM_CONFIG_DIR, entry=entry), timeout=10)
+
+
+class IamServer:
+    def __init__(self, *, port: int = 8111, filer: str = "localhost:8888",
+                 s3_server=None):
+        self.port = port
+        self.store = IamConfigStore(filer)
+        self.s3_server = s3_server
+        self._lock = threading.Lock()
+        self.identities: list[Identity] = self.store.load()
+        self._httpd: ThreadingHTTPServer | None = None
+
+    def start(self) -> None:
+        self._httpd = ThreadingHTTPServer(("", self.port),
+                                          _make_handler(self))
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+        glog.info(f"iam api server on :{self.port}")
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+
+    # -- state mutation ----------------------------------------------------
+
+    def _persist(self) -> None:
+        self.store.save(self.identities)
+        if self.s3_server is not None:
+            self.s3_server.iam.identities = {
+                i.access_key: i for i in self.identities if i.access_key}
+
+    def _find(self, user: str) -> Identity | None:
+        for i in self.identities:
+            if i.name == user:
+                return i
+        return None
+
+    # -- actions (iamapi_management_handlers.go) ---------------------------
+
+    def do_action(self, params: dict[str, str]) -> ET.Element:
+        action = params.get("Action", "")
+        fn = getattr(self, f"_do_{action}", None)
+        if fn is None:
+            raise IamError("InvalidAction", f"unknown action {action!r}")
+        with self._lock:
+            return fn(params)
+
+    def _do_CreateUser(self, p):
+        name = p.get("UserName", "")
+        if not name:
+            raise IamError("InvalidInput", "missing UserName")
+        if self._find(name) is not None:
+            raise IamError("EntityAlreadyExists", name)
+        self.identities.append(Identity(name=name, access_key="",
+                                        secret_key="", actions=[]))
+        self._persist()
+        root = _result("CreateUser")
+        user = ET.SubElement(_member(root, "CreateUserResult"), "User")
+        ET.SubElement(user, "UserName").text = name
+        return root
+
+    def _do_GetUser(self, p):
+        name = p.get("UserName", "")
+        ident = self._find(name)
+        if ident is None:
+            raise IamError("NoSuchEntity", name)
+        root = _result("GetUser")
+        user = ET.SubElement(_member(root, "GetUserResult"), "User")
+        ET.SubElement(user, "UserName").text = ident.name
+        return root
+
+    def _do_ListUsers(self, p):
+        root = _result("ListUsers")
+        res = _member(root, "ListUsersResult")
+        users = ET.SubElement(res, "Users")
+        for ident in self.identities:
+            m = ET.SubElement(users, "member")
+            ET.SubElement(m, "UserName").text = ident.name
+        ET.SubElement(res, "IsTruncated").text = "false"
+        return root
+
+    def _do_DeleteUser(self, p):
+        name = p.get("UserName", "")
+        ident = self._find(name)
+        if ident is None:
+            raise IamError("NoSuchEntity", name)
+        self.identities.remove(ident)
+        self._persist()
+        return _result("DeleteUser")
+
+    def _do_UpdateUser(self, p):
+        name = p.get("UserName", "")
+        new_name = p.get("NewUserName", "")
+        ident = self._find(name)
+        if ident is None:
+            raise IamError("NoSuchEntity", name)
+        if new_name:
+            ident.name = new_name
+            self._persist()
+        return _result("UpdateUser")
+
+    def _do_CreateAccessKey(self, p):
+        import secrets
+
+        name = p.get("UserName", "")
+        ident = self._find(name)
+        if ident is None:
+            ident = Identity(name=name, access_key="", secret_key="",
+                             actions=[])
+            self.identities.append(ident)
+        ident.access_key = secrets.token_hex(8).upper()
+        ident.secret_key = secrets.token_urlsafe(24)
+        self._persist()
+        root = _result("CreateAccessKey")
+        key = ET.SubElement(_member(root, "CreateAccessKeyResult"),
+                            "AccessKey")
+        ET.SubElement(key, "UserName").text = name
+        ET.SubElement(key, "AccessKeyId").text = ident.access_key
+        ET.SubElement(key, "SecretAccessKey").text = ident.secret_key
+        ET.SubElement(key, "Status").text = "Active"
+        return root
+
+    def _do_DeleteAccessKey(self, p):
+        key_id = p.get("AccessKeyId", "")
+        for ident in self.identities:
+            if ident.access_key == key_id:
+                ident.access_key = ""
+                ident.secret_key = ""
+                self._persist()
+                break
+        return _result("DeleteAccessKey")
+
+    def _do_ListAccessKeys(self, p):
+        name = p.get("UserName", "")
+        root = _result("ListAccessKeys")
+        res = _member(root, "ListAccessKeysResult")
+        keys = ET.SubElement(res, "AccessKeyMetadata")
+        for ident in self.identities:
+            if name and ident.name != name:
+                continue
+            if not ident.access_key:
+                continue
+            m = ET.SubElement(keys, "member")
+            ET.SubElement(m, "UserName").text = ident.name
+            ET.SubElement(m, "AccessKeyId").text = ident.access_key
+            ET.SubElement(m, "Status").text = "Active"
+        return root
+
+    def _do_PutUserPolicy(self, p):
+        name = p.get("UserName", "")
+        ident = self._find(name)
+        if ident is None:
+            raise IamError("NoSuchEntity", name)
+        # parse_qs in do_POST already percent-decoded the form field
+        doc = json.loads(p.get("PolicyDocument", "{}"))
+        ident.actions = _policy_to_actions(doc)
+        self._persist()
+        return _result("PutUserPolicy")
+
+    def _do_GetUserPolicy(self, p):
+        name = p.get("UserName", "")
+        ident = self._find(name)
+        if ident is None:
+            raise IamError("NoSuchEntity", name)
+        root = _result("GetUserPolicy")
+        res = _member(root, "GetUserPolicyResult")
+        ET.SubElement(res, "UserName").text = name
+        ET.SubElement(res, "PolicyName").text = p.get("PolicyName", "")
+        ET.SubElement(res, "PolicyDocument").text = json.dumps(
+            _actions_to_policy(ident.actions))
+        return root
+
+    def _do_DeleteUserPolicy(self, p):
+        name = p.get("UserName", "")
+        ident = self._find(name)
+        if ident is None:
+            raise IamError("NoSuchEntity", name)
+        ident.actions = []
+        self._persist()
+        return _result("DeleteUserPolicy")
+
+
+class IamError(Exception):
+    def __init__(self, code: str, message: str):
+        super().__init__(message)
+        self.code = code
+
+
+def _result(action: str) -> ET.Element:
+    root = ET.Element(f"{action}Response")
+    root.set("xmlns", "https://iam.amazonaws.com/doc/2010-05-08/")
+    meta = ET.SubElement(root, "ResponseMetadata")
+    ET.SubElement(meta, "RequestId").text = f"{time.time_ns():x}"
+    return root
+
+
+def _member(root: ET.Element, name: str) -> ET.Element:
+    return ET.SubElement(root, name)
+
+
+def _policy_to_actions(doc: dict) -> list[str]:
+    actions: list[str] = []
+    for stmt in doc.get("Statement", []):
+        if stmt.get("Effect") != "Allow":
+            continue
+        acts = stmt.get("Action", [])
+        if isinstance(acts, str):
+            acts = [acts]
+        resources = stmt.get("Resource", [])
+        if isinstance(resources, str):
+            resources = [resources]
+        buckets = []
+        for r in resources:
+            b = r.removeprefix("arn:aws:s3:::")
+            b = b.split("/", 1)[0]
+            if b and b != "*":
+                buckets.append(b)
+        for a in acts:
+            verb = _POLICY_ACTIONS.get(a)
+            if verb is None:
+                continue
+            if verb == "Admin" or not buckets:
+                if verb not in actions:
+                    actions.append(verb)
+            else:
+                for b in buckets:
+                    scoped = f"{verb}:{b}"
+                    if scoped not in actions:
+                        actions.append(scoped)
+    return actions
+
+
+def _actions_to_policy(actions: list[str]) -> dict:
+    # canonical s3 action per verb (dict inversion would be last-key-wins)
+    inverse = {"Read": "s3:GetObject", "Write": "s3:PutObject",
+               "List": "s3:ListBucket", "Tagging": "s3:PutObjectTagging",
+               "Admin": "s3:*"}
+    statements = []
+    for a in actions:
+        verb, _, bucket = a.partition(":")
+        statements.append({
+            "Effect": "Allow",
+            "Action": [inverse.get(verb, "s3:*")],
+            "Resource": [f"arn:aws:s3:::{bucket or '*'}/*"],
+        })
+    return {"Version": "2012-10-17", "Statement": statements}
+
+
+def _make_handler(srv: IamServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            glog.v(2, f"iam {fmt % args}")
+
+        def do_POST(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(n).decode()
+            params = {k: v[0] for k, v in
+                      urllib.parse.parse_qs(body).items()}
+            try:
+                root = srv.do_action(params)
+                out = ET.tostring(root, xml_declaration=True,
+                                  encoding="utf-8")
+                code = 200
+            except IamError as e:
+                err = ET.Element("ErrorResponse")
+                error = ET.SubElement(err, "Error")
+                ET.SubElement(error, "Code").text = e.code
+                ET.SubElement(error, "Message").text = str(e)
+                out = ET.tostring(err, xml_declaration=True,
+                                  encoding="utf-8")
+                code = 409 if e.code == "EntityAlreadyExists" else 404 \
+                    if e.code == "NoSuchEntity" else 400
+            self.send_response(code)
+            self.send_header("Content-Type", "text/xml")
+            self.send_header("Content-Length", str(len(out)))
+            self.end_headers()
+            self.wfile.write(out)
+
+    return Handler

@@ -1,0 +1,319 @@
+"""Message queue broker.
+
+Rebuild of /root/reference/weed/mq/ (broker + segment serde; the reference
+is an in-progress broker, 671 LoC). Topics are partitioned append logs:
+publish appends (key, value, ts) records to a partition segment; subscribe
+replays from an offset and then tails. Segments persist through the filer
+under /topics/<namespace>/<topic>/<partition>/ the same way the reference
+lays out its topic files.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import struct
+import threading
+import time
+from dataclasses import dataclass, field
+
+SEGMENT_SOFT_BYTES = 4 * 1024 * 1024
+
+
+@dataclass(frozen=True)
+class TopicRef:
+    namespace: str
+    name: str
+
+    def __str__(self) -> str:
+        return f"{self.namespace}.{self.name}"
+
+
+@dataclass
+class Record:
+    key: bytes
+    value: bytes
+    ts_ns: int
+    offset: int = 0
+
+    def encode(self) -> bytes:
+        """length-prefixed (key, value, ts) wire form (segment serde,
+        weed/mq/segment/message_serde.go)."""
+        return struct.pack("<qII", self.ts_ns, len(self.key),
+                           len(self.value)) + self.key + self.value
+
+    @classmethod
+    def decode_stream(cls, blob: bytes) -> list["Record"]:
+        out = []
+        pos = 0
+        while pos + 16 <= len(blob):
+            ts, klen, vlen = struct.unpack_from("<qII", blob, pos)
+            pos += 16
+            key = blob[pos:pos + klen]
+            pos += klen
+            value = blob[pos:pos + vlen]
+            pos += vlen
+            out.append(cls(key=key, value=value, ts_ns=ts))
+        return out
+
+
+class Partition:
+    def __init__(self, index: int):
+        self.index = index
+        self.records: list[Record] = []
+        self.cond = threading.Condition()
+
+    def append(self, rec: Record) -> int:
+        with self.cond:
+            rec.offset = len(self.records)
+            self.records.append(rec)
+            self.cond.notify_all()
+            return rec.offset
+
+    def read(self, offset: int, max_records: int = 1024,
+             timeout: float = 0.0) -> list[Record]:
+        with self.cond:
+            if offset >= len(self.records) and timeout > 0:
+                self.cond.wait(timeout)
+            return self.records[offset:offset + max_records]
+
+
+class Topic:
+    def __init__(self, ref: TopicRef, partition_count: int = 1):
+        self.ref = ref
+        self.partitions = [Partition(i) for i in range(partition_count)]
+        self.created_ns = time.time_ns()
+
+    def route(self, key: bytes) -> Partition:
+        if len(self.partitions) == 1:
+            return self.partitions[0]
+        h = int.from_bytes(hashlib.sha1(key).digest()[:4], "big")
+        return self.partitions[h % len(self.partitions)]
+
+
+class Broker:
+    """In-process broker (weed/mq/broker). Thread-safe."""
+
+    def __init__(self, filer: str | None = None):
+        self.filer = filer
+        self._topics: dict[TopicRef, Topic] = {}
+        self._lock = threading.Lock()
+
+    # -- topic lifecycle ---------------------------------------------------
+
+    def create_topic(self, namespace: str, name: str,
+                     partition_count: int = 1) -> Topic:
+        ref = TopicRef(namespace, name)
+        with self._lock:
+            if ref in self._topics:
+                return self._topics[ref]
+            t = Topic(ref, partition_count)
+            self._topics[ref] = t
+            return t
+
+    def topic(self, namespace: str, name: str) -> Topic | None:
+        return self._topics.get(TopicRef(namespace, name))
+
+    def list_topics(self) -> list[dict]:
+        with self._lock:
+            return [{"namespace": r.namespace, "name": r.name,
+                     "partitions": len(t.partitions),
+                     "records": sum(len(p.records) for p in t.partitions)}
+                    for r, t in sorted(self._topics.items(),
+                                       key=lambda kv: str(kv[0]))]
+
+    def delete_topic(self, namespace: str, name: str) -> bool:
+        with self._lock:
+            return self._topics.pop(TopicRef(namespace, name), None) \
+                is not None
+
+    # -- data plane --------------------------------------------------------
+
+    def publish(self, namespace: str, name: str, key: bytes,
+                value: bytes) -> int:
+        t = self.topic(namespace, name)
+        if t is None:
+            t = self.create_topic(namespace, name)
+        rec = Record(key=key, value=value, ts_ns=time.time_ns())
+        return t.route(key).append(rec)
+
+    def subscribe(self, namespace: str, name: str, *, partition: int = 0,
+                  offset: int = 0, poll_timeout: float = 0.1):
+        """Generator of records from `offset`, then tailing."""
+        t = self.topic(namespace, name)
+        if t is None:
+            raise KeyError(f"no topic {namespace}.{name}")
+        p = t.partitions[partition]
+        while True:
+            batch = p.read(offset, timeout=poll_timeout)
+            if not batch:
+                yield None  # caller decides to keep polling or stop
+                continue
+            for rec in batch:
+                yield rec
+            offset = batch[-1].offset + 1
+
+    # -- persistence through the filer (topic file layout) -----------------
+
+    def flush_to_filer(self) -> int:
+        """Write each partition's log as a segment file under
+        /topics/<ns>/<topic>/<partition>/segment; returns files written."""
+        if not self.filer:
+            return 0
+        from ..pb import filer_pb2, rpc
+
+        stub = rpc.filer_stub(rpc.grpc_address(self.filer))
+        wrote = 0
+        with self._lock:
+            topics = dict(self._topics)
+        for ref, t in topics.items():
+            for p in t.partitions:
+                with p.cond:
+                    blob = b"".join(r.encode() for r in p.records)
+                if not blob:
+                    continue
+                entry = filer_pb2.Entry(name="segment", content=blob)
+                entry.attributes.file_mode = 0o644
+                entry.attributes.mtime = int(time.time())
+                stub.CreateEntry(filer_pb2.CreateEntryRequest(
+                    directory=f"/topics/{ref.namespace}/{ref.name}/"
+                              f"{p.index:04d}",
+                    entry=entry), timeout=30)
+                wrote += 1
+        return wrote
+
+    def load_from_filer(self) -> int:
+        """Rehydrate topics from /topics/...; returns records loaded."""
+        if not self.filer:
+            return 0
+        from ..pb import filer_pb2, rpc
+
+        stub = rpc.filer_stub(rpc.grpc_address(self.filer))
+
+        def listdir(d):
+            try:
+                return [r.entry for r in stub.ListEntries(
+                    filer_pb2.ListEntriesRequest(directory=d, limit=10000))]
+            except Exception:
+                return []
+
+        loaded = 0
+        for ns in listdir("/topics"):
+            if not ns.is_directory:
+                continue
+            for tp in listdir(f"/topics/{ns.name}"):
+                if not tp.is_directory:
+                    continue
+                parts = [p for p in listdir(f"/topics/{ns.name}/{tp.name}")
+                         if p.is_directory]
+                topic = self.create_topic(ns.name, tp.name,
+                                          max(1, len(parts)))
+                for i, part in enumerate(sorted(parts,
+                                                key=lambda e: e.name)):
+                    seg = [e for e in listdir(
+                        f"/topics/{ns.name}/{tp.name}/{part.name}")
+                        if e.name == "segment"]
+                    if not seg:
+                        continue
+                    for rec in Record.decode_stream(seg[0].content):
+                        topic.partitions[i].append(rec)
+                        loaded += 1
+        return loaded
+
+
+def topic_list_json(broker: Broker) -> str:
+    return json.dumps({"topics": broker.list_topics()}, indent=2)
+
+
+class MqHttpServer:
+    """HTTP surface for the broker (the reference's broker speaks gRPC;
+    same operations, simpler wire):
+
+      GET    /topics                         -> topic list JSON
+      POST   /topics/<ns>/<name>             -> publish body (X-Mq-Key hdr)
+      GET    /topics/<ns>/<name>?partition=N&offset=M -> read batch JSON
+      DELETE /topics/<ns>/<name>             -> drop topic
+    """
+
+    def __init__(self, broker: Broker, *, port: int = 17777):
+        self.broker = broker
+        self.port = port
+        self._httpd = None
+
+    def start(self) -> None:
+        import threading
+        from http.server import (
+            BaseHTTPRequestHandler,
+            ThreadingHTTPServer,
+        )
+
+        broker = self.broker
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, fmt, *args):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _topic_parts(self):
+                parts = self.path.split("?", 1)[0].strip("/").split("/")
+                return parts
+
+            def do_GET(self):
+                parts = self._topic_parts()
+                if parts == ["topics"]:
+                    return self._json({"topics": broker.list_topics()})
+                if len(parts) == 3 and parts[0] == "topics":
+                    from urllib.parse import parse_qs, urlparse
+
+                    q = {k: v[0] for k, v in parse_qs(
+                        urlparse(self.path).query).items()}
+                    t = broker.topic(parts[1], parts[2])
+                    if t is None:
+                        return self._json({"error": "no such topic"}, 404)
+                    pi = int(q.get("partition", 0))
+                    if pi >= len(t.partitions):
+                        return self._json({"error": "no such partition"},
+                                          404)
+                    recs = t.partitions[pi].read(int(q.get("offset", 0)))
+                    return self._json({"records": [
+                        {"offset": r.offset, "ts_ns": r.ts_ns,
+                         "key": r.key.decode(errors="replace"),
+                         "value": r.value.decode(errors="replace")}
+                        for r in recs]})
+                self._json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                parts = self._topic_parts()
+                if len(parts) != 3 or parts[0] != "topics":
+                    return self._json({"error": "POST /topics/<ns>/<name>"},
+                                      404)
+                n = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(n)
+                key = (self.headers.get("X-Mq-Key") or "").encode()
+                off = broker.publish(parts[1], parts[2], key, body)
+                self._json({"offset": off})
+
+            def do_DELETE(self):
+                parts = self._topic_parts()
+                if len(parts) == 3 and parts[0] == "topics":
+                    ok = broker.delete_topic(parts[1], parts[2])
+                    return self._json({"deleted": ok},
+                                      200 if ok else 404)
+                self._json({"error": "not found"}, 404)
+
+        self._httpd = ThreadingHTTPServer(("", self.port), Handler)
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()

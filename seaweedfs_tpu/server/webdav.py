@@ -1,0 +1,308 @@
+"""WebDAV gateway over the filer.
+
+Rebuild of /root/reference/weed/server/webdav_server.go (which wraps
+golang.org/x/net/webdav around a filer-backed FileSystem). Here the DAV
+wire protocol is implemented directly: PROPFIND/MKCOL/COPY/MOVE against
+the filer gRPC API, GET/PUT/DELETE proxied through the filer HTTP data
+plane (which already chunks bodies). LOCK/UNLOCK return fake tokens the
+way most minimal DAV servers do — macOS/Windows clients require them.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import urllib.parse
+import xml.etree.ElementTree as ET
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import requests
+
+from ..pb import filer_pb2, rpc
+from ..utils import glog
+
+DAV_NS = "DAV:"
+
+
+def _dav(tag: str) -> str:
+    return f"{{{DAV_NS}}}{tag}"
+
+
+class WebDavServer:
+    def __init__(self, *, port: int = 7333, filer: str = "localhost:8888",
+                 base_dir: str = "/"):
+        self.port = port
+        self.filer = filer
+        self.base_dir = base_dir.rstrip("/") or ""
+        self._httpd: ThreadingHTTPServer | None = None
+        self._thread: threading.Thread | None = None
+
+    @property
+    def stub(self):
+        return rpc.filer_stub(rpc.grpc_address(self.filer))
+
+    def start(self) -> None:
+        handler = _make_handler(self)
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        glog.info(f"webdav server started on :{self.port} -> filer "
+                  f"{self.filer}{self.base_dir or '/'}")
+
+    def stop(self) -> None:
+        if self._httpd:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+
+    # -- filer helpers -----------------------------------------------------
+
+    def full_path(self, dav_path: str) -> str:
+        p = urllib.parse.unquote(dav_path.split("?", 1)[0])
+        return (self.base_dir + "/" + p.strip("/")).rstrip("/") or "/"
+
+    def find(self, path: str) -> filer_pb2.Entry | None:
+        if path == "/":
+            return filer_pb2.Entry(name="", is_directory=True)
+        directory, name = path.rsplit("/", 1)
+        try:
+            resp = self.stub.LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory=directory or "/", name=name), timeout=30)
+        except Exception:
+            return None
+        if not resp.entry.name:
+            return None
+        return resp.entry
+
+    def list_dir(self, path: str) -> list[filer_pb2.Entry]:
+        out = []
+        for resp in self.stub.ListEntries(filer_pb2.ListEntriesRequest(
+                directory=path, limit=1 << 20)):
+            out.append(filer_pb2.Entry.FromString(
+                resp.entry.SerializeToString()))
+        return out
+
+    def filer_url(self, path: str) -> str:
+        return f"http://{self.filer}{urllib.parse.quote(path)}"
+
+
+def _prop_response(href: str, entry: filer_pb2.Entry) -> ET.Element:
+    resp = ET.Element(_dav("response"))
+    ET.SubElement(resp, _dav("href")).text = href
+    propstat = ET.SubElement(resp, _dav("propstat"))
+    prop = ET.SubElement(propstat, _dav("prop"))
+    rtype = ET.SubElement(prop, _dav("resourcetype"))
+    if entry.is_directory:
+        ET.SubElement(rtype, _dav("collection"))
+    else:
+        size = entry.attributes.file_size
+        ET.SubElement(prop, _dav("getcontentlength")).text = str(size)
+        if entry.attributes.mime:
+            ET.SubElement(prop, _dav("getcontenttype")).text = \
+                entry.attributes.mime
+    mtime = entry.attributes.mtime or int(time.time())
+    ET.SubElement(prop, _dav("getlastmodified")).text = time.strftime(
+        "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(mtime))
+    ET.SubElement(prop, _dav("displayname")).text = entry.name
+    ET.SubElement(propstat, _dav("status")).text = "HTTP/1.1 200 OK"
+    return resp
+
+
+def _make_handler(srv: WebDavServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+        server_version = "seaweedfs-tpu-webdav"
+
+        def log_message(self, fmt, *args):
+            glog.v(2, f"webdav {fmt % args}")
+
+        def _send(self, status: int, body: bytes = b"",
+                  content_type: str = "text/xml; charset=utf-8",
+                  headers: dict | None = None):
+            self.send_response(status)
+            self.send_header("Content-Length", str(len(body)))
+            if body:
+                self.send_header("Content-Type", content_type)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            if body:
+                self.wfile.write(body)
+
+        def _read_body(self) -> bytes:
+            if "chunked" in (self.headers.get("Transfer-Encoding") or ""):
+                out = bytearray()
+                while True:
+                    line = self.rfile.readline().strip()
+                    size = int(line.split(b";")[0] or b"0", 16)
+                    if size == 0:
+                        self.rfile.readline()  # trailing CRLF
+                        break
+                    out += self.rfile.read(size)
+                    self.rfile.readline()  # chunk CRLF
+                return bytes(out)
+            n = int(self.headers.get("Content-Length") or 0)
+            return self.rfile.read(n) if n else b""
+
+        def do_OPTIONS(self):
+            self._send(200, headers={
+                "DAV": "1, 2",
+                "Allow": "OPTIONS, GET, HEAD, PUT, DELETE, PROPFIND, "
+                         "PROPPATCH, MKCOL, COPY, MOVE, LOCK, UNLOCK",
+                "MS-Author-Via": "DAV"})
+
+        def do_PROPFIND(self):
+            self._read_body()  # body (prop filters) ignored: return all
+            path = srv.full_path(self.path)
+            entry = srv.find(path)
+            if entry is None:
+                return self._send(404)
+            depth = self.headers.get("Depth", "1")
+            ms = ET.Element(_dav("multistatus"))
+            # self.path is already percent-encoded wire form; reuse as-is
+            href = self.path.split("?", 1)[0] or "/"
+            ms.append(_prop_response(href, entry))
+            if entry.is_directory and depth != "0":
+                for child in srv.list_dir(path):
+                    ch = href.rstrip("/") + "/" + urllib.parse.quote(
+                        child.name)
+                    ms.append(_prop_response(ch, child))
+            body = ET.tostring(ms, xml_declaration=True, encoding="utf-8")
+            self._send(207, body)
+
+        def do_PROPPATCH(self):
+            self._read_body()
+            ms = ET.Element(_dav("multistatus"))
+            body = ET.tostring(ms, xml_declaration=True, encoding="utf-8")
+            self._send(207, body)
+
+        def do_MKCOL(self):
+            path = srv.full_path(self.path)
+            if srv.find(path) is not None:
+                return self._send(405)
+            directory, name = path.rsplit("/", 1)
+            entry = filer_pb2.Entry(name=name, is_directory=True)
+            entry.attributes.file_mode = 0o40770
+            entry.attributes.mtime = int(time.time())
+            srv.stub.CreateEntry(filer_pb2.CreateEntryRequest(
+                directory=directory or "/", entry=entry), timeout=30)
+            self._send(201)
+
+        def do_GET(self):
+            path = srv.full_path(self.path)
+            entry = srv.find(path)
+            if entry is None:
+                return self._send(404)
+            if entry.is_directory:
+                return self._send(405)
+            rng = self.headers.get("Range")
+            r = requests.get(srv.filer_url(path), timeout=300, stream=True,
+                             headers={"Range": rng} if rng else {})
+            if r.status_code >= 300:
+                return self._send(r.status_code)
+            self.send_response(r.status_code)
+            for h in ("Content-Length", "Content-Type", "Content-Range",
+                      "ETag", "Last-Modified", "Accept-Ranges"):
+                if h in r.headers:
+                    self.send_header(h, r.headers[h])
+            self.end_headers()
+            for piece in r.iter_content(chunk_size=256 * 1024):
+                self.wfile.write(piece)
+
+        def do_HEAD(self):
+            # served from metadata only — no body transfer
+            path = srv.full_path(self.path)
+            entry = srv.find(path)
+            if entry is None:
+                return self._send(404)
+            self.send_response(200)
+            if not entry.is_directory:
+                self.send_header("Content-Length",
+                                 str(entry.attributes.file_size))
+                if entry.attributes.mime:
+                    self.send_header("Content-Type", entry.attributes.mime)
+            self.send_header("Accept-Ranges", "bytes")
+            self.end_headers()
+
+        def do_PUT(self):
+            path = srv.full_path(self.path)
+            body = self._read_body()
+            r = requests.put(srv.filer_url(path), data=body, timeout=300,
+                             headers={"Content-Type":
+                                      self.headers.get("Content-Type") or
+                                      "application/octet-stream"})
+            self._send(201 if r.status_code < 300 else r.status_code)
+
+        def do_DELETE(self):
+            path = srv.full_path(self.path)
+            entry = srv.find(path)
+            if entry is None:
+                return self._send(404)
+            directory, name = path.rsplit("/", 1)
+            resp = srv.stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
+                directory=directory or "/", name=name, is_delete_data=True,
+                is_recursive=True), timeout=60)
+            self._send(204 if not resp.error else 409)
+
+        def _dest_path(self) -> str | None:
+            dest = self.headers.get("Destination")
+            if not dest:
+                return None
+            u = urllib.parse.urlparse(dest)
+            return srv.full_path(u.path)
+
+        def do_MOVE(self):
+            import grpc
+
+            src = srv.full_path(self.path)
+            dst = self._dest_path()
+            if dst is None:
+                return self._send(400)
+            if srv.find(src) is None:
+                return self._send(404)
+            od, on = src.rsplit("/", 1)
+            nd, nn = dst.rsplit("/", 1)
+            try:
+                srv.stub.AtomicRenameEntry(
+                    filer_pb2.AtomicRenameEntryRequest(
+                        old_directory=od or "/", old_name=on,
+                        new_directory=nd or "/", new_name=nn), timeout=60)
+            except grpc.RpcError as e:
+                code = e.code()
+                return self._send(
+                    404 if code == grpc.StatusCode.NOT_FOUND else 502)
+            self._send(201)
+
+        def do_COPY(self):
+            src = srv.full_path(self.path)
+            dst = self._dest_path()
+            if dst is None:
+                return self._send(400)
+            entry = srv.find(src)
+            if entry is None:
+                return self._send(404)
+            if entry.is_directory:
+                return self._send(501)  # directory COPY: not supported
+            r = requests.get(srv.filer_url(src), timeout=300)
+            if r.status_code >= 300:
+                return self._send(502)
+            pr = requests.put(srv.filer_url(dst), data=r.content,
+                              timeout=300)
+            self._send(201 if pr.status_code < 300 else pr.status_code)
+
+        def do_LOCK(self):
+            self._read_body()
+            token = f"opaquelocktoken:{time.time_ns():x}"
+            prop = ET.Element(_dav("prop"))
+            ld = ET.SubElement(prop, _dav("lockdiscovery"))
+            al = ET.SubElement(ld, _dav("activelock"))
+            lt = ET.SubElement(al, _dav("locktoken"))
+            ET.SubElement(lt, _dav("href")).text = token
+            body = ET.tostring(prop, xml_declaration=True, encoding="utf-8")
+            self._send(200, body, headers={"Lock-Token": f"<{token}>"})
+
+        def do_UNLOCK(self):
+            self._send(204)
+
+    return Handler
