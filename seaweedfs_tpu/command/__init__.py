@@ -58,6 +58,40 @@ def main(argv=None) -> int:
 
     shp = sub.add_parser("shell", help="admin shell")
     shp.add_argument("-master", default="localhost:9333")
+    shp.add_argument("-filer", default="",
+                     help="filer address for fs.*/remote.* commands")
+
+    fsy = sub.add_parser("filer.sync",
+                         help="continuously sync between two filers")
+    fsy.add_argument("-a", required=True, help="source filer")
+    fsy.add_argument("-b", required=True, help="target filer")
+    fsy.add_argument("-a.path", dest="a_path", default="/")
+    fsy.add_argument("-b.path", dest="b_path", default=None,
+                     help="target path (defaults to -a.path)")
+    fsy.add_argument("-isActiveActive", action="store_true")
+
+    frp = sub.add_parser("filer.replicate",
+                         help="replicate filer events to a sink")
+    frp.add_argument("-filer", default="localhost:8888")
+    frp.add_argument("-path", default="/")
+    frp.add_argument("-sink", default="local",
+                     choices=["local", "filer", "s3"])
+    frp.add_argument("-sink.dir", dest="sink_dir", default="./replica")
+    frp.add_argument("-sink.filer", dest="sink_filer", default="")
+    frp.add_argument("-sink.endpoint", dest="sink_endpoint", default="")
+    frp.add_argument("-sink.bucket", dest="sink_bucket", default="")
+
+    fbk = sub.add_parser("filer.backup",
+                         help="one-shot backup of a filer path to a "
+                              "local directory")
+    fbk.add_argument("-filer", default="localhost:8888")
+    fbk.add_argument("-path", default="/")
+    fbk.add_argument("-target", required=True)
+
+    frs = sub.add_parser("filer.remote.sync",
+                         help="sync remote-mounted directories")
+    frs.add_argument("-filer", default="localhost:8888")
+    frs.add_argument("-dir", required=True)
 
     up = sub.add_parser("upload", help="upload files")
     up.add_argument("-master", default="localhost:9333")
@@ -212,7 +246,87 @@ def _run(opts) -> int:
         from ..shell.env import CommandEnv
         from ..shell.registry import repl
 
-        repl(CommandEnv(opts.master))
+        repl(CommandEnv(opts.master, filer=opts.filer))
+        return 0
+
+    if opts.cmd == "filer.sync":
+        from ..replication import FilerSyncLoop
+
+        b_path = opts.b_path or opts.a_path
+        loops = [FilerSyncLoop(opts.a, opts.b, source_path=opts.a_path,
+                               target_path=b_path)]
+        if opts.isActiveActive:
+            loops.append(FilerSyncLoop(opts.b, opts.a,
+                                       source_path=b_path,
+                                       target_path=opts.a_path))
+        for lp in loops:
+            lp.start()
+        _wait_forever()
+        for lp in loops:
+            lp.stop()
+        return 0
+
+    if opts.cmd == "filer.replicate":
+        import time as _time
+
+        from ..replication import FilerSource, Replicator, new_sink
+        from ..pb import filer_pb2, rpc
+
+        if opts.sink == "local":
+            sink = new_sink("local", directory=opts.sink_dir)
+        elif opts.sink == "filer":
+            sink = new_sink("filer", filer=opts.sink_filer)
+        else:
+            sink = new_sink("s3", endpoint=opts.sink_endpoint,
+                            bucket=opts.sink_bucket)
+        repl_ = Replicator(FilerSource(opts.filer), sink,
+                           source_prefix=opts.path)
+        stub = rpc.filer_stub(rpc.grpc_address(opts.filer))
+        req = filer_pb2.SubscribeMetadataRequest(
+            client_name="filer.replicate", path_prefix=opts.path,
+            since_ns=_time.time_ns())
+        for resp in stub.SubscribeMetadata(req):
+            try:
+                repl_.replicate(resp)
+            except Exception as e:
+                print(f"replicate error: {e}", file=sys.stderr)
+        return 0
+
+    if opts.cmd == "filer.backup":
+        from ..replication import FilerSource, new_sink
+        from ..pb import filer_pb2, rpc
+
+        source = FilerSource(opts.filer)
+        sink = new_sink("local", directory=opts.target)
+        stub = rpc.filer_stub(rpc.grpc_address(opts.filer))
+        copied = 0
+
+        root = opts.path.rstrip("/") or "/"
+
+        def walk(directory):
+            nonlocal copied
+            for resp in stub.ListEntries(filer_pb2.ListEntriesRequest(
+                    directory=directory, limit=1 << 20)):
+                e = resp.entry
+                path = directory.rstrip("/") + "/" + e.name
+                rel = path[len(root):] if root != "/" else path
+                if e.is_directory:
+                    sink.create_entry(rel, e, None)
+                    walk(path)
+                else:
+                    sink.create_entry(rel, e,
+                                      source.read_entry_content(e))
+                    copied += 1
+
+        walk(root)
+        print(f"backed up {copied} files to {opts.target}")
+        return 0
+
+    if opts.cmd == "filer.remote.sync":
+        from ..remote_storage import RemoteGateway
+
+        n = RemoteGateway(opts.filer).sync_dir(opts.dir)
+        print(f"synced {n} entries")
         return 0
 
     if opts.cmd == "upload":

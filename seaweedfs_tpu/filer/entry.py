@@ -26,6 +26,8 @@ class Attr:
     symlink_target: str = ""
     md5: bytes = b""
     disk_type: str = ""
+    file_size: int = 0       # declared size for chunk-less entries
+    #                          (remote-mounted mirrors carry no chunks)
 
     @property
     def is_directory(self) -> bool:
@@ -57,7 +59,8 @@ class Entry:
     def size(self) -> int:
         if self.content:
             return len(self.content)
-        return max((c.offset + c.size for c in self.chunks), default=0)
+        return max((c.offset + c.size for c in self.chunks),
+                   default=self.attr.file_size)
 
     # -- protobuf conversion ----------------------------------------------
 
@@ -88,7 +91,8 @@ class Entry:
             attr=Attr(mtime=a.mtime, crtime=a.crtime, mode=a.file_mode,
                       uid=a.uid, gid=a.gid, mime=a.mime, ttl_sec=a.ttl_sec,
                       user_name=a.user_name, symlink_target=a.symlink_target,
-                      md5=a.md5, disk_type=a.disk_type),
+                      md5=a.md5, disk_type=a.disk_type,
+                      file_size=a.file_size),
             chunks=list(e.chunks),
             extended=dict(e.extended),
             content=e.content,

@@ -41,8 +41,11 @@ class Filer:
     # -- events (filer_notify.go:20 NotifyUpdateEvent) ---------------------
 
     def _notify(self, directory: str, old: Entry | None, new: Entry | None,
-                delete_chunks: bool = False) -> None:
-        ev = filer_pb2.EventNotification(delete_chunks=delete_chunks)
+                delete_chunks: bool = False,
+                from_other_cluster: bool = False) -> None:
+        ev = filer_pb2.EventNotification(
+            delete_chunks=delete_chunks,
+            is_from_other_cluster=from_other_cluster)
         if old is not None:
             ev.old_entry.CopyFrom(old.to_pb())
         if new is not None:
@@ -84,7 +87,8 @@ class Filer:
             return False
 
     def create_entry(self, entry: Entry, *, o_excl: bool = False,
-                     skip_parents: bool = False) -> None:
+                     skip_parents: bool = False,
+                     from_other_cluster: bool = False) -> None:
         entry.full_path = normalize(entry.full_path)
         if not skip_parents:
             self._ensure_parents(entry.parent)
@@ -94,7 +98,8 @@ class Filer:
         if old is not None and old.is_directory and not entry.is_directory:
             raise FilerError(f"{entry.full_path} is a directory")
         self.store.insert_entry(entry)
-        self._notify(entry.parent, old, entry)
+        self._notify(entry.parent, old, entry,
+                     from_other_cluster=from_other_cluster)
 
     def _ensure_parents(self, dir_path: str) -> None:
         dir_path = normalize(dir_path)
@@ -105,16 +110,19 @@ class Filer:
         self._ensure_parents(parent_of(dir_path))
         self.store.insert_entry(new_directory_entry(dir_path))
 
-    def update_entry(self, entry: Entry) -> None:
+    def update_entry(self, entry: Entry, *,
+                     from_other_cluster: bool = False) -> None:
         entry.full_path = normalize(entry.full_path)
         old = self.store.find_entry(entry.full_path)
         if old is None:
             raise NotFound(entry.full_path)
         self.store.update_entry(entry)
-        self._notify(entry.parent, old, entry)
+        self._notify(entry.parent, old, entry,
+                     from_other_cluster=from_other_cluster)
 
     def delete_entry(self, path: str, *, recursive: bool = False,
-                     is_delete_data: bool = True) -> list[str]:
+                     is_delete_data: bool = True,
+                     from_other_cluster: bool = False) -> list[str]:
         """-> chunk fids to garbage-collect (filer_delete_entry.go)."""
         path = normalize(path)
         entry = self.find_entry(path)
@@ -128,7 +136,8 @@ class Filer:
         if is_delete_data:
             fids.extend(c.file_id for c in entry.chunks)
         self.store.delete_entry(path)
-        self._notify(entry.parent, entry, None, delete_chunks=is_delete_data)
+        self._notify(entry.parent, entry, None, delete_chunks=is_delete_data,
+                     from_other_cluster=from_other_cluster)
         return fids
 
     def _collect_fids_recursive(self, dir_path: str) -> list[str]:

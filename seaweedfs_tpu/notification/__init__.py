@@ -1,0 +1,112 @@
+"""Pluggable metadata-event publishers.
+
+Rebuild of /root/reference/weed/notification/ (configuration.go): filer
+mutations can be published to an external queue. Publishers register by
+name; `log` and `memory` are built in, the cloud queues (kafka, aws_sqs,
+google_pub_sub, gocdk_pub_sub) are import-gated stubs since their client
+libraries are not in this image.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from ..pb import filer_pb2
+from ..utils import glog
+
+
+class MessageQueue:
+    """Publisher SPI (notification.MessageQueue interface)."""
+
+    name = "none"
+
+    def initialize(self, config: dict) -> None:  # pragma: no cover
+        pass
+
+    def send_message(self, key: str,
+                     message: filer_pb2.EventNotification) -> None:
+        raise NotImplementedError
+
+
+class LogQueue(MessageQueue):
+    """Logs events (the reference's `log` publisher)."""
+
+    name = "log"
+
+    def send_message(self, key, message):
+        glog.info(f"notify {key}: delete_chunks={message.delete_chunks} "
+                  f"new={message.new_entry.name!r}")
+
+
+class MemoryQueue(MessageQueue):
+    """In-process queue for tests and the replicate command's local mode."""
+
+    name = "memory"
+
+    def __init__(self, capacity: int = 65536):
+        self.events: deque[tuple[str, filer_pb2.EventNotification]] = \
+            deque(maxlen=capacity)
+        self._cond = threading.Condition()
+
+    def send_message(self, key, message):
+        copied = filer_pb2.EventNotification()
+        copied.CopyFrom(message)
+        with self._cond:
+            self.events.append((key, copied))
+            self._cond.notify_all()
+
+    def drain(self, timeout: float = 0.0):
+        with self._cond:
+            if not self.events and timeout > 0:
+                self._cond.wait(timeout)
+            out = list(self.events)
+            self.events.clear()
+            return out
+
+
+class _GatedQueue(MessageQueue):
+    """Placeholder for publishers whose client library is unavailable."""
+
+    def __init__(self, name: str, module: str):
+        self.name = name
+        self._module = module
+
+    def initialize(self, config):
+        raise RuntimeError(
+            f"notification publisher {self.name!r} needs the {self._module} "
+            f"client library, which is not available in this environment")
+
+    def send_message(self, key, message):
+        self.initialize({})
+
+
+QUEUES: dict[str, MessageQueue] = {}
+
+
+def register(q: MessageQueue) -> MessageQueue:
+    QUEUES[q.name] = q
+    return q
+
+
+register(LogQueue())
+register(MemoryQueue())
+for _name, _mod in (("kafka", "sarama/kafka-python"),
+                    ("aws_sqs", "boto3"),
+                    ("google_pub_sub", "google-cloud-pubsub"),
+                    ("gocdk_pub_sub", "gocloud.dev")):
+    register(_GatedQueue(_name, _mod))
+
+
+def load_configuration(config: dict) -> MessageQueue | None:
+    """notification.toml shape: {"notification": {"log": {"enabled": true}}}
+    (LoadConfiguration, configuration.go)."""
+    section = config.get("notification", config)
+    for name, sub in section.items():
+        if isinstance(sub, dict) and sub.get("enabled"):
+            q = QUEUES.get(name)
+            if q is None:
+                raise KeyError(f"unknown notification queue {name!r}")
+            q.initialize(sub)
+            return q
+    return None

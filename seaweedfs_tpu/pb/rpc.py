@@ -192,10 +192,17 @@ def reset_channels() -> None:
         _channels.clear()
 
 
+def derived_grpc_port(http_port: int) -> int:
+    """gRPC port for an HTTP port: +10000 (server_address.go convention),
+    wrapping downward when that would pass the 65535 port ceiling."""
+    p = http_port + GRPC_PORT_DELTA
+    return p if p <= 65535 else http_port - GRPC_PORT_DELTA
+
+
 def grpc_address(http_address: str) -> str:
     """HTTP host:port -> gRPC host:port (+10000 convention)."""
     host, _, port = http_address.rpartition(":")
-    return f"{host}:{int(port) + GRPC_PORT_DELTA}"
+    return f"{host}:{derived_grpc_port(int(port))}"
 
 
 def master_stub(address: str) -> Stub:

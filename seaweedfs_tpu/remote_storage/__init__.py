@@ -1,0 +1,373 @@
+"""Cloud-tier remote storage mounts.
+
+Rebuild of /root/reference/weed/remote_storage/: a filer directory can be
+mounted onto a remote (cloud) store; entries mirror remote objects with a
+`remote entry` marker, bytes are fetched lazily ("cache") and can be
+dropped again ("uncache"). The client SPI mirrors remote_storage_client.go
+(Traverse, ReadFile, WriteFile, DeleteFile); a directory-backed `local`
+client is the built-in working implementation (the reference's tests use
+its own cluster similarly), an `s3` client rides any S3 HTTP endpoint,
+and gcs/azure are gated stubs. Mount configuration persists in the filer
+at /etc/remote.conf as JSON, like the reference's remote.conf protobuf.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+from dataclasses import dataclass
+
+from ..pb import filer_pb2, rpc
+
+REMOTE_CONF_DIR = "/etc"
+REMOTE_CONF_FILE = "remote.conf"
+REMOTE_ENTRY_KEY = "remote.entry"  # Entry.extended marker
+
+
+@dataclass
+class RemoteEntry:
+    """Mirror of remote object metadata (remote_pb RemoteEntry)."""
+
+    path: str           # path under the remote mount root
+    size: int
+    mtime: int
+    etag: str = ""
+
+    def to_json(self) -> bytes:
+        return json.dumps(dataclasses.asdict(self)).encode()
+
+    @classmethod
+    def from_json(cls, blob: bytes) -> "RemoteEntry":
+        return cls(**json.loads(blob))
+
+
+class RemoteStorageClient:
+    """SPI (remote_storage_client.go RemoteStorageClient)."""
+
+    def traverse(self, prefix: str = ""):
+        """yields RemoteEntry for every object under prefix."""
+        raise NotImplementedError
+
+    def read_file(self, path: str) -> bytes:
+        raise NotImplementedError
+
+    def write_file(self, path: str, data: bytes) -> RemoteEntry:
+        raise NotImplementedError
+
+    def delete_file(self, path: str) -> None:
+        raise NotImplementedError
+
+
+class LocalRemoteStorage(RemoteStorageClient):
+    """Directory-backed remote (usable + the test double)."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _abs(self, path: str) -> str:
+        return os.path.join(self.root, path.lstrip("/"))
+
+    def traverse(self, prefix: str = ""):
+        base = self._abs(prefix)
+        for dirpath, _dirs, files in os.walk(base if os.path.isdir(base)
+                                             else self.root):
+            for name in sorted(files):
+                full = os.path.join(dirpath, name)
+                rel = os.path.relpath(full, self.root)
+                if prefix and not rel.startswith(prefix.lstrip("/")):
+                    continue
+                st = os.stat(full)
+                yield RemoteEntry(path="/" + rel, size=st.st_size,
+                                  mtime=int(st.st_mtime))
+
+    def read_file(self, path: str) -> bytes:
+        with open(self._abs(path), "rb") as f:
+            return f.read()
+
+    def write_file(self, path: str, data: bytes) -> RemoteEntry:
+        target = self._abs(path)
+        os.makedirs(os.path.dirname(target), exist_ok=True)
+        with open(target, "wb") as f:
+            f.write(data)
+        return RemoteEntry(path=path, size=len(data),
+                           mtime=int(time.time()))
+
+    def delete_file(self, path: str) -> None:
+        try:
+            os.remove(self._abs(path))
+        except FileNotFoundError:
+            pass
+
+
+class S3RemoteStorage(RemoteStorageClient):
+    """S3-endpoint remote (remote_storage/s3/); plain HTTP + SigV4."""
+
+    def __init__(self, endpoint: str, bucket: str, *, access_key: str = "",
+                 secret_key: str = "", region: str = "us-east-1"):
+        self.endpoint = endpoint.rstrip("/")
+        self.bucket = bucket
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.region = region
+
+    def _headers(self, method: str, url: str, payload: bytes) -> dict:
+        if not self.access_key:
+            return {}
+        from ..s3api.sigv4_client import sign_request
+
+        return sign_request(method, url, payload, self.access_key,
+                            self.secret_key, self.region)
+
+    def _url(self, path: str) -> str:
+        return f"{self.endpoint}/{self.bucket}/{path.lstrip('/')}"
+
+    def traverse(self, prefix: str = ""):
+        import urllib.parse
+        import xml.etree.ElementTree as ET
+
+        import requests
+
+        token = ""
+        while True:
+            url = (f"{self.endpoint}/{self.bucket}?list-type=2"
+                   f"&prefix={prefix.lstrip('/')}")
+            if token:
+                url += ("&continuation-token=" +
+                        urllib.parse.quote(token, safe=""))
+            r = requests.get(url, headers=self._headers("GET", url, b""),
+                             timeout=60)
+            r.raise_for_status()
+            root = ET.fromstring(r.content)
+            for item in root.iter():
+                if not item.tag.endswith("Contents"):
+                    continue
+                key = item.findtext("{*}Key") or ""
+                size = int(item.findtext("{*}Size") or 0)
+                yield RemoteEntry(path="/" + key, size=size, mtime=0,
+                                  etag=(item.findtext("{*}ETag") or
+                                        "").strip('"'))
+            if (root.findtext("{*}IsTruncated") or "").lower() != "true":
+                return
+            token = root.findtext("{*}NextContinuationToken") or ""
+            if not token:
+                return
+
+    def read_file(self, path: str) -> bytes:
+        import requests
+
+        url = self._url(path)
+        r = requests.get(url, headers=self._headers("GET", url, b""),
+                         timeout=300)
+        r.raise_for_status()
+        return r.content
+
+    def write_file(self, path: str, data: bytes) -> RemoteEntry:
+        import requests
+
+        url = self._url(path)
+        r = requests.put(url, data=data,
+                         headers=self._headers("PUT", url, data),
+                         timeout=300)
+        r.raise_for_status()
+        return RemoteEntry(path=path, size=len(data),
+                           mtime=int(time.time()),
+                           etag=r.headers.get("ETag", "").strip('"'))
+
+    def delete_file(self, path: str) -> None:
+        import requests
+
+        url = self._url(path)
+        requests.delete(url, headers=self._headers("DELETE", url, b""),
+                        timeout=60)
+
+
+_CLIENTS = {"local": LocalRemoteStorage, "s3": S3RemoteStorage}
+
+
+def new_client(conf: dict) -> RemoteStorageClient:
+    kind = conf.get("type", "local")
+    if kind in ("gcs", "azure"):
+        raise RuntimeError(f"remote storage {kind!r} needs a cloud client "
+                           f"library not present in this environment")
+    cls = _CLIENTS.get(kind)
+    if cls is None:
+        raise KeyError(f"unknown remote storage type {kind!r}")
+    kwargs = {k: v for k, v in conf.items() if k not in ("type", "name")}
+    return cls(**kwargs)
+
+
+class RemoteConf:
+    """Mount table persisted in the filer (shell `remote.configure` +
+    `remote.mount` state; reference stores remote.conf the same way)."""
+
+    def __init__(self, filer: str):
+        self.filer = filer
+
+    @property
+    def _stub(self):
+        return rpc.filer_stub(rpc.grpc_address(self.filer))
+
+    def load(self) -> dict:
+        try:
+            resp = self._stub.LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory=REMOTE_CONF_DIR, name=REMOTE_CONF_FILE),
+                timeout=10)
+        except Exception:
+            return {"storages": {}, "mounts": {}}
+        if not resp.entry.content:
+            return {"storages": {}, "mounts": {}}
+        return json.loads(resp.entry.content)
+
+    def save(self, conf: dict) -> None:
+        entry = filer_pb2.Entry(name=REMOTE_CONF_FILE,
+                                content=json.dumps(conf, indent=2).encode())
+        entry.attributes.file_mode = 0o600
+        entry.attributes.mtime = int(time.time())
+        self._stub.CreateEntry(filer_pb2.CreateEntryRequest(
+            directory=REMOTE_CONF_DIR, entry=entry), timeout=10)
+
+    def configure_storage(self, name: str, conf: dict) -> None:
+        all_ = self.load()
+        all_.setdefault("storages", {})[name] = conf
+        self.save(all_)
+
+    def mount(self, directory: str, storage: str, remote_path: str) -> None:
+        all_ = self.load()
+        if storage not in all_.get("storages", {}):
+            raise KeyError(f"unknown remote storage {storage!r}")
+        all_.setdefault("mounts", {})[directory] = {
+            "storage": storage, "remote_path": remote_path}
+        self.save(all_)
+
+    def unmount(self, directory: str) -> None:
+        all_ = self.load()
+        all_.get("mounts", {}).pop(directory, None)
+        self.save(all_)
+
+    def client_for(self, directory: str
+                   ) -> tuple[RemoteStorageClient, str] | None:
+        all_ = self.load()
+        m = all_.get("mounts", {}).get(directory)
+        if m is None:
+            return None
+        storage = all_["storages"][m["storage"]]
+        return new_client(storage), m["remote_path"]
+
+
+class RemoteGateway:
+    """Mount operations against the filer namespace
+    (shell remote.* commands + filer.remote.sync)."""
+
+    def __init__(self, filer: str):
+        self.filer = filer
+        self.conf = RemoteConf(filer)
+
+    @property
+    def _stub(self):
+        return rpc.filer_stub(rpc.grpc_address(self.filer))
+
+    def sync_dir(self, directory: str) -> int:
+        """BFS the remote and mirror metadata into the filer
+        (traverse_bfs.go + filer_remote_sync); returns entries synced."""
+        pair = self.conf.client_for(directory)
+        if pair is None:
+            raise KeyError(f"{directory} is not a remote mount")
+        client, remote_root = pair
+        synced = 0
+        for rent in client.traverse(remote_root):
+            rel = rent.path
+            if remote_root.strip("/"):
+                rel = rent.path[len("/" + remote_root.strip("/")):] or "/"
+            target = directory.rstrip("/") + rel
+            d, name = target.rsplit("/", 1)
+            marker = rent.to_json()
+            # unchanged remote object: keep the existing entry (and any
+            # cached chunks); changed: drop it so stale chunks are GC'd
+            try:
+                old = self._stub.LookupDirectoryEntry(
+                    filer_pb2.LookupDirectoryEntryRequest(
+                        directory=d or "/", name=name), timeout=10).entry
+            except Exception:
+                old = None
+            if old is not None and old.name:
+                if old.extended.get(REMOTE_ENTRY_KEY) == marker:
+                    continue
+                self._stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
+                    directory=d or "/", name=name, is_delete_data=True),
+                    timeout=30)
+            entry = filer_pb2.Entry(name=name)
+            entry.attributes.file_size = rent.size
+            entry.attributes.mtime = rent.mtime or int(time.time())
+            entry.attributes.file_mode = 0o644
+            entry.extended[REMOTE_ENTRY_KEY] = marker
+            self._stub.CreateEntry(filer_pb2.CreateEntryRequest(
+                directory=d or "/", entry=entry), timeout=30)
+            synced += 1
+        return synced
+
+    def cache(self, path: str) -> int:
+        """Materialize a remote entry's bytes into the filer (remote.cache);
+        returns bytes cached."""
+        import requests
+
+        d, name = path.rsplit("/", 1)
+        resp = self._stub.LookupDirectoryEntry(
+            filer_pb2.LookupDirectoryEntryRequest(directory=d or "/",
+                                                  name=name), timeout=10)
+        marker = resp.entry.extended.get(REMOTE_ENTRY_KEY)
+        if not marker:
+            raise KeyError(f"{path} is not a remote entry")
+        mount_dir = self._mount_of(path)
+        client, remote_root = self.conf.client_for(mount_dir)
+        rel = path[len(mount_dir):]
+        data = client.read_file("/" + remote_root.strip("/") + rel
+                                if remote_root.strip("/") else rel)
+        r = requests.put(f"http://{self.filer}{path}", data=data,
+                         timeout=300)
+        if r.status_code >= 300:
+            raise IOError(f"cache PUT {path}: {r.status_code}")
+        # re-attach the remote marker lost by the overwrite
+        resp2 = self._stub.LookupDirectoryEntry(
+            filer_pb2.LookupDirectoryEntryRequest(directory=d or "/",
+                                                  name=name), timeout=10)
+        entry = resp2.entry
+        entry.extended[REMOTE_ENTRY_KEY] = marker
+        self._stub.UpdateEntry(filer_pb2.UpdateEntryRequest(
+            directory=d or "/", entry=entry), timeout=10)
+        return len(data)
+
+    def uncache(self, path: str) -> None:
+        """Drop cached chunks, keep the remote pointer (remote.uncache).
+        Delete+recreate so the dropped chunks are garbage-collected."""
+        d, name = path.rsplit("/", 1)
+        resp = self._stub.LookupDirectoryEntry(
+            filer_pb2.LookupDirectoryEntryRequest(directory=d or "/",
+                                                  name=name), timeout=10)
+        entry = resp.entry
+        if REMOTE_ENTRY_KEY not in entry.extended:
+            raise KeyError(f"{path} is not a remote entry")
+        size = max((c.offset + c.size for c in entry.chunks),
+                   default=entry.attributes.file_size)
+        self._stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
+            directory=d or "/", name=name, is_delete_data=True), timeout=30)
+        fresh = filer_pb2.Entry(name=name)
+        fresh.attributes.CopyFrom(entry.attributes)
+        fresh.attributes.file_size = size
+        for k, v in entry.extended.items():
+            fresh.extended[k] = v
+        self._stub.CreateEntry(filer_pb2.CreateEntryRequest(
+            directory=d or "/", entry=fresh), timeout=10)
+
+    def _mount_of(self, path: str) -> str:
+        mounts = self.conf.load().get("mounts", {})
+        best = ""
+        for m in mounts:
+            if path.startswith(m.rstrip("/") + "/") and len(m) > len(best):
+                best = m
+        if not best:
+            raise KeyError(f"{path} is not under a remote mount")
+        return best

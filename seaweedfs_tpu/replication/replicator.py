@@ -1,0 +1,63 @@
+"""Replicator: apply filer metadata events to a sink.
+
+Rebuild of /root/reference/weed/replication/replicator.go — Replicate()
+dispatches EventNotification (old/new entry combinations) to the sink's
+create/update/delete, materializing chunk data through the source.
+"""
+
+from __future__ import annotations
+
+from ..pb import filer_pb2
+from ..utils import glog
+from .sink import ReplicationSink
+from .source import FilerSource
+
+
+class Replicator:
+    def __init__(self, source: FilerSource, sink: ReplicationSink, *,
+                 source_prefix: str = "/"):
+        self.source = source
+        self.sink = sink
+        self.prefix = source_prefix.rstrip("/") or "/"
+
+    def _strip(self, path: str) -> str | None:
+        """Path relative to the replicated prefix, or None if outside."""
+        if self.prefix == "/":
+            return path
+        if path == self.prefix:
+            return "/"
+        if path.startswith(self.prefix + "/"):
+            return path[len(self.prefix):]
+        return None
+
+    def replicate(self, resp: filer_pb2.SubscribeMetadataResponse) -> bool:
+        """-> True if the event was applied (in-prefix)."""
+        ev = resp.event_notification
+        directory = resp.directory
+        has_old = bool(ev.old_entry.name)
+        has_new = bool(ev.new_entry.name)
+        applied = False
+        if has_old:
+            old_path = self._strip(
+                directory.rstrip("/") + "/" + ev.old_entry.name)
+            new_dir = ev.new_parent_path or directory
+            new_path = self._strip(
+                new_dir.rstrip("/") + "/" + ev.new_entry.name) \
+                if has_new else None
+            if old_path is not None and old_path != new_path:
+                self.sink.delete_entry(old_path, ev.old_entry.is_directory)
+                applied = True
+        if has_new:
+            new_dir = ev.new_parent_path or directory
+            new_path = self._strip(
+                new_dir.rstrip("/") + "/" + ev.new_entry.name)
+            if new_path is not None:
+                data = None
+                if not ev.new_entry.is_directory:
+                    data = self.source.read_entry_content(ev.new_entry)
+                self.sink.create_entry(new_path, ev.new_entry, data)
+                applied = True
+        if applied:
+            glog.v(1, f"replicated {directory}: "
+                      f"old={ev.old_entry.name!r} new={ev.new_entry.name!r}")
+        return applied

@@ -1,0 +1,52 @@
+"""Replication source: read chunk bytes out of the source cluster.
+
+Rebuild of /root/reference/weed/replication/source/filer_source.go —
+LookupFileId via the source filer, then HTTP GET from its volume servers.
+"""
+
+from __future__ import annotations
+
+import requests
+
+from ..pb import filer_pb2, rpc
+
+
+class FilerSource:
+    def __init__(self, filer: str):
+        self.filer = filer
+
+    @property
+    def stub(self):
+        return rpc.filer_stub(rpc.grpc_address(self.filer))
+
+    def lookup_urls(self, file_id: str) -> list[str]:
+        vid = file_id.split(",", 1)[0]
+        resp = self.stub.LookupVolume(filer_pb2.LookupVolumeRequest(
+            volume_ids=[vid]), timeout=30)
+        locs = resp.locations_map.get(vid)
+        if locs is None or not locs.locations:
+            raise LookupError(f"no locations for volume {vid}")
+        return [f"http://{l.url}/{file_id}" for l in locs.locations]
+
+    def read_chunk(self, file_id: str) -> bytes:
+        last: Exception | None = None
+        for url in self.lookup_urls(file_id):
+            try:
+                r = requests.get(url, timeout=60)
+                if r.status_code == 200:
+                    return r.content
+                last = IOError(f"{url}: {r.status_code}")
+            except requests.RequestException as e:
+                last = e
+        raise IOError(f"read {file_id}: {last}")
+
+    def read_entry_content(self, entry: filer_pb2.Entry) -> bytes:
+        """Materialize a full entry body (content or chunks)."""
+        if entry.content:
+            return entry.content
+        size = max((c.offset + c.size for c in entry.chunks), default=0)
+        buf = bytearray(size)
+        for c in sorted(entry.chunks, key=lambda c: c.modified_ts_ns):
+            data = self.read_chunk(c.file_id)
+            buf[c.offset:c.offset + len(data)] = data[:c.size]
+        return bytes(buf)

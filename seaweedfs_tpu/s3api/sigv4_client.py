@@ -1,0 +1,77 @@
+"""Client-side AWS Signature V4 signer.
+
+Counterpart of the gateway-side verifier in auth.py (reference:
+/root/reference/weed/s3api/auth_signature_v4.go). Used by the replication
+S3 sink and by tests to produce authenticated requests against any S3
+endpoint, including this framework's own gateway.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import time
+import urllib.parse
+
+
+def _uri_encode(s: str, keep_slash: bool = False) -> str:
+    safe = "-_.~" + ("/" if keep_slash else "")
+    return urllib.parse.quote(s, safe=safe)
+
+
+def sign_request(method: str, url: str, payload: bytes, access_key: str,
+                 secret_key: str, region: str = "us-east-1",
+                 service: str = "s3", amz_now: time.struct_time | None = None
+                 ) -> dict[str, str]:
+    """-> headers dict (Host, X-Amz-Date, X-Amz-Content-Sha256,
+    Authorization) for the given request."""
+    u = urllib.parse.urlparse(url)
+    now = amz_now or time.gmtime()
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", now)
+    date = amz_date[:8]
+    payload_hash = hashlib.sha256(payload).hexdigest()
+    host = u.netloc
+
+    headers = {
+        "Host": host,
+        "X-Amz-Date": amz_date,
+        "X-Amz-Content-Sha256": payload_hash,
+    }
+    signed_names = sorted(h.lower() for h in headers)
+    canonical_headers = "".join(
+        f"{name}:{headers[next(h for h in headers if h.lower() == name)].strip()}\n"
+        for name in signed_names)
+    signed_headers = ";".join(signed_names)
+
+    query_pairs = urllib.parse.parse_qsl(u.query, keep_blank_values=True)
+    canonical_query = "&".join(
+        f"{_uri_encode(k)}={_uri_encode(v)}"
+        for k, v in sorted(query_pairs))
+
+    canonical_request = "\n".join([
+        method,
+        _uri_encode(urllib.parse.unquote(u.path) or "/", keep_slash=True),
+        canonical_query,
+        canonical_headers,
+        signed_headers,
+        payload_hash,
+    ])
+    scope = f"{date}/{region}/{service}/aws4_request"
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical_request.encode()).hexdigest()])
+
+    def h(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = h(("AWS4" + secret_key).encode(), date)
+    k = h(k, region)
+    k = h(k, service)
+    k = h(k, "aws4_request")
+    signature = hmac.new(k, string_to_sign.encode(),
+                         hashlib.sha256).hexdigest()
+
+    headers["Authorization"] = (
+        f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
+        f"SignedHeaders={signed_headers}, Signature={signature}")
+    return headers

@@ -42,7 +42,7 @@ class FilerServer:
                  replication: str = "", chunk_size: int = CHUNK_SIZE):
         self.ip = ip
         self.port = port
-        self.grpc_port = port + rpc.GRPC_PORT_DELTA
+        self.grpc_port = rpc.derived_grpc_port(port)
         self.master = master
         self.collection = collection
         self.replication = replication
@@ -100,7 +100,8 @@ class FilerServer:
         )
 
     def write_file(self, path: str, body: bytes, *, mime: str = "",
-                   ttl: str = "", mode: int = 0o660) -> Entry:
+                   ttl: str = "", mode: int = 0o660,
+                   from_other_cluster: bool = False) -> Entry:
         """autoChunk + saveAsChunk + CreateEntry."""
         chunks = []
         md5 = hashlib.md5()
@@ -124,7 +125,7 @@ class FilerServer:
             old_fids = [c.file_id for c in old.chunks]
         except NotFound:
             pass
-        self.filer.create_entry(entry)
+        self.filer.create_entry(entry, from_other_cluster=from_other_cluster)
         if old_fids:
             self._gc_chunks(old_fids)
         return entry
@@ -203,8 +204,10 @@ class FilerGrpc:
     def CreateEntry(self, request, context):
         e = Entry.from_pb(request.directory, request.entry)
         try:
-            self.filer.create_entry(e, o_excl=request.o_excl,
-                                    skip_parents=request.skip_check_parent_directory)
+            self.filer.create_entry(
+                e, o_excl=request.o_excl,
+                skip_parents=request.skip_check_parent_directory,
+                from_other_cluster=request.is_from_other_cluster)
         except Exception as err:  # noqa: BLE001
             return filer_pb2.CreateEntryResponse(error=str(err))
         return filer_pb2.CreateEntryResponse()
@@ -212,7 +215,8 @@ class FilerGrpc:
     def UpdateEntry(self, request, context):
         e = Entry.from_pb(request.directory, request.entry)
         try:
-            self.filer.update_entry(e)
+            self.filer.update_entry(
+                e, from_other_cluster=request.is_from_other_cluster)
         except NotFound:
             context.abort(grpc.StatusCode.NOT_FOUND, "not found")
         return filer_pb2.UpdateEntryResponse()
@@ -239,7 +243,8 @@ class FilerGrpc:
         try:
             fids = self.filer.delete_entry(
                 path, recursive=request.is_recursive,
-                is_delete_data=request.is_delete_data)
+                is_delete_data=request.is_delete_data,
+                from_other_cluster=request.is_from_other_cluster)
             if request.is_delete_data and fids:
                 self.srv._gc_chunks(fids)
         except NotFound:
@@ -437,8 +442,10 @@ def _make_http_handler(srv: FilerServer):
                         path = path + fname.decode(errors="replace")
                     ctype = ""
                 try:
-                    entry = srv.write_file(path, body, mime=ctype,
-                                           ttl=q.get("ttl", ""))
+                    entry = srv.write_file(
+                        path, body, mime=ctype, ttl=q.get("ttl", ""),
+                        from_other_cluster=bool(
+                            self.headers.get("X-From-Other-Cluster")))
                 except IOError as e:
                     return self._json({"error": str(e)}, 500)
                 self._json({"name": entry.name, "size": entry.size()}, 201)

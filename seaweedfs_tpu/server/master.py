@@ -48,7 +48,7 @@ class MasterServer:
                  allocate_fn=None):
         self.ip = ip
         self.port = port
-        self.grpc_port = port + rpc.GRPC_PORT_DELTA
+        self.grpc_port = rpc.derived_grpc_port(port)
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
         self.topo = Topology(

@@ -59,7 +59,7 @@ class VolumeServer:
                  ec_geometry: Geometry = Geometry()):
         self.ip = ip
         self.port = port
-        self.grpc_port = port + rpc.GRPC_PORT_DELTA
+        self.grpc_port = rpc.derived_grpc_port(port)
         self.master = master  # HTTP address; gRPC is +10000
         self.master_grpc = rpc.grpc_address(master)
         self.pulse_seconds = pulse_seconds

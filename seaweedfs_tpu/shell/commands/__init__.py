@@ -1,1 +1,1 @@
-from . import cluster, collection, ec, lock, volume  # noqa: F401
+from . import cluster, collection, ec, fs, lock, remote, volume  # noqa: F401

@@ -1,0 +1,202 @@
+"""fs.* shell commands: filer namespace browsing and metadata tools.
+
+Rebuild of /root/reference/weed/shell/command_fs_*.go (fs.ls, fs.cd,
+fs.pwd, fs.cat, fs.du, fs.mkdir, fs.rm, fs.mv, fs.meta.save,
+fs.meta.load, fs.meta.cat).
+"""
+
+from __future__ import annotations
+
+import struct
+
+from ...pb import filer_pb2, rpc
+from ..registry import command
+
+
+def _stub(env):
+    return rpc.filer_stub(rpc.grpc_address(env.require_filer()))
+
+
+def _resolve(env, arg: str | None) -> str:
+    p = arg if arg else env.cwd
+    if not p.startswith("/"):
+        p = env.cwd.rstrip("/") + "/" + p
+    while "//" in p:
+        p = p.replace("//", "/")
+    return p.rstrip("/") or "/"
+
+
+def _list(env, directory: str):
+    for resp in _stub(env).ListEntries(filer_pb2.ListEntriesRequest(
+            directory=directory, limit=1 << 20)):
+        yield resp.entry
+
+
+def _find(env, path: str) -> filer_pb2.Entry | None:
+    if path == "/":
+        return filer_pb2.Entry(name="", is_directory=True)
+    d, name = path.rsplit("/", 1)
+    try:
+        e = _stub(env).LookupDirectoryEntry(
+            filer_pb2.LookupDirectoryEntryRequest(
+                directory=d or "/", name=name), timeout=10).entry
+    except Exception:
+        return None
+    return e if (e.name or e.is_directory) else None
+
+
+@command("fs.pwd", "print current filer directory")
+def fs_pwd(env, args, out):
+    print(env.cwd, file=out)
+
+
+@command("fs.cd", "fs.cd <dir>")
+def fs_cd(env, args, out):
+    path = _resolve(env, args[0] if args else "/")
+    e = _find(env, path)
+    if e is None or not e.is_directory:
+        raise RuntimeError(f"{path}: not a directory")
+    env.cwd = path
+
+
+@command("fs.ls", "fs.ls [-l] [dir]")
+def fs_ls(env, args, out):
+    long_ = "-l" in args
+    args = [a for a in args if not a.startswith("-")]
+    path = _resolve(env, args[0] if args else None)
+    for e in _list(env, path):
+        if long_:
+            kind = "d" if e.is_directory else "-"
+            size = e.attributes.file_size or \
+                max((c.offset + c.size for c in e.chunks), default=0)
+            print(f"{kind} {e.attributes.file_mode & 0o7777:04o} "
+                  f"{size:>12d} {e.name}", file=out)
+        else:
+            print(e.name + ("/" if e.is_directory else ""), file=out)
+
+
+@command("fs.du", "fs.du [dir] — directory usage (bytes, files)")
+def fs_du(env, args, out):
+    path = _resolve(env, args[0] if args else None)
+
+    def walk(d):
+        files = size = 0
+        for e in _list(env, d):
+            if e.is_directory:
+                f2, s2 = walk(d.rstrip("/") + "/" + e.name)
+                files += f2
+                size += s2
+            else:
+                files += 1
+                size += e.attributes.file_size or \
+                    max((c.offset + c.size for c in e.chunks), default=0)
+        return files, size
+
+    files, size = walk(path)
+    print(f"{size:>14d} bytes  {files:>8d} files  {path}", file=out)
+
+
+@command("fs.cat", "fs.cat <file>")
+def fs_cat(env, args, out):
+    import requests
+
+    path = _resolve(env, args[0])
+    r = requests.get(f"http://{env.require_filer()}{path}", timeout=60)
+    if r.status_code != 200:
+        raise RuntimeError(f"{path}: {r.status_code}")
+    out.write(r.content.decode(errors="replace"))
+
+
+@command("fs.mkdir", "fs.mkdir <dir>")
+def fs_mkdir(env, args, out):
+    path = _resolve(env, args[0])
+    d, name = path.rsplit("/", 1)
+    entry = filer_pb2.Entry(name=name, is_directory=True)
+    entry.attributes.file_mode = 0o40775
+    _stub(env).CreateEntry(filer_pb2.CreateEntryRequest(
+        directory=d or "/", entry=entry), timeout=10)
+    print(f"created {path}", file=out)
+
+
+@command("fs.rm", "fs.rm [-r] <path>")
+def fs_rm(env, args, out):
+    recursive = "-r" in args or "-rf" in args
+    args = [a for a in args if not a.startswith("-")]
+    path = _resolve(env, args[0])
+    d, name = path.rsplit("/", 1)
+    resp = _stub(env).DeleteEntry(filer_pb2.DeleteEntryRequest(
+        directory=d or "/", name=name, is_delete_data=True,
+        is_recursive=recursive), timeout=60)
+    if resp.error:
+        raise RuntimeError(resp.error)
+    print(f"removed {path}", file=out)
+
+
+@command("fs.mv", "fs.mv <src> <dst>")
+def fs_mv(env, args, out):
+    src = _resolve(env, args[0])
+    dst = _resolve(env, args[1])
+    if _find(env, dst) is not None and _find(env, dst).is_directory:
+        dst = dst.rstrip("/") + "/" + src.rsplit("/", 1)[-1]
+    od, on = src.rsplit("/", 1)
+    nd, nn = dst.rsplit("/", 1)
+    _stub(env).AtomicRenameEntry(filer_pb2.AtomicRenameEntryRequest(
+        old_directory=od or "/", old_name=on,
+        new_directory=nd or "/", new_name=nn), timeout=60)
+    print(f"moved {src} -> {dst}", file=out)
+
+
+# -- metadata save/load (command_fs_meta_save.go) --------------------------
+# File format: repeated [4-byte big-endian length][FullEntry proto] records,
+# the same framing the reference writes.
+
+@command("fs.meta.save", "fs.meta.save -o=meta.bin [dir]")
+def fs_meta_save(env, args, out):
+    output = "meta.bin"
+    rest = []
+    for a in args:
+        if a.startswith("-o="):
+            output = a[3:]
+        else:
+            rest.append(a)
+    path = _resolve(env, rest[0] if rest else None)
+    count = 0
+    with open(output, "wb") as f:
+        def walk(d):
+            nonlocal count
+            for e in _list(env, d):
+                blob = filer_pb2.FullEntry(dir=d, entry=e) \
+                    .SerializeToString()
+                f.write(struct.pack(">I", len(blob)) + blob)
+                count += 1
+                if e.is_directory:
+                    walk(d.rstrip("/") + "/" + e.name)
+
+        walk(path)
+    print(f"saved {count} entries from {path} to {output}", file=out)
+
+
+@command("fs.meta.load", "fs.meta.load meta.bin")
+def fs_meta_load(env, args, out):
+    stub = _stub(env)
+    count = 0
+    with open(args[0], "rb") as f:
+        while True:
+            hdr = f.read(4)
+            if len(hdr) < 4:
+                break
+            (n,) = struct.unpack(">I", hdr)
+            fe = filer_pb2.FullEntry.FromString(f.read(n))
+            stub.CreateEntry(filer_pb2.CreateEntryRequest(
+                directory=fe.dir, entry=fe.entry), timeout=30)
+            count += 1
+    print(f"loaded {count} entries", file=out)
+
+
+@command("fs.meta.cat", "fs.meta.cat <path> — print entry metadata")
+def fs_meta_cat(env, args, out):
+    path = _resolve(env, args[0])
+    e = _find(env, path)
+    if e is None:
+        raise RuntimeError(f"{path}: not found")
+    print(e, file=out)

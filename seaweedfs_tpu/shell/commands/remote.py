@@ -1,0 +1,81 @@
+"""remote.* shell commands: cloud-tier mounts.
+
+Rebuild of /root/reference/weed/shell/command_remote_*.go
+(remote.configure, remote.mount, remote.unmount, remote.meta.sync,
+remote.cache, remote.uncache).
+"""
+
+from __future__ import annotations
+
+import json
+
+from ...remote_storage import RemoteConf, RemoteGateway
+from ..registry import command
+
+
+@command("remote.configure",
+         "remote.configure -name=x -type=local|s3 [-root=...|-endpoint=...]")
+def remote_configure(env, args, out):
+    opts = _kv(args)
+    conf = RemoteConf(env.require_filer())
+    if not opts:
+        print(json.dumps(conf.load().get("storages", {}), indent=2),
+              file=out)
+        return
+    name = opts.pop("name")
+    storage = {"type": opts.pop("type", "local"), **opts}
+    conf.configure_storage(name, storage)
+    print(f"configured remote storage {name}", file=out)
+
+
+@command("remote.mount",
+         "remote.mount -dir=/buckets/x -remote=name/path")
+def remote_mount(env, args, out):
+    opts = _kv(args)
+    conf = RemoteConf(env.require_filer())
+    if not opts:
+        print(json.dumps(conf.load().get("mounts", {}), indent=2), file=out)
+        return
+    directory = opts["dir"]
+    storage, _, remote_path = opts["remote"].partition("/")
+    conf.mount(directory, storage, remote_path or "/")
+    synced = RemoteGateway(env.require_filer()).sync_dir(directory)
+    print(f"mounted {directory} -> {opts['remote']} ({synced} entries)",
+          file=out)
+
+
+@command("remote.unmount", "remote.unmount -dir=/buckets/x")
+def remote_unmount(env, args, out):
+    opts = _kv(args)
+    RemoteConf(env.require_filer()).unmount(opts["dir"])
+    print(f"unmounted {opts['dir']}", file=out)
+
+
+@command("remote.meta.sync", "remote.meta.sync -dir=/buckets/x")
+def remote_meta_sync(env, args, out):
+    opts = _kv(args)
+    n = RemoteGateway(env.require_filer()).sync_dir(opts["dir"])
+    print(f"synced {n} entries", file=out)
+
+
+@command("remote.cache", "remote.cache -dir=/buckets/x/file")
+def remote_cache(env, args, out):
+    opts = _kv(args)
+    n = RemoteGateway(env.require_filer()).cache(opts["dir"])
+    print(f"cached {n} bytes", file=out)
+
+
+@command("remote.uncache", "remote.uncache -dir=/buckets/x/file")
+def remote_uncache(env, args, out):
+    opts = _kv(args)
+    RemoteGateway(env.require_filer()).uncache(opts["dir"])
+    print(f"uncached {opts['dir']}", file=out)
+
+
+def _kv(args) -> dict:
+    out = {}
+    for a in args:
+        if a.startswith("-"):
+            k, _, v = a[1:].partition("=")
+            out[k] = v
+    return out
