@@ -24,6 +24,10 @@ def main(argv=None) -> int:
     mp.add_argument("-volumeSizeLimitMB", type=int, default=30_000)
     mp.add_argument("-defaultReplication", default="000")
     mp.add_argument("-garbageThreshold", type=float, default=0.3)
+    mp.add_argument("-peers", default="",
+                    help="comma-separated master peers for Raft HA")
+    mp.add_argument("-mdir", default="",
+                    help="directory for Raft state persistence")
 
     vp = sub.add_parser("volume", help="run a volume server")
     vp.add_argument("-dir", default="./data", help="comma-separated data dirs")
@@ -167,7 +171,10 @@ def _run(opts) -> int:
         ms = MasterServer(ip=opts.ip, port=opts.port,
                           volume_size_limit_mb=opts.volumeSizeLimitMB,
                           default_replication=opts.defaultReplication,
-                          garbage_threshold=opts.garbageThreshold)
+                          garbage_threshold=opts.garbageThreshold,
+                          peers=[p.strip() for p in opts.peers.split(",")
+                                 if p.strip()] or None,
+                          raft_dir=opts.mdir or None)
         ms.start()
         _wait_forever()
         ms.stop()

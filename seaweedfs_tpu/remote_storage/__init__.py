@@ -122,7 +122,10 @@ class S3RemoteStorage(RemoteStorageClient):
                             self.secret_key, self.region)
 
     def _url(self, path: str) -> str:
-        return f"{self.endpoint}/{self.bucket}/{path.lstrip('/')}"
+        import urllib.parse
+
+        return (f"{self.endpoint}/{self.bucket}/"
+                f"{urllib.parse.quote(path.lstrip('/'), safe='/')}")
 
     def traverse(self, prefix: str = ""):
         import urllib.parse

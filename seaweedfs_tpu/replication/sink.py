@@ -136,8 +136,11 @@ class S3Sink(ReplicationSink):
         self.region = region
 
     def _url(self, path: str) -> str:
+        import urllib.parse
+
         key = (self.dir + "/" if self.dir else "") + path.lstrip("/")
-        return f"{self.endpoint}/{self.bucket}/{key}"
+        return (f"{self.endpoint}/{self.bucket}/"
+                f"{urllib.parse.quote(key, safe='/')}")
 
     def _headers(self, method: str, url: str, payload: bytes) -> dict:
         if not self.access_key:

@@ -47,6 +47,6 @@ class FilerSource:
         size = max((c.offset + c.size for c in entry.chunks), default=0)
         buf = bytearray(size)
         for c in sorted(entry.chunks, key=lambda c: c.modified_ts_ns):
-            data = self.read_chunk(c.file_id)
-            buf[c.offset:c.offset + len(data)] = data[:c.size]
+            data = self.read_chunk(c.file_id)[:c.size]
+            buf[c.offset:c.offset + len(data)] = data
         return bytes(buf)

@@ -45,7 +45,10 @@ class MasterServer:
                  pulse_seconds: int = 5,
                  sequencer_type: str = "memory",
                  garbage_threshold: float = 0.3,
-                 allocate_fn=None):
+                 allocate_fn=None,
+                 peers: list[str] | None = None,
+                 raft_dir: str | None = None,
+                 raft_transport=None):
         self.ip = ip
         self.port = port
         self.grpc_port = rpc.derived_grpc_port(port)
@@ -66,11 +69,46 @@ class MasterServer:
         self._http_server = None
         self._vacuum_thread = None
         self._stop = threading.Event()
+        # multi-master: Raft-replicated MaxVolumeId + leader election
+        # (raft_server.go / cluster_commands.go)
+        self.raft = None
+        self._vid_propose_lock = threading.Lock()
+        if peers:
+            from ..master.raft import RaftNode
 
-    # -- leadership (single-master default) --------------------------------
+            self.raft = RaftNode(
+                self.address, peers, self._raft_apply,
+                transport=raft_transport, state_dir=raft_dir,
+                snapshot_fn=lambda: {
+                    "max_volume_id": self.topo.max_volume_id},
+                restore_fn=lambda s: self._raft_apply(
+                    {"op": "max_volume_id", "value": s["max_volume_id"]}),
+            )
+            self.topo.next_volume_id = self._raft_next_volume_id
+
+    # -- leadership --------------------------------------------------------
 
     def is_leader(self) -> bool:
-        return True
+        return self.raft is None or self.raft.role == "leader"
+
+    def leader_address(self) -> str:
+        if self.raft is None or self.raft.leader_id is None:
+            return self.address
+        return self.raft.leader_id
+
+    def _raft_apply(self, cmd: dict) -> None:
+        if cmd.get("op") == "max_volume_id":
+            with self.topo._lock:
+                self.topo.max_volume_id = max(self.topo.max_volume_id,
+                                              int(cmd["value"]))
+
+    def _raft_next_volume_id(self) -> int:
+        """Raft-committed replacement for Topology.next_volume_id
+        (MaxVolumeIdCommand, cluster_commands.go)."""
+        with self._vid_propose_lock:
+            candidate = self.topo.max_volume_id + 1
+            self.raft.propose({"op": "max_volume_id", "value": candidate})
+            return candidate
 
     @property
     def address(self) -> str:
@@ -91,10 +129,14 @@ class MasterServer:
             target=self._vacuum_loop, args=(vacuum_interval,), daemon=True
         )
         self._vacuum_thread.start()
+        if self.raft is not None:
+            self.raft.start()
         glog.info(f"master started on {self.address} (grpc :{self.grpc_port})")
 
     def stop(self) -> None:
         self._stop.set()
+        if self.raft is not None:
+            self.raft.stop()
         if self._http_server:
             self._http_server.shutdown()
         if self._grpc_server:
@@ -105,6 +147,9 @@ class MasterServer:
     def assign(self, *, count: int = 1, replication: str = "",
                collection: str = "", ttl: str = "", data_center: str = "",
                rack: str = "", data_node: str = "") -> dict:
+        if not self.is_leader():
+            return {"error": f"not the leader; ask {self.leader_address()}",
+                    "leader": self.leader_address()}
         rp = ReplicaPlacement.parse(replication or self.default_replication)
         t = TTL.parse(ttl) if ttl else EMPTY_TTL
         vl = self.topo.get_layout(collection, rp, t)
@@ -254,7 +299,7 @@ class MasterGrpc:
                 dn = ms.handle_heartbeat(hb, dn)
                 yield master_pb2.HeartbeatResponse(
                     volume_size_limit=ms.topo.volume_size_limit,
-                    leader=ms.address,
+                    leader=ms.leader_address(),
                 )
         finally:
             # stream break = node presumed down (defer-unregister path)
@@ -279,7 +324,7 @@ class MasterGrpc:
                         grpc_port=dn.grpc_port, data_center=dn.data_center,
                         new_vids=sorted(dn.volumes),
                         new_ec_vids=sorted(dn.ec_shards),
-                        leader=ms.address,
+                        leader=ms.leader_address(),
                     )
                 )
             while context.is_active():
@@ -291,7 +336,22 @@ class MasterGrpc:
             with ms._keepalive_mu:
                 ms._keepalive_clients.pop(key, None)
 
+    def _leader_stub(self):
+        """Stub to the Raft leader, or None when we are it. Followers hold
+        no topology (volume servers heartbeat only to the leader), so
+        lookups are proxied (the reference redirects the same way)."""
+        ms = self.ms
+        if ms.is_leader() or ms.leader_address() == ms.address:
+            return None
+        return rpc.master_stub(rpc.grpc_address(ms.leader_address()))
+
     def LookupVolume(self, request, context):
+        leader = self._leader_stub()
+        if leader is not None:
+            try:
+                return leader.LookupVolume(request, timeout=10)
+            except grpc.RpcError:
+                pass  # fall through to (possibly stale) local view
         resp = master_pb2.LookupVolumeResponse()
         for vof in request.volume_or_file_ids:
             entry = resp.volume_id_locations.add(volume_or_file_id=vof)
@@ -354,6 +414,14 @@ class MasterGrpc:
         )
 
     def LookupEcVolume(self, request, context):
+        leader = self._leader_stub()
+        if leader is not None:
+            try:
+                return leader.LookupEcVolume(request, timeout=10)
+            except grpc.RpcError as e:
+                if e.code() == grpc.StatusCode.NOT_FOUND:
+                    context.abort(grpc.StatusCode.NOT_FOUND,
+                                  f"ec volume {request.volume_id} not found")
         shard_locs = self.ms.topo.lookup_ec_shards(request.volume_id)
         if not shard_locs:
             context.abort(grpc.StatusCode.NOT_FOUND,
@@ -372,7 +440,7 @@ class MasterGrpc:
 
     def GetMasterConfiguration(self, request, context):
         return master_pb2.GetMasterConfigurationResponse(
-            leader=self.ms.address,
+            leader=self.ms.leader_address(),
             default_replication=self.ms.default_replication,
             volume_size_limit_m_b=self.ms.topo.volume_size_limit // (1024 * 1024),
         )
@@ -441,6 +509,16 @@ def _make_http_handler(ms: MasterServer):
                     "url": r["url"], "publicUrl": r["publicUrl"],
                 })
             if u.path == "/dir/lookup":
+                if not ms.is_leader() and ms.leader_address() != ms.address:
+                    import requests as _rq
+
+                    try:
+                        r = _rq.get(
+                            f"http://{ms.leader_address()}{self.path}",
+                            timeout=10)
+                        return self._json(r.json(), r.status_code)
+                    except _rq.RequestException:
+                        pass  # fall through to local (stale) view
                 vof = q.get("volumeId", q.get("fileId", ""))
                 try:
                     vid = int(str(vof).split(",")[0])
@@ -456,10 +534,16 @@ def _make_http_handler(ms: MasterServer):
                         {"url": n.url, "publicUrl": n.public_url} for n in nodes
                     ],
                 })
+            if u.path == "/cluster/raft/status":
+                if ms.raft is None:
+                    return self._json({"mode": "single-master",
+                                       "leader": ms.address})
+                return self._json(ms.raft.status())
             if u.path in ("/dir/status", "/cluster/status"):
                 total, used, files = ms.topo.statistics()
                 return self._json({
-                    "IsLeader": ms.is_leader(), "Leader": ms.address,
+                    "IsLeader": ms.is_leader(),
+                    "Leader": ms.leader_address(),
                     "Topology": {
                         "Max": total, "Size": used, "FileCount": files,
                         "DataNodes": sorted(ms.topo.nodes),
@@ -480,6 +564,18 @@ def _make_http_handler(ms: MasterServer):
                 return
             self._json({"error": "not found"}, 404)
 
-        do_POST = do_GET
+        def do_POST(self):
+            u = urlparse(self.path)
+            if u.path == "/cluster/raft":
+                if ms.raft is None:
+                    return self._json({"error": "raft not enabled"}, 400)
+                n = int(self.headers.get("Content-Length") or 0)
+                req = json.loads(self.rfile.read(n) or b"{}")
+                handler = getattr(ms.raft, "handle_" + req.get("method", ""),
+                                  None)
+                if handler is None:
+                    return self._json({"error": "unknown raft method"}, 400)
+                return self._json(handler(req.get("payload", {})))
+            return self.do_GET()
 
     return Handler

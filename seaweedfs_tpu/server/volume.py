@@ -60,8 +60,9 @@ class VolumeServer:
         self.ip = ip
         self.port = port
         self.grpc_port = rpc.derived_grpc_port(port)
-        self.master = master  # HTTP address; gRPC is +10000
-        self.master_grpc = rpc.grpc_address(master)
+        self.masters = [m.strip() for m in master.split(",") if m.strip()]
+        self.master = self.masters[0]  # HTTP address; gRPC is +10000
+        self.master_grpc = rpc.grpc_address(self.master)
         self.pulse_seconds = pulse_seconds
         self.ec_geometry = ec_geometry
         self.store = Store(
@@ -112,6 +113,16 @@ class VolumeServer:
                 self._do_heartbeat()
             except grpc.RpcError as e:
                 glog.v(1, f"heartbeat to {self.master} failed: {e.code()}")
+                # rotate to the next configured master; a leader redirect
+                # may have pointed self.master outside the configured list
+                if len(self.masters) > 1:
+                    if self.master in self.masters:
+                        i = self.masters.index(self.master)
+                        nxt = self.masters[(i + 1) % len(self.masters)]
+                    else:
+                        nxt = self.masters[0]
+                    self.master = nxt
+                    self.master_grpc = rpc.grpc_address(nxt)
             if not self._stop.is_set():
                 self._stop.wait(1.0)
 
@@ -127,6 +138,12 @@ class VolumeServer:
         for resp in stub.SendHeartbeat(requests()):
             if resp.volume_size_limit:
                 self.volume_size_limit = resp.volume_size_limit
+            if resp.leader and resp.leader != self.master:
+                # follow the Raft leader (checkWithMaster redirect)
+                glog.info(f"heartbeat redirected to leader {resp.leader}")
+                self.master = resp.leader
+                self.master_grpc = rpc.grpc_address(resp.leader)
+                return
             VOLUME_SERVER_VOLUME_COUNTER.set(
                 sum(len(l.volumes) for l in self.store.locations)
             )

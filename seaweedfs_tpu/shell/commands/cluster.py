@@ -38,3 +38,39 @@ def cluster_status(env, args, out):
     print(f"capacity: {stats.total_size}", file=out)
     print(f"used:     {stats.used_size}", file=out)
     print(f"files:    {stats.file_count}", file=out)
+
+
+@command("cluster.raft.ps", "show Raft membership and roles")
+def cluster_raft_ps(env, args, out):
+    """command_cluster_raft_ps.go: query each master's raft status."""
+    import requests
+
+    seen = set()
+    frontier = [env.master]
+    while frontier:
+        m = frontier.pop()
+        if m in seen:
+            continue
+        seen.add(m)
+        try:
+            st = requests.get(f"http://{m}/cluster/raft/status",
+                              timeout=5).json()
+        except requests.RequestException as e:
+            print(f"  {m}: unreachable ({e})", file=out)
+            continue
+        if st.get("mode") == "single-master":
+            print(f"  {m}: single-master (leader)", file=out)
+            continue
+        print(f"  {m}: {st['role']} term={st['term']} "
+              f"commit={st['commit_index']} leader={st['leader']}",
+              file=out)
+        frontier.extend(p for p in st.get("peers", []) if p not in seen)
+
+
+@command("cluster.raft.leader", "print the current Raft leader")
+def cluster_raft_leader(env, args, out):
+    import requests
+
+    st = requests.get(f"http://{env.master}/cluster/raft/status",
+                      timeout=5).json()
+    print(st.get("leader", env.master), file=out)
