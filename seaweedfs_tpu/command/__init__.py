@@ -38,6 +38,9 @@ def main(argv=None) -> int:
     vp.add_argument("-dataCenter", default="")
     vp.add_argument("-rack", default="")
     vp.add_argument("-coder", default="tpu", choices=["tpu", "jax", "cpu", "native"])
+    vp.add_argument("-tierConfig", default="",
+                    help="JSON file of tier backends, e.g. "
+                         '{"local": {"default": {"root": "/mnt/tier"}}}')
 
     fp = sub.add_parser("filer", help="run a filer server")
     fp.add_argument("-ip", default="localhost")
@@ -139,6 +142,28 @@ def main(argv=None) -> int:
     mnt.add_argument("-replication", default="")
     mnt.add_argument("-cacheDir", default="")
 
+    bk = sub.add_parser("backup", help="backup a live volume locally")
+    bk.add_argument("-master", default="localhost:9333")
+    bk.add_argument("-server", default="", help="volume server (else lookup)")
+    bk.add_argument("-volumeId", type=int, required=True)
+    bk.add_argument("-dir", default=".")
+
+    cpt = sub.add_parser("compact", help="offline-compact a local volume")
+    cpt.add_argument("-dir", default=".")
+    cpt.add_argument("-volumeId", type=int, required=True)
+    cpt.add_argument("-collection", default="")
+
+    fxp = sub.add_parser("fix", help="rebuild .idx from .dat")
+    fxp.add_argument("-dir", default=".")
+    fxp.add_argument("-volumeId", type=int, required=True)
+    fxp.add_argument("-collection", default="")
+
+    exp = sub.add_parser("export", help="extract files from a local volume")
+    exp.add_argument("-dir", default=".")
+    exp.add_argument("-volumeId", type=int, required=True)
+    exp.add_argument("-collection", default="")
+    exp.add_argument("-o", dest="output", default="./export")
+
     sub.add_parser("version", help="print version")
     scp = sub.add_parser("scaffold", help="print a sample config")
     scp.add_argument("-config", default="filer",
@@ -190,10 +215,17 @@ def _run(opts) -> int:
             maxes = maxes * len(dirs)
         coder = (None if opts.coder in ("tpu", "jax")
                  else new_coder(backend=opts.coder))
+        tier_conf = None
+        if opts.tierConfig:
+            import json as _json
+
+            with open(opts.tierConfig) as f:
+                tier_conf = _json.load(f)
         vsrv = VolumeServer(directories=dirs, master=opts.mserver,
                             ip=opts.ip, port=opts.port,
                             data_center=opts.dataCenter, rack=opts.rack,
-                            max_volume_counts=maxes, coder=coder)
+                            max_volume_counts=maxes, coder=coder,
+                            tier_backends=tier_conf)
         vsrv.start()
         _wait_forever()
         vsrv.stop()
@@ -415,6 +447,13 @@ def _run(opts) -> int:
         finally:
             wfs.close()
         return 0
+
+    if opts.cmd in ("backup", "compact", "fix", "export"):
+        from . import tools
+
+        return {"backup": tools.run_backup, "compact": tools.run_compact,
+                "fix": tools.run_fix, "export": tools.run_export}[opts.cmd](
+                    opts)
 
     if opts.cmd == "scaffold":
         from .scaffold import print_scaffold

@@ -117,6 +117,11 @@ class RaftNode:
         self._election_deadline = 0.0
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(
+            max_workers=max(4, 2 * len(self.peers)),
+            thread_name_prefix=f"raft-{node_id}")
         self._load_state()
 
     # -- persistence (raft_server.go resumeState) --------------------------
@@ -218,6 +223,7 @@ class RaftNode:
         self._stop.set()
         with self._mu:
             self._persist()
+        self._pool.shutdown(wait=False, cancel_futures=True)
 
     def _reset_election_timer(self) -> None:
         self._election_deadline = time.monotonic() + random.uniform(
@@ -345,12 +351,9 @@ class RaftNode:
         if len(payloads) == 1:
             peer, payload = next(iter(payloads.items()))
             return {peer: self.transport.call(peer, method, payload)}
-        from concurrent.futures import ThreadPoolExecutor
-
-        with ThreadPoolExecutor(max_workers=len(payloads)) as pool:
-            futs = {p: pool.submit(self.transport.call, p, method, pl)
-                    for p, pl in payloads.items()}
-            return {p: f.result() for p, f in futs.items()}
+        futs = {p: self._pool.submit(self.transport.call, p, method, pl)
+                for p, pl in payloads.items()}
+        return {p: f.result() for p, f in futs.items()}
 
     def _broadcast_append(self) -> None:
         with self._mu:
@@ -429,6 +432,12 @@ class RaftNode:
                     raise TimeoutError(
                         f"command at index {entry.index} not committed")
                 self._commit_cv.wait(remaining)
+            # commit advanced past our index, but a new leader may have
+            # overwritten it — success only if OUR entry (same term) is
+            # what got committed (Raft §5.4.2)
+            committed = self._entry_at(entry.index)
+            if committed is None or committed.term != entry.term:
+                raise NotLeader(self.leader_id)
         return entry.index
 
     def status(self) -> dict:

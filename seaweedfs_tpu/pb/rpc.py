@@ -93,6 +93,10 @@ VOLUME_SERVICE = ("volume_server_pb.VolumeServer", [
     _m("VolumeEcShardRead", V.VolumeEcShardReadRequest, V.VolumeEcShardReadResponse, ss=True),
     _m("VolumeEcBlobDelete", V.VolumeEcBlobDeleteRequest, V.VolumeEcBlobDeleteResponse),
     _m("VolumeEcShardsToVolume", V.VolumeEcShardsToVolumeRequest, V.VolumeEcShardsToVolumeResponse),
+    _m("VolumeTierMoveDatToRemote", V.VolumeTierMoveDatToRemoteRequest,
+       V.VolumeTierMoveDatToRemoteResponse, ss=True),
+    _m("VolumeTierMoveDatFromRemote", V.VolumeTierMoveDatFromRemoteRequest,
+       V.VolumeTierMoveDatFromRemoteResponse, ss=True),
     _m("VolumeServerStatus", V.VolumeServerStatusRequest, V.VolumeServerStatusResponse),
     _m("VolumeServerLeave", V.VolumeServerLeaveRequest, V.VolumeServerLeaveResponse),
     _m("Ping", V.PingRequest, V.PingResponse),

@@ -56,7 +56,12 @@ class VolumeServer:
                  public_url: str = "", data_center: str = "", rack: str = "",
                  max_volume_counts: list[int] | None = None,
                  pulse_seconds: int = 5, coder=None,
-                 ec_geometry: Geometry = Geometry()):
+                 ec_geometry: Geometry = Geometry(),
+                 tier_backends: dict | None = None):
+        if tier_backends:
+            from ..storage.backend import load_tier_backends
+
+            load_tier_backends(tier_backends)
         self.ip = ip
         self.port = port
         self.grpc_port = rpc.derived_grpc_port(port)
@@ -190,7 +195,7 @@ class VolumeServer:
                           soff: int, size: int) -> bytes:
         f = ev.shard_files.get(sid)
         if f is not None:
-            data = os.pread(f.fileno(), size, soff)
+            data = f.read_at(soff, size)
             return data + b"\0" * (size - len(data))
         locs = self._lookup_ec_shards(vid)
         for addr in locs.get(sid, []):
@@ -220,7 +225,7 @@ class VolumeServer:
         geo = ev.geo
         bufs: dict[int, np.ndarray] = {}
         for i, f in ev.shard_files.items():
-            data = os.pread(f.fileno(), size, soff)
+            data = f.read_at(soff, size)
             bufs[i] = np.frombuffer(data + b"\0" * (size - len(data)), np.uint8)
 
         missing = [
@@ -713,7 +718,7 @@ class VolumeGrpc:
         remaining = request.size
         off = request.offset
         while remaining > 0:
-            chunk = os.pread(f.fileno(), min(BUFFER_SIZE_LIMIT, remaining), off)
+            chunk = f.read_at(off, min(BUFFER_SIZE_LIMIT, remaining))
             if not chunk:
                 break
             yield vs.VolumeEcShardReadResponse(data=chunk)
@@ -748,6 +753,37 @@ class VolumeGrpc:
         return vs.VolumeEcShardsToVolumeResponse()
 
     # ---- status / leave / ping
+
+    # -- tiered storage (volume_grpc_tier_upload/download.go) --------------
+
+    def VolumeTierMoveDatToRemote(self, request, context):
+        from ..storage.backend import get_tier_backend
+
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        try:
+            backend = get_tier_backend(request.destination_backend_name)
+        except KeyError as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+        moved = v.tier_to_remote(
+            backend, keep_local=request.keep_local_dat_file)
+        yield vs.VolumeTierMoveDatToRemoteResponse(
+            processed=moved, processed_percentage=100.0)
+
+    def VolumeTierMoveDatFromRemote(self, request, context):
+        v = self.store.find_volume(request.volume_id)
+        if v is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        try:
+            moved = v.tier_from_remote(
+                keep_remote=request.keep_remote_dat_file)
+        except IOError as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+        yield vs.VolumeTierMoveDatFromRemoteResponse(
+            processed=moved, processed_percentage=100.0)
 
     def VolumeServerStatus(self, request, context):
         resp = vs.VolumeServerStatusResponse(

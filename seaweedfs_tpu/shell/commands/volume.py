@@ -264,3 +264,42 @@ def volume_server_leave(env, args, out):
     env.volume_stub(opts.node).VolumeServerLeave(
         vs.VolumeServerLeaveRequest(), timeout=30)
     print(f"{opts.node} asked to leave", file=out)
+
+
+@command("volume.tier.upload", "move a sealed volume's .dat to a tier backend")
+def volume_tier_upload(env, args, out):
+    """command_volume_tier_upload.go: .dat -> remote backend, reads
+    range-fetch afterward."""
+    p = argparse.ArgumentParser(prog="volume.tier.upload")
+    p.add_argument("-node", required=True)
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-dest", required=True, help="tier backend name")
+    p.add_argument("-keepLocalDatFile", action="store_true")
+    opts = p.parse_args(args)
+    env.confirm_is_locked()
+    stub = env.volume_stub(opts.node)
+    for resp in stub.VolumeTierMoveDatToRemote(
+            vs.VolumeTierMoveDatToRemoteRequest(
+                volume_id=opts.volumeId,
+                destination_backend_name=opts.dest,
+                keep_local_dat_file=opts.keepLocalDatFile), timeout=3600):
+        print(f"moved {resp.processed} bytes "
+              f"({resp.processed_percentage:.0f}%)", file=out)
+
+
+@command("volume.tier.download", "bring a tiered volume's .dat back to disk")
+def volume_tier_download(env, args, out):
+    """command_volume_tier_download.go."""
+    p = argparse.ArgumentParser(prog="volume.tier.download")
+    p.add_argument("-node", required=True)
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-keepRemoteDatFile", action="store_true")
+    opts = p.parse_args(args)
+    env.confirm_is_locked()
+    stub = env.volume_stub(opts.node)
+    for resp in stub.VolumeTierMoveDatFromRemote(
+            vs.VolumeTierMoveDatFromRemoteRequest(
+                volume_id=opts.volumeId,
+                keep_remote_dat_file=opts.keepRemoteDatFile), timeout=3600):
+        print(f"downloaded {resp.processed} bytes "
+              f"({resp.processed_percentage:.0f}%)", file=out)

@@ -145,16 +145,18 @@ class EcVolume:
         # an intra-buffer seek)
         self._ecx_file = open(self.ecx_path, "rb", buffering=0)
         self._ecx_size = os.path.getsize(self.ecx_path)
-        self.shard_files: dict[int, object] = {}
+        # shards are immutable once encoded -> mmap for zero-copy reads
+        # (backend.py MmapFile; the reference's memory_map/ backend)
+        from .backend import MmapFile
+
+        self.shard_files: dict[int, MmapFile] = {}
         for i in range(geo.total_shards):
             p = geo.shard_file_name(base_file_name, i)
             if os.path.exists(p):
-                self.shard_files[i] = open(p, "rb")
+                self.shard_files[i] = MmapFile(p)
         if not self.shard_files:
             raise FileNotFoundError(f"no shards for {base_file_name}")
-        any_shard = next(iter(self.shard_files.values()))
-        any_shard.seek(0, 2)
-        self.shard_size = any_shard.tell()
+        self.shard_size = next(iter(self.shard_files.values())).size()
 
     def close(self) -> None:
         for f in self.shard_files.values():
@@ -197,8 +199,7 @@ class EcVolume:
     def _read_interval(self, shard_id: int, shard_off: int, size: int) -> bytes:
         f = self.shard_files.get(shard_id)
         if f is not None:
-            f.seek(shard_off)
-            data = f.read(size)
+            data = f.read_at(shard_off, size)
             if len(data) == size:
                 return data
             data += b"\0" * (size - len(data))
@@ -209,8 +210,7 @@ class EcVolume:
         for i, sf in self.shard_files.items():
             if len(bufs) == self.geo.data_shards:
                 break
-            sf.seek(shard_off)
-            chunk = sf.read(size)
+            chunk = sf.read_at(shard_off, size)
             chunk += b"\0" * (size - len(chunk))
             bufs[i] = np.frombuffer(chunk, dtype=np.uint8)
         if len(bufs) < self.geo.data_shards:

@@ -24,6 +24,7 @@ from .ttl import EMPTY_TTL, TTL
 from .volume import Volume
 
 _VOLUME_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.dat$")
+_TIER_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.tier$")
 _ECX_RE = re.compile(r"^(?:(?P<col>.+)_)?(?P<vid>\d+)\.ecx$")
 
 
@@ -53,6 +54,15 @@ class DiskLocation:
                 vols[int(m.group("vid"))] = (
                     m.group("col") or "", os.path.join(self.directory, name)
                 )
+                continue
+            m = _TIER_RE.match(name)
+            if m:
+                # tiered volume: .dat moved to a remote backend, .tier
+                # sidecar + local .idx remain
+                vols.setdefault(
+                    int(m.group("vid")),
+                    (m.group("col") or "",
+                     os.path.join(self.directory, name)))
                 continue
             m = _ECX_RE.match(name)
             if m:
@@ -92,11 +102,18 @@ class Store:
     #    disk_location_ec.go loadAllEcShards) ------------------------------
 
     def load_existing_volumes(self) -> None:
+        from ..utils import glog
+
         for loc in self.locations:
             vols, ecs = loc.scan()
             for vid, (col, _path) in vols.items():
                 if vid not in loc.volumes:
-                    loc.volumes[vid] = Volume(loc.directory, col, vid)
+                    try:
+                        loc.volumes[vid] = Volume(loc.directory, col, vid)
+                    except Exception as e:
+                        # one unloadable volume (e.g. a .tier sidecar whose
+                        # backend isn't configured) must not down the server
+                        glog.error(f"skip loading volume {vid}: {e}")
             for vid, (col, _path) in ecs.items():
                 if vid not in loc.ec_volumes:
                     try:

@@ -112,6 +112,10 @@ class NeedleMap:
         os.remove(self.idx_path)
 
 
+class TieredVolumeUnavailable(IOError):
+    """A .tier sidecar points at a backend we can't reach/resolve."""
+
+
 class Volume:
     """One append-only needle volume (volume.go:26-60)."""
 
@@ -134,15 +138,44 @@ class Volume:
         self.last_modified_ts_seconds = 0
         self.is_compacting = False
         self._lock = threading.RLock()
+        self.remote_dat = None  # set when the .dat lives on a tier backend
         base = self.file_name()
         dat_exists = os.path.exists(base + ".dat")
-        if dat_exists:
-            self._dat = open(base + ".dat", "r+b")
+        sidecar = None
+        if not dat_exists:
+            from .backend import read_tier_sidecar
+
+            sidecar = read_tier_sidecar(base)
+        from .backend import DiskFile
+
+        if sidecar is not None:
+            # tiered volume: .dat on a remote backend, .idx stays local.
+            # Backend resolution / first remote read can fail (backend not
+            # configured, endpoint down) — raise a tagged error the store
+            # catches so ONE bad volume can't down the whole server.
+            import io
+
+            from .backend import RemoteDatFile, get_tier_backend
+
+            self._dat = None
+            try:
+                self.remote_dat = RemoteDatFile(
+                    get_tier_backend(sidecar["backend"]), sidecar["key"],
+                    sidecar["size"])
+                self.super_block = SuperBlock.from_file(
+                    io.BytesIO(self.remote_dat.read_at(0, 64)))
+            except Exception as e:
+                raise TieredVolumeUnavailable(
+                    f"volume {vid}: tier backend "
+                    f"{sidecar['backend']!r}: {e}") from e
+            self.read_only = True
+        elif dat_exists:
+            self._dat = DiskFile(base + ".dat")
             self.super_block = SuperBlock.from_file(self._dat)
         else:
             from .super_block import ReplicaPlacement
 
-            self._dat = open(base + ".dat", "w+b")
+            self._dat = DiskFile(base + ".dat", create=True)
             self.super_block = SuperBlock(
                 version=version,
                 replica_placement=replica_placement or ReplicaPlacement(),
@@ -171,8 +204,13 @@ class Volume:
     # -- size / stats ------------------------------------------------------
 
     def data_size(self) -> int:
-        self._dat.seek(0, 2)
-        return self._dat.tell()
+        if self._dat is None:
+            return self.remote_dat.size()
+        return self._dat.seek_end()
+
+    @property
+    def is_tiered(self) -> bool:
+        return self.remote_dat is not None
 
     def content_size(self) -> int:
         return self.nm.content_size
@@ -188,6 +226,8 @@ class Volume:
 
     def garbage_level(self) -> float:
         """deleted bytes / total content bytes (volume_vacuum hook)."""
+        if self.is_tiered:
+            return 0.0  # immutable remotely; vacuum must not pick it up
         if self.content_size() == 0:
             return 0.0
         return self.nm.deletion_byte_counter / self.content_size()
@@ -195,7 +235,8 @@ class Volume:
     # -- write path --------------------------------------------------------
 
     def _pread(self, offset: int, length: int) -> bytes:
-        return os.pread(self._dat.fileno(), length, offset)
+        backend = self.remote_dat if self._dat is None else self._dat
+        return backend.read_at(offset, length)
 
     def _read_header_at(self, offset: int) -> Needle:
         b = self._pread(offset, types.NEEDLE_HEADER_SIZE)
@@ -230,8 +271,9 @@ class Volume:
             return offset, n.size, False
 
     def _append_record(self, n: Needle) -> int:
-        self._dat.seek(0, 2)
-        offset = self._dat.tell()
+        if self._dat is None:
+            raise IOError(f"volume {self.id} is tiered (read only)")
+        offset = self._dat.seek_end()
         if offset % types.NEEDLE_PADDING_SIZE != 0:
             # realign a torn tail (Needle.Append alignment guard)
             offset += types.NEEDLE_PADDING_SIZE - (offset % types.NEEDLE_PADDING_SIZE)
@@ -412,6 +454,9 @@ class Volume:
     def compact(self) -> None:
         """Compact2 (volume_vacuum.go:67): copy live needles into .cpd/.cpx."""
         with self._lock:
+            if self._dat is None:
+                raise IOError(
+                    f"volume {self.id} is tiered; download before vacuum")
             self.is_compacting = True
             self._compact_idx_snapshot = os.path.getsize(self.nm.idx_path)
         try:
@@ -449,7 +494,9 @@ class Volume:
             self.nm.close()
             os.replace(base + ".cpd", base + ".dat")
             os.replace(base + ".cpx", base + ".idx")
-            self._dat = open(base + ".dat", "r+b")
+            from .backend import DiskFile
+
+            self._dat = DiskFile(base + ".dat")
             self.super_block = SuperBlock.from_file(self._dat)
             self.nm = NeedleMap(base + ".idx")
             self.is_compacting = False
@@ -486,8 +533,67 @@ class Volume:
 
     def close(self) -> None:
         with self._lock:
-            self._dat.close()
+            if self._dat is not None:
+                self._dat.close()
             self.nm.close()
+
+    # -- tiering (volume_tier.go + VolumeTierMoveDat* RPC backends) --------
+
+    def tier_key(self) -> str:
+        prefix = f"{self.collection}_" if self.collection else ""
+        return f"{prefix}{self.id}.dat"
+
+    def tier_to_remote(self, backend, keep_local: bool = False,
+                       progress_fn=None) -> int:
+        """Upload the sealed .dat to a tier backend; reads then range-fetch
+        remotely. -> bytes moved."""
+        from .backend import RemoteDatFile, write_tier_sidecar
+
+        with self._lock:
+            if self._dat is None:
+                raise IOError(f"volume {self.id} is already tiered")
+            was_read_only = self.read_only
+            self.read_only = True
+            try:
+                self._dat.flush()
+                base = self.file_name()
+                size = self.data_size()
+                moved = backend.upload(self.tier_key(), base + ".dat")
+            except BaseException:
+                self.read_only = was_read_only  # failed upload: stay local
+                raise
+            if progress_fn:
+                progress_fn(moved)
+            write_tier_sidecar(base, backend.name, self.tier_key(), size)
+            self._dat.close()
+            self._dat = None
+            self.remote_dat = RemoteDatFile(backend, self.tier_key(), size)
+            if not keep_local:
+                os.remove(base + ".dat")
+            return moved
+
+    def tier_from_remote(self, keep_remote: bool = False,
+                         progress_fn=None) -> int:
+        """Bring a tiered .dat back to local disk. -> bytes moved."""
+        from .backend import tier_sidecar_path
+
+        with self._lock:
+            if self.remote_dat is None:
+                raise IOError(f"volume {self.id} is not tiered")
+            base = self.file_name()
+            moved = self.remote_dat.backend.download(
+                self.remote_dat.key, base + ".dat")
+            if progress_fn:
+                progress_fn(moved)
+            if not keep_remote:
+                self.remote_dat.backend.delete(self.remote_dat.key)
+            os.remove(tier_sidecar_path(base))
+            from .backend import DiskFile
+
+            self.remote_dat = None
+            self._dat = DiskFile(base + ".dat")
+            self.read_only = False
+            return moved
 
     def destroy(self) -> None:
         """Remove every file of this volume (Destroy, volume_write.go:55-85).

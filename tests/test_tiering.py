@@ -1,0 +1,225 @@
+"""Backend tiering + offline volume tools (SURVEY.md §2.1 backend row,
+weed/storage/backend + command/backup|compact|fix|export)."""
+
+import os
+import socket
+import time
+from types import SimpleNamespace
+
+import numpy as np
+import pytest
+import requests
+
+from seaweedfs_tpu.command.tools import (
+    run_backup,
+    run_compact,
+    run_export,
+    run_fix,
+)
+from seaweedfs_tpu.pb import rpc, volume_server_pb2 as vs
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+from seaweedfs_tpu.storage.backend import (
+    DiskFile,
+    LocalTierBackend,
+    MmapFile,
+    RemoteDatFile,
+    register_tier_backend,
+)
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _fill_volume(tmp_path, vid=1, count=20):
+    v = Volume(str(tmp_path), "", vid)
+    rng = np.random.default_rng(vid)
+    payloads = {}
+    for i in range(1, count + 1):
+        data = rng.integers(0, 256, size=500 + i * 37,
+                            dtype=np.uint8).tobytes()
+        v.write_needle(Needle.create(i, 0x1234, data))
+        payloads[i] = data
+    return v, payloads
+
+
+# -- backend primitives ----------------------------------------------------
+
+def test_disk_and_mmap_files(tmp_path):
+    p = str(tmp_path / "f.bin")
+    d = DiskFile(p, create=True)
+    assert d.append(b"hello") == 0
+    d.write_at(5, b" world")
+    d.flush()
+    assert d.read_at(0, 11) == b"hello world"
+    assert d.size() == 11
+    m = MmapFile(p)
+    assert m.read_at(6, 5) == b"world"
+    m.close()
+    d.close()
+
+
+def test_local_tier_backend_roundtrip(tmp_path):
+    b = LocalTierBackend(str(tmp_path / "tier"))
+    src = tmp_path / "src.bin"
+    src.write_bytes(b"x" * 1000)
+    assert b.upload("1.dat", str(src)) == 1000
+    assert b.read_range("1.dat", 10, 5) == b"xxxxx"
+    dst = tmp_path / "dst.bin"
+    assert b.download("1.dat", str(dst)) == 1000
+    r = RemoteDatFile(b, "1.dat", 1000)
+    assert r.read_at(990, 100) == b"x" * 10  # clamped at size
+    b.delete("1.dat")
+
+
+# -- volume tiering --------------------------------------------------------
+
+def test_volume_tier_roundtrip(tmp_path):
+    backend = register_tier_backend(
+        LocalTierBackend(str(tmp_path / "cloud"), name="testtier"))
+    os.makedirs(tmp_path / "vols", exist_ok=True)
+    v, payloads = _fill_volume(tmp_path / "vols")
+    size_before = v.data_size()
+    moved = v.tier_to_remote(backend)
+    assert moved == size_before
+    assert v.is_tiered and v.read_only
+    assert not os.path.exists(v.file_name() + ".dat")
+    # reads now range-fetch from the backend
+    for nid, data in payloads.items():
+        assert v.read_needle(nid).data == data
+    with pytest.raises(IOError):
+        v.write_needle(Needle(id=999, cookie=1, data=b"nope"))
+    v.close()
+    # reload from disk: sidecar routes reads to the tier
+    v2 = Volume(str(tmp_path / "vols"), "", 1)
+    assert v2.is_tiered
+    assert v2.read_needle(5).data == payloads[5]
+    # bring it back local
+    back = v2.tier_from_remote()
+    assert back == size_before and not v2.is_tiered
+    assert v2.read_needle(7).data == payloads[7]
+    assert not v2.read_only
+    v2.close()
+
+
+def test_tiered_volume_served_over_cluster(tmp_path):
+    register_tier_backend(
+        LocalTierBackend(str(tmp_path / "cloud"), name="srvtier"))
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport, volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "v")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), pulse_seconds=1)
+    vsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    try:
+        # write a file, tier the volume via gRPC, then read through HTTP
+        r = requests.get(f"http://localhost:{mport}/dir/assign?count=1",
+                         timeout=10).json()
+        fid, url = r["fid"], r["url"]
+        payload = b"tiered-needle-payload" * 100
+        pr = requests.put(f"http://{url}/{fid}", data=payload, timeout=30)
+        assert pr.status_code == 201
+        vid = int(fid.split(",")[0])
+        stub = rpc.volume_stub(rpc.grpc_address(url))
+        got = list(stub.VolumeTierMoveDatToRemote(
+            vs.VolumeTierMoveDatToRemoteRequest(
+                volume_id=vid, destination_backend_name="srvtier"),
+            timeout=60))
+        assert got and got[0].processed > 0
+        gr = requests.get(f"http://{url}/{fid}", timeout=30)
+        assert gr.status_code == 200 and gr.content == payload
+        # and back down
+        got = list(stub.VolumeTierMoveDatFromRemote(
+            vs.VolumeTierMoveDatFromRemoteRequest(volume_id=vid),
+            timeout=60))
+        assert got and got[0].processed > 0
+        gr = requests.get(f"http://{url}/{fid}", timeout=30)
+        assert gr.content == payload
+    finally:
+        vsrv.stop()
+        master.stop()
+        rpc.reset_channels()
+
+
+# -- offline tools ---------------------------------------------------------
+
+def test_fix_rebuilds_idx(tmp_path):
+    v, payloads = _fill_volume(tmp_path, vid=3)
+    v.delete_needle(5)
+    v.close()
+    os.remove(str(tmp_path / "3.idx"))
+    run_fix(SimpleNamespace(dir=str(tmp_path), volumeId=3, collection=""))
+    v2 = Volume(str(tmp_path), "", 3)
+    assert v2.read_needle(4).data == payloads[4]
+    from seaweedfs_tpu.storage.errors import DeletedError, NotFoundError
+
+    with pytest.raises((DeletedError, NotFoundError)):
+        v2.read_needle(5)
+    v2.close()
+
+
+def test_compact_and_export(tmp_path):
+    v, payloads = _fill_volume(tmp_path, vid=4)
+    for nid in range(1, 11):
+        v.delete_needle(nid)
+    v.close()
+    run_compact(SimpleNamespace(dir=str(tmp_path), volumeId=4,
+                                collection=""))
+    v2 = Volume(str(tmp_path), "", 4)
+    assert v2.read_needle(15).data == payloads[15]
+    v2.close()
+    out = tmp_path / "exported"
+    run_export(SimpleNamespace(dir=str(tmp_path), volumeId=4,
+                               collection="", output=str(out)))
+    names = os.listdir(out)
+    assert len(names) == 10  # 20 written - 10 deleted
+
+
+def test_backup_full_and_incremental(tmp_path):
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport, volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "v")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), pulse_seconds=1)
+    vsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    try:
+        r = requests.get(f"http://localhost:{mport}/dir/assign?count=1",
+                         timeout=10).json()
+        fid, url = r["fid"], r["url"]
+        requests.put(f"http://{url}/{fid}", data=b"first-payload",
+                     timeout=30)
+        vid = int(fid.split(",")[0])
+        bdir = str(tmp_path / "backup")
+        opts = SimpleNamespace(master=f"localhost:{mport}", server=url,
+                               volumeId=vid, dir=bdir)
+        assert run_backup(opts) == 0
+        assert os.path.exists(os.path.join(bdir, f"{vid}.dat"))
+        # append more, backup again (incremental path)
+        r2 = requests.get(
+            f"http://localhost:{mport}/dir/assign?count=1", timeout=10
+        ).json()
+        if int(r2["fid"].split(",")[0]) == vid:
+            requests.put(f"http://{r2['url']}/{r2['fid']}",
+                         data=b"second-payload", timeout=30)
+        assert run_backup(opts) == 0
+        v = Volume(bdir, "", vid)
+        key = int(fid.split(",")[1][:8].lstrip("0") or "0", 16)
+        assert v.file_count() >= 1
+        v.close()
+    finally:
+        vsrv.stop()
+        master.stop()
+        rpc.reset_channels()
