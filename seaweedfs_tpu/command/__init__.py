@@ -38,6 +38,10 @@ def main(argv=None) -> int:
     vp.add_argument("-dataCenter", default="")
     vp.add_argument("-rack", default="")
     vp.add_argument("-coder", default="tpu", choices=["tpu", "jax", "cpu", "native"])
+    vp.add_argument("-index", default="memory",
+                    choices=["memory", "sqlite", "leveldb"],
+                    help="needle-map index kind (leveldb = sqlite-backed "
+                         "low-memory on-disk map)")
     vp.add_argument("-tierConfig", default="",
                     help="JSON file of tier backends, e.g. "
                          '{"local": {"default": {"root": "/mnt/tier"}}}')
@@ -48,6 +52,10 @@ def main(argv=None) -> int:
     fp.add_argument("-master", default="localhost:9333")
     fp.add_argument("-dir", default="./filer", help="metadata store directory")
     fp.add_argument("-collection", default="")
+    fp.add_argument("-store", default="sqlite",
+                    help="metadata store kind (memory|sqlite|leveldb|...)")
+    fp.add_argument("-peers", default="",
+                    help="comma-separated peer filers for HA aggregation")
 
     s3p = sub.add_parser("s3", help="run an S3 gateway")
     s3p.add_argument("-port", type=int, default=8333)
@@ -225,7 +233,10 @@ def _run(opts) -> int:
                             ip=opts.ip, port=opts.port,
                             data_center=opts.dataCenter, rack=opts.rack,
                             max_volume_counts=maxes, coder=coder,
-                            tier_backends=tier_conf)
+                            tier_backends=tier_conf,
+                            needle_map_kind=("sqlite"
+                                             if opts.index != "memory"
+                                             else "memory"))
         vsrv.start()
         _wait_forever()
         vsrv.stop()
@@ -235,7 +246,10 @@ def _run(opts) -> int:
         from ..server.filer import FilerServer
 
         fs = FilerServer(ip=opts.ip, port=opts.port, master=opts.master,
-                         store_dir=opts.dir, collection=opts.collection)
+                         store_dir=opts.dir, collection=opts.collection,
+                         store=opts.store,
+                         peers=[p.strip() for p in opts.peers.split(",")
+                                if p.strip()])
         fs.start()
         _wait_forever()
         fs.stop()

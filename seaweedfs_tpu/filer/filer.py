@@ -45,7 +45,8 @@ class Filer:
                 from_other_cluster: bool = False) -> None:
         ev = filer_pb2.EventNotification(
             delete_chunks=delete_chunks,
-            is_from_other_cluster=from_other_cluster)
+            is_from_other_cluster=from_other_cluster,
+            signatures=[self.signature])
         if old is not None:
             ev.old_entry.CopyFrom(old.to_pb())
         if new is not None:

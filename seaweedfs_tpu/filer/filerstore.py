@@ -47,10 +47,136 @@ def register_store(name: str, cls: type) -> None:
 
 
 def get_store(name: str, **kwargs) -> FilerStore:
-    from .stores import memory, sqlite  # noqa: F401 - registration side effect
+    from .stores import (  # noqa: F401 - registration side effect
+        gated,
+        leveldb,
+        memory,
+        sqlite,
+    )
 
     cls = _REGISTRY.get(name)
     if cls is None:
         raise ValueError(f"unknown filer store {name!r} "
                          f"(available: {sorted(_REGISTRY)})")
     return cls(**kwargs)
+
+
+def available_stores() -> list[str]:
+    from .stores import gated, leveldb, memory, sqlite  # noqa: F401
+
+    return sorted(_REGISTRY)
+
+
+class StoreWrapper:
+    """Instrumented pass-through (filerstore_wrapper.go): per-op counters
+    and cumulative latency, exported through utils.stats."""
+
+    def __init__(self, store: FilerStore):
+        self.store = store
+        self.name = store.name
+        from ..utils.stats import FILER_STORE_COUNTER, FILER_STORE_SECONDS
+
+        self._counter = FILER_STORE_COUNTER
+        self._seconds = FILER_STORE_SECONDS
+
+    def _timed(self, op: str, fn, *args, **kwargs):
+        import time
+
+        t0 = time.perf_counter()
+        try:
+            return fn(*args, **kwargs)
+        finally:
+            self._counter.inc(store=self.name, op=op)
+            self._seconds.inc(time.perf_counter() - t0,
+                              store=self.name, op=op)
+
+    def insert_entry(self, entry):
+        return self._timed("insert", self.store.insert_entry, entry)
+
+    def update_entry(self, entry):
+        return self._timed("update", self.store.update_entry, entry)
+
+    def find_entry(self, full_path):
+        return self._timed("find", self.store.find_entry, full_path)
+
+    def delete_entry(self, full_path):
+        return self._timed("delete", self.store.delete_entry, full_path)
+
+    def delete_folder_children(self, full_path):
+        return self._timed("deleteFolderChildren",
+                           self.store.delete_folder_children, full_path)
+
+    def list_directory_entries(self, *args, **kwargs):
+        return self._timed("list", lambda: list(
+            self.store.list_directory_entries(*args, **kwargs)))
+
+    def kv_get(self, key):
+        return self._timed("kvGet", self.store.kv_get, key)
+
+    def kv_put(self, key, value):
+        return self._timed("kvPut", self.store.kv_put, key, value)
+
+    def close(self):
+        self.store.close()
+
+
+class PathTranslatingStore:
+    """Mounts a store under a path prefix
+    (filerstore_translate_path.go): callers see `/x`, the backing store
+    sees `<root>/x`. Used for per-path store routing (fs.configure)."""
+
+    def __init__(self, store: FilerStore, root: str):
+        self.store = store
+        self.root = root.rstrip("/")
+        self.name = f"{store.name}@{root}"
+
+    def _to(self, path: str) -> str:
+        return self.root + path if path != "/" else (self.root or "/")
+
+    def _from(self, path: str) -> str:
+        if self.root and path.startswith(self.root):
+            return path[len(self.root):] or "/"
+        return path
+
+    def insert_entry(self, entry):
+        import copy
+
+        e = copy.copy(entry)
+        e.full_path = self._to(entry.full_path)
+        self.store.insert_entry(e)
+
+    def update_entry(self, entry):
+        import copy
+
+        e = copy.copy(entry)
+        e.full_path = self._to(entry.full_path)
+        self.store.update_entry(e)
+
+    def find_entry(self, full_path):
+        e = self.store.find_entry(self._to(full_path))
+        if e is not None:
+            e.full_path = self._from(e.full_path)
+        return e
+
+    def delete_entry(self, full_path):
+        self.store.delete_entry(self._to(full_path))
+
+    def delete_folder_children(self, full_path):
+        self.store.delete_folder_children(self._to(full_path))
+
+    def list_directory_entries(self, dir_path, start_file_name="",
+                               include_start=False, limit=1024, prefix=""):
+        for e in self.store.list_directory_entries(
+                self._to(dir_path), start_file_name, include_start,
+                limit, prefix):
+            e.full_path = self._from(e.full_path)
+            yield e
+
+    def kv_get(self, key):
+        return self.store.kv_get(key)
+
+    def kv_put(self, key, value):
+        return self.store.kv_put(key, value)
+
+    def close(self):
+        self.store.close()

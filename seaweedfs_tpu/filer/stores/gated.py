@@ -1,0 +1,49 @@
+"""Client-library-gated filer stores.
+
+The reference registers 21 metadata backends (SURVEY.md §2.5); the ones
+whose client libraries aren't baked into this image register here as
+gated placeholders that fail at construction with clear guidance, the
+same pattern the notification queues use. Each maps to its reference
+package under /root/reference/weed/filer/<name>/.
+"""
+
+from __future__ import annotations
+
+from ..filerstore import register_store
+
+_GATED = {
+    "rocksdb": "python-rocksdb (cgo-gated in the reference too)",
+    "redis": "redis-py",
+    "redis2": "redis-py",
+    "redis3": "redis-py",
+    "redis_lua": "redis-py",
+    "mysql": "mysql-connector / PyMySQL",
+    "mysql2": "mysql-connector / PyMySQL",
+    "postgres": "psycopg2",
+    "postgres2": "psycopg2",
+    "cassandra": "cassandra-driver",
+    "mongodb": "pymongo",
+    "elastic": "elasticsearch",
+    "etcd": "etcd3",
+    "tikv": "tikv-client",
+    "ydb": "ydb",
+    "hbase": "happybase",
+    "arangodb": "python-arango",
+}
+
+
+def _make(name: str, lib: str):
+    class GatedStore:
+        def __init__(self, **_kwargs):
+            raise RuntimeError(
+                f"filer store {name!r} needs the {lib} client library, "
+                f"which is not available in this environment; use "
+                f"`memory`, `sqlite`, or `leveldb`")
+
+    GatedStore.name = name
+    GatedStore.__name__ = f"Gated_{name}"
+    return GatedStore
+
+
+for _name, _lib in _GATED.items():
+    register_store(_name, _make(_name, _lib))

@@ -39,7 +39,8 @@ class FilerServer:
     def __init__(self, *, ip: str = "localhost", port: int = 8888,
                  master: str = "localhost:9333", store_dir: str = "",
                  store: str = "sqlite", collection: str = "",
-                 replication: str = "", chunk_size: int = CHUNK_SIZE):
+                 replication: str = "", chunk_size: int = CHUNK_SIZE,
+                 peers: list[str] | None = None):
         self.ip = ip
         self.port = port
         self.grpc_port = rpc.derived_grpc_port(port)
@@ -55,12 +56,30 @@ class FilerServer:
                 os.makedirs(store_dir, exist_ok=True)
                 db = os.path.join(store_dir, "filer.db")
             self.filer = Filer(get_store("sqlite", db_path=db))
+        elif store == "leveldb":
+            self.filer = Filer(get_store(
+                "leveldb", directory=store_dir or "./filerldb"))
         else:
             self.filer = Filer(get_store(store))
         self.master_client = MasterClient(master)
         self._http_server = None
         self._grpc_server = None
         self._session = rq.Session()
+        # multi-filer peer aggregation (meta_aggregator.go)
+        self.meta_aggregator = None
+        self._peers = [p for p in (peers or []) if p]
+
+    def _start_aggregator(self) -> None:
+        if not self._peers:
+            return
+        from ..filer.meta_aggregator import MetaAggregator
+
+        self.meta_aggregator = MetaAggregator(self.filer,
+                                              self.filer.signature)
+        for peer in self._peers:
+            if peer == self.address:
+                continue
+            self.meta_aggregator.subscribe_to_peer(rpc.grpc_address(peer))
 
     @property
     def address(self) -> str:
@@ -75,9 +94,12 @@ class FilerServer:
             ("", self.port), _make_http_handler(self))
         threading.Thread(target=self._http_server.serve_forever,
                          daemon=True).start()
+        self._start_aggregator()
         glog.info(f"filer started on {self.address} (grpc :{self.grpc_port})")
 
     def stop(self) -> None:
+        if self.meta_aggregator is not None:
+            self.meta_aggregator.close()
         if self._http_server:
             self._http_server.shutdown()
         if self._grpc_server:
@@ -336,7 +358,17 @@ class FilerGrpc:
                     continue
                 yield msg
 
-    SubscribeLocalMetadata = SubscribeMetadata
+    def SubscribeLocalMetadata(self, request, context):
+        """Locally-originated events only (filer.proto:62): peers use this
+        to aggregate without re-receiving events that were themselves
+        folded in from other peers (the origin filer's signature is the
+        first entry in the event's signature list)."""
+        own = self.filer.signature
+        for msg in self.SubscribeMetadata(request, context):
+            sigs = msg.event_notification.signatures
+            if sigs and sigs[0] != own:
+                continue
+            yield msg
 
     def KvGet(self, request, context):
         v = self.filer.store.kv_get(request.key)

@@ -57,7 +57,8 @@ class VolumeServer:
                  max_volume_counts: list[int] | None = None,
                  pulse_seconds: int = 5, coder=None,
                  ec_geometry: Geometry = Geometry(),
-                 tier_backends: dict | None = None):
+                 tier_backends: dict | None = None,
+                 needle_map_kind: str = "memory"):
         if tier_backends:
             from ..storage.backend import load_tier_backends
 
@@ -74,6 +75,7 @@ class VolumeServer:
             directories, coder=coder, max_volume_counts=max_volume_counts,
             ip=ip, port=port, public_url=public_url, grpc_port=self.grpc_port,
             data_center=data_center, rack=rack,
+            needle_map_kind=needle_map_kind,
         )
         self.volume_size_limit = 30_000 * 1024 * 1024
         self._grpc_server = None

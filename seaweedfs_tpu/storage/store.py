@@ -78,7 +78,8 @@ class Store:
     def __init__(self, directories: list[str], *, coder=None,
                  max_volume_counts: list[int] | None = None,
                  ip: str = "", port: int = 0, public_url: str = "",
-                 grpc_port: int = 0, data_center: str = "", rack: str = ""):
+                 grpc_port: int = 0, data_center: str = "", rack: str = "",
+                 needle_map_kind: str = "memory"):
         from ..models.coder import new_coder
 
         self.ip = ip
@@ -88,6 +89,7 @@ class Store:
         self.data_center = data_center
         self.rack = rack
         self.coder = coder or new_coder()
+        self.needle_map_kind = needle_map_kind
         self._lock = threading.RLock()
         self.locations: list[DiskLocation] = []
         counts = max_volume_counts or [8] * len(directories)
@@ -109,7 +111,8 @@ class Store:
             for vid, (col, _path) in vols.items():
                 if vid not in loc.volumes:
                     try:
-                        loc.volumes[vid] = Volume(loc.directory, col, vid)
+                        loc.volumes[vid] = Volume(loc.directory, col, vid,
+                            needle_map_kind=self.needle_map_kind)
                     except Exception as e:
                         # one unloadable volume (e.g. a .tier sidecar whose
                         # backend isn't configured) must not down the server
@@ -168,7 +171,8 @@ class Store:
             loc = self._pick_location()
             rp = ReplicaPlacement.parse(replication) if replication else ReplicaPlacement()
             t = TTL.parse(ttl) if ttl else EMPTY_TTL
-            v = Volume(loc.directory, collection, vid, replica_placement=rp, ttl=t)
+            v = Volume(loc.directory, collection, vid, replica_placement=rp,
+                       ttl=t, needle_map_kind=self.needle_map_kind)
             loc.volumes[vid] = v
             self.new_volumes.append(master_pb2.VolumeShortInformationMessage(
                 id=vid, collection=collection,
@@ -201,7 +205,8 @@ class Store:
             vols, _ = loc.scan()
             if vid in vols:
                 col, _ = vols[vid]
-                loc.volumes[vid] = Volume(loc.directory, col, vid)
+                loc.volumes[vid] = Volume(loc.directory, col, vid,
+                            needle_map_kind=self.needle_map_kind)
                 return
         raise NotFoundError(f"volume {vid} not found on disk")
 

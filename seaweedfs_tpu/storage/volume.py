@@ -31,13 +31,85 @@ class NeedleValue:
     size: int  # signed
 
 
-class NeedleMap:
-    """In-memory id -> (offset, size) map backed by an append-only .idx log
-    (needle_map_memory.go: NewCompactNeedleMap/doLoading/Put/Get/Delete)."""
+class _SqliteMap:
+    """Dict-shaped id -> NeedleValue map on disk (the reference's
+    NeedleMapLevelDb{,Medium,Large} kinds, needle_map_leveldb.go — low
+    memory for huge volumes; sqlite stands in for LevelDB here)."""
 
-    def __init__(self, idx_path: str):
+    def __init__(self, db_path: str):
+        import sqlite3
+
+        self._db = sqlite3.connect(db_path, check_same_thread=False)
+        self._db.execute("PRAGMA journal_mode=WAL")
+        self._db.execute(
+            "CREATE TABLE IF NOT EXISTS needles ("
+            "key INTEGER PRIMARY KEY, off INTEGER, size INTEGER)")
+        self._lock = threading.Lock()
+
+    def get(self, key: int) -> NeedleValue | None:
+        with self._lock:
+            row = self._db.execute(
+                "SELECT off, size FROM needles WHERE key=?",
+                (key,)).fetchone()
+        return NeedleValue(row[0], row[1]) if row else None
+
+    def __setitem__(self, key: int, nv: NeedleValue) -> None:
+        with self._lock:
+            self._db.execute(
+                "INSERT OR REPLACE INTO needles VALUES (?,?,?)",
+                (key, nv.offset, nv.size))
+            self._db.commit()
+
+    def pop(self, key: int, default=None):
+        nv = self.get(key)
+        if nv is None:
+            return default
+        with self._lock:
+            self._db.execute("DELETE FROM needles WHERE key=?", (key,))
+            self._db.commit()
+        return nv
+
+    def __len__(self) -> int:
+        with self._lock:
+            return self._db.execute(
+                "SELECT COUNT(*) FROM needles").fetchone()[0]
+
+    def items(self):
+        with self._lock:
+            rows = self._db.execute(
+                "SELECT key, off, size FROM needles ORDER BY key").fetchall()
+        for key, off, size in rows:
+            yield key, NeedleValue(off, size)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._db.execute("DELETE FROM needles")
+            self._db.commit()
+
+    def clear_close(self) -> None:
+        with self._lock:
+            self._db.close()
+
+
+class NeedleMap:
+    """id -> (offset, size) map backed by an append-only .idx log
+    (needle_map_memory.go: NewCompactNeedleMap/doLoading/Put/Get/Delete).
+    kind="memory" keeps the map in a dict; kind="sqlite" keeps it on disk
+    (the reference's leveldb index kinds) in a `.ldb` sidecar."""
+
+    def __init__(self, idx_path: str, kind: str = "memory"):
         self.idx_path = idx_path
-        self._m: dict[int, NeedleValue] = {}
+        self.kind = kind
+        if kind == "memory":
+            self._m: dict[int, NeedleValue] | _SqliteMap = {}
+        elif kind == "sqlite":
+            self._m = _SqliteMap(idx_path[:-4] + ".ldb")
+            # the .idx log is the source of truth: rebuild the table from
+            # scratch so stale rows (compaction, truncation repair, prior
+            # runs) can't shadow the replay or inflate deletion counters
+            self._m.clear()
+        else:
+            raise ValueError(f"unknown needle map kind {kind!r}")
         self.max_file_key = 0
         self.file_counter = 0
         self.file_byte_counter = 0
@@ -106,10 +178,17 @@ class NeedleMap:
 
     def close(self) -> None:
         self._idx_file.close()
+        if isinstance(self._m, _SqliteMap):
+            self._m.clear_close()
 
     def destroy(self) -> None:
         self.close()
         os.remove(self.idx_path)
+        if self.kind == "sqlite":
+            try:
+                os.remove(self.idx_path[:-4] + ".ldb")
+            except FileNotFoundError:
+                pass
 
 
 class TieredVolumeUnavailable(IOError):
@@ -129,7 +208,9 @@ class Volume:
         ttl=EMPTY_TTL,
         version: int = types.CURRENT_VERSION,
         preallocate: int = 0,
+        needle_map_kind: str = "memory",
     ):
+        self.needle_map_kind = needle_map_kind
         self.dir = dirname
         self.collection = collection
         self.id = vid
@@ -183,7 +264,7 @@ class Volume:
             )
             self._dat.write(self.super_block.to_bytes())
             self._dat.flush()
-        self.nm = NeedleMap(base + ".idx")
+        self.nm = NeedleMap(base + ".idx", self.needle_map_kind)
         if dat_exists:
             self.check_and_fix_integrity()
 
@@ -403,7 +484,7 @@ class Volume:
             self._dat.flush()
             # reload the map from the repaired idx
             self.nm.close()
-            self.nm = NeedleMap(self.nm.idx_path)
+            self.nm = NeedleMap(self.nm.idx_path, self.needle_map_kind)
 
     def _verify_needle_at(self, offset: int, needle_id: int, size: int) -> bool:
         """verifyNeedleIntegrity (volume_checking.go:88): id matches and the
@@ -498,7 +579,7 @@ class Volume:
 
             self._dat = DiskFile(base + ".dat")
             self.super_block = SuperBlock.from_file(self._dat)
-            self.nm = NeedleMap(base + ".idx")
+            self.nm = NeedleMap(base + ".idx", self.needle_map_kind)
             self.is_compacting = False
 
     def _makeup_diff(self, cpd: str, cpx: str) -> None:

@@ -154,6 +154,11 @@ FILER_REQUEST_HISTOGRAM = Histogram(
     "SeaweedFS_filer_request_seconds", "Filer request latency by type.")
 S3_REQUEST_HISTOGRAM = Histogram(
     "SeaweedFS_s3_request_seconds", "S3 gateway latency by action.")
+FILER_STORE_COUNTER = Counter(
+    "SeaweedFS_filerStore_ops", "Filer store operations by store and op.")
+FILER_STORE_SECONDS = Counter(
+    "SeaweedFS_filerStore_seconds",
+    "Cumulative filer store time by store and op.")
 
 
 def master_metrics_text() -> str:

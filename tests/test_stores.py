@@ -1,0 +1,199 @@
+"""Filer store variants, needle-map kinds, store wrapper/translation, and
+filer meta aggregation (SURVEY.md §2.1 NeedleMap row + §2.5)."""
+
+import socket
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.filer import Attr, Entry, Filer
+from seaweedfs_tpu.filer.filerstore import (
+    PathTranslatingStore,
+    StoreWrapper,
+    available_stores,
+    get_store,
+)
+from seaweedfs_tpu.pb import rpc
+from seaweedfs_tpu.server.filer import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import NeedleMap, Volume
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+# -- leveldb-style store ---------------------------------------------------
+
+def test_leveldb_store_crud_and_persistence(tmp_path):
+    store = get_store("leveldb", directory=str(tmp_path / "ldb"))
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/a/b/c.txt", attr=Attr(mtime=11)))
+    for i in range(5):
+        f.create_entry(Entry(full_path=f"/a/b/f{i}"))
+    assert f.find_entry("/a/b/c.txt").attr.mtime == 11
+    names = [e.name for e in f.list_entries("/a/b")]
+    assert names == ["c.txt", "f0", "f1", "f2", "f3", "f4"]
+    assert [e.name for e in f.list_entries("/a/b", start="f1")] == \
+        ["f2", "f3", "f4"]
+    assert len(list(f.list_entries("/a/b", prefix="f"))) == 5
+    f.delete_entry("/a/b/f0")
+    store.kv_put(b"k", b"v")
+    store.close()
+    # reopen: the log replays
+    store2 = get_store("leveldb", directory=str(tmp_path / "ldb"))
+    f2 = Filer(store2)
+    assert f2.find_entry("/a/b/c.txt").attr.mtime == 11
+    assert [e.name for e in f2.list_entries("/a/b")] == \
+        ["c.txt", "f1", "f2", "f3", "f4"]
+    assert store2.kv_get(b"k") == b"v"
+    store2.close()
+
+
+def test_leveldb_store_compaction(tmp_path):
+    store = get_store("leveldb", directory=str(tmp_path / "ldb"))
+    # churn enough overwrites to trip compaction (threshold 4096)
+    for round_ in range(3):
+        for i in range(2048):
+            store.insert_entry(Entry(full_path=f"/x/e{i}",
+                                     attr=Attr(mtime=round_)))
+    import os
+
+    log_size = os.path.getsize(str(tmp_path / "ldb" / "filer.log"))
+    entries = list(store.list_directory_entries("/x", limit=4096))
+    assert len(entries) == 2048
+    assert all(e.attr.mtime == 2 for e in entries)
+    # compaction kept the log near one generation of entries
+    store2 = get_store("leveldb", directory=str(tmp_path / "ldb"))
+    assert len(list(store2.list_directory_entries("/x", limit=4096))) == 2048
+    store.close()
+    store2.close()
+
+
+def test_gated_stores_fail_with_guidance():
+    assert "redis" in available_stores()
+    with pytest.raises(RuntimeError, match="redis-py"):
+        get_store("redis")
+    with pytest.raises(RuntimeError, match="client library"):
+        get_store("cassandra")
+
+
+def test_store_wrapper_counts_ops():
+    from seaweedfs_tpu.utils.stats import FILER_STORE_COUNTER
+
+    w = StoreWrapper(get_store("memory"))
+    before = FILER_STORE_COUNTER.value(store="memory", op="insert")
+    w.insert_entry(Entry(full_path="/w/x"))
+    assert w.find_entry("/w/x") is not None
+    assert FILER_STORE_COUNTER.value(store="memory", op="insert") == \
+        before + 1
+
+
+def test_path_translating_store():
+    backing = get_store("memory")
+    t = PathTranslatingStore(backing, "/mnt/sub")
+    t.insert_entry(Entry(full_path="/hello.txt", attr=Attr(mtime=5)))
+    assert backing.find_entry("/mnt/sub/hello.txt").attr.mtime == 5
+    got = t.find_entry("/hello.txt")
+    assert got is not None and got.full_path == "/hello.txt"
+    assert [e.full_path for e in t.list_directory_entries("/")] == \
+        ["/hello.txt"]
+
+
+# -- needle map kinds ------------------------------------------------------
+
+def test_sqlite_needle_map_matches_memory(tmp_path):
+    for kind in ("memory", "sqlite"):
+        nm = NeedleMap(str(tmp_path / f"{kind}.idx"), kind)
+        nm.put(7, 100, 64)
+        nm.put(9, 200, 32)
+        nm.delete(7, 300)
+        assert nm.get(9).size == 32
+        assert nm.get(7) is None
+        assert len(nm) == 1
+        assert nm.deletion_counter == 1
+        nm.close()
+        # reload replays the idx identically
+        nm2 = NeedleMap(str(tmp_path / f"{kind}.idx"), kind)
+        assert nm2.get(9).size == 32 and nm2.get(7) is None
+        nm2.close()
+
+
+def test_sqlite_needle_map_reopen_counters_clean(tmp_path):
+    """Reopen must not count live keys as deletions (the .ldb is rebuilt
+    from the .idx, not replayed on top of stale rows)."""
+    nm = NeedleMap(str(tmp_path / "v.idx"), "sqlite")
+    nm.put(1, 10, 100)
+    nm.put(2, 20, 200)
+    nm.close()
+    nm2 = NeedleMap(str(tmp_path / "v.idx"), "sqlite")
+    assert nm2.deletion_counter == 0
+    assert nm2.deletion_byte_counter == 0
+    assert len(nm2) == 2
+    nm2.close()
+
+
+def test_volume_with_sqlite_needle_map(tmp_path):
+    v = Volume(str(tmp_path), "", 9, needle_map_kind="sqlite")
+    payload = b"sqlite-map-payload" * 10
+    v.write_needle(Needle.create(42, 0xABCD, payload))
+    v.close()
+    v2 = Volume(str(tmp_path), "", 9, needle_map_kind="sqlite")
+    assert v2.read_needle(42).data == payload
+    v2.close()
+
+
+# -- meta aggregation ------------------------------------------------------
+
+def test_filer_meta_aggregation(tmp_path):
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport, volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "v")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), pulse_seconds=1)
+    vsrv.start()
+    fports = [_free_port(), _free_port()]
+    addrs = [f"localhost:{p}" for p in fports]
+    filers = []
+    for i, p in enumerate(fports):
+        fs = FilerServer(ip="localhost", port=p,
+                         master=f"localhost:{mport}",
+                         store_dir=str(tmp_path / f"f{i}"),
+                         chunk_size=64 * 1024, peers=list(addrs))
+        fs.start()
+        filers.append(fs)
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    try:
+        t0 = time.time_ns()
+        # write through filer A; subscribe through filer B
+        requests.put(f"http://{addrs[0]}/agg/x.txt", data=b"agg",
+                     timeout=30)
+        deadline = time.time() + 10
+        seen = False
+        while time.time() < deadline and not seen:
+            events, _ = filers[1].filer.read_events(t0, timeout=0.3)
+            seen = any(
+                m.event_notification.new_entry.name == "x.txt"
+                for m in events)
+        assert seen, "filer B never aggregated filer A's event"
+        # no infinite ping-pong: event counts settle
+        time.sleep(1.0)
+        c1 = dict(filers[0].meta_aggregator.peer_counts)
+        c2 = dict(filers[1].meta_aggregator.peer_counts)
+        time.sleep(1.0)
+        assert dict(filers[0].meta_aggregator.peer_counts) == c1
+        assert dict(filers[1].meta_aggregator.peer_counts) == c2
+    finally:
+        for fs in filers:
+            fs.stop()
+        vsrv.stop()
+        master.stop()
+        rpc.reset_channels()
