@@ -108,6 +108,41 @@ def main(argv=None) -> int:
     frs.add_argument("-filer", default="localhost:8888")
     frs.add_argument("-dir", required=True)
 
+    frg = sub.add_parser("filer.remote.gateway",
+                         help="continuously sync all remote mounts")
+    frg.add_argument("-filer", default="localhost:8888")
+    frg.add_argument("-interval", type=float, default=60.0)
+
+    fct = sub.add_parser("filer.cat", help="print a filer file to stdout")
+    fct.add_argument("-filer", default="localhost:8888")
+    fct.add_argument("path")
+
+    fcp = sub.add_parser("filer.copy", help="copy local files to the filer")
+    fcp.add_argument("-filer", default="localhost:8888")
+    fcp.add_argument("files", nargs="+",
+                     help="local files/dirs, last arg is the filer dest dir")
+
+    fmt_ = sub.add_parser("filer.meta.tail",
+                          help="stream filer metadata events as JSON lines")
+    fmt_.add_argument("-filer", default="localhost:8888")
+    fmt_.add_argument("-pathPrefix", default="/")
+
+    fmb = sub.add_parser("filer.meta.backup",
+                         help="continuously back up filer metadata to a "
+                              "local file")
+    fmb.add_argument("-filer", default="localhost:8888")
+    fmb.add_argument("-o", dest="output", default="meta.backup")
+
+    mfp = sub.add_parser("master.follower",
+                         help="run a follower master (requires -peers)")
+    mfp.add_argument("-ip", default="localhost")
+    mfp.add_argument("-port", type=int, default=9334)
+    mfp.add_argument("-peers", required=True)
+    mfp.add_argument("-mdir", default="")
+
+    sub.add_parser("autocomplete", help="print bash completion script")
+    sub.add_parser("update", help="self-update (not applicable here)")
+
     up = sub.add_parser("upload", help="upload files")
     up.add_argument("-master", default="localhost:9333")
     up.add_argument("-collection", default="")
@@ -380,6 +415,147 @@ def _run(opts) -> int:
 
         n = RemoteGateway(opts.filer).sync_dir(opts.dir)
         print(f"synced {n} entries")
+        return 0
+
+    if opts.cmd == "filer.remote.gateway":
+        import time as _time
+
+        from ..remote_storage import RemoteGateway
+
+        gw = RemoteGateway(opts.filer)
+        while True:
+            for directory in list(gw.conf.load().get("mounts", {})):
+                try:
+                    n = gw.sync_dir(directory)
+                    if n:
+                        print(f"synced {n} entries in {directory}")
+                except Exception as e:
+                    print(f"sync {directory}: {e}", file=sys.stderr)
+            _time.sleep(opts.interval)
+
+    if opts.cmd == "filer.cat":
+        import requests
+
+        path = opts.path if opts.path.startswith("/") else "/" + opts.path
+        r = requests.get(f"http://{opts.filer}{path}", timeout=300,
+                         stream=True)
+        if r.status_code != 200:
+            print(f"{path}: HTTP {r.status_code}", file=sys.stderr)
+            return 1
+        for piece in r.iter_content(chunk_size=256 * 1024):
+            sys.stdout.buffer.write(piece)
+        return 0
+
+    if opts.cmd == "filer.copy":
+        import os as _os
+
+        import requests
+
+        *sources, dest = opts.files
+        dest = dest if dest.startswith("/") else "/" + dest
+        copied = 0
+        for src in sources:
+            paths = []
+            if _os.path.isdir(src):
+                for dirpath, _dirs, files in _os.walk(src):
+                    for name in files:
+                        full = _os.path.join(dirpath, name)
+                        rel = _os.path.relpath(full, src)
+                        paths.append((full, rel))
+            else:
+                paths.append((src, _os.path.basename(src)))
+            for full, rel in paths:
+                target = dest.rstrip("/") + "/" + rel
+                with open(full, "rb") as f:  # streamed, not slurped
+                    r = requests.put(f"http://{opts.filer}{target}",
+                                     data=f, timeout=300)
+                if r.status_code >= 300:
+                    print(f"{target}: HTTP {r.status_code}",
+                          file=sys.stderr)
+                    return 1
+                copied += 1
+        print(f"copied {copied} files to {dest}")
+        return 0
+
+    if opts.cmd == "filer.meta.tail":
+        import json as _json
+        import time as _time
+
+        from ..pb import filer_pb2, rpc
+        from google.protobuf.json_format import MessageToDict
+
+        stub = rpc.filer_stub(rpc.grpc_address(opts.filer))
+        req = filer_pb2.SubscribeMetadataRequest(
+            client_name="filer.meta.tail", path_prefix=opts.pathPrefix,
+            since_ns=_time.time_ns())
+        for resp in stub.SubscribeMetadata(req):
+            print(_json.dumps(MessageToDict(resp)), flush=True)
+        return 0
+
+    if opts.cmd == "filer.meta.backup":
+        import os as _os
+        import struct as _struct
+
+        from ..pb import filer_pb2, rpc
+
+        # resume from the last backed-up event so restarts don't duplicate
+        since_ns = 0
+        if _os.path.exists(opts.output):
+            with open(opts.output, "rb") as f:
+                while True:
+                    hdr = f.read(4)
+                    if len(hdr) < 4:
+                        break
+                    (n,) = _struct.unpack(">I", hdr)
+                    blob = f.read(n)
+                    if len(blob) < n:
+                        break
+                    msg = filer_pb2.SubscribeMetadataResponse.FromString(
+                        blob)
+                    since_ns = max(since_ns, msg.ts_ns)
+        stub = rpc.filer_stub(rpc.grpc_address(opts.filer))
+        with open(opts.output, "ab") as f:
+            req = filer_pb2.SubscribeMetadataRequest(
+                client_name="filer.meta.backup", path_prefix="/",
+                since_ns=since_ns)
+            for resp in stub.SubscribeMetadata(req):
+                blob = resp.SerializeToString()
+                f.write(_struct.pack(">I", len(blob)) + blob)
+                f.flush()
+        return 0
+
+    if opts.cmd == "master.follower":
+        from ..server.master import MasterServer
+
+        ms = MasterServer(ip=opts.ip, port=opts.port,
+                          peers=[p.strip() for p in opts.peers.split(",")
+                                 if p.strip()],
+                          raft_dir=opts.mdir or None)
+        ms.start()
+        _wait_forever()
+        ms.stop()
+        return 0
+
+    if opts.cmd == "autocomplete":
+        cmds = " ".join(sorted(
+            c for c in ("master volume filer s3 webdav iam mq.broker "
+                        "server shell mount upload download benchmark "
+                        "backup compact fix export filer.sync "
+                        "filer.replicate filer.backup filer.cat filer.copy "
+                        "filer.meta.tail filer.meta.backup "
+                        "filer.remote.sync filer.remote.gateway "
+                        "master.follower version scaffold").split()))
+        print(f"""# bash completion for weed-tpu
+_weed_tpu() {{
+  local cur=${{COMP_WORDS[COMP_CWORD]}}
+  COMPREPLY=( $(compgen -W "{cmds}" -- "$cur") )
+}}
+complete -F _weed_tpu weed-tpu""")
+        return 0
+
+    if opts.cmd == "update":
+        print("this build installs from source; update with "
+              "`git pull` in the repository checkout")
         return 0
 
     if opts.cmd == "upload":

@@ -60,15 +60,25 @@ class LevelDbStore:
         size = self._log.tell()
         off = 0
         while off + 9 <= size:
+            hdr = os.pread(self._log.fileno(), 9, off)
+            op, klen, vlen = struct.unpack("<BII", hdr)
+            # a crash mid-append can leave a torn tail: truncate it off,
+            # the same repair the volume startup integrity check does
+            if op not in (_PUT, _DEL, _KV) or off + 9 + klen + vlen > size:
+                self._log.truncate(off)
+                break
             op, key, value = self._read_at(off)
-            rec_len = 9 + len(key) + len(value)
-            if op == _PUT:
-                self._index_put(key.decode(), off, replay=True)
-            elif op == _DEL:
-                self._index_del(key.decode())
-            elif op == _KV:
-                self._kv[key] = value
-            off += rec_len
+            try:
+                if op == _PUT:
+                    self._index_put(key.decode(), off, replay=True)
+                elif op == _DEL:
+                    self._index_del(key.decode())
+                elif op == _KV:
+                    self._kv[key] = value
+            except (UnicodeDecodeError, ValueError):
+                self._log.truncate(off)
+                break
+            off += 9 + klen + vlen
 
     def _index_put(self, path: str, off: int, replay: bool = False) -> None:
         d, name = path.rsplit("/", 1)

@@ -56,9 +56,9 @@ class FilerServer:
                 os.makedirs(store_dir, exist_ok=True)
                 db = os.path.join(store_dir, "filer.db")
             self.filer = Filer(get_store("sqlite", db_path=db))
-        elif store == "leveldb":
+        elif store.startswith("leveldb"):
             self.filer = Filer(get_store(
-                "leveldb", directory=store_dir or "./filerldb"))
+                store, directory=store_dir or "./filerldb"))
         else:
             self.filer = Filer(get_store(store))
         self.master_client = MasterClient(master)

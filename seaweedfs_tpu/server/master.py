@@ -549,6 +549,24 @@ def _make_http_handler(ms: MasterServer):
                         "DataNodes": sorted(ms.topo.nodes),
                     },
                 })
+            if u.path == "/vol/grow":
+                if not ms.is_leader():
+                    return self._json(
+                        {"error": "not the leader",
+                         "leader": ms.leader_address()}, 400)
+                from ..storage.super_block import ReplicaPlacement
+                from ..storage.ttl import EMPTY_TTL, TTL
+
+                try:
+                    rp = ReplicaPlacement.parse(
+                        q.get("replication") or ms.default_replication)
+                    t = TTL.parse(q["ttl"]) if q.get("ttl") else EMPTY_TTL
+                    n = ms.growth.grow(
+                        q.get("collection", ""), rp, t,
+                        count=int(q.get("count", 1)))
+                except ValueError as e:
+                    return self._json({"error": str(e)}, 400)
+                return self._json({"count": n})
             if u.path == "/vol/vacuum":
                 n = ms.vacuum_once(float(q.get("garbageThreshold", 0.0001)))
                 return self._json({"vacuumed": n})

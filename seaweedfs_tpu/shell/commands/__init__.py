@@ -1,1 +1,10 @@
-from . import cluster, collection, ec, fs, lock, remote, volume  # noqa: F401
+from . import (  # noqa: F401
+    cluster,
+    collection,
+    ec,
+    fs,
+    lock,
+    remote,
+    s3_mq,
+    volume,
+)

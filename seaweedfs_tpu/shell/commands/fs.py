@@ -200,3 +200,126 @@ def fs_meta_cat(env, args, out):
     if e is None:
         raise RuntimeError(f"{path}: not found")
     print(e, file=out)
+
+
+@command("fs.meta.tail", "fs.meta.tail [-timeAgo=10s] [-pathPrefix=/]")
+def fs_meta_tail(env, args, out):
+    """Stream filer metadata events (command_fs_meta_tail.go); drains
+    until the stream goes idle for 2s (non-interactive shells)."""
+    import time as _time
+
+    import grpc
+
+    prefix = "/"
+    ago_ns = 0
+    for a in args:
+        if a.startswith("-pathPrefix="):
+            prefix = a.split("=", 1)[1]
+        elif a.startswith("-timeAgo="):
+            spec = a.split("=", 1)[1]
+            mult = {"s": 1, "m": 60, "h": 3600}.get(spec[-1], 1)
+            ago_ns = int(float(spec.rstrip("smh")) * mult * 1e9)
+    stub = _stub(env)
+    cursor = _time.time_ns() - ago_ns
+    # timeout=2 is a per-stream deadline, not an idle timer: resume from
+    # the cursor until a whole window passes with no new events
+    while True:
+        got_any = False
+        try:
+            for resp in stub.SubscribeMetadata(
+                    filer_pb2.SubscribeMetadataRequest(
+                        client_name="fs.meta.tail", path_prefix=prefix,
+                        since_ns=cursor), timeout=2):
+                got_any = True
+                cursor = max(cursor, resp.ts_ns)
+                ev = resp.event_notification
+                kind = ("update" if ev.old_entry.name and ev.new_entry.name
+                        else "create" if ev.new_entry.name else "delete")
+                name = ev.new_entry.name or ev.old_entry.name
+                print(f"{resp.ts_ns} {kind} {resp.directory}/{name}",
+                      file=out)
+        except grpc.RpcError as e:
+            if e.code() != grpc.StatusCode.DEADLINE_EXCEEDED:
+                raise
+        if not got_any:
+            return
+
+
+@command("fs.configure",
+         "fs.configure [-locationPrefix=/p -collection=c -replication=XYZ] "
+         "[-apply]")
+def fs_configure(env, args, out):
+    """Per-path storage rules stored at /etc/seaweedfs/filer.conf
+    (command_fs_configure.go + filer_conf.go)."""
+    import json as _json
+    import time as _time
+
+    stub = _stub(env)
+    conf = {"locations": []}
+    try:
+        resp = stub.LookupDirectoryEntry(
+            filer_pb2.LookupDirectoryEntryRequest(
+                directory="/etc/seaweedfs", name="filer.conf"), timeout=10)
+        if resp.entry.content:
+            conf = _json.loads(resp.entry.content)
+    except Exception:
+        pass
+    opts = {}
+    apply_ = "-apply" in args
+    for a in args:
+        if a.startswith("-") and "=" in a:
+            k, _, v = a[1:].partition("=")
+            opts[k] = v
+    if "locationPrefix" in opts:
+        loc = {"location_prefix": opts["locationPrefix"]}
+        for k in ("collection", "replication", "ttl", "disk_type"):
+            if opts.get(k):
+                loc[k] = opts[k]
+        conf["locations"] = [l for l in conf["locations"]
+                             if l["location_prefix"] != loc["location_prefix"]]
+        conf["locations"].append(loc)
+        if apply_:
+            entry = filer_pb2.Entry(
+                name="filer.conf",
+                content=_json.dumps(conf, indent=2).encode())
+            entry.attributes.file_mode = 0o644
+            entry.attributes.mtime = int(_time.time())
+            stub.CreateEntry(filer_pb2.CreateEntryRequest(
+                directory="/etc/seaweedfs", entry=entry), timeout=10)
+        else:
+            # reference semantics: dry run unless -apply
+            print("(dry run; add -apply to persist)", file=out)
+    print(_json.dumps(conf, indent=2), file=out)
+
+
+@command("mount.configure", "mount.configure -dir=/p -quotaMB=n")
+def mount_configure(env, args, out):
+    """Mount quota config persisted in the filer
+    (command_mount_configure.go)."""
+    import json as _json
+    import time as _time
+
+    stub = _stub(env)
+    opts = {}
+    for a in args:
+        if a.startswith("-") and "=" in a:
+            k, _, v = a[1:].partition("=")
+            opts[k] = v
+    conf = {}
+    try:
+        resp = stub.LookupDirectoryEntry(
+            filer_pb2.LookupDirectoryEntryRequest(
+                directory="/etc/seaweedfs", name="mount.conf"), timeout=10)
+        if resp.entry.content:
+            conf = _json.loads(resp.entry.content)
+    except Exception:
+        pass
+    if "dir" in opts:
+        conf[opts["dir"]] = {"quotaMB": int(opts.get("quotaMB", 0))}
+        entry = filer_pb2.Entry(name="mount.conf",
+                                content=_json.dumps(conf, indent=2).encode())
+        entry.attributes.file_mode = 0o644
+        entry.attributes.mtime = int(_time.time())
+        stub.CreateEntry(filer_pb2.CreateEntryRequest(
+            directory="/etc/seaweedfs", entry=entry), timeout=10)
+    print(_json.dumps(conf, indent=2), file=out)

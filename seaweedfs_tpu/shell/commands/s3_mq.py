@@ -1,0 +1,129 @@
+"""s3.* and mq.* shell commands.
+
+Rebuild of /root/reference/weed/shell/command_s3_bucket_*.go,
+command_s3_configure.go, and command_mq_topic_list.go. Buckets are filer
+directories under /buckets (s3api/server.py BUCKETS_DIR); identities live
+at /etc/iam/identity.json shared with the IAM API.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+from ...pb import filer_pb2, rpc
+from ..registry import command
+
+BUCKETS_DIR = "/buckets"
+
+
+def _stub(env):
+    return rpc.filer_stub(rpc.grpc_address(env.require_filer()))
+
+
+@command("s3.bucket.list", "list S3 buckets")
+def s3_bucket_list(env, args, out):
+    for resp in _stub(env).ListEntries(filer_pb2.ListEntriesRequest(
+            directory=BUCKETS_DIR, limit=10000)):
+        e = resp.entry
+        if e.is_directory and not e.name.startswith("."):
+            print(e.name, file=out)
+
+
+@command("s3.bucket.create", "s3.bucket.create -name=<bucket>")
+def s3_bucket_create(env, args, out):
+    opts = _kv(args)
+    name = opts["name"]
+    entry = filer_pb2.Entry(name=name, is_directory=True)
+    entry.attributes.file_mode = 0o40777
+    entry.attributes.mtime = int(time.time())
+    resp = _stub(env).CreateEntry(filer_pb2.CreateEntryRequest(
+        directory=BUCKETS_DIR, entry=entry), timeout=10)
+    if resp.error:
+        raise RuntimeError(resp.error)
+    print(f"created bucket {name}", file=out)
+
+
+@command("s3.bucket.delete", "s3.bucket.delete -name=<bucket>")
+def s3_bucket_delete(env, args, out):
+    opts = _kv(args)
+    name = opts["name"]
+    resp = _stub(env).DeleteEntry(filer_pb2.DeleteEntryRequest(
+        directory=BUCKETS_DIR, name=name, is_delete_data=True,
+        is_recursive=True), timeout=60)
+    if resp.error:
+        raise RuntimeError(resp.error)
+    print(f"deleted bucket {name}", file=out)
+
+
+@command("s3.configure",
+         "s3.configure [-user=x -access_key=k -secret_key=s "
+         "-actions=Read:bucket,Write] [-delete]")
+def s3_configure(env, args, out):
+    """Manage the S3 identity list (command_s3_configure.go), stored in
+    the filer where the IAM API and S3 gateway read it."""
+    from ...iamapi import IamConfigStore
+    from ...s3api.auth import Identity
+
+    store = IamConfigStore(env.require_filer())
+    identities = store.load()
+    opts = _kv(args)
+    if not opts:
+        print(json.dumps(
+            [{"name": i.name, "access_key": i.access_key,
+              "actions": i.actions} for i in identities], indent=2),
+            file=out)
+        return
+    user = opts.get("user", "")
+    existing = next((i for i in identities if i.name == user), None)
+    if "delete" in opts:
+        if existing:
+            identities.remove(existing)
+    else:
+        if existing is None:
+            existing = Identity(name=user, access_key="", secret_key="",
+                                actions=[])
+            identities.append(existing)
+        if opts.get("access_key"):
+            existing.access_key = opts["access_key"]
+        if opts.get("secret_key"):
+            existing.secret_key = opts["secret_key"]
+        if opts.get("actions"):
+            existing.actions = opts["actions"].split(",")
+    store.save(identities)
+    print(f"configured {len(identities)} identities", file=out)
+
+
+@command("mq.topic.list", "list message-queue topics persisted in the filer")
+def mq_topic_list(env, args, out):
+    stub = _stub(env)
+
+    def listdir(d):
+        try:
+            return [r.entry for r in stub.ListEntries(
+                filer_pb2.ListEntriesRequest(directory=d, limit=10000))]
+        except Exception:
+            return []
+
+    found = 0
+    for ns in listdir("/topics"):
+        if not ns.is_directory or ns.name.startswith("."):
+            continue
+        for tp in listdir(f"/topics/{ns.name}"):
+            if not tp.is_directory:
+                continue
+            parts = [p for p in listdir(f"/topics/{ns.name}/{tp.name}")
+                     if p.is_directory]
+            print(f"{ns.name}.{tp.name} partitions={len(parts)}", file=out)
+            found += 1
+    if not found:
+        print("no topics", file=out)
+
+
+def _kv(args) -> dict:
+    out = {}
+    for a in args:
+        if a.startswith("-"):
+            k, _, v = a[1:].partition("=")
+            out[k] = v
+    return out

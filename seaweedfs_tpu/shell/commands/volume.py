@@ -303,3 +303,126 @@ def volume_tier_download(env, args, out):
                 keep_remote_dat_file=opts.keepRemoteDatFile), timeout=3600):
         print(f"downloaded {resp.processed} bytes "
               f"({resp.processed_percentage:.0f}%)", file=out)
+
+
+@command("volume.mount", "volume.mount -node=<server> -volumeId=<n>")
+def volume_mount(env, args, out):
+    p = argparse.ArgumentParser(prog="volume.mount")
+    p.add_argument("-node", required=True)
+    p.add_argument("-volumeId", type=int, required=True)
+    opts = p.parse_args(args)
+    env.volume_stub(opts.node).VolumeMount(
+        vs.VolumeMountRequest(volume_id=opts.volumeId), timeout=30)
+    print(f"mounted volume {opts.volumeId} on {opts.node}", file=out)
+
+
+@command("volume.unmount", "volume.unmount -node=<server> -volumeId=<n>")
+def volume_unmount(env, args, out):
+    p = argparse.ArgumentParser(prog="volume.unmount")
+    p.add_argument("-node", required=True)
+    p.add_argument("-volumeId", type=int, required=True)
+    opts = p.parse_args(args)
+    env.volume_stub(opts.node).VolumeUnmount(
+        vs.VolumeUnmountRequest(volume_id=opts.volumeId), timeout=30)
+    print(f"unmounted volume {opts.volumeId} on {opts.node}", file=out)
+
+
+@command("volume.configure.replication",
+         "volume.configure.replication -volumeId=<n> -replication=XYZ")
+def volume_configure_replication(env, args, out):
+    """command_volume_configure_replication.go: rewrite a volume's replica
+    placement on every server holding it."""
+    p = argparse.ArgumentParser(prog="volume.configure.replication")
+    p.add_argument("-volumeId", type=int, required=True)
+    p.add_argument("-replication", required=True)
+    opts = p.parse_args(args)
+    env.confirm_is_locked()
+    changed = 0
+    for dn in env.collect_data_nodes():
+        for disk in dn.disk_infos.values():
+            for v in disk.volume_infos:
+                if v.id == opts.volumeId:
+                    env.volume_stub(dn.id).VolumeConfigure(
+                        vs.VolumeConfigureRequest(
+                            volume_id=opts.volumeId,
+                            replication=opts.replication), timeout=30)
+                    changed += 1
+    if not changed:
+        raise RuntimeError(f"volume {opts.volumeId} not found")
+    print(f"configured replication={opts.replication} on {changed} replicas",
+          file=out)
+
+
+@command("volume.grow",
+         "volume.grow [-collection=c] [-replication=XYZ] [-count=n]")
+def volume_grow(env, args, out):
+    """command_volume_grow semantics via the master's grow endpoint."""
+    import requests
+
+    p = argparse.ArgumentParser(prog="volume.grow")
+    p.add_argument("-collection", default="")
+    p.add_argument("-replication", default="")
+    p.add_argument("-count", type=int, default=1)
+    opts = p.parse_args(args)
+    r = requests.get(
+        f"http://{env.master}/vol/grow",
+        params={"collection": opts.collection,
+                "replication": opts.replication,
+                "count": opts.count}, timeout=60).json()
+    if "error" in r:
+        raise RuntimeError(r["error"])
+    print(f"grew {r.get('count', 0)} volumes", file=out)
+
+
+@command("volume.fsck",
+         "volume.fsck [-verbose] — cross-check filer chunks vs volumes")
+def volume_fsck(env, args, out):
+    """command_volume_fsck.go (simplified): walk the filer namespace,
+    verify every referenced chunk's volume exists in the topology and the
+    needle is readable; report dangling references."""
+    import requests
+
+    from ...pb import filer_pb2
+    from ...pb import rpc as _rpc
+
+    verbose = "-verbose" in args
+    stub = _rpc.filer_stub(_rpc.grpc_address(env.require_filer()))
+    topo = env.volume_list().topology_info
+    known_vids = set()
+    for dc in topo.data_center_infos:
+        for rack in dc.rack_infos:
+            for dn in rack.data_node_infos:
+                for disk in dn.disk_infos.values():
+                    known_vids.update(v.id for v in disk.volume_infos)
+                    known_vids.update(
+                        ec.id for ec in disk.ec_shard_infos)
+    checked = missing_vol = unreadable = 0
+
+    def walk(d):
+        nonlocal checked, missing_vol, unreadable
+        for resp in stub.ListEntries(filer_pb2.ListEntriesRequest(
+                directory=d, limit=1 << 20)):
+            e = resp.entry
+            path = d.rstrip("/") + "/" + e.name
+            if e.is_directory:
+                walk(path)
+                continue
+            for c in e.chunks:
+                checked += 1
+                vid = int(c.file_id.split(",")[0])
+                if vid not in known_vids:
+                    missing_vol += 1
+                    print(f"  {path}: chunk {c.file_id}: volume {vid} "
+                          f"not in topology", file=out)
+                    continue
+                if verbose:
+                    urls = env.master_client.lookup_file_id(c.file_id)
+                    r = requests.head(urls[0], timeout=10)
+                    if r.status_code != 200:
+                        unreadable += 1
+                        print(f"  {path}: chunk {c.file_id}: HTTP "
+                              f"{r.status_code}", file=out)
+
+    walk("/")
+    print(f"checked {checked} chunks: {missing_vol} dangling volume refs, "
+          f"{unreadable} unreadable", file=out)

@@ -1,0 +1,156 @@
+"""Shell breadth: s3.*, mq.topic.list, fs.configure/meta.tail,
+volume.mount/unmount/grow/fsck, mount.configure (SURVEY.md §2.6 shell row
+— the ~60-command surface)."""
+
+import io
+import socket
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.pb import rpc
+from seaweedfs_tpu.server.filer import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+from seaweedfs_tpu.shell.env import CommandEnv
+from seaweedfs_tpu.shell.registry import COMMANDS, run_command
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def env(tmp_path_factory):
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport, volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("vol"))],
+        master=f"localhost:{mport}", ip="localhost", port=_free_port(),
+        pulse_seconds=1)
+    vsrv.start()
+    fsrv = FilerServer(ip="localhost", port=_free_port(),
+                       master=f"localhost:{mport}",
+                       store_dir=str(tmp_path_factory.mktemp("filer")),
+                       chunk_size=64 * 1024)
+    fsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    e = CommandEnv(f"localhost:{mport}", filer=fsrv.address)
+    e._cluster = (master, vsrv, fsrv)
+    yield e
+    fsrv.stop()
+    vsrv.stop()
+    master.stop()
+    rpc.reset_channels()
+
+
+def run(env, line):
+    out = io.StringIO()
+    assert run_command(env, line, out) == 0, f"{line}: {out.getvalue()}"
+    return out.getvalue()
+
+
+def test_command_surface_size():
+    # the reference ships ~60 admin commands; we should be in that range
+    assert len(COMMANDS) >= 45, sorted(COMMANDS)
+
+
+def test_s3_bucket_lifecycle(env):
+    run(env, "s3.bucket.create -name=shellbucket")
+    assert "shellbucket" in run(env, "s3.bucket.list")
+    # bucket visible to the S3 gateway's filer layout
+    _, _, fsrv = env._cluster
+    requests.put(f"http://{fsrv.address}/buckets/shellbucket/k.txt",
+                 data=b"v", timeout=30)
+    run(env, "s3.bucket.delete -name=shellbucket")
+    assert "shellbucket" not in run(env, "s3.bucket.list")
+
+
+def test_s3_configure_identities(env):
+    run(env, "s3.configure -user=ops -access_key=AK1 -secret_key=SK1 "
+             "-actions=Read:logs,Write:logs")
+    listing = run(env, "s3.configure")
+    assert "AK1" in listing and "Read:logs" in listing
+    run(env, "s3.configure -user=ops -delete")
+    assert "AK1" not in run(env, "s3.configure")
+
+
+def test_mq_topic_list(env):
+    from seaweedfs_tpu.mq import Broker
+
+    _, _, fsrv = env._cluster
+    assert "no topics" in run(env, "mq.topic.list")
+    b = Broker(filer=fsrv.address)
+    b.publish("shell", "events", b"k", b"v")
+    b.flush_to_filer()
+    assert "shell.events" in run(env, "mq.topic.list")
+
+
+def test_volume_grow_and_mount_cycle(env):
+    out = run(env, "volume.grow -count=1")
+    assert "grew" in out
+    listing = run(env, "volume.list")
+    # grab a volume id + node from the listing via topology
+    dn = env.collect_data_nodes()[0]
+    vid = None
+    for disk in dn.disk_infos.values():
+        for v in disk.volume_infos:
+            vid = v.id
+            break
+    assert vid is not None
+    run(env, f"volume.unmount -node={dn.id} -volumeId={vid}")
+    env.wait_heartbeat()
+    run(env, f"volume.mount -node={dn.id} -volumeId={vid}")
+
+
+def test_volume_configure_replication(env):
+    dn = env.collect_data_nodes()[0]
+    vid = next(v.id for disk in dn.disk_infos.values()
+               for v in disk.volume_infos)
+    run(env, "lock")
+    out = run(env, f"volume.configure.replication -volumeId={vid} "
+                   f"-replication=001")
+    run(env, "unlock")
+    assert "configured replication=001" in out
+
+
+def test_volume_fsck(env):
+    _, _, fsrv = env._cluster
+    requests.put(f"http://{fsrv.address}/fsck/f.txt", data=b"x" * 100,
+                 timeout=30)
+    out = run(env, "volume.fsck -verbose")
+    assert "0 dangling" in out and "0 unreadable" in out
+
+
+def test_fs_configure_and_mount_configure(env):
+    # without -apply: dry run, nothing persisted
+    out = run(env, "fs.configure -locationPrefix=/buckets/dry "
+                   "-collection=dry")
+    assert "dry run" in out
+    assert "dry" not in run(env, "fs.configure")
+    out = run(env, "fs.configure -locationPrefix=/buckets/special "
+                   "-collection=special -replication=000 -apply")
+    assert "/buckets/special" in out
+    out = run(env, "fs.configure")
+    assert "special" in out
+    out = run(env, "mount.configure -dir=/mnt/a -quotaMB=512")
+    assert "512" in out
+
+
+def test_fs_meta_tail(env):
+    _, _, fsrv = env._cluster
+    requests.put(f"http://{fsrv.address}/tailme/x.txt", data=b"1",
+                 timeout=30)
+    out = run(env, "fs.meta.tail -timeAgo=30s -pathPrefix=/tailme")
+    assert "create /tailme/x.txt" in out
+
+
+def test_cluster_raft_ps_single_master(env):
+    out = run(env, "cluster.raft.ps")
+    assert "single-master" in out

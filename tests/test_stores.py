@@ -75,6 +75,24 @@ def test_leveldb_store_compaction(tmp_path):
     store2.close()
 
 
+def test_leveldb_store_torn_tail_repair(tmp_path):
+    store = get_store("leveldb", directory=str(tmp_path / "ldb"))
+    store.insert_entry(Entry(full_path="/ok/a", attr=Attr(mtime=1)))
+    store.close()
+    # simulate a crash mid-append: tear the last record
+    log = tmp_path / "ldb" / "filer.log"
+    blob = log.read_bytes()
+    log.write_bytes(blob + b"\x01\xff\xff\x00\x00\x10\x00\x00\x00part")
+    store2 = get_store("leveldb", directory=str(tmp_path / "ldb"))
+    assert store2.find_entry("/ok/a").attr.mtime == 1
+    # the torn tail was truncated; new writes append cleanly
+    store2.insert_entry(Entry(full_path="/ok/b"))
+    store2.close()
+    store3 = get_store("leveldb", directory=str(tmp_path / "ldb"))
+    assert store3.find_entry("/ok/b") is not None
+    store3.close()
+
+
 def test_gated_stores_fail_with_guidance():
     assert "redis" in available_stores()
     with pytest.raises(RuntimeError, match="redis-py"):
