@@ -16,6 +16,14 @@ import threading
 def main(argv=None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
     p = argparse.ArgumentParser(prog="weed-tpu", description=__doc__)
+    # global flags (weed.go -v/-vmodule + grace.SetupProfiling)
+    p.add_argument("-v", type=int, default=0, help="glog verbosity level")
+    p.add_argument("-vmodule", default="",
+                   help="per-module verbosity, e.g. volume=2,filer=1")
+    p.add_argument("-cpuprofile", default="",
+                   help="write a cProfile dump at exit")
+    p.add_argument("-memprofile", default="",
+                   help="write a tracemalloc summary at exit")
     sub = p.add_subparsers(dest="cmd")
 
     mp = sub.add_parser("master", help="run a master server")
@@ -28,6 +36,9 @@ def main(argv=None) -> int:
                     help="comma-separated master peers for Raft HA")
     mp.add_argument("-mdir", default="",
                     help="directory for Raft state persistence")
+    mp.add_argument("-metricsAddress", default="",
+                    help="Prometheus push-gateway, broadcast to the fleet")
+    mp.add_argument("-metricsIntervalSec", type=int, default=15)
 
     vp = sub.add_parser("volume", help="run a volume server")
     vp.add_argument("-dir", default="./data", help="comma-separated data dirs")
@@ -216,6 +227,15 @@ def main(argv=None) -> int:
     if opts.cmd is None:
         p.print_help()
         return 1
+    from ..utils import glog
+    from ..utils.grace import setup_profiling
+
+    if opts.v:
+        glog.set_verbosity(opts.v)
+    if opts.vmodule:
+        glog.set_vmodule(opts.vmodule)
+    if opts.cpuprofile or opts.memprofile:
+        setup_profiling(opts.cpuprofile, opts.memprofile)
     return _run(opts)
 
 
@@ -235,14 +255,20 @@ def _run(opts) -> int:
 
     if opts.cmd == "master":
         from ..server.master import MasterServer
+        from ..utils.config import load_security_config
 
+        sec = load_security_config()
         ms = MasterServer(ip=opts.ip, port=opts.port,
                           volume_size_limit_mb=opts.volumeSizeLimitMB,
                           default_replication=opts.defaultReplication,
                           garbage_threshold=opts.garbageThreshold,
                           peers=[p.strip() for p in opts.peers.split(",")
                                  if p.strip()] or None,
-                          raft_dir=opts.mdir or None)
+                          raft_dir=opts.mdir or None,
+                          metrics_address=opts.metricsAddress,
+                          metrics_interval_sec=opts.metricsIntervalSec,
+                          write_jwt_key=sec["write_key"],
+                          jwt_expires_sec=sec["expires_sec"])
         ms.start()
         _wait_forever()
         ms.stop()
@@ -264,6 +290,12 @@ def _run(opts) -> int:
 
             with open(opts.tierConfig) as f:
                 tier_conf = _json.load(f)
+        from ..security import Guard
+        from ..utils.config import load_security_config
+
+        sec = load_security_config()
+        guard = Guard(whitelist=sec["whitelist"]) if sec["whitelist"] \
+            else None
         vsrv = VolumeServer(directories=dirs, master=opts.mserver,
                             ip=opts.ip, port=opts.port,
                             data_center=opts.dataCenter, rack=opts.rack,
@@ -271,7 +303,9 @@ def _run(opts) -> int:
                             tier_backends=tier_conf,
                             needle_map_kind=("sqlite"
                                              if opts.index != "memory"
-                                             else "memory"))
+                                             else "memory"),
+                            write_jwt_key=sec["write_key"],
+                            guard=guard)
         vsrv.start()
         _wait_forever()
         vsrv.stop()
@@ -451,6 +485,9 @@ def _run(opts) -> int:
 
         import requests
 
+        if len(opts.files) < 2:
+            print("usage: filer.copy <src>... <dest-dir>", file=sys.stderr)
+            return 1
         *sources, dest = opts.files
         dest = dest if dest.startswith("/") else "/" + dest
         copied = 0
@@ -501,6 +538,7 @@ def _run(opts) -> int:
         # resume from the last backed-up event so restarts don't duplicate
         since_ns = 0
         if _os.path.exists(opts.output):
+            good_end = 0
             with open(opts.output, "rb") as f:
                 while True:
                     hdr = f.read(4)
@@ -513,6 +551,11 @@ def _run(opts) -> int:
                     msg = filer_pb2.SubscribeMetadataResponse.FromString(
                         blob)
                     since_ns = max(since_ns, msg.ts_ns)
+                    good_end = f.tell()
+            if good_end < _os.path.getsize(opts.output):
+                # truncate a torn tail so appended records stay parseable
+                with open(opts.output, "r+b") as f:
+                    f.truncate(good_end)
         stub = rpc.filer_stub(rpc.grpc_address(opts.filer))
         with open(opts.output, "ab") as f:
             req = filer_pb2.SubscribeMetadataRequest(

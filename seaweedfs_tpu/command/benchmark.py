@@ -36,7 +36,8 @@ def run_benchmark(opts) -> dict:
         a = assign(master, collection=opts.collection)
         if a.error:
             return None
-        r = upload_data(f"http://{a.url}/{a.fid}", payload, compress=False)
+        r = upload_data(f"http://{a.url}/{a.fid}", payload, compress=False,
+                        auth=a.auth)
         lat_w[i] = time.perf_counter() - t0
         return a.fid if not r.error else None
 

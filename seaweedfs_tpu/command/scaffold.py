@@ -27,13 +27,16 @@ copy_other = 3
 type = "memory"   # or "snowflake"
 """,
     "security": """\
-# security.toml
+# security.toml — searched in ./ , ~/.seaweedfs-tpu/ , /etc/seaweedfs-tpu/
 [jwt.signing]
 key = ""            # base64 secret; empty disables write JWT
 expires_after_seconds = 10
 
 [jwt.signing.read]
 key = ""
+
+[guard]
+white_list = []     # e.g. ["127.0.0.1", "10.0.0.0/8"]; empty = open
 
 [access]
 ui = true

@@ -67,7 +67,8 @@ class LevelDbStore:
             if op not in (_PUT, _DEL, _KV) or off + 9 + klen + vlen > size:
                 self._log.truncate(off)
                 break
-            op, key, value = self._read_at(off)
+            blob = os.pread(self._log.fileno(), klen + vlen, off + 9)
+            key, value = blob[:klen], blob[klen:]
             try:
                 if op == _PUT:
                     self._index_put(key.decode(), off, replay=True)

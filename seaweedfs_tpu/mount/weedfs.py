@@ -478,7 +478,9 @@ class WFS:
         if resp.error:
             raise FuseError(errno.EIO, resp.error)
         url = f"http://{resp.location.url}/{resp.file_id}"
-        r = requests.put(url, data=data, timeout=60)
+        headers = {"Authorization": f"Bearer {resp.auth}"} if resp.auth \
+            else {}
+        r = requests.put(url, data=data, headers=headers, timeout=60)
         if r.status_code >= 300:
             raise FuseError(errno.EIO, f"upload {url}: {r.status_code}")
         j = r.json()

@@ -26,6 +26,7 @@ class AssignResult:
     public_url: str = ""
     count: int = 0
     error: str = ""
+    auth: str = ""  # write JWT minted by the master (jwt.go:30)
     replicas: list = field(default_factory=list)
 
 
@@ -40,6 +41,7 @@ def assign(master: str, *, count: int = 1, collection: str = "",
     return AssignResult(
         fid=resp.fid, url=resp.location.url,
         public_url=resp.location.public_url, count=resp.count,
+        auth=resp.auth,
         replicas=[l.url for l in resp.replicas],
     )
 
@@ -54,10 +56,13 @@ class UploadResult:
 
 def upload_data(url: str, data: bytes, *, filename: str = "",
                 mime: str = "application/octet-stream", ttl: str = "",
-                compress: bool = True, retries: int = 3) -> UploadResult:
+                compress: bool = True, retries: int = 3,
+                auth: str = "") -> UploadResult:
     """PUT needle bytes to a volume server (UploadData w/ retry,
     upload_content.go:85,134)."""
     headers = {"Content-Type": mime or "application/octet-stream"}
+    if auth:
+        headers["Authorization"] = f"Bearer {auth}"
     body = data
     if (compress and len(data) >= COMPRESS_MIN and _compressible(mime)):
         gz = gzip.compress(data, 3)
@@ -123,7 +128,7 @@ def submit(master: str, data: bytes, *, filename: str = "",
     if a.error:
         return {"error": a.error}
     r = upload_data(f"http://{a.url}/{a.fid}", data, filename=filename,
-                    mime=mime, ttl=ttl)
+                    mime=mime, ttl=ttl, auth=a.auth)
     if r.error:
         return {"error": r.error}
     return {"fid": a.fid, "url": a.url, "size": r.size, "eTag": r.etag}

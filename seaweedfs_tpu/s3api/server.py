@@ -215,6 +215,17 @@ def _make_handler(srv: S3Server):
             self._dispatch("DELETE")
 
         def _dispatch(self, verb: str):
+            if verb == "GET" and self.path == "/metrics":
+                from ..utils.stats import gather
+
+                body = gather().encode()
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             bucket, key, q, u = self._route()
             try:
                 with S3_REQUEST_HISTOGRAM.time(action=f"{verb.lower()}"):

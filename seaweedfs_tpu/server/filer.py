@@ -113,7 +113,8 @@ class FilerServer:
                    replication=self.replication, ttl=ttl)
         if a.error:
             raise IOError(f"assign: {a.error}")
-        r = upload_data(f"http://{a.url}/{a.fid}", data, ttl=ttl)
+        r = upload_data(f"http://{a.url}/{a.fid}", data, ttl=ttl,
+                        auth=a.auth)
         if r.error:
             raise IOError(f"upload: {r.error}")
         return filer_pb2.FileChunk(
@@ -292,7 +293,7 @@ class FilerGrpc:
         if a.error:
             return filer_pb2.AssignVolumeResponse(error=a.error)
         return filer_pb2.AssignVolumeResponse(
-            file_id=a.fid, count=a.count,
+            file_id=a.fid, count=a.count, auth=a.auth,
             collection=request.collection or self.srv.collection,
             replication=request.replication or self.srv.replication,
             location=filer_pb2.Location(url=a.url, public_url=a.public_url),

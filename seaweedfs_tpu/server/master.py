@@ -48,7 +48,18 @@ class MasterServer:
                  allocate_fn=None,
                  peers: list[str] | None = None,
                  raft_dir: str | None = None,
-                 raft_transport=None):
+                 raft_transport=None,
+                 metrics_address: str = "",
+                 metrics_interval_sec: int = 15,
+                 write_jwt_key: bytes = b"",
+                 jwt_expires_sec: int = 10):
+        # JWT minting for authorized writes (security/jwt.go:30)
+        self.write_jwt_key = write_jwt_key
+        self.jwt_expires_sec = jwt_expires_sec
+        # push-gateway target broadcast to the fleet at heartbeat
+        # (GetMasterConfiguration -> volume servers start pushing)
+        self.metrics_address = metrics_address
+        self.metrics_interval_sec = metrics_interval_sec
         self.ip = ip
         self.port = port
         self.grpc_port = rpc.derived_grpc_port(port)
@@ -95,6 +106,13 @@ class MasterServer:
         if self.raft is None or self.raft.leader_id is None:
             return self.address
         return self.raft.leader_id
+
+    def mint_write_jwt(self, fid: str) -> str:
+        if not self.write_jwt_key:
+            return ""
+        from ..security import gen_write_jwt
+
+        return gen_write_jwt(self.write_jwt_key, fid, self.jwt_expires_sec)
 
     def _raft_apply(self, cmd: dict) -> None:
         if cmd.get("op") == "max_volume_id":
@@ -382,6 +400,7 @@ class MasterGrpc:
             fid=r["fid"], count=r["count"],
             location=r["location"].to_location(),
             replicas=[dn.to_location() for dn in r["replicas"]],
+            auth=self.ms.mint_write_jwt(r["fid"]),
         )
 
     def Statistics(self, request, context):
@@ -443,6 +462,8 @@ class MasterGrpc:
             leader=self.ms.leader_address(),
             default_replication=self.ms.default_replication,
             volume_size_limit_m_b=self.ms.topo.volume_size_limit // (1024 * 1024),
+            metrics_address=self.ms.metrics_address,
+            metrics_interval_seconds=self.ms.metrics_interval_sec,
         )
 
     def LeaseAdminToken(self, request, context):
@@ -504,10 +525,14 @@ def _make_http_handler(ms: MasterServer):
                 )
                 if "error" in r:
                     return self._json(r, 404)
-                return self._json({
+                out = {
                     "fid": r["fid"], "count": r["count"],
                     "url": r["url"], "publicUrl": r["publicUrl"],
-                })
+                }
+                auth = ms.mint_write_jwt(r["fid"])
+                if auth:
+                    out["auth"] = auth
+                return self._json(out)
             if u.path == "/dir/lookup":
                 if not ms.is_leader() and ms.leader_address() != ms.address:
                     import requests as _rq

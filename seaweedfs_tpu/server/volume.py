@@ -58,7 +58,11 @@ class VolumeServer:
                  pulse_seconds: int = 5, coder=None,
                  ec_geometry: Geometry = Geometry(),
                  tier_backends: dict | None = None,
-                 needle_map_kind: str = "memory"):
+                 needle_map_kind: str = "memory",
+                 write_jwt_key: bytes = b"",
+                 guard=None):
+        self.write_jwt_key = write_jwt_key
+        self.guard = guard  # IP whitelist (security.Guard) or None
         if tier_backends:
             from ..storage.backend import load_tier_backends
 
@@ -101,11 +105,37 @@ class VolumeServer:
         )
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
+        threading.Thread(target=self._check_with_master, daemon=True).start()
         glog.info(f"volume server started on {self.address} (grpc :{self.grpc_port})")
+
+    def _check_with_master(self) -> None:
+        """checkWithMaster (volume_grpc_client_to_master.go:28-47): pull
+        cluster config — start pushing metrics if the master names a
+        push gateway."""
+        from ..utils.stats import start_push
+
+        while not self._stop.is_set():
+            try:
+                resp = rpc.master_stub(self.master_grpc) \
+                    .GetMasterConfiguration(
+                        master_pb2.GetMasterConfigurationRequest(),
+                        timeout=10)
+                if resp.metrics_address:
+                    self._stop_metrics_push = start_push(
+                        resp.metrics_address,
+                        f"volumeServer-{self.port}",
+                        resp.metrics_interval_seconds or 15)
+                return
+            except grpc.RpcError:
+                if self._stop.wait(2.0):
+                    return
 
     def stop(self) -> None:
         self._stop.set()
         self._hb_wake.set()
+        stop_push = getattr(self, "_stop_metrics_push", None)
+        if stop_push is not None:
+            stop_push()
         if self._http_server:
             self._http_server.shutdown()
         if self._grpc_server:
@@ -286,11 +316,20 @@ class VolumeServer:
                         locations: list[str]) -> None:
         import requests as rq
 
+        # replicas enforce JWT like any write; re-sign with the shared
+        # cluster key (the reference re-mints for fan-out the same way)
+        headers = {}
+        if self.write_jwt_key:
+            from ..security import gen_write_jwt
+
+            headers["Authorization"] = \
+                f"Bearer {gen_write_jwt(self.write_jwt_key, fid)}"
+
         def send(addr):
             url = f"http://{addr}/{fid}?type=replicate"
             for k, v in params.items():
                 url += f"&{k}={v}"
-            r = rq.put(url, data=body, timeout=30)
+            r = rq.put(url, data=body, headers=headers, timeout=30)
             if r.status_code >= 300:
                 raise IOError(f"replica write to {addr}: {r.status_code}")
 
@@ -872,9 +911,20 @@ def _make_http_handler(srv: VolumeServer):
         def _json(self, obj, code: int = 200, headers=None) -> None:
             self._reply(code, json.dumps(obj).encode(), headers=headers)
 
+        def _guard_denied(self) -> bool:
+            """IP whitelist (privateStoreHandler wrapping, guard.go:52)."""
+            if srv.guard is None:
+                return False
+            if srv.guard.is_allowed(self.client_address[0]):
+                return False
+            self._json({"error": "forbidden"}, 403)
+            return True
+
         # -- GET/HEAD (volume_server_handlers_read.go:31)
 
         def do_GET(self):
+            if self._guard_denied():
+                return
             u = urlparse(self.path)
             if u.path == "/status":
                 vols = {}
@@ -951,12 +1001,27 @@ def _make_http_handler(srv: VolumeServer):
         do_POST = do_PUT
 
         def _handle_write(self):
+            if self._guard_denied():
+                return
             u = urlparse(self.path)
             q = {k: v[0] for k, v in parse_qs(u.query).items()}
             try:
                 fid = parse_file_id(u.path.lstrip("/"))
             except ValueError as e:
                 return self._json({"error": str(e)}, 400)
+            # JWT write authorization (security.toml jwt.signing) — also
+            # enforced on replica fan-out (the primary re-signs; exempting
+            # ?type=replicate would let anyone forge the param)
+            if srv.write_jwt_key:
+                from ..security import JwtError, verify_fid_jwt
+
+                token = (self.headers.get("Authorization") or "") \
+                    .removeprefix("Bearer ").strip() or q.get("auth", "")
+                try:
+                    verify_fid_jwt(token, srv.write_jwt_key,
+                                   u.path.lstrip("/"))
+                except JwtError as e:
+                    return self._json({"error": f"jwt: {e}"}, 401)
             length = int(self.headers.get("Content-Length") or 0)
             body = self.rfile.read(length)
             name, data = _extract_upload(self.headers, body)
@@ -992,12 +1057,24 @@ def _make_http_handler(srv: VolumeServer):
         # -- DELETE
 
         def do_DELETE(self):
+            if self._guard_denied():
+                return
             u = urlparse(self.path)
             q = {k: v[0] for k, v in parse_qs(u.query).items()}
             try:
                 fid = parse_file_id(u.path.lstrip("/"))
             except ValueError as e:
                 return self._json({"error": str(e)}, 400)
+            if srv.write_jwt_key:  # deletes are writes (jwt.go)
+                from ..security import JwtError, verify_fid_jwt
+
+                token = (self.headers.get("Authorization") or "") \
+                    .removeprefix("Bearer ").strip() or q.get("auth", "")
+                try:
+                    verify_fid_jwt(token, srv.write_jwt_key,
+                                   u.path.lstrip("/"))
+                except JwtError as e:
+                    return self._json({"error": f"jwt: {e}"}, 401)
             try:
                 size = srv.store.delete_needle(fid.volume_id, fid.key, fid.cookie)
             except NotFoundError:
@@ -1010,6 +1087,13 @@ def _make_http_handler(srv: VolumeServer):
             except CookieMismatch as e:
                 return self._json({"error": str(e)}, 403)
             if q.get("type") != "replicate":
+                del_headers = {}
+                if srv.write_jwt_key:
+                    from ..security import gen_write_jwt
+
+                    del_headers["Authorization"] = "Bearer " + \
+                        gen_write_jwt(srv.write_jwt_key,
+                                      u.path.lstrip("/"))
                 for addr in srv.lookup_volume_locations(fid.volume_id):
                     if addr == srv.address:
                         continue
@@ -1017,7 +1101,7 @@ def _make_http_handler(srv: VolumeServer):
                         import requests as rq
 
                         rq.delete(f"http://{addr}{u.path}?type=replicate",
-                                  timeout=30)
+                                  headers=del_headers, timeout=30)
                     except Exception:  # noqa: BLE001
                         pass
             self._json({"size": size}, 202)

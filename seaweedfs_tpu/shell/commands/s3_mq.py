@@ -99,11 +99,15 @@ def mq_topic_list(env, args, out):
     stub = _stub(env)
 
     def listdir(d):
+        import grpc
+
         try:
             return [r.entry for r in stub.ListEntries(
                 filer_pb2.ListEntriesRequest(directory=d, limit=10000))]
-        except Exception:
-            return []
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                return []  # /topics doesn't exist yet
+            raise  # connectivity failures must surface, not read as empty
 
     found = 0
     for ns in listdir("/topics"):

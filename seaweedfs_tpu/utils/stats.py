@@ -163,3 +163,24 @@ FILER_STORE_SECONDS = Counter(
 
 def master_metrics_text() -> str:
     return gather()
+
+
+def start_push(gateway_url: str, job: str, interval_sec: int = 15):
+    """Push the registry to a Prometheus push gateway on an interval
+    (stats.StartPushingMetric / LoopPushingMetric). Returns a stop()."""
+    import requests
+
+    stop = threading.Event()
+
+    def loop():
+        url = f"{gateway_url.rstrip('/')}/metrics/job/{job}"
+        while not stop.wait(interval_sec):
+            try:
+                requests.put(url, data=gather().encode(),
+                             headers={"Content-Type": "text/plain"},
+                             timeout=10)
+            except requests.RequestException:
+                pass
+
+    threading.Thread(target=loop, daemon=True).start()
+    return stop.set

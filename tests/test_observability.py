@@ -1,0 +1,196 @@
+"""Observability + config + security wiring (SURVEY.md §5.1/§5.5/§5.6):
+TOML config tiers, grace profiling, metrics exposition/push, JWT writes,
+guard whitelist."""
+
+import json
+import os
+import socket
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.pb import rpc
+from seaweedfs_tpu.security import Guard, gen_write_jwt
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+from seaweedfs_tpu.utils import config as cfg
+from seaweedfs_tpu.utils.stats import gather
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+# -- config ---------------------------------------------------------------
+
+def test_config_search_and_env_expansion(tmp_path, monkeypatch):
+    monkeypatch.setenv("SECRET_VAL", "s3cr3t")
+    (tmp_path / "custom.toml").write_text(
+        'title = "${SECRET_VAL}"\n[nested]\nvalue = 42\n')
+    monkeypatch.setattr(cfg, "SEARCH_PATHS", [str(tmp_path)])
+    conf = cfg.load_config("custom")
+    assert conf["title"] == "s3cr3t"
+    assert cfg.get_path(conf, "nested.value") == 42
+    assert cfg.get_path(conf, "nested.missing", "dflt") == "dflt"
+    assert cfg.load_config("absent") == {}
+    with pytest.raises(FileNotFoundError):
+        cfg.load_config("absent", required=True)
+
+
+def test_security_config_loading(tmp_path, monkeypatch):
+    import base64
+
+    key = base64.b64encode(b"topsecret").decode()
+    (tmp_path / "security.toml").write_text(
+        f'[jwt.signing]\nkey = "{key}"\nexpires_after_seconds = 30\n'
+        f'[guard]\nwhite_list = ["127.0.0.1"]\n')
+    monkeypatch.setattr(cfg, "SEARCH_PATHS", [str(tmp_path)])
+    sec = cfg.load_security_config()
+    assert sec["write_key"] == b"topsecret"
+    assert sec["expires_sec"] == 30
+    assert sec["whitelist"] == ["127.0.0.1"]
+
+
+# -- grace ----------------------------------------------------------------
+
+def test_grace_profiling_dumps(tmp_path):
+    import subprocess
+    import sys
+
+    cpu = tmp_path / "cpu.pprof"
+    mem = tmp_path / "mem.txt"
+    code = (
+        "from seaweedfs_tpu.utils.grace import setup_profiling\n"
+        f"setup_profiling({str(cpu)!r}, {str(mem)!r})\n"
+        "x = sum(i * i for i in range(10000))\n"
+    )
+    subprocess.run([sys.executable, "-c", code], check=True,
+                   cwd="/root/repo")
+    assert cpu.exists() and cpu.stat().st_size > 0
+    assert mem.exists()
+    import pstats
+
+    stats = pstats.Stats(str(cpu))
+    assert stats.total_calls > 0
+
+
+# -- metrics --------------------------------------------------------------
+
+def test_metrics_exposition_format():
+    text = gather()
+    assert "# TYPE SeaweedFS_volumeServer_request_seconds histogram" in text
+    assert "SeaweedFS_filerStore_ops" in text
+
+
+def test_metrics_push_and_master_broadcast(tmp_path):
+    # a fake push gateway capturing PUTs
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+    import threading
+
+    received = []
+
+    class GW(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_PUT(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            received.append((self.path, self.rfile.read(n)))
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    gw_port = _free_port()
+    gw = ThreadingHTTPServer(("", gw_port), GW)
+    threading.Thread(target=gw.serve_forever, daemon=True).start()
+
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64,
+                          metrics_address=f"http://localhost:{gw_port}",
+                          metrics_interval_sec=1)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "v")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), pulse_seconds=1)
+    vsrv.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and not received:
+            time.sleep(0.2)
+        assert received, "volume server never pushed metrics"
+        path, body = received[0]
+        assert path.startswith("/metrics/job/volumeServer-")
+        assert b"SeaweedFS_" in body
+    finally:
+        vsrv.stop()
+        master.stop()
+        gw.shutdown()
+        rpc.reset_channels()
+
+
+# -- JWT + guard ----------------------------------------------------------
+
+def test_jwt_protected_writes(tmp_path):
+    key = b"jwt-test-key"
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64, write_jwt_key=key)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "v")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), pulse_seconds=1,
+                        write_jwt_key=key)
+    vsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    try:
+        r = requests.get(f"http://localhost:{mport}/dir/assign?count=1",
+                         timeout=10).json()
+        assert r.get("auth"), "master did not mint a JWT"
+        fid, url = r["fid"], r["url"]
+        # unauthorized write is refused
+        bad = requests.put(f"http://{url}/{fid}", data=b"x", timeout=10)
+        assert bad.status_code == 401
+        # with the minted token it lands
+        ok = requests.put(f"http://{url}/{fid}", data=b"authorized",
+                          headers={"Authorization": f"Bearer {r['auth']}"},
+                          timeout=10)
+        assert ok.status_code == 201, ok.text
+        # reads are open (no read key configured)
+        got = requests.get(f"http://{url}/{fid}", timeout=10)
+        assert got.content == b"authorized"
+        # a token for a different fid is refused
+        other = gen_write_jwt(key, "99,deadbeef01")
+        bad2 = requests.put(f"http://{url}/{fid}", data=b"y", timeout=10,
+                            headers={"Authorization": f"Bearer {other}"})
+        assert bad2.status_code == 401
+    finally:
+        vsrv.stop()
+        master.stop()
+        rpc.reset_channels()
+
+
+def test_guard_whitelist(tmp_path):
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport, volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "v")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), pulse_seconds=1,
+                        guard=Guard(whitelist=["10.9.9.9"]))
+    vsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    try:
+        r = requests.get(f"http://{vsrv.address}/status", timeout=10)
+        assert r.status_code == 403  # we come from 127.0.0.1
+    finally:
+        vsrv.stop()
+        master.stop()
+        rpc.reset_channels()
