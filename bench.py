@@ -25,30 +25,26 @@ import numpy as np
 
 def _bench_device(data_shards: int = 10, parity_shards: int = 4,
                   col_bytes: int = 8 * 1024 * 1024, iters: int = 8) -> float:
-    """Data GB/s of the jitted encode kernel, input resident on device."""
-    import jax
+    """Data GB/s of the device encode kernel (Pallas on TPU backends,
+    plain XLA elsewhere — rs_jax._dispatch_matmul picks), input resident
+    on device. Two distinct buffers alternate so runtime-level caching of
+    identical dispatches can't inflate the number."""
     import jax.numpy as jnp
 
-    from seaweedfs_tpu.ops import gf256
-    from seaweedfs_tpu.ops.rs_jax import gf_matmul_bits, gf_matrix_to_bits
+    from seaweedfs_tpu.ops.rs_jax import RSCodecJax
 
-    parity_bits = jnp.asarray(
-        gf_matrix_to_bits(gf256.parity_matrix(data_shards, parity_shards))
-    )
-
-    @jax.jit
-    def encode(data):
-        return gf_matmul_bits(parity_bits, data)
-
+    coder = RSCodecJax(data_shards, parity_shards)
     rng = np.random.default_rng(0)
-    data = jnp.asarray(
-        rng.integers(0, 256, size=(data_shards, col_bytes), dtype=np.uint8)
-    )
-    encode(data).block_until_ready()  # compile
+    bufs = [jnp.asarray(rng.integers(0, 256,
+                                     size=(data_shards, col_bytes),
+                                     dtype=np.uint8))
+            for _ in range(2)]
+    coder.encode_parity(bufs[0]).block_until_ready()  # compile
+    coder.encode_parity(bufs[1]).block_until_ready()
     t0 = time.perf_counter()
-    for _ in range(iters):
-        out = encode(data)
-    out.block_until_ready()
+    outs = [coder.encode_parity(bufs[i % 2]) for i in range(iters)]
+    for o in outs:
+        o.block_until_ready()
     dt = time.perf_counter() - t0
     total = data_shards * col_bytes * iters
     return total / dt / 1e9

@@ -129,6 +129,34 @@ def _apply_matrix_jit(matrix_bits: jax.Array, data: jax.Array) -> jax.Array:
     return gf_matmul_bits(matrix_bits, data)
 
 
+def _use_pallas(b: int) -> bool:
+    """Pallas kernel on TPU backends for large batches: it keeps the 8x
+    bit expansion in VMEM instead of round-tripping it through HBM.
+    SEAWEEDFS_TPU_NO_PALLAS=1 forces the plain XLA formulation."""
+    import os
+
+    if os.environ.get("SEAWEEDFS_TPU_NO_PALLAS"):
+        return False
+    from .rs_pallas import TILE_N, pallas_available
+
+    return b >= TILE_N and pallas_available()
+
+
+def _dispatch_matmul(matrix_bits: jax.Array, data: jax.Array,
+                     out_rows: int) -> jax.Array:
+    """Padded GF matmul via the best backend for this platform/shape.
+    Outputs are bit-identical across paths (tests + bench assert it)."""
+    b = data.shape[1]
+    if _use_pallas(b):
+        from .rs_pallas import TILE_N, gf_matmul_bits_pallas
+
+        padded = (b + TILE_N - 1) // TILE_N * TILE_N
+        if padded != b:
+            data = jnp.pad(data, ((0, 0), (0, padded - b)))
+        return gf_matmul_bits_pallas(matrix_bits, data, out_rows)[:, :b]
+    return _apply_matrix_jit(matrix_bits, _pad_bytes(data, b))[:, :b]
+
+
 class RSCodecJax:
     """klauspost-compatible RS codec with a JAX/TPU execution backend.
 
@@ -154,6 +182,10 @@ class RSCodecJax:
         data = jnp.asarray(data, dtype=jnp.uint8)
         assert data.shape[0] == self.data_shards, data.shape
         b = data.shape[1]
+        if _use_pallas(b):
+            bits = jnp.asarray(gf_matrix_to_bits(
+                gf256.parity_matrix(self.data_shards, self.parity_shards)))
+            return _dispatch_matmul(bits, data, self.parity_shards)
         out = _encode_jit(_pad_bytes(data, b), self.data_shards, self.parity_shards)
         return out[:, :b]
 
@@ -186,8 +218,7 @@ class RSCodecJax:
             return {}
         dec_bits, used = self._decode_bits(tuple(sorted(present.keys())))
         stacked = jnp.stack([jnp.asarray(present[i], jnp.uint8) for i in used])
-        b = stacked.shape[1]
-        data = _apply_matrix_jit(dec_bits, _pad_bytes(stacked, b))[:, :b]
+        data = _dispatch_matmul(dec_bits, stacked, self.data_shards)
         return {i: data[i] for i in missing_data}
 
     def reconstruct(
@@ -200,8 +231,7 @@ class RSCodecJax:
             return {}
         dec_bits, used = self._decode_bits(tuple(sorted(present.keys())))
         stacked = jnp.stack([jnp.asarray(present[i], jnp.uint8) for i in used])
-        b = stacked.shape[1]
-        data = _apply_matrix_jit(dec_bits, _pad_bytes(stacked, b))[:, :b]  # [k, B]
+        data = _dispatch_matmul(dec_bits, stacked, self.data_shards)  # [k, B]
         out: dict[int, jax.Array] = {}
         need_parity = any(i >= self.data_shards for i in missing)
         parity = self.encode_parity(data) if need_parity else None

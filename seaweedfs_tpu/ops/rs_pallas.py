@@ -1,0 +1,83 @@
+"""Pallas TPU kernel for the bitsliced GF(2^8) Reed-Solomon matmul.
+
+The XLA path (rs_jax.gf_matmul_bits) materializes the 8x bit expansion
+([8k, B] int8) and the int32 accumulator through HBM; at 30GB-volume
+batch sizes that traffic dominates. This kernel keeps the whole
+unpack -> MXU dot -> mask -> pack chain inside one VMEM tile, so HBM
+sees only the k data rows in and m parity rows out.
+
+Grid: 1-D over the byte axis. Per tile:
+  data   [k, TN]  uint8  (VMEM in)
+  bits   [8k, TN] int8   (VMEM, transient)
+  acc    [8m, TN] int32  (MXU out, transient)
+  parity [m, TN]  uint8  (VMEM out)
+
+Used automatically by RSCodecJax on TPU backends via rs_jax dispatch;
+falls back to the plain XLA formulation elsewhere (CPU tests run the
+same math through interpret-free XLA, keeping bit-identity oracles).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+# byte-axis tile; multiple of 128 lanes. 8k*TN int8 bits + k*TN data +
+# 8m*TN int32 acc must fit VMEM: k=10,m=4 -> (80 + 10 + 128)*TN ~ 218*TN
+# bytes; TN=16384 -> ~3.6MB, comfortably inside ~16MB.
+TILE_N = 16384
+
+
+def _kernel(mat_ref, data_ref, out_ref):
+    # int32 lanes for the bit twiddling: Mosaic here doesn't legalize
+    # 8-bit vector shifts (arith.shrui on vector<i8>), and reduce_xor /
+    # 3-D iota have no lowering either — hence the unrolled planes
+    data = data_ref[:].astype(jnp.int32)       # [k, TN]
+    k, tn = data.shape
+    # row 8d+j of `bits` is bit j of data row d
+    planes = [((data >> j) & 1) for j in range(8)]
+    bits = jnp.stack(planes, axis=1).reshape(8 * k, tn).astype(jnp.int8)
+    acc = jax.lax.dot_general(
+        mat_ref[:], bits, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32)      # [8m, TN]
+    pbits = acc & 1
+    m8 = pbits.shape[0]
+    pbits = pbits.reshape(m8 // 8, 8, tn)
+    packed = pbits[:, 0, :]
+    for j in range(1, 8):
+        packed = packed | (pbits[:, j, :] << j)
+    out_ref[:] = packed.astype(jnp.uint8)
+
+
+@functools.partial(jax.jit, static_argnames=("out_rows", "interpret"))
+def gf_matmul_bits_pallas(matrix_bits: jax.Array, data: jax.Array,
+                          out_rows: int,
+                          interpret: bool = False) -> jax.Array:
+    """out[R, B] = GFmat (x) data, matrix in bit form [8R, 8C];
+    B must be a multiple of TILE_N lanes (callers pad). interpret=True
+    runs the kernel in the Pallas interpreter (CPU test oracle)."""
+    from jax.experimental import pallas as pl
+
+    k, b = data.shape
+    grid = (b // TILE_N,)
+    return pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((matrix_bits.shape[0], matrix_bits.shape[1]),
+                         lambda i: (0, 0)),
+            pl.BlockSpec((k, TILE_N), lambda i: (0, i)),
+        ],
+        out_specs=pl.BlockSpec((out_rows, TILE_N), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((out_rows, b), jnp.uint8),
+        interpret=interpret,
+    )(matrix_bits, data)
+
+
+def pallas_available() -> bool:
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False

@@ -149,6 +149,13 @@ def rebuild_ec_files(
 
     ins = {i: open(geo.shard_file_name(base_file_name, i), "rb") for i in present}
     outs = {i: open(geo.shard_file_name(base_file_name, i), "wb") for i in missing}
+    pending = None  # (rebuilt dict of device futures) — same double
+    #               buffering as the encoder: disk reads overlap device math
+
+    def flush(rebuilt) -> None:
+        for i in missing:
+            outs[i].write(np.asarray(rebuilt[i], dtype=np.uint8).tobytes())
+
     try:
         offset = 0
         while True:
@@ -166,10 +173,13 @@ def rebuild_ec_files(
                 bufs[i] = np.frombuffer(chunk, dtype=np.uint8)
             if not n:
                 break
-            rebuilt = coder.reconstruct(bufs)
-            for i in missing:
-                outs[i].write(np.asarray(rebuilt[i], dtype=np.uint8).tobytes())
+            rebuilt = coder.reconstruct(bufs)  # async device dispatch
+            if pending is not None:
+                flush(pending)
+            pending = rebuilt
             offset += n
+        if pending is not None:
+            flush(pending)
     finally:
         for f in ins.values():
             f.close()

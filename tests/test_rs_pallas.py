@@ -1,0 +1,38 @@
+"""Pallas GF(2^8) kernel: bit-identity against the XLA formulation
+(SURVEY.md §7 hard part "GF(2^8) on TPU"; kernel in ops/rs_pallas.py).
+On CPU the kernel runs under the Pallas interpreter — the real-TPU
+compilation path is exercised by bench.py on the chip."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from seaweedfs_tpu.ops import gf256
+from seaweedfs_tpu.ops.rs_jax import gf_matmul_bits, gf_matrix_to_bits
+from seaweedfs_tpu.ops.rs_pallas import TILE_N, gf_matmul_bits_pallas
+
+
+@pytest.mark.parametrize("k,m", [(10, 4), (6, 3), (12, 4)])
+def test_pallas_kernel_bit_identical(k, m):
+    mat = jnp.asarray(gf_matrix_to_bits(gf256.parity_matrix(k, m)))
+    rng = np.random.default_rng(k * 100 + m)
+    data = jnp.asarray(
+        rng.integers(0, 256, size=(k, 2 * TILE_N), dtype=np.uint8))
+    ref = gf_matmul_bits(mat, data)
+    out = gf_matmul_bits_pallas(mat, data, m, interpret=True)
+    assert bool(jnp.array_equal(ref, out))
+
+
+def test_pallas_kernel_decode_matrix():
+    # reconstruction matrices route through the same kernel
+    k, m = 10, 4
+    dec, used = gf256.decode_matrix_for(k, m, [0, 2, 3, 4, 5, 7, 8, 9,
+                                               10, 13])
+    bits = jnp.asarray(gf_matrix_to_bits(dec))
+    rng = np.random.default_rng(7)
+    data = jnp.asarray(
+        rng.integers(0, 256, size=(k, TILE_N), dtype=np.uint8))
+    ref = gf_matmul_bits(bits, data)
+    out = gf_matmul_bits_pallas(bits, data, k, interpret=True)
+    assert bool(jnp.array_equal(ref, out))
