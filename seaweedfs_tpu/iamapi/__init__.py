@@ -9,6 +9,7 @@ live into an attached S3 gateway's IdentityAccessManagement.
 
 from __future__ import annotations
 
+import hashlib
 import json
 import threading
 import time
@@ -17,7 +18,7 @@ import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..pb import filer_pb2, rpc
-from ..s3api.auth import Identity
+from ..s3api.auth import AuthError, Identity, IdentityAccessManagement
 from ..utils import glog
 
 IAM_CONFIG_DIR = "/etc/iam"
@@ -105,6 +106,42 @@ class IamServer:
     def stop(self) -> None:
         if self._httpd:
             self._httpd.shutdown()
+
+    # -- auth --------------------------------------------------------------
+
+    def authenticate(self, method: str, path: str, query: str, headers,
+                     body: bytes) -> str | None:
+        """Admin-SigV4 gate for the management API; None = authorized.
+
+        The reference wraps every IAM action in admin auth
+        (iamapi_server.go:72, ``iam.Auth(..., ACTION_ADMIN)``) — without it
+        any network caller could mint credentials (CreateAccessKey) or
+        delete users. Falls open only while NO identity has an access key
+        yet (bootstrap, matching the reference's behavior with an empty
+        s3 config where auth is disabled entirely).
+
+        Fail-closed caveat (same as the reference): if identity.json holds
+        only non-admin keyed users, every action 403s — including
+        PutUserPolicy, so no API path can mint an admin. Recovery is out
+        of band, exactly like the reference: edit /etc/iam/identity.json
+        through the filer (shell ``fs`` commands or ``s3.configure``) to
+        grant an identity the Admin action.
+        """
+        with self._lock:
+            iam = IdentityAccessManagement(
+                [i for i in self.identities if i.access_key])
+        if not iam.enabled:
+            return None
+        payload_hash = headers.get("x-amz-content-sha256") or \
+            hashlib.sha256(body).hexdigest()
+        try:
+            ident = iam.authenticate(method, path, query, headers,
+                                     payload_hash)
+        except AuthError as e:
+            return e.code
+        if ident is not None and not ident.allows("Admin"):
+            return "AccessDenied"
+        return None
 
     # -- state mutation ----------------------------------------------------
 
@@ -340,7 +377,25 @@ def _make_handler(srv: IamServer):
 
         def do_POST(self):
             n = int(self.headers.get("Content-Length") or 0)
-            body = self.rfile.read(n).decode()
+            raw = self.rfile.read(n)
+            u = urllib.parse.urlsplit(self.path)
+            denied = srv.authenticate("POST", u.path, u.query,
+                                      self.headers, raw)
+            if denied:
+                err = ET.Element("ErrorResponse")
+                error = ET.SubElement(err, "Error")
+                ET.SubElement(error, "Code").text = denied
+                ET.SubElement(error, "Message").text = \
+                    "admin credentials required"
+                out = ET.tostring(err, xml_declaration=True,
+                                  encoding="utf-8")
+                self.send_response(403)
+                self.send_header("Content-Type", "text/xml")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+                return
+            body = raw.decode()
             params = {k: v[0] for k, v in
                       urllib.parse.parse_qs(body).items()}
             try:

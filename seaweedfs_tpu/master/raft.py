@@ -112,6 +112,8 @@ class RaftNode:
 
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
+        self._snap_cache: tuple[int, dict] | None = None  # (index, state)
+        self._snap_sent_at: dict[str, float] = {}  # peer -> last send time
         self._mu = threading.RLock()
         self._commit_cv = threading.Condition(self._mu)
         self._election_deadline = 0.0
@@ -177,8 +179,15 @@ class RaftNode:
                 self.commit_index = self.last_applied = e.index
 
     def compact(self) -> None:
-        """Fold applied entries into the snapshot (raft snapshot)."""
+        """Fold applied entries into the snapshot (raft snapshot).
+
+        Requires a snapshot_fn: without one there is nothing to send a
+        lagging follower via InstallSnapshot, so discarding entries would
+        silently lose state for any peer behind the compaction point.
+        """
         with self._mu:
+            if self.snapshot_fn is None:
+                return
             keep = [e for e in self.log if e.index > self.last_applied]
             if len(keep) != len(self.log):
                 folded = [e for e in self.log
@@ -340,6 +349,33 @@ class RaftNode:
                 self._apply_committed()
             return {"term": self.term, "success": True}
 
+    def handle_install_snapshot(self, p: dict) -> dict:
+        """InstallSnapshot (Raft §7): a follower whose next entry was
+        compacted away on the leader restores the leader's state machine
+        snapshot, then resumes AppendEntries past it."""
+        with self._mu:
+            if p["term"] < self.term:
+                return {"term": self.term, "ok": False}
+            if p["term"] > self.term or self.role != FOLLOWER:
+                self._step_down(p["term"])
+            self.term = p["term"]
+            self.leader_id = p["leader"]
+            self._reset_election_timer()
+            idx, tm = p["snapshot_index"], p["snapshot_term"]
+            if idx <= self.commit_index:
+                # stale: we already have (and applied) everything it covers
+                return {"term": self.term, "ok": True}
+            if self.restore_fn is None:
+                return {"term": self.term, "ok": False}
+            self.restore_fn(p["snapshot"])
+            self.snapshot_index, self.snapshot_term = idx, tm
+            # keep only the log suffix past the snapshot
+            self.log = [e for e in self.log if e.index > idx]
+            self.commit_index = idx
+            self.last_applied = idx
+            self._persist()
+            return {"term": self.term, "ok": True}
+
     # -- replication -------------------------------------------------------
 
     def _fanout(self, method: str, payloads: dict[str, dict]
@@ -363,9 +399,39 @@ class RaftNode:
             peers = list(self.peers)
         payloads: dict[str, dict] = {}
         sent: dict[str, tuple[int, list]] = {}
+        snap_payloads: dict[str, dict] = {}
+        snap_index = 0
         with self._mu:
             for peer in peers:
                 nxt = self._next_index.get(peer, self._last_index() + 1)
+                if nxt <= self.snapshot_index and self.snapshot_fn:
+                    # the peer's next entry was compacted away: ship the
+                    # live state machine snapshot instead. It covers
+                    # exactly the applied prefix, so label it last_applied.
+                    # The built snapshot is cached until the state machine
+                    # advances, and resends to a peer are rate-limited so a
+                    # dead/lagging peer doesn't cost a rebuild+reship every
+                    # 150ms heartbeat.
+                    now = time.monotonic()
+                    if now - self._snap_sent_at.get(peer, 0.0) < 1.0:
+                        continue
+                    if self._snap_cache is None or \
+                            self._snap_cache[0] != self.last_applied:
+                        self._snap_cache = (self.last_applied,
+                                            self.snapshot_fn())
+                    snap_index = self._snap_cache[0]
+                    if not snap_payloads:
+                        snap = {
+                            "_from": self.node_id, "term": term,
+                            "leader": self.node_id,
+                            "snapshot_index": snap_index,
+                            "snapshot_term":
+                                self._term_at(snap_index) or self.term,
+                            "snapshot": self._snap_cache[1],
+                        }
+                    snap_payloads[peer] = snap
+                    self._snap_sent_at[peer] = now
+                    continue
                 prev_index = nxt - 1
                 prev_term = self._term_at(prev_index) or 0
                 entries = [{"term": e.term, "index": e.index,
@@ -377,6 +443,19 @@ class RaftNode:
                     "leader": self.node_id, "prev_index": prev_index,
                     "prev_term": prev_term, "entries": entries,
                     "leader_commit": self.commit_index}
+        for peer, resp in self._fanout("install_snapshot",
+                                       snap_payloads).items():
+            if resp is None:
+                continue
+            with self._mu:
+                if resp["term"] > self.term:
+                    self._step_down(resp["term"])
+                    return
+                if resp.get("ok"):
+                    self._match_index[peer] = max(
+                        self._match_index.get(peer, 0), snap_index)
+                    self._next_index[peer] = snap_index + 1
+                    self._snap_sent_at.pop(peer, None)
         for peer, resp in self._fanout("append_entries", payloads).items():
             if resp is None:
                 continue

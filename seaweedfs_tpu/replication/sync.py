@@ -40,6 +40,7 @@ class FilerSyncLoop:
             source_prefix=source_path)
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._stream = None  # live gRPC subscription, for cancel-on-stop
         self.replicated = 0
 
     # -- offset persistence (filer_sync.go getOffset/setOffset) ------------
@@ -64,7 +65,10 @@ class FilerSyncLoop:
     def run_once(self, since_ns: int | None = None,
                  drain_timeout: float | None = 2.0) -> int:
         """Replay available events once; returns new cursor. A finite
-        drain_timeout bounds the tail-wait (None = stream forever)."""
+        drain_timeout bounds the tail-wait; None streams forever (the
+        continuous loop), persisting the cursor after every replicated
+        event so a crash resumes where it left off — with an infinite
+        stream there is no "after the loop" to save at."""
         import grpc
 
         cursor = self.load_cursor() if since_ns is None else since_ns
@@ -72,8 +76,11 @@ class FilerSyncLoop:
         req = filer_pb2.SubscribeMetadataRequest(
             client_name=self.client_name, path_prefix=self.source_path,
             since_ns=cursor)
+        stream = stub.SubscribeMetadata(req, timeout=drain_timeout)
+        self._stream = stream  # stop() cancels it mid-wait
+        continuous = drain_timeout is None
         try:
-            for resp in stub.SubscribeMetadata(req, timeout=drain_timeout):
+            for resp in stream:
                 if self._stop.is_set():
                     break
                 ev = resp.event_notification
@@ -87,10 +94,16 @@ class FilerSyncLoop:
                     glog.error(f"filer.sync replicate @{resp.ts_ns}: {e}")
                     break
                 cursor = resp.ts_ns
+                if continuous:
+                    self.save_cursor(cursor)
         except grpc.RpcError as e:
-            # DEADLINE_EXCEEDED is the normal end of an until-idle drain
-            if e.code() != grpc.StatusCode.DEADLINE_EXCEEDED:
+            # DEADLINE_EXCEEDED is the normal end of an until-idle drain;
+            # CANCELLED is stop() tearing down the continuous stream
+            if e.code() not in (grpc.StatusCode.DEADLINE_EXCEEDED,
+                                grpc.StatusCode.CANCELLED):
                 raise
+        finally:
+            self._stream = None
         self.save_cursor(cursor)
         return cursor
 
@@ -98,7 +111,11 @@ class FilerSyncLoop:
         def loop():
             while not self._stop.is_set():
                 try:
-                    self.run_once()
+                    # stream forever: a finite drain would tear down and
+                    # re-dial the subscription every couple of seconds even
+                    # when fully caught up (the finite drain is for the
+                    # one-shot/test path only)
+                    self.run_once(drain_timeout=None)
                 except Exception as e:
                     glog.v(1, f"filer.sync reconnect: {e}")
                 self._stop.wait(0.5)
@@ -108,5 +125,11 @@ class FilerSyncLoop:
 
     def stop(self) -> None:
         self._stop.set()
+        stream = self._stream
+        if stream is not None:
+            try:
+                stream.cancel()
+            except Exception:
+                pass
         if self._thread:
             self._thread.join(timeout=10)

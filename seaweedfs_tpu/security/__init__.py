@@ -55,25 +55,48 @@ def decode_jwt(token: str, key: bytes) -> dict:
     return claims
 
 
+def normalize_fid(fid: str) -> str:
+    """Canonical token scope for a request fid: strip the filename
+    extension ("3,01ab.jpg") and the delta suffix ("3,01ab_1") — both are
+    views of the same needle, and neither can appear inside the hex fid
+    itself, so stripping is unambiguous."""
+    return fid.split(".", 1)[0].split("_", 1)[0]
+
+
 def gen_write_jwt(key: bytes, fid: str, expires_sec: int = 10) -> str:
     """GenJwtForVolumeServer (jwt.go:30): authorizes one fid write."""
     if not key:
         return ""
-    return encode_jwt({"exp": int(time.time()) + expires_sec, "fid": fid}, key)
+    return encode_jwt(
+        {"exp": int(time.time()) + expires_sec, "fid": normalize_fid(fid)},
+        key)
 
 
 def gen_read_jwt(key: bytes, fid: str, expires_sec: int = 10) -> str:
     if not key:
         return ""
-    return encode_jwt({"exp": int(time.time()) + expires_sec, "fid": fid}, key)
+    return encode_jwt(
+        {"exp": int(time.time()) + expires_sec, "fid": normalize_fid(fid)},
+        key)
 
 
 def verify_fid_jwt(token: str, key: bytes, fid: str) -> None:
+    """Token must cover exactly this fid.
+
+    The reference requires exact equality with the filename extension
+    already stripped from the request (volume_server_handlers.go:183,
+    ``sc.Fid == vid+","+fid``). Prefix matching (or an empty fid claim,
+    which would prefix-match everything) would let a token minted for one
+    needle authorize writes to any needle whose hex fid extends it. Both
+    sides are normalized (see normalize_fid) so tokens minted from
+    extension-bearing paths — e.g. by the replica fan-out, which signs the
+    raw request path — still verify.
+    """
     claims = decode_jwt(token, key)
-    claimed = claims.get("fid", "")
-    # cookie-less prefix match, like the reference's LoadAndValidateJwt
-    if claimed != fid and not fid.startswith(claimed):
-        raise JwtError(f"token fid {claimed!r} does not cover {fid!r}")
+    claimed = normalize_fid(claims.get("fid", ""))
+    base = normalize_fid(fid)
+    if not claimed or claimed != base:
+        raise JwtError(f"token fid {claimed!r} does not match {base!r}")
 
 
 @dataclass

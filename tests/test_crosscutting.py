@@ -43,6 +43,30 @@ def test_jwt_roundtrip_and_fid_scope():
         decode_jwt(expired, key)
 
 
+def test_jwt_fid_exact_match():
+    """Exact equality, extension stripped, empty claim rejected
+    (volume_server_handlers.go:183 requires sc.Fid == vid+","+fid)."""
+    key = b"secret-key"
+    tok = gen_write_jwt(key, "3,0163")
+    # a token for one needle must NOT cover a needle whose fid extends it
+    with pytest.raises(JwtError):
+        verify_fid_jwt(tok, key, "3,01637037d6")
+    # filename extension on the request path is stripped before comparing
+    verify_fid_jwt(gen_write_jwt(key, "3,01637037d6"), key,
+                   "3,01637037d6.jpg")
+    # a same-key token without a fid claim is NOT a universal token
+    no_fid = encode_jwt({"exp": int(time.time()) + 10}, key)
+    with pytest.raises(JwtError):
+        verify_fid_jwt(no_fid, key, "3,01637037d6")
+    # replica fan-out signs the raw request path (extension included):
+    # mint side normalizes too, so such tokens still verify
+    verify_fid_jwt(gen_write_jwt(key, "3,01637037d6.jpg"), key,
+                   "3,01637037d6")
+    # delta-suffixed fids are views of the same needle
+    verify_fid_jwt(gen_write_jwt(key, "3,01637037d6"), key,
+                   "3,01637037d6_1")
+
+
 def test_guard_whitelist():
     g = Guard(whitelist=["10.0.0.0/8", "192.168.1.5"])
     assert g.is_allowed("10.1.2.3")

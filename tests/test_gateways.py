@@ -102,17 +102,60 @@ def test_webdav_options_and_lock(dav):
 
 # -- IAM -------------------------------------------------------------------
 
+ADMIN_CREDS = ("IAMADMINKEY00000", "iam-admin-secret")
+
+
 @pytest.fixture(scope="module")
 def iam(cluster):
     _, _, fsrv = cluster
     srv = IamServer(port=_free_port(), filer=fsrv.address)
+    # bootstrap an admin identity: once any access key exists, the
+    # management API requires admin SigV4 (iamapi_server.go:72)
+    from seaweedfs_tpu.s3api.auth import Identity
+
+    srv.identities.append(Identity("iam-admin", ADMIN_CREDS[0],
+                                   ADMIN_CREDS[1], ["Admin"]))
+    srv._persist()
     srv.start()
     yield srv, f"http://localhost:{srv.port}"
     srv.stop()
 
 
-def _iam_call(url, **params):
-    return requests.post(url, data=params, timeout=30)
+def _iam_call(url, creds=ADMIN_CREDS, **params):
+    """POST a form-encoded IAM action, SigV4-signed unless creds is None."""
+    import urllib.parse
+
+    from tests.test_s3 import _sign_v4
+
+    body = urllib.parse.urlencode(params).encode()
+    headers = {}
+    if creds is not None:
+        headers = _sign_v4("POST", url + "/", creds[0], creds[1], body)
+    return requests.post(url, data=body, headers=headers, timeout=30)
+
+
+def test_iam_requires_admin_sigv4(iam):
+    srv, url = iam
+    # anonymous: rejected outright once identities exist
+    r = _iam_call(url, creds=None, Action="ListUsers")
+    assert r.status_code == 403
+    # wrong key: rejected
+    r = _iam_call(url, creds=("WRONG", "nope"), Action="ListUsers")
+    assert r.status_code == 403
+    # non-admin identity: authenticated but not authorized
+    r = _iam_call(url, Action="CreateUser", UserName="peon")
+    assert r.status_code == 200
+    r = _iam_call(url, Action="CreateAccessKey", UserName="peon")
+    import xml.etree.ElementTree as ET
+
+    root = ET.fromstring(r.content)
+    peon = (root.findtext(".//{*}AccessKeyId"),
+            root.findtext(".//{*}SecretAccessKey"))
+    r = _iam_call(url, creds=peon, Action="ListUsers")
+    assert r.status_code == 403
+    # admin works
+    assert _iam_call(url, Action="ListUsers").status_code == 200
+    _iam_call(url, Action="DeleteUser", UserName="peon")
 
 
 def test_iam_user_lifecycle(iam):
@@ -148,6 +191,7 @@ def test_iam_user_lifecycle(iam):
     # persisted to the filer: a fresh server sees the same state
     srv2 = IamServer(port=_free_port(), filer=srv.store.filer)
     assert srv2._find("alice").access_key == key_id
+    assert srv2._find("iam-admin").actions == ["Admin"]
     r = _iam_call(url, Action="DeleteUser", UserName="alice")
     assert r.status_code == 200
     assert srv._find("alice") is None

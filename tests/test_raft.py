@@ -149,6 +149,58 @@ def test_raft_compaction(tmp_path):
         n.stop()
 
 
+def test_raft_install_snapshot_catches_up_lagging_follower():
+    """A follower partitioned past the leader's compaction point must
+    receive the state-machine snapshot (InstallSnapshot, Raft §7) — with a
+    non-idempotent command stream, missing entries would otherwise silently
+    diverge the follower's state machine."""
+    transport = LocalTransport()
+    ids = [f"node{i}" for i in range(3)]
+    states = {i: [] for i in ids}  # append-log: NOT idempotent
+    nodes = []
+    for i in ids:
+        def apply(cmd, _s=states[i]):
+            _s.append(cmd["v"])
+
+        def snap(_s=states[i]):
+            return list(_s)
+
+        def restore(data, _s=states[i]):
+            _s[:] = data
+
+        node = RaftNode(i, list(ids), apply, transport=transport,
+                        snapshot_fn=snap, restore_fn=restore)
+        transport.register(node)
+        nodes.append(node)
+    for n in nodes:
+        n.start()
+    try:
+        leader = _wait_leader(nodes)
+        follower = next(n for n in nodes if n.role != LEADER)
+        transport.partitioned.add(follower.node_id)
+        for v in range(1, 7):
+            leader.propose({"v": v}, timeout=5)
+        # compact the leader's log past everything the follower has seen
+        leader.compact()
+        assert leader.snapshot_index >= 6 and len(leader.log) == 0
+        transport.partitioned.discard(follower.node_id)
+        deadline = time.time() + 5
+        while time.time() < deadline and \
+                states[follower.node_id] != [1, 2, 3, 4, 5, 6]:
+            time.sleep(0.05)
+        assert states[follower.node_id] == [1, 2, 3, 4, 5, 6]
+        assert follower.snapshot_index >= 6  # arrived via InstallSnapshot
+        # and the follower keeps participating: new entries still replicate
+        leader.propose({"v": 7}, timeout=5)
+        deadline = time.time() + 5
+        while time.time() < deadline and states[follower.node_id][-1] != 7:
+            time.sleep(0.05)
+        assert states[follower.node_id] == [1, 2, 3, 4, 5, 6, 7]
+    finally:
+        for n in nodes:
+            n.stop()
+
+
 # -- live 3-master cluster -------------------------------------------------
 
 @pytest.fixture()
