@@ -132,8 +132,14 @@ class IamServer:
                 [i for i in self.identities if i.access_key])
         if not iam.enabled:
             return None
-        payload_hash = headers.get("x-amz-content-sha256") or \
-            hashlib.sha256(body).hexdigest()
+        # The signature covers whatever hash the client signed, but that
+        # hash must actually match the body — otherwise a captured signed
+        # request could be replayed with a swapped action body.
+        computed = hashlib.sha256(body).hexdigest()
+        claimed = headers.get("x-amz-content-sha256")
+        if claimed and claimed not in ("UNSIGNED-PAYLOAD", computed):
+            return "XAmzContentSHA256Mismatch"
+        payload_hash = claimed or computed
         try:
             ident = iam.authenticate(method, path, query, headers,
                                      payload_hash)

@@ -78,6 +78,10 @@ class FilerSyncLoop:
             since_ns=cursor)
         stream = stub.SubscribeMetadata(req, timeout=drain_timeout)
         self._stream = stream  # stop() cancels it mid-wait
+        if self._stop.is_set():
+            # stop() may have checked _stream before we assigned it —
+            # without this re-check an infinite stream would never die
+            stream.cancel()
         continuous = drain_timeout is None
         try:
             for resp in stream:

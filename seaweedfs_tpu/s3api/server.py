@@ -180,20 +180,37 @@ def _make_handler(srv: S3Server):
             key = parts[1] if len(parts) > 1 else ""
             return bucket, key, q, u
 
+        def _raw_body(self) -> bytes:
+            if not hasattr(self, "_raw_body_cache"):
+                length = int(self.headers.get("Content-Length") or 0)
+                self._raw_body_cache = self.rfile.read(length) if length \
+                    else b""
+            return self._raw_body_cache
+
         def _body(self) -> bytes:
-            length = int(self.headers.get("Content-Length") or 0)
-            body = self.rfile.read(length) if length else b""
+            body = self._raw_body()
             if self.headers.get("x-amz-content-sha256") == \
                     "STREAMING-AWS4-HMAC-SHA256-PAYLOAD":
                 body = _decode_chunked_signing(body)
             return body
 
         def _auth(self, u) -> None:
-            payload_hash = self.headers.get("x-amz-content-sha256",
-                                            "UNSIGNED-PAYLOAD")
+            claimed = self.headers.get("x-amz-content-sha256",
+                                       "UNSIGNED-PAYLOAD")
+            if srv.iam.enabled and claimed not in (
+                    "UNSIGNED-PAYLOAD",
+                    "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"):
+                # the signature covers the client's claimed hash; the claim
+                # must match the actual body or a captured signed request
+                # could be replayed with a swapped body
+                import hashlib
+
+                if hashlib.sha256(self._raw_body()).hexdigest() != claimed:
+                    raise S3Error(400, "XAmzContentSHA256Mismatch",
+                                  "payload hash does not match body")
             try:
                 srv.iam.authenticate(self.command, u.path, u.query,
-                                     self.headers, payload_hash)
+                                     self.headers, claimed)
             except AuthError as e:
                 raise S3Error(403, e.code, str(e))
 

@@ -57,10 +57,11 @@ def decode_jwt(token: str, key: bytes) -> dict:
 
 def normalize_fid(fid: str) -> str:
     """Canonical token scope for a request fid: strip the filename
-    extension ("3,01ab.jpg") and the delta suffix ("3,01ab_1") — both are
-    views of the same needle, and neither can appear inside the hex fid
-    itself, so stripping is unambiguous."""
-    return fid.split(".", 1)[0].split("_", 1)[0]
+    extension ("3,01ab.jpg" -> "3,01ab"). The delta suffix ("3,01ab_1")
+    is NOT stripped — the delta offsets the needle KEY
+    (storage/file_id.py parse_needle_id_cookie), i.e. names a different
+    needle, so a token must be minted for the exact delta it covers."""
+    return fid.split(".", 1)[0]
 
 
 def gen_write_jwt(key: bytes, fid: str, expires_sec: int = 10) -> str:

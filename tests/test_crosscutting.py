@@ -62,8 +62,12 @@ def test_jwt_fid_exact_match():
     # mint side normalizes too, so such tokens still verify
     verify_fid_jwt(gen_write_jwt(key, "3,01637037d6.jpg"), key,
                    "3,01637037d6")
-    # delta-suffixed fids are views of the same needle
-    verify_fid_jwt(gen_write_jwt(key, "3,01637037d6"), key,
+    # a delta suffix offsets the needle KEY — a different needle, so a
+    # token for the base fid must NOT cover it (and vice versa)
+    with pytest.raises(JwtError):
+        verify_fid_jwt(gen_write_jwt(key, "3,01637037d6"), key,
+                       "3,01637037d6_1")
+    verify_fid_jwt(gen_write_jwt(key, "3,01637037d6_1.jpg"), key,
                    "3,01637037d6_1")
 
 
