@@ -56,6 +56,7 @@ MASTER_SERVICE = ("master_pb.Seaweed", [
     _m("GetMasterConfiguration", M.GetMasterConfigurationRequest, M.GetMasterConfigurationResponse),
     _m("LeaseAdminToken", M.LeaseAdminTokenRequest, M.LeaseAdminTokenResponse),
     _m("ReleaseAdminToken", M.ReleaseAdminTokenRequest, M.ReleaseAdminTokenResponse),
+    _m("ListClusterNodes", M.ListClusterNodesRequest, M.ListClusterNodesResponse),
     _m("Ping", M.PingRequest, M.PingResponse),
 ])
 

@@ -40,7 +40,7 @@ class FilerServer:
                  master: str = "localhost:9333", store_dir: str = "",
                  store: str = "sqlite", collection: str = "",
                  replication: str = "", chunk_size: int = CHUNK_SIZE,
-                 peers: list[str] | None = None):
+                 peers: list[str] | None = None, filer_group: str = ""):
         self.ip = ip
         self.port = port
         self.grpc_port = rpc.derived_grpc_port(port)
@@ -68,18 +68,66 @@ class FilerServer:
         # multi-filer peer aggregation (meta_aggregator.go)
         self.meta_aggregator = None
         self._peers = [p for p in (peers or []) if p]
+        # cluster membership: announce to the master's KeepConnected stream
+        # under this group; peers in the same group are discovered from the
+        # master's ClusterNodeUpdate pushes (weed/cluster/cluster.go)
+        self.filer_group = filer_group
+        self._announce_stop = threading.Event()
+        self._announce_thread: threading.Thread | None = None
+        self._subscribed_peers: set[str] = set()
 
     def _start_aggregator(self) -> None:
-        if not self._peers:
+        if not self._peers and not self.filer_group:
             return
         from ..filer.meta_aggregator import MetaAggregator
 
         self.meta_aggregator = MetaAggregator(self.filer,
                                               self.filer.signature)
         for peer in self._peers:
-            if peer == self.address:
-                continue
-            self.meta_aggregator.subscribe_to_peer(rpc.grpc_address(peer))
+            self._subscribe_peer(peer)
+
+    def _subscribe_peer(self, peer: str) -> None:
+        if peer == self.address or peer in self._subscribed_peers:
+            return
+        self._subscribed_peers.add(peer)
+        self.meta_aggregator.subscribe_to_peer(rpc.grpc_address(peer))
+
+    def _on_keepalive_update(self, resp) -> None:
+        u = resp.cluster_node_update
+        if (u.address and u.node_type == "filer"
+                and u.filer_group == self.filer_group
+                and u.is_add and self.meta_aggregator is not None):
+            self._subscribe_peer(u.address)
+
+    def _discover_existing_peers(self) -> None:
+        """Subscribe to group peers that joined BEFORE us — their add
+        events were broadcast before our stream existed (the reference
+        filer lists existing peers at startup, filer.go ListExistingPeerUpdates)."""
+        try:
+            stub = rpc.master_stub(rpc.grpc_address(self.master_client.current_master))
+            resp = stub.ListClusterNodes(
+                master_pb2.ListClusterNodesRequest(
+                    client_type="filer", filer_group=self.filer_group),
+                timeout=10)
+            for n in resp.cluster_nodes:
+                self._subscribe_peer(n.address)
+        except Exception as e:  # master not up yet: updates will cover it
+            glog.v(1, f"filer peer discovery: {e}")
+
+    def _start_announce(self) -> None:
+        """KeepConnected to the master as a filer (filer.go keeps the same
+        stream open so the master tracks filer membership)."""
+        def run():
+            if self.meta_aggregator is not None:
+                self._discover_existing_peers()
+            self.master_client.keep_connected(
+                client_type="filer", client_address=self.address,
+                filer_group=self.filer_group,
+                on_update=self._on_keepalive_update,
+                stop_event=self._announce_stop)
+
+        self._announce_thread = threading.Thread(target=run, daemon=True)
+        self._announce_thread.start()
 
     @property
     def address(self) -> str:
@@ -95,9 +143,11 @@ class FilerServer:
         threading.Thread(target=self._http_server.serve_forever,
                          daemon=True).start()
         self._start_aggregator()
+        self._start_announce()
         glog.info(f"filer started on {self.address} (grpc :{self.grpc_port})")
 
     def stop(self) -> None:
+        self._announce_stop.set()
         if self.meta_aggregator is not None:
             self.meta_aggregator.close()
         if self._http_server:

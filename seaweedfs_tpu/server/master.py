@@ -27,6 +27,7 @@ from urllib.parse import parse_qs, urlparse
 
 import grpc
 
+from ..cluster import Cluster
 from ..pb import master_pb2, rpc
 from ..sequence import new_sequencer
 from ..storage.file_id import parse_file_id
@@ -76,6 +77,8 @@ class MasterServer:
         self._admin_lock_mu = threading.Lock()
         self._keepalive_clients: dict[str, queue.Queue] = {}
         self._keepalive_mu = threading.Lock()
+        # filer/broker group membership + leader hinting (weed/cluster)
+        self.cluster = Cluster()
         self._grpc_server = None
         self._http_server = None
         self._vacuum_thread = None
@@ -245,6 +248,19 @@ class MasterServer:
             self._broadcast_location(dn, new_vids, gone_vids)
         return dn
 
+    def _broadcast_cluster_updates(self, updates) -> None:
+        """Push cluster.NodeUpdate events to every KeepConnected client
+        (master_grpc_server.go broadcastToClients)."""
+        for u in updates:
+            msg = master_pb2.KeepConnectedResponse(
+                cluster_node_update=master_pb2.ClusterNodeUpdate(
+                    node_type=u.node_type, address=u.address,
+                    filer_group=u.filer_group, is_leader=u.is_leader,
+                    is_add=u.is_add))
+            with self._keepalive_mu:
+                for q in self._keepalive_clients.values():
+                    q.put(msg)
+
     def _broadcast_location(self, dn, new_vids, deleted_vids) -> None:
         msg = master_pb2.KeepConnectedResponse(
             volume_location=master_pb2.VolumeLocation(
@@ -333,6 +349,22 @@ class MasterGrpc:
         q: queue.Queue = queue.Queue()
         with ms._keepalive_mu:
             ms._keepalive_clients[key] = q
+        # filers/brokers joining the stream join their cluster group
+        # (master_grpc_server.go KeepConnected -> AddClusterNode)
+        ms._broadcast_cluster_updates(ms.cluster.add_cluster_node(
+            first.filer_group, first.client_type, first.client_address,
+            version=first.version))
+        # seed the newcomer with the CURRENT group membership — members
+        # that joined earlier were broadcast before this stream existed
+        for node_type in ("filer", "broker"):
+            for n in ms.cluster.list_cluster_nodes(first.filer_group,
+                                                   node_type):
+                q.put(master_pb2.KeepConnectedResponse(
+                    cluster_node_update=master_pb2.ClusterNodeUpdate(
+                        node_type=node_type, address=n.address,
+                        filer_group=first.filer_group, is_add=True,
+                        is_leader=ms.cluster.is_one_leader(
+                            first.filer_group, node_type, n.address))))
         try:
             # initial full picture: every node with its volumes
             for dn in ms.topo.alive_nodes():
@@ -353,6 +385,21 @@ class MasterGrpc:
         finally:
             with ms._keepalive_mu:
                 ms._keepalive_clients.pop(key, None)
+            ms._broadcast_cluster_updates(ms.cluster.remove_cluster_node(
+                first.filer_group, first.client_type, first.client_address))
+
+    def ListClusterNodes(self, request, context):
+        ms = self.ms
+        resp = master_pb2.ListClusterNodesResponse()
+        for n in ms.cluster.list_cluster_nodes(request.filer_group,
+                                               request.client_type):
+            resp.cluster_nodes.add(
+                address=n.address, version=n.version,
+                is_leader=ms.cluster.is_one_leader(
+                    request.filer_group, request.client_type, n.address),
+                created_at_ns=int(n.created_ts * 1e9),
+                data_center=n.data_center, rack=n.rack)
+        return resp
 
     def _leader_stub(self):
         """Stub to the Raft leader, or None when we are it. Followers hold

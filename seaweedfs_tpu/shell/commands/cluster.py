@@ -8,11 +8,26 @@ from ...pb import master_pb2, volume_server_pb2 as vs
 from ..registry import command
 
 
-@command("cluster.ps", "list cluster processes (master + volume servers)")
+@command("cluster.ps", "list cluster processes (masters, volume servers, filers, brokers)")
 def cluster_ps(env, args, out):
+    """command_cluster_ps.go: volume servers from topology, filers/brokers
+    from the master's cluster membership (weed/cluster)."""
+    filer_group = args[0] if args else ""
     print(f"master: {env.master}", file=out)
     for dn in env.collect_data_nodes():
         print(f"  volume server: {dn.id} (grpc :{dn.grpc_port})", file=out)
+    for node_type in ("filer", "broker"):
+        try:
+            resp = env.master_stub().ListClusterNodes(
+                master_pb2.ListClusterNodesRequest(
+                    client_type=node_type, filer_group=filer_group),
+                timeout=10)
+        except Exception:  # older master without the RPC
+            continue
+        for n in resp.cluster_nodes:
+            star = " *leader*" if n.is_leader else ""
+            group = f" group={filer_group}" if filer_group else ""
+            print(f"  {node_type}: {n.address}{group}{star}", file=out)
 
 
 @command("cluster.check", "ping every node and report health")

@@ -123,22 +123,43 @@ class MasterClient:
     # -- keep-connected stream (masterclient.go KeepConnected) -------------
 
     def keep_connected(self, client_type: str = "client",
-                       on_update=None, stop_event: threading.Event | None = None):
+                       on_update=None, stop_event: threading.Event | None = None,
+                       client_address: str = "self", filer_group: str = ""):
         """Blocking stream consumer: applies VolumeLocation updates to the
-        cache; reconnects on error until stop_event is set."""
+        cache; reconnects on error until stop_event is set. Filers/brokers
+        pass their address + filer_group so the master registers them in
+        its cluster membership (weed/cluster)."""
         stop = stop_event or threading.Event()
+        current_call = [None]  # the live stream, cancelled when stop fires
+
+        def canceller():
+            stop.wait()
+            call = current_call[0]
+            if call is not None:
+                try:
+                    call.cancel()
+                except Exception:
+                    pass
+
+        threading.Thread(target=canceller, daemon=True).start()
         while not stop.is_set():
             try:
                 stub = self._stub()
 
                 def reqs():
                     yield master_pb2.KeepConnectedRequest(
-                        client_type=client_type, client_address="self")
+                        client_type=client_type,
+                        client_address=client_address,
+                        filer_group=filer_group)
                     while not stop.is_set():
                         time.sleep(1)
                     return
 
-                for resp in stub.KeepConnected(reqs()):
+                call = stub.KeepConnected(reqs())
+                current_call[0] = call
+                if stop.is_set():
+                    call.cancel()
+                for resp in call:
                     vl = resp.volume_location
                     if vl.url:
                         if vl.leader:

@@ -1,0 +1,178 @@
+"""weed/cluster rebuild: membership registry + filer-group wiring.
+
+Covers the semantics of /root/reference/weed/cluster/cluster.go (refcounted
+membership, 3-leader slots, freshest-member promotion) and the live wiring:
+filers announce over KeepConnected, the master tracks them per group,
+ListClusterNodes serves them, and a departing leader is replaced.
+"""
+
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.cluster import (
+    BROKER_TYPE,
+    FILER_TYPE,
+    MASTER_TYPE,
+    MAX_LEADERS,
+    Cluster,
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+# -- unit --------------------------------------------------------------------
+
+def test_membership_refcount_and_leaders():
+    c = Cluster()
+    ups = c.add_cluster_node("g", FILER_TYPE, "f1:8888")
+    assert len(ups) == 1 and ups[0].is_leader and ups[0].is_add
+    # second connection from the same address: refcounted, no event
+    assert c.add_cluster_node("g", FILER_TYPE, "f1:8888") == []
+    # first remove only decrements
+    assert c.remove_cluster_node("g", FILER_TYPE, "f1:8888") == []
+    assert [n.address for n in c.list_cluster_nodes("g", FILER_TYPE)] == \
+        ["f1:8888"]
+    ups = c.remove_cluster_node("g", FILER_TYPE, "f1:8888")
+    assert len(ups) == 1 and not ups[0].is_add
+    assert c.list_cluster_nodes("g", FILER_TYPE) == []
+
+
+def test_leader_cap_and_promotion():
+    c = Cluster()
+    for i in range(5):
+        c.add_cluster_node("g", FILER_TYPE, f"f{i}")
+        time.sleep(0.01)  # distinct created_ts ordering
+    leaders = c.list_leaders("g", FILER_TYPE)
+    assert leaders == ["f0", "f1", "f2"] and len(leaders) == MAX_LEADERS
+    assert c.is_one_leader("g", FILER_TYPE, "f0")
+    assert not c.is_one_leader("g", FILER_TYPE, "f4")
+    # a leader leaves: the FRESHEST non-leader (f4) is promoted
+    ups = c.remove_cluster_node("g", FILER_TYPE, "f1")
+    assert {(u.address, u.is_add, u.is_leader) for u in ups} == {
+        ("f1", False, True), ("f4", True, True)}
+    assert sorted(c.list_leaders("g", FILER_TYPE)) == ["f0", "f2", "f4"]
+    # a non-leader leaves: single non-leader removal event
+    ups = c.remove_cluster_node("g", FILER_TYPE, "f3")
+    assert len(ups) == 1 and not ups[0].is_leader
+
+
+def test_groups_and_types_are_isolated():
+    c = Cluster()
+    c.add_cluster_node("g1", FILER_TYPE, "f1")
+    c.add_cluster_node("g2", FILER_TYPE, "f2")
+    c.add_cluster_node("g1", BROKER_TYPE, "b1")
+    assert [n.address for n in c.list_cluster_nodes("g1", FILER_TYPE)] == ["f1"]
+    assert [n.address for n in c.list_cluster_nodes("g2", FILER_TYPE)] == ["f2"]
+    assert [n.address for n in c.list_cluster_nodes("g1", BROKER_TYPE)] == ["b1"]
+    assert c.list_leaders("g2", FILER_TYPE) == ["f2"]
+
+
+def test_master_type_echoes_only():
+    c = Cluster()
+    ups = c.add_cluster_node("", MASTER_TYPE, "m1")
+    assert len(ups) == 1 and ups[0].is_add
+    assert c.list_cluster_nodes("", MASTER_TYPE) == []  # raft owns masters
+    ups = c.remove_cluster_node("", MASTER_TYPE, "m1")
+    assert len(ups) == 1 and not ups[0].is_add
+
+
+# -- live wiring -------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def filer_ha_cluster(tmp_path_factory):
+    from seaweedfs_tpu.pb import rpc
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    filers = []
+    for i in range(2):
+        f = FilerServer(ip="localhost", port=_free_port(),
+                        master=f"localhost:{mport}",
+                        store_dir=str(tmp_path_factory.mktemp(f"filer{i}")),
+                        filer_group="g1")
+        f.start()
+        filers.append(f)
+    yield master, filers
+    for f in filers:
+        f.stop()
+    master.stop()
+    rpc.reset_channels()
+
+
+def _list_filers(master, group="g1"):
+    from seaweedfs_tpu.pb import master_pb2, rpc
+
+    stub = rpc.master_stub(rpc.grpc_address(master.address))
+    return stub.ListClusterNodes(
+        master_pb2.ListClusterNodesRequest(client_type="filer",
+                                           filer_group=group),
+        timeout=10).cluster_nodes
+
+
+def test_filers_register_in_group(filer_ha_cluster):
+    master, filers = filer_ha_cluster
+    deadline = time.time() + 10
+    nodes = []
+    while time.time() < deadline:
+        nodes = _list_filers(master)
+        if len(nodes) == 2:
+            break
+        time.sleep(0.1)
+    assert {n.address for n in nodes} == {f.address for f in filers}
+    # both fit in the leader slots
+    assert all(n.is_leader for n in nodes)
+    # peer discovery: each filer subscribed to the other via the
+    # ClusterNodeUpdate push, no static peer list
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if all(len(f._subscribed_peers) == 1 for f in filers):
+            break
+        time.sleep(0.1)
+    assert {p for f in filers for p in f._subscribed_peers} == \
+        {f.address for f in filers}
+
+
+def test_filer_departure_updates_membership(filer_ha_cluster):
+    master, filers = filer_ha_cluster
+    # wait for both to register (test above may have run already)
+    deadline = time.time() + 10
+    while time.time() < deadline and len(_list_filers(master)) < 2:
+        time.sleep(0.1)
+    gone = filers.pop()
+    gone.stop()
+    deadline = time.time() + 15
+    nodes = []
+    while time.time() < deadline:
+        nodes = _list_filers(master)
+        if len(nodes) == 1:
+            break
+        time.sleep(0.2)
+    assert [n.address for n in nodes] == [filers[0].address]
+    assert nodes[0].is_leader
+
+
+def test_shell_cluster_ps_lists_filers(filer_ha_cluster):
+    import io
+
+    from seaweedfs_tpu.shell.env import CommandEnv
+    from seaweedfs_tpu.shell.registry import run_command
+
+    master, filers = filer_ha_cluster
+    deadline = time.time() + 10
+    while time.time() < deadline and len(_list_filers(master)) < 1:
+        time.sleep(0.1)
+    env = CommandEnv(master.address)
+    out = io.StringIO()
+    assert run_command(env, "cluster.ps g1", out) == 0
+    text = out.getvalue()
+    assert filers[0].address in text and "filer" in text
