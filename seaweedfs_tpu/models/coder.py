@@ -35,9 +35,22 @@ class ErasureCoder(Protocol):
 
 
 def new_coder(
-    data_shards: int = 10, parity_shards: int = 4, backend: str = "tpu"
+    data_shards: int = 10, parity_shards: int = 4, backend: str | None = None
 ) -> ErasureCoder:
-    """reedsolomon.New(data, parity) equivalent with a backend switch."""
+    """reedsolomon.New(data, parity) equivalent with a backend switch.
+
+    Default backend is "tpu"; override per-process with SEAWEEDFS_TPU_CODER
+    (e.g. "native" to force the C++ host path where no accelerator helps,
+    as in CPU-only CI).
+    """
+    import os
+
+    if backend is None:
+        backend = os.environ.get("SEAWEEDFS_TPU_CODER", "tpu")
+    if backend == "native":
+        from ..ops.rs_native import RSCodecNative
+
+        return RSCodecNative(data_shards, parity_shards)
     if backend in ("tpu", "jax"):
         from ..ops.rs_jax import RSCodecJax
 

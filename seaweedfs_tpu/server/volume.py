@@ -650,7 +650,7 @@ class VolumeGrpc:
             coder = new_coder(geo.data_shards, geo.parity_shards)
         base = v.file_name()
         t0 = time.perf_counter()
-        write_ec_files(base, coder, geo)
+        enc_stats = write_ec_files(base, coder, geo)
         write_sorted_file_from_idx(base)
         from ..storage.ec_volume import save_volume_info
 
@@ -661,7 +661,10 @@ class VolumeGrpc:
         })
         VOLUME_SERVER_EC_ENCODE_BYTES.inc(v.data_size())
         glog.v(0, f"ec encode vol {v.id}: {v.data_size()} bytes in "
-                  f"{time.perf_counter() - t0:.2f}s")
+                  f"{time.perf_counter() - t0:.2f}s "
+                  f"(read {enc_stats.read_s:.2f}s, device-wait "
+                  f"{enc_stats.device_wait_s:.2f}s, write {enc_stats.write_s:.2f}s, "
+                  f"overlap x{enc_stats.overlap_ratio:.2f})")
         return vs.VolumeEcShardsGenerateResponse()
 
     def VolumeEcShardsRebuild(self, request, context):

@@ -19,7 +19,10 @@ bit-identical to the reference's 256KB batching.
 from __future__ import annotations
 
 import os
-from dataclasses import dataclass
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
 
 import numpy as np
 
@@ -32,6 +35,38 @@ from .ec_locate import Geometry
 DEFAULT_BATCH_SIZE = 4 * 1024 * 1024
 # The reference's own buffer size, used when exact loop replication is wanted.
 REFERENCE_BATCH_SIZE = 256 * 1024
+# In-flight slabs between the reader/dispatcher thread and the shard writer.
+# Depth N means up to N encode launches queued on the device while the writer
+# drains earlier parities — the reference is depth-0 (strictly serial,
+# ec_encoder.go:162-192).
+DEFAULT_PIPELINE_DEPTH = 3
+
+
+@dataclass
+class EncodeStats:
+    """Timing breakdown of one pipelined encode, for the overlap-measured
+    artifacts BASELINE.md configs #2/#4 ask for."""
+
+    bytes: int = 0
+    batches: int = 0
+    wall_s: float = 0.0
+    read_s: float = 0.0  # reader thread: file reads + zero fill
+    dispatch_s: float = 0.0  # reader thread: encode launch (sync coders: the
+    #                          whole encode; async JAX dispatch: ~0)
+    device_wait_s: float = 0.0  # writer thread: blocked on parity futures
+    write_s: float = 0.0  # writer thread: shard file writes
+    started: float = field(default_factory=time.perf_counter)
+    ended: float = 0.0
+
+    @property
+    def overlap_ratio(self) -> float:
+        """(read + encode + device-wait + write) / wall — >1 proves phases
+        ran concurrently (the reference's serial loop is exactly 1.0)."""
+        if self.wall_s <= 0:
+            return 0.0
+        return (
+            self.read_s + self.dispatch_s + self.device_wait_s + self.write_s
+        ) / self.wall_s
 
 
 def _pick_batch(block_size: int, requested: int) -> int:
@@ -60,52 +95,111 @@ def generate_ec_files(
     coder,
     geo: Geometry = Geometry(),
     batch_size: int = DEFAULT_BATCH_SIZE,
-) -> None:
+    pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
+) -> EncodeStats:
     """<base>.dat -> <base>.ec00..ecNN (WriteEcFiles / generateEcFiles /
     encodeDatFile, ec_encoder.go:56-87,194-231).
 
     `coder` must expose encode_parity(data[k, B] uint8) -> parity[m, B]
     (models.coder.ErasureCoder).
+
+    Three-stage pipeline, `pipeline_depth` slabs deep:
+
+      reader thread:  read slab -> launch encode (async JAX dispatch) ┐
+                                                              bounded queue
+      writer thread:  write k data shards -> block on parity -> write m ┘
+
+    A recycled buffer pool caps host memory at ~(depth+2) slabs. Multiple
+    volumes encoding concurrently (BASELINE config #4) each run their own
+    reader/writer pair; their encode launches interleave on the shared
+    device queue, so host I/O of one volume overlaps device math of another.
     """
     k, m = geo.data_shards, geo.parity_shards
     dat_path = base_file_name + ".dat"
     dat_size = os.path.getsize(dat_path)
+    stats = EncodeStats()
+    depth = max(1, pipeline_depth)
 
     outs = [open(geo.shard_file_name(base_file_name, i), "wb") for i in range(k + m)]
-    pending: tuple[np.ndarray, object, int] | None = None  # (data, parity_future, nbytes)
+    free_q: queue.Queue = queue.Queue()
+    max_batch = min(batch_size, max(geo.large_block, geo.small_block))
+    for _ in range(depth + 2):
+        free_q.put(np.empty((k, max_batch), dtype=np.uint8))
+    work_q: queue.Queue = queue.Queue(maxsize=depth)
+    stop = threading.Event()
 
-    def flush(p) -> None:
-        data, parity_fut, nbytes = p
-        for i in range(k):
-            outs[i].write(memoryview(data[i])[:nbytes])
-        parity = np.asarray(parity_fut)  # blocks until device done
-        for j in range(m):
-            outs[k + j].write(memoryview(parity[j])[:nbytes])
+    def reader() -> None:
+        try:
+            with open(dat_path, "rb") as f:
+                processed = 0
+                for block_size in _row_schedule(geo, dat_size):
+                    batch = _pick_batch(block_size, batch_size)
+                    for b in range(0, block_size, batch):
+                        if stop.is_set():
+                            return
+                        buf = free_q.get()
+                        if stop.is_set() or buf.shape[1] < batch:
+                            return
+                        data = buf[:, :batch]
+                        t0 = time.perf_counter()
+                        # zero so rows fully past EOF stay zero; short reads
+                        # are zero-padded by _read_padded
+                        data[:] = 0
+                        for i in range(k):
+                            start = processed + block_size * i + b
+                            if start < dat_size:
+                                _read_padded(
+                                    f, start, min(batch, dat_size - start), data[i]
+                                )
+                        t1 = time.perf_counter()
+                        stats.read_s += t1 - t0
+                        parity_fut = coder.encode_parity(data)
+                        stats.dispatch_s += time.perf_counter() - t1
+                        work_q.put((buf, data, parity_fut, batch))
+                    processed += block_size * k
+            work_q.put(None)
+        except BaseException as e:  # surface in the writer/caller
+            work_q.put(e)
 
+    t = threading.Thread(target=reader, name="ec-encode-reader", daemon=True)
+    t.start()
     try:
-        with open(dat_path, "rb") as f:
-            processed = 0
-            for block_size in _row_schedule(geo, dat_size):
-                batch = _pick_batch(block_size, batch_size)
-                for b in range(0, block_size, batch):
-                    # fresh zeros each batch: rows fully past EOF stay zero,
-                    # short reads are zero-padded by _read_padded
-                    data = np.zeros((k, batch), dtype=np.uint8)
-                    for i in range(k):
-                        start = processed + block_size * i + b
-                        if start < dat_size:
-                            _read_padded(f, start, min(batch, dat_size - start), data[i])
-                    parity_fut = coder.encode_parity(data)
-                    if pending is not None:
-                        flush(pending)
-                    pending = (data, parity_fut, batch)
-                processed += block_size * k
-            if pending is not None:
-                flush(pending)
-                pending = None
+        while True:
+            item = work_q.get()
+            if item is None:
+                break
+            if isinstance(item, BaseException):
+                raise item
+            buf, data, parity_fut, nbytes = item
+            t0 = time.perf_counter()
+            for i in range(k):
+                outs[i].write(memoryview(data[i])[:nbytes])
+            t1 = time.perf_counter()
+            parity = np.asarray(parity_fut)  # blocks until device done
+            t2 = time.perf_counter()
+            for j in range(m):
+                outs[k + j].write(memoryview(parity[j])[:nbytes])
+            t3 = time.perf_counter()
+            free_q.put(buf)
+            stats.write_s += (t1 - t0) + (t3 - t2)
+            stats.device_wait_s += t2 - t1
+            stats.batches += 1
+            stats.bytes += k * nbytes
     finally:
+        stop.set()
+        # unblock a reader stuck on free_q.get(), then drain
+        free_q.put(np.empty((k, 0), dtype=np.uint8))
+        while t.is_alive():
+            try:
+                work_q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=0.05)
         for f2 in outs:
             f2.close()
+    stats.ended = time.perf_counter()
+    stats.wall_s = stats.ended - stats.started
+    return stats
 
 
 def _row_schedule(geo: Geometry, dat_size: int):
@@ -118,9 +212,11 @@ def _row_schedule(geo: Geometry, dat_size: int):
         yield geo.small_block
 
 
-def write_ec_files(base_file_name: str, coder, geo: Geometry = Geometry()) -> None:
+def write_ec_files(
+    base_file_name: str, coder, geo: Geometry = Geometry()
+) -> EncodeStats:
     """WriteEcFiles equivalent (ec_encoder.go:56-59)."""
-    generate_ec_files(base_file_name, coder, geo)
+    return generate_ec_files(base_file_name, coder, geo)
 
 
 def write_sorted_file_from_idx(base_file_name: str, ext: str = ".ecx") -> None:
@@ -149,38 +245,55 @@ def rebuild_ec_files(
 
     ins = {i: open(geo.shard_file_name(base_file_name, i), "rb") for i in present}
     outs = {i: open(geo.shard_file_name(base_file_name, i), "wb") for i in missing}
-    pending = None  # (rebuilt dict of device futures) — same double
-    #               buffering as the encoder: disk reads overlap device math
+    # Same pipeline shape as the encoder: a reader thread dispatches
+    # reconstructs asynchronously; the writer drains an N-deep queue, so
+    # shard reads overlap device math overlap shard writes.
+    work_q: queue.Queue = queue.Queue(maxsize=DEFAULT_PIPELINE_DEPTH)
+    stop = threading.Event()
 
-    def flush(rebuilt) -> None:
-        for i in missing:
-            outs[i].write(np.asarray(rebuilt[i], dtype=np.uint8).tobytes())
+    def reader() -> None:
+        try:
+            offset = 0
+            while not stop.is_set():
+                bufs: dict[int, np.ndarray] = {}
+                n = None
+                for i in present:
+                    ins[i].seek(offset)
+                    chunk = ins[i].read(batch_size)
+                    if n is None:
+                        n = len(chunk)
+                    elif len(chunk) != n:
+                        raise IOError(
+                            f"ec shard size mismatch: expected {n} got {len(chunk)}"
+                        )
+                    bufs[i] = np.frombuffer(chunk, dtype=np.uint8)
+                if not n:
+                    break
+                work_q.put(coder.reconstruct(bufs))  # async device dispatch
+                offset += n
+            work_q.put(None)
+        except BaseException as e:
+            work_q.put(e)
 
+    t = threading.Thread(target=reader, name="ec-rebuild-reader", daemon=True)
+    t.start()
     try:
-        offset = 0
         while True:
-            bufs: dict[int, np.ndarray] = {}
-            n = None
-            for i in present:
-                ins[i].seek(offset)
-                chunk = ins[i].read(batch_size)
-                if n is None:
-                    n = len(chunk)
-                elif len(chunk) != n:
-                    raise IOError(
-                        f"ec shard size mismatch: expected {n} got {len(chunk)}"
-                    )
-                bufs[i] = np.frombuffer(chunk, dtype=np.uint8)
-            if not n:
+            rebuilt = work_q.get()
+            if rebuilt is None:
                 break
-            rebuilt = coder.reconstruct(bufs)  # async device dispatch
-            if pending is not None:
-                flush(pending)
-            pending = rebuilt
-            offset += n
-        if pending is not None:
-            flush(pending)
+            if isinstance(rebuilt, BaseException):
+                raise rebuilt
+            for i in missing:
+                outs[i].write(np.asarray(rebuilt[i], dtype=np.uint8).tobytes())
     finally:
+        stop.set()
+        while t.is_alive():
+            try:
+                work_q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join(timeout=0.05)
         for f in ins.values():
             f.close()
         for f in outs.values():
