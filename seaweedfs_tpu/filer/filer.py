@@ -16,6 +16,7 @@ from collections import deque
 from ..pb import filer_pb2
 from .entry import Attr, Entry, new_directory_entry
 from .filerstore import FilerStore
+from .meta_log import SYSTEM_LOG_DIR, MetaLog
 
 
 class FilerError(Exception):
@@ -31,18 +32,27 @@ class NotEmpty(FilerError):
 
 
 class Filer:
-    def __init__(self, store: FilerStore, *, log_capacity: int = 16384):
+    def __init__(self, store: FilerStore, *, log_capacity: int = 16384,
+                 persist_meta_log: bool = True):
         self.store = store
         self._log: deque[filer_pb2.SubscribeMetadataResponse] = deque(
             maxlen=log_capacity)
         self._log_cond = threading.Condition()
         self.signature = int(time.time_ns()) & 0x7FFFFFFF
+        # filer_notify.go:70 logMetaEvent — events also flush to dated
+        # segment entries under /topics/.system/log so subscribers can
+        # resume point-in-time across restarts (and a lagging subscriber
+        # falls back to the persisted log instead of losing drops from
+        # the bounded deque).
+        self.meta_log = MetaLog(store) if persist_meta_log else None
 
     # -- events (filer_notify.go:20 NotifyUpdateEvent) ---------------------
 
     def _notify(self, directory: str, old: Entry | None, new: Entry | None,
                 delete_chunks: bool = False,
                 from_other_cluster: bool = False) -> None:
+        if directory.startswith(SYSTEM_LOG_DIR):
+            return  # the log must not log itself (filer_notify.go SystemLogDir)
         ev = filer_pb2.EventNotification(
             delete_chunks=delete_chunks,
             is_from_other_cluster=from_other_cluster,
@@ -59,9 +69,29 @@ class Filer:
         with self._log_cond:
             self._log.append(msg)
             self._log_cond.notify_all()
+        if self.meta_log is not None:
+            self.meta_log.append(msg)
 
     def read_events(self, since_ns: int, timeout: float = 1.0):
-        """-> (events newer than since_ns, new cursor)."""
+        """-> (events newer than since_ns, new cursor).
+
+        Served from the in-memory tail when the cursor is inside its window;
+        a cursor older than the window (subscriber lagged past the deque, or
+        the filer restarted) replays the persisted log first
+        (ReadPersistedLogBuffer, filer_notify.go:116)."""
+        with self._log_cond:
+            oldest = self._log[0].ts_ns if self._log else None
+        if self.meta_log is not None and (oldest is None or since_ns < oldest):
+            persisted = list(self.meta_log.read_since(since_ns))
+            if persisted:
+                with self._log_cond:
+                    mem = {m.ts_ns for m in self._log}
+                out = [m for m in persisted if m.ts_ns not in mem]
+                with self._log_cond:
+                    out += [m for m in self._log if m.ts_ns > since_ns]
+                out.sort(key=lambda m: m.ts_ns)
+                if out:
+                    return out, out[-1].ts_ns
         with self._log_cond:
             out = [m for m in self._log if m.ts_ns > since_ns]
             if not out:

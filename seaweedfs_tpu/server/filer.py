@@ -154,6 +154,8 @@ class FilerServer:
             self._http_server.shutdown()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5)
+        if self.filer.meta_log is not None:
+            self.filer.meta_log.close()
         self.filer.store.close()
 
     # -- chunk IO ----------------------------------------------------------

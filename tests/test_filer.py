@@ -100,6 +100,51 @@ def test_metadata_event_log():
     assert cursor > t0
 
 
+def test_meta_log_survives_restart(tmp_path):
+    """filer_notify.go:70/:116 — events persist under /topics/.system/log and
+    replay across a filer restart for point-in-time resume."""
+    db = str(tmp_path / "filer.db")
+    f = Filer(get_store("sqlite", db_path=db))
+    t0 = time.time_ns() - 1
+    for i in range(10):
+        f.create_entry(Entry(full_path=f"/d/f{i}"))
+    f.delete_entry("/d/f0")
+    f.meta_log.close()
+    f.store.close()
+
+    f2 = Filer(get_store("sqlite", db_path=db))
+    events, cursor = f2.read_events(t0)
+    names = [m.event_notification.new_entry.name for m in events
+             if m.directory == "/d" and m.event_notification.new_entry.name]
+    assert names == [f"f{i}" for i in range(10)]
+    deletes = [m for m in events if m.directory == "/d"
+               and m.event_notification.old_entry.name == "f0"
+               and not m.event_notification.new_entry.name]
+    assert deletes, "delete event lost across restart"
+    assert cursor == events[-1].ts_ns
+
+    # resume mid-stream: cursor after the 5th create sees only the tail
+    mid = events[4].ts_ns
+    tail, _ = f2.read_events(mid)
+    tail_names = [m.event_notification.new_entry.name for m in tail
+                  if m.directory == "/d" and m.event_notification.new_entry.name]
+    assert tail_names == [f"f{i}" for i in range(5, 10)]
+    f2.store.close()
+
+
+def test_meta_log_outlives_deque_window():
+    """A subscriber that lagged past the bounded deque reads the persisted
+    log instead of silently losing events (round-1 weak #8)."""
+    f = Filer(get_store("memory"), log_capacity=4)
+    t0 = time.time_ns() - 1
+    for i in range(25):
+        f.create_entry(Entry(full_path=f"/lag/f{i}"))
+    events, _ = f.read_events(t0)
+    names = [m.event_notification.new_entry.name for m in events
+             if m.directory == "/lag" and m.event_notification.new_entry.name]
+    assert names == [f"f{i}" for i in range(25)]
+
+
 # -- live cluster ----------------------------------------------------------
 
 @pytest.fixture(scope="module")
