@@ -195,6 +195,8 @@ def main(argv=None) -> int:
     mnt.add_argument("-collection", default="")
     mnt.add_argument("-replication", default="")
     mnt.add_argument("-cacheDir", default="")
+    mnt.add_argument("-localPort", type=int, default=0,
+                     help="localhost gRPC control port (mount.configure)")
 
     bk = sub.add_parser("backup", help="backup a live volume locally")
     bk.add_argument("-master", default="localhost:9333")
@@ -657,12 +659,19 @@ complete -F _weed_tpu weed-tpu""")
 
     if opts.cmd == "mq.broker":
         from ..mq import Broker, MqHttpServer
+        from ..mq.grpc_server import MqGrpcServer
+        from ..pb import rpc as _rpc
 
         broker = Broker(filer=opts.filer)
         broker.load_from_filer()
         http = MqHttpServer(broker, port=opts.port)
         http.start()
+        grpc_srv = MqGrpcServer(broker,
+                                port=_rpc.derived_grpc_port(opts.port),
+                                address=f"localhost:{opts.port}")
+        grpc_srv.start()
         _wait_forever()
+        grpc_srv.stop()
         http.stop()
         broker.flush_to_filer()
         return 0
@@ -675,9 +684,17 @@ complete -F _weed_tpu weed-tpu""")
                   chunk_size=opts.chunkSizeLimitMB * 1024 * 1024,
                   collection=opts.collection, replication=opts.replication,
                   cache_dir=opts.cacheDir or None)
+        control = None
+        if opts.localPort:
+            from ..mount.control import MountControlServer
+
+            control = MountControlServer(wfs, port=opts.localPort)
+            control.start()
         try:
             mount(wfs, opts.dir)
         finally:
+            if control is not None:
+                control.stop()
             wfs.close()
         return 0
 

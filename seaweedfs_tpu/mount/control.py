@@ -1,0 +1,38 @@
+"""Mount control socket (mount_pb.SeaweedMount).
+
+Rebuild of the reference's mount-process gRPC surface
+(/root/reference/weed/pb/mount.proto:11-17, weed/mount/wfs.go Configure /
+weed/command/mount_std.go local socket): `weed mount.configure` adjusts a
+live mount's collection quota without remounting.
+"""
+
+from __future__ import annotations
+
+from ..pb import mount_pb2, rpc
+
+
+class MountControlServicer:
+    def __init__(self, wfs):
+        self.wfs = wfs
+
+    def Configure(self, request, context):
+        # capacity <= 0 clears the quota (mount_grpc_server.go behavior)
+        self.wfs.collection_capacity = max(0, request.collection_capacity)
+        return mount_pb2.ConfigureResponse()
+
+
+class MountControlServer:
+    """Localhost-only control endpoint for a live mount."""
+
+    def __init__(self, wfs, *, port: int):
+        self.port = port
+        self._server = rpc.new_server(max_workers=2)
+        rpc.add_servicer(self._server, rpc.MOUNT_SERVICE,
+                         MountControlServicer(wfs))
+        self._server.add_insecure_port(f"127.0.0.1:{port}")
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)

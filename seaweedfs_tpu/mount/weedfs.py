@@ -88,6 +88,9 @@ class WFS:
         self.disk_type = disk_type
         self.data_center = data_center
         self.upload_concurrency = upload_concurrency
+        self.collection_capacity = 0  # bytes; set via SeaweedMount.Configure
+        self._quota_checked_at = 0.0
+        self._quota_over = False
         self.inodes = InodeToPath()
         self.meta = MetaCache()
         self.chunk_cache = TieredChunkCache(disk_dir=cache_dir)
@@ -256,10 +259,30 @@ class WFS:
         return h
 
     def write(self, fh: int, offset: int, data: bytes) -> int:
+        if self._quota_exceeded():
+            raise OSError(errno.ENOSPC, "collection quota exceeded")
         h = self._handle(fh)
         h.dirty = True
         h.pages.save_data_at(data, offset, time.time_ns())
         return len(data)
+
+    def _quota_exceeded(self) -> bool:
+        """Enforce SeaweedMount.Configure's collection_capacity the way the
+        reference mount does (wfs.go checkAndRecoverQuota): poll collection
+        usage through the filer's Statistics and fail writes with ENOSPC
+        while usage exceeds the quota."""
+        if self.collection_capacity <= 0:
+            return False
+        now = time.time()
+        if now - self._quota_checked_at > 10:
+            self._quota_checked_at = now
+            try:
+                st = self.stub.Statistics(filer_pb2.StatisticsRequest(
+                    collection=self.collection), timeout=5)
+                self._quota_over = st.used_size >= self.collection_capacity
+            except Exception:
+                pass  # keep the last verdict if the filer is unreachable
+        return self._quota_over
 
     def read(self, fh: int, offset: int, size: int) -> bytes:
         h = self._handle(fh)

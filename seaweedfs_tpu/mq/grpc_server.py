@@ -1,0 +1,103 @@
+"""MQ broker gRPC plane (messaging_pb.SeaweedMessaging).
+
+Rebuild of the reference broker service surface
+(/root/reference/weed/pb/mq.proto:11-26, weed/mq/broker/): the control
+plane answers from this broker's own view (single-broker deployments answer
+for themselves, mirroring broker_grpc_server.go's leader short-circuit),
+and the data plane maps Publish/Subscribe streams onto the partitioned
+append logs in mq.Broker.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..pb import mq_pb2, rpc
+
+
+class MqGrpcServicer:
+    def __init__(self, broker, address: str):
+        self.broker = broker
+        self.address = address
+
+    # -- control plane -----------------------------------------------------
+
+    def FindBrokerLeader(self, request, context):
+        return mq_pb2.FindBrokerLeaderResponse(broker=self.address)
+
+    def AssignSegmentBrokers(self, request, context):
+        seg = request.segment
+        self.broker.create_topic(seg.namespace, seg.topic)
+        return mq_pb2.AssignSegmentBrokersResponse(brokers=[self.address])
+
+    def CheckSegmentStatus(self, request, context):
+        seg = request.segment
+        t = self.broker.topic(seg.namespace, seg.topic)
+        return mq_pb2.CheckSegmentStatusResponse(is_active=t is not None)
+
+    def CheckBrokerLoad(self, request, context):
+        msgs = 0
+        nbytes = 0
+        for t in list(self.broker._topics.values()):
+            for p in t.partitions:
+                for r in p.records:
+                    msgs += 1
+                    nbytes += len(r.value)
+        return mq_pb2.CheckBrokerLoadResponse(
+            message_count=msgs, bytes_count=nbytes)
+
+    # -- data plane --------------------------------------------------------
+
+    def Publish(self, request_iterator, context):
+        ns = name = None
+        for req in request_iterator:
+            if req.HasField("init") and req.init.segment.topic:
+                ns, name = req.init.segment.namespace, req.init.segment.topic
+                self.broker.create_topic(ns, name)
+                if not req.message:
+                    continue
+            if ns is None:
+                yield mq_pb2.PublishResponse(
+                    error="first message must carry init.segment", is_closed=True)
+                return
+            off = self.broker.publish(ns, name, bytes(req.key),
+                                      bytes(req.message))
+            yield mq_pb2.PublishResponse(ack_sequence=off)
+
+    def Subscribe(self, request, context):
+        seg = request.segment
+        t = self.broker.topic(seg.namespace, seg.topic)
+        if t is None:
+            return
+        pi = seg.id if seg.id < len(t.partitions) else 0
+        limit = request.max_records or 1 << 30
+        sent = 0
+        offset = request.start_offset
+        while context.is_active() and sent < limit:
+            recs = t.partitions[pi].read(offset, max_records=min(
+                1024, limit - sent))
+            if not recs:
+                if request.max_records:
+                    return  # bounded read: stop at the tail
+                time.sleep(0.05)
+                continue
+            for r in recs:
+                yield mq_pb2.SubscribeResponse(
+                    offset=r.offset, key=r.key, message=r.value, ts_ns=r.ts_ns)
+                sent += 1
+            offset = recs[-1].offset + 1
+
+
+class MqGrpcServer:
+    def __init__(self, broker, *, port: int, address: str = ""):
+        self.port = port
+        self._server = rpc.new_server()
+        rpc.add_servicer(self._server, rpc.MQ_SERVICE,
+                         MqGrpcServicer(broker, address or f"localhost:{port}"))
+        self._server.add_insecure_port(f"[::]:{port}")
+
+    def start(self) -> None:
+        self._server.start()
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.5)

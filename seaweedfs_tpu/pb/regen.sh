@@ -1,0 +1,6 @@
+#!/bin/sh
+# Regenerate *_pb2.py from proto/ (protoc's --python_out emits absolute
+# imports between files; rewrite them to package-relative).
+cd "$(dirname "$0")"
+protoc -I proto --python_out=. proto/*.proto
+sed -i 's/^import \([a-z_]*\)_pb2 as \([a-z_]*\)__pb2$/from . import \1_pb2 as \2__pb2/' ./*_pb2.py

@@ -19,7 +19,7 @@ from concurrent import futures
 
 import grpc
 
-from . import filer_pb2, master_pb2, volume_server_pb2
+from . import filer_pb2, master_pb2, mount_pb2, mq_pb2, s3_pb2, volume_server_pb2
 
 MAX_MESSAGE_SIZE = 1 << 30  # grpc_client_server.go:27
 GRPC_PORT_DELTA = 10000
@@ -100,6 +100,10 @@ VOLUME_SERVICE = ("volume_server_pb.VolumeServer", [
        V.VolumeTierMoveDatFromRemoteResponse, ss=True),
     _m("VolumeServerStatus", V.VolumeServerStatusRequest, V.VolumeServerStatusResponse),
     _m("VolumeServerLeave", V.VolumeServerLeaveRequest, V.VolumeServerLeaveResponse),
+    _m("ReadNeedleMeta", V.ReadNeedleMetaRequest, V.ReadNeedleMetaResponse),
+    _m("FetchAndWriteNeedle", V.FetchAndWriteNeedleRequest, V.FetchAndWriteNeedleResponse),
+    _m("Query", V.QueryRequest, V.QueriedStripe, ss=True),
+    _m("VolumeNeedleStatus", V.VolumeNeedleStatusRequest, V.VolumeNeedleStatusResponse),
     _m("Ping", V.PingRequest, V.PingResponse),
 ])
 
@@ -218,5 +222,39 @@ def volume_stub(address: str) -> Stub:
     return Stub(cached_channel(address), VOLUME_SERVICE)
 
 
+MQ_SERVICE = ("messaging_pb.SeaweedMessaging", [
+    _m("FindBrokerLeader", mq_pb2.FindBrokerLeaderRequest, mq_pb2.FindBrokerLeaderResponse),
+    _m("AssignSegmentBrokers", mq_pb2.AssignSegmentBrokersRequest, mq_pb2.AssignSegmentBrokersResponse),
+    _m("CheckSegmentStatus", mq_pb2.CheckSegmentStatusRequest, mq_pb2.CheckSegmentStatusResponse),
+    _m("CheckBrokerLoad", mq_pb2.CheckBrokerLoadRequest, mq_pb2.CheckBrokerLoadResponse),
+    _m("Publish", mq_pb2.PublishRequest, mq_pb2.PublishResponse, cs=True, ss=True),
+    _m("Subscribe", mq_pb2.SubscribeRequest, mq_pb2.SubscribeResponse, ss=True),
+])
+
+S3_SERVICE = ("s3_pb.SeaweedS3", [
+    _m("Configure", s3_pb2.S3ConfigureRequest, s3_pb2.S3ConfigureResponse),
+])
+
+MOUNT_SERVICE = ("mount_pb.SeaweedMount", [
+    _m("Configure", mount_pb2.ConfigureRequest, mount_pb2.ConfigureResponse),
+])
+
+# The reference's SeaweedIdentityAccessManagement declares no RPCs
+# (iam.proto:11-13); kept for parity so add_servicer accepts it.
+IAM_SERVICE = ("iam_pb.SeaweedIdentityAccessManagement", [])
+
+
 def filer_stub(address: str) -> Stub:
     return Stub(cached_channel(address), FILER_SERVICE)
+
+
+def mq_stub(address: str) -> Stub:
+    return Stub(cached_channel(address), MQ_SERVICE)
+
+
+def s3_stub(address: str) -> Stub:
+    return Stub(cached_channel(address), S3_SERVICE)
+
+
+def mount_stub(address: str) -> Stub:
+    return Stub(cached_channel(address), MOUNT_SERVICE)

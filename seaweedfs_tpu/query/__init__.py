@@ -79,7 +79,8 @@ class Predicate:
 
 
 def query_json(data: bytes, *, select: list[str] | None = None,
-               where: str = "", limit: int = 0) -> list[dict]:
+               where: str = "", limit: int = 0,
+               predicate: Predicate | None = None) -> list[dict]:
     """Filter newline-delimited JSON (or a single doc/array)."""
     text = data.decode()
     docs = []
@@ -91,7 +92,7 @@ def query_json(data: bytes, *, select: list[str] | None = None,
             line = line.strip()
             if line:
                 docs.append(json.loads(line))
-    pred = Predicate(where)
+    pred = predicate if predicate is not None else Predicate(where)
     out = []
     for doc in docs:
         if not pred(doc):
@@ -106,7 +107,8 @@ def query_json(data: bytes, *, select: list[str] | None = None,
 
 def query_csv(data: bytes, *, select: list[str] | None = None,
               where: str = "", limit: int = 0,
-              has_header: bool = True) -> list[dict]:
+              has_header: bool = True,
+              predicate: Predicate | None = None) -> list[dict]:
     reader = csv.reader(io.StringIO(data.decode()))
     rows = list(reader)
     if not rows:
@@ -119,7 +121,7 @@ def query_csv(data: bytes, *, select: list[str] | None = None,
     typed = []
     for d in docs:
         typed.append({k: _parse_value(v) for k, v in d.items()})
-    pred = Predicate(where)
+    pred = predicate if predicate is not None else Predicate(where)
     out = []
     for doc in typed:
         if not pred(doc):
@@ -130,3 +132,47 @@ def query_csv(data: bytes, *, select: list[str] | None = None,
         if limit and len(out) >= limit:
             break
     return out
+
+
+def execute_query(data: bytes, request) -> bytes:
+    """Run a VolumeServerQuery proto request (volume_grpc_query.go) against
+    one object's bytes -> serialized records for a QueriedStripe."""
+    insz = request.input_serialization
+    if (insz.compression_type or "NONE").upper() == "GZIP":
+        from ..utils.compression import gunzip_data
+
+        data = gunzip_data(data)
+
+    # build the predicate straight from the proto triple — a where-string
+    # round-trip would mis-parse values containing " and " or quotes
+    pred = Predicate("")
+    if request.filter.field:
+        op = _OPS.get(request.filter.operand or "=")
+        if op is None:
+            raise ValueError(f"bad operand {request.filter.operand!r}")
+        pred.conds.append((request.filter.field, op,
+                           _parse_value(request.filter.value)))
+    select = list(request.selections) or None
+
+    if insz.HasField("csv_input"):
+        has_header = (insz.csv_input.file_header_info or "NONE").upper() == "USE"
+        docs = query_csv(data, select=select, predicate=pred,
+                         has_header=has_header)
+    else:
+        docs = query_json(data, select=select, predicate=pred)
+    if not docs:
+        return b""
+
+    outsz = request.output_serialization
+    if outsz.HasField("csv_output"):
+        buf = io.StringIO()
+        delim = outsz.csv_output.field_delimiter or ","
+        rec_delim = outsz.csv_output.record_delimiter or "\n"
+        fields = select or list(docs[0].keys())  # input column order
+        w = csv.writer(buf, delimiter=delim, lineterminator=rec_delim)
+        for d in docs:
+            w.writerow([d.get(f, "") for f in fields])
+        return buf.getvalue().encode()
+    rec_delim = (outsz.json_output.record_delimiter
+                 if outsz.HasField("json_output") else "") or "\n"
+    return rec_delim.join(json.dumps(d) for d in docs).encode() + rec_delim.encode()

@@ -50,7 +50,8 @@ class RemoteStorageClient:
         """yields RemoteEntry for every object under prefix."""
         raise NotImplementedError
 
-    def read_file(self, path: str) -> bytes:
+    def read_file(self, path: str, offset: int = 0, size: int = -1) -> bytes:
+        """Whole object, or the [offset, offset+size) range when size >= 0."""
         raise NotImplementedError
 
     def write_file(self, path: str, data: bytes) -> RemoteEntry:
@@ -83,9 +84,10 @@ class LocalRemoteStorage(RemoteStorageClient):
                 yield RemoteEntry(path="/" + rel, size=st.st_size,
                                   mtime=int(st.st_mtime))
 
-    def read_file(self, path: str) -> bytes:
+    def read_file(self, path: str, offset: int = 0, size: int = -1) -> bytes:
         with open(self._abs(path), "rb") as f:
-            return f.read()
+            f.seek(offset)
+            return f.read() if size < 0 else f.read(size)
 
     def write_file(self, path: str, data: bytes) -> RemoteEntry:
         target = self._abs(path)
@@ -158,12 +160,16 @@ class S3RemoteStorage(RemoteStorageClient):
             if not token:
                 return
 
-    def read_file(self, path: str) -> bytes:
+    def read_file(self, path: str, offset: int = 0, size: int = -1) -> bytes:
         import requests
 
         url = self._url(path)
-        r = requests.get(url, headers=self._headers("GET", url, b""),
-                         timeout=300)
+        headers = self._headers("GET", url, b"")
+        if offset or size >= 0:
+            # ranged GET so one-needle fetches don't transfer whole objects
+            end = "" if size < 0 else str(offset + size - 1)
+            headers["Range"] = f"bytes={offset}-{end}"
+        r = requests.get(url, headers=headers, timeout=300)
         r.raise_for_status()
         return r.content
 
@@ -188,6 +194,42 @@ class S3RemoteStorage(RemoteStorageClient):
 
 
 _CLIENTS = {"local": LocalRemoteStorage, "s3": S3RemoteStorage}
+
+
+def mapping_to_pb(conf: dict) -> bytes:
+    """Serialize the mount table as remote_pb.RemoteStorageMapping bytes."""
+    from ..pb import remote_pb2
+
+    m = remote_pb2.RemoteStorageMapping()
+    storages = conf.get("storages", {})
+    for directory, mnt in conf.get("mounts", {}).items():
+        loc = m.mappings[directory]
+        loc.name = mnt.get("storage", "")
+        path = mnt.get("remote_path", "")
+        kind = storages.get(loc.name, {}).get("type", "local")
+        # only bucket-addressed backends split the leading segment off;
+        # a local root has no bucket and keeps its full path
+        if kind == "s3" and "/" in path.lstrip("/"):
+            bucket, _, rest = path.lstrip("/").partition("/")
+            loc.bucket, loc.path = bucket, "/" + rest
+        else:
+            loc.path = "/" + path.lstrip("/")
+    return m.SerializeToString()
+
+
+def conf_to_pb(name: str, conf: dict) -> bytes:
+    """Serialize one storage config as remote_pb.RemoteConf bytes."""
+    from ..pb import remote_pb2
+
+    rc = remote_pb2.RemoteConf(type=conf.get("type", "local"), name=name)
+    if rc.type == "local":
+        rc.local_root = conf.get("root", "")
+    elif rc.type == "s3":
+        rc.s3_endpoint = conf.get("endpoint", "")
+        rc.s3_access_key = conf.get("access_key", "")
+        rc.s3_secret_key = conf.get("secret_key", "")
+        rc.s3_region = conf.get("region", "")
+    return rc.SerializeToString()
 
 
 def new_client(conf: dict) -> RemoteStorageClient:
@@ -232,6 +274,26 @@ class RemoteConf:
         entry.attributes.mtime = int(time.time())
         self._stub.CreateEntry(filer_pb2.CreateEntryRequest(
             directory=REMOTE_CONF_DIR, entry=entry), timeout=10)
+        # wire-parity copy: the reference persists the mount table as a
+        # serialized remote_pb.RemoteStorageMapping at /etc/remote/mapping
+        # (filer_remote_storage.go) — keep that file readable by its tools
+        mapping = filer_pb2.Entry(name="mapping",
+                                  content=mapping_to_pb(conf))
+        mapping.attributes.file_mode = 0o600
+        mapping.attributes.mtime = int(time.time())
+        self._stub.CreateEntry(filer_pb2.CreateEntryRequest(
+            directory=REMOTE_CONF_DIR, entry=mapping), timeout=10)
+
+    def load_mapping_pb(self):
+        """-> remote_pb2.RemoteStorageMapping from /etc/remote/mapping."""
+        from ..pb import remote_pb2
+
+        resp = self._stub.LookupDirectoryEntry(
+            filer_pb2.LookupDirectoryEntryRequest(
+                directory=REMOTE_CONF_DIR, name="mapping"), timeout=10)
+        m = remote_pb2.RemoteStorageMapping()
+        m.ParseFromString(resp.entry.content)
+        return m
 
     def configure_storage(self, name: str, conf: dict) -> None:
         all_ = self.load()

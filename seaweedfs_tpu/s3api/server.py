@@ -56,11 +56,46 @@ class S3Server:
             ("", self.port), _make_handler(self))
         threading.Thread(target=self._http_server.serve_forever,
                          daemon=True).start()
+        # control plane (s3.proto SeaweedS3.Configure; s3api_server.go
+        # registers the same service beside the HTTP handlers). Loopback
+        # only: Configure replaces the whole identity set, and unlike the
+        # reference we have no grpc-TLS gate, so it must not be reachable
+        # off-host.
+        self._grpc_server = rpc.new_server()
+        rpc.add_servicer(self._grpc_server, rpc.S3_SERVICE, _S3Control(self))
+        self._grpc_server.add_insecure_port(
+            f"127.0.0.1:{rpc.derived_grpc_port(self.port)}")
+        self._grpc_server.start()
         glog.info(f"s3 gateway on :{self.port} -> filer {self.filer}")
 
     def stop(self) -> None:
         if self._http_server:
             self._http_server.shutdown()
+        if getattr(self, "_grpc_server", None):
+            self._grpc_server.stop(grace=0.5)
+
+    def configure_from_bytes(self, content: bytes) -> None:
+        """Hot-swap identities from identity.json bytes (the reference's
+        ParseS3ConfigurationFromBytes -> onIamConfigUpdate path), validated
+        through the iam_pb S3ApiConfiguration schema."""
+        from google.protobuf import json_format
+
+        from ..pb import iam_pb2
+
+        conf = json_format.Parse(
+            content.decode(), iam_pb2.S3ApiConfiguration(),
+            ignore_unknown_fields=True)
+        ids = []
+        for ident in conf.identities:
+            cred = ident.credentials[0] if ident.credentials else None
+            # empty actions mean NO permissions (identity.canDo returns
+            # false on an empty list in the reference) — never default up
+            ids.append(Identity(
+                name=ident.name,
+                access_key=cred.access_key if cred else "",
+                secret_key=cred.secret_key if cred else "",
+                actions=list(ident.actions)))
+        self.iam = IdentityAccessManagement(ids)
 
     # -- filer plumbing ----------------------------------------------------
 
@@ -141,6 +176,22 @@ def _iso(ts: int) -> str:
 
 
 # -- request handler -------------------------------------------------------
+
+class _S3Control:
+    """s3_pb.SeaweedS3 servicer — configuration push."""
+
+    def __init__(self, srv: S3Server):
+        self.srv = srv
+
+    def Configure(self, request, context):
+        from ..pb import s3_pb2
+
+        try:
+            self.srv.configure_from_bytes(request.s3_configuration_file_content)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad config: {e}")
+        return s3_pb2.S3ConfigureResponse()
+
 
 def _make_handler(srv: S3Server):
     class Handler(BaseHTTPRequestHandler):

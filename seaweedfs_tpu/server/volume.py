@@ -854,6 +854,107 @@ class VolumeGrpc:
         return vs.PingResponse(start_time_ns=now, remote_time_ns=now,
                                stop_time_ns=time.time_ns())
 
+    # ---- needle metadata / status (volume_server.proto:289-301,596-607) --
+
+    def _parse_record(self, v, offset: int, size: int, context) -> Needle:
+        try:
+            blob = v.read_needle_blob(offset, size)
+            return Needle.from_bytes(blob, v.version, check_crc=False)
+        except (IOError, ValueError) as e:
+            context.abort(grpc.StatusCode.INTERNAL, f"needle read: {e}")
+
+    def ReadNeedleMeta(self, request, context):
+        """Needle attributes without the body (volume_grpc_read_write.go
+        ReadNeedleMeta): callers pass the (offset, size) they learned from
+        the index so no lookup is repeated."""
+        v = self._volume(request.volume_id, context)
+        offset, size = request.offset, request.size
+        if offset == 0:
+            nv = v.nm.get(request.needle_id)
+            if nv is None or types.size_is_deleted(nv.size):
+                context.abort(grpc.StatusCode.NOT_FOUND, "needle not found")
+            offset = types.stored_to_actual_offset(nv.offset)
+            size = nv.size
+        n = self._parse_record(v, offset, size, context)
+        return vs.ReadNeedleMetaResponse(
+            cookie=n.cookie, last_modified=n.last_modified,
+            crc=n.checksum & 0xFFFFFFFF, ttl=str(n.ttl),
+            append_at_ns=n.append_at_ns)
+
+    def VolumeNeedleStatus(self, request, context):
+        """Index + header view of one needle (volume_grpc_read_write.go
+        VolumeNeedleStatus)."""
+        v = self._volume(request.volume_id, context)
+        nv = v.nm.get(request.needle_id)
+        if nv is None or types.size_is_deleted(nv.size):
+            context.abort(grpc.StatusCode.NOT_FOUND, "needle not found")
+        n = self._parse_record(
+            v, types.stored_to_actual_offset(nv.offset), nv.size, context)
+        return vs.VolumeNeedleStatusResponse(
+            needle_id=request.needle_id, cookie=n.cookie, size=nv.size,
+            last_modified=n.last_modified, crc=n.checksum & 0xFFFFFFFF,
+            ttl=str(n.ttl))
+
+    # ---- remote fetch (volume_grpc_remote.go FetchAndWriteNeedle) --------
+
+    def FetchAndWriteNeedle(self, request, context):
+        from ..remote_storage import new_client
+
+        v = self._volume(request.volume_id, context)
+        rc = request.remote_conf
+        conf = {"type": rc.type or "local", "name": rc.name}
+        if conf["type"] == "local":
+            conf["root"] = rc.local_root
+        elif conf["type"] == "s3":
+            conf.update(endpoint=rc.s3_endpoint,
+                        bucket=request.remote_location.bucket,
+                        access_key=rc.s3_access_key,
+                        secret_key=rc.s3_secret_key,
+                        region=rc.s3_region or "us-east-1")
+        try:
+            client = new_client(conf)
+            data = client.read_file(request.remote_location.path,
+                                    request.offset,
+                                    request.size if request.size else -1)
+        except Exception as e:
+            context.abort(grpc.StatusCode.INTERNAL, f"remote fetch: {e}")
+        n = Needle.create(request.needle_id, request.cookie, bytes(data))
+        v.write_needle(n, check_cookie=False)
+        import hashlib as _hashlib
+
+        return vs.FetchAndWriteNeedleResponse(
+            e_tag=_hashlib.md5(bytes(data)).hexdigest())
+
+    # ---- select on the volume server (volume_grpc_query.go) --------------
+
+    def Query(self, request, context):
+        """Scan the named needles as JSON/CSV records, apply the single
+        filter, project `selections`, stream serialized stripes."""
+        from ..query import execute_query
+
+        for fid in request.from_file_ids:
+            try:
+                f = parse_file_id(fid)
+            except ValueError:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              f"bad file id {fid}")
+            try:
+                n = self.srv.read_needle(f.volume_id, f.key, f.cookie)
+            except (NotFoundError, KeyError, CookieMismatch, DeletedError):
+                continue  # skip unreadable fids like not-found (query semantics)
+            data = n.data
+            if n.is_compressed:
+                from ..utils.compression import maybe_decompress
+
+                data = maybe_decompress(data)
+            try:
+                out = execute_query(data, request)
+            except Exception as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                              f"query {fid}: {e}")
+            if out:
+                yield vs.QueriedStripe(records=out)
+
     # ---- helpers
 
     def _volume(self, vid: int, context):

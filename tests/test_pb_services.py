@@ -1,0 +1,281 @@
+"""Coverage for the round-2 proto surface: the mount/s3/iam/mq/remote
+services (/root/reference/weed/pb/{mount,s3,iam,mq,remote}.proto) and the
+four volume RPCs the round-1 build lacked (ReadNeedleMeta,
+FetchAndWriteNeedle, Query, VolumeNeedleStatus —
+/root/reference/weed/pb/volume_server.proto:59,103,107,110)."""
+
+import json
+import socket
+import time
+
+import pytest
+
+from seaweedfs_tpu.operation import assign, upload_data
+from seaweedfs_tpu.pb import (
+    mq_pb2,
+    remote_pb2,
+    rpc,
+    s3_pb2,
+    volume_server_pb2 as vs,
+)
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+from seaweedfs_tpu.storage.file_id import parse_file_id
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport, volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path_factory.mktemp("vol"))],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), pulse_seconds=1)
+    vsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    yield master, vsrv
+    vsrv.stop()
+    master.stop()
+    rpc.reset_channels()
+
+
+def _put(master, payload: bytes, mime="application/octet-stream"):
+    a = assign(master.address)
+    assert not a.error
+    r = upload_data(f"http://{a.url}/{a.fid}", payload, mime=mime)
+    assert not r.error
+    return a
+
+
+# -- ReadNeedleMeta / VolumeNeedleStatus ------------------------------------
+
+def test_needle_meta_and_status(cluster):
+    master, vsrv = cluster
+    payload = b"needle-meta-payload" * 10
+    a = _put(master, payload)
+    f = parse_file_id(a.fid)
+    stub = rpc.volume_stub(rpc.grpc_address(vsrv.address))
+
+    st = stub.VolumeNeedleStatus(vs.VolumeNeedleStatusRequest(
+        volume_id=f.volume_id, needle_id=f.key), timeout=10)
+    assert st.needle_id == f.key
+    assert st.cookie == f.cookie
+    assert st.size > 0 and st.crc != 0
+
+    meta = stub.ReadNeedleMeta(vs.ReadNeedleMetaRequest(
+        volume_id=f.volume_id, needle_id=f.key), timeout=10)
+    assert meta.cookie == f.cookie
+    assert meta.crc == st.crc
+    assert meta.last_modified > 0
+
+    import grpc as _grpc
+
+    with pytest.raises(_grpc.RpcError):
+        stub.VolumeNeedleStatus(vs.VolumeNeedleStatusRequest(
+            volume_id=f.volume_id, needle_id=0xDEAD), timeout=10)
+
+
+# -- FetchAndWriteNeedle ----------------------------------------------------
+
+def test_fetch_and_write_needle(cluster, tmp_path):
+    import requests
+
+    master, vsrv = cluster
+    remote_root = tmp_path / "remote"
+    remote_root.mkdir()
+    (remote_root / "obj.bin").write_bytes(b"remote object body")
+
+    a = _put(master, b"placeholder")  # ensures a writable volume exists
+    f = parse_file_id(a.fid)
+    stub = rpc.volume_stub(rpc.grpc_address(vsrv.address))
+    stub.FetchAndWriteNeedle(vs.FetchAndWriteNeedleRequest(
+        volume_id=f.volume_id, needle_id=0x77, cookie=0x1234,
+        remote_conf=remote_pb2.RemoteConf(type="local",
+                                          local_root=str(remote_root)),
+        remote_location=remote_pb2.RemoteStorageLocation(path="/obj.bin"),
+    ), timeout=10)
+
+    r = requests.get(f"http://{vsrv.address}/{f.volume_id},7700001234",
+                     timeout=10)
+    assert r.status_code == 200
+    assert r.content == b"remote object body"
+
+
+# -- Query ------------------------------------------------------------------
+
+def test_query_json_and_csv(cluster):
+    master, vsrv = cluster
+    docs = [{"name": "a", "n": 1}, {"name": "b", "n": 5}, {"name": "c", "n": 9}]
+    a = _put(master, "\n".join(json.dumps(d) for d in docs).encode(),
+             mime="application/json")
+    stub = rpc.volume_stub(rpc.grpc_address(vsrv.address))
+
+    req = vs.QueryRequest(from_file_ids=[a.fid], selections=["name"])
+    req.filter.field, req.filter.operand, req.filter.value = "n", ">", "3"
+    req.input_serialization.json_input.type = "LINES"
+    stripes = list(stub.Query(req, timeout=10))
+    got = [json.loads(line) for s in stripes
+           for line in s.records.decode().splitlines() if line]
+    assert got == [{"name": "b"}, {"name": "c"}]
+
+    csv_body = b"name,n\nx,2\ny,8\n"
+    b = _put(master, csv_body, mime="text/csv")
+    req2 = vs.QueryRequest(from_file_ids=[b.fid])
+    req2.filter.field, req2.filter.operand, req2.filter.value = "n", ">=", "8"
+    req2.input_serialization.csv_input.file_header_info = "USE"
+    req2.output_serialization.csv_output.field_delimiter = ","
+    stripes2 = list(stub.Query(req2, timeout=10))
+    assert stripes2 and b"y,8" in stripes2[0].records.replace(b"\r", b"")
+
+
+# -- MQ gRPC plane ----------------------------------------------------------
+
+def test_mq_grpc_publish_subscribe():
+    from seaweedfs_tpu.mq import Broker
+    from seaweedfs_tpu.mq.grpc_server import MqGrpcServer
+
+    broker = Broker()
+    port = _free_port()
+    srv = MqGrpcServer(broker, port=port, address=f"localhost:{port}")
+    srv.start()
+    try:
+        stub = rpc.mq_stub(f"localhost:{port}")
+        lead = stub.FindBrokerLeader(
+            mq_pb2.FindBrokerLeaderRequest(filer_group=""), timeout=5)
+        assert lead.broker == f"localhost:{port}"
+
+        seg = mq_pb2.Segment(namespace="ns", topic="events", id=0)
+        assign_resp = stub.AssignSegmentBrokers(
+            mq_pb2.AssignSegmentBrokersRequest(segment=seg), timeout=5)
+        assert assign_resp.brokers == [f"localhost:{port}"]
+        assert stub.CheckSegmentStatus(
+            mq_pb2.CheckSegmentStatusRequest(segment=seg), timeout=5).is_active
+
+        def feed():
+            yield mq_pb2.PublishRequest(
+                init=mq_pb2.PublishRequest.InitMessage(segment=seg))
+            for i in range(5):
+                yield mq_pb2.PublishRequest(key=b"k%d" % i,
+                                            message=b"payload-%d" % i)
+
+        acks = [r.ack_sequence for r in stub.Publish(feed(), timeout=10)]
+        assert acks == [0, 1, 2, 3, 4]
+
+        got = list(stub.Subscribe(mq_pb2.SubscribeRequest(
+            segment=seg, start_offset=1, max_records=3), timeout=10))
+        assert [g.offset for g in got] == [1, 2, 3]
+        assert got[0].message == b"payload-1"
+
+        load = stub.CheckBrokerLoad(mq_pb2.CheckBrokerLoadRequest(), timeout=5)
+        assert load.message_count == 5 and load.bytes_count > 0
+    finally:
+        srv.stop()
+        rpc.reset_channels()
+
+
+# -- S3 Configure -----------------------------------------------------------
+
+def test_s3_configure_grpc():
+    from seaweedfs_tpu.s3api.server import S3Server
+
+    port = _free_port()
+    srv = S3Server(port=port, filer="localhost:1")  # filer never dialed here
+    srv.start()
+    try:
+        conf = {"identities": [{
+            "name": "ops",
+            "credentials": [{"accessKey": "AK1", "secretKey": "SK1"}],
+            "actions": ["Read", "Write:bucket1"],
+        }]}
+        stub = rpc.s3_stub(f"localhost:{rpc.derived_grpc_port(port)}")
+        stub.Configure(s3_pb2.S3ConfigureRequest(
+            s3_configuration_file_content=json.dumps(conf).encode()),
+            timeout=5)
+        ident = srv.iam.lookup("AK1")
+        assert ident.name == "ops" and ident.secret_key == "SK1"
+        assert ident.allows("Write", "bucket1")
+        assert not ident.allows("Write", "bucket2")
+
+        import grpc as _grpc
+
+        with pytest.raises(_grpc.RpcError):
+            stub.Configure(s3_pb2.S3ConfigureRequest(
+                s3_configuration_file_content=b"{nope"), timeout=5)
+    finally:
+        srv.stop()
+        rpc.reset_channels()
+
+
+# -- Mount control ----------------------------------------------------------
+
+def test_mount_configure_grpc():
+    from seaweedfs_tpu.mount.control import MountControlServer
+    from seaweedfs_tpu.mount.weedfs import WFS
+    from seaweedfs_tpu.pb import mount_pb2
+
+    wfs = WFS("localhost:1", subscribe=False)
+    port = _free_port()
+    srv = MountControlServer(wfs, port=port)
+    srv.start()
+    try:
+        stub = rpc.mount_stub(f"localhost:{port}")
+        stub.Configure(mount_pb2.ConfigureRequest(collection_capacity=12345),
+                       timeout=5)
+        assert wfs.collection_capacity == 12345
+        # quota is enforced: once usage reaches capacity, writes ENOSPC
+        class _FakeStub:
+            def Statistics(self, req, timeout=0):
+                from seaweedfs_tpu.pb import filer_pb2
+
+                return filer_pb2.StatisticsResponse(used_size=20000)
+
+        wfs.stub = _FakeStub()
+        assert wfs._quota_exceeded()
+        import errno as _errno
+
+        with pytest.raises(OSError) as ei:
+            wfs.write(1, 0, b"data")
+        assert ei.value.errno == _errno.ENOSPC
+
+        stub.Configure(mount_pb2.ConfigureRequest(collection_capacity=-1),
+                       timeout=5)
+        assert wfs.collection_capacity == 0
+        assert not wfs._quota_exceeded()
+    finally:
+        srv.stop()
+        rpc.reset_channels()
+
+
+# -- remote_pb mapping ------------------------------------------------------
+
+def test_remote_mapping_pb_roundtrip():
+    from seaweedfs_tpu.remote_storage import conf_to_pb, mapping_to_pb
+
+    conf = {"storages": {"src": {"type": "local", "root": "/tmp/r"},
+                         "cloud": {"type": "s3", "endpoint": "http://s3:9000"}},
+            "mounts": {"/data": {"storage": "cloud",
+                                 "remote_path": "bucket1/sub/dir"},
+                       "/arch": {"storage": "src",
+                                 "remote_path": "archive/2024"}}}
+    m = remote_pb2.RemoteStorageMapping()
+    m.ParseFromString(mapping_to_pb(conf))
+    # bucket-addressed backend: first segment is the bucket
+    assert m.mappings["/data"].name == "cloud"
+    assert m.mappings["/data"].bucket == "bucket1"
+    assert m.mappings["/data"].path == "/sub/dir"
+    # local backend: no bucket, full path preserved
+    assert m.mappings["/arch"].name == "src"
+    assert m.mappings["/arch"].bucket == ""
+    assert m.mappings["/arch"].path == "/archive/2024"
+
+    rc = remote_pb2.RemoteConf()
+    rc.ParseFromString(conf_to_pb("src", conf["storages"]["src"]))
+    assert rc.type == "local" and rc.local_root == "/tmp/r"
