@@ -44,6 +44,7 @@ class Entry:
     hard_link_id: bytes = b""
     hard_link_counter: int = 0
     is_directory: bool = False
+    quota: int = 0  # bucket dirs only (filer.proto Entry.quota)
 
     @property
     def name(self) -> str:
@@ -68,7 +69,7 @@ class Entry:
         e = filer_pb2.Entry(
             name=self.name, is_directory=self.is_directory,
             content=self.content, hard_link_id=self.hard_link_id,
-            hard_link_counter=self.hard_link_counter,
+            hard_link_counter=self.hard_link_counter, quota=self.quota,
         )
         e.chunks.extend(self.chunks)
         a = self.attr
@@ -99,6 +100,7 @@ class Entry:
             hard_link_id=e.hard_link_id,
             hard_link_counter=e.hard_link_counter,
             is_directory=e.is_directory,
+            quota=e.quota,
         )
 
 

@@ -145,7 +145,9 @@ class IamServer:
                                      payload_hash)
         except AuthError as e:
             return e.code
-        if ident is not None and not ident.allows("Admin"):
+        # anonymous (ident None) is never acceptable here: unlike the S3
+        # gateway there is no ACL/policy to consult — admin key or nothing
+        if ident is None or not ident.allows("Admin"):
             return "AccessDenied"
         return None
 

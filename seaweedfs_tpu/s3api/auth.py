@@ -58,7 +58,10 @@ class IdentityAccessManagement:
 
     def authenticate(self, method: str, path: str, query: str,
                      headers, payload_hash: str) -> Identity | None:
-        """-> Identity, or None for allowed anonymous access."""
+        """-> Identity, or None when the request carries no credentials
+        (anonymous). Whether anonymous may proceed is an authorization
+        question (bucket ACL / policy) decided by the caller — the
+        reference splits authenticate/authorize the same way."""
         if not self.enabled:
             return None
         auth = headers.get("Authorization", "")
@@ -68,7 +71,9 @@ class IdentityAccessManagement:
         qs = urllib.parse.parse_qs(query)
         if "X-Amz-Signature" in qs:
             return self._verify_presigned(method, path, qs, headers)
-        raise AuthError("AccessDenied", "Anonymous access is disabled")
+        if auth:
+            raise AuthError("AccessDenied", "Unsupported Authorization type")
+        return None  # anonymous
 
     # -- header auth -------------------------------------------------------
 
@@ -103,6 +108,31 @@ class IdentityAccessManagement:
         cred = qs["X-Amz-Credential"][0]
         access_key, date, region, service, _ = _split_credential(cred)
         ident = self.lookup(access_key)
+        # expiry window (auth_signature_v4.go doesPresignedSignatureMatch:
+        # X-Amz-Expires is mandatory — a presigned URL without it would
+        # otherwise validate forever)
+        if "X-Amz-Expires" not in qs:
+            raise AuthError("AuthorizationQueryParametersError",
+                            "X-Amz-Expires is required")
+        import datetime as _dt
+
+        try:
+            expires = int(qs["X-Amz-Expires"][0])
+        except ValueError:
+            raise AuthError("AuthorizationQueryParametersError",
+                            "X-Amz-Expires must be an integer")
+        if not 1 <= expires <= 604800:
+            raise AuthError("AuthorizationQueryParametersError",
+                            "X-Amz-Expires must be between 1 and 604800")
+        try:
+            t0 = _dt.datetime.strptime(
+                qs.get("X-Amz-Date", [""])[0], "%Y%m%dT%H%M%SZ"
+            ).replace(tzinfo=_dt.timezone.utc)
+        except ValueError:
+            raise AuthError("AccessDenied", "bad X-Amz-Date")
+        if _dt.datetime.now(_dt.timezone.utc) > t0 + _dt.timedelta(
+                seconds=expires):
+            raise AuthError("AccessDenied", "Request has expired")
         signed_headers = qs["X-Amz-SignedHeaders"][0].split(";")
         given_sig = qs["X-Amz-Signature"][0]
         amz_date = qs["X-Amz-Date"][0]
@@ -152,7 +182,9 @@ def _canonical_request(method: str, path: str, query: str, headers,
         chdrs += f"{h}:{' '.join(v.split())}\n"
     return "\n".join([
         method,
-        _uri_encode(path, keep_slash=True),
+        # decode then encode once: the wire path is already percent-encoded
+        # and clients sign the singly-encoded form (S3-style SigV4)
+        _uri_encode(urllib.parse.unquote(path), keep_slash=True),
         cq,
         chdrs,
         ";".join(signed_headers),

@@ -26,6 +26,15 @@ from ..pb import filer_pb2, rpc
 from ..utils import glog
 from ..utils.stats import S3_REQUEST_HISTOGRAM
 from .auth import AuthError, Identity, IdentityAccessManagement
+from .circuit_breaker import CircuitBreaker, TooManyRequests, load_filer_config
+from .policy import BucketPolicy, PolicyError
+
+# extended-attr keys (s3_constants in the reference)
+ACL_KEY = "Seaweed-X-Amz-Acl"
+POLICY_KEY = "Seaweed-X-Amz-Policy"
+READONLY_KEY = "Seaweed-Read-Only"
+CANNED_ACLS = ("private", "public-read", "public-read-write",
+               "authenticated-read")
 
 BUCKETS_DIR = "/buckets"
 UPLOADS_DIR = "/buckets/.uploads"
@@ -46,6 +55,8 @@ class S3Server:
         self.filer = filer
         self.filer_grpc = rpc.grpc_address(filer)
         self.iam = IdentityAccessManagement(identities)
+        self.circuit_breaker = CircuitBreaker()
+        self._cb_loaded_at = 0.0
         self._http_server = None
         import requests as rq
 
@@ -101,6 +112,24 @@ class S3Server:
 
     def stub(self):
         return rpc.filer_stub(self.filer_grpc)
+
+    def maybe_reload_circuit_breaker(self) -> None:
+        """Refresh limits from /etc/s3/circuit_breaker.json (10s TTL — the
+        reference reloads on filer metadata events; a short poll keeps the
+        same convergence without a standing subscription)."""
+        now = time.time()
+        if now - self._cb_loaded_at < 10:
+            return
+        self._cb_loaded_at = now
+        try:
+            conf = load_filer_config(self.stub())
+        except Exception:
+            return
+        if conf is not None:
+            self.circuit_breaker.load(conf)
+
+    def bucket_entry(self, bucket: str) -> filer_pb2.Entry | None:
+        return self.find_entry(BUCKETS_DIR, bucket)
 
     def find_entry(self, directory: str, name: str) -> filer_pb2.Entry | None:
         try:
@@ -193,6 +222,29 @@ class _S3Control:
         return s3_pb2.S3ConfigureResponse()
 
 
+def _action_for(verb: str, bucket: str, key: str, q) -> str:
+    """HTTP request -> gateway action verb (s3_constants/header.go mapping)."""
+    if "acl" in q:
+        return "ReadAcp" if verb in ("GET", "HEAD") else "WriteAcp"
+    if "policy" in q:
+        return "Admin"
+    if "tagging" in q:
+        return "Read" if verb in ("GET", "HEAD") else "Tagging"
+    if not bucket:
+        return "List"
+    if not key:
+        if verb == "PUT":
+            return "Admin"  # create bucket
+        if verb == "DELETE":
+            return "Admin"  # delete bucket
+        if verb == "POST":
+            return "Write"  # multi-delete
+        return "List"
+    if verb in ("GET", "HEAD"):
+        return "Read"
+    return "Write"
+
+
 def _make_handler(srv: S3Server):
     class Handler(BaseHTTPRequestHandler):
         protocol_version = "HTTP/1.1"
@@ -245,7 +297,7 @@ def _make_handler(srv: S3Server):
                 body = _decode_chunked_signing(body)
             return body
 
-        def _auth(self, u) -> None:
+        def _auth(self, u) -> Identity | None:
             claimed = self.headers.get("x-amz-content-sha256",
                                        "UNSIGNED-PAYLOAD")
             if srv.iam.enabled and claimed not in (
@@ -253,17 +305,50 @@ def _make_handler(srv: S3Server):
                     "STREAMING-AWS4-HMAC-SHA256-PAYLOAD"):
                 # the signature covers the client's claimed hash; the claim
                 # must match the actual body or a captured signed request
-                # could be replayed with a swapped body
-                import hashlib
-
-                if hashlib.sha256(self._raw_body()).hexdigest() != claimed:
+                actual = hashlib.sha256(self._raw_body()).hexdigest()
+                if actual != claimed:
                     raise S3Error(400, "XAmzContentSHA256Mismatch",
                                   "payload hash does not match body")
             try:
-                srv.iam.authenticate(self.command, u.path, u.query,
-                                     self.headers, claimed)
+                return srv.iam.authenticate(self.command, u.path, u.query,
+                                            self.headers, claimed)
             except AuthError as e:
                 raise S3Error(403, e.code, str(e))
+
+        def _authorize(self, ident: Identity | None, action: str,
+                       bucket: str, key: str,
+                       entry: filer_pb2.Entry | None) -> None:
+            """Identity actions + bucket policy + canned ACL, Deny-wins
+            (auth_credentials.go canDo + policy evaluation). `entry` is the
+            bucket entry fetched once by the dispatcher."""
+            if not srv.iam.enabled:
+                return
+            policy = None
+            if entry is not None and POLICY_KEY in entry.extended:
+                try:
+                    policy = BucketPolicy.parse(entry.extended[POLICY_KEY])
+                except PolicyError:
+                    policy = None
+            verdict = policy.decide(
+                principal=ident.access_key if ident else None,
+                action=action, bucket=bucket, key=key) if policy else None
+            if verdict == "Deny":
+                raise S3Error(403, "AccessDenied", "denied by bucket policy")
+            if verdict == "Allow":
+                return
+            if ident is not None:
+                if ident.allows(action, bucket):
+                    return
+                raise S3Error(403, "AccessDenied",
+                              f"no permission for {action} on {bucket}")
+            # anonymous: only a public canned ACL (or policy, above) admits
+            acl = (entry.extended.get(ACL_KEY, b"") if entry else b"").decode()
+            if acl == "public-read-write" and action in ("Read", "List",
+                                                         "Write"):
+                return
+            if acl == "public-read" and action in ("Read", "List"):
+                return
+            raise S3Error(403, "AccessDenied", "anonymous access denied")
 
         # ---- verbs
 
@@ -295,19 +380,34 @@ def _make_handler(srv: S3Server):
                 self.wfile.write(body)
                 return
             bucket, key, q, u = self._route()
+            action = _action_for(verb, bucket, key, q)
+            release = lambda: None  # noqa: E731
             try:
                 with S3_REQUEST_HISTOGRAM.time(action=f"{verb.lower()}"):
-                    self._auth(u)
+                    # admission first: a tripped breaker must shed load
+                    # before any filer lookups (authz reads bucket state)
+                    srv.maybe_reload_circuit_breaker()
+                    try:
+                        release = srv.circuit_breaker.acquire(
+                            action, bucket,
+                            int(self.headers.get("Content-Length") or 0))
+                    except TooManyRequests as e:
+                        raise S3Error(503, "TooManyRequests", str(e))
+                    bucket_entry = srv.bucket_entry(bucket) if bucket else None
+                    ident = self._auth(u)
+                    self._authorize(ident, action, bucket, key, bucket_entry)
                     if not bucket:
                         return self._service(verb)
                     if not key:
-                        return self._bucket(verb, bucket, q)
-                    return self._object(verb, bucket, key, q)
+                        return self._bucket(verb, bucket, q, bucket_entry)
+                    return self._object(verb, bucket, key, q, bucket_entry)
             except S3Error as e:
                 self._error(e)
             except Exception as e:  # noqa: BLE001
                 glog.error(f"s3 {verb} {self.path}: {e}")
                 self._error(S3Error(500, "InternalError", str(e)))
+            finally:
+                release()
 
         # ---- service level
 
@@ -328,14 +428,30 @@ def _make_handler(srv: S3Server):
 
         # ---- bucket level
 
-        def _bucket(self, verb: str, bucket: str, q):
+        def _bucket(self, verb: str, bucket: str, q,
+                    bucket_entry: filer_pb2.Entry | None = None):
+            if "acl" in q:
+                return self._acl(verb, bucket, "")
+            if "policy" in q:
+                return self._policy(verb, bucket)
             if verb == "PUT":
+                if bucket_entry is not None:
+                    # CreateEntry upserts; recreating would wipe the
+                    # existing bucket's ACL/policy/quota attributes
+                    return self._send(200,
+                                      headers={"Location": f"/{bucket}"})
+                entry = _dir_entry(bucket)
+                acl = self.headers.get("x-amz-acl", "")
+                if acl:
+                    if acl not in CANNED_ACLS:
+                        raise S3Error(400, "InvalidArgument",
+                                      f"unsupported canned acl {acl}")
+                    entry.extended[ACL_KEY] = acl.encode()
                 srv.stub().CreateEntry(filer_pb2.CreateEntryRequest(
-                    directory=BUCKETS_DIR,
-                    entry=_dir_entry(bucket)), timeout=10)
+                    directory=BUCKETS_DIR, entry=entry), timeout=10)
                 return self._send(200, headers={"Location": f"/{bucket}"})
             if verb in ("GET", "HEAD"):
-                entry = srv.find_entry(BUCKETS_DIR, bucket)
+                entry = bucket_entry
                 if entry is None:
                     raise S3Error(404, "NoSuchBucket",
                                   "The specified bucket does not exist")
@@ -417,10 +533,100 @@ def _make_handler(srv: S3Server):
 
         # ---- object level
 
-        def _object(self, verb: str, bucket: str, key: str, q):
-            if srv.find_entry(BUCKETS_DIR, bucket) is None:
+        # ---- ACL (s3acl/ + s3api_object_handlers_acl.go): canned ACLs
+        # stored on the entry, rendered as AccessControlPolicy XML
+
+        def _acl(self, verb: str, bucket: str, key: str):
+            if key:
+                dir_, _, name = f"{BUCKETS_DIR}/{bucket}/{key}".rpartition("/")
+            else:
+                dir_, name = BUCKETS_DIR, bucket
+            entry = srv.find_entry(dir_, name)
+            if entry is None:
+                raise S3Error(404, "NoSuchKey" if key else "NoSuchBucket",
+                              "not found")
+            if verb in ("GET", "HEAD"):
+                acl = entry.extended.get(ACL_KEY, b"private").decode()
+                root = ET.Element("AccessControlPolicy", xmlns=S3_NS)
+                owner = _el(root, "Owner")
+                _el(owner, "ID", "seaweedfs-tpu")
+                grants = _el(root, "AccessControlList")
+                g = _el(grants, "Grant")
+                ge = _el(g, "Grantee")
+                ge.set("xmlns:xsi", "http://www.w3.org/2001/XMLSchema-instance")
+                ge.set("xsi:type", "CanonicalUser")
+                _el(ge, "ID", "seaweedfs-tpu")
+                _el(g, "Permission", "FULL_CONTROL")
+                if acl in ("public-read", "public-read-write"):
+                    g2 = _el(grants, "Grant")
+                    ge2 = _el(g2, "Grantee")
+                    ge2.set("xmlns:xsi",
+                            "http://www.w3.org/2001/XMLSchema-instance")
+                    ge2.set("xsi:type", "Group")
+                    _el(ge2, "URI",
+                        "http://acs.amazonaws.com/groups/global/AllUsers")
+                    _el(g2, "Permission",
+                        "READ" if acl == "public-read" else "FULL_CONTROL")
+                return self._send(200, _xml_bytes(root))
+            if verb == "PUT":
+                acl = self.headers.get("x-amz-acl", "")
+                if not acl:  # grant-by-XML-body unsupported, like many S3s
+                    raise S3Error(400, "MissingSecurityHeader",
+                                  "x-amz-acl canned header required")
+                if acl not in CANNED_ACLS:
+                    raise S3Error(400, "InvalidArgument",
+                                  f"unsupported canned acl {acl}")
+                entry.extended[ACL_KEY] = acl.encode()
+                srv.stub().UpdateEntry(filer_pb2.UpdateEntryRequest(
+                    directory=dir_, entry=entry), timeout=10)
+                return self._send(200)
+            raise S3Error(405, "MethodNotAllowed", "unsupported acl op")
+
+        # ---- bucket policy (policy/ + s3api_bucket_policy_handlers.go)
+
+        def _policy(self, verb: str, bucket: str):
+            entry = srv.find_entry(BUCKETS_DIR, bucket)
+            if entry is None:
+                raise S3Error(404, "NoSuchBucket", "no such bucket")
+            if verb == "GET":
+                blob = entry.extended.get(POLICY_KEY)
+                if not blob:
+                    raise S3Error(404, "NoSuchBucketPolicy",
+                                  "the bucket policy does not exist")
+                return self._send(200, blob, "application/json")
+            if verb == "PUT":
+                try:
+                    pol = BucketPolicy.parse(self._body())
+                except PolicyError as e:
+                    raise S3Error(400, "MalformedPolicy", str(e))
+                entry.extended[POLICY_KEY] = pol.to_bytes()
+                srv.stub().UpdateEntry(filer_pb2.UpdateEntryRequest(
+                    directory=BUCKETS_DIR, entry=entry), timeout=10)
+                return self._send(204)
+            if verb == "DELETE":
+                if POLICY_KEY in entry.extended:
+                    del entry.extended[POLICY_KEY]
+                    srv.stub().UpdateEntry(filer_pb2.UpdateEntryRequest(
+                        directory=BUCKETS_DIR, entry=entry), timeout=10)
+                return self._send(204)
+            raise S3Error(405, "MethodNotAllowed", "unsupported policy op")
+
+        def _object(self, verb: str, bucket: str, key: str, q,
+                    bucket_entry: filer_pb2.Entry | None = None):
+            if bucket_entry is None:
+                bucket_entry = srv.find_entry(BUCKETS_DIR, bucket)
+            if bucket_entry is None:
                 raise S3Error(404, "NoSuchBucket",
                               "The specified bucket does not exist")
+            if verb in ("PUT", "POST") and \
+                    bucket_entry.extended.get(READONLY_KEY) == b"true":
+                # quota outcome (command_s3_bucket_quota_check): block only
+                # data-adding verbs — DELETE stays allowed so an over-quota
+                # bucket can be drained back under its limit
+                raise S3Error(403, "AccessDenied",
+                              f"bucket {bucket} is read-only (quota)")
+            if "acl" in q:
+                return self._acl(verb, bucket, key)
             if "tagging" in q:
                 return self._tagging(verb, bucket, key)
             if "uploads" in q and verb == "POST":
@@ -444,6 +650,15 @@ def _make_handler(srv: S3Server):
                 body = self._body()
                 etag = srv.put_object(bucket, key, body,
                                       self.headers.get("Content-Type", ""))
+                acl = self.headers.get("x-amz-acl", "")
+                if acl in CANNED_ACLS:
+                    dir_, _, name = \
+                        f"{BUCKETS_DIR}/{bucket}/{key}".rpartition("/")
+                    entry = srv.find_entry(dir_, name)
+                    if entry is not None:
+                        entry.extended[ACL_KEY] = acl.encode()
+                        srv.stub().UpdateEntry(filer_pb2.UpdateEntryRequest(
+                            directory=dir_, entry=entry), timeout=10)
                 return self._send(200, headers={"ETag": f'"{etag}"'})
             if verb in ("GET", "HEAD"):
                 if verb == "HEAD":

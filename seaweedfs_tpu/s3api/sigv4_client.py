@@ -75,3 +75,49 @@ def sign_request(method: str, url: str, payload: bytes, access_key: str,
         f"AWS4-HMAC-SHA256 Credential={access_key}/{scope}, "
         f"SignedHeaders={signed_headers}, Signature={signature}")
     return headers
+
+
+def presign_url(method: str, url: str, access_key: str, secret_key: str,
+                *, expires: int = 3600, region: str = "us-east-1",
+                service: str = "s3",
+                amz_now: time.struct_time | None = None) -> str:
+    """Generate a presigned URL (query-string auth, auth_signature_v4.go's
+    presigned flow): anyone holding the URL can perform `method` until
+    X-Amz-Date + X-Amz-Expires."""
+    u = urllib.parse.urlparse(url)
+    now = amz_now or time.gmtime()
+    amz_date = time.strftime("%Y%m%dT%H%M%SZ", now)
+    date = amz_date[:8]
+    scope = f"{date}/{region}/{service}/aws4_request"
+    qs = dict(urllib.parse.parse_qsl(u.query, keep_blank_values=True))
+    qs.update({
+        "X-Amz-Algorithm": "AWS4-HMAC-SHA256",
+        "X-Amz-Credential": f"{access_key}/{scope}",
+        "X-Amz-Date": amz_date,
+        "X-Amz-Expires": str(expires),
+        "X-Amz-SignedHeaders": "host",
+    })
+    canonical_query = "&".join(f"{_uri_encode(k)}={_uri_encode(v)}"
+                               for k, v in sorted(qs.items()))
+    canonical_request = "\n".join([
+        method,
+        _uri_encode(urllib.parse.unquote(u.path) or "/", keep_slash=True),
+        canonical_query,
+        f"host:{u.netloc}\n",
+        "host",
+        "UNSIGNED-PAYLOAD",
+    ])
+    string_to_sign = "\n".join([
+        "AWS4-HMAC-SHA256", amz_date, scope,
+        hashlib.sha256(canonical_request.encode()).hexdigest()])
+
+    def h(key: bytes, msg: str) -> bytes:
+        return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+    k = h(("AWS4" + secret_key).encode(), date)
+    k = h(k, region)
+    k = h(k, service)
+    k = h(k, "aws4_request")
+    sig = hmac.new(k, string_to_sign.encode(), hashlib.sha256).hexdigest()
+    qs["X-Amz-Signature"] = sig
+    return u._replace(query=urllib.parse.urlencode(qs)).geturl()

@@ -94,6 +94,171 @@ def s3_configure(env, args, out):
     print(f"configured {len(identities)} identities", file=out)
 
 
+@command("s3.bucket.quota",
+         "s3.bucket.quota -name=<bucket> [-sizeMB=N | -disable]")
+def s3_bucket_quota(env, args, out):
+    """Set/clear a bucket quota (command_s3_bucket_quota.go): stored as the
+    bucket entry's quota field; s3.bucket.quota.check enforces it."""
+    opts = _kv(args)
+    name = opts["name"]
+    stub = _stub(env)
+    resp = stub.LookupDirectoryEntry(filer_pb2.LookupDirectoryEntryRequest(
+        directory=BUCKETS_DIR, name=name), timeout=10)
+    entry = resp.entry
+    if not entry.name:
+        raise RuntimeError(f"no such bucket {name}")
+    if "disable" in opts:
+        entry.quota = -abs(entry.quota) if entry.quota else 0
+    else:
+        entry.quota = int(opts.get("sizeMB", "0")) << 20
+    stub.UpdateEntry(filer_pb2.UpdateEntryRequest(
+        directory=BUCKETS_DIR, entry=entry), timeout=10)
+    print(f"bucket {name} quota = {entry.quota} bytes", file=out)
+
+
+@command("s3.bucket.quota.check",
+         "s3.bucket.quota.check [-apply]  (toggle read-only on over-quota)")
+def s3_bucket_quota_check(env, args, out):
+    """Enforce quotas (command_s3_bucket_quota_check.go): walk each bucket,
+    compare usage to quota, and with -apply flip the bucket's read-only
+    marker that the S3 gateway checks on writes."""
+    from ...s3api.server import READONLY_KEY
+
+    opts = _kv(args)
+    apply = "apply" in opts
+    stub = _stub(env)
+
+    def tree_size(d: str) -> int:
+        total = 0
+        for r in stub.ListEntries(filer_pb2.ListEntriesRequest(
+                directory=d, limit=100000)):
+            e = r.entry
+            if e.is_directory:
+                total += tree_size(f"{d}/{e.name}")
+            else:
+                total += max(e.attributes.file_size,
+                             sum(c.size for c in e.chunks), len(e.content))
+        return total
+
+    for r in stub.ListEntries(filer_pb2.ListEntriesRequest(
+            directory=BUCKETS_DIR, limit=10000)):
+        entry = r.entry
+        if not entry.is_directory or entry.name.startswith("."):
+            continue
+        readonly = entry.extended.get(READONLY_KEY) == b"true"
+        if entry.quota <= 0:
+            want_ro = False
+        else:
+            used = tree_size(f"{BUCKETS_DIR}/{entry.name}")
+            want_ro = used > entry.quota
+            pct = 100.0 * used / entry.quota
+            print(f"  {entry.name}\tused={used}\tquota={entry.quota}"
+                  f"\t{pct:.1f}%", file=out)
+        if want_ro != readonly:
+            state = "read-only" if want_ro else "writable"
+            if apply:
+                if want_ro:
+                    entry.extended[READONLY_KEY] = b"true"
+                else:
+                    entry.extended.pop(READONLY_KEY, None)
+                stub.UpdateEntry(filer_pb2.UpdateEntryRequest(
+                    directory=BUCKETS_DIR, entry=entry), timeout=10)
+                print(f"    bucket {entry.name} -> {state}", file=out)
+            else:
+                print(f"    would set bucket {entry.name} -> {state} "
+                      f"(rerun with -apply)", file=out)
+
+
+@command("s3.circuitbreaker",
+         "s3.circuitbreaker [-global|-buckets=b1,b2] [-enable|-disable] "
+         "[-actions=Read:Count=100,Write:MB=64] [-delete] [-apply]")
+def s3_circuitbreaker(env, args, out):
+    """Edit /etc/s3/circuit_breaker.json (command_s3_circuitbreaker.go);
+    the gateway hot-reloads it within its poll interval."""
+    from ...s3api.circuit_breaker import CB_CONFIG_DIR, CB_CONFIG_FILE
+
+    opts = _kv(args)
+    stub = _stub(env)
+    conf = {"global": {"enabled": False, "actions": {}}, "buckets": {}}
+    try:
+        resp = stub.LookupDirectoryEntry(filer_pb2.LookupDirectoryEntryRequest(
+            directory=CB_CONFIG_DIR, name=CB_CONFIG_FILE), timeout=10)
+        if resp.entry.content:
+            conf = json.loads(resp.entry.content)
+    except Exception:
+        pass
+
+    if "delete" in opts:
+        conf = {"global": {"enabled": False, "actions": {}}, "buckets": {}}
+    else:
+        actions = {}
+        for pair in filter(None, opts.get("actions", "").split(",")):
+            k, _, v = pair.partition("=")
+            actions[k] = int(v)
+        targets = []
+        if "buckets" in opts:
+            for b in filter(None, opts["buckets"].split(",")):
+                node = conf.setdefault("buckets", {}).setdefault(
+                    b, {"enabled": True, "actions": {}})
+                targets.append(node)
+        else:
+            targets.append(conf.setdefault("global",
+                                           {"enabled": False, "actions": {}}))
+        for node in targets:
+            if "enable" in opts:
+                node["enabled"] = True
+            if "disable" in opts:
+                node["enabled"] = False
+            if actions:
+                node.setdefault("actions", {}).update(actions)
+
+    if "apply" in opts:
+        entry = filer_pb2.Entry(name=CB_CONFIG_FILE,
+                                content=json.dumps(conf, indent=2).encode())
+        entry.attributes.file_mode = 0o600
+        entry.attributes.mtime = int(time.time())
+        stub.CreateEntry(filer_pb2.CreateEntryRequest(
+            directory=CB_CONFIG_DIR, entry=entry), timeout=10)
+        print("applied:", file=out)
+    print(json.dumps(conf, indent=2), file=out)
+
+
+@command("s3.clean.uploads",
+         "s3.clean.uploads [-timeAgo=24h]  (abort stale multipart uploads)")
+def s3_clean_uploads(env, args, out):
+    """Drop multipart upload scratch dirs older than the cutoff
+    (command_s3_clean_uploads.go)."""
+    opts = _kv(args)
+    spec = opts.get("timeAgo", "24h") or "24h"
+    units = {"s": 1, "m": 60, "h": 3600, "d": 86400}
+    mult = units.get(spec[-1], 3600)
+    age = float(spec[:-1] if spec[-1] in units else spec) * mult
+    cutoff = time.time() - age
+    stub = _stub(env)
+    uploads_dir = f"{BUCKETS_DIR}/.uploads"
+    import grpc
+
+    removed = 0
+    try:
+        entries = list(stub.ListEntries(filer_pb2.ListEntriesRequest(
+            directory=uploads_dir, limit=10000)))
+    except grpc.RpcError as e:
+        if e.code() == grpc.StatusCode.NOT_FOUND:
+            entries = []
+        else:
+            raise
+    for r in entries:
+        e = r.entry
+        ts = e.attributes.crtime or e.attributes.mtime
+        if ts and ts < cutoff:
+            stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
+                directory=uploads_dir, name=e.name, is_delete_data=True,
+                is_recursive=True), timeout=30)
+            print(f"aborted upload {e.name}", file=out)
+            removed += 1
+    print(f"removed {removed} stale uploads", file=out)
+
+
 @command("mq.topic.list", "list message-queue topics persisted in the filer")
 def mq_topic_list(env, args, out):
     stub = _stub(env)
