@@ -195,6 +195,9 @@ def main(argv=None) -> int:
     mnt.add_argument("-collection", default="")
     mnt.add_argument("-replication", default="")
     mnt.add_argument("-cacheDir", default="")
+    mnt.add_argument("-memoryLimitMB", type=int, default=64,
+                     help="dirty-page memory budget; excess spills to a "
+                          "swap file under -cacheDir")
     mnt.add_argument("-localPort", type=int, default=0,
                      help="localhost gRPC control port (mount.configure)")
 
@@ -683,7 +686,8 @@ complete -F _weed_tpu weed-tpu""")
         wfs = WFS(rpc.grpc_address(opts.filer),
                   chunk_size=opts.chunkSizeLimitMB * 1024 * 1024,
                   collection=opts.collection, replication=opts.replication,
-                  cache_dir=opts.cacheDir or None)
+                  cache_dir=opts.cacheDir or None,
+                  memory_limit_mb=opts.memoryLimitMB)
         control = None
         if opts.localPort:
             from ..mount.control import MountControlServer

@@ -1,15 +1,24 @@
 """Dirty-page chunked writer + upload pipeline for the mount layer.
 
 Rebuild of /root/reference/weed/mount/page_writer/ (upload_pipeline.go:42
-UploadPipeline, page_chunk_mem.go MemChunk, chunk_interval_list.go) and
+UploadPipeline, page_chunk_mem.go MemChunk, page_chunk_swapfile.go
+SwapFile/SwapFileChunk, chunk_interval_list.go) and
 dirty_pages_chunked.go: writes land in fixed-size memory chunks addressed
 by logical chunk index; a chunk that becomes fully written is sealed and
 uploaded in the background; flush seals everything and waits. Reads that
 hit dirty pages are served from memory until the upload completes.
+
+Memory pressure: the pipeline holds at most `memory_chunk_limit` chunks in
+RAM (writable + sealed-awaiting-upload). Past that, new chunks spill to a
+shared swap file on disk — slot-allocated, slots recycled after upload —
+so a writer streaming faster than uploads drain cannot balloon the mount's
+memory (the reference's swapFileDir behavior under -memoryMapSizeMB).
 """
 
 from __future__ import annotations
 
+import os
+import tempfile
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 from dataclasses import dataclass, field
@@ -54,6 +63,9 @@ class MemChunk:
                 out.append([iv.start, iv.stop])
         return [(s, e) for s, e in out]
 
+    def read_interval(self, start: int, stop: int) -> bytes:
+        return bytes(self.buf[start:stop])
+
     def read_at(self, buf: memoryview, chunk_off: int, min_ts_ns: int = 0
                 ) -> list[tuple[int, int]]:
         """Copy written bytes overlapping [chunk_off, chunk_off+len(buf))
@@ -66,9 +78,120 @@ class MemChunk:
             e = min(iv.stop, chunk_off + len(buf))
             if s >= e:
                 continue
-            buf[s - chunk_off:e - chunk_off] = self.buf[s:e]
+            buf[s - chunk_off:e - chunk_off] = self.read_interval(s, e)
             covered.append((s - chunk_off, e - chunk_off))
         return covered
+
+
+class SwapFile:
+    """Slot-allocated scratch file shared by one pipeline's spilled chunks
+    (page_chunk_swapfile.go SwapFile: ActualFileToChunkIndex reuse)."""
+
+    def __init__(self, directory: str | None, chunk_size: int):
+        self.chunk_size = chunk_size
+        fd, self.path = tempfile.mkstemp(prefix="swfs-swap-", dir=directory)
+        self._f = os.fdopen(fd, "r+b")
+        # unlink immediately: the fd keeps it alive, crash leaves no litter
+        os.unlink(self.path)
+        self._free: list[int] = []
+        self._next = 0
+        self._lock = threading.Lock()
+
+    def assign_slot(self) -> int:
+        with self._lock:
+            if self._free:
+                return self._free.pop()
+            slot = self._next
+            self._next += 1
+            return slot
+
+    def free_slot(self, slot: int) -> None:
+        with self._lock:
+            self._free.append(slot)
+
+    def pwrite(self, slot: int, off: int, data: bytes) -> None:
+        os.pwrite(self._f.fileno(), data, slot * self.chunk_size + off)
+
+    def pread(self, slot: int, off: int, n: int) -> bytes:
+        return os.pread(self._f.fileno(), n, slot * self.chunk_size + off)
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except OSError:
+            pass
+
+
+class SwapFileChunk:
+    """MemChunk twin backed by a swap-file slot (page_chunk_swapfile.go
+    SwapFileChunk): same interface, bytes live on disk. The slot is only
+    recycled once released AND no read holds it (the reference's
+    activityScore/FreeResource accounting) — otherwise an in-flight dirty
+    read could pread a slot already reused by another chunk."""
+
+    def __init__(self, swap: SwapFile, logic_index: int, chunk_size: int):
+        self.swap = swap
+        self.slot = swap.assign_slot()
+        self.logic_index = logic_index
+        self.chunk_size = chunk_size
+        self.intervals: list[WrittenInterval] = []
+        self._ref_lock = threading.Lock()
+        self._reads = 0
+        self._released = False
+
+    def write(self, data: bytes, off_in_chunk: int, ts_ns: int) -> None:
+        self.swap.pwrite(self.slot, off_in_chunk, data)
+        self.intervals.append(
+            WrittenInterval(off_in_chunk, off_in_chunk + len(data), ts_ns))
+
+    written_size = MemChunk.written_size
+    is_complete = MemChunk.is_complete
+    continuous_intervals = MemChunk.continuous_intervals
+    read_at = MemChunk.read_at
+
+    def read_interval(self, start: int, stop: int) -> bytes:
+        return self.swap.pread(self.slot, start, stop - start)
+
+    def begin_read(self) -> None:
+        with self._ref_lock:
+            self._reads += 1
+
+    def end_read(self) -> None:
+        with self._ref_lock:
+            self._reads -= 1
+            free = self._released and self._reads == 0
+        if free:
+            self.swap.free_slot(self.slot)
+
+    def release(self) -> None:
+        with self._ref_lock:
+            if self._released:
+                return
+            self._released = True
+            free = self._reads == 0
+        if free:
+            self.swap.free_slot(self.slot)
+
+
+class MemBudget:
+    """Mount-wide cap on in-memory dirty chunks, shared by every open
+    file's pipeline (one 64MB budget for the whole mount, not per handle)."""
+
+    def __init__(self, limit_chunks: int):
+        self.limit = max(1, limit_chunks)
+        self._held = 0
+        self._lock = threading.Lock()
+
+    def try_take(self) -> bool:
+        with self._lock:
+            if self._held >= self.limit:
+                return False
+            self._held += 1
+            return True
+
+    def give_back(self) -> None:
+        with self._lock:
+            self._held -= 1
 
 
 class UploadPipeline:
@@ -80,16 +203,32 @@ class UploadPipeline:
     responsible for uploading and recording the resulting FileChunk.
     """
 
-    def __init__(self, chunk_size: int, save_fn, *, concurrency: int = 8):
+    def __init__(self, chunk_size: int, save_fn, *, concurrency: int = 8,
+                 memory_chunk_limit: int = 16, swap_dir: str | None = None,
+                 budget: MemBudget | None = None):
         self.chunk_size = chunk_size
         self.save_fn = save_fn
+        # `budget` (normally the mount-wide one from WFS) wins; the
+        # per-pipeline limit is the standalone/test fallback
+        self.budget = budget or MemBudget(memory_chunk_limit)
+        self._swap_dir = swap_dir
+        self._swap: SwapFile | None = None  # created on first spill
+        self.swapped_out = 0  # chunks ever spilled (observability/tests)
         self._lock = threading.Lock()
-        self._writable: dict[int, MemChunk] = {}
-        self._sealed: dict[int, MemChunk] = {}   # kept for reads in flight
+        self._writable: dict[int, MemChunk | SwapFileChunk] = {}
+        self._sealed: dict[int, MemChunk | SwapFileChunk] = {}
         self._futures: list[Future] = []
         self._pool = ThreadPoolExecutor(max_workers=concurrency,
                                         thread_name_prefix="page-upload")
         self.last_err: Exception | None = None
+
+    def _new_chunk_locked(self, logic: int):
+        if not self.budget.try_take():
+            if self._swap is None:
+                self._swap = SwapFile(self._swap_dir, self.chunk_size)
+            self.swapped_out += 1
+            return SwapFileChunk(self._swap, logic, self.chunk_size)
+        return MemChunk(logic, self.chunk_size)
 
     # -- write path --------------------------------------------------------
 
@@ -103,7 +242,7 @@ class UploadPipeline:
             with self._lock:
                 chunk = self._writable.get(logic)
                 if chunk is None:
-                    chunk = MemChunk(logic, self.chunk_size)
+                    chunk = self._new_chunk_locked(logic)
                     self._writable[logic] = chunk
                 chunk.write(data[pos:pos + take], in_chunk, ts_ns)
                 if chunk.is_complete():
@@ -118,19 +257,29 @@ class UploadPipeline:
         fut = self._pool.submit(self._upload, chunk)
         self._futures.append(fut)
 
-    def _upload(self, chunk: MemChunk) -> None:
+    def _upload(self, chunk: MemChunk | SwapFileChunk) -> None:
         base = chunk.logic_index * self.chunk_size
         try:
             for s, e in chunk.continuous_intervals():
                 ts = max((iv.ts_ns for iv in chunk.intervals
                           if iv.start < e and iv.stop > s), default=0)
-                self.save_fn(bytes(chunk.buf[s:e]), base + s, ts)
+                if isinstance(chunk, SwapFileChunk):
+                    payload = chunk.read_interval(s, e)
+                else:
+                    payload = bytes(chunk.buf[s:e])
+                self.save_fn(payload, base + s, ts)
         except Exception as err:  # surfaced on flush
             self.last_err = err
         finally:
             with self._lock:
-                if self._sealed.get(chunk.logic_index) is chunk:
+                mine = self._sealed.get(chunk.logic_index) is chunk
+                if mine:
                     del self._sealed[chunk.logic_index]
+            if mine:  # close() may have already reclaimed it
+                if isinstance(chunk, SwapFileChunk):
+                    chunk.release()  # recycle the slot once no read holds it
+                else:
+                    self.budget.give_back()
 
     # -- read-your-writes --------------------------------------------------
 
@@ -149,9 +298,22 @@ class UploadPipeline:
                 chunks = [c for c in (self._sealed.get(logic),
                                       self._writable.get(logic))
                           if c is not None]
-            for c in chunks:
-                for s, e in c.read_at(buf[pos:pos + take], in_chunk):
-                    covered.append((pos + s, pos + e))
+                # pin swap slots while still under the pipeline lock: the
+                # uploader removes a chunk from these dicts (under this
+                # lock) strictly before releasing its slot, so a chunk
+                # found here is either pinned in time or release defers
+                # the slot free until end_read
+                for c in chunks:
+                    if isinstance(c, SwapFileChunk):
+                        c.begin_read()
+            try:
+                for c in chunks:
+                    for s, e in c.read_at(buf[pos:pos + take], in_chunk):
+                        covered.append((pos + s, pos + e))
+            finally:
+                for c in chunks:
+                    if isinstance(c, SwapFileChunk):
+                        c.end_read()
             pos += take
         covered.sort()
         merged: list[list[int]] = []
@@ -194,3 +356,16 @@ class UploadPipeline:
 
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
+        # return budget / slots still held by unflushed or cancelled chunks
+        with self._lock:
+            leftovers = [c for group in (self._writable, self._sealed)
+                         for c in group.values()]
+            self._writable.clear()
+            self._sealed.clear()
+        for c in leftovers:
+            if isinstance(c, SwapFileChunk):
+                c.release()
+            else:
+                self.budget.give_back()
+        if self._swap is not None:
+            self._swap.close()

@@ -59,7 +59,8 @@ class FileHandle:
         self._lock = threading.Lock()
         self.pages = UploadPipeline(
             wfs.chunk_size, self._save_interval,
-            concurrency=wfs.upload_concurrency)
+            concurrency=wfs.upload_concurrency,
+            budget=wfs.mem_budget, swap_dir=wfs.swap_dir)
 
     def _save_interval(self, data: bytes, offset: int, ts_ns: int) -> None:
         chunk = self.wfs.save_data_as_chunk(data, self.entry.full_path)
@@ -79,6 +80,7 @@ class WFS:
                  disk_type: str = "", data_center: str = "",
                  upload_concurrency: int = 8,
                  cache_dir: str | None = None,
+                 memory_limit_mb: int = 64,
                  subscribe: bool = True):
         self.filer_address = filer_grpc_address
         self.stub = rpc.filer_stub(filer_grpc_address)
@@ -88,6 +90,14 @@ class WFS:
         self.disk_type = disk_type
         self.data_center = data_center
         self.upload_concurrency = upload_concurrency
+        # mount-wide dirty-page budget shared by every open handle; past
+        # it, new chunks spill to per-handle swap files
+        # (page_chunk_swapfile.go; -memoryLimitMB on the mount CLI)
+        from .page_writer import MemBudget
+
+        self.mem_budget = MemBudget(
+            max(1, (memory_limit_mb << 20) // max(chunk_size, 1)))
+        self.swap_dir = cache_dir
         self.collection_capacity = 0  # bytes; set via SeaweedMount.Configure
         self._quota_checked_at = 0.0
         self._quota_over = False

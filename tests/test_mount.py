@@ -78,9 +78,13 @@ def test_mem_chunk_intervals_and_read():
 
 
 def test_upload_pipeline_seal_flush_and_read_back():
+    import threading
+
     saved = []
+    gate = threading.Event()  # hold uploads until the dirty read below ran
 
     def save(data, offset, ts):
+        gate.wait(10)
         saved.append((offset, data))
 
     p = UploadPipeline(16, save, concurrency=2)
@@ -91,6 +95,7 @@ def test_upload_pipeline_seal_flush_and_read_back():
     covered = p.maybe_read_data_at(buf, 14)
     assert covered and covered[0] == (0, 7)
     assert bytes(buf[:7]) == b"xxyyyyy"
+    gate.set()
     p.flush()
     # the 3-byte write at 30 straddles the chunk-1/chunk-2 boundary
     assert sorted(saved) == [(0, b"x" * 16), (16, b"y" * 5),
@@ -110,6 +115,89 @@ def test_upload_pipeline_overlapping_writes_latest_wins():
     p.flush()
     assert saved[0] == b"aaaBBBBaaa"
     p.close()
+
+
+def test_upload_pipeline_spills_to_swapfile(tmp_path):
+    """Writing faster than uploads drain must spill past the memory budget
+    (page_chunk_swapfile.go): bytes stay correct, reads-before-flush serve
+    from the swap file, slots recycle."""
+    import threading
+
+    gate = threading.Event()
+    saved = {}
+
+    def slow_save(data, offset, ts):
+        gate.wait(10)  # hold uploads so sealed chunks pile up
+        saved[offset] = data
+
+    chunk = 1 << 10
+    p = UploadPipeline(chunk, slow_save, concurrency=2,
+                       memory_chunk_limit=2, swap_dir=str(tmp_path))
+    blobs = {}
+    for i in range(12):  # 12 chunks against a 2-chunk memory budget
+        blob = bytes([65 + i]) * chunk
+        blobs[i * chunk] = blob
+        p.save_data_at(blob, i * chunk, i + 1)
+    assert p.swapped_out >= 10, p.swapped_out
+
+    # read-your-writes straight out of the swap file
+    buf = memoryview(bytearray(chunk))
+    covered = p.maybe_read_data_at(buf, 5 * chunk)
+    assert covered == [(0, chunk)]
+    assert bytes(buf) == blobs[5 * chunk]
+
+    gate.set()
+    p.flush()
+    assert saved == blobs
+    # slots are recycled once uploads complete
+    assert p._swap is not None and len(p._swap._free) > 0
+    p.close()
+
+
+def test_upload_pipeline_partial_chunks_spill(tmp_path):
+    """Partial (non-contiguous) writes in spilled chunks keep interval
+    bookkeeping intact through flush."""
+    saved = {}
+    p = UploadPipeline(100, lambda d, o, t: saved.__setitem__(o, d),
+                       concurrency=1, memory_chunk_limit=1,
+                       swap_dir=str(tmp_path))
+    p.save_data_at(b"m" * 100, 0, 1)      # fills chunk 0 (mem, sealed)
+    p.save_data_at(b"a" * 10, 100, 2)     # chunk 1 partial
+    p.save_data_at(b"b" * 10, 150, 3)     # chunk 1, disjoint interval
+    p.save_data_at(b"c" * 7, 260, 4)      # chunk 2 partial
+    p.flush()
+    assert saved[0] == b"m" * 100
+    assert saved[100] == b"a" * 10 and saved[150] == b"b" * 10
+    assert saved[260] == b"c" * 7
+    p.close()
+
+
+def test_mem_budget_shared_across_pipelines(tmp_path):
+    """One mount-wide budget: a second handle's chunks spill once other
+    handles hold the memory (not a per-handle 64MB each)."""
+    from seaweedfs_tpu.mount.page_writer import MemBudget
+
+    budget = MemBudget(2)
+    saved = {}
+
+    def save(d, o, t):
+        saved[o] = d
+
+    p1 = UploadPipeline(100, save, concurrency=1, budget=budget,
+                        swap_dir=str(tmp_path))
+    p2 = UploadPipeline(100, save, concurrency=1, budget=budget,
+                        swap_dir=str(tmp_path))
+    p1.save_data_at(b"a" * 10, 0, 1)      # mem (partial: stays writable)
+    p1.save_data_at(b"b" * 10, 100, 2)    # mem — budget now exhausted
+    p2.save_data_at(b"c" * 10, 0, 3)      # must spill
+    assert p2.swapped_out == 1
+    p1.flush()
+    p2.flush()
+    assert set(saved) == {0, 100}  # both pipelines uploaded (0 twice)
+    p1.close()
+    p2.close()
+    # budget fully returned after close
+    assert budget.try_take() and budget.try_take()
 
 
 # -- live cluster ----------------------------------------------------------
@@ -172,6 +260,39 @@ def test_wfs_multi_chunk_file(wfs):
     assert wfs.read(fh, 0, len(payload)) == payload
     assert wfs.read(fh, 40_000, 1000) == payload[40_000:41_000]
     wfs.release(fh)
+
+
+def test_wfs_write_past_memory_budget_spills(wfs, tmp_path):
+    """End-to-end: a file larger than the pipeline's memory budget goes
+    through the swap file and still reads back byte-identical."""
+    from seaweedfs_tpu.mount.page_writer import MemBudget
+
+    saved_budget = wfs.mem_budget
+    wfs.mem_budget = MemBudget(2)  # 2 x 32KB mount-wide budget
+    try:
+        rng = np.random.default_rng(42)
+        payload = rng.integers(0, 256, size=10 * 32 * 1024,
+                               dtype=np.uint8).tobytes()
+        dino, _ = wfs.mkdir(ROOT_INODE, "spill")
+        ino, _, fh = wfs.create(dino, "big.bin", 0o644)
+        chunk = 32 * 1024
+        # touch every chunk first so 10 partial chunks coexist (8 must
+        # spill), then fill them
+        for i in range(10):
+            wfs.write(fh, i * chunk, payload[i * chunk:i * chunk + 1])
+        h = wfs._handle(fh)
+        assert h.pages.swapped_out >= 8, h.pages.swapped_out
+        for i in reversed(range(10)):
+            wfs.write(fh, i * chunk, payload[i * chunk:(i + 1) * chunk])
+        # dirty reads hit the swap-backed pages
+        assert wfs.read(fh, 3 * chunk, 100) == payload[3 * chunk:3 * chunk + 100]
+        wfs.flush(fh)
+        wfs.release(fh)
+        fh2 = wfs.open(ino)
+        assert wfs.read(fh2, 0, len(payload)) == payload
+        wfs.release(fh2)
+    finally:
+        wfs.mem_budget = saved_budget
 
 
 def test_wfs_readdir_rename_unlink(wfs):
