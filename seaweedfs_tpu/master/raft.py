@@ -109,6 +109,7 @@ class RaftNode:
         self.last_applied = 0
         self.role = FOLLOWER
         self.leader_id: str | None = None
+        self._removed = False  # dropped from membership by a config entry
 
         self._next_index: dict[str, int] = {}
         self._match_index: dict[str, int] = {}
@@ -146,6 +147,7 @@ class RaftNode:
             "snapshot_index": self.snapshot_index,
             "snapshot_term": self.snapshot_term,
             "snapshot": snap,
+            "peers": list(self.peers),  # survives config-entry compaction
             "log": [{"term": e.term, "index": e.index,
                      "command": e.command} for e in self.log],
         }
@@ -166,6 +168,8 @@ class RaftNode:
         self.snapshot_term = blob.get("snapshot_term", 0)
         self.log = [LogEntry(e["term"], e["index"], e["command"])
                     for e in blob["log"]]
+        if blob.get("peers") is not None:
+            self.peers = [p for p in blob["peers"] if p != self.node_id]
         if blob.get("snapshot") is not None and self.restore_fn:
             self.restore_fn(blob["snapshot"])
             self.commit_index = self.last_applied = self.snapshot_index
@@ -175,7 +179,10 @@ class RaftNode:
         durable_commit = blob.get("commit_index", self.snapshot_index)
         for e in self.log:
             if self.last_applied < e.index <= durable_commit:
-                self.apply_fn(e.command)
+                if e.command.get("op") == "raft_config":
+                    self._apply_config(e.command)
+                else:
+                    self.apply_fn(e.command)
                 self.commit_index = self.last_applied = e.index
 
     def compact(self) -> None:
@@ -246,7 +253,8 @@ class RaftNode:
                 self._broadcast_append()
                 self._stop.wait(self.HEARTBEAT)
             else:
-                if time.monotonic() >= self._election_deadline:
+                if time.monotonic() >= self._election_deadline \
+                        and not self._removed:
                     self._run_election()
                 self._stop.wait(0.02)
 
@@ -303,8 +311,17 @@ class RaftNode:
 
     # -- RPC handlers ------------------------------------------------------
 
+    def _is_member(self, node: str) -> bool:
+        return node == self.node_id or node in self.peers
+
     def handle_request_vote(self, p: dict) -> dict:
         with self._mu:
+            # a server removed from the cluster must not be able to win —
+            # or even disturb — elections (its campaigns would otherwise
+            # inflate terms and depose the live leader forever). Don't
+            # adopt its term either.
+            if not self._is_member(p["candidate"]):
+                return {"term": self.term, "granted": False}
             if p["term"] < self.term:
                 return {"term": self.term, "granted": False}
             if p["term"] > self.term:
@@ -320,6 +337,10 @@ class RaftNode:
 
     def handle_append_entries(self, p: dict) -> dict:
         with self._mu:
+            if not self._is_member(p["leader"]):
+                # heartbeats from a removed ex-leader must not reset our
+                # election timer or drag our term around
+                return {"term": self.term, "success": False}
             if p["term"] < self.term:
                 return {"term": self.term, "success": False}
             if p["term"] > self.term or self.role != FOLLOWER:
@@ -488,9 +509,33 @@ class RaftNode:
         while self.last_applied < self.commit_index:
             self.last_applied += 1
             e = self._entry_at(self.last_applied)
-            if e is not None:
+            if e is None:
+                continue
+            if e.command.get("op") == "raft_config":
+                self._apply_config(e.command)
+            else:
                 self.apply_fn(e.command)
         self._commit_cv.notify_all()
+
+    def _apply_config(self, cmd: dict) -> None:
+        """Replicated single-step membership change (cluster_commands.go /
+        raft AddVoter-RemoveServer, without joint consensus — adequate for
+        one-at-a-time add/remove, which is all the shell exposes)."""
+        members = list(cmd.get("peers", []))
+        if self.node_id not in members:
+            # we were removed: stop participating (members refuse our
+            # votes/appends; _removed stops our own campaigning). Re-joining
+            # requires a restart with the current member list + raft.add.
+            self.peers = []
+            self._removed = True
+            if self.role == LEADER:
+                self.role = FOLLOWER
+                self.leader_id = None
+            return
+        self.peers = [p for p in members if p != self.node_id]
+        for p in self.peers:
+            self._next_index.setdefault(p, self._last_index() + 1)
+            self._match_index.setdefault(p, 0)
 
     # -- client API --------------------------------------------------------
 
@@ -518,6 +563,24 @@ class RaftNode:
             if committed is None or committed.term != entry.term:
                 raise NotLeader(self.leader_id)
         return entry.index
+
+    def add_peer(self, peer_id: str, timeout: float = 5.0) -> None:
+        """Commit a config entry adding `peer_id` as a voter."""
+        with self._mu:
+            if self.role != LEADER:
+                raise NotLeader(self.leader_id)
+            members = {self.node_id, peer_id, *self.peers}
+        self.propose({"op": "raft_config", "peers": sorted(members)},
+                     timeout=timeout)
+
+    def remove_peer(self, peer_id: str, timeout: float = 5.0) -> None:
+        """Commit a config entry removing `peer_id` from the cluster."""
+        with self._mu:
+            if self.role != LEADER:
+                raise NotLeader(self.leader_id)
+            members = {self.node_id, *self.peers} - {peer_id}
+        self.propose({"op": "raft_config", "peers": sorted(members)},
+                     timeout=timeout)
 
     def status(self) -> dict:
         with self._mu:

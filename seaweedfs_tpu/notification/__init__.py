@@ -108,5 +108,23 @@ def load_configuration(config: dict) -> MessageQueue | None:
             if q is None:
                 raise KeyError(f"unknown notification queue {name!r}")
             q.initialize(sub)
+            set_active(q)
             return q
     return None
+
+
+_active: MessageQueue | None = None
+
+
+def set_active(q: MessageQueue | None) -> None:
+    """Record the process's configured publisher (filer startup /
+    fs.configure set this; fs.meta.notify reads it)."""
+    global _active
+    _active = q
+
+
+def current_queue(default: str = "") -> MessageQueue | None:
+    """The active publisher, or a named registered one as fallback."""
+    if _active is not None:
+        return _active
+    return QUEUES.get(default) if default else None

@@ -66,6 +66,9 @@ class MasterServer:
         self.grpc_port = rpc.derived_grpc_port(port)
         self.default_replication = default_replication
         self.garbage_threshold = garbage_threshold
+        # volume.vacuum.disable pauses the periodic driver (the reference's
+        # Topology.isDisableVacuum); manual /vol/vacuum still works
+        self.vacuum_disabled = False
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds,
@@ -278,6 +281,8 @@ class MasterServer:
 
     def _vacuum_loop(self, interval: float) -> None:
         while not self._stop.wait(interval):
+            if self.vacuum_disabled:
+                continue
             try:
                 self.vacuum_once(self.garbage_threshold)
             except Exception as e:  # noqa: BLE001 - keep the driver alive
@@ -642,6 +647,32 @@ def _make_http_handler(ms: MasterServer):
             if u.path == "/vol/vacuum":
                 n = ms.vacuum_once(float(q.get("garbageThreshold", 0.0001)))
                 return self._json({"vacuumed": n})
+            if u.path == "/vol/vacuum/disable":
+                ms.vacuum_disabled = True
+                return self._json({"vacuum": "disabled"})
+            if u.path == "/vol/vacuum/enable":
+                ms.vacuum_disabled = False
+                return self._json({"vacuum": "enabled"})
+            if u.path == "/cluster/raft/add":
+                if ms.raft is None:
+                    return self._json({"error": "raft not enabled"}, 400)
+                try:
+                    ms.raft.add_peer(q["id"])
+                except KeyError:
+                    return self._json({"error": "id required"}, 400)
+                except Exception as e:
+                    return self._json({"error": str(e)}, 400)
+                return self._json(ms.raft.status())
+            if u.path == "/cluster/raft/remove":
+                if ms.raft is None:
+                    return self._json({"error": "raft not enabled"}, 400)
+                try:
+                    ms.raft.remove_peer(q["id"])
+                except KeyError:
+                    return self._json({"error": "id required"}, 400)
+                except Exception as e:
+                    return self._json({"error": str(e)}, 400)
+                return self._json(ms.raft.status())
             if u.path == "/col/delete":
                 return self._json({"error": "use gRPC CollectionDelete"}, 400)
             if u.path == "/metrics":

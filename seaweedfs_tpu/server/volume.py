@@ -499,7 +499,7 @@ class VolumeGrpc:
         src = rpc.volume_stub(rpc.grpc_address(request.source_data_node))
         status = src.ReadVolumeFileStatus(
             vs.ReadVolumeFileStatusRequest(volume_id=vid), timeout=30)
-        loc = self.store._pick_location()
+        loc = self.store._pick_location(request.disk_type or None)
         base = loc.base_name(status.collection, vid)
         total = 0
         for ext in (".dat", ".idx"):

@@ -89,3 +89,42 @@ def cluster_raft_leader(env, args, out):
     st = requests.get(f"http://{env.master}/cluster/raft/status",
                       timeout=5).json()
     print(st.get("leader", env.master), file=out)
+
+
+def _raft_leader_addr(env) -> str:
+    import requests
+
+    st = requests.get(f"http://{env.master}/cluster/raft/status",
+                      timeout=5).json()
+    return st.get("leader") or env.master
+
+
+def _raft_member_op(env, args, out, op: str) -> None:
+    import requests
+
+    opts = {k: v for k, v in (a[1:].split("=", 1) for a in args
+                              if a.startswith("-") and "=" in a)}
+    if "id" not in opts:
+        raise RuntimeError(f"usage: cluster.raft.{op} -id=<master-address>")
+    leader = _raft_leader_addr(env)
+    r = requests.get(f"http://{leader}/cluster/raft/{op}",
+                     params={"id": opts["id"]}, timeout=10).json()
+    if "error" in r:
+        raise RuntimeError(r["error"])
+    verb = "added" if op == "add" else "removed"
+    print(f"{verb} {opts['id']}; members: "
+          f"{sorted([r['id'], *r.get('peers', [])])}", file=out)
+
+
+@command("cluster.raft.add", "cluster.raft.add -id=<master-address>")
+def cluster_raft_add(env, args, out):
+    """command_cluster_raft_add.go: add a voter to the master Raft group
+    (the new master should be started with matching -peers)."""
+    _raft_member_op(env, args, out, "add")
+
+
+@command("cluster.raft.remove", "cluster.raft.remove -id=<master-address>")
+def cluster_raft_remove(env, args, out):
+    """command_cluster_raft_remove.go: remove a server from the master
+    Raft group."""
+    _raft_member_op(env, args, out, "remove")

@@ -323,3 +323,155 @@ def mount_configure(env, args, out):
         stub.CreateEntry(filer_pb2.CreateEntryRequest(
             directory="/etc/seaweedfs", entry=entry), timeout=10)
     print(_json.dumps(conf, indent=2), file=out)
+
+
+@command("fs.tree", "fs.tree [dir] — recursively print the directory tree")
+def fs_tree(env, args, out):
+    """command_fs_tree.go."""
+    root = _resolve(env, args[0] if args else None)
+    dirs = files = 0
+
+    def walk(d: str, indent: str) -> None:
+        nonlocal dirs, files
+        entries = sorted(_list(env, d), key=lambda e: e.name)
+        for i, e in enumerate(entries):
+            last = i == len(entries) - 1
+            branch = "└──" if last else "├──"
+            print(f"{indent}{branch} {e.name}"
+                  + ("/" if e.is_directory else ""), file=out)
+            if e.is_directory:
+                dirs += 1
+                walk(f"{d.rstrip('/')}/{e.name}",
+                     indent + ("    " if last else "│   "))
+            else:
+                files += 1
+
+    print(root, file=out)
+    walk(root, "")
+    print(f"{dirs} directories, {files} files", file=out)
+
+
+@command("fs.verify",
+         "fs.verify [-v] [dir] — check every chunk of every file is readable")
+def fs_verify(env, args, out):
+    """command_fs_verify.go: walk the tree and probe each referenced chunk
+    on its volume server."""
+    import requests
+
+    flags = [a for a in args if a.startswith("-")]
+    rest = [a for a in args if not a.startswith("-")]
+    verbose = "-v" in flags
+    root = _resolve(env, rest[0] if rest else None)
+    total = bad = 0
+
+    def check_file(path: str, entry) -> None:
+        nonlocal total, bad
+        for c in entry.chunks:
+            fid = c.file_id or (
+                f"{c.fid.volume_id},{c.fid.file_key:x}{c.fid.cookie:08x}")
+            total += 1
+            try:
+                urls = env.master_client.lookup_file_id(fid)
+                r = requests.head(urls[0], timeout=10)
+                ok = r.status_code == 200
+            except Exception:
+                ok = False
+            if not ok:
+                bad += 1
+                print(f"  MISSING {path} chunk {fid}", file=out)
+            elif verbose:
+                print(f"  ok {path} chunk {fid}", file=out)
+
+    def walk(d: str) -> None:
+        for e in _list(env, d):
+            full = f"{d.rstrip('/')}/{e.name}"
+            if e.is_directory:
+                walk(full)
+            else:
+                check_file(full, e)
+
+    walk(root)
+    print(f"verified {total} chunks, {bad} missing/corrupt", file=out)
+    if bad:
+        raise RuntimeError(f"{bad} of {total} chunks failed verification")
+
+
+@command("fs.meta.changeVolumeId",
+         "fs.meta.changeVolumeId -mapping=old1:new1,old2:new2 [dir] [-apply]")
+def fs_meta_change_volume_id(env, args, out):
+    """command_fs_meta_change_volume_id.go: rewrite chunk volume ids in
+    file metadata after volumes were renumbered/migrated."""
+    opts = {k: v for k, v in (a[1:].split("=", 1) for a in args
+                              if a.startswith("-") and "=" in a)}
+    apply = "-apply" in args
+    rest = [a for a in args if not a.startswith("-")]
+    mapping = {}
+    for pair in filter(None, opts.get("mapping", "").split(",")):
+        old, _, new = pair.partition(":")
+        mapping[int(old)] = int(new)
+    if not mapping:
+        raise RuntimeError("need -mapping=old:new[,old2:new2]")
+    root = _resolve(env, rest[0] if rest else None)
+    stub = _stub(env)
+    changed = 0
+
+    def rewrite(e) -> bool:
+        touched = False
+        for c in e.chunks:
+            vid = c.fid.volume_id if c.fid.volume_id else (
+                int(c.file_id.split(",")[0]) if c.file_id else 0)
+            if vid in mapping:
+                new = mapping[vid]
+                if c.file_id:
+                    c.file_id = f"{new},{c.file_id.split(',', 1)[1]}"
+                if c.fid.volume_id:
+                    c.fid.volume_id = new
+                touched = True
+        return touched
+
+    def walk(d: str) -> None:
+        nonlocal changed
+        for e in _list(env, d):
+            full = f"{d.rstrip('/')}/{e.name}"
+            if e.is_directory:
+                walk(full)
+            elif rewrite(e):
+                changed += 1
+                print(f"  {'updated' if apply else 'would update'} {full}",
+                      file=out)
+                if apply:
+                    stub.UpdateEntry(filer_pb2.UpdateEntryRequest(
+                        directory=d, entry=e), timeout=10)
+
+    walk(root)
+    print(f"{changed} entries {'updated' if apply else 'to update'}"
+          + ("" if apply else " (rerun with -apply)"), file=out)
+
+
+@command("fs.meta.notify",
+         "fs.meta.notify [dir] — re-publish create events for a tree")
+def fs_meta_notify(env, args, out):
+    """command_fs_meta_notify.go: resend metadata as notification events
+    (e.g. to prime a freshly configured notification backend)."""
+    from ...notification import current_queue
+
+    q = current_queue()
+    if q is None:
+        raise RuntimeError("no notification queue configured "
+                           "(see notification.toml / fs.configure)")
+    root = _resolve(env, args[0] if args else None)
+    sent = 0
+
+    def walk(d: str) -> None:
+        nonlocal sent
+        for e in _list(env, d):
+            full = f"{d.rstrip('/')}/{e.name}"
+            ev = filer_pb2.EventNotification()
+            ev.new_entry.CopyFrom(e)
+            q.send_message(full, ev)
+            sent += 1
+            if e.is_directory:
+                walk(full)
+
+    walk(root)
+    print(f"notified {sent} entries under {root}", file=out)

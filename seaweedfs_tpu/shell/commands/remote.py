@@ -79,3 +79,40 @@ def _kv(args) -> dict:
             k, _, v = a[1:].partition("=")
             out[k] = v
     return out
+
+
+@command("remote.mount.buckets",
+         "remote.mount.buckets -remote=<storage> [-apply]")
+def remote_mount_buckets(env, args, out):
+    """command_remote_mount_buckets.go: discover the remote storage's
+    top-level buckets and mount each under /buckets/<bucket>."""
+    opts = _kv(args)
+    storage = opts.get("remote", "")
+    if not storage:
+        raise RuntimeError("usage: remote.mount.buckets -remote=<storage>")
+    apply = "apply" in opts
+    conf = RemoteConf(env.require_filer())
+    all_conf = conf.load()
+    if storage not in all_conf.get("storages", {}):
+        raise RuntimeError(f"unknown remote storage {storage!r}")
+    from ...remote_storage import new_client
+
+    client = new_client(all_conf["storages"][storage])
+    buckets = sorted({e.path.lstrip("/").split("/", 1)[0]
+                      for e in client.traverse("")})
+    mounted = 0
+    for b in buckets:
+        directory = f"/buckets/{b}"
+        if directory in all_conf.get("mounts", {}):
+            continue
+        if apply:
+            conf.mount(directory, storage, b)
+            synced = RemoteGateway(env.require_filer()).sync_dir(directory)
+            print(f"mounted {directory} -> {storage}/{b} "
+                  f"({synced} entries)", file=out)
+        else:
+            print(f"would mount {directory} -> {storage}/{b} "
+                  f"(rerun with -apply)", file=out)
+        mounted += 1
+    if not mounted:
+        print("no unmounted buckets found", file=out)

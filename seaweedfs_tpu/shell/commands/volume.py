@@ -426,3 +426,126 @@ def volume_fsck(env, args, out):
     walk("/")
     print(f"checked {checked} chunks: {missing_vol} dangling volume refs, "
           f"{unreadable} unreadable", file=out)
+
+
+@command("volume.delete.empty",
+         "volume.delete.empty [-quietFor=24h] [-force]  (drop 0-file volumes)")
+def volume_delete_empty(env, args, out):
+    """command_volume_delete_empty.go: delete volumes that hold no live
+    files and have been quiet long enough."""
+    import time as _time
+
+    p = argparse.ArgumentParser(prog="volume.delete.empty")
+    p.add_argument("-quietFor", default="24h")
+    p.add_argument("-force", action="store_true")
+    opts = p.parse_args(args)
+    env.confirm_is_locked()
+    units = {"s": 1, "m": 60, "h": 3600, "d": 86400}
+    spec = opts.quietFor
+    quiet_s = float(spec[:-1] if spec[-1] in units else spec) * \
+        units.get(spec[-1], 3600)
+    cutoff = _time.time() - quiet_s
+    deleted = 0
+    for dn in env.collect_data_nodes():
+        for disk in dn.disk_infos.values():
+            for v in disk.volume_infos:
+                if v.file_count - v.delete_count > 0:
+                    continue
+                if v.modified_at_second and v.modified_at_second > cutoff:
+                    continue
+                if opts.force:
+                    env.volume_stub(dn.id).VolumeDelete(
+                        vs.VolumeDeleteRequest(volume_id=v.id), timeout=60)
+                    print(f"deleted empty volume {v.id} on {dn.id}", file=out)
+                else:
+                    print(f"would delete empty volume {v.id} on {dn.id} "
+                          f"(rerun with -force)", file=out)
+                deleted += 1
+    if not deleted:
+        print("no empty volumes", file=out)
+
+
+@command("volume.tier.move",
+         "volume.tier.move -fromDiskType=hdd -toDiskType=ssd "
+         "[-collection=x] [-apply]")
+def volume_tier_move(env, args, out):
+    """command_volume_tier_move.go: migrate volumes onto servers that have
+    the target disk type (copy there, delete at the source)."""
+    p = argparse.ArgumentParser(prog="volume.tier.move")
+    p.add_argument("-fromDiskType", default="")
+    p.add_argument("-toDiskType", required=True)
+    p.add_argument("-collection", default="")
+    p.add_argument("-apply", action="store_true")
+    opts = p.parse_args(args)
+    env.confirm_is_locked()
+
+    def norm(dt: str) -> str:
+        return "" if dt in ("", "hdd") else dt
+
+    # destination servers offering the target disk type, with room
+    dests = []
+    sources = []  # (vid, server)
+    replicas = _replica_index(env)
+    for dn in env.collect_data_nodes():
+        for dtype, disk in dn.disk_infos.items():
+            if norm(dtype) == norm(opts.toDiskType):
+                free = disk.max_volume_count - disk.volume_count
+                if free > 0:
+                    dests.append([dn.id, free])
+            if norm(dtype) == norm(opts.fromDiskType):
+                for v in disk.volume_infos:
+                    if opts.collection and v.collection != opts.collection:
+                        continue
+                    sources.append((v.id, dn.id))
+    if not dests:
+        print(f"no server offers disk type {opts.toDiskType!r} with room",
+              file=out)
+        return
+    moved = 0
+    for vid, src in sources:
+        # a destination must not already hold this volume (ALREADY_EXISTS)
+        holders = set(replicas.get(vid, {}))
+        dest = next((d for d in dests
+                     if d[0] != src and d[1] > 0 and d[0] not in holders),
+                    None)
+        if dest is None:
+            print(f"volume {vid}: no destination with room", file=out)
+            continue
+        print(f"volume {vid}: {src} -> {dest[0]} ({opts.toDiskType})",
+              file=out)
+        if opts.apply:
+            try:
+                for _ in env.volume_stub(dest[0]).VolumeCopy(
+                        vs.VolumeCopyRequest(volume_id=vid,
+                                             source_data_node=src,
+                                             disk_type=opts.toDiskType),
+                        timeout=24 * 3600):
+                    pass
+                env.volume_stub(src).VolumeDelete(
+                    vs.VolumeDeleteRequest(volume_id=vid), timeout=60)
+            except Exception as e:  # keep moving the rest
+                print(f"  volume {vid} move failed: {e}", file=out)
+                continue
+        moved += 1
+        dest[1] -= 1
+        replicas.setdefault(vid, {})[dest[0]] = None
+    if not moved:
+        print("nothing to move", file=out)
+
+
+@command("volume.vacuum.disable", "pause the master's periodic vacuum")
+def volume_vacuum_disable(env, args, out):
+    """command_volume_vacuum_disable.go."""
+    import requests
+
+    r = requests.get(f"http://{env.master}/vol/vacuum/disable", timeout=10)
+    print(r.json().get("vacuum", "?"), file=out)
+
+
+@command("volume.vacuum.enable", "resume the master's periodic vacuum")
+def volume_vacuum_enable(env, args, out):
+    """command_volume_vacuum_enable.go."""
+    import requests
+
+    r = requests.get(f"http://{env.master}/vol/vacuum/enable", timeout=10)
+    print(r.json().get("vacuum", "?"), file=out)

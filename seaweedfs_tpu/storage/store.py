@@ -152,10 +152,20 @@ class Store:
                 return loc
         return None
 
-    def _pick_location(self) -> DiskLocation:
+    def _pick_location(self, disk_type: str | None = None) -> DiskLocation:
+        """Most-free location, optionally restricted to one disk type
+        (store.go findFreeLocation's diskType filter)."""
         with self._lock:
+            candidates = self.locations
+            if disk_type is not None:
+                want = "" if disk_type == "hdd" else disk_type
+                candidates = [l for l in self.locations
+                              if (l.disk_type or "") == want]
+                if not candidates:
+                    raise IOError(
+                        f"no volume directory with disk type {disk_type!r}")
             best = max(
-                self.locations,
+                candidates,
                 key=lambda l: l.max_volume_count - len(l.volumes),
             )
             if l_free(best) <= 0:

