@@ -45,6 +45,9 @@ class Filer:
         # falls back to the persisted log instead of losing drops from
         # the bounded deque).
         self.meta_log = MetaLog(store) if persist_meta_log else None
+        # optional external publisher (notification.toml; filer_notify.go's
+        # Queue.SendMessage side of NotifyUpdateEvent) — set by the server
+        self.notification_queue = None
 
     # -- events (filer_notify.go:20 NotifyUpdateEvent) ---------------------
 
@@ -71,6 +74,14 @@ class Filer:
             self._log_cond.notify_all()
         if self.meta_log is not None:
             self.meta_log.append(msg)
+        if self.notification_queue is not None:
+            key = (new or old).full_path if (new or old) else directory
+            try:
+                self.notification_queue.send_message(key, ev)
+            except Exception as e:  # publisher failures must not fail writes
+                from ..utils import glog
+
+                glog.warning(f"notification publish failed: {e}")
 
     def read_events(self, since_ns: int, timeout: float = 1.0):
         """-> (events newer than since_ns, new cursor).

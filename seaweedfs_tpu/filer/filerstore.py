@@ -48,6 +48,7 @@ def register_store(name: str, cls: type) -> None:
 
 def get_store(name: str, **kwargs) -> FilerStore:
     from .stores import (  # noqa: F401 - registration side effect
+        abstract_sql,
         gated,
         leveldb,
         memory,
@@ -62,7 +63,13 @@ def get_store(name: str, **kwargs) -> FilerStore:
 
 
 def available_stores() -> list[str]:
-    from .stores import gated, leveldb, memory, sqlite  # noqa: F401
+    from .stores import (  # noqa: F401 - registration side effect
+        abstract_sql,
+        gated,
+        leveldb,
+        memory,
+        sqlite,
+    )
 
     return sorted(_REGISTRY)
 

@@ -1,0 +1,301 @@
+"""Shared SQL filer-store layer + dialects.
+
+Rebuild of /root/reference/weed/filer/abstract_sql/abstract_sql_store.go:
+one generic store speaking DB-API, with per-dialect SQL generation (the
+reference's SqlGenerator interface: GetSqlInsert/Find/Delete/List/... that
+mysql/postgres/sqlite and five more stores all reuse). A dialect supplies:
+
+  * the SQL statements (paramstyle differences: ?, %s, $N)
+  * a connect() factory returning DB-API connections
+  * upsert syntax (ON CONFLICT / ON DUPLICATE KEY)
+
+The sqlite dialect is fully live; mysql/postgres generate their exact SQL
+and are import-gated on their client libraries (pymysql / psycopg2), which
+this environment doesn't ship — construction raises with instructions,
+matching the repo's convention for cloud-gated backends.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterator
+
+from ...pb import filer_pb2
+from ..entry import Entry
+from ..filerstore import register_store
+
+
+class SqlDialect:
+    """SqlGenerator equivalent (abstract_sql_store.go:15-26)."""
+
+    name = "abstract"
+    param = "?"  # DB-API paramstyle placeholder
+
+    def _p(self, n: int) -> list[str]:
+        return [self.param] * n
+
+    def create_table(self, table: str) -> str:
+        return (f"CREATE TABLE IF NOT EXISTS {table} ("
+                f"directory TEXT NOT NULL, name TEXT NOT NULL, meta BLOB, "
+                f"PRIMARY KEY (directory, name))")
+
+    def create_kv_table(self, table: str) -> str:
+        return (f"CREATE TABLE IF NOT EXISTS {table}_kv "
+                f"(k BLOB PRIMARY KEY, v BLOB)")
+
+    def drop_table(self, table: str) -> str:
+        return f"DROP TABLE IF EXISTS {table}"
+
+    def upsert(self, table: str) -> str:
+        a, b, c = self._p(3)
+        return (f"INSERT INTO {table}(directory,name,meta) VALUES({a},{b},{c}) "
+                f"ON CONFLICT(directory,name) DO UPDATE SET meta=excluded.meta")
+
+    def find(self, table: str) -> str:
+        a, b = self._p(2)
+        return (f"SELECT meta FROM {table} WHERE directory={a} AND name={b}")
+
+    def delete(self, table: str) -> str:
+        a, b = self._p(2)
+        return f"DELETE FROM {table} WHERE directory={a} AND name={b}"
+
+    def delete_folder_children(self, table: str) -> str:
+        a, b = self._p(2)
+        return (f"DELETE FROM {table} WHERE directory={a} "
+                f"OR directory LIKE {b}")
+
+    def list_entries(self, table: str, inclusive: bool) -> str:
+        op = ">=" if inclusive else ">"
+        a, b, c, d = self._p(4)
+        return (f"SELECT name, meta FROM {table} WHERE directory={a} "
+                f"AND name {op} {b} AND name LIKE {c} "
+                f"ORDER BY name LIMIT {d}")
+
+    def kv_upsert(self, table: str) -> str:
+        a, b = self._p(2)
+        return (f"INSERT INTO {table}_kv(k,v) VALUES({a},{b}) "
+                f"ON CONFLICT(k) DO UPDATE SET v=excluded.v")
+
+    def kv_get(self, table: str) -> str:
+        return f"SELECT v FROM {table}_kv WHERE k={self.param}"
+
+    def connect(self):
+        raise NotImplementedError
+
+
+class SqliteDialect(SqlDialect):
+    name = "sqlite"
+    param = "?"
+
+    _mem_seq = 0
+    _mem_lock = threading.Lock()
+
+    def __init__(self, db_path: str = ":memory:"):
+        self.uri = False
+        if db_path == ":memory:":
+            # per-connection private :memory: DBs won't do — every server
+            # thread must see one namespace. Use a named shared-cache DB.
+            with SqliteDialect._mem_lock:
+                SqliteDialect._mem_seq += 1
+                db_path = (f"file:filer_mem_{id(self)}_"
+                           f"{SqliteDialect._mem_seq}?mode=memory&cache=shared")
+            self.uri = True
+        self.db_path = db_path
+
+    def connect(self):
+        import sqlite3
+
+        c = sqlite3.connect(self.db_path, uri=self.uri,
+                            check_same_thread=False)
+        if not self.uri:
+            c.execute("PRAGMA journal_mode=WAL")
+            c.execute("PRAGMA synchronous=NORMAL")
+        c.execute("PRAGMA busy_timeout=5000")
+        return c
+
+
+class MySqlDialect(SqlDialect):
+    """mysql/mysql_store.go + mysql_sql_gen.go SQL shapes."""
+
+    name = "mysql"
+    param = "%s"
+
+    def __init__(self, *, host="localhost", port=3306, user="root",
+                 password="", database="seaweedfs", **_):
+        self.kwargs = dict(host=host, port=port, user=user,
+                           password=password, database=database)
+
+    def create_table(self, table: str) -> str:
+        return (f"CREATE TABLE IF NOT EXISTS `{table}` ("
+                f"`directory` VARCHAR(766) NOT NULL, "
+                f"`name` VARCHAR(766) NOT NULL, `meta` LONGBLOB, "
+                f"PRIMARY KEY (`directory`, `name`)) CHARACTER SET utf8mb4")
+
+    def upsert(self, table: str) -> str:
+        return (f"INSERT INTO `{table}`(directory,name,meta) "
+                f"VALUES(%s,%s,%s) "
+                f"ON DUPLICATE KEY UPDATE meta=VALUES(meta)")
+
+    def kv_upsert(self, table: str) -> str:
+        return (f"INSERT INTO `{table}_kv`(k,v) VALUES(%s,%s) "
+                f"ON DUPLICATE KEY UPDATE v=VALUES(v)")
+
+    def connect(self):
+        try:
+            import pymysql
+        except ImportError:
+            raise RuntimeError(
+                "the mysql filer store needs pymysql, which is not "
+                "installed in this environment")
+        return pymysql.connect(**self.kwargs)
+
+
+class PostgresDialect(SqlDialect):
+    """postgres/postgres_store.go + postgres_sql_gen.go SQL shapes."""
+
+    name = "postgres"
+    param = "%s"
+
+    def __init__(self, *, host="localhost", port=5432, user="postgres",
+                 password="", database="seaweedfs", sslmode="disable", **_):
+        self.kwargs = dict(host=host, port=port, user=user,
+                           password=password, dbname=database,
+                           sslmode=sslmode)
+
+    def create_table(self, table: str) -> str:
+        return (f'CREATE TABLE IF NOT EXISTS "{table}" ('
+                f"directory VARCHAR(65535) NOT NULL, "
+                f"name VARCHAR(65535) NOT NULL, meta BYTEA, "
+                f"PRIMARY KEY (directory, name))")
+
+    def upsert(self, table: str) -> str:
+        return (f'INSERT INTO "{table}"(directory,name,meta) '
+                f"VALUES(%s,%s,%s) ON CONFLICT(directory,name) "
+                f"DO UPDATE SET meta=EXCLUDED.meta")
+
+    def kv_upsert(self, table: str) -> str:
+        return (f'INSERT INTO "{table}_kv"(k,v) VALUES(%s,%s) '
+                f"ON CONFLICT(k) DO UPDATE SET v=EXCLUDED.v")
+
+    def connect(self):
+        try:
+            import psycopg2
+        except ImportError:
+            raise RuntimeError(
+                "the postgres filer store needs psycopg2, which is not "
+                "installed in this environment")
+        return psycopg2.connect(**self.kwargs)
+
+
+class AbstractSqlStore:
+    """FilerStore over any SqlDialect (AbstractSqlStore,
+    abstract_sql_store.go:28)."""
+
+    TABLE = "filemeta"
+
+    def __init__(self, dialect: SqlDialect):
+        self.dialect = dialect
+        self.name = dialect.name
+        self._local = threading.local()
+        self._lock = threading.Lock()
+        # anchor connection: creates the schema and, for shared-cache
+        # in-memory sqlite, pins the database alive
+        self._anchor = dialect.connect()
+        cur = self._anchor.cursor()
+        cur.execute(self.dialect.create_table(self.TABLE))
+        cur.execute(self.dialect.create_kv_table(self.TABLE))
+        self._anchor.commit()
+
+    def _conn(self):
+        c = getattr(self._local, "conn", None)
+        if c is None:
+            c = self.dialect.connect()
+            self._local.conn = c
+        return c
+
+    @staticmethod
+    def _split(full_path: str) -> tuple[str, str]:
+        if full_path == "/":
+            return "", "/"
+        d, _, n = full_path.rstrip("/").rpartition("/")
+        return d or "/", n
+
+    def insert_entry(self, entry: Entry) -> None:
+        d, n = self._split(entry.full_path)
+        blob = entry.to_pb().SerializeToString()
+        c = self._conn()
+        with self._lock:
+            c.cursor().execute(self.dialect.upsert(self.TABLE), (d, n, blob))
+            c.commit()
+
+    update_entry = insert_entry
+
+    def find_entry(self, full_path: str) -> Entry | None:
+        d, n = self._split(full_path)
+        cur = self._conn().cursor()
+        cur.execute(self.dialect.find(self.TABLE), (d, n))
+        row = cur.fetchone()
+        if row is None:
+            return None
+        pb = filer_pb2.Entry.FromString(bytes(row[0]))
+        return Entry.from_pb(d, pb)
+
+    def delete_entry(self, full_path: str) -> None:
+        d, n = self._split(full_path)
+        c = self._conn()
+        with self._lock:
+            c.cursor().execute(self.dialect.delete(self.TABLE), (d, n))
+            c.commit()
+
+    def delete_folder_children(self, full_path: str) -> None:
+        base = full_path.rstrip("/") or "/"
+        c = self._conn()
+        with self._lock:
+            c.cursor().execute(
+                self.dialect.delete_folder_children(self.TABLE),
+                (base, base + "/%"))
+            c.commit()
+
+    def list_directory_entries(self, dir_path: str, start_file_name: str = "",
+                               include_start: bool = False,
+                               limit: int = 1024,
+                               prefix: str = "") -> Iterator[Entry]:
+        base = dir_path.rstrip("/") or "/"
+        cur = self._conn().cursor()
+        cur.execute(self.dialect.list_entries(self.TABLE, include_start),
+                    (base, start_file_name, (prefix or "") + "%", limit))
+        for _name, blob in cur.fetchall():
+            pb = filer_pb2.Entry.FromString(bytes(blob))
+            yield Entry.from_pb(base, pb)
+
+    def kv_get(self, key: bytes) -> bytes | None:
+        cur = self._conn().cursor()
+        cur.execute(self.dialect.kv_get(self.TABLE), (key,))
+        row = cur.fetchone()
+        return bytes(row[0]) if row else None
+
+    def kv_put(self, key: bytes, value: bytes) -> None:
+        c = self._conn()
+        with self._lock:
+            c.cursor().execute(self.dialect.kv_upsert(self.TABLE),
+                               (key, value))
+            c.commit()
+
+    def close(self) -> None:
+        c = getattr(self._local, "conn", None)
+        if c is not None:
+            c.close()
+            self._local.conn = None
+        self._anchor.close()
+
+
+def _mysql_store(**kwargs) -> AbstractSqlStore:
+    return AbstractSqlStore(MySqlDialect(**kwargs))
+
+
+def _postgres_store(**kwargs) -> AbstractSqlStore:
+    return AbstractSqlStore(PostgresDialect(**kwargs))
+
+
+register_store("mysql", _mysql_store)
+register_store("postgres", _postgres_store)

@@ -1,146 +1,22 @@
 """SQLite filer store — the durable default.
 
-Rebuild of the reference's abstract_sql/sqlite backends
-(/root/reference/weed/filer/sqlite/sqlite_store.go,
-abstract_sql/abstract_sql_store.go): one row per entry keyed by
-(directory-hash, name) with the Entry protobuf as the value blob, plus a
-generic KV table. Serialization reuses the filer_pb.Entry wire format so
-store contents survive backend swaps.
+Rebuild of the reference's sqlite backend
+(/root/reference/weed/filer/sqlite/sqlite_store.go): since round 2 a thin
+dialect over the shared SQL layer (stores/abstract_sql.py), exactly how the
+reference layers sqlite_store.go on abstract_sql_store.go. Serialization
+reuses the filer_pb.Entry wire format so store contents survive backend
+swaps.
 """
 
 from __future__ import annotations
 
-import sqlite3
-import threading
-from typing import Iterator
-
-from ...pb import filer_pb2
-from ..entry import Entry
 from ..filerstore import register_store
-
-_SCHEMA = """
-CREATE TABLE IF NOT EXISTS filemeta (
-  directory TEXT NOT NULL,
-  name      TEXT NOT NULL,
-  meta      BLOB,
-  PRIMARY KEY (directory, name)
-);
-CREATE TABLE IF NOT EXISTS kv (
-  k BLOB PRIMARY KEY,
-  v BLOB
-);
-"""
+from .abstract_sql import AbstractSqlStore, SqliteDialect
 
 
-class SqliteStore:
-    name = "sqlite"
-
-    _mem_seq = 0
-
+class SqliteStore(AbstractSqlStore):
     def __init__(self, db_path: str = ":memory:", **_):
-        self._uri = False
-        if db_path == ":memory:":
-            # per-connection private :memory: DBs won't do — every server
-            # thread must see one namespace. Use a named shared-cache DB and
-            # pin it with an anchor connection.
-            SqliteStore._mem_seq += 1
-            db_path = (f"file:filer_mem_{id(self)}_{SqliteStore._mem_seq}"
-                       f"?mode=memory&cache=shared")
-            self._uri = True
-        self._db_path = db_path
-        self._local = threading.local()
-        self._lock = threading.Lock()
-        self._anchor = sqlite3.connect(db_path, uri=self._uri,
-                                       check_same_thread=False)
-        self._anchor.executescript(_SCHEMA)
-        self._anchor.commit()
-
-    def _conn(self) -> sqlite3.Connection:
-        c = getattr(self._local, "conn", None)
-        if c is None:
-            c = sqlite3.connect(self._db_path, uri=self._uri,
-                                check_same_thread=False)
-            c.execute("PRAGMA journal_mode=WAL")
-            c.execute("PRAGMA synchronous=NORMAL")
-            c.execute("PRAGMA busy_timeout=5000")
-            self._local.conn = c
-        return c
-
-    @staticmethod
-    def _split(full_path: str) -> tuple[str, str]:
-        if full_path == "/":
-            return "", "/"
-        d, _, n = full_path.rstrip("/").rpartition("/")
-        return d or "/", n
-
-    def insert_entry(self, entry: Entry) -> None:
-        d, n = self._split(entry.full_path)
-        blob = entry.to_pb().SerializeToString()
-        c = self._conn()
-        with self._lock:
-            c.execute(
-                "INSERT INTO filemeta(directory,name,meta) VALUES(?,?,?) "
-                "ON CONFLICT(directory,name) DO UPDATE SET meta=excluded.meta",
-                (d, n, blob))
-            c.commit()
-
-    update_entry = insert_entry
-
-    def find_entry(self, full_path: str) -> Entry | None:
-        d, n = self._split(full_path)
-        row = self._conn().execute(
-            "SELECT meta FROM filemeta WHERE directory=? AND name=?",
-            (d, n)).fetchone()
-        if row is None:
-            return None
-        pb = filer_pb2.Entry.FromString(row[0])
-        return Entry.from_pb(d, pb)
-
-    def delete_entry(self, full_path: str) -> None:
-        d, n = self._split(full_path)
-        c = self._conn()
-        with self._lock:
-            c.execute("DELETE FROM filemeta WHERE directory=? AND name=?", (d, n))
-            c.commit()
-
-    def delete_folder_children(self, full_path: str) -> None:
-        base = full_path.rstrip("/") or "/"
-        c = self._conn()
-        with self._lock:
-            c.execute("DELETE FROM filemeta WHERE directory=? OR directory LIKE ?",
-                      (base, base + "/%"))
-            c.commit()
-
-    def list_directory_entries(self, dir_path: str, start_file_name: str = "",
-                               include_start: bool = False, limit: int = 1024,
-                               prefix: str = "") -> Iterator[Entry]:
-        base = dir_path.rstrip("/") or "/"
-        op = ">=" if include_start else ">"
-        q = (f"SELECT name, meta FROM filemeta WHERE directory=? AND name {op} ? "
-             f"AND name LIKE ? ORDER BY name LIMIT ?")
-        rows = self._conn().execute(
-            q, (base, start_file_name, (prefix or "") + "%", limit)).fetchall()
-        for name, blob in rows:
-            pb = filer_pb2.Entry.FromString(blob)
-            yield Entry.from_pb(base, pb)
-
-    def kv_get(self, key: bytes) -> bytes | None:
-        row = self._conn().execute("SELECT v FROM kv WHERE k=?", (key,)).fetchone()
-        return row[0] if row else None
-
-    def kv_put(self, key: bytes, value: bytes) -> None:
-        c = self._conn()
-        with self._lock:
-            c.execute("INSERT INTO kv(k,v) VALUES(?,?) "
-                      "ON CONFLICT(k) DO UPDATE SET v=excluded.v", (key, value))
-            c.commit()
-
-    def close(self) -> None:
-        c = getattr(self._local, "conn", None)
-        if c is not None:
-            c.close()
-            self._local.conn = None
-        self._anchor.close()
+        super().__init__(SqliteDialect(db_path))
 
 
 register_store("sqlite", SqliteStore)

@@ -148,6 +148,7 @@ class RaftNode:
             "snapshot_term": self.snapshot_term,
             "snapshot": snap,
             "peers": list(self.peers),  # survives config-entry compaction
+            "removed": self._removed,  # a removed node must stay removed
             "log": [{"term": e.term, "index": e.index,
                      "command": e.command} for e in self.log],
         }
@@ -170,6 +171,9 @@ class RaftNode:
                     for e in blob["log"]]
         if blob.get("peers") is not None:
             self.peers = [p for p in blob["peers"] if p != self.node_id]
+        # without this a removed node restarting with peers=[] would
+        # self-elect as a phantom single-node leader (split brain)
+        self._removed = bool(blob.get("removed", False))
         if blob.get("snapshot") is not None and self.restore_fn:
             self.restore_fn(blob["snapshot"])
             self.commit_index = self.last_applied = self.snapshot_index
@@ -181,7 +185,7 @@ class RaftNode:
             if self.last_applied < e.index <= durable_commit:
                 if e.command.get("op") == "raft_config":
                     self._apply_config(e.command)
-                else:
+                elif e.command.get("op") != "noop":
                     self.apply_fn(e.command)
                 self.commit_index = self.last_applied = e.index
 
@@ -299,6 +303,13 @@ class RaftNode:
         nxt = self._last_index() + 1
         self._next_index = {p: nxt for p in self.peers}
         self._match_index = {p: 0 for p in self.peers}
+        # no-op entry at the new term (Raft §8): the commit rule only counts
+        # current-term entries, so without this a prior leader's tail (e.g.
+        # the config entry that removed it) would stay uncommitted on the
+        # followers until the next client proposal
+        if self.peers:
+            self.log.append(LogEntry(self.term, nxt, {"op": "noop"}))
+            self._persist()
         glog.info(f"raft: {self.node_id} became leader (term {self.term})")
 
     def _step_down(self, term: int) -> None:
@@ -513,7 +524,7 @@ class RaftNode:
                 continue
             if e.command.get("op") == "raft_config":
                 self._apply_config(e.command)
-            else:
+            elif e.command.get("op") != "noop":
                 self.apply_fn(e.command)
         self._commit_cv.notify_all()
 

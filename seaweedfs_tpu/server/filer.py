@@ -61,6 +61,18 @@ class FilerServer:
                 store, directory=store_dir or "./filerldb"))
         else:
             self.filer = Filer(get_store(store))
+        # external event publisher, if notification.toml configures one
+        # (filer.go LoadConfiguration("notification"))
+        try:
+            from ..notification import load_configuration
+            from ..utils.config import load_config
+
+            self.filer.notification_queue = load_configuration(
+                load_config("notification"))
+        except Exception as e:
+            from ..utils import glog
+
+            glog.warning(f"notification config ignored: {e}")
         self.master_client = MasterClient(master)
         self._http_server = None
         self._grpc_server = None
@@ -481,6 +493,15 @@ def _make_http_handler(srv: FilerServer):
                     return self._json({"error": "not found"}, 404)
                 if entry.is_directory:
                     limit = int(q.get("limit", 1000))
+                    if "ui" in q or "text/html" in (
+                            self.headers.get("Accept") or ""):
+                        from .ui import filer_ui
+
+                        listed = list(srv.filer.list_entries(
+                            path, q.get("lastFileName", ""), limit=limit))
+                        return self._reply(
+                            200, filer_ui(srv, path, listed),
+                            "text/html; charset=utf-8")
                     entries = [{
                         "FullPath": e.full_path,
                         "Mtime": e.attr.mtime, "Crtime": e.attr.crtime,

@@ -563,7 +563,17 @@ def _make_http_handler(ms: MasterServer):
             self.end_headers()
             self.wfile.write(body)
 
-        def do_GET(self):
+        def do_GET(self):  # noqa: C901 - flat route table
+            if urlparse(self.path).path in ("/", "/ui"):
+                from .ui import master_ui
+
+                body = master_ui(ms)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/html; charset=utf-8")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
             u = urlparse(self.path)
             q = {k: v[0] for k, v in parse_qs(u.query).items()}
             if u.path == "/dir/assign":

@@ -1044,6 +1044,11 @@ def _make_http_handler(srv: VolumeServer):
                                    "text/plain; version=0.0.4")
             if u.path == "/healthz":
                 return self._json({"ok": True})
+            if u.path in ("/", "/ui"):
+                from .ui import volume_ui
+
+                return self._reply(200, volume_ui(srv),
+                                   "text/html; charset=utf-8")
             with VOLUME_SERVER_REQUEST_HISTOGRAM.time(type="read"):
                 self._serve_needle(u)
 

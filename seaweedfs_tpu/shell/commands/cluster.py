@@ -102,9 +102,10 @@ def _raft_leader_addr(env) -> str:
 def _raft_member_op(env, args, out, op: str) -> None:
     import requests
 
-    opts = {k: v for k, v in (a[1:].split("=", 1) for a in args
-                              if a.startswith("-") and "=" in a)}
-    if "id" not in opts:
+    from ..registry import kv_flags
+
+    opts = kv_flags(args)
+    if not opts.get("id"):
         raise RuntimeError(f"usage: cluster.raft.{op} -id=<master-address>")
     leader = _raft_leader_addr(env)
     r = requests.get(f"http://{leader}/cluster/raft/{op}",

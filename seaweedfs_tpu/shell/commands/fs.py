@@ -370,12 +370,20 @@ def fs_verify(env, args, out):
             fid = c.file_id or (
                 f"{c.fid.volume_id},{c.fid.file_key:x}{c.fid.cookie:08x}")
             total += 1
+            # a chunk is missing only if NO replica serves it — one down
+            # replica of a healthy volume is not data loss
+            ok = False
             try:
                 urls = env.master_client.lookup_file_id(fid)
-                r = requests.head(urls[0], timeout=10)
-                ok = r.status_code == 200
             except Exception:
-                ok = False
+                urls = []
+            for url in urls:
+                try:
+                    if requests.head(url, timeout=10).status_code == 200:
+                        ok = True
+                        break
+                except Exception:
+                    continue
             if not ok:
                 bad += 1
                 print(f"  MISSING {path} chunk {fid}", file=out)
@@ -401,9 +409,10 @@ def fs_verify(env, args, out):
 def fs_meta_change_volume_id(env, args, out):
     """command_fs_meta_change_volume_id.go: rewrite chunk volume ids in
     file metadata after volumes were renumbered/migrated."""
-    opts = {k: v for k, v in (a[1:].split("=", 1) for a in args
-                              if a.startswith("-") and "=" in a)}
-    apply = "-apply" in args
+    from ..registry import kv_flags
+
+    opts = kv_flags(args)
+    apply = "apply" in opts
     rest = [a for a in args if not a.startswith("-")]
     mapping = {}
     for pair in filter(None, opts.get("mapping", "").split(",")):
@@ -452,13 +461,15 @@ def fs_meta_change_volume_id(env, args, out):
          "fs.meta.notify [dir] — re-publish create events for a tree")
 def fs_meta_notify(env, args, out):
     """command_fs_meta_notify.go: resend metadata as notification events
-    (e.g. to prime a freshly configured notification backend)."""
-    from ...notification import current_queue
+    (e.g. to prime a freshly configured notification backend). The shell
+    loads notification.toml itself, exactly like the reference command."""
+    from ...notification import current_queue, load_configuration
+    from ...utils.config import load_config
 
-    q = current_queue()
+    q = load_configuration(load_config("notification")) or current_queue()
     if q is None:
         raise RuntimeError("no notification queue configured "
-                           "(see notification.toml / fs.configure)")
+                           "(see notification.toml)")
     root = _resolve(env, args[0] if args else None)
     sent = 0
 

@@ -228,12 +228,11 @@ def s3_circuitbreaker(env, args, out):
 def s3_clean_uploads(env, args, out):
     """Drop multipart upload scratch dirs older than the cutoff
     (command_s3_clean_uploads.go)."""
+    from ..registry import parse_duration
+
     opts = _kv(args)
-    spec = opts.get("timeAgo", "24h") or "24h"
-    units = {"s": 1, "m": 60, "h": 3600, "d": 86400}
-    mult = units.get(spec[-1], 3600)
-    age = float(spec[:-1] if spec[-1] in units else spec) * mult
-    cutoff = time.time() - age
+    cutoff = time.time() - parse_duration(opts.get("timeAgo", "24h") or "24h",
+                                          flag="-timeAgo")
     stub = _stub(env)
     uploads_dir = f"{BUCKETS_DIR}/.uploads"
     import grpc

@@ -440,11 +440,9 @@ def volume_delete_empty(env, args, out):
     p.add_argument("-force", action="store_true")
     opts = p.parse_args(args)
     env.confirm_is_locked()
-    units = {"s": 1, "m": 60, "h": 3600, "d": 86400}
-    spec = opts.quietFor
-    quiet_s = float(spec[:-1] if spec[-1] in units else spec) * \
-        units.get(spec[-1], 3600)
-    cutoff = _time.time() - quiet_s
+    from ..registry import parse_duration
+
+    cutoff = _time.time() - parse_duration(opts.quietFor, flag="-quietFor")
     deleted = 0
     for dn in env.collect_data_nodes():
         for disk in dn.disk_infos.values():

@@ -55,3 +55,30 @@ def repl(env) -> None:
 
 # importing the command modules registers them
 from . import commands  # noqa: E402,F401
+
+
+def kv_flags(args) -> dict:
+    """Shared '-k=v' / bare '-flag' parser for simple commands (the same
+    shape remote.py's commands use; richer commands use argparse)."""
+    out = {}
+    for a in args:
+        if a.startswith("-"):
+            k, _, v = a[1:].partition("=")
+            out[k] = v
+    return out
+
+
+_DURATION_UNITS = {"s": 1, "m": 60, "h": 3600, "d": 86400}
+
+
+def parse_duration(spec: str, *, flag: str = "duration") -> float:
+    """'90s' / '15m' / '24h' / '7d' -> seconds; a bare number or anything
+    unparsable is an error (silent unit guessing misreads operator intent)."""
+    spec = (spec or "").strip()
+    if len(spec) >= 2 and spec[-1] in _DURATION_UNITS:
+        try:
+            return float(spec[:-1]) * _DURATION_UNITS[spec[-1]]
+        except ValueError:
+            pass
+    raise RuntimeError(
+        f"bad {flag} {spec!r}: use <number><unit> with unit one of s/m/h/d")

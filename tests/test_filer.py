@@ -132,6 +132,24 @@ def test_meta_log_survives_restart(tmp_path):
     f2.store.close()
 
 
+def test_notification_queue_receives_events():
+    """filer_notify.go NotifyUpdateEvent -> Queue.SendMessage: a configured
+    publisher sees every metadata event."""
+    from seaweedfs_tpu.notification import MemoryQueue
+
+    f = Filer(get_store("memory"))
+    q = MemoryQueue()
+    f.notification_queue = q
+    f.create_entry(Entry(full_path="/nq/file.txt"))
+    f.delete_entry("/nq/file.txt")
+    keys = [k for k, _ in q.events]
+    assert "/nq/file.txt" in keys
+    creates = [m for k, m in q.events if m.new_entry.name == "file.txt"]
+    deletes = [m for k, m in q.events
+               if m.old_entry.name == "file.txt" and not m.new_entry.name]
+    assert creates and deletes
+
+
 def test_meta_log_outlives_deque_window():
     """A subscriber that lagged past the bounded deque reads the persisted
     log instead of silently losing events (round-1 weak #8)."""

@@ -194,3 +194,51 @@ def test_guard_whitelist(tmp_path):
         vsrv.stop()
         master.stop()
         rpc.reset_channels()
+
+
+# -- status UIs (master_ui/volume_server_ui/filer_ui templates.go) ---------
+
+def test_status_ui_pages(tmp_path):
+    from seaweedfs_tpu.server.filer import FilerServer
+
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport, volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "vol")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), pulse_seconds=1)
+    vsrv.start()
+    fsrv = FilerServer(ip="localhost", port=_free_port(),
+                       master=f"localhost:{mport}")
+    fsrv.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and not master.topo.nodes:
+            time.sleep(0.05)
+
+        r = requests.get(f"http://localhost:{mport}/", timeout=10)
+        assert r.status_code == 200
+        assert "text/html" in r.headers["Content-Type"]
+        assert "Master" in r.text and vsrv.address in r.text
+        assert "Topology" in r.text
+
+        r = requests.get(f"http://{vsrv.address}/ui", timeout=10)
+        assert r.status_code == 200 and "Volume Server" in r.text
+        assert "Disks" in r.text
+
+        # filer: browsers (Accept: text/html) get the directory browser,
+        # API clients keep getting JSON
+        requests.post(f"http://{fsrv.address}/ui-docs/readme.txt",
+                      files={"file": ("readme.txt", b"hello ui")}, timeout=10)
+        r = requests.get(f"http://{fsrv.address}/ui-docs/",
+                         headers={"Accept": "text/html"}, timeout=10)
+        assert r.status_code == 200 and "readme.txt" in r.text
+        assert "<table>" in r.text
+        r = requests.get(f"http://{fsrv.address}/ui-docs/", timeout=10)
+        assert r.headers["Content-Type"].startswith("application/json")
+        assert "readme.txt" in json.dumps(r.json())
+    finally:
+        fsrv.stop()
+        vsrv.stop()
+        master.stop()
+        rpc.reset_channels()

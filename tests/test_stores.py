@@ -215,3 +215,89 @@ def test_filer_meta_aggregation(tmp_path):
         vsrv.stop()
         master.stop()
         rpc.reset_channels()
+
+
+def test_abstract_sql_dialect_layer(tmp_path):
+    """The shared SQL layer (abstract_sql_store.go rebuild): dialects only
+    supply SQL + connections; the store logic is dialect-agnostic."""
+    from seaweedfs_tpu.filer.entry import Entry
+    from seaweedfs_tpu.filer.stores.abstract_sql import (
+        AbstractSqlStore,
+        MySqlDialect,
+        PostgresDialect,
+        SqliteDialect,
+    )
+
+    # mysql/postgres dialects generate their exact SQL shapes...
+    my = MySqlDialect()
+    assert "ON DUPLICATE KEY UPDATE" in my.upsert("filemeta")
+    assert my.find("filemeta").count("%s") == 2
+    pg = PostgresDialect()
+    assert "ON CONFLICT(directory,name)" in pg.upsert("filemeta")
+    assert "BYTEA" in pg.create_table("filemeta")
+    # ...but refuse to connect without their client libraries
+    import pytest as _pytest
+
+    with _pytest.raises(RuntimeError, match="pymysql"):
+        my.connect()
+    with _pytest.raises(RuntimeError, match="psycopg2"):
+        pg.connect()
+
+    # a foreign-paramstyle dialect runs through the same store logic:
+    # translate the pyformat placeholders onto sqlite at execute() time
+    class _PyformatCursor:
+        def __init__(self, cur):
+            self._cur = cur
+
+        def execute(self, sql, params=()):
+            return self._cur.execute(sql.replace("%s", "?"), params)
+
+        def __getattr__(self, a):
+            return getattr(self._cur, a)
+
+    class _PyformatConn:
+        def __init__(self, conn):
+            self._conn = conn
+
+        def cursor(self):
+            return _PyformatCursor(self._conn.cursor())
+
+        def __getattr__(self, a):
+            return getattr(self._conn, a)
+
+    class FakeMySqlDialect(MySqlDialect):
+        def __init__(self, path):
+            super().__init__()
+            self._sqlite = SqliteDialect(path)
+
+        def create_table(self, table):  # mysql DDL isn't sqlite-valid
+            return self._sqlite.create_table(table)
+
+        def upsert(self, table):
+            return self._sqlite.upsert(table).replace("?", "%s")
+
+        def kv_upsert(self, table):
+            return self._sqlite.kv_upsert(table).replace("?", "%s")
+
+        def connect(self):
+            return _PyformatConn(self._sqlite.connect())
+
+    store = AbstractSqlStore(FakeMySqlDialect(str(tmp_path / "f.db")))
+    store.insert_entry(Entry(full_path="/a/b.txt", content=b"dialect!"))
+    store.insert_entry(Entry(full_path="/a/c.txt"))
+    got = store.find_entry("/a/b.txt")
+    assert got is not None and got.content == b"dialect!"
+    names = [e.name for e in store.list_directory_entries("/a")]
+    assert names == ["b.txt", "c.txt"]
+    store.kv_put(b"k", b"v")
+    assert store.kv_get(b"k") == b"v"
+    store.delete_folder_children("/a")
+    assert store.find_entry("/a/b.txt") is None
+    store.close()
+
+
+def test_mysql_postgres_registered():
+    from seaweedfs_tpu.filer.filerstore import available_stores
+
+    avail = available_stores()
+    assert "mysql" in avail and "postgres" in avail and "sqlite" in avail
