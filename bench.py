@@ -35,7 +35,8 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _DEVICE_PROG = r"""
 import json, os, sys, time, traceback
 
-def bench(data_shards=10, parity_shards=4, col_bytes=8*1024*1024, iters=8):
+def bench(data_shards=10, parity_shards=4, col_bytes=32*1024*1024, iters=8,
+          repeats=3):
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -48,14 +49,20 @@ def bench(data_shards=10, parity_shards=4, col_bytes=8*1024*1024, iters=8):
                                      dtype=np.uint8)) for _ in range(2)]
 
     def run_once():
+        # large columns + best-of-N: the tunneled chip's dispatch latency
+        # varies run to run; sizing the batch up keeps a latency-bound
+        # round from cratering the measured device throughput
         coder.encode_parity(bufs[0]).block_until_ready()  # compile
         coder.encode_parity(bufs[1]).block_until_ready()
-        t0 = time.perf_counter()
-        outs = [coder.encode_parity(bufs[i % 2]) for i in range(iters)]
-        for o in outs:
-            o.block_until_ready()
-        dt = time.perf_counter() - t0
-        return data_shards * col_bytes * iters / dt / 1e9
+        best = 0.0
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            outs = [coder.encode_parity(bufs[i % 2]) for i in range(iters)]
+            for o in outs:
+                o.block_until_ready()
+            dt = time.perf_counter() - t0
+            best = max(best, data_shards * col_bytes * iters / dt / 1e9)
+        return best
 
     kernel = "pallas" if _use_pallas(col_bytes) else "xla"
     if kernel == "pallas":

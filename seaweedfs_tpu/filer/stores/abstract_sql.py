@@ -34,13 +34,16 @@ class SqlDialect:
     def _p(self, n: int) -> list[str]:
         return [self.param] * n
 
+    def kv_table(self, table: str) -> str:
+        return f"{table}_kv"
+
     def create_table(self, table: str) -> str:
         return (f"CREATE TABLE IF NOT EXISTS {table} ("
                 f"directory TEXT NOT NULL, name TEXT NOT NULL, meta BLOB, "
                 f"PRIMARY KEY (directory, name))")
 
     def create_kv_table(self, table: str) -> str:
-        return (f"CREATE TABLE IF NOT EXISTS {table}_kv "
+        return (f"CREATE TABLE IF NOT EXISTS {self.kv_table(table)} "
                 f"(k BLOB PRIMARY KEY, v BLOB)")
 
     def drop_table(self, table: str) -> str:
@@ -73,11 +76,11 @@ class SqlDialect:
 
     def kv_upsert(self, table: str) -> str:
         a, b = self._p(2)
-        return (f"INSERT INTO {table}_kv(k,v) VALUES({a},{b}) "
+        return (f"INSERT INTO {self.kv_table(table)}(k,v) VALUES({a},{b}) "
                 f"ON CONFLICT(k) DO UPDATE SET v=excluded.v")
 
     def kv_get(self, table: str) -> str:
-        return f"SELECT v FROM {table}_kv WHERE k={self.param}"
+        return f"SELECT v FROM {self.kv_table(table)} WHERE k={self.param}"
 
     def connect(self):
         raise NotImplementedError
@@ -86,6 +89,11 @@ class SqlDialect:
 class SqliteDialect(SqlDialect):
     name = "sqlite"
     param = "?"
+
+    def kv_table(self, table: str) -> str:
+        # round-1 sqlite databases named this table plain "kv" — keep
+        # reading/writing it so existing stores survive the upgrade
+        return "kv"
 
     _mem_seq = 0
     _mem_lock = threading.Lock()
@@ -126,10 +134,17 @@ class MySqlDialect(SqlDialect):
                            password=password, database=database)
 
     def create_table(self, table: str) -> str:
+        # 2 x VARCHAR(383) x 4 bytes/char (utf8mb4) = 3064 bytes, inside
+        # InnoDB's 3072-byte composite index limit
         return (f"CREATE TABLE IF NOT EXISTS `{table}` ("
-                f"`directory` VARCHAR(766) NOT NULL, "
-                f"`name` VARCHAR(766) NOT NULL, `meta` LONGBLOB, "
+                f"`directory` VARCHAR(383) NOT NULL, "
+                f"`name` VARCHAR(383) NOT NULL, `meta` LONGBLOB, "
                 f"PRIMARY KEY (`directory`, `name`)) CHARACTER SET utf8mb4")
+
+    def create_kv_table(self, table: str) -> str:
+        # BLOB cannot be a MySQL key; bounded VARBINARY can
+        return (f"CREATE TABLE IF NOT EXISTS `{self.kv_table(table)}` "
+                f"(k VARBINARY(255) PRIMARY KEY, v LONGBLOB)")
 
     def upsert(self, table: str) -> str:
         return (f"INSERT INTO `{table}`(directory,name,meta) "
@@ -137,7 +152,7 @@ class MySqlDialect(SqlDialect):
                 f"ON DUPLICATE KEY UPDATE meta=VALUES(meta)")
 
     def kv_upsert(self, table: str) -> str:
-        return (f"INSERT INTO `{table}_kv`(k,v) VALUES(%s,%s) "
+        return (f"INSERT INTO `{self.kv_table(table)}`(k,v) VALUES(%s,%s) "
                 f"ON DUPLICATE KEY UPDATE v=VALUES(v)")
 
     def connect(self):
@@ -168,13 +183,18 @@ class PostgresDialect(SqlDialect):
                 f"name VARCHAR(65535) NOT NULL, meta BYTEA, "
                 f"PRIMARY KEY (directory, name))")
 
+    def create_kv_table(self, table: str) -> str:
+        # Postgres has no BLOB type — BYTEA throughout
+        return (f'CREATE TABLE IF NOT EXISTS "{self.kv_table(table)}" '
+                f"(k BYTEA PRIMARY KEY, v BYTEA)")
+
     def upsert(self, table: str) -> str:
         return (f'INSERT INTO "{table}"(directory,name,meta) '
                 f"VALUES(%s,%s,%s) ON CONFLICT(directory,name) "
                 f"DO UPDATE SET meta=EXCLUDED.meta")
 
     def kv_upsert(self, table: str) -> str:
-        return (f'INSERT INTO "{table}_kv"(k,v) VALUES(%s,%s) '
+        return (f'INSERT INTO "{self.kv_table(table)}"(k,v) VALUES(%s,%s) '
                 f"ON CONFLICT(k) DO UPDATE SET v=EXCLUDED.v")
 
     def connect(self):

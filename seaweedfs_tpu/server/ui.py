@@ -31,13 +31,25 @@ def page(title: str, body: str) -> bytes:
             f"</p></body></html>").encode()
 
 
+class Raw(str):
+    """Marker for cells that are pre-built trusted markup. Only code in
+    this module constructs Raw — every other value (including anything a
+    client or heartbeat supplied that merely LOOKS like markup) is
+    escaped."""
+
+
+def link(href: str, text: str) -> Raw:
+    return Raw(f"<a href='{html.escape(href, quote=True)}'>"
+               f"{html.escape(text)}</a>")
+
+
 def table(headers: list[str], rows: list[list]) -> str:
     out = ["<table><tr>"]
     out += [f"<th>{html.escape(str(h))}</th>" for h in headers]
     out.append("</tr>")
     for row in rows:
         out.append("<tr>")
-        out += [f"<td>{c if str(c).startswith('<a ') else html.escape(str(c))}"
+        out += [f"<td>{c if isinstance(c, Raw) else html.escape(str(c))}"
                 f"</td>" for c in row]
         out.append("</tr>")
     out.append("</table>")
@@ -64,11 +76,8 @@ def master_ui(ms) -> bytes:
     rows = []
     for dn in sorted(ms.topo.nodes.values(), key=lambda n: n.url):
         ec = sum(bin(e.bits).count("1") for e in dn.ec_shards.values())
-        # dn.url comes from heartbeats (untrusted input) — escape it even
-        # inside our own anchor markup
-        url = html.escape(dn.url, quote=True)
         rows.append([dn.data_center, dn.rack,
-                     f"<a href='http://{url}/ui'>{url}</a>",
+                     link(f"http://{dn.url}/ui", dn.url),
                      len(dn.volumes), dn.max_volume_count, ec])
     body += "<h2>Topology</h2>" + table(
         ["DataCenter", "Rack", "Node", "Volumes", "Max", "EC shards"], rows)
@@ -134,8 +143,8 @@ def filer_ui(srv, path: str, entries) -> bytes:
     rows = []
     for e in entries:
         name = e.name + ("/" if e.is_directory else "")
-        href = html.escape(e.full_path) + ("?ui=1" if e.is_directory else "")
-        rows.append([f"<a href='{href}'>{html.escape(name)}</a>",
+        href = e.full_path + ("?ui=1" if e.is_directory else "")
+        rows.append([link(href, name),
                      f"{e.size():,}", e.attr.mime or "—",
                      time.strftime("%F %T", time.localtime(e.attr.mtime))
                      if e.attr.mtime else "—"])

@@ -10,7 +10,7 @@ from __future__ import annotations
 import json
 
 from ...remote_storage import RemoteConf, RemoteGateway
-from ..registry import command
+from ..registry import command, kv_flags as _kv
 
 
 @command("remote.configure",
@@ -72,13 +72,7 @@ def remote_uncache(env, args, out):
     print(f"uncached {opts['dir']}", file=out)
 
 
-def _kv(args) -> dict:
-    out = {}
-    for a in args:
-        if a.startswith("-"):
-            k, _, v = a[1:].partition("=")
-            out[k] = v
-    return out
+
 
 
 @command("remote.mount.buckets",

@@ -12,7 +12,7 @@ import json
 import time
 
 from ...pb import filer_pb2, rpc
-from ..registry import command
+from ..registry import command, kv_flags as _kv
 
 BUCKETS_DIR = "/buckets"
 
@@ -288,10 +288,4 @@ def mq_topic_list(env, args, out):
         print("no topics", file=out)
 
 
-def _kv(args) -> dict:
-    out = {}
-    for a in args:
-        if a.startswith("-"):
-            k, _, v = a[1:].partition("=")
-            out[k] = v
-    return out
+

@@ -53,10 +53,6 @@ def repl(env) -> None:
         run_command(env, line)
 
 
-# importing the command modules registers them
-from . import commands  # noqa: E402,F401
-
-
 def kv_flags(args) -> dict:
     """Shared '-k=v' / bare '-flag' parser for simple commands (the same
     shape remote.py's commands use; richer commands use argparse)."""
@@ -82,3 +78,7 @@ def parse_duration(spec: str, *, flag: str = "duration") -> float:
             pass
     raise RuntimeError(
         f"bad {flag} {spec!r}: use <number><unit> with unit one of s/m/h/d")
+
+
+# importing the command modules registers them
+from . import commands  # noqa: E402,F401

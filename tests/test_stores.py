@@ -273,6 +273,12 @@ def test_abstract_sql_dialect_layer(tmp_path):
         def create_table(self, table):  # mysql DDL isn't sqlite-valid
             return self._sqlite.create_table(table)
 
+        def create_kv_table(self, table):
+            return self._sqlite.create_kv_table(table)
+
+        def kv_table(self, table):
+            return self._sqlite.kv_table(table)
+
         def upsert(self, table):
             return self._sqlite.upsert(table).replace("?", "%s")
 
@@ -301,3 +307,26 @@ def test_mysql_postgres_registered():
 
     avail = available_stores()
     assert "mysql" in avail and "postgres" in avail and "sqlite" in avail
+
+
+def test_sqlite_kv_table_backcompat(tmp_path):
+    """Round-1 sqlite stores used a table named plain 'kv'; upgrades must
+    keep reading it."""
+    import sqlite3
+
+    db = str(tmp_path / "old.db")
+    c = sqlite3.connect(db)
+    c.execute("CREATE TABLE filemeta (directory TEXT NOT NULL, "
+              "name TEXT NOT NULL, meta BLOB, PRIMARY KEY(directory,name))")
+    c.execute("CREATE TABLE kv (k BLOB PRIMARY KEY, v BLOB)")
+    c.execute("INSERT INTO kv(k,v) VALUES(?,?)", (b"old-key", b"old-value"))
+    c.commit()
+    c.close()
+
+    from seaweedfs_tpu.filer.filerstore import get_store
+
+    store = get_store("sqlite", db_path=db)
+    assert store.kv_get(b"old-key") == b"old-value"
+    store.kv_put(b"new-key", b"new-value")
+    assert store.kv_get(b"new-key") == b"new-value"
+    store.close()
