@@ -254,8 +254,12 @@ class UploadPipeline:
         if chunk is None:
             return
         self._sealed[logic] = chunk
+        # drop completed entries so finished MemChunks can be collected —
+        # only in-flight/cancelled ones matter to flush()/close(), and
+        # upload errors travel via last_err, not Future.result()
+        self._futures = [(f, c) for (f, c) in self._futures if not f.done()]
         fut = self._pool.submit(self._upload, chunk)
-        self._futures.append(fut)
+        self._futures.append((fut, chunk))
 
     def _upload(self, chunk: MemChunk | SwapFileChunk) -> None:
         base = chunk.logic_index * self.chunk_size
@@ -272,14 +276,16 @@ class UploadPipeline:
             self.last_err = err
         finally:
             with self._lock:
-                mine = self._sealed.get(chunk.logic_index) is chunk
-                if mine:
+                # a newer generation of the same logic index may have been
+                # sealed over us — only drop the mapping if it is still ours
+                if self._sealed.get(chunk.logic_index) is chunk:
                     del self._sealed[chunk.logic_index]
-            if mine:  # close() may have already reclaimed it
-                if isinstance(chunk, SwapFileChunk):
-                    chunk.release()  # recycle the slot once no read holds it
-                else:
-                    self.budget.give_back()
+            # the upload task owns its sealed chunk: resources return here
+            # exactly once (close() reclaims only never-started uploads)
+            if isinstance(chunk, SwapFileChunk):
+                chunk.release()  # recycle the slot once no read holds it
+            else:
+                self.budget.give_back()
 
     # -- read-your-writes --------------------------------------------------
 
@@ -348,7 +354,7 @@ class UploadPipeline:
             for logic in sorted(self._writable):
                 self._seal_locked(logic)
             futures, self._futures = self._futures, []
-        for f in futures:
+        for f, _chunk in futures:
             f.result()
         if self.last_err is not None:
             err, self.last_err = self.last_err, None
@@ -356,12 +362,17 @@ class UploadPipeline:
 
     def close(self) -> None:
         self._pool.shutdown(wait=False, cancel_futures=True)
-        # return budget / slots still held by unflushed or cancelled chunks
+        # reclaim chunks whose upload will never run: still-writable ones
+        # and sealed ones whose future got cancelled before starting
+        # (a running/finished upload returns its own chunk's resources)
         with self._lock:
-            leftovers = [c for group in (self._writable, self._sealed)
-                         for c in group.values()]
+            leftovers = list(self._writable.values())
             self._writable.clear()
             self._sealed.clear()
+            futures, self._futures = self._futures, []
+        for f, chunk in futures:
+            if f.cancelled():
+                leftovers.append(chunk)
         for c in leftovers:
             if isinstance(c, SwapFileChunk):
                 c.release()

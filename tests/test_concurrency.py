@@ -1,0 +1,259 @@
+"""Concurrency stress suite — the framework's race-detection analogue
+(SURVEY.md §5.2). The reference leans on `go test -race` over its heavily
+goroutine'd code; Python has no sanitizer, so this suite hammers the
+shared-state hot paths from many threads and asserts invariants that any
+interleaving must preserve:
+
+  * needle isolation: a read returns the exact bytes written for that fid
+    (or a clean 404 after delete) — never another writer's payload
+  * index/data agreement after the storm (volume check_and_fix clean)
+  * filer namespace consistency under concurrent create/rename/delete
+  * upload-pipeline byte integrity under reader/writer/spill contention
+"""
+
+import hashlib
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from seaweedfs_tpu.operation import assign, upload_data
+from seaweedfs_tpu.pb import rpc
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+from seaweedfs_tpu.storage.file_id import parse_file_id
+
+THREADS = 8
+OPS_PER_THREAD = 40
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport, volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path_factory.mktemp("vol"))],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), pulse_seconds=1)
+    vsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    yield master, vsrv
+    vsrv.stop()
+    master.stop()
+    rpc.reset_channels()
+
+
+def _run_threads(fn, n=THREADS):
+    errors: list[BaseException] = []
+
+    def wrapped(tid):
+        try:
+            fn(tid)
+        except BaseException as e:  # noqa: BLE001
+            errors.append(e)
+
+    threads = [threading.Thread(target=wrapped, args=(t,)) for t in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    if errors:
+        raise errors[0]
+
+
+def test_volume_write_read_delete_storm(cluster):
+    """Concurrent writers/readers/deleters on one server: every read sees
+    its own payload or a 404 — never crossed wires — and the needle index
+    agrees with the data file afterwards."""
+    master, vsrv = cluster
+    session = requests.Session()
+    written: dict[str, bytes] = {}
+    written_lock = threading.Lock()
+    rng_global = np.random.default_rng(1234)
+    seeds = rng_global.integers(0, 2**31, size=THREADS)
+
+    def worker(tid):
+        rng = np.random.default_rng(seeds[tid])
+        mine: list[tuple[str, bytes]] = []
+        for i in range(OPS_PER_THREAD):
+            op = rng.integers(0, 10)
+            if op < 6 or not mine:  # write
+                payload = (f"t{tid}i{i}:".encode()
+                           + rng.integers(0, 256, int(rng.integers(100, 8000)),
+                                          dtype=np.uint8).tobytes())
+                a = assign(master.address)
+                assert not a.error, a.error
+                r = upload_data(f"http://{a.url}/{a.fid}", payload)
+                assert not r.error, r.error
+                mine.append((a.fid, payload))
+                with written_lock:
+                    written[a.fid] = payload
+            elif op < 9:  # read one of ours
+                fid, payload = mine[int(rng.integers(0, len(mine)))]
+                resp = session.get(f"http://{vsrv.address}/{fid}", timeout=30)
+                if resp.status_code == 200:
+                    assert resp.content == payload, f"crossed wires on {fid}"
+                else:
+                    assert resp.status_code == 404  # deleted by us earlier
+            else:  # delete one of ours
+                fid, _ = mine.pop(int(rng.integers(0, len(mine))))
+                session.delete(f"http://{vsrv.address}/{fid}", timeout=30)
+                with written_lock:
+                    written.pop(fid, None)
+
+    _run_threads(worker)
+
+    # post-storm: all surviving fids readable with exact bytes
+    for fid, payload in written.items():
+        r = session.get(f"http://{vsrv.address}/{fid}", timeout=30)
+        assert r.status_code == 200 and r.content == payload, fid
+
+    # index/data agreement on every volume touched: the startup integrity
+    # scan must find nothing to truncate (a torn/interleaved append would
+    # shrink file_count)
+    for loc in vsrv.store.locations:
+        for vid, v in list(loc.volumes.items()):
+            before = v.file_count()
+            v.check_and_fix_integrity()
+            assert v.file_count() == before, f"volume {vid} lost records"
+
+
+def test_filer_namespace_storm(tmp_path_factory):
+    """Concurrent create/rename/delete on one Filer: no lost updates — the
+    final namespace equals the union of surviving per-thread files, and
+    every surviving file's content is its writer's."""
+    from seaweedfs_tpu.filer import Entry, Filer
+    from seaweedfs_tpu.filer.filerstore import get_store
+
+    f = Filer(get_store("sqlite", db_path=str(
+        tmp_path_factory.mktemp("ns") / "f.db")))
+    survivors: dict[str, bytes] = {}
+    lock = threading.Lock()
+
+    def worker(tid):
+        base = f"/storm/t{tid}"
+        mine = []
+        for i in range(OPS_PER_THREAD):
+            path = f"{base}/file{i}.txt"
+            body = f"payload-{tid}-{i}".encode()
+            f.create_entry(Entry(full_path=path, content=body))
+            mine.append((path, body))
+            if i % 7 == 3:  # rename a quarter of them
+                old, body2 = mine.pop()
+                new = f"{base}/renamed{i}.txt"
+                f.rename(old, new)
+                mine.append((new, body2))
+            if i % 11 == 5 and mine:  # delete some
+                victim, _ = mine.pop(0)
+                f.delete_entry(victim)
+        with lock:
+            survivors.update(dict(mine))
+
+    _run_threads(worker)
+
+    for path, body in survivors.items():
+        got = f.find_entry(path)
+        assert got is not None, f"lost update: {path}"
+        assert got.content == body, f"content mixed up: {path}"
+    # directory listings agree with point lookups
+    for tid in range(THREADS):
+        listed = {e.full_path for e in f.list_entries(f"/storm/t{tid}")}
+        expect = {p for p in survivors if p.startswith(f"/storm/t{tid}/")}
+        assert listed == expect
+    f.store.close()
+
+
+def test_upload_pipeline_reader_writer_spill_storm(tmp_path):
+    """Readers racing writers and the uploader across the spill boundary:
+    reads-before-flush always reflect the latest write for that region."""
+    from seaweedfs_tpu.mount.page_writer import MemBudget, UploadPipeline
+
+    chunk = 4096
+    gate = threading.Event()
+    uploaded = {}
+
+    save_lock = threading.Lock()
+
+    def slow_save(data, offset, ts):
+        gate.wait(20)
+        with save_lock:  # keep the newest stamp per region (uploads of
+            # successive sealed generations finish in any order)
+            if offset not in uploaded or uploaded[offset][0] < ts:
+                uploaded[offset] = (ts, data)
+
+    p = UploadPipeline(chunk, slow_save, concurrency=2,
+                       budget=MemBudget(2), swap_dir=str(tmp_path))
+    region_vals: dict[int, int] = {}
+    vals_lock = threading.Lock()
+    stop = threading.Event()
+    read_errors = []
+
+    def writer(tid):
+        rng = np.random.default_rng(tid)
+        for i in range(OPS_PER_THREAD):
+            region = int(rng.integers(0, 16))
+            stamp = (tid << 16) | i
+            blob = stamp.to_bytes(4, "big") * (chunk // 4)
+            with vals_lock:
+                p.save_data_at(blob, region * chunk, time.time_ns())
+                region_vals[region] = stamp
+
+    def reader():
+        rng = np.random.default_rng(999)
+        buf = memoryview(bytearray(chunk))
+        while not stop.is_set():
+            region = int(rng.integers(0, 16))
+            with vals_lock:
+                want = region_vals.get(region)
+                covered = p.maybe_read_data_at(buf, region * chunk)
+                if want is not None and covered == [(0, chunk)]:
+                    got = int.from_bytes(bytes(buf[:4]), "big")
+                    if got != want:
+                        read_errors.append((region, want, got))
+
+    rt = threading.Thread(target=reader)
+    rt.start()
+    try:
+        _run_threads(writer, n=4)
+    finally:
+        stop.set()
+        rt.join()
+        gate.set()
+    p.flush()
+    assert not read_errors, read_errors[:3]
+    assert p.swapped_out > 0, "storm never hit the spill path"
+    # newest generation wins per region in the uploaded bytes
+    for region, stamp in region_vals.items():
+        assert uploaded[region * chunk][1][:4] == stamp.to_bytes(4, "big")
+    p.close()
+
+
+def test_mem_budget_never_negative_under_churn(tmp_path):
+    from seaweedfs_tpu.mount.page_writer import MemBudget, UploadPipeline
+
+    budget = MemBudget(4)
+
+    def churn(tid):
+        p = UploadPipeline(256, lambda d, o, t: None, concurrency=2,
+                           budget=budget, swap_dir=str(tmp_path))
+        for i in range(OPS_PER_THREAD):
+            p.save_data_at(b"x" * 256, (i % 8) * 256, i)
+        p.flush()
+        p.close()
+
+    _run_threads(churn)
+    assert 0 <= budget._held <= budget.limit, budget._held
+    # all capacity is back
+    takes = sum(1 for _ in range(4) if budget.try_take())
+    assert takes == 4

@@ -392,3 +392,30 @@ def test_wfs_getattr_includes_dirty_size(wfs):
 def test_wfs_statfs(wfs):
     st = wfs.statfs()
     assert st["total"] >= 0
+
+
+def test_pipeline_releases_completed_chunk_refs(tmp_path):
+    """Completed uploads must not pin their MemChunk buffers until flush
+    (unbounded RSS on long streaming writes): the next seal prunes them."""
+    import gc
+    import threading
+    import weakref
+
+    gate = threading.Event()
+    p = UploadPipeline(64, lambda d, o, t: gate.wait(10), concurrency=2)
+    p.save_data_at(b"x" * 64, 0, 1)  # seals chunk 0; upload blocked on gate
+    with p._lock:
+        ref = weakref.ref(next(iter(p._sealed.values())))
+    assert ref() is not None
+    gate.set()
+    deadline = time.time() + 5
+    while time.time() < deadline and p._sealed:
+        time.sleep(0.01)  # upload drains without any flush()
+    p.save_data_at(b"y" * 64, 64, 2)  # next seal prunes finished futures
+    deadline = time.time() + 5
+    while time.time() < deadline and ref() is not None:
+        gc.collect()
+        time.sleep(0.05)
+    assert ref() is None, "completed chunk still pinned by _futures"
+    p.flush()
+    p.close()
