@@ -152,7 +152,17 @@ def main(argv=None) -> int:
     mfp.add_argument("-mdir", default="")
 
     sub.add_parser("autocomplete", help="print bash completion script")
+    sub.add_parser("unautocomplete",
+                   help="print command to remove bash completion")
     sub.add_parser("update", help="self-update (not applicable here)")
+
+    # `weed fuse` — /etc/fstab-style mount entry point (command/fuse.go):
+    # same mount machinery, options packed into a single -o string
+    fu = sub.add_parser("fuse", help="mount via fstab-style options")
+    fu.add_argument("dir", help="mount point")
+    fu.add_argument("-o", default="", help="comma-separated options "
+                    "(filer=host:port,collection=c,replication=xyz,"
+                    "chunkSizeLimitMB=n,cacheDir=d)")
 
     up = sub.add_parser("upload", help="upload files")
     up.add_argument("-master", default="localhost:9333")
@@ -584,6 +594,26 @@ def _run(opts) -> int:
         ms.stop()
         return 0
 
+    if opts.cmd == "unautocomplete":
+        print("complete -r weed-tpu 2>/dev/null  # remove bash completion")
+        return 0
+
+    if opts.cmd == "fuse":
+        from ..mount import WFS, mount
+        from ..pb import rpc
+
+        o = dict(kv.partition("=")[::2] for kv in opts.o.split(",") if kv)
+        wfs = WFS(rpc.grpc_address(o.get("filer", "localhost:8888")),
+                  chunk_size=int(o.get("chunkSizeLimitMB", 2)) * 1024 * 1024,
+                  collection=o.get("collection", ""),
+                  replication=o.get("replication", ""),
+                  cache_dir=o.get("cacheDir") or None)
+        try:
+            mount(wfs, opts.dir)
+        finally:
+            wfs.close()
+        return 0
+
     if opts.cmd == "autocomplete":
         cmds = " ".join(sorted(
             c for c in ("master volume filer s3 webdav iam mq.broker "
@@ -592,7 +622,8 @@ def _run(opts) -> int:
                         "filer.replicate filer.backup filer.cat filer.copy "
                         "filer.meta.tail filer.meta.backup "
                         "filer.remote.sync filer.remote.gateway "
-                        "master.follower version scaffold").split()))
+                        "master.follower version scaffold fuse "
+                        "unautocomplete update").split()))
         print(f"""# bash completion for weed-tpu
 _weed_tpu() {{
   local cur=${{COMP_WORDS[COMP_CWORD]}}

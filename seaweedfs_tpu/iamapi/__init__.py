@@ -132,6 +132,12 @@ class IamServer:
                 [i for i in self.identities if i.access_key])
         if not iam.enabled:
             return None
+        # Admin actions are SigV4-only: v2 signatures bind neither the
+        # body nor a payload-hash claim, so accepting them here would let
+        # a captured v2 token be replayed forever with any action body.
+        if not headers.get("Authorization", "").startswith(
+                "AWS4-HMAC-SHA256"):
+            return "AccessDenied"
         # The signature covers whatever hash the client signed, but that
         # hash must actually match the body — otherwise a captured signed
         # request could be replayed with a swapped action body.

@@ -68,9 +68,14 @@ class IdentityAccessManagement:
         if auth.startswith("AWS4-HMAC-SHA256 "):
             return self._verify_v4(auth, method, path, query, headers,
                                    payload_hash)
+        if auth.startswith("AWS "):  # legacy signature v2
+            return self._verify_v2(auth, method, path, query, headers)
         qs = urllib.parse.parse_qs(query)
         if "X-Amz-Signature" in qs:
             return self._verify_presigned(method, path, qs, headers)
+        if "Signature" in qs and "AWSAccessKeyId" in qs:
+            return self._verify_presigned_v2(method, path, query, qs,
+                                             headers)
         if auth:
             raise AuthError("AccessDenied", "Unsupported Authorization type")
         return None  # anonymous
@@ -99,6 +104,114 @@ class IdentityAccessManagement:
             raise AuthError("SignatureDoesNotMatch",
                             "The request signature we calculated does not "
                             "match the signature you provided")
+        return ident
+
+    # -- legacy signature v2 (auth_signature_v2.go) ------------------------
+
+    # subresources included in the canonicalized resource (resourceList)
+    _V2_SUBRESOURCES = (
+        "acl", "delete", "lifecycle", "location", "logging", "notification",
+        "partNumber", "policy", "requestPayment", "response-cache-control",
+        "response-content-disposition", "response-content-encoding",
+        "response-content-language", "response-content-type",
+        "response-expires", "torrent", "uploadId", "uploads", "versionId",
+        "versioning", "versions", "website",
+    )
+
+    def _v2_string_to_sign(self, method: str, path: str, query: str,
+                           headers, date: str) -> str:
+        """getStringToSignV2: Verb\\nContent-MD5\\nContent-Type\\nDate\\n
+        CanonicalizedAmzHeaders + CanonicalizedResource."""
+        amz: dict[str, list[str]] = {}
+        for k in headers.keys():
+            lk = k.lower()
+            if not lk.startswith("x-amz-") or lk in amz:
+                continue
+            if hasattr(headers, "get_all"):  # email.message.Message
+                vals = headers.get_all(k) or []
+            else:
+                vals = [headers.get(k, "")]
+            amz[lk] = [" ".join(str(v).split()) for v in vals]
+        canonical_amz = "".join(f"{k}:{','.join(v)}\n"
+                                for k, v in sorted(amz.items()))
+        qs = urllib.parse.parse_qs(query, keep_blank_values=True)
+        sub = []
+        for key in self._V2_SUBRESOURCES:
+            if key in qs:
+                v = qs[key][0]
+                sub.append(f"{key}={v}" if v else key)
+        resource = urllib.parse.quote(urllib.parse.unquote(path), safe="/-_.~")
+        if sub:
+            resource += "?" + "&".join(sub)
+        return "\n".join([method,
+                          headers.get("Content-MD5", "") or "",
+                          headers.get("Content-Type", "") or "",
+                          date,
+                          canonical_amz + resource])
+
+    def _v2_signature(self, secret: str, string_to_sign: str) -> str:
+        import base64
+        import hashlib as _hashlib
+
+        return base64.b64encode(hmac.new(
+            secret.encode(), string_to_sign.encode(),
+            _hashlib.sha1).digest()).decode()
+
+    def _verify_v2(self, auth: str, method: str, path: str, query: str,
+                   headers) -> Identity:
+        """Authorization: AWS AccessKeyId:Signature (doesSignV2Match)."""
+        access_key, _, given = auth[len("AWS "):].strip().partition(":")
+        if not given:
+            raise AuthError("AuthorizationHeaderMalformed", "bad v2 header")
+        ident = self.lookup(access_key)
+        date = headers.get("Date", "") or headers.get("x-amz-date", "")
+        self._check_v2_freshness(date)
+        sts = self._v2_string_to_sign(method, path, query, headers, date)
+        want = self._v2_signature(ident.secret_key, sts)
+        if not hmac.compare_digest(want, given):
+            raise AuthError("SignatureDoesNotMatch",
+                            "v2 signature mismatch")
+        return ident
+
+    @staticmethod
+    def _check_v2_freshness(date: str) -> None:
+        """v2 signatures carry no payload-hash claim, so bound their replay
+        window by the signed Date (AWS's 15-minute skew rule)."""
+        import email.utils
+        import time as _time
+
+        try:
+            signed_at = email.utils.parsedate_to_datetime(date).timestamp()
+        except (TypeError, ValueError):
+            raise AuthError("AccessDenied", "missing or bad Date header")
+        if abs(_time.time() - signed_at) > 900:
+            raise AuthError("AccessDenied", "Request has expired")
+
+    def _verify_presigned_v2(self, method: str, path: str, raw_query: str,
+                             qs: dict, headers) -> Identity:
+        """?AWSAccessKeyId=..&Expires=..&Signature=..
+        (doesPresignV2SignatureMatch)."""
+        import time as _time
+
+        ident = self.lookup(qs["AWSAccessKeyId"][0])
+        expires = qs.get("Expires", [""])[0]
+        try:
+            if int(expires) < _time.time():
+                raise AuthError("AccessDenied", "Request has expired")
+        except ValueError:
+            raise AuthError("AccessDenied", "bad Expires")
+        # strip the auth params from the RAW query (re-encoding decoded
+        # values would corrupt '+', '&' or '=' inside them)
+        rest = "&".join(
+            p for p in raw_query.split("&")
+            if p.split("=", 1)[0] not in ("AWSAccessKeyId", "Expires",
+                                          "Signature"))
+        sts = self._v2_string_to_sign(method, path, rest, headers, expires)
+        want = self._v2_signature(ident.secret_key, sts)
+        given = qs["Signature"][0]
+        if not hmac.compare_digest(want, given):
+            raise AuthError("SignatureDoesNotMatch",
+                            "presigned v2 signature mismatch")
         return ident
 
     # -- presigned URLs ----------------------------------------------------

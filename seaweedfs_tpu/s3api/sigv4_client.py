@@ -121,3 +121,65 @@ def presign_url(method: str, url: str, access_key: str, secret_key: str,
     sig = hmac.new(k, string_to_sign.encode(), hashlib.sha256).hexdigest()
     qs["X-Amz-Signature"] = sig
     return u._replace(query=urllib.parse.urlencode(qs)).geturl()
+
+
+def _v2_sign(secret_key: str, string_to_sign: str) -> str:
+    import base64
+
+    return base64.b64encode(hmac.new(
+        secret_key.encode(), string_to_sign.encode(),
+        hashlib.sha1).digest()).decode()
+
+
+def _v2_subresource(query: str) -> str:
+    """Signed subresource portion of the query, in the verifier's order."""
+    from .auth import IdentityAccessManagement as _IAM
+
+    qs = urllib.parse.parse_qs(query, keep_blank_values=True)
+    sub = []
+    for key in _IAM._V2_SUBRESOURCES:
+        if key in qs:
+            v = qs[key][0]
+            sub.append(f"{key}={v}" if v else key)
+    return "&".join(sub)
+
+
+def _v2_string_to_sign(method: str, path: str, query: str, date: str,
+                       content_type: str = "", content_md5: str = "",
+                       amz_headers: dict | None = None) -> str:
+    canonical_amz = "".join(
+        f"{k.lower()}:{v}\n" for k, v in sorted((amz_headers or {}).items()))
+    resource = urllib.parse.quote(urllib.parse.unquote(path), safe="/-_.~")
+    sub = _v2_subresource(query)
+    if sub:
+        resource += "?" + sub
+    return "\n".join([method, content_md5, content_type, date,
+                      canonical_amz + resource])
+
+
+def sign_request_v2(method: str, url: str, access_key: str, secret_key: str,
+                    content_type: str = "") -> dict[str, str]:
+    """Legacy AWS signature v2 headers (counterpart of the gateway's
+    _verify_v2; auth_signature_v2.go signatureV2)."""
+    u = urllib.parse.urlparse(url)
+    date = time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime())
+    sts = _v2_string_to_sign(method, u.path or "/", u.query, date,
+                             content_type)
+    sig = _v2_sign(secret_key, sts)
+    headers = {"Date": date, "Authorization": f"AWS {access_key}:{sig}"}
+    if content_type:
+        headers["Content-Type"] = content_type
+    return headers
+
+
+def presign_url_v2(method: str, url: str, access_key: str, secret_key: str,
+                   *, expires: int = 3600) -> str:
+    """Legacy presigned URL: ?AWSAccessKeyId&Expires&Signature."""
+    u = urllib.parse.urlparse(url)
+    exp = str(int(time.time()) + expires)
+    sts = _v2_string_to_sign(method, u.path or "/", u.query, exp)
+    sig = _v2_sign(secret_key, sts)
+    qs = dict(urllib.parse.parse_qsl(u.query, keep_blank_values=True))
+    qs.update({"AWSAccessKeyId": access_key, "Expires": exp,
+               "Signature": sig})
+    return u._replace(query=urllib.parse.urlencode(qs)).geturl()

@@ -335,3 +335,52 @@ def test_s3_clean_uploads(stack):
     with pytest.raises(_grpc.RpcError):
         stub.LookupDirectoryEntry(filer_pb2.LookupDirectoryEntryRequest(
             directory="/buckets/.uploads", name=upload_id), timeout=10)
+
+
+# -- legacy signature v2 ----------------------------------------------------
+
+def test_sigv2_header_and_presigned(stack):
+    from seaweedfs_tpu.s3api.sigv4_client import presign_url_v2, sign_request_v2
+
+    *_, s3 = stack
+    base = f"http://localhost:{s3.port}"
+    h = sign_request_v2("PUT", f"{base}/v2bkt", "AKADMIN", "SKADMIN")
+    assert requests.put(f"{base}/v2bkt", headers=h, timeout=30).status_code == 200
+    body = b"v2 signed payload"
+    h = sign_request_v2("PUT", f"{base}/v2bkt/f.bin", "AKADMIN", "SKADMIN")
+    assert requests.put(f"{base}/v2bkt/f.bin", data=body, headers=h,
+                        timeout=30).status_code == 200
+    h = sign_request_v2("GET", f"{base}/v2bkt/f.bin", "AKADMIN", "SKADMIN")
+    r = requests.get(f"{base}/v2bkt/f.bin", headers=h, timeout=30)
+    assert r.status_code == 200 and r.content == body
+
+    # wrong secret rejected
+    h = sign_request_v2("GET", f"{base}/v2bkt/f.bin", "AKADMIN", "WRONG")
+    assert requests.get(f"{base}/v2bkt/f.bin", headers=h,
+                        timeout=30).status_code == 403
+
+    # subresources are part of the signed resource (?acl)
+    h = sign_request_v2("GET", f"{base}/v2bkt?acl", "AKADMIN", "SKADMIN")
+    r = requests.get(f"{base}/v2bkt?acl", headers=h, timeout=30)
+    assert r.status_code == 200 and "AccessControlPolicy" in r.text
+
+    # a correctly-signed but stale request is rejected (15-min window)
+    from seaweedfs_tpu.s3api.sigv4_client import _v2_sign, _v2_string_to_sign
+
+    old = "Mon, 01 Jan 2024 00:00:00 GMT"
+    sig = _v2_sign("SKADMIN",
+                   _v2_string_to_sign("GET", "/v2bkt/f.bin", "", old))
+    r = requests.get(f"{base}/v2bkt/f.bin",
+                     headers={"Date": old,
+                              "Authorization": f"AWS AKADMIN:{sig}"},
+                     timeout=30)
+    assert r.status_code == 403 and "expired" in r.text.lower()
+
+    # presigned v2 works and expires
+    url = presign_url_v2("GET", f"{base}/v2bkt/f.bin", "AKADMIN", "SKADMIN")
+    r = requests.get(url, timeout=30)
+    assert r.status_code == 200 and r.content == body
+    stale = presign_url_v2("GET", f"{base}/v2bkt/f.bin", "AKADMIN",
+                           "SKADMIN", expires=-10)
+    r = requests.get(stale, timeout=30)
+    assert r.status_code == 403 and "expired" in r.text.lower()
