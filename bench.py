@@ -64,19 +64,85 @@ def bench(data_shards=10, parity_shards=4, col_bytes=32*1024*1024, iters=8,
             best = max(best, data_shards * col_bytes * iters / dt / 1e9)
         return best
 
+    # a scalar whose value depends on ALL the device buffers (it subsamples
+    # columns, but its INPUTS are the complete arrays, so reading it back
+    # on the host forces every producing computation to actually finish).
+    # One jit object: re-used across timed iterations (per-arity cache).
+    @jax.jit
+    def _digest(parities):
+        acc = jnp.zeros((), jnp.uint32)
+        for p in parities:
+            acc = acc ^ (p[:, ::4097].astype(jnp.uint32).sum() & 0xFFFF)
+        return acc
+
+    def verified_once():
+        # conservative cross-check: host readback of a digest inside the
+        # timed region. Over the tunneled chip, plain block_until_ready can
+        # acknowledge before device completion (observed > HBM-roofline
+        # readings); this number cannot be inflated that way.
+        import numpy as _np
+
+        outs = [coder.encode_parity(bufs[i % 2]) for i in range(iters)]
+        _digest(outs).block_until_ready()  # compile
+        best = 0.0
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            outs = [coder.encode_parity(bufs[i % 2]) for i in range(iters)]
+            _np.asarray(_digest(outs))
+            dt = time.perf_counter() - t0
+            best = max(best, data_shards * col_bytes * iters / dt / 1e9)
+        return best
+
+    def rebuild_once():
+        # BASELINE config #3: regenerate 3 lost shards (decode/invert) —
+        # timed with the same forced-readback discipline as verified_once
+        import numpy as _np
+
+        shards = coder.encode(bufs[0])
+        present = {i: shards[i] for i in range(coder.total_shards)
+                   if i not in (0, 5, 12)}
+
+        def rebuilt_stack():
+            out = coder.reconstruct(present)  # {0,5,12} -> [B] rows
+            return jnp.stack([out[0], out[5], out[12]])
+
+        _digest([rebuilt_stack()]).block_until_ready()  # compile
+        best = 0.0
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            outs = [rebuilt_stack() for _ in range(4)]
+            _np.asarray(_digest(outs))
+            dt = time.perf_counter() - t0
+            best = max(best, data_shards * col_bytes * 4 / dt / 1e9)
+        return best
+
     kernel = "pallas" if _use_pallas(col_bytes) else "xla"
     if kernel == "pallas":
         try:
-            return run_once(), "pallas", backend
+            gbps = run_once()
         except Exception:
             sys.stderr.write("pallas kernel failed, falling back to XLA:\n"
                              + traceback.format_exc() + "\n")
             os.environ["SEAWEEDFS_TPU_NO_PALLAS"] = "1"
-    return run_once(), "xla", backend
+            kernel = "xla"
+            gbps = run_once()
+    else:
+        gbps = run_once()
+    # secondary metrics must never cost us the headline number
+    extras = {}
+    for name, fn in (("verified_gbps", verified_once),
+                     ("rebuild_gbps", rebuild_once)):
+        try:
+            extras[name] = fn()
+        except Exception:
+            sys.stderr.write(f"{name} bench failed:\n"
+                             + traceback.format_exc() + "\n")
+    return gbps, extras, kernel, backend
 
 try:
-    gbps, kernel, backend = bench()
-    print(json.dumps({"gbps": gbps, "kernel": kernel, "backend": backend}))
+    gbps, extras, kernel, backend = bench()
+    print(json.dumps({"gbps": gbps, "kernel": kernel, "backend": backend,
+                      **extras}))
 except Exception as e:
     traceback.print_exc()
     print(json.dumps({"error": f"{type(e).__name__}: {e}"[:500]}))
@@ -86,7 +152,8 @@ except Exception as e:
 def _bench_device() -> dict:
     """Run the device bench in a subprocess with timeout + retries."""
     attempts = int(os.environ.get("SEAWEEDFS_TPU_BENCH_ATTEMPTS", "2"))
-    per_timeout = float(os.environ.get("SEAWEEDFS_TPU_BENCH_TIMEOUT", "300"))
+    # budget covers three timed benches + their compilations
+    per_timeout = float(os.environ.get("SEAWEEDFS_TPU_BENCH_TIMEOUT", "480"))
     last = "no attempts"
     for attempt in range(attempts):
         try:
@@ -154,6 +221,12 @@ def main() -> int:
     ok = "gbps" in dev
     if ok:
         result["value"] = round(dev["gbps"], 3)
+        if dev.get("verified_gbps"):
+            # lower bound with a host readback forcing device completion
+            # (the tunnel can over-report async-dispatch throughput)
+            result["verified_gbps"] = round(dev["verified_gbps"], 3)
+        if dev.get("rebuild_gbps"):
+            result["rebuild_gbps"] = round(dev["rebuild_gbps"], 3)
         result["kernel"] = dev.get("kernel")
         result["backend"] = dev.get("backend")
         if cpu_gbps:
