@@ -198,3 +198,21 @@ def test_ec_lifecycle_over_grpc(cluster):
         got = requests.get(f"http://{vsrv.address}/{fid}", timeout=30)
         assert got.status_code == 200
         assert got.content == blobs[fid]
+
+
+def test_benchmark_tool(cluster):
+    """`weed benchmark` equivalent runs against a live cluster and reports
+    write/read throughput + latency percentiles (benchmark.go:73-111)."""
+    import types
+
+    from seaweedfs_tpu.command.benchmark import run_benchmark
+
+    master, _ = cluster
+    opts = types.SimpleNamespace(n=60, size=1024, c=8,
+                                 master=master.address, collection="",
+                                 skipRead=False)
+    results = run_benchmark(opts)
+    assert results["write"]["failed"] == 0
+    assert results["write"]["requests_per_sec"] > 0
+    assert results["read"]["failed"] == 0
+    assert results["read"]["requests_per_sec"] > 0
