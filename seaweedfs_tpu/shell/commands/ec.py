@@ -20,11 +20,11 @@ from ...pb import master_pb2, volume_server_pb2 as vs
 from ..registry import command
 
 
-def _collect_ec_nodes(env):
+def _collect_ec_nodes(env, topo=None):
     """-> [(url, free_slots, shard_count)] sorted by free slots desc
     (collectEcNodes / sortEcNodesByFreeslotsDecending)."""
     nodes = []
-    for dn in env.collect_data_nodes():
+    for dn in env.collect_data_nodes(topo):
         free = shards = 0
         for disk in dn.disk_infos.values():
             free += disk.free_volume_count
@@ -108,14 +108,23 @@ def _do_ec_encode(env, vid: int, opts, out) -> None:
     total_shards = ((opts.dataShards or 10) + (opts.parityShards or 4))
     print(f"volume {vid}: generated {total_shards} shards on {source}", file=out)
 
-    # 3. spread shards across servers (balancedEcDistribution + parallel copy)
-    nodes = _collect_ec_nodes(env)
+    # 3. spread shards across servers (balancedEcDistribution + parallel
+    # copy), rack-aware: losing one rack must cost as few shards of this
+    # volume as possible (the reference README's "rack-aware placement";
+    # pickRackToBalanceShardsInto in command_ec_balance.go)
+    topo = env.volume_list().topology_info  # one snapshot for both views
+    nodes = _collect_ec_nodes(env, topo)
     if not nodes:
         raise ValueError("no ec-capable nodes")
+    racks = env.node_racks(topo)
     alloc: dict[str, list[int]] = defaultdict(list)
+    rack_load: dict[tuple[str, str], int] = defaultdict(int)
     for sid in range(total_shards):
-        nodes.sort(key=lambda n: (len(alloc[n[0]]), -n[1]))
-        alloc[nodes[0][0]].append(sid)
+        nodes.sort(key=lambda n: (rack_load[racks.get(n[0], ("", n[0]))],
+                                  len(alloc[n[0]]), -n[1]))
+        chosen = nodes[0][0]
+        alloc[chosen].append(sid)
+        rack_load[racks.get(chosen, ("", chosen))] += 1
 
     def copy_to(target_and_sids):
         target, sids = target_and_sids
@@ -180,10 +189,11 @@ def ec_rebuild(env, args, out):
         _rebuild_one(env, vid, holders, total, out)
 
 
-def _all_ec_volumes(env, collection: str = "") -> dict[int, dict[int, list[str]]]:
+def _all_ec_volumes(env, collection: str = "",
+                    topo=None) -> dict[int, dict[int, list[str]]]:
     """vid -> shard -> [holders] from topology (EcShardMap.registerEcNode)."""
     vols: dict[int, dict[int, list[str]]] = defaultdict(lambda: defaultdict(list))
-    for dn in env.collect_data_nodes():
+    for dn in env.collect_data_nodes(topo):
         for disk in dn.disk_infos.values():
             for e in disk.ec_shard_infos:
                 if collection and e.collection != collection:
@@ -260,33 +270,50 @@ def ec_balance(env, args, out):
     opts = p.parse_args(args)
     env.confirm_is_locked()
 
-    vols = _all_ec_volumes(env, opts.collection)
+    topo = env.volume_list().topology_info  # one snapshot for all views
+    vols = _all_ec_volumes(env, opts.collection, topo)
     shard_count: dict[str, int] = defaultdict(int)
     for vid, m in vols.items():
         for sid, hs in m.items():
             for h in hs:
                 shard_count[h] += 1
-    nodes = [n[0] for n in _collect_ec_nodes(env)]
+    nodes = [n[0] for n in _collect_ec_nodes(env, topo)]
     for n in nodes:
         shard_count.setdefault(n, 0)
     if not shard_count:
         print("no ec shards in cluster", file=out)
         return
     avg = sum(shard_count.values()) / len(shard_count)
+    racks = env.node_racks(topo)
     moves = []
     for vid, m in sorted(vols.items()):
         collection = _find_ec_collection(env, vid)
+        # rack -> how many of THIS volume's shards it already holds
+        vol_rack: dict[tuple[str, str], int] = defaultdict(int)
+        for sid, hs in m.items():
+            for h in hs:
+                vol_rack[racks.get(h, ("", h))] += 1
         for sid, hs in sorted(m.items()):
             src = hs[0]
             if shard_count[src] <= avg + 1:
                 continue
-            dst = min((n for n in shard_count if n not in hs),
-                      key=lambda n: shard_count[n], default=None)
-            if dst is None or shard_count[dst] >= avg:
+            # among nodes with headroom, prefer the emptiest rack for this
+            # volume, then the emptiest node (pickRackToBalanceShardsInto);
+            # filtering by headroom FIRST keeps the rack preference from
+            # selecting a full node and skipping the move entirely
+            cands = [n for n in shard_count
+                     if n not in hs and shard_count[n] < avg]
+            dst = min(cands,
+                      key=lambda n: (vol_rack[racks.get(n, ("", n))],
+                                     shard_count[n]),
+                      default=None)
+            if dst is None:
                 continue
             moves.append((vid, collection, sid, src, dst))
             shard_count[src] -= 1
             shard_count[dst] += 1
+            vol_rack[racks.get(dst, ("", dst))] += 1
+            vol_rack[racks.get(src, ("", src))] -= 1
     for vid, collection, sid, src, dst in moves:
         print(f"move volume {vid} shard {sid}: {src} -> {dst}", file=out)
         if not opts.apply:

@@ -75,12 +75,24 @@ class CommandEnv:
         return self.master_stub().VolumeList(
             master_pb2.VolumeListRequest(), timeout=30)
 
-    def collect_data_nodes(self) -> list[master_pb2.DataNodeInfo]:
+    def collect_data_nodes(self, topo=None) -> list[master_pb2.DataNodeInfo]:
+        """Pass a prefetched topology_info to keep node and rack views on
+        one consistent snapshot."""
         out = []
-        topo = self.volume_list().topology_info
+        topo = topo if topo is not None else self.volume_list().topology_info
         for dc in topo.data_center_infos:
             for rack in dc.rack_infos:
                 out.extend(rack.data_node_infos)
+        return out
+
+    def node_racks(self, topo=None) -> dict[str, tuple[str, str]]:
+        """node url -> (data_center, rack) from the master topology."""
+        out = {}
+        topo = topo if topo is not None else self.volume_list().topology_info
+        for dc in topo.data_center_infos:
+            for rack in dc.rack_infos:
+                for dn in rack.data_node_infos:
+                    out[dn.id] = (dc.id, rack.id)
         return out
 
     def wait_heartbeat(self, seconds: float = 1.2) -> None:

@@ -172,3 +172,56 @@ def test_volume_check_disk(cluster):
     master, _ = cluster
     env = CommandEnv(master.address)
     assert "diverging" in _sh(env, "volume.check.disk")
+
+
+def test_ec_encode_rack_aware_spread(tmp_path_factory):
+    """Shard placement balances racks, not just nodes: a lone node in its
+    own rack takes ~half the shards when the other rack has three nodes
+    (the reference README's rack-aware EC placement)."""
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport, volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    servers = []
+    layout = [("rackA",), ("rackA",), ("rackA",), ("rackB",)]
+    for i, (rack,) in enumerate(layout):
+        vsrv = VolumeServer(
+            directories=[str(tmp_path_factory.mktemp(f"rk{i}"))],
+            master=f"localhost:{mport}", ip="localhost", port=_free_port(),
+            ec_geometry=TEST_GEO, pulse_seconds=1, rack=rack,
+            data_center="dc1")
+        vsrv.start()
+        servers.append(vsrv)
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and len(master.topo.nodes) < 4:
+            time.sleep(0.05)
+        rng = np.random.default_rng(5)
+        fid = None
+        for i in range(10):
+            data = rng.integers(0, 256, 3000, dtype=np.uint8).tobytes()
+            res = submit(master.address, data, filename=f"r{i}",
+                         collection="rackec")
+            fid = fid or res["fid"]
+        vid = parse_file_id(fid).volume_id
+        env = CommandEnv(master.address)
+        out = io.StringIO()
+        assert run_command(env, "lock", out) == 0
+        assert run_command(
+            env, f"ec.encode -volumeId {vid} -collection rackec", out) == 0, \
+            out.getvalue()
+        time.sleep(1.5)
+        by_rack = {"rackA": 0, "rackB": 0}
+        for s in servers:
+            n = sum(len(ev.shard_files)
+                    for loc in s.store.locations
+                    for ev in loc.ec_volumes.values())
+            by_rack[s.store.rack] += n
+        assert by_rack["rackA"] + by_rack["rackB"] == 14, by_rack
+        # rack-aware: B's one node carries ~half; count-balanced placement
+        # would leave it with only ~3
+        assert by_rack["rackB"] >= 6, by_rack
+    finally:
+        for s in servers:
+            s.stop()
+        master.stop()
+        rpc.reset_channels()
