@@ -1,0 +1,91 @@
+"""`weed server` all-in-one CLI e2e: the most common deployment entry point
+(reference: weed/command/server.go) — master + volume + filer + s3 in one
+process, driven over real sockets from a subprocess spawn."""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+
+def _pick_ports(n: int) -> list[int]:
+    """n pairwise-distinct ports whose +10000 gRPC shadows are also free
+    and distinct (every server binds both)."""
+    picked: list[int] = []
+    while len(picked) < n:
+        with socket.socket() as s:
+            s.bind(("", 0))
+            p = s.getsockname()[1]
+        if p + 10000 > 65535:
+            continue
+        family = picked + [q + 10000 for q in picked]
+        if p in family or p + 10000 in family:
+            continue
+        try:  # the shadow port must be bindable too
+            with socket.socket() as s2:
+                s2.bind(("", p + 10000))
+        except OSError:
+            continue
+        picked.append(p)
+    return picked
+
+
+def test_weed_server_all_in_one(tmp_path):
+    mport, vport, fport, s3port = _pick_ports(4)
+    # native coder keeps the child off jax entirely (the sitecustomize pins
+    # the axon TPU platform, so env-var platform switches would not help)
+    env = dict(os.environ, SEAWEEDFS_TPU_CODER="native")
+    log_path = tmp_path / "server.log"
+    with open(log_path, "w") as log:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", "server",
+             "-dir", str(tmp_path), "-master.port", str(mport),
+             "-volume.port", str(vport), "-filer", "-filer.port", str(fport),
+             "-s3", "-s3.port", str(s3port)],
+            env=env, stdout=log, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.time() + 60
+        up = False
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                break  # died at startup — fail immediately with the log
+            try:
+                requests.get(f"http://localhost:{s3port}", timeout=1)
+                requests.get(f"http://localhost:{fport}/", timeout=1)
+                up = True
+                break
+            except requests.RequestException:
+                time.sleep(0.3)
+        assert up, ("all-in-one server did not come up; log:\n"
+                    + log_path.read_text()[-2000:])
+
+        # filer write/read
+        r = requests.post(f"http://localhost:{fport}/aio/hello.txt",
+                          files={"file": ("hello.txt", b"all in one")},
+                          timeout=10)
+        assert r.status_code in (200, 201)
+        r = requests.get(f"http://localhost:{fport}/aio/hello.txt", timeout=10)
+        assert r.status_code == 200 and r.content == b"all in one"
+
+        # s3 (open mode, no identities configured): bucket + object
+        assert requests.put(f"http://localhost:{s3port}/aio-bkt",
+                            timeout=10).status_code == 200
+        assert requests.put(f"http://localhost:{s3port}/aio-bkt/k.bin",
+                            data=b"s3 via aio", timeout=10).status_code == 200
+        r = requests.get(f"http://localhost:{s3port}/aio-bkt/k.bin",
+                         timeout=10)
+        assert r.status_code == 200 and r.content == b"s3 via aio"
+
+        # master UI answers too
+        r = requests.get(f"http://localhost:{mport}/", timeout=10)
+        assert r.status_code == 200 and "Master" in r.text
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
