@@ -29,6 +29,7 @@ from ..filer.filerstore import get_store
 from ..operation import assign, delete_files, upload_data
 from ..pb import filer_pb2, master_pb2, rpc
 from ..utils import glog
+from ..utils.http import not_modified
 from ..utils.stats import FILER_REQUEST_HISTOGRAM, gather
 from ..wdclient import MasterClient
 
@@ -514,6 +515,17 @@ def _make_http_handler(srv: FilerServer):
                         "Path": path, "Entries": entries,
                         "ShouldDisplayLoadMore": len(entries) >= limit,
                     })
+                etag = f'"{chunks_etag(entry.chunks)}"'
+                headers = {"ETag": etag}
+                if entry.attr.mtime:
+                    headers["Last-Modified"] = time.strftime(
+                        "%a, %d %b %Y %H:%M:%S GMT",
+                        time.gmtime(entry.attr.mtime))
+                # conditional GETs before Range (filer_server_handlers_read
+                # .go:65-80); RFC 7232 §3.3: If-Modified-Since is consulted
+                # only when no If-None-Match was sent
+                if not_modified(self.headers, etag, entry.attr.mtime):
+                    return self._reply(304, b"", headers=headers)
                 rng_h = self.headers.get("Range")
                 size = entry.size()
                 if rng_h and rng_h.startswith("bytes="):
@@ -521,11 +533,13 @@ def _make_http_handler(srv: FilerServer):
                     start = int(lo)
                     stop = int(hi) + 1 if hi else size
                     data = srv.read_file(entry, start, stop - start)
+                    headers["Content-Range"] = \
+                        f"bytes {start}-{stop - 1}/{size}"
                     return self._reply(
-                        206, data, entry.attr.mime or "application/octet-stream",
-                        {"Content-Range": f"bytes {start}-{stop - 1}/{size}"})
+                        206, data,
+                        entry.attr.mime or "application/octet-stream",
+                        headers)
                 data = srv.read_file(entry)
-                headers = {"ETag": f'"{chunks_etag(entry.chunks)}"'}
                 if entry.attr.md5:
                     headers["Content-MD5"] = entry.attr.md5.hex()
                 return self._reply(

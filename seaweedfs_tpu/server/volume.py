@@ -40,6 +40,7 @@ from ..storage.needle import Needle
 from ..storage.store import Store
 from ..storage.ttl import TTL
 from ..utils import glog
+from ..utils.http import not_modified
 from ..utils.stats import (
     VOLUME_SERVER_EC_ENCODE_BYTES,
     VOLUME_SERVER_REQUEST_HISTOGRAM,
@@ -1072,6 +1073,10 @@ def _make_http_handler(srv: VolumeServer):
             if n.last_modified:
                 headers["Last-Modified"] = time.strftime(
                     "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified))
+            # conditional GETs (volume_server_handlers_read.go:163-176;
+            # RFC 7232 §3.3 precedence via utils.http.not_modified)
+            if not_modified(self.headers, f'"{n.etag()}"', n.last_modified):
+                return self._reply(304, b"", headers=headers)
             stored_mime = n.mime.decode() if n.mime else ""
             ctype = stored_mime or "application/octet-stream"
             if n.is_compressed:

@@ -1,0 +1,22 @@
+"""Small shared HTTP helpers for the threaded servers."""
+
+from __future__ import annotations
+
+import email.utils
+
+
+def not_modified(headers, etag: str, mtime: int) -> bool:
+    """Conditional-GET decision (RFC 7232 §3.3 precedence, the reference's
+    filer/volume read handlers): If-None-Match wins when present;
+    If-Modified-Since is consulted only in its absence."""
+    inm = headers.get("If-None-Match")
+    if inm is not None:
+        return inm == etag
+    ims = headers.get("If-Modified-Since")
+    if ims and mtime:
+        try:
+            since = email.utils.parsedate_to_datetime(ims).timestamp()
+        except (TypeError, ValueError):
+            return False
+        return mtime <= since
+    return False

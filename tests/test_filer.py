@@ -282,3 +282,75 @@ def test_filer_subscribe_metadata(cluster):
     t.join(timeout=10)
     assert len(got) >= 2
     assert got[0].event_notification.new_entry.name == "a.txt"
+
+
+def test_conditional_get_304(cluster):
+    """If-None-Match / If-Modified-Since -> 304 (filer_server_handlers_read
+    and volume_server_handlers_read conditional paths)."""
+    master, vsrv, fsrv = cluster
+    requests.post(f"http://{fsrv.address}/cond/x.txt",
+                  files={"file": ("x.txt", b"cacheable")}, timeout=10)
+    r = requests.get(f"http://{fsrv.address}/cond/x.txt", timeout=10)
+    assert r.status_code == 200
+    etag = r.headers["ETag"]
+    last_mod = r.headers.get("Last-Modified")
+
+    r2 = requests.get(f"http://{fsrv.address}/cond/x.txt",
+                      headers={"If-None-Match": etag}, timeout=10)
+    assert r2.status_code == 304 and not r2.content
+    assert requests.get(f"http://{fsrv.address}/cond/x.txt",
+                        headers={"If-None-Match": '"nope"'},
+                        timeout=10).status_code == 200
+    if last_mod:
+        r3 = requests.get(f"http://{fsrv.address}/cond/x.txt",
+                          headers={"If-Modified-Since": last_mod}, timeout=10)
+        assert r3.status_code == 304
+
+    # volume server conditional path via a direct fid
+    from seaweedfs_tpu.operation import assign, upload_data
+
+    a = assign(master.address)
+    upload_data(f"http://{a.url}/{a.fid}", b"needle-cond")
+    r = requests.get(f"http://{a.url}/{a.fid}", timeout=10)
+    assert r.status_code == 200
+    etag = r.headers["ETag"]
+    assert requests.get(f"http://{a.url}/{a.fid}",
+                        headers={"If-None-Match": etag},
+                        timeout=10).status_code == 304
+    lm = r.headers.get("Last-Modified")
+    if lm:
+        assert requests.get(f"http://{a.url}/{a.fid}",
+                            headers={"If-Modified-Since": lm},
+                            timeout=10).status_code == 304
+
+
+def test_conditional_get_precedence_and_ranges(cluster):
+    """RFC 7232 §3.3: a non-matching If-None-Match must win over a stale
+    If-Modified-Since; ranged revalidation also gets 304 + ETag."""
+    _, _, fsrv = cluster
+    requests.post(f"http://{fsrv.address}/cond/p.txt",
+                  files={"file": ("p.txt", b"first body")}, timeout=10)
+    r = requests.get(f"http://{fsrv.address}/cond/p.txt", timeout=10)
+    last_mod = r.headers.get("Last-Modified")
+
+    # same-second overwrite: mtime unchanged, etag changes
+    requests.post(f"http://{fsrv.address}/cond/p.txt",
+                  files={"file": ("p.txt", b"second body!")}, timeout=10)
+    r2 = requests.get(
+        f"http://{fsrv.address}/cond/p.txt",
+        headers={"If-None-Match": r.headers["ETag"],
+                 "If-Modified-Since": last_mod or
+                 "Thu, 01 Jan 2037 00:00:00 GMT"},
+        timeout=10)
+    assert r2.status_code == 200 and r2.content == b"second body!"
+
+    # ranged revalidation honors conditionals and carries the ETag on 206
+    etag = r2.headers["ETag"]
+    r3 = requests.get(f"http://{fsrv.address}/cond/p.txt",
+                      headers={"Range": "bytes=0-5",
+                               "If-None-Match": etag}, timeout=10)
+    assert r3.status_code == 304
+    r4 = requests.get(f"http://{fsrv.address}/cond/p.txt",
+                      headers={"Range": "bytes=0-5"}, timeout=10)
+    assert r4.status_code == 206 and r4.headers.get("ETag") == etag
+    assert r4.content == b"second"
