@@ -218,12 +218,14 @@ class FilerServer:
             self._gc_chunks(old_fids)
         return entry
 
-    def read_file(self, entry: Entry, offset: int = 0,
-                  size: int | None = None) -> bytes:
+    def stream_file(self, entry: Entry, offset: int = 0,
+                    size: int | None = None):
+        """Yield the file's bytes one chunk view at a time (StreamContent,
+        stream.go:69) — a multi-GB file never materializes in filer RAM."""
         if entry.content:
             end = len(entry.content) if size is None else offset + size
-            return entry.content[offset:end]
-        out = bytearray()
+            yield bytes(entry.content[offset:end])
+            return
         for view in view_from_chunks(entry.chunks, offset,
                                      size if size is not None
                                      else total_size(entry.chunks) - offset):
@@ -242,13 +244,16 @@ class FilerServer:
                         if r.status_code == 200 and not view.is_full_chunk:
                             data = data[view.chunk_offset:
                                         view.chunk_offset + view.size]
-                        out += data
+                        yield data
                         break
                 except rq.RequestException as e:
                     last_err = e
             else:
                 raise IOError(f"chunk {view.file_id} unreadable: {last_err}")
-        return bytes(out)
+
+    def read_file(self, entry: Entry, offset: int = 0,
+                  size: int | None = None) -> bytes:
+        return b"".join(self.stream_file(entry, offset, size))
 
     def _gc_chunks(self, fids: list[str]) -> None:
         if not fids:
@@ -257,6 +262,26 @@ class FilerServer:
             delete_files(self.master, fids)
         except Exception as e:  # noqa: BLE001 - GC is best-effort
             glog.warning(f"chunk gc failed: {e}")
+
+
+def _parse_range(rng_h: str, size: int):
+    """'bytes=a-b' -> clamped (start, stop) half-open span; 'bytes=-N' is a
+    suffix range; unsatisfiable -> "invalid" (416); malformed -> None
+    (serve the full body, like Go's http.ServeContent leniency)."""
+    lo, _, hi = rng_h[len("bytes="):].partition("-")
+    try:
+        if lo == "" and hi:  # suffix: last N bytes
+            n = int(hi)
+            if n <= 0:
+                return "invalid"
+            return max(0, size - n), size
+        start = int(lo)
+        stop = int(hi) + 1 if hi else size
+    except ValueError:
+        return None
+    if start >= size or stop <= start:
+        return "invalid"
+    return start, min(stop, size)
 
 
 def _ttl_seconds(ttl: str) -> int:
@@ -475,6 +500,42 @@ def _make_http_handler(srv: FilerServer):
         def _json(self, obj, code=200):
             self._reply(code, json.dumps(obj).encode())
 
+        def _stream_reply(self, code: int, length: int, chunks,
+                          ctype: str = "application/octet-stream",
+                          headers=None):
+            """Send headers, then write the body chunk by chunk (the
+            reference's StreamContent): filer memory stays one chunk deep
+            regardless of file size. The FIRST chunk is primed before the
+            status line so a fully-unreadable file still gets a clean 500;
+            a later mid-stream failure can only drop the connection (the
+            short body is detectable by Content-Length)."""
+            it = iter(chunks)
+            first = None
+            if self.command != "HEAD":
+                try:
+                    first = next(it)
+                except StopIteration:
+                    pass
+                except IOError as e:
+                    return self._json({"error": str(e)}, 500)
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(length))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
+            self.end_headers()
+            if self.command == "HEAD":
+                return
+            try:
+                if first:
+                    self.wfile.write(first)
+                for piece in it:
+                    if piece:
+                        self.wfile.write(piece)
+            except IOError as e:
+                glog.warning(f"stream aborted for {self.path}: {e}")
+                self.close_connection = True
+
         def _path_q(self):
             u = urlparse(self.path)
             return unquote(u.path), {k: v[0] for k, v in
@@ -528,23 +589,26 @@ def _make_http_handler(srv: FilerServer):
                     return self._reply(304, b"", headers=headers)
                 rng_h = self.headers.get("Range")
                 size = entry.size()
+                ctype = entry.attr.mime or "application/octet-stream"
                 if rng_h and rng_h.startswith("bytes="):
-                    lo, _, hi = rng_h[6:].partition("-")
-                    start = int(lo)
-                    stop = int(hi) + 1 if hi else size
-                    data = srv.read_file(entry, start, stop - start)
-                    headers["Content-Range"] = \
-                        f"bytes {start}-{stop - 1}/{size}"
-                    return self._reply(
-                        206, data,
-                        entry.attr.mime or "application/octet-stream",
-                        headers)
-                data = srv.read_file(entry)
+                    span = _parse_range(rng_h, size)
+                    if span == "invalid":
+                        return self._reply(
+                            416, b"", headers={
+                                "Content-Range": f"bytes */{size}"})
+                    if span is not None:  # malformed ranges fall through
+                        start, stop = span
+                        headers["Content-Range"] = \
+                            f"bytes {start}-{stop - 1}/{size}"
+                        return self._stream_reply(
+                            206, stop - start,
+                            srv.stream_file(entry, start, stop - start),
+                            ctype, headers)
                 if entry.attr.md5:
                     headers["Content-MD5"] = entry.attr.md5.hex()
-                return self._reply(
-                    200, data, entry.attr.mime or "application/octet-stream",
-                    headers)
+                return self._stream_reply(200, size,
+                                          srv.stream_file(entry),
+                                          ctype, headers)
 
         do_HEAD = do_GET
 
