@@ -168,14 +168,22 @@ class S3Server:
             raise S3Error(500, "InternalError", f"filer PUT: {r.status_code}")
         return hashlib.md5(body).hexdigest()
 
-    def get_object(self, bucket: str, key: str, range_header: str = ""):
+    def get_object(self, bucket: str, key: str, range_header: str = "",
+                   stream: bool = False):
         url = (f"http://{self.filer}{BUCKETS_DIR}/{bucket}/"
                + urllib.parse.quote(key))
         headers = {"Range": range_header} if range_header else {}
-        r = self._session.get(url, headers=headers, timeout=600)
+        r = self._session.get(url, headers=headers, timeout=600,
+                              stream=stream)
         if r.status_code == 404:
+            r.close()
             raise S3Error(404, "NoSuchKey", "The specified key does not exist.")
+        if r.status_code == 416:
+            r.close()
+            raise S3Error(416, "InvalidRange",
+                          "The requested range is not satisfiable")
         if r.status_code >= 300:
+            r.close()
             raise S3Error(500, "InternalError", f"filer GET: {r.status_code}")
         return r
 
@@ -674,16 +682,35 @@ def _make_handler(srv: S3Server):
                             time.gmtime(entry.attributes.mtime)),
                     })
                 r = srv.get_object(bucket, key,
-                                   self.headers.get("Range", ""))
+                                   self.headers.get("Range", ""),
+                                   stream=True)
                 headers = {}
-                if "Content-Range" in r.headers:
-                    headers["Content-Range"] = r.headers["Content-Range"]
-                if "ETag" in r.headers:
-                    headers["ETag"] = r.headers["ETag"]
-                return self._send(r.status_code, r.content,
-                                  r.headers.get("Content-Type",
-                                                "application/octet-stream"),
-                                  headers)
+                for h in ("Content-Range", "ETag", "Last-Modified"):
+                    if h in r.headers:
+                        headers[h] = r.headers[h]
+                # pass the filer's stream straight through: gateway memory
+                # stays one chunk deep for any object size
+                try:
+                    self.send_response(r.status_code)
+                    self.send_header("x-amz-request-id", uuid.uuid4().hex[:16])
+                    self.send_header(
+                        "Content-Type",
+                        r.headers.get("Content-Type",
+                                      "application/octet-stream"))
+                    self.send_header("Content-Length",
+                                     r.headers.get("Content-Length", "0"))
+                    for k, v in headers.items():
+                        self.send_header(k, v)
+                    self.end_headers()
+                    # HEAD never reaches here (fast-path above returns)
+                    for piece in r.iter_content(1 << 20):
+                        if piece:
+                            self.wfile.write(piece)
+                except IOError:  # client went away mid-stream
+                    self.close_connection = True
+                finally:
+                    r.close()
+                return
             if verb == "DELETE":
                 srv.delete_object(bucket, key)
                 return self._send(204)

@@ -384,3 +384,17 @@ def test_sigv2_header_and_presigned(stack):
                            "SKADMIN", expires=-10)
     r = requests.get(stale, timeout=30)
     assert r.status_code == 403 and "expired" in r.text.lower()
+
+
+def test_s3_range_416_and_request_id(stack):
+    *_, s3 = stack
+    base = f"http://localhost:{s3.port}"
+    assert _req("PUT", f"{base}/rngbkt", ADMIN).status_code == 200
+    assert _req("PUT", f"{base}/rngbkt/o.bin", ADMIN,
+                b"0123456789").status_code == 200
+    r = _req("GET", f"{base}/rngbkt/o.bin", ADMIN,
+             headers={"Range": "bytes=100-200"})
+    assert r.status_code == 416 and "InvalidRange" in r.text
+    r = _req("GET", f"{base}/rngbkt/o.bin", ADMIN)
+    assert r.status_code == 200
+    assert r.headers.get("x-amz-request-id")
