@@ -190,15 +190,47 @@ class FilerServer:
     def write_file(self, path: str, body: bytes, *, mime: str = "",
                    ttl: str = "", mode: int = 0o660,
                    from_other_cluster: bool = False) -> Entry:
-        """autoChunk + saveAsChunk + CreateEntry."""
+        import io
+
+        return self.write_stream(path, io.BytesIO(body), len(body),
+                                 mime=mime, ttl=ttl, mode=mode,
+                                 from_other_cluster=from_other_cluster)
+
+    def write_stream(self, path: str, reader, length: int, *,
+                     mime: str = "", ttl: str = "", mode: int = 0o660,
+                     from_other_cluster: bool = False) -> Entry:
+        """autoChunk + saveAsChunk + CreateEntry, reading `length` bytes
+        from `reader` one chunk at a time (uploadReaderToChunks in
+        filer_server_handlers_write_autochunk.go): a multi-GB PUT never
+        materializes in filer RAM. On failure the chunks saved so far are
+        garbage-collected before the error surfaces."""
         chunks = []
         md5 = hashlib.md5()
-        for off in range(0, len(body), self.chunk_size) or [0]:
-            piece = body[off:off + self.chunk_size]
-            md5.update(piece)
-            c = self.save_chunk(piece, ttl=ttl)
-            c.offset = off
-            chunks.append(c)
+        off = 0
+        try:
+            while True:
+                want = min(self.chunk_size, length - off)
+                if off and want <= 0:
+                    break
+                piece = reader.read(want) if want > 0 else b""
+                if off and not piece:
+                    break
+                md5.update(piece)
+                c = self.save_chunk(piece, ttl=ttl)
+                c.offset = off
+                chunks.append(c)
+                off += len(piece)
+                if len(piece) < want or want <= 0:
+                    break
+        except Exception:
+            self._gc_chunks([c.file_id for c in chunks])
+            raise
+        return self._finish_entry(path, chunks, md5, mime=mime, ttl=ttl,
+                                  mode=mode,
+                                  from_other_cluster=from_other_cluster)
+
+    def _finish_entry(self, path, chunks, md5, *, mime, ttl, mode,
+                      from_other_cluster):
         now = int(time.time())
         entry = Entry(
             full_path=normalize(path),
@@ -213,7 +245,13 @@ class FilerServer:
             old_fids = [c.file_id for c in old.chunks]
         except NotFound:
             pass
-        self.filer.create_entry(entry, from_other_cluster=from_other_cluster)
+        try:
+            self.filer.create_entry(entry,
+                                    from_other_cluster=from_other_cluster)
+        except Exception:
+            # metadata write failed: the fresh chunks are unreachable
+            self._gc_chunks([c.file_id for c in chunks])
+            raise
         if old_fids:
             self._gc_chunks(old_fids)
         return entry
@@ -616,21 +654,29 @@ def _make_http_handler(srv: FilerServer):
             path, q = self._path_q()
             with FILER_REQUEST_HISTOGRAM.time(type="write"):
                 length = int(self.headers.get("Content-Length") or 0)
-                body = self.rfile.read(length)
                 ctype = self.headers.get("Content-Type") or ""
-                if "multipart/form-data" in ctype:
-                    from .volume import _extract_upload
-
-                    fname, body = _extract_upload(self.headers, body)
-                    if path.endswith("/") and fname:
-                        path = path + fname.decode(errors="replace")
-                    ctype = ""
+                kwargs = dict(
+                    ttl=q.get("ttl", ""),
+                    from_other_cluster=bool(
+                        self.headers.get("X-From-Other-Cluster")))
                 try:
-                    entry = srv.write_file(
-                        path, body, mime=ctype, ttl=q.get("ttl", ""),
-                        from_other_cluster=bool(
-                            self.headers.get("X-From-Other-Cluster")))
+                    if "multipart/form-data" in ctype:
+                        # form uploads must be parsed whole for boundaries
+                        from .volume import _extract_upload
+
+                        body = self.rfile.read(length)
+                        fname, body = _extract_upload(self.headers, body)
+                        if path.endswith("/") and fname:
+                            path = path + fname.decode(errors="replace")
+                        entry = srv.write_file(path, body, mime="", **kwargs)
+                    else:
+                        # raw bodies stream straight into the autochunker
+                        entry = srv.write_stream(path, self.rfile, length,
+                                                 mime=ctype, **kwargs)
                 except IOError as e:
+                    # a mid-body failure leaves unread bytes on the socket;
+                    # the next pipelined request would parse garbage
+                    self.close_connection = True
                     return self._json({"error": str(e)}, 500)
                 self._json({"name": entry.name, "size": entry.size()}, 201)
 
