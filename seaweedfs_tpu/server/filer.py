@@ -196,10 +196,11 @@ class FilerServer:
                                  mime=mime, ttl=ttl, mode=mode,
                                  from_other_cluster=from_other_cluster)
 
-    def write_stream(self, path: str, reader, length: int, *,
+    def write_stream(self, path: str, reader, length: int | None, *,
                      mime: str = "", ttl: str = "", mode: int = 0o660,
                      from_other_cluster: bool = False) -> Entry:
         """autoChunk + saveAsChunk + CreateEntry, reading `length` bytes
+        (or until EOF when length is None — chunked transfer encoding)
         from `reader` one chunk at a time (uploadReaderToChunks in
         filer_server_handlers_write_autochunk.go): a multi-GB PUT never
         materializes in filer RAM. On failure the chunks saved so far are
@@ -209,7 +210,8 @@ class FilerServer:
         off = 0
         try:
             while True:
-                want = min(self.chunk_size, length - off)
+                want = self.chunk_size if length is None \
+                    else min(self.chunk_size, length - off)
                 if off and want <= 0:
                     break
                 piece = reader.read(want) if want > 0 else b""
@@ -300,6 +302,59 @@ class FilerServer:
             delete_files(self.master, fids)
         except Exception as e:  # noqa: BLE001 - GC is best-effort
             glog.warning(f"chunk gc failed: {e}")
+
+
+def _read_all(reader, cap: int = 1 << 30) -> bytes:
+    out = bytearray()
+    while len(out) < cap:
+        piece = reader.read(1 << 20)
+        if not piece:
+            break
+        out += piece
+    return bytes(out)
+
+
+class _ChunkedReader:
+    """Minimal streaming Transfer-Encoding: chunked decoder over rfile
+    (read(n) semantics; b"" at end-of-body after consuming the trailer)."""
+
+    def __init__(self, rfile):
+        self._f = rfile
+        self._remaining = 0
+        self._done = False
+
+    def _next_chunk(self) -> bool:
+        line = self._f.readline(1024).strip()
+        if not line:
+            line = self._f.readline(1024).strip()  # tolerate blank sep
+        size = int(line.split(b";")[0], 16)
+        if size == 0:
+            # consume trailer lines through the terminating blank line
+            while True:
+                t = self._f.readline(1024)
+                if t in (b"\r\n", b"\n", b""):
+                    break
+            self._done = True
+            return False
+        self._remaining = size
+        return True
+
+    def read(self, n: int) -> bytes:
+        out = bytearray()
+        while len(out) < n and not self._done:
+            if self._remaining == 0:
+                if not self._next_chunk():
+                    break
+            take = min(n - len(out), self._remaining)
+            piece = self._f.read(take)
+            if not piece:
+                self._done = True
+                break
+            out += piece
+            self._remaining -= len(piece)
+            if self._remaining == 0:
+                self._f.readline(1024)  # CRLF after each chunk
+        return bytes(out)
 
 
 def _parse_range(rng_h: str, size: int):
@@ -653,25 +708,31 @@ def _make_http_handler(srv: FilerServer):
         def do_PUT(self):
             path, q = self._path_q()
             with FILER_REQUEST_HISTOGRAM.time(type="write"):
-                length = int(self.headers.get("Content-Length") or 0)
+                chunked = "chunked" in (
+                    self.headers.get("Transfer-Encoding") or "").lower()
+                length = None if chunked else int(
+                    self.headers.get("Content-Length") or 0)
                 ctype = self.headers.get("Content-Type") or ""
                 kwargs = dict(
                     ttl=q.get("ttl", ""),
                     from_other_cluster=bool(
                         self.headers.get("X-From-Other-Cluster")))
                 try:
+                    reader = _ChunkedReader(self.rfile) if chunked \
+                        else self.rfile
                     if "multipart/form-data" in ctype:
                         # form uploads must be parsed whole for boundaries
                         from .volume import _extract_upload
 
-                        body = self.rfile.read(length)
+                        body = reader.read(length) if length is not None \
+                            else _read_all(reader)
                         fname, body = _extract_upload(self.headers, body)
                         if path.endswith("/") and fname:
                             path = path + fname.decode(errors="replace")
                         entry = srv.write_file(path, body, mime="", **kwargs)
                     else:
                         # raw bodies stream straight into the autochunker
-                        entry = srv.write_stream(path, self.rfile, length,
+                        entry = srv.write_stream(path, reader, length,
                                                  mime=ctype, **kwargs)
                 except IOError as e:
                     # a mid-body failure leaves unread bytes on the socket;

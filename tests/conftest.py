@@ -20,3 +20,22 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-GB EC scale tests (deselect with -m 'not slow')"
     )
+
+
+import faulthandler  # noqa: E402
+import pytest  # noqa: E402
+
+# Per-test watchdog: if any single test wedges for 5 minutes (the slowest
+# legitimate test is ~70s), dump every thread's stack and kill the run —
+# a diagnosable failure beats an infinitely hung CI/driver session.
+_WATCHDOG_SECONDS = 300
+
+
+@pytest.fixture(autouse=True)
+def _hang_watchdog(request):
+    # multi-GB "slow" tests get a far wider budget on loaded machines
+    budget = 900 if request.node.get_closest_marker("slow") \
+        else _WATCHDOG_SECONDS
+    faulthandler.dump_traceback_later(budget, exit=True)
+    yield
+    faulthandler.cancel_dump_traceback_later()

@@ -397,3 +397,25 @@ def test_range_parsing_edge_cases(cluster):
     # malformed -> full body
     r = requests.get(base, headers={"Range": "bytes=abc-def"}, timeout=10)
     assert r.status_code == 200 and r.content == body
+
+
+def test_chunked_transfer_encoding_put(cluster):
+    """PUT with Transfer-Encoding: chunked streams through the autochunker
+    (no Content-Length): body lands intact, keep-alive stays usable."""
+    _, _, fsrv = cluster
+    rng = np.random.default_rng(21)
+    payload = rng.integers(0, 256, size=150_000, dtype=np.uint8).tobytes()
+
+    def gen():
+        for off in range(0, len(payload), 10_000):
+            yield payload[off:off + 10_000]
+
+    s = requests.Session()
+    r = s.put(f"http://{fsrv.address}/te/chunked.bin", data=gen(), timeout=30)
+    assert r.status_code == 201, r.text
+    r = s.get(f"http://{fsrv.address}/te/chunked.bin", timeout=30)
+    assert r.status_code == 200 and r.content == payload
+    # next request on the same keep-alive connection still parses
+    r = s.get(f"http://{fsrv.address}/te/chunked.bin",
+              headers={"Range": "bytes=0-9"}, timeout=30)
+    assert r.status_code == 206 and r.content == payload[:10]
