@@ -155,18 +155,30 @@ class S3Server:
             if e.code() != grpc.StatusCode.NOT_FOUND:
                 raise
 
-    def put_object(self, bucket: str, key: str, body: bytes,
+    def put_object(self, bucket: str, key: str, body,
                    content_type: str = "") -> str:
-        """-> etag. Streams through the filer HTTP autochunker."""
+        """-> etag. `body` is bytes or a chunk iterator; either way the
+        bytes stream straight through the filer HTTP autochunker."""
         url = (f"http://{self.filer}{BUCKETS_DIR}/{bucket}/"
                + urllib.parse.quote(key))
+        md5 = hashlib.md5()
+        if isinstance(body, (bytes, bytearray)):
+            md5.update(body)
+            data = body
+        else:
+            def _tee():
+                for piece in body:
+                    md5.update(piece)
+                    yield piece
+
+            data = _tee()
         r = self._session.put(
-            url, data=body,
+            url, data=data,
             headers={"Content-Type": content_type or "application/octet-stream"},
             timeout=600)
         if r.status_code >= 300:
             raise S3Error(500, "InternalError", f"filer PUT: {r.status_code}")
-        return hashlib.md5(body).hexdigest()
+        return md5.hexdigest()
 
     def get_object(self, bucket: str, key: str, range_header: str = "",
                    stream: bool = False):
@@ -228,6 +240,19 @@ class _S3Control:
         except Exception as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad config: {e}")
         return s3_pb2.S3ConfigureResponse()
+
+
+def _iter_exact(rfile, length: int):
+    """Yield exactly `length` bytes from the socket in 1MB pieces; a short
+    body is an error (AWS IncompleteBody), never a silent truncation."""
+    remaining = length
+    while remaining > 0:
+        piece = rfile.read(min(1 << 20, remaining))
+        if not piece:
+            raise S3Error(400, "IncompleteBody",
+                          "Request body ended before Content-Length")
+        remaining -= len(piece)
+        yield piece
 
 
 def _action_for(verb: str, bucket: str, key: str, q) -> str:
@@ -655,9 +680,41 @@ def _make_handler(srv: S3Server):
                 src = self.headers.get("x-amz-copy-source")
                 if src:
                     return self._copy_object(bucket, key, src)
-                body = self._body()
-                etag = srv.put_object(bucket, key, body,
-                                      self.headers.get("Content-Type", ""))
+                # When the signature binds no payload hash (anonymous or
+                # UNSIGNED-PAYLOAD), the body can stream straight through —
+                # gateway memory stays one piece deep. Signed payload
+                # hashes and aws-chunked signing need the whole body (the
+                # hash/frame check in _auth/_body already consumed it).
+                claimed = self.headers.get("x-amz-content-sha256",
+                                           "UNSIGNED-PAYLOAD")
+                length = int(self.headers.get("Content-Length") or 0)
+                streamed = (claimed == "UNSIGNED-PAYLOAD"
+                            and not hasattr(self, "_raw_body_cache"))
+                chunked_te = "chunked" in (
+                    self.headers.get("Transfer-Encoding") or "").lower()
+                if chunked_te:
+                    if not streamed:
+                        # signed payloads need Content-Length semantics
+                        raise S3Error(411, "MissingContentLength",
+                                      "chunked transfer requires an "
+                                      "unsigned payload here")
+                    from ..server.filer import _ChunkedReader
+
+                    reader = _ChunkedReader(self.rfile)
+                    body = iter(lambda: reader.read(1 << 20), b"")
+                elif streamed:
+                    body = _iter_exact(self.rfile, length)
+                else:
+                    body = self._body()
+                try:
+                    etag = srv.put_object(
+                        bucket, key, body,
+                        self.headers.get("Content-Type", ""))
+                except Exception:
+                    if streamed:
+                        # body may be partially unread: keep-alive desync
+                        self.close_connection = True
+                    raise
                 acl = self.headers.get("x-amz-acl", "")
                 if acl in CANNED_ACLS:
                     dir_, _, name = \

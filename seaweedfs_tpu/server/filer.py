@@ -306,11 +306,13 @@ class FilerServer:
 
 def _read_all(reader, cap: int = 1 << 30) -> bytes:
     out = bytearray()
-    while len(out) < cap:
+    while True:
         piece = reader.read(1 << 20)
         if not piece:
             break
         out += piece
+        if len(out) > cap:
+            raise IOError(f"body exceeds the {cap}-byte buffered limit")
     return bytes(out)
 
 
@@ -327,7 +329,10 @@ class _ChunkedReader:
         line = self._f.readline(1024).strip()
         if not line:
             line = self._f.readline(1024).strip()  # tolerate blank sep
-        size = int(line.split(b";")[0], 16)
+        try:
+            size = int(line.split(b";")[0], 16)
+        except ValueError:
+            raise IOError(f"malformed chunk-size line {line[:32]!r}")
         if size == 0:
             # consume trailer lines through the terminating blank line
             while True:
@@ -348,8 +353,10 @@ class _ChunkedReader:
             take = min(n - len(out), self._remaining)
             piece = self._f.read(take)
             if not piece:
-                self._done = True
-                break
+                # EOF inside a chunk: the 0-size terminator never arrived,
+                # so the body is TRUNCATED — storing it would turn a
+                # detectable client failure into silent data corruption
+                raise IOError("truncated chunked body")
             out += piece
             self._remaining -= len(piece)
             if self._remaining == 0:

@@ -419,3 +419,28 @@ def test_chunked_transfer_encoding_put(cluster):
     r = s.get(f"http://{fsrv.address}/te/chunked.bin",
               headers={"Range": "bytes=0-9"}, timeout=30)
     assert r.status_code == 206 and r.content == payload[:10]
+
+
+def test_truncated_chunked_put_rejected(cluster):
+    """A chunked body that ends without the 0-size terminator must fail,
+    not silently store a truncated file."""
+    import socket as sk
+
+    _, _, fsrv = cluster
+    host, port = fsrv.address.split(":")
+    conn = sk.create_connection((host, int(port)), timeout=10)
+    conn.sendall(b"PUT /trunc/x.bin HTTP/1.1\r\nHost: x\r\n"
+                 b"Transfer-Encoding: chunked\r\n\r\n"
+                 b"10\r\n0123456789abcdef\r\n"
+                 b"10\r\npartial")  # chunk promises 16 bytes, sends 7
+    conn.shutdown(sk.SHUT_WR)
+    resp = b""
+    while True:
+        piece = conn.recv(4096)
+        if not piece:
+            break
+        resp += piece
+    conn.close()
+    assert b"500" in resp.split(b"\r\n", 1)[0], resp[:100]
+    assert requests.get(f"http://{fsrv.address}/trunc/x.bin",
+                        timeout=10).status_code == 404

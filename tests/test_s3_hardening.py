@@ -398,3 +398,57 @@ def test_s3_range_416_and_request_id(stack):
     r = _req("GET", f"{base}/rngbkt/o.bin", ADMIN)
     assert r.status_code == 200
     assert r.headers.get("x-amz-request-id")
+
+
+def test_s3_streamed_put_incomplete_body(stack):
+    """A body shorter than Content-Length must 400 (IncompleteBody), not
+    store a truncated object (open-mode gateway streams unsigned PUTs)."""
+    import socket as sk
+
+    _, fsrv, s3 = stack
+    # open-mode gateway (no identities) so the unsigned path streams
+    s3_open = S3Server(port=_free_port(), filer=fsrv.address)
+    s3_open.start()
+    try:
+        base = f"http://localhost:{s3_open.port}"
+        assert requests.put(f"{base}/incbkt", timeout=10).status_code == 200
+        conn = sk.create_connection(("localhost", s3_open.port), timeout=10)
+        conn.sendall(b"PUT /incbkt/short.bin HTTP/1.1\r\n"
+                     b"Host: localhost\r\nContent-Length: 100\r\n\r\n"
+                     b"only-ten-b")
+        conn.shutdown(sk.SHUT_WR)
+        resp = b""
+        while True:
+            piece = conn.recv(4096)
+            if not piece:
+                break
+            resp += piece
+        conn.close()
+        assert b"IncompleteBody" in resp, resp[:200]
+        # nothing stored
+        r = requests.get(f"{base}/incbkt/short.bin", timeout=10)
+        assert r.status_code == 404
+    finally:
+        s3_open.stop()
+
+
+def test_s3_chunked_te_put_roundtrip(stack):
+    _, fsrv, _ = stack
+    s3_open = S3Server(port=_free_port(), filer=fsrv.address)
+    s3_open.start()
+    try:
+        base = f"http://localhost:{s3_open.port}"
+        assert requests.put(f"{base}/tebkt", timeout=10).status_code == 200
+        payload = b"chunked transfer to s3 " * 4096
+
+        def gen():
+            for off in range(0, len(payload), 8192):
+                yield payload[off:off + 8192]
+
+        s = requests.Session()
+        r = s.put(f"{base}/tebkt/o.bin", data=gen(), timeout=30)
+        assert r.status_code == 200, r.text
+        r = s.get(f"{base}/tebkt/o.bin", timeout=30)
+        assert r.status_code == 200 and r.content == payload
+    finally:
+        s3_open.stop()
