@@ -108,13 +108,46 @@ def gf_matmul_bits(matrix_bits: jax.Array, data: jax.Array) -> jax.Array:
 
 
 @functools.lru_cache(maxsize=1024)
+def decode_matrix_cached(
+    data_shards: int, parity_shards: int, present: tuple[int, ...]
+) -> tuple[np.ndarray, tuple[int, ...]]:
+    """Cached byte-form decode matrix for a survivor set: host Gauss-Jordan
+    inversion run once per (geometry, survivor set)."""
+    dec, used = gf256.decode_matrix_for(data_shards, parity_shards, list(present))
+    return dec, tuple(used)
+
+
+# Derived kernel operands (bit-form / xor-coefficient form), cached by the
+# compact identity of the matrix — ("parity", k, m) or ("dec", k, m, present)
+# — so the hot path never re-serializes or re-expands matrix contents.
+_DERIVED_MAX = 4096
+_derived_forms: dict[tuple, np.ndarray] = {}
+
+
+def _derived(form: str, key: tuple, matrix: np.ndarray) -> np.ndarray:
+    full = (form, *key)
+    got = _derived_forms.get(full)
+    if got is None:
+        if form == "bits":
+            got = gf_matrix_to_bits(matrix)
+        else:
+            from .rs_xor import xor_coefficients
+
+            got = xor_coefficients(matrix)
+        if len(_derived_forms) >= _DERIVED_MAX:
+            _derived_forms.clear()
+        _derived_forms[full] = got
+    return got
+
+
 def decode_matrix_bits(
     data_shards: int, parity_shards: int, present: tuple[int, ...]
 ) -> tuple[np.ndarray, tuple[int, ...]]:
-    """Cached bit-form decode matrix for a survivor set: host Gauss-Jordan
-    inversion + gf_matrix_to_bits run once per (geometry, survivor set)."""
-    dec, used = gf256.decode_matrix_for(data_shards, parity_shards, list(present))
-    return gf_matrix_to_bits(dec), tuple(used)
+    """Cached bit-form decode matrix for a survivor set (mesh.py and other
+    bitsliced callers)."""
+    dec, used = decode_matrix_cached(data_shards, parity_shards, present)
+    bits = _derived("bits", ("dec", data_shards, parity_shards, present), dec)
+    return bits, used
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2))
@@ -129,25 +162,72 @@ def _apply_matrix_jit(matrix_bits: jax.Array, data: jax.Array) -> jax.Array:
     return gf_matmul_bits(matrix_bits, data)
 
 
-def _use_pallas(b: int) -> bool:
-    """Pallas kernel on TPU backends for large batches: it keeps the 8x
-    bit expansion in VMEM instead of round-tripping it through HBM.
-    SEAWEEDFS_TPU_NO_PALLAS=1 forces the plain XLA formulation."""
+# Device kernel selection. Four formulations, all bit-identical:
+#   xor-pallas : packed-word XOR scheme, hand-tiled (rs_xor kernel) — the
+#                fastest on real TPU (no bit unpack, no MXU padding waste)
+#   xor-xla    : same math, XLA-fused (any backend, any size)
+#   mxu-pallas : bitsliced GF(2) matmul in one VMEM tile (rs_pallas)
+#   mxu-xla    : bitsliced matmul, XLA-materialized (the original path)
+# SEAWEEDFS_TPU_KERNEL overrides; SEAWEEDFS_TPU_NO_PALLAS=1 (legacy) forces
+# the XLA formulations.
+_KERNELS = ("xor-pallas", "xor-xla", "mxu-pallas", "mxu-xla")
+
+
+def _kernel_choice(b: int) -> str:
     import os
 
+    choice = os.environ.get("SEAWEEDFS_TPU_KERNEL", "auto")
+    if choice != "auto":
+        if choice not in _KERNELS:
+            raise ValueError(
+                f"SEAWEEDFS_TPU_KERNEL={choice!r}: expected one of "
+                f"{_KERNELS} or 'auto'"
+            )
+        return choice
     if os.environ.get("SEAWEEDFS_TPU_NO_PALLAS"):
-        return False
-    from .rs_pallas import TILE_N, pallas_available
+        return "mxu-xla"
+    from .rs_pallas import pallas_available
+    from .rs_xor import TILE_BYTES
 
-    return b >= TILE_N and pallas_available()
+    if b >= TILE_BYTES and pallas_available():
+        return "xor-pallas"
+    return "mxu-xla"
 
 
-def _dispatch_matmul(matrix_bits: jax.Array, data: jax.Array,
-                     out_rows: int) -> jax.Array:
+def _use_pallas(b: int) -> bool:
+    """True when the batch is routed to a hand-tiled Pallas kernel."""
+    return _kernel_choice(b).endswith("-pallas")
+
+
+def _dispatch_matmul(matrix: np.ndarray, data: jax.Array, out_rows: int,
+                     key: tuple = None) -> jax.Array:
     """Padded GF matmul via the best backend for this platform/shape.
-    Outputs are bit-identical across paths (tests + bench assert it)."""
+    `matrix` is the byte-form GF(256) matrix; `key` is its compact cache
+    identity (defaults to hashing the contents). Outputs are bit-identical
+    across paths (tests + bench assert it)."""
+    if key is None:
+        key = ("raw", matrix.shape, matrix.tobytes())
     b = data.shape[1]
-    if _use_pallas(b):
+    kind = _kernel_choice(b)
+    if kind == "xor-pallas":
+        from .rs_xor import (TILE_BYTES, _to_bytes, _to_words,
+                             gf_matmul_xor_pallas)
+
+        coeffs = jnp.asarray(
+            _derived("xor", key, matrix).reshape(matrix.shape[0], -1)
+        )
+        padded = (b + TILE_BYTES - 1) // TILE_BYTES * TILE_BYTES
+        if padded != b:
+            data = jnp.pad(data, ((0, 0), (0, padded - b)))
+        words = gf_matmul_xor_pallas(coeffs, _to_words(data), out_rows)
+        return _to_bytes(words)[:, :b]
+    if kind == "xor-xla":
+        from .rs_xor import _matmul_xor_jit
+
+        coeffs = jnp.asarray(_derived("xor", key, matrix))
+        return _matmul_xor_jit(coeffs, _pad_bytes(data, b))[:, :b]
+    matrix_bits = jnp.asarray(_derived("bits", key, matrix))
+    if kind == "mxu-pallas":
         from .rs_pallas import TILE_N, gf_matmul_bits_pallas
 
         padded = (b + TILE_N - 1) // TILE_N * TILE_N
@@ -182,10 +262,10 @@ class RSCodecJax:
         data = jnp.asarray(data, dtype=jnp.uint8)
         assert data.shape[0] == self.data_shards, data.shape
         b = data.shape[1]
-        if _use_pallas(b):
-            bits = jnp.asarray(gf_matrix_to_bits(
-                gf256.parity_matrix(self.data_shards, self.parity_shards)))
-            return _dispatch_matmul(bits, data, self.parity_shards)
+        if _kernel_choice(b) != "mxu-xla":
+            gp = gf256.parity_matrix(self.data_shards, self.parity_shards)
+            key = ("parity", self.data_shards, self.parity_shards)
+            return _dispatch_matmul(gp, data, self.parity_shards, key=key)
         out = _encode_jit(_pad_bytes(data, b), self.data_shards, self.parity_shards)
         return out[:, :b]
 
@@ -198,9 +278,8 @@ class RSCodecJax:
 
     # -- Reconstruct -------------------------------------------------------
 
-    def _decode_bits(self, present: tuple[int, ...]) -> tuple[jax.Array, tuple[int, ...]]:
-        bits, used = decode_matrix_bits(self.data_shards, self.parity_shards, present)
-        return jnp.asarray(bits), used
+    def _decode_matrix(self, present: tuple[int, ...]) -> tuple[np.ndarray, tuple[int, ...]]:
+        return decode_matrix_cached(self.data_shards, self.parity_shards, present)
 
     def reconstruct_data(
         self, shards: dict[int, np.ndarray] | list[np.ndarray | None]
@@ -216,9 +295,11 @@ class RSCodecJax:
         ]
         if not missing_data:
             return {}
-        dec_bits, used = self._decode_bits(tuple(sorted(present.keys())))
+        key = ("dec", self.data_shards, self.parity_shards,
+               tuple(sorted(present.keys())))
+        dec, used = self._decode_matrix(key[3])
         stacked = jnp.stack([jnp.asarray(present[i], jnp.uint8) for i in used])
-        data = _dispatch_matmul(dec_bits, stacked, self.data_shards)
+        data = _dispatch_matmul(dec, stacked, self.data_shards, key=key)
         return {i: data[i] for i in missing_data}
 
     def reconstruct(
@@ -229,9 +310,11 @@ class RSCodecJax:
         missing = [i for i in range(self.total_shards) if i not in present]
         if not missing:
             return {}
-        dec_bits, used = self._decode_bits(tuple(sorted(present.keys())))
+        key = ("dec", self.data_shards, self.parity_shards,
+               tuple(sorted(present.keys())))
+        dec, used = self._decode_matrix(key[3])
         stacked = jnp.stack([jnp.asarray(present[i], jnp.uint8) for i in used])
-        data = _dispatch_matmul(dec_bits, stacked, self.data_shards)  # [k, B]
+        data = _dispatch_matmul(dec, stacked, self.data_shards, key=key)  # [k, B]
         out: dict[int, jax.Array] = {}
         need_parity = any(i >= self.data_shards for i in missing)
         parity = self.encode_parity(data) if need_parity else None

@@ -1,0 +1,171 @@
+"""Packed-word XOR formulation of the GF(2^8) Reed-Solomon matmul.
+
+The bitsliced MXU path (rs_jax.gf_matmul_bits / rs_pallas) expands every
+byte into 8 int8 bit rows and contracts them on the MXU. That wastes the
+systolic array (the [8m, 8k] matrix occupies a 32x80 corner of a 128x128
+tile) and pays Mosaic relayouts for the 8x interleave. This module keeps
+bytes PACKED, four to an int32 lane, and uses only elementwise VPU ops:
+
+    c * x  =  XOR_j  bit_j(x) * gfmul(c, 2^j)            (GF linearity)
+
+For four bytes packed in an int32 word ``w``:
+
+    mask_j = (w >> j) & 0x01010101     # bit j of each byte, in-place
+    mask_j * K                         # K = gfmul(c, 2^j) in [0, 255]:
+                                       # each 0/1 byte becomes K, no carries
+                                       # (max product 0x01010101*255 = 0xFFFFFFFF)
+
+so one shard-row contribution is 8 shift/and/mul/xor chains per output
+row, all on full-width int32 vectors — no unpack, no relayout, no MXU.
+Arithmetic >> is safe: the masked lane positions (0,8,16,24) always sit
+at or below bit 31-j, so sign-extension bits never reach them.
+
+This replaces the same klauspost hot loop as rs_jax
+(/root/reference/weed/storage/erasure_coding/ec_encoder.go:162-192) and is
+bit-identical to it (tests/test_rs_xor.py asserts vs the gf256 oracle and
+the bitsliced path).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import gf256
+
+
+def xor_coefficients(matrix: np.ndarray) -> np.ndarray:
+    """[R, C] GF(256) matrix -> [R, C, 8] int32 multipliers.
+
+    out[r, c, j] = gfmul(matrix[r, c], 2^j), the scalar each bit-j mask is
+    multiplied by before XOR accumulation.
+    """
+    m = np.asarray(matrix, dtype=np.uint8)
+    powers = np.array([1 << j for j in range(8)], dtype=np.uint8)
+    k = gf256.gf_mul_vec(m[:, :, None], powers[None, None, :])
+    return k.astype(np.int32)
+
+
+def _to_words(data: jax.Array) -> jax.Array:
+    """[R, B] uint8 -> [R, B//4] int32 (B must be a multiple of 4)."""
+    r, b = data.shape
+    return jax.lax.bitcast_convert_type(
+        data.reshape(r, b // 4, 4), jnp.int32
+    )
+
+
+def _to_bytes(words: jax.Array) -> jax.Array:
+    """[R, W] int32 -> [R, 4W] uint8 (inverse of _to_words)."""
+    r, w = words.shape
+    return jax.lax.bitcast_convert_type(words, jnp.uint8).reshape(r, 4 * w)
+
+
+def gf_matmul_xor(coeffs: jax.Array, data: jax.Array) -> jax.Array:
+    """out[R, B] = GFmat (x) data[C, B] via the packed-word XOR scheme.
+
+    coeffs: [R, C, 8] int32 from xor_coefficients; data: [C, B] uint8 with
+    B % 4 == 0 (callers pad). Fuses entirely into elementwise int32 ops.
+    """
+    words = _to_words(data)  # [C, W] int32
+    out_rows = coeffs.shape[0]
+    acc = None
+    for j in range(8):
+        mask = (words >> j) & jnp.int32(0x01010101)  # [C, W]
+        # [R, C, W]: every (row, shard) product, then XOR-reduce the shard axis
+        prod = mask[None, :, :] * coeffs[:, :, j][:, :, None]
+        term = jax.lax.reduce(
+            prod, jnp.int32(0), jax.lax.bitwise_xor, dimensions=(1,)
+        )
+        acc = term if acc is None else acc ^ term
+    return _to_bytes(acc)
+
+
+@jax.jit
+def _matmul_xor_jit(coeffs: jax.Array, data: jax.Array) -> jax.Array:
+    return gf_matmul_xor(coeffs, data)
+
+
+# ---------------------------------------------------------------------------
+# Pallas kernel: same math, explicitly tiled so the whole chain stays in VMEM.
+# Rank-3 blocks [rows, 8, LANE] keep every slice a whole (8, 128k) vreg set.
+
+LANE = 512          # int32 lanes per sublane-row in a block
+SUBL = 8            # sublanes per block slice
+BLOCK_W = SUBL * LANE          # int32 words per block == 16384 bytes / 4
+TILE_BYTES = BLOCK_W * 4       # byte-axis tile as seen by callers
+
+
+def _xor_kernel(coeff_ref, data_ref, out_ref):
+    # data_ref: [C, 8, LANE] int32; coeff_ref: [R, 8C] int32 (SMEM scalars)
+    k = data_ref.shape[0]
+    r = out_ref.shape[0]
+    masks = []
+    for c in range(k):
+        w = data_ref[c]
+        masks.append([(w >> j) & jnp.int32(0x01010101) for j in range(8)])
+    for p in range(r):
+        acc = None
+        for c in range(k):
+            for j in range(8):
+                coef = coeff_ref[p, c * 8 + j]
+                term = masks[c][j] * coef
+                acc = term if acc is None else acc ^ term
+        out_ref[p] = acc
+
+
+@functools.partial(jax.jit, static_argnames=("out_rows", "interpret"))
+def gf_matmul_xor_pallas(coeffs_flat: jax.Array, words: jax.Array,
+                         out_rows: int, interpret: bool = False) -> jax.Array:
+    """words [C, W] int32, W % BLOCK_W == 0; coeffs_flat [R, 8C] int32.
+
+    Returns [out_rows, W] int32 parity words.
+    """
+    from jax.experimental import pallas as pl
+
+    k, w = words.shape
+    grid = (w // BLOCK_W,)
+    data3 = words.reshape(k, w // LANE, LANE)
+    out = pl.pallas_call(
+        _xor_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(
+                (coeffs_flat.shape[0], coeffs_flat.shape[1]),
+                lambda i: (0, 0),
+            ),
+            pl.BlockSpec((k, SUBL, LANE), lambda i: (0, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((out_rows, SUBL, LANE), lambda i: (0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((out_rows, w // LANE, LANE), jnp.int32),
+        interpret=interpret,
+    )(coeffs_flat, data3)
+    return out.reshape(out_rows, w)
+
+
+def apply_matrix_xor_pallas(matrix: np.ndarray, data: jax.Array,
+                            interpret: bool = False) -> jax.Array:
+    """Full padded helper: [R, C] GF matrix applied to [C, B] uint8 bytes."""
+    coeffs = jnp.asarray(
+        xor_coefficients(matrix).reshape(matrix.shape[0], -1)
+    )
+    b = data.shape[1]
+    padded = (b + TILE_BYTES - 1) // TILE_BYTES * TILE_BYTES
+    if padded != b:
+        data = jnp.pad(data, ((0, 0), (0, padded - b)))
+    words = _to_words(data)
+    out = gf_matmul_xor_pallas(coeffs, words, matrix.shape[0],
+                               interpret=interpret)
+    return _to_bytes(out)[:, :b]
+
+
+def apply_matrix_xor(matrix: np.ndarray, data: jax.Array) -> jax.Array:
+    """XLA-fused variant of apply_matrix_xor_pallas (any backend)."""
+    coeffs = jnp.asarray(xor_coefficients(matrix))
+    b = data.shape[1]
+    pad = (-b) % 4
+    if pad:
+        data = jnp.pad(data, ((0, 0), (0, pad)))
+    return _matmul_xor_jit(coeffs, data)[:, :b]

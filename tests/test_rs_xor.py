@@ -1,0 +1,112 @@
+"""Bit-identity of the packed-word XOR kernels (rs_xor) vs the gf256 oracle.
+
+Covers both the XLA-fused and the Pallas (interpreter) variants, encode and
+decode matrices, several geometries, and non-aligned byte counts.
+"""
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.ops import gf256
+from seaweedfs_tpu.ops.rs_xor import (
+    apply_matrix_xor,
+    apply_matrix_xor_pallas,
+    xor_coefficients,
+)
+
+
+def _oracle(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
+    out = np.zeros((matrix.shape[0], data.shape[1]), dtype=np.uint8)
+    for r in range(matrix.shape[0]):
+        acc = np.zeros(data.shape[1], dtype=np.uint8)
+        for c in range(matrix.shape[1]):
+            acc ^= gf256.gf_mul_vec(
+                np.full_like(data[c], matrix[r, c]), data[c]
+            )
+        out[r] = acc
+    return out
+
+
+@pytest.mark.parametrize("k,m", [(10, 4), (6, 3), (12, 4), (3, 2)])
+def test_xla_matches_oracle(k, m):
+    rng = np.random.default_rng(k * 100 + m)
+    matrix = gf256.parity_matrix(k, m)
+    data = rng.integers(0, 256, size=(k, 4096), dtype=np.uint8)
+    got = np.asarray(apply_matrix_xor(matrix, data))
+    np.testing.assert_array_equal(got, _oracle(matrix, data))
+
+
+@pytest.mark.parametrize("b", [1, 3, 4, 513, 4096])
+def test_xla_odd_lengths(b):
+    rng = np.random.default_rng(b)
+    matrix = gf256.parity_matrix(10, 4)
+    data = rng.integers(0, 256, size=(10, b), dtype=np.uint8)
+    got = np.asarray(apply_matrix_xor(matrix, data))
+    np.testing.assert_array_equal(got, _oracle(matrix, data))
+
+
+def test_decode_matrix_identity():
+    rng = np.random.default_rng(9)
+    k, m = 10, 4
+    matrix = gf256.parity_matrix(k, m)
+    data = rng.integers(0, 256, size=(k, 2048), dtype=np.uint8)
+    parity = _oracle(matrix, data)
+    shards = np.concatenate([data, parity], axis=0)
+    present = [i for i in range(k + m) if i not in (0, 5, 11, 13)]
+    dec, used = gf256.decode_matrix_for(k, m, present)
+    stacked = shards[list(used)]
+    got = np.asarray(apply_matrix_xor(dec, stacked))
+    np.testing.assert_array_equal(got, data)
+
+
+def test_pallas_interpret_matches_oracle():
+    rng = np.random.default_rng(3)
+    matrix = gf256.parity_matrix(10, 4)
+    from seaweedfs_tpu.ops.rs_xor import TILE_BYTES
+
+    for b in (TILE_BYTES, 2 * TILE_BYTES + 100):
+        data = rng.integers(0, 256, size=(10, b), dtype=np.uint8)
+        got = np.asarray(apply_matrix_xor_pallas(matrix, data, interpret=True))
+        np.testing.assert_array_equal(got, _oracle(matrix, data))
+
+
+def test_coefficients_shape_and_values():
+    matrix = gf256.parity_matrix(6, 3)
+    k = xor_coefficients(matrix)
+    assert k.shape == (3, 6, 8)
+    # j=0 multiplier is the matrix entry itself
+    np.testing.assert_array_equal(k[:, :, 0], matrix.astype(np.int32))
+    # doubling law: k[..., j+1] = gfmul(k[..., j], 2)
+    for j in range(7):
+        np.testing.assert_array_equal(
+            k[:, :, j + 1].astype(np.uint8),
+            gf256.gf_mul_vec(k[:, :, j].astype(np.uint8), np.uint8(2)),
+        )
+
+
+@pytest.mark.parametrize("kind", ["xor-xla", "mxu-xla"])
+def test_codec_dispatch_env_override(kind, monkeypatch):
+    """RSCodecJax honors SEAWEEDFS_TPU_KERNEL and stays bit-identical."""
+    from seaweedfs_tpu.ops.rs_jax import RSCodecJax
+
+    monkeypatch.setenv("SEAWEEDFS_TPU_KERNEL", kind)
+    rng = np.random.default_rng(11)
+    coder = RSCodecJax(10, 4)
+    data = rng.integers(0, 256, size=(10, 20000), dtype=np.uint8)
+    shards = np.asarray(coder.encode(data))
+    matrix = gf256.parity_matrix(10, 4)
+    np.testing.assert_array_equal(shards[10:], _oracle(matrix, data))
+    present = {i: shards[i] for i in range(14) if i not in (1, 4, 10, 12)}
+    rebuilt = coder.reconstruct(present)
+    for i in (1, 4, 10, 12):
+        np.testing.assert_array_equal(np.asarray(rebuilt[i]), shards[i])
+
+
+def test_bad_kernel_env_rejected(monkeypatch):
+    from seaweedfs_tpu.ops.rs_jax import RSCodecJax
+
+    monkeypatch.setenv("SEAWEEDFS_TPU_KERNEL", "xor_pallas")
+    coder = RSCodecJax(10, 4)
+    data = np.zeros((10, 64), dtype=np.uint8)
+    with pytest.raises(ValueError, match="SEAWEEDFS_TPU_KERNEL"):
+        coder.encode_parity(data)
