@@ -35,16 +35,66 @@ _HERE = os.path.dirname(os.path.abspath(__file__))
 _DEVICE_PROG = r"""
 import json, os, sys, time, traceback
 
-def bench(data_shards=10, parity_shards=4, col_bytes=32*1024*1024, iters=8,
+def calibrate(coder, np, jnp, candidates, col_bytes=4*1024*1024):
+    # quick best-of: one compile + one timed burst per kernel formulation;
+    # the winner gets the full-size headline measurement. Forced host
+    # readback keeps the comparison honest over the async tunnel.
+    rng = np.random.default_rng(2)
+    data = jnp.asarray(rng.integers(0, 256, size=(coder.data_shards, col_bytes),
+                                    dtype=np.uint8))
+    scores = {}
+    # candidates are ordered most-likely-winner first; stop sweeping once a
+    # third of the parent watchdog budget is gone so the headline
+    # measurement always has time to print its JSON line
+    budget = 0.35 * float(os.environ.get("SEAWEEDFS_TPU_BENCH_TIMEOUT", "480"))
+    cal_start = time.perf_counter()
+    for kind in candidates:
+        if time.perf_counter() - cal_start > budget and scores:
+            sys.stderr.write(f"calibration budget spent; skipping {kind}\n")
+            continue
+        os.environ["SEAWEEDFS_TPU_KERNEL"] = kind
+        try:
+            t0 = time.perf_counter()
+            np.asarray(coder.encode_parity(data)[:, ::65536])  # compile+run
+            compile_s = time.perf_counter() - t0
+            best = 0.0
+            for _ in range(2):
+                t0 = time.perf_counter()
+                outs = [coder.encode_parity(data) for _ in range(4)]
+                np.asarray(outs[-1][:, ::65536])
+                dt = time.perf_counter() - t0
+                best = max(best, coder.data_shards * col_bytes * 4 / dt / 1e9)
+            scores[kind] = best
+            sys.stderr.write(f"calibrate {kind}: {best:.2f} GB/s"
+                             f" (compile {compile_s:.0f}s)\n")
+        except Exception:
+            sys.stderr.write(f"calibrate {kind} failed:\n"
+                             + traceback.format_exc() + "\n")
+    return scores
+
+def bench(data_shards=10, parity_shards=4, col_bytes=None, iters=8,
           repeats=3):
     import numpy as np
     import jax
     import jax.numpy as jnp
-    from seaweedfs_tpu.ops.rs_jax import RSCodecJax, _use_pallas
+    from seaweedfs_tpu.ops.rs_jax import RSCodecJax, _kernel_choice
 
+    if col_bytes is None:
+        col_bytes = int(os.environ.get("SEAWEEDFS_TPU_BENCH_BYTES",
+                                       32 * 1024 * 1024))
     backend = jax.default_backend()
     coder = RSCodecJax(data_shards, parity_shards)
     rng = np.random.default_rng(0)
+
+    if os.environ.get("SEAWEEDFS_TPU_KERNEL", "auto") == "auto":
+        if backend == "tpu":
+            cands = ("xor-pallas", "xor-xla", "mxu-pallas", "mxu-xla")
+        else:
+            cands = ("xor-xla", "mxu-xla")
+        scores = calibrate(coder, np, jnp, cands)
+        if scores:
+            os.environ["SEAWEEDFS_TPU_KERNEL"] = max(scores, key=scores.get)
+
     bufs = [jnp.asarray(rng.integers(0, 256, size=(data_shards, col_bytes),
                                      dtype=np.uint8)) for _ in range(2)]
 
@@ -114,15 +164,15 @@ def bench(data_shards=10, parity_shards=4, col_bytes=32*1024*1024, iters=8,
             best = max(best, data_shards * col_bytes * 4 / dt / 1e9)
         return best
 
-    kernel = "pallas" if _use_pallas(col_bytes) else "xla"
-    if kernel == "pallas":
+    kernel = _kernel_choice(col_bytes)
+    if kernel.endswith("-pallas"):
         try:
             gbps = run_once()
         except Exception:
-            sys.stderr.write("pallas kernel failed, falling back to XLA:\n"
+            sys.stderr.write(f"{kernel} kernel failed, falling back to XLA:\n"
                              + traceback.format_exc() + "\n")
-            os.environ["SEAWEEDFS_TPU_NO_PALLAS"] = "1"
-            kernel = "xla"
+            kernel = kernel.replace("-pallas", "-xla")
+            os.environ["SEAWEEDFS_TPU_KERNEL"] = kernel
             gbps = run_once()
     else:
         gbps = run_once()

@@ -120,8 +120,13 @@ def decode_matrix_cached(
 # Derived kernel operands (bit-form / xor-coefficient form), cached by the
 # compact identity of the matrix — ("parity", k, m) or ("dec", k, m, present)
 # — so the hot path never re-serializes or re-expands matrix contents.
+# LRU eviction: hot keys (the encode parity matrix) survive survivor-set churn.
+import collections
+
 _DERIVED_MAX = 4096
-_derived_forms: dict[tuple, np.ndarray] = {}
+_derived_forms: "collections.OrderedDict[tuple, np.ndarray]" = (
+    collections.OrderedDict()
+)
 
 
 def _derived(form: str, key: tuple, matrix: np.ndarray) -> np.ndarray:
@@ -134,9 +139,11 @@ def _derived(form: str, key: tuple, matrix: np.ndarray) -> np.ndarray:
             from .rs_xor import xor_coefficients
 
             got = xor_coefficients(matrix)
-        if len(_derived_forms) >= _DERIVED_MAX:
-            _derived_forms.clear()
+        while len(_derived_forms) >= _DERIVED_MAX:
+            _derived_forms.popitem(last=False)
         _derived_forms[full] = got
+    else:
+        _derived_forms.move_to_end(full)
     return got
 
 
@@ -194,11 +201,6 @@ def _kernel_choice(b: int) -> str:
     return "mxu-xla"
 
 
-def _use_pallas(b: int) -> bool:
-    """True when the batch is routed to a hand-tiled Pallas kernel."""
-    return _kernel_choice(b).endswith("-pallas")
-
-
 def _dispatch_matmul(matrix: np.ndarray, data: jax.Array, out_rows: int,
                      key: tuple = None) -> jax.Array:
     """Padded GF matmul via the best backend for this platform/shape.
@@ -210,17 +212,12 @@ def _dispatch_matmul(matrix: np.ndarray, data: jax.Array, out_rows: int,
     b = data.shape[1]
     kind = _kernel_choice(b)
     if kind == "xor-pallas":
-        from .rs_xor import (TILE_BYTES, _to_bytes, _to_words,
-                             gf_matmul_xor_pallas)
+        from .rs_xor import apply_matrix_xor_pallas
 
         coeffs = jnp.asarray(
             _derived("xor", key, matrix).reshape(matrix.shape[0], -1)
         )
-        padded = (b + TILE_BYTES - 1) // TILE_BYTES * TILE_BYTES
-        if padded != b:
-            data = jnp.pad(data, ((0, 0), (0, padded - b)))
-        words = gf_matmul_xor_pallas(coeffs, _to_words(data), out_rows)
-        return _to_bytes(words)[:, :b]
+        return apply_matrix_xor_pallas(matrix, data, coeffs=coeffs)
     if kind == "xor-xla":
         from .rs_xor import _matmul_xor_jit
 

@@ -146,11 +146,16 @@ def gf_matmul_xor_pallas(coeffs_flat: jax.Array, words: jax.Array,
 
 
 def apply_matrix_xor_pallas(matrix: np.ndarray, data: jax.Array,
-                            interpret: bool = False) -> jax.Array:
-    """Full padded helper: [R, C] GF matrix applied to [C, B] uint8 bytes."""
-    coeffs = jnp.asarray(
-        xor_coefficients(matrix).reshape(matrix.shape[0], -1)
-    )
+                            interpret: bool = False,
+                            coeffs: jax.Array | None = None) -> jax.Array:
+    """Full padded helper: [R, C] GF matrix applied to [C, B] uint8 bytes.
+    `coeffs` lets callers pass a cached flattened coefficient array
+    (rs_jax._dispatch_matmul); layout must match xor_coefficients(matrix)
+    reshaped to [R, 8C]."""
+    if coeffs is None:
+        coeffs = jnp.asarray(
+            xor_coefficients(matrix).reshape(matrix.shape[0], -1)
+        )
     b = data.shape[1]
     padded = (b + TILE_BYTES - 1) // TILE_BYTES * TILE_BYTES
     if padded != b:
