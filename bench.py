@@ -94,6 +94,10 @@ def bench(data_shards=10, parity_shards=4, col_bytes=None, iters=8,
         scores = calibrate(coder, np, jnp, cands)
         if scores:
             os.environ["SEAWEEDFS_TPU_KERNEL"] = max(scores, key=scores.get)
+        else:
+            # every candidate failed: fall back to the auto heuristic (and
+            # its pallas->xla failure handling) rather than the last-tried
+            os.environ["SEAWEEDFS_TPU_KERNEL"] = "auto"
 
     bufs = [jnp.asarray(rng.integers(0, 256, size=(data_shards, col_bytes),
                                      dtype=np.uint8)) for _ in range(2)]

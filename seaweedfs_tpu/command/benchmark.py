@@ -5,6 +5,7 @@ benchmark.go:73-111, percentile printer :437)."""
 from __future__ import annotations
 
 import secrets
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
@@ -13,6 +14,16 @@ import requests
 
 from ..operation import assign, upload_data
 from ..wdclient import MasterClient
+
+_tl = threading.local()
+
+
+def _session() -> requests.Session:
+    """Per-thread keepalive session (Session is not concurrency-safe)."""
+    s = getattr(_tl, "session", None)
+    if s is None:
+        s = _tl.session = requests.Session()
+    return s
 
 
 def _percentiles(lat: np.ndarray) -> str:
@@ -37,7 +48,7 @@ def run_benchmark(opts) -> dict:
         if a.error:
             return None
         r = upload_data(f"http://{a.url}/{a.fid}", payload, compress=False,
-                        auth=a.auth)
+                        auth=a.auth, session=_session())
         lat_w[i] = time.perf_counter() - t0
         return a.fid if not r.error else None
 
@@ -56,12 +67,11 @@ def run_benchmark(opts) -> dict:
     if not getattr(opts, "skipRead", False):
         mc = MasterClient(master)
         lat_r = np.zeros(len(fids))
-        session = requests.Session()
 
         def read_one(i: int):
             t0 = time.perf_counter()
             urls = mc.lookup_file_id(fids[i])
-            r = session.get(urls[0], timeout=30)
+            r = _session().get(urls[0], timeout=30)
             lat_r[i] = time.perf_counter() - t0
             return r.status_code == 200 and len(r.content) == size
 

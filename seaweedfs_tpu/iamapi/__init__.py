@@ -15,7 +15,9 @@ import threading
 import time
 import urllib.parse
 import xml.etree.ElementTree as ET
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
+
+from ..utils.httpd import TunedThreadingHTTPServer
 
 from ..pb import filer_pb2, rpc
 from ..s3api.auth import AuthError, Identity, IdentityAccessManagement
@@ -94,10 +96,10 @@ class IamServer:
         self.s3_server = s3_server
         self._lock = threading.Lock()
         self.identities: list[Identity] = self.store.load()
-        self._httpd: ThreadingHTTPServer | None = None
+        self._httpd: TunedThreadingHTTPServer | None = None
 
     def start(self) -> None:
-        self._httpd = ThreadingHTTPServer(("", self.port),
+        self._httpd = TunedThreadingHTTPServer(("", self.port),
                                           _make_handler(self))
         threading.Thread(target=self._httpd.serve_forever,
                          daemon=True).start()

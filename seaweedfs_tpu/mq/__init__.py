@@ -242,10 +242,9 @@ class MqHttpServer:
 
     def start(self) -> None:
         import threading
-        from http.server import (
-            BaseHTTPRequestHandler,
-            ThreadingHTTPServer,
-        )
+        from http.server import BaseHTTPRequestHandler
+
+        from ..utils.httpd import TunedThreadingHTTPServer
 
         broker = self.broker
 
@@ -310,7 +309,7 @@ class MqHttpServer:
                                       200 if ok else 404)
                 self._json({"error": "not found"}, 404)
 
-        self._httpd = ThreadingHTTPServer(("", self.port), Handler)
+        self._httpd = TunedThreadingHTTPServer(("", self.port), Handler)
         threading.Thread(target=self._httpd.serve_forever,
                          daemon=True).start()
 

@@ -57,9 +57,11 @@ class UploadResult:
 def upload_data(url: str, data: bytes, *, filename: str = "",
                 mime: str = "application/octet-stream", ttl: str = "",
                 compress: bool = True, retries: int = 3,
-                auth: str = "") -> UploadResult:
+                auth: str = "", session=None) -> UploadResult:
     """PUT needle bytes to a volume server (UploadData w/ retry,
-    upload_content.go:85,134)."""
+    upload_content.go:85,134). Pass a requests.Session to reuse keepalive
+    connections on hot paths (one session per thread — Session is not
+    safe for concurrent use)."""
     headers = {"Content-Type": mime or "application/octet-stream"}
     if auth:
         headers["Authorization"] = f"Bearer {auth}"
@@ -72,9 +74,10 @@ def upload_data(url: str, data: bytes, *, filename: str = "",
     if ttl:
         url += ("&" if "?" in url else "?") + f"ttl={ttl}"
     last: Exception | None = None
+    http = session or requests
     for attempt in range(retries):
         try:
-            r = requests.put(url, data=body, headers=headers, timeout=60)
+            r = http.put(url, data=body, headers=headers, timeout=60)
             if r.status_code < 300:
                 j = r.json()
                 return UploadResult(name=j.get("name", filename),

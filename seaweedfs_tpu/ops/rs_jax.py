@@ -23,7 +23,9 @@ bit-identical to the Go path.
 
 from __future__ import annotations
 
+import collections
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -121,29 +123,30 @@ def decode_matrix_cached(
 # compact identity of the matrix — ("parity", k, m) or ("dec", k, m, present)
 # — so the hot path never re-serializes or re-expands matrix contents.
 # LRU eviction: hot keys (the encode parity matrix) survive survivor-set churn.
-import collections
-
 _DERIVED_MAX = 4096
 _derived_forms: "collections.OrderedDict[tuple, np.ndarray]" = (
     collections.OrderedDict()
 )
+_derived_lock = threading.Lock()
 
 
 def _derived(form: str, key: tuple, matrix: np.ndarray) -> np.ndarray:
     full = (form, *key)
-    got = _derived_forms.get(full)
-    if got is None:
-        if form == "bits":
-            got = gf_matrix_to_bits(matrix)
-        else:
-            from .rs_xor import xor_coefficients
+    with _derived_lock:
+        got = _derived_forms.get(full)
+        if got is not None:
+            _derived_forms.move_to_end(full)
+            return got
+    if form == "bits":
+        got = gf_matrix_to_bits(matrix)
+    else:
+        from .rs_xor import xor_coefficients
 
-            got = xor_coefficients(matrix)
+        got = xor_coefficients(matrix)
+    with _derived_lock:
         while len(_derived_forms) >= _DERIVED_MAX:
             _derived_forms.popitem(last=False)
         _derived_forms[full] = got
-    else:
-        _derived_forms.move_to_end(full)
     return got
 
 

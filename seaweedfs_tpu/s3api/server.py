@@ -18,7 +18,9 @@ import time
 import urllib.parse
 import uuid
 import xml.etree.ElementTree as ET
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
+
+from ..utils.httpd import TunedThreadingHTTPServer
 
 import grpc
 
@@ -63,7 +65,7 @@ class S3Server:
         self._session = rq.Session()
 
     def start(self) -> None:
-        self._http_server = ThreadingHTTPServer(
+        self._http_server = TunedThreadingHTTPServer(
             ("", self.port), _make_handler(self))
         threading.Thread(target=self._http_server.serve_forever,
                          daemon=True).start()

@@ -16,7 +16,9 @@ import hashlib
 import json
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
+
+from ..utils.httpd import TunedThreadingHTTPServer
 from urllib.parse import parse_qs, unquote, urlparse
 
 import grpc
@@ -151,7 +153,7 @@ class FilerServer:
         rpc.add_servicer(self._grpc_server, rpc.FILER_SERVICE, FilerGrpc(self))
         self._grpc_server.add_insecure_port(f"[::]:{self.grpc_port}")
         self._grpc_server.start()
-        self._http_server = ThreadingHTTPServer(
+        self._http_server = TunedThreadingHTTPServer(
             ("", self.port), _make_http_handler(self))
         threading.Thread(target=self._http_server.serve_forever,
                          daemon=True).start()

@@ -22,7 +22,9 @@ import json
 import queue
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
+
+from ..utils.httpd import TunedThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 import grpc
@@ -145,7 +147,7 @@ class MasterServer:
         rpc.add_servicer(self._grpc_server, rpc.MASTER_SERVICE, MasterGrpc(self))
         self._grpc_server.add_insecure_port(f"[::]:{self.grpc_port}")
         self._grpc_server.start()
-        self._http_server = ThreadingHTTPServer(
+        self._http_server = TunedThreadingHTTPServer(
             ("", self.port), _make_http_handler(self)
         )
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()

@@ -16,7 +16,9 @@ import os
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
+
+from ..utils.httpd import TunedThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 import grpc
@@ -89,6 +91,7 @@ class VolumeServer:
         self._hb_wake = threading.Event()
         # vid -> {shard_id: [addresses]} with expiry (store_ec.go:238 cache)
         self._ec_loc_cache: dict[int, tuple[float, dict[int, list[str]]]] = {}
+        self._loc_cache: dict[int, tuple[float, list[str]]] = {}
 
     @property
     def address(self) -> str:
@@ -101,7 +104,7 @@ class VolumeServer:
         rpc.add_servicer(self._grpc_server, rpc.VOLUME_SERVICE, VolumeGrpc(self))
         self._grpc_server.add_insecure_port(f"[::]:{self.grpc_port}")
         self._grpc_server.start()
-        self._http_server = ThreadingHTTPServer(
+        self._http_server = TunedThreadingHTTPServer(
             ("", self.port), _make_http_handler(self)
         )
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
@@ -338,16 +341,39 @@ class VolumeServer:
             list(ex.map(send, [a for a in locations if a != self.address]))
 
     def lookup_volume_locations(self, vid: int) -> list[str]:
+        """Replica locations for a volume, cached ~10s (the write hot path
+        calls this per request; GetWritableRemoteReplications in the
+        reference resolves peers from its own topology push instead —
+        store_replicate.go:188)."""
+        now = time.monotonic()
+        hit = self._loc_cache.get(vid)
+        if hit and hit[0] > now:
+            return hit[1]
+        locs: list[str] = []
+        ok = False
         try:
             stub = rpc.master_stub(self.master_grpc)
             resp = stub.LookupVolume(
                 master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)]),
                 timeout=10)
             for e in resp.volume_id_locations:
-                return [l.url for l in e.locations]
+                locs = [l.url for l in e.locations]
+                break
+            ok = True
         except grpc.RpcError:
             pass
-        return []
+        # a failed lookup must not disable replication for a full TTL —
+        # cache it only long enough to ride out a hiccup
+        self._loc_cache[vid] = (now + (10.0 if ok else 1.0), locs)
+        return locs
+
+    def volume_needs_replication(self, vid: int) -> bool:
+        """False when the volume's own superblock says single-copy (the
+        common case) — skips the per-write location lookup entirely."""
+        v = self.store.find_volume(vid)
+        if v is None:
+            return True  # unknown here: let the lookup decide
+        return v.super_block.replica_placement.copy_count > 1
 
 
 # -- gRPC admin servicer ---------------------------------------------------
@@ -1156,7 +1182,8 @@ def _make_http_handler(srv: VolumeServer):
                 return self._json({"error": str(e)}, 403)
             except IOError as e:
                 return self._json({"error": str(e)}, 500)
-            if q.get("type") != "replicate":
+            if q.get("type") != "replicate" and \
+                    srv.volume_needs_replication(fid.volume_id):
                 locs = srv.lookup_volume_locations(fid.volume_id)
                 if len(locs) > 1:
                     try:
@@ -1200,7 +1227,8 @@ def _make_http_handler(srv: VolumeServer):
                 return self._json({"size": 0}, 202)
             except CookieMismatch as e:
                 return self._json({"error": str(e)}, 403)
-            if q.get("type") != "replicate":
+            if q.get("type") != "replicate" and \
+                    srv.volume_needs_replication(fid.volume_id):
                 del_headers = {}
                 if srv.write_jwt_key:
                     from ..security import gen_write_jwt

@@ -14,7 +14,9 @@ import threading
 import time
 import urllib.parse
 import xml.etree.ElementTree as ET
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from http.server import BaseHTTPRequestHandler
+
+from ..utils.httpd import TunedThreadingHTTPServer
 
 import requests
 
@@ -34,7 +36,7 @@ class WebDavServer:
         self.port = port
         self.filer = filer
         self.base_dir = base_dir.rstrip("/") or ""
-        self._httpd: ThreadingHTTPServer | None = None
+        self._httpd: TunedThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
     @property
@@ -43,7 +45,7 @@ class WebDavServer:
 
     def start(self) -> None:
         handler = _make_handler(self)
-        self._httpd = ThreadingHTTPServer(("0.0.0.0", self.port), handler)
+        self._httpd = TunedThreadingHTTPServer(("0.0.0.0", self.port), handler)
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()

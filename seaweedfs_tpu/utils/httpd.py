@@ -1,0 +1,26 @@
+"""Shared HTTP server base for every gateway/server in the package.
+
+One tuning matters enormously for the data plane: TCP_NODELAY on accepted
+sockets. BaseHTTPRequestHandler writes status line, headers, and body as
+separate send()s; with Nagle on, a keepalive connection alternates between
+a Nagle-delayed small write and the peer's delayed ACK, stalling ~40ms per
+request (measured: 44ms/GET with a requests.Session vs 1.4ms with fresh
+connections). The reference's Go net/http sets TCP_NODELAY by default, so
+its keepalive path never hits this.
+"""
+
+from __future__ import annotations
+
+import socket
+from http.server import ThreadingHTTPServer
+
+
+class TunedThreadingHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def process_request(self, request, client_address):
+        try:
+            request.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        super().process_request(request, client_address)
