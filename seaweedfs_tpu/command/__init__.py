@@ -56,6 +56,10 @@ def main(argv=None) -> int:
     vp.add_argument("-tierConfig", default="",
                     help="JSON file of tier backends, e.g. "
                          '{"local": {"default": {"root": "/mnt/tier"}}}')
+    vp.add_argument("-nativeDataPlane", dest="native", action="store_true",
+                    help="serve needle GET/PUT/DELETE from the C++ data "
+                         "plane on the public port (JWT/guard configs "
+                         "disable it)")
 
     fp = sub.add_parser("filer", help="run a filer server")
     fp.add_argument("-ip", default="localhost")
@@ -184,6 +188,12 @@ def main(argv=None) -> int:
     bp.add_argument("-collection", default="")
     bp.add_argument("-write", dest="do_write", action="store_true", default=True)
     bp.add_argument("-skipRead", action="store_true")
+    bp.add_argument("-assignBatch", type=int, default=0,
+                    help="files per master assign (fid _delta suffixes); "
+                         "0 = default (1, or 64 with -nativeClient)")
+    bp.add_argument("-nativeClient", action="store_true",
+                    help="drive PUT/GET loops from the compiled C++ client "
+                         "(parity with the reference's Go benchmark client)")
 
     wd = sub.add_parser("webdav", help="run a WebDAV gateway")
     wd.add_argument("-port", type=int, default=7333)
@@ -320,7 +330,7 @@ def _run(opts) -> int:
                                              if opts.index != "memory"
                                              else "memory"),
                             write_jwt_key=sec["write_key"],
-                            guard=guard)
+                            guard=guard, native=opts.native)
         vsrv.start()
         _wait_forever()
         vsrv.stop()

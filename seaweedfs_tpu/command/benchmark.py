@@ -1,29 +1,65 @@
 """`weed benchmark` equivalent: concurrent small-file write/read benchmark
 with latency percentiles (reference: /root/reference/weed/command/
-benchmark.go:73-111, percentile printer :437)."""
+benchmark.go:73-111, percentile printer :437).
+
+Client efficiency matters when comparing against the reference's Go
+client on the same host: this tool uses raw http.client keepalive
+connections (one per worker thread) and can amortize master assigns over
+`assign_batch` files via the fid "_delta" suffix the assign API hands out
+(Assign count=N; needle.go ParsePath:117-142 semantics).
+"""
 
 from __future__ import annotations
 
+import http.client
 import secrets
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
-import requests
 
-from ..operation import assign, upload_data
+from ..operation import assign
 from ..wdclient import MasterClient
 
 _tl = threading.local()
 
 
-def _session() -> requests.Session:
-    """Per-thread keepalive session (Session is not concurrency-safe)."""
-    s = getattr(_tl, "session", None)
-    if s is None:
-        s = _tl.session = requests.Session()
-    return s
+def _conn(addr: str) -> http.client.HTTPConnection:
+    """Per-(thread, server) keepalive connection."""
+    conns = getattr(_tl, "conns", None)
+    if conns is None:
+        conns = _tl.conns = {}
+    c = conns.get(addr)
+    if c is None:
+        host, _, port = addr.partition(":")
+        c = conns[addr] = http.client.HTTPConnection(host, int(port),
+                                                     timeout=30)
+    return c
+
+
+def _request(addr: str, method: str, path: str, body=None, headers=None):
+    """One keepalive request; transparently follows a single 307 (the
+    native data plane redirects non-fast paths to the python listener)
+    and reconnects once on a dropped keepalive connection."""
+    for attempt in (0, 1):
+        c = _conn(addr)
+        try:
+            c.request(method, path, body=body, headers=headers or {})
+            r = c.getresponse()
+            data = r.read()
+        except (http.client.HTTPException, OSError):
+            c.close()
+            if attempt:
+                raise
+            continue
+        if r.status == 307:
+            loc = r.getheader("Location") or ""
+            host = loc.split("//", 1)[1]
+            dest, _, path2 = host.partition("/")
+            return _request(dest, method, "/" + path2, body, headers)
+        return r.status, data
+    raise IOError("unreachable")
 
 
 def _percentiles(lat: np.ndarray) -> str:
@@ -36,53 +72,162 @@ def _percentiles(lat: np.ndarray) -> str:
 
 
 def run_benchmark(opts) -> dict:
+    if getattr(opts, "nativeClient", False):
+        return run_benchmark_native(opts)
     n, size, conc = opts.n, opts.size, opts.c
+    batch = max(1, int(getattr(opts, "assignBatch", 0) or 1))
     master = opts.master
     payload = secrets.token_bytes(size)
-    fids: list[str] = []
     lat_w = np.zeros(n)
+    fids: list[str | None] = [None] * n
+    headers = {"Content-Type": "application/octet-stream"}
 
-    def write_one(i: int):
-        t0 = time.perf_counter()
-        a = assign(master, collection=opts.collection)
-        if a.error:
-            return None
-        r = upload_data(f"http://{a.url}/{a.fid}", payload, compress=False,
-                        auth=a.auth, session=_session())
-        lat_w[i] = time.perf_counter() - t0
-        return a.fid if not r.error else None
+    def write_range(start: int, count: int):
+        """One worker chunk: assign in batches, PUT each fid."""
+        done = start
+        while done < start + count:
+            todo = min(batch, start + count - done)
+            a = assign(master, count=todo, collection=opts.collection)
+            if a.error:
+                done += todo
+                continue
+            hdrs = dict(headers)
+            if a.auth:
+                hdrs["Authorization"] = f"Bearer {a.auth}"
+            for j in range(todo):
+                fid = a.fid if j == 0 else f"{a.fid}_{j}"
+                t0 = time.perf_counter()
+                try:
+                    status, _ = _request(a.url, "PUT", f"/{fid}",
+                                         body=payload, headers=hdrs)
+                except (OSError, http.client.HTTPException):
+                    status = 599
+                lat_w[done + j] = time.perf_counter() - t0
+                if status < 300:
+                    fids[done + j] = fid
+            done += todo
 
+    per = n // conc
+    ranges = [(i * per, per) for i in range(conc)]
+    ranges[-1] = (ranges[-1][0], n - ranges[-1][0])
     t0 = time.perf_counter()
     with ThreadPoolExecutor(max_workers=conc) as ex:
-        fids = [f for f in ex.map(write_one, range(n)) if f]
+        list(ex.map(lambda r: write_range(*r), ranges))
     dt_w = time.perf_counter() - t0
+    written = [f for f in fids if f]
     wr = {"requests_per_sec": n / dt_w, "total_s": dt_w,
-          "failed": n - len(fids), "mb_per_sec": n * size / dt_w / 1e6}
+          "failed": n - len(written), "mb_per_sec": n * size / dt_w / 1e6}
     print(f"\nwrite: {wr['requests_per_sec']:.1f} req/s, "
           f"{wr['mb_per_sec']:.2f} MB/s, {dt_w:.2f} s total, "
-          f"{wr['failed']} failed")
-    print(f"write latency: {_percentiles(lat_w[:len(fids)])}")
+          f"{wr['failed']} failed"
+          + (f" (assign batch {batch})" if batch > 1 else ""))
+    print(f"write latency: {_percentiles(lat_w[:len(written)])}")
 
     results = {"write": wr}
     if not getattr(opts, "skipRead", False):
         mc = MasterClient(master)
-        lat_r = np.zeros(len(fids))
+        lat_r = np.zeros(len(written))
+        ok_count = [0] * conc
 
-        def read_one(i: int):
-            t0 = time.perf_counter()
-            urls = mc.lookup_file_id(fids[i])
-            r = _session().get(urls[0], timeout=30)
-            lat_r[i] = time.perf_counter() - t0
-            return r.status_code == 200 and len(r.content) == size
+        def read_range(t: int, start: int, count: int):
+            ok = 0
+            for i in range(start, min(start + count, len(written))):
+                t0 = time.perf_counter()
+                try:
+                    urls = mc.lookup_file_id(written[i])
+                    addr = urls[0].split("//", 1)[1].split("/", 1)[0]
+                    status, data = _request(addr, "GET", "/" + written[i])
+                    ok += status == 200 and len(data) == size
+                except (OSError, IndexError, http.client.HTTPException):
+                    pass
+                lat_r[i] = time.perf_counter() - t0
+            ok_count[t] = ok
+
+        per = max(1, len(written) // conc)
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=conc) as ex:
+            list(ex.map(lambda a: read_range(*a),
+                        [(t, t * per,
+                          per if t < conc - 1 else len(written) - t * per)
+                         for t in range(conc)]))
+        dt_r = time.perf_counter() - t0
+        total_ok = sum(ok_count)
+        rd = {"requests_per_sec": len(written) / dt_r, "total_s": dt_r,
+              "failed": len(written) - total_ok}
+        print(f"\nread: {rd['requests_per_sec']:.1f} req/s, {dt_r:.2f} s "
+              f"total, {rd['failed']} failed")
+        print(f"read latency: {_percentiles(lat_r)}")
+        results["read"] = rd
+    return results
+
+
+def run_benchmark_native(opts) -> dict:
+    """Compiled-client benchmark: assigns batched through the master, then
+    the C++ keepalive loop (native/dataplane.cpp swdp_bench) drives the
+    PUT/GET hot loops — the counterpart of the reference's Go client."""
+    import ctypes
+
+    from ..native.dataplane import bench_loop
+
+    n, size, conc = opts.n, opts.size, opts.c
+    # native client defaults to batched assigns (Go-client parity); an
+    # explicit -assignBatch value (incl. 1) is honored
+    batch = max(1, int(getattr(opts, "assignBatch", 0) or 64))
+    master = opts.master
+    payload = secrets.token_bytes(size)
+
+    # plan: reserve all fids up front (count=N assigns), grouped by server
+    by_addr: dict[str, list[str]] = {}
+    got = 0
+    while got < n:
+        todo = min(batch, n - got)
+        a = assign(master, count=todo, collection=opts.collection)
+        if a.error:
+            raise RuntimeError(a.error)
+        fl = by_addr.setdefault(a.url, [])
+        fl.append(a.fid)
+        fl.extend(f"{a.fid}_{j}" for j in range(1, todo))
+        got += todo
+
+    # split each server's list across conc workers
+    jobs = []
+    for addr, fl in by_addr.items():
+        per = max(1, len(fl) // conc)
+        for i in range(0, len(fl), per):
+            jobs.append((addr, fl[i:i + per]))
+
+    def run_phase(is_put: bool):
+        lats = []
+        oks = [0] * len(jobs)
+
+        def worker(i):
+            addr, fl = jobs[i]
+            lat = (ctypes.c_int64 * len(fl))()
+            oks[i] = bench_loop(addr, fl, payload if is_put else None, lat)
+            lats.append(np.ctypeslib.as_array(lat).copy())
 
         t0 = time.perf_counter()
         with ThreadPoolExecutor(max_workers=conc) as ex:
-            ok = sum(ex.map(read_one, range(len(fids))))
-        dt_r = time.perf_counter() - t0
-        rd = {"requests_per_sec": len(fids) / dt_r, "total_s": dt_r,
-              "failed": len(fids) - ok}
+            list(ex.map(worker, range(len(jobs))))
+        dt = time.perf_counter() - t0
+        lat_s = np.concatenate(lats) / 1e9 if lats else np.zeros(0)
+        return sum(oks), dt, lat_s
+
+    ok_w, dt_w, lat_w = run_phase(True)
+    wr = {"requests_per_sec": n / dt_w, "total_s": dt_w, "failed": n - ok_w,
+          "mb_per_sec": n * size / dt_w / 1e6}
+    print(f"\nwrite: {wr['requests_per_sec']:.1f} req/s, "
+          f"{wr['mb_per_sec']:.2f} MB/s, {dt_w:.2f} s total, "
+          f"{wr['failed']} failed (native client, assign batch {batch})")
+    print(f"write latency: {_percentiles(lat_w)}")
+    results = {"write": wr}
+
+    if not getattr(opts, "skipRead", False):
+        ok_r, dt_r, lat_r = run_phase(False)
+        rd = {"requests_per_sec": n / dt_r, "total_s": dt_r,
+              "failed": n - ok_r}
         print(f"\nread: {rd['requests_per_sec']:.1f} req/s, {dt_r:.2f} s "
-              f"total, {rd['failed']} failed")
+              f"total, {rd['failed']} failed (native client)")
         print(f"read latency: {_percentiles(lat_r)}")
         results["read"] = rd
     return results

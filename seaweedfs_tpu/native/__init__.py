@@ -1,0 +1,5 @@
+"""Native (C++) runtime components: the volume-server HTTP data plane."""
+
+from .dataplane import NativeDataPlane, native_available
+
+__all__ = ["NativeDataPlane", "native_available"]

@@ -1,0 +1,1119 @@
+// Native volume-server data plane: HTTP needle reads/writes in C++.
+//
+// The reference's data plane is Go's net/http + a compiled storage engine
+// (/root/reference/weed/server/volume_server_handlers_read.go:31,
+// volume_server_handlers_write.go:18, weed/storage/needle/needle_write.go:20).
+// A Python per-request handler costs ~1-3ms of interpreter time; this plane
+// serves the hot paths — GET/PUT/DELETE of /vid,fid — from a C++ thread pool
+// with keepalive, and 307-redirects everything else (status pages, EC
+// volumes, range/conditional/image requests, multipart) to the Python
+// listener, which keeps full behavioral coverage.
+//
+// On-disk formats are bit-identical to the Python engine (and the
+// reference): needle v1/v2/v3 records (needle.py, needle_write.go:20-113),
+// append-only .idx entries id8+offset4+size4 big-endian in units of 8
+// bytes, CRC32-Castagnoli data checksums. Python-side mutations funnel
+// through swdp_append_record/swdp_delete so there is exactly one writer
+// authority per volume (see native/dataplane.py).
+//
+// Exported C ABI (ctypes):
+//   swdp_start / swdp_stop
+//   swdp_add_volume / swdp_remove_volume / swdp_reload_volume
+//   swdp_set_writable
+//   swdp_append_record / swdp_delete / swdp_read  (+ swdp_free)
+//   swdp_volume_stats
+//   swdp_request_count
+
+#include <arpa/inet.h>
+#include <cerrno>
+#include <cstdarg>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <ctime>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <memory>
+#include <mutex>
+#include <shared_mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------- crc32c --
+
+uint32_t crc_table[8][256];
+
+void crc_init() {
+  const uint32_t poly = 0x82F63B78u;  // reversed Castagnoli
+  for (uint32_t i = 0; i < 256; i++) {
+    uint32_t c = i;
+    for (int k = 0; k < 8; k++) c = (c & 1) ? (c >> 1) ^ poly : c >> 1;
+    crc_table[0][i] = c;
+  }
+  for (uint32_t i = 0; i < 256; i++)
+    for (int t = 1; t < 8; t++)
+      crc_table[t][i] =
+          (crc_table[t - 1][i] >> 8) ^ crc_table[0][crc_table[t - 1][i] & 0xFF];
+}
+
+uint32_t crc32c(const uint8_t* p, size_t n, uint32_t crc = 0) {
+  crc = ~crc;
+  while (n >= 8) {
+    crc ^= (uint32_t)p[0] | ((uint32_t)p[1] << 8) | ((uint32_t)p[2] << 16) |
+           ((uint32_t)p[3] << 24);
+    uint32_t hi = (uint32_t)p[4] | ((uint32_t)p[5] << 8) |
+                  ((uint32_t)p[6] << 16) | ((uint32_t)p[7] << 24);
+    crc = crc_table[7][crc & 0xFF] ^ crc_table[6][(crc >> 8) & 0xFF] ^
+          crc_table[5][(crc >> 16) & 0xFF] ^ crc_table[4][crc >> 24] ^
+          crc_table[3][hi & 0xFF] ^ crc_table[2][(hi >> 8) & 0xFF] ^
+          crc_table[1][(hi >> 16) & 0xFF] ^ crc_table[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  while (n--) crc = crc_table[0][(crc ^ *p++) & 0xFF] ^ (crc >> 8);
+  return ~crc;
+}
+
+// legacy CRC.Value() transform accepted on reads (crc.py crc_value_legacy,
+// reference crc.go:25-27): rotate + magic add, kept for old volumes
+uint32_t crc_legacy(uint32_t v) {
+  return (((v >> 15) | (v << 17)) + 0xA282EAD8u) & 0xFFFFFFFFu;
+}
+
+// ------------------------------------------------------------- constants --
+
+constexpr int kHeaderSize = 16;    // cookie4 + id8 + size4
+constexpr int kChecksumSize = 4;
+constexpr int kTimestampSize = 8;  // v3 appendAtNs
+constexpr int kPad = 8;
+constexpr int32_t kTombstone = -1;
+constexpr int64_t kMaxVolumeSize = 32LL * 1024 * 1024 * 1024;
+
+constexpr uint8_t kFlagCompressed = 0x01;
+constexpr uint8_t kFlagHasName = 0x02;
+constexpr uint8_t kFlagHasMime = 0x04;
+constexpr uint8_t kFlagHasLastModified = 0x08;
+constexpr uint8_t kFlagHasTtl = 0x10;
+constexpr uint8_t kFlagHasPairs = 0x20;
+
+int pad_len(int32_t size, int version) {
+  int64_t body = kHeaderSize + (int64_t)size + kChecksumSize;
+  if (version == 3) body += kTimestampSize;
+  return kPad - (int)(body % kPad);  // always 1..8 (types.py padding_length)
+}
+
+int64_t actual_size(int32_t size, int version) {
+  int64_t body = kHeaderSize + (int64_t)size + kChecksumSize;
+  if (version == 3) body += kTimestampSize;
+  return body + pad_len(size, version);
+}
+
+void put_u32(uint8_t* p, uint32_t v) {
+  p[0] = v >> 24; p[1] = v >> 16; p[2] = v >> 8; p[3] = v;
+}
+void put_u64(uint8_t* p, uint64_t v) {
+  for (int i = 0; i < 8; i++) p[i] = (uint8_t)(v >> (56 - 8 * i));
+}
+uint32_t get_u32(const uint8_t* p) {
+  return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+         ((uint32_t)p[2] << 8) | p[3];
+}
+uint64_t get_u64(const uint8_t* p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; i++) v = (v << 8) | p[i];
+  return v;
+}
+
+// --------------------------------------------------------------- volumes --
+
+struct NeedleValue {
+  uint32_t stored_offset;  // units of 8 bytes
+  int32_t size;            // body size field; <=0 invalid
+};
+
+struct Volume {
+  uint32_t vid = 0;
+  std::string dat_path, idx_path;
+  int dat_fd = -1, idx_fd = -1;
+  int version = 3;
+  bool writable = true;
+  std::mutex mu;  // guards appends + map mutation + counters
+  std::unordered_map<uint64_t, NeedleValue> map;
+  int64_t idx_loaded = 0;  // bytes of .idx reflected in `map`
+  int64_t dat_size = 0;
+  uint64_t last_append_ns = 0;
+  uint64_t max_key = 0;
+  int64_t file_count = 0, file_bytes = 0;
+  int64_t del_count = 0, del_bytes = 0;
+
+  ~Volume() {
+    if (dat_fd >= 0) close(dat_fd);
+    if (idx_fd >= 0) close(idx_fd);
+  }
+
+  // Apply one idx entry to the in-memory map (NeedleMap._load semantics).
+  void apply(uint64_t key, uint32_t off, int32_t size) {
+    if (key > max_key) max_key = key;
+    file_count++;
+    auto it = map.find(key);
+    if (off != 0 && size > 0) {
+      if (it != map.end() && it->second.stored_offset != 0 &&
+          it->second.size > 0) {
+        del_count++;
+        del_bytes += it->second.size;
+      }
+      map[key] = NeedleValue{off, size};
+      file_bytes += size;
+    } else {
+      del_count++;
+      if (it != map.end()) {
+        if (it->second.size > 0) del_bytes += it->second.size;
+        map.erase(it);
+      }
+    }
+  }
+
+  // Read .idx entries in [idx_loaded, EOF) into the map. mu held.
+  bool catchup() {
+    struct stat st;
+    if (fstat(idx_fd, &st) != 0) return false;
+    if (st.st_size <= idx_loaded) return true;
+    int64_t want = st.st_size - idx_loaded;
+    std::vector<uint8_t> buf(want);
+    int64_t got = pread(idx_fd, buf.data(), want, idx_loaded);
+    if (got < 0) return false;
+    got -= got % 16;
+    for (int64_t i = 0; i + 16 <= got; i += 16)
+      apply(get_u64(&buf[i]), get_u32(&buf[i + 8]),
+            (int32_t)get_u32(&buf[i + 12]));
+    idx_loaded += got;
+    return true;
+  }
+
+  bool open_files() {
+    dat_fd = open(dat_path.c_str(), O_RDWR | O_CREAT, 0644);
+    idx_fd = open(idx_path.c_str(), O_RDWR | O_CREAT, 0644);
+    if (dat_fd < 0 || idx_fd < 0) return false;
+    struct stat st;
+    if (fstat(dat_fd, &st) == 0) dat_size = st.st_size;
+    map.clear();
+    idx_loaded = 0;
+    file_count = file_bytes = del_count = del_bytes = 0;
+    max_key = 0;
+    return catchup();
+  }
+
+  uint64_t next_append_ns() {
+    struct timespec ts;
+    clock_gettime(CLOCK_REALTIME, &ts);
+    uint64_t now = (uint64_t)ts.tv_sec * 1000000000ull + ts.tv_nsec;
+    if (now <= last_append_ns) now = last_append_ns + 1;
+    last_append_ns = now;
+    return now;
+  }
+
+  // Append a fully-built record; write the idx entry; update the map.
+  // ns_off >= 0: stamp a fresh monotonic appendAtNs into blob[ns_off..+8).
+  // idx_size: size field for the idx entry (kTombstone for deletes).
+  // Returns byte offset in .dat, or -1. mu held.
+  int64_t append(uint8_t* blob, int64_t len, uint64_t key, int32_t idx_size,
+                 int64_t ns_off, uint64_t* ns_out) {
+    int64_t off = lseek(dat_fd, 0, SEEK_END);
+    if (off < 0) return -1;
+    if (off % kPad) {  // realign a torn tail (volume.py _append_record)
+      off += kPad - (off % kPad);
+      if (ftruncate(dat_fd, off) != 0) return -1;
+    }
+    if (off + len > kMaxVolumeSize) { errno = EFBIG; return -1; }
+    if (ns_off >= 0) {
+      uint64_t ns = next_append_ns();
+      put_u64(blob + ns_off, ns);
+      if (ns_out) *ns_out = ns;
+    }
+    int64_t wr = pwrite(dat_fd, blob, len, off);
+    if (wr != len) {
+      (void)!ftruncate(dat_fd, off);
+      return -1;
+    }
+    dat_size = off + len;
+    uint8_t ent[16];
+    put_u64(ent, key);
+    put_u32(ent + 8, (uint32_t)(off / kPad));
+    put_u32(ent + 12, (uint32_t)idx_size);
+    int64_t ioff = lseek(idx_fd, 0, SEEK_END);
+    if (pwrite(idx_fd, ent, 16, ioff) == 16 && ioff == idx_loaded) {
+      apply(key, (uint32_t)(off / kPad), idx_size);
+      idx_loaded += 16;
+    } else {
+      catchup();
+    }
+    return off;
+  }
+};
+
+struct Registry {
+  std::shared_mutex mu;
+  std::unordered_map<uint32_t, std::shared_ptr<Volume>> vols;
+
+  std::shared_ptr<Volume> find(uint32_t vid) {
+    std::shared_lock<std::shared_mutex> l(mu);
+    auto it = vols.find(vid);
+    return it == vols.end() ? nullptr : it->second;
+  }
+};
+
+// ------------------------------------------------------ needle build/read --
+
+struct ParsedNeedle {
+  uint32_t cookie = 0;
+  uint64_t id = 0;
+  int32_t size = 0;
+  const uint8_t* data = nullptr;
+  uint32_t data_len = 0;
+  uint8_t flags = 0;
+  const uint8_t* mime = nullptr;
+  uint8_t mime_len = 0;
+  uint64_t last_modified = 0;
+  uint32_t checksum = 0;
+};
+
+// Parse a v2/v3 record blob (needle.py from_bytes). Returns false on
+// structural error.
+bool parse_record(const uint8_t* b, int64_t len, int version,
+                  ParsedNeedle* out) {
+  if (len < kHeaderSize) return false;
+  out->cookie = get_u32(b);
+  out->id = get_u64(b + 4);
+  out->size = (int32_t)get_u32(b + 12);
+  int32_t size = out->size;
+  if (size < 0 || kHeaderSize + (int64_t)size + kChecksumSize > len)
+    return false;
+  if (version == 1) {
+    out->data = b + kHeaderSize;
+    out->data_len = size;
+  } else {
+    const uint8_t* p = b + kHeaderSize;
+    const uint8_t* end = p + size;
+    if (p + 4 > end) { out->data_len = 0; }
+    else {
+      uint32_t dlen = get_u32(p);
+      p += 4;
+      if (p + dlen > end) return false;
+      out->data = p;
+      out->data_len = dlen;
+      p += dlen;
+      if (p < end) out->flags = *p++;
+      if (p < end && (out->flags & kFlagHasName)) {
+        uint8_t nl = *p++;
+        p += nl;  // name skipped (not served in fast-path headers)
+      }
+      if (p < end && (out->flags & kFlagHasMime)) {
+        out->mime_len = *p++;
+        out->mime = p;
+        p += out->mime_len;
+      }
+      if (p + 5 <= end && (out->flags & kFlagHasLastModified)) {
+        uint64_t lm = 0;
+        for (int i = 0; i < 5; i++) lm = (lm << 8) | p[i];
+        out->last_modified = lm;
+        p += 5;
+      }
+      if (p > end) return false;
+    }
+  }
+  if (size > 0)
+    out->checksum = get_u32(b + kHeaderSize + size);
+  return true;
+}
+
+// ------------------------------------------------------------ HTTP plumb --
+
+struct Plane {
+  int id = 0;
+  Registry reg;
+  std::atomic<uint64_t> requests{0};
+  int listen_fd = -1;
+  int port = 0;
+  int redirect_port = 0;
+  std::atomic<bool> stop{false};
+  std::thread acceptor;
+  std::atomic<int> live_conns{0};
+};
+
+std::mutex g_planes_mu;
+std::unordered_map<int, std::shared_ptr<Plane>> g_planes;
+int g_next_plane = 1;
+
+std::shared_ptr<Plane> plane_of(int id) {
+  std::lock_guard<std::mutex> l(g_planes_mu);
+  auto it = g_planes.find(id);
+  return it == g_planes.end() ? nullptr : it->second;
+}
+
+// Look a volume up across planes by (plane, vid).
+std::shared_ptr<Volume> find_volume(int plane_id, uint32_t vid) {
+  auto pl = plane_of(plane_id);
+  return pl ? pl->reg.find(vid) : nullptr;
+}
+
+struct Request {
+  std::string method, path, query, version;
+  std::unordered_map<std::string, std::string> headers;  // lower-case keys
+  std::vector<uint8_t> body;
+  bool keepalive = true;
+
+  std::string header(const std::string& k) const {
+    auto it = headers.find(k);
+    return it == headers.end() ? "" : it->second;
+  }
+};
+
+// recv with the 1s SO_RCVTIMEO tick: >0 bytes, 0 peer closed,
+// -1 timeout tick (check stop / idle policy), -2 hard error.
+ssize_t recv_step(int fd, char* tmp, size_t cap) {
+  ssize_t n = recv(fd, tmp, cap, 0);
+  if (n > 0) return n;
+  if (n == 0) return 0;
+  if (errno == EAGAIN || errno == EWOULDBLOCK || errno == EINTR) return -1;
+  return -2;
+}
+
+bool read_exact(int fd, std::string& buf, size_t upto,
+                const std::atomic<bool>& stop) {
+  char tmp[8192];
+  int idle_ticks = 0;
+  while (buf.size() < upto) {
+    ssize_t n = recv_step(fd, tmp, sizeof tmp);
+    if (n > 0) { buf.append(tmp, n); idle_ticks = 0; continue; }
+    if (n == -1) {  // mid-body stall: give a slow sender 30s
+      if (stop.load(std::memory_order_relaxed) || ++idle_ticks > 30)
+        return false;
+      continue;
+    }
+    return false;
+  }
+  return true;
+}
+
+// Read one HTTP request. Returns 0 ok, -1 connection done, -2 bad request.
+int read_request(int fd, std::string& buf, Request* req,
+                 const std::atomic<bool>& stop) {
+  size_t hdr_end;
+  int idle_ticks = 0;
+  while ((hdr_end = buf.find("\r\n\r\n")) == std::string::npos) {
+    if (buf.size() > 64 * 1024) return -2;
+    char tmp[8192];
+    ssize_t n = recv_step(fd, tmp, sizeof tmp);
+    if (n > 0) { buf.append(tmp, n); idle_ticks = 0; continue; }
+    if (n == -1) {
+      if (stop.load(std::memory_order_relaxed)) return -1;
+      // idle keepalive connections may wait forever; a half-sent
+      // request line gets 30s
+      if (!buf.empty() && ++idle_ticks > 30) return -1;
+      continue;
+    }
+    return -1;
+  }
+  std::string head = buf.substr(0, hdr_end);
+  size_t line_end = head.find("\r\n");
+  std::string reqline = head.substr(0, line_end);
+  size_t sp1 = reqline.find(' '), sp2 = reqline.rfind(' ');
+  if (sp1 == std::string::npos || sp2 == sp1) return -2;
+  req->method = reqline.substr(0, sp1);
+  std::string target = reqline.substr(sp1 + 1, sp2 - sp1 - 1);
+  req->version = reqline.substr(sp2 + 1);
+  size_t qpos = target.find('?');
+  req->path = qpos == std::string::npos ? target : target.substr(0, qpos);
+  req->query = qpos == std::string::npos ? "" : target.substr(qpos + 1);
+  req->headers.clear();
+  size_t pos = line_end + 2;
+  while (pos < head.size()) {
+    size_t eol = head.find("\r\n", pos);
+    if (eol == std::string::npos) eol = head.size();
+    std::string line = head.substr(pos, eol - pos);
+    pos = eol + 2;
+    size_t colon = line.find(':');
+    if (colon == std::string::npos) continue;
+    std::string k = line.substr(0, colon);
+    for (auto& c : k) c = (char)tolower((unsigned char)c);
+    size_t vstart = colon + 1;
+    while (vstart < line.size() && line[vstart] == ' ') vstart++;
+    req->headers[k] = line.substr(vstart);
+  }
+  req->keepalive = req->version != "HTTP/1.0";
+  std::string conn = req->header("connection");
+  for (auto& c : conn) c = (char)tolower((unsigned char)c);
+  if (conn == "close") req->keepalive = false;
+  if (conn == "keep-alive") req->keepalive = true;
+
+  size_t body_start = hdr_end + 4;
+  size_t clen = 0;
+  std::string cl = req->header("content-length");
+  if (!cl.empty()) clen = (size_t)strtoull(cl.c_str(), nullptr, 10);
+  if (clen > 256u * 1024 * 1024) return -2;
+  if (!req->header("transfer-encoding").empty()) return -2;
+  if (!read_exact(fd, buf, body_start + clen, stop)) return -1;
+  req->body.assign(buf.begin() + body_start, buf.begin() + body_start + clen);
+  buf.erase(0, body_start + clen);
+  return 0;
+}
+
+void send_all(int fd, const void* p, size_t n) {
+  const char* c = (const char*)p;
+  while (n) {
+    ssize_t w = send(fd, c, n, MSG_NOSIGNAL);
+    if (w <= 0) return;
+    c += w;
+    n -= (size_t)w;
+  }
+}
+
+const char* status_text(int code) {
+  switch (code) {
+    case 200: return "OK";
+    case 201: return "Created";
+    case 202: return "Accepted";
+    case 304: return "Not Modified";
+    case 307: return "Temporary Redirect";
+    case 400: return "Bad Request";
+    case 403: return "Forbidden";
+    case 404: return "Not Found";
+    case 411: return "Length Required";
+    case 500: return "Internal Server Error";
+    default: return "";
+  }
+}
+
+void respond(int fd, const Request& req, int code, const std::string& ctype,
+             const std::string& extra_headers, const uint8_t* body,
+             size_t body_len) {
+  char head[1024];
+  int n = snprintf(head, sizeof head,
+                   "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
+                   "Content-Length: %zu\r\n%s%s\r\n",
+                   code, status_text(code), ctype.c_str(), body_len,
+                   extra_headers.c_str(),
+                   req.keepalive ? "" : "Connection: close\r\n");
+  if (req.method == "HEAD") body_len = 0;
+  // single buffer -> single send(): no Nagle/delayed-ACK interaction
+  std::string out;
+  out.reserve((size_t)n + body_len);
+  out.append(head, n);
+  if (body_len) out.append((const char*)body, body_len);
+  send_all(fd, out.data(), out.size());
+}
+
+void respond_json(int fd, const Request& req, int code,
+                  const std::string& json) {
+  respond(fd, req, code, "application/json", "", (const uint8_t*)json.data(),
+          json.size());
+}
+
+void redirect(int fd, const Request& req, int redirect_port) {
+  std::string host = req.header("host");
+  size_t colon = host.rfind(':');
+  if (colon != std::string::npos) host = host.substr(0, colon);
+  if (host.empty()) host = "127.0.0.1";
+  std::string loc = "Location: http://" + host + ":" +
+                    std::to_string(redirect_port) + req.path +
+                    (req.query.empty() ? "" : "?" + req.query) + "\r\n";
+  respond(fd, req, 307, "text/plain", loc, nullptr, 0);
+}
+
+// Parse "/vid,keyhex+cookiehex[.ext]". Returns false if not a fid path.
+bool parse_fid_path(const std::string& path, uint32_t* vid, uint64_t* key,
+                    uint32_t* cookie) {
+  if (path.size() < 4 || path[0] != '/') return false;
+  size_t comma = path.find(',');
+  if (comma == std::string::npos || comma <= 1) return false;
+  uint32_t v = 0;
+  for (size_t i = 1; i < comma; i++) {
+    if (!isdigit((unsigned char)path[i])) return false;
+    v = v * 10 + (path[i] - '0');
+  }
+  std::string hex = path.substr(comma + 1);
+  size_t dot = hex.find('.');
+  if (dot != std::string::npos) hex = hex.substr(0, dot);
+  uint64_t delta = 0;
+  size_t us = hex.rfind('_');
+  if (us != std::string::npos) {  // "key_delta" batched-assign suffix
+    for (size_t i = us + 1; i < hex.size(); i++) {
+      if (!isdigit((unsigned char)hex[i])) return false;
+      delta = delta * 10 + (unsigned)(hex[i] - '0');
+    }
+    if (us + 1 >= hex.size()) return false;
+    hex = hex.substr(0, us);
+  }
+  if (hex.size() <= 8 || hex.size() > 24) return false;
+  uint64_t k = 0;
+  uint32_t c = 0;
+  size_t split = hex.size() - 8;
+  for (size_t i = 0; i < hex.size(); i++) {
+    char ch = (char)tolower((unsigned char)hex[i]);
+    int d;
+    if (ch >= '0' && ch <= '9') d = ch - '0';
+    else if (ch >= 'a' && ch <= 'f') d = ch - 'a' + 10;
+    else return false;
+    if (i < split) k = (k << 4) | (unsigned)d;
+    else c = (c << 4) | (unsigned)d;
+  }
+  *vid = v;
+  *key = k + delta;
+  *cookie = c;
+  return true;
+}
+
+std::string etag_hex(uint32_t crc) {
+  char b[16];
+  snprintf(b, sizeof b, "%08x", crc);
+  return std::string(b);
+}
+
+std::string http_date(uint64_t unix_secs) {
+  char b[64];
+  time_t t = (time_t)unix_secs;
+  struct tm g;
+  gmtime_r(&t, &g);
+  strftime(b, sizeof b, "%a, %d %b %Y %H:%M:%S GMT", &g);
+  return std::string(b);
+}
+
+// ------------------------------------------------------------- handlers --
+
+void handle_get(Plane& pl, int fd, const Request& req, uint32_t vid,
+                uint64_t key, uint32_t cookie) {
+  auto vol = pl.reg.find(vid);
+  if (!vol) return redirect(fd, req, pl.redirect_port);
+  NeedleValue nv{0, 0};
+  {
+    std::lock_guard<std::mutex> l(vol->mu);
+    auto it = vol->map.find(key);
+    if (it == vol->map.end()) {
+      vol->catchup();  // maybe written outside our map (reload races)
+      it = vol->map.find(key);
+    }
+    if (it != vol->map.end()) nv = it->second;
+  }
+  if (nv.stored_offset == 0 || nv.size <= 0)
+    return respond(fd, req, 404, "text/plain", "", nullptr, 0);
+  int64_t total = actual_size(nv.size, vol->version);
+  std::vector<uint8_t> blob(total);
+  int64_t got = pread(vol->dat_fd, blob.data(), total,
+                      (int64_t)nv.stored_offset * kPad);
+  if (got != total)
+    return respond_json(fd, req, 500, "{\"error\":\"short read\"}");
+  ParsedNeedle n;
+  if (!parse_record(blob.data(), total, vol->version, &n) || n.size != nv.size)
+    return respond_json(fd, req, 500, "{\"error\":\"corrupt record\"}");
+  if (n.cookie != cookie)
+    return respond(fd, req, 404, "text/plain", "", nullptr, 0);
+  if (n.flags & (kFlagHasTtl | kFlagHasPairs))
+    return redirect(fd, req, pl.redirect_port);  // rare: py semantics
+  uint32_t actual = crc32c(n.data, n.data_len);
+  if (n.size > 0 && n.checksum != actual && n.checksum != crc_legacy(actual))
+    return respond_json(fd, req, 500,
+                        "{\"error\":\"CRC error! Data On Disk Corrupted\"}");
+  std::string etag = "\"" + etag_hex(actual) + "\"";
+  std::string inm = req.header("if-none-match");
+  std::string extra = "ETag: " + etag + "\r\n";
+  if (n.last_modified)
+    extra += "Last-Modified: " + http_date(n.last_modified) + "\r\n";
+  if (!inm.empty() && inm == etag)
+    return respond(fd, req, 304, "text/plain", extra, nullptr, 0);
+  std::string ctype = n.mime_len
+                          ? std::string((const char*)n.mime, n.mime_len)
+                          : "application/octet-stream";
+  if (n.flags & kFlagCompressed) {
+    std::string ae = req.header("accept-encoding");
+    if (ae.find("gzip") == std::string::npos)
+      return redirect(fd, req, pl.redirect_port);  // py decompresses
+    extra += "Content-Encoding: gzip\r\n";
+  }
+  respond(fd, req, 200, ctype, extra, n.data, n.data_len);
+}
+
+void handle_put(Plane& pl, int fd, const Request& req, uint32_t vid,
+                uint64_t key, uint32_t cookie) {
+  auto vol = pl.reg.find(vid);
+  if (!vol || !vol->writable)
+    return redirect(fd, req, pl.redirect_port);
+  std::string ct = req.header("content-type");
+  if (ct.rfind("multipart/", 0) == 0)
+    return redirect(fd, req, pl.redirect_port);
+  bool compressed = req.header("content-encoding") == "gzip";
+
+  const uint8_t* data = req.body.data();
+  uint32_t dlen = (uint32_t)req.body.size();
+  uint8_t flags = kFlagHasLastModified;
+  if (!ct.empty() && ct.size() < 256) flags |= kFlagHasMime;
+  if (compressed) flags |= kFlagCompressed;
+  uint64_t now_secs = (uint64_t)time(nullptr);
+
+  int32_t size = dlen ? (int32_t)(4 + dlen + 1 +
+                                  ((flags & kFlagHasMime) ? 1 + ct.size() : 0) +
+                                  5)
+                      : 0;
+  uint32_t crc = crc32c(data, dlen);
+  int64_t total = actual_size(size, vol->version);
+  std::vector<uint8_t> blob(total, 0);
+  uint8_t* p = blob.data();
+  put_u32(p, cookie);
+  put_u64(p + 4, key);
+  put_u32(p + 12, (uint32_t)size);
+  int64_t off = kHeaderSize;
+  if (dlen) {
+    put_u32(p + off, dlen);
+    off += 4;
+    memcpy(p + off, data, dlen);
+    off += dlen;
+    p[off++] = flags;
+    if (flags & kFlagHasMime) {
+      p[off++] = (uint8_t)ct.size();
+      memcpy(p + off, ct.data(), ct.size());
+      off += ct.size();
+    }
+    for (int i = 0; i < 5; i++)
+      p[off + i] = (uint8_t)(now_secs >> (32 - 8 * i));
+    off += 5;
+  }
+  put_u32(p + off, crc);
+  off += 4;
+  int64_t ns_off = vol->version == 3 ? off : -1;
+
+  {
+    std::lock_guard<std::mutex> l(vol->mu);
+    if (!vol->writable) {  // frozen between our gate check and the lock
+      // (commit_compact freeze: appending now would write the old inode)
+      goto frozen;
+    }
+    // dedup identical rewrite (volume.py _is_file_unchanged)
+    auto it = vol->map.find(key);
+    if (it != vol->map.end() && it->second.size > 0) {
+      int64_t old_total = actual_size(it->second.size, vol->version);
+      std::vector<uint8_t> old(old_total);
+      if (pread(vol->dat_fd, old.data(), old_total,
+                (int64_t)it->second.stored_offset * kPad) == old_total) {
+        ParsedNeedle on;
+        if (parse_record(old.data(), old_total, vol->version, &on)) {
+          if (on.cookie != cookie) {
+            return respond_json(fd, req, 403,
+                                "{\"error\":\"mismatching cookie\"}");
+          }
+          if (on.checksum == crc && on.data_len == dlen &&
+              memcmp(on.data, data, dlen) == 0) {
+            char out[128];
+            snprintf(out, sizeof out,
+                     "{\"name\": \"\", \"size\": %u, \"eTag\": \"%s\"}", dlen,
+                     etag_hex(crc).c_str());
+            return respond_json(fd, req, 201, out);
+          }
+        }
+      }
+    }
+    if (vol->append(blob.data(), total, key, size, ns_off, nullptr) < 0)
+      return respond_json(fd, req, 500, "{\"error\":\"append failed\"}");
+  }
+  {
+    char out[128];
+    snprintf(out, sizeof out,
+             "{\"name\": \"\", \"size\": %d, \"eTag\": \"%s\"}",
+             size, etag_hex(crc).c_str());
+    return respond_json(fd, req, 201, out);
+  }
+frozen:
+  redirect(fd, req, pl.redirect_port);
+}
+
+void handle_delete(Plane& pl, int fd, const Request& req, uint32_t vid,
+                   uint64_t key, uint32_t cookie) {
+  auto vol = pl.reg.find(vid);
+  if (!vol || !vol->writable)
+    return redirect(fd, req, pl.redirect_port);
+  int32_t freed = 0;
+  {
+    std::lock_guard<std::mutex> l(vol->mu);
+    if (!vol->writable)  // frozen between gate check and lock
+      goto frozen;
+    auto it = vol->map.find(key);
+    if (it == vol->map.end() || it->second.size <= 0)
+      return respond_json(fd, req, 404, "{\"size\": 0}");
+    // cookie check against the stored record (volume.py delete_needle)
+    uint8_t hdr[kHeaderSize];
+    if (pread(vol->dat_fd, hdr, kHeaderSize,
+              (int64_t)it->second.stored_offset * kPad) == kHeaderSize) {
+      if (get_u32(hdr) != cookie)
+        return respond_json(fd, req, 403,
+                            "{\"error\":\"cookie mismatch on delete\"}");
+    }
+    freed = it->second.size;
+    // zero-size deletion marker record (doDeleteRequest)
+    int64_t total = actual_size(0, vol->version);
+    std::vector<uint8_t> blob(total, 0);
+    put_u32(blob.data(), cookie);
+    put_u64(blob.data() + 4, key);
+    int64_t ns_off = vol->version == 3 ? kHeaderSize + kChecksumSize : -1;
+    if (vol->append(blob.data(), total, key, kTombstone, ns_off, nullptr) < 0)
+      return respond_json(fd, req, 500, "{\"error\":\"append failed\"}");
+  }
+  {
+    char out[64];
+    snprintf(out, sizeof out, "{\"size\": %d}", freed);
+    return respond_json(fd, req, 202, out);
+  }
+frozen:
+  redirect(fd, req, pl.redirect_port);
+}
+
+void handle_request(Plane& pl, int fd, const Request& req) {
+  pl.requests.fetch_add(1, std::memory_order_relaxed);
+  uint32_t vid, cookie;
+  uint64_t key;
+  if (!parse_fid_path(req.path, &vid, &key, &cookie))
+    return redirect(fd, req, pl.redirect_port);
+  if (req.method == "GET" || req.method == "HEAD") {
+    // queries (resize, readDeleted), ranges and ims need python semantics
+    if (!req.query.empty() || !req.header("range").empty() ||
+        !req.header("if-modified-since").empty())
+      return redirect(fd, req, pl.redirect_port);
+    return handle_get(pl, fd, req, vid, key, cookie);
+  }
+  if (req.method == "PUT" || req.method == "POST") {
+    if (!req.query.empty() && req.query != "type=replicate")
+      return redirect(fd, req, pl.redirect_port);
+    return handle_put(pl, fd, req, vid, key, cookie);
+  }
+  if (req.method == "DELETE") {
+    if (!req.query.empty() && req.query != "type=replicate")
+      return redirect(fd, req, pl.redirect_port);
+    return handle_delete(pl, fd, req, vid, key, cookie);
+  }
+  redirect(fd, req, pl.redirect_port);
+}
+
+void conn_loop(Plane* srv, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  struct timeval tv{1, 0};  // 1s ticks so stop is noticed promptly
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  std::string buf;
+  Request req;
+  while (!srv->stop.load(std::memory_order_relaxed)) {
+    int rc = read_request(fd, buf, &req, srv->stop);
+    if (rc == -1) break;
+    if (rc == -2) {
+      respond(fd, req, 400, "text/plain", "", nullptr, 0);
+      break;
+    }
+    handle_request(*srv, fd, req);
+    if (!req.keepalive) break;
+  }
+  close(fd);
+  srv->live_conns.fetch_sub(1, std::memory_order_relaxed);
+}
+
+void acceptor_loop(Plane* srv) {
+  while (!srv->stop.load(std::memory_order_relaxed)) {
+    int fd = accept(srv->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (srv->stop.load(std::memory_order_relaxed)) return;
+      continue;
+    }
+    if (srv->live_conns.load(std::memory_order_relaxed) >= 1024) {
+      close(fd);  // connection-flood backstop
+      continue;
+    }
+    srv->live_conns.fetch_add(1, std::memory_order_relaxed);
+    std::thread(conn_loop, srv, fd).detach();
+  }
+}
+
+}  // namespace
+
+// ----------------------------------------------------------------- C ABI --
+
+extern "C" {
+
+// Starts a plane; returns its positive id, or a negative errno.
+int swdp_start(const char* bind_ip, int port, int redirect_port,
+               int nthreads) {
+  static std::once_flag crc_once;
+  std::call_once(crc_once, crc_init);
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return -errno;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  addr.sin_addr.s_addr =
+      bind_ip && *bind_ip ? inet_addr(bind_ip) : INADDR_ANY;
+  if (bind(fd, (struct sockaddr*)&addr, sizeof addr) != 0 ||
+      listen(fd, 256) != 0) {
+    int e = errno;
+    close(fd);
+    return -e;
+  }
+  auto pl = std::make_shared<Plane>();
+  pl->listen_fd = fd;
+  pl->port = port;
+  pl->redirect_port = redirect_port;
+  (void)nthreads;  // per-connection threads; kept for ABI stability
+  {
+    std::lock_guard<std::mutex> l(g_planes_mu);
+    pl->id = g_next_plane++;
+    g_planes[pl->id] = pl;
+  }
+  pl->acceptor = std::thread(acceptor_loop, pl.get());
+  return pl->id;
+}
+
+void swdp_stop(int plane_id) {
+  std::shared_ptr<Plane> pl;
+  {
+    std::lock_guard<std::mutex> l(g_planes_mu);
+    auto it = g_planes.find(plane_id);
+    if (it == g_planes.end()) return;
+    pl = it->second;
+    g_planes.erase(it);
+  }
+  pl->stop.store(true);
+  shutdown(pl->listen_fd, SHUT_RDWR);
+  close(pl->listen_fd);
+  pl->acceptor.join();
+  // connection threads hold a raw Plane*; wait for them to notice stop
+  // (1s recv ticks). If any straggle, park the plane in a graveyard so
+  // the pointer stays valid for the thread's remaining lifetime.
+  for (int i = 0; i < 50 && pl->live_conns.load() > 0; i++)
+    usleep(100 * 1000);
+  {
+    std::unique_lock<std::shared_mutex> l(pl->reg.mu);
+    pl->reg.vols.clear();
+  }
+  if (pl->live_conns.load() > 0) {
+    static std::vector<std::shared_ptr<Plane>> graveyard;
+    static std::mutex gm;
+    std::lock_guard<std::mutex> l(gm);
+    graveyard.push_back(pl);
+  }
+}
+
+int swdp_add_volume(int plane_id, uint32_t vid, const char* dat_path,
+                    const char* idx_path, int version, int writable) {
+  auto pl = plane_of(plane_id);
+  if (!pl) return -ENOENT;
+  auto vol = std::make_shared<Volume>();
+  vol->vid = vid;
+  vol->dat_path = dat_path;
+  vol->idx_path = idx_path;
+  vol->version = version;
+  vol->writable = writable != 0;
+  if (!vol->open_files()) return -errno;
+  std::unique_lock<std::shared_mutex> l(pl->reg.mu);
+  pl->reg.vols[vid] = vol;
+  return 0;
+}
+
+int swdp_remove_volume(int plane_id, uint32_t vid) {
+  auto pl = plane_of(plane_id);
+  if (!pl) return -ENOENT;
+  std::unique_lock<std::shared_mutex> l(pl->reg.mu);
+  return pl->reg.vols.erase(vid) ? 0 : -1;
+}
+
+int swdp_reload_volume(int plane_id, uint32_t vid) {
+  auto vol = find_volume(plane_id, vid);
+  if (!vol) return -1;
+  std::lock_guard<std::mutex> l(vol->mu);
+  if (vol->dat_fd >= 0) close(vol->dat_fd);
+  if (vol->idx_fd >= 0) close(vol->idx_fd);
+  vol->dat_fd = vol->idx_fd = -1;
+  return vol->open_files() ? 0 : -errno;
+}
+
+int swdp_set_writable(int plane_id, uint32_t vid, int writable) {
+  auto vol = find_volume(plane_id, vid);
+  if (!vol) return -1;
+  std::lock_guard<std::mutex> l(vol->mu);
+  vol->writable = writable != 0;
+  return 0;
+}
+
+// Append a caller-built record (Python mutation funnel). Stamps a fresh
+// monotonic appendAtNs at ns_off when ns_off >= 0. Returns the byte offset
+// or a negative errno. idx_size: entry size field (-1 tombstone).
+int64_t swdp_append_record(int plane_id, uint32_t vid, uint64_t key,
+                           uint8_t* blob, int64_t len, int32_t idx_size,
+                           int64_t ns_off, uint64_t* ns_out) {
+  auto vol = find_volume(plane_id, vid);
+  if (!vol) return -ENOENT;
+  std::lock_guard<std::mutex> l(vol->mu);
+  int64_t off = vol->append(blob, len, key, idx_size, ns_off, ns_out);
+  return off < 0 ? -(int64_t)(errno ? errno : EIO) : off;
+}
+
+// Read the full record blob for a needle. *out is malloc'd; caller frees
+// via swdp_free. Returns blob length, 0 if absent/deleted, negative errno.
+int64_t swdp_read(int plane_id, uint32_t vid, uint64_t key, uint8_t** out) {
+  auto vol = find_volume(plane_id, vid);
+  if (!vol) return -ENOENT;
+  NeedleValue nv{0, 0};
+  {
+    std::lock_guard<std::mutex> l(vol->mu);
+    auto it = vol->map.find(key);
+    if (it == vol->map.end()) {
+      vol->catchup();
+      it = vol->map.find(key);
+    }
+    if (it != vol->map.end()) nv = it->second;
+  }
+  if (nv.stored_offset == 0 || nv.size <= 0) return 0;
+  int64_t total = actual_size(nv.size, vol->version);
+  uint8_t* buf = (uint8_t*)malloc(total);
+  if (!buf) return -ENOMEM;
+  int64_t got =
+      pread(vol->dat_fd, buf, total, (int64_t)nv.stored_offset * kPad);
+  if (got != total) {
+    free(buf);
+    return -EIO;
+  }
+  *out = buf;
+  return total;
+}
+
+void swdp_free(uint8_t* p) { free(p); }
+
+int swdp_volume_stats(int plane_id, uint32_t vid, int64_t* file_count,
+                      int64_t* file_bytes, int64_t* del_count,
+                      int64_t* del_bytes, uint64_t* max_key,
+                      int64_t* dat_size) {
+  auto vol = find_volume(plane_id, vid);
+  if (!vol) return -1;
+  std::lock_guard<std::mutex> l(vol->mu);
+  vol->catchup();
+  if (file_count) *file_count = vol->file_count;
+  if (file_bytes) *file_bytes = vol->file_bytes;
+  if (del_count) *del_count = vol->del_count;
+  if (del_bytes) *del_bytes = vol->del_bytes;
+  if (max_key) *max_key = vol->max_key;
+  if (dat_size) *dat_size = vol->dat_size;
+  return 0;
+}
+
+// ---------------------------------------------------------- bench client --
+// Native benchmark driver: one keepalive connection looping PUT or GET
+// over a fid list (the compiled-client counterpart of the reference's Go
+// `weed benchmark` loop, benchmark.go:73-111). Returns the number of
+// 2xx responses; per-request latencies (ns) land in out_lat_ns.
+
+static bool bench_connect(const char* host, int port, int* out_fd) {
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return false;
+  struct sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  addr.sin_addr.s_addr = inet_addr(host);
+  if (addr.sin_addr.s_addr == INADDR_NONE)
+    addr.sin_addr.s_addr = inet_addr("127.0.0.1");
+  if (connect(fd, (struct sockaddr*)&addr, sizeof addr) != 0) {
+    close(fd);
+    return false;
+  }
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  struct timeval tv{30, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  *out_fd = fd;
+  return true;
+}
+
+// Read one HTTP response (headers + content-length body); returns status
+// or -1. `buf` carries leftover pipelined bytes between calls.
+static int bench_read_response(int fd, std::string& buf) {
+  size_t hdr_end;
+  while ((hdr_end = buf.find("\r\n\r\n")) == std::string::npos) {
+    char tmp[8192];
+    ssize_t n = recv(fd, tmp, sizeof tmp, 0);
+    if (n <= 0) return -1;
+    buf.append(tmp, n);
+  }
+  if (buf.size() < 12) return -1;
+  int status = atoi(buf.c_str() + 9);
+  size_t clen = 0;
+  size_t p = buf.find("ontent-Length:");
+  if (p != std::string::npos && p < hdr_end)
+    clen = (size_t)strtoull(buf.c_str() + p + 14, nullptr, 10);
+  size_t total = hdr_end + 4 + clen;
+  char tmp[8192];
+  while (buf.size() < total) {
+    ssize_t n = recv(fd, tmp, sizeof tmp, 0);
+    if (n <= 0) return -1;
+    buf.append(tmp, n);
+  }
+  buf.erase(0, total);
+  return status;
+}
+
+extern "C" int64_t swdp_bench(const char* host, int port, int is_put,
+                              const char** fids, int nfids,
+                              const uint8_t* payload, int64_t plen,
+                              int64_t* out_lat_ns) {
+  int fd;
+  if (!bench_connect(host, port, &fd)) return -errno;
+  std::string head;
+  head.reserve(512);
+  std::string buf;
+  int64_t ok = 0;
+  for (int i = 0; i < nfids; i++) {
+    struct timespec t0, t1;
+    clock_gettime(CLOCK_MONOTONIC, &t0);
+    head.clear();
+    if (is_put) {
+      head += "PUT /";
+      head += fids[i];
+      head += " HTTP/1.1\r\nHost: bench\r\nContent-Type: "
+              "application/octet-stream\r\nContent-Length: ";
+      head += std::to_string(plen);
+      head += "\r\n\r\n";
+      send_all(fd, head.data(), head.size());
+      send_all(fd, payload, (size_t)plen);
+    } else {
+      head += "GET /";
+      head += fids[i];
+      head += " HTTP/1.1\r\nHost: bench\r\n\r\n";
+      send_all(fd, head.data(), head.size());
+    }
+    int status = bench_read_response(fd, buf);
+    if (status < 0) {  // dropped keepalive: reconnect once
+      close(fd);
+      buf.clear();
+      if (!bench_connect(host, port, &fd)) break;
+      continue;
+    }
+    clock_gettime(CLOCK_MONOTONIC, &t1);
+    if (out_lat_ns)
+      out_lat_ns[i] = (t1.tv_sec - t0.tv_sec) * 1000000000LL +
+                      (t1.tv_nsec - t0.tv_nsec);
+    if (status >= 200 && status < 300) ok++;
+  }
+  close(fd);
+  return ok;
+}
+
+uint64_t swdp_request_count(int plane_id) {
+  auto pl = plane_of(plane_id);
+  return pl ? pl->requests.load() : 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,193 @@
+"""ctypes loader + wrapper for the C++ volume data plane (dataplane.cpp).
+
+The plane binds the volume server's public port and serves needle
+GET/PUT/DELETE from C++ worker threads; everything else is 307-redirected
+to the Python listener. Volumes are registered per-vid; all Python-side
+mutations to a registered volume MUST funnel through append_record /
+delete (one writer authority — the C++ lock) and reads through read_blob.
+
+Built on first use with g++, mirroring ops/rs_native.py.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_NATIVE_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_NATIVE_DIR, "dataplane.cpp")
+_SO = os.path.join(_NATIVE_DIR, "libswfs_dataplane.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _build() -> None:
+    cmd = ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", "-pthread",
+           _SRC, "-o", _SO]
+    subprocess.run(cmd, check=True, capture_output=True)
+
+
+def load_library() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            _build()
+        lib = ctypes.CDLL(_SO)
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        lib.swdp_start.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                   ctypes.c_int, ctypes.c_int]
+        lib.swdp_start.restype = ctypes.c_int
+        lib.swdp_stop.argtypes = [ctypes.c_int]
+        lib.swdp_stop.restype = None
+        lib.swdp_add_volume.argtypes = [ctypes.c_int, ctypes.c_uint32,
+                                        ctypes.c_char_p, ctypes.c_char_p,
+                                        ctypes.c_int, ctypes.c_int]
+        lib.swdp_add_volume.restype = ctypes.c_int
+        lib.swdp_remove_volume.argtypes = [ctypes.c_int, ctypes.c_uint32]
+        lib.swdp_remove_volume.restype = ctypes.c_int
+        lib.swdp_reload_volume.argtypes = [ctypes.c_int, ctypes.c_uint32]
+        lib.swdp_reload_volume.restype = ctypes.c_int
+        lib.swdp_set_writable.argtypes = [ctypes.c_int, ctypes.c_uint32,
+                                          ctypes.c_int]
+        lib.swdp_set_writable.restype = ctypes.c_int
+        lib.swdp_append_record.argtypes = [
+            ctypes.c_int, ctypes.c_uint32, ctypes.c_uint64, u8p,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_uint64)]
+        lib.swdp_append_record.restype = ctypes.c_int64
+        lib.swdp_read.argtypes = [ctypes.c_int, ctypes.c_uint32,
+                                  ctypes.c_uint64, ctypes.POINTER(u8p)]
+        lib.swdp_read.restype = ctypes.c_int64
+        lib.swdp_free.argtypes = [u8p]
+        lib.swdp_free.restype = None
+        lib.swdp_volume_stats.argtypes = [ctypes.c_int, ctypes.c_uint32] + \
+            [ctypes.POINTER(ctypes.c_int64)] * 4 + \
+            [ctypes.POINTER(ctypes.c_uint64), ctypes.POINTER(ctypes.c_int64)]
+        lib.swdp_volume_stats.restype = ctypes.c_int
+        lib.swdp_request_count.argtypes = [ctypes.c_int]
+        lib.swdp_request_count.restype = ctypes.c_uint64
+        lib.swdp_bench.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                   ctypes.c_int,
+                                   ctypes.POINTER(ctypes.c_char_p),
+                                   ctypes.c_int, u8p, ctypes.c_int64,
+                                   ctypes.POINTER(ctypes.c_int64)]
+        lib.swdp_bench.restype = ctypes.c_int64
+        _lib = lib
+        return _lib
+
+
+def bench_loop(addr: str, fids: list[str], payload: bytes | None,
+               lat_out=None) -> int:
+    """Run the native keepalive PUT/GET loop over `fids` against addr
+    ("host:port"). payload=None means GET. Returns the 2xx count; fills
+    lat_out (ctypes int64 array) with per-request ns latencies. Releases
+    the GIL for the whole loop."""
+    lib = load_library()
+    host, _, port = addr.partition(":")
+    arr = (ctypes.c_char_p * len(fids))(*[f.encode() for f in fids])
+    if payload is None:
+        body, blen, is_put = None, 0, 0
+    else:
+        body = (ctypes.c_uint8 * len(payload)).from_buffer_copy(payload)
+        blen, is_put = len(payload), 1
+    ok = lib.swdp_bench(host.encode(), int(port), is_put, arr, len(fids),
+                        body, blen, lat_out)
+    if ok < 0:
+        raise IOError(f"bench loop vs {addr}: errno {-ok}")
+    return int(ok)
+
+
+def native_available() -> bool:
+    try:
+        load_library()
+        return True
+    except Exception:
+        return False
+
+
+class NativeDataPlane:
+    """One C++ HTTP plane instance (multiple may coexist per process)."""
+
+    def __init__(self, bind_ip: str, port: int, redirect_port: int,
+                 nthreads: int = 8):
+        self.lib = load_library()
+        self.port = port
+        self.redirect_port = redirect_port
+        self.plane_id = self.lib.swdp_start(bind_ip.encode(), port,
+                                            redirect_port, nthreads)
+        if self.plane_id <= 0:
+            raise OSError(
+                f"native data plane failed to start: {self.plane_id}")
+
+    def stop(self) -> None:
+        if self.plane_id > 0:
+            self.lib.swdp_stop(self.plane_id)
+            self.plane_id = 0
+
+    # -- volume registry ---------------------------------------------------
+
+    def add_volume(self, vid: int, dat_path: str, idx_path: str,
+                   version: int, writable: bool) -> None:
+        rc = self.lib.swdp_add_volume(self.plane_id, vid, dat_path.encode(),
+                                      idx_path.encode(), version,
+                                      1 if writable else 0)
+        if rc != 0:
+            raise OSError(f"add_volume {vid}: {rc}")
+
+    def remove_volume(self, vid: int) -> None:
+        self.lib.swdp_remove_volume(self.plane_id, vid)
+
+    def reload_volume(self, vid: int) -> None:
+        self.lib.swdp_reload_volume(self.plane_id, vid)
+
+    def set_writable(self, vid: int, writable: bool) -> None:
+        self.lib.swdp_set_writable(self.plane_id, vid, 1 if writable else 0)
+
+    # -- mutation funnel ---------------------------------------------------
+
+    def append_record(self, vid: int, key: int, blob: bytes, idx_size: int,
+                      ns_off: int) -> tuple[int, int]:
+        """Append a prebuilt record; C++ stamps appendAtNs at ns_off.
+        -> (byte_offset, append_at_ns)."""
+        buf = (ctypes.c_uint8 * len(blob)).from_buffer_copy(blob)
+        ns = ctypes.c_uint64(0)
+        off = self.lib.swdp_append_record(self.plane_id, vid, key, buf,
+                                          len(blob), idx_size, ns_off,
+                                          ctypes.byref(ns))
+        if off < 0:
+            raise IOError(f"native append vid={vid}: errno {-off}")
+        return int(off), int(ns.value)
+
+    def read_blob(self, vid: int, key: int) -> bytes | None:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self.lib.swdp_read(self.plane_id, vid, key, ctypes.byref(out))
+        if n < 0:
+            raise IOError(f"native read vid={vid}: errno {-n}")
+        if n == 0:
+            return None
+        try:
+            return ctypes.string_at(out, n)
+        finally:
+            self.lib.swdp_free(out)
+
+    def volume_stats(self, vid: int) -> dict | None:
+        fc, fb, dc, db = (ctypes.c_int64() for _ in range(4))
+        mk = ctypes.c_uint64()
+        ds = ctypes.c_int64()
+        rc = self.lib.swdp_volume_stats(
+            self.plane_id, vid, ctypes.byref(fc), ctypes.byref(fb), ctypes.byref(dc),
+            ctypes.byref(db), ctypes.byref(mk), ctypes.byref(ds))
+        if rc != 0:
+            return None
+        return {"file_count": fc.value, "file_bytes": fb.value,
+                "del_count": dc.value, "del_bytes": db.value,
+                "max_key": mk.value, "dat_size": ds.value}
+
+    def request_count(self) -> int:
+        return int(self.lib.swdp_request_count(self.plane_id))

@@ -63,9 +63,20 @@ class VolumeServer:
                  tier_backends: dict | None = None,
                  needle_map_kind: str = "memory",
                  write_jwt_key: bytes = b"",
-                 guard=None):
+                 guard=None, native: bool = False):
         self.write_jwt_key = write_jwt_key
         self.guard = guard  # IP whitelist (security.Guard) or None
+        # C++ data plane: serves needle GET/PUT/DELETE on the public port,
+        # 307s everything else to the Python listener on admin_port. Only
+        # meaningful when neither JWT auth nor an IP guard is configured
+        # (those checks live in the Python handlers).
+        self.native_enabled = bool(native) and not write_jwt_key and guard is None
+        self.native_plane = None
+        if self.native_enabled:
+            self.admin_port = port + 11000 if port + 11000 < 65536 \
+                else port - 11000
+        else:
+            self.admin_port = port
         if tier_backends:
             from ..storage.backend import load_tier_backends
 
@@ -92,6 +103,7 @@ class VolumeServer:
         # vid -> {shard_id: [addresses]} with expiry (store_ec.go:238 cache)
         self._ec_loc_cache: dict[int, tuple[float, dict[int, list[str]]]] = {}
         self._loc_cache: dict[int, tuple[float, list[str]]] = {}
+        self._native_lock = threading.Lock()
 
     @property
     def address(self) -> str:
@@ -105,12 +117,65 @@ class VolumeServer:
         self._grpc_server.add_insecure_port(f"[::]:{self.grpc_port}")
         self._grpc_server.start()
         self._http_server = TunedThreadingHTTPServer(
-            ("", self.port), _make_http_handler(self)
+            ("", self.admin_port), _make_http_handler(self)
         )
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
+        if self.native_enabled:
+            from ..native import NativeDataPlane
+
+            self.native_plane = NativeDataPlane(
+                "", self.port, self.admin_port, nthreads=8)
+            self._sync_native_registry()
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
         threading.Thread(target=self._check_with_master, daemon=True).start()
-        glog.info(f"volume server started on {self.address} (grpc :{self.grpc_port})")
+        glog.info(f"volume server started on {self.address} "
+                  f"(grpc :{self.grpc_port}"
+                  + (f", native data plane, admin :{self.admin_port})"
+                     if self.native_plane else ")"))
+
+    def _sync_native_registry(self) -> None:
+        """Reconcile the C++ plane's volume registry with the store: add
+        new volumes, drop gone ones, track read-only flips. Called at
+        start, every heartbeat, and after volume lifecycle RPCs."""
+        plane = self.native_plane
+        if plane is None:
+            return
+        with self._native_lock:  # heartbeat + gRPC handlers race here
+            current: dict[int, object] = {}
+            for loc in self.store.locations:
+                current.update(loc.volumes)
+            registered = getattr(self, "_native_vids", {})
+            for vid, v in current.items():
+                if v.is_tiered or v._dat is None:
+                    continue
+                writable = (not v.read_only
+                            and v.super_block.replica_placement.copy_count == 1
+                            and not str(v.ttl))
+                if vid not in registered:
+                    base = v.file_name()
+                    try:
+                        plane.add_volume(vid, base + ".dat", base + ".idx",
+                                         v.version, writable)
+                    except OSError:
+                        continue
+                    v.native_writable = writable
+                    v.attach_native(plane)
+                    registered[vid] = writable
+                elif registered[vid] != writable:
+                    plane.set_writable(vid, writable)
+                    v.native_writable = writable
+                    registered[vid] = writable
+            for vid in list(registered):
+                if vid not in current:
+                    plane.remove_volume(vid)
+                    registered.pop(vid)
+            self._native_vids = registered
+            # absorb C++-appended idx entries so nm counters (heartbeats,
+            # vacuum decisions) stay authoritative
+            for vid in registered:
+                v = current.get(vid)
+                if v is not None:
+                    v.sync_native()
 
     def _check_with_master(self) -> None:
         """checkWithMaster (volume_grpc_client_to_master.go:28-47): pull
@@ -144,6 +209,9 @@ class VolumeServer:
             self._http_server.shutdown()
         if self._grpc_server:
             self._grpc_server.stop(grace=0.5)
+        if self.native_plane is not None:
+            self.native_plane.stop()
+            self.native_plane = None
         self.store.close()
 
     # -- heartbeat client (volume_grpc_client_to_master.go:50-92) ----------
@@ -172,6 +240,7 @@ class VolumeServer:
 
         def requests():
             while not self._stop.is_set():
+                self._sync_native_registry()
                 yield self.store.collect_heartbeat()
                 self._hb_wake.wait(self.pulse_seconds)
                 self._hb_wake.clear()
@@ -359,11 +428,11 @@ class VolumeServer:
             for e in resp.volume_id_locations:
                 locs = [l.url for l in e.locations]
                 break
-            ok = True
+            ok = bool(locs)  # empty list = master still warming: short TTL
         except grpc.RpcError:
             pass
-        # a failed lookup must not disable replication for a full TTL —
-        # cache it only long enough to ride out a hiccup
+        # a failed/empty lookup must not disable replication for a full
+        # TTL — cache it only long enough to ride out a hiccup
         self._loc_cache[vid] = (now + (10.0 if ok else 1.0), locs)
         return locs
 
@@ -439,6 +508,7 @@ class VolumeGrpc:
             request.replication, request.ttl,
         )
         self.srv.trigger_heartbeat()
+        self.srv._sync_native_registry()
         return vs.AllocateVolumeResponse()
 
     # ---- status / sync
@@ -477,11 +547,13 @@ class VolumeGrpc:
     def VolumeMount(self, request, context):
         self.store.mount_volume(request.volume_id)
         self.srv.trigger_heartbeat()
+        self.srv._sync_native_registry()
         return vs.VolumeMountResponse()
 
     def VolumeUnmount(self, request, context):
         self.store.unmount_volume(request.volume_id)
         self.srv.trigger_heartbeat()
+        self.srv._sync_native_registry()
         return vs.VolumeUnmountResponse()
 
     def VolumeDelete(self, request, context):
@@ -490,16 +562,19 @@ class VolumeGrpc:
         except NotFoundError:
             pass
         self.srv.trigger_heartbeat()
+        self.srv._sync_native_registry()
         return vs.VolumeDeleteResponse()
 
     def VolumeMarkReadonly(self, request, context):
         self._volume(request.volume_id, context).read_only = True
         self.srv.trigger_heartbeat()
+        self.srv._sync_native_registry()
         return vs.VolumeMarkReadonlyResponse()
 
     def VolumeMarkWritable(self, request, context):
         self._volume(request.volume_id, context).read_only = False
         self.srv.trigger_heartbeat()
+        self.srv._sync_native_registry()
         return vs.VolumeMarkWritableResponse()
 
     def VolumeConfigure(self, request, context):

@@ -116,29 +116,59 @@ class NeedleMap:
         self.deletion_counter = 0
         self.deletion_byte_counter = 0
         self._idx_file = open(idx_path, "ab")
+        # bytes of the .idx log reflected in the map — lets catchup_from_idx
+        # absorb entries appended by another writer (the native data plane)
+        self._idx_consumed = 0
         if os.path.getsize(idx_path):
             self._load()
+
+    def _apply(self, key: int, off: int, size: int) -> None:
+        """Replay one idx entry (doLoading semantics)."""
+        self.max_file_key = max(self.max_file_key, key)
+        self.file_counter += 1
+        if off != 0 and types.size_is_valid(size):
+            old = self._m.get(key)
+            self._m[key] = NeedleValue(off, size)
+            self.file_byte_counter += size
+            if old is not None and old.offset != 0 and types.size_is_valid(old.size):
+                self.deletion_counter += 1
+                self.deletion_byte_counter += old.size
+        else:
+            old = self._m.pop(key, None)
+            self.deletion_counter += 1
+            if old is not None:
+                self.deletion_byte_counter += max(old.size, 0)
 
     def _load(self) -> None:
         from . import idx as idx_mod
 
         ids, offs, sizes = idx_mod.read_index_file(self.idx_path)
         for i in range(len(ids)):
-            key, off, size = int(ids[i]), int(offs[i]), int(sizes[i])
-            self.max_file_key = max(self.max_file_key, key)
-            self.file_counter += 1
-            if off != 0 and types.size_is_valid(size):
-                old = self._m.get(key)
-                self._m[key] = NeedleValue(off, size)
-                self.file_byte_counter += size
-                if old is not None and old.offset != 0 and types.size_is_valid(old.size):
-                    self.deletion_counter += 1
-                    self.deletion_byte_counter += old.size
-            else:
-                old = self._m.pop(key, None)
-                self.deletion_counter += 1
-                if old is not None:
-                    self.deletion_byte_counter += max(old.size, 0)
+            self._apply(int(ids[i]), int(offs[i]), int(sizes[i]))
+        self._idx_consumed = len(ids) * types.NEEDLE_MAP_ENTRY_SIZE
+
+    def catchup_from_idx(self) -> int:
+        """Absorb idx entries appended past our watermark by another writer
+        (the C++ data plane appends both .dat records and .idx entries;
+        this keeps the Python map/counters authoritative for vacuum,
+        heartbeats and EC). -> number of entries applied."""
+        try:
+            size = os.path.getsize(self.idx_path)
+        except OSError:
+            return 0
+        if size <= self._idx_consumed:
+            return 0
+        with open(self.idx_path, "rb") as f:
+            f.seek(self._idx_consumed)
+            tail = f.read(size - self._idx_consumed)
+        n = len(tail) // types.NEEDLE_MAP_ENTRY_SIZE
+        for i in range(n):
+            key, off, sz = types.unpack_needle_map_entry(
+                tail[i * types.NEEDLE_MAP_ENTRY_SIZE:
+                     (i + 1) * types.NEEDLE_MAP_ENTRY_SIZE])
+            self._apply(key, off, sz)
+        self._idx_consumed += n * types.NEEDLE_MAP_ENTRY_SIZE
+        return n
 
     def put(self, key: int, stored_offset: int, size: int) -> None:
         old = self._m.get(key)
@@ -165,6 +195,7 @@ class NeedleMap:
     def _append(self, key: int, off: int, size: int) -> None:
         self._idx_file.write(types.pack_needle_map_entry(key, off, size))
         self._idx_file.flush()
+        self._idx_consumed += types.NEEDLE_MAP_ENTRY_SIZE
 
     def __len__(self) -> int:
         return len(self._m)
@@ -219,6 +250,13 @@ class Volume:
         self.last_modified_ts_seconds = 0
         self.is_compacting = False
         self._lock = threading.RLock()
+        # native (C++) data-plane attachment: when set, the plane is the
+        # single writer authority for this volume's .dat/.idx and all
+        # needle reads/writes funnel through it (native/dataplane.py).
+        # native_writable mirrors the registry's decision (False for
+        # replicated/TTL volumes whose PUTs must stay in Python).
+        self.native = None
+        self.native_writable = False
         self.remote_dat = None  # set when the .dat lives on a tier backend
         base = self.file_name()
         dat_exists = os.path.exists(base + ".dat")
@@ -325,12 +363,56 @@ class Volume:
             raise EOFError("short needle header")
         return Needle.parse_header(b)
 
+    # -- native data-plane funnel ------------------------------------------
+
+    def attach_native(self, plane) -> None:
+        """Hand write authority for this volume to the C++ data plane."""
+        with self._lock:
+            self.sync_native()
+            self.native = plane
+
+    def detach_native(self) -> None:
+        with self._lock:
+            self.native = None
+            self.sync_native()
+
+    def sync_native(self) -> None:
+        """Absorb .idx entries appended by the C++ plane so nm-based logic
+        (heartbeats, vacuum, EC preconditions) stays authoritative."""
+        with self._lock:
+            self.nm.catchup_from_idx()
+
+    def _native_write(self, n: Needle, check_cookie: bool = True) -> tuple[int, int, bool]:
+        """write_needle via the C++ single-writer (same semantics)."""
+        old_blob = self.native.read_blob(self.id, n.id)
+        if old_blob is not None:
+            old = Needle.from_bytes(old_blob, self.version, check_crc=False)
+            if n.cookie == 0 and not check_cookie:
+                n.cookie = old.cookie
+            if old.cookie != n.cookie:
+                raise CookieMismatch(f"mismatching cookie {n.cookie:x}")
+            if (not str(self.ttl) and old.checksum == n.checksum
+                    and old.data == n.data):
+                return 0, len(n.data), True
+        blob = bytearray(n.to_bytes(self.version))
+        ns_off = types.NEEDLE_HEADER_SIZE + n.size + types.NEEDLE_CHECKSUM_SIZE
+        off, ns = self.native.append_record(
+            self.id, n.id, bytes(blob), n.size,
+            ns_off if self.version == types.VERSION3 else -1)
+        n.append_at_ns = ns
+        self.last_append_at_ns = max(self.last_append_at_ns, ns)
+        if self.last_modified_ts_seconds < n.last_modified:
+            self.last_modified_ts_seconds = n.last_modified
+        return off, n.size, False
+
     def write_needle(self, n: Needle, check_cookie: bool = True) -> tuple[int, int, bool]:
         """Append a needle (doWriteRequest, volume_write.go:127-176).
         -> (offset_bytes, size, is_unchanged)."""
         with self._lock:
             if self.read_only:
                 raise IOError(f"volume {self.id} is read only")
+            if self.native is not None:
+                return self._native_write(n, check_cookie)
             if self._is_file_unchanged(n):
                 return 0, len(n.data), True
             nv = self.nm.get(n.id)
@@ -396,6 +478,8 @@ class Volume:
         with self._lock:
             if self.read_only:
                 raise IOError(f"volume {self.id} is read only")
+            if self.native is not None:
+                return self._native_delete(needle_id, cookie)
             nv = self.nm.get(needle_id)
             if nv is None or not types.size_is_valid(nv.size):
                 return 0
@@ -413,11 +497,39 @@ class Volume:
             self.nm.delete(needle_id, types.offset_to_stored(offset))
             return size
 
+    def _native_delete(self, needle_id: int, cookie: int | None) -> int:
+        old_blob = self.native.read_blob(self.id, needle_id)
+        if old_blob is None:
+            return 0
+        old = Needle.from_bytes(old_blob, self.version, check_crc=False)
+        if cookie is not None and old.cookie != cookie:
+            raise CookieMismatch("cookie mismatch on delete")
+        marker = Needle(id=needle_id, cookie=cookie or 0)
+        blob = marker.to_bytes(self.version)
+        ns_off = types.NEEDLE_HEADER_SIZE + types.NEEDLE_CHECKSUM_SIZE
+        _, ns = self.native.append_record(
+            self.id, needle_id, blob, types.TOMBSTONE_FILE_SIZE,
+            ns_off if self.version == types.VERSION3 else -1)
+        self.last_append_at_ns = max(self.last_append_at_ns, ns)
+        return old.size
+
     # -- read path ---------------------------------------------------------
 
     def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:
         """readNeedle (volume_read.go:19-72): map lookup, record read, CRC,
         cookie + TTL checks."""
+        if self.native is not None:
+            blob = self.native.read_blob(self.id, needle_id)
+            if blob is None:
+                raise NotFoundError(f"needle {needle_id:x} not found")
+            n = Needle.from_bytes(blob, self.version)
+            if cookie is not None and n.cookie != cookie:
+                raise CookieMismatch(
+                    f"cookie mismatch: read {n.cookie:x} expected {cookie:x}"
+                )
+            if n.has_expired():
+                raise NotFoundError(f"needle {needle_id:x} expired")
+            return n
         nv = self.nm.get(needle_id)
         if nv is None or nv.offset == 0:
             raise NotFoundError(f"needle {needle_id:x} not found")
@@ -539,6 +651,7 @@ class Volume:
                 raise IOError(
                     f"volume {self.id} is tiered; download before vacuum")
             self.is_compacting = True
+            self.nm.catchup_from_idx()  # native plane may have appended
             self._compact_idx_snapshot = os.path.getsize(self.nm.idx_path)
         try:
             base = self.file_name()
@@ -570,6 +683,11 @@ class Volume:
         snapshot (makeupDiff), atomically swap .cpd/.cpx into place."""
         base = self.file_name()
         with self._lock:
+            # freeze the C++ writer: anything it appended before the freeze
+            # is caught by _makeup_diff's idx-tail replay; nothing may land
+            # in the old .dat after the replay reads the tail
+            if self.native is not None:
+                self.native.set_writable(self.id, False)
             self._makeup_diff(base + ".cpd", base + ".cpx")
             self._dat.close()
             self.nm.close()
@@ -581,6 +699,11 @@ class Volume:
             self.super_block = SuperBlock.from_file(self._dat)
             self.nm = NeedleMap(base + ".idx", self.needle_map_kind)
             self.is_compacting = False
+            if self.native is not None:
+                self.native.reload_volume(self.id)
+                # restore the REGISTRY's writability decision, not blanket
+                # True: replicated/TTL volumes must keep redirecting PUTs
+                self.native.set_writable(self.id, self.native_writable)
 
     def _makeup_diff(self, cpd: str, cpx: str) -> None:
         """Replay .idx entries appended after the compaction snapshot onto
@@ -614,6 +737,9 @@ class Volume:
 
     def close(self) -> None:
         with self._lock:
+            if self.native is not None:
+                self.native.remove_volume(self.id)
+                self.native = None
             if self._dat is not None:
                 self._dat.close()
             self.nm.close()
