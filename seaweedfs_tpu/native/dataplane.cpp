@@ -25,6 +25,7 @@
 //   swdp_request_count
 
 #include <arpa/inet.h>
+#include <netdb.h>
 #include <cerrno>
 #include <cstdarg>
 #include <cstdint>
@@ -168,14 +169,16 @@ struct Volume {
     if (key > max_key) max_key = key;
     file_count++;
     auto it = map.find(key);
-    if (off != 0 && size > 0) {
+    // size == 0 is a live empty file (python Volume.write_needle keeps it
+    // in its map); only off==0 / negative size (tombstone) delete
+    if (off != 0 && size >= 0) {
       if (it != map.end() && it->second.stored_offset != 0 &&
           it->second.size > 0) {
         del_count++;
         del_bytes += it->second.size;
       }
       map[key] = NeedleValue{off, size};
-      file_bytes += size;
+      if (size > 0) file_bytes += size;
     } else {
       del_count++;
       if (it != map.end()) {
@@ -253,7 +256,15 @@ struct Volume {
     put_u32(ent + 8, (uint32_t)(off / kPad));
     put_u32(ent + 12, (uint32_t)idx_size);
     int64_t ioff = lseek(idx_fd, 0, SEEK_END);
-    if (pwrite(idx_fd, ent, 16, ioff) == 16 && ioff == idx_loaded) {
+    if (pwrite(idx_fd, ent, 16, ioff) != 16) {
+      // an acknowledged-but-unindexed needle would 404 forever: undo the
+      // .dat append and fail the request instead
+      (void)!ftruncate(idx_fd, ioff);
+      (void)!ftruncate(dat_fd, off);
+      dat_size = off;
+      return -1;
+    }
+    if (ioff == idx_loaded) {
       apply(key, (uint32_t)(off / kPad), idx_size);
       idx_loaded += 16;
     } else {
@@ -499,19 +510,25 @@ const char* status_text(int code) {
 void respond(int fd, const Request& req, int code, const std::string& ctype,
              const std::string& extra_headers, const uint8_t* body,
              size_t body_len) {
-  char head[1024];
-  int n = snprintf(head, sizeof head,
-                   "HTTP/1.1 %d %s\r\nContent-Type: %s\r\n"
-                   "Content-Length: %zu\r\n%s%s\r\n",
-                   code, status_text(code), ctype.c_str(), body_len,
-                   extra_headers.c_str(),
-                   req.keepalive ? "" : "Connection: close\r\n");
-  if (req.method == "HEAD") body_len = 0;
-  // single buffer -> single send(): no Nagle/delayed-ACK interaction
+  if (req.method == "HEAD") body = nullptr;
+  // single buffer -> single send(): no Nagle/delayed-ACK interaction.
+  // Composed as a std::string: header size is unbounded (redirect
+  // Locations echo the request path).
   std::string out;
-  out.reserve((size_t)n + body_len);
-  out.append(head, n);
-  if (body_len) out.append((const char*)body, body_len);
+  out.reserve(256 + extra_headers.size() + (body ? body_len : 0));
+  out += "HTTP/1.1 ";
+  out += std::to_string(code);
+  out += ' ';
+  out += status_text(code);
+  out += "\r\nContent-Type: ";
+  out += ctype;
+  out += "\r\nContent-Length: ";
+  out += std::to_string(body_len);
+  out += "\r\n";
+  out += extra_headers;
+  if (!req.keepalive) out += "Connection: close\r\n";
+  out += "\r\n";
+  if (body && body_len) out.append((const char*)body, body_len);
   send_all(fd, out.data(), out.size());
 }
 
@@ -606,7 +623,7 @@ void handle_get(Plane& pl, int fd, const Request& req, uint32_t vid,
     }
     if (it != vol->map.end()) nv = it->second;
   }
-  if (nv.stored_offset == 0 || nv.size <= 0)
+  if (nv.stored_offset == 0 || nv.size < 0)
     return respond(fd, req, 404, "text/plain", "", nullptr, 0);
   int64_t total = actual_size(nv.size, vol->version);
   std::vector<uint8_t> blob(total);
@@ -978,7 +995,7 @@ int64_t swdp_read(int plane_id, uint32_t vid, uint64_t key, uint8_t** out) {
     }
     if (it != vol->map.end()) nv = it->second;
   }
-  if (nv.stored_offset == 0 || nv.size <= 0) return 0;
+  if (nv.stored_offset == 0 || nv.size < 0) return 0;
   int64_t total = actual_size(nv.size, vol->version);
   uint8_t* buf = (uint8_t*)malloc(total);
   if (!buf) return -ENOMEM;
@@ -1018,14 +1035,23 @@ int swdp_volume_stats(int plane_id, uint32_t vid, int64_t* file_count,
 // 2xx responses; per-request latencies (ns) land in out_lat_ns.
 
 static bool bench_connect(const char* host, int port, int* out_fd) {
-  int fd = socket(AF_INET, SOCK_STREAM, 0);
-  if (fd < 0) return false;
   struct sockaddr_in addr{};
   addr.sin_family = AF_INET;
   addr.sin_port = htons((uint16_t)port);
   addr.sin_addr.s_addr = inet_addr(host);
-  if (addr.sin_addr.s_addr == INADDR_NONE)
-    addr.sin_addr.s_addr = inet_addr("127.0.0.1");
+  if (addr.sin_addr.s_addr == INADDR_NONE) {
+    struct addrinfo hints{}, *res = nullptr;
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    if (getaddrinfo(host, nullptr, &hints, &res) != 0 || !res) {
+      if (res) freeaddrinfo(res);
+      return false;
+    }
+    addr.sin_addr = ((struct sockaddr_in*)res->ai_addr)->sin_addr;
+    freeaddrinfo(res);
+  }
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return false;
   if (connect(fd, (struct sockaddr*)&addr, sizeof addr) != 0) {
     close(fd);
     return false;

@@ -249,3 +249,31 @@ def test_delta_fid_roundtrip(native_cluster):
         assert r.status_code == 201, (fid, r.text)
         g = s.get(f"http://{a.url}/{fid}")
         assert g.status_code == 200 and g.content == body, fid
+
+
+def test_long_url_no_stack_leak(native_cluster):
+    """Oversized request paths must yield a clean bounded response (the
+    redirect Location echoes the path — headers are built unbounded)."""
+    master, vsrv = native_cluster
+    long_path = "/" + "a" * 3000
+    r = requests.get(f"http://{vsrv.address}{long_path}",
+                     allow_redirects=False, timeout=10)
+    assert r.status_code == 307
+    assert r.headers["Location"].endswith("a" * 3000)
+    assert len(r.content) == 0
+
+
+def test_empty_body_put_roundtrip(native_cluster):
+    """Zero-length files serve back 200/empty and an empty overwrite does
+    not destroy the needle (live-map parity with the python engine)."""
+    master, vsrv = native_cluster
+    a = _assign(master)
+    s = requests.Session()
+    assert s.put(f"http://{a.url}/{a.fid}", data=b"").status_code == 201
+    g = s.get(f"http://{a.url}/{a.fid}")
+    assert g.status_code == 200 and g.content == b""
+    # non-empty then empty overwrite: empty wins, needle still present
+    assert s.put(f"http://{a.url}/{a.fid}", data=b"hello").status_code == 201
+    assert s.put(f"http://{a.url}/{a.fid}", data=b"").status_code == 201
+    g = s.get(f"http://{a.url}/{a.fid}")
+    assert g.status_code == 200 and g.content == b""
