@@ -56,10 +56,13 @@ def main(argv=None) -> int:
     vp.add_argument("-tierConfig", default="",
                     help="JSON file of tier backends, e.g. "
                          '{"local": {"default": {"root": "/mnt/tier"}}}')
-    vp.add_argument("-nativeDataPlane", dest="native", action="store_true",
+    vp.add_argument("-nativeDataPlane", dest="native", default="auto",
+                    nargs="?", const="on", choices=["auto", "on", "off"],
                     help="serve needle GET/PUT/DELETE from the C++ data "
-                         "plane on the public port (JWT/guard configs "
-                         "disable it)")
+                         "plane on the public port. auto = on when the "
+                         "toolchain builds it and no JWT/guard is "
+                         "configured (those paths stay Python); bare flag "
+                         "= on")
 
     fp = sub.add_parser("filer", help="run a filer server")
     fp.add_argument("-ip", default="localhost")
@@ -85,6 +88,10 @@ def main(argv=None) -> int:
     sp.add_argument("-filer.port", dest="filer_port", type=int, default=8888)
     sp.add_argument("-s3", action="store_true")
     sp.add_argument("-s3.port", dest="s3_port", type=int, default=8333)
+    sp.add_argument("-volume.nativeDataPlane", dest="volume_native",
+                    default="auto", nargs="?", const="on",
+                    choices=["auto", "on", "off"],
+                    help="C++ needle data plane on the volume public port")
 
     shp = sub.add_parser("shell", help="admin shell")
     shp.add_argument("-master", default="localhost:9333")
@@ -321,6 +328,15 @@ def _run(opts) -> int:
         sec = load_security_config()
         guard = Guard(whitelist=sec["whitelist"]) if sec["whitelist"] \
             else None
+        if opts.native == "auto":
+            if sec["write_key"] or guard is not None:
+                use_native = False  # python handlers own auth: skip probe
+            else:
+                from ..native import native_available
+
+                use_native = native_available()
+        else:
+            use_native = opts.native == "on"
         vsrv = VolumeServer(directories=dirs, master=opts.mserver,
                             ip=opts.ip, port=opts.port,
                             data_center=opts.dataCenter, rack=opts.rack,
@@ -330,7 +346,7 @@ def _run(opts) -> int:
                                              if opts.index != "memory"
                                              else "memory"),
                             write_jwt_key=sec["write_key"],
-                            guard=guard, native=opts.native)
+                            guard=guard, native=use_native)
         vsrv.start()
         _wait_forever()
         vsrv.stop()
@@ -364,9 +380,16 @@ def _run(opts) -> int:
 
         ms = MasterServer(ip=opts.ip, port=opts.master_port)
         ms.start()
+        if opts.volume_native == "auto":
+            from ..native import native_available
+
+            use_native = native_available()
+        else:
+            use_native = opts.volume_native == "on"
         vsrv = VolumeServer(directories=opts.dir.split(","),
                             master=f"{opts.ip}:{opts.master_port}",
-                            ip=opts.ip, port=opts.volume_port)
+                            ip=opts.ip, port=opts.volume_port,
+                            native=use_native)
         vsrv.start()
         stoppers = [vsrv.stop, ms.stop]
         if opts.filer or opts.s3:

@@ -70,6 +70,13 @@ class VolumeServer:
         # 307s everything else to the Python listener on admin_port. Only
         # meaningful when neither JWT auth nor an IP guard is configured
         # (those checks live in the Python handlers).
+        # SEAWEEDFS_TPU_NATIVE=1 forces it on process-wide, =0 forces it
+        # off (CI sweep knob); unset respects the constructor argument.
+        env_native = os.environ.get("SEAWEEDFS_TPU_NATIVE", "").lower()
+        if env_native in ("1", "true", "on"):
+            native = True
+        elif env_native in ("0", "false", "off"):
+            native = False
         self.native_enabled = bool(native) and not write_jwt_key and guard is None
         self.native_plane = None
         if self.native_enabled:
@@ -116,9 +123,18 @@ class VolumeServer:
         rpc.add_servicer(self._grpc_server, rpc.VOLUME_SERVICE, VolumeGrpc(self))
         self._grpc_server.add_insecure_port(f"[::]:{self.grpc_port}")
         self._grpc_server.start()
-        self._http_server = TunedThreadingHTTPServer(
-            ("", self.admin_port), _make_http_handler(self)
-        )
+        handler = _make_http_handler(self)
+        try:
+            self._http_server = TunedThreadingHTTPServer(
+                ("", self.admin_port), handler)
+        except OSError:
+            if not self.native_enabled:
+                raise
+            # deterministic admin port (public+11000) taken by another
+            # process: fall back to an ephemeral one — only redirects
+            # reference it, via the Location header
+            self._http_server = TunedThreadingHTTPServer(("", 0), handler)
+            self.admin_port = self._http_server.server_address[1]
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
         if self.native_enabled:
             from ..native import NativeDataPlane
@@ -1063,6 +1079,10 @@ class VolumeGrpc:
         v = self.store.find_volume(vid)
         if v is None:
             context.abort(grpc.StatusCode.NOT_FOUND, f"volume {vid} not found")
+        if v.native is not None:
+            # gRPC handlers read v.nm directly; absorb any idx entries the
+            # C++ plane appended first (cheap fstat when nothing changed)
+            v.sync_native()
         return v
 
     def _ec_base(self, vid: int, collection: str, context) -> str:

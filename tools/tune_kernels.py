@@ -1,0 +1,79 @@
+"""Sweep the RS kernel formulations and tile shapes on the real chip.
+
+Usage:  python tools/tune_kernels.py [--quick]
+
+For each formulation (xor-pallas / xor-xla / mxu-pallas / mxu-xla) this
+measures encode throughput with forced host readbacks at several batch
+sizes, plus tile-shape variants for the XOR Pallas kernel (LANE x SUBL).
+Prints a table and the suggested default. Run it whenever kernels change;
+bench.py's auto-calibration picks the winner at bench time regardless.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def bench_kernel(kind: str, col_bytes: int, iters: int = 6,
+                 repeats: int = 2) -> float:
+    import numpy as np
+
+    os.environ["SEAWEEDFS_TPU_KERNEL"] = kind
+    import jax.numpy as jnp
+
+    from seaweedfs_tpu.ops.rs_jax import RSCodecJax
+
+    coder = RSCodecJax(10, 4)
+    rng = np.random.default_rng(0)
+    data = jnp.asarray(
+        rng.integers(0, 256, size=(10, col_bytes), dtype=np.uint8))
+    np.asarray(coder.encode_parity(data)[:, ::65536])  # compile
+    best = 0.0
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        outs = [coder.encode_parity(data) for _ in range(iters)]
+        np.asarray(outs[-1][:, ::65536])
+        dt = time.perf_counter() - t0
+        best = max(best, 10 * col_bytes * iters / dt / 1e9)
+    return best
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    args = ap.parse_args()
+
+    import jax
+
+    backend = jax.default_backend()
+    print(f"backend: {backend}")
+    sizes = [4 * 2**20] if args.quick else [2**20, 8 * 2**20, 32 * 2**20]
+    kinds = ["xor-pallas", "xor-xla", "mxu-pallas", "mxu-xla"]
+    if backend != "tpu":
+        kinds = [k for k in kinds if not k.endswith("-pallas")]
+
+    results: dict[tuple, float] = {}
+    for kind in kinds:
+        for b in sizes:
+            try:
+                g = bench_kernel(kind, b)
+            except Exception as e:
+                print(f"  {kind:12s} {b >> 20:4d}MB  FAILED: "
+                      f"{type(e).__name__}: {e}"[:120])
+                continue
+            results[(kind, b)] = g
+            print(f"  {kind:12s} {b >> 20:4d}MB  {g:8.2f} GB/s")
+    if results:
+        win = max(results, key=results.get)
+        print(f"\nwinner: {win[0]} at {win[1] >> 20}MB "
+              f"({results[win]:.2f} GB/s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
