@@ -9,12 +9,27 @@ Rebuild of /root/reference/weed/operation/ — `Assign`
 from __future__ import annotations
 
 import gzip
+import threading
 import time
 from dataclasses import dataclass, field
 
 import requests
 
 from ..pb import master_pb2, rpc
+
+_tl = threading.local()
+
+
+def thread_session() -> requests.Session:
+    """Default per-thread keepalive session for volume-server uploads.
+    requests.Session is not safe for concurrent use, so each worker
+    thread gets its own (filer autochunker, S3 gateway, replication sinks
+    all upload from thread pools)."""
+    s = getattr(_tl, "session", None)
+    if s is None:
+        s = _tl.session = requests.Session()
+        s.trust_env = False  # skip per-request proxy-env scans
+    return s
 
 COMPRESS_MIN = 128  # don't bother gzipping tiny payloads
 
@@ -74,7 +89,7 @@ def upload_data(url: str, data: bytes, *, filename: str = "",
     if ttl:
         url += ("&" if "?" in url else "?") + f"ttl={ttl}"
     last: Exception | None = None
-    http = session or requests
+    http = session or thread_session()
     for attempt in range(retries):
         try:
             r = http.put(url, data=body, headers=headers, timeout=60)

@@ -20,6 +20,8 @@ import uuid
 import xml.etree.ElementTree as ET
 from http.server import BaseHTTPRequestHandler
 
+from ..operation import thread_session as _session
+
 from ..utils.httpd import TunedThreadingHTTPServer
 
 import grpc
@@ -62,7 +64,6 @@ class S3Server:
         self._http_server = None
         import requests as rq
 
-        self._session = rq.Session()
 
     def start(self) -> None:
         self._http_server = TunedThreadingHTTPServer(
@@ -174,7 +175,7 @@ class S3Server:
                     yield piece
 
             data = _tee()
-        r = self._session.put(
+        r = _session().put(
             url, data=data,
             headers={"Content-Type": content_type or "application/octet-stream"},
             timeout=600)
@@ -187,7 +188,7 @@ class S3Server:
         url = (f"http://{self.filer}{BUCKETS_DIR}/{bucket}/"
                + urllib.parse.quote(key))
         headers = {"Range": range_header} if range_header else {}
-        r = self._session.get(url, headers=headers, timeout=600,
+        r = _session().get(url, headers=headers, timeout=600,
                               stream=stream)
         if r.status_code == 404:
             r.close()
@@ -849,7 +850,7 @@ def _make_handler(srv: S3Server):
             body = self._body()
             url = (f"http://{srv.filer}{UPLOADS_DIR}/{upload_id}/"
                    f"{part_number:04d}.part")
-            r = srv._session.put(url, data=body, timeout=600)
+            r = _session().put(url, data=body, timeout=600)
             if r.status_code >= 300:
                 raise S3Error(500, "InternalError", "part upload failed")
             self._send(200, headers={

@@ -28,7 +28,7 @@ from ..filer import Attr, Entry, Filer
 from ..filer.filechunks import etag as chunks_etag, total_size, view_from_chunks
 from ..filer.filer import NotEmpty, NotFound, normalize
 from ..filer.filerstore import get_store
-from ..operation import assign, delete_files, upload_data
+from ..operation import assign, delete_files, thread_session, upload_data
 from ..pb import filer_pb2, master_pb2, rpc
 from ..utils import glog
 from ..utils.http import not_modified
@@ -79,7 +79,8 @@ class FilerServer:
         self.master_client = MasterClient(master)
         self._http_server = None
         self._grpc_server = None
-        self._session = rq.Session()
+        # per-thread keepalive sessions: handler threads must not share
+        # one Session (operation.thread_session docstring)
         # multi-filer peer aggregation (meta_aggregator.go)
         self.meta_aggregator = None
         self._peers = [p for p in (peers or []) if p]
@@ -275,7 +276,7 @@ class FilerServer:
             last_err = None
             for url in urls:
                 try:
-                    r = self._session.get(
+                    r = thread_session().get(
                         url, timeout=60,
                         headers={"Range":
                                  f"bytes={view.chunk_offset}-"
