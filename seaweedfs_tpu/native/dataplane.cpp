@@ -496,6 +496,7 @@ const char* status_text(int code) {
     case 200: return "OK";
     case 201: return "Created";
     case 202: return "Accepted";
+    case 206: return "Partial Content";
     case 304: return "Not Modified";
     case 307: return "Temporary Redirect";
     case 400: return "Bad Request";
@@ -652,11 +653,49 @@ void handle_get(Plane& pl, int fd, const Request& req, uint32_t vid,
   std::string ctype = n.mime_len
                           ? std::string((const char*)n.mime, n.mime_len)
                           : "application/octet-stream";
+  std::string rng = req.header("range");
   if (n.flags & kFlagCompressed) {
+    // py decompresses for non-gzip clients and for ranged reads
     std::string ae = req.header("accept-encoding");
-    if (ae.find("gzip") == std::string::npos)
-      return redirect(fd, req, pl.redirect_port);  // py decompresses
+    if (ae.find("gzip") == std::string::npos || !rng.empty())
+      return redirect(fd, req, pl.redirect_port);
     extra += "Content-Encoding: gzip\r\n";
+  }
+  if (!rng.empty()) {
+    // Common "bytes=lo-hi" / "bytes=lo-" ranges are served natively with
+    // volume.py's clamp semantics. Anything unusual — suffix/multi
+    // ranges, non-digits, overflow-scale bounds, start past EOF — is
+    // delegated to the python handler so edge semantics live in exactly
+    // one place.
+    uint64_t start = 0, hi = 0;
+    bool has_hi = false, clean = rng.rfind("bytes=", 0) == 0;
+    if (clean) {
+      std::string spec = rng.substr(6);
+      size_t dash = spec.find('-');
+      clean = dash != std::string::npos && dash > 0 && dash <= 15 &&
+              spec.size() - dash - 1 <= 15 &&
+              spec.find(',') == std::string::npos;
+      if (clean) {
+        for (size_t i = 0; i < spec.size() && clean; i++)
+          if (i != dash && !isdigit((unsigned char)spec[i])) clean = false;
+      }
+      if (clean) {
+        start = strtoull(spec.c_str(), nullptr, 10);
+        has_hi = dash + 1 < spec.size();
+        if (has_hi) hi = strtoull(spec.c_str() + dash + 1, nullptr, 10);
+      }
+    }
+    if (!clean || start >= n.data_len)
+      return redirect(fd, req, pl.redirect_port);
+    uint64_t stop = has_hi ? hi + 1 : n.data_len;
+    if (stop > n.data_len) stop = n.data_len;
+    // inverted ranges keep the raw start in Content-Range and serve an
+    // empty body, byte-for-byte like volume.py's data[start:stop]
+    uint64_t body_len = stop > start ? stop - start : 0;
+    extra += "Content-Range: bytes " + std::to_string(start) + "-" +
+             std::to_string(stop ? stop - 1 : 0) + "/" +
+             std::to_string(n.data_len) + "\r\n";
+    return respond(fd, req, 206, ctype, extra, n.data + start, body_len);
   }
   respond(fd, req, 200, ctype, extra, n.data, n.data_len);
 }
@@ -800,9 +839,9 @@ void handle_request(Plane& pl, int fd, const Request& req) {
   if (!parse_fid_path(req.path, &vid, &key, &cookie))
     return redirect(fd, req, pl.redirect_port);
   if (req.method == "GET" || req.method == "HEAD") {
-    // queries (resize, readDeleted), ranges and ims need python semantics
-    if (!req.query.empty() || !req.header("range").empty() ||
-        !req.header("if-modified-since").empty())
+    // queries (resize, readDeleted) and ims need python semantics;
+    // plain "bytes=lo-hi" ranges are served natively (filer chunk views)
+    if (!req.query.empty() || !req.header("if-modified-since").empty())
       return redirect(fd, req, pl.redirect_port);
     return handle_get(pl, fd, req, vid, key, cookie);
   }

@@ -1219,9 +1219,14 @@ def _make_http_handler(srv: VolumeServer):
                     q.get("mode", ""))
             rng = self.headers.get("Range")
             if rng and rng.startswith("bytes="):
-                lo, _, hi = rng[6:].partition("-")
-                start = int(lo or 0)
-                stop = int(hi) + 1 if hi else len(data)
+                try:
+                    lo, _, hi = rng[6:].partition("-")
+                    start = int(lo or 0)
+                    stop = int(hi) + 1 if hi else len(data)
+                except ValueError:
+                    # unparseable spec: ignore the header, serve the full
+                    # object (Go http.ServeContent's lenient behavior)
+                    return self._reply(200, data, ctype, headers)
                 stop = min(stop, len(data))
                 headers["Content-Range"] = f"bytes {start}-{stop - 1}/{len(data)}"
                 return self._reply(206, data[start:stop], ctype, headers)
