@@ -160,6 +160,22 @@ def decode_matrix_bits(
     return bits, used
 
 
+def decode_matrix_xor(
+    data_shards: int, parity_shards: int, present: tuple[int, ...]
+) -> tuple[np.ndarray, tuple[int, ...]]:
+    """Cached xor-coefficient decode matrix for a survivor set."""
+    dec, used = decode_matrix_cached(data_shards, parity_shards, present)
+    co = _derived("xor", ("dec", data_shards, parity_shards, present), dec)
+    return co, used
+
+
+def parity_matrix_op(data_shards: int, parity_shards: int,
+                     form: str) -> np.ndarray:
+    """Cached parity-matrix operand in "bits" or "xor" form."""
+    gp = gf256.parity_matrix(data_shards, parity_shards)
+    return _derived(form, ("parity", data_shards, parity_shards), gp)
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2))
 def _encode_jit(data: jax.Array, data_shards: int, parity_shards: int) -> jax.Array:
     gp = gf256.parity_matrix(data_shards, parity_shards)

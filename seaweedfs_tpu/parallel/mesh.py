@@ -26,8 +26,9 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops import gf256
-from ..ops.rs_jax import decode_matrix_bits, gf_matmul_bits, gf_matrix_to_bits
+from ..ops.rs_jax import decode_matrix_bits, decode_matrix_xor, \
+    gf_matmul_bits, parity_matrix_op
+from ..ops.rs_xor import gf_matmul_xor
 
 STRIPE_AXIS = "stripe"
 
@@ -45,33 +46,41 @@ def _col_pad(b: int, n: int, quantum: int = 8) -> int:
     return (b + step - 1) // step * step
 
 
-@functools.partial(jax.jit, static_argnums=(2, 3))
-def _apply_sharded(matrix_bits, data, mesh, axis):
-    fn = jax.shard_map(
-        lambda m, d: gf_matmul_bits(m, d),
-        mesh=mesh,
-        in_specs=(P(None, None), P(None, axis)),
-        out_specs=P(None, axis),
-    )
-    return fn(matrix_bits, data)
+def _per_device_fn(kernel: str):
+    return gf_matmul_xor if kernel == "xor" else gf_matmul_bits
+
+
+def _matrix_spec(matrix_op) -> P:
+    return P(*(None,) * matrix_op.ndim)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4))
-def _parity_probe(matrix_bits, shards, mesh, axis, data_shards):
+def _apply_sharded(matrix_op, data, mesh, axis, kernel="bits"):
+    fn = jax.shard_map(
+        lambda m, d: _per_device_fn(kernel)(m, d),
+        mesh=mesh,
+        in_specs=(_matrix_spec(matrix_op), P(None, axis)),
+        out_specs=P(None, axis),
+    )
+    return fn(matrix_op, data)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
+def _parity_probe(matrix_op, shards, mesh, axis, data_shards,
+                  kernel="bits"):
     """max over all bytes of (recomputed parity ^ stored parity); 0 iff clean.
     pmax over the mesh axis rides the ICI — cannot wrap, unlike a sum."""
-
     def local(m, x):
-        par = gf_matmul_bits(m, x[:data_shards])
+        par = _per_device_fn(kernel)(m, x[:data_shards])
         diff = jnp.max((par ^ x[data_shards:]).astype(jnp.int32))
         return jax.lax.pmax(diff, axis)
 
     return jax.shard_map(
         local,
         mesh=mesh,
-        in_specs=(P(None, None), P(None, axis)),
+        in_specs=(_matrix_spec(matrix_op), P(None, axis)),
         out_specs=P(),
-    )(matrix_bits, shards)
+    )(matrix_op, shards)
 
 
 class ShardedCoder:
@@ -80,19 +89,25 @@ class ShardedCoder:
     embarrassingly parallel across byte columns, SURVEY.md §5.7-5.8).
     """
 
-    def __init__(self, data_shards: int = 10, parity_shards: int = 4, mesh: Mesh | None = None):
+    def __init__(self, data_shards: int = 10, parity_shards: int = 4,
+                 mesh: Mesh | None = None, kernel: str = "xor"):
         if data_shards <= 0 or parity_shards < 0:
             raise ValueError("bad geometry")
         if data_shards + parity_shards > 256:
             raise ValueError("at most 256 total shards in GF(256)")
+        if kernel not in ("xor", "bits"):
+            raise ValueError(f"kernel must be 'xor' or 'bits', got {kernel!r}")
         self.data_shards = data_shards
         self.parity_shards = parity_shards
         self.total_shards = data_shards + parity_shards
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = self.mesh.axis_names[0]
         self._n = self.mesh.devices.size
-        self._parity_bits = jnp.asarray(
-            gf_matrix_to_bits(gf256.parity_matrix(data_shards, parity_shards))
+        # per-device formulation: "xor" (packed-word scheme, rs_xor — the
+        # faster one everywhere measured) or "bits" (bitsliced MXU matmul)
+        self.kernel = kernel
+        self._parity_op = jnp.asarray(
+            parity_matrix_op(data_shards, parity_shards, kernel)
         )
 
     # -- sharding helpers --------------------------------------------------
@@ -117,7 +132,8 @@ class ShardedCoder:
         """data [k, B] -> parity [m, B]; columns computed mesh-parallel."""
         assert data.shape[0] == self.data_shards, data.shape
         arr, b = self._shard(data)
-        out = _apply_sharded(self._parity_bits, arr, self.mesh, self.axis)
+        out = _apply_sharded(self._parity_op, arr, self.mesh, self.axis,
+                             self.kernel)
         return out[:, :b]
 
     def encode(self, shards) -> jax.Array:
@@ -146,16 +162,19 @@ class ShardedCoder:
         missing = [i for i in range(limit) if i not in present]
         if not missing:
             return {}
-        dec_bits_np, used = decode_matrix_bits(
-            self.data_shards, self.parity_shards, tuple(sorted(present.keys()))
-        )
+        decode_fn = decode_matrix_xor if self.kernel == "xor" \
+            else decode_matrix_bits
+        dec_np, used = decode_fn(self.data_shards, self.parity_shards,
+                                 tuple(sorted(present.keys())))
+        dec_op = jnp.asarray(dec_np)
         stacked = np.stack([np.asarray(present[i], np.uint8) for i in used])
         arr, b = self._shard(stacked)
-        data = _apply_sharded(jnp.asarray(dec_bits_np), arr, self.mesh, self.axis)
+        data = _apply_sharded(dec_op, arr, self.mesh, self.axis, self.kernel)
         out: dict[int, jax.Array] = {}
         if any(i >= self.data_shards for i in missing):
             # data is already padded + mesh-sharded: re-encode in place
-            parity = _apply_sharded(self._parity_bits, data, self.mesh, self.axis)
+            parity = _apply_sharded(self._parity_op, data, self.mesh,
+                                    self.axis, self.kernel)
         else:
             parity = None
         for i in missing:
@@ -178,7 +197,8 @@ class ShardedCoder:
         assert shards.shape[0] == self.total_shards, shards.shape
         arr, _ = self._shard(shards)
         return _parity_probe(
-            self._parity_bits, arr, self.mesh, self.axis, self.data_shards
+            self._parity_op, arr, self.mesh, self.axis, self.data_shards,
+            self.kernel
         )
 
     # kept as the historical name used by the dry-run driver
