@@ -131,6 +131,8 @@ _derived_lock = threading.Lock()
 
 
 def _derived(form: str, key: tuple, matrix: np.ndarray) -> np.ndarray:
+    if form not in ("bits", "xor"):
+        raise ValueError(f"derived form must be 'bits' or 'xor', got {form!r}")
     full = (form, *key)
     with _derived_lock:
         got = _derived_forms.get(full)
@@ -150,23 +152,22 @@ def _derived(form: str, key: tuple, matrix: np.ndarray) -> np.ndarray:
     return got
 
 
+def decode_matrix_op(
+    data_shards: int, parity_shards: int, present: tuple[int, ...],
+    form: str
+) -> tuple[np.ndarray, tuple[int, ...]]:
+    """Cached decode-matrix operand ("bits" or "xor" form) for a
+    survivor set."""
+    dec, used = decode_matrix_cached(data_shards, parity_shards, present)
+    op = _derived(form, ("dec", data_shards, parity_shards, present), dec)
+    return op, used
+
+
 def decode_matrix_bits(
     data_shards: int, parity_shards: int, present: tuple[int, ...]
 ) -> tuple[np.ndarray, tuple[int, ...]]:
-    """Cached bit-form decode matrix for a survivor set (mesh.py and other
-    bitsliced callers)."""
-    dec, used = decode_matrix_cached(data_shards, parity_shards, present)
-    bits = _derived("bits", ("dec", data_shards, parity_shards, present), dec)
-    return bits, used
-
-
-def decode_matrix_xor(
-    data_shards: int, parity_shards: int, present: tuple[int, ...]
-) -> tuple[np.ndarray, tuple[int, ...]]:
-    """Cached xor-coefficient decode matrix for a survivor set."""
-    dec, used = decode_matrix_cached(data_shards, parity_shards, present)
-    co = _derived("xor", ("dec", data_shards, parity_shards, present), dec)
-    return co, used
+    """Bit-form convenience wrapper over decode_matrix_op."""
+    return decode_matrix_op(data_shards, parity_shards, present, "bits")
 
 
 def parity_matrix_op(data_shards: int, parity_shards: int,

@@ -26,8 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.rs_jax import decode_matrix_bits, decode_matrix_xor, \
-    gf_matmul_bits, parity_matrix_op
+from ..ops.rs_jax import decode_matrix_op, gf_matmul_bits, parity_matrix_op
 from ..ops.rs_xor import gf_matmul_xor
 
 STRIPE_AXIS = "stripe"
@@ -55,7 +54,7 @@ def _matrix_spec(matrix_op) -> P:
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4))
-def _apply_sharded(matrix_op, data, mesh, axis, kernel="bits"):
+def _apply_sharded(matrix_op, data, mesh, axis, kernel):
     fn = jax.shard_map(
         lambda m, d: _per_device_fn(kernel)(m, d),
         mesh=mesh,
@@ -66,8 +65,7 @@ def _apply_sharded(matrix_op, data, mesh, axis, kernel="bits"):
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
-def _parity_probe(matrix_op, shards, mesh, axis, data_shards,
-                  kernel="bits"):
+def _parity_probe(matrix_op, shards, mesh, axis, data_shards, kernel):
     """max over all bytes of (recomputed parity ^ stored parity); 0 iff clean.
     pmax over the mesh axis rides the ICI — cannot wrap, unlike a sum."""
     def local(m, x):
@@ -162,10 +160,9 @@ class ShardedCoder:
         missing = [i for i in range(limit) if i not in present]
         if not missing:
             return {}
-        decode_fn = decode_matrix_xor if self.kernel == "xor" \
-            else decode_matrix_bits
-        dec_np, used = decode_fn(self.data_shards, self.parity_shards,
-                                 tuple(sorted(present.keys())))
+        dec_np, used = decode_matrix_op(
+            self.data_shards, self.parity_shards,
+            tuple(sorted(present.keys())), self.kernel)
         dec_op = jnp.asarray(dec_np)
         stacked = np.stack([np.asarray(present[i], np.uint8) for i in used])
         arr, b = self._shard(stacked)
