@@ -88,9 +88,10 @@ def bench(data_shards=10, parity_shards=4, col_bytes=None, iters=8,
 
     if os.environ.get("SEAWEEDFS_TPU_KERNEL", "auto") == "auto":
         if backend == "tpu":
-            cands = ("xor-pallas", "xor-xla", "mxu-pallas", "mxu-xla")
+            cands = ("xor-pallas", "sel-pallas", "xor-xla", "sel-xla",
+                     "mxu-pallas", "mxu-xla")
         else:
-            cands = ("xor-xla", "mxu-xla")
+            cands = ("xor-xla", "sel-xla", "mxu-xla")
         scores = calibrate(coder, np, jnp, cands)
         if scores:
             os.environ["SEAWEEDFS_TPU_KERNEL"] = max(scores, key=scores.get)

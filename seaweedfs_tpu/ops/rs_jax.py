@@ -189,15 +189,19 @@ def _apply_matrix_jit(matrix_bits: jax.Array, data: jax.Array) -> jax.Array:
     return gf_matmul_bits(matrix_bits, data)
 
 
-# Device kernel selection. Four formulations, all bit-identical:
-#   xor-pallas : packed-word XOR scheme, hand-tiled (rs_xor kernel) — the
-#                fastest on real TPU (no bit unpack, no MXU padding waste)
+# Device kernel selection. Six formulations, all bit-identical:
+#   xor-pallas : packed-word mask*coef XOR scheme, hand-tiled (rs_xor)
 #   xor-xla    : same math, XLA-fused (any backend, any size)
+#   sel-pallas : xtime-select scheme — GF doubling chains + static
+#                XOR-selection by matrix bits (no bit extraction, ~no
+#                multiplies), hand-tiled
+#   sel-xla    : same, XLA-fused
 #   mxu-pallas : bitsliced GF(2) matmul in one VMEM tile (rs_pallas)
 #   mxu-xla    : bitsliced matmul, XLA-materialized (the original path)
 # SEAWEEDFS_TPU_KERNEL overrides; SEAWEEDFS_TPU_NO_PALLAS=1 (legacy) forces
-# the XLA formulations.
-_KERNELS = ("xor-pallas", "xor-xla", "mxu-pallas", "mxu-xla")
+# the XLA formulations. bench.py calibrates and picks the winner.
+_KERNELS = ("xor-pallas", "xor-xla", "sel-pallas", "sel-xla",
+            "mxu-pallas", "mxu-xla")
 
 
 def _kernel_choice(b: int) -> str:
@@ -231,6 +235,21 @@ def _dispatch_matmul(matrix: np.ndarray, data: jax.Array, out_rows: int,
         key = ("raw", matrix.shape, matrix.tobytes())
     b = data.shape[1]
     kind = _kernel_choice(b)
+    if kind.startswith("sel-") and key[0] == "dec":
+        # sel kernels specialize on the static matrix; decode matrices
+        # (one per survivor set, up to C(n,k) of them) would recompile
+        # per failure pattern — route those to the runtime-operand xor
+        # form and keep sel for the one-per-geometry encode matrix
+        kind = kind.replace("sel-", "xor-")
+    if kind == "sel-pallas":
+        from .rs_xor import apply_matrix_sel_pallas
+
+        return apply_matrix_sel_pallas(matrix, data, token=key)
+    if kind == "sel-xla":
+        from .rs_xor import apply_matrix_sel
+
+        return apply_matrix_sel(matrix, _pad_bytes(data, b),
+                                token=key)[:, :b]
     if kind == "xor-pallas":
         from .rs_xor import apply_matrix_xor_pallas
 

@@ -53,7 +53,8 @@ def main() -> int:
     backend = jax.default_backend()
     print(f"backend: {backend}")
     sizes = [4 * 2**20] if args.quick else [2**20, 8 * 2**20, 32 * 2**20]
-    kinds = ["xor-pallas", "xor-xla", "mxu-pallas", "mxu-xla"]
+    kinds = ["xor-pallas", "sel-pallas", "xor-xla", "sel-xla",
+             "mxu-pallas", "mxu-xla"]
     if backend != "tpu":
         kinds = [k for k in kinds if not k.endswith("-pallas")]
 
