@@ -28,7 +28,9 @@ the bitsliced path).
 
 from __future__ import annotations
 
+import collections
 import functools
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -157,10 +159,7 @@ def apply_matrix_xor_pallas(matrix: np.ndarray, data: jax.Array,
             xor_coefficients(matrix).reshape(matrix.shape[0], -1)
         )
     b = data.shape[1]
-    padded = (b + TILE_BYTES - 1) // TILE_BYTES * TILE_BYTES
-    if padded != b:
-        data = jnp.pad(data, ((0, 0), (0, padded - b)))
-    words = _to_words(data)
+    words = _to_words(_pad_to_tile(data))
     out = gf_matmul_xor_pallas(coeffs, words, matrix.shape[0],
                                interpret=interpret)
     return _to_bytes(out)[:, :b]
@@ -170,10 +169,7 @@ def apply_matrix_xor(matrix: np.ndarray, data: jax.Array) -> jax.Array:
     """XLA-fused variant of apply_matrix_xor_pallas (any backend)."""
     coeffs = jnp.asarray(xor_coefficients(matrix))
     b = data.shape[1]
-    pad = (-b) % 4
-    if pad:
-        data = jnp.pad(data, ((0, 0), (0, pad)))
-    return _matmul_xor_jit(coeffs, data)[:, :b]
+    return _matmul_xor_jit(coeffs, _pad_to_word(data))[:, :b]
 
 
 # ---------------------------------------------------------------------------
@@ -252,15 +248,35 @@ def _sel_kernel_factory(matrix: np.ndarray):
 # cache the jitted callables by a compact caller-provided token —
 # re-serializing matrix bytes per call would defeat the point. The
 # dispatcher only routes ENCODE matrices here (one per geometry);
-# decode matrices use the runtime-operand xor kernels.
-_sel_runners: dict = {}
+# decode matrices use the runtime-operand xor kernels. Lock + LRU cap
+# mirror rs_jax._derived (direct public callers may pass many matrices).
+_SEL_MAX = 256
+_sel_runners: "collections.OrderedDict" = collections.OrderedDict()
+_sel_lock = threading.Lock()
+
+
+def _matrix_token(matrix: np.ndarray) -> tuple:
+    return (matrix.shape, np.asarray(matrix, np.uint8).tobytes())
+
+
+def _pad_to_tile(data: jax.Array) -> jax.Array:
+    b = data.shape[1]
+    padded = (b + TILE_BYTES - 1) // TILE_BYTES * TILE_BYTES
+    return data if padded == b else jnp.pad(data, ((0, 0), (0, padded - b)))
+
+
+def _pad_to_word(data: jax.Array) -> jax.Array:
+    pad = (-data.shape[1]) % 4
+    return data if not pad else jnp.pad(data, ((0, 0), (0, pad)))
 
 
 def _sel_runner(matrix: np.ndarray, token, pallas: bool, interpret: bool):
     key = (token, pallas, interpret)
-    run = _sel_runners.get(key)
-    if run is not None:
-        return run
+    with _sel_lock:
+        run = _sel_runners.get(key)
+        if run is not None:
+            _sel_runners.move_to_end(key)
+            return run
     matrix = np.asarray(matrix, np.uint8)
     if pallas:
         from jax.experimental import pallas as pl
@@ -284,7 +300,10 @@ def _sel_runner(matrix: np.ndarray, token, pallas: bool, interpret: bool):
             )(data3)
     else:
         run = jax.jit(lambda words: gf_matmul_sel(matrix, words))
-    _sel_runners[key] = run
+    with _sel_lock:
+        while len(_sel_runners) >= _SEL_MAX:
+            _sel_runners.popitem(last=False)
+        _sel_runners[key] = run
     return run
 
 
@@ -295,12 +314,9 @@ def apply_matrix_sel_pallas(matrix: np.ndarray, data: jax.Array,
     xtime-select kernel. `token` is the compact cache identity of the
     matrix (defaults to hashing its contents)."""
     if token is None:
-        token = (matrix.shape, np.asarray(matrix, np.uint8).tobytes())
+        token = _matrix_token(matrix)
     b = data.shape[1]
-    padded = (b + TILE_BYTES - 1) // TILE_BYTES * TILE_BYTES
-    if padded != b:
-        data = jnp.pad(data, ((0, 0), (0, padded - b)))
-    words = _to_words(data)
+    words = _to_words(_pad_to_tile(data))
     k, w = words.shape
     run = _sel_runner(matrix, token, pallas=True, interpret=interpret)
     out = run(words.reshape(k, w // LANE, LANE))
@@ -311,11 +327,8 @@ def apply_matrix_sel(matrix: np.ndarray, data: jax.Array,
                      token=None) -> jax.Array:
     """XLA-fused xtime-select variant (any backend)."""
     if token is None:
-        token = (matrix.shape, np.asarray(matrix, np.uint8).tobytes())
+        token = _matrix_token(matrix)
     b = data.shape[1]
-    pad = (-b) % 4
-    if pad:
-        data = jnp.pad(data, ((0, 0), (0, pad)))
-    words = _to_words(data)
+    words = _to_words(_pad_to_word(data))
     run = _sel_runner(matrix, token, pallas=False, interpret=False)
     return _to_bytes(run(words))[:, :b]

@@ -2,11 +2,11 @@
 
 Usage:  python tools/tune_kernels.py [--quick]
 
-For each formulation (xor-pallas / xor-xla / mxu-pallas / mxu-xla) this
-measures encode throughput with forced host readbacks at several batch
-sizes, plus tile-shape variants for the XOR Pallas kernel (LANE x SUBL).
-Prints a table and the suggested default. Run it whenever kernels change;
-bench.py's auto-calibration picks the winner at bench time regardless.
+For each formulation (xor-pallas / sel-pallas / xor-xla / sel-xla /
+mxu-pallas / mxu-xla) this measures encode throughput with forced host
+readbacks at several batch sizes. Prints a table and the suggested
+default. Run it whenever kernels change; bench.py's auto-calibration
+picks the winner at bench time regardless.
 """
 
 from __future__ import annotations
