@@ -62,8 +62,6 @@ class S3Server:
         self.circuit_breaker = CircuitBreaker()
         self._cb_loaded_at = 0.0
         self._http_server = None
-        import requests as rq
-
 
     def start(self) -> None:
         self._http_server = TunedThreadingHTTPServer(
@@ -670,6 +668,11 @@ def _make_handler(srv: S3Server):
             if "uploadId" in q:
                 upload_id = q["uploadId"][0]
                 if verb == "PUT" and "partNumber" in q:
+                    src = self.headers.get("x-amz-copy-source")
+                    if src:
+                        return self._upload_part_copy(
+                            bucket, key, upload_id,
+                            int(q["partNumber"][0]), src)
                     return self._upload_part(bucket, key, upload_id,
                                              int(q["partNumber"][0]))
                 if verb == "POST":
@@ -776,9 +779,15 @@ def _make_handler(srv: S3Server):
                 return self._send(204)
             raise S3Error(405, "MethodNotAllowed", "unsupported object op")
 
-        def _copy_object(self, bucket: str, key: str, src: str):
+        def _parse_copy_source(self, src: str) -> tuple[str, str]:
             src = urllib.parse.unquote(src.lstrip("/"))
             sbucket, _, skey = src.partition("/")
+            if not sbucket or not skey:
+                raise S3Error(400, "InvalidArgument", "bad copy source")
+            return sbucket, skey
+
+        def _copy_object(self, bucket: str, key: str, src: str):
+            sbucket, skey = self._parse_copy_source(src)
             r = srv.get_object(sbucket, skey)
             etag = srv.put_object(bucket, key, r.content,
                                   r.headers.get("Content-Type", ""))
@@ -856,15 +865,67 @@ def _make_handler(srv: S3Server):
             self._send(200, headers={
                 "ETag": f'"{hashlib.md5(body).hexdigest()}"'})
 
+        def _upload_part_copy(self, bucket: str, key: str, upload_id: str,
+                              part_number: int, src: str):
+            """UploadPartCopy: a part sourced from an existing object,
+            optionally a byte range — streamed, never fully buffered
+            (CopyObjectPartHandler, s3api_object_copy_handlers.go:135-183;
+            bad ranges map to 400 InvalidArgument like the reference)."""
+            if srv.find_entry(UPLOADS_DIR, upload_id) is None:
+                raise S3Error(404, "NoSuchUpload", "upload not found")
+            sbucket, skey = self._parse_copy_source(src)
+            sdir, _, sname = f"{BUCKETS_DIR}/{sbucket}/{skey}".rpartition("/")
+            sentry = srv.find_entry(sdir, sname)
+            if sentry is None:
+                raise S3Error(404, "NoSuchKey", "copy source not found")
+            src_size = sentry.attributes.file_size
+            range_header = ""
+            rng = self.headers.get("x-amz-copy-source-range", "")
+            if rng:
+                bad = S3Error(
+                    400, "InvalidArgument",
+                    "Range specified is not valid for source object "
+                    f"of size: {src_size}")
+                if not rng.startswith("bytes="):
+                    raise bad
+                try:
+                    lo, _, hi = rng[6:].partition("-")
+                    start = int(lo)
+                    stop = int(hi) + 1 if hi else src_size
+                except ValueError:
+                    raise bad
+                if start >= src_size or stop > src_size or start >= stop:
+                    raise bad
+                range_header = f"bytes={start}-{stop - 1}"
+            r = srv.get_object(sbucket, skey, range_header=range_header,
+                               stream=True)
+            url = (f"http://{srv.filer}{UPLOADS_DIR}/{upload_id}/"
+                   f"{part_number:04d}.part")
+            md5 = hashlib.md5()
+
+            def _tee():
+                for piece in r.iter_content(1 << 20):
+                    md5.update(piece)
+                    yield piece
+
+            pr = _session().put(url, data=_tee(), timeout=600)
+            if pr.status_code >= 300:
+                raise S3Error(500, "InternalError", "part copy failed")
+            root = ET.Element("CopyPartResult", xmlns=S3_NS)
+            _el(root, "ETag", f'"{md5.hexdigest()}"')
+            _el(root, "LastModified", _iso(int(time.time())))
+            self._send(200, _xml_bytes(root))
+
         def _complete_multipart(self, bucket: str, key: str, upload_id: str):
             updir = f"{UPLOADS_DIR}/{upload_id}"
             meta_entry = srv.find_entry(UPLOADS_DIR, upload_id)
             if meta_entry is None:
                 raise S3Error(404, "NoSuchUpload", "upload not found")
             meta = json.loads(meta_entry.extended.get("upload-meta", b"{}"))
+            # numeric sort: '10000.part' must follow '9999.part'
             parts = sorted(
                 (e for e in srv.list_dir(updir) if e.name.endswith(".part")),
-                key=lambda e: e.name)
+                key=lambda e: int(e.name.split(".")[0]))
             chunks, offset = [], 0
             for p in parts:
                 for c in p.chunks:
@@ -906,9 +967,10 @@ def _make_handler(srv: S3Server):
             _el(root, "Bucket", bucket)
             _el(root, "Key", key)
             _el(root, "UploadId", upload_id)
-            for e in srv.list_dir(updir):
-                if not e.name.endswith(".part"):
-                    continue
+            for e in sorted(
+                    (e for e in srv.list_dir(updir)
+                     if e.name.endswith(".part")),
+                    key=lambda e: int(e.name.split(".")[0])):
                 p = _el(root, "Part")
                 _el(p, "PartNumber", int(e.name.split(".")[0]))
                 _el(p, "Size", e.attributes.file_size)
