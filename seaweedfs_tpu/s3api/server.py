@@ -780,6 +780,9 @@ def _make_handler(srv: S3Server):
             raise S3Error(405, "MethodNotAllowed", "unsupported object op")
 
         def _parse_copy_source(self, src: str) -> tuple[str, str]:
+            # "?versionId=..." may qualify the source (we keep a single
+            # version; the suffix must not leak into the key)
+            src = src.partition("?")[0]
             src = urllib.parse.unquote(src.lstrip("/"))
             sbucket, _, skey = src.partition("/")
             if not sbucket or not skey:
@@ -922,9 +925,11 @@ def _make_handler(srv: S3Server):
             if meta_entry is None:
                 raise S3Error(404, "NoSuchUpload", "upload not found")
             meta = json.loads(meta_entry.extended.get("upload-meta", b"{}"))
-            # numeric sort: '10000.part' must follow '9999.part'
+            # numeric sort: '10000.part' must follow '9999.part'; S3
+            # allows 10000 parts, above list_dir's default 1000 cap
             parts = sorted(
-                (e for e in srv.list_dir(updir) if e.name.endswith(".part")),
+                (e for e in srv.list_dir(updir, limit=10001)
+                 if e.name.endswith(".part")),
                 key=lambda e: int(e.name.split(".")[0]))
             chunks, offset = [], 0
             for p in parts:
@@ -968,7 +973,7 @@ def _make_handler(srv: S3Server):
             _el(root, "Key", key)
             _el(root, "UploadId", upload_id)
             for e in sorted(
-                    (e for e in srv.list_dir(updir)
+                    (e for e in srv.list_dir(updir, limit=10001)
                      if e.name.endswith(".part")),
                     key=lambda e: int(e.name.split(".")[0])):
                 p = _el(root, "Part")

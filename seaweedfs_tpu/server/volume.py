@@ -45,6 +45,7 @@ from ..utils import glog
 from ..utils.http import not_modified
 from ..utils.stats import (
     VOLUME_SERVER_EC_ENCODE_BYTES,
+    VOLUME_SERVER_NATIVE_REQUESTS,
     VOLUME_SERVER_REQUEST_HISTOGRAM,
     VOLUME_SERVER_VOLUME_COUNTER,
     gather,
@@ -273,6 +274,9 @@ class VolumeServer:
             VOLUME_SERVER_VOLUME_COUNTER.set(
                 sum(len(l.volumes) for l in self.store.locations)
             )
+            plane = self.native_plane  # stop() may null it concurrently
+            if plane is not None:
+                VOLUME_SERVER_NATIVE_REQUESTS.set(plane.request_count())
             if self._stop.is_set():
                 return
 
@@ -1160,7 +1164,13 @@ def _make_http_handler(srv: VolumeServer):
                                      "collection": v.collection,
                                      "fileCount": v.file_count(),
                                      "readOnly": v.read_only}
-                return self._json({"Version": "seaweedfs-tpu", "Volumes": vols})
+                plane = srv.native_plane
+                return self._json({
+                    "Version": "seaweedfs-tpu", "Volumes": vols,
+                    "NativeDataPlane": plane is not None,
+                    "NativeRequests":
+                        plane.request_count() if plane else 0,
+                })
             if u.path == "/metrics":
                 return self._reply(200, gather().encode(),
                                    "text/plain; version=0.0.4")

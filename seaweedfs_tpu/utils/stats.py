@@ -146,6 +146,9 @@ VOLUME_SERVER_REQUEST_HISTOGRAM = Histogram(
     "SeaweedFS_volumeServer_request_seconds", "Request latency by type.")
 VOLUME_SERVER_VOLUME_COUNTER = Gauge(
     "SeaweedFS_volumeServer_volumes", "Volumes managed by this server.")
+VOLUME_SERVER_NATIVE_REQUESTS = Gauge(
+    "SeaweedFS_volumeServer_native_requests",
+    "Requests served by the C++ data plane since start.")
 VOLUME_SERVER_EC_ENCODE_BYTES = Counter(
     "SeaweedFS_volumeServer_ec_encode_bytes", "Bytes erasure-encoded.")
 VOLUME_SERVER_EC_DEVICE_SECONDS = Counter(

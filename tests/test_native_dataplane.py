@@ -421,3 +421,12 @@ def test_range_edge_cases_delegate_to_python(native_cluster):
         assert native.content == python.content, rng
         assert native.headers.get("Content-Range") == \
             python.headers.get("Content-Range"), rng
+
+
+def test_status_and_metrics_expose_native_plane(native_cluster):
+    master, vsrv = native_cluster
+    a = _assign(master)
+    requests.put(f"http://{a.url}/{a.fid}", data=b"observed")
+    st = requests.get(f"http://{vsrv.address}/status").json()
+    assert st["NativeDataPlane"] is True
+    assert st["NativeRequests"] >= 1
