@@ -58,6 +58,34 @@ def _assign(master):
     return a
 
 
+def _thread_session():
+    """Per-thread keepalive session for storm tests."""
+    import threading
+
+    tl = _thread_session.__dict__.setdefault("tl", threading.local())
+    s = getattr(tl, "s", None)
+    if s is None:
+        s = tl.s = requests.Session()
+    return s
+
+
+def _assign_n_on_same_volume(master, n, attempts=3000):
+    """Assign until `n` fids land on one volume; -> (vid, fids)."""
+    from seaweedfs_tpu.storage.file_id import parse_file_id
+
+    first = _assign(master)
+    vid = parse_file_id(first.fid).volume_id
+    fids = []
+    for _ in range(attempts):
+        if len(fids) >= n:
+            break
+        a = _assign(master)
+        if parse_file_id(a.fid).volume_id == vid:
+            fids.append(a)
+    assert len(fids) >= n, f"assigns stopped routing to volume {vid}"
+    return vid, fids
+
+
 def test_write_read_delete_via_native_port(native_cluster):
     master, vsrv = native_cluster
     assert vsrv.native_plane is not None
@@ -283,30 +311,13 @@ def test_concurrent_storm(native_cluster):
     """Parallel writers/overwriters/readers/deleters against one volume:
     every acknowledged write must be readable-or-deleted consistently,
     and the C++ map must agree with the on-disk idx at the end."""
-    import threading
     from concurrent.futures import ThreadPoolExecutor
 
     from seaweedfs_tpu.storage.file_id import parse_file_id
 
     master, vsrv = native_cluster
-    first = _assign(master)
-    vid = parse_file_id(first.fid).volume_id
-    fids = []
-    for _ in range(2000):
-        if len(fids) >= 60:
-            break
-        a = _assign(master)
-        if parse_file_id(a.fid).volume_id == vid:
-            fids.append(a)
-    assert len(fids) >= 60, f"assigns stopped routing to volume {vid}"
-
-    tl = threading.local()
-
-    def sess():
-        s = getattr(tl, "s", None)
-        if s is None:
-            s = tl.s = requests.Session()
-        return s
+    vid, fids = _assign_n_on_same_volume(master, 60)
+    sess = _thread_session
 
     errors = []
 
@@ -435,31 +446,15 @@ def test_status_and_metrics_expose_native_plane(native_cluster):
 def test_compaction_under_concurrent_native_writes(native_cluster):
     """Writers hammer the C++ plane while python compacts the volume
     repeatedly: no acknowledged write may be lost (the freeze/idx-tail
-    replay handshake in commit_compact)."""
+    replay handshake in commit_compact), and no write may be REJECTED
+    (the freeze blocks via the python volume lock, it never errors).
+    Transient transport drops of unacknowledged requests are tolerated —
+    they assert nothing about the invariant."""
     import threading
-    from concurrent.futures import ThreadPoolExecutor
-
-    from seaweedfs_tpu.storage.file_id import parse_file_id
 
     master, vsrv = native_cluster
-    first = _assign(master)
-    vid = parse_file_id(first.fid).volume_id
-    fids = []
-    for _ in range(3000):
-        if len(fids) >= 24:
-            break
-        a = _assign(master)
-        if parse_file_id(a.fid).volume_id == vid:
-            fids.append(a)
-    assert len(fids) >= 24
-
-    tl = threading.local()
-
-    def sess():
-        s = getattr(tl, "s", None)
-        if s is None:
-            s = tl.s = requests.Session()
-        return s
+    vid, fids = _assign_n_on_same_volume(master, 8)
+    sess = _thread_session
 
     stop = threading.Event()
     acked: dict[str, bytes] = {}
@@ -478,8 +473,8 @@ def test_compaction_under_concurrent_native_writes(native_cluster):
                     acked[a.fid] = body
                 else:
                     errors.append((a.fid, r.status_code))
-            except requests.RequestException as e:
-                errors.append((a.fid, repr(e)))
+            except requests.RequestException:
+                pass  # unacked: says nothing about lost acks
 
     v = vsrv.store.find_volume(vid)
     threads = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
