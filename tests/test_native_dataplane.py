@@ -458,6 +458,7 @@ def test_compaction_under_concurrent_native_writes(native_cluster):
 
     stop = threading.Event()
     acked: dict[str, bytes] = {}
+    indeterminate: set = set()  # dropped mid-flight: server MAY have applied
     errors = []
 
     def writer(idx):
@@ -471,10 +472,13 @@ def test_compaction_under_concurrent_native_writes(native_cluster):
                                timeout=30)
                 if r.status_code == 201:
                     acked[a.fid] = body
+                    indeterminate.discard(a.fid)
                 else:
                     errors.append((a.fid, r.status_code))
             except requests.RequestException:
-                pass  # unacked: says nothing about lost acks
+                # unacked but possibly applied server-side: the fid's
+                # exact-body check would race its own lost response
+                indeterminate.add(a.fid)
 
     v = vsrv.store.find_volume(vid)
     threads = [threading.Thread(target=writer, args=(i,)) for i in range(8)]
@@ -490,7 +494,14 @@ def test_compaction_under_concurrent_native_writes(native_cluster):
         for t in threads:
             t.join()
     assert not errors, errors[:3]
-    # every last-acknowledged body must read back exactly
+    # every last-acknowledged body must read back exactly (unless a later
+    # write to the same fid was dropped mid-flight — then content is
+    # legitimately indeterminate between the two)
+    checked = 0
     for fid, body in acked.items():
+        if fid in indeterminate:
+            continue
         g = requests.get(f"http://{fids[0].url}/{fid}", timeout=30)
         assert g.status_code == 200 and g.content == body, fid
+        checked += 1
+    assert checked > 0  # the storm must have proven something

@@ -1,0 +1,160 @@
+"""Live-cluster coverage for shell commands that had none: volume
+copy/move/mark, evacuate, collection.delete, ec.balance, raft.leader,
+bucket quotas (command_volume_copy.go, command_volume_move.go,
+command_volume_server_evacuate.go, command_collection_delete.go,
+command_ec_balance.go, command_s3_bucket_quota.go parity)."""
+
+import io
+import socket
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.operation import assign, submit
+from seaweedfs_tpu.pb import rpc
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+from seaweedfs_tpu.shell.env import CommandEnv
+from seaweedfs_tpu.shell.registry import run_command
+from seaweedfs_tpu.storage.file_id import parse_file_id
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport, volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vols = []
+    for i in range(2):
+        v = VolumeServer(
+            directories=[str(tmp_path_factory.mktemp(f"sv{i}"))],
+            master=f"localhost:{mport}", ip="localhost", port=_free_port(),
+            pulse_seconds=1)
+        v.start()
+        vols.append(v)
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topo.nodes) < 2:
+        time.sleep(0.05)
+    assert len(master.topo.nodes) == 2
+    env = CommandEnv(master.address)
+    out = io.StringIO()
+    assert run_command(env, "lock", out) == 0
+    yield master, vols, env
+    for v in vols:
+        v.stop()
+    master.stop()
+    rpc.reset_channels()
+
+
+def _run(env, cmd: str) -> str:
+    out = io.StringIO()
+    code = run_command(env, cmd, out)
+    assert code == 0, f"{cmd!r} failed: {out.getvalue()}"
+    return out.getvalue()
+
+
+def _server_of(master, vols, vid):
+    for v in vols:
+        if v.store.find_volume(vid) is not None:
+            return v
+    raise AssertionError(f"volume {vid} on neither server")
+
+
+def test_raft_leader(cluster):
+    master, _, env = cluster
+    assert master.address in _run(env, "cluster.raft.leader")
+
+
+def test_volume_mark_copy_move(cluster):
+    master, vols, env = cluster
+    r = submit(master.address, b"ops-payload" * 50, filename="ops.bin")
+    fid = r["fid"]
+    vid = parse_file_id(fid).volume_id
+    src = _server_of(master, vols, vid)
+    dst = vols[0] if src is vols[1] else vols[1]
+
+    # mark readonly, then writable again
+    _run(env, f"volume.mark -node {src.address} -volumeId {vid} -readonly")
+    assert src.store.find_volume(vid).read_only
+    _run(env, f"volume.mark -node {src.address} -volumeId {vid} -writable")
+    assert not src.store.find_volume(vid).read_only
+
+    # move to the peer: source sheds the volume, needle survives on dst
+    _run(env, f"volume.move -from {src.address} -to {dst.address} "
+              f"-volumeId {vid}")
+    assert src.store.find_volume(vid) is None
+    got = requests.get(f"http://{dst.address}/{fid}", timeout=10)
+    assert got.status_code == 200 and got.content == b"ops-payload" * 50
+
+    # copy back: both servers now hold it and serve the needle
+    _run(env, f"volume.copy -from {dst.address} -to {src.address} "
+              f"-volumeId {vid}")
+    assert src.store.find_volume(vid) is not None
+    assert requests.get(f"http://{src.address}/{fid}",
+                        timeout=10).status_code == 200
+
+
+def test_volume_server_evacuate(cluster, tmp_path):
+    master, vols, env = cluster
+    # a third server holding one exclusive volume, then drain it
+    extra = VolumeServer(directories=[str(tmp_path / "evac")],
+                         master=master.address, ip="localhost",
+                         port=_free_port(), pulse_seconds=1)
+    extra.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and len(master.topo.nodes) < 3:
+            time.sleep(0.05)
+        r = submit(master.address, b"evac" * 100, filename="e.bin")
+        vid = parse_file_id(r["fid"]).volume_id
+        src = _server_of(master, vols + [extra], vid)
+        if src is not extra:  # land the volume on the extra server
+            _run(env, f"volume.move -from {src.address} "
+                      f"-to {extra.address} -volumeId {vid}")
+        time.sleep(1.2)  # heartbeats settle the replica index
+        plan = _run(env, f"volumeServer.evacuate -node {extra.address}")
+        assert f"volume {vid}" in plan, plan
+        _run(env, f"volumeServer.evacuate -node {extra.address} -apply")
+        time.sleep(1.2)
+        assert all(not loc.volumes for loc in extra.store.locations)
+        # the needle survived the drain
+        urls = requests.get(
+            f"http://{master.address}/dir/lookup?volumeId={vid}",
+            timeout=10).json()
+        assert any(requests.get(f"http://{loc['url']}/{r['fid']}",
+                                timeout=10).status_code == 200
+                   for loc in urls.get("locations", []))
+        # unregister from the master BEFORE stopping, so later tests'
+        # volume growth cannot place volumes on the dead node
+        _run(env, f"volumeServer.leave -node {extra.address}")
+        deadline = time.time() + 10
+        while time.time() < deadline and len(master.topo.nodes) > 2:
+            time.sleep(0.05)
+    finally:
+        extra.stop()
+
+
+def test_ec_balance_dry_run(cluster):
+    master, _, env = cluster
+    # no EC volumes: command still succeeds as a no-op plan
+    _run(env, "ec.balance")
+
+
+def test_collection_delete(cluster):
+    master, vols, env = cluster
+    r = submit(master.address, b"col-data", filename="c.bin",
+               collection="scratch")
+    vid = parse_file_id(r["fid"]).volume_id
+    out = _run(env, "collection.delete -collection scratch")
+    assert "force" in out  # dry-run warns
+    _run(env, "collection.delete -collection scratch -force")
+    time.sleep(1.2)
+    for v in vols:
+        assert v.store.find_volume(vid) is None

@@ -1,0 +1,68 @@
+"""Probe the TPU tunnel until it answers, then run the quick kernel tune.
+
+Each probe runs in a subprocess with a hard timeout (the wedged tunnel
+HANGS rather than erring). On the first healthy probe this runs
+tools/tune_kernels.py --quick and appends everything to TUNE_RESULT.txt.
+
+Usage: python tools/await_tpu.py [--minutes 9]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OUT = os.path.join(REPO, "TUNE_RESULT.txt")
+
+PROBE = ("import jax, jax.numpy as jnp; "
+         "print('backend:', jax.default_backend()); "
+         "print('sum:', float(jnp.ones((8, 8)).sum()))")
+
+
+def probe(timeout: float = 75) -> bool:
+    try:
+        r = subprocess.run([sys.executable, "-c", PROBE],
+                           capture_output=True, text=True, timeout=timeout)
+        return r.returncode == 0 and "backend: tpu" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--minutes", type=float, default=9.0)
+    args = ap.parse_args()
+    deadline = time.time() + args.minutes * 60
+    while time.time() < deadline:
+        if probe():
+            stamp = time.strftime("%H:%M:%S")
+            print(f"[{stamp}] tunnel healthy — tuning", flush=True)
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.join(REPO, "tools",
+                                                  "tune_kernels.py"),
+                     "--quick"],
+                    capture_output=True, text=True, timeout=1200)
+                stdout, stderr, rc = r.stdout, r.stderr, r.returncode
+            except subprocess.TimeoutExpired as e:  # tunnel re-wedged
+                stdout = (e.stdout or b"").decode(errors="replace") \
+                    if isinstance(e.stdout, bytes) else (e.stdout or "")
+                stderr = "tune timed out (tunnel wedged again?)"
+                rc = 124
+            with open(OUT, "a") as f:
+                f.write(f"\n=== tune at {stamp} (rc={rc}) ===\n")
+                f.write(stdout)
+                f.write(stderr[-2000:])
+            print(stdout, flush=True)
+            return rc
+        time.sleep(45)
+    print("tunnel still wedged", flush=True)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
