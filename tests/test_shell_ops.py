@@ -60,11 +60,11 @@ def _run(env, cmd: str) -> str:
     return out.getvalue()
 
 
-def _server_of(master, vols, vid):
+def _server_of(vols, vid):
     for v in vols:
         if v.store.find_volume(vid) is not None:
             return v
-    raise AssertionError(f"volume {vid} on neither server")
+    raise AssertionError(f"volume {vid} on no server")
 
 
 def test_raft_leader(cluster):
@@ -77,7 +77,7 @@ def test_volume_mark_copy_move(cluster):
     r = submit(master.address, b"ops-payload" * 50, filename="ops.bin")
     fid = r["fid"]
     vid = parse_file_id(fid).volume_id
-    src = _server_of(master, vols, vid)
+    src = _server_of(vols, vid)
     dst = vols[0] if src is vols[1] else vols[1]
 
     # mark readonly, then writable again
@@ -99,6 +99,13 @@ def test_volume_mark_copy_move(cluster):
     assert src.store.find_volume(vid) is not None
     assert requests.get(f"http://{src.address}/{fid}",
                         timeout=10).status_code == 200
+    # drop the duplicate: a single-copy volume held twice would leave
+    # diverging replicas for later tests (writes land on one holder)
+    from seaweedfs_tpu.pb import volume_server_pb2 as vs
+
+    rpc.volume_stub(rpc.grpc_address(src.address)).VolumeDelete(
+        vs.VolumeDeleteRequest(volume_id=vid), timeout=30)
+    time.sleep(1.2)  # heartbeat refreshes the master's replica index
 
 
 def test_volume_server_evacuate(cluster, tmp_path):
@@ -114,7 +121,7 @@ def test_volume_server_evacuate(cluster, tmp_path):
             time.sleep(0.05)
         r = submit(master.address, b"evac" * 100, filename="e.bin")
         vid = parse_file_id(r["fid"]).volume_id
-        src = _server_of(master, vols + [extra], vid)
+        src = _server_of(vols + [extra], vid)
         if src is not extra:  # land the volume on the extra server
             _run(env, f"volume.move -from {src.address} "
                       f"-to {extra.address} -volumeId {vid}")
@@ -158,3 +165,89 @@ def test_collection_delete(cluster):
     time.sleep(1.2)
     for v in vols:
         assert v.store.find_volume(vid) is None
+
+
+def test_volume_tier_upload_download(cluster, tmp_path_factory):
+    """volume.tier.upload moves a sealed .dat to a tier backend and reads
+    keep working; volume.tier.download brings it back
+    (command_volume_tier_upload/download parity)."""
+    master, vols, env = cluster
+    tier_root = str(tmp_path_factory.mktemp("tier"))
+    extra = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("tiervol"))],
+        master=master.address, ip="localhost", port=_free_port(),
+        pulse_seconds=1,
+        tier_backends={"local": {"default": {"root": tier_root}}})
+    extra.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and len(master.topo.nodes) < 3:
+            time.sleep(0.05)
+        r = submit(master.address, b"tiered!" * 64, filename="t.bin")
+        vid = parse_file_id(r["fid"]).volume_id
+        src = _server_of(vols + [extra], vid)
+        if src is not extra:
+            _run(env, f"volume.move -from {src.address} "
+                      f"-to {extra.address} -volumeId {vid}")
+        _run(env, f"volume.mark -node {extra.address} -volumeId {vid} "
+                  f"-readonly")
+        _run(env, f"volume.tier.upload -node {extra.address} "
+                  f"-volumeId {vid} -dest local")
+        v = extra.store.find_volume(vid)
+        assert v.is_tiered
+        got = requests.get(f"http://{extra.address}/{r['fid']}", timeout=10)
+        assert got.status_code == 200 and got.content == b"tiered!" * 64
+        _run(env, f"volume.tier.download -node {extra.address} "
+                  f"-volumeId {vid}")
+        assert not extra.store.find_volume(vid).is_tiered
+        assert requests.get(f"http://{extra.address}/{r['fid']}",
+                            timeout=10).content == b"tiered!" * 64
+        _run(env, f"volumeServer.leave -node {extra.address}")
+        deadline = time.time() + 10
+        while time.time() < deadline and len(master.topo.nodes) > 2:
+            time.sleep(0.05)
+    finally:
+        extra.stop()
+
+
+def test_remote_shell_commands(cluster, tmp_path_factory):
+    """remote.configure/mount/meta.sync/cache/uncache/unmount through the
+    shell against a live filer and a 'local'-kind remote store."""
+    import os
+
+    from seaweedfs_tpu.server.filer import FilerServer
+
+    master, vols, env = cluster
+    remote_root = str(tmp_path_factory.mktemp("remote"))
+    os.makedirs(f"{remote_root}/data", exist_ok=True)
+    with open(f"{remote_root}/data/hello.txt", "w") as f:
+        f.write("remote hello")
+    fs = FilerServer(ip="localhost", port=_free_port(),
+                     master=master.address,
+                     store_dir=str(tmp_path_factory.mktemp("rfiler")))
+    fs.start()
+    env.filer = f"localhost:{fs.port}"
+    try:
+        _run(env, f"remote.configure -name=loc -type=local "
+                  f"-root={remote_root}")
+        assert "loc" in _run(env, "remote.configure")
+        requests.put(f"http://localhost:{fs.port}/buckets/rm/.keep",
+                     data=b"", timeout=10)
+        out = _run(env, "remote.mount -dir=/buckets/rm -remote=loc/data")
+        assert "mounted" in out
+        assert "/buckets/rm" in _run(env, "remote.mount")
+        # mounted listing shows the remote file; cache pulls the bytes
+        ls = requests.get(f"http://localhost:{fs.port}/buckets/rm/",
+                          headers={"Accept": "application/json"}, timeout=10)
+        assert b"hello.txt" in ls.content
+        _run(env, "remote.cache -dir=/buckets/rm/hello.txt")
+        got = requests.get(
+            f"http://localhost:{fs.port}/buckets/rm/hello.txt", timeout=10)
+        assert got.status_code == 200 and got.content == b"remote hello"
+        _run(env, "remote.uncache -dir=/buckets/rm/hello.txt")
+        _run(env, "remote.meta.sync -dir=/buckets/rm")
+        _run(env, "remote.unmount -dir=/buckets/rm")
+        assert "/buckets/rm" not in _run(env, "remote.mount")
+    finally:
+        env.filer = None
+        fs.stop()

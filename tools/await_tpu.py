@@ -49,9 +49,9 @@ def main() -> int:
                     capture_output=True, text=True, timeout=1200)
                 stdout, stderr, rc = r.stdout, r.stderr, r.returncode
             except subprocess.TimeoutExpired as e:  # tunnel re-wedged
-                stdout = (e.stdout or b"").decode(errors="replace") \
-                    if isinstance(e.stdout, bytes) else (e.stdout or "")
-                stderr = "tune timed out (tunnel wedged again?)"
+                stdout = e.stdout or ""
+                stderr = ("tune timed out (tunnel wedged again?)\n"
+                          + (e.stderr or ""))
                 rc = 124
             with open(OUT, "a") as f:
                 f.write(f"\n=== tune at {stamp} (rc={rc}) ===\n")
