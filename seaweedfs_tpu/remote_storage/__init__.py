@@ -374,6 +374,41 @@ class RemoteGateway:
             synced += 1
         return synced
 
+    def _remote_location(self, path: str):
+        """-> (client, remote-store path) for a filer path under a mount.
+        Raises IOError (not KeyError) so HTTP handlers answer a clean 500
+        when the mount is gone but marker entries linger."""
+        try:
+            mount_dir = self._mount_of(path)
+        except KeyError as e:
+            raise IOError(str(e)) from e
+        client, remote_root = self.conf.client_for(mount_dir)
+        rel = path[len(mount_dir):]
+        rpath = ("/" + remote_root.strip("/") + rel
+                 if remote_root.strip("/") else rel)
+        return client, rpath
+
+    def read_through(self, path: str, offset: int, size: int,
+                     piece: int = 2 * 1024 * 1024):
+        """Yield a remote entry's bytes straight from the remote store in
+        fixed-size ranged reads — no caching, no whole-object buffering
+        (the reference filer's IsInRemoteOnly read fallback). Exactly
+        `size` bytes are produced so HTTP framing never drifts from the
+        declared Content-Length even if the remote object changed.
+        """
+        client, rpath = self._remote_location(path)
+        remaining = size
+        pos = offset
+        while remaining > 0:
+            want = min(piece, remaining)
+            data = client.read_file(rpath, pos, want)
+            if not data:
+                raise IOError(
+                    f"remote object truncated: {rpath} short at {pos}")
+            yield data[:remaining]
+            pos += len(data)
+            remaining -= len(data)
+
     def cache(self, path: str) -> int:
         """Materialize a remote entry's bytes into the filer (remote.cache);
         returns bytes cached."""
@@ -386,11 +421,8 @@ class RemoteGateway:
         marker = resp.entry.extended.get(REMOTE_ENTRY_KEY)
         if not marker:
             raise KeyError(f"{path} is not a remote entry")
-        mount_dir = self._mount_of(path)
-        client, remote_root = self.conf.client_for(mount_dir)
-        rel = path[len(mount_dir):]
-        data = client.read_file("/" + remote_root.strip("/") + rel
-                                if remote_root.strip("/") else rel)
+        client, rpath = self._remote_location(path)
+        data = client.read_file(rpath)
         r = requests.put(f"http://{self.filer}{path}", data=data,
                          timeout=300)
         if r.status_code >= 300:

@@ -269,6 +269,21 @@ class FilerServer:
             end = len(entry.content) if size is None else offset + size
             yield bytes(entry.content[offset:end])
             return
+        from ..remote_storage import REMOTE_ENTRY_KEY
+
+        remote_only = not entry.chunks and (
+            entry.extended.get(REMOTE_ENTRY_KEY) is not None
+            or entry.extended.get(REMOTE_ENTRY_KEY.encode()) is not None)
+        if remote_only:
+            # mounted but not cached: stream through from the remote store
+            # on demand (the reference's IsInRemoteOnly read fallback),
+            # capped at the entry's declared size so Content-Length holds
+            from ..remote_storage import RemoteGateway
+
+            cap = entry.size() - offset if size is None else size
+            yield from RemoteGateway(self.address).read_through(
+                entry.full_path, offset, max(cap, 0))
+            return
         for view in view_from_chunks(entry.chunks, offset,
                                      size if size is not None
                                      else total_size(entry.chunks) - offset):
@@ -744,9 +759,12 @@ def _make_http_handler(srv: FilerServer):
                         # raw bodies stream straight into the autochunker
                         entry = srv.write_stream(path, reader, length,
                                                  mime=ctype, **kwargs)
-                except IOError as e:
-                    # a mid-body failure leaves unread bytes on the socket;
-                    # the next pipelined request would parse garbage
+                except Exception as e:
+                    # any failure (assign errors incl. "no writable
+                    # volumes", mid-body IO) must answer 500 JSON, never
+                    # abort the connection; a mid-body failure also leaves
+                    # unread bytes on the socket, so the next pipelined
+                    # request would parse garbage — close it
                     self.close_connection = True
                     return self._json({"error": str(e)}, 500)
                 self._json({"name": entry.name, "size": entry.size()}, 201)

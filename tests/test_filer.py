@@ -444,3 +444,24 @@ def test_truncated_chunked_put_rejected(cluster):
     assert b"500" in resp.split(b"\r\n", 1)[0], resp[:100]
     assert requests.get(f"http://{fsrv.address}/trunc/x.bin",
                         timeout=10).status_code == 404
+
+
+def test_put_with_no_writable_volumes_returns_500(tmp_path):
+    """A filer PUT when assign fails (no volume servers) must answer a
+    clean 500 JSON, not abort the connection."""
+    from seaweedfs_tpu.server.master import MasterServer
+
+    master = MasterServer(ip="localhost", port=_free_port(),
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    fs = FilerServer(ip="localhost", port=_free_port(),
+                     master=master.address, store_dir=str(tmp_path / "nf"))
+    fs.start()
+    try:
+        r = requests.put(f"http://localhost:{fs.port}/x/y.bin", data=b"data",
+                         timeout=15)
+        assert r.status_code == 500 and "error" in r.json()
+    finally:
+        fs.stop()
+        master.stop()
+        rpc.reset_channels()

@@ -251,3 +251,54 @@ def test_remote_shell_commands(cluster, tmp_path_factory):
     finally:
         env.filer = None
         fs.stop()
+
+
+def test_fs_meta_cat(cluster, tmp_path_factory):
+    from seaweedfs_tpu.server.filer import FilerServer
+
+    master, _, env = cluster
+    fs = FilerServer(ip="localhost", port=_free_port(),
+                     master=master.address,
+                     store_dir=str(tmp_path_factory.mktemp("mcfiler")))
+    fs.start()
+    env.filer = f"localhost:{fs.port}"
+    try:
+        requests.put(f"http://localhost:{fs.port}/docs/a.txt",
+                     data=b"meta me", timeout=10)
+        out = _run(env, "fs.meta.cat /docs/a.txt")
+        assert "a.txt" in out and "7" in out  # name + file size
+    finally:
+        env.filer = None
+        fs.stop()
+
+
+def test_remote_mount_buckets(cluster, tmp_path_factory):
+    import os
+
+    from seaweedfs_tpu.server.filer import FilerServer
+
+    master, _, env = cluster
+    remote_root = str(tmp_path_factory.mktemp("rbuckets"))
+    for b in ("alpha", "beta"):
+        os.makedirs(f"{remote_root}/{b}", exist_ok=True)
+        with open(f"{remote_root}/{b}/x.txt", "w") as f:
+            f.write(b)
+    fs = FilerServer(ip="localhost", port=_free_port(),
+                     master=master.address,
+                     store_dir=str(tmp_path_factory.mktemp("rbfiler")))
+    fs.start()
+    env.filer = f"localhost:{fs.port}"
+    try:
+        _run(env, f"remote.configure -name=rb -type=local "
+                  f"-root={remote_root}")
+        plan = _run(env, "remote.mount.buckets -remote=rb")
+        assert "alpha" in plan and "beta" in plan
+        _run(env, "remote.mount.buckets -remote=rb -apply")
+        mounts = _run(env, "remote.mount")
+        assert "/buckets/alpha" in mounts and "/buckets/beta" in mounts
+        got = requests.get(
+            f"http://localhost:{fs.port}/buckets/alpha/x.txt", timeout=10)
+        assert got.status_code == 200 and got.content == b"alpha"
+    finally:
+        env.filer = None
+        fs.stop()
