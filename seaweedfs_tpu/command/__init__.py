@@ -207,6 +207,14 @@ def main(argv=None) -> int:
     wd.add_argument("-filer", default="localhost:8888")
     wd.add_argument("-filer.path", dest="filer_path", default="/")
 
+    ftp = sub.add_parser("ftp", help="run an FTP gateway")
+    ftp.add_argument("-port", type=int, default=8021)
+    ftp.add_argument("-filer", default="localhost:8888")
+    ftp.add_argument("-ip", default="", help="passive-mode address "
+                     "(default: derived from each control connection)")
+    ftp.add_argument("-portRangeStart", type=int, default=30000)
+    ftp.add_argument("-portRangeStop", type=int, default=30100)
+
     ip_ = sub.add_parser("iam", help="run an IAM API server")
     ip_.add_argument("-port", type=int, default=8111)
     ip_.add_argument("-filer", default="localhost:8888")
@@ -713,6 +721,18 @@ complete -F _weed_tpu weed-tpu""")
         wd.start()
         _wait_forever()
         wd.stop()
+        return 0
+
+    if opts.cmd == "ftp":
+        from ..ftpd import FtpServer, FtpServerOptions
+
+        fsrv = FtpServer(FtpServerOptions(
+            port=opts.port, filer=opts.filer, ip=opts.ip,
+            passive_port_start=opts.portRangeStart,
+            passive_port_stop=opts.portRangeStop))
+        fsrv.start()
+        _wait_forever()
+        fsrv.stop()
         return 0
 
     if opts.cmd == "iam":

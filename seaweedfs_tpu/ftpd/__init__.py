@@ -1,14 +1,33 @@
-"""FTP gateway stub.
+"""FTP gateway over the filer.
 
-Parity with /root/reference/weed/ftpd/ (81 LoC): the reference wires
-fclairamb/ftpserverlib but ships as a work-in-progress stub; this build
-mirrors that status. No FTP server library is baked into this image, so
-`FtpServer.start` raises with guidance toward the working frontends.
+The reference's /root/reference/weed/ftpd/ (81 LoC) wires
+fclairamb/ftpserverlib but ships as a work-in-progress stub. This build
+goes further: a working RFC 959 subset implemented directly on sockets
+(no FTP library exists in this image), backed by the filer's HTTP API —
+the same pattern as the WebDAV gateway.
+
+Supported: USER/PASS (anonymous or any credentials unless a user map is
+given), PWD/CWD/CDUP, TYPE, PASV + EPSV passive data connections, LIST,
+NLST, RETR, STOR, APPE-free simple uploads, DELE, MKD, RMD, SIZE, FEAT,
+SYST, NOOP, QUIT. One data connection per control connection, passive
+only (active-mode PORT is rejected — NAT-hostile and unneeded for the
+test surface).
 """
 
 from __future__ import annotations
 
+import posixpath
+import socket
+import threading
+import time
+import urllib.parse
 from dataclasses import dataclass
+
+import grpc
+
+from ..operation import thread_session
+from ..pb import filer_pb2, rpc
+from ..utils import glog
 
 
 @dataclass
@@ -17,15 +36,348 @@ class FtpServerOptions:
     filer: str = "localhost:8888"
     passive_port_start: int = 30000
     passive_port_stop: int = 30100
+    # advertised/bound address for passive data sockets; "" derives it
+    # from the control connection's local address
+    ip: str = ""
+    users: dict | None = None  # user -> password; None = accept anyone
+
+
+class _Session(threading.Thread):
+    """One FTP control connection."""
+
+    def __init__(self, srv: "FtpServer", conn: socket.socket, peer):
+        super().__init__(daemon=True)
+        self.srv = srv
+        self.conn = conn
+        self.peer = peer
+        self.cwd = "/"
+        self.user = ""
+        self.authed = False
+        self.pasv: socket.socket | None = None
+
+    # -- plumbing ----------------------------------------------------------
+
+    def send(self, line: str) -> None:
+        self.conn.sendall((line + "\r\n").encode())
+
+    def filer_url(self, path: str) -> str:
+        return (f"http://{self.srv.options.filer}"
+                + urllib.parse.quote(path))
+
+    def resolve(self, arg: str) -> str:
+        p = arg if arg.startswith("/") else posixpath.join(self.cwd, arg)
+        norm = posixpath.normpath(p)
+        return norm if norm.startswith("/") else "/" + norm
+
+    def open_data(self):
+        """Accept the client's passive data connection BEFORE any 1xx
+        preliminary reply (a 1xx commits the server to a transfer, RFC
+        959); returns None — after answering 425 — when there is no
+        usable passive listener."""
+        if self.pasv is None:
+            self.send("425 use PASV first")
+            return None
+        lsock, self.pasv = self.pasv, None
+        try:
+            lsock.settimeout(20)
+            data, _ = lsock.accept()
+            return data
+        except OSError:
+            self.send("425 can't open data connection")
+            return None
+        finally:
+            lsock.close()
+
+    # -- command loop ------------------------------------------------------
+
+    def run(self) -> None:  # noqa: C901 - a protocol switch is a switch
+        try:
+            self.send("220 seaweedfs-tpu FTP ready")
+            buf = b""
+            while True:
+                while b"\r\n" not in buf:
+                    chunk = self.conn.recv(4096)
+                    if not chunk:
+                        return
+                    buf += chunk
+                line, _, buf = buf.partition(b"\r\n")
+                try:
+                    verb, _, arg = line.decode(errors="replace").partition(" ")
+                    if not self.handle(verb.upper(), arg.strip()):
+                        return
+                except (IOError, OSError) as e:
+                    self.send(f"550 {e}")
+        except OSError:
+            pass
+        finally:
+            if self.pasv is not None:
+                self.pasv.close()
+            self.conn.close()
+
+    def handle(self, verb: str, arg: str) -> bool:
+        if verb == "QUIT":
+            self.send("221 bye")
+            return False
+        if verb == "USER":
+            self.user = arg
+            self.send("331 password please")
+            return True
+        if verb == "PASS":
+            users = self.srv.options.users
+            if users is not None and users.get(self.user) != arg:
+                self.send("530 login incorrect")
+                return True
+            self.authed = True
+            self.send("230 logged in")
+            return True
+        if not self.authed:
+            self.send("530 log in first")
+            return True
+        if verb == "SYST":
+            self.send("215 UNIX Type: L8")
+        elif verb == "FEAT":
+            self.send("211-features")
+            self.send(" SIZE")
+            self.send(" EPSV")
+            self.send("211 end")
+        elif verb in ("NOOP", "TYPE"):
+            self.send("200 ok")
+        elif verb == "PWD":
+            self.send(f'257 "{self.cwd}"')
+        elif verb in ("CWD", "CDUP"):
+            target = self.resolve(arg) if verb == "CWD" else \
+                posixpath.dirname(self.cwd.rstrip("/")) or "/"
+            if self._is_dir(target):
+                self.cwd = target
+                self.send(f'250 "{self.cwd}"')
+            else:
+                self.send("550 no such directory")
+        elif verb in ("PASV", "EPSV"):
+            self._enter_passive(extended=verb == "EPSV")
+        elif verb == "PORT":
+            self.send("502 passive mode only")
+        elif verb in ("LIST", "NLST"):
+            self._list(self.resolve(arg) if arg and not arg.startswith("-")
+                       else self.cwd, names_only=verb == "NLST")
+        elif verb == "RETR":
+            self._retr(self.resolve(arg))
+        elif verb == "STOR":
+            self._stor(self.resolve(arg))
+        elif verb == "DELE":
+            self._dele(self.resolve(arg))
+        elif verb == "MKD":
+            self._mkd(self.resolve(arg))
+        elif verb == "RMD":
+            self._rmd(self.resolve(arg))
+        elif verb == "SIZE":
+            self._size(self.resolve(arg))
+        else:
+            self.send("502 not implemented")
+        return True
+
+    # -- filer-backed operations ------------------------------------------
+
+    def _meta(self, path: str):
+        """Single-entry lookup via the filer gRPC API (webdav.py find())."""
+        directory, name = path.rsplit("/", 1)
+        try:
+            entry = rpc.filer_stub(
+                rpc.grpc_address(self.srv.options.filer)
+            ).LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory=directory or "/", name=name),
+                timeout=20).entry
+        except grpc.RpcError:
+            return None
+        return {"IsDirectory": entry.is_directory,
+                "FileSize": entry.attributes.file_size}
+
+    def _is_dir(self, path: str) -> bool:
+        if path == "/":
+            return True
+        e = self._meta(path)
+        return bool(e and e.get("IsDirectory"))
+
+    def _enter_passive(self, extended: bool) -> None:
+        opts = self.srv.options
+        if self.pasv is not None:
+            self.pasv.close()
+            self.pasv = None
+        # advertise the interface the client already reached us on unless
+        # an explicit address was configured
+        adv = opts.ip or self.conn.getsockname()[0]
+        lsock = socket.socket()
+        lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        for port in range(opts.passive_port_start, opts.passive_port_stop):
+            try:
+                lsock.bind((adv, port))
+                break
+            except OSError:
+                continue
+        else:
+            # never escape the configured (firewall-shaped) passive range
+            lsock.close()
+            self.send("425 no free passive port")
+            return
+        lsock.listen(1)
+        self.pasv = lsock
+        port = lsock.getsockname()[1]
+        if extended:
+            self.send(f"229 Entering Extended Passive Mode (|||{port}|)")
+        else:
+            h = adv.replace(".", ",")
+            self.send(f"227 Entering Passive Mode ({h},{port >> 8},"
+                      f"{port & 0xFF})")
+
+    def _list_entries(self, path: str):
+        """All entries, paged via lastFileName (the filer caps one page)."""
+        url = self.filer_url(path) + ("" if path.endswith("/") else "/")
+        last = ""
+        while True:
+            r = thread_session().get(
+                url, params={"limit": "1000", "lastFileName": last},
+                headers={"Accept": "application/json"}, timeout=30)
+            if r.status_code != 200:
+                raise IOError("no such directory")
+            body = r.json()
+            page = body.get("Entries") or []
+            yield from page
+            if not page or not body.get("ShouldDisplayLoadMore"):
+                return
+            last = posixpath.basename(page[-1]["FullPath"])
+
+    def _list(self, path: str, names_only: bool) -> None:
+        try:
+            entries = list(self._list_entries(path))
+        except IOError:
+            return self.send("550 no such directory")
+        data = self.open_data()
+        if data is None:
+            return
+        self.send("150 listing")
+        try:
+            out = []
+            for e in entries:
+                name = posixpath.basename(e["FullPath"])
+                if names_only:
+                    out.append(name)
+                    continue
+                kind = "d" if e.get("IsDirectory") else "-"
+                size = e.get("FileSize", 0)
+                mtime = time.strftime(
+                    "%b %d %H:%M", time.localtime(e.get("Mtime") or 0))
+                out.append(f"{kind}rw-r--r-- 1 weed weed {size:>12} "
+                           f"{mtime} {name}")
+            data.sendall(("\r\n".join(out) + "\r\n").encode()
+                         if out else b"")
+        finally:
+            data.close()
+        self.send("226 done")
+
+    def _retr(self, path: str) -> None:
+        r = thread_session().get(self.filer_url(path), stream=True,
+                                 timeout=300)
+        if r.status_code != 200:
+            return self.send("550 no such file")
+        data = self.open_data()
+        if data is None:
+            r.close()
+            return
+        self.send("150 sending")
+        try:
+            for piece in r.iter_content(1 << 20):
+                data.sendall(piece)
+        finally:
+            data.close()
+            r.close()
+        self.send("226 done")
+
+    def _stor(self, path: str) -> None:
+        data = self.open_data()
+        if data is None:
+            return
+        self.send("150 receiving")
+
+        def chunks():
+            while True:
+                piece = data.recv(1 << 20)
+                if not piece:
+                    return
+                yield piece
+
+        try:
+            r = thread_session().put(self.filer_url(path), data=chunks(),
+                                     timeout=300)
+        finally:
+            data.close()
+        if r.status_code >= 300:
+            return self.send(f"550 upload failed: {r.status_code}")
+        self.send("226 stored")
+
+    def _dele(self, path: str) -> None:
+        r = thread_session().delete(self.filer_url(path), timeout=60)
+        self.send("250 deleted" if r.status_code < 300
+                  else f"550 delete failed: {r.status_code}")
+
+    def _mkd(self, path: str) -> None:
+        # directory entry via the filer gRPC API (same as WebDAV MKCOL)
+        directory, name = path.rsplit("/", 1)
+        entry = filer_pb2.Entry(name=name, is_directory=True)
+        entry.attributes.file_mode = 0o40770
+        entry.attributes.mtime = int(time.time())
+        try:
+            rpc.filer_stub(rpc.grpc_address(self.srv.options.filer)) \
+                .CreateEntry(filer_pb2.CreateEntryRequest(
+                    directory=directory or "/", entry=entry), timeout=30)
+        except Exception as e:
+            return self.send(f"550 mkdir failed: {e}")
+        self.send(f'257 "{path}"')
+
+    def _rmd(self, path: str) -> None:
+        r = thread_session().delete(self.filer_url(path),
+                                    params={"recursive": "false"},
+                                    timeout=60)
+        self.send("250 removed" if r.status_code < 300
+                  else f"550 rmdir failed: {r.status_code}")
+
+    def _size(self, path: str) -> None:
+        e = self._meta(path)
+        if e is None or e.get("IsDirectory"):
+            return self.send("550 no such file")
+        self.send(f"213 {e.get('FileSize', 0)}")
 
 
 class FtpServer:
-    """Placeholder matching weed/ftpd/ftpd.go's WIP server."""
+    """Working FTP frontend (the reference's weed/ftpd is a WIP stub)."""
 
     def __init__(self, options: FtpServerOptions | None = None):
         self.options = options or FtpServerOptions()
+        self._lsock: socket.socket | None = None
+        self._stop = threading.Event()
 
     def start(self) -> None:
-        raise NotImplementedError(
-            "the FTP gateway is a stub (the reference's weed/ftpd is too); "
-            "use the S3, WebDAV, HTTP filer, or mount frontends")
+        self._lsock = socket.socket()
+        self._lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._lsock.bind(("", self.options.port))
+        self._lsock.listen(16)
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+        glog.info(f"ftp gateway on :{self.options.port} -> "
+                  f"filer {self.options.filer}")
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, peer = self._lsock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            _Session(self, conn, peer).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._lsock is not None:
+            try:
+                self._lsock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            self._lsock.close()

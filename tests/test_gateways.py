@@ -287,6 +287,52 @@ def test_iam_policy_roundtrip_canonical():
 
 # -- FTP stub --------------------------------------------------------------
 
-def test_ftp_stub_raises():
-    with pytest.raises(NotImplementedError):
-        FtpServer().start()
+def test_ftp_server_lifecycle():
+    """The FTP gateway (no longer a stub) starts and stops cleanly even
+    with no filer behind it."""
+    from seaweedfs_tpu.ftpd import FtpServer, FtpServerOptions
+
+    srv = FtpServer(FtpServerOptions(port=_free_port()))
+    srv.start()
+    srv.stop()
+
+
+def test_ftp_gateway(cluster):
+    """The FTP frontend drives the filer end-to-end via stdlib ftplib:
+    login, mkdir, upload, list, size, download, delete, rmdir."""
+    import ftplib
+    import io as _io
+
+    _, _, fsrv = cluster
+    from seaweedfs_tpu.ftpd import FtpServer, FtpServerOptions
+
+    port = _free_port()
+    start = _free_port()
+    ftp_srv = FtpServer(FtpServerOptions(
+        port=port, filer=fsrv.address,
+        passive_port_start=start, passive_port_stop=start + 200))
+    ftp_srv.start()
+    try:
+        ftp = ftplib.FTP()
+        ftp.connect("127.0.0.1", port, timeout=15)
+        ftp.login("demo", "demo")
+        assert ftp.pwd() == "/"
+        ftp.mkd("/ftpbox")
+        ftp.cwd("/ftpbox")
+        payload = b"ftp payload " * 500
+        ftp.storbinary("STOR hello.bin", _io.BytesIO(payload))
+        assert "hello.bin" in ftp.nlst()
+        assert ftp.size("hello.bin") == len(payload)
+        buf = _io.BytesIO()
+        ftp.retrbinary("RETR hello.bin", buf.write)
+        assert buf.getvalue() == payload
+        lines = []
+        ftp.retrlines("LIST", lines.append)
+        assert any("hello.bin" in l for l in lines)
+        ftp.delete("hello.bin")
+        assert "hello.bin" not in ftp.nlst()
+        ftp.cwd("/")
+        ftp.rmd("/ftpbox")
+        ftp.quit()
+    finally:
+        ftp_srv.stop()
