@@ -1,43 +1,296 @@
-"""Kernel FUSE binding for WFS, gated on an available libfuse wrapper.
+"""Kernel FUSE binding for WFS.
+
+Two backends, tried in order:
+
+1. fusepy (``import fuse``), when the environment provides it.
+2. The bundled C shim (fuse_shim.c): this image ships libfuse.so.2 with
+   no headers and no fusepy, so the shim declares the 2.9 ABI by hand,
+   exposes a flat-typed callback table, and this module implements those
+   callbacks over WFS with ctypes. Serving is single-threaded (-s) so
+   callbacks never race the GIL.
 
 The reference mounts via go-fuse v2 (/root/reference/weed/mount/weedfs.go,
-weed/command/mount_std.go). This environment ships no fusepy/libfuse
-Python wrapper, so the binding is optional: `mount()` raises a clear error
-when no backend is importable, and everything above it (WFS) is exercised
-in-process instead (tests/test_mount.py).
+weed/command/mount_std.go); `weed mount` wires this up.
 """
 
 from __future__ import annotations
 
-from .weedfs import WFS
+import ctypes
+import errno as _errno
+import os
+import subprocess
+import threading
+
+from .weedfs import WFS, FuseError
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "fuse_shim.c")
+_SO = os.path.join(_HERE, "libswfs_fuse.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+
+
+def _load_shim() -> ctypes.CDLL:
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if (not os.path.exists(_SO)
+                or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+            subprocess.run(
+                ["gcc", "-O2", "-shared", "-fPIC",
+                 "-D_FILE_OFFSET_BITS=64", _SRC, "-o", _SO,
+                 "-l:libfuse.so.2"],
+                check=True, capture_output=True)
+        lib = ctypes.CDLL(_SO)
+        lib.swfuse_mount.argtypes = [ctypes.c_char_p, ctypes.c_void_p,
+                                     ctypes.c_int]
+        lib.swfuse_mount.restype = ctypes.c_int
+        lib.swfuse_filler.argtypes = [ctypes.c_void_p, ctypes.c_char_p]
+        lib.swfuse_filler.restype = None
+        _lib = lib
+        return _lib
 
 
 def fuse_available() -> bool:
     try:
         import fuse  # noqa: F401  (fusepy)
 
-        return hasattr(fuse, "FUSE")
+        if hasattr(fuse, "FUSE"):
+            return True
+    except Exception:
+        pass
+    if not os.path.exists("/dev/fuse"):
+        return False
+    try:
+        _load_shim()
+        return True
     except Exception:
         return False
 
 
-def mount(wfs: WFS, mountpoint: str, *, foreground: bool = True) -> None:
-    """Mount `wfs` at `mountpoint` via fusepy, if present."""
-    if not fuse_available():
-        raise RuntimeError(
-            "no FUSE backend available (fusepy/libfuse not installed); "
-            "use the WFS API directly or the weed-tpu filer/S3/WebDAV "
-            "frontends")
+# ---- ctypes callback table (mirrors struct swfuse_ops) -------------------
+
+_GETATTR = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                            ctypes.POINTER(ctypes.c_int64))
+_READDIR = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_void_p)
+_CREATE = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32,
+                           ctypes.POINTER(ctypes.c_uint64))
+_OPEN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_int,
+                         ctypes.POINTER(ctypes.c_uint64))
+_READ = ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_char_p, ctypes.c_uint64,
+                         ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64)
+_WRITE = ctypes.CFUNCTYPE(ctypes.c_int64, ctypes.c_char_p, ctypes.c_uint64,
+                          ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int64)
+_FH = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_uint64)
+_PATH1 = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p)
+_PATH_MODE = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                              ctypes.c_uint32)
+_PATH2 = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_char_p)
+_TRUNC = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_int64)
+_READLINK = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p,
+                             ctypes.c_void_p, ctypes.c_uint64)
+_CHOWN = ctypes.CFUNCTYPE(ctypes.c_int, ctypes.c_char_p, ctypes.c_uint32,
+                          ctypes.c_uint32)
+
+
+class _SwfuseOps(ctypes.Structure):
+    _fields_ = [
+        ("getattr", _GETATTR), ("readdir", _READDIR), ("create", _CREATE),
+        ("open", _OPEN), ("read", _READ), ("write", _WRITE),
+        ("flush", _FH), ("release", _FH), ("mkdir", _PATH_MODE),
+        ("rmdir", _PATH1), ("unlink", _PATH1), ("rename", _PATH2),
+        ("truncate", _TRUNC), ("symlink", _PATH2),
+        ("readlink", _READLINK), ("chmod", _PATH_MODE),
+        ("chown", _CHOWN),
+    ]
+
+
+def _shim_ops(wfs: WFS, lib: ctypes.CDLL) -> _SwfuseOps:
+    """Build the callback table over a WFS instance. The returned struct
+    must stay referenced for the mount's lifetime."""
+    import stat as statmod
+
+    def guard(fn):
+        def wrapped(*args):
+            try:
+                return fn(*args)
+            except FuseError as e:
+                return -int(e.errno)
+            except KeyError:
+                return -_errno.ENOENT
+            except OSError as e:  # e.g. quota ENOSPC from WFS.write
+                return -(e.errno or _errno.EIO)
+            except Exception:
+                return -_errno.EIO
+
+        return wrapped
+
+    def ino(path: bytes) -> int:
+        return wfs.path_inode(path.decode())
+
+    @guard
+    def sw_getattr(path, out):
+        i = ino(path)
+        e = wfs.getattr(i)
+        a = e.attr
+        mode = a.mode
+        if e.is_directory and not statmod.S_ISDIR(mode):
+            mode |= statmod.S_IFDIR
+        elif not e.is_directory and not statmod.S_ISREG(mode) \
+                and not statmod.S_ISLNK(mode):
+            mode |= statmod.S_IFREG
+        out[0] = mode
+        out[1] = wfs.entry_size(i, e)
+        out[2] = a.mtime
+        out[3] = max(1, getattr(e, "hard_link_counter", 1) or 1)
+        out[4] = a.uid
+        out[5] = a.gid
+        out[6] = a.crtime
+        return 0
+
+    @guard
+    def sw_readdir(path, token):
+        for e in wfs.readdir(ino(path)):
+            lib.swfuse_filler(token, e.name.encode())
+        return 0
+
+    @guard
+    def sw_create(path, mode, fh_out):
+        parent, name = path.decode().rsplit("/", 1)
+        _, _, fh = wfs.create(ino((parent or "/").encode()), name, mode)
+        fh_out[0] = fh
+        return 0
+
+    @guard
+    def sw_open(path, flags, fh_out):
+        fh_out[0] = wfs.open(ino(path))
+        return 0
+
+    @guard
+    def sw_read(path, fh, buf, size, off):
+        data = wfs.read(int(fh), int(off), int(size))
+        ctypes.memmove(buf, data, len(data))
+        return len(data)
+
+    @guard
+    def sw_write(path, fh, buf, size, off):
+        data = ctypes.string_at(buf, int(size))
+        return wfs.write(int(fh), int(off), data)
+
+    @guard
+    def sw_flush(path, fh):
+        wfs.flush(int(fh))
+        return 0
+
+    @guard
+    def sw_release(path, fh):
+        wfs.release(int(fh))
+        return 0
+
+    @guard
+    def sw_mkdir(path, mode):
+        parent, name = path.decode().rsplit("/", 1)
+        wfs.mkdir(ino((parent or "/").encode()), name, mode)
+        return 0
+
+    @guard
+    def sw_rmdir(path):
+        parent, name = path.decode().rsplit("/", 1)
+        wfs.rmdir(ino((parent or "/").encode()), name)
+        return 0
+
+    @guard
+    def sw_unlink(path):
+        parent, name = path.decode().rsplit("/", 1)
+        wfs.unlink(ino((parent or "/").encode()), name)
+        return 0
+
+    @guard
+    def sw_rename(old, new):
+        op, on = old.decode().rsplit("/", 1)
+        np_, nn = new.decode().rsplit("/", 1)
+        wfs.rename(ino((op or "/").encode()), on,
+                   ino((np_ or "/").encode()), nn)
+        return 0
+
+    @guard
+    def sw_truncate(path, size):
+        wfs.setattr(ino(path), size=int(size))
+        return 0
+
+    @guard
+    def sw_symlink(target, linkpath):
+        parent, name = linkpath.decode().rsplit("/", 1)
+        wfs.symlink(ino((parent or "/").encode()), name, target.decode())
+        return 0
+
+    @guard
+    def sw_readlink(path, buf, bufsize):
+        target = wfs.readlink(ino(path)).encode()
+        # always NUL-terminate: libfuse strlen()s the buffer
+        n = min(len(target), max(0, int(bufsize) - 1))
+        ctypes.memmove(buf, target, n)
+        ctypes.memset(ctypes.c_void_p(buf + n), 0, 1)
+        return 0
+
+    @guard
+    def sw_chmod(path, mode):
+        wfs.setattr(ino(path), mode=int(mode))
+        return 0
+
+    @guard
+    def sw_chown(path, uid, gid):
+        wfs.setattr(ino(path), uid=int(uid), gid=int(gid))
+        return 0
+
+    return _SwfuseOps(
+        getattr=_GETATTR(sw_getattr), readdir=_READDIR(sw_readdir),
+        create=_CREATE(sw_create), open=_OPEN(sw_open),
+        read=_READ(sw_read), write=_WRITE(sw_write),
+        flush=_FH(sw_flush), release=_FH(sw_release),
+        mkdir=_PATH_MODE(sw_mkdir), rmdir=_PATH1(sw_rmdir),
+        unlink=_PATH1(sw_unlink), rename=_PATH2(sw_rename),
+        truncate=_TRUNC(sw_truncate), symlink=_PATH2(sw_symlink),
+        readlink=_READLINK(sw_readlink), chmod=_PATH_MODE(sw_chmod),
+        chown=_CHOWN(sw_chown),
+    )
+
+
+def unmount(mountpoint: str) -> None:
+    subprocess.run(["fusermount", "-u", mountpoint],
+                   capture_output=True)
+
+
+def mount(wfs: WFS, mountpoint: str, *, foreground: bool = True,
+          debug: bool = False) -> int:
+    """Mount `wfs` at `mountpoint`. Blocks until unmounted
+    (``fusermount -u``); run in a thread or subprocess for async use."""
+    try:
+        import fuse  # noqa: F401
+
+        if hasattr(fuse, "FUSE"):
+            return _mount_fusepy(wfs, mountpoint, foreground)
+    except Exception:
+        # fusepy raises EnvironmentError (not ImportError) when libfuse
+        # is unlocatable; fall through to the bundled shim either way
+        pass
+    lib = _load_shim()
+    ops = _shim_ops(wfs, lib)
+    rc = lib.swfuse_mount(mountpoint.encode(), ctypes.byref(ops),
+                          1 if debug else 0)
+    if rc != 0:
+        raise RuntimeError(f"fuse mount failed (rc={rc})")
+    return rc
+
+
+def _mount_fusepy(wfs: WFS, mountpoint: str, foreground: bool) -> int:
     import functools
 
     import fuse
 
-    from .weedfs import FuseError
-
     def _errno_bridge(fn):
-        """fusepy only honors errnos raised as FuseOSError (an OSError);
-        translate WFS's FuseError so ENOENT/EEXIST/ENODATA/... survive."""
-
         @functools.wraps(fn)
         def wrapped(*args, **kwargs):
             try:
@@ -55,7 +308,7 @@ def mount(wfs: WFS, mountpoint: str, *, foreground: bool = True) -> None:
             return super().__new__(mcs, name, bases, ns)
 
     class _Ops(fuse.Operations,
-               metaclass=_OpsMeta):  # pragma: no cover - needs a kernel
+               metaclass=_OpsMeta):  # pragma: no cover - needs fusepy
         def __init__(self, w: WFS):
             self.w = w
 
@@ -126,3 +379,4 @@ def mount(wfs: WFS, mountpoint: str, *, foreground: bool = True) -> None:
 
     fuse.FUSE(_Ops(wfs), mountpoint, foreground=foreground,
               nothreads=False, allow_other=False)
+    return 0

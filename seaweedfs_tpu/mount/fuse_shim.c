@@ -1,0 +1,249 @@
+// libfuse-2.9 shim: adapts the high-level FUSE ABI to simple-typed
+// callbacks a Python ctypes layer can implement (fuse_binding.py).
+//
+// The image ships libfuse.so.2 but no headers and no fusepy, so the
+// 2.9 ABI structs are declared by hand (layout verified by a mounted
+// smoke test during development). struct stat comes from the real
+// system headers — the shim fills it from a flat int64 attribute array
+// so Python never needs platform struct layouts.
+//
+// Reference counterpart: the go-fuse v2 RawFileSystem bridge in
+// /root/reference/weed/mount/weedfs.go + command/mount_std.go.
+
+#define _FILE_OFFSET_BITS 64
+#include <errno.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+#include <sys/stat.h>
+#include <sys/types.h>
+
+struct fuse_file_info {
+  int flags;
+  unsigned long fh_old;
+  int writepage;
+  unsigned int direct_io : 1, keep_cache : 1, flush : 1, nonseekable : 1,
+      flock_release : 1, padding : 27;
+  uint64_t fh;
+  uint64_t lock_owner;
+};
+
+typedef int (*fuse_fill_dir_t)(void *buf, const char *name,
+                               const struct stat *stbuf, off_t off);
+
+struct fuse_operations {
+  int (*getattr)(const char *, struct stat *);
+  int (*readlink)(const char *, char *, size_t);
+  void *getdir;
+  int (*mknod)(const char *, mode_t, dev_t);
+  int (*mkdir)(const char *, mode_t);
+  int (*unlink)(const char *);
+  int (*rmdir)(const char *);
+  int (*symlink)(const char *, const char *);
+  int (*rename)(const char *, const char *);
+  int (*link)(const char *, const char *);
+  int (*chmod)(const char *, mode_t);
+  int (*chown)(const char *, uid_t, gid_t);
+  int (*truncate)(const char *, off_t);
+  void *utime;
+  int (*open)(const char *, struct fuse_file_info *);
+  int (*read)(const char *, char *, size_t, off_t, struct fuse_file_info *);
+  int (*write)(const char *, const char *, size_t, off_t,
+               struct fuse_file_info *);
+  void *statfs;
+  int (*flush)(const char *, struct fuse_file_info *);
+  int (*release)(const char *, struct fuse_file_info *);
+  void *fsync; void *setxattr; void *getxattr; void *listxattr;
+  void *removexattr; void *opendir;
+  int (*readdir)(const char *, void *, fuse_fill_dir_t, off_t,
+                 struct fuse_file_info *);
+  void *releasedir; void *fsyncdir; void *init; void *destroy;
+  void *access;
+  int (*create)(const char *, mode_t, struct fuse_file_info *);
+  void *ftruncate; void *fgetattr; void *lock; void *utimens; void *bmap;
+  unsigned int flag_nullpath_ok : 1, flag_nopath : 1,
+      flag_utime_omit_ok : 1, flag_reserved : 29;
+  void *ioctl; void *poll; void *write_buf; void *read_buf; void *flock;
+  void *fallocate;
+};
+
+extern int fuse_main_real(int argc, char *argv[],
+                          const struct fuse_operations *op, size_t op_size,
+                          void *user_data);
+
+// ---- the simplified ABI python implements --------------------------------
+
+// getattr out slots: [mode, size, mtime, nlink, uid, gid, crtime, 0]
+struct swfuse_ops {
+  int (*getattr)(const char *path, int64_t out[8]);
+  int (*readdir)(const char *path, void *token);
+  int (*create)(const char *path, uint32_t mode, uint64_t *fh_out);
+  int (*open)(const char *path, int flags, uint64_t *fh_out);
+  int64_t (*read)(const char *path, uint64_t fh, char *buf, uint64_t size,
+                  int64_t off);
+  int64_t (*write)(const char *path, uint64_t fh, const char *buf,
+                   uint64_t size, int64_t off);
+  int (*flush)(const char *path, uint64_t fh);
+  int (*release)(const char *path, uint64_t fh);
+  int (*mkdir)(const char *path, uint32_t mode);
+  int (*rmdir)(const char *path);
+  int (*unlink)(const char *path);
+  int (*rename)(const char *from, const char *to);
+  int (*truncate)(const char *path, int64_t size);
+  int (*symlink)(const char *target, const char *linkpath);
+  int (*readlink)(const char *path, char *buf, uint64_t bufsize);
+  int (*chmod)(const char *path, uint32_t mode);
+  int (*chown)(const char *path, uint32_t uid, uint32_t gid);
+};
+
+static struct swfuse_ops g_ops;
+
+struct filler_token {
+  void *buf;
+  fuse_fill_dir_t fill;
+};
+
+void swfuse_filler(void *token, const char *name) {
+  struct filler_token *t = (struct filler_token *)token;
+  t->fill(t->buf, name, NULL, 0);
+}
+
+// ---- fuse_operations -> swfuse_ops adapters ------------------------------
+
+static int sw_getattr(const char *path, struct stat *st) {
+  int64_t a[8] = {0};
+  int rc = g_ops.getattr(path, a);
+  if (rc != 0) return rc;
+  memset(st, 0, sizeof *st);
+  st->st_mode = (mode_t)a[0];
+  st->st_size = a[1];
+  st->st_mtime = a[2];
+  st->st_ctime = a[6] ? a[6] : a[2];
+  st->st_atime = a[2];
+  st->st_nlink = (nlink_t)(a[3] ? a[3] : 1);
+  st->st_uid = (uid_t)a[4];
+  st->st_gid = (gid_t)a[5];
+  st->st_blksize = 4096;
+  st->st_blocks = (a[1] + 511) / 512;
+  return 0;
+}
+
+static int sw_readdir(const char *path, void *buf, fuse_fill_dir_t fill,
+                      off_t off, struct fuse_file_info *fi) {
+  (void)off; (void)fi;
+  struct filler_token t = {buf, fill};
+  fill(buf, ".", NULL, 0);
+  fill(buf, "..", NULL, 0);
+  return g_ops.readdir(path, &t);
+}
+
+static int sw_create(const char *path, mode_t mode,
+                     struct fuse_file_info *fi) {
+  uint64_t fh = 0;
+  int rc = g_ops.create(path, (uint32_t)mode, &fh);
+  if (rc == 0) fi->fh = fh;
+  return rc;
+}
+
+static int sw_open(const char *path, struct fuse_file_info *fi) {
+  uint64_t fh = 0;
+  int rc = g_ops.open(path, fi->flags, &fh);
+  if (rc == 0) fi->fh = fh;
+  return rc;
+}
+
+static int sw_read(const char *path, char *buf, size_t size, off_t off,
+                   struct fuse_file_info *fi) {
+  return (int)g_ops.read(path, fi->fh, buf, size, off);
+}
+
+static int sw_write(const char *path, const char *buf, size_t size,
+                    off_t off, struct fuse_file_info *fi) {
+  return (int)g_ops.write(path, fi->fh, buf, size, off);
+}
+
+static int sw_flush(const char *path, struct fuse_file_info *fi) {
+  return g_ops.flush(path, fi->fh);
+}
+
+static int sw_release(const char *path, struct fuse_file_info *fi) {
+  return g_ops.release(path, fi->fh);
+}
+
+static int sw_mkdir(const char *path, mode_t mode) {
+  return g_ops.mkdir(path, (uint32_t)mode);
+}
+static int sw_rmdir(const char *path) { return g_ops.rmdir(path); }
+static int sw_unlink(const char *path) { return g_ops.unlink(path); }
+static int sw_rename(const char *a, const char *b) {
+  return g_ops.rename(a, b);
+}
+static int sw_truncate(const char *path, off_t size) {
+  return g_ops.truncate(path, size);
+}
+static int sw_symlink(const char *target, const char *linkpath) {
+  return g_ops.symlink(target, linkpath);
+}
+static int sw_readlink(const char *path, char *buf, size_t size) {
+  return g_ops.readlink(path, buf, size);
+}
+static int sw_chmod(const char *path, mode_t mode) {
+  return g_ops.chmod(path, (uint32_t)mode);
+}
+static int sw_chown(const char *path, uid_t u, gid_t g) {
+  return g_ops.chown(path, u, g);
+}
+
+// Mount and serve until unmounted (fusermount -u). Blocks the calling
+// thread; single-threaded (-s) so python callbacks never race the GIL.
+static volatile int g_mounted = 0;
+
+int swfuse_mount(const char *mountpoint, struct swfuse_ops *ops,
+                 int debug) {
+  // one mount per process: the callback table is a process global, so a
+  // concurrent second mount would silently rewire the first one
+  if (__sync_lock_test_and_set(&g_mounted, 1)) return -EBUSY;
+  g_ops = *ops;
+  struct fuse_operations fops;
+  memset(&fops, 0, sizeof fops);
+  fops.getattr = sw_getattr;
+  fops.readdir = sw_readdir;
+  fops.create = sw_create;
+  fops.open = sw_open;
+  fops.read = sw_read;
+  fops.write = sw_write;
+  fops.flush = sw_flush;
+  fops.release = sw_release;
+  fops.mkdir = sw_mkdir;
+  fops.rmdir = sw_rmdir;
+  fops.unlink = sw_unlink;
+  fops.rename = sw_rename;
+  fops.truncate = sw_truncate;
+  fops.symlink = sw_symlink;
+  fops.readlink = sw_readlink;
+  fops.chmod = sw_chmod;
+  fops.chown = sw_chown;
+  char arg0[] = "swfuse";
+  char arg1[] = "-f";
+  char arg2[] = "-s";
+  char arg3[] = "-d";
+  char *argv[5];
+  int argc = 0;
+  argv[argc++] = arg0;
+  argv[argc++] = (char *)mountpoint;
+  argv[argc++] = arg1;
+  argv[argc++] = arg2;
+  if (debug) argv[argc++] = arg3;
+  // libfuse installs its own INT/TERM/HUP/PIPE handlers and restores
+  // SIG_DFL on teardown — which would clobber the embedding process's
+  // dispositions (python keeps SIGPIPE ignored; losing that makes the
+  // NEXT EPIPE on any socket kill the whole process). Save and restore.
+  struct sigaction saved[4];
+  const int sigs[4] = {SIGINT, SIGTERM, SIGHUP, SIGPIPE};
+  for (int i = 0; i < 4; i++) sigaction(sigs[i], NULL, &saved[i]);
+  int rc = fuse_main_real(argc, argv, &fops, sizeof fops, NULL);
+  for (int i = 0; i < 4; i++) sigaction(sigs[i], &saved[i], NULL);
+  __sync_lock_release(&g_mounted);
+  return rc;
+}

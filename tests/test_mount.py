@@ -419,3 +419,47 @@ def test_pipeline_releases_completed_chunk_refs(tmp_path):
     assert ref() is None, "completed chunk still pinned by _futures"
     p.flush()
     p.close()
+
+
+@pytest.mark.skipif(
+    not __import__("os").path.exists("/dev/fuse"),
+    reason="no /dev/fuse in this environment")
+def test_kernel_fuse_mount(wfs, tmp_path):
+    """Real kernel mount through the bundled libfuse shim: plain os calls
+    against the mountpoint exercise WFS end-to-end (mount_std.go parity)."""
+    import os
+    import threading
+    import time as _time
+
+    from seaweedfs_tpu.mount import fuse_binding
+
+    if not fuse_binding.fuse_available():
+        pytest.skip("fuse backend unavailable")
+    mnt = str(tmp_path / "mnt")
+    os.makedirs(mnt)
+    t = threading.Thread(target=fuse_binding.mount, args=(wfs, mnt),
+                         daemon=True)
+    t.start()
+    deadline = _time.time() + 15
+    while _time.time() < deadline and not os.path.ismount(mnt):
+        _time.sleep(0.1)
+    assert os.path.ismount(mnt), "kernel mount did not appear"
+    try:
+        os.makedirs(f"{mnt}/kd")
+        payload = b"fuse-bytes" * 2000
+        with open(f"{mnt}/kd/a.bin", "wb") as f:
+            f.write(payload)
+        assert os.stat(f"{mnt}/kd/a.bin").st_size == len(payload)
+        with open(f"{mnt}/kd/a.bin", "rb") as f:
+            assert f.read() == payload
+        os.rename(f"{mnt}/kd/a.bin", f"{mnt}/kd/b.bin")
+        os.symlink("b.bin", f"{mnt}/kd/l")
+        with open(f"{mnt}/kd/l", "rb") as f:
+            assert f.read() == payload
+        assert sorted(os.listdir(f"{mnt}/kd")) == ["b.bin", "l"]
+        os.remove(f"{mnt}/kd/l")
+        os.remove(f"{mnt}/kd/b.bin")
+        os.rmdir(f"{mnt}/kd")
+    finally:
+        fuse_binding.unmount(mnt)
+        t.join(timeout=10)
