@@ -104,6 +104,7 @@ def _raft_member_op(env, args, out, op: str) -> None:
 
     from ..registry import kv_flags
 
+    env.confirm_is_locked()  # membership changes mutate cluster topology
     opts = kv_flags(args)
     if not opts.get("id"):
         raise RuntimeError(f"usage: cluster.raft.{op} -id=<master-address>")

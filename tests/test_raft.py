@@ -224,16 +224,20 @@ def ha_cluster(tmp_path):
     rpc.reset_channels()
 
 
-def test_master_ha_leader_and_assign(ha_cluster):
-    masters, vsrv, addrs = ha_cluster
-    deadline = time.time() + 15
-    leader = None
+def _wait_master_leader(masters, timeout=15.0):
+    """Wait for exactly one live MasterServer to claim leadership."""
+    deadline = time.time() + timeout
     while time.time() < deadline:
         leaders = [m for m in masters if m.is_leader()]
         if len(leaders) == 1:
-            leader = leaders[0]
-            break
+            return leaders[0]
         time.sleep(0.1)
+    return None
+
+
+def test_master_ha_leader_and_assign(ha_cluster):
+    masters, vsrv, addrs = ha_cluster
+    leader = _wait_master_leader(masters)
     assert leader is not None
     # volume server finds its way to the leader and registers
     deadline = time.time() + 15
@@ -325,3 +329,54 @@ def test_raft_membership_add_remove():
     finally:
         for n in nodes.values():
             n.stop()
+
+
+def test_shell_raft_remove_live(ha_cluster):
+    """cluster.raft.remove against a live 3-master group: membership
+    shrinks, the removed master stops participating, and the remaining
+    pair keeps serving assigns (command_cluster_raft_remove.go)."""
+    import io
+
+    from seaweedfs_tpu.operation import assign
+    from seaweedfs_tpu.shell.env import CommandEnv
+    from seaweedfs_tpu.shell.registry import run_command
+
+    masters, vsrv, addrs = ha_cluster
+    leader = _wait_master_leader(masters)
+    assert leader is not None
+    victim = next(m for m in masters if m is not leader)
+
+    env = CommandEnv(leader.address)
+    out = io.StringIO()
+    assert run_command(env, "lock", out) == 0
+    assert run_command(
+        env, f"cluster.raft.remove -id={victim.address}", out) == 0
+    assert victim.address in out.getvalue()
+
+    # membership on the leader no longer includes the victim
+    deadline = time.time() + 10
+    while time.time() < deadline and \
+            victim.address in leader.raft.status().get("peers", []):
+        time.sleep(0.1)
+    assert victim.address not in leader.raft.status().get("peers", [])
+
+    # the remaining group still assigns. Leadership may have moved during
+    # the config change, so try every surviving master each round.
+    import grpc as _grpc
+
+    survivors = [m for m in masters if m is not victim]
+    deadline = time.time() + 25
+    last_err = "no attempt"
+    ok = False
+    while time.time() < deadline and not ok:
+        for m in survivors:
+            try:
+                a = assign(m.address)
+                if not a.error:
+                    ok = True
+                    break
+                last_err = a.error
+            except _grpc.RpcError as e:
+                last_err = str(e)
+        time.sleep(0.3)
+    assert ok, last_err
