@@ -463,3 +463,70 @@ def test_kernel_fuse_mount(wfs, tmp_path):
     finally:
         fuse_binding.unmount(mnt)
         t.join(timeout=10)
+
+
+@pytest.mark.skipif(
+    not __import__("os").path.exists("/dev/fuse"),
+    reason="no /dev/fuse in this environment")
+def test_weed_mount_cli_subprocess(tmp_path):
+    """`weed mount` as a real subprocess: the CLI wires WFS + the fuse
+    binding; the test does plain file IO against the mountpoint."""
+    import os
+    import signal
+    import subprocess
+    import sys
+    import time as _time
+
+    from seaweedfs_tpu.mount import fuse_binding
+
+    if not fuse_binding.fuse_available():
+        pytest.skip("fuse backend unavailable")
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "cv")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), pulse_seconds=1)
+    vsrv.start()
+    fsrv = FilerServer(ip="localhost", port=_free_port(),
+                       master=f"localhost:{mport}",
+                       store_dir=str(tmp_path / "cf"))
+    fsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    filer_addr = fsrv.address
+    mnt = str(tmp_path / "climnt")
+    os.makedirs(mnt)
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", "mount",
+         "-filer", filer_addr, "-dir", mnt],
+        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
+        text=True)
+    try:
+        deadline = _time.time() + 30
+        while _time.time() < deadline and not os.path.ismount(mnt):
+            if proc.poll() is not None:  # crashed at startup: fail fast
+                break
+            _time.sleep(0.2)
+        assert os.path.ismount(mnt), (
+            f"CLI mount did not appear (rc={proc.poll()}): "
+            f"{proc.stderr.read()[-500:] if proc.poll() is not None else ''}")
+        with open(f"{mnt}/cli.txt", "wb") as f:
+            f.write(b"via the weed mount subcommand")
+        with open(f"{mnt}/cli.txt", "rb") as f:
+            assert f.read() == b"via the weed mount subcommand"
+        os.remove(f"{mnt}/cli.txt")
+    finally:
+        fuse_binding.unmount(mnt)
+        proc.send_signal(signal.SIGINT)
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+        fsrv.stop()
+        vsrv.stop()
+        master.stop()
+        rpc.reset_channels()
