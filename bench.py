@@ -314,6 +314,8 @@ try:
         "writes_per_sec": round(r["write"]["requests_per_sec"], 1),
         "reads_per_sec": round(r["read"]["requests_per_sec"], 1),
         "failed": r["write"]["failed"] + r["read"]["failed"],
+        "write_p99_ms": r["write"].get("p99_ms"),
+        "read_p99_ms": r["read"].get("p99_ms"),
     }))
 finally:
     for v in vols:
@@ -367,6 +369,11 @@ def main() -> int:
             sf["writes_per_sec"] / 15708.23, 2)
         result["smallfile_vs_ref_reads"] = round(
             sf["reads_per_sec"] / 47019.38, 2)
+        # reference published avg 1.0ms writes / 0.3ms reads (p99 2.6/0.7)
+        if sf.get("write_p99_ms") is not None:
+            result["smallfile_write_p99_ms"] = sf["write_p99_ms"]
+        if sf.get("read_p99_ms") is not None:
+            result["smallfile_read_p99_ms"] = sf["read_p99_ms"]
     else:
         result["smallfile_error"] = sf.get("error", "?")[:200]
     dev = _bench_device()

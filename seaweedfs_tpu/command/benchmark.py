@@ -62,13 +62,27 @@ def _request(addr: str, method: str, path: str, body=None, headers=None):
     raise IOError("unreachable")
 
 
+def _pcts(lat_s: np.ndarray) -> dict:
+    """avg/p50/p99 (ms) over COMPLETED samples only — zero slots are
+    requests that never finished and must not flatten the stats."""
+    lat_s = lat_s[lat_s > 0]
+    if lat_s.size == 0:
+        return {}
+    ms = lat_s * 1000
+    return {"avg_ms": round(float(ms.mean()), 3),
+            "p50_ms": round(float(np.percentile(ms, 50)), 3),
+            "p95_ms": round(float(np.percentile(ms, 95)), 3),
+            "p99_ms": round(float(np.percentile(ms, 99)), 3),
+            "max_ms": round(float(ms.max()), 3)}
+
+
 def _percentiles(lat: np.ndarray) -> str:
-    if lat.size == 0:
+    d = _pcts(lat)
+    if not d:
         return "no samples"
-    ms = lat * 1000
-    return (f"avg {ms.mean():.1f} ms, p50 {np.percentile(ms, 50):.1f}, "
-            f"p95 {np.percentile(ms, 95):.1f}, p99 {np.percentile(ms, 99):.1f}, "
-            f"max {ms.max():.1f}")
+    return (f"avg {d['avg_ms']:.1f} ms, p50 {d['p50_ms']:.1f}, "
+            f"p95 {d['p95_ms']:.1f}, p99 {d['p99_ms']:.1f}, "
+            f"max {d['max_ms']:.1f}")
 
 
 def run_benchmark(opts) -> dict:
@@ -215,7 +229,7 @@ def run_benchmark_native(opts) -> dict:
 
     ok_w, dt_w, lat_w = run_phase(True)
     wr = {"requests_per_sec": n / dt_w, "total_s": dt_w, "failed": n - ok_w,
-          "mb_per_sec": n * size / dt_w / 1e6}
+          "mb_per_sec": n * size / dt_w / 1e6, **_pcts(lat_w)}
     print(f"\nwrite: {wr['requests_per_sec']:.1f} req/s, "
           f"{wr['mb_per_sec']:.2f} MB/s, {dt_w:.2f} s total, "
           f"{wr['failed']} failed (native client, assign batch {batch})")
@@ -225,7 +239,7 @@ def run_benchmark_native(opts) -> dict:
     if not getattr(opts, "skipRead", False):
         ok_r, dt_r, lat_r = run_phase(False)
         rd = {"requests_per_sec": n / dt_r, "total_s": dt_r,
-              "failed": n - ok_r}
+              "failed": n - ok_r, **_pcts(lat_r)}
         print(f"\nread: {rd['requests_per_sec']:.1f} req/s, {dt_r:.2f} s "
               f"total, {rd['failed']} failed (native client)")
         print(f"read latency: {_percentiles(lat_r)}")

@@ -503,8 +503,8 @@ def test_weed_mount_cli_subprocess(tmp_path):
     proc = subprocess.Popen(
         [sys.executable, "-m", "seaweedfs_tpu", "mount",
          "-filer", filer_addr, "-dir", mnt],
-        env=env, stdout=subprocess.DEVNULL, stderr=subprocess.PIPE,
-        text=True)
+        env=env, stdout=subprocess.DEVNULL,
+        stderr=open(str(tmp_path / "mount.log"), "w"))
     try:
         deadline = _time.time() + 30
         while _time.time() < deadline and not os.path.ismount(mnt):
@@ -513,7 +513,7 @@ def test_weed_mount_cli_subprocess(tmp_path):
             _time.sleep(0.2)
         assert os.path.ismount(mnt), (
             f"CLI mount did not appear (rc={proc.poll()}): "
-            f"{proc.stderr.read()[-500:] if proc.poll() is not None else ''}")
+            + open(str(tmp_path / "mount.log")).read()[-500:])
         with open(f"{mnt}/cli.txt", "wb") as f:
             f.write(b"via the weed mount subcommand")
         with open(f"{mnt}/cli.txt", "rb") as f:
@@ -526,6 +526,7 @@ def test_weed_mount_cli_subprocess(tmp_path):
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
+            proc.wait(timeout=5)
         fsrv.stop()
         vsrv.stop()
         master.stop()
