@@ -505,3 +505,35 @@ def test_compaction_under_concurrent_native_writes(native_cluster):
         assert g.status_code == 200 and g.content == body, fid
         checked += 1
     assert checked > 0  # the storm must have proven something
+
+
+def test_head_parity(native_cluster):
+    """Native HEAD matches python HEAD and GET headers; the keepalive
+    stream stays clean (no stray body bytes after a HEAD)."""
+    import http.client
+
+    master, vsrv = native_cluster
+    a = _assign(master)
+    body = b"H" * 512
+    s = requests.Session()
+    assert s.put(f"http://{a.url}/{a.fid}", data=body).status_code == 201
+    native = s.head(f"http://{vsrv.address}/{a.fid}")
+    python = s.head(f"http://localhost:{vsrv.admin_port}/{a.fid}")
+    got = s.get(f"http://{vsrv.address}/{a.fid}")
+    assert native.status_code == python.status_code == got.status_code == 200
+    for h in ("Content-Length", "ETag", "Content-Type"):
+        assert native.headers.get(h) == python.headers.get(h) \
+            == got.headers.get(h), h
+    assert native.headers["Content-Length"] == "512"
+    # HEAD must not leave body bytes on the wire: a follow-up request on
+    # the SAME keepalive connection parses cleanly only if it didn't
+    host, _, port = vsrv.address.partition(":")
+    c = http.client.HTTPConnection(host, int(port), timeout=10)
+    c.request("HEAD", f"/{a.fid}")
+    r1 = c.getresponse()
+    r1.read()
+    assert r1.status == 200
+    c.request("GET", f"/{a.fid}")
+    r2 = c.getresponse()
+    assert r2.status == 200 and r2.read() == body
+    c.close()
