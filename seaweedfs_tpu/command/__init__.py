@@ -214,6 +214,9 @@ def main(argv=None) -> int:
                      "(default: derived from each control connection)")
     ftp.add_argument("-portRangeStart", type=int, default=30000)
     ftp.add_argument("-portRangeStop", type=int, default=30100)
+    ftp.add_argument("-user", default="", help="require this login "
+                     "(with -pass); default accepts any credentials")
+    ftp.add_argument("-pass", dest="password", default="")
 
     ip_ = sub.add_parser("iam", help="run an IAM API server")
     ip_.add_argument("-port", type=int, default=8111)
@@ -726,10 +729,13 @@ complete -F _weed_tpu weed-tpu""")
     if opts.cmd == "ftp":
         from ..ftpd import FtpServer, FtpServerOptions
 
+        if bool(opts.user) != bool(opts.password):
+            p.error("ftp: -user and -pass must be given together")
         fsrv = FtpServer(FtpServerOptions(
             port=opts.port, filer=opts.filer, ip=opts.ip,
             passive_port_start=opts.portRangeStart,
-            passive_port_stop=opts.portRangeStop))
+            passive_port_stop=opts.portRangeStop,
+            users={opts.user: opts.password} if opts.user else None))
         fsrv.start()
         _wait_forever()
         fsrv.stop()
