@@ -730,7 +730,9 @@ complete -F _weed_tpu weed-tpu""")
         from ..ftpd import FtpServer, FtpServerOptions
 
         if bool(opts.user) != bool(opts.password):
-            p.error("ftp: -user and -pass must be given together")
+            print("ftp: -user and -pass must be given together",
+                  file=sys.stderr)
+            return 2
         fsrv = FtpServer(FtpServerOptions(
             port=opts.port, filer=opts.filer, ip=opts.ip,
             passive_port_start=opts.portRangeStart,
