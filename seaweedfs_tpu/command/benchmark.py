@@ -135,7 +135,8 @@ def run_benchmark(opts) -> dict:
           f"{wr['mb_per_sec']:.2f} MB/s, {dt_w:.2f} s total, "
           f"{wr['failed']} failed"
           + (f" (assign batch {batch})" if batch > 1 else ""))
-    print(f"write latency: {_percentiles(lat_w[:len(written)])}")
+    ok_mask = np.array([f is not None for f in fids], dtype=bool)
+    print(f"write latency: {_percentiles(lat_w[ok_mask])}")
 
     results = {"write": wr}
     if not getattr(opts, "skipRead", False):

@@ -204,13 +204,16 @@ class _Session(threading.Thread):
             self.pasv.close()
             self.pasv = None
         # advertise the interface the client already reached us on unless
-        # an explicit address was configured
+        # an explicit address was configured; BIND the wildcard — opts.ip
+        # may be a NAT/external address not assigned to any local interface
+        # (every bind would fail), and on multi-homed hosts the data
+        # connection may arrive on a different interface than the control
         adv = opts.ip or self.conn.getsockname()[0]
         lsock = socket.socket()
         lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         for port in range(opts.passive_port_start, opts.passive_port_stop):
             try:
-                lsock.bind((adv, port))
+                lsock.bind(("", port))
                 break
             except OSError:
                 continue

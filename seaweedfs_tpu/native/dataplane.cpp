@@ -173,7 +173,7 @@ struct Volume {
     // in its map); only off==0 / negative size (tombstone) delete
     if (off != 0 && size >= 0) {
       if (it != map.end() && it->second.stored_offset != 0 &&
-          it->second.size > 0) {
+          it->second.size >= 0) {
         del_count++;
         del_bytes += it->second.size;
       }
@@ -615,6 +615,7 @@ void handle_get(Plane& pl, int fd, const Request& req, uint32_t vid,
   auto vol = pl.reg.find(vid);
   if (!vol) return redirect(fd, req, pl.redirect_port);
   NeedleValue nv{0, 0};
+  int rfd = -1;
   {
     std::lock_guard<std::mutex> l(vol->mu);
     auto it = vol->map.find(key);
@@ -623,13 +624,22 @@ void handle_get(Plane& pl, int fd, const Request& req, uint32_t vid,
       it = vol->map.find(key);
     }
     if (it != vol->map.end()) nv = it->second;
+    // dup the fd while the map snapshot is consistent with it:
+    // swdp_reload_volume (vacuum commit) closes+reopens dat_fd under mu,
+    // so a bare pread after unlock could hit a closed/reused descriptor
+    // or the post-compaction file at a stale offset. The dup pins the
+    // pre-reload inode, against which nv's offset is valid.
+    if (nv.stored_offset != 0 && nv.size >= 0) rfd = dup(vol->dat_fd);
   }
   if (nv.stored_offset == 0 || nv.size < 0)
     return respond(fd, req, 404, "text/plain", "", nullptr, 0);
+  if (rfd < 0)
+    return respond_json(fd, req, 500, "{\"error\":\"dup failed\"}");
   int64_t total = actual_size(nv.size, vol->version);
   std::vector<uint8_t> blob(total);
-  int64_t got = pread(vol->dat_fd, blob.data(), total,
+  int64_t got = pread(rfd, blob.data(), total,
                       (int64_t)nv.stored_offset * kPad);
+  close(rfd);
   if (got != total)
     return respond_json(fd, req, 500, "{\"error\":\"short read\"}");
   ParsedNeedle n;
@@ -803,7 +813,7 @@ void handle_delete(Plane& pl, int fd, const Request& req, uint32_t vid,
     if (!vol->writable)  // frozen between gate check and lock
       goto frozen;
     auto it = vol->map.find(key);
-    if (it == vol->map.end() || it->second.size <= 0)
+    if (it == vol->map.end() || it->second.size < 0)
       return respond_json(fd, req, 404, "{\"size\": 0}");
     // cookie check against the stored record (volume.py delete_needle)
     uint8_t hdr[kHeaderSize];
@@ -884,6 +894,9 @@ void acceptor_loop(Plane* srv) {
     int fd = accept(srv->listen_fd, nullptr, nullptr);
     if (fd < 0) {
       if (srv->stop.load(std::memory_order_relaxed)) return;
+      // a persistent failure (e.g. EMFILE under thread-per-conn load)
+      // would otherwise busy-spin a full core
+      if (errno != EINTR) usleep(20000);
       continue;
     }
     if (srv->live_conns.load(std::memory_order_relaxed) >= 1024) {
@@ -1025,6 +1038,7 @@ int64_t swdp_read(int plane_id, uint32_t vid, uint64_t key, uint8_t** out) {
   auto vol = find_volume(plane_id, vid);
   if (!vol) return -ENOENT;
   NeedleValue nv{0, 0};
+  int rfd = -1;
   {
     std::lock_guard<std::mutex> l(vol->mu);
     auto it = vol->map.find(key);
@@ -1033,13 +1047,19 @@ int64_t swdp_read(int plane_id, uint32_t vid, uint64_t key, uint8_t** out) {
       it = vol->map.find(key);
     }
     if (it != vol->map.end()) nv = it->second;
+    // see handle_get: pin the fd the snapshot refers to across reloads
+    if (nv.stored_offset != 0 && nv.size >= 0) rfd = dup(vol->dat_fd);
   }
   if (nv.stored_offset == 0 || nv.size < 0) return 0;
+  if (rfd < 0) return -EIO;
   int64_t total = actual_size(nv.size, vol->version);
   uint8_t* buf = (uint8_t*)malloc(total);
-  if (!buf) return -ENOMEM;
-  int64_t got =
-      pread(vol->dat_fd, buf, total, (int64_t)nv.stored_offset * kPad);
+  if (!buf) {
+    close(rfd);
+    return -ENOMEM;
+  }
+  int64_t got = pread(rfd, buf, total, (int64_t)nv.stored_offset * kPad);
+  close(rfd);
   if (got != total) {
     free(buf);
     return -EIO;

@@ -123,14 +123,23 @@ class NeedleMap:
             self._load()
 
     def _apply(self, key: int, off: int, size: int) -> None:
-        """Replay one idx entry (doLoading semantics)."""
+        """Replay one idx entry (doLoading semantics).
+
+        Liveness: off != 0 and size >= 0. This deliberately keeps
+        zero-byte needles live, diverging from the reference's replay
+        (needle_map_memory.go:40 uses size.IsValid(), size > 0) which
+        drops on restart the empty files its own read path serves
+        (volume_read.go:36 returns success for readSize == 0). Both this
+        map and the C++ plane (native/dataplane.cpp Volume::apply) use
+        the same predicate so the two planes never diverge on catchup.
+        """
         self.max_file_key = max(self.max_file_key, key)
         self.file_counter += 1
-        if off != 0 and types.size_is_valid(size):
+        if off != 0 and size >= 0:
             old = self._m.get(key)
             self._m[key] = NeedleValue(off, size)
             self.file_byte_counter += size
-            if old is not None and old.offset != 0 and types.size_is_valid(old.size):
+            if old is not None and old.offset != 0 and old.size >= 0:
                 self.deletion_counter += 1
                 self.deletion_byte_counter += old.size
         else:
@@ -176,7 +185,7 @@ class NeedleMap:
         self.max_file_key = max(self.max_file_key, key)
         self.file_counter += 1
         self.file_byte_counter += max(size, 0)
-        if old is not None and old.offset != 0 and types.size_is_valid(old.size):
+        if old is not None and old.offset != 0 and old.size >= 0:
             self.deletion_counter += 1
             self.deletion_byte_counter += old.size
         self._append(key, stored_offset, size)
@@ -186,7 +195,7 @@ class NeedleMap:
 
     def delete(self, key: int, stored_offset: int) -> int:
         old = self._m.pop(key, None)
-        deleted = old.size if old is not None and types.size_is_valid(old.size) else 0
+        deleted = old.size if old is not None and old.size >= 0 else 0
         self.deletion_counter += 1
         self.deletion_byte_counter += deleted
         self._append(key, stored_offset, types.TOMBSTONE_FILE_SIZE)
@@ -460,7 +469,7 @@ class Volume:
         if str(self.ttl):
             return False
         nv = self.nm.get(n.id)
-        if nv is None or nv.offset == 0 or not types.size_is_valid(nv.size):
+        if nv is None or nv.offset == 0 or nv.size < 0:
             return False
         try:
             old = self._read_record(nv)
@@ -481,7 +490,7 @@ class Volume:
             if self.native is not None:
                 return self._native_delete(needle_id, cookie)
             nv = self.nm.get(needle_id)
-            if nv is None or not types.size_is_valid(nv.size):
+            if nv is None or nv.offset == 0 or nv.size < 0:
                 return 0
             if cookie is not None:
                 existing = self._read_header_at(
@@ -721,7 +730,7 @@ class Volume:
                 key, off, size = types.unpack_needle_map_entry(
                     tail[i : i + types.NEEDLE_MAP_ENTRY_SIZE]
                 )
-                if off != 0 and types.size_is_valid(size):
+                if off != 0 and size >= 0:  # same liveness as _apply
                     nv = NeedleValue(off, size)
                     n = self._read_record(nv)
                     dst.seek(0, 2)

@@ -307,6 +307,38 @@ def test_empty_body_put_roundtrip(native_cluster):
     assert g.status_code == 200 and g.content == b""
 
 
+def test_zero_byte_replay_parity(native_cluster):
+    """Both planes use one liveness predicate (off != 0 and size >= 0):
+    a zero-byte needle written via the C++ plane stays live in the Python
+    map after catchup AND after a from-scratch idx replay (fresh map)."""
+    from seaweedfs_tpu.storage.file_id import parse_file_id
+    from seaweedfs_tpu.storage.volume import NeedleMap
+
+    master, vsrv = native_cluster
+    a = _assign(master)
+    fid = parse_file_id(a.fid)
+    s = requests.Session()
+    assert s.put(f"http://{a.url}/{a.fid}", data=b"").status_code == 201
+    v = vsrv.store.find_volume(fid.volume_id)
+    # cross-plane catchup: the python map absorbs the C++ idx append
+    v.nm.catchup_from_idx()
+    nv = v.nm.get(fid.key)
+    assert nv is not None and nv.size == 0
+    # from-scratch replay of the same idx (restart semantics)
+    fresh = NeedleMap(v.nm.idx_path)
+    nv2 = fresh.get(fid.key)
+    assert nv2 is not None and nv2.size == 0
+    fresh.close()
+    # and it still serves from both planes
+    g = s.get(f"http://{a.url}/{a.fid}")
+    assert g.status_code == 200 and g.content == b""
+    n = vsrv.store.read_needle(fid.volume_id, fid.key, fid.cookie)
+    assert n.data == b""
+    # zero-byte needles must be deletable (delete-side liveness matches)
+    assert s.delete(f"http://{a.url}/{a.fid}").status_code in (200, 202)
+    assert s.get(f"http://{a.url}/{a.fid}").status_code == 404
+
+
 def test_concurrent_storm(native_cluster):
     """Parallel writers/overwriters/readers/deleters against one volume:
     every acknowledged write must be readable-or-deleted consistently,
