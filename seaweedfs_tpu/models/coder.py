@@ -12,6 +12,7 @@ Both must produce byte-identical output; tests/test_rs_codec.py enforces it.
 
 from __future__ import annotations
 
+import threading
 from typing import Protocol, runtime_checkable
 
 import numpy as np
@@ -34,14 +35,84 @@ class ErasureCoder(Protocol):
     def verify(self, shards) -> bool: ...
 
 
+class AutoMeshCoder:
+    """Device-backed coder that resolves its implementation at FIRST USE:
+    ShardedCoder (parallel/mesh.py) when the process sees more than one
+    device, RSCodecJax otherwise.
+
+    Resolution is deferred because `jax.devices()` instantiates the backend
+    — and the remote-TPU tunnel is known to hang rather than fail when
+    down. Servers construct their coder at startup (storage/store.py), and
+    startup must never block on the accelerator; the first encode is where
+    a wedged tunnel is allowed to surface.
+    """
+
+    def __init__(self, data_shards: int, parity_shards: int):
+        if data_shards <= 0 or parity_shards < 0:
+            raise ValueError("bad geometry")
+        if data_shards + parity_shards > 256:
+            raise ValueError("at most 256 total shards in GF(256)")
+        self.data_shards = data_shards
+        self.parity_shards = parity_shards
+        self.total_shards = data_shards + parity_shards
+        self._impl = None
+        self._lock = threading.Lock()
+
+    def _resolve(self):
+        # shared across gRPC handler threads: single construction
+        if self._impl is None:
+            with self._lock:
+                if self._impl is None:
+                    import jax
+
+                    if len(jax.devices()) > 1:
+                        from ..parallel.mesh import ShardedCoder
+
+                        self._impl = ShardedCoder(
+                            self.data_shards, self.parity_shards)
+                    else:
+                        from ..ops.rs_jax import RSCodecJax
+
+                        self._impl = RSCodecJax(
+                            self.data_shards, self.parity_shards)
+        return self._impl
+
+    # The full ErasureCoder surface is spelled out (rather than proxied via
+    # __getattr__) so hasattr/isinstance probes — including the
+    # runtime_checkable Protocol above — never force a backend resolve.
+    def encode_parity(self, data):
+        return self._resolve().encode_parity(data)
+
+    def encode(self, shards):
+        return self._resolve().encode(shards)
+
+    def reconstruct(self, shards):
+        return self._resolve().reconstruct(shards)
+
+    def reconstruct_data(self, shards):
+        return self._resolve().reconstruct_data(shards)
+
+    def verify(self, shards) -> bool:
+        return self._resolve().verify(shards)
+
+    def parity_probe(self, shards):
+        return self._resolve().parity_probe(shards)
+
+    parity_checksum = parity_probe
+
+
 def new_coder(
     data_shards: int = 10, parity_shards: int = 4, backend: str | None = None
 ) -> ErasureCoder:
     """reedsolomon.New(data, parity) equivalent with a backend switch.
 
-    Default backend is "tpu"; override per-process with SEAWEEDFS_TPU_CODER
-    (e.g. "native" to force the C++ host path where no accelerator helps,
-    as in CPU-only CI).
+    Default backend is "tpu": mesh-sharded across every visible device when
+    more than one exists (parallel/mesh.ShardedCoder), single-device
+    RSCodecJax otherwise — so the production ec.encode/rebuild pipelines
+    scale across a chip mesh with no call-site changes. Override
+    per-process with SEAWEEDFS_TPU_CODER (e.g. "native" to force the C++
+    host path where no accelerator helps, as in CPU-only CI; "single" to
+    pin one device; "mesh" to require the mesh).
     """
     import os
 
@@ -52,9 +123,15 @@ def new_coder(
 
         return RSCodecNative(data_shards, parity_shards)
     if backend in ("tpu", "jax"):
+        return AutoMeshCoder(data_shards, parity_shards)
+    if backend == "single":
         from ..ops.rs_jax import RSCodecJax
 
         return RSCodecJax(data_shards, parity_shards)
+    if backend in ("mesh", "sharded"):
+        from ..parallel.mesh import ShardedCoder
+
+        return ShardedCoder(data_shards, parity_shards)
     if backend in ("cpu", "numpy"):
         from ..ops.rs_cpu import RSCodecCPU
 
