@@ -369,6 +369,18 @@ class RSCodecJax:
         parity = self.encode_parity(shards[: self.data_shards])
         return bool(jnp.array_equal(parity, shards[self.data_shards:]))
 
+    def parity_probe(self, shards: np.ndarray | jax.Array) -> jax.Array:
+        """Scalar 0 iff stored parity matches recomputed parity, else the
+        max differing byte — single-device form of the mesh coder's
+        ICI-collective probe (parallel/mesh.ShardedCoder.parity_probe),
+        keeping the coder surface uniform across device counts."""
+        shards = jnp.asarray(shards, dtype=jnp.uint8)
+        assert shards.shape[0] == self.total_shards, shards.shape
+        parity = self.encode_parity(shards[: self.data_shards])
+        return jnp.max((parity ^ shards[self.data_shards:]).astype(jnp.int32))
+
+    parity_checksum = parity_probe
+
     # ----------------------------------------------------------------------
 
     def _as_dict(self, shards) -> dict[int, np.ndarray]:
