@@ -63,6 +63,11 @@ def main(argv=None) -> int:
                          "toolchain builds it and no JWT/guard is "
                          "configured (those paths stay Python); bare flag "
                          "= on")
+    vp.add_argument("-largeDisk", action="store_true",
+                    help="5-byte needle offsets: 8TB volume cap instead of "
+                         "32GB (the reference's 5BytesOffset build tag as a "
+                         "runtime switch; .idx stride becomes 17 bytes and "
+                         "is not interchangeable with 4-byte index files)")
 
     fp = sub.add_parser("filer", help="run a filer server")
     fp.add_argument("-ip", default="localhost")
@@ -261,6 +266,14 @@ def main(argv=None) -> int:
     exp.add_argument("-collection", default="")
     exp.add_argument("-o", dest="output", default="./export")
 
+    # the 5-byte-offset mode is process-wide (reference: 5BytesOffset build
+    # tag) — every subcommand that opens .idx/.dat/.ecx takes the flag
+    for sc in (sp, bk, cpt, fxp, exp):
+        sc.add_argument("-largeDisk", action="store_true",
+                        help="5-byte needle offsets (8TB volumes); must "
+                             "match the mode the volume files were "
+                             "written with")
+
     sub.add_parser("version", help="print version")
     scp = sub.add_parser("scaffold", help="print a sample config")
     scp.add_argument("-config", default="filer",
@@ -279,6 +292,12 @@ def main(argv=None) -> int:
         glog.set_vmodule(opts.vmodule)
     if opts.cpuprofile or opts.memprofile:
         setup_profiling(opts.cpuprofile, opts.memprofile)
+    if getattr(opts, "largeDisk", False):
+        # like the reference's 5BytesOffset build tag, the mode applies
+        # to the whole process, whichever subcommand enabled it
+        from ..storage import types as _types
+
+        _types.set_large_disk(True)
     return _run(opts)
 
 

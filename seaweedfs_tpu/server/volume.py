@@ -78,7 +78,11 @@ class VolumeServer:
             native = True
         elif env_native in ("0", "false", "off"):
             native = False
-        self.native_enabled = bool(native) and not write_jwt_key and guard is None
+        # the C++ plane speaks 16-byte idx entries only; in large-disk
+        # (5-byte offset) mode it could never serve a volume, so don't
+        # bind it at all — clients keep the direct python port
+        self.native_enabled = (bool(native) and not write_jwt_key
+                               and guard is None and types.OFFSET_SIZE == 4)
         self.native_plane = None
         if self.native_enabled:
             self.admin_port = port + 11000 if port + 11000 < 65536 \
@@ -164,6 +168,11 @@ class VolumeServer:
             registered = getattr(self, "_native_vids", {})
             for vid, v in current.items():
                 if v.is_tiered or v._dat is None:
+                    continue
+                if types.OFFSET_SIZE != 4:
+                    # the C++ plane reads/writes 16-byte idx entries only;
+                    # large-disk (5-byte offset, 17B stride) volumes stay
+                    # on the python engine
                     continue
                 writable = (not v.read_only
                             and v.super_block.replica_placement.copy_count == 1

@@ -45,6 +45,12 @@ def search_needle_from_sorted_index(
     (used to tombstone in place). Raises NotFoundError on miss.
     (ec_volume.go:230-255)
     """
+    if ecx_file_size % types.NEEDLE_MAP_ENTRY_SIZE:
+        raise IOError(
+            f".ecx size {ecx_file_size} is not a multiple of the active "
+            f"{types.NEEDLE_MAP_ENTRY_SIZE}-byte entry stride — likely a "
+            f"large-disk (5-byte offset) mode mismatch"
+        )
     lo, hi = 0, ecx_file_size // types.NEEDLE_MAP_ENTRY_SIZE
     while lo < hi:
         mid = (lo + hi) // 2

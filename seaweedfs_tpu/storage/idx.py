@@ -18,12 +18,19 @@ from . import types
 
 
 def parse_index_bytes(buf: bytes) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Parse raw .idx bytes -> (ids u64, stored_offsets u32, sizes i32)."""
-    n = len(buf) // types.NEEDLE_MAP_ENTRY_SIZE
-    arr = np.frombuffer(buf, dtype=np.uint8, count=n * 16).reshape(n, 16)
+    """Parse raw .idx bytes -> (ids u64, stored_offsets u64, sizes i32).
+    Entry stride follows the active offset width (16B with 4-byte offsets,
+    17B in large-disk mode — the 5th, high-order offset byte sits after
+    the big-endian lower four, offset_5bytes.go BytesToOffset)."""
+    stride = types.NEEDLE_MAP_ENTRY_SIZE
+    n = len(buf) // stride
+    arr = np.frombuffer(buf, dtype=np.uint8, count=n * stride).reshape(n, stride)
     ids = arr[:, 0:8].copy().view(">u8").reshape(n).astype(np.uint64)
-    offsets = arr[:, 8:12].copy().view(">u4").reshape(n).astype(np.uint32)
-    sizes = arr[:, 12:16].copy().view(">i4").reshape(n).astype(np.int32)
+    offsets = arr[:, 8:12].copy().view(">u4").reshape(n).astype(np.uint64)
+    if types.OFFSET_SIZE == 5:
+        offsets |= arr[:, 12].astype(np.uint64) << 32
+    so = 8 + types.OFFSET_SIZE
+    sizes = arr[:, so:so + 4].copy().view(">i4").reshape(n).astype(np.int32)
     return ids, offsets, sizes
 
 
@@ -52,12 +59,18 @@ def iter_index_entries(path: str | os.PathLike) -> Iterator[tuple[int, int, int]
 def pack_index_arrays(
     ids: np.ndarray, stored_offsets: np.ndarray, sizes: np.ndarray
 ) -> bytes:
-    """Columnar arrays -> raw big-endian .idx bytes."""
+    """Columnar arrays -> raw big-endian .idx bytes (stride follows the
+    active offset width; see parse_index_bytes)."""
     n = len(ids)
-    out = np.empty((n, 16), dtype=np.uint8)
+    stride = types.NEEDLE_MAP_ENTRY_SIZE
+    offs64 = np.ascontiguousarray(stored_offsets.astype(np.uint64))
+    out = np.empty((n, stride), dtype=np.uint8)
     out[:, 0:8] = np.ascontiguousarray(ids.astype(np.uint64)).view(np.uint8).reshape(n, 8)[:, ::-1]
-    out[:, 8:12] = np.ascontiguousarray(stored_offsets.astype(np.uint32)).view(np.uint8).reshape(n, 4)[:, ::-1]
-    out[:, 12:16] = np.ascontiguousarray(sizes.astype(np.int32)).view(np.uint8).reshape(n, 4)[:, ::-1]
+    out[:, 8:12] = (offs64 & 0xFFFFFFFF).astype(np.uint32).view(np.uint8).reshape(n, 4)[:, ::-1]
+    so = 8 + types.OFFSET_SIZE
+    if types.OFFSET_SIZE == 5:
+        out[:, 12] = (offs64 >> 32).astype(np.uint8)
+    out[:, so:so + 4] = np.ascontiguousarray(sizes.astype(np.int32)).view(np.uint8).reshape(n, 4)[:, ::-1]
     return out.tobytes()
 
 

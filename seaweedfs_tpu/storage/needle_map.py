@@ -48,7 +48,7 @@ class MemDb:
         if not entries:
             return b""
         ids = np.array([e[0] for e in entries], dtype=np.uint64)
-        offs = np.array([e[1] for e in entries], dtype=np.uint32)
+        offs = np.array([e[1] for e in entries], dtype=np.uint64)
         sizes = np.array([e[2] for e in entries], dtype=np.int32)
         return idx.pack_index_arrays(ids, offs, sizes)
 

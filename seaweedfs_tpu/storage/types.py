@@ -2,13 +2,21 @@
 
 Wire-compatible with the reference's on-disk formats
 (/root/reference/weed/storage/types/needle_types.go,
-offset_4bytes.go, needle_id_type.go; all integers big-endian per
-weed/util/bytes.go). Offsets are stored as uint32 in units of
-NEEDLE_PADDING_SIZE (8) bytes, capping volumes at 32GB (4-byte offset build).
+offset_4bytes.go, offset_5bytes.go, needle_id_type.go; all integers
+big-endian per weed/util/bytes.go). Offsets are stored in units of
+NEEDLE_PADDING_SIZE (8) bytes, 4 bytes wide by default (32GB volume cap).
+
+The reference's ``5BytesOffset`` build tag (offset_5bytes.go: a 5th
+high-order byte appended after the big-endian lower four, lifting the cap
+to 8TB) is a process-wide mode here too: enable with set_large_disk(True)
+or SEAWEEDFS_TPU_LARGE_DISK=1 before any volume is opened. The .idx/.ecx
+entry stride becomes 17; like the reference, 4-byte and 5-byte index
+files are not interchangeable.
 """
 
 from __future__ import annotations
 
+import os as _os
 import struct
 
 NEEDLE_ID_SIZE = 8
@@ -28,6 +36,26 @@ _U32 = struct.Struct(">I")
 _U64 = struct.Struct(">Q")
 
 
+def set_large_disk(on: bool) -> None:
+    """Switch the process between 4-byte (32GB) and 5-byte (8TB) offsets —
+    the runtime analogue of the reference's 5BytesOffset build tag
+    (offset_5bytes.go:14-16). Must be flipped before volumes are opened;
+    existing index files keep whichever stride they were written with."""
+    global OFFSET_SIZE, NEEDLE_MAP_ENTRY_SIZE, MAX_POSSIBLE_VOLUME_SIZE
+    OFFSET_SIZE = 5 if on else 4
+    NEEDLE_MAP_ENTRY_SIZE = NEEDLE_ID_SIZE + OFFSET_SIZE + SIZE_SIZE
+    MAX_POSSIBLE_VOLUME_SIZE = 4 * 1024 * 1024 * 1024 * 8 * (256 if on else 1)
+
+
+def large_disk() -> bool:
+    return OFFSET_SIZE == 5
+
+
+if _os.environ.get("SEAWEEDFS_TPU_LARGE_DISK", "").lower() in (
+        "1", "true", "yes", "on"):
+    set_large_disk(True)
+
+
 def size_is_deleted(size: int) -> bool:
     return size < 0 or size == TOMBSTONE_FILE_SIZE
 
@@ -37,8 +65,9 @@ def size_is_valid(size: int) -> bool:
 
 
 def offset_to_stored(actual_offset: int) -> int:
-    """Byte offset -> stored uint32 (units of 8 bytes)."""
-    return (actual_offset // NEEDLE_PADDING_SIZE) & 0xFFFFFFFF
+    """Byte offset -> stored offset integer (units of 8 bytes), masked to
+    the active offset width (ToOffset, offset_4bytes.go / offset_5bytes.go)."""
+    return (actual_offset // NEEDLE_PADDING_SIZE) & ((1 << (8 * OFFSET_SIZE)) - 1)
 
 
 def stored_to_actual_offset(stored: int) -> int:
@@ -56,15 +85,22 @@ def u32_to_size(v: int) -> int:
 
 
 def pack_needle_map_entry(needle_id: int, stored_offset: int, size: int) -> bytes:
-    """16-byte .idx/.ecx entry: id(8) + offset(4) + size(4), big-endian."""
-    return _U64.pack(needle_id) + _U32.pack(stored_offset) + _U32.pack(size_to_u32(size))
+    """.idx/.ecx entry: id(8) + offset(4|5) + size(4). The offset is the
+    big-endian lower 4 bytes, with the 5th HIGH-order byte appended after
+    them in large-disk mode (OffsetToBytes, offset_5bytes.go:19-25)."""
+    off = _U32.pack(stored_offset & 0xFFFFFFFF)
+    if OFFSET_SIZE == 5:
+        off += bytes(((stored_offset >> 32) & 0xFF,))
+    return _U64.pack(needle_id) + off + _U32.pack(size_to_u32(size))
 
 
 def unpack_needle_map_entry(b: bytes) -> tuple[int, int, int]:
     """-> (needle_id, stored_offset, signed size)."""
     (nid,) = _U64.unpack_from(b, 0)
     (off,) = _U32.unpack_from(b, 8)
-    (sz,) = _U32.unpack_from(b, 12)
+    if OFFSET_SIZE == 5:
+        off |= b[12] << 32
+    (sz,) = _U32.unpack_from(b, 8 + OFFSET_SIZE)
     return nid, off, u32_to_size(sz)
 
 

@@ -311,6 +311,26 @@ class Volume:
             )
             self._dat.write(self.super_block.to_bytes())
             self._dat.flush()
+            if types.large_disk():
+                with open(base + ".lrg", "wb"):  # stride marker, see below
+                    pass
+        # Offset-width (stride) guard: a 4-byte-offset .idx parsed at
+        # 17-byte stride (or vice versa) is garbage, and the startup
+        # integrity repair would then happily truncate the volume to
+        # nothing. Volumes created in large-disk mode carry a `.lrg`
+        # marker; refuse to open across a mode mismatch. (The reference
+        # has the same hazard between 5BytesOffset and default binaries,
+        # with no guard — this is deliberately stricter.)
+        if dat_exists:
+            has_marker = os.path.exists(base + ".lrg")
+            if has_marker != types.large_disk():
+                raise IOError(
+                    f"volume {vid}: index stride mismatch — volume was "
+                    f"written with {'5' if has_marker else '4'}-byte "
+                    f"offsets but the process is in "
+                    f"{'large-disk (5-byte)' if types.large_disk() else '4-byte'} "
+                    f"mode; restart with the matching -largeDisk setting"
+                )
         self.nm = NeedleMap(base + ".idx", self.needle_map_kind)
         if dat_exists:
             self.check_and_fix_integrity()
@@ -726,7 +746,8 @@ class Volume:
             return
         newdb = read_needle_map(cpx)
         with open(cpd, "r+b") as dst:
-            for i in range(0, len(tail) - 15, types.NEEDLE_MAP_ENTRY_SIZE):
+            for i in range(0, len(tail) - (types.NEEDLE_MAP_ENTRY_SIZE - 1),
+                           types.NEEDLE_MAP_ENTRY_SIZE):
                 key, off, size = types.unpack_needle_map_entry(
                     tail[i : i + types.NEEDLE_MAP_ENTRY_SIZE]
                 )

@@ -1,0 +1,138 @@
+"""5-byte offset (large_disk) mode: the runtime analogue of the
+reference's 5BytesOffset build tag (offset_5bytes.go) — 17-byte .idx/.ecx
+entries, 8TB volume cap, needles addressable past the 32GB 4-byte limit.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.storage import idx as idx_mod
+from seaweedfs_tpu.storage import needle_map, types
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+
+
+@pytest.fixture
+def large_disk():
+    types.set_large_disk(True)
+    yield
+    types.set_large_disk(False)
+
+
+def test_entry_codec_roundtrip_past_32gb(large_disk):
+    assert types.OFFSET_SIZE == 5
+    assert types.NEEDLE_MAP_ENTRY_SIZE == 17
+    assert types.MAX_POSSIBLE_VOLUME_SIZE == 8 * 1024**4
+    # 40GB byte offset -> stored units comfortably past 2^32
+    stored = types.offset_to_stored(40 * 1024**3)
+    assert stored > 0xFFFFFFFF // types.NEEDLE_PADDING_SIZE
+    b = types.pack_needle_map_entry(0xDEADBEEF, stored, 1234)
+    assert len(b) == 17
+    # wire layout: BE lower 4 bytes then the high byte (offset_5bytes.go)
+    assert b[12] == (stored >> 32) & 0xFF
+    nid, off, size = types.unpack_needle_map_entry(b)
+    assert (nid, off, size) == (0xDEADBEEF, stored, 1234)
+    assert types.stored_to_actual_offset(off) == 40 * 1024**3
+
+
+def test_entry_codec_4byte_unchanged():
+    assert types.OFFSET_SIZE == 4
+    b = types.pack_needle_map_entry(7, 99, -1)
+    assert len(b) == 16
+    assert types.unpack_needle_map_entry(b) == (7, 99, -1)
+
+
+def test_idx_arrays_roundtrip(large_disk):
+    ids = np.array([1, 2, 3], np.uint64)
+    offs = np.array([5, 0x1_2345_6789, 0xFF_FFFF_FFFF], np.uint64)
+    sizes = np.array([10, -1, 2**31 - 1], np.int32)
+    raw = idx_mod.pack_index_arrays(ids, offs, sizes)
+    assert len(raw) == 3 * 17
+    i2, o2, s2 = idx_mod.parse_index_bytes(raw)
+    assert np.array_equal(i2, ids)
+    assert np.array_equal(o2, offs)
+    assert np.array_equal(s2, sizes)
+    # per-entry codec agrees with the vectorized one
+    for j in range(3):
+        assert raw[j * 17:(j + 1) * 17] == types.pack_needle_map_entry(
+            int(ids[j]), int(offs[j]), int(sizes[j]))
+
+
+def test_memdb_sorted_bytes_roundtrip(large_disk, tmp_path):
+    db = needle_map.MemDb()
+    db.set(3, 0x2_0000_0001, 77)
+    db.set(1, 42, 9)
+    with open(tmp_path / "v.idx", "wb") as f:
+        f.write(db.to_sorted_bytes())
+    back = needle_map.read_needle_map(str(tmp_path / "v.idx"))
+    assert back.get(3) == (0x2_0000_0001, 77)
+    assert back.get(1) == (42, 9)
+
+
+def test_volume_needle_past_32gb(large_disk, tmp_path):
+    """Write/read/replay a needle whose record sits beyond the 4-byte
+    offset horizon, on a sparse 33GB .dat."""
+    v = Volume(str(tmp_path) + os.sep, "", 9)
+    n1 = Needle.create(1, 0x11, b"below")
+    v.write_needle(n1)
+    # push EOF past 32GB; ext4 keeps it sparse
+    v._dat.truncate(33 * 1024**3)
+    n2 = Needle.create(2, 0x22, b"beyond-32gb")
+    v.write_needle(n2)
+    nv = v.nm.get(2)
+    assert types.stored_to_actual_offset(nv.offset) >= 33 * 1024**3
+    assert v.read_needle(2, 0x22).data == b"beyond-32gb"
+    assert v.read_needle(1, 0x11).data == b"below"
+    v.close()
+    # replay from the 17-byte-stride idx
+    v2 = Volume(str(tmp_path) + os.sep, "", 9)
+    assert v2.read_needle(2, 0x22).data == b"beyond-32gb"
+    assert v2.read_needle(1, 0x11).data == b"below"
+    assert v2.delete_needle(2, 0x22) > 0
+    with pytest.raises(Exception):
+        v2.read_needle(2, 0x22)
+    v2.close()
+
+
+def test_stride_mismatch_refused(tmp_path):
+    """Opening a volume across an offset-width flip must error cleanly
+    instead of letting the integrity repair parse garbage and truncate
+    the volume to nothing."""
+    # 4-byte volume, then large-disk process
+    v = Volume(str(tmp_path) + os.sep, "", 1)
+    v.write_needle(Needle.create(1, 1, b"keep me"))
+    v.close()
+    types.set_large_disk(True)
+    try:
+        with pytest.raises(IOError, match="stride mismatch"):
+            Volume(str(tmp_path) + os.sep, "", 1)
+        # large-disk volume, then 4-byte process
+        v2 = Volume(str(tmp_path) + os.sep, "", 2)
+        v2.write_needle(Needle.create(1, 1, b"big"))
+        v2.close()
+    finally:
+        types.set_large_disk(False)
+    with pytest.raises(IOError, match="stride mismatch"):
+        Volume(str(tmp_path) + os.sep, "", 2)
+    # and the refusals destroyed nothing
+    types.set_large_disk(True)
+    try:
+        assert Volume(str(tmp_path) + os.sep, "", 2).read_needle(1, 1).data \
+            == b"big"
+    finally:
+        types.set_large_disk(False)
+    assert Volume(str(tmp_path) + os.sep, "", 1).read_needle(1, 1).data \
+        == b"keep me"
+
+
+def test_4byte_volume_caps_at_32gb(tmp_path):
+    """Without large_disk, an append past 32GB must be refused, not
+    silently wrapped (volume.py append guard)."""
+    v = Volume(str(tmp_path) + os.sep, "", 10)
+    v.write_needle(Needle.create(1, 1, b"x"))
+    v._dat.truncate(33 * 1024**3)
+    with pytest.raises(IOError):
+        v.write_needle(Needle.create(2, 2, b"y"))
+    v.close()
