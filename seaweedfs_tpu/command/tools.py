@@ -49,6 +49,7 @@ def run_backup(opts) -> int:
                                      else status.idx_file_size)),
                         timeout=3600):
                     f.write(resp.file_content)
+        types.write_stride_marker(base)
         print(f"full backup of volume {opts.volumeId}: "
               f"{os.path.getsize(base + '.dat')} bytes")
         return 0

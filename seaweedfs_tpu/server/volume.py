@@ -642,6 +642,7 @@ class VolumeGrpc:
                     f.write(chunk.file_content)
                     total += len(chunk.file_content)
             yield vs.VolumeCopyResponse(processed_bytes=total)
+        types.write_stride_marker(base)
         self.store.mount_volume(vid)
         self.srv.trigger_heartbeat()
         v = self.store.find_volume(vid)

@@ -4,16 +4,20 @@ Rebuild of /root/reference/weed/server/webdav_server.go (which wraps
 golang.org/x/net/webdav around a filer-backed FileSystem). Here the DAV
 wire protocol is implemented directly: PROPFIND/MKCOL/COPY/MOVE against
 the filer gRPC API, GET/PUT/DELETE proxied through the filer HTTP data
-plane (which already chunks bodies). LOCK/UNLOCK return fake tokens the
-way most minimal DAV servers do — macOS/Windows clients require them.
+plane (which already chunks bodies). LOCK/UNLOCK are backed by a real
+in-memory lock table with expiry/refresh/If-token enforcement
+(LockManager below) — the analogue of x/net/webdav's memLS that the
+reference inherits.
 """
 
 from __future__ import annotations
 
+import re
 import threading
 import time
 import urllib.parse
 import xml.etree.ElementTree as ET
+from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler
 
 from ..utils.httpd import TunedThreadingHTTPServer
@@ -30,12 +34,206 @@ def _dav(tag: str) -> str:
     return f"{{{DAV_NS}}}{tag}"
 
 
+DEFAULT_LOCK_SECONDS = 600.0
+MAX_LOCK_SECONDS = 3600.0
+_TOKEN_RE = re.compile(r"<(opaquelocktoken:[^>]+)>")
+
+
+@dataclass
+class DavLock:
+    token: str
+    path: str  # normalized filer path, no trailing slash
+    depth_infinity: bool
+    owner_xml: str
+    timeout_s: float
+    expires_at: float = field(default=0.0)
+
+    def refresh(self, timeout_s: float | None = None) -> None:
+        if timeout_s is not None:
+            self.timeout_s = timeout_s
+        self.expires_at = time.monotonic() + self.timeout_s
+
+
+class LockManager:
+    """Exclusive write locks keyed by normalized path — the memLS
+    semantics the reference gets from golang.org/x/net/webdav
+    (webdav_server.go wires webdav.NewMemLS()): create/refresh with
+    Timeout, lazy expiry, conflict via ancestors (depth-infinity locks
+    cover their subtree) and descendants, token confirmation from the
+    If header. Shared locks are granted but enforced exclusively —
+    documented deviation, same practical protection."""
+
+    def __init__(self) -> None:
+        self._locks: dict[str, DavLock] = {}
+        self._mu = threading.Lock()
+
+    # -- internals (call with _mu held) ------------------------------------
+
+    def _purge(self) -> None:
+        now = time.monotonic()
+        for p in [p for p, l in self._locks.items() if l.expires_at <= now]:
+            del self._locks[p]
+
+    def _covering(self, path: str) -> DavLock | None:
+        """The lock protecting `path`: on itself, or a depth-infinity
+        lock on any ancestor."""
+        l = self._locks.get(path)
+        if l is not None:
+            return l
+        parent = path.rsplit("/", 1)[0]
+        while parent:
+            l = self._locks.get(parent)
+            if l is not None and l.depth_infinity:
+                return l
+            parent = parent.rsplit("/", 1)[0]
+        l = self._locks.get("/")
+        return l if l is not None and l.depth_infinity else None
+
+    def _descendant_locked(self, path: str) -> DavLock | None:
+        prefix = path.rstrip("/") + "/"
+        for p, l in self._locks.items():
+            if p.startswith(prefix):
+                return l
+        return None
+
+    # -- surface -----------------------------------------------------------
+
+    def lock(self, path: str, owner_xml: str, depth_infinity: bool,
+             timeout_s: float) -> DavLock | None:
+        """-> new lock, or None on conflict (423)."""
+        path = path.rstrip("/") or "/"
+        with self._mu:
+            self._purge()
+            if self._covering(path) is not None:
+                return None
+            if depth_infinity and self._descendant_locked(path) is not None:
+                return None
+            import uuid
+
+            l = DavLock(
+                token=f"opaquelocktoken:{uuid.uuid4()}",
+                path=path, depth_infinity=depth_infinity,
+                owner_xml=owner_xml, timeout_s=timeout_s)
+            l.refresh()
+            self._locks[path] = l
+            return l
+
+    def refresh(self, path: str, tokens: list[str],
+                timeout_s: float | None) -> DavLock | None:
+        """LOCK with no body + If token refreshes (RFC 4918 §7.8)."""
+        path = path.rstrip("/") or "/"
+        with self._mu:
+            self._purge()
+            l = self._covering(path)
+            if l is None or l.token not in tokens:
+                return None
+            l.refresh(timeout_s)
+            return l
+
+    def unlock(self, path: str, token: str) -> bool:
+        path = path.rstrip("/") or "/"
+        with self._mu:
+            self._purge()
+            l = self._covering(path)
+            if l is None or l.token != token:
+                return False
+            del self._locks[l.path]
+            return True
+
+    def can_modify(self, path: str, tokens: list[str]) -> bool:
+        """True when `path` is unlocked or the caller submitted the
+        covering lock's token (write-op gate, RFC 4918 §6.4)."""
+        path = path.rstrip("/") or "/"
+        with self._mu:
+            self._purge()
+            l = self._covering(path)
+            return l is None or l.token in tokens
+
+    def can_modify_recursive(self, path: str, tokens: list[str]) -> bool:
+        """can_modify + every lock held INSIDE the subtree must also be
+        submitted — DELETE/MOVE of a collection affects all members
+        (RFC 4918 §9.6.1: 423 when any member is locked)."""
+        path = path.rstrip("/") or "/"
+        with self._mu:
+            self._purge()
+            l = self._covering(path)
+            if l is not None and l.token not in tokens:
+                return False
+            prefix = path.rstrip("/") + "/"
+            return all(l.token in tokens
+                       for p, l in self._locks.items()
+                       if p.startswith(prefix))
+
+    def release_subtree(self, path: str) -> None:
+        """Drop every lock on `path` or beneath it — the resources are
+        gone (successful DELETE / MOVE source, RFC 4918 §9.6.1). Callers
+        authorize via can_modify_recursive first."""
+        path = path.rstrip("/") or "/"
+        prefix = path + "/"
+        with self._mu:
+            for p in [p for p in self._locks
+                      if p == path or p.startswith(prefix)]:
+                del self._locks[p]
+
+    def discover(self, path: str) -> DavLock | None:
+        with self._mu:
+            self._purge()
+            return self._covering(path.rstrip("/") or "/")
+
+
+def _parse_timeout_header(value: str | None) -> float:
+    """"Second-600" / "Infinite" / comma list -> clamped seconds."""
+    if not value:
+        return DEFAULT_LOCK_SECONDS
+    for part in value.split(","):
+        part = part.strip()
+        if part.lower().startswith("second-"):
+            try:
+                return min(float(part[7:]), MAX_LOCK_SECONDS)
+            except ValueError:
+                continue
+        if part.lower() == "infinite":
+            return MAX_LOCK_SECONDS
+    return DEFAULT_LOCK_SECONDS
+
+
+def _if_tokens(headers) -> list[str]:
+    """All lock tokens submitted in If / Lock-Token headers. The full
+    RFC 4918 If grammar (tagged lists, etag conditions, Not) collapses
+    here to token extraction — enough to enforce ownership."""
+    out = []
+    for name in ("If", "Lock-Token"):
+        v = headers.get(name)
+        if v:
+            out.extend(_TOKEN_RE.findall(v))
+    return out
+
+
+def _lockdiscovery_xml(l: DavLock) -> bytes:
+    prop = ET.Element(_dav("prop"))
+    ld = ET.SubElement(prop, _dav("lockdiscovery"))
+    al = ET.SubElement(ld, _dav("activelock"))
+    ET.SubElement(ET.SubElement(al, _dav("locktype")), _dav("write"))
+    ET.SubElement(ET.SubElement(al, _dav("lockscope")), _dav("exclusive"))
+    ET.SubElement(al, _dav("depth")).text = (
+        "infinity" if l.depth_infinity else "0")
+    if l.owner_xml:
+        ET.SubElement(al, _dav("owner")).text = l.owner_xml
+    ET.SubElement(al, _dav("timeout")).text = f"Second-{int(l.timeout_s)}"
+    lt = ET.SubElement(al, _dav("locktoken"))
+    ET.SubElement(lt, _dav("href")).text = l.token
+    ET.SubElement(ET.SubElement(al, _dav("lockroot")),
+                  _dav("href")).text = l.path
+    return ET.tostring(prop, xml_declaration=True, encoding="utf-8")
+
+
 class WebDavServer:
     def __init__(self, *, port: int = 7333, filer: str = "localhost:8888",
                  base_dir: str = "/"):
         self.port = port
         self.filer = filer
         self.base_dir = base_dir.rstrip("/") or ""
+        self.locks = LockManager()
         self._httpd: TunedThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
@@ -174,6 +372,8 @@ def _make_handler(srv: WebDavServer):
             self._send(207, body)
 
         def do_PROPPATCH(self):
+            if not self._check_lock(srv.full_path(self.path)):
+                return
             self._read_body()
             ms = ET.Element(_dav("multistatus"))
             body = ET.tostring(ms, xml_declaration=True, encoding="utf-8")
@@ -181,6 +381,8 @@ def _make_handler(srv: WebDavServer):
 
         def do_MKCOL(self):
             path = srv.full_path(self.path)
+            if not self._check_lock(path):
+                return
             if srv.find(path) is not None:
                 return self._send(405)
             directory, name = path.rsplit("/", 1)
@@ -229,6 +431,8 @@ def _make_handler(srv: WebDavServer):
 
         def do_PUT(self):
             path = srv.full_path(self.path)
+            if not self._check_lock(path):
+                return
             body = self._read_body()
             r = requests.put(srv.filer_url(path), data=body, timeout=300,
                              headers={"Content-Type":
@@ -238,6 +442,8 @@ def _make_handler(srv: WebDavServer):
 
         def do_DELETE(self):
             path = srv.full_path(self.path)
+            if not self._check_lock(path, recursive=True):
+                return
             entry = srv.find(path)
             if entry is None:
                 return self._send(404)
@@ -245,6 +451,8 @@ def _make_handler(srv: WebDavServer):
             resp = srv.stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
                 directory=directory or "/", name=name, is_delete_data=True,
                 is_recursive=True), timeout=60)
+            if not resp.error:
+                srv.locks.release_subtree(path)  # resources gone (§9.6.1)
             self._send(204 if not resp.error else 409)
 
         def _dest_path(self) -> str | None:
@@ -261,6 +469,9 @@ def _make_handler(srv: WebDavServer):
             dst = self._dest_path()
             if dst is None:
                 return self._send(400)
+            if (not self._check_lock(src, recursive=True)
+                    or not self._check_lock(dst, recursive=True)):
+                return
             if srv.find(src) is None:
                 return self._send(404)
             od, on = src.rsplit("/", 1)
@@ -274,6 +485,7 @@ def _make_handler(srv: WebDavServer):
                 code = e.code()
                 return self._send(
                     404 if code == grpc.StatusCode.NOT_FOUND else 502)
+            srv.locks.release_subtree(src)  # moved away (§9.6.1 analogue)
             self._send(201)
 
         def do_COPY(self):
@@ -281,6 +493,8 @@ def _make_handler(srv: WebDavServer):
             dst = self._dest_path()
             if dst is None:
                 return self._send(400)
+            if not self._check_lock(dst):  # COPY reads src, writes dst
+                return
             entry = srv.find(src)
             if entry is None:
                 return self._send(404)
@@ -293,18 +507,57 @@ def _make_handler(srv: WebDavServer):
                               timeout=300)
             self._send(201 if pr.status_code < 300 else pr.status_code)
 
+        def _check_lock(self, path: str, recursive: bool = False) -> bool:
+            """False (and a 423 response sent) when `path` is locked and
+            the request lacks the covering token. recursive=True also
+            requires tokens for locks inside the subtree (DELETE/MOVE of
+            collections, RFC 4918 §9.6.1)."""
+            tokens = _if_tokens(self.headers)
+            ok = (srv.locks.can_modify_recursive(path, tokens) if recursive
+                  else srv.locks.can_modify(path, tokens))
+            if ok:
+                return True
+            self._send(423)
+            return False
+
         def do_LOCK(self):
-            self._read_body()
-            token = f"opaquelocktoken:{time.time_ns():x}"
-            prop = ET.Element(_dav("prop"))
-            ld = ET.SubElement(prop, _dav("lockdiscovery"))
-            al = ET.SubElement(ld, _dav("activelock"))
-            lt = ET.SubElement(al, _dav("locktoken"))
-            ET.SubElement(lt, _dav("href")).text = token
-            body = ET.tostring(prop, xml_declaration=True, encoding="utf-8")
-            self._send(200, body, headers={"Lock-Token": f"<{token}>"})
+            body = self._read_body()
+            path = srv.full_path(self.path)
+            timeout_s = _parse_timeout_header(self.headers.get("Timeout"))
+            if not body:
+                # refresh (RFC 4918 §7.8): no body, token in If
+                l = srv.locks.refresh(path, _if_tokens(self.headers),
+                                      timeout_s)
+                if l is None:
+                    return self._send(412)
+                return self._send(200, _lockdiscovery_xml(l))
+            owner = ""
+            try:
+                root = ET.fromstring(body)
+                o = root.find(_dav("owner"))
+                if o is not None:
+                    owner = "".join(o.itertext()).strip() or \
+                        "".join(ET.tostring(c, encoding="unicode")
+                                for c in o)
+            except ET.ParseError:
+                return self._send(400)
+            depth_inf = (self.headers.get("Depth", "infinity").lower()
+                         != "0")
+            l = srv.locks.lock(path, owner, depth_inf, timeout_s)
+            if l is None:
+                return self._send(423)
+            # 201 when LOCK created the (previously absent) resource is
+            # not implemented: lock-null resources are obsolete in 4918
+            self._send(200, _lockdiscovery_xml(l),
+                       headers={"Lock-Token": f"<{l.token}>"})
 
         def do_UNLOCK(self):
+            path = srv.full_path(self.path)
+            m = _TOKEN_RE.search(self.headers.get("Lock-Token") or "")
+            if not m:
+                return self._send(400)
+            if not srv.locks.unlock(path, m.group(1)):
+                return self._send(409)
             self._send(204)
 
     return Handler

@@ -481,11 +481,7 @@ def write_dat_file(
     quirk included."""
     k = geo.data_shards
     names = shard_file_names or [geo.shard_file_name(base_file_name, i) for i in range(k)]
-    if types.large_disk():
-        # volumes rebuilt from shards must carry the stride marker the
-        # Volume open guard checks (storage/volume.py)
-        with open(base_file_name + ".lrg", "wb"):
-            pass
+    types.write_stride_marker(base_file_name)
     ins = [open(names[i], "rb") for i in range(k)]
     try:
         with open(base_file_name + ".dat", "wb") as out:

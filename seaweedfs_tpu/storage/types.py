@@ -51,6 +51,17 @@ def large_disk() -> bool:
     return OFFSET_SIZE == 5
 
 
+def write_stride_marker(base_file_name: str) -> None:
+    """Create the `.lrg` stride marker next to a volume's files when the
+    process is in large-disk mode. Every code path that materializes a
+    volume's .dat/.idx (create, copy, backup, ec-decode) must call this
+    so the open-time stride guard (storage/volume.py) recognizes the
+    files' offset width."""
+    if large_disk():
+        with open(base_file_name + ".lrg", "wb"):
+            pass
+
+
 if _os.environ.get("SEAWEEDFS_TPU_LARGE_DISK", "").lower() in (
         "1", "true", "yes", "on"):
     set_large_disk(True)

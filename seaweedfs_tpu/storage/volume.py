@@ -311,17 +311,16 @@ class Volume:
             )
             self._dat.write(self.super_block.to_bytes())
             self._dat.flush()
-            if types.large_disk():
-                with open(base + ".lrg", "wb"):  # stride marker, see below
-                    pass
+            types.write_stride_marker(base)
         # Offset-width (stride) guard: a 4-byte-offset .idx parsed at
         # 17-byte stride (or vice versa) is garbage, and the startup
         # integrity repair would then happily truncate the volume to
         # nothing. Volumes created in large-disk mode carry a `.lrg`
         # marker; refuse to open across a mode mismatch. (The reference
         # has the same hazard between 5BytesOffset and default binaries,
-        # with no guard — this is deliberately stricter.)
-        if dat_exists:
+        # with no guard — this is deliberately stricter.) Applies to
+        # tiered volumes too: their .idx is local even when .dat is not.
+        if dat_exists or sidecar is not None:
             has_marker = os.path.exists(base + ".lrg")
             if has_marker != types.large_disk():
                 raise IOError(
