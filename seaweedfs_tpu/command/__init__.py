@@ -206,6 +206,11 @@ def main(argv=None) -> int:
     bp.add_argument("-nativeClient", action="store_true",
                     help="drive PUT/GET loops from the compiled C++ client "
                          "(parity with the reference's Go benchmark client)")
+    bp.add_argument("-filer", default="",
+                    help="benchmark whole-object PUT/GET through this "
+                         "FILER address (host:port) under /buckets/ — the "
+                         "C++ filer hot plane path when the server runs "
+                         "one — instead of raw volume fids")
 
     wd = sub.add_parser("webdav", help="run a WebDAV gateway")
     wd.add_argument("-port", type=int, default=7333)
@@ -427,7 +432,10 @@ def _run(opts) -> int:
 
             fs = FilerServer(ip=opts.ip, port=opts.filer_port,
                              master=f"{opts.ip}:{opts.master_port}",
-                             store_dir=opts.dir.split(",")[0] + "/filer")
+                             store_dir=opts.dir.split(",")[0] + "/filer",
+                             # co-located volume plane: C++ filer hot path
+                             # for whole-object PUT/GET under /buckets/
+                             native_volume_plane=vsrv.native_plane)
             fs.start()
             stoppers.insert(0, fs.stop)
         if opts.s3:

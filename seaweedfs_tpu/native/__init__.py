@@ -1,5 +1,5 @@
 """Native (C++) runtime components: the volume-server HTTP data plane."""
 
-from .dataplane import NativeDataPlane, native_available
+from .dataplane import NativeDataPlane, NativeFilerPlane, native_available
 
-__all__ = ["NativeDataPlane", "native_available"]
+__all__ = ["NativeDataPlane", "NativeFilerPlane", "native_available"]

@@ -42,6 +42,9 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <deque>
 #include <memory>
 #include <mutex>
 #include <shared_mutex>
@@ -908,6 +911,343 @@ void acceptor_loop(Plane* srv) {
   }
 }
 
+// ------------------------------------------------------- filer hot plane --
+//
+// C++ ownership of whole-object PUT/GET under a path prefix (default
+// "/buckets/"), the filer analogue of the volume data plane above and the
+// round-3 answer to the all-Python filer write path (~250 writes/s:
+// 3 HTTP hops + store + event log per PUT). Design:
+//
+//   * Python leases fid blocks (batched master assigns) into the plane;
+//     each native PUT mints one fid and appends the needle DIRECTLY into
+//     the co-located volume plane's registry — zero HTTP hops when filer
+//     and volume server share the process (`weed server`).
+//   * Entry metadata is appended to a hot log + in-memory map; the
+//     Python filer tails the log (FilerServer._absorb_hot_log) into the
+//     real store, emitting metadata events on absorption. Listings /
+//     metadata reads absorb-then-serve, so read-your-writes holds.
+//   * GETs of hot objects are served from the map straight off the
+//     volume plane; anything else (queries, ranges, conditionals,
+//     multipart, oversized bodies, unknown paths) 307s to Python.
+//   * Python-side mutations (S3 gateway, DELETE, rename) call
+//     swfp_invalidate via the Filer.on_mutate hook so the map never
+//     serves stale bytes.
+//
+// Reference counterpart: filer_server_handlers_write_autochunk.go:24
+// (the per-request assign+upload+CreateEntry pipeline this replaces).
+
+struct HotEntry {
+  uint32_t vid = 0;
+  uint64_t key = 0;
+  uint32_t cookie = 0;
+  uint64_t size = 0;
+  uint32_t crc = 0;
+  uint64_t mtime_ns = 0;
+  std::string mime;
+};
+
+struct FidLease {
+  uint32_t vid = 0;
+  uint64_t base = 0;
+  uint32_t cookie = 0;
+  uint32_t next = 0;
+  uint32_t count = 0;
+};
+
+struct FilerPlane {
+  int id = 0;
+  int listen_fd = -1;
+  int port = 0, redirect_port = 0;
+  int vol_plane_id = -1;
+  size_t max_body = 4u << 20;
+  std::string prefix = "/buckets/";
+  std::atomic<bool> stop{false};
+  std::thread acceptor;
+  std::atomic<int> live_conns{0};
+  std::atomic<uint64_t> requests{0}, native_puts{0}, native_gets{0},
+      redirects{0};
+
+  std::mutex mu;  // map + hot log + leases
+  std::condition_variable lease_cv;  // signaled on swfp_add_lease
+  std::unordered_map<std::string, HotEntry> map;
+  std::deque<FidLease> leases;
+  uint64_t lease_remaining = 0;
+  int log_fd = -1;
+
+  ~FilerPlane() {
+    if (log_fd >= 0) close(log_fd);
+  }
+};
+
+std::mutex g_fplanes_mu;
+std::unordered_map<int, std::shared_ptr<FilerPlane>> g_fplanes;
+int g_next_fplane = 1;
+
+std::shared_ptr<FilerPlane> fplane_of(int id) {
+  std::lock_guard<std::mutex> l(g_fplanes_mu);
+  auto it = g_fplanes.find(id);
+  return it == g_fplanes.end() ? nullptr : it->second;
+}
+
+// Hot-log record, little-endian (tools read it with struct '<'):
+// [u8 op=1][u16 plen][u16 mimelen][u32 vid][u64 key][u32 cookie]
+// [u64 size][u32 crc][u64 mtime_ns][path][mime]
+constexpr size_t kHotHdr = 1 + 2 + 2 + 4 + 8 + 4 + 8 + 4 + 8;
+
+void put_le16(uint8_t* p, uint16_t v) { p[0] = v & 0xFF; p[1] = v >> 8; }
+void put_le32(uint8_t* p, uint32_t v) {
+  for (int i = 0; i < 4; i++) p[i] = (v >> (8 * i)) & 0xFF;
+}
+void put_le64(uint8_t* p, uint64_t v) {
+  for (int i = 0; i < 8; i++) p[i] = (v >> (8 * i)) & 0xFF;
+}
+
+void hotlog_append(FilerPlane& fp, const std::string& path,
+                   const HotEntry& e) {
+  if (fp.log_fd < 0) return;
+  std::vector<uint8_t> rec(kHotHdr + path.size() + e.mime.size());
+  uint8_t* p = rec.data();
+  p[0] = 1;
+  put_le16(p + 1, (uint16_t)path.size());
+  put_le16(p + 3, (uint16_t)e.mime.size());
+  put_le32(p + 5, e.vid);
+  put_le64(p + 9, e.key);
+  put_le32(p + 17, e.cookie);
+  put_le64(p + 21, e.size);
+  put_le32(p + 29, e.crc);
+  put_le64(p + 33, e.mtime_ns);
+  memcpy(p + kHotHdr, path.data(), path.size());
+  memcpy(p + kHotHdr + path.size(), e.mime.data(), e.mime.size());
+  // single write() so the python tailer never sees a torn record except
+  // at a crash boundary (where it stops at the last complete record)
+  ssize_t w = write(fp.log_fd, rec.data(), rec.size());
+  (void)w;
+}
+
+std::string json_escape(const std::string& s) {
+  std::string out;
+  out.reserve(s.size() + 2);
+  for (unsigned char c : s) {
+    if (c == '"' || c == '\\') { out += '\\'; out += (char)c; }
+    else if (c < 0x20) {
+      char b[8];
+      snprintf(b, sizeof b, "\\u%04x", c);
+      out += b;
+    } else out += (char)c;
+  }
+  return out;
+}
+
+void handle_filer_put(FilerPlane& fp, int fd, const Request& req) {
+  if (!req.query.empty() || req.body.size() > fp.max_body)
+    return fp.redirects++, redirect(fd, req, fp.redirect_port);
+  std::string ct = req.header("content-type");
+  if (ct.rfind("multipart/", 0) == 0 || ct.size() >= 256 ||
+      !req.header("content-encoding").empty())
+    return fp.redirects++, redirect(fd, req, fp.redirect_port);
+  const std::string& path = req.path;
+  if (path.size() >= 4096 || path.back() == '/')
+    return fp.redirects++, redirect(fd, req, fp.redirect_port);
+
+  // mint a fid from the leased blocks; a dry pool briefly waits for the
+  // python refill thread (bursts outrun it) before giving up to python
+  uint32_t vid = 0, cookie = 0;
+  uint64_t key = 0;
+  {
+    std::unique_lock<std::mutex> l(fp.mu);
+    for (int attempt = 0; attempt < 2 && vid == 0; attempt++) {
+      while (!fp.leases.empty()) {
+        FidLease& ls = fp.leases.front();
+        if (ls.next >= ls.count) { fp.leases.pop_front(); continue; }
+        vid = ls.vid;
+        key = ls.base + ls.next;
+        cookie = ls.cookie;
+        ls.next++;
+        fp.lease_remaining--;
+        break;
+      }
+      if (vid == 0 && attempt == 0)
+        fp.lease_cv.wait_for(l, std::chrono::milliseconds(500),
+                             [&] { return !fp.leases.empty(); });
+    }
+  }
+  if (vid == 0)
+    return fp.redirects++, redirect(fd, req, fp.redirect_port);
+  auto vol = find_volume(fp.vol_plane_id, vid);
+  if (!vol || !vol->writable)
+    return fp.redirects++, redirect(fd, req, fp.redirect_port);
+
+  // build + append the needle record (same wire as handle_put; fresh
+  // keys never collide, so no dedup/cookie-check pass is needed)
+  const uint8_t* data = req.body.data();
+  uint32_t dlen = (uint32_t)req.body.size();
+  uint8_t flags = kFlagHasLastModified;
+  if (!ct.empty()) flags |= kFlagHasMime;
+  uint64_t now_secs = (uint64_t)time(nullptr);
+  int32_t size = dlen ? (int32_t)(4 + dlen + 1 +
+                                  ((flags & kFlagHasMime) ? 1 + ct.size() : 0) +
+                                  5)
+                      : 0;
+  uint32_t crc = crc32c(data, dlen);
+  int64_t total = actual_size(size, vol->version);
+  std::vector<uint8_t> blob(total, 0);
+  uint8_t* p = blob.data();
+  put_u32(p, cookie);
+  put_u64(p + 4, key);
+  put_u32(p + 12, (uint32_t)size);
+  int64_t off = kHeaderSize;
+  if (dlen) {
+    put_u32(p + off, dlen);
+    off += 4;
+    memcpy(p + off, data, dlen);
+    off += dlen;
+    p[off++] = flags;
+    if (flags & kFlagHasMime) {
+      p[off++] = (uint8_t)ct.size();
+      memcpy(p + off, ct.data(), ct.size());
+      off += ct.size();
+    }
+    for (int i = 0; i < 5; i++)
+      p[off + i] = (uint8_t)(now_secs >> (32 - 8 * i));
+    off += 5;
+  }
+  put_u32(p + off, crc);
+  off += 4;
+  int64_t ns_off = vol->version == 3 ? off : -1;
+  uint64_t ns = 0;
+  {
+    std::lock_guard<std::mutex> l(vol->mu);
+    if (!vol->writable)
+      return fp.redirects++, redirect(fd, req, fp.redirect_port);
+    if (vol->append(blob.data(), total, key, size, ns_off, &ns) < 0)
+      return respond_json(fd, req, 500, "{\"error\":\"append failed\"}");
+  }
+  if (!ns) ns = now_secs * 1000000000ull;
+
+  HotEntry e;
+  e.vid = vid;
+  e.key = key;
+  e.cookie = cookie;
+  e.size = dlen;
+  e.crc = crc;
+  e.mtime_ns = ns;
+  e.mime = ct;
+  {
+    std::lock_guard<std::mutex> l(fp.mu);
+    hotlog_append(fp, path, e);
+    fp.map[path] = std::move(e);
+  }
+  fp.native_puts++;
+  std::string name = path.substr(path.rfind('/') + 1);
+  std::string out = "{\"name\": \"" + json_escape(name) +
+                    "\", \"size\": " + std::to_string(dlen) + "}";
+  respond_json(fd, req, 201, out);
+}
+
+void handle_filer_get(FilerPlane& fp, int fd, const Request& req) {
+  if (!req.query.empty() || !req.header("range").empty() ||
+      !req.header("if-modified-since").empty())
+    return fp.redirects++, redirect(fd, req, fp.redirect_port);
+  HotEntry e;
+  {
+    std::lock_guard<std::mutex> l(fp.mu);
+    auto it = fp.map.find(req.path);
+    if (it == fp.map.end())
+      return fp.redirects++, redirect(fd, req, fp.redirect_port);
+    e = it->second;
+  }
+  auto vol = find_volume(fp.vol_plane_id, e.vid);
+  if (!vol)
+    return fp.redirects++, redirect(fd, req, fp.redirect_port);
+  NeedleValue nv{0, 0};
+  int rfd = -1;
+  {
+    std::lock_guard<std::mutex> l(vol->mu);
+    auto it = vol->map.find(e.key);
+    if (it == vol->map.end()) {
+      vol->catchup();
+      it = vol->map.find(e.key);
+    }
+    if (it != vol->map.end()) nv = it->second;
+    if (nv.stored_offset != 0 && nv.size >= 0) rfd = dup(vol->dat_fd);
+  }
+  if (nv.stored_offset == 0 || nv.size < 0 || rfd < 0)
+    return fp.redirects++, redirect(fd, req, fp.redirect_port);
+  int64_t total = actual_size(nv.size, vol->version);
+  std::vector<uint8_t> blob(total);
+  int64_t got = pread(rfd, blob.data(), total,
+                      (int64_t)nv.stored_offset * kPad);
+  close(rfd);
+  ParsedNeedle n;
+  if (got != total ||
+      !parse_record(blob.data(), total, vol->version, &n) ||
+      n.cookie != e.cookie || crc32c(n.data, n.data_len) != e.crc)
+    return fp.redirects++, redirect(fd, req, fp.redirect_port);
+  std::string etag = "\"" + etag_hex(e.crc) + "\"";
+  std::string extra = "ETag: " + etag + "\r\n";
+  extra += "Last-Modified: " + http_date(e.mtime_ns / 1000000000ull) +
+           "\r\n";
+  std::string inm = req.header("if-none-match");
+  if (!inm.empty() && inm == etag) {
+    fp.native_gets++;
+    return respond(fd, req, 304, "text/plain", extra, nullptr, 0);
+  }
+  std::string ctype =
+      e.mime.empty() ? "application/octet-stream" : e.mime;
+  fp.native_gets++;
+  respond(fd, req, 200, ctype, extra, n.data, n.data_len);
+}
+
+void handle_filer_request(FilerPlane& fp, int fd, const Request& req) {
+  fp.requests.fetch_add(1, std::memory_order_relaxed);
+  if (req.path.rfind(fp.prefix, 0) == 0) {
+    if (req.method == "GET" || req.method == "HEAD")
+      return handle_filer_get(fp, fd, req);
+    if (req.method == "PUT" || req.method == "POST")
+      return handle_filer_put(fp, fd, req);
+  }
+  fp.redirects++;
+  redirect(fd, req, fp.redirect_port);
+}
+
+void filer_conn_loop(FilerPlane* srv, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+  struct timeval tv{1, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  std::string buf;
+  Request req;
+  while (!srv->stop.load(std::memory_order_relaxed)) {
+    int rc = read_request(fd, buf, &req, srv->stop);
+    if (rc == -1) break;
+    if (rc == -2) {
+      respond(fd, req, 400, "text/plain", "", nullptr, 0);
+      break;
+    }
+    handle_filer_request(*srv, fd, req);
+    if (!req.keepalive) break;
+  }
+  close(fd);
+  srv->live_conns.fetch_sub(1, std::memory_order_relaxed);
+}
+
+void filer_acceptor_loop(FilerPlane* srv) {
+  while (!srv->stop.load(std::memory_order_relaxed)) {
+    int fd = accept(srv->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (srv->stop.load(std::memory_order_relaxed)) return;
+      if (errno != EINTR) usleep(20000);
+      continue;
+    }
+    if (srv->live_conns.load(std::memory_order_relaxed) >= 1024) {
+      close(fd);
+      continue;
+    }
+    srv->live_conns.fetch_add(1, std::memory_order_relaxed);
+    std::thread(filer_conn_loop, srv, fd).detach();
+  }
+}
+
 }  // namespace
 
 // ----------------------------------------------------------------- C ABI --
@@ -1199,6 +1539,135 @@ extern "C" int64_t swdp_bench(const char* host, int port, int is_put,
 uint64_t swdp_request_count(int plane_id) {
   auto pl = plane_of(plane_id);
   return pl ? pl->requests.load() : 0;
+}
+
+// ------------------------------------------------- filer hot plane ABI --
+
+// Starts a filer hot plane bound to `port`; non-hot requests 307 to
+// `redirect_port` (the python filer listener). `vol_plane_id` is the
+// co-located volume plane whose registry serves the needle IO.
+// `log_path` is the hot entry log the python filer absorbs.
+int swfp_start(const char* bind_ip, int port, int redirect_port,
+               int vol_plane_id, const char* log_path, const char* prefix,
+               int64_t max_body) {
+  static std::once_flag crc_once;
+  std::call_once(crc_once, crc_init);
+  int lfd = open(log_path, O_WRONLY | O_CREAT | O_APPEND, 0644);
+  if (lfd < 0) return -errno;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) {
+    close(lfd);
+    return -errno;
+  }
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+  struct sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  addr.sin_addr.s_addr =
+      bind_ip && *bind_ip ? inet_addr(bind_ip) : INADDR_ANY;
+  if (bind(fd, (struct sockaddr*)&addr, sizeof addr) != 0 ||
+      listen(fd, 256) != 0) {
+    int e = errno;
+    close(fd);
+    close(lfd);
+    return -e;
+  }
+  auto fp = std::make_shared<FilerPlane>();
+  fp->listen_fd = fd;
+  fp->log_fd = lfd;
+  fp->port = port;
+  fp->redirect_port = redirect_port;
+  fp->vol_plane_id = vol_plane_id;
+  if (prefix && *prefix) fp->prefix = prefix;
+  if (max_body > 0) fp->max_body = (size_t)max_body;
+  {
+    std::lock_guard<std::mutex> l(g_fplanes_mu);
+    fp->id = g_next_fplane++;
+    g_fplanes[fp->id] = fp;
+  }
+  fp->acceptor = std::thread(filer_acceptor_loop, fp.get());
+  return fp->id;
+}
+
+void swfp_stop(int id) {
+  std::shared_ptr<FilerPlane> fp;
+  {
+    std::lock_guard<std::mutex> l(g_fplanes_mu);
+    auto it = g_fplanes.find(id);
+    if (it == g_fplanes.end()) return;
+    fp = it->second;
+    g_fplanes.erase(it);
+  }
+  fp->stop.store(true);
+  shutdown(fp->listen_fd, SHUT_RDWR);
+  close(fp->listen_fd);
+  fp->acceptor.join();
+  for (int i = 0; i < 300 && fp->live_conns.load() > 0; i++)
+    usleep(10000);
+}
+
+// Feed a block of `count` fids (vid, base_key..base_key+count-1, cookie)
+// from a batched master assign.
+int swfp_add_lease(int id, uint32_t vid, uint64_t base_key, uint32_t cookie,
+                   uint32_t count) {
+  auto fp = fplane_of(id);
+  if (!fp) return -ENOENT;
+  {
+    std::lock_guard<std::mutex> l(fp->mu);
+    fp->leases.push_back(FidLease{vid, base_key, cookie, 0, count});
+    fp->lease_remaining += count;
+  }
+  fp->lease_cv.notify_all();
+  return 0;
+}
+
+uint64_t swfp_lease_remaining(int id) {
+  auto fp = fplane_of(id);
+  if (!fp) return 0;
+  std::lock_guard<std::mutex> l(fp->mu);
+  return fp->lease_remaining;
+}
+
+// Drop a path from the hot map (python-side mutation: delete, rename,
+// S3 overwrite). Returns 1 when present.
+int swfp_invalidate(int id, const char* path) {
+  auto fp = fplane_of(id);
+  if (!fp) return -ENOENT;
+  std::lock_guard<std::mutex> l(fp->mu);
+  return fp->map.erase(path) ? 1 : 0;
+}
+
+// Drop a path and everything beneath it (recursive delete / rename).
+int swfp_invalidate_prefix(int id, const char* path) {
+  auto fp = fplane_of(id);
+  if (!fp) return -ENOENT;
+  std::string p(path);
+  while (p.size() > 1 && p.back() == '/') p.pop_back();
+  std::string prefix = p + "/";
+  int n = 0;
+  std::lock_guard<std::mutex> l(fp->mu);
+  n += (int)fp->map.erase(p);
+  for (auto it = fp->map.begin(); it != fp->map.end();) {
+    if (it->first.rfind(prefix, 0) == 0) {
+      it = fp->map.erase(it);
+      n++;
+    } else {
+      ++it;
+    }
+  }
+  return n;
+}
+
+int swfp_stats(int id, uint64_t* requests, uint64_t* native_puts,
+               uint64_t* native_gets, uint64_t* redirects) {
+  auto fp = fplane_of(id);
+  if (!fp) return -ENOENT;
+  if (requests) *requests = fp->requests.load();
+  if (native_puts) *native_puts = fp->native_puts.load();
+  if (native_gets) *native_gets = fp->native_gets.load();
+  if (redirects) *redirects = fp->redirects.load();
+  return 0;
 }
 
 }  // extern "C"

@@ -78,6 +78,26 @@ def load_library() -> ctypes.CDLL:
                                    ctypes.c_int, u8p, ctypes.c_int64,
                                    ctypes.POINTER(ctypes.c_int64)]
         lib.swdp_bench.restype = ctypes.c_int64
+        lib.swfp_start.argtypes = [ctypes.c_char_p, ctypes.c_int,
+                                   ctypes.c_int, ctypes.c_int,
+                                   ctypes.c_char_p, ctypes.c_char_p,
+                                   ctypes.c_int64]
+        lib.swfp_start.restype = ctypes.c_int
+        lib.swfp_stop.argtypes = [ctypes.c_int]
+        lib.swfp_stop.restype = None
+        lib.swfp_add_lease.argtypes = [ctypes.c_int, ctypes.c_uint32,
+                                       ctypes.c_uint64, ctypes.c_uint32,
+                                       ctypes.c_uint32]
+        lib.swfp_add_lease.restype = ctypes.c_int
+        lib.swfp_lease_remaining.argtypes = [ctypes.c_int]
+        lib.swfp_lease_remaining.restype = ctypes.c_uint64
+        lib.swfp_invalidate.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.swfp_invalidate.restype = ctypes.c_int
+        lib.swfp_invalidate_prefix.argtypes = [ctypes.c_int, ctypes.c_char_p]
+        lib.swfp_invalidate_prefix.restype = ctypes.c_int
+        lib.swfp_stats.argtypes = [ctypes.c_int] + \
+            [ctypes.POINTER(ctypes.c_uint64)] * 4
+        lib.swfp_stats.restype = ctypes.c_int
         _lib = lib
         return _lib
 
@@ -191,3 +211,52 @@ class NativeDataPlane:
 
     def request_count(self) -> int:
         return int(self.lib.swdp_request_count(self.plane_id))
+
+
+class NativeFilerPlane:
+    """C++ filer hot plane: whole-object PUT/GET under `prefix` served
+    straight off a co-located volume plane's registry; everything else
+    307s to the python filer at redirect_port. Entry metadata lands in
+    `log_path`, which FilerServer absorbs into the real store."""
+
+    def __init__(self, bind_ip: str, port: int, redirect_port: int,
+                 volume_plane_id: int, log_path: str,
+                 prefix: str = "/buckets/", max_body: int = 4 << 20):
+        self.lib = load_library()
+        self.port = port
+        self.redirect_port = redirect_port
+        self.log_path = log_path
+        self.prefix = prefix
+        self.plane_id = self.lib.swfp_start(
+            bind_ip.encode(), port, redirect_port, volume_plane_id,
+            log_path.encode(), prefix.encode(), max_body)
+        if self.plane_id <= 0:
+            raise OSError(
+                f"native filer plane failed to start: {self.plane_id}")
+
+    def stop(self) -> None:
+        if self.plane_id > 0:
+            self.lib.swfp_stop(self.plane_id)
+            self.plane_id = 0
+
+    def add_lease(self, vid: int, base_key: int, cookie: int,
+                  count: int) -> None:
+        rc = self.lib.swfp_add_lease(self.plane_id, vid, base_key, cookie,
+                                     count)
+        if rc != 0:
+            raise OSError(f"add_lease: {rc}")
+
+    def lease_remaining(self) -> int:
+        return int(self.lib.swfp_lease_remaining(self.plane_id))
+
+    def invalidate(self, path: str) -> None:
+        self.lib.swfp_invalidate(self.plane_id, path.encode())
+
+    def invalidate_prefix(self, path: str) -> None:
+        self.lib.swfp_invalidate_prefix(self.plane_id, path.encode())
+
+    def stats(self) -> dict:
+        vals = [ctypes.c_uint64() for _ in range(4)]
+        self.lib.swfp_stats(self.plane_id, *(ctypes.byref(v) for v in vals))
+        return {"requests": vals[0].value, "native_puts": vals[1].value,
+                "native_gets": vals[2].value, "redirects": vals[3].value}

@@ -43,9 +43,11 @@ class FilerServer:
                  master: str = "localhost:9333", store_dir: str = "",
                  store: str = "sqlite", collection: str = "",
                  replication: str = "", chunk_size: int = CHUNK_SIZE,
-                 peers: list[str] | None = None, filer_group: str = ""):
+                 peers: list[str] | None = None, filer_group: str = "",
+                 native_volume_plane=None):
         self.ip = ip
         self.port = port
+        self.store_dir = store_dir
         self.grpc_port = rpc.derived_grpc_port(port)
         self.master = master
         self.collection = collection
@@ -91,6 +93,18 @@ class FilerServer:
         self._announce_stop = threading.Event()
         self._announce_thread: threading.Thread | None = None
         self._subscribed_peers: set[str] = set()
+        # native filer hot plane (C++ PUT/GET of whole objects under
+        # /buckets/ straight off the CO-LOCATED volume plane — `weed
+        # server` wires its volume plane in here). See the design note in
+        # native/dataplane.cpp "filer hot plane".
+        self._vol_plane = native_volume_plane
+        self.hot_plane = None
+        self.admin_port = port  # public port when no hot plane
+        self._hot_lock = threading.Lock()
+        self._hot_mark = 0
+        self._hot_absorbing = False
+        self._hot_stop = threading.Event()
+        self._hot_threads: list[threading.Thread] = []
 
     def _start_aggregator(self) -> None:
         if not self._peers and not self.filer_group:
@@ -154,16 +168,32 @@ class FilerServer:
         rpc.add_servicer(self._grpc_server, rpc.FILER_SERVICE, FilerGrpc(self))
         self._grpc_server.add_insecure_port(f"[::]:{self.grpc_port}")
         self._grpc_server.start()
+        http_port = self.port
+        if self._vol_plane is not None:
+            try:
+                http_port = self._start_hot_plane()
+            except Exception as e:
+                glog.warning(f"filer hot plane unavailable: {e}")
+                http_port = self.port
         self._http_server = TunedThreadingHTTPServer(
-            ("", self.port), _make_http_handler(self))
+            ("", http_port), _make_http_handler(self))
         threading.Thread(target=self._http_server.serve_forever,
                          daemon=True).start()
         self._start_aggregator()
         self._start_announce()
-        glog.info(f"filer started on {self.address} (grpc :{self.grpc_port})")
+        glog.info(f"filer started on {self.address} (grpc :{self.grpc_port})"
+                  + (f" (native hot plane, admin :{self.admin_port})"
+                     if self.hot_plane else ""))
 
     def stop(self) -> None:
         self._announce_stop.set()
+        self._hot_stop.set()
+        if self.hot_plane is not None:
+            self.hot_plane.stop()
+        for t in self._hot_threads:
+            t.join(timeout=5)
+        if self.hot_plane is not None:
+            self._absorb_hot_log()  # drain acknowledged PUTs to the store
         if self.meta_aggregator is not None:
             self.meta_aggregator.close()
         if self._http_server:
@@ -173,6 +203,160 @@ class FilerServer:
         if self.filer.meta_log is not None:
             self.filer.meta_log.close()
         self.filer.store.close()
+
+    # -- native hot plane --------------------------------------------------
+
+    def _hot_log_path(self) -> str:
+        import os
+
+        base = self.store_dir or "."
+        os.makedirs(base, exist_ok=True)
+        return os.path.join(base, "filer-hot.log")
+
+    def _start_hot_plane(self) -> int:
+        """Bind the C++ plane on the public port, move python to the admin
+        port. -> the port python should bind."""
+        import os
+
+        from ..native import NativeFilerPlane
+
+        log_path = self._hot_log_path()
+        # a previous run may have crashed with acknowledged-but-unabsorbed
+        # PUTs in the log: absorb them BEFORE truncating for the new plane
+        if os.path.exists(log_path) and os.path.getsize(log_path):
+            self._hot_mark = 0
+            self._absorb_hot_log(log_path=log_path)
+        open(log_path, "wb").close()
+        self._hot_mark = 0
+        self.admin_port = self.port + 11000
+        self.hot_plane = NativeFilerPlane(
+            "", self.port, self.admin_port,
+            self._vol_plane.plane_id, log_path,
+            max_body=min(self.chunk_size, 4 << 20))
+        self.filer.on_mutate = self._on_python_mutation
+        t1 = threading.Thread(target=self._lease_loop, daemon=True,
+                              name="filer-hot-leases")
+        t2 = threading.Thread(target=self._absorb_loop, daemon=True,
+                              name="filer-hot-absorber")
+        self._hot_threads = [t1, t2]
+        t1.start()
+        t2.start()
+        return self.admin_port
+
+    def _on_python_mutation(self, path: str, recursive: bool) -> None:
+        if self.hot_plane is None or self._hot_absorbing:
+            return  # absorption re-creates hot entries; keep their cache
+        if recursive:
+            self.hot_plane.invalidate_prefix(path)
+        else:
+            self.hot_plane.invalidate(path)
+
+    def _lease_loop(self) -> None:
+        """Keep the plane stocked with fid blocks (batched assigns)."""
+        from ..operation import assign
+        from ..storage.file_id import parse_file_id
+
+        low, batch = 16384, 8192
+        while not self._hot_stop.is_set():
+            try:
+                if self.hot_plane.lease_remaining() < low:
+                    a = assign(self.master, count=batch,
+                               collection=self.collection,
+                               replication=self.replication)
+                    if not a.error:
+                        fid = parse_file_id(a.fid)
+                        self.hot_plane.add_lease(
+                            fid.volume_id, fid.key, fid.cookie,
+                            max(1, int(a.count or batch)))
+                        continue  # refill until above the low-water mark
+            except Exception as e:
+                glog.v(1, f"hot lease refill: {e}")
+            self._hot_stop.wait(0.02)
+
+    def _absorb_loop(self) -> None:
+        while not self._hot_stop.is_set():
+            try:
+                self._absorb_hot_log()
+            except Exception as e:
+                glog.warning(f"hot log absorb: {e}")
+            self._hot_stop.wait(0.05)
+
+    def hot_sync(self) -> None:
+        """Absorb any pending hot-log records so metadata reads see every
+        acknowledged native PUT (read-your-writes across planes)."""
+        if self.hot_plane is not None:
+            self._absorb_hot_log()
+
+    def _absorb_hot_log(self, log_path: str | None = None) -> None:
+        """Tail the C++ plane's entry log into the real store (the
+        filer-side analogue of NeedleMap.catchup_from_idx). Emits the
+        normal metadata events at absorption time."""
+        import os
+        import struct as _struct
+
+        path = log_path or (self.hot_plane.log_path if self.hot_plane
+                            else None)
+        if path is None:
+            return
+        try:  # lock-free fast path: nothing new appended
+            if os.path.getsize(path) <= self._hot_mark:
+                return
+        except OSError:
+            return
+        with self._hot_lock:
+            try:
+                size = os.path.getsize(path)
+            except OSError:
+                return
+            if size <= self._hot_mark:
+                return
+            with open(path, "rb") as f:
+                f.seek(self._hot_mark)
+                buf = f.read(size - self._hot_mark)
+            HDR = 41
+            off = 0
+            self._hot_absorbing = True
+            try:
+                while off + HDR <= len(buf):
+                    (op, plen, mlen, vid, key, cookie, fsize, crc,
+                     mtime_ns) = _struct.unpack_from("<BHHIQIQIQ", buf, off)
+                    end = off + HDR + plen + mlen
+                    if op != 1 or end > len(buf):
+                        break  # torn tail: wait for the rest
+                    p = buf[off + HDR:off + HDR + plen].decode(
+                        errors="replace")
+                    mime = buf[off + HDR + plen:end].decode(errors="replace")
+                    self._absorb_one(p, vid, key, cookie, fsize, crc,
+                                     mtime_ns, mime)
+                    off = end
+            finally:
+                self._hot_absorbing = False
+            self._hot_mark += off
+
+    def _absorb_one(self, path: str, vid: int, key: int, cookie: int,
+                    fsize: int, crc: int, mtime_ns: int, mime: str) -> None:
+        from ..storage.file_id import FileId
+
+        fid = str(FileId(vid, key, cookie))
+        old_fids: list[str] = []
+        try:
+            old = self.filer.find_entry(path)
+            old_fids = [c.file_id for c in old.chunks]
+        except NotFound:
+            pass
+        chunk = filer_pb2.FileChunk(
+            file_id=fid, size=fsize, modified_ts_ns=mtime_ns,
+            e_tag=f"{crc & 0xFFFFFFFF:08x}")
+        entry = Entry(
+            full_path=normalize(path),
+            attr=Attr(mtime=mtime_ns // 1_000_000_000,
+                      crtime=mtime_ns // 1_000_000_000,
+                      mode=0o660, mime=mime),
+            chunks=[chunk],
+        )
+        self.filer.create_entry(entry)
+        if old_fids and old_fids != [fid]:
+            self._gc_chunks(old_fids)
 
     # -- chunk IO ----------------------------------------------------------
 
@@ -418,6 +602,7 @@ class FilerGrpc:
         self.filer = srv.filer
 
     def LookupDirectoryEntry(self, request, context):
+        self.srv.hot_sync()
         try:
             e = self.filer.find_entry(
                 request.directory.rstrip("/") + "/" + request.name)
@@ -426,6 +611,7 @@ class FilerGrpc:
         return filer_pb2.LookupDirectoryEntryResponse(entry=e.to_pb())
 
     def ListEntries(self, request, context):
+        self.srv.hot_sync()
         limit = request.limit or 1024
         for e in self.filer.list_entries(
                 request.directory, request.start_from_file_name,
@@ -433,6 +619,7 @@ class FilerGrpc:
             yield filer_pb2.ListEntriesResponse(entry=e.to_pb())
 
     def CreateEntry(self, request, context):
+        self.srv.hot_sync()
         e = Entry.from_pb(request.directory, request.entry)
         try:
             self.filer.create_entry(
@@ -444,6 +631,7 @@ class FilerGrpc:
         return filer_pb2.CreateEntryResponse()
 
     def UpdateEntry(self, request, context):
+        self.srv.hot_sync()
         e = Entry.from_pb(request.directory, request.entry)
         try:
             self.filer.update_entry(
@@ -453,6 +641,7 @@ class FilerGrpc:
         return filer_pb2.UpdateEntryResponse()
 
     def AppendToEntry(self, request, context):
+        self.srv.hot_sync()
         path = request.directory.rstrip("/") + "/" + request.entry_name
         try:
             e = self.filer.find_entry(path)
@@ -470,6 +659,7 @@ class FilerGrpc:
         return filer_pb2.AppendToEntryResponse()
 
     def DeleteEntry(self, request, context):
+        self.srv.hot_sync()
         path = request.directory.rstrip("/") + "/" + request.name
         try:
             fids = self.filer.delete_entry(
@@ -485,6 +675,7 @@ class FilerGrpc:
         return filer_pb2.DeleteEntryResponse()
 
     def AtomicRenameEntry(self, request, context):
+        self.srv.hot_sync()
         try:
             self.filer.rename(
                 request.old_directory.rstrip("/") + "/" + request.old_name,
@@ -666,6 +857,7 @@ def _make_http_handler(srv: FilerServer):
                                    "text/plain; version=0.0.4")
             if path == "/healthz":
                 return self._json({"ok": True})
+            srv.hot_sync()  # see native PUTs not yet absorbed
             with FILER_REQUEST_HISTOGRAM.time(type="read"):
                 try:
                     entry = srv.filer.find_entry(path)
@@ -732,6 +924,7 @@ def _make_http_handler(srv: FilerServer):
 
         def do_PUT(self):
             path, q = self._path_q()
+            srv.hot_sync()  # ordering: older hot records absorb first
             with FILER_REQUEST_HISTOGRAM.time(type="write"):
                 chunked = "chunked" in (
                     self.headers.get("Transfer-Encoding") or "").lower()
@@ -773,6 +966,7 @@ def _make_http_handler(srv: FilerServer):
 
         def do_DELETE(self):
             path, q = self._path_q()
+            srv.hot_sync()
             recursive = q.get("recursive") == "true"
             try:
                 fids = srv.filer.delete_entry(path, recursive=recursive)

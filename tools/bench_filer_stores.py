@@ -1,0 +1,149 @@
+"""Filer-store micro-benchmark at scale (VERDICT r2 next-step #8).
+
+Drives the FilerStore SPI directly — insert N entries (D dirs x N/D
+files), point lookups, full paged listing of one large directory, rename
+(delete+insert move the way filer.rename does per entry), delete — for
+the on-disk stores, and writes STORE_BENCH.json at the repo root.
+
+Usage: python tools/bench_filer_stores.py [-n 1000000] [--stores leveldb,sqlite]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from seaweedfs_tpu.filer.entry import Entry  # noqa: E402
+
+
+def make_store(kind: str, workdir: str):
+    if kind == "leveldb":
+        from seaweedfs_tpu.filer.stores.leveldb import LevelDbStore
+
+        return LevelDbStore(os.path.join(workdir, "ldb"))
+    if kind == "sqlite":
+        from seaweedfs_tpu.filer.stores.sqlite import SqliteStore
+
+        return SqliteStore(os.path.join(workdir, "filer.db"))
+    if kind == "memory":
+        from seaweedfs_tpu.filer.stores.memory import MemoryStore
+
+        return MemoryStore()
+    raise ValueError(kind)
+
+
+def entry_for(d: int, i: int) -> Entry:
+    e = Entry(f"/bench/d{d:04d}/f{i:06d}")
+    e.attr.file_size = 1024 + i
+    e.attr.mtime = 1700000000 + i
+    e.attr.mode = 0o644
+    return e
+
+
+def bench_store(kind: str, n: int, dirs: int, big_dir_files: int) -> dict:
+    out: dict = {"store": kind, "entries": n}
+    with tempfile.TemporaryDirectory() as workdir:
+        st = make_store(kind, workdir)
+        per_dir = n // dirs
+
+        t0 = time.perf_counter()
+        for d in range(dirs):
+            for i in range(per_dir):
+                st.insert_entry(entry_for(d, i))
+        # one oversized directory for the listing test
+        for i in range(big_dir_files):
+            e = Entry(f"/bench/big/f{i:06d}")
+            e.attr.file_size = i
+            st.insert_entry(e)
+        dt = time.perf_counter() - t0
+        total = n + big_dir_files
+        out["insert_per_sec"] = round(total / dt, 1)
+        out["insert_s"] = round(dt, 2)
+
+        # point lookups, spread over the keyspace
+        t0 = time.perf_counter()
+        hits = 0
+        lookups = 20_000
+        for j in range(lookups):
+            d, i = j % dirs, (j * 7919) % per_dir
+            hits += st.find_entry(f"/bench/d{d:04d}/f{i:06d}") is not None
+        dt = time.perf_counter() - t0
+        assert hits == lookups, hits
+        out["lookup_per_sec"] = round(lookups / dt, 1)
+
+        # full paged listing of the big directory (filer-style pages)
+        t0 = time.perf_counter()
+        seen = 0
+        last = ""
+        while True:
+            page = list(st.list_directory_entries(
+                "/bench/big", start_file_name=last, include_start=False,
+                limit=1024))
+            if not page:
+                break
+            seen += len(page)
+            last = page[-1].name
+        dt = time.perf_counter() - t0
+        assert seen == big_dir_files, seen
+        out["list_big_dir_s"] = round(dt, 3)
+        out["list_entries_per_sec"] = round(big_dir_files / dt, 1)
+
+        # rename = delete+insert per entry (filer.rename's per-entry move)
+        import dataclasses
+
+        t0 = time.perf_counter()
+        renames = min(10_000, per_dir)
+        for i in range(renames):
+            old = st.find_entry(f"/bench/d0000/f{i:06d}")
+            ne = Entry(f"/bench/renamed/f{i:06d}",
+                       attr=dataclasses.replace(old.attr))
+            st.insert_entry(ne)
+            st.delete_entry(old.full_path)
+        dt = time.perf_counter() - t0
+        out["rename_per_sec"] = round(renames / dt, 1)
+
+        # deletes
+        t0 = time.perf_counter()
+        deletes = min(20_000, per_dir)
+        for i in range(deletes):
+            st.delete_entry(f"/bench/d0001/f{i:06d}")
+        dt = time.perf_counter() - t0
+        out["delete_per_sec"] = round(deletes / dt, 1)
+
+        if hasattr(st, "close"):
+            st.close()
+    return out
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("-n", type=int, default=1_000_000)
+    ap.add_argument("--dirs", type=int, default=1000)
+    ap.add_argument("--big-dir-files", type=int, default=100_000)
+    ap.add_argument("--stores", default="leveldb,sqlite")
+    ap.add_argument("-o", default=os.path.join(REPO, "STORE_BENCH.json"))
+    args = ap.parse_args()
+
+    results = []
+    for kind in args.stores.split(","):
+        print(f"== {kind}: {args.n} entries ==", flush=True)
+        r = bench_store(kind, args.n, args.dirs, args.big_dir_files)
+        print(json.dumps(r), flush=True)
+        results.append(r)
+    with open(args.o, "w") as f:
+        json.dump({"timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                                              time.gmtime()),
+                   "results": results}, f, indent=1)
+    print(f"wrote {args.o}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
