@@ -49,7 +49,11 @@ def run_backup(opts) -> int:
                                      else status.idx_file_size)),
                         timeout=3600):
                     f.write(resp.file_content)
-        types.write_stride_marker(base)
+        # backed-up bytes carry the SOURCE's offset width — mirror its
+        # marker rather than stamping local mode
+        from ..operation import sync_stride_marker
+
+        sync_stride_marker(stub, opts.volumeId, status.collection, base)
         print(f"full backup of volume {opts.volumeId}: "
               f"{os.path.getsize(base + '.dat')} bytes")
         return 0

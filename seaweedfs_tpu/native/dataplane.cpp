@@ -387,6 +387,11 @@ struct Request {
   std::unordered_map<std::string, std::string> headers;  // lower-case keys
   std::vector<uint8_t> body;
   bool keepalive = true;
+  // body arrived as Transfer-Encoding: chunked and was consumed off the
+  // socket during parsing — the client CANNOT replay it after a 307
+  // (requests raises UnrewindableBodyError on generator bodies), so
+  // fall-back paths must proxy instead of redirect
+  bool chunked = false;
 
   std::string header(const std::string& k) const {
     auto it = headers.find(k);
@@ -419,6 +424,64 @@ bool read_exact(int fd, std::string& buf, size_t upto,
     return false;
   }
   return true;
+}
+
+// Decode a chunked body starting at buf[body_start] (RFC 7230 §4.1),
+// pulling more bytes from fd as needed. On success req->body holds the
+// decoded payload and buf is trimmed past the final CRLF. Returns 0 ok,
+// -1 connection lost, -2 bad framing. The python servers accept chunked
+// uploads (server/filer.py _ChunkedReader), so the native planes must
+// too — requests sends generator bodies this way (the S3 gateway's
+// streaming unsigned PUT path).
+int read_chunked(int fd, std::string& buf, size_t body_start, Request* req,
+                 const std::atomic<bool>& stop) {
+  req->body.clear();
+  size_t pos = body_start;
+  for (;;) {
+    size_t eol;
+    while ((eol = buf.find("\r\n", pos)) == std::string::npos) {
+      if (buf.size() - pos > 1024) return -2;  // absurd chunk-size line
+      if (!read_exact(fd, buf, buf.size() + 1, stop)) return -1;
+    }
+    std::string szline = buf.substr(pos, eol - pos);
+    size_t semi = szline.find(';');  // drop chunk extensions
+    if (semi != std::string::npos) szline.resize(semi);
+    char* endp = nullptr;
+    errno = 0;
+    unsigned long long csz = strtoull(szline.c_str(), &endp, 16);
+    if (endp == szline.c_str() || errno == ERANGE) return -2;
+    // bound csz FIRST: body.size()+csz could wrap uint64 and a wrapped
+    // data_start+csz would make read_exact trivially "succeed"
+    if (csz > 256ull * 1024 * 1024 ||
+        req->body.size() + csz > 256ull * 1024 * 1024)
+      return -2;
+    size_t data_start = eol + 2;
+    if (csz == 0) {
+      // optional trailers, then a blank line
+      pos = data_start;
+      for (;;) {
+        size_t teol;
+        while ((teol = buf.find("\r\n", pos)) == std::string::npos) {
+          if (buf.size() - pos > 64 * 1024) return -2;
+          if (!read_exact(fd, buf, buf.size() + 1, stop)) return -1;
+        }
+        bool blank = teol == pos;
+        pos = teol + 2;
+        if (blank) break;
+      }
+      buf.erase(0, pos);
+      return 0;
+    }
+    if (!read_exact(fd, buf, data_start + csz + 2, stop)) return -1;
+    req->body.insert(req->body.end(), buf.begin() + data_start,
+                     buf.begin() + data_start + csz);
+    if (buf.compare(data_start + csz, 2, "\r\n") != 0) return -2;
+    pos = data_start + csz + 2;
+    if (pos > (1u << 20)) {  // bound the staging buffer
+      buf.erase(0, pos);
+      pos = 0;
+    }
+  }
 }
 
 // Read one HTTP request. Returns 0 ok, -1 connection done, -2 bad request.
@@ -477,7 +540,14 @@ int read_request(int fd, std::string& buf, Request* req,
   std::string cl = req->header("content-length");
   if (!cl.empty()) clen = (size_t)strtoull(cl.c_str(), nullptr, 10);
   if (clen > 256u * 1024 * 1024) return -2;
-  if (!req->header("transfer-encoding").empty()) return -2;
+  std::string te = req->header("transfer-encoding");
+  req->chunked = false;
+  if (!te.empty()) {
+    for (auto& c : te) c = (char)tolower((unsigned char)c);
+    if (te != "chunked") return -2;  // gzip/deflate TE: not supported
+    req->chunked = true;
+    return read_chunked(fd, buf, body_start, req, stop);
+  }
   if (!read_exact(fd, buf, body_start + clen, stop)) return -1;
   req->body.assign(buf.begin() + body_start, buf.begin() + body_start + clen);
   buf.erase(0, body_start + clen);
@@ -542,7 +612,53 @@ void respond_json(int fd, const Request& req, int code,
           json.size());
 }
 
+// Forward an already-parsed request to the python server on loopback
+// with Content-Length framing and relay the response verbatim. Used for
+// chunked-TE requests, whose body the client cannot re-send after a 307.
+void proxy_to_python(int fd, const Request& req, int backend_port) {
+  int b = socket(AF_INET, SOCK_STREAM, 0);
+  struct sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)backend_port);
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (b < 0 || connect(b, (struct sockaddr*)&addr, sizeof addr) != 0) {
+    if (b >= 0) close(b);
+    return respond_json(fd, req, 500,
+                        "{\"error\":\"python backend unreachable\"}");
+  }
+  struct timeval tv{60, 0};  // python writes can take a while
+  setsockopt(b, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof tv);
+  std::string out = req.method + " " + req.path +
+                    (req.query.empty() ? "" : "?" + req.query) +
+                    " HTTP/1.1\r\n";
+  for (const auto& kv : req.headers) {
+    if (kv.first == "transfer-encoding" || kv.first == "content-length" ||
+        kv.first == "connection" || kv.first == "expect")
+      continue;
+    out += kv.first + ": " + kv.second + "\r\n";
+  }
+  out += "Content-Length: " + std::to_string(req.body.size()) + "\r\n";
+  out += "Connection: close\r\n\r\n";
+  send_all(b, out.data(), out.size());
+  if (!req.body.empty()) send_all(b, req.body.data(), req.body.size());
+  // relay until the backend closes (it honors Connection: close); the
+  // relayed headers carry that close, so the client re-opens cleanly
+  char tmp[16384];
+  for (;;) {
+    ssize_t n = recv(b, tmp, sizeof tmp, 0);
+    if (n > 0) {
+      send_all(fd, tmp, (size_t)n);
+      continue;
+    }
+    if (n < 0 && errno == EINTR) continue;
+    break;  // 0 = done; timeout/error = give up (client sees truncation)
+  }
+  close(b);
+}
+
 void redirect(int fd, const Request& req, int redirect_port) {
+  if (req.chunked)  // consumed body is not replayable: forward instead
+    return proxy_to_python(fd, req, redirect_port);
   std::string host = req.header("host");
   size_t colon = host.rfind(':');
   if (colon != std::string::npos) host = host.substr(0, colon);
@@ -973,6 +1089,10 @@ struct FilerPlane {
   std::deque<FidLease> leases;
   uint64_t lease_remaining = 0;
   int log_fd = -1;
+  // set when a hot-log append failed (disk full / IO error): acked PUTs
+  // could no longer be made durable, so the fast path stands down and
+  // every PUT defers to the python filer until restart
+  bool log_failed = false;
 
   ~FilerPlane() {
     if (log_fd >= 0) close(log_fd);
@@ -1002,9 +1122,12 @@ void put_le64(uint8_t* p, uint64_t v) {
   for (int i = 0; i < 8; i++) p[i] = (v >> (8 * i)) & 0xFF;
 }
 
-void hotlog_append(FilerPlane& fp, const std::string& path,
+// Append one record; caller holds fp.mu. Returns false when the record
+// could not be made fully durable — the caller must NOT ack the PUT
+// (the acked entry would vanish on restart) and the plane stands down.
+bool hotlog_append(FilerPlane& fp, const std::string& path,
                    const HotEntry& e) {
-  if (fp.log_fd < 0) return;
+  if (fp.log_fd < 0 || fp.log_failed) return false;
   std::vector<uint8_t> rec(kHotHdr + path.size() + e.mime.size());
   uint8_t* p = rec.data();
   p[0] = 1;
@@ -1020,8 +1143,16 @@ void hotlog_append(FilerPlane& fp, const std::string& path,
   memcpy(p + kHotHdr + path.size(), e.mime.data(), e.mime.size());
   // single write() so the python tailer never sees a torn record except
   // at a crash boundary (where it stops at the last complete record)
+  off_t pre = lseek(fp.log_fd, 0, SEEK_CUR);
   ssize_t w = write(fp.log_fd, rec.data(), rec.size());
-  (void)w;
+  if (w == (ssize_t)rec.size()) return true;
+  // failed or short (disk full): remove the torn tail so the absorber
+  // never stalls on it, and disable the fast path for good measure
+  if (pre >= 0) {
+    if (ftruncate(fp.log_fd, pre) == 0) lseek(fp.log_fd, pre, SEEK_SET);
+  }
+  fp.log_failed = true;
+  return false;
 }
 
 std::string json_escape(const std::string& s) {
@@ -1038,15 +1169,22 @@ std::string json_escape(const std::string& s) {
   return out;
 }
 
-void handle_filer_put(FilerPlane& fp, int fd, const Request& req) {
+void handle_filer_put(FilerPlane& fp, int fd, const Request& req,
+                      const std::string& path) {
   if (!req.query.empty() || req.body.size() > fp.max_body)
     return fp.redirects++, redirect(fd, req, fp.redirect_port);
   std::string ct = req.header("content-type");
   if (ct.rfind("multipart/", 0) == 0 || ct.size() >= 256 ||
       !req.header("content-encoding").empty())
     return fp.redirects++, redirect(fd, req, fp.redirect_port);
-  const std::string& path = req.path;
-  if (path.size() >= 4096 || path.back() == '/')
+  if (path.empty() || path.size() >= 4096 || path.back() == '/')
+    return fp.redirects++, redirect(fd, req, fp.redirect_port);
+  bool log_down;
+  {
+    std::lock_guard<std::mutex> l(fp.mu);
+    log_down = fp.log_failed;
+  }
+  if (log_down)  // can't make metadata durable: python owns PUTs
     return fp.redirects++, redirect(fd, req, fp.redirect_port);
 
   // mint a fid from the leased blocks; a dry pool briefly waits for the
@@ -1115,13 +1253,21 @@ void handle_filer_put(FilerPlane& fp, int fd, const Request& req) {
   off += 4;
   int64_t ns_off = vol->version == 3 ? off : -1;
   uint64_t ns = 0;
+  // socket writes (redirect/respond) happen OUTSIDE vol->mu: a slow
+  // client must not stall the volume's whole IO (cf. handle_put's
+  // goto-frozen structure)
+  int append_rc = 1;  // 1 frozen, 0 ok, -1 failed
   {
     std::lock_guard<std::mutex> l(vol->mu);
-    if (!vol->writable)
-      return fp.redirects++, redirect(fd, req, fp.redirect_port);
-    if (vol->append(blob.data(), total, key, size, ns_off, &ns) < 0)
-      return respond_json(fd, req, 500, "{\"error\":\"append failed\"}");
+    if (vol->writable)
+      append_rc =
+          vol->append(blob.data(), total, key, size, ns_off, &ns) < 0 ? -1
+                                                                      : 0;
   }
+  if (append_rc > 0)
+    return fp.redirects++, redirect(fd, req, fp.redirect_port);
+  if (append_rc < 0)
+    return respond_json(fd, req, 500, "{\"error\":\"append failed\"}");
   if (!ns) ns = now_secs * 1000000000ull;
 
   HotEntry e;
@@ -1132,10 +1278,16 @@ void handle_filer_put(FilerPlane& fp, int fd, const Request& req) {
   e.crc = crc;
   e.mtime_ns = ns;
   e.mime = ct;
+  bool logged;
   {
     std::lock_guard<std::mutex> l(fp.mu);
-    hotlog_append(fp, path, e);
-    fp.map[path] = std::move(e);
+    logged = hotlog_append(fp, path, e);
+    if (logged) fp.map[path] = std::move(e);
+  }
+  if (!logged) {
+    // never ack what the restart path can't recover; the needle becomes
+    // an unreferenced orphan (vacuum reclaims it)
+    return respond_json(fd, req, 500, "{\"error\":\"hot log write failed\"}");
   }
   fp.native_puts++;
   std::string name = path.substr(path.rfind('/') + 1);
@@ -1144,14 +1296,15 @@ void handle_filer_put(FilerPlane& fp, int fd, const Request& req) {
   respond_json(fd, req, 201, out);
 }
 
-void handle_filer_get(FilerPlane& fp, int fd, const Request& req) {
+void handle_filer_get(FilerPlane& fp, int fd, const Request& req,
+                      const std::string& path) {
   if (!req.query.empty() || !req.header("range").empty() ||
       !req.header("if-modified-since").empty())
     return fp.redirects++, redirect(fd, req, fp.redirect_port);
   HotEntry e;
   {
     std::lock_guard<std::mutex> l(fp.mu);
-    auto it = fp.map.find(req.path);
+    auto it = fp.map.find(path);
     if (it == fp.map.end())
       return fp.redirects++, redirect(fd, req, fp.redirect_port);
     e = it->second;
@@ -1198,13 +1351,85 @@ void handle_filer_get(FilerPlane& fp, int fd, const Request& req) {
   respond(fd, req, 200, ctype, extra, n.data, n.data_len);
 }
 
+bool valid_utf8(const std::string& s) {
+  size_t i = 0;
+  while (i < s.size()) {
+    unsigned char c = s[i];
+    int follow;
+    unsigned cp_min;
+    if (c < 0x80) { i++; continue; }
+    else if ((c & 0xE0) == 0xC0) { follow = 1; cp_min = 0x80; }
+    else if ((c & 0xF0) == 0xE0) { follow = 2; cp_min = 0x800; }
+    else if ((c & 0xF8) == 0xF0) { follow = 3; cp_min = 0x10000; }
+    else return false;
+    if (i + follow >= s.size()) return false;
+    unsigned cp = c & (0x3F >> follow);
+    for (int k = 1; k <= follow; k++) {
+      unsigned char b = s[i + k];
+      if ((b & 0xC0) != 0x80) return false;
+      cp = (cp << 6) | (b & 0x3F);
+    }
+    if (cp < cp_min || cp > 0x10FFFF || (cp >= 0xD800 && cp <= 0xDFFF))
+      return false;
+    i += 1 + follow;
+  }
+  return true;
+}
+
+// Percent-decode a request path (RFC 3986; '+' stays literal, matching
+// python's urllib.parse.unquote used by server/filer.py). False on a
+// malformed escape OR a non-UTF8 result (escaped or raw) — the python
+// absorber decodes logged paths with errors="replace", so keying the
+// hot map by non-UTF8 bytes would diverge from the store path and
+// python-side deletes could never invalidate the entry; those requests
+// defer to python instead.
+bool url_decode(const std::string& in, std::string* out) {
+  out->clear();
+  out->reserve(in.size());
+  for (size_t i = 0; i < in.size(); i++) {
+    if (in[i] != '%') {
+      // raw bytes >= 0x80 decode as iso-8859-1 mojibake on the python
+      // side (BaseHTTPRequestHandler), and a literal ';' is stripped
+      // into urlparse's .params there — both canonicalize differently
+      // from a byte-for-byte key, so defer them
+      if ((unsigned char)in[i] >= 0x80 || in[i] == ';') return false;
+      out->push_back(in[i]);
+      continue;
+    }
+    if (i + 2 >= in.size() || !isxdigit((unsigned char)in[i + 1]) ||
+        !isxdigit((unsigned char)in[i + 2]))
+      return false;
+    auto hex = [](char c) {
+      return c <= '9' ? c - '0' : (c | 0x20) - 'a' + 10;
+    };
+    out->push_back((char)(hex(in[i + 1]) * 16 + hex(in[i + 2])));
+    i += 2;
+  }
+  // C0 controls (esp. %00: swfp_invalidate takes NUL-terminated C
+  // strings, so a key containing NUL could never be invalidated) and
+  // non-UTF8 both defer to python
+  for (unsigned char c : *out)
+    if (c < 0x20) return false;
+  return valid_utf8(*out);
+}
+
 void handle_filer_request(FilerPlane& fp, int fd, const Request& req) {
   fp.requests.fetch_add(1, std::memory_order_relaxed);
-  if (req.path.rfind(fp.prefix, 0) == 0) {
+  // the python filer stores entries under the DECODED path
+  // (server/filer.py unquote); hot-map keys, log records and
+  // invalidations all use that same canonical form, so '/a%20b' and
+  // '/a b' hit one entry rather than corrupting two. Paths the python
+  // side would further normalize ('//' collapse, filer.py normalize)
+  // defer to python — a hot-map key diverging from the store path could
+  // never be invalidated.
+  std::string path;
+  if (url_decode(req.path, &path) &&
+      path.find("//") == std::string::npos &&
+      path.rfind(fp.prefix, 0) == 0) {
     if (req.method == "GET" || req.method == "HEAD")
-      return handle_filer_get(fp, fd, req);
+      return handle_filer_get(fp, fd, req, path);
     if (req.method == "PUT" || req.method == "POST")
-      return handle_filer_put(fp, fd, req);
+      return handle_filer_put(fp, fd, req, path);
   }
   fp.redirects++;
   redirect(fd, req, fp.redirect_port);
@@ -1627,6 +1852,18 @@ uint64_t swfp_lease_remaining(int id) {
   if (!fp) return 0;
   std::lock_guard<std::mutex> l(fp->mu);
   return fp->lease_remaining;
+}
+
+// Stand the fast path down: stop acking native PUTs (they redirect to
+// python instead). Called when the python absorber detects hot-log
+// corruption — acking writes whose metadata can never be absorbed would
+// silently lose them.
+int swfp_disable_log(int id) {
+  auto fp = fplane_of(id);
+  if (!fp) return -ENOENT;
+  std::lock_guard<std::mutex> l(fp->mu);
+  fp->log_failed = true;
+  return 0;
 }
 
 // Drop a path from the hot map (python-side mutation: delete, rename,

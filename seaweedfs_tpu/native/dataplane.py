@@ -98,6 +98,8 @@ def load_library() -> ctypes.CDLL:
         lib.swfp_stats.argtypes = [ctypes.c_int] + \
             [ctypes.POINTER(ctypes.c_uint64)] * 4
         lib.swfp_stats.restype = ctypes.c_int
+        lib.swfp_disable_log.argtypes = [ctypes.c_int]
+        lib.swfp_disable_log.restype = ctypes.c_int
         _lib = lib
         return _lib
 
@@ -248,6 +250,11 @@ class NativeFilerPlane:
 
     def lease_remaining(self) -> int:
         return int(self.lib.swfp_lease_remaining(self.plane_id))
+
+    def disable_log(self) -> None:
+        """Stop acking native PUTs (redirect them to python) — used when
+        the absorber can no longer make hot-log metadata durable."""
+        self.lib.swfp_disable_log(self.plane_id)
 
     def invalidate(self, path: str) -> None:
         self.lib.swfp_invalidate(self.plane_id, path.encode())

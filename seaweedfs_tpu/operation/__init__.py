@@ -111,6 +111,38 @@ def _compressible(mime: str) -> bool:
     return mime in ("", "application/octet-stream")
 
 
+def sync_stride_marker(stub, volume_id: int, collection: str, base: str,
+                       ext: str = ".lrg", is_ec: bool = False) -> None:
+    """Mirror the SOURCE's stride-marker file next to freshly copied
+    volume/EC index bytes (volume copy, backup, EC shard copy).
+
+    Raw-byte copies carry the source's offset width, so the local marker
+    must reflect the source, not this process's mode — stamping local
+    mode at a copy site would make the open-time stride guards
+    (storage/volume.py, storage/ec_files.py check_ecx_stride) a
+    tautology and let a cross-mode copy misparse silently."""
+    import os
+
+    import grpc
+
+    from ..pb import volume_server_pb2 as vs
+
+    try:
+        for _ in stub.CopyFile(vs.CopyFileRequest(
+                volume_id=volume_id, ext=ext, collection=collection,
+                is_ec_volume=is_ec), timeout=60):
+            pass
+        with open(base + ext, "wb"):
+            pass
+    except grpc.RpcError as e:
+        if e.code() != grpc.StatusCode.NOT_FOUND:
+            raise
+        try:
+            os.remove(base + ext)
+        except FileNotFoundError:
+            pass
+
+
 def delete_files(master: str, fids: list[str]) -> list[dict]:
     """Group fids by volume location and fan out BatchDelete RPCs
     (delete_content.go DeleteFilesAtOneVolumeServer)."""

@@ -102,7 +102,11 @@ class FilerServer:
         self.admin_port = port  # public port when no hot plane
         self._hot_lock = threading.Lock()
         self._hot_mark = 0
-        self._hot_absorbing = False
+        # call-stack-scoped (NOT process-global): a genuine mutation on
+        # another thread must still invalidate the hot map while the
+        # absorber thread replays log records through create_entry
+        self._hot_absorbing = threading.local()
+        self._hot_log_corrupt = False
         self._hot_stop = threading.Event()
         self._hot_threads: list[threading.Thread] = []
 
@@ -226,8 +230,16 @@ class FilerServer:
         if os.path.exists(log_path) and os.path.getsize(log_path):
             self._hot_mark = 0
             self._absorb_hot_log(log_path=log_path)
+            if self._hot_log_corrupt:
+                # records past the corruption point were never absorbed:
+                # truncating would silently discard acked writes. Keep
+                # the bytes for forensics/manual recovery.
+                aside = log_path + ".corrupt"
+                os.replace(log_path, aside)
+                glog.error(f"corrupt hot log preserved at {aside}")
         open(log_path, "wb").close()
         self._hot_mark = 0
+        self._hot_log_corrupt = False  # fresh log: clear any replay alarm
         self.admin_port = self.port + 11000
         self.hot_plane = NativeFilerPlane(
             "", self.port, self.admin_port,
@@ -244,7 +256,8 @@ class FilerServer:
         return self.admin_port
 
     def _on_python_mutation(self, path: str, recursive: bool) -> None:
-        if self.hot_plane is None or self._hot_absorbing:
+        if self.hot_plane is None or getattr(self._hot_absorbing, "active",
+                                             False):
             return  # absorption re-creates hot entries; keep their cache
         if recursive:
             self.hot_plane.invalidate_prefix(path)
@@ -296,8 +309,9 @@ class FilerServer:
 
         path = log_path or (self.hot_plane.log_path if self.hot_plane
                             else None)
-        if path is None:
-            return
+        if path is None or self._hot_log_corrupt:
+            return  # corrupt: halted (and the plane stood down) — don't
+            #         keep re-reading an ever-growing tail every poll
         try:  # lock-free fast path: nothing new appended
             if os.path.getsize(path) <= self._hot_mark:
                 return
@@ -313,15 +327,36 @@ class FilerServer:
             with open(path, "rb") as f:
                 f.seek(self._hot_mark)
                 buf = f.read(size - self._hot_mark)
+            if self._hot_log_corrupt:
+                return
             HDR = 41
             off = 0
-            self._hot_absorbing = True
+            self._hot_absorbing.active = True
             try:
                 while off + HDR <= len(buf):
                     (op, plen, mlen, vid, key, cookie, fsize, crc,
                      mtime_ns) = _struct.unpack_from("<BHHIQIQIQ", buf, off)
+                    # the C++ writer enforces plen < 4096 and mlen < 256,
+                    # so out-of-range lengths are corruption (not a torn
+                    # tail) — without this, a garbage length would stall
+                    # absorption forever while PUTs keep being acked
+                    if op != 1 or plen >= 4096 or mlen >= 256:
+                        # full header available with a bad op byte is NOT
+                        # a torn tail (the C++ plane truncates failed
+                        # writes): the log itself is corrupt. Alarm and
+                        # stand the plane down — it must stop ACKING PUTs
+                        # whose metadata can never be absorbed.
+                        self._hot_log_corrupt = True
+                        if self.hot_plane is not None:
+                            self.hot_plane.disable_log()
+                        glog.error(
+                            f"hot log corrupt at offset "
+                            f"{self._hot_mark + off} (op={op}); absorption "
+                            f"halted and native PUTs disabled — restart "
+                            f"the filer to resync")
+                        break
                     end = off + HDR + plen + mlen
-                    if op != 1 or end > len(buf):
+                    if end > len(buf):
                         break  # torn tail: wait for the rest
                     p = buf[off + HDR:off + HDR + plen].decode(
                         errors="replace")
@@ -330,7 +365,7 @@ class FilerServer:
                                      mtime_ns, mime)
                     off = end
             finally:
-                self._hot_absorbing = False
+                self._hot_absorbing.active = False
             self._hot_mark += off
 
     def _absorb_one(self, path: str, vid: int, key: int, cookie: int,

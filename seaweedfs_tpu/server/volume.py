@@ -642,7 +642,11 @@ class VolumeGrpc:
                     f.write(chunk.file_content)
                     total += len(chunk.file_content)
             yield vs.VolumeCopyResponse(processed_bytes=total)
-        types.write_stride_marker(base)
+        # the copied bytes carry the SOURCE's offset width — mirror its
+        # marker rather than stamping local mode (operation docstring)
+        from ..operation import sync_stride_marker
+
+        sync_stride_marker(src, vid, status.collection, base)
         self.store.mount_volume(vid)
         self.srv.trigger_heartbeat()
         v = self.store.find_volume(vid)
@@ -833,6 +837,14 @@ class VolumeGrpc:
                     f.write(chunk.file_content)
             if ext == ".ecj" and os.path.getsize(base + ext) == 0:
                 os.remove(base + ext)
+            if ext == ".ecx":
+                # the per-index stride marker travels WITH the .ecx: the
+                # SOURCE's offset width decides how its entries parse
+                from ..operation import sync_stride_marker
+
+                sync_stride_marker(src, request.volume_id,
+                                   request.collection, base,
+                                   ext=".ecx.lrg", is_ec=True)
         return vs.VolumeEcShardsCopyResponse()
 
     def VolumeEcShardsDelete(self, request, context):
@@ -850,7 +862,9 @@ class VolumeGrpc:
             geo = self._ec_geo(base)
             if not any(os.path.exists(base + f".ec{i:02d}")
                        for i in range(geo.total_shards)):
-                for ext in (".ecx", ".ecj", ".vif"):
+                # the per-index marker goes with its .ecx — a stale one
+                # would falsely refuse a later re-encode in the other mode
+                for ext in (".ecx", ".ecj", ".vif", ".ecx.lrg"):
                     try:
                         os.remove(base + ext)
                     except FileNotFoundError:

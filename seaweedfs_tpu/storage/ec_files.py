@@ -349,8 +349,44 @@ def write_ec_files(
     return generate_ec_files(base_file_name, coder, geo)
 
 
+def write_ecx_stride_marker(base_file_name: str) -> None:
+    """Sync the per-index `.ecx.lrg` marker to the active offset width.
+
+    EC index files carry their OWN marker, distinct from the volume's
+    `.lrg`: shards travel between servers independently of any .dat
+    volume sharing the base name, so one shared marker could describe
+    at most one of the two artifact families correctly."""
+    if types.large_disk():
+        with open(base_file_name + ".ecx.lrg", "wb"):
+            pass
+    else:
+        try:
+            os.remove(base_file_name + ".ecx.lrg")
+        except FileNotFoundError:
+            pass
+
+
+def check_ecx_stride(base_file_name: str) -> None:
+    """Refuse to parse a .ecx across an offset-width mismatch — the
+    size-modulus heuristic alone misses entry counts that are multiples
+    of both strides. Every .ecx-consuming path (EcVolume open, ec-decode)
+    must call this before reading entries."""
+    has_marker = os.path.exists(base_file_name + ".ecx.lrg")
+    if has_marker != types.large_disk():
+        raise IOError(
+            f"ec volume {base_file_name}: index stride mismatch — .ecx "
+            f"was written with {'5' if has_marker else '4'}-byte offsets "
+            f"but the process is in "
+            f"{'large-disk (5-byte)' if types.large_disk() else '4-byte'} "
+            f"mode; restart with the matching -largeDisk setting"
+        )
+
+
 def write_sorted_file_from_idx(base_file_name: str, ext: str = ".ecx") -> None:
     needle_map.write_sorted_file_from_idx(base_file_name, ext)
+    # .ecx entries use the active offset width: stamp the marker so the
+    # .ecx-consuming guards recognize it
+    write_ecx_stride_marker(base_file_name)
 
 
 def rebuild_ec_files(
@@ -456,6 +492,7 @@ def find_dat_file_size(
 ) -> int:
     """True .dat length = max(offset + actual_size) over live .ecx entries
     (FindDatFileSize, ec_decoder.go:48-70)."""
+    check_ecx_stride(base_file_name)
     dat_size = 0
     ids, offs, sizes = idx_mod.read_index_file(base_file_name + ".ecx")
     for i in range(len(ids)):
@@ -512,6 +549,7 @@ def write_idx_file_from_ec_index(base_file_name: str) -> None:
     """Reconstruct <base>.idx from .ecx + .ecj tombstones
     (WriteIdxFileFromEcIndex, ec_decoder.go:18-43): copy .ecx, then append a
     tombstone entry per journaled deletion."""
+    check_ecx_stride(base_file_name)  # .idx inherits the .ecx entry bytes
     ecx = base_file_name + ".ecx"
     with open(ecx, "rb") as f:
         payload = f.read()

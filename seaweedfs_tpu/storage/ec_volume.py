@@ -80,6 +80,10 @@ def mark_needle_deleted(ecx_file, entry_offset: int) -> None:
 def delete_needle_from_ecx(base_file_name: str, needle_id: int) -> None:
     """Tombstone the .ecx entry in place and append the id to the .ecj journal
     (DeleteNeedleFromEcx, ec_volume_delete.go:27-49). Missing needle is a no-op."""
+    from .ec_files import check_ecx_stride
+
+    check_ecx_stride(base_file_name)  # in-place writes at the wrong
+    #                                   stride would corrupt the index
     ecx_path = base_file_name + ".ecx"
     size = os.path.getsize(ecx_path)
     with open(ecx_path, "r+b") as f:
@@ -97,6 +101,9 @@ def rebuild_ecx_file(base_file_name: str) -> None:
     ecj_path = base_file_name + ".ecj"
     if not os.path.exists(ecj_path):
         return
+    from .ec_files import check_ecx_stride
+
+    check_ecx_stride(base_file_name)  # tombstone replay writes in place
     ecx_path = base_file_name + ".ecx"
     ecx_size = os.path.getsize(ecx_path)
     with open(ecx_path, "r+b") as ecx, open(ecj_path, "rb") as ecj:
@@ -146,6 +153,13 @@ class EcVolume:
         self.geo = geo
         self.version = version
         self.ecx_path = base_file_name + ".ecx"
+        # Offset-width (stride) guard, mirroring Volume.__init__: the
+        # size-modulus check below is only a heuristic (entry counts that
+        # are multiples of 17 pass a 4-byte read and vice versa), so EC
+        # opens enforce the per-index `.ecx.lrg` marker (ec_files.py).
+        from .ec_files import check_ecx_stride
+
+        check_ecx_stride(base_file_name)
         # unbuffered: in-place tombstoning writes through other handles must
         # be visible immediately (BufferedReader can serve stale bytes after
         # an intra-buffer seek)

@@ -52,13 +52,20 @@ def large_disk() -> bool:
 
 
 def write_stride_marker(base_file_name: str) -> None:
-    """Create the `.lrg` stride marker next to a volume's files when the
-    process is in large-disk mode. Every code path that materializes a
-    volume's .dat/.idx (create, copy, backup, ec-decode) must call this
-    so the open-time stride guard (storage/volume.py) recognizes the
-    files' offset width."""
+    """Sync the `.lrg` stride marker to the process's active offset
+    width. Every code path that materializes a volume's .dat/.idx/.ecx
+    (create, copy, backup, ec-generate, ec-decode) must call this so the
+    open-time stride guards (storage/volume.py, storage/ec_volume.py)
+    recognize the files' offset width. In 4-byte mode a STALE marker
+    from an earlier large-disk tenancy of the same base is removed —
+    leaving it would falsely refuse the freshly-written 4-byte files."""
     if large_disk():
         with open(base_file_name + ".lrg", "wb"):
+            pass
+    else:
+        try:
+            _os.remove(base_file_name + ".lrg")
+        except FileNotFoundError:
             pass
 
 

@@ -127,6 +127,43 @@ def test_stride_mismatch_refused(tmp_path):
         == b"keep me"
 
 
+def test_ec_stride_mismatch_refused(tmp_path):
+    """EC opens enforce the .lrg marker too: a 4-byte .ecx whose entry
+    count happens to be a multiple of 17 passes the modulus heuristic and
+    would be misparsed (round-3 ADVICE). ec-generate stamps the marker;
+    EcVolume.__init__ checks it."""
+    from seaweedfs_tpu.models.coder import new_coder
+    from seaweedfs_tpu.storage import ec_files
+    from seaweedfs_tpu.storage.ec_locate import Geometry
+    from seaweedfs_tpu.storage.ec_volume import EcVolume
+
+    geo = Geometry(data_shards=3, parity_shards=2,
+                   large_block=4096, small_block=256)
+    base = str(tmp_path / "9")
+    v = Volume(str(tmp_path) + os.sep, "", 9)
+    # 17 entries: the byte size (17*16=272 in 4-byte mode) is a multiple
+    # of BOTH strides, so only the marker can catch the mismatch
+    for i in range(1, 18):
+        v.write_needle(Needle.create(i, i, bytes([i]) * 64))
+    v.close()
+    coder = new_coder(3, 2, "cpu")
+    ec_files.generate_ec_files(base, coder, geo, batch_size=4096)
+    ec_files.write_sorted_file_from_idx(base)
+    assert os.path.getsize(base + ".ecx") % 17 == 0  # trap armed
+
+    ec = EcVolume(base, coder, geo)  # same mode: opens fine
+    ec.close()
+    types.set_large_disk(True)
+    try:
+        with pytest.raises(IOError, match="stride mismatch"):
+            EcVolume(base, coder, geo)
+    finally:
+        types.set_large_disk(False)
+    # the refusal destroyed nothing
+    ec = EcVolume(base, coder, geo)
+    ec.close()
+
+
 def test_4byte_volume_caps_at_32gb(tmp_path):
     """Without large_disk, an append past 32GB must be refused, not
     silently wrapped (volume.py append guard)."""

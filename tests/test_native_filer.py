@@ -1,0 +1,387 @@
+"""Native C++ filer hot plane trust suite (round-3 VERDICT item 3).
+
+Earns the filer plane the volume plane's level of trust: byte parity
+against the python filer on identical inputs, chunked Transfer-Encoding
+PUTs (the round-3 S3 streaming regression), percent-encoded path
+canonicalization (round-3 ADVICE high), python-mutation invalidation,
+SIGKILL-mid-hotlog crash durability, and metadata-event ordering for
+absorbed hot-plane writes.
+
+Reference behaviors:
+  weed/server/filer_server_handlers_write_autochunk.go:24 (chunked PUT)
+  weed/filer/filer_notify.go:20 (metadata events on every mutation)
+"""
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.native import native_available
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="native toolchain unavailable")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+@pytest.fixture(scope="module")
+def hot_cluster(tmp_path_factory):
+    """master + native volume plane + filer WITH the C++ hot plane."""
+    from seaweedfs_tpu.pb import rpc
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    from tests.test_cli_server import _pick_ports
+
+    mport, vport, fport = _pick_ports(3)
+    master = MasterServer(ip="localhost", port=mport, volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("hfvol"))],
+        master=f"localhost:{mport}", ip="localhost", port=vport,
+        native=True)
+    vsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    assert master.topo.nodes, "volume server did not register"
+    fs = FilerServer(ip="localhost", port=fport,
+                     master=f"localhost:{mport}",
+                     store_dir=str(tmp_path_factory.mktemp("hffiler")),
+                     native_volume_plane=vsrv.native_plane)
+    fs.start()
+    assert fs.hot_plane is not None, "hot plane did not start"
+    # native PUTs need a stocked fid lease pool
+    deadline = time.time() + 10
+    while time.time() < deadline and fs.hot_plane.lease_remaining() == 0:
+        time.sleep(0.05)
+    assert fs.hot_plane.lease_remaining() > 0, "lease pool never filled"
+    yield master, vsrv, fs
+    fs.stop()
+    vsrv.stop()
+    master.stop()
+    rpc.reset_channels()
+
+
+def _native_url(fs, path: str) -> str:
+    return f"http://localhost:{fs.port}{path}"
+
+
+def _admin_url(fs, path: str) -> str:
+    return f"http://localhost:{fs.admin_port}{path}"
+
+
+def test_byte_parity_native_vs_python(hot_cluster):
+    """Identical PUT/GET through both planes: bytes, ETag, Content-Type,
+    and absorbed store metadata must agree."""
+    _, _, fs = hot_cluster
+    cases = [
+        (b"x", "text/plain"),
+        (b"hello hot plane" * 100, "application/octet-stream"),
+        (os.urandom(512 * 1024), "image/png"),
+        (b"", ""),  # zero-byte object
+    ]
+    for i, (payload, ctype) in enumerate(cases):
+        npath = f"/buckets/parity/n{i}.bin"
+        ppath = f"/buckets/parity/p{i}.bin"
+        headers = {"Content-Type": ctype} if ctype else {}
+        rn = requests.put(_native_url(fs, npath), data=payload,
+                          headers=headers, timeout=10)
+        rp = requests.put(_admin_url(fs, ppath), data=payload,
+                          headers=headers, timeout=10)
+        assert rn.status_code in (200, 201), rn.text
+        assert rp.status_code in (200, 201), rp.text
+
+        gn = requests.get(_native_url(fs, npath), timeout=10)
+        gp = requests.get(_native_url(fs, ppath), timeout=10)
+        assert gn.status_code == gp.status_code == 200
+        assert gn.content == gp.content == payload
+        if ctype and payload:
+            assert gn.headers["Content-Type"] == ctype
+            assert gp.headers["Content-Type"] == ctype
+        # the SAME object must serve the same ETag through either plane
+        # (cross-object ETags can differ: the python write path may gzip,
+        # and ETags cover the stored bytes — reference behavior)
+        if payload:
+            fs.hot_sync()
+            ga = requests.get(_admin_url(fs, npath), timeout=10)
+            assert ga.status_code == 200 and ga.content == payload
+            assert gn.headers.get("ETag") == ga.headers.get("ETag"), \
+                (gn.headers, ga.headers)
+
+        # absorbed metadata matches the python-plane entry
+        fs.hot_sync()
+        en = fs.filer.find_entry(npath)
+        ep = fs.filer.find_entry(ppath)
+        assert sum(c.size for c in en.chunks) == len(payload)
+        assert sum(c.size for c in ep.chunks) == len(payload)
+        if ctype:
+            assert en.attr.mime == ep.attr.mime == ctype
+
+
+def test_chunked_transfer_encoding_put(hot_cluster):
+    """Streaming generator bodies (requests sends Transfer-Encoding:
+    chunked) must work against the native plane — the round-3 regression
+    broke every anonymous streaming S3 PUT with a 400→500."""
+    _, _, fs = hot_cluster
+    payload = os.urandom(300 * 1024)
+
+    def gen():
+        for i in range(0, len(payload), 32 * 1024):
+            yield payload[i:i + 32 * 1024]
+
+    r = requests.put(_native_url(fs, "/buckets/chunked/s.bin"), data=gen(),
+                     timeout=10)
+    assert r.status_code in (200, 201), r.text
+    g = requests.get(_native_url(fs, "/buckets/chunked/s.bin"), timeout=10)
+    assert g.status_code == 200 and g.content == payload
+
+    # a chunked PUT that the plane can't serve natively (here: bigger
+    # than max_body) must still succeed — the body is consumed, so the
+    # plane PROXIES to python instead of 307ing an unreplayable request
+    big = os.urandom(6 * 1024 * 1024)  # > max_body (4MB cap)
+
+    def gen_big():
+        for i in range(0, len(big), 256 * 1024):
+            yield big[i:i + 256 * 1024]
+
+    r = requests.put(_native_url(fs, "/buckets/chunked/big.bin"),
+                     data=gen_big(), timeout=30)
+    assert r.status_code in (200, 201), (r.status_code, r.text[:200])
+    g = requests.get(_native_url(fs, "/buckets/chunked/big.bin"), timeout=30)
+    assert g.status_code == 200 and g.content == big
+
+    # chunk extensions and a trailing empty chunk line are legal framing
+    with socket.create_connection(("localhost", fs.port), timeout=10) as s:
+        body = b"7;ext=1\r\nchunked\r\n3\r\n-ok\r\n0\r\n\r\n"
+        s.sendall(b"PUT /buckets/chunked/raw.bin HTTP/1.1\r\n"
+                  b"Host: x\r\nTransfer-Encoding: chunked\r\n"
+                  b"Connection: close\r\n\r\n" + body)
+        resp = b""
+        while chunk := s.recv(4096):
+            resp += chunk
+    assert b" 201 " in resp.split(b"\r\n", 1)[0] + b" ", resp[:200]
+    g = requests.get(_native_url(fs, "/buckets/chunked/raw.bin"), timeout=10)
+    assert g.content == b"chunked-ok"
+
+
+def test_percent_encoded_paths_are_canonical(hot_cluster):
+    """'/a%20b' and '/a b' are ONE object on both planes (ADVICE high:
+    encoded hot-map keys used to diverge from the decoded store path)."""
+    _, _, fs = hot_cluster
+    enc = "/buckets/pct/a%20b%20c.txt"
+    dec = "/buckets/pct/a b c.txt"
+    r = requests.put(_native_url(fs, enc), data=b"spaces v1", timeout=10)
+    assert r.status_code in (200, 201)
+
+    # native GET by encoded path sees it
+    g = requests.get(_native_url(fs, enc), timeout=10)
+    assert g.status_code == 200 and g.content == b"spaces v1"
+
+    # absorbed under the DECODED canonical path
+    fs.hot_sync()
+    e = fs.filer.find_entry(dec)
+    assert sum(c.size for c in e.chunks) == len(b"spaces v1")
+
+    # python-plane overwrite must invalidate the hot entry (same key!)
+    r = requests.put(_admin_url(fs, enc), data=b"spaces v2 longer",
+                     timeout=10)
+    assert r.status_code in (200, 201)
+    g = requests.get(_native_url(fs, enc), timeout=10)
+    assert g.status_code == 200 and g.content == b"spaces v2 longer", \
+        "stale hot entry served after python overwrite of encoded path"
+
+    # malformed escapes defer to python (which rejects/normalizes them)
+    r = requests.put(_native_url(fs, "/buckets/pct/bad%zz"), data=b"x",
+                     timeout=10)
+    assert r.status_code != 500
+
+
+def test_python_delete_invalidates_hot_entry(hot_cluster):
+    _, _, fs = hot_cluster
+    path = "/buckets/inval/d.txt"
+    assert requests.put(_native_url(fs, path), data=b"doomed",
+                        timeout=10).status_code in (200, 201)
+    fs.hot_sync()
+    r = requests.delete(_admin_url(fs, path), timeout=10)
+    assert r.status_code in (200, 202, 204)
+    g = requests.get(_native_url(fs, path), timeout=10)
+    assert g.status_code == 404, \
+        f"deleted object still served: {g.status_code}"
+
+
+def test_metadata_events_ordered(hot_cluster):
+    """Subscribers see absorbed hot-plane writes in PUT order
+    (filer_notify.go:20 — every mutation emits an event)."""
+    _, _, fs = hot_cluster
+    fs.hot_sync()
+    evs, cursor = fs.filer.read_events(0, timeout=0.1)
+    while evs:  # drain the log so only our writes remain
+        evs, cursor = fs.filer.read_events(cursor, timeout=0.1)
+    paths = [f"/buckets/events/e{i}.txt" for i in range(8)]
+    for i, p in enumerate(paths):
+        assert requests.put(_native_url(fs, p), data=f"ev{i}".encode(),
+                            timeout=10).status_code in (200, 201)
+    fs.hot_sync()
+    seen: list[str] = []
+    deadline = time.time() + 5
+    while time.time() < deadline and len(seen) < len(paths):
+        evs, cursor = fs.filer.read_events(cursor, timeout=0.5)
+        for m in evs:
+            ev = m.event_notification
+            if ev.new_entry and ev.new_entry.name.startswith("e"):
+                seen.append(f"{m.directory}/{ev.new_entry.name}")
+    assert [p for p in seen if p in paths] == paths, seen
+
+
+def test_sigkill_mid_hotlog_preserves_acked_puts(tmp_path):
+    """SIGKILL the all-in-one server mid-PUT-storm; every acknowledged
+    native PUT must read back after a restart on the same directory (the
+    startup path absorbs the crashed plane's hot log before truncating,
+    server/filer.py _start_hot_plane)."""
+    from tests.test_cli_server import _pick_ports
+
+    port_m, port_v, port_f = _pick_ports(3)
+    env = dict(os.environ, SEAWEEDFS_TPU_CODER="native")
+    args = [sys.executable, "-m", "seaweedfs_tpu", "server",
+            "-dir", str(tmp_path), "-master.port", str(port_m),
+            "-volume.port", str(port_v), "-filer",
+            "-filer.port", str(port_f)]
+    proc = subprocess.Popen(args, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    acked: list[tuple[str, bytes]] = []
+    try:
+        deadline = time.time() + 40
+        up = False
+        while time.time() < deadline and proc.poll() is None:
+            try:
+                requests.get(f"http://localhost:{port_f}/", timeout=1)
+                up = True
+                break
+            except requests.RequestException:
+                time.sleep(0.3)
+        assert up, "all-in-one server did not come up"
+
+        i = 0
+        storm_end = time.time() + 4
+        while time.time() < storm_end:
+            p = f"/buckets/crash/f{i}.bin"
+            body = os.urandom(1024) + str(i).encode()
+            try:
+                r = requests.put(f"http://localhost:{port_f}{p}", data=body,
+                                 timeout=5)
+            except requests.RequestException:
+                break
+            if r.status_code in (200, 201):
+                acked.append((p, body))
+            i += 1
+        assert len(acked) > 20, f"storm too small: {len(acked)}"
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(timeout=10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    # restart on the same dir; absorbed-from-log entries must all serve
+    proc = subprocess.Popen(args, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    try:
+        deadline = time.time() + 40
+        up = False
+        while time.time() < deadline and proc.poll() is None:
+            try:
+                requests.get(f"http://localhost:{port_f}/", timeout=1)
+                up = True
+                break
+            except requests.RequestException:
+                time.sleep(0.3)
+        assert up, "server did not come back after SIGKILL"
+        missing = []
+        for p, body in acked:
+            g = requests.get(f"http://localhost:{port_f}{p}", timeout=10)
+            if g.status_code != 200 or g.content != body:
+                missing.append((p, g.status_code))
+        assert not missing, \
+            f"{len(missing)}/{len(acked)} acked PUTs lost: {missing[:5]}"
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_corrupt_hotlog_stands_plane_down(tmp_path):
+    """A corrupt hot-log record must alarm, halt absorption, AND stop the
+    C++ plane from acking PUTs it can no longer make durable (they fall
+    back to python and keep working)."""
+    from seaweedfs_tpu.pb import rpc
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from tests.test_cli_server import _pick_ports
+
+    mport, vport, fport = _pick_ports(3)
+    master = MasterServer(ip="localhost", port=mport, volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "vol")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=vport, native=True)
+    vsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    fs = FilerServer(ip="localhost", port=fport,
+                     master=f"localhost:{mport}",
+                     store_dir=str(tmp_path / "filer"),
+                     native_volume_plane=vsrv.native_plane)
+    fs.start()
+    try:
+        assert fs.hot_plane is not None
+        deadline = time.time() + 10
+        while time.time() < deadline and fs.hot_plane.lease_remaining() == 0:
+            time.sleep(0.05)
+        # one good native PUT, absorbed
+        assert requests.put(_native_url(fs, "/buckets/c/ok.txt"),
+                            data=b"good", timeout=10).status_code == 201
+        fs.hot_sync()
+        # inject a corrupt record (bad op byte, full header present)
+        with open(fs.hot_plane.log_path, "ab") as f:
+            f.write(b"\x07" + b"\x00" * 60)
+        fs.hot_sync()
+        assert fs._hot_log_corrupt
+        # plane stood down: PUTs still succeed (via python), and the
+        # entry is durably in the store WITHOUT hot-log absorption
+        r = requests.put(_native_url(fs, "/buckets/c/after.txt"),
+                         data=b"via python", timeout=10)
+        assert r.status_code in (200, 201)
+        e = fs.filer.find_entry("/buckets/c/after.txt")
+        assert sum(c.size for c in e.chunks) == len(b"via python")
+        g = requests.get(_native_url(fs, "/buckets/c/after.txt"), timeout=10)
+        assert g.status_code == 200 and g.content == b"via python"
+    finally:
+        fs.stop()
+        vsrv.stop()
+        master.stop()
+        rpc.reset_channels()
+
+
+def test_native_plane_actually_serves(hot_cluster):
+    """The suite above is meaningless if everything 307'd to python:
+    assert the C++ plane took real PUT and GET traffic."""
+    _, _, fs = hot_cluster
+    st = fs.hot_plane.stats()
+    assert st["native_puts"] > 10, st
+    assert st["native_gets"] > 5, st
