@@ -13,8 +13,15 @@ dbFile = "./filer.db"
 [memory]
 enabled = false
 
-[leveldb-like]
-# the sqlite store is the durable default in this build
+[leveldb]
+enabled = false
+dir = "./filerldb"
+
+[redis]           # also: [redis2] — same live RESP store, redis2 layout
+enabled = false
+address = "localhost:6379"
+password = ""
+database = 0
 """,
     "master": """\
 # master.toml

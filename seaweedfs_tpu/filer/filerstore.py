@@ -52,6 +52,7 @@ def get_store(name: str, **kwargs) -> FilerStore:
         gated,
         leveldb,
         memory,
+        redis,
         sqlite,
     )
 
@@ -68,6 +69,7 @@ def available_stores() -> list[str]:
         gated,
         leveldb,
         memory,
+        redis,
         sqlite,
     )
 

@@ -13,9 +13,8 @@ from ..filerstore import register_store
 
 _GATED = {
     "rocksdb": "python-rocksdb (cgo-gated in the reference too)",
-    "redis": "redis-py",
-    "redis2": "redis-py",
-    "redis3": "redis-py",
+    # redis/redis2 are REAL now: stores/redis.py speaks RESP itself
+    "redis3": "redis-py (sharded key layout; redis/redis2 are live)",
     "redis_lua": "redis-py",
     "mysql": "mysql-connector / PyMySQL",
     "mysql2": "mysql-connector / PyMySQL",

@@ -155,9 +155,12 @@ class S3Sink(ReplicationSink):
             return
         url = self._url(path)
         body = data or b""
-        r = requests.put(url, data=body,
-                         headers=self._headers("PUT", url, body),
-                         timeout=300)
+        headers = self._headers("PUT", url, body)
+        # carry the entry's mime across (s3_sink.go sets ContentType on
+        # the upload input) so gateway reads return the original type
+        if entry.attributes.mime:
+            headers["Content-Type"] = entry.attributes.mime
+        r = requests.put(url, data=body, headers=headers, timeout=300)
         if r.status_code >= 300:
             raise IOError(f"s3 sink PUT {url}: {r.status_code}")
 

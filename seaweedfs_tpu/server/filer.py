@@ -53,19 +53,38 @@ class FilerServer:
         self.collection = collection
         self.replication = replication
         self.chunk_size = chunk_size
+        # filer.toml's enabled section selects + configures the store
+        # (command/filer.go LoadConfiguration("filer") — the reference's
+        # only store-selection mechanism; our -store flag remains as the
+        # fallback default when no section is enabled)
+        store_kwargs: dict = {}
+        try:
+            from ..utils.config import load_config
+
+            for kind, section in load_config("filer").items():
+                if isinstance(section, dict) and section.get("enabled"):
+                    store = kind
+                    store_kwargs = {k: v for k, v in section.items()
+                                    if k != "enabled"}
+                    break
+        except Exception as e:
+            from ..utils import glog
+
+            glog.warning(f"filer config ignored: {e}")
         if store == "sqlite":
             import os
 
-            db = ":memory:"
-            if store_dir:
+            db = store_kwargs.pop("dbFile", "") or ":memory:"
+            if store_dir and db == ":memory:":
                 os.makedirs(store_dir, exist_ok=True)
                 db = os.path.join(store_dir, "filer.db")
             self.filer = Filer(get_store("sqlite", db_path=db))
         elif store.startswith("leveldb"):
             self.filer = Filer(get_store(
-                store, directory=store_dir or "./filerldb"))
+                store, directory=store_kwargs.pop("dir", "")
+                or store_dir or "./filerldb"))
         else:
-            self.filer = Filer(get_store(store))
+            self.filer = Filer(get_store(store, **store_kwargs))
         # external event publisher, if notification.toml configures one
         # (filer.go LoadConfiguration("notification"))
         try:

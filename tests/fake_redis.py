@@ -1,0 +1,172 @@
+"""In-process pure-python RESP server: enough of the Redis wire protocol
+(SET/GET/DEL/ZADD/ZREM/ZRANGEBYLEX/AUTH/SELECT/PING/FLUSHDB) to exercise
+the real RedisStore (seaweedfs_tpu/filer/stores/redis.py) end to end.
+The protocol framing is real RESP2 — the same client code path talks to
+an actual Redis unchanged."""
+
+from __future__ import annotations
+
+import bisect
+import socket
+import threading
+
+
+class FakeRedisServer:
+    def __init__(self, *, password: str = ""):
+        self.password = password
+        self.kv: dict[bytes, bytes] = {}
+        self.zsets: dict[bytes, list[bytes]] = {}  # lex-sorted members
+        self._lock = threading.Lock()
+        self._listen = socket.socket()
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind(("localhost", 0))
+        self._listen.listen(16)
+        self.port = self._listen.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._accept_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+
+    # -- wire --------------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listen.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        f = conn.makefile("rb")
+        authed = not self.password
+        try:
+            while not self._stop.is_set():
+                args = self._read_command(f)
+                if args is None:
+                    return
+                cmd = args[0].upper().decode(errors="replace")
+                if cmd == "AUTH":
+                    if len(args) == 2 and args[1].decode() == self.password:
+                        authed = True
+                        conn.sendall(b"+OK\r\n")
+                    else:
+                        conn.sendall(b"-ERR invalid password\r\n")
+                    continue
+                if not authed:
+                    conn.sendall(b"-NOAUTH Authentication required.\r\n")
+                    continue
+                conn.sendall(self._dispatch(cmd, args[1:]))
+        except (OSError, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _read_command(f) -> list[bytes] | None:
+        line = f.readline()
+        if not line:
+            return None
+        if not line.startswith(b"*"):
+            raise ValueError("inline commands unsupported")
+        n = int(line[1:-2])
+        args = []
+        for _ in range(n):
+            hdr = f.readline()
+            if not hdr.startswith(b"$"):
+                raise ValueError("expected bulk string")
+            ln = int(hdr[1:-2])
+            blob = f.read(ln + 2)
+            if len(blob) != ln + 2:
+                return None
+            args.append(blob[:-2])
+        return args
+
+    # -- commands ----------------------------------------------------------
+
+    def _dispatch(self, cmd: str, a: list[bytes]) -> bytes:
+        with self._lock:
+            if cmd == "PING":
+                return b"+PONG\r\n"
+            if cmd == "SELECT":
+                return b"+OK\r\n"  # single namespace is fine for tests
+            if cmd == "FLUSHDB":
+                self.kv.clear()
+                self.zsets.clear()
+                return b"+OK\r\n"
+            if cmd == "SET" and len(a) == 2:
+                self.kv[a[0]] = a[1]
+                return b"+OK\r\n"
+            if cmd == "GET" and len(a) == 1:
+                v = self.kv.get(a[0])
+                if v is None:
+                    return b"$-1\r\n"
+                return b"$%d\r\n%s\r\n" % (len(v), v)
+            if cmd == "DEL":
+                n = 0
+                for k in a:
+                    n += self.kv.pop(k, None) is not None
+                    n += self.zsets.pop(k, None) is not None
+                return b":%d\r\n" % n
+            if cmd == "ZADD" and len(a) >= 3:
+                members = self.zsets.setdefault(a[0], [])
+                added = 0
+                for m in a[2::2]:  # (score, member) pairs; scores ignored
+                    i = bisect.bisect_left(members, m)
+                    if i >= len(members) or members[i] != m:
+                        members.insert(i, m)
+                        added += 1
+                return b":%d\r\n" % added
+            if cmd == "ZREM" and len(a) >= 2:
+                members = self.zsets.get(a[0], [])
+                removed = 0
+                for m in a[1:]:
+                    i = bisect.bisect_left(members, m)
+                    if i < len(members) and members[i] == m:
+                        members.pop(i)
+                        removed += 1
+                return b":%d\r\n" % removed
+            if cmd == "ZRANGEBYLEX" and len(a) in (3, 6):
+                members = self.zsets.get(a[0], [])
+                out = self._lex_range(members, a[1], a[2])
+                if len(a) == 6:  # ... LIMIT offset count
+                    if a[3].upper() != b"LIMIT":
+                        return b"-ERR syntax error\r\n"
+                    off, cnt = int(a[4]), int(a[5])
+                    out = out[off:] if cnt < 0 else out[off:off + cnt]
+                body = b"".join(b"$%d\r\n%s\r\n" % (len(m), m)
+                                for m in out)
+                return b"*%d\r\n%s" % (len(out), body)
+            return b"-ERR unknown command '%s'\r\n" % cmd.encode()
+
+    @staticmethod
+    def _lex_range(members: list[bytes], lo: bytes,
+                   hi: bytes) -> list[bytes]:
+        if lo == b"-":
+            i = 0
+        elif lo.startswith(b"["):
+            i = bisect.bisect_left(members, lo[1:])
+        elif lo.startswith(b"("):
+            i = bisect.bisect_right(members, lo[1:])
+        else:
+            raise ValueError("bad min")
+        if hi == b"+":
+            j = len(members)
+        elif hi.startswith(b"["):
+            j = bisect.bisect_right(members, hi[1:])
+        elif hi.startswith(b"("):
+            j = bisect.bisect_left(members, hi[1:])
+        else:
+            raise ValueError("bad max")
+        return members[i:j]

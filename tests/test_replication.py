@@ -124,6 +124,74 @@ def test_replicator_to_local_sink(two_clusters, tmp_path):
     assert not (sink_dir / "hello.txt").exists()
 
 
+def test_s3_sink_e2e_via_own_gateway(two_clusters):
+    """VERDICT r3 item 5: filer A events -> S3Sink -> this framework's
+    OWN S3 gateway fronting filer B; byte + metadata equality, deletes
+    propagate. (Reference: replication/sink/s3sink/s3_sink.go.)"""
+    import grpc
+
+    from seaweedfs_tpu.replication.sink import S3Sink
+    from seaweedfs_tpu.s3api.server import S3Server
+
+    (_, _, fa), (_, _, fb) = two_clusters
+    s3port = _free_port()
+    s3 = S3Server(port=s3port, filer=fb.address)
+    s3.start()
+    try:
+        gw = f"http://localhost:{s3port}"
+        assert requests.put(f"{gw}/mirror-bkt",
+                            timeout=10).status_code == 200
+        base = f"http://{fa.address}"
+        payload = os.urandom(100_000)
+        requests.put(f"{base}/s3src/deep/obj.bin", data=payload,
+                     headers={"Content-Type": "application/x-test"},
+                     timeout=30)
+        repl = Replicator(
+            FilerSource(fa.address),
+            S3Sink(gw, "mirror-bkt", directory="mirrored"),
+            source_prefix="/s3src")
+        stub = rpc.filer_stub(rpc.grpc_address(fa.address))
+        n = 0
+        try:
+            for resp in stub.SubscribeMetadata(
+                    filer_pb2.SubscribeMetadataRequest(
+                        client_name="s3t", path_prefix="/s3src",
+                        since_ns=0), timeout=2):
+                if repl.replicate(resp):
+                    n += 1
+        except grpc.RpcError as e:
+            assert e.code() == grpc.StatusCode.DEADLINE_EXCEEDED
+        assert n >= 1
+        # byte equality through the gateway
+        g = requests.get(f"{gw}/mirror-bkt/mirrored/deep/obj.bin",
+                         timeout=30)
+        assert g.status_code == 200 and g.content == payload
+        # metadata (mime) carried across both hops
+        assert g.headers["Content-Type"] == "application/x-test"
+        # and equality straight from filer B's store
+        e = fb.filer.find_entry("/buckets/mirror-bkt/mirrored/deep/obj.bin")
+        from seaweedfs_tpu.filer.filechunks import total_size
+        assert total_size(e.chunks) == len(payload)
+        assert e.attr.mime == "application/x-test"
+
+        # deletes propagate (resume from a cursor like a real consumer)
+        t1 = time.time_ns()
+        requests.delete(f"{base}/s3src/deep/obj.bin", timeout=30)
+        try:
+            for resp in stub.SubscribeMetadata(
+                    filer_pb2.SubscribeMetadataRequest(
+                        client_name="s3t2", path_prefix="/s3src",
+                        since_ns=t1), timeout=2):
+                repl.replicate(resp)
+        except grpc.RpcError:
+            pass
+        g = requests.get(f"{gw}/mirror-bkt/mirrored/deep/obj.bin",
+                         timeout=30)
+        assert g.status_code == 404
+    finally:
+        s3.stop()
+
+
 # -- filer -> filer sync ---------------------------------------------------
 
 def test_filer_sync_between_clusters(two_clusters):
