@@ -152,22 +152,43 @@ def _derived(form: str, key: tuple, matrix: np.ndarray) -> np.ndarray:
     return got
 
 
-def decode_matrix_op(
+@functools.lru_cache(maxsize=1024)
+def fused_reconstruct_matrix(
     data_shards: int, parity_shards: int, present: tuple[int, ...],
-    form: str
+    missing: tuple[int, ...]
 ) -> tuple[np.ndarray, tuple[int, ...]]:
-    """Cached decode-matrix operand ("bits" or "xor" form) for a
-    survivor set."""
+    """Byte-form [len(missing), k] matrix taking the k survivors straight
+    to every missing shard — data AND parity — in ONE GF matmul.
+
+    Data rows come from the decode matrix; parity rows fold the parity
+    generator through it (G_p @ dec), so reconstruct needs no second
+    encode dispatch (round-3 VERDICT item 4). GF arithmetic is exact:
+    outputs are bit-identical to the two-pass decode+re-encode (the
+    reference's shape, ec_encoder.go:233-287 / store_ec.go:384).
+    Cached per (geometry, survivor set, missing set)."""
     dec, used = decode_matrix_cached(data_shards, parity_shards, present)
-    op = _derived(form, ("dec", data_shards, parity_shards, present), dec)
-    return op, used
+    out = np.empty((len(missing), data_shards), dtype=np.uint8)
+    parity_idx = [j for j, i in enumerate(missing) if i >= data_shards]
+    for j, i in enumerate(missing):
+        if i < data_shards:
+            out[j] = dec[i]
+    if parity_idx:
+        gp = gf256.parity_matrix(data_shards, parity_shards)
+        rows = [missing[j] - data_shards for j in parity_idx]
+        out[parity_idx] = gf256.gf_matmul(gp[rows], dec)
+    return out, used
 
 
-def decode_matrix_bits(
-    data_shards: int, parity_shards: int, present: tuple[int, ...]
+def fused_reconstruct_op(
+    data_shards: int, parity_shards: int, present: tuple[int, ...],
+    missing: tuple[int, ...], form: str
 ) -> tuple[np.ndarray, tuple[int, ...]]:
-    """Bit-form convenience wrapper over decode_matrix_op."""
-    return decode_matrix_op(data_shards, parity_shards, present, "bits")
+    """Cached derived-form ("bits"/"xor") fused reconstruct operand."""
+    fmat, used = fused_reconstruct_matrix(
+        data_shards, parity_shards, present, missing)
+    op = _derived(form, ("fdec", data_shards, parity_shards, present,
+                         missing), fmat)
+    return op, used
 
 
 def parity_matrix_op(data_shards: int, parity_shards: int,
@@ -237,11 +258,12 @@ def _dispatch_matmul(matrix: np.ndarray, data: jax.Array, out_rows: int,
         key = ("raw", matrix.shape, matrix.tobytes())
     b = data.shape[1]
     kind = _kernel_choice(b)
-    if kind.startswith("sel-") and key[0] == "dec":
-        # sel kernels specialize on the static matrix; decode matrices
-        # (one per survivor set, up to C(n,k) of them) would recompile
-        # per failure pattern — route those to the runtime-operand xor
-        # form and keep sel for the one-per-geometry encode matrix
+    if kind.startswith("sel-") and key[0] == "fdec":
+        # sel kernels specialize on the static matrix; fused reconstruct
+        # matrices (one per survivor+missing set, up to C(n,k) of them)
+        # would recompile per failure pattern — route those to the
+        # runtime-operand xor form and keep sel for the one-per-geometry
+        # encode matrix
         kind = kind.replace("sel-", "xor-")
     if kind == "sel-pallas":
         from .rs_xor import apply_matrix_sel_pallas
@@ -316,9 +338,6 @@ class RSCodecJax:
 
     # -- Reconstruct -------------------------------------------------------
 
-    def _decode_matrix(self, present: tuple[int, ...]) -> tuple[np.ndarray, tuple[int, ...]]:
-        return decode_matrix_cached(self.data_shards, self.parity_shards, present)
-
     def reconstruct_data(
         self, shards: dict[int, np.ndarray] | list[np.ndarray | None]
     ) -> dict[int, jax.Array]:
@@ -327,41 +346,28 @@ class RSCodecJax:
         `shards`: dict shard_id -> [B] bytes, or list with None for missing.
         Returns {shard_id: [B] uint8} for every previously-missing data shard.
         """
-        present = self._as_dict(shards)
-        missing_data = [
-            i for i in range(self.data_shards) if i not in present
-        ]
-        if not missing_data:
-            return {}
-        key = ("dec", self.data_shards, self.parity_shards,
-               tuple(sorted(present.keys())))
-        dec, used = self._decode_matrix(key[3])
-        stacked = jnp.stack([jnp.asarray(present[i], jnp.uint8) for i in used])
-        data = _dispatch_matmul(dec, stacked, self.data_shards, key=key)
-        return {i: data[i] for i in missing_data}
+        return self._reconstruct_fused(shards, self.data_shards)
 
     def reconstruct(
         self, shards: dict[int, np.ndarray] | list[np.ndarray | None]
     ) -> dict[int, jax.Array]:
-        """Recompute ALL missing shards (data and parity) from any k survivors."""
+        """Recompute ALL missing shards (data and parity) from any k
+        survivors — one fused [missing, k] GF matmul, no second encode
+        pass (fused_reconstruct_matrix)."""
+        return self._reconstruct_fused(shards, self.total_shards)
+
+    def _reconstruct_fused(self, shards, limit: int) -> dict[int, jax.Array]:
         present = self._as_dict(shards)
-        missing = [i for i in range(self.total_shards) if i not in present]
+        missing = tuple(i for i in range(limit) if i not in present)
         if not missing:
             return {}
-        key = ("dec", self.data_shards, self.parity_shards,
-               tuple(sorted(present.keys())))
-        dec, used = self._decode_matrix(key[3])
+        pres = tuple(sorted(present.keys()))
+        fmat, used = fused_reconstruct_matrix(
+            self.data_shards, self.parity_shards, pres, missing)
+        key = ("fdec", self.data_shards, self.parity_shards, pres, missing)
         stacked = jnp.stack([jnp.asarray(present[i], jnp.uint8) for i in used])
-        data = _dispatch_matmul(dec, stacked, self.data_shards, key=key)  # [k, B]
-        out: dict[int, jax.Array] = {}
-        need_parity = any(i >= self.data_shards for i in missing)
-        parity = self.encode_parity(data) if need_parity else None
-        for i in missing:
-            if i < self.data_shards:
-                out[i] = data[i]
-            else:
-                out[i] = parity[i - self.data_shards]
-        return out
+        out = _dispatch_matmul(fmat, stacked, len(missing), key=key)
+        return {i: out[j] for j, i in enumerate(missing)}
 
     def verify(self, shards: np.ndarray | jax.Array) -> bool:
         """True iff parity rows match the data rows."""

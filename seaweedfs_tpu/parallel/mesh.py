@@ -26,7 +26,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ..ops.rs_jax import decode_matrix_op, gf_matmul_bits, parity_matrix_op
+from ..ops.rs_jax import (
+    fused_reconstruct_op,
+    gf_matmul_bits,
+    parity_matrix_op,
+)
 from ..ops.rs_xor import gf_matmul_xor
 
 STRIPE_AXIS = "stripe"
@@ -157,27 +161,21 @@ class ShardedCoder:
             else {i: s for i, s in enumerate(shards) if s is not None}
         )
         limit = self.data_shards if data_only else self.total_shards
-        missing = [i for i in range(limit) if i not in present]
+        missing = tuple(i for i in range(limit) if i not in present)
         if not missing:
             return {}
-        dec_np, used = decode_matrix_op(
+        # one fused [missing, k] matmul — parity rows are folded through
+        # the decode matrix host-side (rs_jax.fused_reconstruct_matrix),
+        # so no second mesh-wide encode dispatch
+        op_np, used = fused_reconstruct_op(
             self.data_shards, self.parity_shards,
-            tuple(sorted(present.keys())), self.kernel)
-        dec_op = jnp.asarray(dec_np)
+            tuple(sorted(present.keys())), missing, self.kernel)
+        fused_op = jnp.asarray(op_np)
         stacked = np.stack([np.asarray(present[i], np.uint8) for i in used])
         arr, b = self._shard(stacked)
-        data = _apply_sharded(dec_op, arr, self.mesh, self.axis, self.kernel)
-        out: dict[int, jax.Array] = {}
-        if any(i >= self.data_shards for i in missing):
-            # data is already padded + mesh-sharded: re-encode in place
-            parity = _apply_sharded(self._parity_op, data, self.mesh,
-                                    self.axis, self.kernel)
-        else:
-            parity = None
-        for i in missing:
-            src = data[i] if i < self.data_shards else parity[i - self.data_shards]
-            out[i] = src[:b]
-        return out
+        out_arr = _apply_sharded(fused_op, arr, self.mesh, self.axis,
+                                 self.kernel)
+        return {i: out_arr[j][:b] for j, i in enumerate(missing)}
 
     def verify(self, shards) -> bool:
         shards = np.asarray(shards, dtype=np.uint8)
