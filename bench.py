@@ -169,6 +169,37 @@ def bench(data_shards=10, parity_shards=4, col_bytes=None, iters=8,
             best = max(best, data_shards * col_bytes * 4 / dt / 1e9)
         return best
 
+    def scan_chained_once():
+        # ONE dispatch runs K dependent encodes under lax.scan: pure
+        # device throughput independent of per-dispatch tunnel latency
+        # (~60ms each way on the axon loopback). Each step XORs its
+        # parity back into the data rows, so steps form a true data
+        # dependency chain XLA cannot elide or reorder; the forced
+        # readback slice depends on every step.
+        from seaweedfs_tpu.ops.rs_jax import gf_matmul_bits, parity_matrix_op
+        mb = jnp.asarray(parity_matrix_op(data_shards, parity_shards,
+                                          "bits"))
+        K = 24
+
+        @jax.jit
+        def chained(d):
+            def step(c, _):
+                p = gf_matmul_bits(mb, c)
+                head = c[:parity_shards] ^ p
+                return jnp.concatenate([head, c[parity_shards:]], 0), ()
+
+            out, _ = jax.lax.scan(step, d, None, length=K)
+            return out
+
+        chained(bufs[0]).block_until_ready()  # compile
+        best = 0.0
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            np.asarray(chained(bufs[0])[:, ::65537])
+            dt = time.perf_counter() - t0
+            best = max(best, data_shards * col_bytes * K / dt / 1e9)
+        return best
+
     kernel = _kernel_choice(col_bytes)
     if kernel.endswith("-pallas"):
         try:
@@ -188,12 +219,18 @@ def bench(data_shards=10, parity_shards=4, col_bytes=None, iters=8,
           flush=True)
     extras = {}
     for name, fn in (("verified_gbps", verified_once),
-                     ("rebuild_gbps", rebuild_once)):
+                     ("rebuild_gbps", rebuild_once),
+                     ("device_scan_gbps", scan_chained_once)):
         try:
             extras[name] = fn()
         except Exception:
             sys.stderr.write(f"{name} bench failed:\n"
                              + traceback.format_exc() + "\n")
+        # re-publish cumulatively after EVERY extra: the parent salvages
+        # the last parseable line on a watchdog kill, so metrics already
+        # measured survive a later extra wedging the tunnel
+        print(json.dumps({"gbps": gbps, "kernel": kernel,
+                          "backend": backend, **extras}), flush=True)
     return gbps, extras, kernel, backend
 
 try:
@@ -209,8 +246,10 @@ except Exception as e:
 def _bench_device() -> dict:
     """Run the device bench in a subprocess with timeout + retries."""
     attempts = int(os.environ.get("SEAWEEDFS_TPU_BENCH_ATTEMPTS", "2"))
-    # budget covers three timed benches + their compilations
-    per_timeout = float(os.environ.get("SEAWEEDFS_TPU_BENCH_TIMEOUT", "480"))
+    # budget covers four timed benches + their compilations; each extra
+    # re-publishes cumulatively, so a late wedge only loses the extras
+    # that hadn't finished
+    per_timeout = float(os.environ.get("SEAWEEDFS_TPU_BENCH_TIMEOUT", "540"))
     last = "no attempts"
     for attempt in range(attempts):
         try:
@@ -404,6 +443,10 @@ def main() -> int:
             result["verified_gbps"] = round(dev["verified_gbps"], 3)
         if dev.get("rebuild_gbps"):
             result["rebuild_gbps"] = round(dev["rebuild_gbps"], 3)
+        if dev.get("device_scan_gbps"):
+            # one lax.scan dispatch chaining K dependent encodes: pure
+            # device throughput, independent of tunnel dispatch latency
+            result["device_scan_gbps"] = round(dev["device_scan_gbps"], 3)
         result["kernel"] = dev.get("kernel")
         result["backend"] = dev.get("backend")
         if cpu_gbps:
