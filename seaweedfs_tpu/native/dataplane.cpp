@@ -669,6 +669,27 @@ void redirect(int fd, const Request& req, int redirect_port) {
   respond(fd, req, 307, "text/plain", loc, nullptr, 0);
 }
 
+// Parse a CLEAN "bytes=lo-hi" / "bytes=lo-" Range header. Anything
+// unusual — suffix/multi ranges, non-digits, overflow-scale bounds —
+// returns false and the caller defers to python, so edge semantics live
+// in exactly one place per plane's python counterpart.
+bool parse_clean_range(const std::string& rng, uint64_t* start,
+                       uint64_t* hi, bool* has_hi) {
+  if (rng.rfind("bytes=", 0) != 0) return false;
+  std::string spec = rng.substr(6);
+  size_t dash = spec.find('-');
+  if (dash == std::string::npos || dash == 0 || dash > 15 ||
+      spec.size() - dash - 1 > 15 ||
+      spec.find(',') != std::string::npos)
+    return false;
+  for (size_t i = 0; i < spec.size(); i++)
+    if (i != dash && !isdigit((unsigned char)spec[i])) return false;
+  *start = strtoull(spec.c_str(), nullptr, 10);
+  *has_hi = dash + 1 < spec.size();
+  if (*has_hi) *hi = strtoull(spec.c_str() + dash + 1, nullptr, 10);
+  return true;
+}
+
 // Parse "/vid,keyhex+cookiehex[.ext]". Returns false if not a fid path.
 bool parse_fid_path(const std::string& path, uint32_t* vid, uint64_t* key,
                     uint32_t* cookie) {
@@ -792,28 +813,11 @@ void handle_get(Plane& pl, int fd, const Request& req, uint32_t vid,
   }
   if (!rng.empty()) {
     // Common "bytes=lo-hi" / "bytes=lo-" ranges are served natively with
-    // volume.py's clamp semantics. Anything unusual — suffix/multi
-    // ranges, non-digits, overflow-scale bounds, start past EOF — is
-    // delegated to the python handler so edge semantics live in exactly
-    // one place.
+    // volume.py's clamp semantics; anything else (incl. start past EOF)
+    // is delegated to the python handler.
     uint64_t start = 0, hi = 0;
-    bool has_hi = false, clean = rng.rfind("bytes=", 0) == 0;
-    if (clean) {
-      std::string spec = rng.substr(6);
-      size_t dash = spec.find('-');
-      clean = dash != std::string::npos && dash > 0 && dash <= 15 &&
-              spec.size() - dash - 1 <= 15 &&
-              spec.find(',') == std::string::npos;
-      if (clean) {
-        for (size_t i = 0; i < spec.size() && clean; i++)
-          if (i != dash && !isdigit((unsigned char)spec[i])) clean = false;
-      }
-      if (clean) {
-        start = strtoull(spec.c_str(), nullptr, 10);
-        has_hi = dash + 1 < spec.size();
-        if (has_hi) hi = strtoull(spec.c_str() + dash + 1, nullptr, 10);
-      }
-    }
+    bool has_hi = false;
+    bool clean = parse_clean_range(rng, &start, &hi, &has_hi);
     if (!clean || start >= n.data_len)
       return redirect(fd, req, pl.redirect_port);
     uint64_t stop = has_hi ? hi + 1 : n.data_len;
@@ -1169,14 +1173,80 @@ std::string json_escape(const std::string& s) {
   return out;
 }
 
+// First-file-part extraction from multipart/form-data, mirroring the
+// python filer's semantics (server/volume.py _extract_upload: first part
+// with a payload wins, stored mime is empty). False defers to python
+// (no/odd boundary, transfer-encoded or nested-multipart parts, framing
+// surprises). Operates on a string_view over the body — no full copy.
+bool parse_multipart(const std::string& ct, const std::vector<uint8_t>& body,
+                     std::vector<uint8_t>* out) {
+  size_t bp = ct.find("boundary=");
+  if (bp == std::string::npos) return false;
+  std::string b = ct.substr(bp + 9);
+  if (!b.empty() && b.front() == '"') {
+    size_t q = b.find('"', 1);
+    if (q == std::string::npos) return false;
+    b = b.substr(1, q - 1);
+  } else {
+    size_t sc = b.find(';');
+    if (sc != std::string::npos) b = b.substr(0, sc);
+  }
+  if (b.empty()) return false;
+  std::string delim = "--" + b;
+  std::string_view data((const char*)body.data(), body.size());
+  size_t p = data.find(delim);
+  if (p == std::string::npos) return false;
+  p += delim.size();
+  if (data.substr(p, 2) != "\r\n") return false;
+  p += 2;
+  size_t hdr_end = data.find("\r\n\r\n", p);
+  if (hdr_end == std::string::npos) return false;
+  std::string hdrs(data.substr(p, hdr_end - p));
+  for (auto& c : hdrs) c = (char)tolower((unsigned char)c);
+  if (hdrs.find("content-transfer-encoding:") != std::string::npos)
+    return false;  // base64/qp parts need python's email decoder
+  if (hdrs.find("content-type: multipart/") != std::string::npos ||
+      hdrs.find("content-type:multipart/") != std::string::npos)
+    return false;  // nested container part: python skips to its children
+  size_t body_start = hdr_end + 4;
+  // the part ends at a TRUE delimiter LINE: CRLF + delim followed (after
+  // optional linear whitespace padding) by CRLF or the closing "--".
+  // RFC 2046 allows content containing CRLF + a PREFIX of the delimiter
+  // ("\r\n--bonus" with boundary "b"), so a bare find() would truncate.
+  std::string marker = "\r\n" + delim;
+  size_t next = body_start > 0 ? body_start - 2 : 0;  // part may be empty
+  for (;;) {
+    next = data.find(marker, next);
+    if (next == std::string::npos) return false;
+    size_t after = next + marker.size();
+    while (after < data.size() &&
+           (data[after] == ' ' || data[after] == '\t'))
+      after++;
+    if (data.substr(after, 2) == "\r\n" || data.substr(after, 2) == "--")
+      break;
+    next += 1;  // prefix match inside content: keep scanning
+  }
+  if (next < body_start) return false;  // delimiter inside part headers
+  out->assign(body.begin() + body_start, body.begin() + next);
+  return true;
+}
+
 void handle_filer_put(FilerPlane& fp, int fd, const Request& req,
                       const std::string& path) {
   if (!req.query.empty() || req.body.size() > fp.max_body)
     return fp.redirects++, redirect(fd, req, fp.redirect_port);
   std::string ct = req.header("content-type");
-  if (ct.rfind("multipart/", 0) == 0 || ct.size() >= 256 ||
-      !req.header("content-encoding").empty())
+  if (ct.size() >= 256 || !req.header("content-encoding").empty())
     return fp.redirects++, redirect(fd, req, fp.redirect_port);
+  std::vector<uint8_t> part;
+  bool is_multipart = ct.rfind("multipart/form-data", 0) == 0;
+  if (is_multipart) {
+    if (!parse_multipart(ct, req.body, &part))
+      return fp.redirects++, redirect(fd, req, fp.redirect_port);
+    ct.clear();  // python stores multipart uploads with empty mime
+  } else if (ct.rfind("multipart/", 0) == 0) {
+    return fp.redirects++, redirect(fd, req, fp.redirect_port);
+  }
   if (path.empty() || path.size() >= 4096 || path.back() == '/')
     return fp.redirects++, redirect(fd, req, fp.redirect_port);
   bool log_down;
@@ -1217,8 +1287,9 @@ void handle_filer_put(FilerPlane& fp, int fd, const Request& req,
 
   // build + append the needle record (same wire as handle_put; fresh
   // keys never collide, so no dedup/cookie-check pass is needed)
-  const uint8_t* data = req.body.data();
-  uint32_t dlen = (uint32_t)req.body.size();
+  const uint8_t* data = is_multipart ? part.data() : req.body.data();
+  uint32_t dlen =
+      (uint32_t)(is_multipart ? part.size() : req.body.size());
   uint8_t flags = kFlagHasLastModified;
   if (!ct.empty()) flags |= kFlagHasMime;
   uint64_t now_secs = (uint64_t)time(nullptr);
@@ -1298,8 +1369,7 @@ void handle_filer_put(FilerPlane& fp, int fd, const Request& req,
 
 void handle_filer_get(FilerPlane& fp, int fd, const Request& req,
                       const std::string& path) {
-  if (!req.query.empty() || !req.header("range").empty() ||
-      !req.header("if-modified-since").empty())
+  if (!req.query.empty() || !req.header("if-modified-since").empty())
     return fp.redirects++, redirect(fd, req, fp.redirect_port);
   HotEntry e;
   {
@@ -1347,6 +1417,26 @@ void handle_filer_get(FilerPlane& fp, int fd, const Request& req,
   }
   std::string ctype =
       e.mime.empty() ? "application/octet-stream" : e.mime;
+  std::string rng = req.header("range");
+  if (!rng.empty()) {
+    // clean "bytes=lo-hi" / "bytes=lo-" only, mirroring the python
+    // filer's _parse_range clamp exactly on this subset; suffix forms,
+    // multi-ranges, malformed and unsatisfiable specs defer to python
+    // (which owns the 416 / ServeContent-leniency edge semantics)
+    uint64_t start = 0, hi = 0;
+    bool has_hi = false;
+    bool clean = parse_clean_range(rng, &start, &hi, &has_hi);
+    uint64_t size = n.data_len;
+    uint64_t stop = has_hi ? (hi + 1 < size ? hi + 1 : size) : size;
+    if (!clean || start >= size || stop <= start)
+      return fp.redirects++, redirect(fd, req, fp.redirect_port);
+    extra += "Content-Range: bytes " + std::to_string(start) + "-" +
+             std::to_string(stop - 1) + "/" + std::to_string(size) +
+             "\r\n";
+    fp.native_gets++;
+    return respond(fd, req, 206, ctype, extra, n.data + start,
+                   stop - start);
+  }
   fp.native_gets++;
   respond(fd, req, 200, ctype, extra, n.data, n.data_len);
 }

@@ -208,6 +208,75 @@ def test_percent_encoded_paths_are_canonical(hot_cluster):
     assert r.status_code != 500
 
 
+def test_multipart_and_range_served_natively(hot_cluster):
+    """Round-3 VERDICT item 8: multipart form uploads and clean byte
+    ranges no longer 307 to python (the fast path widened from 67% to
+    ~93% on the mixed workload in COVERAGE.md)."""
+    _, _, fs = hot_cluster
+    before = fs.hot_plane.stats()
+    payload = b"multipart native payload" * 10
+    r = requests.post(_native_url(fs, "/buckets/wide/mp.bin"),
+                      files={"file": ("x.bin", payload)}, timeout=10)
+    assert r.status_code == 201, r.text
+    g = requests.get(_native_url(fs, "/buckets/wide/mp.bin"), timeout=10)
+    assert g.content == payload
+    # python semantics: multipart uploads store an empty mime -> GET
+    # defaults to application/octet-stream
+    assert g.headers["Content-Type"] == "application/octet-stream"
+
+    # clean ranges: lo-hi, lo-, over-long hi clamps; mirror python
+    g = requests.get(_native_url(fs, "/buckets/wide/mp.bin"),
+                     headers={"Range": "bytes=5-9"}, timeout=10)
+    assert g.status_code == 206 and g.content == payload[5:10]
+    assert g.headers["Content-Range"] == f"bytes 5-9/{len(payload)}"
+    g = requests.get(_native_url(fs, "/buckets/wide/mp.bin"),
+                     headers={"Range": "bytes=10-"}, timeout=10)
+    assert g.status_code == 206 and g.content == payload[10:]
+    g = requests.get(_native_url(fs, "/buckets/wide/mp.bin"),
+                     headers={"Range": f"bytes=0-{len(payload) * 2}"},
+                     timeout=10)
+    assert g.status_code == 206 and g.content == payload
+    after = fs.hot_plane.stats()
+    assert after["native_puts"] > before["native_puts"]
+    assert after["native_gets"] >= before["native_gets"] + 4
+    assert after["redirects"] == before["redirects"], \
+        "widened requests still redirected to python"
+
+    # unusual forms still defer to python with python's exact semantics
+    g = requests.get(_native_url(fs, "/buckets/wide/mp.bin"),
+                     headers={"Range": "bytes=-5"}, timeout=10)  # suffix
+    assert g.status_code == 206 and g.content == payload[-5:]
+    g = requests.get(_native_url(fs, "/buckets/wide/mp.bin"),
+                     headers={"Range": f"bytes={len(payload)}-"},
+                     timeout=10)
+    assert g.status_code == 416  # unsatisfiable: python owns the 416
+
+
+def test_multipart_boundary_prefix_in_content(hot_cluster):
+    """RFC 2046 only forbids the FULL delimiter line in content: a body
+    containing CRLF + a prefix of the delimiter ('\\r\\n--bonus' with
+    boundary 'b') must not be truncated at the false match."""
+    _, _, fs = hot_cluster
+    payload = b"head\r\n--bonus bytes that look like a boundary\r\ntail"
+    body = (b"--b\r\n"
+            b"Content-Disposition: form-data; name=\"file\"; "
+            b"filename=\"t.bin\"\r\n\r\n"
+            + payload +
+            b"\r\n--b--\r\n")
+    with socket.create_connection(("localhost", fs.port), timeout=10) as s:
+        s.sendall(b"POST /buckets/wide/prefix.bin HTTP/1.1\r\nHost: x\r\n"
+                  b"Content-Type: multipart/form-data; boundary=b\r\n"
+                  b"Content-Length: " + str(len(body)).encode() +
+                  b"\r\nConnection: close\r\n\r\n" + body)
+        resp = b""
+        while chunk := s.recv(4096):
+            resp += chunk
+    assert b" 201 " in resp.split(b"\r\n", 1)[0] + b" ", resp[:200]
+    g = requests.get(_native_url(fs, "/buckets/wide/prefix.bin"),
+                     timeout=10)
+    assert g.content == payload, (g.content, payload)
+
+
 def test_python_delete_invalidates_hot_entry(hot_cluster):
     _, _, fs = hot_cluster
     path = "/buckets/inval/d.txt"
