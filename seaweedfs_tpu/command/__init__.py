@@ -117,11 +117,20 @@ def main(argv=None) -> int:
     frp.add_argument("-filer", default="localhost:8888")
     frp.add_argument("-path", default="/")
     frp.add_argument("-sink", default="local",
-                     choices=["local", "filer", "s3"])
-    frp.add_argument("-sink.dir", dest="sink_dir", default="./replica")
+                     choices=["local", "filer", "s3", "gcs", "azure", "b2"])
+    frp.add_argument("-sink.dir", dest="sink_dir", default=None,
+                     help="local sink directory (default ./replica), or "
+                          "key prefix for the cloud sinks")
     frp.add_argument("-sink.filer", dest="sink_filer", default="")
     frp.add_argument("-sink.endpoint", dest="sink_endpoint", default="")
     frp.add_argument("-sink.bucket", dest="sink_bucket", default="")
+    frp.add_argument("-sink.container", dest="sink_container", default="")
+    frp.add_argument("-sink.account", dest="sink_account", default="")
+    frp.add_argument("-sink.key", dest="sink_key", default="",
+                     help="azure shared key / gcs bearer token")
+    frp.add_argument("-sink.keyId", dest="sink_key_id", default="")
+    frp.add_argument("-sink.applicationKey", dest="sink_app_key",
+                     default="")
 
     fbk = sub.add_parser("filer.backup",
                          help="one-shot backup of a filer path to a "
@@ -480,13 +489,30 @@ def _run(opts) -> int:
         from ..replication import FilerSource, Replicator, new_sink
         from ..pb import filer_pb2, rpc
 
+        prefix = opts.sink_dir or ""
         if opts.sink == "local":
-            sink = new_sink("local", directory=opts.sink_dir)
+            sink = new_sink("local", directory=opts.sink_dir or "./replica")
         elif opts.sink == "filer":
             sink = new_sink("filer", filer=opts.sink_filer)
+        elif opts.sink == "gcs":
+            sink = new_sink("gcs", bucket=opts.sink_bucket,
+                            token=opts.sink_key, directory=prefix,
+                            **({"endpoint": opts.sink_endpoint}
+                               if opts.sink_endpoint else {}))
+        elif opts.sink == "azure":
+            sink = new_sink("azure", container=opts.sink_container,
+                            account=opts.sink_account, key=opts.sink_key,
+                            directory=prefix, endpoint=opts.sink_endpoint)
+        elif opts.sink == "b2":
+            sink = new_sink("b2", bucket=opts.sink_bucket,
+                            key_id=opts.sink_key_id,
+                            application_key=opts.sink_app_key,
+                            directory=prefix,
+                            **({"endpoint": opts.sink_endpoint}
+                               if opts.sink_endpoint else {}))
         else:
             sink = new_sink("s3", endpoint=opts.sink_endpoint,
-                            bucket=opts.sink_bucket)
+                            bucket=opts.sink_bucket, directory=prefix)
         repl_ = Replicator(FilerSource(opts.filer), sink,
                            source_prefix=opts.path)
         stub = rpc.filer_stub(rpc.grpc_address(opts.filer))

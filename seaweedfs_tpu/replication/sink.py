@@ -3,9 +3,9 @@
 Rebuild of /root/reference/weed/replication/sink/ — the ReplicationSink
 interface (replication_sink.go: CreateEntry/UpdateEntry/DeleteEntry/
 GetSinkToDirectory) with the filer sink (filersink/), local sink
-(localsink/), and an S3 sink whose wire client is the S3 gateway's own
-HTTP surface, so it works against any S3 endpoint without boto3.
-(Azure/GCS/B2 sinks are gated the same way the notification queues are.)
+(localsink/), an S3 sink whose wire client is the S3 gateway's own
+HTTP surface (works against any S3 endpoint without boto3), and
+GCS/Azure/B2 sinks riding the REST wire clients in ..cloud.
 """
 
 from __future__ import annotations
@@ -172,28 +172,83 @@ class S3Sink(ReplicationSink):
                         timeout=60)
 
 
-class _GatedSink(ReplicationSink):
-    def __init__(self, name: str, module: str):
-        self.name = name
-        self._module = module
+class _CloudSink(ReplicationSink):
+    """Shared shell for object-store sinks: directory-entry skip, key
+    prefixing, mime defaulting. Subclasses only construct a ..cloud
+    client (uniform put/remove verbs)."""
+
+    default_mime = "application/octet-stream"
+
+    def __init__(self, client, directory: str):
+        self.client = client
+        self.dir = directory.strip("/")
+
+    def _key(self, path: str) -> str:
+        return (self.dir + "/" if self.dir else "") + path.lstrip("/")
 
     def create_entry(self, path, entry, data):
-        raise RuntimeError(
-            f"replication sink {self.name!r} needs {self._module}, which "
-            f"is not available in this environment")
+        if entry.is_directory:
+            return
+        self.client.put(self._key(path), data or b"",
+                        entry.attributes.mime or self.default_mime)
 
-    delete_entry = create_entry
+    def delete_entry(self, path, is_directory):
+        if is_directory:
+            return
+        self.client.remove(self._key(path))
+
+
+class GcsSink(_CloudSink):
+    """Mirror into a GCS bucket (sink/gcssink/gcs_sink.go) over the JSON
+    API wire client (..cloud.GcsClient) — no vendor SDK."""
+
+    name = "gcs"
+
+    def __init__(self, bucket: str, *, directory: str = "", token: str = "",
+                 endpoint: str = "https://storage.googleapis.com"):
+        from ..cloud import GcsClient
+
+        super().__init__(GcsClient(bucket, token=token, endpoint=endpoint),
+                         directory)
+
+
+class AzureSink(_CloudSink):
+    """Mirror into an Azure container (sink/azuresink/azure_sink.go) with
+    SharedKey-signed REST calls (..cloud.AzureBlobClient)."""
+
+    name = "azure"
+
+    def __init__(self, container: str, *, account: str, key: str,
+                 directory: str = "", endpoint: str = ""):
+        from ..cloud import AzureBlobClient
+
+        super().__init__(AzureBlobClient(container, account=account,
+                                         key=key, endpoint=endpoint),
+                         directory)
+
+
+class B2Sink(_CloudSink):
+    """Mirror into a B2 bucket (sink/b2sink/b2_sink.go) over the native
+    API (..cloud.B2Client): authorize/upload-url dance, sha1-verified
+    uploads, versioned deletes."""
+
+    name = "b2"
+    default_mime = "b2/x-auto"
+
+    def __init__(self, bucket: str, *, key_id: str, application_key: str,
+                 directory: str = "",
+                 endpoint: str = "https://api.backblazeb2.com"):
+        from ..cloud import B2Client
+
+        super().__init__(B2Client(bucket, key_id=key_id,
+                                  application_key=application_key,
+                                  endpoint=endpoint), directory)
 
 
 def new_sink(kind: str, **kwargs) -> ReplicationSink:
-    if kind == "filer":
-        return FilerSink(**kwargs)
-    if kind == "local":
-        return LocalSink(**kwargs)
-    if kind == "s3":
-        return S3Sink(**kwargs)
-    if kind in ("gcs", "azure", "b2"):
-        return _GatedSink(kind, {"gcs": "google-cloud-storage",
-                                 "azure": "azure-storage-blob",
-                                 "b2": "b2sdk"}[kind])
-    raise KeyError(f"unknown sink {kind!r}")
+    sinks = {"filer": FilerSink, "local": LocalSink, "s3": S3Sink,
+             "gcs": GcsSink, "azure": AzureSink, "b2": B2Sink}
+    cls = sinks.get(kind)
+    if cls is None:
+        raise KeyError(f"unknown sink {kind!r}")
+    return cls(**kwargs)

@@ -14,7 +14,11 @@ from ..registry import command, kv_flags as _kv
 
 
 @command("remote.configure",
-         "remote.configure -name=x -type=local|s3 [-root=...|-endpoint=...]")
+         "remote.configure -name=x -type=local|s3|gcs|azure|b2 "
+         "[-root=... | -endpoint=... -bucket=... -access_key=... "
+         "-secret_key=... | -bucket=... -token=... | -container=... "
+         "-account=... -key=... | -bucket=... -key_id=... "
+         "-application_key=...]")
 def remote_configure(env, args, out):
     opts = _kv(args)
     conf = RemoteConf(env.require_filer())

@@ -1,0 +1,160 @@
+"""In-process fake Kafka broker: Metadata v0 + Produce v0.
+
+Independently decodes the binary framing the producer in
+seaweedfs_tpu/notification/kafka_wire.py emits — including the
+MessageSet CRC, which is recomputed and enforced — and stores
+(key, value) per partition so tests can assert delivery.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import zlib
+
+
+class FakeKafkaBroker:
+    def __init__(self, topic: str = "seaweedfs_filer", partitions: int = 3):
+        self.topic = topic
+        self.npartitions = partitions
+        self.messages: dict[int, list[tuple[bytes, bytes]]] = {
+            i: [] for i in range(partitions)}
+        self.crc_failures = 0
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(8)
+        self.port = self._srv.getsockname()[1]
+        self._stop = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def close(self):
+        self._stop = True
+        try:
+            self._srv.close()
+        except OSError:
+            pass
+
+    # -- server loop
+
+    def _accept_loop(self):
+        while not self._stop:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        try:
+            while True:
+                hdr = self._recv(conn, 4)
+                if hdr is None:
+                    return
+                (size,) = struct.unpack(">i", hdr)
+                req = self._recv(conn, size)
+                if req is None:
+                    return
+                api_key, api_version, corr = struct.unpack_from(">hhi", req)
+                off = 8
+                (cid_len,) = struct.unpack_from(">h", req, off)
+                off += 2 + cid_len
+                if api_key == 3 and api_version == 0:
+                    resp = self._metadata(req, off)
+                elif api_key == 0 and api_version == 0:
+                    resp = self._produce(req, off)
+                else:
+                    return
+                out = struct.pack(">i", corr) + resp
+                conn.sendall(struct.pack(">i", len(out)) + out)
+        except OSError:
+            pass
+        finally:
+            conn.close()
+
+    @staticmethod
+    def _recv(conn: socket.socket, n: int) -> bytes | None:
+        out = b""
+        while len(out) < n:
+            chunk = conn.recv(n - len(out))
+            if not chunk:
+                return None
+            out += chunk
+        return out
+
+    # -- RPC handlers
+
+    def _metadata(self, req: bytes, off: int) -> bytes:
+        def s(x: str) -> bytes:
+            b = x.encode()
+            return struct.pack(">h", len(b)) + b
+
+        # one broker (us), one topic, npartitions with leader 0
+        out = struct.pack(">i", 1)                        # brokers
+        out += struct.pack(">i", 0) + s("127.0.0.1") + \
+            struct.pack(">i", self.port)
+        out += struct.pack(">i", 1)                       # topics
+        out += struct.pack(">h", 0) + s(self.topic)
+        out += struct.pack(">i", self.npartitions)
+        for pid in range(self.npartitions):
+            out += struct.pack(">hii", 0, pid, 0)         # err, id, leader
+            out += struct.pack(">i", 1) + struct.pack(">i", 0)   # replicas
+            out += struct.pack(">i", 1) + struct.pack(">i", 0)   # isr
+        return out
+
+    def _produce(self, req: bytes, off: int) -> bytes:
+        _acks, _timeout = struct.unpack_from(">hi", req, off)
+        off += 6
+        (ntopics,) = struct.unpack_from(">i", req, off)
+        off += 4
+        resp_topics = b""
+        for _ in range(ntopics):
+            (tlen,) = struct.unpack_from(">h", req, off)
+            off += 2
+            topic = req[off:off + tlen].decode()
+            off += tlen
+            (nparts,) = struct.unpack_from(">i", req, off)
+            off += 4
+            parts_out = b""
+            for _ in range(nparts):
+                pid, ms_size = struct.unpack_from(">ii", req, off)
+                off += 8
+                ms = req[off:off + ms_size]
+                off += ms_size
+                err, offset = self._ingest(topic, pid, ms)
+                parts_out += struct.pack(">ihq", pid, err, offset)
+            resp_topics += (struct.pack(">h", tlen) + topic.encode() +
+                            struct.pack(">i", nparts) + parts_out)
+        return struct.pack(">i", ntopics) + resp_topics
+
+    def _ingest(self, topic: str, pid: int, ms: bytes) -> tuple[int, int]:
+        if topic != self.topic or pid not in self.messages:
+            return 3, -1                       # UNKNOWN_TOPIC_OR_PARTITION
+        off = 0
+        last = -1
+        while off + 12 <= len(ms):
+            _offset, msize = struct.unpack_from(">qi", ms, off)
+            off += 12
+            msg = ms[off:off + msize]
+            off += msize
+            (crc,) = struct.unpack_from(">I", msg, 0)
+            if zlib.crc32(msg[4:]) & 0xFFFFFFFF != crc:
+                self.crc_failures += 1
+                return 2, -1                   # CORRUPT_MESSAGE
+            p = 6                              # crc4 + magic1 + attrs1
+            (klen,) = struct.unpack_from(">i", msg, p)
+            p += 4
+            key = msg[p:p + klen] if klen >= 0 else b""
+            p += max(klen, 0)
+            (vlen,) = struct.unpack_from(">i", msg, p)
+            p += 4
+            value = msg[p:p + vlen] if vlen >= 0 else b""
+            self.messages[pid].append((key, value))
+            last = len(self.messages[pid]) - 1
+        return 0, last

@@ -72,7 +72,7 @@ def test_notification_registry_and_config():
     assert isinstance(q, MemoryQueue)
     assert load_configuration({"notification": {}}) is None
     with pytest.raises(RuntimeError):
-        QUEUES["kafka"].initialize({})
+        QUEUES["gocdk_pub_sub"].initialize({})
 
 
 def test_memory_queue_roundtrip():
