@@ -16,10 +16,10 @@ _GATED = {
     # redis/redis2 are REAL now: stores/redis.py speaks RESP itself
     "redis3": "redis-py (sharded key layout; redis/redis2 are live)",
     "redis_lua": "redis-py",
+    # postgres/postgres2 are REAL now: stores/pg_wire.py speaks the v3
+    # wire protocol itself (extended query + SCRAM auth)
     "mysql": "mysql-connector / PyMySQL",
     "mysql2": "mysql-connector / PyMySQL",
-    "postgres": "psycopg2",
-    "postgres2": "psycopg2",
     "cassandra": "cassandra-driver",
     "mongodb": "pymongo",
     "elastic": "elasticsearch",

@@ -1,0 +1,368 @@
+"""Pure-python PostgreSQL wire-protocol (v3) client, DB-API flavored.
+
+Rebuild of the client side the reference gets from lib/pq
+(/root/reference/weed/filer/postgres/postgres_store.go:1 imports
+_ "github.com/lib/pq"): no psycopg2 in this image, so the store speaks
+the v3 protocol itself, the same way stores/redis.py speaks RESP.
+
+Scope — exactly what AbstractSqlStore needs, implemented on the real
+wire format so the same code path talks to an actual postgres:
+
+  * StartupMessage + auth: trust, cleartext (3), md5 (5), and
+    SCRAM-SHA-256 (10/11/12, RFC 7677 via hashlib.pbkdf2_hmac)
+  * extended query protocol: Parse/Bind/Describe/Execute/Sync —
+    ``%s`` DB-API placeholders are rewritten to ``$N``; parameters are
+    sent with per-parameter format codes (text for str, binary for
+    bytes) so bytea round-trips without hex-escaping games
+  * all-binary result columns, decoded by RowDescription type OID
+    (text/varchar/name -> str, bytea -> bytes, int2/4/8 -> int)
+  * one statement per Sync; errors surface as PgError with the
+    server's SQLSTATE + message
+
+Transactions: like the reference's database/sql usage, statements
+autocommit; ``commit()`` is a no-op kept for DB-API shape.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import socket
+import struct
+import threading
+
+
+class PgError(Exception):
+    def __init__(self, fields: dict[str, str]):
+        self.sqlstate = fields.get("C", "")
+        self.message = fields.get("M", "postgres error")
+        super().__init__(f"{self.sqlstate}: {self.message}")
+
+
+# binary-format decoders by type OID
+_OID_TEXT = {25, 1043, 19, 18, 2275}   # text, varchar, name, char, cstring
+_OID_BYTEA = 17
+_OID_INT = {20: 8, 23: 4, 21: 2}       # int8/int4/int2
+_OID_BOOL = 16
+
+
+def _decode_col(oid: int, data: bytes | None):
+    if data is None:
+        return None
+    if oid == _OID_BYTEA:
+        return bytes(data)
+    if oid in _OID_INT:
+        return int.from_bytes(data, "big", signed=True)
+    if oid == _OID_BOOL:
+        return data != b"\x00"
+    if oid in _OID_TEXT:
+        return data.decode("utf-8", errors="replace")
+    return bytes(data)  # unknown: hand back raw
+
+
+def _rewrite_placeholders(sql: str) -> str:
+    """%s -> $1..$N, skipping string literals ('...' with '' escapes)."""
+    out, n, i = [], 0, 0
+    in_str = False
+    while i < len(sql):
+        ch = sql[i]
+        if in_str:
+            out.append(ch)
+            if ch == "'":
+                in_str = False
+            i += 1
+        elif ch == "'":
+            in_str = True
+            out.append(ch)
+            i += 1
+        elif ch == "%" and i + 1 < len(sql) and sql[i + 1] == "s":
+            n += 1
+            out.append(f"${n}")
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)
+
+
+class PgCursor:
+    def __init__(self, conn: "PgConnection"):
+        self._conn = conn
+        self._rows: list[tuple] = []
+        self._idx = 0
+        self.rowcount = -1
+
+    def execute(self, sql: str, params: tuple = ()) -> "PgCursor":
+        self._rows, self.rowcount = self._conn._query(sql, tuple(params))
+        self._idx = 0
+        return self
+
+    def fetchone(self):
+        if self._idx >= len(self._rows):
+            return None
+        row = self._rows[self._idx]
+        self._idx += 1
+        return row
+
+    def fetchall(self) -> list[tuple]:
+        rows = self._rows[self._idx:]
+        self._idx = len(self._rows)
+        return rows
+
+    def close(self) -> None:
+        self._rows = []
+
+
+class PgConnection:
+    def __init__(self, *, host="localhost", port=5432, user="postgres",
+                 password="", dbname="seaweedfs", connect_timeout=10,
+                 application_name="seaweedfs_tpu", **_ignored):
+        self.user = user
+        self.password = password
+        self._host, self._port = host, int(port)
+        self._dbname, self._appname = dbname, application_name
+        self._connect_timeout = connect_timeout
+        self._lock = threading.Lock()
+        self._sock: socket.socket | None = None
+        self._buf = b""
+        self._connect()
+
+    def _connect(self) -> None:
+        self._sock = socket.create_connection(
+            (self._host, self._port), timeout=self._connect_timeout)
+        self._sock.settimeout(30)
+        self._buf = b""
+        self._startup(self.user, self._dbname, self._appname)
+
+    def _mark_broken(self) -> None:
+        """A socket error mid-exchange leaves the stream desynchronized —
+        drop the connection so the next query reconnects cleanly."""
+        try:
+            if self._sock is not None:
+                self._sock.close()
+        except OSError:
+            pass
+        self._sock = None
+        self._buf = b""
+
+    # -- wire primitives ---------------------------------------------------
+
+    def _send(self, type_byte: bytes, payload: bytes) -> None:
+        self._sock.sendall(type_byte + struct.pack(">I", len(payload) + 4)
+                           + payload)
+
+    def _recv_exact(self, n: int) -> bytes:
+        while len(self._buf) < n:
+            chunk = self._sock.recv(65536)
+            if not chunk:
+                raise ConnectionError("postgres server closed connection")
+            self._buf += chunk
+        out, self._buf = self._buf[:n], self._buf[n:]
+        return out
+
+    def _recv_msg(self) -> tuple[bytes, bytes]:
+        head = self._recv_exact(5)
+        tag = head[:1]
+        (length,) = struct.unpack(">I", head[1:5])
+        return tag, self._recv_exact(length - 4)
+
+    # -- startup + auth ----------------------------------------------------
+
+    def _startup(self, user: str, dbname: str, appname: str) -> None:
+        kv = (f"user\0{user}\0database\0{dbname}\0"
+              f"application_name\0{appname}\0client_encoding\0UTF8\0\0")
+        payload = struct.pack(">I", 196608) + kv.encode()
+        self._sock.sendall(struct.pack(">I", len(payload) + 4) + payload)
+        scram = None
+        while True:
+            tag, body = self._recv_msg()
+            if tag == b"E":
+                raise PgError(self._parse_error(body))
+            if tag == b"R":
+                (code,) = struct.unpack(">I", body[:4])
+                if code == 0:            # AuthenticationOk
+                    continue
+                if code == 3:            # cleartext password
+                    self._send(b"p", self.password.encode() + b"\0")
+                elif code == 5:          # md5
+                    salt = body[4:8]
+                    inner = hashlib.md5(
+                        self.password.encode() + self.user.encode()
+                    ).hexdigest()
+                    digest = hashlib.md5(
+                        inner.encode() + salt).hexdigest()
+                    self._send(b"p", b"md5" + digest.encode() + b"\0")
+                elif code == 10:         # SASL: mechanism list
+                    mechs = body[4:].split(b"\0")
+                    if b"SCRAM-SHA-256" not in mechs:
+                        raise PgError({"M": "no supported SASL mechanism",
+                                       "C": "28000"})
+                    scram = _ScramClient(self.password)
+                    first = scram.client_first()
+                    self._send(b"p", b"SCRAM-SHA-256\0"
+                               + struct.pack(">I", len(first)) + first)
+                elif code == 11:         # SASL continue
+                    final = scram.client_final(body[4:])
+                    self._send(b"p", final)
+                elif code == 12:         # SASL final
+                    scram.verify_server(body[4:])
+                else:
+                    raise PgError({"M": f"unsupported auth code {code}",
+                                   "C": "28000"})
+            elif tag == b"Z":            # ReadyForQuery
+                return
+            # S (ParameterStatus), K (BackendKeyData), N (Notice): skip
+
+    # -- extended-protocol query ------------------------------------------
+
+    def _query(self, sql: str, params: tuple) -> tuple[list[tuple], int]:
+        pg_sql = _rewrite_placeholders(sql)
+        with self._lock:
+            if self._sock is None:
+                self._connect()
+            try:
+                return self._query_locked(pg_sql, params)
+            except (OSError, ConnectionError):
+                self._mark_broken()
+                raise
+
+    def _query_locked(self, pg_sql: str,
+                      params: tuple) -> tuple[list[tuple], int]:
+        # Parse (unnamed statement)
+        self._send(b"P", b"\0" + pg_sql.encode() + b"\0"
+                   + struct.pack(">h", 0))
+        # Bind: per-param format codes, all-binary results
+        parts = [b"\0\0", struct.pack(">h", len(params))]
+        for p in params:
+            parts.append(struct.pack(
+                ">h", 1 if isinstance(p, (bytes, bytearray, memoryview))
+                else 0))
+        parts.append(struct.pack(">h", len(params)))
+        for p in params:
+            if p is None:
+                parts.append(struct.pack(">i", -1))
+                continue
+            if isinstance(p, (bytes, bytearray, memoryview)):
+                raw = bytes(p)
+            elif isinstance(p, bool):
+                raw = b"true" if p else b"false"
+            else:
+                raw = str(p).encode("utf-8")
+            parts.append(struct.pack(">i", len(raw)) + raw)
+        parts.append(struct.pack(">hh", 1, 1))  # results: binary
+        self._send(b"B", b"".join(parts))
+        self._send(b"D", b"P\0")     # Describe portal
+        self._send(b"E", b"\0" + struct.pack(">i", 0))
+        self._send(b"S", b"")        # Sync
+        rows: list[tuple] = []
+        oids: list[int] = []
+        rowcount = -1
+        err: dict[str, str] | None = None
+        while True:
+            tag, body = self._recv_msg()
+            if tag == b"T":          # RowDescription
+                (ncols,) = struct.unpack(">h", body[:2])
+                off = 2
+                oids = []
+                for _ in range(ncols):
+                    end = body.index(b"\0", off)
+                    off = end + 1 + 18
+                    (oid,) = struct.unpack(">I", body[end + 7:end + 11])
+                    oids.append(oid)
+            elif tag == b"D":        # DataRow
+                (ncols,) = struct.unpack(">h", body[:2])
+                off = 2
+                vals = []
+                for ci in range(ncols):
+                    (ln,) = struct.unpack(">i", body[off:off + 4])
+                    off += 4
+                    if ln < 0:
+                        vals.append(None)
+                    else:
+                        oid = oids[ci] if ci < len(oids) else 17
+                        vals.append(_decode_col(oid, body[off:off + ln]))
+                        off += ln
+                rows.append(tuple(vals))
+            elif tag == b"C":        # CommandComplete
+                words = body.rstrip(b"\0").split()
+                if words and words[-1].isdigit():
+                    rowcount = int(words[-1])
+            elif tag == b"E":
+                err = self._parse_error(body)
+            elif tag == b"Z":        # ReadyForQuery — done
+                break
+            # 1/2/n/s (ParseComplete/BindComplete/NoData/Suspended): skip
+        if err is not None:
+            raise PgError(err)
+        return rows, rowcount
+
+    @staticmethod
+    def _parse_error(body: bytes) -> dict[str, str]:
+        fields: dict[str, str] = {}
+        off = 0
+        while off < len(body) and body[off:off + 1] != b"\0":
+            code = chr(body[off])
+            end = body.index(b"\0", off + 1)
+            fields[code] = body[off + 1:end].decode("utf-8", "replace")
+            off = end + 1
+        return fields
+
+    # -- DB-API shape ------------------------------------------------------
+
+    def cursor(self) -> PgCursor:
+        return PgCursor(self)
+
+    def commit(self) -> None:
+        pass  # autocommit, one statement per Sync
+
+    def close(self) -> None:
+        try:
+            self._send(b"X", b"")        # Terminate
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+
+class _ScramClient:
+    """Client side of SCRAM-SHA-256 (RFC 5802/7677)."""
+
+    def __init__(self, password: str):
+        self.password = password.encode("utf-8")
+        self.nonce = base64.b64encode(os.urandom(18)).decode()
+        self.first_bare = f"n=,r={self.nonce}"
+        self.server_sig: bytes | None = None
+
+    def client_first(self) -> bytes:
+        return ("n,," + self.first_bare).encode()
+
+    def client_final(self, server_first: bytes) -> bytes:
+        sf = server_first.decode()
+        attrs = dict(kv.split("=", 1) for kv in sf.split(","))
+        r, salt, iters = attrs["r"], base64.b64decode(attrs["s"]), \
+            int(attrs["i"])
+        if not r.startswith(self.nonce):
+            raise PgError({"M": "SCRAM server nonce mismatch", "C": "28000"})
+        salted = hashlib.pbkdf2_hmac("sha256", self.password, salt, iters)
+        client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored_key = hashlib.sha256(client_key).digest()
+        final_bare = f"c=biws,r={r}"
+        auth_msg = ",".join([self.first_bare, sf, final_bare]).encode()
+        client_sig = hmac.new(stored_key, auth_msg, hashlib.sha256).digest()
+        proof = bytes(a ^ b for a, b in zip(client_key, client_sig))
+        server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        self.server_sig = hmac.new(server_key, auth_msg,
+                                   hashlib.sha256).digest()
+        return (final_bare
+                + ",p=" + base64.b64encode(proof).decode()).encode()
+
+    def verify_server(self, server_final: bytes) -> None:
+        attrs = dict(kv.split("=", 1)
+                     for kv in server_final.decode().split(","))
+        if base64.b64decode(attrs.get("v", "")) != self.server_sig:
+            raise PgError({"M": "SCRAM server signature mismatch",
+                           "C": "28000"})
