@@ -179,13 +179,11 @@ class MySqlDialect(SqlDialect):
                 f"ON DUPLICATE KEY UPDATE v=VALUES(v)")
 
     def connect(self):
-        try:
-            import pymysql
-        except ImportError:
-            raise RuntimeError(
-                "the mysql filer store needs pymysql, which is not "
-                "installed in this environment")
-        return pymysql.connect(**self.kwargs)
+        # no pymysql in this image: speak the client/server protocol
+        # directly (mysql_wire.MySqlConnection)
+        from .mysql_wire import MySqlConnection
+
+        return MySqlConnection(**self.kwargs)
 
 
 class PostgresDialect(SqlDialect):
@@ -468,6 +466,14 @@ def _postgres2_store(**kwargs) -> AbstractSqlStore:
     return store
 
 
+def _mysql2_store(**kwargs) -> AbstractSqlStore:
+    store = AbstractSqlStore(MySqlDialect(**kwargs),
+                             support_bucket_table=True)
+    store.name = "mysql2"
+    return store
+
+
 register_store("mysql", _mysql_store)
+register_store("mysql2", _mysql2_store)
 register_store("postgres", _postgres_store)
 register_store("postgres2", _postgres2_store)

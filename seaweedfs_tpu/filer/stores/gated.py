@@ -17,9 +17,8 @@ _GATED = {
     "redis3": "redis-py (sharded key layout; redis/redis2 are live)",
     "redis_lua": "redis-py",
     # postgres/postgres2 are REAL now: stores/pg_wire.py speaks the v3
-    # wire protocol itself (extended query + SCRAM auth)
-    "mysql": "mysql-connector / PyMySQL",
-    "mysql2": "mysql-connector / PyMySQL",
+    # wire protocol itself (extended query + SCRAM auth); mysql/mysql2
+    # likewise via stores/mysql_wire.py (binary prepared statements)
     "cassandra": "cassandra-driver",
     "mongodb": "pymongo",
     "elastic": "elasticsearch",

@@ -33,6 +33,8 @@ import socket
 import struct
 import threading
 
+from .wire_common import WireCursor, rewrite_placeholders
+
 
 class PgError(Exception):
     def __init__(self, fields: dict[str, str]):
@@ -62,57 +64,8 @@ def _decode_col(oid: int, data: bytes | None):
     return bytes(data)  # unknown: hand back raw
 
 
-def _rewrite_placeholders(sql: str) -> str:
-    """%s -> $1..$N, skipping string literals ('...' with '' escapes)."""
-    out, n, i = [], 0, 0
-    in_str = False
-    while i < len(sql):
-        ch = sql[i]
-        if in_str:
-            out.append(ch)
-            if ch == "'":
-                in_str = False
-            i += 1
-        elif ch == "'":
-            in_str = True
-            out.append(ch)
-            i += 1
-        elif ch == "%" and i + 1 < len(sql) and sql[i + 1] == "s":
-            n += 1
-            out.append(f"${n}")
-            i += 2
-        else:
-            out.append(ch)
-            i += 1
-    return "".join(out)
-
-
-class PgCursor:
-    def __init__(self, conn: "PgConnection"):
-        self._conn = conn
-        self._rows: list[tuple] = []
-        self._idx = 0
-        self.rowcount = -1
-
-    def execute(self, sql: str, params: tuple = ()) -> "PgCursor":
-        self._rows, self.rowcount = self._conn._query(sql, tuple(params))
-        self._idx = 0
-        return self
-
-    def fetchone(self):
-        if self._idx >= len(self._rows):
-            return None
-        row = self._rows[self._idx]
-        self._idx += 1
-        return row
-
-    def fetchall(self) -> list[tuple]:
-        rows = self._rows[self._idx:]
-        self._idx = len(self._rows)
-        return rows
-
-    def close(self) -> None:
-        self._rows = []
+class PgCursor(WireCursor):
+    pass
 
 
 class PgConnection:
@@ -134,7 +87,12 @@ class PgConnection:
             (self._host, self._port), timeout=self._connect_timeout)
         self._sock.settimeout(30)
         self._buf = b""
-        self._startup(self.user, self._dbname, self._appname)
+        try:
+            self._startup(self.user, self._dbname, self._appname)
+        except Exception:
+            # never keep a half-authenticated socket for the next query
+            self._mark_broken()
+            raise
 
     def _mark_broken(self) -> None:
         """A socket error mid-exchange leaves the stream desynchronized —
@@ -218,7 +176,7 @@ class PgConnection:
     # -- extended-protocol query ------------------------------------------
 
     def _query(self, sql: str, params: tuple) -> tuple[list[tuple], int]:
-        pg_sql = _rewrite_placeholders(sql)
+        pg_sql = rewrite_placeholders(sql, lambda n: f"${n}")
         with self._lock:
             if self._sock is None:
                 self._connect()

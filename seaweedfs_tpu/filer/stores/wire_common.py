@@ -1,0 +1,63 @@
+"""Shared pieces of the wire-protocol DB clients (pg_wire, mysql_wire):
+the DB-API cursor shell and the %s-placeholder rewriter."""
+
+from __future__ import annotations
+
+from typing import Callable
+
+
+class WireCursor:
+    """Minimal DB-API cursor over a connection exposing
+    ``_query(sql, params) -> (rows, rowcount)``."""
+
+    def __init__(self, conn):
+        self._conn = conn
+        self._rows: list[tuple] = []
+        self._idx = 0
+        self.rowcount = -1
+
+    def execute(self, sql: str, params: tuple = ()) -> "WireCursor":
+        self._rows, self.rowcount = self._conn._query(sql, tuple(params))
+        self._idx = 0
+        return self
+
+    def fetchone(self):
+        if self._idx >= len(self._rows):
+            return None
+        row = self._rows[self._idx]
+        self._idx += 1
+        return row
+
+    def fetchall(self) -> list[tuple]:
+        rows = self._rows[self._idx:]
+        self._idx = len(self._rows)
+        return rows
+
+    def close(self) -> None:
+        self._rows = []
+
+
+def rewrite_placeholders(sql: str, token: Callable[[int], str]) -> str:
+    """Replace DB-API ``%s`` placeholders outside '...' string literals
+    with ``token(n)`` (1-based): ``lambda n: "?"`` for mysql,
+    ``lambda n: f"${n}"`` for postgres."""
+    out, n, i, in_str = [], 0, 0, False
+    while i < len(sql):
+        ch = sql[i]
+        if in_str:
+            out.append(ch)
+            if ch == "'":
+                in_str = False
+            i += 1
+        elif ch == "'":
+            in_str = True
+            out.append(ch)
+            i += 1
+        elif ch == "%" and i + 1 < len(sql) and sql[i + 1] == "s":
+            n += 1
+            out.append(token(n))
+            i += 2
+        else:
+            out.append(ch)
+            i += 1
+    return "".join(out)

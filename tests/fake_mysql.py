@@ -1,0 +1,385 @@
+"""In-process pure-python MySQL protocol server backed by sqlite: enough
+of handshake-v10 auth (mysql_native_password, verified with independent
+scramble math), COM_QUERY text resultsets, and the COM_STMT_PREPARE /
+COM_STMT_EXECUTE binary protocol to exercise the real mysql filer store
+(seaweedfs_tpu/filer/stores/mysql_wire.py) end to end. MySQL-only SQL
+(ON DUPLICATE KEY UPDATE, CHARACTER SET, information_schema.tables) is
+translated to sqlite at execution time."""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import re
+import socket
+import sqlite3
+import struct
+import threading
+
+
+def _scramble(password: str, salt: bytes) -> bytes:
+    if not password:
+        return b""
+    h1 = hashlib.sha1(password.encode()).digest()
+    h2 = hashlib.sha1(h1).digest()
+    h3 = hashlib.sha1(salt + h2).digest()
+    return bytes(a ^ b for a, b in zip(h1, h3))
+
+
+def _lenenc_int(n: int) -> bytes:
+    if n < 0xfb:
+        return bytes([n])
+    if n < 1 << 16:
+        return b"\xfc" + struct.pack("<H", n)
+    if n < 1 << 24:
+        return b"\xfd" + struct.pack("<I", n)[:3]
+    return b"\xfe" + struct.pack("<Q", n)
+
+
+def _lenenc_bytes(b: bytes) -> bytes:
+    return _lenenc_int(len(b)) + b
+
+
+def _read_lenenc_int(buf: bytes, off: int) -> tuple[int, int]:
+    c = buf[off]
+    if c < 0xfb:
+        return c, off + 1
+    if c == 0xfc:
+        return struct.unpack_from("<H", buf, off + 1)[0], off + 3
+    if c == 0xfd:
+        return int.from_bytes(buf[off + 1:off + 4], "little"), off + 4
+    return struct.unpack_from("<Q", buf, off + 1)[0], off + 9
+
+
+def _read_lenenc_bytes(buf: bytes, off: int) -> tuple[bytes, int]:
+    n, off = _read_lenenc_int(buf, off)
+    return buf[off:off + n], off + n
+
+
+T_TINY, T_LONGLONG, T_DOUBLE = 1, 8, 5
+T_VAR_STRING, T_BLOB = 253, 252
+
+
+def translate_sql(sql: str) -> str:
+    """MySQL dialect -> sqlite (test-infra translation, not product)."""
+    out = re.sub(r"\s*CHARACTER SET \w+", "", sql, flags=re.I)
+    # information_schema.tables -> sqlite_master
+    out = re.sub(
+        r"information_schema\.tables", "_information_schema_tables",
+        out, flags=re.I)
+    out = re.sub(r"\btable_name\b", "name", out, flags=re.I)
+    # mysql's default LIKE escape is backslash; sqlite needs it explicit
+    if re.search(r"LIKE\s+'[^']*\\\\?_[^']*'", out) and "ESCAPE" not in out:
+        out = re.sub(r"(LIKE\s+'[^']*')", r"\1 ESCAPE '\\'", out,
+                     flags=re.I)
+    # ON DUPLICATE KEY UPDATE c=VALUES(c)[, ...] -> ON CONFLICT upsert;
+    # conflict target = insert columns minus the updated ones
+    m = re.search(r"INSERT INTO\s+`?([^`(\s]+)`?\s*\(([^)]*)\)(.*?)"
+                  r"ON DUPLICATE KEY UPDATE\s+(.*)$", out,
+                  flags=re.I | re.S)
+    if m:
+        cols = [c.strip().strip("`") for c in m.group(2).split(",")]
+        updates = re.findall(r"`?(\w+)`?\s*=\s*VALUES\(`?\w+`?\)",
+                             m.group(4))
+        target = [c for c in cols if c not in updates]
+        sets = ", ".join(f"{u}=excluded.{u}" for u in updates)
+        out = (f"INSERT INTO `{m.group(1)}`({m.group(2)}){m.group(3)}"
+               f"ON CONFLICT({', '.join(target)}) DO UPDATE SET {sets}")
+    return out
+
+
+class FakeMySqlServer:
+    def __init__(self, *, user: str = "root", password: str = ""):
+        self.user = user
+        self.password = password
+        self.db = sqlite3.connect(":memory:", check_same_thread=False)
+        # catalog shim for information_schema.tables lookups
+        self.db.execute(
+            "CREATE VIEW _information_schema_tables AS SELECT name "
+            "FROM sqlite_master WHERE type='table'")
+        self._dblock = threading.Lock()
+        self._listen = socket.socket()
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind(("localhost", 0))
+        self._listen.listen(8)
+        self.port = self._listen.getsockname()[1]
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listen.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    # -- framing -----------------------------------------------------------
+
+    class _Conn:
+        def __init__(self, sock: socket.socket):
+            self.sock = sock
+            self.buf = b""
+            self.seq = 0
+            self.stmts: dict[int, tuple[str, int]] = {}
+            self.next_stmt = 1
+
+        def recv_exact(self, n: int) -> bytes:
+            while len(self.buf) < n:
+                chunk = self.sock.recv(65536)
+                if not chunk:
+                    raise ConnectionError("client gone")
+                self.buf += chunk
+            out, self.buf = self.buf[:n], self.buf[n:]
+            return out
+
+        def read_packet(self) -> bytes:
+            head = self.recv_exact(4)
+            length = int.from_bytes(head[:3], "little")
+            self.seq = head[3] + 1
+            return self.recv_exact(length)
+
+        def send_packet(self, payload: bytes) -> None:
+            self.sock.sendall(len(payload).to_bytes(3, "little")
+                              + bytes([self.seq & 0xff]) + payload)
+            self.seq += 1
+
+    def _ok(self, c: "_Conn", affected: int = 0) -> None:
+        c.send_packet(b"\x00" + _lenenc_int(affected) + _lenenc_int(0)
+                      + struct.pack("<HH", 2, 0))
+
+    def _err(self, c: "_Conn", code: int, msg: str) -> None:
+        c.send_packet(b"\xff" + struct.pack("<H", code) + b"#HY000"
+                      + msg.encode())
+
+    def _eof(self, c: "_Conn") -> None:
+        c.send_packet(b"\xfe" + struct.pack("<HH", 0, 2))
+
+    # -- serve -------------------------------------------------------------
+
+    def _serve(self, sock: socket.socket) -> None:
+        c = self._Conn(sock)
+        try:
+            # real MySQL salts are NUL-free printable bytes; a NUL here
+            # would be rstripped by clients and break the scramble
+            salt = bytes(33 + b % 94 for b in os.urandom(20))
+            greeting = (bytes([10]) + b"8.0.fake\0"
+                        + struct.pack("<I", os.getpid() & 0xffffffff)
+                        + salt[:8] + b"\0"
+                        + struct.pack("<H", 0xffff) + bytes([33])
+                        + struct.pack("<H", 2) + struct.pack("<H", 0x000f)
+                        + bytes([21]) + b"\0" * 10
+                        + salt[8:] + b"\0"
+                        + b"mysql_native_password\0")
+            c.seq = 0
+            c.send_packet(greeting)
+            resp = c.read_packet()
+            off = 4 + 4 + 1 + 23
+            end = resp.index(b"\0", off)
+            user = resp[off:end].decode()
+            off = end + 1
+            alen = resp[off]
+            token = resp[off + 1:off + 1 + alen]
+            if user != self.user or token != _scramble(self.password, salt):
+                self._err(c, 1045, f"Access denied for user '{user}'")
+                return
+            self._ok(c)
+            while not self._stop.is_set():
+                pkt = c.read_packet()
+                cmd = pkt[0]
+                if cmd == 0x01:            # COM_QUIT
+                    return
+                if cmd == 0x03:            # COM_QUERY
+                    self._com_query(c, pkt[1:].decode("utf-8"))
+                elif cmd == 0x16:          # COM_STMT_PREPARE
+                    self._stmt_prepare(c, pkt[1:].decode("utf-8"))
+                elif cmd == 0x17:          # COM_STMT_EXECUTE
+                    self._stmt_execute(c, pkt)
+                elif cmd == 0x19:          # COM_STMT_CLOSE (no response)
+                    (sid,) = struct.unpack_from("<I", pkt, 1)
+                    c.stmts.pop(sid, None)
+                elif cmd == 0x0e:          # COM_PING
+                    self._ok(c)
+                else:
+                    self._err(c, 1047, f"unknown command {cmd}")
+        except (ConnectionError, OSError, struct.error, ValueError):
+            pass
+        finally:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+    # -- command handlers --------------------------------------------------
+
+    def _run_sql(self, sql: str, args: list):
+        with self._dblock:
+            cur = self.db.cursor()
+            cur.execute(translate_sql(sql), args)
+            rows = cur.fetchall() if cur.description else []
+            desc = cur.description
+            affected = cur.rowcount
+            self.db.commit()
+        return rows, desc, affected
+
+    def _com_query(self, c: "_Conn", sql: str) -> None:
+        try:
+            rows, desc, affected = self._run_sql(sql, [])
+        except sqlite3.Error as e:
+            self._err(c, 1064, f"sqlite: {e}")
+            return
+        if not desc:
+            self._ok(c, max(affected, 0))
+            return
+        self._send_resultset(c, desc, rows, binary=False)
+
+    def _stmt_prepare(self, c: "_Conn", sql: str) -> None:
+        nparams = self._count_params(sql)
+        sid = c.next_stmt
+        c.next_stmt += 1
+        c.stmts[sid] = (sql, nparams)
+        c.send_packet(b"\x00" + struct.pack("<IHH", sid, 0, nparams)
+                      + b"\0" + struct.pack("<H", 0))
+        for _ in range(nparams):
+            c.send_packet(self._coldef(b"?", T_VAR_STRING, 33))
+        if nparams:
+            self._eof(c)
+
+    @staticmethod
+    def _count_params(sql: str) -> int:
+        n, in_str = 0, False
+        for ch in sql:
+            if in_str:
+                if ch == "'":
+                    in_str = False
+            elif ch == "'":
+                in_str = True
+            elif ch == "?":
+                n += 1
+        return n
+
+    def _stmt_execute(self, c: "_Conn", pkt: bytes) -> None:
+        (sid,) = struct.unpack_from("<I", pkt, 1)
+        if sid not in c.stmts:
+            self._err(c, 1243, "unknown statement")
+            return
+        sql, nparams = c.stmts[sid]
+        off = 1 + 4 + 1 + 4
+        args: list = []
+        if nparams:
+            nullmap = pkt[off:off + (nparams + 7) // 8]
+            off += (nparams + 7) // 8
+            bound = pkt[off]
+            off += 1
+            types = []
+            if bound:
+                for _ in range(nparams):
+                    types.append((pkt[off], pkt[off + 1]))
+                    off += 2
+            for i in range(nparams):
+                if nullmap[i // 8] & (1 << (i % 8)):
+                    args.append(None)
+                    continue
+                t = types[i][0]
+                if t == T_LONGLONG:
+                    args.append(struct.unpack_from("<q", pkt, off)[0])
+                    off += 8
+                elif t == T_TINY:
+                    args.append(pkt[off])
+                    off += 1
+                elif t == T_DOUBLE:
+                    args.append(struct.unpack_from("<d", pkt, off)[0])
+                    off += 8
+                elif t == T_BLOB:
+                    raw, off = _read_lenenc_bytes(pkt, off)
+                    args.append(bytes(raw))
+                else:
+                    raw, off = _read_lenenc_bytes(pkt, off)
+                    args.append(raw.decode("utf-8"))
+        try:
+            rows, desc, affected = self._run_sql(sql, args)
+        except sqlite3.Error as e:
+            self._err(c, 1064, f"sqlite: {e}")
+            return
+        if not desc:
+            self._ok(c, max(affected, 0))
+            return
+        self._send_resultset(c, desc, rows, binary=True)
+
+    # -- resultset encoding ------------------------------------------------
+
+    @staticmethod
+    def _coldef(name: bytes, ctype: int, charset: int) -> bytes:
+        return (_lenenc_bytes(b"def") + _lenenc_bytes(b"") * 3
+                + _lenenc_bytes(name) + _lenenc_bytes(name)
+                + bytes([0x0c]) + struct.pack("<HIBHB", charset, 1 << 24,
+                                              ctype, 0, 0) + b"\0\0")
+
+    def _col_meta(self, rows: list, ci: int) -> tuple[int, int]:
+        for row in rows:
+            v = row[ci]
+            if v is None:
+                continue
+            if isinstance(v, bytes):
+                return T_BLOB, 63
+            if isinstance(v, int):
+                return T_LONGLONG, 63
+            if isinstance(v, float):
+                return T_DOUBLE, 63
+            return T_VAR_STRING, 33
+        return T_VAR_STRING, 33
+
+    def _send_resultset(self, c: "_Conn", desc, rows: list,
+                        binary: bool) -> None:
+        metas = [self._col_meta(rows, i) for i in range(len(desc))]
+        c.send_packet(_lenenc_int(len(desc)))
+        for col, (ctype, charset) in zip(desc, metas):
+            c.send_packet(self._coldef(col[0].encode(), ctype, charset))
+        self._eof(c)
+        for row in rows:
+            if binary:
+                c.send_packet(self._binary_row(row, metas))
+            else:
+                c.send_packet(self._text_row(row))
+        self._eof(c)
+
+    @staticmethod
+    def _text_row(row) -> bytes:
+        parts = []
+        for v in row:
+            if v is None:
+                parts.append(b"\xfb")
+            elif isinstance(v, bytes):
+                parts.append(_lenenc_bytes(v))
+            else:
+                parts.append(_lenenc_bytes(str(v).encode("utf-8")))
+        return b"".join(parts)
+
+    @staticmethod
+    def _binary_row(row, metas) -> bytes:
+        n = len(row)
+        nullmap = bytearray((n + 9) // 8)
+        vals = []
+        for i, v in enumerate(row):
+            if v is None:
+                nullmap[(i + 2) // 8] |= 1 << ((i + 2) % 8)
+                continue
+            ctype = metas[i][0]
+            if ctype == T_LONGLONG:
+                vals.append(struct.pack("<q", v))
+            elif ctype == T_DOUBLE:
+                vals.append(struct.pack("<d", float(v)))
+            elif ctype == T_BLOB:
+                vals.append(_lenenc_bytes(v if isinstance(v, bytes)
+                                          else str(v).encode()))
+            else:
+                vals.append(_lenenc_bytes(str(v).encode("utf-8")))
+        return b"\x00" + bytes(nullmap) + b"".join(vals)

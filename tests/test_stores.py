@@ -375,14 +375,15 @@ def test_abstract_sql_dialect_layer(tmp_path):
     pg = PostgresDialect()
     assert "ON CONFLICT(directory,name)" in pg.upsert("filemeta")
     assert "BYTEA" in pg.create_table("filemeta")
-    # mysql still refuses to connect without its client library;
-    # postgres speaks the wire itself now (pg_wire) — with no server
-    # listening the failure is a socket error, not a gated RuntimeError
+    # both dialects speak their wire protocols themselves now (pg_wire /
+    # mysql_wire) — with no server listening the failure is a socket
+    # error, not a gated RuntimeError
     import pytest as _pytest
 
-    with _pytest.raises(RuntimeError, match="pymysql"):
-        my.connect()
-    pg_free = PostgresDialect(port=1)  # nothing listens on port 1
+    my_free = MySqlDialect(port=1)  # nothing listens on port 1
+    with _pytest.raises(OSError):
+        my_free.connect()
+    pg_free = PostgresDialect(port=1)
     with _pytest.raises(OSError):
         pg_free.connect()
 
@@ -631,6 +632,106 @@ def test_postgres_reconnects_after_socket_drop(pg_server):
     cur.execute("SELECT 3 + 3")  # reconnected under the hood
     assert cur.fetchone()[0] == 6
     c.close()
+
+
+# -- mysql store (real client/server protocol against an in-process
+#    server) ---------------------------------------------------------------
+
+@pytest.fixture
+def mysql_server():
+    from tests.fake_mysql import FakeMySqlServer
+
+    srv = FakeMySqlServer()
+    yield srv
+    srv.stop()
+
+
+def test_mysql_store_crud_listing_and_kv(mysql_server):
+    """Same coverage as the postgres CRUD test, through the MySQL binary
+    prepared-statement protocol (mysql_store.go via go-sql-driver; here
+    mysql_wire.py via COM_STMT_PREPARE/EXECUTE)."""
+    store = get_store("mysql", host="localhost", port=mysql_server.port)
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/a/b/c.txt", attr=Attr(mtime=11)))
+    for i in range(5):
+        f.create_entry(Entry(full_path=f"/a/b/f{i}"))
+    assert f.find_entry("/a/b/c.txt").attr.mtime == 11
+    assert [e.name for e in f.list_entries("/a/b")] == \
+        ["c.txt", "f0", "f1", "f2", "f3", "f4"]
+    assert [e.name for e in f.list_entries("/a/b", start="f1")] == \
+        ["f2", "f3", "f4"]
+    assert len(list(f.list_entries("/a/b", prefix="f"))) == 5
+    f.delete_entry("/a/b/f0")
+    assert [e.name for e in f.list_entries("/a/b")] == \
+        ["c.txt", "f1", "f2", "f3", "f4"]
+    # ON DUPLICATE KEY UPDATE upsert path
+    f.create_entry(Entry(full_path="/a/b/c.txt", attr=Attr(mtime=99)))
+    assert f.find_entry("/a/b/c.txt").attr.mtime == 99
+    # binary blob round-trip
+    gnarly = bytes(range(256))
+    store.kv_put(b"k\x00bin", gnarly)
+    assert store.kv_get(b"k\x00bin") == gnarly
+    assert store.kv_get(b"absent") is None
+    store.delete_folder_children("/a")
+    assert store.find_entry("/a/b/c.txt") is None
+    store.close()
+
+
+def test_mysql_auth_and_reconnect(mysql_server):
+    from tests.fake_mysql import FakeMySqlServer
+
+    from seaweedfs_tpu.filer.stores.mysql_wire import (
+        MySqlConnection,
+        MySqlError,
+    )
+
+    locked = FakeMySqlServer(user="weed", password="sekret")
+    try:
+        c = MySqlConnection(host="localhost", port=locked.port,
+                            user="weed", password="sekret", database="x")
+        cur = c.cursor()
+        cur.execute("SELECT 20 + 3")
+        assert cur.fetchone()[0] == 23
+        # reconnect after a dropped socket (stmt cache must not leak
+        # stale ids across the reconnect)
+        cur.execute("SELECT 1 + %s", (1,))
+        c._sock.close()
+        with pytest.raises((OSError, ConnectionError)):
+            cur.execute("SELECT 2 + %s", (2,))
+        cur.execute("SELECT 2 + %s", (2,))
+        assert cur.fetchone()[0] == 4
+        c.close()
+        with pytest.raises(MySqlError, match="Access denied"):
+            MySqlConnection(host="localhost", port=locked.port,
+                            user="weed", password="wrong", database="x")
+    finally:
+        locked.stop()
+
+
+def test_mysql2_bucket_tables(mysql_server):
+    """mysql2 = SupportBucketTable through the backtick-quoting dialect
+    (information_schema.tables enumeration on ancestor deletes)."""
+    store = get_store("mysql2", host="localhost", port=mysql_server.port)
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/buckets/my-bkt/obj", content=b"m1"))
+    f.create_entry(Entry(full_path="/buckets/other/obj", content=b"m2"))
+    assert store.find_entry("/buckets/my-bkt/obj").content == b"m1"
+    with mysql_server._dblock:
+        cur = mysql_server.db.cursor()
+        cur.execute("SELECT count(*) FROM `bucket_my-bkt`")
+        assert cur.fetchone()[0] >= 1
+    store.delete_folder_children("/buckets/my-bkt")
+    assert store.find_entry("/buckets/my-bkt/obj") is None
+    assert store.find_entry("/buckets/other/obj").content == b"m2"
+    # ancestor wipe drops every bucket table via information_schema
+    store.delete_folder_children("/buckets")
+    assert store.find_entry("/buckets/other/obj") is None
+    with mysql_server._dblock:
+        cur = mysql_server.db.cursor()
+        cur.execute("SELECT name FROM sqlite_master WHERE type='table' "
+                    "AND name LIKE 'bucket_%'")
+        assert cur.fetchall() == []
+    store.close()
 
 
 def test_postgres_store_backs_live_filer(pg_server, tmp_path):

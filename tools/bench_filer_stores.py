@@ -140,6 +140,16 @@ def main() -> int:
     with open(args.o, "w") as f:
         json.dump({"timestamp": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                               time.gmtime()),
+                   "anchor": (
+                       "the reference publishes no filer-store microbench "
+                       "(README.md:533-583 covers the volume data path "
+                       "only), so there is no upstream number to compare "
+                       "against; these figures exist to catch regressions "
+                       "between rounds of THIS repo, and to show the "
+                       "metadata plane sustains the smallfile headline "
+                       "(store inserts/s must exceed smallfile writes/s, "
+                       "~62k/s in BENCH_DEVICE_LAST_GOOD.json, to keep "
+                       "the filer from becoming the bottleneck)"),
                    "results": results}, f, indent=1)
     print(f"wrote {args.o}")
     return 0
