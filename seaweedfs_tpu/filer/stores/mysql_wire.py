@@ -34,9 +34,9 @@ _MAX_CACHED_STMTS = 64
 # column types
 T_TINY, T_SHORT, T_LONG, T_FLOAT, T_DOUBLE, T_LONGLONG = 1, 2, 3, 4, 5, 8
 T_VARCHAR, T_VAR_STRING, T_STRING, T_BLOB = 15, 253, 254, 252
-_LENENC_TYPES = {T_VARCHAR, T_VAR_STRING, T_STRING, T_BLOB, 249, 250, 251,
-                 246}
-_INT_SIZES = {T_TINY: 1, T_SHORT: 2, T_LONG: 4, T_LONGLONG: 8, 13: 4}
+# fixed-width binary-protocol integer types: TINY/SHORT/LONG/LONGLONG,
+# YEAR (13, 2 bytes) and INT24 (9, sent as 4 bytes on the wire)
+_INT_SIZES = {T_TINY: 1, T_SHORT: 2, T_LONG: 4, T_LONGLONG: 8, 13: 2, 9: 4}
 
 CAP_LONG_PASSWORD = 0x1
 CAP_CONNECT_WITH_DB = 0x8
@@ -47,9 +47,12 @@ CAP_PLUGIN_AUTH = 0x80000
 
 
 class MySqlError(Exception):
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str, server: bool = False):
         self.code = code
         self.message = message
+        # server=True: a well-framed ERR packet — the stream is still in
+        # sync. Anything else means our parser lost its place.
+        self.server = server
         super().__init__(f"({code}) {message}")
 
 
@@ -152,7 +155,7 @@ class MySqlConnection:
         msg = payload[3:]
         if msg[:1] == b"#":        # sql-state marker
             msg = msg[6:]
-        return MySqlError(code, msg.decode("utf-8", "replace"))
+        return MySqlError(code, msg.decode("utf-8", "replace"), server=True)
 
     # -- connect + auth ----------------------------------------------------
 
@@ -206,6 +209,9 @@ class MySqlConnection:
             pkt = self._read_packet()
         if pkt[:1] == b"\xff":
             raise self._parse_err(pkt)
+        # make the documented autocommit contract real even on servers
+        # configured with autocommit=0
+        self._com_query("SET autocommit=1")
 
     def _mark_broken(self) -> None:
         try:
@@ -228,7 +234,15 @@ class MySqlConnection:
                 if params:
                     return self._stmt_execute(my_sql, params)
                 return self._com_query(my_sql)
-            except (OSError, ConnectionError):
+            except MySqlError as e:
+                if not e.server:
+                    # parse desync (unexpected framing): the stream can't
+                    # be trusted any more
+                    self._mark_broken()
+                raise
+            except Exception:
+                # socket errors AND struct/index parse failures both leave
+                # unread response bytes behind — never reuse the stream
                 self._mark_broken()
                 raise
 

@@ -238,6 +238,13 @@ def _kernel_choice(b: int) -> str:
         return choice
     if os.environ.get("SEAWEEDFS_TPU_NO_PALLAS"):
         return "sel-xla"
+    if jax.default_backend() == "tpu":
+        # measured on the real chip (TUNE_RESULT.txt, round-4 full sweep):
+        # mxu-xla wins at every size — 13.78 GB/s at 32MB vs xor-pallas
+        # 3.15 / sel-pallas 4.29 / sel-xla 3.83; the MXU eats the GF(2)
+        # bit-matmul far faster than VPU-side table/xor schemes, the
+        # reverse of the CPU ranking that set the old default
+        return "mxu-xla"
     from .rs_pallas import pallas_available
     from .rs_xor import TILE_BYTES
 

@@ -231,6 +231,9 @@ class FakeMySqlServer:
         return rows, desc, affected
 
     def _com_query(self, c: "_Conn", sql: str) -> None:
+        if re.match(r"\s*SET\s", sql, flags=re.I):
+            self._ok(c)          # session variables: accept and ignore
+            return
         try:
             rows, desc, affected = self._run_sql(sql, [])
         except sqlite3.Error as e:
