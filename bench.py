@@ -88,10 +88,16 @@ def bench(data_shards=10, parity_shards=4, col_bytes=None, iters=8,
 
     if os.environ.get("SEAWEEDFS_TPU_KERNEL", "auto") == "auto":
         if backend == "tpu":
-            cands = ("xor-pallas", "sel-pallas", "xor-xla", "sel-xla",
-                     "mxu-pallas", "mxu-xla")
+            # mxu first: the round-4 on-chip sweep (TUNE_RESULT.txt) has
+            # mxu-xla/mxu-pallas 3-4x ahead of every xor/sel form at all
+            # sizes. Order matters: the calibration budget can expire
+            # mid-sweep over a slow tunnel, and the winner must not be
+            # picked from a losers-only subset (round-4 bug: xor-first
+            # ordering + expired budget crowned sel-xla at 3.7 GB/s).
+            cands = ("mxu-xla", "mxu-pallas", "xor-pallas", "sel-pallas",
+                     "sel-xla", "xor-xla")
         else:
-            cands = ("xor-xla", "sel-xla", "mxu-xla")
+            cands = ("sel-xla", "xor-xla", "mxu-xla")
         scores = calibrate(coder, np, jnp, cands)
         if scores:
             os.environ["SEAWEEDFS_TPU_KERNEL"] = max(scores, key=scores.get)

@@ -52,6 +52,7 @@ def get_store(name: str, **kwargs) -> FilerStore:
         gated,
         leveldb,
         memory,
+        mongo_wire,
         redis,
         sqlite,
     )
@@ -69,6 +70,7 @@ def available_stores() -> list[str]:
         gated,
         leveldb,
         memory,
+        mongo_wire,
         redis,
         sqlite,
     )

@@ -20,7 +20,7 @@ _GATED = {
     # wire protocol itself (extended query + SCRAM auth); mysql/mysql2
     # likewise via stores/mysql_wire.py (binary prepared statements)
     "cassandra": "cassandra-driver",
-    "mongodb": "pymongo",
+    # mongodb is REAL now: stores/mongo_wire.py speaks OP_MSG + BSON
     "elastic": "elasticsearch",
     "etcd": "etcd3",
     "tikv": "tikv-client",

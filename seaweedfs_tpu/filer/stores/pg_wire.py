@@ -25,15 +25,16 @@ autocommit; ``commit()`` is a no-op kept for DB-API shape.
 
 from __future__ import annotations
 
-import base64
 import hashlib
-import hmac
-import os
 import socket
 import struct
 import threading
 
-from .wire_common import WireCursor, rewrite_placeholders
+from .wire_common import (
+    ScramClient,
+    WireCursor,
+    rewrite_placeholders,
+)
 
 
 class PgError(Exception):
@@ -157,7 +158,7 @@ class PgConnection:
                     if b"SCRAM-SHA-256" not in mechs:
                         raise PgError({"M": "no supported SASL mechanism",
                                        "C": "28000"})
-                    scram = _ScramClient(self.password)
+                    scram = ScramClient(self.password)
                     first = scram.client_first()
                     self._send(b"p", b"SCRAM-SHA-256\0"
                                + struct.pack(">I", len(first)) + first)
@@ -284,43 +285,3 @@ class PgConnection:
             self._sock.close()
         except OSError:
             pass
-
-
-class _ScramClient:
-    """Client side of SCRAM-SHA-256 (RFC 5802/7677)."""
-
-    def __init__(self, password: str):
-        self.password = password.encode("utf-8")
-        self.nonce = base64.b64encode(os.urandom(18)).decode()
-        self.first_bare = f"n=,r={self.nonce}"
-        self.server_sig: bytes | None = None
-
-    def client_first(self) -> bytes:
-        return ("n,," + self.first_bare).encode()
-
-    def client_final(self, server_first: bytes) -> bytes:
-        sf = server_first.decode()
-        attrs = dict(kv.split("=", 1) for kv in sf.split(","))
-        r, salt, iters = attrs["r"], base64.b64decode(attrs["s"]), \
-            int(attrs["i"])
-        if not r.startswith(self.nonce):
-            raise PgError({"M": "SCRAM server nonce mismatch", "C": "28000"})
-        salted = hashlib.pbkdf2_hmac("sha256", self.password, salt, iters)
-        client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
-        stored_key = hashlib.sha256(client_key).digest()
-        final_bare = f"c=biws,r={r}"
-        auth_msg = ",".join([self.first_bare, sf, final_bare]).encode()
-        client_sig = hmac.new(stored_key, auth_msg, hashlib.sha256).digest()
-        proof = bytes(a ^ b for a, b in zip(client_key, client_sig))
-        server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
-        self.server_sig = hmac.new(server_key, auth_msg,
-                                   hashlib.sha256).digest()
-        return (final_bare
-                + ",p=" + base64.b64encode(proof).decode()).encode()
-
-    def verify_server(self, server_final: bytes) -> None:
-        attrs = dict(kv.split("=", 1)
-                     for kv in server_final.decode().split(","))
-        if base64.b64decode(attrs.get("v", "")) != self.server_sig:
-            raise PgError({"M": "SCRAM server signature mismatch",
-                           "C": "28000"})

@@ -3,6 +3,10 @@ the DB-API cursor shell and the %s-placeholder rewriter."""
 
 from __future__ import annotations
 
+import base64
+import hashlib
+import hmac
+import os
 from typing import Callable
 
 
@@ -61,3 +65,45 @@ def rewrite_placeholders(sql: str, token: Callable[[int], str]) -> str:
             out.append(ch)
             i += 1
     return "".join(out)
+
+
+class ScramClient:
+    """Client side of SCRAM-SHA-256 (RFC 5802/7677). postgres leaves
+    the authzid/username empty (the startup message names the user);
+    mongodb sends n=<user>."""
+
+    def __init__(self, password: str, username: str = ""):
+        self.password = password.encode("utf-8")
+        self.nonce = base64.b64encode(os.urandom(18)).decode()
+        user = username.replace("=", "=3D").replace(",", "=2C")
+        self.first_bare = f"n={user},r={self.nonce}"
+        self.server_sig: bytes | None = None
+
+    def client_first(self) -> bytes:
+        return ("n,," + self.first_bare).encode()
+
+    def client_final(self, server_first: bytes) -> bytes:
+        sf = server_first.decode()
+        attrs = dict(kv.split("=", 1) for kv in sf.split(","))
+        r, salt, iters = attrs["r"], base64.b64decode(attrs["s"]), \
+            int(attrs["i"])
+        if not r.startswith(self.nonce):
+            raise ConnectionError("SCRAM server nonce mismatch")
+        salted = hashlib.pbkdf2_hmac("sha256", self.password, salt, iters)
+        client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored_key = hashlib.sha256(client_key).digest()
+        final_bare = f"c=biws,r={r}"
+        auth_msg = ",".join([self.first_bare, sf, final_bare]).encode()
+        client_sig = hmac.new(stored_key, auth_msg, hashlib.sha256).digest()
+        proof = bytes(a ^ b for a, b in zip(client_key, client_sig))
+        server_key = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        self.server_sig = hmac.new(server_key, auth_msg,
+                                   hashlib.sha256).digest()
+        return (final_bare
+                + ",p=" + base64.b64encode(proof).decode()).encode()
+
+    def verify_server(self, server_final: bytes) -> None:
+        attrs = dict(kv.split("=", 1)
+                     for kv in server_final.decode().split(","))
+        if base64.b64decode(attrs.get("v", "")) != self.server_sig:
+            raise ConnectionError("SCRAM server signature mismatch")

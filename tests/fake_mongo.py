@@ -1,0 +1,266 @@
+"""In-process pure-python MongoDB OP_MSG server: enough of the command
+set (find/getMore with filters+sort+limit, update with upsert, delete,
+createIndexes, saslStart/saslContinue SCRAM-SHA-256) to exercise the
+real mongodb filer store (seaweedfs_tpu/filer/stores/mongo_wire.py)
+end to end. BSON framing is decoded with the store's own codec but the
+SCRAM proof is verified with independent RFC 7677 math, and cursors are
+deliberately returned in small batches so getMore really runs."""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import hmac
+import os
+import re
+import socket
+import struct
+import threading
+
+from seaweedfs_tpu.filer.stores.bson import Regex, decode_doc, encode_doc
+
+OP_MSG = 2013
+BATCH = 3          # small on purpose: forces the client's getMore loop
+
+
+class FakeMongoServer:
+    def __init__(self, *, user: str = "", password: str = ""):
+        self.user = user
+        self.password = password
+        self.docs: list[dict] = []      # {directory, name, meta}
+        self._dblock = threading.Lock()
+        self._cursors: dict[int, list[dict]] = {}
+        self._next_cursor = 1000
+        self._listen = socket.socket()
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind(("localhost", 0))
+        self._listen.listen(8)
+        self.port = self._listen.getsockname()[1]
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listen.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("client gone")
+            buf += chunk
+        return buf
+
+    def _serve(self, conn: socket.socket) -> None:
+        authed = not self.password
+        scram: dict | None = None
+        try:
+            while not self._stop.is_set():
+                header = self._recv_exact(conn, 16)
+                length, rid, _rto, opcode = struct.unpack("<iiii", header)
+                payload = self._recv_exact(conn, length - 16)
+                if opcode != OP_MSG or payload[4] != 0:
+                    self._reply(conn, rid, {"ok": 0, "code": 2,
+                                            "errmsg": "bad message"})
+                    continue
+                cmd, _ = decode_doc(payload, 5)
+                verb = next(iter(cmd))
+                if verb == "saslStart":
+                    reply, scram = self._sasl_start(cmd)
+                elif verb == "saslContinue":
+                    reply, scram = self._sasl_continue(cmd, scram)
+                    if reply.get("done") and reply.get("ok") == 1:
+                        authed = True
+                elif not authed:
+                    reply = {"ok": 0, "code": 13,
+                             "errmsg": "command requires authentication"}
+                else:
+                    reply = self._dispatch(verb, cmd)
+                self._reply(conn, rid, reply)
+        except (ConnectionError, OSError, struct.error, ValueError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    @staticmethod
+    def _reply(conn: socket.socket, rid: int, doc: dict) -> None:
+        body = b"\x00\x00\x00\x00\x00" + encode_doc(doc)
+        conn.sendall(struct.pack("<iiii", 16 + len(body), 1, rid, OP_MSG)
+                     + body)
+
+    # -- SCRAM-SHA-256 server side (independent implementation) -----------
+
+    def _sasl_start(self, cmd: dict):
+        if cmd.get("mechanism") != "SCRAM-SHA-256":
+            return {"ok": 0, "code": 278, "errmsg": "bad mechanism"}, None
+        client_first = cmd["payload"].decode()
+        bare = client_first.split(",", 2)[2]
+        attrs = dict(kv.split("=", 1) for kv in bare.split(","))
+        if self.user and attrs.get("n") != self.user:
+            return {"ok": 0, "code": 11,
+                    "errmsg": "authentication failed"}, None
+        snonce = attrs["r"] + base64.b64encode(os.urandom(12)).decode()
+        salt, iters = os.urandom(16), 4096
+        server_first = (f"r={snonce},s={base64.b64encode(salt).decode()},"
+                        f"i={iters}")
+        state = {"bare": bare, "server_first": server_first,
+                 "snonce": snonce, "salt": salt, "iters": iters}
+        return {"ok": 1, "conversationId": 1, "done": False,
+                "payload": server_first.encode()}, state
+
+    def _sasl_continue(self, cmd: dict, state: dict | None):
+        if not state:
+            return {"ok": 0, "code": 17,
+                    "errmsg": "no SASL session"}, None
+        final = cmd["payload"].decode()
+        fattrs = dict(kv.split("=", 1) for kv in final.split(","))
+        final_bare = final[:final.rindex(",p=")]
+        if fattrs.get("r") != state["snonce"]:
+            return {"ok": 0, "code": 11, "errmsg": "nonce mismatch"}, None
+        salted = hashlib.pbkdf2_hmac("sha256", self.password.encode(),
+                                     state["salt"], state["iters"])
+        ckey = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored = hashlib.sha256(ckey).digest()
+        auth_msg = ",".join([state["bare"], state["server_first"],
+                             final_bare]).encode()
+        csig = hmac.new(stored, auth_msg, hashlib.sha256).digest()
+        proof = base64.b64decode(fattrs["p"])
+        if hashlib.sha256(bytes(a ^ b for a, b in
+                                zip(proof, csig))).digest() != stored:
+            return {"ok": 0, "code": 11,
+                    "errmsg": "authentication failed"}, None
+        skey = hmac.new(salted, b"Server Key", hashlib.sha256).digest()
+        ssig = hmac.new(skey, auth_msg, hashlib.sha256).digest()
+        return {"ok": 1, "conversationId": 1, "done": True,
+                "payload": b"v=" + base64.b64encode(ssig)}, None
+
+    # -- commands ----------------------------------------------------------
+
+    def _dispatch(self, verb: str, cmd: dict) -> dict:
+        if verb == "createIndexes":
+            return {"ok": 1}
+        if verb == "update":
+            return self._update(cmd)
+        if verb == "find":
+            return self._find(cmd)
+        if verb == "getMore":
+            return self._get_more(cmd)
+        if verb == "delete":
+            return self._delete(cmd)
+        if verb in ("ping", "hello", "isMaster", "endSessions"):
+            return {"ok": 1}
+        return {"ok": 0, "code": 59, "errmsg": f"no such command {verb!r}"}
+
+    @staticmethod
+    def _match_value(cond, value) -> bool:
+        if isinstance(cond, Regex):
+            return bool(re.search(cond.pattern, value or ""))
+        if isinstance(cond, dict):
+            for op, rhs in cond.items():
+                if op == "$gt":
+                    if not (value or "") > rhs:
+                        return False
+                elif op == "$gte":
+                    if not (value or "") >= rhs:
+                        return False
+                elif op == "$lt":
+                    if not (value or "") < rhs:
+                        return False
+                elif op == "$regex":
+                    pat = rhs.pattern if isinstance(rhs, Regex) else rhs
+                    if not re.search(pat, value or ""):
+                        return False
+                else:
+                    raise ValueError(f"unsupported operator {op}")
+            return True
+        return value == cond
+
+    def _match(self, doc: dict, flt: dict) -> bool:
+        for k, cond in flt.items():
+            if k == "$or":
+                if not any(self._match(doc, sub) for sub in cond):
+                    return False
+            elif not self._match_value(cond, doc.get(k)):
+                return False
+        return True
+
+    def _update(self, cmd: dict) -> dict:
+        n = 0
+        with self._dblock:
+            for u in cmd.get("updates", []):
+                q, upd = u["q"], u["u"]
+                sets = upd.get("$set", {})
+                hit = False
+                for doc in self.docs:
+                    if self._match(doc, q):
+                        doc.update(sets)
+                        hit = True
+                        n += 1
+                if not hit and u.get("upsert"):
+                    doc = dict(q)
+                    doc.update(sets)
+                    self.docs.append(doc)
+                    n += 1
+        return {"ok": 1, "n": n}
+
+    def _find(self, cmd: dict) -> dict:
+        flt = cmd.get("filter", {})
+        with self._dblock:
+            rows = [dict(d) for d in self.docs if self._match(d, flt)]
+        for key, direction in reversed(list(cmd.get("sort", {}).items())):
+            rows.sort(key=lambda d: d.get(key) or "",
+                      reverse=direction < 0)
+        limit = cmd.get("limit", 0)
+        if limit:
+            rows = rows[:limit]
+        first, rest = rows[:BATCH], rows[BATCH:]
+        cid = 0
+        if rest:
+            with self._dblock:
+                cid = self._next_cursor
+                self._next_cursor += 1
+                self._cursors[cid] = rest
+        return {"ok": 1, "cursor": {"firstBatch": first, "id": cid,
+                                    "ns": "seaweedfs.filemeta"}}
+
+    def _get_more(self, cmd: dict) -> dict:
+        cid = cmd["getMore"]
+        with self._dblock:
+            rest = self._cursors.get(cid, [])
+            batch, rest = rest[:BATCH], rest[BATCH:]
+            if rest:
+                self._cursors[cid] = rest
+            else:
+                self._cursors.pop(cid, None)
+                cid = 0 if not rest else cid
+        return {"ok": 1, "cursor": {"nextBatch": batch,
+                                    "id": cid if rest else 0,
+                                    "ns": "seaweedfs.filemeta"}}
+
+    def _delete(self, cmd: dict) -> dict:
+        n = 0
+        with self._dblock:
+            for d in cmd.get("deletes", []):
+                q = d["q"]
+                keep = [doc for doc in self.docs
+                        if not self._match(doc, q)]
+                n += len(self.docs) - len(keep)
+                self.docs = keep
+        return {"ok": 1, "n": n}
