@@ -154,14 +154,19 @@ def bench(data_shards=10, parity_shards=4, col_bytes=None, iters=8,
 
     def rebuild_once():
         # BASELINE config #3: regenerate 3 lost shards (decode/invert) —
-        # timed with the same forced-readback discipline as verified_once
+        # timed with the same forced-readback discipline as verified_once.
+        # Survivors enter pre-stacked [11, B], the same contiguous form
+        # the rebuild pipeline's readinto produces (ec_files.py reader):
+        # one column-permuted fused matmul, no device-side re-stack.
         shards = coder.encode(bufs[0])
-        present = {i: shards[i] for i in range(coder.total_shards)
-                   if i not in (0, 5, 12)}
+        pres_ids = tuple(i for i in range(coder.total_shards)
+                         if i not in (0, 5, 12))
+        stacked = jnp.stack([shards[i] for i in pres_ids])
+        stacked.block_until_ready()
 
         def rebuilt_stack():
-            out = coder.reconstruct(present)  # {0,5,12} -> [B] rows
-            return jnp.stack([out[0], out[5], out[12]])
+            _mids, rows = coder.reconstruct_stacked(pres_ids, stacked)
+            return rows
 
         # warm with the SAME pytree arity as the timed call (a 1-element
         # list would leave the 4-element retrace+compile inside repeat 1)

@@ -109,6 +109,21 @@ class MongoConnection:
         reply, _ = decode_doc(payload, 5)
         return reply
 
+    @staticmethod
+    def _check_ok(reply: dict, what: str) -> dict:
+        if reply.get("ok") != 1:     # covers int 1 and double 1.0
+            raise MongoError(int(reply.get("code", 0)),
+                             str(reply.get("errmsg", what)))
+        # ok:1 with per-document failures is still a failure (the Go
+        # driver surfaces writeErrors from UpdateOne/DeleteMany too) —
+        # swallowing them would silently lose acknowledged metadata
+        werrs = reply.get("writeErrors")
+        if werrs:
+            first = werrs[0] if isinstance(werrs, list) else {}
+            raise MongoError(int(first.get("code", 0)),
+                             f"write error: {first.get('errmsg', werrs)}")
+        return reply
+
     def command(self, db: str, doc: dict) -> dict:
         with self._lock:
             if self._sock is None:
@@ -120,38 +135,27 @@ class MongoConnection:
             except Exception:
                 self._mark_broken()
                 raise
-        if reply.get("ok") != 1 and reply.get("ok") != 1.0:
-            raise MongoError(int(reply.get("code", 0)),
-                             str(reply.get("errmsg", "command failed")))
-        return reply
+        return self._check_ok(reply, "command failed")
 
     def _auth(self) -> None:
         scram = ScramClient(self._password, username=self._user)
-        first = self._roundtrip({
+        first = self._check_ok(self._roundtrip({
             "saslStart": 1, "mechanism": "SCRAM-SHA-256",
-            "payload": scram.client_first(), "$db": "admin"})
-        if first.get("ok") != 1 and first.get("ok") != 1.0:
-            raise MongoError(int(first.get("code", 0)),
-                             str(first.get("errmsg", "saslStart failed")))
-        final = self._roundtrip({
+            "payload": scram.client_first(), "$db": "admin"}),
+            "saslStart failed")
+        final = self._check_ok(self._roundtrip({
             "saslContinue": 1,
             "conversationId": first.get("conversationId", 1),
             "payload": scram.client_final(first["payload"]),
-            "$db": "admin"})
-        if final.get("ok") != 1 and final.get("ok") != 1.0:
-            raise MongoError(int(final.get("code", 0)),
-                             str(final.get("errmsg", "auth failed")))
+            "$db": "admin"}), "auth failed")
         scram.verify_server(final["payload"])
         for _ in range(3):           # bounded: a server may want one empty
             if final.get("done"):    # closing exchange, never more
                 return
-            final = self._roundtrip({
+            final = self._check_ok(self._roundtrip({
                 "saslContinue": 1,
                 "conversationId": first.get("conversationId", 1),
-                "payload": b"", "$db": "admin"})
-            if final.get("ok") != 1 and final.get("ok") != 1.0:
-                raise MongoError(int(final.get("code", 0)),
-                                 str(final.get("errmsg", "auth failed")))
+                "payload": b"", "$db": "admin"}), "auth failed")
         if not final.get("done"):
             raise MongoError(0, "SASL conversation never completed")
 
@@ -203,21 +207,33 @@ class MongodbStore:
             cmd["limit"] = limit
         reply = self.conn.command(self.database, cmd)
         cursor = reply["cursor"]
-        batch = cursor.get("firstBatch", [])
-        yield from batch
-        seen = len(batch)
-        while cursor.get("id"):
-            reply = self.conn.command(self.database, {
-                "getMore": Int64(cursor["id"]),
-                "collection": self.COLLECTION})
-            cursor = reply["cursor"]
-            batch = cursor.get("nextBatch", [])
-            if limit and seen + len(batch) > limit:
-                batch = batch[:limit - seen]
+        try:
+            batch = cursor.get("firstBatch", [])
             yield from batch
-            seen += len(batch)
-            if limit and seen >= limit:
-                break
+            seen = len(batch)
+            while cursor.get("id"):
+                reply = self.conn.command(self.database, {
+                    "getMore": Int64(cursor["id"]),
+                    "collection": self.COLLECTION})
+                cursor = reply["cursor"]
+                batch = cursor.get("nextBatch", [])
+                if limit and seen + len(batch) > limit:
+                    batch = batch[:limit - seen]
+                yield from batch
+                seen += len(batch)
+                if limit and seen >= limit:
+                    break
+        finally:
+            # consumer may abandon the generator mid-listing; a live
+            # server-side cursor would otherwise linger for its full
+            # timeout and count against open-cursor limits
+            if cursor.get("id"):
+                try:
+                    self.conn.command(self.database, {
+                        "killCursors": self.COLLECTION,
+                        "cursors": [Int64(cursor["id"])]})
+                except Exception:
+                    pass
 
     def find_entry(self, full_path: str) -> Entry | None:
         d, n = self._split(full_path)
@@ -274,9 +290,8 @@ class MongodbStore:
     def kv_get(self, key: bytes) -> bytes | None:
         d, n = self._kv_dir_name(key)
         for doc in self._find({"directory": d, "name": n}, limit=1):
-            meta = doc.get("meta")
             # empty value != absent key (matches memory/redis stores)
-            return meta if meta is not None else None
+            return doc.get("meta")
         return None
 
     def close(self) -> None:

@@ -92,6 +92,23 @@ class AutoMeshCoder:
     def reconstruct_data(self, shards):
         return self._resolve().reconstruct_data(shards)
 
+    def reconstruct_stacked(self, present_ids, stacked, data_only=False):
+        """Pre-stacked survivor form; falls back to the dict path on
+        backends without a native stacked kernel."""
+        impl = self._resolve()
+        fn = getattr(impl, "reconstruct_stacked", None)
+        if fn is not None:
+            return fn(present_ids, stacked, data_only=data_only)
+        out = (impl.reconstruct_data if data_only
+               else impl.reconstruct)({s: stacked[j] for j, s
+                                       in enumerate(present_ids)})
+        missing = tuple(sorted(out))
+        import numpy as _np
+
+        if not missing:
+            return missing, _np.zeros((0, stacked.shape[1]), _np.uint8)
+        return missing, _np.stack([_np.asarray(out[i]) for i in missing])
+
     def verify(self, shards) -> bool:
         return self._resolve().verify(shards)
 

@@ -376,6 +376,41 @@ class RSCodecJax:
         out = _dispatch_matmul(fmat, stacked, len(missing), key=key)
         return {i: out[j] for j, i in enumerate(missing)}
 
+    def reconstruct_stacked(
+        self, present_ids: tuple[int, ...],
+        stacked: np.ndarray | jax.Array, data_only: bool = False,
+    ) -> tuple[tuple[int, ...], jax.Array]:
+        """Reconstruct from survivors already stacked [P, B] in caller
+        row order -> (missing_ids, [len(missing), B]).
+
+        The hot-path form: the rebuild pipeline reads survivor shards
+        into ONE contiguous buffer, so re-stacking k device rows per
+        batch (an extra ~2x HBM round-trip at rebuild sizes) is pure
+        waste. Instead the fused [missing, k] matrix is column-permuted
+        to the caller's row order, with zero columns for surplus
+        survivors — identical GF math, zero data movement."""
+        limit = self.data_shards if data_only else self.total_shards
+        present_ids = tuple(present_ids)
+        missing = tuple(i for i in range(limit)
+                        if i not in set(present_ids))
+        stacked = jnp.asarray(stacked, jnp.uint8)
+        assert stacked.shape[0] == len(present_ids), stacked.shape
+        if not missing:
+            return (), jnp.zeros((0, stacked.shape[1]), jnp.uint8)
+        fmat, used = fused_reconstruct_matrix(
+            self.data_shards, self.parity_shards,
+            tuple(sorted(present_ids)), missing)
+        col_of = {s: c for c, s in enumerate(used)}
+        pm = np.zeros((len(missing), len(present_ids)), np.uint8)
+        for j, s in enumerate(present_ids):
+            c = col_of.get(s)
+            if c is not None:
+                pm[:, j] = fmat[:, c]
+        key = ("fdecs", self.data_shards, self.parity_shards,
+               present_ids, missing)
+        out = _dispatch_matmul(pm, stacked, len(missing), key=key)
+        return missing, out
+
     def verify(self, shards: np.ndarray | jax.Array) -> bool:
         """True iff parity rows match the data rows."""
         shards = jnp.asarray(shards, dtype=jnp.uint8)

@@ -425,25 +425,38 @@ def rebuild_ec_files(
     work_q: queue.Queue = queue.Queue(maxsize=DEFAULT_PIPELINE_DEPTH)
     stop = threading.Event()
 
+    use_stacked = hasattr(coder, "reconstruct_stacked")
+    pres_tuple = tuple(present)
+
     def reader() -> None:
         try:
             offset = 0
             while not stop.is_set():
-                bufs: dict[int, np.ndarray] = {}
+                # survivors land in ONE contiguous [P, batch] buffer via
+                # readinto — the stacked reconstruct then runs a single
+                # column-permuted matmul with no device-side re-stack
+                stacked = np.empty((len(present), batch_size),
+                                   dtype=np.uint8)
                 n = None
-                for i in present:
+                for j, i in enumerate(present):
                     ins[i].seek(offset)
-                    chunk = ins[i].read(batch_size)
+                    got = ins[i].readinto(memoryview(stacked[j]))
                     if n is None:
-                        n = len(chunk)
-                    elif len(chunk) != n:
+                        n = got
+                    elif got != n:
                         raise IOError(
-                            f"ec shard size mismatch: expected {n} got {len(chunk)}"
+                            f"ec shard size mismatch: expected {n} got {got}"
                         )
-                    bufs[i] = np.frombuffer(chunk, dtype=np.uint8)
                 if not n:
                     break
-                work_q.put(coder.reconstruct(bufs))  # async device dispatch
+                if use_stacked:
+                    mids, rows = coder.reconstruct_stacked(
+                        pres_tuple, stacked[:, :n])
+                    work_q.put(dict(zip(mids, rows)))
+                else:
+                    bufs = {i: stacked[j, :n]
+                            for j, i in enumerate(present)}
+                    work_q.put(coder.reconstruct(bufs))
                 offset += n
             work_q.put(None)
         except BaseException as e:

@@ -119,3 +119,34 @@ def test_exhaustive_two_loss_small_geometry():
         rec = tpu.reconstruct(have)
         for i in lost:
             assert np.array_equal(np.asarray(rec[i]), shards[i])
+
+
+def test_reconstruct_stacked_bit_identical_to_dict_path():
+    """The pre-stacked survivor form (column-permuted fused matrix,
+    ec_files rebuild hot path) must match the dict path byte-for-byte,
+    including surplus survivors (P > k) and arbitrary caller row order."""
+    tpu = new_coder(10, 4, "tpu")
+    data = _rand(10, 555, seed=33)
+    shards = np.asarray(
+        tpu.encode(np.concatenate([data, np.zeros((4, 555), np.uint8)]))
+    )
+    lost = (0, 5, 12)
+    pres_ids = tuple(i for i in range(14) if i not in lost)
+    # deliberately shuffle the caller's row order
+    order = pres_ids[::-1]
+    stacked = np.stack([shards[i] for i in order])
+    mids, rows = tpu.reconstruct_stacked(order, stacked)
+    assert mids == lost
+    rows = np.asarray(rows)
+    ref = tpu.reconstruct({i: shards[i] for i in pres_ids})
+    for j, i in enumerate(mids):
+        assert np.array_equal(rows[j], shards[i])
+        assert np.array_equal(rows[j], np.asarray(ref[i]))
+    # data_only limits regeneration to data shards
+    mids_d, rows_d = tpu.reconstruct_stacked(order, stacked, data_only=True)
+    assert mids_d == (0, 5)
+    assert np.array_equal(np.asarray(rows_d)[0], shards[0])
+    # nothing missing -> empty result
+    all_ids = tuple(range(14))
+    mids_n, rows_n = tpu.reconstruct_stacked(all_ids, shards)
+    assert mids_n == () and np.asarray(rows_n).shape == (0, 555)
