@@ -21,7 +21,8 @@ _GATED = {
     # likewise via stores/mysql_wire.py (binary prepared statements)
     "cassandra": "cassandra-driver",
     # mongodb is REAL now: stores/mongo_wire.py speaks OP_MSG + BSON
-    "elastic": "elasticsearch",
+    # elastic/elastic7 are REAL now: stores/elastic_wire.py drives the
+    # REST/JSON API with the stdlib http client
     "etcd": "etcd3",
     "tikv": "tikv-client",
     "ydb": "ydb",

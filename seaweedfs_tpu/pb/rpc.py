@@ -208,6 +208,14 @@ def derived_grpc_port(http_port: int) -> int:
     return p if p <= 65535 else http_port - GRPC_PORT_DELTA
 
 
+def derived_admin_port(http_port: int) -> int:
+    """Native-plane admin listener for a public port: +11000, wrapping
+    downward past the ceiling (same rule as derived_grpc_port, offset
+    chosen not to collide with the gRPC shadow)."""
+    p = http_port + 11000
+    return p if p <= 65535 else http_port - 11000
+
+
 def grpc_address(http_address: str) -> str:
     """HTTP host:port -> gRPC host:port (+10000 convention)."""
     host, _, port = http_address.rpartition(":")

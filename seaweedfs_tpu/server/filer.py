@@ -259,7 +259,9 @@ class FilerServer:
         open(log_path, "wb").close()
         self._hot_mark = 0
         self._hot_log_corrupt = False  # fresh log: clear any replay alarm
-        self.admin_port = self.port + 11000
+        # high-port guard: a filer on e.g. :57000 must not derive an
+        # admin port past 65535 (that crashed the whole server)
+        self.admin_port = rpc.derived_admin_port(self.port)
         self.hot_plane = NativeFilerPlane(
             "", self.port, self.admin_port,
             self._vol_plane.plane_id, log_path,

@@ -85,8 +85,7 @@ class VolumeServer:
                                and guard is None and types.OFFSET_SIZE == 4)
         self.native_plane = None
         if self.native_enabled:
-            self.admin_port = port + 11000 if port + 11000 < 65536 \
-                else port - 11000
+            self.admin_port = rpc.derived_admin_port(port)
         else:
             self.admin_port = port
         if tier_backends:

@@ -1,0 +1,193 @@
+"""In-process fake Elasticsearch: enough of the REST API (document
+PUT/GET/DELETE, index create/delete, _search with bool/term/range/
+prefix queries, sort, size, search_after, basic auth) to exercise the
+real elastic filer store (seaweedfs_tpu/filer/stores/elastic_wire.py)
+end to end. Runs on http.server; JSON shapes mirror ES 7.x."""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class FakeElasticServer:
+    def __init__(self, *, username: str = "", password: str = ""):
+        self.username, self.password = username, password
+        # indices: name -> {doc_id: source}
+        self.indices: dict[str, dict[str, dict]] = {}
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):  # quiet
+                pass
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b""
+                return json.loads(raw) if raw else {}
+
+            def _send(self, status: int, doc: dict) -> None:
+                payload = json.dumps(doc).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _authed(self) -> bool:
+                if not outer.password:
+                    return True
+                hdr = self.headers.get("Authorization", "")
+                want = "Basic " + base64.b64encode(
+                    f"{outer.username}:{outer.password}".encode()).decode()
+                return hdr == want
+
+            def _route(self, method: str) -> None:
+                if not self._authed():
+                    self._send(401, {"error": "unauthorized"})
+                    return
+                try:
+                    outer._handle(self, method)
+                except Exception as e:  # pragma: no cover
+                    self._send(500, {"error": str(e)})
+
+            def do_GET(self):
+                self._route("GET")
+
+            def do_PUT(self):
+                self._route("PUT")
+
+            def do_POST(self):
+                self._route("POST")
+
+            def do_DELETE(self):
+                self._route("DELETE")
+
+        self._httpd = ThreadingHTTPServer(("localhost", 0), Handler)
+        self.port = self._httpd.server_port
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- request handling --------------------------------------------------
+
+    def _handle(self, h, method: str) -> None:
+        path = h.path.split("?", 1)[0]
+        parts = [p for p in path.split("/") if p]
+        body = h._body() if method in ("PUT", "POST") else {}
+        with self._lock:
+            if len(parts) == 1:
+                index = parts[0]
+                if method == "PUT":       # create index
+                    if index in self.indices:
+                        h._send(400, {"error": {"type":
+                                                "resource_already_exists"}})
+                    else:
+                        self.indices[index] = {}
+                        h._send(200, {"acknowledged": True})
+                elif method == "DELETE":
+                    if self.indices.pop(index, None) is None:
+                        h._send(404, {"error": "no such index"})
+                    else:
+                        h._send(200, {"acknowledged": True})
+                else:
+                    h._send(404, {"error": "bad request"})
+                return
+            if len(parts) == 2 and parts[1] == "_search":
+                self._search(h, parts[0], body)
+                return
+            if len(parts) == 3 and parts[1] == "_doc":
+                index, doc_id = parts[0], parts[2]
+                if method == "PUT":
+                    self.indices.setdefault(index, {})[doc_id] = body
+                    h._send(201, {"result": "created", "_id": doc_id})
+                elif method == "GET":
+                    docs = self.indices.get(index)
+                    if docs is None:
+                        h._send(404, {"error": "no such index",
+                                      "found": False})
+                    elif doc_id in docs:
+                        h._send(200, {"found": True, "_id": doc_id,
+                                      "_source": docs[doc_id]})
+                    else:
+                        h._send(404, {"found": False})
+                elif method == "DELETE":
+                    docs = self.indices.get(index)
+                    if docs is None or doc_id not in docs:
+                        h._send(404, {"result": "not_found"})
+                    else:
+                        del docs[doc_id]
+                        h._send(200, {"result": "deleted"})
+                return
+        h._send(400, {"error": f"unhandled route {method} {path}"})
+
+    # -- search ------------------------------------------------------------
+
+    @staticmethod
+    def _match_clause(clause: dict, src: dict) -> bool:
+        kind = next(iter(clause))
+        spec = clause[kind]
+        field, cond = next(iter(spec.items()))
+        value = src.get(field)
+        if kind == "term":
+            return value == cond
+        if kind == "prefix":
+            return isinstance(value, str) and value.startswith(cond)
+        if kind == "range":
+            for op, rhs in cond.items():
+                if op == "gt" and not (value or "") > rhs:
+                    return False
+                if op == "gte" and not (value or "") >= rhs:
+                    return False
+                if op == "lt" and not (value or "") < rhs:
+                    return False
+                if op == "lte" and not (value or "") <= rhs:
+                    return False
+            return True
+        raise ValueError(f"unsupported query clause {kind}")
+
+    def _search(self, h, index: str, body: dict) -> None:
+        docs = self.indices.get(index)
+        if docs is None:
+            h._send(404, {"error": "no such index"})
+            return
+        query = body.get("query", {})
+        clauses = (query.get("bool", {}).get("must", [query])
+                   if "bool" in query else [query] if query else [])
+        rows = [(doc_id, src) for doc_id, src in docs.items()
+                if all(self._match_clause(c, src) for c in clauses)]
+        sort_spec = body.get("sort", [])
+        sort_fields = []
+        for s in sort_spec:
+            if isinstance(s, dict):
+                f, d = next(iter(s.items()))
+                sort_fields.append((f, d if isinstance(d, str)
+                                    else d.get("order", "asc")))
+        for f, order in reversed(sort_fields):
+            key = (lambda r, f=f: r[1].get(f) if f != "_id" else r[0])
+            rows.sort(key=lambda r: key(r) or "", reverse=order == "desc")
+        after = body.get("search_after")
+        if after and sort_fields:
+            f0 = sort_fields[0][0]
+
+            def sort_val(r):
+                return r[0] if f0 == "_id" else (r[1].get(f0) or "")
+
+            rows = [r for r in rows if sort_val(r) > after[0]]
+        size = body.get("size", 10)
+        rows = rows[:size]
+        hits = [{"_id": doc_id, "_source": src,
+                 "sort": [src.get(sort_fields[0][0]) if sort_fields
+                          and sort_fields[0][0] != "_id" else doc_id]}
+                for doc_id, src in rows]
+        h._send(200, {"hits": {"total": {"value": len(hits)},
+                               "hits": hits}})

@@ -454,3 +454,66 @@ def test_native_plane_actually_serves(hot_cluster):
     st = fs.hot_plane.stats()
     assert st["native_puts"] > 10, st
     assert st["native_gets"] > 5, st
+
+
+def test_high_filer_port_admin_shadow_stays_in_range(tmp_path):
+    """A filer on a port where +11000 would pass 65535 must fall back to
+    port-11000 for the hot-plane admin listener, like the volume plane
+    (volume.py:88) — not crash the whole server with a bind overflow."""
+    import socket as _socket
+
+    from seaweedfs_tpu.pb import rpc
+    from seaweedfs_tpu.server.filer import FilerServer
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    from tests.test_cli_server import _pick_ports
+
+    mport, vport = _pick_ports(2)
+    # a high filer port whose +11000 shadow overflows but that is itself
+    # free along with its -11000 shadow
+    fport = None
+    for cand in range(60100, 65100, 7):
+        try:
+            with _socket.socket() as s1, _socket.socket() as s2, \
+                    _socket.socket() as s3:
+                s1.bind(("", cand))
+                s2.bind(("", cand - 11000))
+                s3.bind(("", cand - 10000 if cand - 10000 > 0 else cand))
+            fport = cand  # grpc shadow wraps down too (derived_grpc_port)
+            break
+        except OSError:
+            continue
+    if fport is None:
+        import pytest as _pytest
+
+        _pytest.skip("no suitable high port free")
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "v")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=vport, native=True)
+    vsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    fs = FilerServer(ip="localhost", port=fport,
+                     master=f"localhost:{mport}",
+                     store_dir=str(tmp_path / "f"),
+                     native_volume_plane=vsrv.native_plane)
+    try:
+        fs.start()
+        assert fs.admin_port <= 65535
+        if fs.hot_plane is not None:
+            assert fs.admin_port == fport - 11000
+        r = requests.put(f"http://localhost:{fport}/hi/x.bin",
+                         data=b"high-port", timeout=20)
+        assert r.status_code in (200, 201)
+        g = requests.get(f"http://localhost:{fport}/hi/x.bin", timeout=20)
+        assert g.status_code == 200 and g.content == b"high-port"
+    finally:
+        fs.stop()
+        vsrv.stop()
+        master.stop()
+        rpc.reset_channels()

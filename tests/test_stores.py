@@ -713,6 +713,97 @@ def test_mongodb_scram_auth(mongo_server):
         locked.stop()
 
 
+# -- elastic store (REST/JSON against an in-process fake ES) ---------------
+
+@pytest.fixture
+def es_server():
+    from tests.fake_elastic import FakeElasticServer
+
+    srv = FakeElasticServer()
+    yield srv
+    srv.stop()
+
+
+def test_elastic_store_crud_listing_and_kv(es_server):
+    """elastic_store.go layout over plain REST: index per top-level dir,
+    md5 ids, ParentId term queries; Name-sorted listings (the reference
+    sorts md5-of-path descending — an upstream wart this store fixes)."""
+    store = get_store("elastic", host="localhost", port=es_server.port)
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/a/b/c.txt", attr=Attr(mtime=11)))
+    for i in range(5):
+        f.create_entry(Entry(full_path=f"/a/b/f{i}"))
+    assert f.find_entry("/a/b/c.txt").attr.mtime == 11
+    assert [e.name for e in f.list_entries("/a/b")] == \
+        ["c.txt", "f0", "f1", "f2", "f3", "f4"]
+    assert [e.name for e in f.list_entries("/a/b", start="f1")] == \
+        ["f2", "f3", "f4"]
+    assert len(list(f.list_entries("/a/b", prefix="f"))) == 5
+    f.delete_entry("/a/b/f0")
+    assert store.find_entry("/a/b/f0") is None
+    # docs really live in the per-top-dir index
+    assert any(k.startswith(".seaweedfs_a") for k in es_server.indices)
+    # kv round-trip
+    gnarly = bytes(range(256))
+    store.kv_put(b"\x00weird\xffkey", gnarly)
+    assert store.kv_get(b"\x00weird\xffkey") == gnarly
+    assert store.kv_get(b"absent") is None
+    # subtree delete: top-level wipe drops the index
+    store.delete_folder_children("/a")
+    assert store.find_entry("/a/b/c.txt") is None
+    assert ".seaweedfs_a" not in es_server.indices
+    store.close()
+
+
+def test_elastic_case_variants_and_file_delete_isolation(es_server):
+    """/Data and /data must not share an index (ES index names are
+    forcibly lowercase; the reference's plain lower() makes an index
+    drop for one destroy the other), and deleting a top-level FILE must
+    never drop a directory's index."""
+    store = get_store("elastic", host="localhost", port=es_server.port)
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/data/keep.txt", content=b"lower"))
+    f.create_entry(Entry(full_path="/Data/other.txt", content=b"upper"))
+    assert store.find_entry("/data/keep.txt").content == b"lower"
+    assert store.find_entry("/Data/other.txt").content == b"upper"
+    # deleting the UPPER-case tree leaves the lower-case one intact
+    store.delete_folder_children("/Data")
+    store.delete_entry("/Data")
+    assert store.find_entry("/Data/other.txt") is None
+    assert store.find_entry("/data/keep.txt").content == b"lower"
+    # a top-level FILE named like a directory must not wipe the dir
+    f.create_entry(Entry(full_path="/data2", content=b"plain file"))
+    f.create_entry(Entry(full_path="/data2x/deep.txt", content=b"tree"))
+    store.delete_entry("/data2")
+    assert store.find_entry("/data2") is None
+    assert store.find_entry("/data2x/deep.txt").content == b"tree"
+    store.close()
+
+
+def test_elastic_store_auth_and_pagination(es_server):
+    from tests.fake_elastic import FakeElasticServer
+
+    from seaweedfs_tpu.filer.stores.elastic_wire import ElasticError
+
+    locked = FakeElasticServer(username="weed", password="sekret")
+    try:
+        with pytest.raises(ElasticError, match="401"):
+            get_store("elastic", host="localhost", port=locked.port)
+        store = get_store("elastic", host="localhost", port=locked.port,
+                          username="weed", password="sekret")
+        # force multi-page listing through search_after
+        store.max_page_size = 3
+        f = Filer(store)
+        for i in range(10):
+            f.create_entry(Entry(full_path=f"/pg/dir/e{i:02d}"))
+        names = [e.name for e in
+                 store.list_directory_entries("/pg/dir", limit=1024)]
+        assert names == [f"e{i:02d}" for i in range(10)]
+        store.close()
+    finally:
+        locked.stop()
+
+
 # -- mysql store (real client/server protocol against an in-process
 #    server) ---------------------------------------------------------------
 
