@@ -49,7 +49,9 @@ def register_store(name: str, cls: type) -> None:
 def get_store(name: str, **kwargs) -> FilerStore:
     from .stores import (  # noqa: F401 - registration side effect
         abstract_sql,
+        cql_wire,
         elastic_wire,
+        etcd_store,
         gated,
         leveldb,
         memory,
@@ -68,7 +70,9 @@ def get_store(name: str, **kwargs) -> FilerStore:
 def available_stores() -> list[str]:
     from .stores import (  # noqa: F401 - registration side effect
         abstract_sql,
+        cql_wire,
         elastic_wire,
+        etcd_store,
         gated,
         leveldb,
         memory,

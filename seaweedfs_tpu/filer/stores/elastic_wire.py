@@ -96,6 +96,11 @@ class ElasticClient:
                 except OSError:
                     pass
                 self._local.conn = None
+                with self._conns_lock:
+                    try:
+                        self._all_conns.remove(c)
+                    except ValueError:
+                        pass
                 if attempt:
                     raise
         doc = json.loads(raw) if raw else {}
@@ -186,8 +191,7 @@ class ElasticStore:
         d, n = self._split(entry.full_path)
         blob = entry.to_pb().SerializeToString()
         self._ensure_index(_index_of(entry.full_path))
-        self.client.request("PUT", self._doc_path(entry.full_path) +
-                            "?refresh=true", {
+        self.client.request("PUT", self._doc_path(entry.full_path), {
             "ParentId": _md5(d),
             "FullPath": entry.full_path,
             "Name": n,
@@ -230,14 +234,18 @@ class ElasticStore:
         # wipe the /Data directory tree)
         if full_path.count("/") == 1 and full_path != "/":
             e = self.find_entry(full_path)
-            if e is None or e.is_directory:
+            # a MISSING entry must not drop the index: deletes are
+            # idempotent everywhere else, and a stray second delete of
+            # a file racing a same-named directory's creation would
+            # otherwise wipe that directory's whole subtree
+            if e is not None and e.is_directory:
                 index = _index_of(full_path, is_directory=True)
                 self.client.request("DELETE", "/" + index,
                                     ok_statuses=(200, 404))
                 self._known_indices.discard(index)
         try:
-            self.client.request("DELETE", self._doc_path(full_path)
-                                + "?refresh=true", ok_statuses=(200, 404))
+            self.client.request("DELETE", self._doc_path(full_path),
+                                ok_statuses=(200, 404))
         except ElasticError as e:
             if e.status != 404:
                 raise
@@ -268,6 +276,14 @@ class ElasticStore:
         base = dir_path.rstrip("/") or "/"
         index = _index_of(base, is_directory=True)
         parent = _md5(base)
+        # one refresh per listing instead of refresh=true on every
+        # write (the per-write form serializes real-ES ingest behind
+        # segment creation; GET-by-id is realtime and needs neither)
+        try:
+            self.client.request("POST", f"/{index}/_refresh", {},
+                                ok_statuses=(200, 404))
+        except ElasticError:
+            pass
         must: list = [{"term": {"ParentId": parent}}]
         if start_file_name:
             op = "gte" if include_start else "gt"
@@ -311,7 +327,7 @@ class ElasticStore:
 
     def kv_put(self, key: bytes, value: bytes) -> None:
         self.client.request(
-            "PUT", f"/{INDEX_KV}/_doc/{key.hex()}?refresh=true",
+            "PUT", f"/{INDEX_KV}/_doc/{key.hex()}",
             {"Value": base64.b64encode(value).decode()})
 
     def kv_get(self, key: bytes) -> bytes | None:

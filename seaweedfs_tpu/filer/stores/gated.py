@@ -19,11 +19,12 @@ _GATED = {
     # postgres/postgres2 are REAL now: stores/pg_wire.py speaks the v3
     # wire protocol itself (extended query + SCRAM auth); mysql/mysql2
     # likewise via stores/mysql_wire.py (binary prepared statements)
-    "cassandra": "cassandra-driver",
+    # cassandra is REAL now: stores/cql_wire.py speaks CQL protocol v4
     # mongodb is REAL now: stores/mongo_wire.py speaks OP_MSG + BSON
     # elastic/elastic7 are REAL now: stores/elastic_wire.py drives the
     # REST/JSON API with the stdlib http client
-    "etcd": "etcd3",
+    # etcd is REAL now: stores/etcd_store.py drives the
+    # etcdserverpb.KV gRPC API via the repo pb stack
     "tikv": "tikv-client",
     "ydb": "ydb",
     "hbase": "happybase",

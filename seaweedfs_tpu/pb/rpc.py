@@ -129,6 +129,19 @@ FILER_SERVICE = ("filer_pb.SeaweedFiler", [
 ])
 
 
+def etcd_kv_service():
+    """etcdserverpb.KV subset (proto/etcd_kv.proto) — names match the
+    real etcd v3 API so the stub talks to an actual etcd unchanged.
+    Lazy: the etcd store is the only consumer."""
+    from . import etcd_kv_pb2 as E
+
+    return ("etcdserverpb.KV", [
+        _m("Range", E.RangeRequest, E.RangeResponse),
+        _m("Put", E.PutRequest, E.PutResponse),
+        _m("DeleteRange", E.DeleteRangeRequest, E.DeleteRangeResponse),
+    ])
+
+
 # -- generic stub / servicer -----------------------------------------------
 
 class Stub:

@@ -1,0 +1,218 @@
+"""In-process fake Cassandra: enough of the CQL binary protocol v4
+(STARTUP/READY, PasswordAuthenticator challenge, QUERY with bound
+values, Rows/Void results, ERROR frames) to exercise the real
+cassandra filer store (seaweedfs_tpu/filer/stores/cql_wire.py) end to
+end. Statements execute on sqlite with the CQL-isms translated
+(USING TTL, keyspaces, clustering clauses)."""
+
+from __future__ import annotations
+
+import re
+import socket
+import sqlite3
+import struct
+import threading
+
+OP_ERROR, OP_STARTUP, OP_READY, OP_AUTHENTICATE = 0x00, 0x01, 0x02, 0x03
+OP_QUERY, OP_RESULT, OP_AUTH_RESPONSE, OP_AUTH_SUCCESS = (
+    0x07, 0x08, 0x0F, 0x10)
+T_BLOB, T_INT, T_VARCHAR = 0x0003, 0x0009, 0x000D
+
+
+def translate_cql(cql: str) -> str | None:
+    """CQL -> sqlite; None means 'acknowledge with Void, no-op'."""
+    s = cql.strip()
+    if re.match(r"CREATE KEYSPACE|USE\s", s, flags=re.I):
+        return None
+    s = re.sub(r"\s*USING TTL \?", "", s, flags=re.I)
+    # CQL INSERT is an upsert by definition
+    s = re.sub(r"^INSERT INTO", "INSERT OR REPLACE INTO", s, flags=re.I)
+    s = re.sub(r"PRIMARY KEY\s*\(\((\w+)\),\s*(\w+)\)",
+               r"PRIMARY KEY (\1, \2)", s, flags=re.I)
+    s = re.sub(r"\)\s*WITH CLUSTERING ORDER BY.*$", ")", s,
+               flags=re.I | re.S)
+    s = s.replace("varchar", "TEXT").replace("blob", "BLOB")
+    return s
+
+
+class FakeCassandraServer:
+    def __init__(self, *, username: str = "", password: str = ""):
+        self.username, self.password = username, password
+        self.db = sqlite3.connect(":memory:", check_same_thread=False)
+        self._dblock = threading.Lock()
+        self._listen = socket.socket()
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind(("localhost", 0))
+        self._listen.listen(8)
+        self.port = self._listen.getsockname()[1]
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listen.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    @staticmethod
+    def _recv_exact(conn: socket.socket, n: int) -> bytes:
+        buf = b""
+        while len(buf) < n:
+            chunk = conn.recv(n - len(buf))
+            if not chunk:
+                raise ConnectionError("client gone")
+            buf += chunk
+        return buf
+
+    @staticmethod
+    def _frame(opcode: int, body: bytes, stream: int = 0) -> bytes:
+        return struct.pack(">BBhBI", 0x84, 0, stream, opcode,
+                           len(body)) + body
+
+    def _error(self, code: int, msg: str) -> bytes:
+        raw = msg.encode()
+        return self._frame(OP_ERROR, struct.pack(">i", code)
+                           + struct.pack(">H", len(raw)) + raw)
+
+    def _serve(self, conn: socket.socket) -> None:
+        try:
+            authed = not self.password
+            while not self._stop.is_set():
+                head = self._recv_exact(conn, 9)
+                _ver, _flags, stream, opcode, length = struct.unpack(
+                    ">BBhBI", head)
+                body = self._recv_exact(conn, length)
+                if opcode == OP_STARTUP:
+                    if self.password:
+                        cls = "org.apache.cassandra.auth.PasswordAuthenticator"
+                        raw = cls.encode()
+                        conn.sendall(self._frame(
+                            OP_AUTHENTICATE,
+                            struct.pack(">H", len(raw)) + raw, stream))
+                    else:
+                        conn.sendall(self._frame(OP_READY, b"", stream))
+                elif opcode == OP_AUTH_RESPONSE:
+                    (n,) = struct.unpack(">i", body[:4])
+                    token = body[4:4 + n]
+                    parts = token.split(b"\x00")
+                    if (len(parts) >= 3
+                            and parts[1].decode() == self.username
+                            and parts[2].decode() == self.password):
+                        authed = True
+                        conn.sendall(self._frame(
+                            OP_AUTH_SUCCESS, struct.pack(">i", -1), stream))
+                    else:
+                        conn.sendall(self._error(0x0100, "Bad credentials"))
+                elif opcode == OP_QUERY:
+                    if not authed:
+                        conn.sendall(self._error(0x0100, "not authed"))
+                        continue
+                    conn.sendall(self._query(body, stream))
+                else:
+                    conn.sendall(self._error(0x000A,
+                                             f"bad opcode {opcode}"))
+        except (ConnectionError, OSError, struct.error):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- query handling ----------------------------------------------------
+
+    def _query(self, body: bytes, stream: int) -> bytes:
+        (qlen,) = struct.unpack(">I", body[:4])
+        cql = body[4:4 + qlen].decode("utf-8")
+        off = 4 + qlen
+        _consistency, flags = struct.unpack_from(">HB", body, off)
+        off += 3
+        raw_vals: list[bytes | None] = []
+        if flags & 0x01:
+            (nvals,) = struct.unpack_from(">H", body, off)
+            off += 2
+            for _ in range(nvals):
+                (ln,) = struct.unpack_from(">i", body, off)
+                off += 4
+                if ln < 0:
+                    raw_vals.append(None)
+                else:
+                    raw_vals.append(body[off:off + ln])
+                    off += ln
+        had_ttl = re.search(r"USING TTL \?", cql, flags=re.I) is not None
+        lite = translate_cql(cql)
+        if lite is None:
+            return self._frame(OP_RESULT, struct.pack(">i", 1), stream)
+        if had_ttl and raw_vals:
+            raw_vals = raw_vals[:-1]          # TTL param consumed
+        # type the raw values by statement shape: INSERT binds
+        # (text, text, blob); everything else binds text (LIMIT ? is
+        # a 4-byte int, detected by context position)
+        args: list = []
+        is_insert = lite.lstrip().upper().startswith("INSERT")
+        has_limit = re.search(r"LIMIT \?", lite, flags=re.I) is not None
+        for i, rv in enumerate(raw_vals):
+            if rv is None:
+                args.append(None)
+            elif is_insert and i == 2:
+                args.append(rv)               # meta blob
+            elif has_limit and i == len(raw_vals) - 1:
+                args.append(int.from_bytes(rv, "big", signed=True))
+            else:
+                args.append(rv.decode("utf-8"))
+        try:
+            with self._dblock:
+                cur = self.db.cursor()
+                cur.execute(lite, args)
+                rows = cur.fetchall() if cur.description else []
+                desc = cur.description
+                self.db.commit()
+        except sqlite3.Error as e:
+            return self._error(0x2200, f"sqlite: {e}")
+        if not desc:
+            return self._frame(OP_RESULT, struct.pack(">i", 1), stream)
+        # Rows result with global_tables_spec
+        types = []
+        for ci in range(len(desc)):
+            tid = T_VARCHAR
+            for row in rows:
+                v = row[ci]
+                if v is None:
+                    continue
+                tid = (T_BLOB if isinstance(v, bytes)
+                       else T_INT if isinstance(v, int) else T_VARCHAR)
+                break
+            types.append(tid)
+        out = [struct.pack(">i", 2), struct.pack(">ii", 0x0001, len(desc))]
+
+        def s(x: str) -> bytes:
+            raw = x.encode()
+            return struct.pack(">H", len(raw)) + raw
+
+        out += [s("seaweedfs"), s("filemeta")]
+        for col, tid in zip(desc, types):
+            out.append(s(col[0]) + struct.pack(">H", tid))
+        out.append(struct.pack(">i", len(rows)))
+        for row in rows:
+            for v, tid in zip(row, types):
+                if v is None:
+                    out.append(struct.pack(">i", -1))
+                elif tid == T_INT:
+                    out.append(struct.pack(">i", 4)
+                               + struct.pack(">i", int(v)))
+                elif isinstance(v, bytes):
+                    out.append(struct.pack(">i", len(v)) + v)
+                else:
+                    raw = str(v).encode()
+                    out.append(struct.pack(">i", len(raw)) + raw)
+        return self._frame(OP_RESULT, b"".join(out), stream)

@@ -105,6 +105,10 @@ class FakeElasticServer:
             if len(parts) == 2 and parts[1] == "_search":
                 self._search(h, parts[0], body)
                 return
+            if len(parts) == 2 and parts[1] == "_refresh":
+                h._send(200 if parts[0] in self.indices else 404,
+                        {"_shards": {"successful": 1}})
+                return
             if len(parts) == 3 and parts[1] == "_doc":
                 index, doc_id = parts[0], parts[2]
                 if method == "PUT":

@@ -1,0 +1,75 @@
+"""In-process fake etcd v3: the etcdserverpb.KV service (Range with
+range_end/sort/limit, Put, DeleteRange) served via grpcio over the same
+proto the store's client uses — byte-range semantics implemented
+independently on a sorted key dict, revision counters included."""
+
+from __future__ import annotations
+
+import threading
+
+from seaweedfs_tpu.pb import etcd_kv_pb2 as E, rpc
+
+
+class _KVServicer:
+    def __init__(self):
+        self.data: dict[bytes, tuple[bytes, int]] = {}  # key -> (val, rev)
+        self.rev = 0
+        self.lock = threading.Lock()
+
+    def _select(self, key: bytes, range_end: bytes) -> list[bytes]:
+        if not range_end:
+            return [key] if key in self.data else []
+        if range_end == b"\x00":      # from key to end of keyspace
+            return sorted(k for k in self.data if k >= key)
+        return sorted(k for k in self.data if key <= k < range_end)
+
+    def Range(self, req: E.RangeRequest, ctx) -> E.RangeResponse:
+        with self.lock:
+            keys = self._select(req.key, req.range_end)
+            if req.sort_order == E.RangeRequest.DESCEND:
+                keys.reverse()
+            count = len(keys)
+            if req.limit:
+                keys = keys[:req.limit]
+            kvs = [E.KeyValue(key=k, value=self.data[k][0],
+                              mod_revision=self.data[k][1])
+                   for k in keys]
+            return E.RangeResponse(
+                header=E.ResponseHeader(revision=self.rev),
+                kvs=kvs, count=count,
+                more=req.limit > 0 and count > req.limit)
+
+    def Put(self, req: E.PutRequest, ctx) -> E.PutResponse:
+        with self.lock:
+            self.rev += 1
+            self.data[req.key] = (req.value, self.rev)
+            return E.PutResponse(
+                header=E.ResponseHeader(revision=self.rev))
+
+    def DeleteRange(self, req: E.DeleteRangeRequest,
+                    ctx) -> E.DeleteRangeResponse:
+        with self.lock:
+            keys = self._select(req.key, req.range_end)
+            for k in keys:
+                del self.data[k]
+            if keys:
+                self.rev += 1
+            return E.DeleteRangeResponse(
+                header=E.ResponseHeader(revision=self.rev),
+                deleted=len(keys))
+
+
+class FakeEtcdServer:
+    def __init__(self):
+        self.servicer = _KVServicer()
+        self._server = rpc.new_server(max_workers=8)
+        rpc.add_servicer(self._server, rpc.etcd_kv_service(), self.servicer)
+        self.port = self._server.add_insecure_port("localhost:0")
+        self._server.start()
+
+    @property
+    def data(self):
+        return self.servicer.data
+
+    def stop(self) -> None:
+        self._server.stop(grace=0.2)

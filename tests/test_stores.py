@@ -98,7 +98,7 @@ def test_gated_stores_fail_with_guidance():
     with pytest.raises(RuntimeError, match="redis-py"):
         get_store("redis3")
     with pytest.raises(RuntimeError, match="client library"):
-        get_store("cassandra")
+        get_store("tikv")
 
 
 # -- redis store (real RESP wire against an in-process server) -------------
@@ -711,6 +711,149 @@ def test_mongodb_scram_auth(mongo_server):
         c.close()
     finally:
         locked.stop()
+
+
+# -- etcd store (etcdserverpb.KV gRPC against an in-process server) --------
+
+@pytest.fixture
+def etcd_server():
+    from tests.fake_etcd import FakeEtcdServer
+
+    srv = FakeEtcdServer()
+    yield srv
+    srv.stop()
+
+
+def test_etcd_store_crud_listing_and_kv(etcd_server):
+    """etcd_store.go's dir\\x00name key layout over the real
+    etcdserverpb.KV gRPC surface (Range/Put/DeleteRange)."""
+    store = get_store("etcd", servers=f"localhost:{etcd_server.port}")
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/a/b/c.txt", attr=Attr(mtime=11)))
+    for i in range(5):
+        f.create_entry(Entry(full_path=f"/a/b/f{i}"))
+    assert f.find_entry("/a/b/c.txt").attr.mtime == 11
+    assert [e.name for e in f.list_entries("/a/b")] == \
+        ["c.txt", "f0", "f1", "f2", "f3", "f4"]
+    assert [e.name for e in f.list_entries("/a/b", start="f1")] == \
+        ["f2", "f3", "f4"]
+    assert [e.name for e in
+            store.list_directory_entries("/a/b", "f1",
+                                         include_start=True)] == \
+        ["f1", "f2", "f3", "f4"]
+    assert len(list(f.list_entries("/a/b", prefix="f"))) == 5
+    f.delete_entry("/a/b/f0")
+    assert store.find_entry("/a/b/f0") is None
+    # the dir\x00name layout is really on the wire
+    assert b"/a/b\x00c.txt" in etcd_server.data
+    # kv: raw key bytes are the etcd key (etcd_store_kv.go)
+    gnarly = bytes(range(256))
+    store.kv_put(b"\x01raw\xffkey", gnarly)
+    assert store.kv_get(b"\x01raw\xffkey") == gnarly
+    assert store.kv_get(b"absent") is None
+    # subtree delete: children + descendants, sibling prefixes survive
+    for p in ("/t/x/1", "/t/x/sub/2", "/t/x/sub/deep/3", "/t/xy/keep"):
+        f.create_entry(Entry(full_path=p))
+    store.delete_folder_children("/t/x")
+    assert store.find_entry("/t/x/1") is None
+    assert store.find_entry("/t/x/sub/2") is None
+    assert store.find_entry("/t/x/sub/deep/3") is None
+    assert store.find_entry("/t/xy/keep") is not None
+    store.close()
+
+
+def test_etcd_and_cassandra_prefix_listing_beyond_limit(etcd_server,
+                                                        cass_server):
+    """A prefixed listing must find matches past the first `limit`
+    non-matching names (server-side limit + client-side filter would
+    silently return nothing)."""
+    for store in (
+        get_store("etcd", servers=f"localhost:{etcd_server.port}"),
+        get_store("cassandra", host="localhost", port=cass_server.port),
+    ):
+        f = Filer(store)
+        for i in range(60):
+            f.create_entry(Entry(full_path=f"/plim/dir/a{i:03d}"))
+        f.create_entry(Entry(full_path="/plim/dir/zfile.txt"))
+        names = [e.name for e in store.list_directory_entries(
+            "/plim/dir", prefix="z", limit=50)]
+        assert names == ["zfile.txt"], (store.name, names)
+        store.close()
+
+
+# -- cassandra store (CQL protocol v4 against an in-process server) --------
+
+@pytest.fixture
+def cass_server():
+    from tests.fake_cassandra import FakeCassandraServer
+
+    srv = FakeCassandraServer()
+    yield srv
+    srv.stop()
+
+
+def test_cassandra_store_crud_listing_and_kv(cass_server):
+    """cassandra_store.go's exact statement set over the real CQL v4
+    wire (frames, bound values, Rows results)."""
+    store = get_store("cassandra", host="localhost", port=cass_server.port)
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/a/b/c.txt", attr=Attr(mtime=11)))
+    for i in range(5):
+        f.create_entry(Entry(full_path=f"/a/b/f{i}"))
+    assert f.find_entry("/a/b/c.txt").attr.mtime == 11
+    assert [e.name for e in f.list_entries("/a/b")] == \
+        ["c.txt", "f0", "f1", "f2", "f3", "f4"]
+    assert [e.name for e in f.list_entries("/a/b", start="f1")] == \
+        ["f2", "f3", "f4"]
+    assert len(list(f.list_entries("/a/b", prefix="f"))) == 5
+    f.delete_entry("/a/b/f0")
+    assert store.find_entry("/a/b/f0") is None
+    # upsert (CQL INSERT semantics)
+    f.create_entry(Entry(full_path="/a/b/c.txt", attr=Attr(mtime=99)))
+    assert f.find_entry("/a/b/c.txt").attr.mtime == 99
+    # kv with binary keys through the 8-byte split
+    gnarly = bytes(range(256))
+    store.kv_put(b"\xfe\xffkey", gnarly)
+    assert store.kv_get(b"\xfe\xffkey") == gnarly
+    assert store.kv_get(b"absent!") is None
+    # subtree delete (python recursion over partitions)
+    for p in ("/t/x/1", "/t/x/sub/2", "/t/keep"):
+        f.create_entry(Entry(full_path=p))
+    store.delete_folder_children("/t/x")
+    assert store.find_entry("/t/x/1") is None
+    assert store.find_entry("/t/x/sub/2") is None
+    assert store.find_entry("/t/keep") is not None
+    store.close()
+
+
+def test_cassandra_auth_and_errors(cass_server):
+    from tests.fake_cassandra import FakeCassandraServer
+
+    from seaweedfs_tpu.filer.stores.cql_wire import (
+        CqlConnection,
+        CqlError,
+    )
+
+    locked = FakeCassandraServer(username="weed", password="sekret")
+    try:
+        store = get_store("cassandra", host="localhost", port=locked.port,
+                          username="weed", password="sekret")
+        f = Filer(store)
+        f.create_entry(Entry(full_path="/auth/ok", attr=Attr(mtime=5)))
+        assert f.find_entry("/auth/ok").attr.mtime == 5
+        store.close()
+        with pytest.raises((CqlError, ConnectionError)):
+            CqlConnection(host="localhost", port=locked.port,
+                          username="weed", password="wrong")
+    finally:
+        locked.stop()
+    # server-side errors keep the connection framed and usable
+    c = CqlConnection(host="localhost", port=cass_server.port)
+    with pytest.raises(CqlError, match="sqlite"):
+        c.query("SELECT * FROM no_such_table")
+    assert c.query("CREATE KEYSPACE IF NOT EXISTS x WITH replication = "
+                   "{'class': 'SimpleStrategy'}") == []
+    c.close()
 
 
 # -- elastic store (REST/JSON against an in-process fake ES) ---------------
