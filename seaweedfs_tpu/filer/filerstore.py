@@ -49,6 +49,7 @@ def register_store(name: str, cls: type) -> None:
 def get_store(name: str, **kwargs) -> FilerStore:
     from .stores import (  # noqa: F401 - registration side effect
         abstract_sql,
+        arango_wire,
         cql_wire,
         elastic_wire,
         etcd_store,
@@ -70,6 +71,7 @@ def get_store(name: str, **kwargs) -> FilerStore:
 def available_stores() -> list[str]:
     from .stores import (  # noqa: F401 - registration side effect
         abstract_sql,
+        arango_wire,
         cql_wire,
         elastic_wire,
         etcd_store,

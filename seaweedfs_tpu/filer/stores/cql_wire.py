@@ -33,6 +33,7 @@ from typing import Iterator
 from ...pb import filer_pb2
 from ..entry import Entry
 from ..filerstore import register_store
+from .wire_common import split_dir_name
 
 # opcodes
 OP_ERROR, OP_STARTUP, OP_READY, OP_AUTHENTICATE = 0x00, 0x01, 0x02, 0x03
@@ -93,6 +94,7 @@ class CqlConnection:
         self._lock = threading.Lock()
         self._sock: socket.socket | None = None
         self._buf = b""
+        self._keyspace = ""
         self._connect()
 
     # -- frames ------------------------------------------------------------
@@ -141,6 +143,10 @@ class CqlConnection:
                 raise self._parse_error(body)
             elif opcode != OP_READY:
                 raise CqlError(0, f"unexpected startup opcode {opcode}")
+            if self._keyspace:
+                # a reconnect must replay USE: statements are
+                # unqualified, and a fresh session has no keyspace
+                self._query_locked(f"USE {self._keyspace}", ())
         except Exception:
             self._mark_broken()
             raise
@@ -161,6 +167,11 @@ class CqlConnection:
         return CqlError(code, body[6:6 + n].decode("utf-8", "replace"))
 
     # -- query -------------------------------------------------------------
+
+    def set_keyspace(self, keyspace: str) -> None:
+        """USE now and on every reconnect."""
+        self.query(f"USE {keyspace}")
+        self._keyspace = keyspace
 
     def query(self, cql: str, params: tuple = ()) -> list[tuple]:
         with self._lock:
@@ -249,18 +260,13 @@ class CassandraStore:
         self.conn.query(
             f"CREATE KEYSPACE IF NOT EXISTS {keyspace} WITH replication = "
             f"{{'class': 'SimpleStrategy', 'replication_factor': 1}}")
-        self.conn.query(f"USE {keyspace}")
+        self.conn.set_keyspace(keyspace)
         self.conn.query(
             "CREATE TABLE IF NOT EXISTS filemeta (directory varchar, "
             "name varchar, meta blob, PRIMARY KEY ((directory), name)) "
             "WITH CLUSTERING ORDER BY (name ASC)")
 
-    @staticmethod
-    def _split(full_path: str) -> tuple[str, str]:
-        if full_path == "/":
-            return "", "/"
-        d, _, n = full_path.rstrip("/").rpartition("/")
-        return d or "/", n
+    _split = staticmethod(split_dir_name)
 
     def insert_entry(self, entry: Entry) -> None:
         d, n = self._split(entry.full_path)

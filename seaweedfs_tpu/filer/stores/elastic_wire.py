@@ -39,6 +39,7 @@ from typing import Iterator
 from ...pb import filer_pb2
 from ..entry import Entry
 from ..filerstore import register_store
+from .wire_common import split_dir_name
 
 INDEX_PREFIX = ".seaweedfs_"
 INDEX_KV = ".seaweedfs_kv_entries"
@@ -199,12 +200,7 @@ class ElasticStore:
 
     update_entry = insert_entry
 
-    @staticmethod
-    def _split(full_path: str) -> tuple[str, str]:
-        if full_path == "/":
-            return "", "/"
-        d, _, n = full_path.rstrip("/").rpartition("/")
-        return d or "/", n
+    _split = staticmethod(split_dir_name)
 
     def _decode(self, src: dict, directory: str) -> Entry | None:
         meta = src.get("Meta")

@@ -28,6 +28,7 @@ from typing import Iterator
 from ...pb import filer_pb2
 from ..entry import Entry
 from ..filerstore import register_store
+from .wire_common import split_dir_name
 
 SEP = b"\x00"
 
@@ -66,12 +67,7 @@ class EtcdStore:
         self.kv.Range(E.RangeRequest(key=b"\x00", limit=1),
                       timeout=timeout)
 
-    @staticmethod
-    def _split(full_path: str) -> tuple[str, str]:
-        if full_path == "/":
-            return "", "/"
-        d, _, n = full_path.rstrip("/").rpartition("/")
-        return d or "/", n
+    _split = staticmethod(split_dir_name)
 
     def _key(self, full_path: str) -> bytes:
         d, n = self._split(full_path)

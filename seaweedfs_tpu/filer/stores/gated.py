@@ -28,7 +28,8 @@ _GATED = {
     "tikv": "tikv-client",
     "ydb": "ydb",
     "hbase": "happybase",
-    "arangodb": "python-arango",
+    # arangodb is REAL now: stores/arango_wire.py drives
+    # the REST + AQL cursor API
 }
 
 

@@ -39,7 +39,7 @@ from ...pb import filer_pb2
 from ..entry import Entry
 from ..filerstore import register_store
 from .bson import Int64, Regex, decode_doc, encode_doc
-from .wire_common import ScramClient
+from .wire_common import ScramClient, split_dir_name
 
 OP_MSG = 2013
 
@@ -179,12 +179,7 @@ class MongodbStore:
             "indexes": [{"key": {"directory": 1, "name": 1},
                          "name": "directory_1_name_1", "unique": True}]})
 
-    @staticmethod
-    def _split(full_path: str) -> tuple[str, str]:
-        if full_path == "/":
-            return "", "/"
-        d, _, n = full_path.rstrip("/").rpartition("/")
-        return d or "/", n
+    _split = staticmethod(split_dir_name)
 
     def _upsert(self, d: str, n: str, meta: bytes) -> None:
         self.conn.command(self.database, {

@@ -67,6 +67,15 @@ def rewrite_placeholders(sql: str, token: Callable[[int], str]) -> str:
     return "".join(out)
 
 
+def split_dir_name(full_path: str) -> tuple[str, str]:
+    """'/a/b/c.txt' -> ('/a/b', 'c.txt'); root is ('', '/'). The one
+    canonical path splitter for every wire store."""
+    if full_path == "/":
+        return "", "/"
+    d, _, n = full_path.rstrip("/").rpartition("/")
+    return d or "/", n
+
+
 class ScramClient:
     """Client side of SCRAM-SHA-256 (RFC 5802/7677). postgres leaves
     the authzid/username empty (the startup message names the user);

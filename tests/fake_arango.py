@@ -1,0 +1,220 @@
+"""In-process fake ArangoDB: document CRUD with overwriteMode=replace,
+collection create/drop, basic auth, and an AQL endpoint that executes
+the filer store's two query templates (list + subtree remove) with
+bindVars and small cursor batches so hasMore/PUT-cursor paging runs.
+Exercises seaweedfs_tpu/filer/stores/arango_wire.py end to end."""
+
+from __future__ import annotations
+
+import base64
+import json
+import re
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+BATCH = 3
+
+
+class FakeArangoServer:
+    def __init__(self, *, username: str = "", password: str = ""):
+        self.username, self.password = username, password
+        self.collections: dict[str, dict[str, dict]] = {}
+        self._cursors: dict[str, list[dict]] = {}
+        self._next_cursor = 100
+        self._lock = threading.Lock()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _body(self) -> dict:
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n) if n else b""
+                return json.loads(raw) if raw else {}
+
+            def _send(self, status: int, doc: dict) -> None:
+                payload = json.dumps(doc).encode()
+                self.send_response(status)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+            def _authed(self) -> bool:
+                if not outer.password:
+                    return True
+                want = "Basic " + base64.b64encode(
+                    f"{outer.username}:{outer.password}".encode()).decode()
+                return self.headers.get("Authorization", "") == want
+
+            def _route(self, method: str) -> None:
+                if not self._authed():
+                    self._send(401, {"error": True, "errorMessage":
+                                     "unauthorized"})
+                    return
+                try:
+                    outer._handle(self, method)
+                except Exception as e:  # pragma: no cover
+                    self._send(500, {"error": True, "errorMessage": str(e)})
+
+            def do_GET(self):
+                self._route("GET")
+
+            def do_POST(self):
+                self._route("POST")
+
+            def do_PUT(self):
+                self._route("PUT")
+
+            def do_DELETE(self):
+                self._route("DELETE")
+
+        self._httpd = ThreadingHTTPServer(("localhost", 0), Handler)
+        self.port = self._httpd.server_port
+        threading.Thread(target=self._httpd.serve_forever,
+                         daemon=True).start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+    # -- routing -----------------------------------------------------------
+
+    def _handle(self, h, method: str) -> None:
+        path, _, query = h.path.partition("?")
+        parts = [p for p in path.split("/") if p]
+        # strip /_db/<name>
+        if len(parts) >= 2 and parts[0] == "_db":
+            parts = parts[2:]
+        body = h._body() if method in ("POST", "PUT") else {}
+        with self._lock:
+            if parts[:2] == ["_api", "collection"]:
+                if method == "GET" and len(parts) == 2:
+                    h._send(200, {"result": [{"name": n}
+                                             for n in self.collections]})
+                    return
+                if method == "POST":
+                    name = body.get("name", "")
+                    if name in self.collections:
+                        h._send(409, {"error": True,
+                                      "errorMessage": "duplicate name"})
+                    else:
+                        self.collections[name] = {}
+                        h._send(200, {"name": name})
+                elif method == "DELETE" and len(parts) == 3:
+                    if self.collections.pop(parts[2], None) is None:
+                        h._send(404, {"error": True})
+                    else:
+                        h._send(200, {})
+                else:
+                    h._send(400, {"error": True})
+                return
+            if parts[:2] == ["_api", "document"]:
+                self._document(h, method, parts[2:], body,
+                               "overwriteMode=replace" in query)
+                return
+            if parts[:2] == ["_api", "cursor"]:
+                if method == "POST":
+                    self._cursor_start(h, body)
+                elif method == "PUT" and len(parts) == 3:
+                    self._cursor_next(h, parts[2])
+                else:
+                    h._send(400, {"error": True})
+                return
+        h._send(400, {"error": True,
+                      "errorMessage": f"unhandled {method} {path}"})
+
+    def _document(self, h, method: str, rest: list, body: dict,
+                  replace: bool) -> None:
+        if method == "POST" and len(rest) == 1:
+            coll = self.collections.get(rest[0])
+            if coll is None:
+                h._send(404, {"error": True})
+                return
+            key = body.get("_key", "")
+            if key in coll and not replace:
+                h._send(409, {"error": True, "errorMessage": "conflict"})
+                return
+            coll[key] = body
+            h._send(201, {"_key": key})
+            return
+        if len(rest) == 2:
+            coll = self.collections.get(rest[0])
+            if coll is None or rest[1] not in coll:
+                h._send(404, {"error": True})
+                return
+            if method == "GET":
+                h._send(200, coll[rest[1]])
+            elif method == "DELETE":
+                del coll[rest[1]]
+                h._send(200, {})
+            else:
+                h._send(400, {"error": True})
+            return
+        h._send(400, {"error": True})
+
+    # -- AQL (the store's two templates only) ------------------------------
+
+    _LIST_RE = re.compile(
+        r"FOR d IN @@collection FILTER d\.directory == @dir "
+        r"AND d\.name (>=|>) @start AND STARTS_WITH\(d\.name, @prefix\) "
+        r"SORT d\.name ASC LIMIT @limit RETURN d")
+    _REMOVE_RE = re.compile(
+        r"FOR d IN @@collection FILTER d\.directory == @dir OR "
+        r"STARTS_WITH\(d\.directory, @sub\) REMOVE d IN @@collection")
+
+    def _cursor_start(self, h, body: dict) -> None:
+        query = " ".join(body.get("query", "").split())
+        bind = body.get("bindVars", {})
+        coll = self.collections.get(bind.get("@collection", ""))
+        if coll is None:
+            h._send(404, {"error": True, "errorMessage": "no collection"})
+            return
+        m = self._LIST_RE.fullmatch(query)
+        if m:
+            op = m.group(1)
+            rows = [d for d in coll.values()
+                    if d.get("directory") == bind["dir"]
+                    and (d.get("name", "") >= bind["start"] if op == ">="
+                         else d.get("name", "") > bind["start"])
+                    and d.get("name", "").startswith(bind["prefix"])]
+            rows.sort(key=lambda d: d.get("name", ""))
+            rows = rows[:bind["limit"]]
+            self._respond_batched(h, rows)
+            return
+        if self._REMOVE_RE.fullmatch(query):
+            doomed = [k for k, d in coll.items()
+                      if d.get("directory") == bind["dir"]
+                      or d.get("directory", "").startswith(bind["sub"])]
+            for k in doomed:
+                del coll[k]
+            h._send(201, {"result": [], "hasMore": False,
+                          "count": len(doomed)})
+            return
+        h._send(400, {"error": True,
+                      "errorMessage": f"unsupported AQL: {query}"})
+
+    def _respond_batched(self, h, rows: list) -> None:
+        first, rest = rows[:BATCH], rows[BATCH:]
+        doc: dict = {"result": first, "hasMore": bool(rest)}
+        if rest:
+            cid = str(self._next_cursor)
+            self._next_cursor += 1
+            self._cursors[cid] = rest
+            doc["id"] = cid
+        h._send(201, doc)
+
+    def _cursor_next(self, h, cid: str) -> None:
+        rest = self._cursors.get(cid, [])
+        batch, rest = rest[:BATCH], rest[BATCH:]
+        if rest:
+            self._cursors[cid] = rest
+        else:
+            self._cursors.pop(cid, None)
+        doc = {"result": batch, "hasMore": bool(rest)}
+        if rest:
+            doc["id"] = cid
+        h._send(200, doc)

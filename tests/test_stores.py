@@ -713,6 +713,93 @@ def test_mongodb_scram_auth(mongo_server):
         locked.stop()
 
 
+# -- arangodb store (REST + AQL against an in-process server) --------------
+
+@pytest.fixture
+def arango_server():
+    from tests.fake_arango import FakeArangoServer
+
+    srv = FakeArangoServer()
+    yield srv
+    srv.stop()
+
+
+def test_arangodb_store_crud_listing_and_kv(arango_server):
+    """arangodb_store.go layout over REST+AQL: md5 _key docs, collection
+    per bucket, AQL listings batched small enough to exercise cursor
+    paging (PUT /_api/cursor)."""
+    store = get_store("arangodb", host="localhost",
+                      port=arango_server.port)
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/a/b/c.txt", attr=Attr(mtime=11)))
+    for i in range(9):
+        f.create_entry(Entry(full_path=f"/a/b/f{i}"))
+    assert f.find_entry("/a/b/c.txt").attr.mtime == 11
+    assert [e.name for e in f.list_entries("/a/b")] == \
+        ["c.txt"] + [f"f{i}" for i in range(9)]
+    assert [e.name for e in f.list_entries("/a/b", start="f5")] == \
+        ["f6", "f7", "f8"]
+    assert len(list(f.list_entries("/a/b", prefix="f"))) == 9
+    f.delete_entry("/a/b/f0")
+    assert store.find_entry("/a/b/f0") is None
+    f.create_entry(Entry(full_path="/a/b/c.txt", attr=Attr(mtime=99)))
+    assert f.find_entry("/a/b/c.txt").attr.mtime == 99
+    # kv
+    gnarly = bytes(range(256))
+    store.kv_put(b"\x00kv\xffkey", gnarly)
+    assert store.kv_get(b"\x00kv\xffkey") == gnarly
+    assert store.kv_get(b"nope") is None
+    # subtree delete through the AQL REMOVE template
+    for p in ("/t/x/1", "/t/x/sub/2", "/t/keep"):
+        f.create_entry(Entry(full_path=p))
+    store.delete_folder_children("/t/x")
+    assert store.find_entry("/t/x/1") is None
+    assert store.find_entry("/t/x/sub/2") is None
+    assert store.find_entry("/t/keep") is not None
+    # bucket objects land in their own collection; bucket wipe drops it
+    f.create_entry(Entry(full_path="/buckets/bk1/obj", content=b"b1"))
+    assert "bucket_bk1" in arango_server.collections
+    assert store.find_entry("/buckets/bk1/obj").content == b"b1"
+    # bucket DIR entries stay in the default collection so that listing
+    # /buckets (S3 ListAllMyBuckets) actually works
+    f.create_entry(Entry(full_path="/buckets/bk2", is_directory=True))
+    names = [e.name for e in store.list_directory_entries("/buckets")]
+    assert "bk2" in names
+    store.delete_folder_children("/buckets/bk1")
+    assert store.find_entry("/buckets/bk1/obj") is None
+    assert "bucket_bk1" not in arango_server.collections
+    # /buckets-wide wipe drops every bucket collection
+    f.create_entry(Entry(full_path="/buckets/bk3/deep/obj", content=b"x"))
+    store.delete_folder_children("/buckets")
+    assert store.find_entry("/buckets/bk3/deep/obj") is None
+    assert not any(n.startswith("bucket_")
+                   for n in arango_server.collections)
+    # root-wide wipe reaches the whole tree (sub prefix "/" not "//")
+    f.create_entry(Entry(full_path="/deep/er/file", content=b"d"))
+    store.delete_folder_children("/")
+    assert store.find_entry("/deep/er/file") is None
+    store.close()
+
+
+def test_arangodb_auth(arango_server):
+    from tests.fake_arango import FakeArangoServer
+
+    from seaweedfs_tpu.filer.stores.elastic_wire import ElasticError
+
+    locked = FakeArangoServer(username="weed", password="sekret")
+    try:
+        with pytest.raises(ElasticError, match="401"):
+            get_store("arangodb", host="localhost", port=locked.port)
+        store = get_store("arangodb", host="localhost", port=locked.port,
+                          username="weed", password="sekret")
+        f = Filer(store)
+        f.create_entry(Entry(full_path="/auth/ok", attr=Attr(mtime=5)))
+        assert f.find_entry("/auth/ok").attr.mtime == 5
+        store.close()
+    finally:
+        locked.stop()
+
+
 # -- etcd store (etcdserverpb.KV gRPC against an in-process server) --------
 
 @pytest.fixture
