@@ -191,6 +191,43 @@ def fused_reconstruct_op(
     return op, used
 
 
+@functools.lru_cache(maxsize=512)
+def fused_reconstruct_stacked_matrix(
+    data_shards: int, parity_shards: int, present_ids: tuple[int, ...],
+    limit: int,
+) -> tuple[tuple[int, ...], np.ndarray]:
+    """Byte-form [missing, len(present_ids)] matrix operating on
+    survivors stacked in the CALLER's row order: the fused matrix's
+    columns are permuted to that order, with zero columns for surplus
+    survivors — so a pre-stacked buffer needs no device gather."""
+    missing = tuple(i for i in range(limit) if i not in set(present_ids))
+    if not missing:
+        return (), np.zeros((0, len(present_ids)), np.uint8)
+    fmat, used = fused_reconstruct_matrix(
+        data_shards, parity_shards, tuple(sorted(present_ids)), missing)
+    col_of = {s: c for c, s in enumerate(used)}
+    pm = np.zeros((len(missing), len(present_ids)), np.uint8)
+    for j, s in enumerate(present_ids):
+        c = col_of.get(s)
+        if c is not None:
+            pm[:, j] = fmat[:, c]
+    return missing, pm
+
+
+def fused_reconstruct_stacked_op(
+    data_shards: int, parity_shards: int, present_ids: tuple[int, ...],
+    limit: int, form: str,
+) -> tuple[tuple[int, ...], np.ndarray]:
+    """Cached derived-form of the stacked (column-permuted) operand."""
+    missing, pm = fused_reconstruct_stacked_matrix(
+        data_shards, parity_shards, present_ids, limit)
+    if not missing:
+        return missing, pm
+    op = _derived(form, ("fdecs", data_shards, parity_shards,
+                         present_ids, missing), pm)
+    return missing, op
+
+
 def parity_matrix_op(data_shards: int, parity_shards: int,
                      form: str) -> np.ndarray:
     """Cached parity-matrix operand in "bits" or "xor" form."""
@@ -265,7 +302,7 @@ def _dispatch_matmul(matrix: np.ndarray, data: jax.Array, out_rows: int,
         key = ("raw", matrix.shape, matrix.tobytes())
     b = data.shape[1]
     kind = _kernel_choice(b)
-    if kind.startswith("sel-") and key[0] == "fdec":
+    if kind.startswith("sel-") and key[0] in ("fdec", "fdecs"):
         # sel kernels specialize on the static matrix; fused reconstruct
         # matrices (one per survivor+missing set, up to C(n,k) of them)
         # would recompile per failure pattern — route those to the
@@ -391,21 +428,12 @@ class RSCodecJax:
         survivors — identical GF math, zero data movement."""
         limit = self.data_shards if data_only else self.total_shards
         present_ids = tuple(present_ids)
-        missing = tuple(i for i in range(limit)
-                        if i not in set(present_ids))
         stacked = jnp.asarray(stacked, jnp.uint8)
         assert stacked.shape[0] == len(present_ids), stacked.shape
+        missing, pm = fused_reconstruct_stacked_matrix(
+            self.data_shards, self.parity_shards, present_ids, limit)
         if not missing:
             return (), jnp.zeros((0, stacked.shape[1]), jnp.uint8)
-        fmat, used = fused_reconstruct_matrix(
-            self.data_shards, self.parity_shards,
-            tuple(sorted(present_ids)), missing)
-        col_of = {s: c for c, s in enumerate(used)}
-        pm = np.zeros((len(missing), len(present_ids)), np.uint8)
-        for j, s in enumerate(present_ids):
-            c = col_of.get(s)
-            if c is not None:
-                pm[:, j] = fmat[:, c]
         key = ("fdecs", self.data_shards, self.parity_shards,
                present_ids, missing)
         out = _dispatch_matmul(pm, stacked, len(missing), key=key)

@@ -28,6 +28,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.rs_jax import (
     fused_reconstruct_op,
+    fused_reconstruct_stacked_op,
     gf_matmul_bits,
     parity_matrix_op,
 )
@@ -176,6 +177,28 @@ class ShardedCoder:
         out_arr = _apply_sharded(fused_op, arr, self.mesh, self.axis,
                                  self.kernel)
         return {i: out_arr[j][:b] for j, i in enumerate(missing)}
+
+    def reconstruct_stacked(self, present_ids, stacked,
+                            data_only: bool = False):
+        """Pre-stacked survivors [P, B] in caller row order ->
+        (missing_ids, [missing, B]) — the column-permuted fused matmul
+        sharded over the mesh, no re-stack/gather (same contract as
+        RSCodecJax.reconstruct_stacked)."""
+        present_ids = tuple(present_ids)
+        assert stacked.shape[0] == len(present_ids), stacked.shape
+        limit = self.data_shards if data_only else self.total_shards
+        missing, op_np = fused_reconstruct_stacked_op(
+            self.data_shards, self.parity_shards, present_ids, limit,
+            self.kernel)
+        if not missing:
+            return (), jnp.zeros((0, stacked.shape[1]), jnp.uint8)
+        # hand the buffer to _shard untouched: a device-resident,
+        # correctly-sharded array must keep its fast path (np.asarray
+        # here would be a device->host->device round trip)
+        arr, b = self._shard(stacked)
+        out_arr = _apply_sharded(jnp.asarray(op_np), arr, self.mesh,
+                                 self.axis, self.kernel)
+        return missing, out_arr[:, :b]
 
     def verify(self, shards) -> bool:
         shards = np.asarray(shards, dtype=np.uint8)

@@ -41,6 +41,25 @@ def test_sharded_reconstruct(coder):
         assert np.array_equal(np.asarray(rebuilt[i]), shards[i])
 
 
+def test_sharded_reconstruct_stacked_matches_dict(coder):
+    """Mesh-sharded stacked reconstruct: same contract and bytes as the
+    dict path, shuffled caller row order, surplus survivors."""
+    rng = np.random.default_rng(7)
+    data = rng.integers(0, 256, size=(10, 4096), dtype=np.uint8)
+    shards = np.asarray(coder.encode(data))
+    lost = (1, 10, 12)  # 11 survivors > k: exercises the zero columns
+    pres_ids = tuple(i for i in range(14) if i not in lost)[::-1]
+    stacked = np.stack([shards[i] for i in pres_ids])
+    mids, rows = coder.reconstruct_stacked(pres_ids, stacked)
+    assert mids == lost
+    rows = np.asarray(rows)
+    for j, i in enumerate(mids):
+        assert np.array_equal(rows[j], shards[i])
+    # nothing missing
+    mids0, rows0 = coder.reconstruct_stacked(tuple(range(14)), shards)
+    assert mids0 == () and np.asarray(rows0).shape[0] == 0
+
+
 def test_parity_checksum_zero_then_nonzero(coder):
     rng = np.random.default_rng(2)
     data = rng.integers(0, 256, size=(10, 1024), dtype=np.uint8)
