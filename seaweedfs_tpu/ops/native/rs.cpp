@@ -20,6 +20,9 @@
 
 #include <cstdint>
 #include <cstring>
+#if defined(__AVX2__)
+#include <immintrin.h>
+#endif
 
 namespace {
 
@@ -58,18 +61,77 @@ struct Nibbles {
     }
 };
 
-inline void axpy(uint8_t c, const uint8_t* __restrict src, uint8_t* __restrict dst,
-                 int64_t n) {
-    if (c == 0) return;
-    if (c == 1) {
-        for (int64_t j = 0; j < n; ++j) dst[j] ^= src[j];
-        return;
-    }
+inline void axpy_scalar(uint8_t c, const uint8_t* __restrict src,
+                        uint8_t* __restrict dst, int64_t n) {
     const Nibbles t(c);
     for (int64_t j = 0; j < n; ++j) {
         const uint8_t x = src[j];
         dst[j] ^= static_cast<uint8_t>(t.lo[x & 0xF] ^ t.hi[x >> 4]);
     }
+}
+
+#if defined(__AVX2__)
+// The vectorized form of the same split — one vpshufb per nibble, the
+// exact scheme klauspost/reedsolomon's SSSE3/AVX2 Go assembly uses
+// (SURVEY.md §2: galMulAVX2). 32 bytes per iteration, tables stay in
+// two ymm registers. This is the honest CPU anchor for the TPU
+// numbers: comparing against the scalar loop would flatter the chip.
+inline void axpy_avx2(uint8_t c, const uint8_t* __restrict src,
+                      uint8_t* __restrict dst, int64_t n) {
+    const Nibbles t(c);
+    const __m256i lo = _mm256_broadcastsi128_si256(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(t.lo)));
+    const __m256i hi = _mm256_broadcastsi128_si256(
+        _mm_loadu_si128(reinterpret_cast<const __m128i*>(t.hi)));
+    const __m256i mask = _mm256_set1_epi8(0x0F);
+    int64_t j = 0;
+    for (; j + 32 <= n; j += 32) {
+        const __m256i x = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i*>(src + j));
+        const __m256i l = _mm256_shuffle_epi8(lo,
+                                              _mm256_and_si256(x, mask));
+        const __m256i h = _mm256_shuffle_epi8(
+            hi, _mm256_and_si256(_mm256_srli_epi64(x, 4), mask));
+        const __m256i d = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i*>(dst + j));
+        _mm256_storeu_si256(
+            reinterpret_cast<__m256i*>(dst + j),
+            _mm256_xor_si256(d, _mm256_xor_si256(l, h)));
+    }
+    if (j < n) axpy_scalar(c, src + j, dst + j, n - j);
+}
+
+inline void xor_avx2(const uint8_t* __restrict src,
+                     uint8_t* __restrict dst, int64_t n) {
+    int64_t j = 0;
+    for (; j + 32 <= n; j += 32) {
+        const __m256i x = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i*>(src + j));
+        const __m256i d = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i*>(dst + j));
+        _mm256_storeu_si256(reinterpret_cast<__m256i*>(dst + j),
+                            _mm256_xor_si256(d, x));
+    }
+    for (; j < n; ++j) dst[j] ^= src[j];
+}
+#endif
+
+inline void axpy(uint8_t c, const uint8_t* __restrict src, uint8_t* __restrict dst,
+                 int64_t n) {
+    if (c == 0) return;
+    if (c == 1) {
+#if defined(__AVX2__)
+        xor_avx2(src, dst, n);
+#else
+        for (int64_t j = 0; j < n; ++j) dst[j] ^= src[j];
+#endif
+        return;
+    }
+#if defined(__AVX2__)
+    axpy_avx2(c, src, dst, n);
+#else
+    axpy_scalar(c, src, dst, n);
+#endif
 }
 
 }  // namespace
@@ -133,6 +195,17 @@ uint32_t swfs_crc32c(const uint8_t* data, int64_t n, uint32_t seed) {
     }
     for (; i < n; ++i) crc = (crc >> 8) ^ crc32c_table[0][(crc ^ data[i]) & 0xFF];
     return ~crc;
+}
+
+// Which axpy variant this build runs: 2 = AVX2 vpshufb, 0 = scalar.
+// Lets callers (bench.py) record the anchor they actually measured
+// instead of assuming the vectorized build succeeded.
+int swfs_simd_level() {
+#if defined(__AVX2__)
+    return 2;
+#else
+    return 0;
+#endif
 }
 
 }  // extern "C"

@@ -95,6 +95,20 @@ class RSCodecNative(RSCodecCPU):
         return gf_matmul_native(matrix, data)
 
 
+def simd_level() -> int:
+    """2 = AVX2 vpshufb build, 0 = scalar; -1 if the library is
+    unavailable or predates the export."""
+    try:
+        lib = load_library()
+        fn = getattr(lib, "swfs_simd_level", None)
+        if fn is None:
+            return -1
+        fn.restype = ctypes.c_int
+        return int(fn())
+    except Exception:
+        return -1
+
+
 def available() -> bool:
     try:
         load_library()
