@@ -4,7 +4,12 @@ Each probe runs in a subprocess with a hard timeout (the wedged tunnel
 HANGS rather than erring). On the first healthy probe this runs
 tools/tune_kernels.py --quick and appends everything to TUNE_RESULT.txt.
 
-Usage: python tools/await_tpu.py [--minutes 9]
+Usage: python tools/await_tpu.py [--minutes 9] [--bench]
+
+--bench runs `python bench.py` (single device attempt, generous budget)
+instead of the kernel tune on the first healthy probe, appending the
+JSON line to BENCH_WATCH.txt — the round-5 "capture a device number the
+moment the tunnel recovers" loop in one command.
 """
 
 from __future__ import annotations
@@ -32,33 +37,58 @@ def probe(timeout: float = 75) -> bool:
         return False
 
 
+def _as_text(x) -> str:
+    """TimeoutExpired attaches stdout/stderr as BYTES even under
+    text=True; normalize either way."""
+    if x is None:
+        return ""
+    if isinstance(x, bytes):
+        return x.decode("utf-8", "replace")
+    return x
+
+
+def run_and_log(cmd: list, outfile: str, timeout: float, label: str,
+                env: dict | None = None) -> int:
+    """Run `cmd`, append stdout + stderr-tail to `outfile`, echo stdout."""
+    stamp = time.strftime("%H:%M:%S")
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout, env=env)
+        stdout, stderr, rc = r.stdout, r.stderr, r.returncode
+    except subprocess.TimeoutExpired as e:  # tunnel re-wedged
+        stdout = _as_text(e.stdout)
+        stderr = (f"{label} timed out (tunnel wedged again?)\n"
+                  + _as_text(e.stderr))
+        rc = 124
+    with open(outfile, "a") as f:
+        f.write(f"\n=== {label} at {stamp} (rc={rc}) ===\n")
+        f.write(stdout)
+        f.write(stderr[-2000:])
+    print(stdout, flush=True)
+    return rc
+
+
 def main() -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--minutes", type=float, default=9.0)
+    ap.add_argument("--bench", action="store_true",
+                    help="run bench.py instead of the kernel tune")
     args = ap.parse_args()
     deadline = time.time() + args.minutes * 60
     while time.time() < deadline:
         if probe():
             stamp = time.strftime("%H:%M:%S")
-            print(f"[{stamp}] tunnel healthy — tuning", flush=True)
-            try:
-                r = subprocess.run(
-                    [sys.executable, os.path.join(REPO, "tools",
-                                                  "tune_kernels.py"),
-                     "--quick"],
-                    capture_output=True, text=True, timeout=1200)
-                stdout, stderr, rc = r.stdout, r.stderr, r.returncode
-            except subprocess.TimeoutExpired as e:  # tunnel re-wedged
-                stdout = e.stdout or ""
-                stderr = ("tune timed out (tunnel wedged again?)\n"
-                          + (e.stderr or ""))
-                rc = 124
-            with open(OUT, "a") as f:
-                f.write(f"\n=== tune at {stamp} (rc={rc}) ===\n")
-                f.write(stdout)
-                f.write(stderr[-2000:])
-            print(stdout, flush=True)
-            return rc
+            action = "benching" if args.bench else "tuning"
+            print(f"[{stamp}] tunnel healthy — {action}", flush=True)
+            if args.bench:
+                return run_and_log(
+                    [sys.executable, os.path.join(REPO, "bench.py")],
+                    os.path.join(REPO, "BENCH_WATCH.txt"), 1500, "bench",
+                    env=dict(os.environ, SEAWEEDFS_TPU_BENCH_ATTEMPTS="1"))
+            return run_and_log(
+                [sys.executable,
+                 os.path.join(REPO, "tools", "tune_kernels.py"),
+                 "--quick"], OUT, 1200, "tune")
         time.sleep(45)
     print("tunnel still wedged", flush=True)
     return 1
