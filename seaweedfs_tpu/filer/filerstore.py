@@ -58,6 +58,7 @@ def get_store(name: str, **kwargs) -> FilerStore:
         memory,
         mongo_wire,
         redis,
+        redis3,
         sqlite,
     )
 
@@ -80,6 +81,7 @@ def available_stores() -> list[str]:
         memory,
         mongo_wire,
         redis,
+        redis3,
         sqlite,
     )
 

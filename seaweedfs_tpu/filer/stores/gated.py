@@ -13,8 +13,9 @@ from ..filerstore import register_store
 
 _GATED = {
     "rocksdb": "python-rocksdb (cgo-gated in the reference too)",
-    # redis/redis2 are REAL now: stores/redis.py speaks RESP itself
-    "redis3": "redis-py (sharded key layout; redis/redis2 are live)",
+    # redis/redis2 are REAL now: stores/redis.py speaks RESP itself;
+    # redis3 likewise via stores/redis3.py (segmented bounded-key
+    # directory listings)
     "redis_lua": "redis-py",
     # postgres/postgres2 are REAL now: stores/pg_wire.py speaks the v3
     # wire protocol itself (extended query + SCRAM auth); mysql/mysql2

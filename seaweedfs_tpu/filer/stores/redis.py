@@ -130,14 +130,43 @@ class RedisStore:
         self.client = RespClient(host, port, db=db or database,
                                  password=password)
 
+    # -- child-index hooks (redis3 overrides these with the segmented
+    #    layout; entry-blob handling stays shared) -------------------------
+
+    def _index_child(self, dir_path: str, name: str) -> None:
+        self.client.cmd("ZADD", _dir_set_key(dir_path), "0", name.encode())
+
+    def _unindex_child(self, dir_path: str, name: str) -> None:
+        self.client.cmd("ZREM", _dir_set_key(dir_path), name.encode())
+
+    def _iter_child_names(self, dir_path: str, lo: str,
+                          inclusive: bool):
+        """Child names >= lo (or > lo), ascending. Paged so an
+        emptiness probe never pulls a huge directory over the wire."""
+        set_key = _dir_set_key(dir_path)
+        if lo:
+            bound = (("[" if inclusive else "(") + lo).encode()
+        else:
+            bound = b"-"
+        offset, page_size = 0, 1024
+        while True:
+            page = self.client.cmd("ZRANGEBYLEX", set_key, bound, b"+",
+                                   "LIMIT", str(offset), str(page_size))
+            if not page:
+                return
+            for m in page:
+                yield m.decode()
+            if len(page) < page_size:
+                return
+            offset += len(page)
+
     # -- FilerStore SPI ----------------------------------------------------
 
     def insert_entry(self, entry: Entry) -> None:
         blob = filer_pb2.FullEntry(
             dir=entry.parent, entry=entry.to_pb()).SerializeToString()
         self.client.cmd("SET", entry.full_path.encode(), blob)
-        self.client.cmd("ZADD", _dir_set_key(entry.parent), "0",
-                        entry.name.encode())
+        self._index_child(entry.parent, entry.name)
 
     update_entry = insert_entry
 
@@ -151,7 +180,7 @@ class RedisStore:
     def delete_entry(self, full_path: str) -> None:
         d, _, name = full_path.rpartition("/")
         self.client.cmd("DEL", full_path.encode())
-        self.client.cmd("ZREM", _dir_set_key(d or "/"), name.encode())
+        self._unindex_child(d or "/", name)
 
     def delete_folder_children(self, full_path: str) -> None:
         """BFS over the per-directory sets: every descendant entry key
@@ -182,37 +211,22 @@ class RedisStore:
         wire (the reference redis2 store pushes LIMIT down the same
         way)."""
         d = dir_path.rstrip("/") or "/"
-        if start_file_name:
-            lo = (("[" if include_start else "(")
-                  + start_file_name).encode()
-        elif prefix:
-            lo = b"[" + prefix.encode()
-        else:
-            lo = b"-"
-        set_key = _dir_set_key(d)
-        offset, count = 0, 0
-        page_size = max(16, min(limit, 1024))
-        while True:
-            page = self.client.cmd("ZRANGEBYLEX", set_key, lo, b"+",
-                                   "LIMIT", str(offset), str(page_size))
-            if not page:
-                return
-            for m in page:
-                name = m.decode()
-                if prefix and not name.startswith(prefix):
-                    if name > prefix:  # lex-sorted: no more matches
-                        return
-                    continue
-                e = self.find_entry((d.rstrip("/") or "") + "/" + name)
-                if e is None:
-                    continue
-                yield e
-                count += 1
-                if count >= limit:
+        lo, inclusive = start_file_name, include_start or not start_file_name
+        if prefix and prefix > lo:
+            lo, inclusive = prefix, True
+        count = 0
+        for name in self._iter_child_names(d, lo, inclusive):
+            if prefix and not name.startswith(prefix):
+                if name > prefix:  # lex-sorted: no more matches
                     return
-            if len(page) < page_size:
+                continue
+            e = self.find_entry((d.rstrip("/") or "") + "/" + name)
+            if e is None:
+                continue
+            yield e
+            count += 1
+            if count >= limit:
                 return
-            offset += len(page)
 
     def kv_get(self, key: bytes) -> bytes | None:
         return self.client.cmd("GET", KV_PREFIX + key)

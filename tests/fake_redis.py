@@ -1,5 +1,5 @@
 """In-process pure-python RESP server: enough of the Redis wire protocol
-(SET/GET/DEL/ZADD/ZREM/ZRANGEBYLEX/AUTH/SELECT/PING/FLUSHDB) to exercise
+(SET/GET/DEL/ZADD/ZREM/ZCARD/ZRANGEBYLEX/ZREVRANGEBYLEX/MULTI/EXEC/AUTH/SELECT/PING/FLUSHDB) to exercise
 the real RedisStore (seaweedfs_tpu/filer/stores/redis.py) end to end.
 The protocol framing is real RESP2 — the same client code path talks to
 an actual Redis unchanged."""
@@ -48,6 +48,7 @@ class FakeRedisServer:
     def _serve(self, conn: socket.socket) -> None:
         f = conn.makefile("rb")
         authed = not self.password
+        queued: list | None = None  # MULTI buffer (per connection)
         try:
             while not self._stop.is_set():
                 args = self._read_command(f)
@@ -63,6 +64,25 @@ class FakeRedisServer:
                     continue
                 if not authed:
                     conn.sendall(b"-NOAUTH Authentication required.\r\n")
+                    continue
+                if cmd == "MULTI":
+                    queued = []
+                    conn.sendall(b"+OK\r\n")
+                    continue
+                if cmd == "EXEC":
+                    if queued is None:
+                        conn.sendall(b"-ERR EXEC without MULTI\r\n")
+                        continue
+                    with self._lock:  # atomic: one lock for the batch
+                        replies = [self._dispatch_locked(c, a)
+                                   for c, a in queued]
+                    queued = None
+                    conn.sendall(b"*%d\r\n" % len(replies)
+                                 + b"".join(replies))
+                    continue
+                if queued is not None:
+                    queued.append((cmd, args[1:]))
+                    conn.sendall(b"+QUEUED\r\n")
                     continue
                 conn.sendall(self._dispatch(cmd, args[1:]))
         except (OSError, ValueError):
@@ -97,6 +117,10 @@ class FakeRedisServer:
 
     def _dispatch(self, cmd: str, a: list[bytes]) -> bytes:
         with self._lock:
+            return self._dispatch_locked(cmd, a)
+
+    def _dispatch_locked(self, cmd: str, a: list[bytes]) -> bytes:
+        if True:
             if cmd == "PING":
                 return b"+PONG\r\n"
             if cmd == "SELECT":
@@ -137,6 +161,21 @@ class FakeRedisServer:
                         members.pop(i)
                         removed += 1
                 return b":%d\r\n" % removed
+            if cmd == "ZCARD" and len(a) == 1:
+                return b":%d\r\n" % len(self.zsets.get(a[0], []))
+            if cmd == "ZREVRANGEBYLEX" and len(a) in (3, 6):
+                # args come max-first: (key, hi, lo); reuse the range
+                # then reverse
+                members = self.zsets.get(a[0], [])
+                out = self._lex_range(members, a[2], a[1])[::-1]
+                if len(a) == 6:
+                    if a[3].upper() != b"LIMIT":
+                        return b"-ERR syntax error\r\n"
+                    off, cnt = int(a[4]), int(a[5])
+                    out = out[off:] if cnt < 0 else out[off:off + cnt]
+                body = b"".join(b"$%d\r\n%s\r\n" % (len(m), m)
+                                for m in out)
+                return b"*%d\r\n%s" % (len(out), body)
             if cmd == "ZRANGEBYLEX" and len(a) in (3, 6):
                 members = self.zsets.get(a[0], [])
                 out = self._lex_range(members, a[1], a[2])

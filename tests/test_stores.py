@@ -94,11 +94,11 @@ def test_leveldb_store_torn_tail_repair(tmp_path):
 
 
 def test_gated_stores_fail_with_guidance():
-    assert "redis3" in available_stores()
-    with pytest.raises(RuntimeError, match="redis-py"):
-        get_store("redis3")
+    assert "tikv" in available_stores()
     with pytest.raises(RuntimeError, match="client library"):
         get_store("tikv")
+    with pytest.raises(RuntimeError, match="happybase"):
+        get_store("hbase")
 
 
 # -- redis store (real RESP wire against an in-process server) -------------
@@ -175,6 +175,70 @@ def test_redis_store_auth_and_errors(redis_server):
         c.cmd("NOPE")
     assert c.cmd("PING") == "PONG"  # connection still in sync
     c.close()
+
+
+def test_redis3_segmented_listing(redis_server):
+    """redis3: directory listings in size-bounded ZSET segments (the
+    reference's skiplist-of-batches invariant). A tiny batch forces
+    real splits; ordering, pagination, prefix, and removal-driven
+    segment collapse must all hold across segment boundaries."""
+    store = get_store("redis3", host="localhost", port=redis_server.port,
+                      batch=4)
+    f = Filer(store)
+    names = [f"e{i:03d}" for i in range(40)]
+    import random
+
+    shuffled = names[:]
+    random.Random(7).shuffle(shuffled)  # splits under random order
+    for n in shuffled:
+        f.create_entry(Entry(full_path=f"/big/dir/{n}"))
+    # every segment key stays bounded at 2*batch
+    seg_keys = [k for k in redis_server.zsets
+                if k.startswith(b"/big/dir\x00seg:")]
+    assert len(seg_keys) >= 3, "tiny batch must have split segments"
+    assert all(len(redis_server.zsets[k]) <= 8 for k in seg_keys)
+    # full ordered listing across segments
+    assert [e.name for e in
+            store.list_directory_entries("/big/dir", limit=1024)] == names
+    # start/include_start pagination across a segment boundary
+    assert [e.name for e in store.list_directory_entries(
+        "/big/dir", "e019", include_start=False, limit=3)] == \
+        ["e020", "e021", "e022"]
+    assert [e.name for e in store.list_directory_entries(
+        "/big/dir", "e019", include_start=True, limit=2)] == \
+        ["e019", "e020"]
+    # prefix narrowing
+    assert [e.name for e in store.list_directory_entries(
+        "/big/dir", prefix="e03", limit=1024)] == \
+        [f"e{i:03d}" for i in range(30, 40)]
+    # removal shrinks/collapses segments without losing order
+    for n in names[10:30]:
+        f.delete_entry(f"/big/dir/{n}")
+    assert [e.name for e in
+            store.list_directory_entries("/big/dir", limit=1024)] == \
+        names[:10] + names[30:]
+    # subtree delete clears every segment + index key
+    store.delete_folder_children("/big")
+    assert store.find_entry("/big/dir/e000") is None
+    assert not any(k.startswith(b"/big/dir\x00")
+                   for k in redis_server.zsets if redis_server.zsets[k])
+    store.close()
+
+
+def test_redis3_crud_and_kv(redis_server):
+    store = get_store("redis3", host="localhost", port=redis_server.port)
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/a/b/c.txt", attr=Attr(mtime=11)))
+    assert f.find_entry("/a/b/c.txt").attr.mtime == 11
+    f.create_entry(Entry(full_path="/a/b/c.txt", attr=Attr(mtime=99)))
+    assert f.find_entry("/a/b/c.txt").attr.mtime == 99
+    store.kv_put(b"k3", b"v3")
+    assert store.kv_get(b"k3") == b"v3"
+    # entry blobs share the redis/redis2 layout: readable cross-store
+    other = get_store("redis", host="localhost", port=redis_server.port)
+    assert Filer(other).find_entry("/a/b/c.txt").attr.mtime == 99
+    other.close()
+    store.close()
 
 
 def test_filer_toml_selects_store(redis_server, tmp_path, monkeypatch):
