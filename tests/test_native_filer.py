@@ -462,6 +462,8 @@ def test_high_filer_port_admin_shadow_stays_in_range(tmp_path):
     (volume.py:88) — not crash the whole server with a bind overflow."""
     import socket as _socket
 
+    import pytest as _pytest
+
     from seaweedfs_tpu.pb import rpc
     from seaweedfs_tpu.server.filer import FilerServer
     from seaweedfs_tpu.server.master import MasterServer
@@ -469,25 +471,24 @@ def test_high_filer_port_admin_shadow_stays_in_range(tmp_path):
 
     from tests.test_cli_server import _pick_ports
 
-    mport, vport = _pick_ports(2)
-    # a high filer port whose +11000 shadow overflows but that is itself
-    # free along with its -11000 shadow
-    fport = None
-    for cand in range(60100, 65100, 7):
-        try:
-            with _socket.socket() as s1, _socket.socket() as s2, \
-                    _socket.socket() as s3:
-                s1.bind(("", cand))
-                s2.bind(("", cand - 11000))
-                s3.bind(("", cand - 10000 if cand - 10000 > 0 else cand))
-            fport = cand  # grpc shadow wraps down too (derived_grpc_port)
-            break
-        except OSError:
-            continue
-    if fport is None:
-        import pytest as _pytest
+    def probe(start: int):
+        """Next candidate >= start whose -11000/-10000 shadows are also
+        free: a high port whose +11000 shadow overflows. Cheap, so the
+        retry loop below re-runs it instead of paying a server startup
+        to discover a conflict."""
+        for cand in range(start, 65100, 7):
+            try:
+                with _socket.socket() as s1, _socket.socket() as s2, \
+                        _socket.socket() as s3:
+                    s1.bind(("", cand))
+                    s2.bind(("", cand - 11000))
+                    s3.bind(("", cand - 10000))
+                return cand  # grpc shadow wraps down (derived_grpc_port)
+            except OSError:
+                continue
+        return None
 
-        _pytest.skip("no suitable high port free")
+    mport, vport = _pick_ports(2)
     master = MasterServer(ip="localhost", port=mport,
                           volume_size_limit_mb=64)
     master.start(vacuum_interval=3600)
@@ -495,35 +496,35 @@ def test_high_filer_port_admin_shadow_stays_in_range(tmp_path):
                         master=f"localhost:{mport}", ip="localhost",
                         port=vport, native=True)
     vsrv.start()
-    deadline = time.time() + 10
-    while time.time() < deadline and not master.topo.nodes:
-        time.sleep(0.05)
-    # the probe above races concurrent suite tests grabbing ephemeral
-    # ports; retry across candidates rather than flaking
     fs = None
-    for attempt in range(3):
-        try:
-            fs = FilerServer(ip="localhost", port=fport,
-                             master=f"localhost:{mport}",
-                             store_dir=str(tmp_path / f"f{attempt}"),
-                             native_volume_plane=vsrv.native_plane)
-            fs.start()
-            break
-        except OSError:
-            try:
-                fs.stop()
-            except Exception:
-                pass
-            fs = None
-            fport += 14  # next candidate in the same high band
-    if fs is None:
-        vsrv.stop()
-        master.stop()
-        rpc.reset_channels()
-        import pytest as _pytest
-
-        _pytest.skip("high ports contended by concurrent tests")
     try:
+        deadline = time.time() + 10
+        while time.time() < deadline and not master.topo.nodes:
+            time.sleep(0.05)
+        # probes race concurrent suite tests grabbing ephemeral ports;
+        # re-probe + retry across the band rather than flaking
+        fport = 60100
+        for attempt in range(3):
+            fport = probe(fport)
+            if fport is None:
+                break
+            try:
+                fs = FilerServer(ip="localhost", port=fport,
+                                 master=f"localhost:{mport}",
+                                 store_dir=str(tmp_path / f"f{attempt}"),
+                                 native_volume_plane=vsrv.native_plane)
+                fs.start()
+                break
+            except OSError:
+                if fs is not None:
+                    try:
+                        fs.stop()
+                    except Exception:
+                        pass
+                fs = None
+                fport += 7  # lost the race: next candidate
+        if fs is None:
+            _pytest.skip("high ports contended by concurrent tests")
         assert fs.admin_port <= 65535
         if fs.hot_plane is not None:
             assert fs.admin_port == fport - 11000
@@ -533,7 +534,8 @@ def test_high_filer_port_admin_shadow_stays_in_range(tmp_path):
         g = requests.get(f"http://localhost:{fport}/hi/x.bin", timeout=20)
         assert g.status_code == 200 and g.content == b"high-port"
     finally:
-        fs.stop()
+        if fs is not None:
+            fs.stop()
         vsrv.stop()
         master.stop()
         rpc.reset_channels()
