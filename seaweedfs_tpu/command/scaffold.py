@@ -22,6 +22,62 @@ enabled = false
 address = "localhost:6379"
 password = ""
 database = 0
+
+[redis3]          # size-bounded segmented listings for huge directories
+enabled = false
+address = "localhost:6379"
+password = ""
+
+[postgres]        # also: [postgres2] — per-bucket tables
+enabled = false
+host = "localhost"
+port = 5432
+user = "postgres"
+password = ""
+database = "seaweedfs"
+
+[mysql]           # also: [mysql2] — per-bucket tables
+enabled = false
+host = "localhost"
+port = 3306
+user = "root"
+password = ""
+database = "seaweedfs"
+
+[mongodb]
+enabled = false
+host = "localhost"
+port = 27017
+database = "seaweedfs"
+user = ""         # SCRAM-SHA-256 when set
+password = ""
+
+[cassandra]
+enabled = false
+host = "localhost"
+port = 9042
+keyspace = "seaweedfs"
+username = ""
+password = ""
+
+[etcd]
+enabled = false
+servers = "localhost:2379"
+
+[elastic7]        # also: [elastic]
+enabled = false
+host = "localhost"
+port = 9200
+username = ""
+password = ""
+
+[arangodb]
+enabled = false
+host = "localhost"
+port = 8529
+username = "root"
+password = ""
+database = "_system"
 """,
     "master": """\
 # master.toml
