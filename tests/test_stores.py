@@ -1253,3 +1253,65 @@ def test_sqlite_kv_table_backcompat(tmp_path):
     store.kv_put(b"new-key", b"new-value")
     assert store.kv_get(b"new-key") == b"new-value"
     store.close()
+
+
+def test_filer_sync_across_heterogeneous_wire_stores(pg_server,
+                                                     mongo_server,
+                                                     tmp_path):
+    """filer.sync between a postgres-wire-backed filer and a
+    mongo-wire-backed filer: the metadata event log, sync loop, and
+    entry model must be store-agnostic end to end (the reference gets
+    this property from its FilerStore SPI; here both sides run live
+    wire protocols)."""
+    from seaweedfs_tpu.filer import Filer
+    from seaweedfs_tpu.replication import FilerSyncLoop
+
+    clusters = []
+    try:
+        filers = []
+        for tag, store in (("pg", get_store("postgres", host="localhost",
+                                            port=pg_server.port)),
+                           ("mg", get_store("mongodb", host="localhost",
+                                            port=mongo_server.port))):
+            mport = _free_port()
+            master = MasterServer(ip="localhost", port=mport,
+                                  volume_size_limit_mb=64)
+            master.start(vacuum_interval=3600)
+            vsrv = VolumeServer(
+                directories=[str(tmp_path / f"v-{tag}")],
+                master=f"localhost:{mport}", ip="localhost",
+                port=_free_port(), pulse_seconds=1)
+            vsrv.start()
+            fs = FilerServer(ip="localhost", port=_free_port(),
+                             master=f"localhost:{mport}", store="memory")
+            fs.filer = Filer(store)
+            fs.start()
+            deadline = time.time() + 10
+            while time.time() < deadline and not master.topo.nodes:
+                time.sleep(0.05)
+            clusters.append((master, vsrv, fs))
+            filers.append(fs)
+        fa, fb = filers
+        t0 = time.time_ns()
+        r = requests.put(f"http://{fa.address}/x/doc.txt",
+                         data=b"cross-store sync", timeout=30)
+        assert r.status_code in (200, 201)
+        loop = FilerSyncLoop(fa.address, fb.address, source_path="/x")
+        loop.run_once(since_ns=t0)
+        assert loop.replicated >= 1
+        g = requests.get(f"http://{fb.address}/x/doc.txt", timeout=30)
+        assert g.status_code == 200 and g.content == b"cross-store sync"
+        # the entry really landed in the MONGO store on the target side
+        assert any(d.get("name") == "doc.txt" for d in mongo_server.docs)
+        # and originated from rows in the POSTGRES store on the source
+        with pg_server._dblock:
+            cur = pg_server.db.cursor()
+            cur.execute("SELECT count(*) FROM filemeta WHERE name=?",
+                        ("doc.txt",))
+            assert cur.fetchone()[0] == 1
+    finally:
+        for master, vsrv, fs in reversed(clusters):
+            fs.stop()
+            vsrv.stop()
+            master.stop()
+        rpc.reset_channels()
