@@ -25,6 +25,13 @@ from ..entry import Entry
 from ..filerstore import register_store
 
 
+def like_escape(s: str) -> str:
+    """Escape LIKE wildcards in a fixed prefix with '!' (the ESCAPE
+    char every dialect statement declares): '/data_1' must not also
+    match '/dataX1'."""
+    return s.replace("!", "!!").replace("%", "!%").replace("_", "!_")
+
+
 class SqlDialect:
     """SqlGenerator equivalent (abstract_sql_store.go:15-26)."""
 
@@ -76,15 +83,20 @@ class SqlDialect:
         return f"DELETE FROM {self.qi(table)} WHERE directory={a} AND name={b}"
 
     def delete_folder_children(self, table: str) -> str:
+        # ESCAPE '!': directory names may contain SQL wildcards ('_',
+        # '%'); callers escape the fixed prefix with like_escape() so
+        # '/data_1/%' can't also match '/dataX1/...'. '!' is portable
+        # across sqlite/mysql/postgres (backslash is not: mysql string
+        # syntax vs pg standard_conforming_strings).
         a, b = self._p(2)
         return (f"DELETE FROM {self.qi(table)} WHERE directory={a} "
-                f"OR directory LIKE {b}")
+                f"OR directory LIKE {b} ESCAPE '!'")
 
     def list_entries(self, table: str, inclusive: bool) -> str:
         op = ">=" if inclusive else ">"
         a, b, c, d = self._p(4)
         return (f"SELECT name, meta FROM {self.qi(table)} WHERE directory={a} "
-                f"AND name {op} {b} AND name LIKE {c} "
+                f"AND name {op} {b} AND name LIKE {c} ESCAPE '!' "
                 f"ORDER BY name LIMIT {d}")
 
     def kv_upsert(self, table: str) -> str:
@@ -406,11 +418,15 @@ class AbstractSqlStore:
                 c.commit()
         table = self._table_for(base)
         c = self._conn()
+        # '/' + '/%' would build pattern '//%', which matches no real
+        # directory and leaves every deeper descendant row behind on a
+        # root-wide wipe; root's descendants all match '/%'
+        like = "/%" if base == "/" else like_escape(base) + "/%"
         with self._lock:
             self._bucket_read(table, lambda: (
                 c.cursor().execute(
                     self.dialect.delete_folder_children(table),
-                    (base, base + "/%")),
+                    (base, like)),
                 c.commit()))
 
     def list_directory_entries(self, dir_path: str, start_file_name: str = "",
@@ -423,7 +439,8 @@ class AbstractSqlStore:
 
         def go():
             cur.execute(self.dialect.list_entries(table, include_start),
-                        (base, start_file_name, (prefix or "") + "%", limit))
+                        (base, start_file_name,
+                         like_escape(prefix or "") + "%", limit))
             return cur.fetchall()
 
         for _name, blob in self._bucket_read(table, go) or []:

@@ -99,7 +99,18 @@ class ArangodbStore:
         bucket = self._bucket_of(full_path)
         if bucket is None:
             return DEFAULT_COLLECTION
-        coll = "bucket_" + bucket.replace(".", "_")
+        # ArangoDB collection names can't contain '.'; a plain
+        # '.'->'_' swap makes buckets 'a.b' and 'a_b' SHARE a
+        # collection (deleting one would wipe the other — S3 bucket
+        # names legitimately contain dots). Escape-code instead:
+        # '_'->'__' first, then '.'->'_d' — prefix-free, so the
+        # mapping is injective for EVERY pair of bucket names, and
+        # dot-free, underscore-free names keep their plain form.
+        # Layout change from the earlier '.'->'_' scheme: buckets with
+        # '_' or '.' in the name map to a NEW collection (the old
+        # mapping was lossy, so data written under it was already at
+        # risk of cross-bucket deletion; no read-fallback is kept).
+        coll = "bucket_" + bucket.replace("_", "__").replace(".", "_d")
         if create:
             self._ensure_collection(coll)
         return coll

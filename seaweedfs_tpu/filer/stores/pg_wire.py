@@ -183,7 +183,14 @@ class PgConnection:
                 self._connect()
             try:
                 return self._query_locked(pg_sql, params)
-            except (OSError, ConnectionError):
+            except PgError:
+                raise  # server error: stream was drained to ReadyForQuery
+            except Exception:
+                # Parse failures (struct.error/IndexError on a malformed
+                # RowDescription/DataRow) abort mid-result-stream; the
+                # unread messages up to ReadyForQuery would be consumed
+                # as the NEXT query's replies. Same discipline as
+                # mysql_wire: poison the connection.
                 self._mark_broken()
                 raise
 

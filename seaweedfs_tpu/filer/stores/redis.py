@@ -56,36 +56,89 @@ class RespClient:
         except OSError:
             pass
 
-    def cmd(self, *args):
-        """-> reply (str for simple strings, int, bytes | None for bulk,
-        list for arrays). Raises RespError for server errors. Any I/O
-        failure (timeout, short read) poisons the connection — a stale
-        reply could still be queued on the socket, and parsing it as the
-        NEXT command's reply would silently return wrong data (redis-py
-        likewise closes on I/O errors)."""
+    @staticmethod
+    def _encode(args) -> bytes:
         out = [b"*%d\r\n" % len(args)]
         for a in args:
             b = a if isinstance(a, bytes) else str(a).encode()
             out.append(b"$%d\r\n%s\r\n" % (len(b), b))
-        with self._lock:
-            if self._sock is None:
-                raise RespProtocolError(
-                    "connection is closed (previous I/O error)")
-            try:
-                self._sock.sendall(b"".join(out))
-                return self._read_reply()
-            except RespProtocolError:
-                self.close()
-                self._sock = None
-                raise
-            except RespError:
-                raise  # server -ERR reply: connection is still in sync
-            except OSError:  # NB: RespError subclasses OSError — order!
-                self.close()
-                self._sock = None
-                raise
+        return b"".join(out)
 
-    def _read_reply(self):
+    def _exchange_locked(self, payload: bytes, read):
+        """Send `payload` and return read(); caller holds the lock.
+        Any I/O failure (timeout, short read) poisons the connection —
+        a stale reply could still be queued on the socket, and parsing
+        it as the NEXT command's reply would silently return wrong data
+        (redis-py likewise closes on I/O errors). A server -ERR reply
+        (RespError) leaves the connection in sync."""
+        if self._sock is None:
+            raise RespProtocolError(
+                "connection is closed (previous I/O error)")
+        try:
+            self._sock.sendall(payload)
+            return read()
+        except RespProtocolError:
+            self.close()
+            self._sock = None
+            raise
+        except RespError:
+            raise  # server -ERR reply: connection is still in sync
+        except OSError:  # NB: RespError subclasses OSError — order!
+            self.close()
+            self._sock = None
+            raise
+
+    def cmd(self, *args):
+        """-> reply (str for simple strings, int, bytes | None for bulk,
+        list for arrays). Raises RespError for server errors."""
+        payload = self._encode(args)
+        with self._lock:
+            return self._exchange_locked(payload, self._read_reply)
+
+    def transaction(self, *cmds):
+        """MULTI ... EXEC as one locked unit -> EXEC's reply array.
+
+        The lock is held across the whole exchange: sending MULTI and
+        EXEC as separate cmd() calls would let another thread's command
+        land inside the open transaction, where the server QUEUEs it
+        (its caller then reads '+QUEUED' as its reply) and EXEC's array
+        absorbs its result — reply-stream corruption under the filer's
+        threaded HTTP server. All frames go out in one sendall and the
+        replies (+OK, +QUEUED xN, EXEC array) are read back in order.
+        """
+        payload = b"".join(self._encode(args) for args in
+                           ((("MULTI",),) + cmds + (("EXEC",),)))
+
+        def read_all():
+            replies = []
+            err = None
+            for _ in range(len(cmds) + 2):
+                try:
+                    replies.append(self._read_reply())
+                except RespProtocolError:
+                    raise
+                except RespError as e:
+                    # queue-time error (e.g. bad command): the server
+                    # still answers the remaining frames, so keep
+                    # draining to stay in sync
+                    replies.append(e)
+                    err = err or e
+            if err is not None:
+                raise err
+            exec_reply = replies[-1]
+            if isinstance(exec_reply, list):
+                # exec-time failures arrive as error ELEMENTS inside
+                # the reply array; the stream is fully drained, so
+                # raising keeps the connection in sync
+                for el in exec_reply:
+                    if isinstance(el, RespError):
+                        raise el
+            return exec_reply
+
+        with self._lock:
+            return self._exchange_locked(payload, read_all)
+
+    def _read_reply(self, nested: bool = False):
         line = self._f.readline()
         if not line.endswith(b"\r\n"):
             raise RespProtocolError("connection closed mid-reply")
@@ -93,6 +146,12 @@ class RespClient:
         if kind == b"+":
             return rest.decode()
         if kind == b"-":
+            # Inside an array (EXEC replies): raising here would abandon
+            # the remaining elements on the socket and desynchronize the
+            # stream — return the error as a value (redis-py does the
+            # same) and let the caller decide.
+            if nested:
+                return RespError(rest.decode())
             raise RespError(rest.decode())
         if kind == b":":
             return int(rest)
@@ -108,7 +167,7 @@ class RespClient:
             n = int(rest)
             if n < 0:
                 return None
-            return [self._read_reply() for _ in range(n)]
+            return [self._read_reply(nested=True) for _ in range(n)]
         raise RespProtocolError(f"bad RESP type byte {kind!r}")
 
 

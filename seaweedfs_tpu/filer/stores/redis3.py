@@ -73,13 +73,13 @@ class SegmentedNameList:
         upper = members[len(members) // 2:]
         new_seg = self._seg_key(mid)
         # atomic: a crash between moving members and indexing the new
-        # segment would otherwise strand `upper` unreachable to listings
-        self.client.cmd("MULTI")
-        self.client.cmd("ZADD", new_seg,
-                        *[x for m in upper for x in (b"0", m)])
-        self.client.cmd("ZADD", self.idx, "0", mid.encode())
-        self.client.cmd("ZREM", seg, *upper)
-        self.client.cmd("EXEC")
+        # segment would otherwise strand `upper` unreachable to listings;
+        # transaction() holds the client lock across MULTI..EXEC so a
+        # concurrent thread's command can't be QUEUED into it
+        self.client.transaction(
+            ("ZADD", new_seg, *[x for m in upper for x in (b"0", m)]),
+            ("ZADD", self.idx, "0", mid.encode()),
+            ("ZREM", seg, *upper))
 
     def remove(self, name: str) -> None:
         start = self._seg_start_for(name)

@@ -1315,3 +1315,142 @@ def test_filer_sync_across_heterogeneous_wire_stores(pg_server,
             vsrv.stop()
             master.stop()
         rpc.reset_channels()
+
+
+# -- round-5 advisor regressions -------------------------------------------
+
+def test_resp_transaction_is_atomic_under_concurrency(redis_server):
+    """MULTI..EXEC must hold the client lock for the whole exchange: a
+    concurrent thread's command landing between MULTI and EXEC would be
+    QUEUED into the open transaction (its caller reads '+QUEUED' as its
+    reply and EXEC's array absorbs its result)."""
+    import threading
+
+    from seaweedfs_tpu.filer.stores.redis import RespClient
+
+    c = RespClient("localhost", redis_server.port)
+    c.cmd("SET", b"stable", b"expected")
+    stop = threading.Event()
+    bad: list = []
+
+    def reader():
+        # a corrupted stream can also surface as a RAISED error (e.g. a
+        # misattributed EXEC element) — capture it, don't die silently
+        try:
+            while not stop.is_set():
+                got = c.cmd("GET", b"stable")
+                if got != b"expected":
+                    bad.append(got)
+                    return
+        except Exception as e:  # noqa: BLE001
+            bad.append(e)
+
+    threads = [threading.Thread(target=reader) for _ in range(3)]
+    for t in threads:
+        t.start()
+    for i in range(200):
+        c.transaction(("ZADD", b"txz", "0", f"m{i}".encode()),
+                      ("ZREM", b"txz", f"m{i}".encode()))
+    stop.set()
+    for t in threads:
+        t.join()
+    assert bad == [], f"reply-stream corruption: GET returned {bad[0]!r}"
+    c.close()
+
+
+def test_postgres_parse_failure_poisons_connection(pg_server):
+    """A malformed reply that aborts _query_locked mid-result-stream must
+    mark the connection broken (like mysql_wire): the unread messages up
+    to ReadyForQuery would otherwise be consumed as the NEXT query's
+    replies and silently return wrong rows."""
+    import struct as _struct
+
+    from seaweedfs_tpu.filer.stores.pg_wire import PgConnection
+
+    c = PgConnection(host="localhost", port=pg_server.port)
+    cur = c.cursor()
+    cur.execute("SELECT 1 + 1")
+    assert cur.fetchone()[0] == 2
+
+    def malformed():
+        raise _struct.error("truncated DataRow")
+
+    c._recv_msg = malformed
+    with pytest.raises(_struct.error):
+        c.cursor().execute("SELECT 2 + 2")
+    assert c._sock is None, "parse failure must poison the connection"
+    del c.__dict__["_recv_msg"]  # restore the class method
+    # next query reconnects cleanly and reads ITS OWN reply
+    cur = c.cursor()
+    cur.execute("SELECT 40 + 2")
+    assert cur.fetchone()[0] == 42
+    c.close()
+
+
+def test_arangodb_dotted_bucket_collections_distinct(arango_server):
+    """Buckets 'a.b' and 'a_b' must not share a collection: S3 bucket
+    names legitimately contain dots, and deleting one bucket must not
+    wipe the other's objects."""
+    store = get_store("arangodb", host="localhost",
+                      port=arango_server.port)
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/buckets/a.b/one", content=b"dot"))
+    f.create_entry(Entry(full_path="/buckets/a_b/two", content=b"under"))
+    assert store.find_entry("/buckets/a.b/one").content == b"dot"
+    assert store.find_entry("/buckets/a_b/two").content == b"under"
+    store.delete_folder_children("/buckets/a.b")
+    assert store.find_entry("/buckets/a.b/one") is None
+    assert store.find_entry("/buckets/a_b/two") is not None, \
+        "deleting bucket 'a.b' destroyed bucket 'a_b'"
+    store.close()
+
+
+def test_sql_root_delete_wipes_descendants(tmp_path):
+    """delete_folder_children('/') must remove DEEP descendants too:
+    base '/' + '/%' builds LIKE '//%', which matches nothing, leaving
+    every row below the first level behind."""
+    store = get_store("sqlite", db_path=str(tmp_path / "root.db"))
+    f = Filer(store)
+    for p in ("/top", "/a/b/c/deep.txt", "/a/b/mid.txt", "/a/shallow"):
+        f.create_entry(Entry(full_path=p))
+    store.delete_folder_children("/")
+    for p in ("/top", "/a/b/c/deep.txt", "/a/b/mid.txt", "/a/shallow"):
+        assert store.find_entry(p) is None, f"{p} survived root wipe"
+    store.close()
+
+
+def test_resp_transaction_exec_error_keeps_stream_in_sync(redis_server):
+    """Exec-time failures arrive as error ELEMENTS inside EXEC's reply
+    array; the client must drain the whole array (staying in sync) and
+    then raise — not abort mid-array and leave elements on the socket."""
+    from seaweedfs_tpu.filer.stores.redis import RespClient, RespError
+
+    c = RespClient("localhost", redis_server.port)
+    c.cmd("SET", b"stable", b"expected")
+    with pytest.raises(RespError, match="unknown command"):
+        c.transaction(("ZADD", b"txz2", "0", b"m"), ("NOPE",))
+    # the connection is still usable and replies are OUR replies
+    assert c.cmd("GET", b"stable") == b"expected"
+    assert c.cmd("PING") == "PONG"
+    c.close()
+
+
+def test_sql_like_wildcards_in_directory_names(tmp_path):
+    """'_'/'%' in directory names are data, not wildcards: deleting
+    /data_1 must not also wipe /dataX1, and a '_'-containing listing
+    prefix must not match arbitrary characters."""
+    store = get_store("sqlite", db_path=str(tmp_path / "wild.db"))
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/data_1/doomed", content=b"x"))
+    f.create_entry(Entry(full_path="/dataX1/survivor", content=b"y"))
+    store.delete_folder_children("/data_1")
+    assert store.find_entry("/data_1/doomed") is None
+    assert store.find_entry("/dataX1/survivor") is not None, \
+        "wildcard '_' in LIKE wiped a sibling directory"
+    # prefix with '_' in listings
+    f.create_entry(Entry(full_path="/d/x_1"))
+    f.create_entry(Entry(full_path="/d/xa1"))
+    names = [e.name for e in
+             store.list_directory_entries("/d", prefix="x_")]
+    assert names == ["x_1"]
+    store.close()
