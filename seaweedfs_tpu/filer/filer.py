@@ -219,14 +219,22 @@ class Filer:
     def rename(self, old_path: str, new_path: str) -> None:
         """AtomicRenameEntry semantics: move the entry (and any subtree) by
         rewriting paths in the store (filer_rename.go moveEntry)."""
+        for _ in self.rename_stream(old_path, new_path):
+            pass
+
+    def rename_stream(self, old_path: str, new_path: str):
+        """rename() that yields each (old_entry, moved_entry) as it lands
+        — the engine under both AtomicRenameEntry and StreamRenameEntry
+        (filer_grpc_server_rename.go:51 moveEntry): children move first,
+        depth-first, then the entry itself."""
         old_path, new_path = normalize(old_path), normalize(new_path)
         entry = self.find_entry(old_path)
         self._ensure_parents(parent_of(new_path))
         if entry.is_directory:
             for child in list(self.store.list_directory_entries(
                     old_path, limit=1_000_000)):
-                self.rename(child.full_path,
-                            new_path + "/" + child.name)
+                yield from self.rename_stream(child.full_path,
+                                              new_path + "/" + child.name)
         moved = Entry(full_path=new_path, attr=entry.attr, chunks=entry.chunks,
                       extended=entry.extended, content=entry.content,
                       is_directory=entry.is_directory,
@@ -237,6 +245,7 @@ class Filer:
         self._mutated(old_path, recursive=entry.is_directory)
         self._mutated(new_path, recursive=entry.is_directory)
         self._notify(moved.parent, entry, moved)
+        yield entry, moved
 
     def list_entries(self, dir_path: str, start: str = "",
                      include_start: bool = False, limit: int = 1024,

@@ -53,11 +53,17 @@ MASTER_SERVICE = ("master_pb.Seaweed", [
     _m("VolumeList", M.VolumeListRequest, M.VolumeListResponse),
     _m("LookupEcVolume", M.LookupEcVolumeRequest, M.LookupEcVolumeResponse),
     _m("VacuumVolume", M.VacuumVolumeRequest, M.VacuumVolumeResponse),
+    _m("DisableVacuum", M.DisableVacuumRequest, M.DisableVacuumResponse),
+    _m("EnableVacuum", M.EnableVacuumRequest, M.EnableVacuumResponse),
+    _m("VolumeMarkReadonly", M.VolumeMarkReadonlyRequest, M.VolumeMarkReadonlyResponse),
     _m("GetMasterConfiguration", M.GetMasterConfigurationRequest, M.GetMasterConfigurationResponse),
     _m("LeaseAdminToken", M.LeaseAdminTokenRequest, M.LeaseAdminTokenResponse),
     _m("ReleaseAdminToken", M.ReleaseAdminTokenRequest, M.ReleaseAdminTokenResponse),
     _m("ListClusterNodes", M.ListClusterNodesRequest, M.ListClusterNodesResponse),
     _m("Ping", M.PingRequest, M.PingResponse),
+    _m("RaftListClusterServers", M.RaftListClusterServersRequest, M.RaftListClusterServersResponse),
+    _m("RaftAddServer", M.RaftAddServerRequest, M.RaftAddServerResponse),
+    _m("RaftRemoveServer", M.RaftRemoveServerRequest, M.RaftRemoveServerResponse),
 ])
 
 VOLUME_SERVICE = ("volume_server_pb.VolumeServer", [
@@ -115,6 +121,7 @@ FILER_SERVICE = ("filer_pb.SeaweedFiler", [
     _m("AppendToEntry", F.AppendToEntryRequest, F.AppendToEntryResponse),
     _m("DeleteEntry", F.DeleteEntryRequest, F.DeleteEntryResponse),
     _m("AtomicRenameEntry", F.AtomicRenameEntryRequest, F.AtomicRenameEntryResponse),
+    _m("StreamRenameEntry", F.StreamRenameEntryRequest, F.StreamRenameEntryResponse, ss=True),
     _m("AssignVolume", F.AssignVolumeRequest, F.AssignVolumeResponse),
     _m("LookupVolume", F.LookupVolumeRequest, F.LookupVolumeResponse),
     _m("CollectionList", F.CollectionListRequest, F.CollectionListResponse),
@@ -125,6 +132,8 @@ FILER_SERVICE = ("filer_pb.SeaweedFiler", [
     _m("SubscribeLocalMetadata", F.SubscribeMetadataRequest, F.SubscribeMetadataResponse, ss=True),
     _m("KvGet", F.KvGetRequest, F.KvGetResponse),
     _m("KvPut", F.KvPutRequest, F.KvPutResponse),
+    _m("CacheRemoteObjectToLocalCluster", F.CacheRemoteObjectToLocalClusterRequest,
+       F.CacheRemoteObjectToLocalClusterResponse),
     _m("Ping", F.PingRequest, F.PingResponse),
 ])
 

@@ -326,8 +326,13 @@ class RemoteConf:
     """Mount table persisted in the filer (shell `remote.configure` +
     `remote.mount` state; reference stores remote.conf the same way)."""
 
-    def __init__(self, filer: str):
+    def __init__(self, filer: str, *, entry_reader=None):
+        # entry_reader: optional (directory, name) -> content|None hook
+        # so code running INSIDE the filer process (the gRPC
+        # CacheRemoteObjectToLocalCluster handler) reads the conf
+        # in-process instead of looping back through its own gRPC pool
         self.filer = filer
+        self._entry_reader = entry_reader
 
     @property
     def _stub(self):
@@ -335,15 +340,19 @@ class RemoteConf:
 
     def load(self) -> dict:
         try:
-            resp = self._stub.LookupDirectoryEntry(
-                filer_pb2.LookupDirectoryEntryRequest(
-                    directory=REMOTE_CONF_DIR, name=REMOTE_CONF_FILE),
-                timeout=10)
+            if self._entry_reader is not None:
+                content = self._entry_reader(REMOTE_CONF_DIR,
+                                             REMOTE_CONF_FILE)
+            else:
+                content = self._stub.LookupDirectoryEntry(
+                    filer_pb2.LookupDirectoryEntryRequest(
+                        directory=REMOTE_CONF_DIR, name=REMOTE_CONF_FILE),
+                    timeout=10).entry.content
         except Exception:
             return {"storages": {}, "mounts": {}}
-        if not resp.entry.content:
+        if not content:
             return {"storages": {}, "mounts": {}}
-        return json.loads(resp.entry.content)
+        return json.loads(content)
 
     def save(self, conf: dict) -> None:
         entry = filer_pb2.Entry(name=REMOTE_CONF_FILE,
@@ -405,9 +414,9 @@ class RemoteGateway:
     """Mount operations against the filer namespace
     (shell remote.* commands + filer.remote.sync)."""
 
-    def __init__(self, filer: str):
+    def __init__(self, filer: str, *, conf: RemoteConf | None = None):
         self.filer = filer
-        self.conf = RemoteConf(filer)
+        self.conf = conf if conf is not None else RemoteConf(filer)
 
     @property
     def _stub(self):

@@ -740,6 +740,30 @@ class FilerGrpc:
             context.abort(grpc.StatusCode.NOT_FOUND, "source not found")
         return filer_pb2.AtomicRenameEntryResponse()
 
+    def StreamRenameEntry(self, request, context):
+        """filer_grpc_server_rename.go:51 — same move as
+        AtomicRenameEntry, but each moved entry streams back as a rename
+        event so subscribers (mounts, sync loops) can track a large
+        directory move incrementally."""
+        self.srv.hot_sync()
+        old = request.old_directory.rstrip("/") + "/" + request.old_name
+        new = request.new_directory.rstrip("/") + "/" + request.new_name
+        try:
+            # complete the WHOLE move before streaming: the generator is
+            # only advanced as the client reads, so a cancel/deadline
+            # mid-stream would otherwise leave the namespace half-moved
+            moves = list(self.filer.rename_stream(old, new))
+        except NotFound:
+            context.abort(grpc.StatusCode.NOT_FOUND, "source not found")
+        for old_e, moved in moves:
+            ev = filer_pb2.EventNotification(
+                old_entry=old_e.to_pb(), new_entry=moved.to_pb(),
+                new_parent_path=moved.parent,
+                signatures=[*request.signatures, self.filer.signature])
+            yield filer_pb2.StreamRenameEntryResponse(
+                directory=old_e.parent, event_notification=ev,
+                ts_ns=time.time_ns())
+
     def AssignVolume(self, request, context):
         a = assign(self.srv.master, count=max(request.count, 1),
                    collection=request.collection or self.srv.collection,
@@ -835,6 +859,53 @@ class FilerGrpc:
     def KvPut(self, request, context):
         self.filer.store.kv_put(request.key, request.value)
         return filer_pb2.KvPutResponse()
+
+    def CacheRemoteObjectToLocalCluster(self, request, context):
+        """filer_grpc_server_remote.go: materialize a remote-mounted
+        entry's bytes into local volumes and return the updated entry
+        (the wire contract behind `weed shell remote.cache`).
+
+        Everything runs in-process (find/update via self.filer, bytes
+        via srv.write_file): nested loopback gRPC from inside a gRPC
+        worker could exhaust the 32-thread pool under concurrency."""
+        from ..remote_storage import (
+            REMOTE_ENTRY_KEY,
+            RemoteConf,
+            RemoteGateway,
+        )
+
+        path = request.directory.rstrip("/") + "/" + request.name
+        try:
+            e = self.filer.find_entry(path)
+        except NotFound:
+            context.abort(grpc.StatusCode.NOT_FOUND, f"{path} not found")
+        marker = e.extended.get(REMOTE_ENTRY_KEY)
+        if not marker:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"{path} is not a remote entry")
+        try:
+            conf = RemoteConf(self.srv.address,
+                              entry_reader=self._local_entry_content)
+            gw = RemoteGateway(self.srv.address, conf=conf)
+            client, rpath = gw._remote_location(path)
+            data = client.read_file(rpath)
+            self.srv.write_file(path, data)
+            # re-attach the remote marker lost by the overwrite
+            e = self.filer.find_entry(path)
+            e.extended[REMOTE_ENTRY_KEY] = marker
+            self.filer.update_entry(e)
+        except Exception as err:  # noqa: BLE001 - remote IO failures
+            context.abort(grpc.StatusCode.INTERNAL, str(err))
+        return filer_pb2.CacheRemoteObjectToLocalClusterResponse(
+            entry=e.to_pb())
+
+    def _local_entry_content(self, directory: str, name: str
+                             ) -> bytes | None:
+        try:
+            return self.filer.find_entry(
+                directory.rstrip("/") + "/" + name).content
+        except NotFound:
+            return None
 
     def Ping(self, request, context):
         now = time.time_ns()

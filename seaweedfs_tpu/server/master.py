@@ -511,6 +511,91 @@ class MasterGrpc:
                             volume_id=request.volume_id)
         return master_pb2.VacuumVolumeResponse()
 
+    def DisableVacuum(self, request, context):
+        # master_grpc_server_volume.go:287 (Topo.DisableVacuum)
+        self.ms.vacuum_disabled = True
+        return master_pb2.DisableVacuumResponse()
+
+    def EnableVacuum(self, request, context):
+        # master_grpc_server_volume.go:294 (Topo.EnableVacuum)
+        self.ms.vacuum_disabled = False
+        return master_pb2.EnableVacuumResponse()
+
+    def VolumeMarkReadonly(self, request, context):
+        # master_grpc_server_volume.go:301 — flip the layout standing so
+        # assignment stops (or resumes) handing out the volume
+        ms = self.ms
+        if not ms.is_leader():
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          f"not the leader; leader is {ms.leader_address()}")
+        url = f"{request.ip}:{request.port}" if request.ip else ""
+        found = ms.topo.mark_volume_readonly(
+            request.collection, request.volume_id, request.is_readonly,
+            url=url)
+        if not found:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {request.volume_id} not found")
+        return master_pb2.VolumeMarkReadonlyResponse()
+
+    def RaftListClusterServers(self, request, context):
+        # master_grpc_server_raft.go:13; in single-master mode the
+        # cluster is this one server, leading itself
+        ms = self.ms
+        resp = master_pb2.RaftListClusterServersResponse()
+        if ms.raft is None:
+            resp.cluster_servers.add(id=ms.address, address=ms.address,
+                                     suffrage="Voter", isLeader=True)
+            return resp
+        st = ms.raft.status()
+        for addr in sorted({st["id"], *st["peers"]}):
+            resp.cluster_servers.add(
+                id=addr, address=addr, suffrage="Voter",
+                isLeader=addr == st["leader"])
+        return resp
+
+    def RaftAddServer(self, request, context):
+        # master_grpc_server_raft.go:37
+        ms = self.ms
+        if ms.raft is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "raft not enabled (single-master mode)")
+        if not ms.is_leader():
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          f"not the leader; leader is {ms.leader_address()}")
+        # this raft identifies peers BY address (id == address); a
+        # distinct id would be registered under the address and then be
+        # unremovable by RaftRemoveServer(id=...)
+        if request.id and request.address and request.id != request.address:
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "server id must equal its address here "
+                          f"(got id={request.id!r} "
+                          f"address={request.address!r})")
+        try:
+            ms.raft.add_peer(request.address or request.id)
+        except Exception as e:  # noqa: BLE001 - surface the raft error
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        return master_pb2.RaftAddServerResponse()
+
+    def RaftRemoveServer(self, request, context):
+        # master_grpc_server_raft.go:64
+        ms = self.ms
+        if ms.raft is None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          "raft not enabled (single-master mode)")
+        if not ms.is_leader():
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION,
+                          f"not the leader; leader is {ms.leader_address()}")
+        st = ms.raft.status()
+        if request.id not in {st["id"], *st["peers"]}:
+            # a silent no-op "success" would hide a typo'd id forever
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"{request.id} is not a member")
+        try:
+            ms.raft.remove_peer(request.id)
+        except Exception as e:  # noqa: BLE001
+            context.abort(grpc.StatusCode.INTERNAL, str(e))
+        return master_pb2.RaftRemoveServerResponse()
+
     def GetMasterConfiguration(self, request, context):
         return master_pb2.GetMasterConfigurationResponse(
             leader=self.ms.leader_address(),
@@ -659,32 +744,9 @@ def _make_http_handler(ms: MasterServer):
             if u.path == "/vol/vacuum":
                 n = ms.vacuum_once(float(q.get("garbageThreshold", 0.0001)))
                 return self._json({"vacuumed": n})
-            if u.path == "/vol/vacuum/disable":
-                ms.vacuum_disabled = True
-                return self._json({"vacuum": "disabled"})
-            if u.path == "/vol/vacuum/enable":
-                ms.vacuum_disabled = False
-                return self._json({"vacuum": "enabled"})
-            if u.path == "/cluster/raft/add":
-                if ms.raft is None:
-                    return self._json({"error": "raft not enabled"}, 400)
-                try:
-                    ms.raft.add_peer(q["id"])
-                except KeyError:
-                    return self._json({"error": "id required"}, 400)
-                except Exception as e:
-                    return self._json({"error": str(e)}, 400)
-                return self._json(ms.raft.status())
-            if u.path == "/cluster/raft/remove":
-                if ms.raft is None:
-                    return self._json({"error": "raft not enabled"}, 400)
-                try:
-                    ms.raft.remove_peer(q["id"])
-                except KeyError:
-                    return self._json({"error": "id required"}, 400)
-                except Exception as e:
-                    return self._json({"error": str(e)}, 400)
-                return self._json(ms.raft.status())
+            # vacuum enable/disable and raft membership moved to gRPC
+            # (DisableVacuum/EnableVacuum/RaftAddServer/RaftRemoveServer)
+            # — the reference keeps no HTTP twin for them either
             if u.path == "/col/delete":
                 return self._json({"error": "use gRPC CollectionDelete"}, 400)
             if u.path == "/metrics":

@@ -55,67 +55,56 @@ def cluster_status(env, args, out):
     print(f"files:    {stats.file_count}", file=out)
 
 
+def _raft_servers(env):
+    return env.master_stub().RaftListClusterServers(
+        master_pb2.RaftListClusterServersRequest(), timeout=10
+    ).cluster_servers
+
+
 @command("cluster.raft.ps", "show Raft membership and roles")
 def cluster_raft_ps(env, args, out):
-    """command_cluster_raft_ps.go: query each master's raft status."""
-    import requests
-
-    seen = set()
-    frontier = [env.master]
-    while frontier:
-        m = frontier.pop()
-        if m in seen:
-            continue
-        seen.add(m)
-        try:
-            st = requests.get(f"http://{m}/cluster/raft/status",
-                              timeout=5).json()
-        except requests.RequestException as e:
-            print(f"  {m}: unreachable ({e})", file=out)
-            continue
-        if st.get("mode") == "single-master":
-            print(f"  {m}: single-master (leader)", file=out)
-            continue
-        print(f"  {m}: {st['role']} term={st['term']} "
-              f"commit={st['commit_index']} leader={st['leader']}",
-              file=out)
-        frontier.extend(p for p in st.get("peers", []) if p not in seen)
+    """command_cluster_raft_ps.go via master RaftListClusterServers —
+    the same gRPC a stock `weed shell` issues."""
+    for s in _raft_servers(env):
+        star = " *leader*" if s.isLeader else ""
+        print(f"  {s.id} {s.suffrage}{star}", file=out)
 
 
 @command("cluster.raft.leader", "print the current Raft leader")
 def cluster_raft_leader(env, args, out):
-    import requests
-
-    st = requests.get(f"http://{env.master}/cluster/raft/status",
-                      timeout=5).json()
-    print(st.get("leader", env.master), file=out)
+    for s in _raft_servers(env):
+        if s.isLeader:
+            print(s.address, file=out)
+            return
+    print(env.master, file=out)
 
 
 def _raft_leader_addr(env) -> str:
-    import requests
-
-    st = requests.get(f"http://{env.master}/cluster/raft/status",
-                      timeout=5).json()
-    return st.get("leader") or env.master
+    for s in _raft_servers(env):
+        if s.isLeader:
+            return s.address
+    return env.master
 
 
 def _raft_member_op(env, args, out, op: str) -> None:
-    import requests
-
+    from ...pb import rpc
     from ..registry import kv_flags
 
     env.confirm_is_locked()  # membership changes mutate cluster topology
     opts = kv_flags(args)
     if not opts.get("id"):
         raise RuntimeError(f"usage: cluster.raft.{op} -id=<master-address>")
-    leader = _raft_leader_addr(env)
-    r = requests.get(f"http://{leader}/cluster/raft/{op}",
-                     params={"id": opts["id"]}, timeout=10).json()
-    if "error" in r:
-        raise RuntimeError(r["error"])
+    # membership ops must land on the leader (followers reject them)
+    stub = rpc.master_stub(rpc.grpc_address(_raft_leader_addr(env)))
+    if op == "add":
+        stub.RaftAddServer(master_pb2.RaftAddServerRequest(
+            id=opts["id"], address=opts["id"], voter=True), timeout=10)
+    else:
+        stub.RaftRemoveServer(master_pb2.RaftRemoveServerRequest(
+            id=opts["id"]), timeout=10)
     verb = "added" if op == "add" else "removed"
-    print(f"{verb} {opts['id']}; members: "
-          f"{sorted([r['id'], *r.get('peers', [])])}", file=out)
+    members = sorted(s.id for s in _raft_servers(env))
+    print(f"{verb} {opts['id']}; members: {members}", file=out)
 
 
 @command("cluster.raft.add", "cluster.raft.add -id=<master-address>")

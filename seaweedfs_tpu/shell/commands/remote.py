@@ -64,9 +64,20 @@ def remote_meta_sync(env, args, out):
 
 @command("remote.cache", "remote.cache -dir=/buckets/x/file")
 def remote_cache(env, args, out):
+    """command_remote_cache.go: the filer does the remote fetch; the
+    shell speaks the same CacheRemoteObjectToLocalCluster gRPC a stock
+    client would."""
+    from ...pb import filer_pb2, rpc
+
     opts = _kv(args)
-    n = RemoteGateway(env.require_filer()).cache(opts["dir"])
-    print(f"cached {n} bytes", file=out)
+    d, _, name = opts["dir"].rpartition("/")
+    stub = rpc.filer_stub(rpc.grpc_address(env.require_filer()))
+    resp = stub.CacheRemoteObjectToLocalCluster(
+        filer_pb2.CacheRemoteObjectToLocalClusterRequest(
+            directory=d or "/", name=name), timeout=300)
+    size = max((c.offset + c.size for c in resp.entry.chunks),
+               default=resp.entry.attributes.file_size)
+    print(f"cached {size} bytes", file=out)
 
 
 @command("remote.uncache", "remote.uncache -dir=/buckets/x/file")

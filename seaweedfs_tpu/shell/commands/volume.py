@@ -533,17 +533,15 @@ def volume_tier_move(env, args, out):
 
 @command("volume.vacuum.disable", "pause the master's periodic vacuum")
 def volume_vacuum_disable(env, args, out):
-    """command_volume_vacuum_disable.go."""
-    import requests
-
-    r = requests.get(f"http://{env.master}/vol/vacuum/disable", timeout=10)
-    print(r.json().get("vacuum", "?"), file=out)
+    """command_volume_vacuum_disable.go via master DisableVacuum."""
+    env.master_stub().DisableVacuum(
+        master_pb2.DisableVacuumRequest(), timeout=10)
+    print("disabled", file=out)
 
 
 @command("volume.vacuum.enable", "resume the master's periodic vacuum")
 def volume_vacuum_enable(env, args, out):
-    """command_volume_vacuum_enable.go."""
-    import requests
-
-    r = requests.get(f"http://{env.master}/vol/vacuum/enable", timeout=10)
-    print(r.json().get("vacuum", "?"), file=out)
+    """command_volume_vacuum_enable.go via master EnableVacuum."""
+    env.master_stub().EnableVacuum(
+        master_pb2.EnableVacuumRequest(), timeout=10)
+    print("enabled", file=out)

@@ -257,6 +257,46 @@ class Topology:
                 self.register_volume(v, dn)
             dn.last_seen = time.time()
 
+    def mark_volume_readonly(self, collection: str, vid: int,
+                             readonly: bool, *, url: str = "") -> bool:
+        """Flip a volume's readonly standing in its layout (the master
+        half of VolumeMarkReadonly, master_grpc_server_volume.go:301):
+        readonly volumes leave the writable set so assignment skips
+        them. `url` narrows the flip to one replica's VolumeInfo; the
+        layout-level sets are global either way (a volume with ANY
+        readonly replica is not safely writable under replication).
+        -> True when the volume was found."""
+        with self._lock:
+            for key, vl in self.layouts.items():
+                if collection and key.split("/")[0] != collection:
+                    continue
+                if vid not in vl.locations:
+                    continue
+                with vl._lock:
+                    if readonly:
+                        vl.readonly.add(vid)
+                        vl.writables.discard(vid)
+                    else:
+                        vl.readonly.discard(vid)
+                        # mirror register(): a replica short of the
+                        # placement OR a volume past the size limit must
+                        # not re-enter the writable set
+                        infos = [dn.volumes[vid]
+                                 for dn in vl.locations[vid]
+                                 if vid in dn.volumes]
+                        if (len(vl.locations[vid]) >= vl.rp.copy_count
+                                and all(v.size < vl.volume_size_limit
+                                        for v in infos)):
+                            vl.writables.add(vid)
+                for dn in vl.locations[vid]:
+                    if url and dn.url != url:
+                        continue
+                    v = dn.volumes.get(vid)
+                    if v is not None:
+                        v.read_only = readonly
+                return True
+            return False
+
     def lookup(self, collection: str, vid: int) -> list[DataNode]:
         with self._lock:
             for key, vl in self.layouts.items():

@@ -279,3 +279,119 @@ def test_remote_mapping_pb_roundtrip():
     rc = remote_pb2.RemoteConf()
     rc.ParseFromString(conf_to_pb("src", conf["storages"]["src"]))
     assert rc.type == "local" and rc.local_root == "/tmp/r"
+
+
+# -- round-5 proto parity: master vacuum/readonly/raft + filer stream rpcs --
+
+def test_master_vacuum_toggle_grpc(cluster):
+    """DisableVacuum/EnableVacuum (reference master.proto:30-32) pause
+    and resume the periodic vacuum driver."""
+    from seaweedfs_tpu.pb import master_pb2
+
+    master, _ = cluster
+    stub = rpc.master_stub(rpc.grpc_address(master.address))
+    stub.DisableVacuum(master_pb2.DisableVacuumRequest(), timeout=10)
+    assert master.vacuum_disabled is True
+    stub.EnableVacuum(master_pb2.EnableVacuumRequest(), timeout=10)
+    assert master.vacuum_disabled is False
+
+
+@pytest.fixture
+def fresh_cluster(tmp_path):
+    """Function-scoped cluster with free volume slots (the module
+    cluster's slots are exhausted by earlier tests)."""
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "vol")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), pulse_seconds=1)
+    vsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    yield master, vsrv
+    vsrv.stop()
+    master.stop()
+
+
+def test_master_volume_mark_readonly_grpc(fresh_cluster):
+    """VolumeMarkReadonly (reference master.proto:34 /
+    master_grpc_server_volume.go:301): the volume leaves the writable
+    set so assignment skips it; marking writable restores it."""
+    from seaweedfs_tpu.pb import master_pb2
+
+    master, vsrv = fresh_cluster
+    a = _put(master, b"mark me readonly")
+    vid = parse_file_id(a.fid).volume_id
+    stub = rpc.master_stub(rpc.grpc_address(master.address))
+    stub.VolumeMarkReadonly(master_pb2.VolumeMarkReadonlyRequest(
+        ip="localhost", port=vsrv.port, volume_id=vid,
+        is_readonly=True), timeout=10)
+    layouts = [vl for vl in master.topo.layouts.values()
+               if vid in vl.locations]
+    assert layouts and all(vid in vl.readonly and vid not in vl.writables
+                           for vl in layouts)
+    stub.VolumeMarkReadonly(master_pb2.VolumeMarkReadonlyRequest(
+        ip="localhost", port=vsrv.port, volume_id=vid,
+        is_readonly=False), timeout=10)
+    assert all(vid not in vl.readonly for vl in layouts)
+    # unknown volume -> NOT_FOUND
+    import grpc as grpc_mod
+    with pytest.raises(grpc_mod.RpcError) as ei:
+        stub.VolumeMarkReadonly(master_pb2.VolumeMarkReadonlyRequest(
+            volume_id=9999, is_readonly=True), timeout=10)
+    assert ei.value.code() == grpc_mod.StatusCode.NOT_FOUND
+
+
+def test_master_raft_list_single_master(cluster):
+    """RaftListClusterServers in single-master mode: one Voter, leading
+    (reference master.proto:46)."""
+    from seaweedfs_tpu.pb import master_pb2
+
+    master, _ = cluster
+    resp = rpc.master_stub(rpc.grpc_address(master.address)) \
+        .RaftListClusterServers(
+            master_pb2.RaftListClusterServersRequest(), timeout=10)
+    assert len(resp.cluster_servers) == 1
+    s = resp.cluster_servers[0]
+    assert s.address == master.address and s.isLeader
+
+
+def test_filer_stream_rename_entry(fresh_cluster):
+    """StreamRenameEntry (reference filer.proto:33): a directory move
+    streams one rename event per moved entry, children first."""
+    from seaweedfs_tpu.pb import filer_pb2
+    from seaweedfs_tpu.server.filer import FilerServer
+
+    master, _ = fresh_cluster
+    fsrv = FilerServer(ip="localhost", port=_free_port(),
+                       master=master.address, store="memory")
+    fsrv.start()
+    try:
+        import requests
+
+        for name in ("a.txt", "b.txt"):
+            r = requests.put(f"http://{fsrv.address}/olddir/{name}",
+                             data=name.encode(), timeout=10)
+            assert r.status_code in (200, 201)
+        stub = rpc.filer_stub(rpc.grpc_address(fsrv.address))
+        events = list(stub.StreamRenameEntry(
+            filer_pb2.StreamRenameEntryRequest(
+                old_directory="/", old_name="olddir",
+                new_directory="/", new_name="newdir",
+                signatures=[1234]), timeout=30))
+        # 2 children + the directory itself, children first
+        assert len(events) == 3
+        moved = [e.event_notification.new_entry.name for e in events]
+        assert moved[-1] == "newdir" and set(moved[:-1]) == {"a.txt", "b.txt"}
+        assert all(1234 in e.event_notification.signatures for e in events)
+        assert all(e.ts_ns > 0 for e in events)
+        # the move really happened
+        g = requests.get(f"http://{fsrv.address}/newdir/a.txt", timeout=10)
+        assert g.status_code == 200 and g.content == b"a.txt"
+        assert requests.get(f"http://{fsrv.address}/olddir/a.txt",
+                            timeout=10).status_code == 404
+    finally:
+        fsrv.stop()
