@@ -78,6 +78,17 @@ port = 8529
 username = "root"
 password = ""
 database = "_system"
+
+[tikv]
+enabled = false
+pdaddrs = "localhost:2379"
+
+[hbase]
+enabled = false
+# the Thrift2 gateway address (`hbase thrift2 start`); create the
+# table once with: create 'seaweedfs', 'meta', 'kv'
+zkquorum = "localhost:9090"
+table = "seaweedfs"
 """,
     "master": """\
 # master.toml

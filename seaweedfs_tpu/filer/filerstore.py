@@ -60,6 +60,8 @@ def get_store(name: str, **kwargs) -> FilerStore:
         redis,
         redis3,
         sqlite,
+        hbase_store,
+        tikv_store,
     )
 
     cls = _REGISTRY.get(name)
@@ -83,6 +85,8 @@ def available_stores() -> list[str]:
         redis,
         redis3,
         sqlite,
+        hbase_store,
+        tikv_store,
     )
 
     return sorted(_REGISTRY)

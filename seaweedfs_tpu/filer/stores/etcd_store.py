@@ -28,19 +28,14 @@ from typing import Iterator
 from ...pb import filer_pb2
 from ..entry import Entry
 from ..filerstore import register_store
-from .wire_common import split_dir_name
+from .wire_common import prefix_end, split_dir_name
 
 SEP = b"\x00"
 
 
 def _prefix_end(prefix: bytes) -> bytes:
-    """etcd clientv3.GetPrefixRangeEnd: increment the last byte."""
-    b = bytearray(prefix)
-    for i in reversed(range(len(b))):
-        if b[i] < 0xFF:
-            b[i] += 1
-            return bytes(b[:i + 1])
-    return b"\x00"  # whole keyspace
+    """etcd clientv3.GetPrefixRangeEnd (b"\\x00" = whole keyspace)."""
+    return prefix_end(prefix, unbounded=b"\x00")
 
 
 class EtcdStore:

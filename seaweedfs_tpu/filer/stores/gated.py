@@ -26,9 +26,11 @@ _GATED = {
     # REST/JSON API with the stdlib http client
     # etcd is REAL now: stores/etcd_store.py drives the
     # etcdserverpb.KV gRPC API via the repo pb stack
-    "tikv": "tikv-client",
+    # tikv is REAL now: stores/tikv_store.py drives the RawKV
+    # gRPC API with pdpb region routing via the repo pb stack
     "ydb": "ydb",
-    "hbase": "happybase",
+    # hbase is REAL now: stores/hbase_store.py drives the Thrift2
+    # gateway (THBaseService) via stores/thrift_wire.py
     # arangodb is REAL now: stores/arango_wire.py drives
     # the REST + AQL cursor API
 }

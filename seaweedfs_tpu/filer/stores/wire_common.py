@@ -116,3 +116,16 @@ class ScramClient:
                      for kv in server_final.decode().split(","))
         if base64.b64decode(attrs.get("v", "")) != self.server_sig:
             raise ConnectionError("SCRAM server signature mismatch")
+
+
+def prefix_end(prefix: bytes, *, unbounded: bytes = b"") -> bytes:
+    """Smallest key greater than every key with `prefix` (etcd
+    clientv3.GetPrefixRangeEnd: increment the last non-0xFF byte).
+    `unbounded` is returned when no such key exists (all-0xFF prefix):
+    etcd's convention is b"\\x00" ("whole keyspace"), tikv's is b""."""
+    b = bytearray(prefix)
+    for i in reversed(range(len(b))):
+        if b[i] < 0xFF:
+            b[i] += 1
+            return bytes(b[:i + 1])
+    return unbounded

@@ -138,6 +138,32 @@ FILER_SERVICE = ("filer_pb.SeaweedFiler", [
 ])
 
 
+def tikv_pd_service():
+    """pdpb.PD subset (proto/tikv_pd.proto) — real kvproto names, so
+    the stub talks to an actual Placement Driver unchanged."""
+    from . import tikv_pd_pb2 as P
+
+    return ("pdpb.PD", [
+        _m("GetMembers", P.GetMembersRequest, P.GetMembersResponse),
+        _m("GetRegion", P.GetRegionRequest, P.GetRegionResponse),
+        _m("GetStore", P.GetStoreRequest, P.GetStoreResponse),
+    ])
+
+
+def tikv_service():
+    """tikvpb.Tikv RawKV subset (proto/tikv_rpc.proto)."""
+    from . import tikv_kvrpc_pb2 as K
+
+    return ("tikvpb.Tikv", [
+        _m("RawGet", K.RawGetRequest, K.RawGetResponse),
+        _m("RawPut", K.RawPutRequest, K.RawPutResponse),
+        _m("RawDelete", K.RawDeleteRequest, K.RawDeleteResponse),
+        _m("RawScan", K.RawScanRequest, K.RawScanResponse),
+        _m("RawDeleteRange", K.RawDeleteRangeRequest,
+           K.RawDeleteRangeResponse),
+    ])
+
+
 def etcd_kv_service():
     """etcdserverpb.KV subset (proto/etcd_kv.proto) — names match the
     real etcd v3 API so the stub talks to an actual etcd unchanged.

@@ -1,0 +1,244 @@
+"""In-process fake HBase Thrift2 gateway: THBaseService (get/put/
+deleteSingle/getScannerResults) over the real Thrift strict binary
+protocol. The protocol parser/encoder here is written independently of
+seaweedfs_tpu's thrift_wire.py (same public spec, separate code), so a
+framing bug in either side fails the tests instead of cancelling out.
+Cells live in one table as {row: {family: value}} with the single 'a'
+qualifier the store uses; unknown methods answer a TApplicationException
+like a real gateway.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+VERSION_1 = 0x80010000
+CALL, REPLY, EXCEPTION = 1, 2, 3
+BOOL, BYTE, DOUBLE = 2, 3, 4
+I16, I32, I64 = 6, 8, 10
+STRING, STRUCT, MAP, SET, LIST = 11, 12, 13, 14, 15
+
+
+class _Dec:
+    def __init__(self, f):
+        self.f = f
+
+    def take(self, n: int) -> bytes:
+        b = self.f.read(n)
+        if len(b) != n:
+            raise EOFError
+        return b
+
+    def value(self, t: int):
+        if t == BOOL:
+            return self.take(1) != b"\x00"
+        if t == BYTE:
+            return struct.unpack(">b", self.take(1))[0]
+        if t == DOUBLE:
+            return struct.unpack(">d", self.take(8))[0]
+        if t == I16:
+            return struct.unpack(">h", self.take(2))[0]
+        if t == I32:
+            return struct.unpack(">i", self.take(4))[0]
+        if t == I64:
+            return struct.unpack(">q", self.take(8))[0]
+        if t == STRING:
+            return self.take(struct.unpack(">i", self.take(4))[0])
+        if t == STRUCT:
+            return self.struct()
+        if t in (LIST, SET):
+            et = struct.unpack(">b", self.take(1))[0]
+            n = struct.unpack(">i", self.take(4))[0]
+            return [self.value(et) for _ in range(n)]
+        if t == MAP:
+            kt, vt = struct.unpack(">bb", self.take(2))
+            n = struct.unpack(">i", self.take(4))[0]
+            return {self.value(kt): self.value(vt) for _ in range(n)}
+        raise ValueError(f"type {t}")
+
+    def struct(self) -> dict:
+        out = {}
+        while True:
+            t = struct.unpack(">b", self.take(1))[0]
+            if t == 0:
+                return out
+            fid = struct.unpack(">h", self.take(2))[0]
+            out[fid] = self.value(t)
+
+
+def _e_str(b: bytes) -> bytes:
+    return struct.pack(">i", len(b)) + b
+
+
+def _e_field(fid: int, t: int, payload: bytes) -> bytes:
+    return struct.pack(">bh", t, fid) + payload
+
+
+def _e_struct(*fields: bytes) -> bytes:
+    return b"".join(fields) + b"\x00"
+
+
+def _e_list(etype: int, elems: list[bytes]) -> bytes:
+    return struct.pack(">bi", etype, len(elems)) + b"".join(elems)
+
+
+def _tresult(row: bytes, family: bytes, value: bytes) -> bytes:
+    cv = _e_struct(_e_field(1, STRING, _e_str(family)),
+                   _e_field(2, STRING, _e_str(b"a")),
+                   _e_field(3, STRING, _e_str(value)))
+    return _e_struct(_e_field(1, STRING, _e_str(row)),
+                     _e_field(2, LIST, _e_list(STRUCT, [cv])))
+
+
+class FakeHbaseThriftServer:
+    def __init__(self, *, tables: tuple[str, ...] = ("seaweedfs",)):
+        # {table: {row: {family: value}}}
+        self.tables: dict[bytes, dict[bytes, dict[bytes, bytes]]] = {
+            t.encode(): {} for t in tables}
+        self.lock = threading.Lock()
+        self.calls: list[str] = []  # observed method names, for tests
+        self._listen = socket.socket()
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind(("localhost", 0))
+        self._listen.listen(16)
+        self.port = self._listen.getsockname()[1]
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+
+    def _accept(self) -> None:
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listen.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve, args=(conn,),
+                             daemon=True).start()
+
+    def _serve(self, conn: socket.socket) -> None:
+        f = conn.makefile("rb")
+        try:
+            while not self._stop.is_set():
+                try:
+                    d = _Dec(f)
+                    head = struct.unpack(">i", d.take(4))[0] & 0xFFFFFFFF
+                    if head & 0xFFFF0000 != VERSION_1:
+                        return  # not strict binary protocol: hang up
+                    name = d.take(struct.unpack(">i", d.take(4))[0])
+                    seq = struct.unpack(">i", d.take(4))[0]
+                    args = d.struct()
+                except EOFError:
+                    return
+                self.calls.append(name.decode())
+                body, mtype = self._dispatch(name.decode(), args)
+                head = struct.pack(">i",
+                                   (VERSION_1 | mtype) - (1 << 32))
+                conn.sendall(head + _e_str(name)
+                             + struct.pack(">i", seq) + body)
+        except OSError:
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    # -- THBaseService ------------------------------------------------------
+
+    def _table(self, args: dict):
+        t = self.tables.get(args.get(1, b""))
+        if t is None:
+            # declared TIOError {1: message} in reply field 1
+            return None, _e_struct(_e_field(1, STRUCT, _e_struct(
+                _e_field(1, STRING,
+                         _e_str(b"TableNotFoundException")))))
+        return t, None
+
+    @staticmethod
+    def _families(spec: dict, field_id: int = 2,
+                  default: bytes = b"meta") -> list[bytes]:
+        # TGet/TDelete carry columns in field 2; TScan in field 3
+        # (field 2 is stopRow) — hbase.thrift
+        cols = spec.get(field_id)
+        if not cols:
+            return [default]
+        return [c.get(1, default) for c in cols]
+
+    def _dispatch(self, method: str, args: dict) -> tuple[bytes, int]:
+        with self.lock:
+            if method in ("get", "exists"):
+                table, err = self._table(args)
+                if err is not None:
+                    return err, REPLY
+                tget = args.get(2, {})
+                row = tget.get(1, b"")
+                fams = self._families(tget)
+                cells = table.get(row, {})
+                hit = next((fam for fam in fams if fam in cells), None)
+                if method == "exists":
+                    return _e_struct(_e_field(
+                        0, BOOL, b"\x01" if hit else b"\x00")), REPLY
+                if hit is None:
+                    return _e_struct(_e_field(0, STRUCT,
+                                              _e_struct())), REPLY
+                return _e_struct(_e_field(0, STRUCT, _tresult(
+                    row, hit, cells[hit]))), REPLY
+            if method == "put":
+                table, err = self._table(args)
+                if err is not None:
+                    return err, REPLY
+                tput = args.get(2, {})
+                row = tput.get(1, b"")
+                for cv in tput.get(2) or []:
+                    fam, qual, val = cv.get(1), cv.get(2), cv.get(3)
+                    assert qual == b"a", f"unexpected qualifier {qual!r}"
+                    table.setdefault(row, {})[fam] = val
+                return _e_struct(), REPLY
+            if method == "deleteSingle":
+                table, err = self._table(args)
+                if err is not None:
+                    return err, REPLY
+                tdel = args.get(2, {})
+                row = tdel.get(1, b"")
+                cells = table.get(row)
+                if cells is not None:
+                    for fam in self._families(tdel):
+                        cells.pop(fam, None)
+                    if not cells:
+                        table.pop(row, None)
+                return _e_struct(), REPLY
+            if method == "getScannerResults":
+                table, err = self._table(args)
+                if err is not None:
+                    return err, REPLY
+                tscan = args.get(2, {})
+                start = tscan.get(1, b"")
+                stop = tscan.get(2, b"")
+                fams = self._families(tscan, field_id=3)
+                n = args.get(3, 1024)
+                rows = sorted(r for r in table
+                              if r >= start and (not stop or r < stop))
+                out = []
+                for r in rows:
+                    for fam in fams:
+                        if fam in table[r]:
+                            out.append(_tresult(r, fam, table[r][fam]))
+                            break
+                    if len(out) >= n:
+                        break
+                return _e_struct(_e_field(0, LIST,
+                                          _e_list(STRUCT, out))), REPLY
+            # TApplicationException {1: message, 2: type=1 unknown method}
+            body = _e_struct(
+                _e_field(1, STRING,
+                         _e_str(f"unknown method {method}".encode())),
+                _e_field(2, I32, struct.pack(">i", 1)))
+            return body, EXCEPTION

@@ -1,0 +1,172 @@
+"""In-process fake TiKV: a pdpb.PD servicer (GetMembers/GetRegion/
+GetStore) and per-"store" tikvpb.Tikv RawKV servicers over the same
+kvproto wire the real cluster speaks. The keyspace is split into TWO
+regions at a configurable boundary and served by two distinct gRPC
+servers, so the client's PD routing loop (key->region->store->stub) and
+cross-region scan/delete-range splitting are exercised for real: every
+request's Context is validated against the region that actually owns
+the key range — wrong region id/epoch or a key outside the region's
+bounds returns a region_error exactly like a real region server.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from seaweedfs_tpu.pb import rpc
+from seaweedfs_tpu.pb import tikv_kvrpc_pb2 as K
+from seaweedfs_tpu.pb import tikv_meta_pb2 as M
+from seaweedfs_tpu.pb import tikv_pd_pb2 as P
+
+CLUSTER_ID = 7_431_998
+
+
+class _RegionServicer:
+    """One region server ("store") owning [start_key, end_key)."""
+
+    def __init__(self, region: M.Region, data: dict[bytes, bytes],
+                 lock: threading.Lock):
+        self.region = region
+        self.data = data  # shared, range-partitioned by _owns
+        self.lock = lock
+
+    def _owns(self, key: bytes) -> bool:
+        r = self.region
+        if r.start_key and key < r.start_key:
+            return False
+        if r.end_key and key >= r.end_key:
+            return False
+        return True
+
+    def _ctx_error(self, ctx: K.Context, *keys: bytes):
+        r = self.region
+        if ctx.region_id != r.id:
+            return K.RegionError(
+                message=f"region {ctx.region_id} not found on store")
+        if (ctx.region_epoch.version != r.region_epoch.version
+                or ctx.region_epoch.conf_ver != r.region_epoch.conf_ver):
+            return K.RegionError(message="epoch_not_match")
+        for k in keys:
+            if k and not self._owns(k):
+                return K.RegionError(message="key not in region")
+        return None
+
+    def RawGet(self, req: K.RawGetRequest, _):
+        err = self._ctx_error(req.context, req.key)
+        if err:
+            return K.RawGetResponse(region_error=err)
+        with self.lock:
+            if req.key not in self.data:
+                return K.RawGetResponse(not_found=True)
+            return K.RawGetResponse(value=self.data[req.key])
+
+    def RawPut(self, req: K.RawPutRequest, _):
+        err = self._ctx_error(req.context, req.key)
+        if err:
+            return K.RawPutResponse(region_error=err)
+        with self.lock:
+            self.data[req.key] = req.value
+        return K.RawPutResponse()
+
+    def RawDelete(self, req: K.RawDeleteRequest, _):
+        err = self._ctx_error(req.context, req.key)
+        if err:
+            return K.RawDeleteResponse(region_error=err)
+        with self.lock:
+            self.data.pop(req.key, None)
+        return K.RawDeleteResponse()
+
+    def _range_keys(self, start: bytes, end: bytes) -> list[bytes]:
+        return sorted(k for k in self.data
+                      if self._owns(k) and k >= start
+                      and (not end or k < end))
+
+    def RawScan(self, req: K.RawScanRequest, _):
+        err = self._ctx_error(req.context, req.start_key)
+        if err:
+            return K.RawScanResponse(region_error=err)
+        with self.lock:
+            keys = self._range_keys(req.start_key, req.end_key)
+            if req.limit:
+                keys = keys[:req.limit]
+            return K.RawScanResponse(kvs=[
+                K.KvPair(key=k, value=self.data[k]) for k in keys])
+
+    def RawDeleteRange(self, req: K.RawDeleteRangeRequest, _):
+        # a real region server rejects ranges reaching past its bounds
+        r = self.region
+        if req.end_key and r.end_key and req.end_key > r.end_key:
+            return K.RawDeleteRangeResponse(region_error=K.RegionError(
+                message="range spills past region end"))
+        err = self._ctx_error(req.context, req.start_key)
+        if err:
+            return K.RawDeleteRangeResponse(region_error=err)
+        with self.lock:
+            for k in self._range_keys(req.start_key, req.end_key):
+                del self.data[k]
+        return K.RawDeleteRangeResponse()
+
+
+class _PDServicer:
+    def __init__(self, regions: list[M.Region],
+                 stores: dict[int, M.Store]):
+        self.regions = regions
+        self.stores = stores
+
+    def _hdr(self):
+        return P.ResponseHeader(cluster_id=CLUSTER_ID)
+
+    def GetMembers(self, req: P.GetMembersRequest, _):
+        m = P.Member(name="pd-0", member_id=1)
+        return P.GetMembersResponse(header=self._hdr(), members=[m],
+                                    leader=m)
+
+    def GetRegion(self, req: P.GetRegionRequest, _):
+        for r in self.regions:
+            if ((not r.start_key or req.region_key >= r.start_key)
+                    and (not r.end_key or req.region_key < r.end_key)):
+                return P.GetRegionResponse(header=self._hdr(), region=r,
+                                           leader=r.peers[0])
+        return P.GetRegionResponse(header=self._hdr())
+
+    def GetStore(self, req: P.GetStoreRequest, _):
+        s = self.stores.get(req.store_id)
+        if s is None:
+            return P.GetStoreResponse(header=P.ResponseHeader(
+                cluster_id=CLUSTER_ID,
+                error=P.Error(message=f"store {req.store_id} not found")))
+        return P.GetStoreResponse(header=self._hdr(), store=s)
+
+
+class FakeTikvCluster:
+    """PD + two region servers splitting the keyspace at `split_key`."""
+
+    def __init__(self, split_key: bytes = b"\x80"):
+        self.data: dict[bytes, bytes] = {}
+        lock = threading.Lock()
+        self._servers = []
+        regions, stores = [], {}
+        bounds = [(b"", split_key), (split_key, b"")]
+        for i, (lo, hi) in enumerate(bounds, start=1):
+            region = M.Region(
+                id=i, start_key=lo, end_key=hi,
+                region_epoch=M.RegionEpoch(conf_ver=1, version=5),
+                peers=[M.Peer(id=100 + i, store_id=i)])
+            srv = rpc.new_server(max_workers=8)
+            rpc.add_servicer(srv, rpc.tikv_service(),
+                             _RegionServicer(region, self.data, lock))
+            port = srv.add_insecure_port("localhost:0")
+            srv.start()
+            self._servers.append(srv)
+            regions.append(region)
+            stores[i] = M.Store(id=i, address=f"localhost:{port}")
+        pd = rpc.new_server(max_workers=8)
+        rpc.add_servicer(pd, rpc.tikv_pd_service(),
+                         _PDServicer(regions, stores))
+        self.port = pd.add_insecure_port("localhost:0")
+        pd.start()
+        self._servers.append(pd)
+
+    def stop(self) -> None:
+        for s in self._servers:
+            s.stop(grace=0.2)
