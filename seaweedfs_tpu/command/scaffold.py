@@ -114,6 +114,38 @@ white_list = []     # e.g. ["127.0.0.1", "10.0.0.0/8"]; empty = open
 
 [access]
 ui = true
+
+# All gRPC TLS authentications are MUTUAL: when a component section
+# carries cert+key and [grpc] carries the shared ca, that component's
+# gRPC port requires a client certificate signed by the same ca, and
+# plaintext clients are rejected. Certs must cover the host names in
+# their SANs. Empty values (the default) keep plaintext.
+[grpc]
+ca = ""
+allowed_wildcard_domain = ""   # e.g. ".mycompany.com"
+
+[grpc.master]
+cert = ""
+key = ""
+allowed_commonNames = ""       # comma-separated CNs
+
+[grpc.volume]
+cert = ""
+key = ""
+allowed_commonNames = ""
+
+[grpc.filer]
+cert = ""
+key = ""
+allowed_commonNames = ""
+
+[grpc.msg_broker]
+cert = ""
+key = ""
+
+[grpc.client]
+cert = ""
+key = ""
 """,
     "shell": """\
 # shell.toml

@@ -93,8 +93,10 @@ class MqGrpcServer:
         self.port = port
         self._server = rpc.new_server()
         rpc.add_servicer(self._server, rpc.MQ_SERVICE,
-                         MqGrpcServicer(broker, address or f"localhost:{port}"))
-        self._server.add_insecure_port(f"[::]:{port}")
+                         MqGrpcServicer(broker,
+                                        address or f"localhost:{port}"),
+                         component="msg_broker")
+        rpc.serve_port(self._server, f"[::]:{port}", "msg_broker")
 
     def start(self) -> None:
         self._server.start()

@@ -197,13 +197,43 @@ class Stub:
             setattr(self, m["name"], fn)
 
 
-def add_servicer(server: grpc.Server, service, servicer) -> None:
+def add_servicer(server: grpc.Server, service, servicer,
+                 component: str | None = None) -> None:
     """Register `servicer` (an object with one method per RPC name) for the
-    given descriptor on a grpc.Server."""
+    given descriptor on a grpc.Server. With `component`, and ONLY when
+    that component's server TLS actually loads (the reference returns
+    creds+authenticator together from LoadServerTLS and neither on
+    failure, tls.go:26-87), every handler first validates the mTLS
+    peer's common name against [grpc.<component>].allowed_commonNames /
+    grpc.allowed_wildcard_domain (tls.go:64-76)."""
+    auth = None
+    if component is not None:
+        from ..security.tls import (
+            load_authenticator,
+            load_server_credentials,
+        )
+
+        if load_server_credentials(component) is not None:
+            auth = load_authenticator(component)
     full_name, methods = service
     handlers = {}
+
+    def guarded(behavior, streaming: bool):
+        if auth is None or not auth.active:
+            return behavior
+        if streaming:
+            def stream_wrap(request, context):
+                auth.check_context(context)
+                yield from behavior(request, context)
+            return stream_wrap
+
+        def unary_wrap(request, context):
+            auth.check_context(context)
+            return behavior(request, context)
+        return unary_wrap
+
     for m in methods:
-        behavior = getattr(servicer, m["name"])
+        behavior = guarded(getattr(servicer, m["name"]), m["ss"])
         kw = dict(request_deserializer=m["req"].FromString,
                   response_serializer=m["resp"].SerializeToString)
         if m["cs"] and m["ss"]:
@@ -231,22 +261,70 @@ def new_server(max_workers: int = 32) -> grpc.Server:
 
 _channels: dict[str, grpc.Channel] = {}
 _channels_lock = threading.Lock()
+# Outbound mTLS credentials from security.toml, loaded once
+# (LoadClientTLS, security/tls.go:89); None = plaintext. Resolution
+# order: [grpc.client], then the first configured server component —
+# the reference dials with the CALLING component's cert (master dials
+# as grpc.master etc.); one process here can host several components
+# behind this shared channel cache, so it presents ONE client identity,
+# preferring the dedicated [grpc.client] pair. Server-only configs
+# (no [grpc.client]) still dial secured instead of being locked out.
+_client_creds: grpc.ChannelCredentials | None = None
+_client_creds_loaded = False
+
+
+def _client_credentials_locked() -> grpc.ChannelCredentials | None:
+    """Resolve/cache outbound creds; _channels_lock must be held."""
+    global _client_creds, _client_creds_loaded
+    if not _client_creds_loaded:
+        from ..security.tls import load_client_credentials
+
+        for component in ("client", "master", "volume", "filer"):
+            _client_creds = load_client_credentials(component)
+            if _client_creds is not None:
+                break
+        _client_creds_loaded = True
+    return _client_creds
 
 
 def cached_channel(address: str) -> grpc.Channel:
+    # creds resolve under the SAME lock hold that fills the cache, so a
+    # concurrent reset_channels() can't interleave and seed the fresh
+    # cache with stale credentials
     with _channels_lock:
         ch = _channels.get(address)
         if ch is None:
-            ch = grpc.insecure_channel(address, options=_CHANNEL_OPTIONS)
+            creds = _client_credentials_locked()
+            if creds is not None:
+                ch = grpc.secure_channel(address, creds,
+                                         options=_CHANNEL_OPTIONS)
+            else:
+                ch = grpc.insecure_channel(address,
+                                           options=_CHANNEL_OPTIONS)
             _channels[address] = ch
         return ch
 
 
 def reset_channels() -> None:
+    global _client_creds, _client_creds_loaded
     with _channels_lock:
         for ch in _channels.values():
             ch.close()
         _channels.clear()
+        _client_creds = None
+        _client_creds_loaded = False
+
+
+def serve_port(server: grpc.Server, address: str, component: str) -> int:
+    """Bind a server port with [grpc.<component>] mutual TLS when
+    security.toml configures it, plaintext otherwise (the LoadServerTLS
+    dispatch every reference server runs at startup)."""
+    from ..security.tls import load_server_credentials
+
+    creds = load_server_credentials(component)
+    if creds is not None:
+        return server.add_secure_port(address, creds)
+    return server.add_insecure_port(address)
 
 
 def derived_grpc_port(http_port: int) -> int:

@@ -1,0 +1,121 @@
+"""Mutual-TLS for the gRPC planes, configured by security.toml.
+
+Rebuild of /root/reference/weed/security/tls.go: `LoadServerTLS`
+(:26) builds server credentials from the ``[grpc.<component>]``
+cert/key pair plus the shared ``grpc.ca`` root, with
+``RequireClientCert`` — all gRPC TLS is MUTUAL; `LoadClientTLS` (:89)
+builds the matching client credentials from ``[grpc.client]`` (or a
+component-specific section). When a section is absent or incomplete
+both sides fall back to plaintext, exactly like the reference (every
+cert field defaults to "" in security.toml and LoadClientTLS returns
+insecure creds on any missing file).
+
+Common-name authorization (`allowed_commonNames` /
+`grpc.allowed_wildcard_domain`, tls.go:64-76 Authenticator) is
+enforced here at the server via each servicer's peer-identity check
+hook; grpcio surfaces the verified client cert through
+``context.auth_context()``.
+
+The HTTP data planes keep JWT + IP-guard auth (the reference ships
+its https.* sections commented out by default; its control plane
+story is gRPC mTLS, which this module covers end to end).
+"""
+
+from __future__ import annotations
+
+import fnmatch
+
+import grpc
+
+from ..utils import glog
+from ..utils.config import get_path, load_config
+
+
+def _read(path: str) -> bytes | None:
+    if not path:
+        return None
+    try:
+        with open(path, "rb") as f:
+            return f.read()
+    except OSError as e:
+        glog.warning(f"security.toml TLS file {path!r}: {e}")
+        return None
+
+
+def _section(conf: dict, component: str) -> tuple[bytes, bytes, bytes] | None:
+    """(ca, cert, key) bytes for grpc.<component>, or None."""
+    ca = _read(get_path(conf, "grpc.ca", ""))
+    cert = _read(get_path(conf, f"grpc.{component}.cert", ""))
+    key = _read(get_path(conf, f"grpc.{component}.key", ""))
+    if not (ca and cert and key):
+        return None
+    return ca, cert, key
+
+
+def load_server_credentials(component: str, conf: dict | None = None
+                            ) -> grpc.ServerCredentials | None:
+    """grpc.ServerCredentials for [grpc.<component>] — mutual TLS with
+    require_client_auth, or None for plaintext (LoadServerTLS)."""
+    conf = load_config("security") if conf is None else conf
+    sec = _section(conf, component)
+    if sec is None:
+        return None
+    ca, cert, key = sec
+    return grpc.ssl_server_credentials(
+        [(key, cert)], root_certificates=ca, require_client_auth=True)
+
+
+def load_client_credentials(component: str = "client",
+                            conf: dict | None = None
+                            ) -> grpc.ChannelCredentials | None:
+    """grpc.ChannelCredentials for [grpc.client] (LoadClientTLS), or
+    None for plaintext."""
+    conf = load_config("security") if conf is None else conf
+    sec = _section(conf, component)
+    if sec is None:
+        return None
+    ca, cert, key = sec
+    return grpc.ssl_channel_credentials(
+        root_certificates=ca, private_key=key, certificate_chain=cert)
+
+
+class CommonNameAuthenticator:
+    """tls.go:21 Authenticator: restrict verified client certs to an
+    allow-list of common names and/or a wildcard domain."""
+
+    def __init__(self, allowed_common_names: str = "",
+                 allowed_wildcard_domain: str = ""):
+        self.names = {s.strip() for s in allowed_common_names.split(",")
+                      if s.strip()}
+        self.wildcard = allowed_wildcard_domain
+
+    @property
+    def active(self) -> bool:
+        return bool(self.names or self.wildcard)
+
+    def allow(self, common_name: str) -> bool:
+        if not self.active:
+            return True
+        if common_name in self.names:
+            return True
+        return bool(self.wildcard) and fnmatch.fnmatch(
+            common_name, "*" + self.wildcard)
+
+    def check_context(self, context) -> None:
+        """Abort the RPC unless the peer cert's CN is allowed."""
+        if not self.active:
+            return
+        auth = context.auth_context() or {}
+        cns = [v.decode("utf-8", "replace")
+               for v in auth.get("x509_common_name", [])]
+        if not any(self.allow(cn) for cn in cns):
+            context.abort(grpc.StatusCode.UNAUTHENTICATED,
+                          f"client common name {cns} not allowed")
+
+
+def load_authenticator(component: str, conf: dict | None = None
+                       ) -> CommonNameAuthenticator:
+    conf = load_config("security") if conf is None else conf
+    return CommonNameAuthenticator(
+        get_path(conf, f"grpc.{component}.allowed_commonNames", "") or "",
+        get_path(conf, "grpc.allowed_wildcard_domain", "") or "")

@@ -188,8 +188,9 @@ class FilerServer:
 
     def start(self) -> None:
         self._grpc_server = rpc.new_server()
-        rpc.add_servicer(self._grpc_server, rpc.FILER_SERVICE, FilerGrpc(self))
-        self._grpc_server.add_insecure_port(f"[::]:{self.grpc_port}")
+        rpc.add_servicer(self._grpc_server, rpc.FILER_SERVICE,
+                         FilerGrpc(self), component="filer")
+        rpc.serve_port(self._grpc_server, f"[::]:{self.grpc_port}", "filer")
         self._grpc_server.start()
         http_port = self.port
         if self._vol_plane is not None:

@@ -124,8 +124,9 @@ class VolumeServer:
 
     def start(self) -> None:
         self._grpc_server = rpc.new_server()
-        rpc.add_servicer(self._grpc_server, rpc.VOLUME_SERVICE, VolumeGrpc(self))
-        self._grpc_server.add_insecure_port(f"[::]:{self.grpc_port}")
+        rpc.add_servicer(self._grpc_server, rpc.VOLUME_SERVICE,
+                         VolumeGrpc(self), component="volume")
+        rpc.serve_port(self._grpc_server, f"[::]:{self.grpc_port}", "volume")
         self._grpc_server.start()
         handler = _make_http_handler(self)
         try:

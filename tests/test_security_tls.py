@@ -1,0 +1,266 @@
+"""gRPC mutual TLS (security.toml [grpc.*], reference
+weed/security/tls.go): a full master+volume+filer cluster where every
+gRPC plane requires client certificates; plaintext and cert-less
+clients are rejected; common-name allow-lists gate verified peers."""
+
+import datetime
+import socket
+import time
+
+import grpc
+import pytest
+import requests
+
+from seaweedfs_tpu.pb import master_pb2, rpc
+from seaweedfs_tpu.server.filer import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _make_cert(subject_cn, issuer_cert=None, issuer_key=None, *,
+               is_ca=False):
+    """-> (cert_pem, key_pem, cert, key). Self-signed when no issuer."""
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, subject_cn)])
+    now = datetime.datetime.now(datetime.timezone.utc)
+    builder = (x509.CertificateBuilder()
+               .subject_name(name)
+               .issuer_name(issuer_cert.subject if issuer_cert else name)
+               .public_key(key.public_key())
+               .serial_number(x509.random_serial_number())
+               .not_valid_before(now - datetime.timedelta(minutes=5))
+               .not_valid_after(now + datetime.timedelta(days=1))
+               .add_extension(x509.BasicConstraints(ca=is_ca,
+                                                    path_length=None),
+                              critical=True))
+    if not is_ca:
+        builder = builder.add_extension(
+            x509.SubjectAlternativeName([
+                x509.DNSName("localhost"),
+                x509.IPAddress(__import__("ipaddress")
+                               .ip_address("127.0.0.1")),
+            ]), critical=False)
+    cert = builder.sign(issuer_key or key, hashes.SHA256())
+    return (cert.public_bytes(serialization.Encoding.PEM),
+            key.private_bytes(
+                serialization.Encoding.PEM,
+                serialization.PrivateFormat.TraditionalOpenSSL,
+                serialization.NoEncryption()),
+            cert, key)
+
+
+@pytest.fixture
+def tls_pki(tmp_path):
+    """CA + per-component certs + a security.toml pointing at them,
+    activated by scoping the config search path to tmp_path."""
+    ca_pem, ca_key_pem, ca_cert, ca_key = _make_cert("weed-ca", is_ca=True)
+    files = {"ca.pem": ca_pem}
+    for who in ("master", "volume", "filer", "client", "intruder"):
+        cert_pem, key_pem, _, _ = _make_cert(
+            f"weed-{who}", issuer_cert=ca_cert, issuer_key=ca_key)
+        files[f"{who}.crt"] = cert_pem
+        files[f"{who}.key"] = key_pem
+    for fn, blob in files.items():
+        (tmp_path / fn).write_bytes(blob)
+
+    def toml(**section_extras: str) -> None:
+        body = [f'[grpc]\nca = "{tmp_path}/ca.pem"']
+        for c in ("master", "volume", "filer", "client"):
+            sec = (f'[grpc.{c}]\ncert = "{tmp_path}/{c}.crt"\n'
+                   f'key = "{tmp_path}/{c}.key"')
+            if c in section_extras:
+                sec += "\n" + section_extras[c]
+            body.append(sec)
+        (tmp_path / "security.toml").write_text("\n".join(body) + "\n")
+
+    toml()
+    yield tmp_path, toml
+
+
+@pytest.fixture
+def tls_paths(tls_pki, monkeypatch):
+    tmp_path, toml = tls_pki
+    from seaweedfs_tpu.utils import config
+
+    monkeypatch.setattr(config, "SEARCH_PATHS", [str(tmp_path)])
+    rpc.reset_channels()  # drop plaintext channels + cached client creds
+    yield tmp_path, toml
+    rpc.reset_channels()
+
+
+def test_mtls_cluster_end_to_end(tls_paths, tmp_path):
+    """Heartbeats, assignment, and the filer metadata plane all ride
+    mutual TLS; plaintext and cert-less clients are refused."""
+    tls_dir, _ = tls_paths
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "tlsvol")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), pulse_seconds=1)
+    vsrv.start()
+    fs = FilerServer(ip="localhost", port=_free_port(),
+                     master=f"localhost:{mport}", store="memory")
+    fs.start()
+    try:
+        # volume -> master heartbeat stream crossed the mTLS boundary
+        deadline = time.time() + 15
+        while time.time() < deadline and not master.topo.nodes:
+            time.sleep(0.05)
+        assert master.topo.nodes, "no heartbeat over mTLS"
+        # full write/read path: filer->master assign + filer gRPC all TLS
+        base = f"http://{fs.address}"
+        r = requests.put(f"{base}/tls/hello.txt", data=b"mutual tls",
+                         timeout=30)
+        assert r.status_code in (200, 201)
+        g = requests.get(f"{base}/tls/hello.txt", timeout=30)
+        assert g.status_code == 200 and g.content == b"mutual tls"
+        # the secured master gRPC port works for a proper mTLS client
+        stub = rpc.master_stub(rpc.grpc_address(master.address))
+        assert stub.Ping(master_pb2.PingRequest(),
+                         timeout=10).start_time_ns > 0
+        gaddr = f"localhost:{master.grpc_port}"
+        # plaintext client: rejected at the transport
+        plain = grpc.insecure_channel(gaddr)
+        with pytest.raises(grpc.RpcError) as e1:
+            rpc.Stub(plain, rpc.MASTER_SERVICE).Ping(
+                master_pb2.PingRequest(), timeout=5)
+        assert e1.value.code() == grpc.StatusCode.UNAVAILABLE
+        plain.close()
+        # TLS WITHOUT a client cert: handshake refused (mutual is
+        # required, tls.go RequireClientCert)
+        anon = grpc.secure_channel(gaddr, grpc.ssl_channel_credentials(
+            root_certificates=(tls_dir / "ca.pem").read_bytes()))
+        with pytest.raises(grpc.RpcError) as e2:
+            rpc.Stub(anon, rpc.MASTER_SERVICE).Ping(
+                master_pb2.PingRequest(), timeout=5)
+        assert e2.value.code() == grpc.StatusCode.UNAVAILABLE
+        anon.close()
+    finally:
+        fs.stop()
+        vsrv.stop()
+        master.stop()
+
+
+def test_mtls_common_name_allowlist(tls_paths):
+    """allowed_commonNames (tls.go:64 Authenticator): a verified peer
+    whose CN is not allowed gets UNAUTHENTICATED, an allowed CN works."""
+    tls_dir, toml = tls_paths
+    toml(master='allowed_commonNames = "weed-client"')
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    try:
+        # allowed CN (weed-client, via the cached [grpc.client] creds)
+        stub = rpc.master_stub(rpc.grpc_address(master.address))
+        assert stub.Ping(master_pb2.PingRequest(),
+                         timeout=10).start_time_ns > 0
+        # a cert the CA signed but whose CN is not in the list
+        creds = grpc.ssl_channel_credentials(
+            root_certificates=(tls_dir / "ca.pem").read_bytes(),
+            private_key=(tls_dir / "intruder.key").read_bytes(),
+            certificate_chain=(tls_dir / "intruder.crt").read_bytes())
+        ch = grpc.secure_channel(f"localhost:{master.grpc_port}", creds)
+        with pytest.raises(grpc.RpcError) as ei:
+            rpc.Stub(ch, rpc.MASTER_SERVICE).Ping(
+                master_pb2.PingRequest(), timeout=5)
+        assert ei.value.code() == grpc.StatusCode.UNAUTHENTICATED
+        ch.close()
+    finally:
+        master.stop()
+
+
+def test_plaintext_stays_default(tmp_path, monkeypatch):
+    """No security.toml -> everything stays plaintext (every cert field
+    defaults to '' in the scaffold, like the reference)."""
+    from seaweedfs_tpu.utils import config
+
+    monkeypatch.setattr(config, "SEARCH_PATHS", [str(tmp_path / "empty")])
+    rpc.reset_channels()
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    try:
+        stub = rpc.master_stub(rpc.grpc_address(master.address))
+        assert stub.Ping(master_pb2.PingRequest(),
+                         timeout=10).start_time_ns > 0
+    finally:
+        master.stop()
+        rpc.reset_channels()
+
+
+def test_server_only_config_still_dials_secured(tls_pki, monkeypatch,
+                                                tmp_path):
+    """A reference-style server-only security.toml (component certs, NO
+    [grpc.client]) must not strand outbound dials on plaintext: the
+    channel cache falls back to the first configured component cert."""
+    tls_dir, _ = tls_pki
+    body = [f'[grpc]\nca = "{tls_dir}/ca.pem"']
+    for c in ("master", "volume", "filer"):
+        body.append(f'[grpc.{c}]\ncert = "{tls_dir}/{c}.crt"\n'
+                    f'key = "{tls_dir}/{c}.key"')
+    (tls_dir / "security.toml").write_text("\n".join(body) + "\n")
+    from seaweedfs_tpu.utils import config
+
+    monkeypatch.setattr(config, "SEARCH_PATHS", [str(tls_dir)])
+    rpc.reset_channels()
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "sovol")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), pulse_seconds=1)
+    vsrv.start()
+    try:
+        deadline = time.time() + 15
+        while time.time() < deadline and not master.topo.nodes:
+            time.sleep(0.05)
+        assert master.topo.nodes, \
+            "volume->master heartbeat failed without [grpc.client]"
+    finally:
+        vsrv.stop()
+        master.stop()
+        rpc.reset_channels()
+
+
+def test_cn_allowlist_without_certs_does_not_brick_server(tmp_path,
+                                                          monkeypatch):
+    """allowed_commonNames with a broken cert path: server TLS fails to
+    load, the port binds plaintext — and the authenticator must NOT
+    activate (the reference couples creds+authenticator in
+    LoadServerTLS); otherwise every RPC dies UNAUTHENTICATED."""
+    (tmp_path / "security.toml").write_text(
+        f'[grpc]\nca = "{tmp_path}/missing-ca.pem"\n'
+        f'[grpc.master]\ncert = "{tmp_path}/missing.crt"\n'
+        f'key = "{tmp_path}/missing.key"\n'
+        'allowed_commonNames = "weed-client"\n')
+    from seaweedfs_tpu.utils import config
+
+    monkeypatch.setattr(config, "SEARCH_PATHS", [str(tmp_path)])
+    rpc.reset_channels()
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    try:
+        stub = rpc.master_stub(rpc.grpc_address(master.address))
+        assert stub.Ping(master_pb2.PingRequest(),
+                         timeout=10).start_time_ns > 0
+    finally:
+        master.stop()
+        rpc.reset_channels()
