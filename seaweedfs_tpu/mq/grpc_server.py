@@ -92,11 +92,13 @@ class MqGrpcServer:
     def __init__(self, broker, *, port: int, address: str = ""):
         self.port = port
         self._server = rpc.new_server()
-        rpc.add_servicer(self._server, rpc.MQ_SERVICE,
-                         MqGrpcServicer(broker,
-                                        address or f"localhost:{port}"),
-                         component="msg_broker")
-        rpc.serve_port(self._server, f"[::]:{port}", "msg_broker")
+        creds = rpc.add_servicer(self._server, rpc.MQ_SERVICE,
+                                 MqGrpcServicer(
+                                     broker,
+                                     address or f"localhost:{port}"),
+                                 component="msg_broker")
+        rpc.serve_port(self._server, f"[::]:{port}", "msg_broker",
+                       creds=creds)
 
     def start(self) -> None:
         self._server.start()

@@ -198,22 +198,29 @@ class Stub:
 
 
 def add_servicer(server: grpc.Server, service, servicer,
-                 component: str | None = None) -> None:
+                 component: str | None = None):
     """Register `servicer` (an object with one method per RPC name) for the
     given descriptor on a grpc.Server. With `component`, and ONLY when
     that component's server TLS actually loads (the reference returns
     creds+authenticator together from LoadServerTLS and neither on
     failure, tls.go:26-87), every handler first validates the mTLS
     peer's common name against [grpc.<component>].allowed_commonNames /
-    grpc.allowed_wildcard_domain (tls.go:64-76)."""
+    grpc.allowed_wildcard_domain (tls.go:64-76).
+
+    -> the loaded grpc.ServerCredentials (or None). Pass them to
+    serve_port so the port binds from the SAME config read that armed
+    the authenticator — re-reading there would open a drift window
+    (cert rotation mid-start = CN checks active on a plaintext port)."""
     auth = None
+    creds = None
     if component is not None:
         from ..security.tls import (
             load_authenticator,
             load_server_credentials,
         )
 
-        if load_server_credentials(component) is not None:
+        creds = load_server_credentials(component)
+        if creds is not None:
             auth = load_authenticator(component)
     full_name, methods = service
     handlers = {}
@@ -248,6 +255,7 @@ def add_servicer(server: grpc.Server, service, servicer,
     server.add_generic_rpc_handlers(
         (grpc.method_handlers_generic_handler(full_name, handlers),)
     )
+    return creds
 
 
 def new_server(max_workers: int = 32) -> grpc.Server:
@@ -279,7 +287,8 @@ def _client_credentials_locked() -> grpc.ChannelCredentials | None:
     if not _client_creds_loaded:
         from ..security.tls import load_client_credentials
 
-        for component in ("client", "master", "volume", "filer"):
+        for component in ("client", "master", "volume", "filer",
+                          "msg_broker"):
             _client_creds = load_client_credentials(component)
             if _client_creds is not None:
                 break
@@ -315,13 +324,20 @@ def reset_channels() -> None:
         _client_creds_loaded = False
 
 
-def serve_port(server: grpc.Server, address: str, component: str) -> int:
+_UNSET = object()
+
+
+def serve_port(server: grpc.Server, address: str, component: str,
+               creds=_UNSET) -> int:
     """Bind a server port with [grpc.<component>] mutual TLS when
     security.toml configures it, plaintext otherwise (the LoadServerTLS
-    dispatch every reference server runs at startup)."""
-    from ..security.tls import load_server_credentials
+    dispatch every reference server runs at startup). Pass the creds
+    add_servicer returned to bind from the same config read; omitted,
+    they load fresh here."""
+    if creds is _UNSET:
+        from ..security.tls import load_server_credentials
 
-    creds = load_server_credentials(component)
+        creds = load_server_credentials(component)
     if creds is not None:
         return server.add_secure_port(address, creds)
     return server.add_insecure_port(address)

@@ -23,7 +23,6 @@ story is gRPC mTLS, which this module covers end to end).
 
 from __future__ import annotations
 
-import fnmatch
 
 import grpc
 
@@ -98,8 +97,10 @@ class CommonNameAuthenticator:
             return True
         if common_name in self.names:
             return True
-        return bool(self.wildcard) and fnmatch.fnmatch(
-            common_name, "*" + self.wildcard)
+        # plain suffix match, exactly the reference (tls.go
+        # Authenticate: strings.HasSuffix) — NOT a glob, so metachars
+        # in the configured domain stay literal
+        return bool(self.wildcard) and common_name.endswith(self.wildcard)
 
     def check_context(self, context) -> None:
         """Abort the RPC unless the peer cert's CN is allowed."""

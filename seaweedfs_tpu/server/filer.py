@@ -188,9 +188,10 @@ class FilerServer:
 
     def start(self) -> None:
         self._grpc_server = rpc.new_server()
-        rpc.add_servicer(self._grpc_server, rpc.FILER_SERVICE,
-                         FilerGrpc(self), component="filer")
-        rpc.serve_port(self._grpc_server, f"[::]:{self.grpc_port}", "filer")
+        creds = rpc.add_servicer(self._grpc_server, rpc.FILER_SERVICE,
+                                 FilerGrpc(self), component="filer")
+        rpc.serve_port(self._grpc_server, f"[::]:{self.grpc_port}",
+                       "filer", creds=creds)
         self._grpc_server.start()
         http_port = self.port
         if self._vol_plane is not None:

@@ -144,9 +144,10 @@ class MasterServer:
 
     def start(self, *, vacuum_interval: float = 60.0) -> None:
         self._grpc_server = rpc.new_server()
-        rpc.add_servicer(self._grpc_server, rpc.MASTER_SERVICE,
-                         MasterGrpc(self), component="master")
-        rpc.serve_port(self._grpc_server, f"[::]:{self.grpc_port}", "master")
+        creds = rpc.add_servicer(self._grpc_server, rpc.MASTER_SERVICE,
+                                 MasterGrpc(self), component="master")
+        rpc.serve_port(self._grpc_server, f"[::]:{self.grpc_port}",
+                       "master", creds=creds)
         self._grpc_server.start()
         self._http_server = TunedThreadingHTTPServer(
             ("", self.port), _make_http_handler(self)

@@ -124,9 +124,10 @@ class VolumeServer:
 
     def start(self) -> None:
         self._grpc_server = rpc.new_server()
-        rpc.add_servicer(self._grpc_server, rpc.VOLUME_SERVICE,
-                         VolumeGrpc(self), component="volume")
-        rpc.serve_port(self._grpc_server, f"[::]:{self.grpc_port}", "volume")
+        creds = rpc.add_servicer(self._grpc_server, rpc.VOLUME_SERVICE,
+                                 VolumeGrpc(self), component="volume")
+        rpc.serve_port(self._grpc_server, f"[::]:{self.grpc_port}",
+                       "volume", creds=creds)
         self._grpc_server.start()
         handler = _make_http_handler(self)
         try:
