@@ -218,10 +218,12 @@ def add_servicer(server: grpc.Server, service, servicer,
             load_authenticator,
             load_server_credentials,
         )
+        from ..utils.config import load_config
 
-        creds = load_server_credentials(component)
+        conf = load_config("security")  # ONE read feeds both
+        creds = load_server_credentials(component, conf)
         if creds is not None:
-            auth = load_authenticator(component)
+            auth = load_authenticator(component, conf)
     full_name, methods = service
     handlers = {}
 

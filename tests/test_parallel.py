@@ -143,3 +143,74 @@ def test_mesh_kernels_bit_identical():
     np.testing.assert_array_equal(out["xor"][0], out["bits"][0])
     for i in (2, 7, 13):
         np.testing.assert_array_equal(out["xor"][1][i], out["bits"][1][i])
+
+
+def test_generate_ec_files_mesh_production_ratio_boundary(tmp_path):
+    """Mesh-sharded encode across a REAL large-row -> small-row boundary
+    at the production 1024:1 block ratio (1GB:1MB scaled to 1MB:1KB —
+    the full-constant run needs ~10GB of GF math, ~10 min on this
+    1-core box; the boundary/row arithmetic under test is ratio- and
+    row-count-exact either way). Payload = 1 full large row + 3 small
+    rows + a partial block, so the schedule emits every row kind."""
+    import __graft_entry__ as ge
+
+    from seaweedfs_tpu.models.coder import new_coder
+    from seaweedfs_tpu.storage.ec_locate import Geometry
+
+    geo = Geometry(large_block=1 << 20, small_block=1 << 10)
+    k = geo.data_shards
+    payload = (geo.large_block * k          # one full large row
+               + geo.small_block * k * 3    # three full small rows
+               + 700)                       # partial trailing block
+    n_large, n_small = geo.row_counts(payload)
+    assert (n_large, n_small) == (1, 4), "payload must cross the boundary"
+    ge.ec_file_pipeline_oracle(str(tmp_path), new_coder(10, 4),
+                               batch_size=1 << 18, drop=(1, 5, 11),
+                               payload_len=payload, seed=31, geo=geo)
+
+
+def test_geometry_arithmetic_at_true_production_constants():
+    """Row/locate arithmetic at the UNSCALED 1GB/1MB constants with
+    multi-GB offsets: every byte of a 22GB+ volume must map to exactly
+    one (shard, offset) and the mapping must be monotone within a shard
+    — the class of bug (32-bit truncation, row mis-count) that shrunken
+    geometries can't surface."""
+    from seaweedfs_tpu.storage.ec_locate import (
+        LARGE_BLOCK_SIZE,
+        SMALL_BLOCK_SIZE,
+        Geometry,
+        locate_data,
+    )
+
+    geo = Geometry()
+    assert geo.large_block == LARGE_BLOCK_SIZE == 1 << 30
+    assert geo.small_block == SMALL_BLOCK_SIZE == 1 << 20
+    k = geo.data_shards
+    # 2 full large rows + 5 small rows + partial: 21.48GB
+    dat_size = 2 * k * geo.large_block + 5 * k * geo.small_block + 12_345
+    assert geo.row_counts(dat_size) == (2, 6)
+    assert geo.shard_size(dat_size) == \
+        2 * geo.large_block + 6 * geo.small_block
+    # probe offsets all around the large->small boundary and the tail
+    boundary = 2 * k * geo.large_block
+    probes = [0, geo.large_block - 1, geo.large_block,
+              boundary - 1, boundary, boundary + 1,
+              boundary + k * geo.small_block,     # 2nd small row
+              dat_size - 12_345, dat_size - 1]
+    for off in probes:
+        ivs = locate_data(geo, dat_size, off, 1)
+        assert len(ivs) == 1, off
+        sid, soff = ivs[0].to_shard_id_and_offset(geo)
+        assert 0 <= sid < k
+        assert 0 <= soff < geo.shard_size(dat_size), (off, soff)
+    # a read spanning the boundary covers every byte exactly once
+    span = locate_data(geo, dat_size, boundary - 4096, 8192)
+    assert sum(iv.size for iv in span) == 8192
+    assert any(iv.is_large_block for iv in span)
+    assert any(not iv.is_large_block for iv in span)
+    # small-row shard offsets land past 2^31: the mapping must stay
+    # 64-bit exact (2 large blocks = 2^31, plus the small-row tail)
+    iv = locate_data(geo, dat_size, dat_size - 1, 1)[0]
+    _, soff = iv.to_shard_id_and_offset(geo)
+    assert soff > 2**31 - 1
+    assert soff < geo.shard_size(dat_size)
