@@ -171,8 +171,10 @@ class FakeMySqlServer:
             # real MySQL salts are NUL-free printable bytes; a NUL here
             # would be rstripped by clients and break the scramble
             salt = bytes(33 + b % 94 for b in os.urandom(20))
+            # fixed connection id: pid-derived ids would make the
+            # wire-golden traces (tests/goldens/) process-dependent
             greeting = (bytes([10]) + b"8.0.fake\0"
-                        + struct.pack("<I", os.getpid() & 0xffffffff)
+                        + struct.pack("<I", 7431)
                         + salt[:8] + b"\0"
                         + struct.pack("<H", 0xffff) + bytes([33])
                         + struct.pack("<H", 2) + struct.pack("<H", 0x000f)

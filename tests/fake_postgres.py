@@ -95,7 +95,9 @@ class FakePostgresServer:
                          ("client_encoding", "UTF8")):
                 conn.sendall(self._msg(
                     b"S", k.encode() + b"\0" + v.encode() + b"\0"))
-            conn.sendall(self._msg(b"K", struct.pack(">II", os.getpid(),
+            # fixed backend pid: a real pid would make the wire-golden
+            # traces (tests/goldens/) process-dependent
+            conn.sendall(self._msg(b"K", struct.pack(">II", 7431,
                                                      0x5eed)))
             conn.sendall(self._msg(b"Z", b"I"))
             self._extended_loop(conn)

@@ -1,0 +1,225 @@
+"""Protocol-trace goldens for the wire-protocol filer stores.
+
+The store clients and their in-repo fake servers share one author, so a
+framing bug could in principle hide by appearing on both sides. These
+goldens pin the conversation itself: one canonical session per store —
+connect, auth, insert, find, update, list, kv put/get, delete, subtree
+delete, close — recorded byte-for-byte through a TCP proxy with all
+nondeterminism pinned (os.urandom replaced by a deterministic stream;
+entries carry fixed timestamps; request ids are per-connection
+counters). `tools/record_goldens.py` writes tests/goldens/<store>.trace
+and tests/test_wire_goldens.py re-runs the identical session and
+asserts the conversation still matches — any change to either the
+client's emitted bytes or the fake's replies fails until the golden is
+consciously regenerated (and reviewed as a wire-format change).
+
+Trace format: one line per direction-switch,
+``C <hex>`` (client->server) / ``S <hex>`` (server->client), with
+``#`` comment lines for annotation.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import socket
+import threading
+from contextlib import contextmanager
+
+GOLDEN_DIR = os.path.join(os.path.dirname(__file__), "goldens")
+
+
+# -- determinism -----------------------------------------------------------
+
+class _DeterministicRandom:
+    """sha256-counter byte stream standing in for os.urandom."""
+
+    def __init__(self, seed: bytes = b"seaweedfs-golden"):
+        self.seed = seed
+        self.n = 0
+
+    def __call__(self, size: int) -> bytes:
+        out = b""
+        while len(out) < size:
+            out += hashlib.sha256(self.seed
+                                  + self.n.to_bytes(8, "big")).digest()
+            self.n += 1
+        return out[:size]
+
+
+@contextmanager
+def pinned_entropy():
+    real = os.urandom
+    os.urandom = _DeterministicRandom()
+    try:
+        yield
+    finally:
+        os.urandom = real
+
+
+# -- recording proxy -------------------------------------------------------
+
+class RecordingProxy:
+    """TCP proxy in front of a fake server, logging both directions as
+    a merged (direction, bytes) conversation."""
+
+    def __init__(self, upstream_port: int):
+        self.upstream_port = upstream_port
+        self.conversation: list[tuple[str, bytes]] = []
+        self.pumps: list[threading.Thread] = []
+        self._mu = threading.Lock()
+        self._listen = socket.socket()
+        self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listen.bind(("localhost", 0))
+        self._listen.listen(4)
+        self.port = self._listen.getsockname()[1]
+        self._stop = threading.Event()
+        threading.Thread(target=self._accept, daemon=True).start()
+
+    def _record(self, direction: str, data: bytes) -> None:
+        with self._mu:
+            if self.conversation and \
+                    self.conversation[-1][0] == direction:
+                d, prev = self.conversation[-1]
+                self.conversation[-1] = (d, prev + data)
+            else:
+                self.conversation.append((direction, data))
+
+    def _accept(self) -> None:
+        while not self._stop.is_set():
+            try:
+                client, _ = self._listen.accept()
+            except OSError:
+                return
+            upstream = socket.create_connection(
+                ("localhost", self.upstream_port))
+
+            def pump(src, dst, direction):
+                try:
+                    while True:
+                        b = src.recv(65536)
+                        if not b:
+                            break
+                        self._record(direction, b)
+                        dst.sendall(b)
+                except OSError:
+                    pass
+                finally:
+                    try:
+                        dst.shutdown(socket.SHUT_WR)
+                    except OSError:
+                        pass
+
+            for args in ((client, upstream, "C"), (upstream, client, "S")):
+                t = threading.Thread(target=pump, args=args, daemon=True)
+                t.start()
+                self.pumps.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        try:
+            self._listen.close()
+        except OSError:
+            pass
+
+
+# -- canonical session -----------------------------------------------------
+
+def golden_cases():
+    """(store kind, fake-server factory, store kwargs) for every golden
+    — the ONE definition both tools/record_goldens.py and
+    tests/test_wire_goldens.py run, so recorder and replayer provably
+    exercise the identical session (incl. auth mode/credentials)."""
+    from tests.fake_cassandra import FakeCassandraServer
+    from tests.fake_mongo import FakeMongoServer
+    from tests.fake_mysql import FakeMySqlServer
+    from tests.fake_postgres import FakePostgresServer
+
+    return [
+        ("postgres",
+         lambda: FakePostgresServer(auth="scram", user="weed",
+                                    password="golden"),
+         dict(user="weed", password="golden")),
+        ("mysql",
+         lambda: FakeMySqlServer(user="weed", password="golden"),
+         dict(user="weed", password="golden")),
+        ("mongodb", FakeMongoServer, {}),
+        ("cassandra", FakeCassandraServer, {}),
+    ]
+
+def canonical_session(store) -> None:
+    """The one scripted op sequence every golden records."""
+    from seaweedfs_tpu.filer import Attr, Entry
+
+    def entry(path, mtime, content=b""):
+        return Entry(full_path=path, content=content,
+                     attr=Attr(mtime=mtime, crtime=mtime, mode=0o644,
+                               uid=1000, gid=1000))
+
+    store.insert_entry(entry("/g/a.txt", 1_700_000_001, b"golden-a"))
+    store.insert_entry(entry("/g/b.txt", 1_700_000_002, b"golden-b"))
+    assert store.find_entry("/g/a.txt").content == b"golden-a"
+    assert store.find_entry("/g/missing") is None
+    store.insert_entry(entry("/g/a.txt", 1_700_000_009, b"golden-a2"))
+    names = [e.name for e in
+             store.list_directory_entries("/g", limit=16)]
+    assert names == ["a.txt", "b.txt"], names
+    store.kv_put(b"gkey", bytes(range(32)))
+    assert store.kv_get(b"gkey") == bytes(range(32))
+    assert store.kv_get(b"absent") is None
+    store.delete_entry("/g/b.txt")
+    store.delete_folder_children("/g")
+    assert store.find_entry("/g/a.txt") is None
+
+
+def run_session(kind: str, fake_port: int, **store_kwargs
+                ) -> list[tuple[str, bytes]]:
+    """Run the canonical session for `kind` through a recording proxy
+    with pinned entropy -> the merged conversation."""
+    from seaweedfs_tpu.filer.filerstore import get_store
+
+    proxy = RecordingProxy(fake_port)
+    try:
+        with pinned_entropy():
+            store = get_store(kind, host="localhost", port=proxy.port,
+                              **store_kwargs)
+            canonical_session(store)
+            store.close()
+        # drain: the pump threads exit deterministically on EOF after
+        # store.close() (pg Terminate / mysql COM_QUIT are part of the
+        # trace) — join them instead of polling a quiet window, which
+        # could truncate trailing bytes on a loaded machine
+        for t in list(proxy.pumps):
+            t.join(timeout=10)
+        return list(proxy.conversation)
+    finally:
+        proxy.stop()
+
+
+# -- trace file io ---------------------------------------------------------
+
+def save_trace(name: str, conversation: list[tuple[str, bytes]],
+               header: str = "") -> str:
+    os.makedirs(GOLDEN_DIR, exist_ok=True)
+    path = os.path.join(GOLDEN_DIR, f"{name}.trace")
+    with open(path, "w") as f:
+        f.write(f"# {name} wire-protocol golden (tests/wire_goldens.py)\n")
+        if header:
+            for line in header.splitlines():
+                f.write(f"# {line}\n")
+        for d, b in conversation:
+            f.write(f"{d} {b.hex()}\n")
+    return path
+
+
+def load_trace(name: str) -> list[tuple[str, bytes]]:
+    path = os.path.join(GOLDEN_DIR, f"{name}.trace")
+    out: list[tuple[str, bytes]] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line or line.startswith("#"):
+                continue
+            d, hexs = line.split(" ", 1)
+            out.append((d, bytes.fromhex(hexs)))
+    return out

@@ -1,0 +1,51 @@
+"""Record tests/goldens/<store>.trace protocol goldens.
+
+Run from the repo root after a CONSCIOUS wire-format change:
+
+    python tools/record_goldens.py
+
+then review the trace diffs like any other wire-contract change. The
+same canonical session (tests/wire_goldens.py) replays in
+tests/test_wire_goldens.py and must keep producing these exact bytes.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from tests import wire_goldens as wg  # noqa: E402
+
+HEADERS = {
+    "postgres": "v3 extended query protocol, SCRAM-SHA-256 auth "
+                "(stores/pg_wire.py vs tests/fake_postgres.py)",
+    "mysql": "binary protocol, native-password handshake + prepared "
+             "statements (stores/mysql_wire.py vs tests/fake_mysql.py)",
+    "mongodb": "OP_MSG/BSON (stores/mongo_wire.py vs "
+               "tests/fake_mongo.py)",
+    "cassandra": "CQL v4 frames (stores/cql_wire.py vs "
+                 "tests/fake_cassandra.py)",
+}
+
+
+def record_all() -> None:
+    for name, mk, kwargs in wg.golden_cases():
+        srv = mk()
+        try:
+            convo = wg.run_session(name, srv.port, **kwargs)
+        finally:
+            srv.stop()
+        path = wg.save_trace(name, convo, HEADERS[name])
+        total = sum(len(b) for _, b in convo)
+        print(f"{name}: {len(convo)} direction-switches, "
+              f"{total} bytes -> {path}")
+
+
+if __name__ == "__main__":
+    record_all()
