@@ -89,6 +89,11 @@ enabled = false
 # table once with: create 'seaweedfs', 'meta', 'kv'
 zkquorum = "localhost:9090"
 table = "seaweedfs"
+
+[ydb]
+enabled = false
+dsn = "grpc://localhost:2136/local"
+prefix = "seaweedfs"
 """,
     "master": """\
 # master.toml

@@ -62,6 +62,7 @@ def get_store(name: str, **kwargs) -> FilerStore:
         sqlite,
         hbase_store,
         tikv_store,
+        ydb_store,
     )
 
     cls = _REGISTRY.get(name)
@@ -87,6 +88,7 @@ def available_stores() -> list[str]:
         sqlite,
         hbase_store,
         tikv_store,
+        ydb_store,
     )
 
     return sorted(_REGISTRY)

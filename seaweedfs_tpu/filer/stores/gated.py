@@ -28,7 +28,9 @@ _GATED = {
     # etcdserverpb.KV gRPC API via the repo pb stack
     # tikv is REAL now: stores/tikv_store.py drives the RawKV
     # gRPC API with pdpb region routing via the repo pb stack
-    "ydb": "ydb",
+    # ydb is REAL now: stores/ydb_store.py drives the
+    # Ydb.Table.V1.TableService gRPC API (sessions, Operation/Any
+    # envelope, typed YQL parameters) via the repo pb stack
     # hbase is REAL now: stores/hbase_store.py drives the Thrift2
     # gateway (THBaseService) via stores/thrift_wire.py
     # arangodb is REAL now: stores/arango_wire.py drives

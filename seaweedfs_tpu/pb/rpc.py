@@ -164,6 +164,24 @@ def tikv_service():
     ])
 
 
+def ydb_table_service():
+    """Ydb.Table.V1.TableService subset (proto/ydb_table_v1.proto) —
+    real package/service names, so method paths and Any type_urls
+    match an actual YDB endpoint."""
+    from . import ydb_table_pb2 as Y
+
+    return ("Ydb.Table.V1.TableService", [
+        _m("CreateSession", Y.CreateSessionRequest,
+           Y.CreateSessionResponse),
+        _m("DeleteSession", Y.DeleteSessionRequest,
+           Y.DeleteSessionResponse),
+        _m("ExecuteDataQuery", Y.ExecuteDataQueryRequest,
+           Y.ExecuteDataQueryResponse),
+        _m("ExecuteSchemeQuery", Y.ExecuteSchemeQueryRequest,
+           Y.ExecuteSchemeQueryResponse),
+    ])
+
+
 def etcd_kv_service():
     """etcdserverpb.KV subset (proto/etcd_kv.proto) — names match the
     real etcd v3 API so the stub talks to an actual etcd unchanged.

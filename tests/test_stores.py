@@ -97,11 +97,11 @@ def test_gated_stores_fail_with_guidance():
     # tikv and hbase went live in round 5; the remaining gated kinds
     # still register and fail at construction with clear guidance
     avail = available_stores()
-    assert "tikv" in avail and "hbase" in avail
+    assert "tikv" in avail and "hbase" in avail and "ydb" in avail
     with pytest.raises(RuntimeError, match="client library"):
         get_store("rocksdb")
-    with pytest.raises(RuntimeError, match="ydb"):
-        get_store("ydb")
+    with pytest.raises(RuntimeError, match="redis-py"):
+        get_store("redis_lua")
 
 
 # -- redis store (real RESP wire against an in-process server) -------------
@@ -1665,6 +1665,103 @@ def test_hbase_store_backs_live_filer(hbase_server, tmp_path):
         g = requests.get(f"{base}/hb/x.bin", timeout=30)
         assert g.status_code == 200 and g.content == b"hbase-backed"
         assert [e.name for e in fs.filer.list_entries("/hb")] == ["x.bin"]
+    finally:
+        fs.stop()
+        vsrv.stop()
+        master.stop()
+        rpc.reset_channels()
+
+
+# -- ydb store (Table-service gRPC against an in-process server) -----------
+
+@pytest.fixture
+def ydb_server():
+    from tests.fake_ydb import FakeYdbServer
+
+    srv = FakeYdbServer()
+    yield srv
+    srv.stop()
+
+
+def test_ydb_store_crud_listing_and_kv(ydb_server):
+    """ydb_store.go's (dir_hash, name) filemeta layout over the real
+    Ydb.Table.V1.TableService wire — sessions, Operation/Any envelope,
+    typed YQL parameters validated by the fake against the declared
+    types, paged truncated listings."""
+    store = get_store("ydb", dsn=f"grpc://localhost:{ydb_server.port}/local")
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/a/b/c.txt", attr=Attr(mtime=11)))
+    for i in range(30):
+        f.create_entry(Entry(full_path=f"/a/b/f{i:02d}"))
+    assert f.find_entry("/a/b/c.txt").attr.mtime == 11
+    names = [e.name for e in
+             store.list_directory_entries("/a/b", limit=1000)]
+    assert names == ["c.txt"] + [f"f{i:02d}" for i in range(30)]
+    assert [e.name for e in store.list_directory_entries(
+        "/a/b", "f05", include_start=False, limit=3)] == \
+        ["f06", "f07", "f08"]
+    assert [e.name for e in store.list_directory_entries(
+        "/a/b", "f05", include_start=True, limit=2)] == ["f05", "f06"]
+    assert [e.name for e in store.list_directory_entries(
+        "/a/b", prefix="f1", limit=1000)] == \
+        [f"f1{i}" for i in range(10)]
+    f.delete_entry("/a/b/f00")
+    assert store.find_entry("/a/b/f00") is None
+    f.create_entry(Entry(full_path="/a/b/c.txt", attr=Attr(mtime=99)))
+    assert f.find_entry("/a/b/c.txt").attr.mtime == 99
+    gnarly = bytes(range(256))
+    store.kv_put(b"kv\x00bin", gnarly)
+    assert store.kv_get(b"kv\x00bin") == gnarly
+    assert store.kv_get(b"absent") is None
+    # short kv keys are zero-padded to the 8-byte dir_hash head
+    store.kv_put(b"k", b"short")
+    assert store.kv_get(b"k") == b"short"
+    store.close()
+
+
+def test_ydb_store_subtree_delete_and_session_recovery(ydb_server):
+    store = get_store("ydb", dsn=f"grpc://localhost:{ydb_server.port}/local")
+    f = Filer(store)
+    for p in ("/t/x/1", "/t/x/sub/2", "/t/x/sub/deep/3", "/t/keep"):
+        f.create_entry(Entry(full_path=p))
+    store.delete_folder_children("/t/x")
+    for p in ("/t/x/1", "/t/x/sub/2", "/t/x/sub/deep/3"):
+        assert store.find_entry(p) is None, p
+    assert store.find_entry("/t/keep") is not None
+    # server-side session loss: the next op must transparently
+    # recreate the session (the sdk's retryer behavior, ydb_store.go
+    # rides DB.Table().Do)
+    ydb_server.expire_sessions()
+    assert store.find_entry("/t/keep") is not None
+    store.close()
+
+
+def test_ydb_store_backs_live_filer(ydb_server, tmp_path):
+    """A full filer server (HTTP data path) on the ydb store."""
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "ydbvol")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port())
+    vsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    fs = FilerServer(ip="localhost", port=_free_port(),
+                     master=f"localhost:{mport}", store="memory")
+    fs.filer = Filer(get_store(
+        "ydb", dsn=f"grpc://localhost:{ydb_server.port}/local"))
+    fs.start()
+    try:
+        base = f"http://{fs.address}"
+        r = requests.put(f"{base}/yd/x.bin", data=b"ydb-backed",
+                         timeout=30)
+        assert r.status_code in (200, 201)
+        g = requests.get(f"{base}/yd/x.bin", timeout=30)
+        assert g.status_code == 200 and g.content == b"ydb-backed"
+        assert [e.name for e in fs.filer.list_entries("/yd")] == ["x.bin"]
     finally:
         fs.stop()
         vsrv.stop()
