@@ -94,6 +94,12 @@ table = "seaweedfs"
 enabled = false
 dsn = "grpc://localhost:2136/local"
 prefix = "seaweedfs"
+
+[redis_lua]
+enabled = false
+address = "localhost:6379"
+password = ""
+database = 0
 """,
     "master": """\
 # master.toml

@@ -59,6 +59,7 @@ def get_store(name: str, **kwargs) -> FilerStore:
         mongo_wire,
         redis,
         redis3,
+        redis_lua,
         sqlite,
         hbase_store,
         tikv_store,
@@ -85,6 +86,7 @@ def available_stores() -> list[str]:
         mongo_wire,
         redis,
         redis3,
+        redis_lua,
         sqlite,
         hbase_store,
         tikv_store,

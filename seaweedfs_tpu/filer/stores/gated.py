@@ -16,7 +16,8 @@ _GATED = {
     # redis/redis2 are REAL now: stores/redis.py speaks RESP itself;
     # redis3 likewise via stores/redis3.py (segmented bounded-key
     # directory listings)
-    "redis_lua": "redis-py",
+    # redis_lua is REAL now: stores/redis_lua.py runs the three
+    # mutations as server-side Lua via EVALSHA/EVAL over the RESP wire
     # postgres/postgres2 are REAL now: stores/pg_wire.py speaks the v3
     # wire protocol itself (extended query + SCRAM auth); mysql/mysql2
     # likewise via stores/mysql_wire.py (binary prepared statements)

@@ -107,7 +107,15 @@ CREATE TABLE filemeta (
     meta String,
     expire_at Uint32,
     PRIMARY KEY (dir_hash, name)
+)
+WITH (
+    TTL = Interval("PT0S") ON expire_at AS SECONDS
 );"""
+# The WITH TTL clause is createTableOptions' TimeToLiveSettings
+# (ydb_types.go:46: expire_at, unit seconds, value-since-epoch) in YQL
+# form — a real server purges rows once expire_at passes. NB the
+# reference writes entry.TtlSec (a DURATION) into this epoch-seconds
+# column; the value layout is kept verbatim for data compatibility.
 
 
 class YdbError(IOError):
@@ -315,6 +323,20 @@ class YdbStore:
             "$name": _utf8(n),
         })
 
+    def _all_subdir_names(self, d: str) -> list[str]:
+        """Every subdirectory child of `d`, paged to exhaustion — a
+        fixed listing cap would strand subtrees past it as orphans once
+        the parent rows are deleted."""
+        out: list[str] = []
+        start, inclusive = "", True
+        while True:
+            page = list(self.list_directory_entries(
+                d, start, include_start=inclusive, limit=4096))
+            out.extend(e.name for e in page if e.is_directory)
+            if len(page) < 4096:
+                return out
+            start, inclusive = page[-1].name, False
+
     def delete_folder_children(self, full_path: str) -> None:
         """One dir_hash bucket per call in the reference; this repo's
         store contract is whole-subtree, so recurse through listings
@@ -322,9 +344,7 @@ class YdbStore:
         stack = [full_path.rstrip("/") or "/"]
         while stack:
             d = stack.pop()
-            subdirs = [e.name for e in
-                       self.list_directory_entries(d, limit=1_000_000)
-                       if e.is_directory]
+            subdirs = self._all_subdir_names(d)
             self._execute(
                 _DELETE_FOLDER_CHILDREN.format(p=self._prefix), {
                     "$dir_hash": _int64(hash_string_to_long(d)),
@@ -355,6 +375,10 @@ class YdbStore:
                 name = row.items[0].text_value
                 blob = row.items[1].bytes_value
                 start = name
+                if prefix and not name.startswith(prefix):
+                    # YQL LIKE treats '_'/'%' as wildcards; the siblings
+                    # all re-verify the literal prefix client-side
+                    continue
                 yield Entry.from_pb(base,
                                     filer_pb2.Entry.FromString(blob))
                 emitted += 1

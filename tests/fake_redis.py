@@ -7,6 +7,7 @@ an actual Redis unchanged."""
 from __future__ import annotations
 
 import bisect
+import hashlib
 import socket
 import threading
 
@@ -16,6 +17,7 @@ class FakeRedisServer:
         self.password = password
         self.kv: dict[bytes, bytes] = {}
         self.zsets: dict[bytes, list[bytes]] = {}  # lex-sorted members
+        self.scripts: dict[bytes, bytes] = {}  # sha1 -> script text
         self._lock = threading.Lock()
         self._listen = socket.socket()
         self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -119,10 +121,80 @@ class FakeRedisServer:
         with self._lock:
             return self._dispatch_locked(cmd, a)
 
+    # -- lua scripting (EVAL/EVALSHA/SCRIPT LOAD) --------------------------
+    #
+    # No Lua interpreter lives here; instead the fake executes a tiny
+    # registry of SUPPORTED script semantics, keyed by the sha1 of the
+    # script text a client sends (exactly how a real server addresses
+    # scripts). EVAL registers the text and runs it; EVALSHA of an
+    # unknown sha answers NOSCRIPT like a real server, which is the
+    # fallback path go-redis-style clients exercise. The effects are
+    # implemented natively under the SERVER lock — the atomicity Lua
+    # gives on a real redis. Arity (numkeys, argv) is validated.
+
+    def _lua_call(self, script: bytes, keys: list[bytes],
+                  argv: list[bytes]) -> bytes:
+        text = script.decode("utf-8", "replace")
+        has = lambda *words: all(w in text for w in words)  # noqa: E731
+        if has("SET", "ZADD", "EX"):        # insert-entry shape
+            if len(keys) != 2 or len(argv) != 3:
+                return b"-ERR wrong arity for insert script\r\n"
+            path, dirset = keys
+            blob, ttl, name = argv
+            self.kv[path] = blob  # EX ttl: expiry not modeled here
+            if name:
+                members = self.zsets.setdefault(dirset, [])
+                i = bisect.bisect_left(members, name)
+                if i >= len(members) or members[i] != name:
+                    members.insert(i, name)
+            return b":0\r\n"
+        if has("DEL", "ZREM"):              # delete-entry shape
+            if len(keys) != 3 or len(argv) != 1:
+                return b"-ERR wrong arity for delete script\r\n"
+            path, pathset, dirset = keys
+            (name,) = argv
+            self.kv.pop(path, None)
+            self.zsets.pop(pathset, None)
+            if name:
+                members = self.zsets.get(dirset, [])
+                i = bisect.bisect_left(members, name)
+                if i < len(members) and members[i] == name:
+                    members.pop(i)
+            return b":0\r\n"
+        if has("ZRANGE", "ipairs"):         # delete-children shape
+            if len(keys) != 2 or argv:
+                return b"-ERR wrong arity for delete-children script\r\n"
+            d, dirset = keys
+            names = list(self.zsets.get(dirset, []))
+            for name in names:
+                # child LIST keys stay: the client recurses per level
+                self.kv.pop(d + b"/" + name, None)
+            self.zsets.pop(dirset, None)
+            return b":%d\r\n" % len(names)
+        return b"-ERR unsupported script\r\n"
+
     def _dispatch_locked(self, cmd: str, a: list[bytes]) -> bytes:
         if True:
             if cmd == "PING":
                 return b"+PONG\r\n"
+            if cmd == "SCRIPT" and len(a) >= 2 \
+                    and a[0].upper() == b"LOAD":
+                sha = hashlib.sha1(a[1]).hexdigest().encode()
+                self.scripts[sha] = a[1]
+                return b"$%d\r\n%s\r\n" % (len(sha), sha)
+            if cmd in ("EVAL", "EVALSHA") and len(a) >= 2:
+                if cmd == "EVAL":
+                    script = a[0]
+                    self.scripts[
+                        hashlib.sha1(script).hexdigest().encode()] = script
+                else:
+                    script = self.scripts.get(a[0].lower())
+                    if script is None:
+                        return (b"-NOSCRIPT No matching script. "
+                                b"Please use EVAL.\r\n")
+                nkeys = int(a[1])
+                keys, argv = a[2:2 + nkeys], a[2 + nkeys:]
+                return self._lua_call(script, list(keys), list(argv))
             if cmd == "SELECT":
                 return b"+OK\r\n"  # single namespace is fine for tests
             if cmd == "FLUSHDB":

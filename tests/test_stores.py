@@ -97,11 +97,11 @@ def test_gated_stores_fail_with_guidance():
     # tikv and hbase went live in round 5; the remaining gated kinds
     # still register and fail at construction with clear guidance
     avail = available_stores()
-    assert "tikv" in avail and "hbase" in avail and "ydb" in avail
+    for kind in ("tikv", "hbase", "ydb", "redis_lua"):
+        assert kind in avail
+    # rocksdb is the one remaining gate (cgo-gated in the reference too)
     with pytest.raises(RuntimeError, match="client library"):
         get_store("rocksdb")
-    with pytest.raises(RuntimeError, match="redis-py"):
-        get_store("redis_lua")
 
 
 # -- redis store (real RESP wire against an in-process server) -------------
@@ -1767,3 +1767,59 @@ def test_ydb_store_backs_live_filer(ydb_server, tmp_path):
         vsrv.stop()
         master.stop()
         rpc.reset_channels()
+
+
+def test_redis_lua_store_scripts(redis_server):
+    """redis_lua: the three mutations run as server-side scripts over
+    EVALSHA (NOSCRIPT -> EVAL loads, later calls hit the sha cache);
+    layout and blobs stay redis2-compatible (universal_redis_store.go
+    + stored_procedure/*.lua)."""
+    store = get_store("redis_lua", host="localhost",
+                      port=redis_server.port)
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/a/b/c.txt", attr=Attr(mtime=11)))
+    for i in range(10):
+        f.create_entry(Entry(full_path=f"/a/b/f{i}"))
+    assert f.find_entry("/a/b/c.txt").attr.mtime == 11
+    assert [e.name for e in
+            store.list_directory_entries("/a/b", limit=100)] == \
+        ["c.txt"] + [f"f{i}" for i in range(10)]
+    assert [e.name for e in store.list_directory_entries(
+        "/a/b", "f3", include_start=False, limit=3)] == \
+        ["f4", "f5", "f6"]
+    f.delete_entry("/a/b/f0")
+    assert store.find_entry("/a/b/f0") is None
+    # upsert + blob compat with the plain redis store
+    f.create_entry(Entry(full_path="/a/b/c.txt", attr=Attr(mtime=99)))
+    other = get_store("redis", host="localhost", port=redis_server.port)
+    assert Filer(other).find_entry("/a/b/c.txt").attr.mtime == 99
+    other.close()
+    # kv rides the parent's plain SET/GET
+    store.kv_put(b"lk", bytes(range(64)))
+    assert store.kv_get(b"lk") == bytes(range(64))
+    # subtree delete clears entries, sets, and the subdir entries
+    f.create_entry(Entry(full_path="/t/x/sub/deep.txt"))
+    f.create_entry(Entry(full_path="/t/keep"))
+    store.delete_folder_children("/t/x")
+    assert store.find_entry("/t/x/sub/deep.txt") is None
+    assert store.find_entry("/t/keep") is not None
+    assert not any(k.startswith(b"/t/x") and redis_server.zsets[k]
+                   for k in redis_server.zsets)
+    # by now all three scripts were loaded and cached by sha
+    assert len(redis_server.scripts) == 3
+    store.close()
+
+
+def test_redis_lua_evalsha_cache(redis_server):
+    """Second store on the same server: its first mutation EVALSHAs a
+    sha the server already knows — no EVAL needed (go-redis Script.Run
+    semantics over the RESP wire)."""
+    s1 = get_store("redis_lua", host="localhost", port=redis_server.port)
+    Filer(s1).create_entry(Entry(full_path="/warm/a"))
+    s1.close()
+    pre = dict(redis_server.scripts)
+    s2 = get_store("redis_lua", host="localhost", port=redis_server.port)
+    Filer(s2).create_entry(Entry(full_path="/warm/b"))
+    assert redis_server.scripts == pre, "no new script loads expected"
+    assert s2.find_entry("/warm/b") is not None
+    s2.close()
