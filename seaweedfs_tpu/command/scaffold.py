@@ -154,6 +154,11 @@ allowed_commonNames = ""
 cert = ""
 key = ""
 
+[grpc.s3]
+cert = ""
+key = ""
+allowed_commonNames = ""       # gates Configure: it replaces ALL identities
+
 [grpc.client]
 cert = ""
 key = ""

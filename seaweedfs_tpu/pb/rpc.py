@@ -308,7 +308,7 @@ def _client_credentials_locked() -> grpc.ChannelCredentials | None:
         from ..security.tls import load_client_credentials
 
         for component in ("client", "master", "volume", "filer",
-                          "msg_broker"):
+                          "msg_broker", "s3"):
             _client_creds = load_client_credentials(component)
             if _client_creds is not None:
                 break

@@ -69,14 +69,19 @@ class S3Server:
         threading.Thread(target=self._http_server.serve_forever,
                          daemon=True).start()
         # control plane (s3.proto SeaweedS3.Configure; s3api_server.go
-        # registers the same service beside the HTTP handlers). Loopback
-        # only: Configure replaces the whole identity set, and unlike the
-        # reference we have no grpc-TLS gate, so it must not be reachable
-        # off-host.
+        # registers the same service beside the HTTP handlers). With
+        # [grpc.s3] in security.toml the port requires mTLS like the
+        # reference's LoadServerTLS gate and binds all interfaces;
+        # plaintext deployments stay LOOPBACK-ONLY — Configure replaces
+        # the whole identity set and must not be reachable off-host
+        # unauthenticated.
         self._grpc_server = rpc.new_server()
-        rpc.add_servicer(self._grpc_server, rpc.S3_SERVICE, _S3Control(self))
-        self._grpc_server.add_insecure_port(
-            f"127.0.0.1:{rpc.derived_grpc_port(self.port)}")
+        creds = rpc.add_servicer(self._grpc_server, rpc.S3_SERVICE,
+                                 _S3Control(self), component="s3")
+        bind_ip = "[::]" if creds is not None else "127.0.0.1"
+        rpc.serve_port(self._grpc_server,
+                       f"{bind_ip}:{rpc.derived_grpc_port(self.port)}",
+                       "s3", creds=creds)
         self._grpc_server.start()
         glog.info(f"s3 gateway on :{self.port} -> filer {self.filer}")
 

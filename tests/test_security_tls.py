@@ -264,3 +264,62 @@ def test_cn_allowlist_without_certs_does_not_brick_server(tmp_path,
     finally:
         master.stop()
         rpc.reset_channels()
+
+
+def test_shell_and_s3_control_over_mtls(tls_paths, tmp_path):
+    """The admin shell's gRPC commands and the S3 Configure control
+    plane both work over mTLS; the S3 control port rejects plaintext
+    when [grpc.s3] is configured (the reference's LoadServerTLS gate
+    on s3api_server.go's grpc listener)."""
+    import io
+
+    tls_dir, _ = tls_paths
+    # extend the config with an s3 section (same CA/keypair family)
+    with open(tls_dir / "security.toml", "a") as fh:
+        fh.write(f'[grpc.s3]\ncert = "{tls_dir}/client.crt"\n'
+                 f'key = "{tls_dir}/client.key"\n')
+    from seaweedfs_tpu.pb import s3_pb2
+    from seaweedfs_tpu.s3api.server import S3Server
+    from seaweedfs_tpu.shell.env import CommandEnv
+    from seaweedfs_tpu.shell.registry import run_command
+
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    fs = FilerServer(ip="localhost", port=_free_port(),
+                     master=f"localhost:{mport}", store="memory")
+    fs.start()
+    s3 = S3Server(port=_free_port(), filer=fs.address)
+    s3.start()
+    try:
+        # shell gRPC (lock + cluster.raft.ps) rides the mTLS channel
+        env = CommandEnv(master.address)
+        out = io.StringIO()
+        assert run_command(env, "lock", out) == 0
+        assert run_command(env, "cluster.raft.ps", out) == 0
+        assert master.address in out.getvalue()
+        # s3 Configure over mTLS (a real identity json body)
+        stub = rpc.Stub(rpc.cached_channel(
+            f"localhost:{rpc.derived_grpc_port(s3.port)}"),
+            rpc.S3_SERVICE)
+        conf = (b'{"identities":[{"name":"tls-admin","credentials":'
+                b'[{"accessKey":"ak","secretKey":"sk"}],'
+                b'"actions":["Admin"]}]}')
+        stub.Configure(s3_pb2.S3ConfigureRequest(
+            s3_configuration_file_content=conf), timeout=10)
+        assert any(i.name == "tls-admin"
+                   for i in s3.iam.identities.values()), \
+            "Configure did not apply"
+        # plaintext client: refused at the transport
+        plain = grpc.insecure_channel(
+            f"localhost:{rpc.derived_grpc_port(s3.port)}")
+        with pytest.raises(grpc.RpcError) as ei:
+            rpc.Stub(plain, rpc.S3_SERVICE).Configure(
+                s3_pb2.S3ConfigureRequest(), timeout=5)
+        assert ei.value.code() == grpc.StatusCode.UNAVAILABLE
+        plain.close()
+    finally:
+        s3.stop()
+        fs.stop()
+        master.stop()
