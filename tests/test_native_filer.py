@@ -331,7 +331,7 @@ def test_sigkill_mid_hotlog_preserves_acked_puts(tmp_path):
                             stderr=subprocess.DEVNULL)
     acked: list[tuple[str, bytes]] = []
     try:
-        deadline = time.time() + 40
+        deadline = time.time() + 150
         up = False
         while time.time() < deadline and proc.poll() is None:
             try:
@@ -367,7 +367,7 @@ def test_sigkill_mid_hotlog_preserves_acked_puts(tmp_path):
     proc = subprocess.Popen(args, env=env, stdout=subprocess.DEVNULL,
                             stderr=subprocess.DEVNULL)
     try:
-        deadline = time.time() + 40
+        deadline = time.time() + 150
         up = False
         while time.time() < deadline and proc.poll() is None:
             try:
