@@ -149,6 +149,79 @@ class StoreWrapper:
         self.store.close()
 
 
+class RetryingStore:
+    """Transient-fault-absorbing pass-through: every store op retries on
+    retryable transport errors (connection loss to a redis/ydb/mysql
+    backend, gRPC UNAVAILABLE, injected faults) with exponential backoff
+    — behind the per-target circuit breaker in utils.retry so a dead
+    metadata backend sheds load instead of being hammered by every
+    handler thread. Mutations additionally evaluate the
+    `filer.store.mutate` failpoint so the chaos suite can flap the
+    backend without monkeypatching.
+
+    Safe to retry because the 9-op SPI is idempotent end to end: inserts
+    are UPSERTs, deletes tolerate already-gone rows, reads are reads.
+    """
+
+    def __init__(self, store: FilerStore, *, attempts: int = 4,
+                 wait_init: float = 0.05):
+        self.store = store
+        self.name = store.name
+        self.attempts = attempts
+        self.wait_init = wait_init
+
+    def _run(self, op: str, fn, *, mutate: bool = False):
+        from ..utils import failpoint
+        from ..utils.retry import retry
+
+        def attempt():
+            if mutate:
+                failpoint.fail("filer.store.mutate",
+                               ctx=f"{self.name} {op}")
+            return fn()
+
+        return retry(f"store.{self.name}.{op}", attempt,
+                     attempts=self.attempts, wait_init=self.wait_init)
+
+    def insert_entry(self, entry):
+        return self._run("insert", lambda: self.store.insert_entry(entry),
+                         mutate=True)
+
+    def update_entry(self, entry):
+        return self._run("update", lambda: self.store.update_entry(entry),
+                         mutate=True)
+
+    def find_entry(self, full_path):
+        return self._run("find", lambda: self.store.find_entry(full_path))
+
+    def delete_entry(self, full_path):
+        return self._run("delete",
+                         lambda: self.store.delete_entry(full_path),
+                         mutate=True)
+
+    def delete_folder_children(self, full_path):
+        return self._run(
+            "deleteFolderChildren",
+            lambda: self.store.delete_folder_children(full_path),
+            mutate=True)
+
+    def list_directory_entries(self, *args, **kwargs):
+        # materialized so a mid-iteration transport error is retryable
+        # as a unit instead of surfacing from a half-consumed generator
+        return self._run("list", lambda: list(
+            self.store.list_directory_entries(*args, **kwargs)))
+
+    def kv_get(self, key):
+        return self._run("kvGet", lambda: self.store.kv_get(key))
+
+    def kv_put(self, key, value):
+        return self._run("kvPut", lambda: self.store.kv_put(key, value),
+                         mutate=True)
+
+    def close(self):
+        self.store.close()
+
+
 class PathTranslatingStore:
     """Mounts a store under a path prefix
     (filerstore_translate_path.go): callers see `/x`, the backing store

@@ -126,6 +126,13 @@ class RespClient:
             if err is not None:
                 raise err
             exec_reply = replies[-1]
+            if exec_reply is None:
+                # EXEC replied nil: the server aborted the transaction
+                # (WATCH conflict, cluster failover). The stream is fully
+                # drained, so raising keeps the connection in sync —
+                # returning None here let callers (redis3 segment split)
+                # mistake an aborted transaction for a commit.
+                raise RespError("transaction aborted: EXEC returned nil")
             if isinstance(exec_reply, list):
                 # exec-time failures arrive as error ELEMENTS inside
                 # the reply array; the stream is fully drained, so

@@ -40,6 +40,7 @@ from ...pb import ydb_table_pb2 as T
 from ...pb import ydb_value_pb2 as V
 from ..entry import Entry
 from ..filerstore import register_store
+from .abstract_sql import like_escape
 from .wire_common import split_dir_name
 
 TABLE = "filemeta"
@@ -93,8 +94,13 @@ DECLARE $limit AS Uint64;
 
 SELECT name, meta
 FROM filemeta
-WHERE dir_hash = $dir_hash AND directory = $directory and name > $start_name and name LIKE $prefix
+WHERE dir_hash = $dir_hash AND directory = $directory and name > $start_name and name LIKE $prefix ESCAPE '!'
 ORDER BY name ASC LIMIT $limit;"""
+# ESCAPE '!' + like_escape'd prefix: a literal '_'/'%' in the prefix
+# must not act as a YQL wildcard — unescaped, 'my_' also matched 'myX',
+# and those rows were then dropped client-side WITHOUT advancing
+# `emitted`, so real matches past the server page silently vanished
+# from listings (the reference inherits this; abstract_sql here escapes)
 
 _LIST_INCLUSIVE = _LIST.replace("name > $start_name", "name >= $start_name")
 
@@ -195,14 +201,26 @@ class YdbStore:
 
     def __init__(self, *, dsn: str = "grpc://localhost:2136/local",
                  prefix: str = "", timeout: int = 10, **_kwargs):
-        # dsn: grpc://host:port/database (command/scaffold.go [ydb] dsn)
-        rest = dsn.split("://", 1)[-1]
+        # dsn: grpc://host:port/database (command/scaffold.go [ydb] dsn);
+        # grpcs:// dials TLS like the reference SDK — silently downgrading
+        # a secure DSN to plaintext would leak metadata on the wire
+        scheme, sep, rest = dsn.partition("://")
+        if not sep:
+            scheme, rest = "grpc", dsn
         endpoint, _, database = rest.partition("/")
         self._database = "/" + database if database else "/local"
         self._prefix = (self._database + "/" + prefix.strip("/")
                         if prefix else self._database)
         self._timeout = timeout
-        self._channel = grpc.insecure_channel(endpoint)
+        if scheme == "grpc":
+            self._channel = grpc.insecure_channel(endpoint)
+        elif scheme == "grpcs":
+            self._channel = grpc.secure_channel(
+                endpoint, grpc.ssl_channel_credentials())
+        else:
+            raise ValueError(
+                f"unsupported ydb dsn scheme {scheme!r} "
+                f"(use grpc:// or grpcs://)")
         self.table = rpc.Stub(self._channel, rpc.ydb_table_service())
         self._mu = threading.Lock()      # guards _session
         self._op_mu = threading.Lock()   # serializes query round trips
@@ -366,7 +384,7 @@ class YdbStore:
                 "$dir_hash": _int64(dir_hash),
                 "$directory": _utf8(base),
                 "$start_name": _utf8(start),
-                "$prefix": _utf8(prefix + "%"),
+                "$prefix": _utf8(like_escape(prefix) + "%"),
                 "$limit": _uint64(limit - emitted),
             }, tx=_RO_TX)
             rows = [row for rs in res.result_sets for row in rs.rows]

@@ -13,9 +13,12 @@ import threading
 import time
 from dataclasses import dataclass, field
 
+import grpc
 import requests
 
 from ..pb import master_pb2, rpc
+from ..utils import glog
+from ..utils.retry import Backoff, guarded_attempt
 
 _tl = threading.local()
 
@@ -45,20 +48,103 @@ class AssignResult:
     replicas: list = field(default_factory=list)
 
 
+# Master replies that describe topology churn or a momentarily-full
+# cluster, not a bad request: a node mid-(re)registration after a
+# heartbeat-stream break empties the writable set for a second or two,
+# so these are worth re-asking after backoff (the reference's
+# assign_file_id retries its whole lookup the same way). Placement
+# SHAPE errors ("not enough racks", "not enough other data centers")
+# are deliberately absent: retrying cannot conjure a rack, and the
+# caller should see the config error immediately.
+_TRANSIENT_ASSIGN = ("no writable volumes", "no free volume slot",
+                     "not enough servers",
+                     "no data center with enough free slots",
+                     "volume growth rpc failed")
+
+
 def assign(master: str, *, count: int = 1, collection: str = "",
-           replication: str = "", ttl: str = "", data_center: str = "") -> AssignResult:
-    stub = rpc.master_stub(rpc.grpc_address(master))
-    resp = stub.Assign(master_pb2.AssignRequest(
+           replication: str = "", ttl: str = "",
+           data_center: str = "") -> AssignResult:
+    """Assign a file id, surviving master faults (assign_file_id.go's
+    retried LookupJwt path + masterclient failover): `master` may be a
+    comma-separated list; transient gRPC failures rotate to the next
+    master, a follower's "not the leader; ask <addr>" reply redirects
+    to (and remembers) the named leader, and capacity errors during
+    topology churn are re-asked after backoff."""
+    masters = [m.strip() for m in str(master).split(",") if m.strip()]
+    if not masters:
+        # pure configuration error — don't sleep through retry cycles
+        return AssignResult(error="assign: no masters configured")
+    req = master_pb2.AssignRequest(
         count=count, collection=collection, replication=replication,
-        ttl=ttl, data_center=data_center), timeout=30)
-    if resp.error:
-        return AssignResult(error=resp.error)
-    return AssignResult(
-        fid=resp.fid, url=resp.location.url,
-        public_url=resp.location.public_url, count=resp.count,
-        auth=resp.auth,
-        replicas=[l.url for l in resp.replicas],
-    )
+        ttl=ttl, data_center=data_center)
+    cycles = 4
+    bo = Backoff(wait_init=0.3)
+    # None until some master answers or fails; a bare "not the leader"
+    # redirect is recorded only when nothing more informative is held
+    last_err: Exception | str | None = None
+    queue = list(masters)
+    for cycle in range(cycles):
+        # `seen` only bounds redirects within one cycle: a leader that
+        # failed transiently this cycle is worth re-asking next cycle
+        seen: set[str] = set()
+        while queue:
+            m = queue.pop(0)
+            seen.add(m)
+            try:
+                call = lambda: rpc.master_stub(  # noqa: E731
+                    rpc.grpc_address(m)).Assign(req, timeout=30)
+                # ordinary first-cycle traffic bypasses the breaker;
+                # re-asks against a failing master are admission-capped
+                resp = guarded_attempt(m, call) if cycle else call()
+            except (grpc.RpcError, ConnectionError, TimeoutError) as e:
+                # rotate on EVERY RpcError (masterclient tryAllMasters
+                # does not classify): a master mid-shutdown or
+                # mid-election can surface UNKNOWN/CANCELLED, not just
+                # UNAVAILABLE, and the cycle bound already caps retries —
+                # exhaustion returns an AssignResult error, never raises
+                glog.v(1, f"assign via {m} failed: {e}")
+                last_err = e
+                continue
+            if resp.error:
+                # follower redirect: "not the leader; ask host:port"
+                leader = resp.error.rsplit("ask ", 1)[-1].strip() \
+                    if "not the leader" in resp.error else ""
+                if leader:
+                    if leader not in seen:
+                        queue.insert(0, leader)
+                    elif not (isinstance(last_err, str)
+                              and "not the leader" not in last_err):
+                        # redirect back at a master that just failed
+                        # this cycle — transient leader outage; record
+                        # it ONLY if no more informative reply (a
+                        # capacity/config error from the real leader)
+                        # is already held, and let the next cycle
+                        # re-ask after backoff
+                        last_err = resp.error
+                    continue
+                if any(t in resp.error for t in _TRANSIENT_ASSIGN):
+                    glog.v(1, f"assign via {m}: transient capacity "
+                              f"error: {resp.error}")
+                    last_err = resp.error
+                    continue
+                return AssignResult(error=resp.error)
+            return AssignResult(
+                fid=resp.fid, url=resp.location.url,
+                public_url=resp.location.public_url, count=resp.count,
+                auth=resp.auth,
+                replicas=[l.url for l in resp.replicas],
+            )
+        queue = [m for m in masters]
+        if cycle < cycles - 1:
+            bo.sleep()
+    if isinstance(last_err, str):
+        # a master DID answer, definitively; don't misreport a capacity
+        # condition as a connectivity problem
+        return AssignResult(error=f"assign: {last_err} "
+                                  f"(after {cycles} cycles)")
+    return AssignResult(error=f"assign: no master reachable "
+                              f"({masters}): {last_err}")
 
 
 @dataclass
@@ -90,6 +176,7 @@ def upload_data(url: str, data: bytes, *, filename: str = "",
         url += ("&" if "?" in url else "?") + f"ttl={ttl}"
     last: Exception | None = None
     http = session or thread_session()
+    bo = Backoff(wait_init=0.1)
     for attempt in range(retries):
         try:
             r = http.put(url, data=body, headers=headers, timeout=60)
@@ -99,9 +186,12 @@ def upload_data(url: str, data: bytes, *, filename: str = "",
                                     size=j.get("size", len(data)),
                                     etag=j.get("eTag", ""))
             last = IOError(f"{r.status_code}: {r.text[:200]}")
+            if r.status_code < 500:
+                break  # 4xx (bad request, auth) won't improve on retry
         except requests.RequestException as e:
             last = e
-        time.sleep(0.2 * (attempt + 1))
+        if attempt < retries - 1:
+            bo.sleep()
     return UploadResult(error=str(last))
 
 

@@ -26,6 +26,11 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+try:
+    _shard_map = jax.shard_map  # jax >= 0.4.35 top-level export
+except AttributeError:  # pragma: no cover - depends on jax version
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from ..ops.rs_jax import (
     fused_reconstruct_op,
     fused_reconstruct_stacked_op,
@@ -60,7 +65,7 @@ def _matrix_spec(matrix_op) -> P:
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4))
 def _apply_sharded(matrix_op, data, mesh, axis, kernel):
-    fn = jax.shard_map(
+    fn = _shard_map(
         lambda m, d: _per_device_fn(kernel)(m, d),
         mesh=mesh,
         in_specs=(_matrix_spec(matrix_op), P(None, axis)),
@@ -78,7 +83,7 @@ def _parity_probe(matrix_op, shards, mesh, axis, data_shards, kernel):
         diff = jnp.max((par ^ x[data_shards:]).astype(jnp.int32))
         return jax.lax.pmax(diff, axis)
 
-    return jax.shard_map(
+    return _shard_map(
         local,
         mesh=mesh,
         in_specs=(_matrix_spec(matrix_op), P(None, axis)),

@@ -20,6 +20,7 @@ from concurrent import futures
 import grpc
 
 from . import filer_pb2, master_pb2, mount_pb2, mq_pb2, s3_pb2, volume_server_pb2
+from ..utils import failpoint
 
 MAX_MESSAGE_SIZE = 1 << 30  # grpc_client_server.go:27
 GRPC_PORT_DELTA = 10000
@@ -197,10 +198,47 @@ def etcd_kv_service():
 
 # -- generic stub / servicer -----------------------------------------------
 
+class InjectedRpcError(grpc.RpcError):
+    """Synthetic RpcError raised by an armed `pb.<Method>` failpoint —
+    carries a status code so client-side retry classification treats it
+    exactly like a real transport failure."""
+
+    def __init__(self, status_code, details: str):
+        self._code = status_code
+        self._details = details
+        super().__init__(f"{status_code}: {details}")
+
+    def code(self):
+        return self._code
+
+    def details(self):
+        return self._details
+
+
+def _failpoint_guard(fn, method_name: str, address: str):
+    """Per-call chaos hook: an armed failpoint named `pb.<Method>`
+    (optionally @-matched against the dialed address) surfaces as gRPC
+    UNAVAILABLE before the wire is touched. One dict probe when the
+    registry is empty — negligible against marshalling costs. The ctx
+    comma-terminates the address (failpoint ctx convention) so a match
+    for port 1234 cannot substring-hit port 12345."""
+    name = f"pb.{method_name}"
+    ctx = f"{address},"
+
+    def call(*args, **kwargs):
+        try:
+            failpoint.fail(name, ctx=ctx)
+        except failpoint.FailpointError as e:
+            raise InjectedRpcError(grpc.StatusCode.UNAVAILABLE, str(e))
+        return fn(*args, **kwargs)
+
+    return call
+
+
 class Stub:
     """Callable-per-method client stub built from a service descriptor."""
 
-    def __init__(self, channel: grpc.Channel, service):
+    def __init__(self, channel: grpc.Channel, service, address: str = ""):
         full_name, methods = service
         for m in methods:
             path = f"/{full_name}/{m['name']}"
@@ -212,7 +250,7 @@ class Stub:
                 fn = channel.stream_unary(path, m["req"].SerializeToString, m["resp"].FromString)
             else:
                 fn = channel.unary_unary(path, m["req"].SerializeToString, m["resp"].FromString)
-            setattr(self, m["name"], fn)
+            setattr(self, m["name"], _failpoint_guard(fn, m["name"], address))
 
 
 def add_servicer(server: grpc.Server, service, servicer,
@@ -385,11 +423,11 @@ def grpc_address(http_address: str) -> str:
 
 
 def master_stub(address: str) -> Stub:
-    return Stub(cached_channel(address), MASTER_SERVICE)
+    return Stub(cached_channel(address), MASTER_SERVICE, address)
 
 
 def volume_stub(address: str) -> Stub:
-    return Stub(cached_channel(address), VOLUME_SERVICE)
+    return Stub(cached_channel(address), VOLUME_SERVICE, address)
 
 
 MQ_SERVICE = ("messaging_pb.SeaweedMessaging", [
@@ -415,16 +453,16 @@ IAM_SERVICE = ("iam_pb.SeaweedIdentityAccessManagement", [])
 
 
 def filer_stub(address: str) -> Stub:
-    return Stub(cached_channel(address), FILER_SERVICE)
+    return Stub(cached_channel(address), FILER_SERVICE, address)
 
 
 def mq_stub(address: str) -> Stub:
-    return Stub(cached_channel(address), MQ_SERVICE)
+    return Stub(cached_channel(address), MQ_SERVICE, address)
 
 
 def s3_stub(address: str) -> Stub:
-    return Stub(cached_channel(address), S3_SERVICE)
+    return Stub(cached_channel(address), S3_SERVICE, address)
 
 
 def mount_stub(address: str) -> Stub:
-    return Stub(cached_channel(address), MOUNT_SERVICE)
+    return Stub(cached_channel(address), MOUNT_SERVICE, address)

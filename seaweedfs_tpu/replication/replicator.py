@@ -9,16 +9,32 @@ from __future__ import annotations
 
 from ..pb import filer_pb2
 from ..utils import glog
-from .sink import ReplicationSink
+from ..utils.retry import is_retryable, retry
+from .sink import ReplicationSink, SinkUnavailable
 from .source import FilerSource
 
 
 class Replicator:
     def __init__(self, source: FilerSource, sink: ReplicationSink, *,
-                 source_prefix: str = "/"):
+                 source_prefix: str = "/", sink_attempts: int = 4,
+                 sink_wait_init: float = 0.05):
         self.source = source
         self.sink = sink
         self.prefix = source_prefix.rstrip("/") or "/"
+        # a flapping sink (target filer restart, S3 endpoint blip) is
+        # retried with backoff instead of dropping the event on the floor
+        self.sink_attempts = sink_attempts
+        self.sink_wait_init = sink_wait_init
+
+    def _apply(self, what: str, fn) -> None:
+        # sink applies are idempotent (PUT-or-overwrite / delete-if-there),
+        # so target-side 5xx (SinkUnavailable) are retryable too, not just
+        # transport-level failures; 4xx rejections and local path errors
+        # can never improve on retry and propagate at once
+        retry(f"replication.{self.sink.name}.{what}", fn,
+              attempts=self.sink_attempts, wait_init=self.sink_wait_init,
+              retryable=lambda e: is_retryable(e)
+              or isinstance(e, SinkUnavailable))
 
     def _strip(self, path: str) -> str | None:
         """Path relative to the replicated prefix, or None if outside."""
@@ -45,7 +61,8 @@ class Replicator:
                 new_dir.rstrip("/") + "/" + ev.new_entry.name) \
                 if has_new else None
             if old_path is not None and old_path != new_path:
-                self.sink.delete_entry(old_path, ev.old_entry.is_directory)
+                self._apply("delete", lambda: self.sink.delete_entry(
+                    old_path, ev.old_entry.is_directory))
                 applied = True
         if has_new:
             new_dir = ev.new_parent_path or directory
@@ -55,7 +72,8 @@ class Replicator:
                 data = None
                 if not ev.new_entry.is_directory:
                     data = self.source.read_entry_content(ev.new_entry)
-                self.sink.create_entry(new_path, ev.new_entry, data)
+                self._apply("create", lambda: self.sink.create_entry(
+                    new_path, ev.new_entry, data))
                 applied = True
         if applied:
             glog.v(1, f"replicated {directory}: "

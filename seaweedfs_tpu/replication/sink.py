@@ -16,10 +16,23 @@ import time
 import requests
 
 from ..pb import filer_pb2, rpc
+from ..utils import failpoint
+
+
+class SinkUnavailable(IOError):
+    """Target-side transient failure (5xx, injected flap): the apply is
+    idempotent and worth retrying. Client-side rejections (4xx auth,
+    bad request) stay plain IOError — retrying those only adds load."""
 
 
 class ReplicationSink:
     name = "abstract"
+
+    def _chaos(self, verb: str, path: str) -> None:
+        """`replication.sink` failpoint: lets the chaos suite flap the
+        sink (fail the first N applies, delay, etc.) uniformly across
+        every concrete sink."""
+        failpoint.fail("replication.sink", ctx=f"{self.name} {verb} {path}")
 
     def create_entry(self, path: str, entry: filer_pb2.Entry,
                      data: bytes | None) -> None:
@@ -52,6 +65,7 @@ class FilerSink(ReplicationSink):
         return self.dir + path
 
     def create_entry(self, path, entry, data):
+        self._chaos("create", path)
         target = self._target(path)
         if entry.is_directory:
             e = filer_pb2.Entry(name=target.rsplit("/", 1)[-1],
@@ -69,9 +83,11 @@ class FilerSink(ReplicationSink):
                      # reverse sync loop skips it (filer_sync.go signatures)
                      "X-From-Other-Cluster": "1"}, timeout=300)
         if r.status_code >= 300:
-            raise IOError(f"filer sink PUT {target}: {r.status_code}")
+            cls = SinkUnavailable if r.status_code >= 500 else IOError
+            raise cls(f"filer sink PUT {target}: {r.status_code}")
 
     def delete_entry(self, path, is_directory):
+        self._chaos("delete", path)
         target = self._target(path)
         directory, name = target.rsplit("/", 1)
         self.stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
@@ -92,6 +108,7 @@ class LocalSink(ReplicationSink):
         return os.path.join(self.dir, path.lstrip("/"))
 
     def create_entry(self, path, entry, data):
+        self._chaos("create", path)
         target = self._target(path)
         if entry.is_directory:
             os.makedirs(target, exist_ok=True)
@@ -106,6 +123,7 @@ class LocalSink(ReplicationSink):
                               entry.attributes.mtime))
 
     def delete_entry(self, path, is_directory):
+        self._chaos("delete", path)
         target = self._target(path)
         try:
             if is_directory:
@@ -151,6 +169,7 @@ class S3Sink(ReplicationSink):
                             self.secret_key, self.region)
 
     def create_entry(self, path, entry, data):
+        self._chaos("create", path)
         if entry.is_directory:
             return
         url = self._url(path)
@@ -162,9 +181,11 @@ class S3Sink(ReplicationSink):
             headers["Content-Type"] = entry.attributes.mime
         r = requests.put(url, data=body, headers=headers, timeout=300)
         if r.status_code >= 300:
-            raise IOError(f"s3 sink PUT {url}: {r.status_code}")
+            cls = SinkUnavailable if r.status_code >= 500 else IOError
+            raise cls(f"s3 sink PUT {url}: {r.status_code}")
 
     def delete_entry(self, path, is_directory):
+        self._chaos("delete", path)
         if is_directory:
             return
         url = self._url(path)
@@ -187,12 +208,14 @@ class _CloudSink(ReplicationSink):
         return (self.dir + "/" if self.dir else "") + path.lstrip("/")
 
     def create_entry(self, path, entry, data):
+        self._chaos("create", path)
         if entry.is_directory:
             return
         self.client.put(self._key(path), data or b"",
                         entry.attributes.mime or self.default_mime)
 
     def delete_entry(self, path, is_directory):
+        self._chaos("delete", path)
         if is_directory:
             return
         self.client.remove(self._key(path))

@@ -27,7 +27,7 @@ import requests as rq
 from ..filer import Attr, Entry, Filer
 from ..filer.filechunks import etag as chunks_etag, total_size, view_from_chunks
 from ..filer.filer import NotEmpty, NotFound, normalize
-from ..filer.filerstore import get_store
+from ..filer.filerstore import RetryingStore, get_store
 from ..operation import assign, delete_files, thread_session, upload_data
 from ..pb import filer_pb2, master_pb2, rpc
 from ..utils import glog
@@ -78,13 +78,16 @@ class FilerServer:
             if store_dir and db == ":memory:":
                 os.makedirs(store_dir, exist_ok=True)
                 db = os.path.join(store_dir, "filer.db")
-            self.filer = Filer(get_store("sqlite", db_path=db))
+            backing = get_store("sqlite", db_path=db)
         elif store.startswith("leveldb"):
-            self.filer = Filer(get_store(
+            backing = get_store(
                 store, directory=store_kwargs.pop("dir", "")
-                or store_dir or "./filerldb"))
+                or store_dir or "./filerldb")
         else:
-            self.filer = Filer(get_store(store, **store_kwargs))
+            backing = get_store(store, **store_kwargs)
+        # transient backend hiccups (and injected chaos) retry with
+        # backoff instead of surfacing as 500s from handler threads
+        self.filer = Filer(RetryingStore(backing))
         # external event publisher, if notification.toml configures one
         # (filer.go LoadConfiguration("notification"))
         try:
@@ -434,16 +437,19 @@ class FilerServer:
 
     def write_file(self, path: str, body: bytes, *, mime: str = "",
                    ttl: str = "", mode: int = 0o660,
-                   from_other_cluster: bool = False) -> Entry:
+                   from_other_cluster: bool = False,
+                   extended: dict | None = None) -> Entry:
         import io
 
         return self.write_stream(path, io.BytesIO(body), len(body),
                                  mime=mime, ttl=ttl, mode=mode,
-                                 from_other_cluster=from_other_cluster)
+                                 from_other_cluster=from_other_cluster,
+                                 extended=extended)
 
     def write_stream(self, path: str, reader, length: int | None, *,
                      mime: str = "", ttl: str = "", mode: int = 0o660,
-                     from_other_cluster: bool = False) -> Entry:
+                     from_other_cluster: bool = False,
+                     extended: dict | None = None) -> Entry:
         """autoChunk + saveAsChunk + CreateEntry, reading `length` bytes
         (or until EOF when length is None — chunked transfer encoding)
         from `reader` one chunk at a time (uploadReaderToChunks in
@@ -474,10 +480,11 @@ class FilerServer:
             raise
         return self._finish_entry(path, chunks, md5, mime=mime, ttl=ttl,
                                   mode=mode,
-                                  from_other_cluster=from_other_cluster)
+                                  from_other_cluster=from_other_cluster,
+                                  extended=extended)
 
     def _finish_entry(self, path, chunks, md5, *, mime, ttl, mode,
-                      from_other_cluster):
+                      from_other_cluster, extended=None):
         now = int(time.time())
         entry = Entry(
             full_path=normalize(path),
@@ -485,6 +492,7 @@ class FilerServer:
                       md5=md5.digest(),
                       ttl_sec=_ttl_seconds(ttl)),
             chunks=chunks,
+            extended=dict(extended) if extended else {},
         )
         old_fids = []
         try:
@@ -529,27 +537,87 @@ class FilerServer:
         for view in view_from_chunks(entry.chunks, offset,
                                      size if size is not None
                                      else total_size(entry.chunks) - offset):
-            urls = self.master_client.lookup_file_id(view.file_id)
-            last_err = None
+            yield self._read_chunk_view(view)
+
+    def _read_chunk_view(self, view) -> bytes:
+        """One chunk view's bytes with full failover: every replica in
+        the cached location map, then a cache-invalidating re-lookup
+        (the map may be stale after a replica died), then servers
+        holding ANY EC shard of the volume — which reconstruct from any
+        k shards server-side (the LookupFileIdWithFallback read ladder
+        this rebuild previously lacked: first dead replica was fatal)."""
+        headers = {"Range": f"bytes={view.chunk_offset}-"
+                            f"{view.chunk_offset + view.size - 1}"} \
+            if not view.is_full_chunk else {}
+        last_err: Exception | None = None
+
+        def try_urls(urls):
+            """-> (data | None, every-replica-replied-404). A sweep that
+            was ONLY definitive 404s means the needle is absent, not
+            that replicas are down — distinguishing the two keeps a
+            deleted-file poll from escalating into master re-lookups
+            and EC sweeps on every read."""
+            nonlocal last_err
+            all_notfound = bool(urls)
             for url in urls:
                 try:
-                    r = thread_session().get(
-                        url, timeout=60,
-                        headers={"Range":
-                                 f"bytes={view.chunk_offset}-"
-                                 f"{view.chunk_offset + view.size - 1}"}
-                        if not view.is_full_chunk else {})
+                    r = thread_session().get(url, timeout=60,
+                                             headers=headers)
                     if r.status_code in (200, 206):
                         data = r.content
                         if r.status_code == 200 and not view.is_full_chunk:
                             data = data[view.chunk_offset:
                                         view.chunk_offset + view.size]
-                        yield data
-                        break
+                        if len(data) == view.size:
+                            return data, False
+                        # a replica serving the wrong byte count (e.g.
+                        # flag-corrupted needle) must read as a FAILED
+                        # replica, not stream short into a body whose
+                        # Content-Length was already computed
+                        all_notfound = False
+                        last_err = IOError(
+                            f"{url}: wrong chunk size "
+                            f"{len(data)} != {view.size}")
+                    elif r.status_code == 404:
+                        last_err = IOError(f"{url}: 404")
+                    else:
+                        all_notfound = False
+                        last_err = IOError(f"{url}: {r.status_code}")
                 except rq.RequestException as e:
+                    all_notfound = False
                     last_err = e
-            else:
-                raise IOError(f"chunk {view.file_id} unreadable: {last_err}")
+            return None, all_notfound
+
+        notfound = False
+        try:
+            data, _ = try_urls(
+                self.master_client.lookup_file_id(view.file_id))
+            if data is not None:
+                return data
+            # all cached replicas failed: the map may be stale — drop it,
+            # re-ask the master, and walk the fresh replica set once more
+            # (a 404 sweep still refreshes once: the volume may have
+            # MOVED and the old holder answers 404 for it)
+            vid = view.file_id.split(",")[0]
+            glog.v(1, f"chunk {view.file_id}: cached replicas failed "
+                      f"({last_err}); refreshing volume {vid} locations")
+            data, notfound = try_urls(self.master_client.lookup_file_id(
+                view.file_id, refresh=True))
+            if data is not None:
+                return data
+        except LookupError as e:
+            last_err = e
+            notfound = False
+        if not notfound:
+            # last resort: the volume may live on (only) as EC shards.
+            # Skipped when every FRESH replica answered a definitive 404
+            # — the needle is deleted/absent, and LookupEcVolume has no
+            # negative cache to absorb a polling client.
+            data, _ = try_urls(
+                self.master_client.ec_fallback_urls(view.file_id))
+            if data is not None:
+                return data
+        raise IOError(f"chunk {view.file_id} unreadable: {last_err}")
 
     def read_file(self, entry: Entry, offset: int = 0,
                   size: int | None = None) -> bytes:
@@ -891,11 +959,12 @@ class FilerGrpc:
             gw = RemoteGateway(self.srv.address, conf=conf)
             client, rpath = gw._remote_location(path)
             data = client.read_file(rpath)
-            self.srv.write_file(path, data)
-            # re-attach the remote marker lost by the overwrite
-            e = self.filer.find_entry(path)
-            e.extended[REMOTE_ENTRY_KEY] = marker
-            self.filer.update_entry(e)
+            # the marker rides the SAME store write as the content: a
+            # crash between "write bytes" and a follow-up marker update
+            # must not leave a cached entry that is no longer recognized
+            # as remote (breaking remote.uncache / meta sync for it)
+            e = self.srv.write_file(
+                path, data, extended={REMOTE_ENTRY_KEY: marker})
         except Exception as err:  # noqa: BLE001 - remote IO failures
             context.abort(grpc.StatusCode.INTERNAL, str(err))
         return filer_pb2.CacheRemoteObjectToLocalClusterResponse(

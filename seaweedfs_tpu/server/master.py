@@ -181,17 +181,39 @@ class MasterServer:
         rp = ReplicaPlacement.parse(replication or self.default_replication)
         t = TTL.parse(ttl) if ttl else EMPTY_TTL
         vl = self.topo.get_layout(collection, rp, t)
+        grow_err: Exception | None = None
         if vl.active_count() == 0:
             with self._grow_lock:  # single grower, like vgCh serialization
                 if vl.active_count() == 0:
-                    self.growth.grow(
-                        collection, rp, t,
-                        count=self.growth.default_count(rp),
-                        data_center=data_center, rack=rack, data_node=data_node,
-                    )
+                    try:
+                        self.growth.grow(
+                            collection, rp, t,
+                            count=self.growth.default_count(rp),
+                            data_center=data_center, rack=rack,
+                            data_node=data_node,
+                        )
+                    except (ValueError, grpc.RpcError, IOError) as e:
+                        # a full/churning cluster is a routine condition —
+                        # including a chosen volume server dying between
+                        # heartbeat and AllocateVolume (grpc.RpcError):
+                        # surface it as an assign error (clients retry it
+                        # as transient), never as a raw gRPC exception
+                        glog.v(1, f"volume growth failed: {e}")
+                        grow_err = e
         try:
             fid, n, locations = self.topo.pick_for_write(collection, rp, t, count=count)
         except ValueError as e:
+            # when growth is WHY there is nothing to pick, the grow error
+            # is the real diagnosis — a generic "no writable volumes"
+            # would read as transient churn to clients and bury a
+            # permanent placement-shape misconfiguration. Placement
+            # ValueErrors pass through raw (their strings classify
+            # client-side); transport failures get a marker that
+            # operation.assign treats as transient.
+            if isinstance(grow_err, ValueError):
+                return {"error": str(grow_err)}
+            if grow_err is not None:
+                return {"error": f"volume growth rpc failed: {grow_err}"}
             return {"error": str(e)}
         primary = locations[0]
         return {

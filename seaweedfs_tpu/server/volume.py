@@ -41,7 +41,7 @@ from ..storage.file_id import parse_file_id
 from ..storage.needle import Needle
 from ..storage.store import Store
 from ..storage.ttl import TTL
-from ..utils import glog
+from ..utils import failpoint, glog
 from ..utils.http import not_modified
 from ..utils.stats import (
     VOLUME_SERVER_EC_ENCODE_BYTES,
@@ -330,8 +330,16 @@ class VolumeServer:
                           soff: int, size: int) -> bytes:
         f = ev.shard_files.get(sid)
         if f is not None:
-            data = f.read_at(soff, size)
-            return data + b"\0" * (size - len(data))
+            try:
+                # chaos hook: a lost/unreadable local shard pushes the
+                # read down the remote-peer / reconstruct-from-any-k path
+                failpoint.fail("ec.shard.read",
+                               ctx=f"{self.address}, shard={sid},")
+                data = f.read_at(soff, size)
+                return data + b"\0" * (size - len(data))
+            except OSError as e:  # includes injected FailpointError
+                glog.v(1, f"ec vol {vid} shard {sid} local read failed "
+                          f"({e}); degrading to remote/reconstruct")
         locs = self._lookup_ec_shards(vid)
         for addr in locs.get(sid, []):
             if addr == self.address:
@@ -360,7 +368,12 @@ class VolumeServer:
         geo = ev.geo
         bufs: dict[int, np.ndarray] = {}
         for i, f in ev.shard_files.items():
-            data = f.read_at(soff, size)
+            try:
+                failpoint.fail("ec.shard.read",
+                               ctx=f"{self.address}, shard={i},")
+                data = f.read_at(soff, size)
+            except OSError:  # includes injected FailpointError
+                continue  # survivor set shrinks; any k still suffice
             bufs[i] = np.frombuffer(data + b"\0" * (size - len(data)), np.uint8)
 
         missing = [
@@ -416,12 +429,23 @@ class VolumeServer:
     # -- replication (topology/store_replicate.go:24) ----------------------
 
     def replicate_write(self, fid: str, body: bytes, params: dict,
-                        locations: list[str]) -> None:
+                        locations: list[str],
+                        content_type: str = "",
+                        content_encoding: str = "") -> None:
         import requests as rq
 
+        # the body is forwarded VERBATIM (possibly gzipped, possibly a
+        # multipart envelope), so the headers describing it must travel
+        # too: without Content-Encoding the replica stores compressed
+        # bytes with is_compressed unset and later serves raw gzip to
+        # readers (silent corruption on replica failover)
+        headers = {}
+        if content_type:
+            headers["Content-Type"] = content_type
+        if content_encoding:
+            headers["Content-Encoding"] = content_encoding
         # replicas enforce JWT like any write; re-sign with the shared
         # cluster key (the reference re-mints for fan-out the same way)
-        headers = {}
         if self.write_jwt_key:
             from ..security import gen_write_jwt
 
@@ -893,6 +917,14 @@ class VolumeGrpc:
 
     def VolumeEcShardRead(self, request, context):
         """Stream a shard extent in 2MB messages (handler :309-375)."""
+        try:
+            # same chaos hook as the local path: a peer asking for a
+            # "lost" shard here gets UNAVAILABLE and reconstructs instead
+            failpoint.fail(
+                "ec.shard.read",
+                ctx=f"{self.srv.address}, shard={request.shard_id},")
+        except failpoint.FailpointError as e:
+            context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
         ev = self.store.find_ec_volume(request.volume_id)
         if ev is None:
             context.abort(grpc.StatusCode.NOT_FOUND,
@@ -1217,14 +1249,19 @@ def _make_http_handler(srv: VolumeServer):
             except ValueError as e:
                 return self._json({"error": str(e)}, 400)
             try:
+                # chaos hook: a targeted replica answers 500 (or stalls)
+                # so client-side replica failover can be exercised
+                failpoint.fail("volume.http.read",
+                               ctx=f"{srv.address}, {u.path}")
                 n = srv.read_needle(fid.volume_id, fid.key, fid.cookie)
             except (NotFoundError, DeletedError):
                 return self._reply(404)
             except CookieMismatch:
                 return self._reply(404)
-            except IOError as e:
+            except IOError as e:  # includes injected FailpointError
                 return self._json({"error": str(e)}, 500)
-            data = n.data
+            data = failpoint.corrupt("volume.http.read.corrupt", n.data,
+                                     ctx=f"{srv.address},")
             headers = {"ETag": f'"{n.etag()}"'}
             if n.last_modified:
                 headers["Last-Modified"] = time.strftime(
@@ -1284,6 +1321,12 @@ def _make_http_handler(srv: VolumeServer):
                 fid = parse_file_id(u.path.lstrip("/"))
             except ValueError as e:
                 return self._json({"error": str(e)}, 400)
+            try:
+                # chaos hook: flaky/slow writes on a targeted server
+                failpoint.fail("volume.http.write",
+                               ctx=f"{srv.address}, {u.path}")
+            except failpoint.FailpointError as e:
+                return self._json({"error": str(e)}, 500)
             # JWT write authorization (security.toml jwt.signing) — also
             # enforced on replica fan-out (the primary re-signs; exempting
             # ?type=replicate would let anyone forge the param)
@@ -1324,7 +1367,12 @@ def _make_http_handler(srv: VolumeServer):
                     try:
                         srv.replicate_write(
                             u.path.lstrip("/"), body,
-                            {k: v for k, v in q.items() if k != "type"}, locs)
+                            {k: v for k, v in q.items() if k != "type"},
+                            locs,
+                            content_type=self.headers.get(
+                                "Content-Type") or "",
+                            content_encoding=self.headers.get(
+                                "Content-Encoding") or "")
                     except IOError as e:
                         return self._json({"error": f"replication: {e}"}, 500)
             self._json({"name": (name or b"").decode(errors="replace"),

@@ -10,7 +10,11 @@ wins. `${ENV}` values are expanded the way viper's automatic env does.
 from __future__ import annotations
 
 import os
-import tomllib
+
+try:
+    import tomllib  # Python >= 3.11
+except ModuleNotFoundError:  # pragma: no cover - depends on interpreter
+    import tomli as tomllib  # same API, pre-3.11 interpreters
 
 SEARCH_PATHS = [".", "~/.seaweedfs-tpu", "/etc/seaweedfs-tpu"]
 

@@ -35,12 +35,18 @@ def on_interrupt(fn) -> None:
 
 
 def _run_hooks(*_args) -> None:
+    """Run-and-drain, exactly once per registration: the list swap under
+    the lock means a SIGTERM handler racing atexit (or two concurrent
+    signals) can never run the same hook twice — whoever swaps first
+    owns the whole batch, later callers see an empty list. A hook that
+    raises (even SystemExit from a sys.exit() inside a callback) must
+    not block the remaining hooks."""
     with _hooks_lock:
         hooks, _hooks[:] = list(_hooks), []
     for fn in reversed(hooks):
         try:
             fn()
-        except Exception:
+        except BaseException:  # noqa: BLE001 - shutdown must proceed
             pass
 
 

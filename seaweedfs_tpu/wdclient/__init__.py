@@ -4,6 +4,13 @@ Rebuild of /root/reference/weed/wdclient/ — `MasterClient` keeps a vidMap
 cache of volume id -> locations (vid_map.go:72, masterclient.go:44's
 5-generation cache becomes a single TTL'd dict; the generations existed to
 bound Go map churn) and `LookupFileIdWithFallback` (masterclient.go:59).
+
+Fault handling (utils/retry.py): every master RPC fails over across the
+configured master list on UNAVAILABLE/DEADLINE_EXCEEDED (the responder
+becomes the new leader hint — masterclient.go's tryAllMasters), stale
+vid-cache entries are invalidated on lookup misses, and
+`ec_fallback_urls` surfaces EC-shard holders as last-resort read
+targets when every plain replica of a volume is gone.
 """
 
 from __future__ import annotations
@@ -16,6 +23,8 @@ import grpc
 
 from ..pb import master_pb2, rpc
 from ..storage.file_id import parse_file_id
+from ..utils import glog
+from ..utils.retry import multi_retry
 
 
 class Location:
@@ -54,6 +63,43 @@ class MasterClient:
     def _stub(self):
         return rpc.master_stub(rpc.grpc_address(self._leader))
 
+    # -- leader failover ---------------------------------------------------
+
+    def _with_master(self, op: str, fn):
+        """Run fn(stub) against the current leader, failing over across
+        the configured masters on transient gRPC errors (UNAVAILABLE /
+        DEADLINE_EXCEEDED). Whichever master answers becomes the new
+        leader hint, so subsequent calls go straight there."""
+        candidates = [self._leader] + [m for m in self.masters
+                                       if m != self._leader]
+
+        def attempt(master):
+            out = fn(rpc.master_stub(rpc.grpc_address(master)))
+            if master != self._leader:
+                glog.v(1, f"master failover: {op} answered by {master}")
+                self._leader = master
+            return out
+
+        return multi_retry(f"master.{op}", candidates, attempt, cycles=2)
+
+    def resolve_leader(self) -> str:
+        """Ask any reachable master who leads (RaftListClusterServers;
+        single-master clusters lead themselves) and repoint at it."""
+        def ask(stub):
+            resp = stub.RaftListClusterServers(
+                master_pb2.RaftListClusterServersRequest(), timeout=10)
+            for s in resp.cluster_servers:
+                if s.isLeader:
+                    return s.address
+            return ""
+
+        leader = self._with_master("resolve_leader", ask)
+        if leader and leader != self._leader:
+            self._leader = leader
+            if leader not in self.masters:
+                self.masters.append(leader)
+        return self._leader
+
     # -- volume lookup -----------------------------------------------------
 
     def add_location(self, vid: int, loc: Location) -> None:
@@ -75,18 +121,29 @@ class MasterClient:
             else:
                 del self._vid_cache[vid]
 
-    def lookup_volume(self, vid: int) -> list[Location]:
-        now = time.time()
+    def invalidate(self, vid: int) -> None:
+        """Drop cached locations for a volume — called when every cached
+        replica failed a read, so the next lookup re-asks the master
+        instead of replaying a stale map."""
         with self._lock:
-            entry = self._vid_cache.get(vid)
-            if entry and entry[0] > now and entry[1]:
-                return list(entry[1])
-        resp = self._stub().LookupVolume(
+            self._vid_cache.pop(vid, None)
+            self._ec_vid_cache.pop(vid, None)
+
+    def lookup_volume(self, vid: int, *, refresh: bool = False
+                      ) -> list[Location]:
+        now = time.time()
+        if not refresh:
+            with self._lock:
+                entry = self._vid_cache.get(vid)
+                if entry and entry[0] > now and entry[1]:
+                    return list(entry[1])
+        resp = self._with_master("LookupVolume", lambda stub: stub.LookupVolume(
             master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)]),
-            timeout=10)
+            timeout=10))
         locs = []
         for e in resp.volume_id_locations:
             if e.error:
+                self.invalidate(vid)  # a miss means the cache lied too
                 raise LookupError(e.error)
             locs = [Location(l.url, l.public_url, l.grpc_port, l.data_center)
                     for l in e.locations]
@@ -94,14 +151,33 @@ class MasterClient:
             self._vid_cache[vid] = (now + self.cache_ttl, locs)
         return locs
 
-    def lookup_file_id(self, fid: str) -> list[str]:
+    def lookup_file_id(self, fid: str, *, refresh: bool = False) -> list[str]:
         """-> http URLs serving this fid (LookupFileIdWithFallback)."""
         f = parse_file_id(fid)
-        locs = self.lookup_volume(f.volume_id)
+        locs = self.lookup_volume(f.volume_id, refresh=refresh)
         if not locs:
+            self.invalidate(f.volume_id)
             raise LookupError(f"volume {f.volume_id} has no locations")
         random.shuffle(locs)
         return [f"http://{l.url}/{fid}" for l in locs]
+
+    def ec_fallback_urls(self, fid: str) -> list[str]:
+        """Last-resort read targets: HTTP URLs of servers holding ANY EC
+        shard of this fid's volume — each can serve the needle by
+        reconstructing from any k shards (store_ec.go recover path).
+        Empty when the volume was never EC-encoded."""
+        f = parse_file_id(fid)
+        try:
+            shard_locs = self.lookup_ec_volume(f.volume_id)
+        except (grpc.RpcError, ConnectionError, TimeoutError):
+            return []  # not EC-encoded (NOT_FOUND) or masters unreachable
+        servers: list[str] = []
+        for locs in shard_locs.values():
+            for l in locs:
+                if l.url not in servers:
+                    servers.append(l.url)
+        random.shuffle(servers)
+        return [f"http://{url}/{fid}" for url in servers]
 
     def lookup_ec_volume(self, vid: int) -> dict[int, list[Location]]:
         now = time.time()
@@ -109,8 +185,9 @@ class MasterClient:
             entry = self._ec_vid_cache.get(vid)
             if entry and entry[0] > now:
                 return dict(entry[1])
-        resp = self._stub().LookupEcVolume(
-            master_pb2.LookupEcVolumeRequest(volume_id=vid), timeout=10)
+        resp = self._with_master(
+            "LookupEcVolume", lambda stub: stub.LookupEcVolume(
+                master_pb2.LookupEcVolumeRequest(volume_id=vid), timeout=10))
         out = {
             sl.shard_id: [Location(l.url, l.public_url, l.grpc_port)
                           for l in sl.locations]
@@ -175,5 +252,14 @@ class MasterClient:
                     if stop.is_set():
                         break
             except grpc.RpcError:
+                # rotate to the next configured master before redialing —
+                # a dead leader must not pin the stream-reconnect loop
+                if len(self.masters) > 1:
+                    if self._leader in self.masters:
+                        i = self.masters.index(self._leader)
+                        self._leader = self.masters[
+                            (i + 1) % len(self.masters)]
+                    else:
+                        self._leader = self.masters[0]
                 if stop.wait(1.0):
                     break

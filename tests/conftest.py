@@ -20,6 +20,11 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: multi-GB EC scale tests (deselect with -m 'not slow')"
     )
+    config.addinivalue_line(
+        "markers",
+        "chaos: failpoint-driven fault-injection suite (tests/test_chaos.py);"
+        " runs inside the tier-1 -m 'not slow' selection"
+    )
 
 
 import faulthandler  # noqa: E402

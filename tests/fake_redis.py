@@ -18,6 +18,10 @@ class FakeRedisServer:
         self.kv: dict[bytes, bytes] = {}
         self.zsets: dict[bytes, list[bytes]] = {}  # lex-sorted members
         self.scripts: dict[bytes, bytes] = {}  # sha1 -> script text
+        # when set, the next EXEC replies nil (*-1) without applying the
+        # queued commands — how a real server reports a transaction
+        # aborted by a WATCH conflict or cluster failover
+        self.abort_next_exec = False
         self._lock = threading.Lock()
         self._listen = socket.socket()
         self._listen.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -74,6 +78,11 @@ class FakeRedisServer:
                 if cmd == "EXEC":
                     if queued is None:
                         conn.sendall(b"-ERR EXEC without MULTI\r\n")
+                        continue
+                    if self.abort_next_exec:
+                        self.abort_next_exec = False
+                        queued = None
+                        conn.sendall(b"*-1\r\n")
                         continue
                     with self._lock:  # atomic: one lock for the batch
                         replies = [self._dispatch_locked(c, a)

@@ -41,6 +41,27 @@ def _norm(yql: str) -> str:
     return re.sub(r"\s+", " ", yql).strip()
 
 
+def _like_regex(pattern: str, escape: str) -> re.Pattern:
+    """SQL LIKE pattern -> compiled regex ('%' any run, '_' any one
+    char, `escape`-prefixed chars literal)."""
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if escape and c == escape and i + 1 < len(pattern):
+            out.append(re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(re.escape(c))
+        i += 1
+    return re.compile("".join(out), re.DOTALL)
+
+
 class _Expect:
     INT64 = ("int64",)
     UTF8 = ("utf8",)
@@ -161,10 +182,14 @@ class _TableServicer:
         if "SELECT meta FROM filemeta" in q:
             return "find"
         if "SELECT name, meta FROM filemeta" in q:
+            kind = None
             if "name >= $start_name" in q:
-                return "list:inclusive"
-            if "name > $start_name" in q:
-                return "list:exclusive"
+                kind = "list:inclusive"
+            elif "name > $start_name" in q:
+                kind = "list:exclusive"
+            if kind and "ESCAPE '!'" in q:
+                kind += ":escape"
+            return kind
         return None
 
     @staticmethod
@@ -201,19 +226,26 @@ class _TableServicer:
                 rs.rows.append(V.Value(items=[
                     V.Value(bytes_value=row[1])]))
             return T.ExecuteQueryResult(result_sets=[rs])
-        # list
-        inclusive = kind.endswith("inclusive")
-        prefix = p["$prefix"]
-        assert prefix.endswith("%"), "store always sends LIKE prefix%"
-        stem = prefix[:-1]
+        # list — real LIKE semantics: '%'/'_' are wildcards unless the
+        # statement declares ESCAPE '!' and the char is escaped (a
+        # literal-startswith fake would mask the wildcard-prefix bug the
+        # store must defend against)
+        inclusive = "inclusive" in kind
+        escape = "!" if kind.endswith("escape") else ""
+        matcher = _like_regex(p["$prefix"], escape)
         names = sorted(
             n for (h, n), (d, _, _) in self.rows.items()
             if h == p["$dir_hash"] and d == p["$directory"]
             and (n >= p["$start_name"] if inclusive
                  else n > p["$start_name"])
-            and n.startswith(stem))
+            and matcher.fullmatch(n))
+        # truncated reflects the RESULT-SET CAP only: a LIMIT-bounded
+        # page is a COMPLETED query on a real server (truncated=False
+        # even when more rows match). A fake that set truncated for
+        # LIMIT-bounding too would hide the wildcard-prefix under-return
+        # the store's paging loop must survive (ADVICE r5 #1).
         limit = min(p["$limit"], RESULT_PAGE)
-        truncated = len(names) > limit
+        truncated = p["$limit"] > RESULT_PAGE and len(names) > RESULT_PAGE
         rs = V.ResultSet(
             columns=[V.Column(name="name",
                               type=V.Type(type_id=V.Type.UTF8)),

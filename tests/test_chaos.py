@@ -1,0 +1,398 @@
+"""Chaos suite: failpoint-driven fault injection through the real server
+stacks (utils/failpoint.py + utils/retry.py — ISSUE 1 tentpole).
+
+Every scenario arms a named failpoint and then drives the ordinary
+client paths, asserting ZERO client-visible errors while the injected
+faults demonstrably fire (`hits` assertions):
+
+- replica loss: `volume.http.read` fails 20%/100% of reads on ONE
+  replica; filer reads fail over to the survivor
+- EC degradation: `ec.shard.read` loses four data shards; reads
+  reconstruct from the remaining k
+- master outage: `pb.Assign` flaps the leader mid-assign; a raft trio
+  loses its real leader and assign follows the new one
+- metadata-backend flaps: `filer.store.mutate` interrupts store writes;
+  RetryingStore absorbs them
+- replication sink flaps: `replication.sink` bounces applies; the
+  Replicator retries instead of dropping events
+- subprocess stacks: SWFS_FAILPOINTS env arms a spawned `weed server`
+
+The volume-data-plane scenarios need the Python HTTP handlers (that's
+where the failpoints live), so the fixture pins SEAWEEDFS_TPU_NATIVE=0
+while the cluster is up.
+"""
+
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from seaweedfs_tpu.operation import assign, submit
+from seaweedfs_tpu.pb import filer_pb2, master_pb2, rpc
+from seaweedfs_tpu.pb import volume_server_pb2 as vs
+from seaweedfs_tpu.replication import LocalSink, Replicator
+from seaweedfs_tpu.server.filer import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+from seaweedfs_tpu.storage.ec_locate import Geometry
+from seaweedfs_tpu.storage.file_id import parse_file_id
+from seaweedfs_tpu.utils import failpoint
+from seaweedfs_tpu.wdclient import MasterClient
+
+pytestmark = pytest.mark.chaos
+
+TEST_GEO = Geometry(large_block=10000, small_block=100)
+
+
+def _free_port() -> int:
+    """A free HTTP port whose +10000 gRPC sibling is also free — servers
+    derive their gRPC listener from the HTTP port, so probing only one
+    of the pair invites bind collisions across the suite."""
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        if port + 10000 > 65535:
+            continue
+        with socket.socket() as s2:
+            try:
+                s2.bind(("", port + 10000))
+            except OSError:
+                continue
+        return port
+    raise RuntimeError("no free port pair found")
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_failpoints():
+    failpoint.clear()
+    yield
+    failpoint.clear()
+
+
+@pytest.fixture(scope="module")
+def chaos_cluster(tmp_path_factory):
+    """master + 2 volume servers (replication 001) + filer."""
+    old_native = os.environ.get("SEAWEEDFS_TPU_NATIVE")
+    os.environ["SEAWEEDFS_TPU_NATIVE"] = "0"
+    tmp = tmp_path_factory.mktemp("chaos")
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    volumes = []
+    for i in range(2):
+        vsrv = VolumeServer(
+            directories=[str(tmp / f"vol{i}")],
+            master=f"localhost:{mport}", ip="localhost",
+            port=_free_port(), pulse_seconds=1, ec_geometry=TEST_GEO)
+        vsrv.start()
+        volumes.append(vsrv)
+    fsrv = FilerServer(ip="localhost", port=_free_port(),
+                       master=f"localhost:{mport}",
+                       store_dir=str(tmp / "filer"),
+                       chunk_size=32 * 1024, replication="001")
+    fsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topo.nodes) < 2:
+        time.sleep(0.05)
+    assert len(master.topo.nodes) == 2, "volume servers did not register"
+    yield master, volumes, fsrv
+    fsrv.stop()
+    for v in volumes:
+        v.stop()
+    master.stop()
+    rpc.reset_channels()
+    if old_native is None:
+        os.environ.pop("SEAWEEDFS_TPU_NATIVE", None)
+    else:
+        os.environ["SEAWEEDFS_TPU_NATIVE"] = old_native
+
+
+# -- volume plane: replica failover ----------------------------------------
+
+def test_filer_read_survives_flaky_replica(chaos_cluster):
+    """20% of reads on one replica fail; every filer read still returns
+    the right bytes (acceptance scenario #1)."""
+    master, volumes, fsrv = chaos_cluster
+    payload = np.random.default_rng(1).integers(
+        0, 256, size=150_000, dtype=np.uint8).tobytes()
+    base = f"http://{fsrv.address}"
+    r = requests.put(f"{base}/chaos/flaky.bin", data=payload, timeout=30)
+    assert r.status_code in (200, 201), r.text
+    with failpoint.active("volume.http.read", p=0.2, seed=7,
+                          match=volumes[0].address + ",") as fp:
+        for _ in range(25):
+            got = requests.get(f"{base}/chaos/flaky.bin", timeout=30)
+            assert got.status_code == 200
+            assert got.content == payload
+        assert fp.hits > 0, "chaos never fired — test is vacuous"
+
+
+def test_filer_read_survives_dead_replica(chaos_cluster):
+    """One replica 100% dead for reads: still zero client-visible
+    errors via the surviving replica."""
+    master, volumes, fsrv = chaos_cluster
+    payload = b"replica-down " * 4000
+    base = f"http://{fsrv.address}"
+    assert requests.put(f"{base}/chaos/dead.bin", data=payload,
+                        timeout=30).status_code in (200, 201)
+    with failpoint.active("volume.http.read", p=1.0,
+                          match=volumes[1].address + ",") as fp:
+        for _ in range(10):
+            got = requests.get(f"{base}/chaos/dead.bin", timeout=30)
+            assert got.status_code == 200
+            assert got.content == payload
+        assert fp.hits > 0
+
+
+# -- EC plane: reconstruct around lost shards ------------------------------
+
+def test_ec_read_with_four_lost_shards(chaos_cluster):
+    """Lose 4 data shards of an EC volume; reads reconstruct from the
+    remaining 10 (acceptance scenario #2), over HTTP and through the
+    wdclient EC-fallback ladder."""
+    master, volumes, _ = chaos_cluster
+    rng = np.random.default_rng(0)
+    blobs, fids = {}, []
+    for i in range(12):
+        data = rng.integers(0, 256, size=int(rng.integers(200, 4000)),
+                            dtype=np.uint8).tobytes()
+        res = submit(master.address, data, filename=f"c{i}.bin",
+                     collection="chaosec")
+        assert "fid" in res, res
+        fids.append(res["fid"])
+        blobs[res["fid"]] = data
+    vid = parse_file_id(fids[0]).volume_id
+    vsrv = next(v for v in volumes if v.store.has_volume(vid))
+    stub = rpc.volume_stub(rpc.grpc_address(vsrv.address))
+    stub.VolumeMarkReadonly(vs.VolumeMarkReadonlyRequest(volume_id=vid),
+                            timeout=30)
+    stub.VolumeEcShardsGenerate(
+        vs.VolumeEcShardsGenerateRequest(volume_id=vid,
+                                         collection="chaosec"),
+        timeout=120)
+    stub.VolumeUnmount(vs.VolumeUnmountRequest(volume_id=vid), timeout=30)
+    stub.VolumeEcShardsMount(
+        vs.VolumeEcShardsMountRequest(volume_id=vid, collection="chaosec",
+                                      shard_ids=list(range(14))),
+        timeout=30)
+    deadline = time.time() + 10
+    while time.time() < deadline:
+        if vid in master.topo.ec_shard_map and vid not in {
+                v for n in master.topo.nodes.values() for v in n.volumes}:
+            break
+        time.sleep(0.1)
+
+    same_fid = [f for f in fids if parse_file_id(f).volume_id == vid]
+    assert same_fid
+    lost = "|".join(f"shard={i}," for i in range(4))
+    with failpoint.active("ec.shard.read", p=1.0, match=lost) as fp:
+        for fid in same_fid:
+            got = requests.get(f"http://{vsrv.address}/{fid}", timeout=60)
+            assert got.status_code == 200, (fid, got.status_code)
+            assert got.content == blobs[fid]
+        assert fp.hits > 0, "no shard read was ever injected"
+        # wdclient ladder: plain lookup has no replica left -> EC
+        # fallback serves the bytes from shard holders
+        mc = MasterClient(master.address)
+        for fid in same_fid[:3]:
+            urls = mc.ec_fallback_urls(fid)
+            assert urls, "EC fallback found no shard holders"
+            assert requests.get(urls[0], timeout=60).content == blobs[fid]
+
+
+# -- master plane: leader outage -------------------------------------------
+
+def test_assign_survives_transient_leader_outage(chaos_cluster):
+    """The first Assign RPC is injected dead (UNAVAILABLE); the retry
+    cycle re-asks after backoff and the assign completes."""
+    master, _, _ = chaos_cluster
+    # replication 001 reuses the cluster's existing writable volumes —
+    # the module cluster is deliberately slot-full by now, and this
+    # scenario is about the RPC retry, not volume growth
+    with failpoint.active("pb.Assign", p=1.0, count=1) as fp:
+        a = assign(master.address, replication="001")
+        assert not a.error and a.fid
+        assert fp.hits == 1
+
+
+def test_assign_fails_over_to_new_raft_leader(tmp_path):
+    """Kill the real raft leader; assign() walks the master list (dead
+    leader first) to whoever leads now (acceptance scenario #3)."""
+    ports = [_free_port() for _ in range(3)]
+    addrs = [f"localhost:{p}" for p in ports]
+    masters = []
+    for p in ports:
+        ms = MasterServer(ip="localhost", port=p, volume_size_limit_mb=64,
+                          peers=list(addrs), raft_dir=str(tmp_path))
+        ms.start(vacuum_interval=3600)
+        masters.append(ms)
+    vsrv = VolumeServer(directories=[str(tmp_path / "v")],
+                        master=",".join(addrs), ip="localhost",
+                        port=_free_port(), pulse_seconds=1)
+    vsrv.start()
+    try:
+        def wait_leader(pool, timeout=45.0):
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                leaders = [m for m in pool if m.is_leader()]
+                if len(leaders) == 1:
+                    return leaders[0]
+                time.sleep(0.1)
+            return None
+
+        leader = wait_leader(masters)
+        assert leader is not None
+        deadline = time.time() + 45
+        while time.time() < deadline and not leader.topo.nodes:
+            time.sleep(0.1)
+        assert leader.topo.nodes
+
+        leader.stop()
+        survivors = [m for m in masters if m is not leader]
+        new_leader = wait_leader(survivors)
+        assert new_leader is not None, "no re-election after leader loss"
+        deadline = time.time() + 45
+        while time.time() < deadline and not new_leader.topo.nodes:
+            time.sleep(0.1)
+        assert new_leader.topo.nodes, "volume server never re-registered"
+
+        # dead leader deliberately FIRST in the list the client dials
+        ordered = [leader.address] + [m.address for m in survivors]
+        a = assign(",".join(ordered))
+        assert not a.error and a.fid, a.error
+
+        # wdclient re-resolves leadership the same way: starting from
+        # the dead leader, RaftListClusterServers via any survivor
+        # repoints the client at whoever leads now
+        mc = MasterClient(ordered)
+        assert mc.resolve_leader() == new_leader.address
+        assert mc.current_master == new_leader.address
+    finally:
+        vsrv.stop()
+        for ms in masters:
+            ms.stop()
+        rpc.reset_channels()
+
+
+# -- filer metadata plane: flapping store backend --------------------------
+
+def test_filer_write_survives_store_flaps(chaos_cluster):
+    """Three consecutive store mutations fail; RetryingStore absorbs
+    them and the PUT still lands (then reads back)."""
+    _, _, fsrv = chaos_cluster
+    base = f"http://{fsrv.address}"
+    with failpoint.active("filer.store.mutate", p=1.0, count=3) as fp:
+        r = requests.put(f"{base}/chaosfs/retry.txt", data=b"survives",
+                         timeout=30)
+        assert r.status_code in (200, 201), r.text
+        assert fp.hits == 3
+    got = requests.get(f"{base}/chaosfs/retry.txt", timeout=30)
+    assert got.status_code == 200 and got.content == b"survives"
+
+
+# -- replication plane: flapping sink --------------------------------------
+
+class _StaticSource:
+    def read_entry_content(self, entry: filer_pb2.Entry) -> bytes:
+        return bytes(entry.content)
+
+
+def _create_event(directory: str, name: str, data: bytes):
+    ev = filer_pb2.SubscribeMetadataResponse(directory=directory)
+    ev.event_notification.new_entry.name = name
+    ev.event_notification.new_entry.content = data
+    return ev
+
+
+def test_replication_sink_survives_flaps(tmp_path):
+    """The sink bounces the first two applies; the Replicator retries
+    instead of dropping the event (acceptance scenario #4)."""
+    sink_dir = tmp_path / "mirror"
+    repl = Replicator(_StaticSource(), LocalSink(str(sink_dir)),
+                      source_prefix="/src", sink_wait_init=0.01)
+    with failpoint.active("replication.sink", p=1.0, count=2) as fp:
+        assert repl.replicate(_create_event("/src", "a.txt", b"flap"))
+        assert fp.hits == 2
+    assert (sink_dir / "a.txt").read_bytes() == b"flap"
+
+    # a sink that stays down must surface, not silently drop the event
+    with failpoint.active("replication.sink", p=1.0):
+        with pytest.raises(IOError):
+            repl.replicate(_create_event("/src", "b.txt", b"lost?"))
+    assert not (sink_dir / "b.txt").exists()
+
+
+def test_env_spec_grammar_expresses_shard_targeting():
+    """The `@match` part of an SWFS_FAILPOINTS item must round-trip the
+    documented shard-targeting form: comma-terminated shard ids with
+    `|`-joined alternatives. (Regression: a `;`-terminated ctx
+    convention made `@shard=1;` unparseable — the `;` was eaten as the
+    item separator, and `|`-alternatives crashed load_env at import.)"""
+    failpoint.load_env("ec.shard.read=error(1.0)@shard=1,|shard=4,;"
+                       "pb.Assign=error(0.5x2)")
+    try:
+        assert failpoint.is_armed("ec.shard.read")
+        assert failpoint.is_armed("pb.Assign")
+        with pytest.raises(failpoint.FailpointError):
+            failpoint.fail("ec.shard.read", ctx="v1 shard=4,")
+        # shard=10 must NOT be hit by the shard=1 alternative
+        failpoint.fail("ec.shard.read", ctx="v1 shard=10,")
+        failpoint.fail("ec.shard.read", ctx="v1 shard=2,")
+    finally:
+        failpoint.clear()
+
+
+# -- subprocess stacks: SWFS_FAILPOINTS env bootstrap ----------------------
+
+def test_env_failpoint_drives_subprocess_server(tmp_path):
+    """A spawned `weed server` arms failpoints from SWFS_FAILPOINTS: the
+    first volume read 500s, the x1 count bound then expires and the
+    retry succeeds — proving the chaos plumbing reaches real
+    subprocess stacks, not just in-process servers."""
+    mport, vport = _free_port(), _free_port()
+    env = dict(os.environ, SEAWEEDFS_TPU_CODER="native",
+               SWFS_FAILPOINTS="volume.http.read=error(1.0x1)")
+    env.pop("SEAWEEDFS_TPU_NATIVE", None)
+    log_path = tmp_path / "server.log"
+    with open(log_path, "w") as log:
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "seaweedfs_tpu", "server",
+             "-dir", str(tmp_path), "-master.port", str(mport),
+             "-volume.port", str(vport),
+             "-volume.nativeDataPlane", "off"],
+            env=env, stdout=log, stderr=subprocess.STDOUT)
+    try:
+        deadline = time.time() + 120
+        res = None
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                pytest.fail("server died at startup:\n"
+                            + log_path.read_text()[-2000:])
+            try:
+                res = submit(f"localhost:{mport}", b"env-chaos",
+                             filename="e.bin")
+                if "fid" in res:
+                    break
+            except Exception:
+                pass
+            time.sleep(1.0)
+        assert res and "fid" in res, res
+        url = f"http://{res['url']}/{res['fid']}"
+        first = requests.get(url, timeout=10)
+        assert first.status_code == 500, "env failpoint never armed"
+        second = requests.get(url, timeout=10)
+        assert second.status_code == 200
+        assert second.content == b"env-chaos"
+    finally:
+        proc.terminate()
+        try:
+            proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            proc.kill()

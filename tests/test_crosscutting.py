@@ -84,11 +84,16 @@ def test_compression():
     assert gunzip_data(gzip_data(data)) == data
     assert maybe_decompress(gzip_data(data)) == data
     assert maybe_decompress(data) == data
-    assert unzstd_data(zstd_data(data)) == data
-    assert maybe_decompress(zstd_data(data)) == data
     assert is_gzippable(ext=".txt")
     assert not is_gzippable(ext=".jpg")
     assert not is_gzippable(mime="video/mp4")
+
+
+def test_zstd_compression():
+    pytest.importorskip("zstandard")
+    data = b"aaaa" * 1000
+    assert unzstd_data(zstd_data(data)) == data
+    assert maybe_decompress(zstd_data(data)) == data
 
 
 def test_cipher_roundtrip():
@@ -169,3 +174,84 @@ def test_stats_render():
     assert "test_gauge 7" in text
     assert "test_hist_seconds_count" in text
     assert "# TYPE test_hist_seconds histogram" in text
+
+
+def test_grace_hooks_run_once_when_sigterm_races_atexit():
+    """utils/grace: the SIGTERM handler and atexit both call
+    _run_hooks; the drain-under-lock means each hook runs exactly once
+    no matter how many shutdown paths race, and a hook that raises
+    (even SystemExit from a sys.exit() in a callback) must not block
+    the remaining hooks."""
+    import threading
+
+    from seaweedfs_tpu.utils import grace
+
+    with grace._hooks_lock:
+        saved, grace._hooks[:] = list(grace._hooks), []
+    try:
+        calls = []
+        grace.on_interrupt(lambda: calls.append("first"))
+
+        def exploding():
+            calls.append("boom")
+            raise SystemExit(1)
+
+        grace.on_interrupt(exploding)
+        grace.on_interrupt(lambda: calls.append("last"))
+
+        barrier = threading.Barrier(4)
+
+        def shutdown_path():
+            barrier.wait()
+            grace._run_hooks()
+
+        threads = [threading.Thread(target=shutdown_path)
+                   for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # LIFO order, each hook exactly once no matter which path won,
+        # and the raising hook did not block the one registered first
+        assert calls == ["last", "boom", "first"]
+        # a later shutdown path (atexit after SIGTERM) finds nothing
+        grace._run_hooks()
+        assert calls == ["last", "boom", "first"]
+    finally:
+        with grace._hooks_lock:
+            grace._hooks[:] = saved
+
+
+def test_grace_signal_handler_exits_after_hooks():
+    import signal
+
+    from seaweedfs_tpu.utils import grace
+
+    with grace._hooks_lock:
+        saved, grace._hooks[:] = list(grace._hooks), []
+    try:
+        ran = []
+        grace.on_interrupt(lambda: ran.append(True))
+        with pytest.raises(SystemExit) as exc:
+            grace._run_hooks_and_exit(signal.SIGTERM, None)
+        assert ran == [True]
+        assert exc.value.code == 128 + signal.SIGTERM
+    finally:
+        with grace._hooks_lock:
+            grace._hooks[:] = saved
+
+
+def test_cipher_gcm_known_answer():
+    """AES-256-GCM spec test case 14 (zero key/IV/plaintext) pins the
+    pure-python fallback in utils/cipher byte-for-byte, independent of
+    whether the `cryptography` wheel is installed."""
+    from seaweedfs_tpu.utils.cipher import _gcm
+
+    key, nonce = bytes(32), bytes(12)
+    sealed = _gcm(key, nonce, bytes(16), seal=True)
+    assert sealed.hex() == ("cea7403d4d606b6e074ec5d3baf39d18"
+                            "d0d1c8a799996bf0265b98b5d48ab919")
+    assert _gcm(key, nonce, sealed, seal=False) == bytes(16)
+    tampered = bytes([sealed[0] ^ 1]) + sealed[1:]
+    with pytest.raises(ValueError):
+        _gcm(key, nonce, tampered, seal=False)

@@ -109,11 +109,12 @@ def test_new_coder_resolves_to_mesh():
     assert np.array_equal(np.asarray(c.encode_parity(data)), ref)
 
 
+@pytest.mark.slow
 def test_generate_ec_files_mesh_bit_identical(tmp_path):
     """generate_ec_files + rebuild_ec_files through the default production
     coder (mesh-sharded on this 8-device suite) are byte-identical to the
     CPU oracle's shard files — odd payload size, different drop set than
-    the dryrun's."""
+    the dryrun's. Minutes of GF math through 8 virtual CPU devices."""
     import __graft_entry__ as ge
 
     from seaweedfs_tpu.models.coder import new_coder

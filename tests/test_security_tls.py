@@ -11,6 +11,8 @@ import grpc
 import pytest
 import requests
 
+pytest.importorskip("cryptography")  # cert generation needs the wheel
+
 from seaweedfs_tpu.pb import master_pb2, rpc
 from seaweedfs_tpu.server.filer import FilerServer
 from seaweedfs_tpu.server.master import MasterServer

@@ -253,6 +253,60 @@ def test_remote_shell_commands(cluster, tmp_path_factory):
         fs.stop()
 
 
+def test_remote_cache_marker_rides_content_write(cluster,
+                                                 tmp_path_factory):
+    """ADVICE r5: CacheRemoteObjectToLocalCluster must attach the
+    remote marker in the SAME store write as the cached bytes. The old
+    two-step (write_file, then a separate update_entry re-attaching the
+    marker) left a cached entry unrecognized as remote — breaking
+    remote.uncache/meta.sync for it — whenever the second write failed
+    or the process crashed between the two."""
+    import os
+
+    from seaweedfs_tpu.remote_storage import REMOTE_ENTRY_KEY
+    from seaweedfs_tpu.server.filer import FilerServer
+
+    master, vols, env = cluster
+    remote_root = str(tmp_path_factory.mktemp("remote2"))
+    os.makedirs(f"{remote_root}/data", exist_ok=True)
+    with open(f"{remote_root}/data/m.txt", "w") as f:
+        f.write("marked")
+    fs = FilerServer(ip="localhost", port=_free_port(),
+                     master=master.address,
+                     store_dir=str(tmp_path_factory.mktemp("rfiler2")))
+    fs.start()
+    env.filer = f"localhost:{fs.port}"
+    try:
+        _run(env, f"remote.configure -name=loc2 -type=local "
+                  f"-root={remote_root}")
+        requests.put(f"http://localhost:{fs.port}/buckets/rm2/.keep",
+                     data=b"", timeout=10)
+        _run(env, "remote.mount -dir=/buckets/rm2 -remote=loc2/data")
+        # listing materializes the remote stub entries locally
+        requests.get(f"http://localhost:{fs.port}/buckets/rm2/",
+                     headers={"Accept": "application/json"}, timeout=10)
+
+        def fail_update(*_a, **_k):  # any follow-up write IS the bug
+            raise IOError("marker must ride the content write")
+
+        orig = fs.filer.update_entry
+        fs.filer.update_entry = fail_update
+        try:
+            _run(env, "remote.cache -dir=/buckets/rm2/m.txt")
+        finally:
+            fs.filer.update_entry = orig
+        e = fs.filer.find_entry("/buckets/rm2/m.txt")
+        assert e.extended.get(REMOTE_ENTRY_KEY), "remote marker dropped"
+        got = requests.get(
+            f"http://localhost:{fs.port}/buckets/rm2/m.txt", timeout=10)
+        assert got.status_code == 200 and got.content == b"marked"
+        # still recognized as remote: uncache evicts the local copy
+        _run(env, "remote.uncache -dir=/buckets/rm2/m.txt")
+    finally:
+        env.filer = None
+        fs.stop()
+
+
 def test_fs_meta_cat(cluster, tmp_path_factory):
     from seaweedfs_tpu.server.filer import FilerServer
 

@@ -257,7 +257,12 @@ def test_filer_toml_selects_store(redis_server, tmp_path, monkeypatch):
     fs = FilerServer(ip="localhost", port=_free_port(),
                      master="localhost:1", store="sqlite")
     try:
-        assert isinstance(fs.filer.store, RedisStore)
+        # the server always interposes the transient-fault retry layer;
+        # the toml-selected backend sits right under it
+        from seaweedfs_tpu.filer.filerstore import RetryingStore
+
+        assert isinstance(fs.filer.store, RetryingStore)
+        assert isinstance(fs.filer.store.store, RedisStore)
         # and it actually works against the live RESP server
         fs.filer.create_entry(Entry(full_path="/toml/picked",
                                     attr=Attr(mtime=7)))
@@ -1767,6 +1772,78 @@ def test_ydb_store_backs_live_filer(ydb_server, tmp_path):
         vsrv.stop()
         master.stop()
         rpc.reset_channels()
+
+
+def test_ydb_prefix_like_wildcards_escaped(ydb_server):
+    """ADVICE r5: a listing prefix containing '_' must match literally.
+    Unescaped, LIKE 'my_%' also matched every 'myX...' sibling; the
+    wildcard rows consumed the server-side LIMIT ('myA' sorts before
+    'my_', so they fill the entire first page), were dropped
+    client-side without advancing `emitted`, and the loop then stopped
+    on the LIMIT-completed (non-truncated) page — silently dropping
+    every real match from the listing."""
+    store = get_store("ydb", dsn=f"grpc://localhost:{ydb_server.port}/local")
+    f = Filer(store)
+    for i in range(8):
+        f.create_entry(Entry(full_path=f"/like/esc/my_{i}"))
+        f.create_entry(Entry(full_path=f"/like/esc/myA{i}"))
+    assert [e.name for e in store.list_directory_entries(
+        "/like/esc", prefix="my_", limit=5)] == \
+        [f"my_{i}" for i in range(5)]
+    assert [e.name for e in store.list_directory_entries(
+        "/like/esc", prefix="my_", limit=1000)] == \
+        [f"my_{i}" for i in range(8)]
+    # '%' in a name is data, not an any-run wildcard
+    f.create_entry(Entry(full_path="/like/esc/p%q"))
+    f.create_entry(Entry(full_path="/like/esc/pXq"))
+    assert [e.name for e in store.list_directory_entries(
+        "/like/esc", prefix="p%", limit=10)] == ["p%q"]
+    store.close()
+
+
+def test_ydb_grpcs_dsn_dials_tls(ydb_server, monkeypatch):
+    """ADVICE r5: a grpcs:// DSN must dial a secure channel — silently
+    downgrading to plaintext leaks metadata on the wire — and unknown
+    schemes must raise instead of being ignored."""
+    import grpc
+
+    dialed = {}
+    insecure = grpc.insecure_channel
+
+    def fake_secure(endpoint, creds, *args, **kwargs):
+        dialed["endpoint"] = endpoint
+        dialed["creds"] = creds
+        return insecure(endpoint)  # the fake server speaks plaintext
+
+    monkeypatch.setattr(grpc, "secure_channel", fake_secure)
+    store = get_store(
+        "ydb", dsn=f"grpcs://localhost:{ydb_server.port}/local")
+    assert dialed["endpoint"] == f"localhost:{ydb_server.port}"
+    assert isinstance(dialed["creds"], grpc.ChannelCredentials)
+    f = Filer(store)
+    f.create_entry(Entry(full_path="/tls/x", attr=Attr(mtime=5)))
+    assert store.find_entry("/tls/x").attr.mtime == 5
+    store.close()
+    with pytest.raises(ValueError, match="scheme"):
+        get_store("ydb", dsn=f"http://localhost:{ydb_server.port}/local")
+
+
+def test_resp_transaction_abort_surfaces_as_error(redis_server):
+    """ADVICE r5: EXEC replying nil (transaction aborted server-side,
+    e.g. a WATCH conflict or cluster failover) must raise — returning
+    None let callers like redis3's segment split mistake an aborted
+    transaction for a commit."""
+    from seaweedfs_tpu.filer.stores.redis import RespClient, RespError
+
+    c = RespClient("localhost", redis_server.port)
+    redis_server.abort_next_exec = True
+    with pytest.raises(RespError, match="aborted"):
+        c.transaction(("SET", b"aborted-key", b"v"))
+    # the queued commands were NOT applied, and the reply stream is
+    # still in sync (the nil was fully consumed)
+    assert c.cmd("GET", b"aborted-key") is None
+    assert c.cmd("PING") == "PONG"
+    c.close()
 
 
 def test_redis_lua_store_scripts(redis_server):
