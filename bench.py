@@ -31,6 +31,22 @@ import time
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 
+
+def _last_json_line(text) -> dict | None:
+    """Last parseable JSON object in a child's stdout (children print
+    progress/noise before the result line; watchdog kills can leave a
+    torn tail)."""
+    if isinstance(text, bytes):
+        text = text.decode(errors="replace")
+    for line in reversed((text or "").strip().splitlines()):
+        try:
+            out = json.loads(line)
+        except ValueError:
+            continue
+        if isinstance(out, dict):
+            return out
+    return None
+
 # Child: init backend, run the device encode bench, print one JSON line.
 _DEVICE_PROG = r"""
 import json, os, sys, time, traceback
@@ -260,6 +276,45 @@ except Exception as e:
 """
 
 
+# Tiny child: just initialize the backend and name it. jax.devices() over
+# a wedged axon tunnel HANGS rather than raising (r05 burned the full
+# 540s device timeout twice discovering that), so the probe's only job is
+# to fail FAST and let the bench skip straight to the CPU/last-good path.
+_PROBE_PROG = r"""
+import json, sys
+try:
+    import jax
+    print(json.dumps({"backend": jax.default_backend()}), flush=True)
+except Exception as e:
+    print(json.dumps({"error": f"{type(e).__name__}: {e}"[:300]}), flush=True)
+"""
+
+
+def _probe_device_backend() -> dict:
+    """-> {"backend": name} | {"error": ...} | {"timeout": seconds}.
+    Only a TIMEOUT skips the device bench outright (wedged tunnel); an
+    error child still lets _bench_device retry (a held chip can free up
+    between its attempts). The default timeout is a third of the
+    device-bench budget so a slow-but-healthy cold backend init (which
+    would have fit the 540s attempt) isn't misread as a wedge."""
+    bench_budget = float(os.environ.get("SEAWEEDFS_TPU_BENCH_TIMEOUT",
+                                        "540"))
+    timeout = float(os.environ.get("SEAWEEDFS_TPU_PROBE_TIMEOUT",
+                                   str(max(75.0, bench_budget / 3))))
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _PROBE_PROG], cwd=_HERE,
+            capture_output=True, text=True, timeout=timeout)
+        out = _last_json_line(proc.stdout)
+        if out is not None:
+            return out
+        return {"error": f"probe rc={proc.returncode}: {proc.stderr[-200:]}"}
+    except subprocess.TimeoutExpired:
+        return {"timeout": timeout}
+    except Exception as e:  # pragma: no cover - defensive
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def _bench_device() -> dict:
     """Run the device bench in a subprocess with timeout + retries."""
     attempts = int(os.environ.get("SEAWEEDFS_TPU_BENCH_ATTEMPTS", "2"))
@@ -275,9 +330,8 @@ def _bench_device() -> dict:
                 cwd=_HERE, capture_output=True, text=True,
                 timeout=per_timeout,
             )
-            line = proc.stdout.strip().splitlines()[-1] if proc.stdout.strip() else ""
-            if line:
-                out = json.loads(line)
+            out = _last_json_line(proc.stdout)
+            if out is not None:
                 if "gbps" in out:
                     return out
                 last = out.get("error", "unknown child error")
@@ -286,17 +340,10 @@ def _bench_device() -> dict:
         except subprocess.TimeoutExpired as e:
             # the child prints the headline line before the secondary
             # benches — salvage it if only the extras wedged
-            partial = e.stdout or ""
-            if isinstance(partial, bytes):
-                partial = partial.decode(errors="replace")
-            for pline in reversed(partial.strip().splitlines() or []):
-                try:
-                    out = json.loads(pline)
-                except ValueError:
-                    continue
-                if "gbps" in out:
-                    out["note"] = "secondary benches timed out"
-                    return out
+            out = _last_json_line(e.stdout or "")
+            if out is not None and "gbps" in out:
+                out["note"] = "secondary benches timed out"
+                return out
             last = f"device bench attempt timed out after {per_timeout:.0f}s (tunnel wedged or chip held)"
         except Exception as e:
             last = f"{type(e).__name__}: {e}"
@@ -388,13 +435,9 @@ def _bench_smallfile_once() -> dict:
             capture_output=True, text=True,
             timeout=float(os.environ.get("SEAWEEDFS_TPU_SMALLFILE_TIMEOUT",
                                          "180")))
-        for line in reversed(proc.stdout.strip().splitlines() or []):
-            try:
-                out = json.loads(line)
-            except ValueError:
-                continue
-            if "writes_per_sec" in out:
-                return out
+        out = _last_json_line(proc.stdout)
+        if out is not None and "writes_per_sec" in out:
+            return out
         return {"error": f"rc={proc.returncode}: {proc.stderr[-200:]}"}
     except subprocess.TimeoutExpired:
         return {"error": "smallfile bench timed out"}
@@ -478,7 +521,21 @@ def main() -> int:
             result["smallfile_writes_spread_pct"] = sf["writes_spread_pct"]
     else:
         result["smallfile_error"] = sf.get("error", "?")[:200]
-    dev = _bench_device()
+    probe = _probe_device_backend()
+    if "timeout" in probe:
+        # the tunnel is wedged RIGHT NOW: attempting the device bench
+        # would burn attempts x 540s to learn the same thing — go
+        # straight to the last-good fallback path below
+        dev = {"error": f"device probe timed out after "
+                        f"{probe['timeout']:.0f}s (tunnel wedged); "
+                        f"device bench skipped"}
+        result["device_probe"] = "timeout"
+    else:
+        if "backend" in probe:
+            result["device_probe"] = probe["backend"]
+        else:
+            result["device_probe"] = f"error: {probe.get('error', '?')}"[:200]
+        dev = _bench_device()
     ok = "gbps" in dev
     if ok:
         result["value"] = round(dev["gbps"], 3)

@@ -147,25 +147,39 @@ struct NeedleValue {
   int32_t size;            // body size field; <=0 invalid
 };
 
+// Shared descriptor ownership: readers copy the shared_ptr under the
+// volume mutex (no syscall) and pread after unlocking; a reload
+// (vacuum commit) swaps in a new owner while in-flight readers keep the
+// pre-reload inode alive. This replaces the old dup()+close() pair that
+// cost two syscalls on EVERY GET.
+struct FdOwner {
+  int fd = -1;
+  explicit FdOwner(int f) : fd(f) {}
+  FdOwner(const FdOwner&) = delete;
+  FdOwner& operator=(const FdOwner&) = delete;
+  ~FdOwner() {
+    if (fd >= 0) close(fd);
+  }
+};
+
 struct Volume {
   uint32_t vid = 0;
   std::string dat_path, idx_path;
-  int dat_fd = -1, idx_fd = -1;
+  std::shared_ptr<FdOwner> dat, idx;
   int version = 3;
   bool writable = true;
   std::mutex mu;  // guards appends + map mutation + counters
   std::unordered_map<uint64_t, NeedleValue> map;
   int64_t idx_loaded = 0;  // bytes of .idx reflected in `map`
   int64_t dat_size = 0;
+  int64_t idx_size = 0;  // append offset: tracked, not lseek'd per PUT
   uint64_t last_append_ns = 0;
   uint64_t max_key = 0;
   int64_t file_count = 0, file_bytes = 0;
   int64_t del_count = 0, del_bytes = 0;
 
-  ~Volume() {
-    if (dat_fd >= 0) close(dat_fd);
-    if (idx_fd >= 0) close(idx_fd);
-  }
+  int dat_fd() const { return dat ? dat->fd : -1; }
+  int idx_fd() const { return idx ? idx->fd : -1; }
 
   // Apply one idx entry to the in-memory map (NeedleMap._load semantics).
   void apply(uint64_t key, uint32_t off, int32_t size) {
@@ -194,11 +208,12 @@ struct Volume {
   // Read .idx entries in [idx_loaded, EOF) into the map. mu held.
   bool catchup() {
     struct stat st;
-    if (fstat(idx_fd, &st) != 0) return false;
+    if (fstat(idx_fd(), &st) != 0) return false;
+    if (st.st_size > idx_size) idx_size = st.st_size;
     if (st.st_size <= idx_loaded) return true;
     int64_t want = st.st_size - idx_loaded;
     std::vector<uint8_t> buf(want);
-    int64_t got = pread(idx_fd, buf.data(), want, idx_loaded);
+    int64_t got = pread(idx_fd(), buf.data(), want, idx_loaded);
     if (got < 0) return false;
     got -= got % 16;
     for (int64_t i = 0; i + 16 <= got; i += 16)
@@ -209,11 +224,19 @@ struct Volume {
   }
 
   bool open_files() {
-    dat_fd = open(dat_path.c_str(), O_RDWR | O_CREAT, 0644);
-    idx_fd = open(idx_path.c_str(), O_RDWR | O_CREAT, 0644);
-    if (dat_fd < 0 || idx_fd < 0) return false;
+    int dfd = open(dat_path.c_str(), O_RDWR | O_CREAT, 0644);
+    int ifd = open(idx_path.c_str(), O_RDWR | O_CREAT, 0644);
+    if (dfd < 0 || ifd < 0) {
+      if (dfd >= 0) close(dfd);
+      if (ifd >= 0) close(ifd);
+      return false;
+    }
+    // old owners (if any) release when the last in-flight reader drops
+    dat = std::make_shared<FdOwner>(dfd);
+    idx = std::make_shared<FdOwner>(ifd);
     struct stat st;
-    if (fstat(dat_fd, &st) == 0) dat_size = st.st_size;
+    if (fstat(dfd, &st) == 0) dat_size = st.st_size;
+    if (fstat(ifd, &st) == 0) idx_size = st.st_size;
     map.clear();
     idx_loaded = 0;
     file_count = file_bytes = del_count = del_bytes = 0;
@@ -234,13 +257,17 @@ struct Volume {
   // ns_off >= 0: stamp a fresh monotonic appendAtNs into blob[ns_off..+8).
   // idx_size: size field for the idx entry (kTombstone for deletes).
   // Returns byte offset in .dat, or -1. mu held.
-  int64_t append(uint8_t* blob, int64_t len, uint64_t key, int32_t idx_size,
+  int64_t append(uint8_t* blob, int64_t len, uint64_t key, int32_t ent_size,
                  int64_t ns_off, uint64_t* ns_out) {
-    int64_t off = lseek(dat_fd, 0, SEEK_END);
-    if (off < 0) return -1;
+    // dat_size/idx_size are authoritative (single writer under mu, both
+    // re-derived on open/reload): appends cost two pwrites, not the old
+    // two lseeks + two pwrites — syscalls dominate this hot path on the
+    // sandboxed kernels this serves.
+    int64_t off = dat_size;
     if (off % kPad) {  // realign a torn tail (volume.py _append_record)
       off += kPad - (off % kPad);
-      if (ftruncate(dat_fd, off) != 0) return -1;
+      if (ftruncate(dat_fd(), off) != 0) return -1;
+      dat_size = off;
     }
     if (off + len > kMaxVolumeSize) { errno = EFBIG; return -1; }
     if (ns_off >= 0) {
@@ -248,27 +275,28 @@ struct Volume {
       put_u64(blob + ns_off, ns);
       if (ns_out) *ns_out = ns;
     }
-    int64_t wr = pwrite(dat_fd, blob, len, off);
+    int64_t wr = pwrite(dat_fd(), blob, len, off);
     if (wr != len) {
-      (void)!ftruncate(dat_fd, off);
+      (void)!ftruncate(dat_fd(), off);
       return -1;
     }
     dat_size = off + len;
     uint8_t ent[16];
     put_u64(ent, key);
     put_u32(ent + 8, (uint32_t)(off / kPad));
-    put_u32(ent + 12, (uint32_t)idx_size);
-    int64_t ioff = lseek(idx_fd, 0, SEEK_END);
-    if (pwrite(idx_fd, ent, 16, ioff) != 16) {
+    put_u32(ent + 12, (uint32_t)ent_size);
+    int64_t ioff = idx_size;
+    if (pwrite(idx_fd(), ent, 16, ioff) != 16) {
       // an acknowledged-but-unindexed needle would 404 forever: undo the
       // .dat append and fail the request instead
-      (void)!ftruncate(idx_fd, ioff);
-      (void)!ftruncate(dat_fd, off);
+      (void)!ftruncate(idx_fd(), ioff);
+      (void)!ftruncate(dat_fd(), off);
       dat_size = off;
       return -1;
     }
+    idx_size = ioff + 16;
     if (ioff == idx_loaded) {
-      apply(key, (uint32_t)(off / kPad), idx_size);
+      apply(key, (uint32_t)(off / kPad), ent_size);
       idx_loaded += 16;
     } else {
       catchup();
@@ -755,31 +783,37 @@ void handle_get(Plane& pl, int fd, const Request& req, uint32_t vid,
   auto vol = pl.reg.find(vid);
   if (!vol) return redirect(fd, req, pl.redirect_port);
   NeedleValue nv{0, 0};
-  int rfd = -1;
+  bool no_dat = false;
+  std::shared_ptr<FdOwner> ref;
   {
     std::lock_guard<std::mutex> l(vol->mu);
+    no_dat = !vol->dat;
     auto it = vol->map.find(key);
     if (it == vol->map.end()) {
       vol->catchup();  // maybe written outside our map (reload races)
       it = vol->map.find(key);
     }
     if (it != vol->map.end()) nv = it->second;
-    // dup the fd while the map snapshot is consistent with it:
-    // swdp_reload_volume (vacuum commit) closes+reopens dat_fd under mu,
-    // so a bare pread after unlock could hit a closed/reused descriptor
-    // or the post-compaction file at a stale offset. The dup pins the
-    // pre-reload inode, against which nv's offset is valid.
-    if (nv.stored_offset != 0 && nv.size >= 0) rfd = dup(vol->dat_fd);
+    // pin the fd owner while the map snapshot is consistent with it:
+    // swdp_reload_volume (vacuum commit) swaps in a new owner under mu,
+    // so a bare pread after unlock could hit the post-compaction file
+    // at a stale offset. The shared_ptr copy keeps the pre-reload inode
+    // open, against which nv's offset is valid — no dup() syscall.
+    if (nv.stored_offset != 0 && nv.size >= 0) ref = vol->dat;
   }
+  if (no_dat)
+    // failed reload cleared the handles and the map: an empty map must
+    // NOT read as a definitive 404 (the filer's read ladder would stop
+    // failing over) — python owns the truth for this volume now
+    return redirect(fd, req, pl.redirect_port);
   if (nv.stored_offset == 0 || nv.size < 0)
     return respond(fd, req, 404, "text/plain", "", nullptr, 0);
-  if (rfd < 0)
-    return respond_json(fd, req, 500, "{\"error\":\"dup failed\"}");
+  if (!ref || ref->fd < 0)
+    return respond_json(fd, req, 500, "{\"error\":\"no dat file\"}");
   int64_t total = actual_size(nv.size, vol->version);
   std::vector<uint8_t> blob(total);
-  int64_t got = pread(rfd, blob.data(), total,
+  int64_t got = pread(ref->fd, blob.data(), total,
                       (int64_t)nv.stored_offset * kPad);
-  close(rfd);
   if (got != total)
     return respond_json(fd, req, 500, "{\"error\":\"short read\"}");
   ParsedNeedle n;
@@ -838,6 +872,13 @@ void handle_put(Plane& pl, int fd, const Request& req, uint32_t vid,
   auto vol = pl.reg.find(vid);
   if (!vol || !vol->writable)
     return redirect(fd, req, pl.redirect_port);
+  bool put_no_dat;
+  {
+    std::lock_guard<std::mutex> l(vol->mu);
+    put_no_dat = !vol->dat;
+  }
+  if (put_no_dat)  // handles cleared by a failed reload: python owns it
+    return redirect(fd, req, pl.redirect_port);
   std::string ct = req.header("content-type");
   if (ct.rfind("multipart/", 0) == 0)
     return redirect(fd, req, pl.redirect_port);
@@ -892,7 +933,7 @@ void handle_put(Plane& pl, int fd, const Request& req, uint32_t vid,
     if (it != vol->map.end() && it->second.size > 0) {
       int64_t old_total = actual_size(it->second.size, vol->version);
       std::vector<uint8_t> old(old_total);
-      if (pread(vol->dat_fd, old.data(), old_total,
+      if (pread(vol->dat_fd(), old.data(), old_total,
                 (int64_t)it->second.stored_offset * kPad) == old_total) {
         ParsedNeedle on;
         if (parse_record(old.data(), old_total, vol->version, &on)) {
@@ -930,6 +971,13 @@ void handle_delete(Plane& pl, int fd, const Request& req, uint32_t vid,
   auto vol = pl.reg.find(vid);
   if (!vol || !vol->writable)
     return redirect(fd, req, pl.redirect_port);
+  bool del_no_dat;
+  {
+    std::lock_guard<std::mutex> l(vol->mu);
+    del_no_dat = !vol->dat;
+  }
+  if (del_no_dat)  // handles cleared by a failed reload: python owns it
+    return redirect(fd, req, pl.redirect_port);
   int32_t freed = 0;
   {
     std::lock_guard<std::mutex> l(vol->mu);
@@ -940,7 +988,7 @@ void handle_delete(Plane& pl, int fd, const Request& req, uint32_t vid,
       return respond_json(fd, req, 404, "{\"size\": 0}");
     // cookie check against the stored record (volume.py delete_needle)
     uint8_t hdr[kHeaderSize];
-    if (pread(vol->dat_fd, hdr, kHeaderSize,
+    if (pread(vol->dat_fd(), hdr, kHeaderSize,
               (int64_t)it->second.stored_offset * kPad) == kHeaderSize) {
       if (get_u32(hdr) != cookie)
         return respond_json(fd, req, 403,
@@ -1383,7 +1431,7 @@ void handle_filer_get(FilerPlane& fp, int fd, const Request& req,
   if (!vol)
     return fp.redirects++, redirect(fd, req, fp.redirect_port);
   NeedleValue nv{0, 0};
-  int rfd = -1;
+  std::shared_ptr<FdOwner> ref;
   {
     std::lock_guard<std::mutex> l(vol->mu);
     auto it = vol->map.find(e.key);
@@ -1392,15 +1440,14 @@ void handle_filer_get(FilerPlane& fp, int fd, const Request& req,
       it = vol->map.find(e.key);
     }
     if (it != vol->map.end()) nv = it->second;
-    if (nv.stored_offset != 0 && nv.size >= 0) rfd = dup(vol->dat_fd);
+    if (nv.stored_offset != 0 && nv.size >= 0) ref = vol->dat;
   }
-  if (nv.stored_offset == 0 || nv.size < 0 || rfd < 0)
+  if (nv.stored_offset == 0 || nv.size < 0 || !ref || ref->fd < 0)
     return fp.redirects++, redirect(fd, req, fp.redirect_port);
   int64_t total = actual_size(nv.size, vol->version);
   std::vector<uint8_t> blob(total);
-  int64_t got = pread(rfd, blob.data(), total,
+  int64_t got = pread(ref->fd, blob.data(), total,
                       (int64_t)nv.stored_offset * kPad);
-  close(rfd);
   ParsedNeedle n;
   if (got != total ||
       !parse_record(blob.data(), total, vol->version, &n) ||
@@ -1660,10 +1707,21 @@ int swdp_reload_volume(int plane_id, uint32_t vid) {
   auto vol = find_volume(plane_id, vid);
   if (!vol) return -1;
   std::lock_guard<std::mutex> l(vol->mu);
-  if (vol->dat_fd >= 0) close(vol->dat_fd);
-  if (vol->idx_fd >= 0) close(vol->idx_fd);
-  vol->dat_fd = vol->idx_fd = -1;
-  return vol->open_files() ? 0 : -errno;
+  // open_files swaps in fresh FdOwners; the old descriptors close when
+  // the last in-flight reader releases its pinned shared_ptr
+  if (!vol->open_files()) {
+    int e = errno;
+    // fail LOUDLY: a failed reopen after vacuum commit must not leave
+    // the plane serving (and appending to) the pre-compaction inode —
+    // dropping the holders + map turns every request into an explicit
+    // error until a later reload succeeds
+    vol->dat.reset();
+    vol->idx.reset();
+    vol->map.clear();
+    vol->idx_loaded = 0;
+    return -(e ? e : EIO);
+  }
+  return 0;
 }
 
 int swdp_set_writable(int plane_id, uint32_t vid, int writable) {
@@ -1693,7 +1751,7 @@ int64_t swdp_read(int plane_id, uint32_t vid, uint64_t key, uint8_t** out) {
   auto vol = find_volume(plane_id, vid);
   if (!vol) return -ENOENT;
   NeedleValue nv{0, 0};
-  int rfd = -1;
+  std::shared_ptr<FdOwner> ref;
   {
     std::lock_guard<std::mutex> l(vol->mu);
     auto it = vol->map.find(key);
@@ -1702,19 +1760,16 @@ int64_t swdp_read(int plane_id, uint32_t vid, uint64_t key, uint8_t** out) {
       it = vol->map.find(key);
     }
     if (it != vol->map.end()) nv = it->second;
-    // see handle_get: pin the fd the snapshot refers to across reloads
-    if (nv.stored_offset != 0 && nv.size >= 0) rfd = dup(vol->dat_fd);
+    // see handle_get: pin the fd owner the snapshot refers to across
+    // reloads (shared_ptr copy, no dup syscall)
+    if (nv.stored_offset != 0 && nv.size >= 0) ref = vol->dat;
   }
   if (nv.stored_offset == 0 || nv.size < 0) return 0;
-  if (rfd < 0) return -EIO;
+  if (!ref || ref->fd < 0) return -EIO;
   int64_t total = actual_size(nv.size, vol->version);
   uint8_t* buf = (uint8_t*)malloc(total);
-  if (!buf) {
-    close(rfd);
-    return -ENOMEM;
-  }
-  int64_t got = pread(rfd, buf, total, (int64_t)nv.stored_offset * kPad);
-  close(rfd);
+  if (!buf) return -ENOMEM;
+  int64_t got = pread(ref->fd, buf, total, (int64_t)nv.stored_offset * kPad);
   if (got != total) {
     free(buf);
     return -EIO;
@@ -1826,8 +1881,10 @@ extern "C" int64_t swdp_bench(const char* host, int port, int is_put,
               "application/octet-stream\r\nContent-Length: ";
       head += std::to_string(plen);
       head += "\r\n\r\n";
+      // head + body in ONE send: small-file PUTs are syscall-bound on
+      // sandboxed kernels, and two sends also invite a delayed-ACK stall
+      head.append((const char*)payload, (size_t)plen);
       send_all(fd, head.data(), head.size());
-      send_all(fd, payload, (size_t)plen);
     } else {
       head += "GET /";
       head += fids[i];

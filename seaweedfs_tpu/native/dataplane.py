@@ -165,8 +165,21 @@ class NativeDataPlane:
     def remove_volume(self, vid: int) -> None:
         self.lib.swdp_remove_volume(self.plane_id, vid)
 
-    def reload_volume(self, vid: int) -> None:
-        self.lib.swdp_reload_volume(self.plane_id, vid)
+    def reload_volume(self, vid: int) -> bool:
+        """Reopen a volume's files after an external swap (vacuum
+        commit). On failure the C++ side already dropped its handles and
+        map; remove the volume from the plane too (requests 307 to
+        python, which is correct, instead of 404ing on a cleared map)
+        and report False so the caller detaches."""
+        rc = self.lib.swdp_reload_volume(self.plane_id, vid)
+        if rc >= 0:
+            return True
+        from ..utils import glog
+
+        self.lib.swdp_remove_volume(self.plane_id, vid)
+        glog.error(f"native plane reload of volume {vid} failed "
+                   f"(errno {-rc}); volume served by python")
+        return False
 
     def set_writable(self, vid: int, writable: bool) -> None:
         self.lib.swdp_set_writable(self.plane_id, vid, 1 if writable else 0)

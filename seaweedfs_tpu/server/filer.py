@@ -31,9 +31,17 @@ from ..filer.filerstore import RetryingStore, get_store
 from ..operation import assign, delete_files, thread_session, upload_data
 from ..pb import filer_pb2, master_pb2, rpc
 from ..utils import glog
+from ..utils.chunk_cache import TieredChunkCache
 from ..utils.http import not_modified
-from ..utils.stats import FILER_REQUEST_HISTOGRAM, gather
+from ..utils.stats import (
+    FILER_CHUNK_CACHE_COUNTER,
+    FILER_REQUEST_HISTOGRAM,
+    chunk_cache_stats,
+    fid_lease_stats,
+    gather,
+)
 from ..wdclient import MasterClient
+from ..wdclient.lease import FidLeasePool
 
 CHUNK_SIZE = 4 * 1024 * 1024  # maxMB default (command/filer.go)
 
@@ -101,6 +109,33 @@ class FilerServer:
 
             glog.warning(f"notification config ignored: {e}")
         self.master_client = MasterClient(master)
+        # batched fid leasing (ISSUE 2): N small-file chunk saves cost ~1
+        # master Assign RPC. SWFS_FID_LEASE_BATCH=1 degrades to one RPC
+        # per chunk (the pre-lease behavior).
+        import os as _os
+
+        self.fid_pool = FidLeasePool(
+            master,
+            batch=int(_os.environ.get("SWFS_FID_LEASE_BATCH", "128") or 1))
+        # filer-side chunk cache (ISSUE 2): the mount-only
+        # TieredChunkCache promoted to the filer's chunk-read ladder
+        # (and thereby the S3 gateway GET path, which streams through
+        # the filer). Keyed by fid; invalidated on chunk GC so an
+        # overwritten entry can never serve stale bytes.
+        cache_mb = int(_os.environ.get("SWFS_FILER_CACHE_MB", "64") or 0)
+        disk_mb = int(_os.environ.get("SWFS_FILER_CACHE_DISK_MB", "0") or 0)
+        cache_dir = None
+        if disk_mb > 0 and store_dir:
+            cache_dir = _os.path.join(store_dir, "chunk_cache")
+        if cache_mb > 0 or cache_dir:
+            self.chunk_cache = TieredChunkCache(
+                mem_bytes=max(cache_mb, 0) << 20, disk_dir=cache_dir,
+                disk_bytes=disk_mb << 20,
+                # disk-only mode: route every size to the disk tier (a
+                # 0-byte memory tier would silently drop small chunks)
+                mem_threshold=0 if cache_mb <= 0 else 1024 * 1024)
+        else:
+            self.chunk_cache = None
         self._http_server = None
         self._grpc_server = None
         # per-thread keepalive sessions: handler threads must not share
@@ -203,8 +238,12 @@ class FilerServer:
             except Exception as e:
                 glog.warning(f"filer hot plane unavailable: {e}")
                 http_port = self.port
-        self._http_server = TunedThreadingHTTPServer(
-            ("", http_port), _make_http_handler(self))
+        if self._http_server is None:
+            # _start_hot_plane binds the admin listener itself (it must
+            # know the REAL admin port before the C++ plane learns its
+            # redirect target); this path is hot-plane-off / fallback
+            self._http_server = TunedThreadingHTTPServer(
+                ("", http_port), _make_http_handler(self))
         threading.Thread(target=self._http_server.serve_forever,
                          daemon=True).start()
         self._start_aggregator()
@@ -267,10 +306,28 @@ class FilerServer:
         # high-port guard: a filer on e.g. :57000 must not derive an
         # admin port past 65535 (that crashed the whole server)
         self.admin_port = rpc.derived_admin_port(self.port)
-        self.hot_plane = NativeFilerPlane(
-            "", self.port, self.admin_port,
-            self._vol_plane.plane_id, log_path,
-            max_body=min(self.chunk_size, 4 << 20))
+        # bind the python admin listener BEFORE the C++ plane learns its
+        # redirect target: the deterministic +11000 port can be taken by
+        # another process (volume.py's start has the same fallback), and
+        # a 307 target must never point at a port we failed to bind
+        try:
+            self._http_server = TunedThreadingHTTPServer(
+                ("", self.admin_port), _make_http_handler(self))
+        except OSError:
+            self._http_server = TunedThreadingHTTPServer(
+                ("", 0), _make_http_handler(self))
+            self.admin_port = self._http_server.server_address[1]
+        try:
+            self.hot_plane = NativeFilerPlane(
+                "", self.port, self.admin_port,
+                self._vol_plane.plane_id, log_path,
+                max_body=min(self.chunk_size, 4 << 20))
+        except Exception:
+            # plane failed AFTER the admin bind: release it so the
+            # fallback path can bind the PUBLIC port instead
+            self._http_server.server_close()
+            self._http_server = None
+            raise
         self.filer.on_mutate = self._on_python_mutation
         t1 = threading.Thread(target=self._lease_loop, daemon=True,
                               name="filer-hot-leases")
@@ -422,14 +479,36 @@ class FilerServer:
     # -- chunk IO ----------------------------------------------------------
 
     def save_chunk(self, data: bytes, *, ttl: str = "") -> filer_pb2.FileChunk:
-        a = assign(self.master, collection=self.collection,
-                   replication=self.replication, ttl=ttl)
-        if a.error:
-            raise IOError(f"assign: {a.error}")
-        r = upload_data(f"http://{a.url}/{a.fid}", data, ttl=ttl,
-                        auth=a.auth)
-        if r.error:
-            raise IOError(f"upload: {r.error}")
+        last_err = ""
+        for attempt in (0, 1):
+            a = self.fid_pool.acquire(collection=self.collection,
+                                      replication=self.replication, ttl=ttl)
+            if a.error:
+                raise IOError(f"assign: {a.error}")
+            r = upload_data(f"http://{a.url}/{a.fid}", data, ttl=ttl,
+                            auth=a.auth)
+            if not r.error:
+                break
+            # the leased volume may have filled/moved/gone read-only
+            # since the batch assign: drop THIS key's leases and re-ask
+            # the (possibly failed-over) master for a fresh target once
+            # (other collections' healthy leases stay pooled)
+            last_err = r.error
+            self.fid_pool.invalidate(collection=self.collection,
+                                     replication=self.replication, ttl=ttl)
+        else:
+            raise IOError(f"upload: {last_err}")
+        if self.chunk_cache is not None and not ttl \
+                and len(data) < self.chunk_cache.mem_threshold:
+            # write-through for SMALL chunks only: the small-file
+            # PUT->GET hot path hits memory on first read, while one
+            # bulk upload's 4MB chunks must not evict the whole
+            # small-file working set (large chunks still enter the
+            # cache on the read path, where a hit is proven demand).
+            # TTL'd chunks stay uncached — the cache has no expiry
+            # sweep.
+            self.chunk_cache.put(a.fid, bytes(data))
+            FILER_CHUNK_CACHE_COUNTER.inc(result="put")
         return filer_pb2.FileChunk(
             file_id=a.fid, size=len(data),
             modified_ts_ns=time.time_ns(), e_tag=r.etag,
@@ -534,22 +613,46 @@ class FilerServer:
             yield from RemoteGateway(self.address).read_through(
                 entry.full_path, offset, max(cap, 0))
             return
+        # TTL'd entries never enter the chunk cache: their needles expire
+        # volume-side and nothing would ever invalidate the cached copy
+        # (TTL expiry doesn't pass through _gc_chunks)
+        cacheable = not entry.attr.ttl_sec
         for view in view_from_chunks(entry.chunks, offset,
                                      size if size is not None
                                      else total_size(entry.chunks) - offset):
-            yield self._read_chunk_view(view)
+            yield self._read_chunk_view(view, cacheable=cacheable)
 
-    def _read_chunk_view(self, view) -> bytes:
-        """One chunk view's bytes with full failover: every replica in
-        the cached location map, then a cache-invalidating re-lookup
-        (the map may be stale after a replica died), then servers
-        holding ANY EC shard of the volume — which reconstruct from any
-        k shards server-side (the LookupFileIdWithFallback read ladder
-        this rebuild previously lacked: first dead replica was fatal)."""
+    def _read_chunk_view(self, view, cacheable: bool = True) -> bytes:
+        """One chunk view's bytes: the filer chunk cache first (rung 0 —
+        zero volume-server round-trips on a hit), then full failover:
+        every replica in the cached location map, a cache-invalidating
+        re-lookup (the map may be stale after a replica died), then
+        servers holding ANY EC shard of the volume — which reconstruct
+        from any k shards server-side (the LookupFileIdWithFallback read
+        ladder this rebuild previously lacked: first dead replica was
+        fatal)."""
+        cache = self.chunk_cache
+        if cache is not None and cacheable:
+            cached = cache.get(view.file_id)
+            if cached is not None and \
+                    len(cached) >= view.chunk_offset + view.size:
+                FILER_CHUNK_CACHE_COUNTER.inc(result="hit")
+                return bytes(cached[view.chunk_offset:
+                                    view.chunk_offset + view.size])
+            FILER_CHUNK_CACHE_COUNTER.inc(result="miss")
         headers = {"Range": f"bytes={view.chunk_offset}-"
                             f"{view.chunk_offset + view.size - 1}"} \
             if not view.is_full_chunk else {}
         last_err: Exception | None = None
+
+        def filled(data: bytes) -> bytes:
+            # read-through population: only whole chunks of non-TTL'd
+            # entries (a ranged fetch can't serve later full-chunk
+            # reads; expired needles would linger in cache forever)
+            if cache is not None and cacheable and view.is_full_chunk:
+                cache.put(view.file_id, data)
+                FILER_CHUNK_CACHE_COUNTER.inc(result="put")
+            return data
 
         def try_urls(urls):
             """-> (data | None, every-replica-replied-404). A sweep that
@@ -593,7 +696,7 @@ class FilerServer:
             data, _ = try_urls(
                 self.master_client.lookup_file_id(view.file_id))
             if data is not None:
-                return data
+                return filled(data)
             # all cached replicas failed: the map may be stale — drop it,
             # re-ask the master, and walk the fresh replica set once more
             # (a 404 sweep still refreshes once: the volume may have
@@ -604,7 +707,7 @@ class FilerServer:
             data, notfound = try_urls(self.master_client.lookup_file_id(
                 view.file_id, refresh=True))
             if data is not None:
-                return data
+                return filled(data)
         except LookupError as e:
             last_err = e
             notfound = False
@@ -616,7 +719,7 @@ class FilerServer:
             data, _ = try_urls(
                 self.master_client.ec_fallback_urls(view.file_id))
             if data is not None:
-                return data
+                return filled(data)
         raise IOError(f"chunk {view.file_id} unreadable: {last_err}")
 
     def read_file(self, entry: Entry, offset: int = 0,
@@ -626,6 +729,14 @@ class FilerServer:
     def _gc_chunks(self, fids: list[str]) -> None:
         if not fids:
             return
+        if self.chunk_cache is not None:
+            # invalidate BEFORE the needles die: between a delete and a
+            # re-write that recycles nothing (fids are never reused by
+            # the filer path) a stale cache entry could otherwise serve
+            # bytes the namespace no longer references
+            for fid in fids:
+                if self.chunk_cache.delete(fid):
+                    FILER_CHUNK_CACHE_COUNTER.inc(result="invalidate")
         try:
             delete_files(self.master, fids)
         except Exception as e:  # noqa: BLE001 - GC is best-effort
@@ -1055,6 +1166,19 @@ def _make_http_handler(srv: FilerServer):
                                    "text/plain; version=0.0.4")
             if path == "/healthz":
                 return self._json({"ok": True})
+            if path == "/status":
+                hot = srv.hot_plane.stats() if srv.hot_plane else None
+                return self._json({
+                    "Version": "seaweedfs-tpu",
+                    "ChunkCache": chunk_cache_stats(),
+                    "ChunkCacheEnabled": srv.chunk_cache is not None,
+                    "FidLease": {
+                        **fid_lease_stats(),
+                        "remaining": srv.fid_pool.remaining(),
+                        "batch": srv.fid_pool.batch,
+                    },
+                    "NativeHotPlane": hot,
+                })
             srv.hot_sync()  # see native PUTs not yet absorbed
             with FILER_REQUEST_HISTOGRAM.time(type="read"):
                 try:

@@ -168,7 +168,9 @@ class VolumeServer:
                 current.update(loc.volumes)
             registered = getattr(self, "_native_vids", {})
             for vid, v in current.items():
-                if v.is_tiered or v._dat is None:
+                if v.is_tiered or v._dat is None or v._gc_frozen:
+                    # frozen: un-flushable buffered bytes — handing the
+                    # plane write authority (attach flushes) would raise
                     continue
                 if types.OFFSET_SIZE != 4:
                     # the C++ plane reads/writes 16-byte idx entries only;
@@ -1144,6 +1146,17 @@ class VolumeGrpc:
             # gRPC handlers read v.nm directly; absorb any idx entries the
             # C++ plane appended first (cheap fstat when nothing changed)
             v.sync_native()
+        else:
+            # admin handlers read the .dat/.idx files (or their sizes)
+            # directly; group-commit may still hold bytes in the write
+            # buffer (no-op when empty). Under v._lock: an unlocked idx
+            # flush could race a writer mid-append and land an idx entry
+            # on the OS before its dat record bytes.
+            try:
+                with v._lock:
+                    v._sync_buffers()
+            except OSError:
+                pass  # surfaced to writers by their own flush
         return v
 
     def _ec_base(self, vid: int, collection: str, context) -> str:
@@ -1220,13 +1233,20 @@ def _make_http_handler(srv: VolumeServer):
                         vols[vid] = {"size": v.data_size(),
                                      "collection": v.collection,
                                      "fileCount": v.file_count(),
-                                     "readOnly": v.read_only}
+                                     "readOnly": v.read_only
+                                     or v._gc_frozen}
+                from ..utils.stats import group_commit_stats
+
                 plane = srv.native_plane
                 return self._json({
                     "Version": "seaweedfs-tpu", "Volumes": vols,
                     "NativeDataPlane": plane is not None,
                     "NativeRequests":
                         plane.request_count() if plane else 0,
+                    # flush-batching factor of the python write engine
+                    # (ISSUE 2 group commit); the native plane writes
+                    # through unbuffered pwrite and does not batch
+                    "GroupCommit": group_commit_stats(),
                 })
             if u.path == "/metrics":
                 return self._reply(200, gather().encode(),

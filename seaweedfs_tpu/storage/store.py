@@ -308,7 +308,10 @@ class Store:
                 hb.volumes.append(master_pb2.VolumeInformationMessage(
                     id=vid, size=v.data_size(), collection=v.collection,
                     file_count=v.file_count(), delete_count=v.deleted_count(),
-                    deleted_byte_count=v.deleted_size(), read_only=v.read_only,
+                    deleted_byte_count=v.deleted_size(),
+                    # a flush-frozen volume must leave the master's
+                    # writable set like a read-only one
+                    read_only=v.read_only or v._gc_frozen,
                     replica_placement=v.super_block.replica_placement.to_byte(),
                     version=v.version, ttl=v.ttl.to_uint32(),
                     compact_revision=v.super_block.compaction_revision,

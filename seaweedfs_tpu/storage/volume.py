@@ -16,13 +16,33 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from dataclasses import dataclass
 
 from . import types
+from ..utils.stats import (
+    VOLUME_GROUP_COMMIT_FLUSHES,
+    VOLUME_GROUP_COMMIT_WRITES,
+)
 from .errors import CookieMismatch, DeletedError, NotFoundError
 from .needle import Needle, needle_body_length
 from .super_block import SuperBlock
 from .ttl import EMPTY_TTL
+
+
+def _group_commit_enabled() -> bool:
+    return os.environ.get("SWFS_GROUP_COMMIT", "1").lower() \
+        not in ("0", "false", "off")
+
+
+def _group_commit_window_s() -> float:
+    """Optional extra accumulation window before the leader flushes.
+    0 (default) = pure leader batching: a lone writer flushes at once
+    (no added latency) and batching emerges only under concurrency."""
+    try:
+        return float(os.environ.get("SWFS_GROUP_COMMIT_WINDOW_MS", "0")) / 1e3
+    except ValueError:
+        return 0.0
 
 
 @dataclass
@@ -115,7 +135,15 @@ class NeedleMap:
         self.file_byte_counter = 0
         self.deletion_counter = 0
         self.deletion_byte_counter = 0
-        self._idx_file = open(idx_path, "ab")
+        # 1MB buffer (64Ki entries): with auto_flush deferred to group
+        # commit, a FULL stdio buffer would auto-drain idx entries to
+        # the OS independent of the leader's dat-then-idx flush order.
+        # Un-flushed batch depth is bounded by the server's thread pool
+        # (tens), orders of magnitude under this capacity.
+        self._idx_file = open(idx_path, "ab", buffering=1 << 20)
+        # False defers the per-entry flush to the owning Volume's
+        # group-commit pass; standalone users keep flush-per-append
+        self.auto_flush = True
         # bytes of the .idx log reflected in the map — lets catchup_from_idx
         # absorb entries appended by another writer (the native data plane)
         self._idx_consumed = 0
@@ -203,8 +231,12 @@ class NeedleMap:
 
     def _append(self, key: int, off: int, size: int) -> None:
         self._idx_file.write(types.pack_needle_map_entry(key, off, size))
-        self._idx_file.flush()
+        if self.auto_flush:
+            self._idx_file.flush()
         self._idx_consumed += types.NEEDLE_MAP_ENTRY_SIZE
+
+    def flush(self) -> None:
+        self._idx_file.flush()
 
     def __len__(self) -> int:
         return len(self._m)
@@ -259,6 +291,25 @@ class Volume:
         self.last_modified_ts_seconds = 0
         self.is_compacting = False
         self._lock = threading.RLock()
+        # group commit (ISSUE 2): appends are buffered and a leader
+        # writer flushes dat-then-idx ONCE for every write registered so
+        # far; concurrent writers share one flush instead of paying one
+        # each. Acks only happen after the covering flush, and the
+        # dat-before-idx flush order (with appends excluded by _lock
+        # during the flush) keeps the on-disk idx never ahead of dat.
+        self._gc_enabled = _group_commit_enabled()
+        self._gc_cond = threading.Condition()
+        self._gc_seq = 0        # writes appended (registered for flush)
+        self._gc_flushed = 0    # writes covered by a completed flush
+        self._gc_leader = False
+        # set by a failed batch flush: refuses NEW writes (alongside but
+        # independent of read_only, so unfreezing can never clobber a
+        # read-only state set by an admin/EC/vacuum path meanwhile)
+        self._gc_frozen = False
+        # cached append offset: the byte past the last buffered record.
+        # None = re-derive from seek_end (which also drains the write
+        # buffer). Invalidated whenever _dat is replaced or truncated.
+        self._dat_tail: int | None = None
         # native (C++) data-plane attachment: when set, the plane is the
         # single writer authority for this volume's .dat/.idx and all
         # needle reads/writes funnel through it (native/dataplane.py).
@@ -330,9 +381,16 @@ class Volume:
                     f"{'large-disk (5-byte)' if types.large_disk() else '4-byte'} "
                     f"mode; restart with the matching -largeDisk setting"
                 )
-        self.nm = NeedleMap(base + ".idx", self.needle_map_kind)
+        self.nm = self._new_needle_map(base + ".idx")
         if dat_exists:
             self.check_and_fix_integrity()
+
+    def _new_needle_map(self, idx_path: str) -> NeedleMap:
+        nm = NeedleMap(idx_path, self.needle_map_kind)
+        # under group commit the volume owns idx durability: per-entry
+        # flushes are deferred to the shared batch flush
+        nm.auto_flush = not self._gc_enabled
+        return nm
 
     # -- naming ------------------------------------------------------------
 
@@ -385,8 +443,22 @@ class Volume:
         backend = self.remote_dat if self._dat is None else self._dat
         return backend.read_at(offset, length)
 
+    def _pread_durable(self, offset: int, length: int) -> bytes:
+        """pread that tolerates the group-commit window: a map entry can
+        exist for a record whose bytes are still in the write buffer
+        (pread bypasses it), so a short read drains the buffer once and
+        retries before giving up."""
+        blob = self._pread(offset, length)
+        if len(blob) < length and self._dat is not None:
+            try:
+                self._dat.flush()
+            except OSError:
+                return blob
+            blob = self._pread(offset, length)
+        return blob
+
     def _read_header_at(self, offset: int) -> Needle:
-        b = self._pread(offset, types.NEEDLE_HEADER_SIZE)
+        b = self._pread_durable(offset, types.NEEDLE_HEADER_SIZE)
         if len(b) < types.NEEDLE_HEADER_SIZE:
             raise EOFError("short needle header")
         return Needle.parse_header(b)
@@ -396,12 +468,15 @@ class Volume:
     def attach_native(self, plane) -> None:
         """Hand write authority for this volume to the C++ data plane."""
         with self._lock:
+            self._sync_buffers()  # plane appends at the REAL file tail
+            self._dat_tail = None
             self.sync_native()
             self.native = plane
 
     def detach_native(self) -> None:
         with self._lock:
             self.native = None
+            self._dat_tail = None  # the plane moved the file tail
             self.sync_native()
 
     def sync_native(self) -> None:
@@ -435,36 +510,147 @@ class Volume:
 
     def write_needle(self, n: Needle, check_cookie: bool = True) -> tuple[int, int, bool]:
         """Append a needle (doWriteRequest, volume_write.go:127-176).
-        -> (offset_bytes, size, is_unchanged)."""
+        -> (offset_bytes, size, is_unchanged). Acknowledged only after
+        the record's bytes reached the OS (group-commit flush)."""
         with self._lock:
             if self.read_only:
                 raise IOError(f"volume {self.id} is read only")
+            if self._gc_frozen:
+                raise IOError(f"volume {self.id} is frozen: a previous "
+                              f"group-commit flush failed")
             if self.native is not None:
                 return self._native_write(n, check_cookie)
-            if self._is_file_unchanged(n):
-                return 0, len(n.data), True
-            nv = self.nm.get(n.id)
-            if nv is not None:
-                existing = self._read_header_at(
-                    types.stored_to_actual_offset(nv.offset)
-                )
-                if n.cookie == 0 and not check_cookie:
-                    n.cookie = existing.cookie
-                if existing.cookie != n.cookie:
-                    raise CookieMismatch(f"mismatching cookie {n.cookie:x}")
-            n.update_append_at_ns(self.last_append_at_ns)
-            offset = self._append_record(n)
-            self.last_append_at_ns = n.append_at_ns
-            if nv is None or types.stored_to_actual_offset(nv.offset) < offset:
-                self.nm.put(n.id, types.offset_to_stored(offset), n.size)
-            if self.last_modified_ts_seconds < n.last_modified:
-                self.last_modified_ts_seconds = n.last_modified
-            return offset, n.size, False
+            unchanged = self._is_file_unchanged(n)
+            if unchanged:
+                # the matched record may still be in the group-commit
+                # window (its writer blocked in _commit_wait): this ack
+                # claims the bytes are stored, so it must wait — outside
+                # the lock — for the flush covering every write
+                # registered so far. A pre-batching dedup hit was always
+                # against already-durable data.
+                with self._gc_cond:
+                    seq = self._gc_seq
+                offset = 0
+            else:
+                nv = self.nm.get(n.id)
+                if nv is not None:
+                    existing = self._read_header_at(
+                        types.stored_to_actual_offset(nv.offset)
+                    )
+                    if n.cookie == 0 and not check_cookie:
+                        n.cookie = existing.cookie
+                    if existing.cookie != n.cookie:
+                        raise CookieMismatch(
+                            f"mismatching cookie {n.cookie:x}")
+                n.update_append_at_ns(self.last_append_at_ns)
+                offset = self._append_record(n)
+                self.last_append_at_ns = n.append_at_ns
+                if nv is None or \
+                        types.stored_to_actual_offset(nv.offset) < offset:
+                    self.nm.put(n.id, types.offset_to_stored(offset),
+                                n.size)
+                if self.last_modified_ts_seconds < n.last_modified:
+                    self.last_modified_ts_seconds = n.last_modified
+                seq = self._commit_register()
+        self._commit_wait(seq)
+        if unchanged:
+            return 0, len(n.data), True
+        return offset, n.size, False
+
+    # -- group commit ------------------------------------------------------
+
+    def _commit_register(self) -> int:
+        """Mark one buffered write awaiting durability. _lock held."""
+        if not self._gc_enabled:
+            return 0
+        with self._gc_cond:
+            self._gc_seq += 1
+            return self._gc_seq
+
+    def _commit_wait(self, seq: int) -> None:
+        """Block until a flush covering `seq` completed. The first waiter
+        with no flush in flight becomes the leader: it flushes dat THEN
+        idx under _lock (no concurrent appends), covering every write
+        registered so far — followers just wait for that flush."""
+        if not self._gc_enabled or seq == 0:
+            return
+        window = _group_commit_window_s()
+        while True:
+            with self._gc_cond:
+                if self._gc_flushed >= seq:
+                    return
+                if self._gc_leader:
+                    self._gc_cond.wait(1.0)
+                    continue
+                self._gc_leader = True
+                prev = self._gc_flushed
+            err: Exception | None = None
+            flushed_ok = False
+            target = 0
+            try:
+                # the leadership MUST be handed back whatever happens
+                # (incl. KeyboardInterrupt mid-sleep/flush) — a wedged
+                # leader flag would silently stall every writer forever
+                if window:
+                    time.sleep(window)
+                with self._lock:
+                    with self._gc_cond:
+                        target = self._gc_seq
+                    try:
+                        # dat first: an idx entry must never hit the OS
+                        # before the record bytes it points at
+                        if self._dat is not None:
+                            self._dat.flush()
+                        self.nm.flush()
+                        # a waiter's retry drained the buffers after a
+                        # transient failure: state is fully durable again
+                        self._gc_frozen = False
+                        flushed_ok = True
+                    except Exception as e:  # noqa: BLE001 - to writers
+                        err = e
+                        # the in-memory map already holds entries for the
+                        # un-acked writes of this batch and they cannot be
+                        # selectively rolled back (appends interleave) —
+                        # freeze the volume so a LATER write's flush can't
+                        # silently commit bytes whose writers were told
+                        # 500. Waiters still retry the flush themselves (a
+                        # transient ENOSPC may clear); a restart replays
+                        # the durable idx prefix and
+                        # check_and_fix_integrity truncates whatever never
+                        # reached the OS.
+                        self._gc_frozen = True
+                        from ..utils import glog
+
+                        glog.error(f"volume {self.id}: group-commit flush "
+                                   f"failed, volume frozen for writes: {e}")
+            finally:
+                with self._gc_cond:
+                    self._gc_leader = False
+                    if flushed_ok:
+                        self._gc_flushed = max(self._gc_flushed, target)
+                    self._gc_cond.notify_all()
+            if err is not None:
+                raise IOError(
+                    f"volume {self.id}: group-commit flush failed: {err}")
+            VOLUME_GROUP_COMMIT_FLUSHES.inc()
+            VOLUME_GROUP_COMMIT_WRITES.inc(target - prev)
+
+    def _sync_buffers(self) -> None:
+        """Push buffered dat/idx bytes to the OS — for paths that read
+        the files (or their sizes) directly: compaction snapshots, admin
+        status RPCs, incremental copy."""
+        if self._dat is not None:
+            self._dat.flush()
+        self.nm.flush()
 
     def _append_record(self, n: Needle) -> int:
         if self._dat is None:
             raise IOError(f"volume {self.id} is tiered (read only)")
-        offset = self._dat.seek_end()
+        offset = self._dat_tail
+        if offset is None:
+            # seek_end also drains the stdio write buffer, so the cached
+            # tail and the buffered stream agree from here on
+            offset = self._dat.seek_end()
         if offset % types.NEEDLE_PADDING_SIZE != 0:
             # realign a torn tail (Needle.Append alignment guard)
             offset += types.NEEDLE_PADDING_SIZE - (offset % types.NEEDLE_PADDING_SIZE)
@@ -477,10 +663,13 @@ class Volume:
             )
         try:
             self._dat.write(blob)
-            self._dat.flush()
+            if not self._gc_enabled:
+                self._dat.flush()
         except OSError:
+            self._dat_tail = None
             self._dat.truncate(offset)
             raise
+        self._dat_tail = offset + len(blob)
         return offset
 
     def _is_file_unchanged(self, n: Needle) -> bool:
@@ -506,6 +695,9 @@ class Volume:
         with self._lock:
             if self.read_only:
                 raise IOError(f"volume {self.id} is read only")
+            if self._gc_frozen:
+                raise IOError(f"volume {self.id} is frozen: a previous "
+                              f"group-commit flush failed")
             if self.native is not None:
                 return self._native_delete(needle_id, cookie)
             nv = self.nm.get(needle_id)
@@ -523,7 +715,9 @@ class Volume:
             offset = self._append_record(marker)
             self.last_append_at_ns = marker.append_at_ns
             self.nm.delete(needle_id, types.offset_to_stored(offset))
-            return size
+            seq = self._commit_register()
+        self._commit_wait(seq)
+        return size
 
     def _native_delete(self, needle_id: int, cookie: int | None) -> int:
         old_blob = self.native.read_blob(self.id, needle_id)
@@ -575,7 +769,7 @@ class Volume:
     def _read_record(self, nv: NeedleValue) -> Needle:
         offset = types.stored_to_actual_offset(nv.offset)
         length = types.actual_size(nv.size, self.version)
-        blob = self._pread(offset, length)
+        blob = self._pread_durable(offset, length)
         if len(blob) < length:
             raise IOError("short needle read")
         return Needle.from_bytes(blob, self.version, expected_size=nv.size)
@@ -583,7 +777,7 @@ class Volume:
     def read_needle_blob(self, offset: int, size: int) -> bytes:
         """Raw record bytes (ReadNeedleBlob) for replication/EC streaming."""
         length = types.actual_size(size, self.version)
-        blob = self._pread(offset, length)
+        blob = self._pread_durable(offset, length)
         if len(blob) < length:
             raise IOError("short needle blob read")
         return blob
@@ -622,9 +816,10 @@ class Volume:
                 end = self.super_block.block_size
             self._dat.truncate(end)
             self._dat.flush()
+            self._dat_tail = None
             # reload the map from the repaired idx
             self.nm.close()
-            self.nm = NeedleMap(self.nm.idx_path, self.needle_map_kind)
+            self.nm = self._new_needle_map(self.nm.idx_path)
 
     def _verify_needle_at(self, offset: int, needle_id: int, size: int) -> bool:
         """verifyNeedleIntegrity (volume_checking.go:88): id matches and the
@@ -679,6 +874,7 @@ class Volume:
                 raise IOError(
                     f"volume {self.id} is tiered; download before vacuum")
             self.is_compacting = True
+            self._sync_buffers()  # the snapshot must cover buffered writes
             self.nm.catchup_from_idx()  # native plane may have appended
             self._compact_idx_snapshot = os.path.getsize(self.nm.idx_path)
         try:
@@ -716,6 +912,7 @@ class Volume:
             # in the old .dat after the replay reads the tail
             if self.native is not None:
                 self.native.set_writable(self.id, False)
+            self._sync_buffers()  # the diff replay reads the idx FILE
             self._makeup_diff(base + ".cpd", base + ".cpx")
             self._dat.close()
             self.nm.close()
@@ -724,14 +921,21 @@ class Volume:
             from .backend import DiskFile
 
             self._dat = DiskFile(base + ".dat")
+            self._dat_tail = None
             self.super_block = SuperBlock.from_file(self._dat)
-            self.nm = NeedleMap(base + ".idx", self.needle_map_kind)
+            self.nm = self._new_needle_map(base + ".idx")
             self.is_compacting = False
             if self.native is not None:
-                self.native.reload_volume(self.id)
-                # restore the REGISTRY's writability decision, not blanket
-                # True: replicated/TTL volumes must keep redirecting PUTs
-                self.native.set_writable(self.id, self.native_writable)
+                if self.native.reload_volume(self.id):
+                    # restore the REGISTRY's writability decision, not
+                    # blanket True: replicated/TTL volumes must keep
+                    # redirecting PUTs
+                    self.native.set_writable(self.id, self.native_writable)
+                else:
+                    # the plane dropped the volume (failed reopen):
+                    # detach so the python engine serves it — stale
+                    # plane state must never answer for it again
+                    self.native = None
 
     def _makeup_diff(self, cpd: str, cpx: str) -> None:
         """Replay .idx entries appended after the compaction snapshot onto
@@ -803,6 +1007,7 @@ class Volume:
             write_tier_sidecar(base, backend.name, self.tier_key(), size)
             self._dat.close()
             self._dat = None
+            self._dat_tail = None
             self.remote_dat = RemoteDatFile(backend, self.tier_key(), size)
             if not keep_local:
                 os.remove(base + ".dat")
@@ -828,6 +1033,7 @@ class Volume:
 
             self.remote_dat = None
             self._dat = DiskFile(base + ".dat")
+            self._dat_tail = None
             self.read_only = False
             return moved
 

@@ -41,6 +41,14 @@ class MemoryCache:
                 _, evicted = self._data.popitem(last=False)
                 self._used -= len(evicted)
 
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            old = self._data.pop(key, None)
+            if old is None:
+                return False
+            self._used -= len(old)
+            return True
+
 
 class DiskCache:
     def __init__(self, directory: str, capacity_bytes: int = 1 << 30):
@@ -78,6 +86,17 @@ class DiskCache:
                 f.write(value)
             os.replace(tmp, path)
             self._total += len(value)
+
+    def delete(self, key: str) -> bool:
+        with self._lock:
+            path = self._path(key)
+            try:
+                size = os.stat(path).st_size
+                os.remove(path)
+            except FileNotFoundError:
+                return False
+            self._total -= size
+            return True
 
     def _evict(self, incoming: int) -> None:
         """LRU-by-atime scan; only runs once the running total overflows."""
@@ -119,7 +138,22 @@ class TieredChunkCache:
         return v
 
     def put(self, fid: str, value: bytes) -> None:
+        # evict the fid from the tier NOT written: a same-fid re-put of
+        # a different size routes differently, and a stale entry in the
+        # earlier-checked tier would shadow the fresh bytes forever
         if len(value) < self.mem_threshold or self.disk is None:
             self.mem.put(fid, value)
+            if self.disk is not None:
+                self.disk.delete(fid)
         else:
             self.disk.put(fid, value)
+            self.mem.delete(fid)
+
+    def delete(self, fid: str) -> bool:
+        """Invalidate a fid in every tier. Both tiers are always checked:
+        the routing threshold decides where a PUT lands, but a fid may
+        have been cached at a different size by an earlier write."""
+        dropped = self.mem.delete(fid)
+        if self.disk is not None:
+            dropped = self.disk.delete(fid) or dropped
+        return dropped

@@ -20,6 +20,7 @@ the breaker, only the retry storm that follows a failure does.
 from __future__ import annotations
 
 import random
+import ssl
 import time
 
 import grpc
@@ -43,15 +44,72 @@ _RETRYABLE_GRPC = frozenset((
 ))
 
 
+# generic ssl.SSLError reasons that indicate the PEER'S IDENTITY was
+# rejected — retrying (or failing over to the "next replica", which is
+# the same misconfigured cluster) cannot cure a bad certificate, and
+# hammering a node we refuse to trust only hides the config error
+_SSL_FATAL_REASON_MARKERS = ("CERTIFICATE", "UNKNOWN_CA", "BAD_CERT",
+                             "CERT_", "HOSTNAME_MISMATCH")
+
+
+def _ssl_error_of(exc: BaseException) -> ssl.SSLError | None:
+    """Innermost ssl.SSLError in the cause/context/args chain.
+    requests wraps TLS failures as requests.exceptions.SSLError (a
+    ConnectionError subclass!) around urllib3 around the real
+    ssl.SSLError, so the blanket ConnectionError branch below would
+    happily retry certificate rejections without this unwrap."""
+    seen: set[int] = set()
+    stack: list[BaseException | None] = [exc]
+    while stack:
+        e = stack.pop()
+        if e is None or id(e) in seen:
+            continue
+        seen.add(id(e))
+        if isinstance(e, ssl.SSLError):
+            return e
+        stack.append(getattr(e, "__cause__", None))
+        stack.append(getattr(e, "__context__", None))
+        stack.extend(a for a in getattr(e, "args", ())
+                     if isinstance(a, BaseException))
+    return None
+
+
+def ssl_error_is_retryable(e: ssl.SSLError) -> bool:
+    """Classify ssl.SSLError subtypes (ROADMAP open item): handshake
+    timeouts, EOF mid-handshake and protocol flakes look like a node
+    going down and are retryable; certificate-verification failures are
+    a trust decision and fail fast."""
+    if isinstance(e, ssl.SSLCertVerificationError):
+        return False
+    if isinstance(e, (ssl.SSLEOFError, ssl.SSLZeroReturnError,
+                      ssl.SSLWantReadError, ssl.SSLWantWriteError,
+                      ssl.SSLSyscallError)):
+        return True  # connection torn mid-handshake/read: transient
+    reason = (getattr(e, "reason", "") or "").upper()
+    if any(m in reason for m in _SSL_FATAL_REASON_MARKERS):
+        return False
+    # alert strings travel in args too (urllib3 re-raises with a
+    # stringified inner error on some paths)
+    msg = " ".join(str(a) for a in e.args).upper()
+    if "CERTIFICATE_VERIFY_FAILED" in msg or "UNKNOWN CA" in msg:
+        return False
+    return True  # handshake alerts, version hiccups, truncated records
+
+
 def is_retryable(exc: BaseException) -> bool:
     """Transient transport/availability failures — the ones a different
     attempt (or a different replica) can cure. Application errors
-    (NOT_FOUND, bad request, integrity failures) are final."""
+    (NOT_FOUND, bad request, integrity failures) are final, and so are
+    TLS certificate-verification rejections (a cert-invalid replica is
+    not merely down; see ssl_error_is_retryable)."""
     if isinstance(exc, grpc.RpcError):
         code = exc.code() if callable(getattr(exc, "code", None)) else None
         return code in _RETRYABLE_GRPC
     if isinstance(exc, FailpointError):
         return True  # injected faults model transient outages
+    sslerr = _ssl_error_of(exc)
+    if sslerr is not None:
+        return ssl_error_is_retryable(sslerr)
     try:
         import requests
 

@@ -163,6 +163,68 @@ FILER_STORE_SECONDS = Counter(
     "SeaweedFS_filerStore_seconds",
     "Cumulative filer store time by store and op.")
 
+# -- small-file hot-path instrumentation (ISSUE 2): every counter below
+#    exists to make a bench delta attributable to one optimization -------
+
+CLIENT_ASSIGN_SECONDS = Histogram(
+    "SeaweedFS_client_assign_seconds", "Master Assign RPC latency.")
+CLIENT_ASSIGN_COUNTER = Counter(
+    "SeaweedFS_client_assign_ops",
+    "Master Assign calls by outcome (ok/error) and leased fid count.")
+CLIENT_FID_LEASE_COUNTER = Counter(
+    "SeaweedFS_client_fid_lease_ops",
+    "Fid lease pool activity: hit (no RPC), refill, expired, invalidate.")
+CLIENT_UPLOAD_SECONDS = Histogram(
+    "SeaweedFS_client_upload_seconds", "Volume-server upload latency.")
+FILER_CHUNK_CACHE_COUNTER = Counter(
+    "SeaweedFS_filer_chunk_cache_ops",
+    "Filer chunk-read cache lookups by result (hit/miss) and mutations "
+    "(put/invalidate).")
+VOLUME_GROUP_COMMIT_WRITES = Counter(
+    "SeaweedFS_volumeServer_group_commit_writes",
+    "Needle writes acknowledged through the group-commit flush path.")
+VOLUME_GROUP_COMMIT_FLUSHES = Counter(
+    "SeaweedFS_volumeServer_group_commit_flushes",
+    "Batched dat+idx flushes; writes/flushes is the batching factor.")
+
+
+def group_commit_stats() -> dict:
+    """Snapshot for /status pages: flush-batching factor provenance."""
+    writes = VOLUME_GROUP_COMMIT_WRITES.value()
+    flushes = VOLUME_GROUP_COMMIT_FLUSHES.value()
+    return {
+        "writes": int(writes),
+        "flushes": int(flushes),
+        "batchFactor": round(writes / flushes, 3) if flushes else 0.0,
+    }
+
+
+def chunk_cache_stats() -> dict:
+    hits = FILER_CHUNK_CACHE_COUNTER.value(result="hit")
+    misses = FILER_CHUNK_CACHE_COUNTER.value(result="miss")
+    total = hits + misses
+    return {
+        "hits": int(hits),
+        "misses": int(misses),
+        "puts": int(FILER_CHUNK_CACHE_COUNTER.value(result="put")),
+        "invalidations": int(
+            FILER_CHUNK_CACHE_COUNTER.value(result="invalidate")),
+        "hitRate": round(hits / total, 4) if total else 0.0,
+    }
+
+
+def fid_lease_stats() -> dict:
+    return {
+        "leaseHits": int(CLIENT_FID_LEASE_COUNTER.value(result="hit")),
+        "refills": int(CLIENT_FID_LEASE_COUNTER.value(result="refill")),
+        "expired": int(CLIENT_FID_LEASE_COUNTER.value(result="expired")),
+        "invalidations": int(
+            CLIENT_FID_LEASE_COUNTER.value(result="invalidate")),
+        "assignOk": int(CLIENT_ASSIGN_COUNTER.value(outcome="ok")),
+        "assignErrors": int(CLIENT_ASSIGN_COUNTER.value(outcome="error")),
+        "assignedFids": int(CLIENT_ASSIGN_COUNTER.value(outcome="fids")),
+    }
+
 
 def master_metrics_text() -> str:
     return gather()

@@ -116,15 +116,59 @@ def chaos_cluster(tmp_path_factory):
 
 # -- volume plane: replica failover ----------------------------------------
 
-def test_filer_read_survives_flaky_replica(chaos_cluster):
+def _put_replicated(fsrv, base, path, payload, attempts=5):
+    """PUT `payload` and prove every chunk is readable from BOTH
+    replicas before the test arms failpoints. The lease-pooled PUT
+    returns fast enough that the master may not have absorbed the second
+    server's heartbeat for a freshly-grown volume yet — the write then
+    lands un-replicated and the filer caches a one-location map for 10
+    minutes, starving the targeted replica of reads and making the
+    failpoint hits-assertions vacuously fail. A re-PUT after the
+    locations registered replicates properly (fresh fids)."""
+    for _ in range(attempts):
+        r = requests.put(base + path, data=payload, timeout=30)
+        assert r.status_code in (200, 201), r.text
+        fids = [c.file_id for c in fsrv.filer.find_entry(path).chunks]
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            try:
+                locs = {v: fsrv.master_client.lookup_volume(
+                            v, refresh=True)
+                        for v in {parse_file_id(f).volume_id
+                                  for f in fids}}
+            except LookupError:
+                time.sleep(0.2)
+                continue
+            if all(len(l) >= 2 for l in locs.values()) and all(
+                    requests.get(f"http://{l.url}/{fid}",
+                                 timeout=10).status_code == 200
+                    for fid in fids
+                    for l in locs[parse_file_id(fid).volume_id]):
+                return
+            time.sleep(0.2)
+    raise AssertionError(f"{path} never landed on both replicas")
+
+
+@pytest.fixture
+def no_filer_cache(chaos_cluster):
+    """Bypass the filer chunk cache: replica-failover scenarios must
+    drive every read down to the volume servers, where the failpoints
+    live (a cache hit would make the chaos vacuous, not survived)."""
+    _, _, fsrv = chaos_cluster
+    saved = fsrv.chunk_cache
+    fsrv.chunk_cache = None
+    yield
+    fsrv.chunk_cache = saved
+
+
+def test_filer_read_survives_flaky_replica(chaos_cluster, no_filer_cache):
     """20% of reads on one replica fail; every filer read still returns
     the right bytes (acceptance scenario #1)."""
     master, volumes, fsrv = chaos_cluster
     payload = np.random.default_rng(1).integers(
         0, 256, size=150_000, dtype=np.uint8).tobytes()
     base = f"http://{fsrv.address}"
-    r = requests.put(f"{base}/chaos/flaky.bin", data=payload, timeout=30)
-    assert r.status_code in (200, 201), r.text
+    _put_replicated(fsrv, base, "/chaos/flaky.bin", payload)
     with failpoint.active("volume.http.read", p=0.2, seed=7,
                           match=volumes[0].address + ",") as fp:
         for _ in range(25):
@@ -134,14 +178,13 @@ def test_filer_read_survives_flaky_replica(chaos_cluster):
         assert fp.hits > 0, "chaos never fired — test is vacuous"
 
 
-def test_filer_read_survives_dead_replica(chaos_cluster):
+def test_filer_read_survives_dead_replica(chaos_cluster, no_filer_cache):
     """One replica 100% dead for reads: still zero client-visible
     errors via the surviving replica."""
     master, volumes, fsrv = chaos_cluster
     payload = b"replica-down " * 4000
     base = f"http://{fsrv.address}"
-    assert requests.put(f"{base}/chaos/dead.bin", data=payload,
-                        timeout=30).status_code in (200, 201)
+    _put_replicated(fsrv, base, "/chaos/dead.bin", payload)
     with failpoint.active("volume.http.read", p=1.0,
                           match=volumes[1].address + ",") as fp:
         for _ in range(10):
@@ -347,6 +390,133 @@ def test_env_spec_grammar_expresses_shard_targeting():
         failpoint.fail("ec.shard.read", ctx="v1 shard=2,")
     finally:
         failpoint.clear()
+
+
+# -- small-file hot path under chaos (ISSUE 2) -----------------------------
+
+def test_cached_chunk_invalidated_on_failover_rewrite(chaos_cluster):
+    """Write -> read (chunk now cached at the filer) -> kill one replica
+    -> overwrite -> read: the cache must serve the NEW bytes, never the
+    invalidated chunk, even while the rewrite itself is failing over
+    around the dead replica (ISSUE 2 acceptance: cached chunks are
+    invalidated on replica failover re-writes)."""
+    master, volumes, fsrv = chaos_cluster
+    if fsrv.chunk_cache is None:
+        pytest.skip("filer chunk cache disabled in this environment")
+    base = f"http://{fsrv.address}"
+    old_bytes = b"cache-me-v1 " * 2000
+    new_bytes = b"cache-me-v2! " * 2100
+    assert requests.put(f"{base}/chaos/cached.bin", data=old_bytes,
+                        timeout=30).status_code in (200, 201)
+    got = requests.get(f"{base}/chaos/cached.bin", timeout=30)
+    assert got.content == old_bytes  # populates the fid-keyed cache
+    old_fids = [c.file_id for c in
+                fsrv.filer.find_entry("/chaos/cached.bin").chunks]
+    assert any(fsrv.chunk_cache.get(f) is not None for f in old_fids), \
+        "cache was never populated — the invalidation check is vacuous"
+    with failpoint.active("volume.http.read", p=1.0,
+                          match=volumes[0].address + ","):
+        # the overwrite mints fresh fids and must invalidate the old
+        # ones in the cache (write-through + GC invalidation)
+        assert requests.put(f"{base}/chaos/cached.bin", data=new_bytes,
+                            timeout=30).status_code in (200, 201)
+        # the overwrite is only reachable through NEW fids, so the real
+        # invalidation evidence is the old fids' cache entries dying
+        # (without it, a future fid reuse could resurrect stale bytes)
+        for f in old_fids:
+            assert fsrv.chunk_cache.get(f) is None, \
+                f"old fid {f} still cached after overwrite"
+        for _ in range(5):
+            got = requests.get(f"{base}/chaos/cached.bin", timeout=30)
+            assert got.status_code == 200
+            assert got.content == new_bytes, \
+                "stale cached chunk served after overwrite"
+
+
+def test_fid_leases_survive_master_flap_and_upload_failure(chaos_cluster):
+    """The filer's fid-lease pool must (a) keep minting fids across a
+    transient master outage (assign's PR-1 failover plumbing refills the
+    pool) and (b) drop leases + re-lease when an upload to a leased
+    volume target fails (the leased volume may be gone after failover)."""
+    master, volumes, fsrv = chaos_cluster
+    base = f"http://{fsrv.address}"
+    fsrv.fid_pool.invalidate(all_keys=True)  # start from a dry pool
+    # (a) the refill Assign itself is injected dead once: the pool's
+    # batched assign retries through the flap and the PUT still lands
+    with failpoint.active("pb.Assign", p=1.0, count=1) as fp:
+        r = requests.put(f"{base}/chaoslease/a.txt", data=b"lease-a",
+                         timeout=30)
+        assert r.status_code in (200, 201), r.text
+        assert fp.hits == 1
+    assert requests.get(f"{base}/chaoslease/a.txt",
+                        timeout=30).content == b"lease-a"
+    # the pool is stocked now: the next PUTs must not pay an Assign each
+    before = fsrv.fid_pool.remaining()
+    assert before > 0, "batched assign left no leased fids in the pool"
+    assert requests.put(f"{base}/chaoslease/b.txt", data=b"lease-b",
+                        timeout=30).status_code in (200, 201)
+    assert fsrv.fid_pool.remaining() < before, \
+        "PUT did not drain the lease pool"
+    # (b) every upload fails while the failpoint holds: save_chunk must
+    # invalidate the pool between attempts (observable as a drained
+    # pool) rather than replaying the same dead lease forever
+    with failpoint.active("volume.http.write", p=1.0):
+        r = requests.put(f"{base}/chaoslease/c.txt", data=b"lease-c",
+                         timeout=30)
+        assert r.status_code == 500  # both lease targets injected dead
+    assert fsrv.fid_pool.remaining() == 0, \
+        "failed upload left stale leases in the pool"
+    # with the fault gone the pool re-leases from scratch and recovers
+    assert requests.put(f"{base}/chaoslease/c.txt", data=b"lease-c",
+                        timeout=30).status_code in (200, 201)
+    assert requests.get(f"{base}/chaoslease/c.txt",
+                        timeout=30).content == b"lease-c"
+
+
+def test_group_commit_acked_writes_are_os_visible(chaos_cluster):
+    """Concurrent PUTs through the python volume plane (group commit
+    batches their flushes); after every ack the needle bytes must be
+    visible through an INDEPENDENT file descriptor — i.e. they reached
+    the OS, not just a user-space buffer (ISSUE 2 acceptance: group
+    commit never acks a write whose bytes didn't reach the OS)."""
+    import concurrent.futures as cf
+    import glob as _glob
+    import os as _os
+
+    master, volumes, fsrv = chaos_cluster
+    rng = np.random.default_rng(42)
+    # incompressible payloads: the upload path would gzip repetitive
+    # bytes, and this test byte-searches the raw .dat files
+    payloads = {f"/chaosgc/f{i:03d}.bin":
+                rng.integers(0, 256, size=500 + 37 * i,
+                             dtype=np.uint8).tobytes() for i in range(24)}
+    base = f"http://{fsrv.address}"
+
+    def put(item):
+        path, data = item
+        r = requests.put(base + path, data=data, timeout=30)
+        return path, r.status_code
+
+    with cf.ThreadPoolExecutor(max_workers=8) as ex:
+        for path, status in ex.map(put, payloads.items()):
+            assert status in (200, 201), path
+    # group commit engaged (the counter is process-global, so only
+    # assert it moved — batching ratios are timing-dependent)
+    from seaweedfs_tpu.utils.stats import group_commit_stats
+    st = group_commit_stats()
+    assert st["writes"] > 0 and st["flushes"] > 0
+    # OS-visibility: read every .dat through FRESH descriptors, never
+    # through the volume objects (whose read path may flush buffers on
+    # demand) — after the ack, the bytes must already be in the OS
+    raw = b""
+    for vsrv in volumes:
+        for loc in vsrv.store.locations:
+            for dat in _glob.glob(_os.path.join(loc.directory, "*.dat")):
+                with open(dat, "rb") as f:
+                    raw += f.read()
+    for path, data in payloads.items():
+        assert data in raw, \
+            f"acked write {path} not visible through the OS"
 
 
 # -- subprocess stacks: SWFS_FAILPOINTS env bootstrap ----------------------

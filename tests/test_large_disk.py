@@ -77,8 +77,11 @@ def test_volume_needle_past_32gb(large_disk, tmp_path):
     v = Volume(str(tmp_path) + os.sep, "", 9)
     n1 = Needle.create(1, 0x11, b"below")
     v.write_needle(n1)
-    # push EOF past 32GB; ext4 keeps it sparse
+    # push EOF past 32GB; ext4 keeps it sparse. Resizing _dat behind the
+    # volume's back must invalidate its cached append tail (every
+    # in-tree resize site does the same).
     v._dat.truncate(33 * 1024**3)
+    v._dat_tail = None
     n2 = Needle.create(2, 0x22, b"beyond-32gb")
     v.write_needle(n2)
     nv = v.nm.get(2)
@@ -170,6 +173,7 @@ def test_4byte_volume_caps_at_32gb(tmp_path):
     v = Volume(str(tmp_path) + os.sep, "", 10)
     v.write_needle(Needle.create(1, 1, b"x"))
     v._dat.truncate(33 * 1024**3)
+    v._dat_tail = None  # resized behind the volume's back (see above)
     with pytest.raises(IOError):
         v.write_needle(Needle.create(2, 2, b"y"))
     v.close()

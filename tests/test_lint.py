@@ -1,0 +1,39 @@
+"""tools/lint.py wired into tier-1 as a fast pre-test gate (ISSUE 2
+satellite): the whole tree must pass the pinned minimal rule set
+(E9/F63/F7/F82 under ruff; the built-in syntax+comparison fallback when
+ruff isn't installed) before the functional suite spends its budget."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_lint_gate_is_clean():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py")],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, \
+        f"lint findings:\n{proc.stdout}\n{proc.stderr}"
+
+
+def test_lint_catches_syntax_error(tmp_path):
+    """The gate actually gates: a file that cannot compile fails it."""
+    bad = tmp_path / "pkg"
+    bad.mkdir()
+    (bad / "broken.py").write_text("def f(:\n    pass\n")
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "swfs_lint", os.path.join(REPO, "tools", "lint.py"))
+    lint = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(lint)
+
+    files = [str(bad / "broken.py")]
+    orig = lint._python_files
+    lint._python_files = lambda: files
+    try:
+        assert lint.run_fallback() == 1
+    finally:
+        lint._python_files = orig

@@ -427,6 +427,9 @@ def test_filer_chunked_read_through_native(native_cluster, tmp_path):
     master, vsrv = native_cluster
     fs = FilerServer(ip="localhost", port=_free_port(),
                      master=master.address, store_dir=str(tmp_path / "f"))
+    # this test counts volume-plane hits: the filer chunk cache would
+    # serve the GET without ever touching the native plane
+    fs.chunk_cache = None
     fs.start()
     try:
         s = requests.Session()

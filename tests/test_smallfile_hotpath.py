@@ -1,0 +1,405 @@
+"""Unit coverage for the ISSUE 2 small-file hot-path pieces:
+
+- TieredChunkCache: disk-tier eviction, atime-scan LRU ordering, and the
+  new write/delete invalidation semantics (write-overwrite-read must
+  never return the old bytes);
+- FidLeasePool: batching arithmetic ("fid_delta" minting), block
+  expiry, invalidation, JWT degradation;
+- Volume group commit: concurrent writers share flushes, acked bytes
+  are OS-visible through fresh descriptors, idx is never ahead of dat,
+  and the SWFS_GROUP_COMMIT=0 escape hatch restores flush-per-write;
+- ssl.SSLError classification in utils/retry.is_retryable (ROADMAP
+  open item): handshake/EOF flakes retry, certificate rejections fail
+  fast — including when requests wraps them as ConnectionError.
+"""
+
+import os
+import ssl
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.operation import AssignResult
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import Volume
+from seaweedfs_tpu.utils import retry as retry_mod
+from seaweedfs_tpu.utils.chunk_cache import DiskCache, TieredChunkCache
+from seaweedfs_tpu.wdclient import lease as lease_mod
+from seaweedfs_tpu.wdclient.lease import FidLeasePool
+
+
+# -- TieredChunkCache ------------------------------------------------------
+
+def test_chunk_cache_delete_invalidates_both_tiers(tmp_path):
+    c = TieredChunkCache(mem_bytes=1 << 20, disk_dir=str(tmp_path),
+                         disk_bytes=1 << 20, mem_threshold=1024)
+    c.put("1,aa", b"x" * 10)        # memory tier
+    c.put("2,bb", b"y" * 4096)      # disk tier
+    assert c.get("1,aa") == b"x" * 10
+    assert c.get("2,bb") == b"y" * 4096
+    assert c.delete("1,aa") and c.delete("2,bb")
+    assert c.get("1,aa") is None and c.get("2,bb") is None
+    assert c.delete("1,aa") is False  # second delete: nothing left
+
+
+def test_chunk_cache_overwrite_never_serves_old_bytes(tmp_path):
+    """The filer protocol: an overwrite mints a NEW fid, caches the new
+    bytes under it, and invalidates the old fid. After that sequence the
+    old bytes must be unreachable through either key."""
+    c = TieredChunkCache(mem_bytes=1 << 20, disk_dir=str(tmp_path),
+                         disk_bytes=1 << 20, mem_threshold=64)
+    c.put("3,old", b"version-1" * 20)   # disk tier (>64)
+    c.put("3,old", b"v2")               # same fid re-written smaller: mem
+    assert c.get("3,old") == b"v2", \
+        "stale disk-tier bytes shadowed a newer same-fid write"
+    c.put("4,new", b"version-2")
+    c.delete("3,old")
+    assert c.get("3,old") is None
+    assert c.get("4,new") == b"version-2"
+
+
+def test_chunk_cache_reput_routes_across_tiers(tmp_path):
+    """A same-fid re-put that routes to the OTHER tier must evict the
+    old entry there: mem is consulted first, so a stale mem entry would
+    shadow a newer disk write forever (and vice versa on delete)."""
+    c = TieredChunkCache(mem_bytes=1 << 20, disk_dir=str(tmp_path),
+                         disk_bytes=1 << 20, mem_threshold=100)
+    c.put("5,x", b"m" * 10)          # mem
+    c.put("5,x", b"D" * 500)         # disk: mem copy must die
+    assert c.get("5,x") == b"D" * 500, \
+        "stale memory-tier entry shadowed a newer disk-tier write"
+    c.put("5,x", b"m2" * 5)          # back to mem: disk copy must die
+    assert c.get("5,x") == b"m2" * 5
+    assert c.disk.get("5,x") is None
+
+
+def test_disk_cache_eviction_is_atime_lru(tmp_path):
+    dc = DiskCache(str(tmp_path), capacity_bytes=10_000)
+    dc.put("a", b"A" * 3000)
+    dc.put("b", b"B" * 3000)
+    dc.put("c", b"C" * 3000)
+    # age a + c, freshen b (atime drives the eviction scan)
+    now = time.time()
+    os.utime(dc._path("a"), (now - 300, now - 300))
+    os.utime(dc._path("c"), (now - 200, now - 200))
+    os.utime(dc._path("b"), (now, now))
+    dc.put("d", b"D" * 3000)  # overflows: oldest-atime entries go first
+    assert dc.get("a") is None, "LRU victim (oldest atime) survived"
+    assert dc.get("b") == b"B" * 3000
+    assert dc.get("d") == b"D" * 3000
+
+
+def test_disk_cache_total_survives_delete_accounting(tmp_path):
+    dc = DiskCache(str(tmp_path), capacity_bytes=8_000)
+    dc.put("a", b"A" * 3000)
+    assert dc.delete("a")
+    # freed bytes must be reusable without eviction churn
+    dc.put("b", b"B" * 3000)
+    dc.put("c", b"C" * 3000)
+    assert dc.get("b") and dc.get("c")
+
+
+# -- FidLeasePool ----------------------------------------------------------
+
+def _fake_assign(results):
+    calls = []
+
+    def assign(master, *, count=1, collection="", replication="", ttl="",
+               data_center=""):
+        calls.append(count)
+        return results.pop(0)
+
+    return assign, calls
+
+
+def test_fid_lease_pool_mints_delta_fids(monkeypatch):
+    a = AssignResult(fid="7,01aabbccdd", url="vs:8080", count=4)
+    assign, calls = _fake_assign([a])
+    monkeypatch.setattr(lease_mod, "assign", assign)
+    pool = FidLeasePool("m:9333", batch=4)
+    fids = [pool.acquire().fid for _ in range(4)]
+    assert fids == ["7,01aabbccdd", "7,01aabbccdd_1",
+                    "7,01aabbccdd_2", "7,01aabbccdd_3"]
+    assert calls == [4], "four acquires must cost exactly one Assign"
+    # "fid_delta" parses to base key + delta (ParsePath semantics)
+    from seaweedfs_tpu.storage.file_id import parse_file_id
+    f0, f3 = parse_file_id(fids[0]), parse_file_id(fids[3])
+    assert f3.key == f0.key + 3 and f3.cookie == f0.cookie
+
+
+def test_fid_lease_pool_expires_blocks(monkeypatch):
+    results = [AssignResult(fid="7,01aa11223344", url="u", count=100),
+               AssignResult(fid="8,01bb11223344", url="u", count=100)]
+    assign, calls = _fake_assign(results)
+    monkeypatch.setattr(lease_mod, "assign", assign)
+    pool = FidLeasePool("m", batch=100, max_age=0.05)
+    assert pool.acquire().fid.startswith("7,")
+    time.sleep(0.08)
+    assert pool.remaining() == 0, "expired block still counted"
+    assert pool.acquire().fid.startswith("8,"), \
+        "expired lease block was still handing out fids"
+    assert calls == [100, 100]
+
+
+def test_fid_lease_pool_invalidate_and_error_passthrough(monkeypatch):
+    results = [AssignResult(fid="9,01cc11223344", url="u", count=8),
+               AssignResult(error="no writable volumes")]
+    assign, _ = _fake_assign(results)
+    monkeypatch.setattr(lease_mod, "assign", assign)
+    pool = FidLeasePool("m", batch=8)
+    assert not pool.acquire().error
+    assert pool.remaining() == 7
+    pool.invalidate()
+    assert pool.remaining() == 0
+    assert pool.acquire().error == "no writable volumes"
+
+
+def test_fid_lease_pool_jwt_blocks_never_batch(monkeypatch):
+    """The master signs the BASE fid only: an auth'd assign must not
+    stock delta fids that would fail JWT verification."""
+    results = [AssignResult(fid="5,01dd11223344", url="u", count=16,
+                            auth="jwt-token"),
+               AssignResult(fid="5,01ee11223344", url="u", count=1,
+                            auth="jwt-token")]
+    assign, calls = _fake_assign(results)
+    monkeypatch.setattr(lease_mod, "assign", assign)
+    pool = FidLeasePool("m", batch=16)
+    first = pool.acquire()
+    assert first.auth and "_" not in first.fid
+    assert pool.remaining() == 0
+    assert "_" not in pool.acquire().fid
+    # the pool LEARNS: after the first signed reply, it stops reserving
+    # whole blocks of needle ids it can never hand out
+    assert calls == [16, 1]
+
+
+def test_fid_lease_refill_racing_invalidate_is_discarded(monkeypatch):
+    """A refill Assign completing AFTER invalidate() must not stock its
+    (suspect) block — otherwise save_chunk's single retry draws a fid
+    from the very volume whose failure triggered the invalidation."""
+    pool = FidLeasePool("m", batch=8)
+
+    def racing_assign(master, *, count=1, **kw):
+        pool.invalidate()  # lands while this RPC is "in flight"
+        return AssignResult(fid="3,01aa11223344", url="u", count=count)
+
+    monkeypatch.setattr(lease_mod, "assign", racing_assign)
+    a = pool.acquire()
+    assert not a.error
+    assert pool.remaining() == 0, \
+        "stale refilled block survived a concurrent invalidate"
+
+
+def test_fid_lease_pool_separate_keys(monkeypatch):
+    results = [AssignResult(fid="1,01aa11223344", url="u", count=10),
+               AssignResult(fid="2,01bb11223344", url="u", count=10)]
+    assign, calls = _fake_assign(results)
+    monkeypatch.setattr(lease_mod, "assign", assign)
+    pool = FidLeasePool("m", batch=10)
+    a = pool.acquire(collection="hot")
+    b = pool.acquire(collection="cold")
+    assert a.fid.startswith("1,") and b.fid.startswith("2,")
+    assert pool.acquire(collection="hot").fid.startswith("1,")
+    assert len(calls) == 2
+    # invalidation is per-key: one failing collection must not destroy
+    # the other's healthy batching
+    pool.invalidate(collection="hot")
+    assert pool.remaining() == 9  # cold's block survives (10 - 1 taken)
+    assert pool.acquire(collection="cold").fid.startswith("2,")
+    assert len(calls) == 2, "cold re-assigned despite a live lease"
+
+
+# -- volume group commit ---------------------------------------------------
+
+def _mk_volume(tmp_path, vid=1):
+    return Volume(str(tmp_path), "", vid)
+
+
+def test_group_commit_concurrent_writers_share_flushes(tmp_path):
+    from seaweedfs_tpu.utils.stats import (
+        VOLUME_GROUP_COMMIT_FLUSHES,
+        VOLUME_GROUP_COMMIT_WRITES,
+    )
+
+    v = _mk_volume(tmp_path)
+    w0 = VOLUME_GROUP_COMMIT_WRITES.value()
+    f0 = VOLUME_GROUP_COMMIT_FLUSHES.value()
+    n_threads, per = 8, 25
+    errs = []
+
+    def writer(t):
+        try:
+            for i in range(per):
+                nid = t * 1000 + i + 1
+                n = Needle.create(nid, 0x1234, b"gc" * 40 + bytes([t, i]))
+                v.write_needle(n)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    writes = VOLUME_GROUP_COMMIT_WRITES.value() - w0
+    flushes = VOLUME_GROUP_COMMIT_FLUSHES.value() - f0
+    assert writes == n_threads * per
+    assert 0 < flushes <= writes
+    # every acked write is OS-visible through a FRESH descriptor
+    base = v.file_name()
+    with open(base + ".dat", "rb") as f:
+        raw = f.read()
+    for t in range(n_threads):
+        for i in range(per):
+            assert (b"gc" * 40 + bytes([t, i])) in raw
+    # idx on disk never ahead of dat: every idx entry parses to a
+    # record that exists within the dat bytes already on the OS
+    from seaweedfs_tpu.storage import idx as idx_mod, types
+    ids, offs, sizes = idx_mod.read_index_file(base + ".idx")
+    for off, size in zip(offs, sizes):
+        end = types.stored_to_actual_offset(int(off)) + \
+            types.actual_size(int(size), v.version)
+        assert end <= len(raw), "idx entry points past the durable dat"
+    v.close()
+
+
+def test_group_commit_read_your_own_write(tmp_path):
+    v = _mk_volume(tmp_path)
+    n = Needle.create(42, 0xabcd, b"read-back")
+    v.write_needle(n)
+    got = v.read_needle(42, 0xabcd)
+    assert got.data == b"read-back"
+    # overwrite + delete keep working through the deferred-flush path
+    v.write_needle(Needle.create(42, 0xabcd, b"read-back-2"))
+    assert v.read_needle(42, 0xabcd).data == b"read-back-2"
+    assert v.delete_needle(42, 0xabcd) > 0
+    v.close()
+    # a fresh Volume replays the idx: the acked state survives
+    v2 = _mk_volume(tmp_path)
+    from seaweedfs_tpu.storage.errors import NotFoundError
+    with pytest.raises(NotFoundError):
+        v2.read_needle(42, 0xabcd)
+    v2.close()
+
+
+def test_group_commit_env_escape_hatch(tmp_path, monkeypatch):
+    monkeypatch.setenv("SWFS_GROUP_COMMIT", "0")
+    v = _mk_volume(tmp_path, vid=3)
+    assert v._gc_enabled is False
+    assert v.nm.auto_flush is True
+    v.write_needle(Needle.create(7, 1, b"inline-flush"))
+    base = v.file_name()
+    with open(base + ".dat", "rb") as f:
+        assert b"inline-flush" in f.read()
+    v.close()
+
+
+def test_group_commit_flush_failure_freezes_volume(tmp_path):
+    """A failed batch flush must not let a LATER write's flush silently
+    commit bytes whose writer was told 500: the volume freezes for
+    writes (restart repair converges on the durable prefix). The freeze
+    flag is independent of read_only, so it can never clobber a
+    read-only state set by an admin/EC path meanwhile."""
+    v = _mk_volume(tmp_path, vid=5)
+    v.write_needle(Needle.create(1, 1, b"pre-failure"))
+    real_flush = v._dat.flush
+    def boom():
+        raise OSError(28, "No space left on device")
+    v._dat.flush = boom
+    with pytest.raises(IOError):
+        v.write_needle(Needle.create(2, 2, b"doomed"))
+    assert v._gc_frozen
+    assert not v.read_only  # the admin flag stays untouched
+    v._dat.flush = real_flush
+    with pytest.raises(IOError):  # frozen: new writes are refused
+        v.write_needle(Needle.create(3, 3, b"rejected"))
+    v.close()
+
+
+def test_filer_cache_skips_ttl_and_serves_cacheable(tmp_path):
+    """_read_chunk_view rung 0: cacheable views are served from the
+    fid-keyed cache with zero volume round-trips; non-cacheable (TTL'd)
+    views bypass the cache entirely (nothing would ever expire them)."""
+    from seaweedfs_tpu.filer.filechunks import ChunkView
+    from seaweedfs_tpu.server.filer import FilerServer
+
+    srv = FilerServer(ip="localhost", port=18888, master="localhost:1",
+                      store_dir=str(tmp_path))  # never started
+    try:
+        assert srv.chunk_cache is not None
+        srv.chunk_cache.put("9,aabbccdd11", b"cached-bytes")
+        view = ChunkView(file_id="9,aabbccdd11", chunk_offset=0,
+                         size=len(b"cached-bytes"), logical_offset=0,
+                         is_full_chunk=True)
+        assert srv._read_chunk_view(view) == b"cached-bytes"
+        # TTL'd entry: the cache must not answer — the (dead) cluster is
+        # consulted and the read fails instead of serving expired bytes
+        srv.master_client.lookup_file_id = \
+            lambda fid, refresh=False: (_ for _ in ()).throw(
+                LookupError("volume gone"))
+        srv.master_client.ec_fallback_urls = lambda fid: []
+        with pytest.raises(IOError):
+            srv._read_chunk_view(view, cacheable=False)
+    finally:
+        srv.filer.store.close()
+
+
+def test_filer_disk_only_cache_config(tmp_path, monkeypatch):
+    from seaweedfs_tpu.server.filer import FilerServer
+
+    monkeypatch.setenv("SWFS_FILER_CACHE_MB", "0")
+    monkeypatch.setenv("SWFS_FILER_CACHE_DISK_MB", "32")
+    srv = FilerServer(ip="localhost", port=18889, master="localhost:1",
+                      store_dir=str(tmp_path))
+    try:
+        assert srv.chunk_cache is not None, \
+            "disk-only cache config was silently dropped"
+        srv.chunk_cache.put("1,smallchunk99", b"tiny")  # routes to disk
+        assert srv.chunk_cache.get("1,smallchunk99") == b"tiny"
+        assert srv.chunk_cache.disk is not None
+        assert srv.chunk_cache.disk.get("1,smallchunk99") == b"tiny"
+    finally:
+        srv.filer.store.close()
+
+
+# -- ssl.SSLError classification (ROADMAP open item) -----------------------
+
+def test_ssl_cert_verification_fails_fast():
+    e = ssl.SSLCertVerificationError(
+        1, "certificate verify failed: unable to get local issuer")
+    assert retry_mod.is_retryable(e) is False
+
+
+def test_ssl_handshake_flakes_retry():
+    assert retry_mod.is_retryable(ssl.SSLEOFError(
+        8, "EOF occurred in violation of protocol")) is True
+    assert retry_mod.is_retryable(ssl.SSLWantReadError()) is True
+    generic = ssl.SSLError(1, "[SSL] record layer failure")
+    assert retry_mod.is_retryable(generic) is True
+
+
+def test_ssl_generic_cert_reason_fails_fast():
+    e = ssl.SSLError(1, "alert")
+    e.reason = "TLSV1_ALERT_UNKNOWN_CA"
+    assert retry_mod.is_retryable(e) is False
+    e2 = ssl.SSLError(1, "sslv3 alert certificate expired")
+    e2.reason = "SSLV3_ALERT_CERTIFICATE_EXPIRED"
+    assert retry_mod.is_retryable(e2) is False
+
+
+def test_ssl_wrapped_in_requests_connectionerror():
+    """requests.exceptions.SSLError subclasses ConnectionError — without
+    the unwrap, cert rejections would ride the blanket retry branch."""
+    import requests as rq
+
+    inner = ssl.SSLCertVerificationError(1, "certificate verify failed")
+    wrapped = rq.exceptions.SSLError(inner)
+    assert retry_mod.is_retryable(wrapped) is False
+    flaky = rq.exceptions.SSLError(
+        ssl.SSLEOFError(8, "EOF occurred in violation of protocol"))
+    assert retry_mod.is_retryable(flaky) is True
+    # plain connection refusals keep retrying as before
+    assert retry_mod.is_retryable(rq.exceptions.ConnectionError()) is True
