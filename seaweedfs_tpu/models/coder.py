@@ -26,6 +26,8 @@ class ErasureCoder(Protocol):
 
     def encode_parity(self, data): ...
 
+    def encode_parity_stacked(self, stack): ...
+
     def encode(self, shards): ...
 
     def reconstruct(self, shards) -> dict[int, np.ndarray]: ...
@@ -83,6 +85,19 @@ class AutoMeshCoder:
     def encode_parity(self, data):
         return self._resolve().encode_parity(data)
 
+    def encode_parity_stacked(self, stack):
+        """[V, k, B] -> [V, m, B] in one stacked dispatch; falls back to
+        per-slab encode_parity on backends without a native stacked
+        kernel (bytes identical either way — columns are independent)."""
+        impl = self._resolve()
+        fn = getattr(impl, "encode_parity_stacked", None)
+        if fn is not None:
+            return fn(stack)
+        import numpy as _np
+
+        return _np.stack(
+            [_np.asarray(impl.encode_parity(s), _np.uint8) for s in stack])
+
     def encode(self, shards):
         return self._resolve().encode(shards)
 
@@ -99,15 +114,10 @@ class AutoMeshCoder:
         fn = getattr(impl, "reconstruct_stacked", None)
         if fn is not None:
             return fn(present_ids, stacked, data_only=data_only)
-        out = (impl.reconstruct_data if data_only
-               else impl.reconstruct)({s: stacked[j] for j, s
-                                       in enumerate(present_ids)})
-        missing = tuple(sorted(out))
-        import numpy as _np
+        from ..ops.dispatch import reconstruct_stacked_via_dict
 
-        if not missing:
-            return missing, _np.zeros((0, stacked.shape[1]), _np.uint8)
-        return missing, _np.stack([_np.asarray(out[i]) for i in missing])
+        return reconstruct_stacked_via_dict(impl, present_ids, stacked,
+                                            data_only)
 
     def verify(self, shards) -> bool:
         return self._resolve().verify(shards)

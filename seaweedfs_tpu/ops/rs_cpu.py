@@ -27,13 +27,49 @@ class RSCodecCPU:
         self._gp = gf256.parity_matrix(data_shards, parity_shards)
 
     def _matmul(self, matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
-        """GF(256) matmul hook — overridden by the native C++ backend."""
-        return gf256.gf_matmul(matrix, data)
+        """GF(256) matmul hook — overridden by the native C++ backend.
+
+        Streaming accumulation (out[i] ^= T[c] @ row) instead of
+        gf256.gf_matmul's [m, k, B] product tensor: the 3D intermediate
+        falls out of cache past a few KB per column and costs 2-4x at
+        volume-slab sizes. XOR is exact and order-free, so the bytes are
+        identical to the tensor form (tests pin both against rs_jax)."""
+        matrix = np.asarray(matrix, dtype=np.uint8)
+        data = np.asarray(data, dtype=np.uint8)
+        table = gf256._mul_table()
+        out = np.zeros((matrix.shape[0], data.shape[1]), dtype=np.uint8)
+        for i in range(matrix.shape[0]):
+            acc = out[i]
+            for j in range(matrix.shape[1]):
+                c = int(matrix[i, j])
+                if c == 0:
+                    continue
+                if c == 1:
+                    acc ^= data[j]
+                else:
+                    acc ^= table[c][data[j]]
+        return out
 
     def encode_parity(self, data: np.ndarray) -> np.ndarray:
         data = np.asarray(data, dtype=np.uint8)
         assert data.shape[0] == self.data_shards
         return self._matmul(self._gp, data)
+
+    def encode_parity_stacked(self, stack: np.ndarray) -> np.ndarray:
+        """stack [V, k, B] -> parity [V, m, B]: V volumes' slabs encoded in
+        ONE matmul call. Parity is a per-byte-column GF matmul, so laying
+        the V slabs side by side along the column axis ([k, V*B]) yields
+        bytes identical to V separate encode_parity calls — this is the
+        CPU mirror of the device op (ops/dispatch.py batches through it),
+        amortizing the per-call overhead the dispatch scheduler exists to
+        kill."""
+        stack = np.asarray(stack, dtype=np.uint8)
+        assert stack.ndim == 3 and stack.shape[1] == self.data_shards, \
+            stack.shape
+        v, k, b = stack.shape
+        wide = stack.transpose(1, 0, 2).reshape(k, v * b)
+        parity = self._matmul(self._gp, wide)
+        return parity.reshape(self.parity_shards, v, b).transpose(1, 0, 2)
 
     def encode(self, shards: np.ndarray) -> np.ndarray:
         shards = np.asarray(shards, dtype=np.uint8).copy()
@@ -60,6 +96,22 @@ class RSCodecCPU:
                     parity = self.encode_parity(data)
                 out[i] = parity[i - self.data_shards]
         return out
+
+    def reconstruct_stacked(
+        self, present_ids, stacked: np.ndarray, data_only: bool = False
+    ) -> tuple[tuple[int, ...], np.ndarray]:
+        """Pre-stacked survivors [P, B] in caller row order ->
+        (missing_ids, [len(missing), B]) — CPU mirror of
+        RSCodecJax.reconstruct_stacked so the EC dispatch scheduler's
+        column-concatenated reconstruct batches run identically off
+        device. Same survivor-subset choice (sorted ids, first k) as the
+        fused device matrix, so bytes match bit-for-bit."""
+        from .dispatch import reconstruct_stacked_via_dict
+
+        stacked = np.asarray(stacked, dtype=np.uint8)
+        assert stacked.shape[0] == len(present_ids), stacked.shape
+        return reconstruct_stacked_via_dict(self, present_ids, stacked,
+                                            data_only)
 
     def reconstruct_data(self, shards) -> dict[int, np.ndarray]:
         present = self._as_dict(shards)

@@ -373,6 +373,26 @@ class RSCodecJax:
         out = _encode_jit(_pad_bytes(data, b), self.data_shards, self.parity_shards)
         return out[:, :b]
 
+    def encode_parity_stacked(
+        self, stack: np.ndarray | jax.Array
+    ) -> jax.Array:
+        """stack [V, k, B] -> parity [V, m, B] in ONE device dispatch.
+
+        Parity is a per-byte-column GF matmul, so the V slabs are laid
+        side by side along the column axis ([k, V*B]) and encoded as one
+        batch — the dispatch-amortization primitive behind
+        ops/dispatch.py: V volumes' concurrent encode pipelines pay one
+        device round-trip instead of V. Columns are independent, so each
+        slab's bytes are identical to its own encode_parity call."""
+        stack = jnp.asarray(stack, dtype=jnp.uint8)
+        assert stack.ndim == 3 and stack.shape[1] == self.data_shards, \
+            stack.shape
+        v, k, b = stack.shape
+        wide = jnp.swapaxes(stack, 0, 1).reshape(k, v * b)
+        parity = self.encode_parity(wide)
+        return jnp.swapaxes(
+            parity.reshape(self.parity_shards, v, b), 0, 1)
+
     def encode(self, shards: np.ndarray | jax.Array) -> jax.Array:
         """[k, B] data or [total, B] shards: fills parity rows, returns all."""
         shards = jnp.asarray(shards, dtype=jnp.uint8)

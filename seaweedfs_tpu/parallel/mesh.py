@@ -144,6 +144,24 @@ class ShardedCoder:
                              self.kernel)
         return out[:, :b]
 
+    def encode_parity_stacked(self, stack) -> jax.Array:
+        """stack [V, k, B] -> parity [V, m, B]: the V slabs ride ONE
+        mesh-sharded dispatch, columns laid side by side ([k, V*B]) —
+        same column-independence argument as
+        RSCodecJax.encode_parity_stacked, so per-slab bytes are identical
+        to V separate encode_parity calls. The stacked column axis also
+        spreads across the mesh, so batching and multi-chip scaling
+        compose."""
+        stack = np.asarray(stack, dtype=np.uint8)
+        assert stack.ndim == 3 and stack.shape[1] == self.data_shards, \
+            stack.shape
+        v, k, b = stack.shape
+        wide = np.ascontiguousarray(
+            stack.transpose(1, 0, 2).reshape(k, v * b))
+        parity = self.encode_parity(wide)
+        return jnp.swapaxes(
+            parity.reshape(self.parity_shards, v, b), 0, 1)
+
     def encode(self, shards) -> jax.Array:
         """[k, B] data or [total, B] shards -> all [total, B] shards with
         parity rows (re)computed."""

@@ -24,6 +24,7 @@ from urllib.parse import parse_qs, urlparse
 import grpc
 import numpy as np
 
+from ..ops import dispatch
 from ..pb import master_pb2, rpc, volume_server_pb2 as vs
 from ..storage import types
 from ..storage.ec_files import (
@@ -115,6 +116,11 @@ class VolumeServer:
         self._ec_loc_cache: dict[int, tuple[float, dict[int, list[str]]]] = {}
         self._loc_cache: dict[int, tuple[float, list[str]]] = {}
         self._native_lock = threading.Lock()
+        # reconstructed-interval LRU for degraded EC reads: a hot lost
+        # shard pays the k-survivor fetch + device dispatch once per
+        # block; invalidated on shard mount/unmount/delete (the gRPC
+        # handlers below). SWFS_EC_RECON_CACHE_MB=0 disables it.
+        self.ec_recon_cache = dispatch.ReconstructIntervalCache()
 
     @property
     def address(self) -> str:
@@ -367,6 +373,47 @@ class VolumeServer:
     def _reconstruct_interval(self, ev: EcVolume, vid: int, sid: int,
                               soff: int, size: int,
                               locs: dict[int, list[str]]) -> bytes:
+        """Degraded read: serve [soff, soff+size) of a lost shard.
+
+        Rides the reconstructed-interval cache (block-aligned, LRU,
+        invalidated on shard mount/unmount/delete) so repeated degraded
+        reads of a hot lost shard stop paying a full k-shard fetch +
+        device dispatch each; cache-miss blocks and cache-off reads go
+        through `_reconstruct_range`, whose dispatches micro-batch with
+        every other concurrent degraded read via the EC dispatch
+        scheduler."""
+        cache = self.ec_recon_cache
+        if (cache is None or not cache.enabled()
+                or len(ev.shard_files) < ev.geo.data_shards):
+            # remote-survivor reconstructs stay interval-sized: block-
+            # aligning them would multiply the remote fetch traffic by
+            # up to block/interval per missing local shard
+            return self._reconstruct_range(ev, vid, sid, soff, size, locs)
+        out = bytearray()
+        bs = cache.block_size
+        gen = cache.generation(vid)  # before any survivor bytes are read
+        for blk in cache.blocks_for(soff, size):
+            start = blk * bs
+            blen = min(bs, max(ev.shard_size, soff + size) - start)
+            data = cache.get(vid, sid, blk)
+            if data is None:
+                data = self._reconstruct_range(
+                    ev, vid, sid, start, blen, locs)
+                cache.put(vid, sid, blk, data, gen=gen)
+            lo = max(soff, start) - start
+            hi = min(soff + size, start + blen) - start
+            out += data[lo:hi]
+        if len(out) < size:  # interval ran past the cached shard extent
+            out += b"\0" * (size - len(out))
+        return bytes(out)
+
+    def _reconstruct_range(self, ev: EcVolume, vid: int, sid: int,
+                           soff: int, size: int,
+                           locs: dict[int, list[str]]) -> bytes:
+        """recoverOneRemoteEcShardInterval (store_ec.go:339-393): gather k
+        survivor intervals (local + remote, in parallel), then reconstruct
+        through the stacked fast path — concurrent calls sharing a
+        survivor set coalesce into one device dispatch."""
         geo = ev.geo
         bufs: dict[int, np.ndarray] = {}
         for i, f in ev.shard_files.items():
@@ -405,10 +452,12 @@ class VolumeServer:
             raise IOError(
                 f"ec volume {vid}: only {len(bufs)} shards reachable, "
                 f"need {geo.data_shards}")
-        rebuilt = self.store.coder.reconstruct({i: b for i, b in bufs.items()})
-        if sid in rebuilt:
-            return np.asarray(rebuilt[sid], np.uint8).tobytes()
-        return bufs[sid].tobytes()
+        if sid in bufs:  # a flaky local read healed mid-gather
+            return bufs[sid].tobytes()
+        pres = tuple(sorted(bufs))  # canonical order -> shared lane
+        mids, rows = dispatch.reconstruct_now(
+            self.store.coder, pres, np.stack([bufs[i] for i in pres]))
+        return np.asarray(rows[mids.index(sid)], np.uint8).tobytes()
 
     def _lookup_ec_shards(self, vid: int) -> dict[int, list[str]]:
         """cachedLookupEcShardLocations (store_ec.go:238), 10s TTL."""
@@ -903,17 +952,21 @@ class VolumeGrpc:
                 if os.path.exists(base + ".ecx"):
                     self.store.mount_ec_shards(
                         request.volume_id, request.collection, [])
+        self.srv.ec_recon_cache.invalidate(request.volume_id)
         self.srv.trigger_heartbeat()
         return vs.VolumeEcShardsDeleteResponse()
 
     def VolumeEcShardsMount(self, request, context):
         self.store.mount_ec_shards(
             request.volume_id, request.collection, list(request.shard_ids))
+        # cached reconstructions may describe shards that just (re)appeared
+        self.srv.ec_recon_cache.invalidate(request.volume_id)
         self.srv.trigger_heartbeat()
         return vs.VolumeEcShardsMountResponse()
 
     def VolumeEcShardsUnmount(self, request, context):
         self.store.unmount_ec_shards(request.volume_id, list(request.shard_ids))
+        self.srv.ec_recon_cache.invalidate(request.volume_id)
         self.srv.trigger_heartbeat()
         return vs.VolumeEcShardsUnmountResponse()
 
@@ -1235,7 +1288,10 @@ def _make_http_handler(srv: VolumeServer):
                                      "fileCount": v.file_count(),
                                      "readOnly": v.read_only
                                      or v._gc_frozen}
-                from ..utils.stats import group_commit_stats
+                from ..utils.stats import (
+                    ec_dispatch_stats,
+                    group_commit_stats,
+                )
 
                 plane = srv.native_plane
                 return self._json({
@@ -1247,6 +1303,9 @@ def _make_http_handler(srv: VolumeServer):
                     # (ISSUE 2 group commit); the native plane writes
                     # through unbuffered pwrite and does not batch
                     "GroupCommit": group_commit_stats(),
+                    # EC dispatch plane (ISSUE 3): stacked-dispatch batch
+                    # factors + reconstructed-interval cache ratios
+                    "EcDispatch": ec_dispatch_stats(),
                 })
             if u.path == "/metrics":
                 return self._reply(200, gather().encode(),

@@ -59,6 +59,11 @@ def ec_encode(env, args, out):
     p.add_argument("-dataShards", type=int, default=0)
     p.add_argument("-parityShards", type=int, default=0)
     p.add_argument("-parallelCopy", type=int, default=10)
+    p.add_argument("-parallelEncode", type=int, default=4,
+                   help="volumes erasure-coded concurrently; concurrent "
+                        "VolumeEcShardsGenerate pipelines on one server "
+                        "coalesce into stacked device dispatches "
+                        "(ops/dispatch.py)")
     opts = p.parse_args(args)
     env.confirm_is_locked()
 
@@ -67,8 +72,33 @@ def ec_encode(env, args, out):
     if not vids:
         print("no volumes qualify for ec encoding", file=out)
         return
-    for vid in vids:
-        _do_ec_encode(env, vid, opts, out)
+    if opts.parallelEncode <= 1 or len(vids) == 1:
+        for vid in vids:
+            _do_ec_encode(env, vid, opts, out)
+        return
+    # encode volumes concurrently: the per-volume shard lifecycle is
+    # independent, and overlapping the servers' encode pipelines is what
+    # lets the EC dispatch scheduler amortize device round-trips across
+    # volumes. Placement shares one in-flight load ledger — concurrent
+    # encoders see the same pre-copy topology snapshot, so without it
+    # every thread would crown the same emptiest node/rack and pile all
+    # volumes' shards there. Failures surface after every volume had its
+    # attempt.
+    errors: list[tuple[int, Exception]] = []
+    shared = _SharedPlacement()
+
+    def one(vid):
+        try:
+            _do_ec_encode(env, vid, opts, out, shared=shared)
+        except Exception as e:  # KeyboardInterrupt/SystemExit still abort
+            errors.append((vid, e))
+
+    with ThreadPoolExecutor(max_workers=opts.parallelEncode) as ex:
+        list(ex.map(one, vids))
+    for vid, e in errors:
+        print(f"volume {vid}: ec encode failed: {e}", file=out)
+    if errors:
+        raise errors[0][1]
 
 
 def _collect_full_volume_ids(env, collection: str, full_percent: float) -> list[int]:
@@ -87,7 +117,22 @@ def _collect_full_volume_ids(env, collection: str, full_percent: float) -> list[
     return sorted(set(vids))
 
 
-def _do_ec_encode(env, vid: int, opts, out) -> None:
+class _SharedPlacement:
+    """Cross-thread ledger of shard placements already decided by THIS
+    ec.encode invocation but not yet visible in topology heartbeats:
+    node/rack counts that concurrent volumes' placement loops fold into
+    their sort keys so the load spreads instead of piling onto whichever
+    node the shared stale snapshot ranks emptiest."""
+
+    def __init__(self):
+        import threading
+
+        self.lock = threading.Lock()
+        self.node_load: dict[str, int] = defaultdict(int)
+        self.rack_load: dict[tuple[str, str], int] = defaultdict(int)
+
+
+def _do_ec_encode(env, vid: int, opts, out, shared=None) -> None:
     locations = _volume_locations(env, vid)
     if not locations:
         raise ValueError(f"volume {vid} not found in topology")
@@ -119,12 +164,21 @@ def _do_ec_encode(env, vid: int, opts, out) -> None:
     racks = env.node_racks(topo)
     alloc: dict[str, list[int]] = defaultdict(list)
     rack_load: dict[tuple[str, str], int] = defaultdict(int)
-    for sid in range(total_shards):
-        nodes.sort(key=lambda n: (rack_load[racks.get(n[0], ("", n[0]))],
-                                  len(alloc[n[0]]), -n[1]))
-        chosen = nodes[0][0]
-        alloc[chosen].append(sid)
-        rack_load[racks.get(chosen, ("", chosen))] += 1
+    if shared is None:
+        shared = _SharedPlacement()  # serial path: ledger is a no-op
+    with shared.lock:
+        for sid in range(total_shards):
+            nodes.sort(key=lambda n: (
+                rack_load[racks.get(n[0], ("", n[0]))]
+                + shared.rack_load[racks.get(n[0], ("", n[0]))],
+                len(alloc[n[0]]) + shared.node_load[n[0]],
+                -n[1]))
+            chosen = nodes[0][0]
+            alloc[chosen].append(sid)
+            rack_load[racks.get(chosen, ("", chosen))] += 1
+        for node, sids in alloc.items():
+            shared.node_load[node] += len(sids)
+            shared.rack_load[racks.get(node, ("", node))] += len(sids)
 
     def copy_to(target_and_sids):
         target, sids = target_and_sids

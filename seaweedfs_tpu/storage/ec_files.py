@@ -26,6 +26,7 @@ from dataclasses import dataclass, field
 
 import numpy as np
 
+from ..ops import dispatch
 from . import idx as idx_mod
 from . import needle_map, types
 from .ec_locate import Geometry
@@ -246,13 +247,22 @@ def generate_ec_files(
             try:
                 fallocate(f2.fileno(), 0, shard_size)
             except OSError:
-                break
+                continue  # best-effort PER FILE (one ENOSPC/EOPNOTSUPP
+                #           must not strip preallocation from the rest)
     free_q: queue.Queue = queue.Queue()
     max_batch = min(batch_size, max(geo.large_block, geo.small_block))
     for _ in range(depth + 2):
         free_q.put(np.empty((k, max_batch), dtype=np.uint8))
     work_q: queue.Queue = queue.Queue(maxsize=depth)
     stop = threading.Event()
+
+    # EC dispatch scheduler (ops/dispatch.py): slabs from THIS pipeline and
+    # any other volume encoding concurrently through the same coder share
+    # stacked [V, k, B] device dispatches. The futures it returns answer
+    # np.asarray just like the lazy device array from a direct call, and
+    # shard bytes stay identical (zero-padded ragged columns slice away).
+    sched = dispatch.maybe_scheduler(coder)
+    encode = coder.encode_parity if sched is None else sched.encode_parity
 
     def reader() -> None:
         try:
@@ -279,7 +289,7 @@ def generate_ec_files(
                                 )
                         t1 = time.perf_counter()
                         stats.read_s += t1 - t0
-                        parity_fut = coder.encode_parity(data)
+                        parity_fut = encode(data)
                         stats.dispatch_s += time.perf_counter() - t1
                         work_q.put((buf, data, parity_fut, batch))
                     processed += block_size * k
@@ -418,7 +428,7 @@ def rebuild_ec_files(
             try:
                 fallocate(f.fileno(), 0, shard_size)
             except OSError:
-                break
+                continue  # best-effort per file, as in generate_ec_files
     # Same pipeline shape as the encoder: a reader thread dispatches
     # reconstructs asynchronously; the coordinator drains an N-deep queue
     # and fans rebuilt rows out to one writer thread per missing shard.
@@ -427,6 +437,10 @@ def rebuild_ec_files(
 
     use_stacked = hasattr(coder, "reconstruct_stacked")
     pres_tuple = tuple(present)
+    # share stacked reconstruct dispatches with any concurrent rebuild of
+    # the same survivor set (and keep the pipeline depth working ahead:
+    # futures resolve in the coordinator, not the reader)
+    sched = dispatch.maybe_scheduler(coder) if use_stacked else None
 
     def reader() -> None:
         try:
@@ -449,7 +463,12 @@ def rebuild_ec_files(
                         )
                 if not n:
                     break
-                if use_stacked:
+                if sched is not None:
+                    # fresh buffer each loop: the slab may reference it
+                    # without a defensive copy
+                    work_q.put(sched.reconstruct_stacked(
+                        pres_tuple, stacked[:, :n]))
+                elif use_stacked:
                     mids, rows = coder.reconstruct_stacked(
                         pres_tuple, stacked[:, :n])
                     work_q.put(dict(zip(mids, rows)))
@@ -473,6 +492,9 @@ def rebuild_ec_files(
                 break
             if isinstance(rebuilt, BaseException):
                 raise rebuilt
+            if isinstance(rebuilt, dispatch.EcFuture):
+                mids, rows = rebuilt.result()
+                rebuilt = dict(zip(mids, rows))
             for i in missing:
                 row = np.ascontiguousarray(
                     np.asarray(rebuilt[i], dtype=np.uint8))

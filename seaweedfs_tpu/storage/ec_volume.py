@@ -9,6 +9,7 @@ ReadEcShardNeedle store_ec.go:136-176).
 
 from __future__ import annotations
 
+import io
 import os
 
 import numpy as np
@@ -36,6 +37,23 @@ def save_volume_info(base_file_name: str, info: dict) -> None:
         json.dump(info, f)
 
 
+def _read_at(f, offset: int, length: int) -> bytes:
+    """Positional read that never moves a shared handle's file position:
+    concurrent needle lookups share the EcVolume's one .ecx handle, and
+    interleaved seek+read pairs from N serving threads corrupt each
+    other's binary searches (found by the ISSUE-3 concurrent
+    degraded-read probe). pread when the object has a real fd; the
+    seek+read fallback serves file-likes (BytesIO) in tests."""
+    try:
+        fd = f.fileno()
+    except (AttributeError, OSError, ValueError, io.UnsupportedOperation):
+        fd = None
+    if fd is not None:
+        return os.pread(fd, length, offset)
+    f.seek(offset)
+    return f.read(length)
+
+
 def search_needle_from_sorted_index(
     ecx_file, ecx_file_size: int, needle_id: int, process_fn=None
 ) -> tuple[int, int]:
@@ -54,8 +72,8 @@ def search_needle_from_sorted_index(
     lo, hi = 0, ecx_file_size // types.NEEDLE_MAP_ENTRY_SIZE
     while lo < hi:
         mid = (lo + hi) // 2
-        ecx_file.seek(mid * types.NEEDLE_MAP_ENTRY_SIZE)
-        buf = ecx_file.read(types.NEEDLE_MAP_ENTRY_SIZE)
+        buf = _read_at(ecx_file, mid * types.NEEDLE_MAP_ENTRY_SIZE,
+                       types.NEEDLE_MAP_ENTRY_SIZE)
         key, offset, size = types.unpack_needle_map_entry(buf)
         if key == needle_id:
             if process_fn is not None:
@@ -226,19 +244,30 @@ class EcVolume:
             return data
         # degraded: rebuild this interval from any k surviving shards
         # (recoverOneRemoteEcShardInterval, store_ec.go:339-393)
-        bufs: dict[int, np.ndarray] = {}
+        pres: list[int] = []
+        rows: list[np.ndarray] = []
         for i, sf in self.shard_files.items():
-            if len(bufs) == self.geo.data_shards:
+            if len(pres) == self.geo.data_shards:
                 break
-            chunk = sf.read_at(shard_off, size)
+            try:
+                chunk = sf.read_at(shard_off, size)
+            except OSError:  # bad sector / stale handle: any k suffice,
+                continue  # same tolerance as the server-side gather
             chunk += b"\0" * (size - len(chunk))
-            bufs[i] = np.frombuffer(chunk, dtype=np.uint8)
-        if len(bufs) < self.geo.data_shards:
+            pres.append(i)
+            rows.append(np.frombuffer(chunk, dtype=np.uint8))
+        if len(pres) < self.geo.data_shards:
             raise IOError(
-                f"cannot reconstruct shard {shard_id}: only {len(bufs)} shards available"
+                f"cannot reconstruct shard {shard_id}: only {len(pres)} shards available"
             )
-        rebuilt = self.coder.reconstruct_data(bufs)
-        return np.asarray(rebuilt[shard_id], dtype=np.uint8).tobytes()
+        from ..ops import dispatch
+
+        # concurrent degraded reads sharing this survivor set ride ONE
+        # stacked reconstruct dispatch (micro-batched by the window)
+        missing, out = dispatch.reconstruct_now(
+            self.coder, pres, np.stack(rows), data_only=True)
+        return np.asarray(
+            out[missing.index(shard_id)], dtype=np.uint8).tobytes()
 
     def delete_needle(self, needle_id: int) -> None:
         delete_needle_from_ecx(self.base, needle_id)

@@ -338,6 +338,12 @@ class Store:
                 ev.close()
             loc.volumes.clear()
             loc.ec_volumes.clear()
+        # the EC dispatch scheduler attached to this store's coder (if any
+        # EC work ran) owns a flusher thread — flush + join it so tests
+        # and restarts never leak one
+        sched = getattr(self.coder, "_ec_dispatch_sched", None)
+        if sched is not None:
+            sched.close()
 
 
 def l_free(loc: DiskLocation) -> int:

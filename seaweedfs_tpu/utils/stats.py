@@ -188,6 +188,60 @@ VOLUME_GROUP_COMMIT_FLUSHES = Counter(
     "Batched dat+idx flushes; writes/flushes is the batching factor.")
 
 
+# -- EC dispatch plane (ISSUE 3): the scheduler that coalesces encode /
+#    reconstruct slabs into stacked device dispatches, plus the
+#    reconstructed-interval cache serving repeated degraded reads ---------
+
+EC_DISPATCH_SLABS = Counter(
+    "SeaweedFS_ec_dispatch_slabs",
+    "Slabs submitted to the EC dispatch scheduler by lane "
+    "(encode/reconstruct).")
+EC_DISPATCH_BATCHES = Counter(
+    "SeaweedFS_ec_dispatch_batches",
+    "Stacked dispatches issued by lane; slabs/batches is the batch factor.")
+EC_DISPATCH_WINDOW_WAIT = Histogram(
+    "SeaweedFS_ec_dispatch_window_wait_seconds",
+    "Time a slab waited in the scheduler before its dispatch launched.")
+EC_DISPATCH_STACK_SLABS = Histogram(
+    "SeaweedFS_ec_dispatch_stacked_slabs",
+    "Slabs per stacked dispatch (the realized batch size).",
+    buckets=[1, 2, 3, 4, 6, 8, 12, 16, 24, 32, 48, 64])
+EC_DISPATCH_STACK_BYTES = Histogram(
+    "SeaweedFS_ec_dispatch_stacked_bytes",
+    "Input bytes per stacked dispatch.",
+    buckets=[4096, 65536, 1 << 20, 4 << 20, 16 << 20, 64 << 20, 256 << 20])
+EC_RECON_CACHE_COUNTER = Counter(
+    "SeaweedFS_ec_dispatch_recon_cache_ops",
+    "Reconstructed-interval cache activity by result "
+    "(hit/miss/put/invalidate/evict).")
+
+
+def ec_dispatch_stats() -> dict:
+    """Snapshot for /status pages: per-lane batch factor + cache ratios."""
+    out: dict = {}
+    for lane in ("encode", "reconstruct"):
+        slabs = EC_DISPATCH_SLABS.value(lane=lane)
+        batches = EC_DISPATCH_BATCHES.value(lane=lane)
+        out[lane] = {
+            "slabs": int(slabs),
+            "batches": int(batches),
+            "batchFactor": round(slabs / batches, 3) if batches else 0.0,
+        }
+    hits = EC_RECON_CACHE_COUNTER.value(result="hit")
+    misses = EC_RECON_CACHE_COUNTER.value(result="miss")
+    total = hits + misses
+    out["reconCache"] = {
+        "hits": int(hits),
+        "misses": int(misses),
+        "puts": int(EC_RECON_CACHE_COUNTER.value(result="put")),
+        "invalidations": int(
+            EC_RECON_CACHE_COUNTER.value(result="invalidate")),
+        "evictions": int(EC_RECON_CACHE_COUNTER.value(result="evict")),
+        "hitRate": round(hits / total, 4) if total else 0.0,
+    }
+    return out
+
+
 def group_commit_stats() -> dict:
     """Snapshot for /status pages: flush-batching factor provenance."""
     writes = VOLUME_GROUP_COMMIT_WRITES.value()

@@ -44,3 +44,40 @@ def _hang_watchdog(request):
     faulthandler.dump_traceback_later(budget, exit=True)
     yield
     faulthandler.cancel_dump_traceback_later()
+
+
+# -- duration audit (ISSUE 3 satellite): every test that takes longer than
+# the threshold MUST carry @pytest.mark.slow, or the run fails. PR 1 shipped
+# a 252s mesh test into tier-1 unmarked and silently ate a third of the
+# tier-1 budget for a round; this makes that class of regression loud.
+_SLOW_AUDIT_THRESHOLD = float(os.environ.get("SWFS_TEST_SLOW_THRESHOLD",
+                                             "120"))
+_overlong: list[tuple[str, float]] = []
+
+
+def pytest_runtest_logreport(report):
+    if report.when != "call" or report.duration <= _SLOW_AUDIT_THRESHOLD:
+        return
+    if "slow" in getattr(report, "keywords", {}):
+        return
+    _overlong.append((report.nodeid, report.duration))
+
+
+def pytest_terminal_summary(terminalreporter):
+    if not _overlong:
+        return
+    terminalreporter.section("slow-test audit FAILED")
+    for nodeid, dur in _overlong:
+        terminalreporter.write_line(
+            f"  {nodeid} took {dur:.1f}s (> {_SLOW_AUDIT_THRESHOLD:.0f}s) "
+            f"without @pytest.mark.slow")
+    terminalreporter.write_line(
+        "  mark these slow (or speed them up) — unmarked long tests eat "
+        "the tier-1 budget for every future run")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # flip a green run red when the audit tripped; pytest returns
+    # session.exitstatus AFTER this hook, so the mutation sticks
+    if _overlong and exitstatus == 0:
+        session.exitstatus = 1

@@ -1,0 +1,370 @@
+"""EC dispatch scheduler suite (ISSUE 3): stacked encode/reconstruct
+bit-identity, flush-window ordering, clean shutdown, the
+reconstructed-interval cache, and the satellites that rode along
+(best-effort fallocate, thread-safe .ecx lookups).
+
+The load-bearing property is GOLDEN-OUTPUT SAFETY: with the scheduler on
+or off, .ec00-.ec13 bytes are identical — batching is allowed to change
+only when dispatches happen, never what they compute.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.models.coder import new_coder
+from seaweedfs_tpu.ops import dispatch
+from seaweedfs_tpu.ops.rs_cpu import RSCodecCPU
+from seaweedfs_tpu.storage import ec_files
+from seaweedfs_tpu.storage import ec_volume as ecv
+from seaweedfs_tpu.storage.ec_locate import Geometry
+from seaweedfs_tpu.utils import stats
+
+TEST_GEO = Geometry(large_block=10000, small_block=100)
+
+
+@pytest.fixture(autouse=True)
+def _clean_schedulers():
+    yield
+    dispatch.shutdown_all()
+    assert not _flusher_threads(), "leaked ec-dispatch flusher thread"
+
+
+def _flusher_threads():
+    return [t for t in threading.enumerate()
+            if t.name.startswith("ec-dispatch") and t.is_alive()]
+
+
+def _make_volume(base, seed=0, n_needles=40):
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_ec_pipeline import _make_synthetic_volume
+
+    _make_synthetic_volume(base, seed=seed, n_needles=n_needles)
+
+
+# -- stacked op bit-identity -------------------------------------------------
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu", "single"])
+def test_encode_parity_stacked_matches_per_slab(backend):
+    coder = new_coder(10, 4, backend)
+    oracle = RSCodecCPU(10, 4)
+    rng = np.random.default_rng(1)
+    stack = rng.integers(0, 256, (5, 10, 777), dtype=np.uint8)
+    want = np.stack([np.asarray(oracle.encode_parity(s)) for s in stack])
+    got = np.asarray(coder.encode_parity_stacked(stack))
+    assert got.shape == (5, 4, 777)
+    assert np.array_equal(got, want)
+
+
+def test_encode_parity_stacked_ragged_zero_padding():
+    """Ragged tails ride zero-padded columns; the padding must slice away
+    without perturbing real columns (EOF zero-fill / small-row schedule)."""
+    coder = new_coder(10, 4, "cpu")
+    rng = np.random.default_rng(2)
+    widths = [512, 100, 37, 512]
+    bmax = max(widths)
+    stack = np.zeros((len(widths), 10, bmax), dtype=np.uint8)
+    slabs = []
+    for i, w in enumerate(widths):
+        s = rng.integers(0, 256, (10, w), dtype=np.uint8)
+        stack[i, :, :w] = s
+        slabs.append(s)
+    out = np.asarray(coder.encode_parity_stacked(stack))
+    for i, (w, s) in enumerate(zip(widths, slabs)):
+        assert np.array_equal(out[i][:, :w],
+                              np.asarray(coder.encode_parity(s)))
+        assert not out[i][:, w:].any(), "zero columns must encode to zero"
+
+
+@pytest.mark.parametrize("data_only", [False, True])
+def test_reconstruct_stacked_survivor_permutations(data_only):
+    """CPU mirror vs device path across unsorted survivor orderings —
+    the scheduler keys lanes by the caller's order, so every permutation
+    must reconstruct identically."""
+    cpu = new_coder(10, 4, "cpu")
+    dev = new_coder(10, 4, "tpu")
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, (10, 333), dtype=np.uint8)
+    shards = np.asarray(cpu.encode(
+        np.vstack([data, np.zeros((4, 333), np.uint8)])))
+    for _ in range(5):
+        ids = list(range(14))
+        rng.shuffle(ids)
+        pres = tuple(ids[:11])
+        stk = np.stack([shards[i] for i in pres])
+        m1, r1 = cpu.reconstruct_stacked(pres, stk, data_only=data_only)
+        m2, r2 = dev.reconstruct_stacked(pres, stk, data_only=data_only)
+        assert m1 == tuple(m2)
+        assert np.array_equal(np.asarray(r1), np.asarray(r2))
+        for j, mid in enumerate(m1):
+            assert np.array_equal(np.asarray(r1[j]), shards[mid])
+
+
+# -- pipeline golden safety: scheduler on vs off -----------------------------
+
+
+def test_generate_ec_files_bit_identical_scheduler_on_off(
+        tmp_path, monkeypatch):
+    """The acceptance pin: .ec00-.ec13 bytes identical with the scheduler
+    on and off, over a volume whose tail exercises the small-row schedule
+    and EOF zero padding."""
+    outs = {}
+    for mode in ("0", "1"):
+        monkeypatch.setenv("SWFS_EC_DISPATCH", mode)
+        base = str(tmp_path / f"m{mode}")
+        _make_volume(base, seed=11)
+        coder = new_coder(10, 4, "tpu")
+        ec_files.generate_ec_files(base, coder, TEST_GEO, batch_size=50)
+        outs[mode] = [
+            open(TEST_GEO.shard_file_name(base, i), "rb").read()
+            for i in range(14)
+        ]
+    for i in range(14):
+        assert outs["0"][i] == outs["1"][i], f"shard {i} differs"
+
+
+def test_rebuild_ec_files_via_scheduler(tmp_path, monkeypatch):
+    monkeypatch.setenv("SWFS_EC_DISPATCH", "1")
+    base = str(tmp_path / "r")
+    _make_volume(base, seed=12)
+    coder = new_coder(10, 4, "cpu")
+    ec_files.generate_ec_files(base, coder, TEST_GEO, batch_size=100)
+    originals = {}
+    for i in (1, 6, 12):
+        p = TEST_GEO.shard_file_name(base, i)
+        originals[i] = open(p, "rb").read()
+        os.remove(p)
+    rebuilt = ec_files.rebuild_ec_files(base, coder, TEST_GEO,
+                                        batch_size=1 << 16)
+    assert sorted(rebuilt) == [1, 6, 12]
+    for i, want in originals.items():
+        assert open(TEST_GEO.shard_file_name(base, i),
+                    "rb").read() == want
+
+
+def test_degraded_read_via_scheduler_matches_direct(tmp_path, monkeypatch):
+    """EcVolume._read_interval micro-batch path == the direct dict
+    reconstruct, bytes for bytes."""
+    base = str(tmp_path / "g")
+    _make_volume(base, seed=13)
+    coder = new_coder(10, 4, "cpu")
+    ec_files.generate_ec_files(base, coder, TEST_GEO, batch_size=100)
+    ec_files.write_sorted_file_from_idx(base)
+    monkeypatch.setenv("SWFS_EC_DISPATCH", "0")
+    vol = ecv.EcVolume(base, coder, TEST_GEO)
+    want = {nid: vol.read_needle_blob(nid) for nid in (1, 7, 25)}
+    vol.close()
+    for i in (0, 3, 9, 12):
+        os.remove(TEST_GEO.shard_file_name(base, i))
+    monkeypatch.setenv("SWFS_EC_DISPATCH", "1")
+    vol = ecv.EcVolume(base, coder, TEST_GEO)
+    for nid, blob in want.items():
+        assert vol.read_needle_blob(nid) == blob
+    vol.close()
+
+
+# -- scheduler semantics -----------------------------------------------------
+
+
+def test_flush_window_fifo_ordering_and_batching():
+    """Slabs submitted in order from one thread (= one volume's pipeline)
+    must resolve to THEIR parity in submission order, and a batch must
+    actually form (the whole point)."""
+    coder = RSCodecCPU(10, 4)
+    sched = dispatch.EcDispatchScheduler(coder, window=0.25)
+    try:
+        rng = np.random.default_rng(4)
+        slabs = [rng.integers(0, 256, (10, 64 + 8 * i), dtype=np.uint8)
+                 for i in range(6)]
+        b0 = stats.EC_DISPATCH_BATCHES.value(lane="encode")
+        futs = [sched.encode_parity(s) for s in slabs]
+        for s, f in zip(slabs, futs):
+            assert np.array_equal(np.asarray(f),
+                                  np.asarray(coder.encode_parity(s)))
+        b1 = stats.EC_DISPATCH_BATCHES.value(lane="encode")
+        assert b1 - b0 < len(slabs), "no batching happened"
+    finally:
+        sched.close()
+
+
+def test_scheduler_demand_flush_no_window_stall():
+    """A consumer blocking on a pending future must not wait out a long
+    window — demand flush dispatches immediately."""
+    import time
+
+    coder = RSCodecCPU(10, 4)
+    sched = dispatch.EcDispatchScheduler(coder, window=30.0)
+    try:
+        data = np.arange(640, dtype=np.uint8).reshape(10, 64)
+        t0 = time.perf_counter()
+        fut = sched.encode_parity(data)
+        out = np.asarray(fut.result(timeout=10))
+        assert time.perf_counter() - t0 < 5.0
+        assert np.array_equal(out, np.asarray(coder.encode_parity(data)))
+    finally:
+        sched.close()
+
+
+def test_scheduler_clean_shutdown_joins_flusher():
+    coder = RSCodecCPU(10, 4)
+    sched = dispatch.scheduler_for(coder)
+    fut = sched.encode_parity(
+        np.zeros((10, 32), dtype=np.uint8))
+    np.asarray(fut)
+    assert _flusher_threads() or True  # may have idled out already
+    sched.close()
+    assert sched.closed
+    for t in _flusher_threads():
+        t.join(timeout=2)
+    assert not _flusher_threads()
+    # a closed scheduler refuses work; scheduler_for hands out a fresh one
+    with pytest.raises(RuntimeError):
+        sched.encode_parity(np.zeros((10, 8), np.uint8))
+    again = dispatch.scheduler_for(coder)
+    assert again is not sched and not again.closed
+    again.close()
+
+
+def test_scheduler_error_propagates_to_futures():
+    class Broken:
+        data_shards, parity_shards, total_shards = 10, 4, 14
+
+        def encode_parity(self, data):
+            raise IOError("boom")
+
+        def encode_parity_stacked(self, stack):
+            raise IOError("boom")
+
+    sched = dispatch.EcDispatchScheduler(Broken(), window=0.01)
+    try:
+        fut = sched.encode_parity(np.zeros((10, 16), np.uint8))
+        with pytest.raises(IOError):
+            fut.result(timeout=5)
+    finally:
+        sched.close()
+
+
+def test_dispatch_env_gate(monkeypatch):
+    coder = RSCodecCPU(10, 4)
+    monkeypatch.setenv("SWFS_EC_DISPATCH", "0")
+    assert dispatch.maybe_scheduler(coder) is None
+    monkeypatch.setenv("SWFS_EC_DISPATCH", "1")
+    sched = dispatch.maybe_scheduler(coder)
+    assert sched is not None
+    sched.close()
+
+
+# -- reconstructed-interval cache -------------------------------------------
+
+
+def test_recon_cache_lru_bound_and_invalidate():
+    cache = dispatch.ReconstructIntervalCache(max_bytes=1000,
+                                              block_size=100)
+    for blk in range(8):
+        cache.put(7, 3, blk, b"x" * 200)  # 8 * 200 > 1000 -> evictions
+    assert len(cache) <= 5
+    assert cache.get(7, 3, 7) == b"x" * 200  # newest survives
+    assert cache.get(7, 3, 0) is None  # oldest evicted
+    cache.put(8, 1, 0, b"y" * 100)
+    assert cache.invalidate(7) > 0
+    assert cache.get(7, 3, 7) is None
+    assert cache.get(8, 1, 0) == b"y" * 100  # other volumes untouched
+    assert cache.invalidate(8) == 1
+    assert len(cache) == 0
+
+
+def test_recon_cache_block_math():
+    cache = dispatch.ReconstructIntervalCache(max_bytes=1 << 20,
+                                              block_size=100)
+    assert list(cache.blocks_for(0, 1)) == [0]
+    assert list(cache.blocks_for(99, 2)) == [0, 1]
+    assert list(cache.blocks_for(250, 100)) == [2, 3]
+    assert list(cache.blocks_for(0, 0)) == []
+
+
+def test_recon_cache_generation_guards_stale_put():
+    """A reconstruct that straddles an invalidate (shard remount while
+    the k-survivor gather is in flight) must not repopulate the cache
+    with pre-invalidation bytes."""
+    cache = dispatch.ReconstructIntervalCache(max_bytes=1 << 20,
+                                              block_size=100)
+    gen = cache.generation(7)  # snapshot before "reading survivors"
+    cache.invalidate(7)  # remount lands mid-reconstruct
+    cache.put(7, 1, 0, b"stale", gen=gen)
+    assert cache.get(7, 1, 0) is None, "stale put survived the remount"
+    gen2 = cache.generation(7)
+    assert gen2 != gen
+    cache.put(7, 1, 0, b"fresh", gen=gen2)
+    assert cache.get(7, 1, 0) == b"fresh"
+
+
+def test_recon_cache_disabled_by_zero_budget():
+    cache = dispatch.ReconstructIntervalCache(max_bytes=0)
+    assert not cache.enabled()
+    cache.put(1, 1, 0, b"z")
+    assert len(cache) == 0
+
+
+# -- satellites --------------------------------------------------------------
+
+
+def test_fallocate_best_effort_per_file(tmp_path, monkeypatch):
+    """One shard file's failed preallocation must not strip it from the
+    rest (the old loop `break`-ed on the first OSError)."""
+    if not hasattr(os, "posix_fallocate"):
+        pytest.skip("no posix_fallocate on this platform")
+    base = str(tmp_path / "f")
+    _make_volume(base, seed=14)
+    calls = []
+    real = os.posix_fallocate
+
+    def flaky(fd, offset, length):
+        calls.append(fd)
+        if len(calls) == 3:  # third shard file fails
+            raise OSError(95, "fallocate unsupported here")
+        return real(fd, offset, length)
+
+    monkeypatch.setattr(os, "posix_fallocate", flaky)
+    coder = new_coder(10, 4, "cpu")
+    ec_files.generate_ec_files(base, coder, TEST_GEO, batch_size=100)
+    assert len(calls) == 14, "preallocation stopped at the first failure"
+    want = TEST_GEO.shard_size(os.path.getsize(base + ".dat"))
+    for i in range(14):
+        assert os.path.getsize(TEST_GEO.shard_file_name(base, i)) == want
+
+
+def test_concurrent_ecx_lookups_are_threadsafe(tmp_path):
+    """Regression for the shared-handle seek+read race: N threads binary-
+    searching one EcVolume's .ecx concurrently corrupted each other's
+    file position and raised spurious NotFoundError (found by the ISSUE-3
+    degraded-read probe; fixed with positional pread)."""
+    base = str(tmp_path / "c")
+    _make_volume(base, seed=15, n_needles=30)
+    coder = new_coder(10, 4, "cpu")
+    ec_files.generate_ec_files(base, coder, TEST_GEO, batch_size=100)
+    ec_files.write_sorted_file_from_idx(base)
+    vol = ecv.EcVolume(base, coder, TEST_GEO)
+    errs = []
+    barrier = threading.Barrier(8)
+
+    def lookup():
+        try:
+            barrier.wait()
+            for _ in range(40):
+                for nid in range(1, 31):
+                    vol.find_needle(nid)
+        except BaseException as e:
+            errs.append(e)
+
+    ths = [threading.Thread(target=lookup) for _ in range(8)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    vol.close()
+    assert not errs, errs[0]
