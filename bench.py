@@ -659,6 +659,221 @@ print(json.dumps(out))
 """
 
 
+# Integrity-plane A/B (ISSUE 4): how fast can the scrub plane verify,
+# and what does pacing cost the foreground? Three probes in a throwaway
+# subprocess: (1) EC syndrome-check GB/s through the device coder vs a
+# pure-CPU re-encode + byte-compare; (2) scheduler on/off — concurrent
+# per-volume verifies must coalesce into stacked dispatches (batch
+# factor from the live metrics); (3) foreground smallfile read latency
+# with a paced scrub running vs idle.
+_SCRUBAB_PROG = r"""
+import json, os, socket, tempfile, threading, time, traceback
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")  # never touch the chip here
+from seaweedfs_tpu.models.coder import new_coder
+from seaweedfs_tpu.scrub.scrubber import Scrubber
+from seaweedfs_tpu.storage.store import Store
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.ec_files import (write_ec_files,
+                                            write_sorted_file_from_idx)
+from seaweedfs_tpu.storage.ec_locate import Geometry
+from seaweedfs_tpu.storage.ec_volume import save_volume_info
+from seaweedfs_tpu.utils import stats
+
+DAT_MB = float(os.environ.get("SWFS_SCRUBAB_MB", "12"))
+N_VOLS = int(os.environ.get("SWFS_SCRUBAB_VOLS", "4"))
+out = {}
+
+
+def build_store():
+    tmp = tempfile.mkdtemp()
+    st = Store([tmp], max_volume_counts=[2 * N_VOLS])
+    rng = np.random.default_rng(0)
+    per = int(DAT_MB * (1 << 20) / N_VOLS)
+    geo = Geometry()
+    for vid in range(1, N_VOLS + 1):
+        v = st.add_volume(vid)
+        blob = rng.integers(0, 256, size=per, dtype=np.uint8).tobytes()
+        step = 1 << 20
+        for i in range(0, per, step):
+            v.write_needle(Needle.create(i // step + 1, 0xA,
+                                         blob[i:i + step]))
+        base = v.file_name()
+        with v._lock:
+            v._sync_buffers()
+        write_ec_files(base, st.coder, geo)
+        write_sorted_file_from_idx(base)
+        save_volume_info(base, {"version": v.version, "dataShards": 10,
+                                "parityShards": 4,
+                                "largeBlock": geo.large_block,
+                                "smallBlock": geo.small_block})
+        st.unmount_volume(vid)
+        st.mount_ec_shards(vid, "", list(range(14)))
+    return st
+
+
+def syndrome_pass(st):
+    # one scrubber per volume, concurrently: their recompute slabs share
+    # the store coder's dispatch scheduler, so batching is measurable
+    vols = list(range(1, N_VOLS + 1))
+    scs = [Scrubber(st, None, interval_s=0, max_mbps=0) for _ in vols]
+    reports, errs = [], []
+
+    def run(sc, vv):
+        try:
+            reports.append(sc.run_once(vid=vv, full=True,
+                                       anti_entropy=False))
+        except BaseException:
+            errs.append(traceback.format_exc())
+
+    s0 = stats.ec_dispatch_stats()["encode"]
+    t0 = time.perf_counter()
+    ths = [threading.Thread(target=run, args=(sc, vv))
+           for sc, vv in zip(scs, vols)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    wall = time.perf_counter() - t0
+    if errs:
+        raise RuntimeError(errs[0])
+    s1 = stats.ec_dispatch_stats()["encode"]
+    nbytes = sum(r.bytes for r in reports)
+    findings = sum(len(r.findings) for r in reports)
+    slabs = s1["slabs"] - s0["slabs"]
+    batches = s1["batches"] - s0["batches"]
+    return {"gbps": round(nbytes / wall / 1e9, 3), "bytes": nbytes,
+            "wall_s": round(wall, 2), "findings": findings,
+            "batch_factor": round(slabs / batches, 2) if batches else 0.0}
+
+
+try:
+    st = build_store()
+    # A — device coder, scheduler ON (scrub slabs coalesce)
+    os.environ.pop("SWFS_EC_DISPATCH", None)
+    out["device_sched_on"] = syndrome_pass(st)
+    # B — device coder, scheduler OFF (per-slab dispatches)
+    os.environ["SWFS_EC_DISPATCH"] = "0"
+    out["device_sched_off"] = syndrome_pass(st)
+    # C — pure-CPU re-encode + byte-compare reference
+    saved = st.coder
+    st.coder = new_coder(10, 4, backend="cpu")
+    out["cpu_compare"] = syndrome_pass(st)
+    st.coder = saved
+    os.environ.pop("SWFS_EC_DISPATCH", None)
+    st.close()
+except Exception as e:
+    traceback.print_exc()
+    out["syndrome_error"] = f"{type(e).__name__}: {e}"[:300]
+
+# pacing overhead on foreground smallfile reads: a live mini-cluster,
+# read latency with the scrubber idle vs running paced
+try:
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.pb import rpc
+    import requests
+
+    def free_port():
+        with socket.socket() as s:
+            s.bind(("", 0))
+            return s.getsockname()[1]
+
+    os.environ["SEAWEEDFS_TPU_NATIVE"] = "0"
+    mport = free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=256)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[tempfile.mkdtemp()],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=free_port(), pulse_seconds=1)
+    vsrv.start()
+    try:
+        from seaweedfs_tpu.operation import assign
+        rng = np.random.default_rng(1)
+        fids = []
+        deadline = time.time() + 20
+        while len(fids) < 200 and time.time() < deadline:
+            a = assign(master.address)
+            if a.error:
+                time.sleep(0.2)
+                continue
+            data = rng.integers(0, 256, size=1024,
+                                dtype=np.uint8).tobytes()
+            r = requests.put(f"http://{a.url}/{a.fid}", data=data,
+                             timeout=10)
+            if r.status_code in (200, 201):
+                fids.append(a.fid)
+
+        def read_phase(seconds=3.0):
+            lats = []
+            t_end = time.time() + seconds
+            i = 0
+            while time.time() < t_end:
+                fid = fids[i % len(fids)]
+                t0 = time.perf_counter()
+                requests.get(f"http://{vsrv.address}/{fid}", timeout=10)
+                lats.append((time.perf_counter() - t0) * 1e3)
+                i += 1
+            lats.sort()
+            return {"reads": len(lats),
+                    "p50_ms": round(lats[len(lats) // 2], 3),
+                    "p99_ms": round(lats[int(len(lats) * 0.99)], 3)}
+
+        base_phase = read_phase()
+        # paced scrub loops over every volume while the readers hammer
+        pace = float(os.environ.get("SWFS_SCRUBAB_PACE_MBPS", "8"))
+        sc = Scrubber(vsrv.store, vsrv, interval_s=0, max_mbps=pace)
+        stop = threading.Event()
+
+        def scrub_loop():
+            while not stop.is_set():
+                sc.run_once(full=True, anti_entropy=False)
+
+        t = threading.Thread(target=scrub_loop, daemon=True)
+        t.start()
+        scrub_phase = read_phase()
+        stop.set()
+        sc._stop.set()
+        t.join(timeout=10)
+        out["pacing"] = {
+            "pace_mbps": pace,
+            "baseline": base_phase, "with_scrub": scrub_phase,
+            "p50_overhead_pct": round(
+                100.0 * (scrub_phase["p50_ms"] / base_phase["p50_ms"] - 1),
+                1) if base_phase["p50_ms"] else 0.0,
+        }
+    finally:
+        vsrv.stop()
+        master.stop()
+        rpc.reset_channels()
+except Exception as e:
+    traceback.print_exc()
+    out["pacing_error"] = f"{type(e).__name__}: {e}"[:300]
+
+print(json.dumps(out))
+"""
+
+
+def _bench_scrub_ab() -> dict:
+    """Run the integrity-plane A/B child (hard timeout, JSON salvage)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _SCRUBAB_PROG], cwd=_HERE,
+            capture_output=True, text=True,
+            timeout=float(os.environ.get("SEAWEEDFS_TPU_SCRUBAB_TIMEOUT",
+                                         "600")))
+        out = _last_json_line(proc.stdout)
+        if out is not None:
+            return out
+        return {"error": f"rc={proc.returncode}: {proc.stderr[-300:]}"}
+    except subprocess.TimeoutExpired:
+        return {"error": "scrub A/B timed out"}
+    except Exception as e:  # never let the secondary hurt the headline
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def _bench_ec_dispatch_ab() -> dict:
     """Run the EC-dispatch A/B child (hard timeout, last-JSON salvage)."""
     try:
@@ -808,6 +1023,12 @@ def main() -> int:
         # artifact content to stdout)
         print(json.dumps(_bench_ec_dispatch_ab()))
         return 0
+    if "--scrub-ab" in sys.argv:
+        # standalone integrity-plane A/B (ISSUE 4): syndrome GB/s device
+        # vs CPU byte-compare, scheduler on/off batch factor, pacing
+        # overhead on foreground reads
+        print(json.dumps(_bench_scrub_ab()))
+        return 0
     result = {
         "metric": "ec_encode_rs10_4_GBps_per_chip",
         "value": 0.0,
@@ -860,6 +1081,14 @@ def main() -> int:
             result["ec_dispatch"] = ab
         else:
             result["ec_dispatch_error"] = ab.get("error", "?")[:200]
+    if os.environ.get("SEAWEEDFS_TPU_SCRUBAB", "1").lower() not in (
+            "0", "false", "off"):
+        sab = _bench_scrub_ab()
+        if "device_sched_on" in sab or "pacing" in sab:
+            # integrity-plane A/B (ISSUE 4): syndrome GB/s + pacing cost
+            result["scrub"] = sab
+        else:
+            result["scrub_error"] = sab.get("error", "?")[:200]
     probe = _await_device_probe()
     if "timeout" in probe:
         # the tunnel is wedged RIGHT NOW: attempting the device bench

@@ -19,7 +19,15 @@ from concurrent import futures
 
 import grpc
 
-from . import filer_pb2, master_pb2, mount_pb2, mq_pb2, s3_pb2, volume_server_pb2
+from . import (
+    filer_pb2,
+    master_pb2,
+    mount_pb2,
+    mq_pb2,
+    s3_pb2,
+    scrub_pb2,
+    volume_server_pb2,
+)
 from ..utils import failpoint
 
 MAX_MESSAGE_SIZE = 1 << 30  # grpc_client_server.go:27
@@ -56,6 +64,10 @@ MASTER_SERVICE = ("master_pb.Seaweed", [
     _m("VacuumVolume", M.VacuumVolumeRequest, M.VacuumVolumeResponse),
     _m("DisableVacuum", M.DisableVacuumRequest, M.DisableVacuumResponse),
     _m("EnableVacuum", M.EnableVacuumRequest, M.EnableVacuumResponse),
+    _m("DisableScrub", scrub_pb2.DisableScrubRequest,
+       scrub_pb2.DisableScrubResponse),
+    _m("EnableScrub", scrub_pb2.EnableScrubRequest,
+       scrub_pb2.EnableScrubResponse),
     _m("VolumeMarkReadonly", M.VolumeMarkReadonlyRequest, M.VolumeMarkReadonlyResponse),
     _m("GetMasterConfiguration", M.GetMasterConfigurationRequest, M.GetMasterConfigurationResponse),
     _m("LeaseAdminToken", M.LeaseAdminTokenRequest, M.LeaseAdminTokenResponse),
@@ -112,6 +124,13 @@ VOLUME_SERVICE = ("volume_server_pb.VolumeServer", [
     _m("Query", V.QueryRequest, V.QueriedStripe, ss=True),
     _m("VolumeNeedleStatus", V.VolumeNeedleStatusRequest, V.VolumeNeedleStatusResponse),
     _m("Ping", V.PingRequest, V.PingResponse),
+    # integrity plane (scrub.proto; messages in pb/scrub_pb2.py)
+    _m("VolumeDigest", scrub_pb2.VolumeDigestRequest,
+       scrub_pb2.VolumeDigestResponse),
+    _m("VolumeScrub", scrub_pb2.VolumeScrubRequest,
+       scrub_pb2.VolumeScrubResponse),
+    _m("ScrubStatus", scrub_pb2.ScrubStatusRequest,
+       scrub_pb2.ScrubStatusResponse),
 ])
 
 FILER_SERVICE = ("filer_pb.SeaweedFiler", [

@@ -1,0 +1,177 @@
+"""Per-volume digest manifests for cross-replica anti-entropy.
+
+A digest manifest is the sorted list of (needle_id, stored_crc, size)
+triples for every live needle of a volume, plus tombstone entries
+(size = -1) for ids whose latest index record is a deletion. Two replicas
+that agree on the rolling CRC of this list hold byte-identical live
+content — so anti-entropy ships ~16 bytes per needle instead of the
+needle bytes themselves, and only diffs entry lists when the rolling
+digests disagree.
+
+The stored CRC is the checksum the WRITER committed (the 4 bytes after
+the needle body on disk) — reading it costs one 4-byte pread per needle,
+i.e. manifest construction is index-speed, not data-speed. Whether those
+stored CRCs still match the data bytes is the scrubber's CRC sweep's job
+(scrubber.py); the two passes together separate "replicas diverged"
+(digests differ) from "disk rotted" (sweep finding).
+
+Manifest file format (golden-pinned by tests/test_scrub.py):
+
+    magic   8B  b"SWFSDG1\\n"
+    count   8B  big-endian entry count
+    entries 16B each, ascending needle id:
+            id(8, BE) crc(4, BE) size(4, BE two's-complement)
+
+rolling_crc = crc32c over the concatenated entry bytes (magic and count
+excluded, so the digest of an empty volume is crc32c(b"") == 0).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+from ..storage import types
+from ..storage.crc import crc32c, crc32c_combine
+
+MAGIC = b"SWFSDG1\n"
+ENTRY_SIZE = 16
+TOMBSTONE_SIZE = -1
+
+
+@dataclass(frozen=True)
+class DigestEntry:
+    needle_id: int
+    crc: int
+    size: int  # negative = tombstone
+
+    def to_bytes(self) -> bytes:
+        return (self.needle_id.to_bytes(8, "big")
+                + (self.crc & 0xFFFFFFFF).to_bytes(4, "big")
+                + (self.size & 0xFFFFFFFF).to_bytes(4, "big"))
+
+    @classmethod
+    def from_bytes(cls, b: bytes) -> "DigestEntry":
+        size = int.from_bytes(b[12:16], "big")
+        if size >= 1 << 31:
+            size -= 1 << 32
+        return cls(int.from_bytes(b[0:8], "big"),
+                   int.from_bytes(b[8:12], "big"), size)
+
+
+def volume_digest_entries(v) -> list[DigestEntry]:
+    """Build the sorted entry list for a plain volume: live needles carry
+    the stored CRC read from disk; tombstoned ids carry (0, -1)."""
+    if v.native is not None:
+        v.sync_native()  # absorb C++-plane appends first
+    entries: list[DigestEntry] = []
+    for key, nv in list(v.nm):
+        if nv.offset == 0 or types.size_is_deleted(nv.size):
+            continue
+        off = types.stored_to_actual_offset(nv.offset)
+        crc_bytes = v._pread_durable(
+            off + types.NEEDLE_HEADER_SIZE + nv.size,
+            types.NEEDLE_CHECKSUM_SIZE)
+        crc = int.from_bytes(crc_bytes, "big") if len(crc_bytes) == 4 else 0
+        entries.append(DigestEntry(key, crc, nv.size))
+    for key in set(v.nm.tombstones):
+        entries.append(DigestEntry(key, 0, TOMBSTONE_SIZE))
+    entries.sort(key=lambda e: e.needle_id)
+    return entries
+
+
+def rolling_digest(entries: list[DigestEntry]) -> int:
+    """Rolling CRC over the LIVE entries only. Tombstones are excluded
+    deliberately: they exist to stop a diff from resurrecting deleted
+    needles, but two replicas that agree on every live needle while
+    differing in deletion HISTORY (one vacuumed, one missed a delete of
+    a needle it never had) are converged — folding tombstones into the
+    cheap comparison would flag such pairs as divergent on every sweep,
+    forever, with nothing to heal."""
+    crc = 0
+    for e in entries:
+        if e.size >= 0:
+            crc = crc32c(e.to_bytes(), crc)
+    return crc
+
+
+def manifest_bytes(entries: list[DigestEntry]) -> bytes:
+    out = bytearray(MAGIC)
+    out += len(entries).to_bytes(8, "big")
+    for e in entries:
+        out += e.to_bytes()
+    return bytes(out)
+
+
+def write_manifest(base_file_name: str, entries: list[DigestEntry]) -> str:
+    """Persist `<base>.dig` atomically; returns the path."""
+    path = base_file_name + ".dig"
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(manifest_bytes(entries))
+    os.replace(tmp, path)
+    return path
+
+
+def read_manifest(path: str) -> list[DigestEntry]:
+    with open(path, "rb") as f:
+        blob = f.read()
+    if blob[:8] != MAGIC:
+        raise IOError(f"{path}: not a digest manifest")
+    count = int.from_bytes(blob[8:16], "big")
+    body = blob[16:]
+    if len(body) != count * ENTRY_SIZE:
+        raise IOError(f"{path}: truncated manifest")
+    return [DigestEntry.from_bytes(body[i * ENTRY_SIZE:(i + 1) * ENTRY_SIZE])
+            for i in range(count)]
+
+
+def diff_entries(mine: list[DigestEntry], theirs: list[DigestEntry]):
+    """-> (only_mine, only_theirs, differing) where differing is a list of
+    (my_entry, their_entry) pairs sharing an id but not (crc, size)."""
+    m = {e.needle_id: e for e in mine}
+    t = {e.needle_id: e for e in theirs}
+    only_mine = [m[k] for k in sorted(m.keys() - t.keys())]
+    only_theirs = [t[k] for k in sorted(t.keys() - m.keys())]
+    differing = [(m[k], t[k]) for k in sorted(m.keys() & t.keys())
+                 if (m[k].crc, m[k].size) != (t[k].crc, t[k].size)]
+    return only_mine, only_theirs, differing
+
+
+# -- EC volumes: per-shard whole-file digests -------------------------------
+
+def ec_shard_crcs(ev, chunk: int = 1 << 20,
+                  slab_crcs: dict[int, list[tuple[int, int]]] | None = None,
+                  ) -> dict[int, "ShardCrc"]:
+    """CRC32C + size of every locally-present shard file.
+
+    When the EC syndrome sweep already checksummed slabs (it has the
+    bytes in hand anyway), pass them as `slab_crcs[sid] = [(crc, nbytes),
+    ...]` in file order: the whole-file digest is then folded together
+    with crc32c_combine instead of re-reading the shards."""
+    out: dict[int, ShardCrc] = {}
+    for sid, f in sorted(ev.shard_files.items()):
+        size = f.size()
+        if slab_crcs is not None and sid in slab_crcs:
+            crc = 0
+            for c, n in slab_crcs[sid]:
+                crc = crc32c_combine(crc, c, n)
+            out[sid] = ShardCrc(sid, crc, size)
+            continue
+        crc = 0
+        off = 0
+        while off < size:
+            data = f.read_at(off, min(chunk, size - off))
+            if not data:
+                break
+            crc = crc32c(data, crc)
+            off += len(data)
+        out[sid] = ShardCrc(sid, crc, size)
+    return out
+
+
+@dataclass(frozen=True)
+class ShardCrc:
+    shard_id: int
+    crc: int
+    size: int

@@ -1,0 +1,880 @@
+"""Continuous integrity plane: the per-volume-server background scrubber.
+
+Production stores rot — disks flip bits, replicas diverge after
+failovers, EC shards decay silently until a degraded read needs them.
+Online-EC studies treat verification/repair as a first-class workload
+that must be paced against foreground I/O (arXiv:1709.05365), and
+pipelined coding makes repair cheap enough to run continuously
+(RapidRAID, arXiv:1207.6744). This module is that workload:
+
+  * **Needle CRC sweep** — walks every volume's .dat needle-by-needle
+    (python and native-plane volumes alike), re-computing CRC32C over
+    each live record and checking it against the stored checksum. The
+    sweep keeps a persistent cursor (`<base>.scb`, JSON) so a restarted
+    server resumes mid-volume instead of re-reading from zero.
+  * **EC syndrome verify** — re-encodes the data shards of every local
+    EC volume through the shared EC dispatch scheduler (ops/dispatch.py)
+    and compares the recomputed parity against the on-disk .ec10–.ec13
+    bytes. A parity recompute is bit-identical `encode_parity` work, so
+    scrub slabs coalesce into the same stacked device dispatches as
+    foreground encode traffic. Mismatching slabs are narrowed to the
+    culprit shard by leave-one-out reconstruction.
+  * **Anti-entropy** — builds digest manifests (scrub/digest.py) and
+    compares rolling CRCs with every replica via the VolumeDigest RPC;
+    only diverging volumes exchange entry lists, and only diverging
+    needles move bytes.
+  * **Self-healing repair** — findings escalate: quarantine the needle
+    (server answers from a healthy replica mid-repair) or the shard
+    (reads degrade-reconstruct around it), then re-replicate /
+    EC-rebuild, re-verify, and only then clear the finding.
+
+Pacing: a token bucket (`SWFS_SCRUB_MAX_MBPS`, 0 = unpaced) bounds bytes
+read per second, and the sweep backs off whenever the server's
+foreground QPS exceeds `SWFS_SCRUB_FG_QPS`. The daemon period is
+`SWFS_SCRUB_INTERVAL_S` (0 disables the thread; `run_once` still serves
+the on-demand RPC / shell paths).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..ops import dispatch
+from ..storage import types
+from ..storage.crc import crc32c
+from ..storage.errors import DeletedError, NotFoundError
+from ..storage.needle import CrcError, Needle
+from ..utils import glog
+from ..utils.stats import (
+    SCRUB_BACKOFFS,
+    SCRUB_BYTES,
+    SCRUB_FINDINGS,
+    SCRUB_NEEDLES,
+    SCRUB_PACE_WAIT_SECONDS,
+    SCRUB_REPAIRS,
+    SCRUB_SWEEPS,
+)
+from . import digest as digest_mod
+
+MAX_FINDINGS_KEPT = 256
+DEFAULT_EC_SLAB = 1 << 20
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def fetch_verified_needle(stub, vid: int, needle_id: int,
+                          version: int) -> Needle | None:
+    """ReadNeedleBlob by needle id, parsed + CRC-verified — the ONE
+    replica-fetch used by scrub repair, anti-entropy pulls, and the
+    server's quarantine failover (never heal FROM rot, never serve it)."""
+    import grpc
+
+    from ..pb import volume_server_pb2 as vs
+
+    try:
+        resp = stub.ReadNeedleBlob(vs.ReadNeedleBlobRequest(
+            volume_id=vid, needle_id=needle_id), timeout=30)
+        return Needle.from_bytes(bytes(resp.needle_blob), version)
+    except (grpc.RpcError, IOError, ValueError):
+        return None
+
+
+def fetch_needle_from_replicas(srv, vid: int, needle_id: int,
+                               version: int) -> Needle | None:
+    """Try every replica the master knows (self excluded) until one
+    yields a verified copy."""
+    from ..pb import rpc
+
+    for addr in srv.lookup_volume_locations(vid):
+        if addr == srv.address:
+            continue
+        n = fetch_verified_needle(
+            rpc.volume_stub(rpc.grpc_address(addr)), vid, needle_id,
+            version)
+        if n is not None:
+            return n
+    return None
+
+
+class TokenBucket:
+    """Byte-rate pacer: take(n) sleeps long enough to keep the long-run
+    rate under `rate_bytes_per_s` (1s burst capacity). rate <= 0 = off."""
+
+    def __init__(self, rate_bytes_per_s: float):
+        self.rate = rate_bytes_per_s
+        self.capacity = max(rate_bytes_per_s, 1.0)
+        self._tokens = self.capacity
+        self._last = time.monotonic()
+        self._lock = threading.Lock()
+
+    def take(self, n: int) -> float:
+        if self.rate <= 0 or n <= 0:
+            return 0.0
+        with self._lock:
+            now = time.monotonic()
+            self._tokens = min(self.capacity,
+                               self._tokens + (now - self._last) * self.rate)
+            self._last = now
+            self._tokens -= n
+            wait = -self._tokens / self.rate if self._tokens < 0 else 0.0
+        if wait > 0:
+            time.sleep(wait)
+            SCRUB_PACE_WAIT_SECONDS.inc(wait)
+        return wait
+
+
+@dataclass
+class Finding:
+    volume_id: int
+    kind: str               # needle_crc | ec_parity | replica_divergence
+    needle_id: int = 0
+    shard_id: int = 0
+    detail: str = ""
+    state: str = "found"    # found | repaired | failed
+    found_at: float = field(default_factory=time.time)
+
+    def set_state(self, state: str) -> None:
+        self.state = state
+        SCRUB_FINDINGS.inc(kind=self.kind, state=state)
+
+
+@dataclass
+class ScrubReport:
+    volumes: int = 0
+    needles: int = 0
+    bytes: int = 0
+    repaired: int = 0
+    findings: list[Finding] = field(default_factory=list)
+
+
+class _Cursor:
+    """Persistent per-volume sweep position (`<base>.scb`): survives
+    restarts so a multi-hour volume resumes mid-sweep. The compaction
+    revision is stored alongside — a vacuum shifts every offset, so a
+    revision mismatch resets the cursor instead of verifying garbage."""
+
+    def __init__(self, base: str):
+        self.path = base + ".scb"
+        self.offset = 0
+        self.ec_offset = 0
+        self.sweeps = 0
+        self.revision = -1
+        try:
+            with open(self.path) as f:
+                d = json.load(f)
+            self.offset = int(d.get("offset", 0))
+            self.ec_offset = int(d.get("ecOffset", 0))
+            self.sweeps = int(d.get("sweeps", 0))
+            self.revision = int(d.get("revision", -1))
+        except (OSError, ValueError):
+            pass
+
+    def save(self) -> None:
+        tmp = self.path + ".tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump({"offset": self.offset, "ecOffset": self.ec_offset,
+                           "sweeps": self.sweeps, "revision": self.revision,
+                           "updated": time.time()}, f)
+            os.replace(tmp, self.path)
+        except OSError:
+            pass  # cursor persistence is best-effort
+
+
+class Scrubber:
+    """One per volume server (constructable over a bare Store for tests).
+
+    `server` (when given) provides replica lookup for repair, the
+    foreground-QPS signal for backoff, and the recon-cache invalidation
+    hook; without it the scrubber still detects and does local-only
+    repair (EC rebuild)."""
+
+    def __init__(self, store, server=None, *,
+                 interval_s: float | None = None,
+                 max_mbps: float | None = None):
+        self.store = store
+        self.server = server
+        self.interval = _env_float("SWFS_SCRUB_INTERVAL_S", 3600.0) \
+            if interval_s is None else interval_s
+        mbps = _env_float("SWFS_SCRUB_MAX_MBPS", 64.0) \
+            if max_mbps is None else max_mbps
+        self.bucket = TokenBucket(mbps * 1024 * 1024)
+        self.fg_qps_limit = _env_float("SWFS_SCRUB_FG_QPS", 50.0)
+        self.backoff_s = _env_float("SWFS_SCRUB_BACKOFF_MS", 200.0) / 1e3
+        self.ec_slab = int(_env_float("SWFS_SCRUB_EC_SLAB",
+                                      DEFAULT_EC_SLAB))
+        # bytes of needle records verified per volume per pass; 0 =
+        # sweep each volume to the end in one pass. A bounded pass keeps
+        # any single run_once() short on multi-GB volumes — the cursor
+        # carries the position to the next pass (and across restarts).
+        self.pass_budget = int(_env_float("SWFS_SCRUB_PASS_BYTES", 0))
+        self.findings: list[Finding] = []
+        # vid -> {sid: ShardCrc} folded from the last clean syndrome
+        # sweep; MUST be invalidated whenever shard files change
+        # (mount/unmount/delete/rebuild — server handlers wire it)
+        self._ec_digests: dict[int, dict] = {}
+        self.sweeps_completed = 0
+        self.last_sweep_unix = 0.0
+        self.running = False
+        self._cursors: dict[str, _Cursor] = {}
+        self._run_lock = threading.Lock()
+        self._mu = threading.Lock()
+        self._stop = threading.Event()
+        self._wake = threading.Event()
+        self._suspects: set[int] = set()
+        self._thread: threading.Thread | None = None
+
+    # -- daemon lifecycle --------------------------------------------------
+
+    def start(self) -> None:
+        if self.interval <= 0 or self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._loop, name="scrub-daemon", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout=5)
+        self._thread = None
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(self.interval)
+            self._wake.clear()
+            if self._stop.is_set():
+                return
+            with self._mu:
+                suspects = sorted(self._suspects)
+                self._suspects.clear()
+            try:
+                if suspects:
+                    # a read-path CRC failure escalated: verify those
+                    # volumes promptly instead of waiting a full period
+                    for vid in suspects:
+                        self.run_once(vid=vid)
+                else:
+                    self.run_once()
+            except Exception as e:  # noqa: BLE001 — keep the daemon alive
+                glog.warning(f"scrub sweep failed: {e}")
+
+    def invalidate_ec_digest(self, vid: int) -> None:
+        """Shard files changed (mount/unmount/delete/rebuild): drop the
+        cached per-shard CRCs so VolumeDigest never serves stale ones."""
+        self._ec_digests.pop(vid, None)
+
+    def cached_ec_digest(self, vid: int) -> dict | None:
+        """Per-shard CRCs folded by the last clean syndrome sweep (None
+        when uncached) — the read half of invalidate_ec_digest's
+        contract, so callers never touch the dict directly."""
+        return self._ec_digests.get(vid)
+
+    def report_suspect(self, vid: int) -> None:
+        """Serving-path hook: a read smelled corruption in `vid` — queue a
+        targeted verify without waiting for the next periodic sweep."""
+        with self._mu:
+            self._suspects.add(vid)
+        self._wake.set()
+
+    # -- findings registry -------------------------------------------------
+
+    def _add_finding(self, f: Finding) -> Finding:
+        SCRUB_FINDINGS.inc(kind=f.kind, state="found")
+        with self._mu:
+            self.findings.append(f)
+            del self.findings[:-MAX_FINDINGS_KEPT]
+        glog.warning(
+            f"scrub finding: vol {f.volume_id} {f.kind} "
+            f"needle={f.needle_id:x} shard={f.shard_id}: {f.detail}")
+        return f
+
+    def snapshot_findings(self) -> list[Finding]:
+        with self._mu:
+            return list(self.findings)
+
+    # -- pacing ------------------------------------------------------------
+
+    def _maybe_backoff(self) -> None:
+        srv = self.server
+        if srv is None or self.fg_qps_limit <= 0:
+            return
+        qps_fn = getattr(srv, "foreground_qps", None)
+        if qps_fn is None:
+            return
+        while qps_fn() > self.fg_qps_limit and not self._stop.is_set():
+            SCRUB_BACKOFFS.inc()
+            time.sleep(self.backoff_s)
+
+    # -- the sweep ---------------------------------------------------------
+
+    def run_once(self, vid: int | None = None, full: bool = False,
+                 repair: bool = True,
+                 anti_entropy: bool | None = None) -> ScrubReport:
+        """One pass over this server's volumes (or just `vid`): needle CRC
+        sweep + EC syndrome verify + (when replicated and a server is
+        attached) digest anti-entropy. Serialized: concurrent callers
+        queue behind the running pass."""
+        report = ScrubReport()
+        with self._run_lock:
+            self.running = True
+            try:
+                for loc in self.store.locations:
+                    for v_id, v in list(loc.volumes.items()):
+                        if vid is not None and v_id != vid:
+                            continue
+                        self._sweep_volume(v, full, repair, report)
+                        report.volumes += 1
+                    for v_id, ev in list(loc.ec_volumes.items()):
+                        if vid is not None and v_id != vid:
+                            continue
+                        self._verify_ec_volume(loc, v_id, full, repair,
+                                               report)
+                        report.volumes += 1
+                if anti_entropy or (anti_entropy is None
+                                    and self.server is not None):
+                    self.run_anti_entropy(vid=vid, repair=repair,
+                                          report=report)
+                self.sweeps_completed += 1
+                self.last_sweep_unix = time.time()
+            finally:
+                self.running = False
+        return report
+
+    # ---- plain volumes: needle-by-needle CRC
+
+    def _cursor_for(self, base: str) -> _Cursor:
+        with self._mu:  # status() snapshots this dict concurrently
+            cur = self._cursors.get(base)
+            if cur is None:
+                cur = self._cursors[base] = _Cursor(base)
+            return cur
+
+    def _sweep_volume(self, v, full: bool, repair: bool,
+                      report: ScrubReport) -> None:
+        base = v.file_name()
+        if v.is_tiered:
+            return  # remote .dat: tier backends carry their own checksums
+        cur = self._cursor_for(base)
+        with v._lock:
+            try:
+                v._sync_buffers()  # sweep reads the file under group commit
+            except OSError:
+                return  # surfaced to writers by their own flush
+        if v.native is not None:
+            v.sync_native()
+        revision = v.super_block.compaction_revision
+        if cur.revision != revision:
+            cur.offset = 0  # compaction rewrote every offset
+            cur.revision = revision
+        dat_size = v.data_size()
+        start = cur.offset
+        if full or start >= dat_size:
+            start = 0
+        # The needle MAP drives the walk, in .dat offset order — never
+        # on-disk record chaining: a rotten header's bogus size field
+        # would stall a chained walk at the first bad record and leave
+        # everything past it silently unscrubbed forever. Map-driven,
+        # header rot in a live record surfaces as a finding instead
+        # (id/size mismatch against the map via expected_size).
+        entries = sorted(
+            (types.stored_to_actual_offset(nv.offset), nv.size, key)
+            for key, nv in list(v.nm)
+            if nv.offset != 0 and not types.size_is_deleted(nv.size))
+        persist_every = 8 * 1024 * 1024
+        since_persist = 0
+        verified_this_pass = 0
+        completed = True
+        for off, size, key in entries:
+            if off < start or off >= dat_size:
+                continue  # behind the cursor, or appended mid-sweep
+            if self._stop.is_set():
+                completed = False
+                break
+            if self.pass_budget and verified_this_pass >= self.pass_budget:
+                completed = False  # bounded pass: cursor resumes next run
+                break
+            self._maybe_backoff()
+            length = types.actual_size(size, v.version)
+            self.bucket.take(length)
+            blob = v._pread_durable(off, length)
+            SCRUB_BYTES.inc(len(blob), kind="needle")
+            SCRUB_NEEDLES.inc()
+            report.needles += 1
+            report.bytes += len(blob)
+            verified_this_pass += len(blob)
+            bad, err = False, ""
+            try:
+                if len(blob) < length:
+                    raise IOError(f"short record read "
+                                  f"({len(blob)} < {length})")
+                parsed = Needle.from_bytes(blob, v.version,
+                                           expected_size=size)
+                if parsed.id != key:
+                    raise IOError(
+                        f"record id {parsed.id:x} != map id {key:x}")
+            except (CrcError, ValueError, IOError) as e:
+                bad, err = True, str(e)
+            else:
+                if parsed.has_expired():
+                    bad = False  # dying anyway; repair would resurrect
+            nv_now = v.nm.get(key)
+            still_live = (nv_now is not None
+                          and not types.size_is_deleted(nv_now.size)
+                          and types.stored_to_actual_offset(nv_now.offset)
+                          == off)
+            if bad and still_live:
+                f = self._add_finding(Finding(
+                    v.id, "needle_crc", needle_id=key,
+                    detail=f"offset {off}: {err}"))
+                report.findings.append(f)
+                if repair:
+                    if self._repair_needle(v, key, f):
+                        report.repaired += 1
+            cur.offset = off + length
+            since_persist += length
+            if since_persist >= persist_every:
+                cur.save()
+                since_persist = 0
+        if completed:
+            cur.offset = dat_size  # next pass wraps to the beginning
+            cur.sweeps += 1
+            SCRUB_SWEEPS.inc(kind="volume")
+            # refresh the digest manifest at each completed sweep so
+            # anti-entropy peers can compare without a full rebuild
+            try:
+                d_entries = digest_mod.volume_digest_entries(v)
+                digest_mod.write_manifest(base, d_entries)
+                SCRUB_BYTES.inc(len(d_entries) * digest_mod.ENTRY_SIZE,
+                                kind="digest")
+            except OSError:
+                pass
+        cur.save()
+
+    def _repair_needle(self, v, needle_id: int, finding: Finding) -> bool:
+        """Quarantine -> fetch a CRC-verified copy from a healthy replica
+        -> rewrite locally -> re-verify -> clear. The server keeps
+        serving the needle from the replica while quarantined."""
+        v.quarantine(needle_id)
+        try:
+            n = None
+            if self.server is not None:
+                n = fetch_needle_from_replicas(self.server, v.id,
+                                               needle_id, v.version)
+            if n is None:
+                finding.set_state("failed")
+                SCRUB_REPAIRS.inc(method="re_replicate", outcome="failed")
+                return False
+            try:
+                v.write_needle(n, check_cookie=False)
+                nv = v.nm.get(needle_id)
+                if nv is None:
+                    raise IOError("repair write vanished from the map")
+                v._read_record(nv)  # re-verify: CRC checked on parse
+            except (IOError, ValueError) as e:
+                finding.detail += f"; repair failed: {e}"
+                finding.set_state("failed")
+                SCRUB_REPAIRS.inc(method="re_replicate", outcome="failed")
+                return False
+            finding.set_state("repaired")
+            SCRUB_REPAIRS.inc(method="re_replicate", outcome="ok")
+            glog.info(f"scrub: vol {v.id} needle {needle_id:x} "
+                      f"re-replicated and verified clean")
+            return True
+        finally:
+            v.unquarantine(needle_id)
+
+    # ---- EC volumes: syndrome verify through the dispatch scheduler
+
+    def _geo_coder(self, geo):
+        coder = self.store.coder
+        if (coder.data_shards, coder.parity_shards) == (geo.data_shards,
+                                                        geo.parity_shards):
+            return coder
+        from ..models.coder import new_coder
+
+        return new_coder(geo.data_shards, geo.parity_shards)
+
+    def _verify_ec_volume(self, loc, vid: int, full: bool, repair: bool,
+                          report: ScrubReport, _depth: int = 0) -> None:
+        ev = loc.ec_volumes.get(vid)
+        if ev is None:
+            return
+        geo = ev.geo
+        k = geo.data_shards
+        present = set(ev.shard_files)
+        if not all(i in present for i in range(k)):
+            return  # data shards elsewhere: the holder of each verifies
+        parity_present = [k + j for j in range(geo.parity_shards)
+                          if k + j in present]
+        if not parity_present:
+            return
+        coder = self._geo_coder(geo)
+        sched = dispatch.maybe_scheduler(coder)
+        encode = coder.encode_parity if sched is None else sched.encode_parity
+        cur = self._cursor_for(ev.base)
+        shard_size = ev.shard_size
+        slab = max(4096, self.ec_slab)
+        start = 0 if full or cur.ec_offset >= shard_size else cur.ec_offset
+        off = start
+        # whole-shard CRCs chained slab-to-slab as the sweep reads them
+        # in file order — crc32c's incremental form; crc32c_combine
+        # stays available for out-of-order/parallel folds but would be
+        # pure overhead here (GF(2) matrix math per slab)
+        running: dict[int, int] = ({i: 0 for i in sorted(present)}
+                                   if start == 0 else {})
+        clean = True
+        while off < shard_size:
+            if self._stop.is_set():
+                return
+            self._maybe_backoff()
+            n = min(slab, shard_size - off)
+            self.bucket.take(n * len(present))
+            rows: dict[int, np.ndarray] = {}
+            for i in sorted(present):
+                data = ev.shard_files[i].read_at(off, n)
+                rows[i] = np.frombuffer(
+                    data + b"\0" * (n - len(data)), np.uint8)
+                if i in running:
+                    running[i] = crc32c(rows[i].tobytes(), running[i])
+            data_stack = np.stack([rows[i] for i in range(k)])
+            # the recompute rides the shared encode lane: scrub slabs
+            # stack into the same device dispatches as foreground encodes
+            recomputed = np.asarray(encode(data_stack), np.uint8)
+            SCRUB_BYTES.inc(n * len(present), kind="ec_syndrome")
+            report.bytes += n * len(present)
+            for j, sid in enumerate(range(k, geo.total_shards)):
+                if sid not in rows:
+                    continue
+                if not np.array_equal(recomputed[j], rows[sid]):
+                    clean = False
+                    culprit = self._identify_bad_shard(ev, coder, off, n)
+                    f = self._add_finding(Finding(
+                        vid, "ec_parity",
+                        shard_id=culprit if culprit is not None else 255,
+                        detail=f"syndrome mismatch in shard byte range "
+                               f"[{off}, {off + n})"
+                               + ("" if culprit is not None
+                                  else " (culprit ambiguous)")))
+                    report.findings.append(f)
+                    if repair and culprit is not None:
+                        if self._repair_ec_shard(loc, vid, culprit, f):
+                            report.repaired += 1
+                            if _depth < 2:
+                                # shards were rebuilt: re-verify the whole
+                                # volume against the fresh files
+                                self._verify_ec_volume(
+                                    loc, vid, True, repair, report,
+                                    _depth + 1)
+                            return
+                    break  # one finding per slab is enough
+            off += n
+            cur.ec_offset = off
+        cur.ec_offset = off if off < shard_size else shard_size
+        if off >= shard_size and clean:
+            cur.sweeps += 1
+            SCRUB_SWEEPS.inc(kind="ec")
+            if start == 0 and running:
+                # whole-shard digests fall out of the slabs we already
+                # read — no second pass over the files
+                self._ec_digests[vid] = {
+                    i: digest_mod.ShardCrc(i, running[i],
+                                           ev.shard_files[i].size())
+                    for i in running if i in ev.shard_files}
+        cur.save()
+
+    def _identify_bad_shard(self, ev, coder, off: int,
+                            size: int) -> int | None:
+        """Leave-one-out: the corrupt shard is the one whose replacement
+        by a reconstruction from the others makes every parity equation
+        hold again. Exact for single-shard corruption under RS(k, m)."""
+        geo = ev.geo
+        total = geo.total_shards
+        rows: dict[int, np.ndarray] = {}
+        for i, f in ev.shard_files.items():
+            data = f.read_at(off, size)
+            rows[i] = np.frombuffer(data + b"\0" * (size - len(data)),
+                                    np.uint8)
+        if len(rows) < total:
+            return None  # missing shards are the rebuild path's business
+        k = geo.data_shards
+        for cand in range(total):
+            pres = tuple(i for i in range(total) if i != cand)
+            try:
+                missing, out = dispatch.reconstruct_now(
+                    coder, pres, np.stack([rows[i] for i in pres]))
+                rec = np.asarray(out[list(missing).index(cand)], np.uint8)
+            except (IOError, ValueError, KeyError):
+                continue
+            trial = dict(rows)
+            trial[cand] = rec
+            parity = np.asarray(coder.encode_parity(
+                np.stack([trial[i] for i in range(k)])), np.uint8)
+            if all(np.array_equal(parity[j], trial[k + j])
+                   for j in range(geo.parity_shards)):
+                return cand
+        return None
+
+    def _repair_ec_shard(self, loc, vid: int, sid: int,
+                         finding: Finding) -> bool:
+        """Quarantine the shard (reads degrade-reconstruct around it),
+        delete its file, EC-rebuild from the survivors, remount, and let
+        the caller re-verify the fresh bytes."""
+        ev = loc.ec_volumes.get(vid)
+        if ev is None:
+            return False
+        base = ev.base
+        collection = getattr(ev, "collection", "")
+        geo = ev.geo
+        try:
+            # atomic replace (no close): in-flight readers iterating the
+            # old dict keep a valid mmap; dropping the entry makes every
+            # NEW read reconstruct instead of serving rotten bytes
+            ev.shard_files = {i: f for i, f in ev.shard_files.items()
+                              if i != sid}
+            shard_path = geo.shard_file_name(base, sid)
+            try:
+                os.remove(shard_path)
+            except FileNotFoundError:
+                pass
+            from ..storage.ec_files import rebuild_ec_files
+
+            coder = self._geo_coder(geo)
+            rebuilt = rebuild_ec_files(base, coder, geo)
+            self.store.mount_ec_shards(vid, collection, rebuilt)
+            self.invalidate_ec_digest(vid)
+            srv = self.server
+            if srv is not None:
+                srv.ec_recon_cache.invalidate(vid)
+                srv.trigger_heartbeat()
+        except (IOError, ValueError, OSError) as e:
+            finding.detail += f"; rebuild failed: {e}"
+            finding.set_state("failed")
+            SCRUB_REPAIRS.inc(method="ec_rebuild", outcome="failed")
+            return False
+        finding.set_state("repaired")
+        SCRUB_REPAIRS.inc(method="ec_rebuild", outcome="ok")
+        glog.info(f"scrub: ec vol {vid} shard {sid} rebuilt from survivors")
+        return True
+
+    # ---- anti-entropy: digest comparison across replicas
+
+    def run_anti_entropy(self, vid: int | None = None, repair: bool = True,
+                         report: ScrubReport | None = None) -> ScrubReport:
+        report = report if report is not None else ScrubReport()
+        srv = self.server
+        if srv is None:
+            return report
+        for loc in self.store.locations:
+            for v_id, v in list(loc.volumes.items()):
+                if vid is not None and v_id != vid:
+                    continue
+                if v.super_block.replica_placement.copy_count <= 1:
+                    continue
+                try:
+                    self._anti_entropy_volume(v, repair, report)
+                except Exception as e:  # noqa: BLE001 — next volume
+                    glog.warning(f"anti-entropy vol {v_id}: {e}")
+        return report
+
+    def _anti_entropy_volume(self, v, repair: bool,
+                             report: ScrubReport) -> None:
+        import grpc
+
+        from ..pb import rpc, scrub_pb2
+
+        srv = self.server
+        mine = digest_mod.volume_digest_entries(v)
+        my_rolling = digest_mod.rolling_digest(mine)
+        my_live = sum(1 for e in mine if e.size >= 0)
+        for addr in srv.lookup_volume_locations(v.id):
+            if addr == srv.address:
+                continue
+            try:
+                stub = rpc.volume_stub(rpc.grpc_address(addr))
+                resp = stub.VolumeDigest(scrub_pb2.VolumeDigestRequest(
+                    volume_id=v.id), timeout=30)
+                if resp.rolling_crc == my_rolling \
+                        and resp.needle_count == my_live:
+                    continue  # replicas agree — ~20 bytes settled it
+                resp = stub.VolumeDigest(scrub_pb2.VolumeDigestRequest(
+                    volume_id=v.id, include_entries=True), timeout=60)
+            except grpc.RpcError:
+                continue
+            theirs = [digest_mod.DigestEntry(e.needle_id, e.crc, e.size)
+                      for e in resp.entries]
+            only_mine, only_theirs, differing = digest_mod.diff_entries(
+                mine, theirs)
+            # a one-sided tombstone (the other replica never had the id
+            # at all) is already-converged deletion history, not
+            # divergence — nothing exists to heal, so flagging it would
+            # pin a permanently-"repaired-every-sweep" finding
+            only_mine = [e for e in only_mine if e.size >= 0]
+            only_theirs = [e for e in only_theirs if e.size >= 0]
+            if not (only_mine or only_theirs or differing):
+                continue
+            f = self._add_finding(Finding(
+                v.id, "replica_divergence",
+                detail=f"vs {addr}: +{len(only_mine)} local-only, "
+                       f"+{len(only_theirs)} remote-only, "
+                       f"{len(differing)} differing"))
+            report.findings.append(f)
+            if not repair:
+                continue
+            ok = self._heal_divergence(v, addr, only_mine, only_theirs,
+                                       differing)
+            # "repaired" is only claimed on PROVEN convergence: recompute
+            # the local digest and re-fetch the peer's rolling CRC. An
+            # unorderable live-vs-live conflict (equal append_at_ns) —
+            # or any silent non-heal — leaves the digests apart and the
+            # finding honestly failed, instead of an endlessly
+            # "repairing" counter that never converges.
+            mine = digest_mod.volume_digest_entries(v)
+            my_rolling = digest_mod.rolling_digest(mine)
+            my_live = sum(1 for e in mine if e.size >= 0)
+            if ok:
+                try:
+                    resp = stub.VolumeDigest(scrub_pb2.VolumeDigestRequest(
+                        volume_id=v.id), timeout=30)
+                    ok = (resp.rolling_crc == my_rolling
+                          and resp.needle_count == my_live)
+                except grpc.RpcError:
+                    ok = False
+            f.set_state("repaired" if ok else "failed")
+            SCRUB_REPAIRS.inc(method="anti_entropy",
+                              outcome="ok" if ok else "failed")
+            if ok:
+                report.repaired += 1
+
+    def _heal_divergence(self, v, addr: str, only_mine, only_theirs,
+                         differing) -> bool:
+        """Converge one (local, peer) replica pair. Rules: tombstones win
+        over live entries (deletes propagate — without vector clocks the
+        alternative resurrects deleted data); live-vs-live conflicts go
+        to the newest append_at_ns; missing entries are copied toward
+        the replica that lacks them."""
+        import grpc
+
+        from ..pb import rpc
+        from ..pb import volume_server_pb2 as vs
+        from ..storage.file_id import format_needle_id_cookie
+
+        stub = rpc.volume_stub(rpc.grpc_address(addr))
+        ok = True
+        try:
+            for e in only_theirs:
+                if e.size < 0:
+                    continue  # their tombstone for an id we never had
+                theirs_n = fetch_verified_needle(stub, v.id, e.needle_id,
+                                                 v.version)
+                if theirs_n is None:
+                    ok = False
+                    continue
+                v.write_needle(theirs_n, check_cookie=False)
+            for e in only_mine:
+                if e.size < 0:
+                    continue
+                nv = v.nm.get(e.needle_id)
+                if nv is None:
+                    continue
+                try:
+                    # CRC-verify the LOCAL record before shipping it:
+                    # pushing unverified bytes would replicate local rot
+                    # onto the healthy peer (never heal FROM rot)
+                    v._read_record(nv)
+                except (IOError, ValueError):
+                    ok = False  # the needle sweep owns this finding
+                    continue
+                blob = v.read_needle_blob(
+                    types.stored_to_actual_offset(nv.offset), nv.size)
+                stub.WriteNeedleBlob(vs.WriteNeedleBlobRequest(
+                    volume_id=v.id, needle_id=e.needle_id, size=nv.size,
+                    needle_blob=blob), timeout=30)
+            for me, them in differing:
+                if me.size < 0:  # my tombstone vs their live: delete wins
+                    stub.BatchDelete(vs.BatchDeleteRequest(
+                        file_ids=[f"{v.id},"
+                                  f"{format_needle_id_cookie(me.needle_id, 0)}"],
+                        skip_cookie_check=True), timeout=30)
+                    continue
+                if them.size < 0:  # their tombstone vs my live
+                    try:
+                        v.delete_needle(me.needle_id)
+                    except (NotFoundError, DeletedError):
+                        pass
+                    continue
+                theirs_n = fetch_verified_needle(stub, v.id, me.needle_id,
+                                                 v.version)
+                if theirs_n is None:
+                    ok = False
+                    continue
+                nv = v.nm.get(me.needle_id)
+                mine_n = None
+                if nv is not None:
+                    try:
+                        mine_n = v._read_record(nv)
+                    except (IOError, ValueError):
+                        mine_n = None  # local copy rotten: theirs wins
+                if mine_n is None or \
+                        theirs_n.append_at_ns > mine_n.append_at_ns:
+                    v.write_needle(theirs_n, check_cookie=False)
+                elif mine_n.append_at_ns > theirs_n.append_at_ns:
+                    blob = v.read_needle_blob(
+                        types.stored_to_actual_offset(nv.offset), nv.size)
+                    stub.WriteNeedleBlob(vs.WriteNeedleBlobRequest(
+                        volume_id=v.id, needle_id=me.needle_id,
+                        size=nv.size, needle_blob=blob), timeout=30)
+                # equal timestamps with differing bytes cannot be ordered
+                # — leave both and let the finding surface to operators
+        except (grpc.RpcError, IOError, ValueError) as e:
+            glog.warning(f"anti-entropy heal vol {v.id} vs {addr}: {e}")
+            return False
+        return ok
+
+    # -- introspection -----------------------------------------------------
+
+    def status(self) -> dict:
+        """The /status `Scrub` section + `volume.scrub -status` payload."""
+        import re as _re
+
+        cursors = []
+        with self._mu:
+            snapshot = sorted(self._cursors.items())
+        for base, cur in snapshot:
+            name = os.path.basename(base)
+            m = _re.search(r"(\d+)$", name)
+            cursors.append({"base": name,
+                            "volumeId": int(m.group(1)) if m else 0,
+                            "offset": cur.offset, "ecOffset": cur.ec_offset,
+                            "sweeps": cur.sweeps})
+        with self._mu:
+            findings = [
+                {"volumeId": f.volume_id, "kind": f.kind,
+                 "needleId": f.needle_id, "shardId": f.shard_id,
+                 "state": f.state, "detail": f.detail}
+                for f in self.findings[-32:]]
+            backlog = len(self._suspects)
+        return {
+            "running": self.running,
+            "intervalSeconds": self.interval,
+            "maxMBps": self.bucket.rate / (1024 * 1024)
+            if self.bucket.rate > 0 else 0,
+            "sweepsCompleted": self.sweeps_completed,
+            "lastSweepUnix": self.last_sweep_unix,
+            "suspectBacklog": backlog,
+            "cursors": cursors,
+            "recentFindings": findings,
+        }

@@ -71,6 +71,10 @@ class MasterServer:
         # volume.vacuum.disable pauses the periodic driver (the reference's
         # Topology.isDisableVacuum); manual /vol/vacuum still works
         self.vacuum_disabled = False
+        # integrity plane (ISSUE 4): periodic fleet-wide scrub driver —
+        # each tick asks the least-recently-scrubbed volume server (the
+        # topology round-robin hook) to run one self-healing pass
+        self.scrub_disabled = False
         self.topo = Topology(
             volume_size_limit=volume_size_limit_mb * 1024 * 1024,
             pulse_seconds=pulse_seconds,
@@ -142,7 +146,8 @@ class MasterServer:
 
     # -- lifecycle ---------------------------------------------------------
 
-    def start(self, *, vacuum_interval: float = 60.0) -> None:
+    def start(self, *, vacuum_interval: float = 60.0,
+              scrub_interval: float | None = None) -> None:
         self._grpc_server = rpc.new_server()
         creds = rpc.add_servicer(self._grpc_server, rpc.MASTER_SERVICE,
                                  MasterGrpc(self), component="master")
@@ -157,6 +162,17 @@ class MasterServer:
             target=self._vacuum_loop, args=(vacuum_interval,), daemon=True
         )
         self._vacuum_thread.start()
+        if scrub_interval is None:
+            import os as _os
+
+            try:
+                scrub_interval = float(_os.environ.get(
+                    "SWFS_MASTER_SCRUB_INTERVAL_S", "0"))
+            except ValueError:
+                scrub_interval = 0.0
+        if scrub_interval > 0:
+            threading.Thread(target=self._scrub_loop,
+                             args=(scrub_interval,), daemon=True).start()
         if self.raft is not None:
             self.raft.start()
         glog.info(f"master started on {self.address} (grpc :{self.grpc_port})")
@@ -313,6 +329,42 @@ class MasterServer:
                 self.vacuum_once(self.garbage_threshold)
             except Exception as e:  # noqa: BLE001 - keep the driver alive
                 glog.warning(f"vacuum pass failed: {e}")
+
+    # -- scrub driver (integrity plane, ISSUE 4) ---------------------------
+
+    def _scrub_loop(self, interval: float) -> None:
+        """Periodic fleet scrub: each tick nudges the least-recently-
+        scrubbed volume server (topology.next_scrub_targets) to run a
+        self-healing pass. The per-server scrubber does its own pacing;
+        this loop only spreads WHICH server sweeps WHEN."""
+        while not self._stop.wait(interval):
+            if self.scrub_disabled or not self.is_leader():
+                continue
+            try:
+                self.scrub_once()
+            except Exception as e:  # noqa: BLE001 - keep the driver alive
+                glog.warning(f"scrub pass failed: {e}")
+
+    def scrub_once(self, max_nodes: int = 1, repair: bool = True) -> int:
+        """Ask up to `max_nodes` due volume servers for one scrub pass.
+        -> servers that completed."""
+        from ..pb import scrub_pb2
+
+        done = 0
+        for dn in self.topo.next_scrub_targets(max_nodes):
+            try:
+                stub = rpc.volume_stub(dn.grpc_address)
+                resp = stub.VolumeScrub(
+                    scrub_pb2.VolumeScrubRequest(repair=repair),
+                    timeout=3600)
+                if resp.findings:
+                    glog.warning(
+                        f"scrub on {dn.url}: {len(resp.findings)} "
+                        f"finding(s), {resp.repaired} repaired")
+                done += 1
+            except grpc.RpcError as e:
+                glog.warning(f"scrub on {dn.url}: {e.code()}")
+        return done
 
     def vacuum_once(self, threshold: float, volume_id: int = 0) -> int:
         """One scan: compact+commit every volume whose garbage ratio exceeds
@@ -544,6 +596,20 @@ class MasterGrpc:
         # master_grpc_server_volume.go:294 (Topo.EnableVacuum)
         self.ms.vacuum_disabled = False
         return master_pb2.EnableVacuumResponse()
+
+    def DisableScrub(self, request, context):
+        # pause the fleet scrub driver (incident knob; per-server
+        # daemons keep their own SWFS_SCRUB_INTERVAL_S schedule)
+        from ..pb import scrub_pb2
+
+        self.ms.scrub_disabled = True
+        return scrub_pb2.DisableScrubResponse()
+
+    def EnableScrub(self, request, context):
+        from ..pb import scrub_pb2
+
+        self.ms.scrub_disabled = False
+        return scrub_pb2.EnableScrubResponse()
 
     def VolumeMarkReadonly(self, request, context):
         # master_grpc_server_volume.go:301 — flip the layout standing so

@@ -25,7 +25,9 @@ import grpc
 import numpy as np
 
 from ..ops import dispatch
-from ..pb import master_pb2, rpc, volume_server_pb2 as vs
+from ..pb import master_pb2, rpc, scrub_pb2, volume_server_pb2 as vs
+from ..scrub import Scrubber
+from ..scrub import digest as scrub_digest
 from ..storage import types
 from ..storage.ec_files import (
     find_dat_file_size,
@@ -37,9 +39,14 @@ from ..storage.ec_files import (
 )
 from ..storage.ec_locate import Geometry, locate_data
 from ..storage.ec_volume import EcVolume, delete_needle_from_ecx
-from ..storage.errors import CookieMismatch, DeletedError, NotFoundError
+from ..storage.errors import (
+    CookieMismatch,
+    DeletedError,
+    NotFoundError,
+    QuarantinedError,
+)
 from ..storage.file_id import parse_file_id
-from ..storage.needle import Needle
+from ..storage.needle import CrcError, Needle
 from ..storage.store import Store
 from ..storage.ttl import TTL
 from ..utils import failpoint, glog
@@ -53,6 +60,35 @@ from ..utils.stats import (
 )
 
 BUFFER_SIZE_LIMIT = 2 * 1024 * 1024  # streaming chunk (volume_grpc_copy.go:25)
+
+
+class _RateMeter:
+    """Sliding-window foreground request rate — the signal the scrub
+    plane backs off on (scrub must yield to client traffic). note() is
+    amortized O(1): each timestamp is appended once and popped once, so
+    the hot data path never pays a window-sized rebuild under the lock."""
+
+    def __init__(self, window_s: float = 2.0):
+        from collections import deque
+
+        self.window = window_s
+        self._events: "deque[float]" = deque()
+        self._lock = threading.Lock()
+
+    def note(self) -> None:
+        now = time.monotonic()
+        cut = now - self.window
+        with self._lock:
+            self._events.append(now)
+            while self._events and self._events[0] < cut:
+                self._events.popleft()
+
+    def qps(self) -> float:
+        cut = time.monotonic() - self.window
+        with self._lock:
+            while self._events and self._events[0] < cut:
+                self._events.popleft()
+            return len(self._events) / self.window
 
 
 class VolumeServer:
@@ -121,6 +157,12 @@ class VolumeServer:
         # block; invalidated on shard mount/unmount/delete (the gRPC
         # handlers below). SWFS_EC_RECON_CACHE_MB=0 disables it.
         self.ec_recon_cache = dispatch.ReconstructIntervalCache()
+        # integrity plane (ISSUE 4): the paced background scrubber —
+        # needle CRC sweeps, EC syndrome verification, anti-entropy and
+        # the self-healing repair ladder (scrub/scrubber.py). The
+        # foreground rate meter is what it backs off on.
+        self._fg_rate = _RateMeter()
+        self.scrubber = Scrubber(self.store, self)
 
     @property
     def address(self) -> str:
@@ -156,6 +198,7 @@ class VolumeServer:
             self._sync_native_registry()
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
         threading.Thread(target=self._check_with_master, daemon=True).start()
+        self.scrubber.start()
         glog.info(f"volume server started on {self.address} "
                   f"(grpc :{self.grpc_port}"
                   + (f", native data plane, admin :{self.admin_port})"
@@ -237,6 +280,7 @@ class VolumeServer:
     def stop(self) -> None:
         self._stop.set()
         self._hb_wake.set()
+        self.scrubber.stop()
         stop_push = getattr(self, "_stop_metrics_push", None)
         if stop_push is not None:
             stop_push()
@@ -303,14 +347,40 @@ class VolumeServer:
 
     # -- needle read incl. EC (store.go:410 / store_ec.go:136) -------------
 
+    def foreground_qps(self) -> float:
+        """Client data-plane request rate; the scrubber backs off on it."""
+        return self._fg_rate.qps()
+
     def read_needle(self, vid: int, needle_id: int, cookie: int | None):
         v = self.store.find_volume(vid)
         if v is not None:
-            return v.read_needle(needle_id, cookie)
+            try:
+                return v.read_needle(needle_id, cookie)
+            except QuarantinedError:
+                # scrub quarantined the local record mid-repair: answer
+                # from a healthy replica so the client never sees either
+                # the corrupt bytes or an error
+                n = self._read_needle_from_replica(v, needle_id, cookie)
+                if n is not None:
+                    return n
+                raise
         ev = self.store.find_ec_volume(vid)
         if ev is not None:
             return self._read_ec_needle(ev, vid, needle_id, cookie)
         raise NotFoundError(f"volume {vid} not found")
+
+    def _read_needle_from_replica(self, v, needle_id: int,
+                                  cookie: int | None) -> Needle | None:
+        from ..scrub.scrubber import fetch_needle_from_replicas
+
+        n = fetch_needle_from_replicas(self, v.id, needle_id, v.version)
+        if n is None:
+            return None
+        if cookie is not None and n.cookie != cookie:
+            raise CookieMismatch("cookie mismatch on replica read")
+        if n.has_expired():
+            raise NotFoundError(f"needle {needle_id:x} expired")
+        return n
 
     def _read_ec_needle(self, ev: EcVolume, vid: int, needle_id: int,
                         cookie: int | None) -> Needle:
@@ -318,20 +388,81 @@ class VolumeServer:
         if types.size_is_deleted(size):
             raise DeletedError(f"needle {needle_id:x} deleted")
         length = types.actual_size(size, ev.version)
-        blob = self._read_ec_extent(ev, vid, offset, length)
-        n = Needle.from_bytes(blob, ev.version, expected_size=size)
-        if cookie is not None and n.cookie != cookie:
-            raise CookieMismatch("cookie mismatch on EC read")
-        return n
 
-    def _read_ec_extent(self, ev: EcVolume, vid: int, offset: int, length: int) -> bytes:
+        def parse_verified(blob: bytes) -> Needle:
+            n = Needle.from_bytes(blob, ev.version, expected_size=size)
+            if cookie is not None and n.cookie != cookie:
+                # under suspected rot a cookie mismatch is ambiguous
+                # (rotten header byte vs bad client) — let the ladder
+                # decide by reconstructing; a genuine bad cookie fails
+                # the same way against the reconstructed bytes too
+                raise CookieMismatch("cookie mismatch on EC read")
+            return n
+
+        # extent-read failures (unreachable shards, failed reconstruct)
+        # propagate directly: the self-heal ladder below is ONLY for
+        # bytes that were read but failed verification — retrying an
+        # infrastructure failure per candidate shard would turn one
+        # failing read into N expensive k-survivor gathers
+        blob = self._read_ec_extent(ev, vid, offset, length)
+        try:
+            return parse_verified(blob)
+        except (CrcError, ValueError, IOError, CookieMismatch) as first:
+            # The record failed verification straight off local shard
+            # files (IOError here is parse-level: a flipped size byte
+            # reads as SizeMismatch/short-body, not a CRC error;
+            # CookieMismatch covers cookie-byte rot). One of the shards
+            # this needle touches has rotted on disk — but which one
+            # isn't knowable from the failure alone. Retry once per
+            # candidate shard, reconstructing the extent with that shard
+            # excluded everywhere (13 survivors still >= k): the
+            # candidate whose exclusion yields a verified parse is the
+            # rotten one. The client gets clean bytes; the volume is
+            # queued for a targeted scrub + durable rebuild.
+            intervals = locate_data(ev.geo, ev.dat_size_estimate, offset,
+                                    length)
+            sids = list(dict.fromkeys(
+                iv.to_shard_id_and_offset(ev.geo)[0] for iv in intervals))
+            if isinstance(first, CookieMismatch):
+                # the cookie lives in the record HEADER, i.e. the first
+                # interval's shard — one candidate bounds the work, so a
+                # client sending a genuinely wrong cookie costs one
+                # reconstruction, not one per shard (request
+                # amplification)
+                sids = sids[:1]
+            for suspect in sids:
+                try:
+                    n = parse_verified(self._read_ec_extent(
+                        ev, vid, offset, length, exclude_shard=suspect))
+                except (CrcError, ValueError, IOError, CookieMismatch):
+                    continue
+                self.scrubber.report_suspect(vid)
+                glog.warning(
+                    f"ec vol {vid} needle {needle_id:x}: local shard "
+                    f"bytes failed verification (suspect shard "
+                    f"{suspect}); served via reconstruction, scrub "
+                    f"queued")
+                return n
+            raise
+
+    def _read_ec_extent(self, ev: EcVolume, vid: int, offset: int,
+                        length: int,
+                        exclude_shard: int | None = None) -> bytes:
         """readEcShardIntervals (store_ec.go:176): local shard file, else
-        remote peer holding the shard, else reconstruct from any k."""
+        remote peer holding the shard, else reconstruct from any k. With
+        `exclude_shard`, that shard's local bytes are treated as rotten:
+        its intervals reconstruct around it and it is never used as a
+        survivor (scrub self-heal)."""
         intervals = locate_data(ev.geo, ev.dat_size_estimate, offset, length)
         out = bytearray()
         for iv in intervals:
             sid, soff = iv.to_shard_id_and_offset(ev.geo)
-            out += self._read_ec_interval(ev, vid, sid, soff, iv.size)
+            if exclude_shard is not None and sid == exclude_shard:
+                out += self._reconstruct_range(
+                    ev, vid, sid, soff, iv.size,
+                    self._lookup_ec_shards(vid), exclude={exclude_shard})
+            else:
+                out += self._read_ec_interval(ev, vid, sid, soff, iv.size)
         return bytes(out)
 
     def _read_ec_interval(self, ev: EcVolume, vid: int, sid: int,
@@ -409,14 +540,20 @@ class VolumeServer:
 
     def _reconstruct_range(self, ev: EcVolume, vid: int, sid: int,
                            soff: int, size: int,
-                           locs: dict[int, list[str]]) -> bytes:
+                           locs: dict[int, list[str]],
+                           exclude: set[int] | None = None) -> bytes:
         """recoverOneRemoteEcShardInterval (store_ec.go:339-393): gather k
         survivor intervals (local + remote, in parallel), then reconstruct
         through the stacked fast path — concurrent calls sharing a
-        survivor set coalesce into one device dispatch."""
+        survivor set coalesce into one device dispatch. Shards in
+        `exclude` are never used as survivors (scrub self-heal: their
+        bytes exist locally but are suspected rotten)."""
         geo = ev.geo
+        exclude = exclude or set()
         bufs: dict[int, np.ndarray] = {}
         for i, f in ev.shard_files.items():
+            if i in exclude:
+                continue
             try:
                 failpoint.fail("ec.shard.read",
                                ctx=f"{self.address}, shard={i},")
@@ -427,7 +564,8 @@ class VolumeServer:
 
         missing = [
             i for i in range(geo.total_shards)
-            if i not in bufs and i != sid and locs.get(i)
+            if i not in bufs and i != sid and i not in exclude
+            and locs.get(i)
         ]
 
         def fetch(i):
@@ -775,7 +913,16 @@ class VolumeGrpc:
 
     def ReadNeedleBlob(self, request, context):
         v = self._volume(request.volume_id, context)
-        blob = v.read_needle_blob(request.offset, request.size)
+        offset, size = request.offset, request.size
+        if offset == 0 and size == 0 and request.needle_id:
+            # by-id form (scrub/anti-entropy): callers on OTHER servers
+            # can't know local offsets — resolve through the needle map
+            nv = v.nm.get(request.needle_id)
+            if nv is None or types.size_is_deleted(nv.size):
+                context.abort(grpc.StatusCode.NOT_FOUND, "needle not found")
+            offset = types.stored_to_actual_offset(nv.offset)
+            size = nv.size
+        blob = v.read_needle_blob(offset, size)
         return vs.ReadNeedleBlobResponse(needle_blob=blob)
 
     def WriteNeedleBlob(self, request, context):
@@ -888,6 +1035,7 @@ class VolumeGrpc:
         from ..storage.ec_volume import rebuild_ecx_file
 
         rebuild_ecx_file(base)
+        self.srv.scrubber.invalidate_ec_digest(request.volume_id)
         self.srv.trigger_heartbeat()
         return vs.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
 
@@ -921,6 +1069,7 @@ class VolumeGrpc:
                 sync_stride_marker(src, request.volume_id,
                                    request.collection, base,
                                    ext=".ecx.lrg", is_ec=True)
+        self.srv.scrubber.invalidate_ec_digest(request.volume_id)
         return vs.VolumeEcShardsCopyResponse()
 
     def VolumeEcShardsDelete(self, request, context):
@@ -953,6 +1102,7 @@ class VolumeGrpc:
                     self.store.mount_ec_shards(
                         request.volume_id, request.collection, [])
         self.srv.ec_recon_cache.invalidate(request.volume_id)
+        self.srv.scrubber.invalidate_ec_digest(request.volume_id)
         self.srv.trigger_heartbeat()
         return vs.VolumeEcShardsDeleteResponse()
 
@@ -961,12 +1111,14 @@ class VolumeGrpc:
             request.volume_id, request.collection, list(request.shard_ids))
         # cached reconstructions may describe shards that just (re)appeared
         self.srv.ec_recon_cache.invalidate(request.volume_id)
+        self.srv.scrubber.invalidate_ec_digest(request.volume_id)
         self.srv.trigger_heartbeat()
         return vs.VolumeEcShardsMountResponse()
 
     def VolumeEcShardsUnmount(self, request, context):
         self.store.unmount_ec_shards(request.volume_id, list(request.shard_ids))
         self.srv.ec_recon_cache.invalidate(request.volume_id)
+        self.srv.scrubber.invalidate_ec_digest(request.volume_id)
         self.srv.trigger_heartbeat()
         return vs.VolumeEcShardsUnmountResponse()
 
@@ -1087,6 +1239,78 @@ class VolumeGrpc:
         now = time.time_ns()
         return vs.PingResponse(start_time_ns=now, remote_time_ns=now,
                                stop_time_ns=time.time_ns())
+
+    # ---- integrity plane (scrub.proto; ISSUE 4) --------------------------
+
+    def VolumeDigest(self, request, context):
+        """Digest manifest of one volume: sorted per-needle stored CRCs +
+        rolling digest (anti-entropy compares THIS instead of shipping
+        bytes). EC volumes answer per-shard whole-file CRCs instead."""
+        vid = request.volume_id
+        v = self.store.find_volume(vid)
+        if v is not None:
+            entries = scrub_digest.volume_digest_entries(v)
+            resp = scrub_pb2.VolumeDigestResponse(
+                volume_id=vid,
+                needle_count=sum(1 for e in entries if e.size >= 0),
+                tombstone_count=sum(1 for e in entries if e.size < 0),
+                rolling_crc=scrub_digest.rolling_digest(entries))
+            if request.include_entries:
+                for e in entries:
+                    resp.entries.add(needle_id=e.needle_id, crc=e.crc,
+                                     size=e.size)
+            return resp
+        ev = self.store.find_ec_volume(vid)
+        if ev is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"volume {vid} not found")
+        # a fresh syndrome sweep caches fold-combined shard CRCs
+        # (invalidated on any shard mount/unmount/delete/rebuild);
+        # compute directly when none is cached
+        cached = self.srv.scrubber.cached_ec_digest(vid)
+        shard_crcs = cached or scrub_digest.ec_shard_crcs(ev)
+        resp = scrub_pb2.VolumeDigestResponse(volume_id=vid, is_ec=True)
+        for sc in shard_crcs.values():
+            resp.shard_digests.add(shard_id=sc.shard_id, crc=sc.crc,
+                                   size=sc.size)
+        return resp
+
+    def VolumeScrub(self, request, context):
+        """On-demand scrub: sweep one volume (or all) now, optionally
+        escalating findings into repair (the shell's `volume.scrub`)."""
+        report = self.srv.scrubber.run_once(
+            vid=request.volume_id or None, full=request.full,
+            repair=request.repair)
+        resp = scrub_pb2.VolumeScrubResponse(
+            volumes_scrubbed=report.volumes,
+            needles_checked=report.needles,
+            bytes_verified=report.bytes,
+            repaired=report.repaired)
+        for f in report.findings:
+            resp.findings.add(
+                volume_id=f.volume_id, kind=f.kind, needle_id=f.needle_id,
+                shard_id=max(f.shard_id, 0), detail=f.detail,
+                state=f.state, found_at_unix=f.found_at)
+        return resp
+
+    def ScrubStatus(self, request, context):
+        sc = self.srv.scrubber
+        st = sc.status()  # one locked snapshot feeds the whole response
+        resp = scrub_pb2.ScrubStatusResponse(
+            sweeps_completed=sc.sweeps_completed,
+            running=sc.running,
+            last_sweep_unix=sc.last_sweep_unix,
+            suspect_backlog=st["suspectBacklog"])
+        for c in st["cursors"]:
+            resp.cursors.add(volume_id=c["volumeId"],
+                             offset=max(c["offset"], 0),
+                             volume_size=0, sweeps=c["sweeps"])
+        for f in sc.snapshot_findings():
+            resp.findings.add(
+                volume_id=f.volume_id, kind=f.kind, needle_id=f.needle_id,
+                shard_id=max(f.shard_id, 0), detail=f.detail,
+                state=f.state, found_at_unix=f.found_at)
+        return resp
 
     # ---- needle metadata / status (volume_server.proto:289-301,596-607) --
 
@@ -1291,6 +1515,7 @@ def _make_http_handler(srv: VolumeServer):
                 from ..utils.stats import (
                     ec_dispatch_stats,
                     group_commit_stats,
+                    scrub_stats,
                 )
 
                 plane = srv.native_plane
@@ -1306,6 +1531,10 @@ def _make_http_handler(srv: VolumeServer):
                     # EC dispatch plane (ISSUE 3): stacked-dispatch batch
                     # factors + reconstructed-interval cache ratios
                     "EcDispatch": ec_dispatch_stats(),
+                    # integrity plane (ISSUE 4): sweep cursors, findings
+                    # lifecycle, repair outcomes, pacing
+                    "Scrub": {**srv.scrubber.status(),
+                              "counters": scrub_stats()},
                 })
             if u.path == "/metrics":
                 return self._reply(200, gather().encode(),
@@ -1317,6 +1546,7 @@ def _make_http_handler(srv: VolumeServer):
 
                 return self._reply(200, volume_ui(srv),
                                    "text/html; charset=utf-8")
+            srv._fg_rate.note()  # scrub pacing backs off on this rate
             with VOLUME_SERVER_REQUEST_HISTOGRAM.time(type="read"):
                 self._serve_needle(u)
 
@@ -1386,6 +1616,7 @@ def _make_http_handler(srv: VolumeServer):
         # -- PUT/POST (volume_server_handlers_write.go:18)
 
         def do_PUT(self):
+            srv._fg_rate.note()
             with VOLUME_SERVER_REQUEST_HISTOGRAM.time(type="write"):
                 self._handle_write()
 

@@ -3,11 +3,70 @@
 The reference uses Go's hash/crc32 Castagnoli table
 (/root/reference/weed/storage/needle/crc.go:12) for every needle's data
 checksum. google_crc32c provides the same polynomial (0x1EDC6F41,
-hardware-accelerated); a small table fallback keeps the package importable
-without it.
+hardware-accelerated); the native C++ kernel is the second choice, and a
+pure-python slice-by-8 implementation keeps whole-volume scrub sweeps
+usable even in stripped (crcmod-less, FUSE-less) containers — the old
+byte-at-a-time table fallback made a background scrubber effectively
+unable to keep up with even one volume.
+
+Also here: `crc32c_combine`, the zlib-style GF(2) matrix combine that
+merges CRCs of independently-checksummed chunks (crc(A||B) from crc(A),
+crc(B), len(B)). The scrub plane's in-order syndrome sweep chains slab
+CRCs with plain `crc32c(data, prev)` (cheaper); combine is the tool for
+out-of-order or parallel verification folds (scrub/digest.py
+`ec_shard_crcs(slab_crcs=...)`).
 """
 
 from __future__ import annotations
+
+_POLY = 0x82F63B78  # reversed 0x1EDC6F41 (Castagnoli)
+
+
+def _make_tables(n: int = 8) -> list[list[int]]:
+    """Slice-by-N lookup tables. t[0] is the classic byte table; t[k]
+    advances a byte seen k positions earlier through k extra zero bytes."""
+    t0 = []
+    for i in range(256):
+        c = i
+        for _ in range(8):
+            c = (c >> 1) ^ _POLY if c & 1 else c >> 1
+        t0.append(c)
+    tables = [t0]
+    for _ in range(1, n):
+        prev = tables[-1]
+        tables.append([t0[v & 0xFF] ^ (v >> 8) for v in prev])
+    return tables
+
+
+_T = _make_tables()
+
+
+def crc32c_py(data: bytes, value: int = 0) -> int:
+    """Pure-python slice-by-8 CRC32C (incremental: pass the previous value
+    to extend, exactly like google_crc32c.extend)."""
+    t0, t1, t2, t3, t4, t5, t6, t7 = _T
+    crc = (value & 0xFFFFFFFF) ^ 0xFFFFFFFF
+    b = bytes(data)
+    n = len(b)
+    i = 0
+    # 8 bytes per iteration: fold the current CRC into the first word
+    end8 = n - (n % 8)
+    while i < end8:
+        w = int.from_bytes(b[i:i + 8], "little") ^ crc
+        crc = (t7[w & 0xFF]
+               ^ t6[(w >> 8) & 0xFF]
+               ^ t5[(w >> 16) & 0xFF]
+               ^ t4[(w >> 24) & 0xFF]
+               ^ t3[(w >> 32) & 0xFF]
+               ^ t2[(w >> 40) & 0xFF]
+               ^ t1[(w >> 48) & 0xFF]
+               ^ t0[(w >> 56) & 0xFF])
+        i += 8
+    while i < n:
+        crc = t0[(crc ^ b[i]) & 0xFF] ^ (crc >> 8)
+        i += 1
+    return crc ^ 0xFFFFFFFF
+
 
 try:
     import google_crc32c
@@ -23,24 +82,54 @@ except ImportError:
             return crc32c_native(data, value)
 
     except Exception:  # pragma: no cover - fallback for stripped environments
-        _POLY = 0x82F63B78  # reversed 0x1EDC6F41
+        crc32c = crc32c_py
 
-        def _make_table() -> list[int]:
-            table = []
-            for i in range(256):
-                c = i
-                for _ in range(8):
-                    c = (c >> 1) ^ _POLY if c & 1 else c >> 1
-                table.append(c)
-            return table
 
-        _TABLE = _make_table()
+# -- combine (zlib crc32_combine ported to the Castagnoli polynomial) -------
 
-        def crc32c(data: bytes, value: int = 0) -> int:
-            c = value ^ 0xFFFFFFFF
-            for b in bytes(data):
-                c = _TABLE[(c ^ b) & 0xFF] ^ (c >> 8)
-            return c ^ 0xFFFFFFFF
+def _gf2_matrix_times(mat: list[int], vec: int) -> int:
+    out = 0
+    i = 0
+    while vec:
+        if vec & 1:
+            out ^= mat[i]
+        vec >>= 1
+        i += 1
+    return out
+
+
+def _gf2_matrix_square(mat: list[int]) -> list[int]:
+    return [_gf2_matrix_times(mat, mat[n]) for n in range(32)]
+
+
+def crc32c_combine(crc1: int, crc2: int, len2: int) -> int:
+    """crc(A || B) from crc1=crc(A), crc2=crc(B), len2=len(B).
+
+    Lets the scrubber checksum slabs independently (even out of order)
+    and fold them into a whole-file digest in O(32^2 log len2) — no
+    re-read. Identity: combine(c, crc(b""), 0) == c."""
+    if len2 <= 0:
+        return crc1 & 0xFFFFFFFF
+    # operator matrix for one zero bit
+    odd = [_POLY] + [1 << (n - 1) for n in range(1, 32)]
+    even = _gf2_matrix_square(odd)   # two zero bits
+    odd = _gf2_matrix_square(even)   # four zero bits
+    crc1 &= 0xFFFFFFFF
+    while True:
+        # apply len2 zero BYTES to crc1, squaring through each bit of len2
+        even = _gf2_matrix_square(odd)
+        if len2 & 1:
+            crc1 = _gf2_matrix_times(even, crc1)
+        len2 >>= 1
+        if not len2:
+            break
+        odd = _gf2_matrix_square(even)
+        if len2 & 1:
+            crc1 = _gf2_matrix_times(odd, crc1)
+        len2 >>= 1
+        if not len2:
+            break
+    return (crc1 ^ crc2) & 0xFFFFFFFF
 
 
 def crc_value_legacy(crc: int) -> int:

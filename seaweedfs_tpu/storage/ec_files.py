@@ -125,6 +125,20 @@ class _ShardWriters:
             if not self._errors:  # fail fast but keep draining queues
                 t0 = time.perf_counter()
                 try:
+                    from ..utils import failpoint
+
+                    if failpoint.is_armed("ec.shard.write.corrupt"):
+                        # chaos hook (scrub plane): flip the first byte of
+                        # a targeted shard's slab as it lands on disk —
+                        # simulated shard bit rot the EC syndrome sweep
+                        # must find (ctx comma-terminates the id so
+                        # @shard=1, can't substring-hit shard 10)
+                        raw = bytes(memoryview(arr)[:nbytes])
+                        out = failpoint.corrupt(
+                            "ec.shard.write.corrupt", raw,
+                            ctx=f"shard={shard_id},")
+                        if out is not raw:
+                            arr = memoryview(out)
                     self._files[shard_id].write(memoryview(arr)[:nbytes])
                 except BaseException as e:
                     self._errors.append(e)

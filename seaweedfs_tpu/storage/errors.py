@@ -12,3 +12,15 @@ class DeletedError(KeyError):
 
 class CookieMismatch(ValueError):
     """Request cookie does not match the stored needle's cookie."""
+
+
+class QuarantinedError(IOError):
+    """The needle is quarantined by the scrub plane: its on-disk bytes
+    failed verification and a repair is in flight. Serving layers must
+    answer from a healthy replica, never from the local record."""
+
+    def __init__(self, vid: int, needle_id: int):
+        self.volume_id = vid
+        self.needle_id = needle_id
+        super().__init__(
+            f"needle {needle_id:x} of volume {vid} is quarantined for repair")

@@ -265,16 +265,23 @@ class Store:
 
     def mount_ec_shards(self, vid: int, collection: str, shard_ids: list[int]) -> None:
         """Open (or re-open) the EC volume after new shard files arrived
-        (store_ec.go:25 MountEcShards)."""
+        (store_ec.go:25 MountEcShards).
+
+        The OLD runtime is NOT closed here: in-flight readers (degraded
+        reads, the scrub sweep) may still hold it, and closing its mmaps
+        under them turns a routine remount — including the scrub plane's
+        rebuild-then-remount repair — into client-visible 500s. Dropping
+        the reference is enough: refcounting closes the mmaps the moment
+        the last reader returns."""
         with self._lock:
             for loc in self.locations:
                 base = loc.base_name(collection, vid)
                 if os.path.exists(base + ".ecx"):
-                    old = loc.ec_volumes.pop(vid, None)
-                    if old is not None:
-                        old.close()
                     ev = EcVolume(base, self.coder)
                     ev.collection = collection
+                    # single dict assignment: concurrent readers see the
+                    # old runtime or the new one, never a gap (a pop
+                    # first would 404 reads racing a remount)
                     loc.ec_volumes[vid] = ev
                     return
             raise NotFoundError(f"no .ecx for EC volume {vid}")
@@ -285,7 +292,9 @@ class Store:
                 ev = loc.ec_volumes.get(vid)
                 if ev is None:
                     continue
-                ev.close()
+                # teardown deferred to GC, as in mount_ec_shards: reads
+                # already past find_ec_volume() complete against the old
+                # runtime instead of crashing on a closed mmap
                 del loc.ec_volumes[vid]
                 return
 

@@ -24,7 +24,12 @@ from ..utils.stats import (
     VOLUME_GROUP_COMMIT_FLUSHES,
     VOLUME_GROUP_COMMIT_WRITES,
 )
-from .errors import CookieMismatch, DeletedError, NotFoundError
+from .errors import (
+    CookieMismatch,
+    DeletedError,
+    NotFoundError,
+    QuarantinedError,
+)
 from .needle import Needle, needle_body_length
 from .super_block import SuperBlock
 from .ttl import EMPTY_TTL
@@ -135,6 +140,12 @@ class NeedleMap:
         self.file_byte_counter = 0
         self.deletion_counter = 0
         self.deletion_byte_counter = 0
+        # ids whose LATEST idx entry is a tombstone. The live map pops
+        # deleted keys, but anti-entropy (scrub/digest.py) must tell
+        # "deleted here" apart from "never written here" — without this a
+        # replica that processed a delete gets the needle resurrected by
+        # the replica that missed it.
+        self.tombstones: set[int] = set()
         # 1MB buffer (64Ki entries): with auto_flush deferred to group
         # commit, a FULL stdio buffer would auto-drain idx entries to
         # the OS independent of the leader's dat-then-idx flush order.
@@ -166,12 +177,14 @@ class NeedleMap:
         if off != 0 and size >= 0:
             old = self._m.get(key)
             self._m[key] = NeedleValue(off, size)
+            self.tombstones.discard(key)
             self.file_byte_counter += size
             if old is not None and old.offset != 0 and old.size >= 0:
                 self.deletion_counter += 1
                 self.deletion_byte_counter += old.size
         else:
             old = self._m.pop(key, None)
+            self.tombstones.add(key)
             self.deletion_counter += 1
             if old is not None:
                 self.deletion_byte_counter += max(old.size, 0)
@@ -210,6 +223,7 @@ class NeedleMap:
     def put(self, key: int, stored_offset: int, size: int) -> None:
         old = self._m.get(key)
         self._m[key] = NeedleValue(stored_offset, size)
+        self.tombstones.discard(key)
         self.max_file_key = max(self.max_file_key, key)
         self.file_counter += 1
         self.file_byte_counter += max(size, 0)
@@ -223,6 +237,7 @@ class NeedleMap:
 
     def delete(self, key: int, stored_offset: int) -> int:
         old = self._m.pop(key, None)
+        self.tombstones.add(key)
         deleted = old.size if old is not None and old.size >= 0 else 0
         self.deletion_counter += 1
         self.deletion_byte_counter += deleted
@@ -291,6 +306,10 @@ class Volume:
         self.last_modified_ts_seconds = 0
         self.is_compacting = False
         self._lock = threading.RLock()
+        # scrub plane: needle ids whose on-disk record failed verification
+        # and is being repaired — read_needle refuses them (the server
+        # layer answers from a healthy replica instead of corrupt bytes)
+        self.quarantined: set[int] = set()
         # group commit (ISSUE 2): appends are buffered and a leader
         # writer flushes dat-then-idx ONCE for every write registered so
         # far; concurrent writers share one flush instead of paying one
@@ -656,6 +675,23 @@ class Volume:
             offset += types.NEEDLE_PADDING_SIZE - (offset % types.NEEDLE_PADDING_SIZE)
             self._dat.seek(offset)
         blob = n.to_bytes(self.version)  # also computes n.size
+        from ..utils import failpoint
+
+        if failpoint.is_armed("volume.dat.write.corrupt") \
+                and len(n.data) > 0:
+            # chaos hook (scrub plane): flip the first DATA byte of the
+            # record as it lands on disk — the stored CRC (computed from
+            # the good bytes) no longer matches, i.e. simulated bit rot
+            # the background scrubber must find. Data starts after the
+            # 16B header + 4B dataSize for v2/v3 (v1 has no dataSize).
+            doff = types.NEEDLE_HEADER_SIZE + (
+                0 if self.version == types.VERSION1 else 4)
+            tail = bytes(blob[doff:])
+            out = failpoint.corrupt(
+                "volume.dat.write.corrupt", tail,
+                ctx=f"vol={self.id}, {self.dir},")
+            if out is not tail:
+                blob = blob[:doff] + out
         if offset + len(blob) > types.MAX_POSSIBLE_VOLUME_SIZE:
             # past 32GB the 4-byte stored offset would wrap -> corruption
             raise IOError(
@@ -737,9 +773,21 @@ class Volume:
 
     # -- read path ---------------------------------------------------------
 
+    # -- scrub quarantine --------------------------------------------------
+
+    def quarantine(self, needle_id: int) -> None:
+        """Refuse to serve this needle's local bytes until unquarantined
+        (scrub found the record corrupt; repair is in flight)."""
+        self.quarantined.add(needle_id)
+
+    def unquarantine(self, needle_id: int) -> None:
+        self.quarantined.discard(needle_id)
+
     def read_needle(self, needle_id: int, cookie: int | None = None) -> Needle:
         """readNeedle (volume_read.go:19-72): map lookup, record read, CRC,
         cookie + TTL checks."""
+        if self.quarantined and needle_id in self.quarantined:
+            raise QuarantinedError(self.id, needle_id)
         if self.native is not None:
             blob = self.native.read_blob(self.id, needle_id)
             if blob is None:

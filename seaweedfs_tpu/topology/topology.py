@@ -75,6 +75,9 @@ class DataNode:
         self.ec_shards: dict[int, "EcShardInfo"] = {}  # vid -> bits
         self.last_seen = time.time()
         self.max_file_key = 0
+        # integrity plane: when the master last asked this node to run a
+        # scrub pass (next_scrub_targets round-robins on it)
+        self.last_scrub = 0.0
 
     @property
     def url(self) -> str:
@@ -372,6 +375,26 @@ class Topology:
             for sid, urls in self.ec_shard_map.get(vid, {}).items():
                 out[sid] = [self.nodes[u] for u in urls if u in self.nodes]
             return out
+
+    # -- scrub scheduling (ISSUE 4) ----------------------------------------
+
+    def next_scrub_targets(self, max_nodes: int = 1,
+                           min_spacing_s: float = 0.0) -> list[DataNode]:
+        """Pick the alive nodes whose last master-driven scrub pass is
+        oldest (round-robin over the fleet: one node per master tick, so
+        a large cluster never scrubs everywhere at once). Nodes scrubbed
+        within `min_spacing_s` are skipped — the hook the master's
+        periodic driver uses to spread a full-fleet pass across its
+        interval instead of front-loading it."""
+        with self._lock:
+            now = time.time()
+            due = [n for n in self.alive_nodes()
+                   if now - n.last_scrub >= min_spacing_s]
+            due.sort(key=lambda n: (n.last_scrub, n.url))
+            picked = due[:max(0, max_nodes)]
+            for n in picked:
+                n.last_scrub = now
+            return picked
 
     # -- assignment --------------------------------------------------------
 

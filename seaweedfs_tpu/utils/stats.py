@@ -216,6 +216,60 @@ EC_RECON_CACHE_COUNTER = Counter(
     "(hit/miss/put/invalidate/evict).")
 
 
+# -- continuous integrity plane (ISSUE 4): the background scrubber, the
+#    digest/anti-entropy comparisons, and the self-healing repair ladder ---
+
+SCRUB_BYTES = Counter(
+    "SeaweedFS_scrub_bytes",
+    "Bytes verified by the scrub plane by sweep kind "
+    "(needle/ec_syndrome/digest).")
+SCRUB_NEEDLES = Counter(
+    "SeaweedFS_scrub_needles_checked",
+    "Needle records CRC-verified by the background scrubber.")
+SCRUB_SWEEPS = Counter(
+    "SeaweedFS_scrub_sweeps",
+    "Completed scrub sweeps by kind (volume/ec).")
+SCRUB_FINDINGS = Counter(
+    "SeaweedFS_scrub_findings",
+    "Integrity findings by kind (needle_crc/ec_parity/replica_divergence) "
+    "and state transition (found/repaired/failed/cleared).")
+SCRUB_REPAIRS = Counter(
+    "SeaweedFS_scrub_repairs",
+    "Repair escalations by method (re_replicate/ec_rebuild/anti_entropy) "
+    "and outcome (ok/failed).")
+SCRUB_PACE_WAIT_SECONDS = Counter(
+    "SeaweedFS_scrub_pace_wait_seconds",
+    "Cumulative seconds the scrubber slept in the SWFS_SCRUB_MAX_MBPS "
+    "token bucket.")
+SCRUB_BACKOFFS = Counter(
+    "SeaweedFS_scrub_backoffs",
+    "Times the scrubber backed off because foreground QPS was high.")
+
+
+def scrub_stats() -> dict:
+    """Snapshot for /status pages: find->repair->clean lifecycle counters."""
+    out = {
+        "bytesVerified": {
+            k: int(SCRUB_BYTES.value(kind=k))
+            for k in ("needle", "ec_syndrome", "digest")},
+        "needlesChecked": int(SCRUB_NEEDLES.value()),
+        "sweeps": {k: int(SCRUB_SWEEPS.value(kind=k))
+                   for k in ("volume", "ec")},
+        "findings": {}, "repairs": {},
+        "paceWaitSeconds": round(SCRUB_PACE_WAIT_SECONDS.value(), 3),
+        "backoffs": int(SCRUB_BACKOFFS.value()),
+    }
+    for kind in ("needle_crc", "ec_parity", "replica_divergence"):
+        out["findings"][kind] = {
+            s: int(SCRUB_FINDINGS.value(kind=kind, state=s))
+            for s in ("found", "repaired", "failed")}
+    for method in ("re_replicate", "ec_rebuild", "anti_entropy"):
+        out["repairs"][method] = {
+            o: int(SCRUB_REPAIRS.value(method=method, outcome=o))
+            for o in ("ok", "failed")}
+    return out
+
+
 def ec_dispatch_stats() -> dict:
     """Snapshot for /status pages: per-lane batch factor + cache ratios."""
     out: dict = {}

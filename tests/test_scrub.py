@@ -1,0 +1,564 @@
+"""Continuous integrity plane (ISSUE 4): scrub correctness invariants.
+
+- slice-by-8 CRC32C fallback + crc32c_combine (scrub's chunked API)
+- digest manifest format pinned by a golden; tombstones in the digest
+- clean volumes yield ZERO findings bit-identically across the
+  rs_cpu / rs_jax / rs_native coder backends
+- the scrub cursor resumes mid-volume across a server restart
+- quarantined needles never serve their (corrupt) local bytes
+- the EC syndrome sweep pins the culprit shard and the rebuild repair
+  converges (parity and data shard cases)
+- cluster plane: VolumeDigest RPC, digest-riding volume.check.disk
+  (incl. EC coverage), volume.scrub shell command, master scheduling
+"""
+
+import io
+import os
+import socket
+import time
+
+import numpy as np
+import pytest
+import requests
+
+from seaweedfs_tpu.models.coder import new_coder
+from seaweedfs_tpu.pb import rpc, scrub_pb2
+from seaweedfs_tpu.pb import volume_server_pb2 as vs
+from seaweedfs_tpu.scrub import digest as digest_mod
+from seaweedfs_tpu.scrub.scrubber import Scrubber, TokenBucket
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+from seaweedfs_tpu.storage import types
+from seaweedfs_tpu.storage.crc import (
+    crc32c,
+    crc32c_combine,
+    crc32c_py,
+)
+from seaweedfs_tpu.storage.ec_files import (
+    write_ec_files,
+    write_sorted_file_from_idx,
+)
+from seaweedfs_tpu.storage.ec_locate import Geometry
+from seaweedfs_tpu.storage.ec_volume import save_volume_info
+from seaweedfs_tpu.storage.errors import QuarantinedError
+from seaweedfs_tpu.storage.file_id import parse_file_id
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.store import Store
+
+TEST_GEO = Geometry(large_block=10000, small_block=100)
+
+
+# -- crc fallback (satellite: slice-by-8 + combine) -------------------------
+
+def test_crc32c_py_known_vector_and_parity_with_active():
+    # the canonical CRC32C check vector (RFC 3720 appendix B.4)
+    assert crc32c_py(b"123456789") == 0xE3069283
+    rng = np.random.default_rng(7)
+    for size in (0, 1, 7, 8, 9, 63, 64, 65, 1000):
+        blob = rng.integers(0, 256, size=size, dtype=np.uint8).tobytes()
+        assert crc32c_py(blob) == crc32c(blob)
+
+
+def test_crc32c_py_incremental_extend():
+    a, b = b"hello, ", b"integrity plane"
+    assert crc32c_py(b, crc32c_py(a)) == crc32c_py(a + b)
+
+
+def test_crc32c_combine():
+    rng = np.random.default_rng(11)
+    for la, lb in ((0, 5), (5, 0), (1, 1), (100, 3), (3, 1000), (517, 517)):
+        a = rng.integers(0, 256, size=la, dtype=np.uint8).tobytes()
+        b = rng.integers(0, 256, size=lb, dtype=np.uint8).tobytes()
+        assert crc32c_combine(crc32c(a), crc32c(b), lb) == crc32c(a + b)
+    # identity: appending nothing changes nothing
+    assert crc32c_combine(0x1234ABCD, crc32c(b""), 0) == 0x1234ABCD
+
+
+def test_combine_folds_chunked_shard_digest():
+    """The EC sweep checksums slabs independently and folds them into a
+    whole-shard digest — prove the fold equals a straight pass."""
+    rng = np.random.default_rng(13)
+    blob = rng.integers(0, 256, size=10_000, dtype=np.uint8).tobytes()
+    folded = 0
+    for off in range(0, len(blob), 1337):
+        chunk = blob[off:off + 1337]
+        folded = crc32c_combine(folded, crc32c(chunk), len(chunk))
+    assert folded == crc32c(blob)
+
+
+# -- digest manifests -------------------------------------------------------
+
+def test_digest_manifest_format_golden():
+    """The on-disk manifest format is an anti-entropy wire contract —
+    pin it byte-for-byte so a silent format change cannot make every
+    replica pair look divergent (or worse, identical)."""
+    entries = [
+        digest_mod.DigestEntry(1, 0x11223344, 100),
+        digest_mod.DigestEntry(0xDEADBEEF, 0x55667788, 2049),
+        digest_mod.DigestEntry(0x1_0000_0001, 0, -1),  # tombstone
+    ]
+    blob = digest_mod.manifest_bytes(entries)
+    assert blob.hex() == (
+        "535746534447310a"              # magic "SWFSDG1\n"
+        "0000000000000003"              # count
+        "00000000000000011122334400000064"
+        "00000000deadbeef5566778800000801"
+        "00000001000000010000000" "0ffffffff")
+    # rolling digest covers LIVE entries only: deletion history may
+    # differ between converged replicas (vacuum, delete of a never-held
+    # id), so tombstones stay in the manifest for resurrection-prevention
+    # but out of the cheap equality check
+    live = blob[16:16 + 2 * digest_mod.ENTRY_SIZE]
+    assert digest_mod.rolling_digest(entries) == crc32c(live)
+    assert digest_mod.rolling_digest([]) == 0
+    assert digest_mod.rolling_digest(
+        [digest_mod.DigestEntry(7, 0, -1)]) == 0  # tombstone-only == empty
+
+
+def test_digest_manifest_roundtrip(tmp_path):
+    entries = [digest_mod.DigestEntry(5, 42, 17),
+               digest_mod.DigestEntry(9, 0, -1)]
+    path = digest_mod.write_manifest(str(tmp_path / "v"), entries)
+    assert path.endswith(".dig")
+    assert digest_mod.read_manifest(path) == entries
+
+
+def test_volume_digest_entries_and_tombstones(tmp_path):
+    st = Store([str(tmp_path)])
+    v = st.add_volume(1)
+    v.write_needle(Needle.create(1, 0xA, b"one"))
+    v.write_needle(Needle.create(2, 0xB, b"two"))
+    v.delete_needle(2)
+    entries = digest_mod.volume_digest_entries(v)
+    by_id = {e.needle_id: e for e in entries}
+    assert by_id[1].size > 0
+    assert by_id[1].crc == crc32c(b"one")
+    assert by_id[2].size == digest_mod.TOMBSTONE_SIZE
+    st.close()
+
+
+def test_diff_entries():
+    a = [digest_mod.DigestEntry(1, 10, 5), digest_mod.DigestEntry(2, 20, 5)]
+    b = [digest_mod.DigestEntry(2, 21, 5), digest_mod.DigestEntry(3, 30, 5)]
+    only_a, only_b, diff = digest_mod.diff_entries(a, b)
+    assert [e.needle_id for e in only_a] == [1]
+    assert [e.needle_id for e in only_b] == [3]
+    assert [(m.needle_id, t.crc) for m, t in diff] == [(2, 21)]
+
+
+# -- token bucket -----------------------------------------------------------
+
+def test_token_bucket_paces():
+    tb = TokenBucket(1_000_000)  # 1 MB/s, 1s burst
+    assert tb.take(100_000) == 0.0  # rides the initial burst
+    t0 = time.monotonic()
+    tb.take(1_000_000)  # deficit: must sleep ~0.1s+
+    assert time.monotonic() - t0 > 0.02
+    assert TokenBucket(0).take(1 << 30) == 0.0  # unpaced
+
+
+# -- sweep invariants (no cluster) ------------------------------------------
+
+def _fill_volume(st, vid, n_needles=20, seed=0):
+    v = st.add_volume(vid)
+    rng = np.random.default_rng(seed)
+    blobs = {}
+    for i in range(1, n_needles + 1):
+        data = rng.integers(0, 256, size=int(rng.integers(100, 900)),
+                            dtype=np.uint8).tobytes()
+        v.write_needle(Needle.create(i, 0xABC, data))
+        blobs[i] = data
+    return v, blobs
+
+
+def _make_ec(st, v, geo=TEST_GEO):
+    base = v.file_name()
+    with v._lock:
+        v._sync_buffers()
+    write_ec_files(base, st.coder, geo)
+    write_sorted_file_from_idx(base)
+    save_volume_info(base, {
+        "version": v.version, "dataShards": geo.data_shards,
+        "parityShards": geo.parity_shards, "largeBlock": geo.large_block,
+        "smallBlock": geo.small_block})
+    st.unmount_volume(v.id)
+    st.mount_ec_shards(v.id, "", list(range(geo.total_shards)))
+    return base
+
+
+@pytest.mark.parametrize("backend", ["cpu", "single", "native"])
+def test_clean_volumes_zero_findings_across_backends(tmp_path, backend):
+    """Syndrome checks are bit-identical: a clean volume + clean EC
+    volume produce ZERO findings whichever coder backend re-encodes the
+    parity (a single false positive would make continuous scrubbing
+    untenable)."""
+    try:
+        coder = new_coder(TEST_GEO.data_shards, TEST_GEO.parity_shards,
+                          backend=backend)
+    except Exception as e:  # pragma: no cover - stripped container
+        pytest.skip(f"backend {backend} unavailable: {e}")
+    st = Store([str(tmp_path)], coder=coder)
+    v, _ = _fill_volume(st, 1, seed=3)
+    v2, _ = _fill_volume(st, 2, seed=4)
+    _make_ec(st, v2)
+    sc = Scrubber(st, None, interval_s=0, max_mbps=0)
+    report = sc.run_once()
+    assert report.volumes == 2
+    assert report.needles == 20
+    assert report.findings == [], [f.detail for f in report.findings]
+    st.close()
+
+
+def test_cursor_resumes_mid_volume_across_restart(tmp_path):
+    st = Store([str(tmp_path)])
+    v, _ = _fill_volume(st, 1, n_needles=40, seed=5)
+    with v._lock:
+        v._sync_buffers()
+    dat_size = v.data_size()
+    base = v.file_name()
+    sc = Scrubber(st, None, interval_s=0, max_mbps=0)
+    sc.pass_budget = dat_size // 3  # bounded pass stops mid-volume
+    r1 = sc.run_once()
+    assert 0 < r1.needles < 40
+    cur_path = base + ".scb"
+    assert os.path.exists(cur_path)
+    mid = sc._cursor_for(base).offset
+    assert v.super_block.block_size < mid < dat_size
+    st.close()
+
+    # restart: fresh Store + fresh Scrubber; position must come from disk
+    st2 = Store([str(tmp_path)])
+    sc2 = Scrubber(st2, None, interval_s=0, max_mbps=0)
+    r2 = sc2.run_once()
+    assert sc2._cursor_for(base).offset >= mid
+    assert r1.needles + r2.needles == 40  # no overlap, no gap
+    assert r2.findings == []
+    st2.close()
+
+
+def test_cursor_resets_after_compaction(tmp_path):
+    """A vacuum rewrites every offset — a stale cursor must reset, not
+    verify garbage mid-record."""
+    st = Store([str(tmp_path)])
+    v, _ = _fill_volume(st, 1, n_needles=10, seed=6)
+    sc = Scrubber(st, None, interval_s=0, max_mbps=0)
+    sc.run_once()
+    v.delete_needle(3)
+    v.compact()
+    v.commit_compact()
+    report = sc.run_once()  # revision bumped -> cursor resets, no findings
+    assert report.findings == []
+    assert report.needles == 9
+    st.close()
+
+
+def _corrupt_needle_on_disk(v, needle_id):
+    nv = v.nm.get(needle_id)
+    off = types.stored_to_actual_offset(nv.offset)
+    with v._lock:
+        v._sync_buffers()
+    with open(v.file_name() + ".dat", "r+b") as f:
+        f.seek(off + types.NEEDLE_HEADER_SIZE + 4)  # first data byte
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+
+
+def test_sweep_finds_corrupt_needle_and_quarantine_blocks_serving(tmp_path):
+    st = Store([str(tmp_path)])
+    v, blobs = _fill_volume(st, 1, n_needles=8, seed=7)
+    _corrupt_needle_on_disk(v, 5)
+    sc = Scrubber(st, None, interval_s=0, max_mbps=0)
+    report = sc.run_once(full=True)
+    assert [f.needle_id for f in report.findings] == [5]
+    assert report.findings[0].kind == "needle_crc"
+    # no replica to heal from: the finding stays, honestly failed
+    assert report.findings[0].state == "failed"
+
+    # quarantined needles never serve their local bytes mid-repair
+    v.quarantine(5)
+    with pytest.raises(QuarantinedError):
+        v.read_needle(5, 0xABC)
+    v.unquarantine(5)
+    st.close()
+
+
+def test_header_rot_neither_stalls_sweep_nor_hides(tmp_path):
+    """A rotten record HEADER (bogus size field) must not stall the
+    sweep: the walk is needle-map-driven, so every other needle is still
+    verified and the rotten one surfaces as a finding (a record-chained
+    walk would silently stop at the bad size and never scrub past it)."""
+    st = Store([str(tmp_path)])
+    v, _ = _fill_volume(st, 1, n_needles=10, seed=12)
+    nv = v.nm.get(4)
+    off = types.stored_to_actual_offset(nv.offset)
+    with v._lock:
+        v._sync_buffers()
+    with open(v.file_name() + ".dat", "r+b") as f:
+        f.seek(off + 12)  # the header's 4-byte size field
+        f.write((nv.size + 7777).to_bytes(4, "big"))
+    sc = Scrubber(st, None, interval_s=0, max_mbps=0)
+    report = sc.run_once(full=True)
+    assert report.needles == 10  # needles AFTER the rot still verified
+    assert [f.needle_id for f in report.findings] == [4]
+    st.close()
+
+
+def test_sweep_skips_superseded_and_deleted_records(tmp_path):
+    """Only LIVE records are verified: a corrupt superseded record (its
+    id was rewritten later) and tombstones must not produce findings."""
+    st = Store([str(tmp_path)])
+    v, _ = _fill_volume(st, 1, n_needles=6, seed=8)
+    _corrupt_needle_on_disk(v, 2)
+    # supersede the corrupt record: nm now points at the new offset
+    v.write_needle(Needle.create(2, 0xABC, b"fresh bytes"))
+    v.delete_needle(4)
+    sc = Scrubber(st, None, interval_s=0, max_mbps=0)
+    report = sc.run_once(full=True)
+    assert report.findings == [], [f.detail for f in report.findings]
+    st.close()
+
+
+@pytest.mark.parametrize("bad_shard", [3, 12])  # a data and a parity shard
+def test_ec_syndrome_pins_culprit_and_rebuild_converges(tmp_path, bad_shard):
+    st = Store([str(tmp_path)])
+    v, blobs = _fill_volume(st, 2, seed=9)
+    base = _make_ec(st, v)
+    with open(TEST_GEO.shard_file_name(base, bad_shard), "r+b") as f:
+        f.seek(41)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0x5A]))
+    sc = Scrubber(st, None, interval_s=0, max_mbps=0)
+    report = sc.run_once(full=True)
+    culprits = [(f.shard_id, f.state) for f in report.findings
+                if f.kind == "ec_parity"]
+    assert (bad_shard, "repaired") in culprits, report.findings
+    # the rebuilt shard serves the original content
+    ev = st.find_ec_volume(2)
+    for i, data in blobs.items():
+        n = Needle.from_bytes(ev.read_needle_blob(i), ev.version)
+        assert n.data == data
+    # and a fresh full sweep is clean — find -> repair -> clean converged
+    r2 = sc.run_once(full=True)
+    assert r2.findings == [], [f.detail for f in r2.findings]
+    st.close()
+
+
+def test_scrub_runs_through_dispatch_scheduler(tmp_path):
+    """EC syndrome recompute slabs must ride the shared encode lane of
+    the EC dispatch scheduler (that's what lets scrub coalesce with
+    foreground encodes into stacked device dispatches)."""
+    from seaweedfs_tpu.utils import stats
+
+    st = Store([str(tmp_path)])
+    v, _ = _fill_volume(st, 2, seed=10)
+    _make_ec(st, v)
+    before = stats.EC_DISPATCH_SLABS.value(lane="encode")
+    sc = Scrubber(st, None, interval_s=0, max_mbps=0)
+    sc.run_once(full=True)
+    assert stats.EC_DISPATCH_SLABS.value(lane="encode") > before
+    st.close()
+
+
+# -- cluster plane: RPCs, shell, master scheduling --------------------------
+
+def _free_port() -> int:
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        if port + 10000 > 65535:
+            continue
+        with socket.socket() as s2:
+            try:
+                s2.bind(("", port + 10000))
+            except OSError:
+                continue
+        return port
+    raise RuntimeError("no free port pair found")
+
+
+@pytest.fixture(scope="module")
+def scrub_cluster(tmp_path_factory):
+    """master + 2 volume servers, replication 001 volumes grown on use."""
+    old_native = os.environ.get("SEAWEEDFS_TPU_NATIVE")
+    os.environ["SEAWEEDFS_TPU_NATIVE"] = "0"
+    tmp = tmp_path_factory.mktemp("scrub")
+    master = MasterServer(ip="localhost", port=_free_port(),
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    volumes = []
+    for i in range(2):
+        vsrv = VolumeServer(
+            directories=[str(tmp / f"vol{i}")],
+            master=master.address, ip="localhost",
+            port=_free_port(), pulse_seconds=1, ec_geometry=TEST_GEO)
+        vsrv.start()
+        volumes.append(vsrv)
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topo.nodes) < 2:
+        time.sleep(0.05)
+    assert len(master.topo.nodes) == 2
+    yield master, volumes
+    for v in volumes:
+        v.stop()
+    master.stop()
+    rpc.reset_channels()
+    if old_native is None:
+        os.environ.pop("SEAWEEDFS_TPU_NATIVE", None)
+    else:
+        os.environ["SEAWEEDFS_TPU_NATIVE"] = old_native
+
+
+def _put_replicated(master, volumes, payload, attempts=8):
+    """-> fid whose bytes are provably on BOTH replicas."""
+    from seaweedfs_tpu.operation import assign
+
+    for _ in range(attempts):
+        a = assign(master.address, replication="001")
+        if a.error:
+            time.sleep(0.3)
+            continue
+        r = requests.put(f"http://{a.url}/{a.fid}", data=payload, timeout=30)
+        if r.status_code not in (200, 201):
+            time.sleep(0.3)
+            continue
+        vid = parse_file_id(a.fid).volume_id
+        deadline = time.time() + 8
+        while time.time() < deadline:
+            if all(v.store.has_volume(vid) and
+                   requests.get(f"http://{v.address}/{a.fid}",
+                                timeout=10).status_code == 200
+                   for v in volumes):
+                return a.fid
+            time.sleep(0.2)
+    raise AssertionError("payload never landed on both replicas")
+
+
+def test_volume_digest_rpc_agrees_across_replicas(scrub_cluster):
+    master, volumes = scrub_cluster
+    fid = _put_replicated(master, volumes, b"digest-me " * 500)
+    vid = parse_file_id(fid).volume_id
+    digests = []
+    for v in volumes:
+        stub = rpc.volume_stub(rpc.grpc_address(v.address))
+        d = stub.VolumeDigest(scrub_pb2.VolumeDigestRequest(volume_id=vid),
+                              timeout=30)
+        digests.append((d.rolling_crc, d.needle_count, d.tombstone_count))
+        assert d.needle_count >= 1
+    assert digests[0] == digests[1], "replicas diverge on a clean write"
+    # entries ship only on request
+    stub = rpc.volume_stub(rpc.grpc_address(volumes[0].address))
+    d = stub.VolumeDigest(scrub_pb2.VolumeDigestRequest(
+        volume_id=vid, include_entries=True), timeout=30)
+    assert len(d.entries) == d.needle_count + d.tombstone_count
+
+
+def test_quarantined_needle_served_from_replica(scrub_cluster):
+    """Mid-repair reads of a quarantined needle come from the healthy
+    replica — the client sees the right bytes, zero errors."""
+    master, volumes = scrub_cluster
+    payload = b"quarantine-serve " * 300
+    fid = _put_replicated(master, volumes, payload)
+    f = parse_file_id(fid)
+    vsrv = volumes[0]
+    v = vsrv.store.find_volume(f.volume_id)
+    assert v is not None
+    v.quarantine(f.key)
+    try:
+        got = requests.get(f"http://{vsrv.address}/{fid}", timeout=30)
+        assert got.status_code == 200
+        assert got.content == payload
+    finally:
+        v.unquarantine(f.key)
+
+
+def test_check_disk_rides_digests_and_names_needles(scrub_cluster):
+    """volume.check.disk compares digest manifests; a hand-made replica
+    divergence is reported with the diverging needle named."""
+    from seaweedfs_tpu.shell.commands import volume as _  # noqa: F401
+    from seaweedfs_tpu.shell.env import CommandEnv
+    from seaweedfs_tpu.shell.registry import run_command
+
+    master, volumes = scrub_cluster
+    fid = _put_replicated(master, volumes, b"check-disk " * 400)
+    env = CommandEnv(master.address)
+    out = io.StringIO()
+    assert run_command(env, "volume.check.disk", out) == 0
+    assert "0 integrity issue(s)" in out.getvalue(), out.getvalue()
+
+    # diverge one replica: rewrite the fid directly (no fan-out)
+    new_payload = b"CHECK-DISK " * 400
+    r = requests.put(f"http://{volumes[0].address}/{fid}?type=replicate",
+                     data=new_payload, timeout=30)
+    assert r.status_code in (200, 201)
+    out = io.StringIO()
+    assert run_command(env, "volume.check.disk", out) == 0
+    text = out.getvalue()
+    assert "replicas diverge" in text, text
+    assert f"needle {parse_file_id(fid).key:x}" in text, text
+
+    # heal through the scrub plane, then the check is clean again
+    volumes[0].scrubber.run_once(vid=parse_file_id(fid).volume_id)
+    out = io.StringIO()
+    run_command(env, "volume.check.disk", out)
+    assert "replicas diverge" not in out.getvalue(), out.getvalue()
+
+
+def test_volume_scrub_shell_command_and_status(scrub_cluster):
+    from seaweedfs_tpu.shell.commands import volume as _  # noqa: F401
+    from seaweedfs_tpu.shell.env import CommandEnv
+    from seaweedfs_tpu.shell.registry import run_command
+
+    master, volumes = scrub_cluster
+    _put_replicated(master, volumes, b"scrub-cmd " * 100)
+    env = CommandEnv(master.address)
+    out = io.StringIO()
+    assert run_command(
+        env, f"volume.scrub -node={volumes[0].address}", out) == 0
+    text = out.getvalue()
+    assert "scrubbed" in text and "0 finding(s)" in text, text
+    out = io.StringIO()
+    assert run_command(
+        env, f"volume.scrub -node={volumes[0].address} -status", out) == 0
+    assert "sweeps:" in out.getvalue()
+
+
+def test_master_scrub_scheduling_round_robins(scrub_cluster):
+    """The topology hook hands out the least-recently-scrubbed node;
+    master.scrub_once drives one self-healing pass on it."""
+    master, volumes = scrub_cluster
+    t0 = [dn.last_scrub for dn in master.topo.nodes.values()]
+    assert master.scrub_once() == 1
+    assert master.scrub_once() == 1
+    scrubbed = [dn.last_scrub for dn in master.topo.nodes.values()]
+    assert all(s > t for s, t in zip(scrubbed, t0))
+    # per-server scrubbers actually ran (sweep counters moved)
+    assert all(v.scrubber.sweeps_completed >= 1 for v in volumes)
+    # spacing guard: both nodes were just scrubbed
+    assert master.topo.next_scrub_targets(2, min_spacing_s=3600) == []
+    # the pause knob round-trips over the master RPC (incident control)
+    stub = rpc.master_stub(rpc.grpc_address(master.address))
+    stub.DisableScrub(scrub_pb2.DisableScrubRequest(), timeout=10)
+    assert master.scrub_disabled
+    stub.EnableScrub(scrub_pb2.EnableScrubRequest(), timeout=10)
+    assert not master.scrub_disabled
+
+
+def test_status_page_has_scrub_section(scrub_cluster):
+    master, volumes = scrub_cluster
+    st = requests.get(f"http://{volumes[0].address}/status",
+                      timeout=10).json()
+    assert "Scrub" in st
+    assert "counters" in st["Scrub"]
+    assert "findings" in st["Scrub"]["counters"]
+
+
+def test_scrub_metrics_exported(scrub_cluster):
+    master, volumes = scrub_cluster
+    volumes[0].scrubber.run_once()
+    text = requests.get(f"http://{volumes[0].address}/metrics",
+                        timeout=10).text
+    assert "SeaweedFS_scrub_bytes" in text
+    assert "SeaweedFS_scrub_findings" in text

@@ -171,7 +171,9 @@ def test_volume_balance_dry_run(cluster):
 def test_volume_check_disk(cluster):
     master, _ = cluster
     env = CommandEnv(master.address)
-    assert "diverging" in _sh(env, "volume.check.disk")
+    # digest-riding check (ISSUE 4): summary counts integrity issues
+    # (replica digest divergence + EC shard-copy divergence)
+    assert "integrity issue(s) found" in _sh(env, "volume.check.disk")
 
 
 def test_ec_encode_rack_aware_spread(tmp_path_factory):
