@@ -874,6 +874,245 @@ def _bench_scrub_ab() -> dict:
         return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
+# Multi-chip sharded dispatch A/B (ISSUE 5): same-box, interleaved, over
+# the FORCED 8-device host platform (the same virtual mesh tier-1 uses —
+# the real chip is never touched, so a wedged tunnel can't hang this).
+# Part 1: eight volumes erasure-encoding concurrently through ONE shared
+# mesh coder, V-axis per-chip lanes on vs off — with vshard off every
+# window funnels through one column-sharded shard_map launch; with it on,
+# slabs round-robin across per-chip lanes and flush as device-affine
+# single-chip dispatches. Part 2: eight concurrent degraded-read
+# reconstruct streams, one survivor set each — per-survivor-set chip
+# placement on vs the single funnel. Bit-identity of the shard files is
+# asserted against the vshard-off path AND the rs_cpu oracle inside the
+# child; per-chip batch counters prove the work actually spread.
+_MESHAB_PROG = r"""
+import hashlib, json, os, sys, tempfile, threading, time, traceback
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+# 4ms probe window, as in the ISSUE-3 A/B: thread wakeups on a loaded
+# 1-core box cost ~1ms and the window is a documented knob
+os.environ.setdefault("SWFS_EC_DISPATCH_WINDOW_MS", "4")
+os.environ["SEAWEEDFS_TPU_NATIVE"] = "0"
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")  # never touch the real chip
+
+from seaweedfs_tpu.ops import dispatch
+from seaweedfs_tpu.ops.rs_cpu import RSCodecCPU
+from seaweedfs_tpu.parallel.mesh import ShardedCoder
+from seaweedfs_tpu.storage import ec_files
+from seaweedfs_tpu.storage.ec_locate import Geometry
+from seaweedfs_tpu.utils import stats
+
+GEO = Geometry(large_block=64 * 1024, small_block=4 * 1024)
+VOLS = int(os.environ.get("SWFS_MESHAB_VOLS", "8"))
+VOL_MB = int(os.environ.get("SWFS_MESHAB_VOL_MB", "2"))
+BATCH = int(os.environ.get("SWFS_MESHAB_BATCH", str(64 * 1024)))
+ROUNDS = int(os.environ.get("SWFS_MESHAB_ROUNDS", "5"))
+RITERS = int(os.environ.get("SWFS_MESHAB_RECON_ITERS", "20"))
+
+out = {}
+med = lambda xs: sorted(xs)[len(xs) // 2]
+
+
+def set_vshard(on):
+    val = "1" if on else "0"
+    os.environ["SWFS_EC_DISPATCH_VSHARD"] = val
+    os.environ["SWFS_EC_MESH_VSHARD"] = val
+
+
+def encode_round(bases, coder):
+    errs = []
+    t0 = time.perf_counter()
+
+    def one(b):
+        try:
+            ec_files.generate_ec_files(b, coder, GEO, batch_size=BATCH)
+        except BaseException:
+            errs.append(traceback.format_exc())
+
+    ths = [threading.Thread(target=one, args=(b,)) for b in bases]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    if errs:
+        raise RuntimeError(errs[0])
+    return time.perf_counter() - t0
+
+
+def shard_hashes(base):
+    return [hashlib.sha256(
+        open(GEO.shard_file_name(base, i), "rb").read()).hexdigest()
+        for i in range(14)]
+
+
+coder = ShardedCoder(10, 4)
+out["devices"] = coder._n
+rng = np.random.default_rng(7)
+
+# -- part 1: concurrent multi-volume encode ---------------------------------
+try:
+    tmp = tempfile.mkdtemp()
+    bases = []
+    for i in range(VOLS):
+        base = os.path.join(tmp, f"v{i}")
+        with open(base + ".dat", "wb") as f:
+            f.write(rng.integers(0, 256, VOL_MB << 20,
+                                 dtype=np.uint8).tobytes())
+        bases.append(base)
+    # warm BOTH configurations (XLA compiles, GF tables, page cache)
+    set_vshard(False)
+    encode_round(bases, coder)
+    set_vshard(True)
+    encode_round(bases, coder)
+    s0 = stats.EC_DISPATCH_BATCHES.split_by("chip", lane="encode")
+    on, off = [], []
+    for r in range(ROUNDS):  # interleaved: same-box load fairness
+        set_vshard(False)
+        off.append(encode_round(bases, coder))
+        set_vshard(True)
+        on.append(encode_round(bases, coder))
+    s1 = stats.EC_DISPATCH_BATCHES.split_by("chip", lane="encode")
+    per_chip = {c: int(s1.get(c, 0) - s0.get(c, 0))
+                for c in s1 if c != "-"}
+    # bit-identity: the files on disk froze after the LAST on-round;
+    # re-encode volume 0 with vshard off and with the rs_cpu oracle
+    on_hashes = shard_hashes(bases[0])
+    set_vshard(False)
+    ec_files.generate_ec_files(bases[0], coder, GEO, batch_size=BATCH)
+    off_hashes = shard_hashes(bases[0])
+    cpu_base = os.path.join(tmp, "cpu")
+    with open(bases[0] + ".dat", "rb") as src, \
+            open(cpu_base + ".dat", "wb") as dst:
+        dst.write(src.read())
+    os.environ["SWFS_EC_DISPATCH"] = "0"
+    ec_files.generate_ec_files(cpu_base, RSCodecCPU(10, 4), GEO,
+                               batch_size=BATCH)
+    os.environ.pop("SWFS_EC_DISPATCH", None)
+    cpu_hashes = shard_hashes(cpu_base)
+    set_vshard(True)
+    out["encode_ab"] = {
+        "volumes": VOLS, "vol_mb": VOL_MB, "batch_bytes": BATCH,
+        "rounds": ROUNDS,
+        "window_ms": float(os.environ["SWFS_EC_DISPATCH_WINDOW_MS"]),
+        "off_s": [round(x, 3) for x in off],
+        "on_s": [round(x, 3) for x in on],
+        "off_median_s": round(med(off), 3),
+        "on_median_s": round(med(on), 3),
+        "improvement_pct": round(100 * (med(off) - med(on)) / med(off), 1),
+        "per_chip_batches": per_chip,
+        "all_chips_active": (len(per_chip) == coder._n
+                             and all(v > 0 for v in per_chip.values())),
+        "identical_vshard_on_vs_off": on_hashes == off_hashes,
+        "identical_vs_rs_cpu": on_hashes == cpu_hashes,
+    }
+    print(json.dumps(out), flush=True)  # salvage line before part 2
+except Exception as e:
+    traceback.print_exc()
+    out["encode_ab_error"] = f"{type(e).__name__}: {e}"[:300]
+
+# -- part 2: concurrent degraded-read reconstruct ---------------------------
+try:
+    cpu = RSCodecCPU(10, 4)
+    data = rng.integers(0, 256, (10, 64 * 1024), dtype=np.uint8)
+    shards = np.asarray(cpu.encode(
+        np.vstack([data, np.zeros((4, data.shape[1]), np.uint8)])))
+    sets = []
+    for i in range(8):  # 8 readers, each behind a DIFFERENT failure set
+        drop = {i % 14, (i + 3) % 14, (i + 7) % 14}
+        pres = tuple(j for j in range(14) if j not in drop)
+        stk = np.stack([shards[j] for j in pres])
+        want = cpu.reconstruct_stacked(pres, stk)
+        sets.append((pres, stk, want))
+
+    def recon_round():
+        errs = []
+        barrier = threading.Barrier(len(sets))
+        sched = dispatch.scheduler_for(coder)
+
+        def worker(i):
+            pres, stk, want = sets[i]
+            try:
+                barrier.wait()
+                for it in range(RITERS):
+                    m, rows = sched.reconstruct_stacked(pres,
+                                                        stk).result()
+                    if it == 0:
+                        assert tuple(m) == tuple(want[0])
+                        assert np.array_equal(np.asarray(rows),
+                                              np.asarray(want[1]))
+            except BaseException:
+                errs.append(traceback.format_exc())
+
+        t0 = time.perf_counter()
+        ths = [threading.Thread(target=worker, args=(i,))
+               for i in range(len(sets))]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        if errs:
+            raise RuntimeError(errs[0])
+        return time.perf_counter() - t0
+
+    set_vshard(False)
+    recon_round()  # warm
+    set_vshard(True)
+    recon_round()
+    r_on, r_off = [], []
+    for r in range(ROUNDS):
+        set_vshard(False)
+        r_off.append(recon_round())
+        set_vshard(True)
+        r_on.append(recon_round())
+    rb = stats.EC_DISPATCH_BATCHES.split_by("chip", lane="reconstruct")
+    out["reconstruct_ab"] = {
+        "readers": len(sets), "iters": RITERS, "rounds": ROUNDS,
+        "off_s": [round(x, 3) for x in r_off],
+        "on_s": [round(x, 3) for x in r_on],
+        "off_median_s": round(med(r_off), 3),
+        "on_median_s": round(med(r_on), 3),
+        "improvement_pct": round(
+            100 * (med(r_off) - med(r_on)) / med(r_off), 1),
+        "chips_used": sorted(c for c in rb if c != "-"),
+    }
+except Exception as e:
+    traceback.print_exc()
+    out["reconstruct_ab_error"] = f"{type(e).__name__}: {e}"[:300]
+
+dispatch.shutdown_all()
+print(json.dumps(out))
+"""
+
+
+def _bench_mesh_dispatch_ab() -> dict:
+    """Run the multi-chip dispatch A/B child (hard timeout, last-JSON
+    salvage — the same wedged-tunnel guard pattern as every device-shaped
+    bench, even though the child pins the virtual CPU mesh)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _MESHAB_PROG], cwd=_HERE,
+            capture_output=True, text=True,
+            timeout=float(os.environ.get("SEAWEEDFS_TPU_MESHAB_TIMEOUT",
+                                         "600")))
+        out = _last_json_line(proc.stdout)
+        if out is not None:
+            return out
+        return {"error": f"rc={proc.returncode}: {proc.stderr[-300:]}"}
+    except subprocess.TimeoutExpired as e:
+        out = _last_json_line(e.stdout or "")
+        if out is not None:
+            out["note"] = "reconstruct phase timed out; encode salvaged"
+            return out
+        return {"error": "mesh dispatch A/B timed out"}
+    except Exception as e:  # never let the secondary hurt the headline
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 def _bench_ec_dispatch_ab() -> dict:
     """Run the EC-dispatch A/B child (hard timeout, last-JSON salvage)."""
     try:
@@ -1023,6 +1262,14 @@ def main() -> int:
         # artifact content to stdout)
         print(json.dumps(_bench_ec_dispatch_ab()))
         return 0
+    if "--mesh-dispatch-ab" in sys.argv:
+        # standalone multi-chip dispatch A/B (ISSUE 5): prints the
+        # BENCH_AB_ISSUE5.json artifact content and writes the artifact
+        out = _bench_mesh_dispatch_ab()
+        with open(os.path.join(_HERE, "BENCH_AB_ISSUE5.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps(out))
+        return 0 if "encode_ab" in out else 1
     if "--scrub-ab" in sys.argv:
         # standalone integrity-plane A/B (ISSUE 4): syndrome GB/s device
         # vs CPU byte-compare, scheduler on/off batch factor, pacing
@@ -1081,6 +1328,15 @@ def main() -> int:
             result["ec_dispatch"] = ab
         else:
             result["ec_dispatch_error"] = ab.get("error", "?")[:200]
+    if os.environ.get("SEAWEEDFS_TPU_MESHAB", "1").lower() not in (
+            "0", "false", "off"):
+        mab = _bench_mesh_dispatch_ab()
+        if "encode_ab" in mab or "reconstruct_ab" in mab:
+            # multi-chip V-axis dispatch A/B (ISSUE 5) over the forced
+            # 8-device host platform; per-chip counters from live metrics
+            result["mesh_dispatch"] = mab
+        else:
+            result["mesh_dispatch_error"] = mab.get("error", "?")[:200]
     if os.environ.get("SEAWEEDFS_TPU_SCRUBAB", "1").lower() not in (
             "0", "false", "off"):
         sab = _bench_scrub_ab()

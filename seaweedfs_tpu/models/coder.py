@@ -65,12 +65,12 @@ class AutoMeshCoder:
         if self._impl is None:
             with self._lock:
                 if self._impl is None:
-                    import jax
+                    # device enumeration goes through the mesh helpers
+                    # (tools/lint.py forbids bare jax.devices() here)
+                    from ..parallel import mesh
 
-                    if len(jax.devices()) > 1:
-                        from ..parallel.mesh import ShardedCoder
-
-                        self._impl = ShardedCoder(
+                    if mesh.device_count() > 1:
+                        self._impl = mesh.ShardedCoder(
                             self.data_shards, self.parity_shards)
                     else:
                         from ..ops.rs_jax import RSCodecJax
@@ -118,6 +118,65 @@ class AutoMeshCoder:
 
         return reconstruct_stacked_via_dict(impl, present_ids, stacked,
                                             data_only)
+
+    # -- per-chip (V-axis) dispatch surface (ISSUE 5) ----------------------
+    #
+    # The EC dispatch scheduler probes these with hasattr BEFORE any
+    # device work, so they must exist here statically (never resolve on a
+    # probe); placement_devices() itself resolves — it is only called
+    # from a submit, which is already EC work.
+
+    def placement_devices(self) -> list:
+        """Mesh devices for per-chip dispatch lanes; [] on a
+        single-device backend (the scheduler then keeps one lane)."""
+        impl = self._resolve()
+        fn = getattr(impl, "placement_devices", None)
+        return fn() if fn is not None else []
+
+    def encode_parity_stacked_on(self, stack, device):
+        """Stacked encode pinned to one chip; backends without the
+        device-affine form fall back to the plain stacked path (bytes
+        identical — only placement differs)."""
+        impl = self._resolve()
+        fn = getattr(impl, "encode_parity_stacked_on", None)
+        if fn is not None:
+            return fn(stack, device)
+        return self.encode_parity_stacked(stack)
+
+    def reconstruct_stacked_on(self, present_ids, stacked,
+                               data_only=False, device=None):
+        impl = self._resolve()
+        fn = getattr(impl, "reconstruct_stacked_on", None)
+        if fn is not None:
+            return fn(present_ids, stacked, data_only=data_only,
+                      device=device)
+        return self.reconstruct_stacked(present_ids, stacked,
+                                        data_only=data_only)
+
+    def reconstruct_stacked_vsharded(self, present_ids, stack,
+                                     data_only=False):
+        """Uniform survivor stacks [V, P, B] with the V axis sharded over
+        the mesh; per-slab fallback on backends without the variant."""
+        impl = self._resolve()
+        fn = getattr(impl, "reconstruct_stacked_vsharded", None)
+        if fn is not None:
+            return fn(present_ids, stack, data_only=data_only)
+        import numpy as _np
+
+        stack = _np.asarray(stack, _np.uint8)
+        outs = [self.reconstruct_stacked(present_ids, s,
+                                         data_only=data_only)
+                for s in stack]
+        if not outs:  # V=0: match the mesh variant's shape contract
+            limit = (self.data_shards if data_only
+                     else self.total_shards)
+            missing = tuple(i for i in range(limit)
+                            if i not in set(present_ids))
+            return missing, _np.zeros(
+                (0, len(missing), stack.shape[2] if stack.ndim == 3
+                 else 0), _np.uint8)
+        return outs[0][0], _np.stack(
+            [_np.asarray(rows, _np.uint8) for _, rows in outs])
 
     def verify(self, shards) -> bool:
         return self._resolve().verify(shards)

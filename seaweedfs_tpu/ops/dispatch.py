@@ -39,6 +39,8 @@ shard mount/unmount/delete).
 
 from __future__ import annotations
 
+import atexit
+import itertools
 import os
 import threading
 import time
@@ -58,6 +60,9 @@ from ..utils.stats import (
 
 DEFAULT_WINDOW_MS = 2.0
 DEFAULT_MAX_SLABS = 32
+# survivor-set -> chip assignments kept per scheduler (LRU): each set's
+# fused decode matrix lives on its assigned chip (ops/rs_jax._op_on_device)
+DEFAULT_REC_SETS = 128
 # flusher thread exits after this long with no pending work (a fresh
 # submit restarts it) — idle schedulers self-clean instead of leaking a
 # thread per coder across tests
@@ -67,6 +72,13 @@ _IDLE_EXIT_S = 1.0
 def enabled() -> bool:
     """SWFS_EC_DISPATCH gates the whole plane (default on)."""
     return os.environ.get("SWFS_EC_DISPATCH", "1").lower() not in (
+        "0", "false", "off")
+
+
+def vshard_enabled() -> bool:
+    """SWFS_EC_DISPATCH_VSHARD gates the per-chip (V-axis) lanes on
+    mesh-backed coders (default on; single-device coders ignore it)."""
+    return os.environ.get("SWFS_EC_DISPATCH_VSHARD", "1").lower() not in (
         "0", "false", "off")
 
 
@@ -162,9 +174,19 @@ def maybe_scheduler(coder):
 
 
 def shutdown_all() -> None:
-    """Flush + close every live scheduler (tests; process teardown)."""
+    """Flush + close every live scheduler (tests; process teardown).
+    Idempotent — close() on an already-closed scheduler is a no-op — and
+    registered via atexit so a process that never calls Store.close()
+    (crashed test, REPL, signal-less exit) still drains in-flight lanes
+    instead of abandoning their futures."""
     for sched in list(_schedulers):
-        sched.close()
+        try:
+            sched.close()
+        except Exception:  # noqa: BLE001 — teardown must visit every one
+            pass
+
+
+atexit.register(shutdown_all)
 
 
 def reconstruct_stacked_via_dict(coder, present_ids, stacked,
@@ -208,9 +230,23 @@ class EcDispatchScheduler:
     """Window-batched stacked dispatch over one coder.
 
     Lanes:
-      ("enc",)                          — encode slabs [k, B]
+      ("enc",)                          — encode slabs [k, B] (single chip)
+      ("enc", chip)                     — per-chip encode lane on a mesh
+                                          coder: slabs round-robin across
+                                          chips, each lane flushes as ONE
+                                          device-affine stacked dispatch
       ("rec", present_ids, data_only)   — reconstruct slabs [P, B] sharing
-                                          one survivor set / fused matrix
+                                          one survivor set / fused matrix;
+                                          on a mesh the whole lane is
+                                          pinned to the chip holding that
+                                          set's decode matrix (LRU)
+
+    Multi-chip (ISSUE 5): a fleet of concurrent encodes used to funnel
+    through one stacked launch per window — per-chip lanes keep the V
+    (volume/slab) axis spread over every chip's own dispatch queue, so
+    the chips fill in parallel (RapidRAID's pipelined distribution,
+    arXiv:1207.6744). SWFS_EC_DISPATCH_VSHARD=0 restores the single
+    funnel; single-device coders are untouched either way.
     """
 
     def __init__(self, coder, window: float | None = None,
@@ -222,6 +258,16 @@ class EcDispatchScheduler:
                            str(DEFAULT_MAX_SLABS)))
         self._cv = threading.Condition()
         self._lanes: "OrderedDict[tuple, list[_Slab]]" = OrderedDict()
+        # per-chip lane state — `_chips` resolves LAZILY on first submit:
+        # asking a coder for its devices may instantiate the backend, and
+        # schedulers are constructed on the first EC call, which must not
+        # become the place a wedged tunnel hangs a server's startup path
+        self._chips: list | None = None
+        self._enc_rr = itertools.count()
+        self._rec_chips: "OrderedDict[tuple, int]" = OrderedDict()
+        self._rec_rr = 0
+        self._rec_max = int(os.environ.get("SWFS_EC_DISPATCH_REC_SETS",
+                                           str(DEFAULT_REC_SETS)))
         self._thread: threading.Thread | None = None
         # Serializes SUBMISSION into the coder (not completion — jax
         # dispatch stays async, so batches still pipeline device-side).
@@ -234,6 +280,73 @@ class EcDispatchScheduler:
         self.closed = False
         _schedulers.add(self)
 
+    # -- per-chip lane plumbing --------------------------------------------
+
+    def _chip_list(self) -> list:
+        """The coder's placement devices when per-chip lanes apply, else
+        []. Resolved once (may instantiate the backend — acceptable here:
+        a submit IS device work); the env gate is re-read every call so
+        an A/B can flip V-axis sharding without rebuilding schedulers."""
+        if not vshard_enabled():
+            return []
+        chips = self._chips
+        if chips is None:
+            chips = []
+            fn = getattr(self.coder, "placement_devices", None)
+            if fn is not None and hasattr(self.coder,
+                                          "encode_parity_stacked_on"):
+                try:
+                    devs = fn()
+                    if devs and len(devs) > 1:
+                        chips = list(devs)
+                    self._chips = chips
+                except Exception:  # noqa: BLE001 — transiently
+                    # unreachable backend: DON'T cache, so the next
+                    # submit re-probes instead of silently pinning the
+                    # scheduler to the single-chip path forever
+                    return []
+            else:
+                self._chips = chips
+        return chips
+
+    def _assign_rec_chip(self, key: tuple, n_chips: int) -> int:
+        """Stable survivor-set -> chip placement, LRU-evicted: every slab
+        sharing this fused decode matrix dispatches on the chip where the
+        matrix is resident (rs_jax keeps it cached device-side)."""
+        with self._cv:
+            got = self._rec_chips.get(key)
+            if got is None:
+                got = self._rec_rr % n_chips
+                self._rec_rr += 1
+                self._rec_chips[key] = got
+                while len(self._rec_chips) > self._rec_max:
+                    # evict oldest set WITHOUT queued slabs: dropping an
+                    # in-flight lane's pinning mid-window would dispatch
+                    # it unpinned and desync the per-chip counters
+                    for old in self._rec_chips:
+                        if old not in self._lanes:
+                            del self._rec_chips[old]
+                            break
+                    else:
+                        break  # every set in flight; defer eviction
+            else:
+                self._rec_chips.move_to_end(key)
+            return got
+
+    def _lane_chip(self, key: tuple) -> int | None:
+        """Chip index a lane is pinned to (None = single-chip path)."""
+        if key[0] == "enc":
+            return key[1] if len(key) > 1 else None
+        with self._cv:
+            return self._rec_chips.get(key)
+
+    def _chip_device(self, key: tuple):
+        chips = self._chip_list()
+        idx = self._lane_chip(key)
+        if chips and idx is not None and idx < len(chips):
+            return chips[idx]
+        return None
+
     # -- submission --------------------------------------------------------
 
     def encode_parity(self, data: np.ndarray, copy: bool = True) -> EcFuture:
@@ -241,29 +354,43 @@ class EcDispatchScheduler:
 
         `copy=True` (default) snapshots the slab: the encode pipeline
         recycles its read buffers as soon as the data rows hit disk,
-        which can be before the stacked dispatch reads them."""
+        which can be before the stacked dispatch reads them.
+
+        On a mesh coder, slabs round-robin over per-chip lanes — one
+        pipeline alone fans across every chip, and N pipelines load the
+        chips evenly (no chip starves; tests pin the fairness)."""
         data = np.asarray(data, dtype=np.uint8)
         if copy:
             data = data.copy()
-        return self._submit(("enc",), data)
+        chips = self._chip_list()
+        if chips:
+            key = ("enc", next(self._enc_rr) % len(chips))
+        else:
+            key = ("enc",)
+        return self._submit(key, data, chip=self._lane_chip(key))
 
     def reconstruct_stacked(self, present_ids, stacked: np.ndarray,
                             data_only: bool = False,
                             copy: bool = False) -> EcFuture:
         """Submit survivors [P, B] (caller row order); the future resolves
         to (missing_ids, rows[len(missing), B]). Slabs sharing a survivor
-        set share one column-concatenated `reconstruct_stacked` dispatch."""
+        set share one column-concatenated `reconstruct_stacked` dispatch,
+        pinned to the set's assigned chip on a mesh coder."""
         stacked = np.asarray(stacked, dtype=np.uint8)
         if copy:
             stacked = stacked.copy()
-        return self._submit(("rec", tuple(present_ids), bool(data_only)),
-                            stacked)
+        key = ("rec", tuple(present_ids), bool(data_only))
+        chips = self._chip_list()
+        chip = self._assign_rec_chip(key, len(chips)) if chips else None
+        return self._submit(key, stacked, chip=chip)
 
-    def _submit(self, key: tuple, data: np.ndarray) -> EcFuture:
+    def _submit(self, key: tuple, data: np.ndarray,
+                chip: int | None = None) -> EcFuture:
         fut = EcFuture(self, key)
         slab = _Slab(data, fut)
         kind = "encode" if key[0] == "enc" else "reconstruct"
-        EC_DISPATCH_SLABS.inc(lane=kind)
+        EC_DISPATCH_SLABS.inc(lane=kind,
+                              chip="-" if chip is None else str(chip))
         with self._cv:
             if self.closed:
                 raise RuntimeError("ec dispatch scheduler is closed")
@@ -340,30 +467,44 @@ class EcDispatchScheduler:
 
     def _dispatch(self, key: tuple, slabs: list[_Slab]) -> None:
         kind = "encode" if key[0] == "enc" else "reconstruct"
+        chip = self._lane_chip(key)
+        label = "-" if chip is None else str(chip)
         now = time.perf_counter()
-        EC_DISPATCH_BATCHES.inc(lane=kind)
+        EC_DISPATCH_BATCHES.inc(lane=kind, chip=label)
         EC_DISPATCH_STACK_SLABS.observe(len(slabs), lane=kind)
         EC_DISPATCH_STACK_BYTES.observe(
             sum(s.data.nbytes for s in slabs), lane=kind)
         for s in slabs:
-            EC_DISPATCH_WINDOW_WAIT.observe(now - s.t, lane=kind)
+            EC_DISPATCH_WINDOW_WAIT.observe(now - s.t, lane=kind,
+                                            chip=label)
         # caller holds _dispatch_mu: coder submission is single-threaded
         # (concurrent shard_map submissions deadlock XLA's cross-module
         # rendezvous on the multi-device CPU mesh), and in-flight
-        # dispatch time turns into batching for the next elevator
+        # dispatch time turns into batching for the next elevator.
+        # Per-chip sub-dispatches are plain per-device jits — submission
+        # still serializes here (it's cheap), but EXECUTION proceeds on
+        # every chip's own queue concurrently.
         try:
+            device = self._chip_device(key)
             if key[0] == "enc":
-                self._dispatch_encode(slabs)
+                self._dispatch_encode(slabs, device)
             else:
-                self._dispatch_reconstruct(key, slabs)
+                self._dispatch_reconstruct(key, slabs, device)
         except BaseException as e:
             for s in slabs:
                 if not s.fut.done():
                     s.fut._set_error(e)
 
-    def _dispatch_encode(self, slabs: list[_Slab]) -> None:
+    def _dispatch_encode(self, slabs: list[_Slab], device=None) -> None:
+        fn_on = (getattr(self.coder, "encode_parity_stacked_on", None)
+                 if device is not None else None)
         if len(slabs) == 1:
-            slabs[0].fut._set(self.coder.encode_parity(slabs[0].data))
+            s = slabs[0]
+            if fn_on is not None:
+                # lone slab on a chip lane: [None] view, no zero-pad copy
+                s.fut._set(fn_on(s.data[None], device)[0])
+            else:
+                s.fut._set(self.coder.encode_parity(s.data))
             return
         if not hasattr(self.coder, "encode_parity_stacked"):
             for s in slabs:  # exotic coder: amortization off, bytes same
@@ -374,27 +515,57 @@ class EcDispatchScheduler:
         stack = np.zeros((len(slabs), k, bmax), dtype=np.uint8)
         for i, s in enumerate(slabs):
             stack[i, :, : s.width] = s.data
-        out = self.coder.encode_parity_stacked(stack)
+        if fn_on is not None:
+            # device-affine sub-dispatch: this chip lane's slabs ride one
+            # stacked launch pinned to the lane's chip
+            out = fn_on(stack, device)
+        else:
+            out = self.coder.encode_parity_stacked(stack)
         # ragged tails ride zero-padded columns; zero columns encode to
         # zero parity and are sliced away, so per-slab bytes are identical
         # to a lone dispatch (pinned by tests/test_ec_dispatch.py)
         for i, s in enumerate(slabs):
             s.fut._set(out[i][:, : s.width])
 
-    def _dispatch_reconstruct(self, key: tuple, slabs: list[_Slab]) -> None:
+    def _dispatch_reconstruct(self, key: tuple, slabs: list[_Slab],
+                              device=None) -> None:
         _, present_ids, data_only = key
         if not hasattr(self.coder, "reconstruct_stacked"):
             for s in slabs:  # exotic coder: per-slab dict reconstruct
                 s.fut._set(reconstruct_stacked_via_dict(
                     self.coder, present_ids, s.data, data_only))
             return
+        chips = self._chip_list()
+        fn_v = getattr(self.coder, "reconstruct_stacked_vsharded", None)
+        if (fn_v is not None and chips and len(slabs) >= len(chips)
+                and len({s.width for s in slabs}) == 1):
+            # a BIG uniform batch (a rebuild pipeline's demand-flushed
+            # backlog) outgrows its single assigned chip: shard the V
+            # axis over the whole mesh instead, so a lone rebuild uses
+            # every chip (small serving micro-batches keep the
+            # survivor-set chip placement below)
+            vstack = np.stack([s.data for s in slabs])
+            missing, rows = fn_v(present_ids, vstack, data_only=data_only)
+            for i, s in enumerate(slabs):
+                s.fut._set((missing, rows[i]))
+            return
+        fn_on = (getattr(self.coder, "reconstruct_stacked_on", None)
+                 if device is not None else None)
+
+        def recon(stk):
+            if fn_on is not None:
+                # survivor-set chip placement: the fused decode matrix is
+                # resident on this lane's chip; its slabs dispatch there
+                return fn_on(present_ids, stk, data_only=data_only,
+                             device=device)
+            return self.coder.reconstruct_stacked(
+                present_ids, stk, data_only=data_only)
+
         if len(slabs) == 1:
-            slabs[0].fut._set(self.coder.reconstruct_stacked(
-                present_ids, slabs[0].data, data_only=data_only))
+            slabs[0].fut._set(recon(slabs[0].data))
             return
         cat = np.concatenate([s.data for s in slabs], axis=1)
-        missing, rows = self.coder.reconstruct_stacked(
-            present_ids, cat, data_only=data_only)
+        missing, rows = recon(cat)
         off = 0
         for s in slabs:
             s.fut._set((missing, rows[:, off: off + s.width]))
@@ -406,15 +577,39 @@ class EcDispatchScheduler:
         with self._cv:
             return sum(len(l) for l in self._lanes.values())
 
-    def close(self) -> None:
-        """Flush pending work, then stop + join the flusher thread."""
-        self.flush()
+    def chip_depths(self) -> dict[str, int]:
+        """Queued slabs per chip lane ("-" = single-chip lanes) — the
+        per-chip depth surfaced in the volume server's /status."""
         with self._cv:
-            self.closed = True
+            out: dict[str, int] = {}
+            for key, lane in self._lanes.items():
+                if key[0] == "enc" and len(key) > 1:
+                    c = str(key[1])
+                elif key[0] == "rec":
+                    idx = self._rec_chips.get(key)
+                    c = "-" if idx is None else str(idx)
+                else:
+                    c = "-"
+                out[c] = out.get(c, 0) + len(lane)
+            return out
+
+    def close(self) -> None:
+        """Drain pending lanes, then stop + join the flusher thread.
+
+        Idempotent: a second close (Store.close after shutdown_all, a
+        test tearing down twice) neither re-drains nor re-joins — and
+        never joins the calling thread itself, so a close reached from
+        a future callback can't deadlock on a dead flusher."""
+        with self._cv:
+            already = self.closed
+            self.closed = True  # rejects NEW submissions while we drain
             t = self._thread
             self._thread = None
             self._cv.notify_all()
-        if t is not None and t.is_alive():
+        if not already:
+            self.flush()  # resolve every already-queued future
+        if t is not None and t is not threading.current_thread() \
+                and t.is_alive():
             t.join(timeout=5)
 
 

@@ -71,6 +71,29 @@ class RSCodecCPU:
         parity = self._matmul(self._gp, wide)
         return parity.reshape(self.parity_shards, v, b).transpose(1, 0, 2)
 
+    def encode_parity_stacked_vsharded(self, stack: np.ndarray,
+                                       parts: int) -> np.ndarray:
+        """CPU mirror of the mesh coder's V-axis sharded stacked encode
+        (parallel/mesh.ShardedCoder over `parts` chips): zero-pad V to a
+        multiple of `parts`, encode each part's slabs as its own stacked
+        call, slice the padding away. Zero slabs encode to zero parity
+        and columns are independent, so the result is bit-identical to
+        one encode_parity_stacked over the whole stack — this is the
+        oracle tests/bench pin the multi-chip partitioning against."""
+        stack = np.asarray(stack, dtype=np.uint8)
+        assert stack.ndim == 3 and parts > 0, (stack.shape, parts)
+        v = stack.shape[0]
+        pad_v = -(-v // parts) * parts
+        if pad_v != v:
+            stack = np.concatenate(
+                [stack, np.zeros((pad_v - v,) + stack.shape[1:],
+                                 np.uint8)])
+        per = pad_v // parts
+        out = np.concatenate(
+            [self.encode_parity_stacked(stack[i * per:(i + 1) * per])
+             for i in range(parts)])
+        return out[:v]
+
     def encode(self, shards: np.ndarray) -> np.ndarray:
         shards = np.asarray(shards, dtype=np.uint8).copy()
         shards[self.data_shards:] = self.encode_parity(shards[: self.data_shards])

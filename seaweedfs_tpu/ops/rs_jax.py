@@ -152,6 +152,35 @@ def _derived(form: str, key: tuple, matrix: np.ndarray) -> np.ndarray:
     return got
 
 
+# Device-RESIDENT kernel operands for the per-chip dispatch lanes
+# (ops/dispatch.py, ISSUE 5): a survivor set's fused decode matrix (or the
+# encode parity operand) is uploaded to its assigned chip once and reused
+# by every later dispatch pinned there. LRU so survivor-set churn can't
+# pin one chip's memory full of dead matrices.
+_DEVICE_OPS_MAX = 256
+_device_ops: "collections.OrderedDict[tuple, jax.Array]" = (
+    collections.OrderedDict()
+)
+_device_ops_lock = threading.Lock()
+
+
+def _op_on_device(full_key: tuple, host_op: np.ndarray, device) -> jax.Array:
+    """The derived operand `host_op` (identified by `full_key`), committed
+    to `device` — cached, LRU-evicted."""
+    key = (full_key, device)
+    with _device_ops_lock:
+        got = _device_ops.get(key)
+        if got is not None:
+            _device_ops.move_to_end(key)
+            return got
+    arr = jax.device_put(host_op, device)
+    with _device_ops_lock:
+        while len(_device_ops) >= _DEVICE_OPS_MAX:
+            _device_ops.popitem(last=False)
+        _device_ops[key] = arr
+    return arr
+
+
 @functools.lru_cache(maxsize=1024)
 def fused_reconstruct_matrix(
     data_shards: int, parity_shards: int, present: tuple[int, ...],
@@ -293,15 +322,24 @@ def _kernel_choice(b: int) -> str:
 
 
 def _dispatch_matmul(matrix: np.ndarray, data: jax.Array, out_rows: int,
-                     key: tuple = None) -> jax.Array:
+                     key: tuple = None, device=None) -> jax.Array:
     """Padded GF matmul via the best backend for this platform/shape.
     `matrix` is the byte-form GF(256) matrix; `key` is its compact cache
-    identity (defaults to hashing the contents). Outputs are bit-identical
+    identity (defaults to hashing the contents). With `device`, the
+    computation is pinned to that chip (inputs committed there; derived
+    operands served from the device-resident LRU) — the per-chip lane
+    form used by the EC dispatch scheduler. Outputs are bit-identical
     across paths (tests + bench assert it)."""
     if key is None:
         key = ("raw", matrix.shape, matrix.tobytes())
     b = data.shape[1]
     kind = _kernel_choice(b)
+    if device is not None:
+        # pinned dispatches stay on the XLA formulations: placement is
+        # driven by committed inputs, which the hand-tiled pallas paths
+        # don't plumb — and bytes are identical across all formulations
+        kind = kind.replace("-pallas", "-xla")
+        data = jax.device_put(data, device)
     if kind.startswith("sel-") and key[0] in ("fdec", "fdecs"):
         # sel kernels specialize on the static matrix; fused reconstruct
         # matrices (one per survivor+missing set, up to C(n,k) of them)
@@ -328,9 +366,13 @@ def _dispatch_matmul(matrix: np.ndarray, data: jax.Array, out_rows: int,
     if kind == "xor-xla":
         from .rs_xor import _matmul_xor_jit
 
-        coeffs = jnp.asarray(_derived("xor", key, matrix))
+        coeffs_np = _derived("xor", key, matrix)
+        coeffs = (_op_on_device(("xor", *key), coeffs_np, device)
+                  if device is not None else jnp.asarray(coeffs_np))
         return _matmul_xor_jit(coeffs, _pad_bytes(data, b))[:, :b]
-    matrix_bits = jnp.asarray(_derived("bits", key, matrix))
+    bits_np = _derived("bits", key, matrix)
+    matrix_bits = (_op_on_device(("bits", *key), bits_np, device)
+                   if device is not None else jnp.asarray(bits_np))
     if kind == "mxu-pallas":
         from .rs_pallas import TILE_N, gf_matmul_bits_pallas
 
@@ -361,20 +403,28 @@ class RSCodecJax:
 
     # -- Encode ------------------------------------------------------------
 
-    def encode_parity(self, data: np.ndarray | jax.Array) -> jax.Array:
-        """data [k, B] uint8 -> parity [m, B] uint8 (device array)."""
+    def encode_parity(self, data: np.ndarray | jax.Array,
+                      device=None) -> jax.Array:
+        """data [k, B] uint8 -> parity [m, B] uint8 (device array).
+        `device` pins the dispatch to one chip (per-chip lanes)."""
+        if device is not None:
+            # commit to the target chip BEFORE any jnp op: an uncommitted
+            # asarray would land on the default device and make chip 0
+            # the serialization point the per-chip lanes exist to remove
+            data = jax.device_put(np.asarray(data, np.uint8), device)
         data = jnp.asarray(data, dtype=jnp.uint8)
         assert data.shape[0] == self.data_shards, data.shape
         b = data.shape[1]
-        if _kernel_choice(b) != "mxu-xla":
+        if device is not None or _kernel_choice(b) != "mxu-xla":
             gp = gf256.parity_matrix(self.data_shards, self.parity_shards)
             key = ("parity", self.data_shards, self.parity_shards)
-            return _dispatch_matmul(gp, data, self.parity_shards, key=key)
+            return _dispatch_matmul(gp, data, self.parity_shards, key=key,
+                                    device=device)
         out = _encode_jit(_pad_bytes(data, b), self.data_shards, self.parity_shards)
         return out[:, :b]
 
     def encode_parity_stacked(
-        self, stack: np.ndarray | jax.Array
+        self, stack: np.ndarray | jax.Array, device=None
     ) -> jax.Array:
         """stack [V, k, B] -> parity [V, m, B] in ONE device dispatch.
 
@@ -383,13 +433,20 @@ class RSCodecJax:
         batch — the dispatch-amortization primitive behind
         ops/dispatch.py: V volumes' concurrent encode pipelines pay one
         device round-trip instead of V. Columns are independent, so each
-        slab's bytes are identical to its own encode_parity call."""
+        slab's bytes are identical to its own encode_parity call.
+        `device` pins the whole stacked dispatch to one chip — the
+        device-affine sub-dispatch form the scheduler's per-chip lanes
+        flush through."""
+        if device is not None:
+            # commit FIRST (see encode_parity): the swapaxes/reshape
+            # below must run on the lane's chip, not the default device
+            stack = jax.device_put(np.asarray(stack, np.uint8), device)
         stack = jnp.asarray(stack, dtype=jnp.uint8)
         assert stack.ndim == 3 and stack.shape[1] == self.data_shards, \
             stack.shape
         v, k, b = stack.shape
         wide = jnp.swapaxes(stack, 0, 1).reshape(k, v * b)
-        parity = self.encode_parity(wide)
+        parity = self.encode_parity(wide, device=device)
         return jnp.swapaxes(
             parity.reshape(self.parity_shards, v, b), 0, 1)
 
@@ -436,6 +493,7 @@ class RSCodecJax:
     def reconstruct_stacked(
         self, present_ids: tuple[int, ...],
         stacked: np.ndarray | jax.Array, data_only: bool = False,
+        device=None,
     ) -> tuple[tuple[int, ...], jax.Array]:
         """Reconstruct from survivors already stacked [P, B] in caller
         row order -> (missing_ids, [len(missing), B]).
@@ -445,9 +503,17 @@ class RSCodecJax:
         batch (an extra ~2x HBM round-trip at rebuild sizes) is pure
         waste. Instead the fused [missing, k] matrix is column-permuted
         to the caller's row order, with zero columns for surplus
-        survivors — identical GF math, zero data movement."""
+        survivors — identical GF math, zero data movement.
+
+        `device` pins the dispatch to one chip: the scheduler's
+        per-survivor-set chip placement routes every slab sharing this
+        fused matrix to the chip where the matrix already lives."""
         limit = self.data_shards if data_only else self.total_shards
         present_ids = tuple(present_ids)
+        if device is not None:
+            # commit FIRST (see encode_parity): survivors go straight to
+            # the survivor set's chip, no default-device detour
+            stacked = jax.device_put(np.asarray(stacked, np.uint8), device)
         stacked = jnp.asarray(stacked, jnp.uint8)
         assert stacked.shape[0] == len(present_ids), stacked.shape
         missing, pm = fused_reconstruct_stacked_matrix(
@@ -456,7 +522,8 @@ class RSCodecJax:
             return (), jnp.zeros((0, stacked.shape[1]), jnp.uint8)
         key = ("fdecs", self.data_shards, self.parity_shards,
                present_ids, missing)
-        out = _dispatch_matmul(pm, stacked, len(missing), key=key)
+        out = _dispatch_matmul(pm, stacked, len(missing), key=key,
+                               device=device)
         return missing, out
 
     def verify(self, shards: np.ndarray | jax.Array) -> bool:

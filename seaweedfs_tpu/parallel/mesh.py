@@ -11,15 +11,28 @@ encode/reconstruct — the ICI is only needed for integrity collectives
 Mesh axes used here:
 
   * ``stripe`` — the byte-column axis of a stripe batch (pure data parallel).
+  * the SAME axis doubles as the V (volume/slab) axis for the stacked
+    variants (ISSUE 5): a stacked batch ``[V, k, B]`` can shard whole
+    slabs across chips instead of splitting every slab's columns —
+    per-chip dispatch queues fill independently, which is what a fleet
+    of concurrent encodes needs (RapidRAID's pipelined distribution of
+    coding work across nodes, arXiv:1207.6744).
 
 `shard_map` gives each device its local [k, B/n] slab; the same bitsliced
 MXU matmul from ops/rs_jax.py runs per-device. Outputs keep the same
 sharding, so a host only pulls back the shard slabs it will write locally.
+
+This module is also the ONE sanctioned device-enumeration point:
+tools/lint.py rejects bare ``jax.devices()`` anywhere else (bench.py
+excepted) — device placement must go through the helpers here so mesh
+policy stays in one file.
 """
 
 from __future__ import annotations
 
 import functools
+import os
+import threading
 
 import jax
 import jax.numpy as jnp
@@ -41,11 +54,30 @@ from ..ops.rs_xor import gf_matmul_xor
 
 STRIPE_AXIS = "stripe"
 
+# Serialized-submission guard (found by ISSUE 3's tier-1 CPU mesh): two
+# threads concurrently submitting multi-device shard_map modules interleave
+# XLA's cross-module rendezvous and deadlock. The lock covers SUBMISSION
+# only — the returned arrays are async, so batches still pipeline
+# device-side. The EC dispatch scheduler holds its own lock too; this one
+# protects the direct-call paths (scheduler off, concurrent scrubbers).
+_SUBMIT_MU = threading.Lock()
+
+
+def local_devices() -> list:
+    """Every device this process can place work on — THE sanctioned
+    enumeration call (see module docstring / tools/lint.py)."""
+    return list(jax.devices())
+
+
+def device_count() -> int:
+    """len(local_devices()) without making callers touch jax directly."""
+    return len(local_devices())
+
 
 def make_mesh(devices=None, axis: str = STRIPE_AXIS) -> Mesh:
     """1-D mesh over the given (default: all) devices."""
     if devices is None:
-        devices = jax.devices()
+        devices = local_devices()
     return Mesh(np.asarray(devices), (axis,))
 
 
@@ -72,6 +104,29 @@ def _apply_sharded(matrix_op, data, mesh, axis, kernel):
         out_specs=P(None, axis),
     )
     return fn(matrix_op, data)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def _apply_stacked_vsharded(matrix_op, stack, mesh, axis, kernel):
+    """stack [V, R, B] with V sharded across the mesh -> [V, out, B].
+
+    Each device holds whole slabs ([V/n, R, B] locally) and runs ONE
+    column-concatenated GF matmul over them — the V-axis counterpart of
+    `_apply_sharded`'s byte-column split. Zero cross-chip communication,
+    like the column form: slabs are independent."""
+    def local(m, s):
+        v, r, b = s.shape
+        wide = jnp.swapaxes(s, 0, 1).reshape(r, v * b)
+        out = _per_device_fn(kernel)(m, wide)
+        return jnp.swapaxes(out.reshape(out.shape[0], v, b), 0, 1)
+
+    fn = _shard_map(
+        local,
+        mesh=mesh,
+        in_specs=(_matrix_spec(matrix_op), P(axis, None, None)),
+        out_specs=P(axis, None, None),
+    )
+    return fn(matrix_op, stack)
 
 
 @functools.partial(jax.jit, static_argnums=(2, 3, 4, 5))
@@ -140,27 +195,121 @@ class ShardedCoder:
         """data [k, B] -> parity [m, B]; columns computed mesh-parallel."""
         assert data.shape[0] == self.data_shards, data.shape
         arr, b = self._shard(data)
-        out = _apply_sharded(self._parity_op, arr, self.mesh, self.axis,
-                             self.kernel)
+        with _SUBMIT_MU:
+            out = _apply_sharded(self._parity_op, arr, self.mesh, self.axis,
+                                 self.kernel)
         return out[:, :b]
+
+    def _vshard_wanted(self, v: int) -> bool:
+        """V-axis sharding pays when every chip gets at least one whole
+        slab; SWFS_EC_MESH_VSHARD=0 pins the ISSUE-3 column split."""
+        if v < self._n or self._n <= 1:
+            return False
+        return os.environ.get("SWFS_EC_MESH_VSHARD", "1").lower() not in (
+            "0", "false", "off")
+
+    def _vshard_put(self, stack: np.ndarray) -> tuple[jax.Array, int, int]:
+        """Zero-pad V to a device multiple (and B to the kernel's word
+        quantum) and place slab-sharded. Zero slabs/columns encode and
+        reconstruct to zero bytes and are sliced away, the same argument
+        as the scheduler's ragged-tail column padding."""
+        v, r, b = stack.shape
+        pad_v = -(-v // self._n) * self._n
+        pad_b = -(-b // 8) * 8
+        if pad_v != v or pad_b != b:
+            stack = np.pad(stack, ((0, pad_v - v), (0, 0), (0, pad_b - b)))
+        sharding = NamedSharding(self.mesh, P(self.axis, None, None))
+        return jax.device_put(stack, sharding), v, b
 
     def encode_parity_stacked(self, stack) -> jax.Array:
         """stack [V, k, B] -> parity [V, m, B]: the V slabs ride ONE
-        mesh-sharded dispatch, columns laid side by side ([k, V*B]) —
-        same column-independence argument as
-        RSCodecJax.encode_parity_stacked, so per-slab bytes are identical
-        to V separate encode_parity calls. The stacked column axis also
-        spreads across the mesh, so batching and multi-chip scaling
-        compose."""
+        mesh-sharded dispatch. With V >= chips (and SWFS_EC_MESH_VSHARD
+        on) the V axis itself shards — each chip encodes whole slabs,
+        so a big stacked batch fans out with zero cross-chip traffic;
+        otherwise columns are laid side by side ([k, V*B]) and split, as
+        in ISSUE 3. Both are per-byte-column GF matmuls, so per-slab
+        bytes are identical to V separate encode_parity calls either
+        way (pinned by tests/test_mesh_dispatch.py)."""
         stack = np.asarray(stack, dtype=np.uint8)
         assert stack.ndim == 3 and stack.shape[1] == self.data_shards, \
             stack.shape
         v, k, b = stack.shape
+        if self._vshard_wanted(v):
+            arr, v0, b0 = self._vshard_put(stack)
+            with _SUBMIT_MU:
+                out = _apply_stacked_vsharded(
+                    self._parity_op, arr, self.mesh, self.axis, self.kernel)
+            return out[:v0, :, :b0]
         wide = np.ascontiguousarray(
             stack.transpose(1, 0, 2).reshape(k, v * b))
         parity = self.encode_parity(wide)
         return jnp.swapaxes(
             parity.reshape(self.parity_shards, v, b), 0, 1)
+
+    def reconstruct_stacked_vsharded(self, present_ids, stack,
+                                     data_only: bool = False):
+        """Uniform-width survivor stacks [V, P, B] -> (missing_ids,
+        [V, len(missing), B]) with the V axis sharded across chips —
+        every chip reconstructs whole slabs through the same fused
+        column-permuted matrix (same GF math as reconstruct_stacked, so
+        bytes are identical slab for slab)."""
+        present_ids = tuple(present_ids)
+        stack = np.asarray(stack, dtype=np.uint8)
+        assert stack.ndim == 3 and stack.shape[1] == len(present_ids), \
+            stack.shape
+        limit = self.data_shards if data_only else self.total_shards
+        missing, op_np = fused_reconstruct_stacked_op(
+            self.data_shards, self.parity_shards, present_ids, limit,
+            self.kernel)
+        if not missing:
+            return (), jnp.zeros(
+                (stack.shape[0], 0, stack.shape[2]), jnp.uint8)
+        if stack.shape[0] == 0:  # V=0: nothing to shard, shape contract
+            return missing, jnp.zeros(
+                (0, len(missing), stack.shape[2]), jnp.uint8)
+        arr, v0, b0 = self._vshard_put(stack)
+        with _SUBMIT_MU:
+            out = _apply_stacked_vsharded(
+                jnp.asarray(op_np), arr, self.mesh, self.axis, self.kernel)
+        return missing, out[:v0, :, :b0]
+
+    # -- per-chip (device-affine) entry points ------------------------------
+    #
+    # The EC dispatch scheduler's per-chip lanes (ops/dispatch.py) flush
+    # each chip's queued slabs as ONE single-device stacked dispatch
+    # pinned to that chip — no shard_map, no rendezvous, every chip's
+    # dispatch queue fills independently.
+
+    def placement_devices(self) -> list:
+        """The mesh's devices, in mesh order — the chips the dispatch
+        scheduler round-robins encode slabs (and pins survivor sets) to."""
+        return list(self.mesh.devices.flat)
+
+    def _chip_codec(self):
+        # lazily-built single-device codec reused for every chip: jit
+        # caches per (shape, device), so chips don't trample each other
+        impl = self.__dict__.get("_chip_impl")
+        if impl is None:
+            from ..ops.rs_jax import RSCodecJax
+
+            impl = self.__dict__["_chip_impl"] = RSCodecJax(
+                self.data_shards, self.parity_shards)
+        return impl
+
+    def encode_parity_stacked_on(self, stack, device) -> jax.Array:
+        """stack [V, k, B] encoded in one stacked dispatch pinned to
+        `device` (bytes identical to encode_parity_stacked — columns are
+        independent of where they're computed)."""
+        return self._chip_codec().encode_parity_stacked(stack,
+                                                        device=device)
+
+    def reconstruct_stacked_on(self, present_ids, stacked,
+                               data_only: bool = False, device=None):
+        """Pre-stacked survivors [P, B] reconstructed on `device`; the
+        survivor set's fused decode matrix is cached device-resident
+        (ops/rs_jax._op_on_device, LRU)."""
+        return self._chip_codec().reconstruct_stacked(
+            present_ids, stacked, data_only=data_only, device=device)
 
     def encode(self, shards) -> jax.Array:
         """[k, B] data or [total, B] shards -> all [total, B] shards with
@@ -197,8 +346,9 @@ class ShardedCoder:
         fused_op = jnp.asarray(op_np)
         stacked = np.stack([np.asarray(present[i], np.uint8) for i in used])
         arr, b = self._shard(stacked)
-        out_arr = _apply_sharded(fused_op, arr, self.mesh, self.axis,
-                                 self.kernel)
+        with _SUBMIT_MU:
+            out_arr = _apply_sharded(fused_op, arr, self.mesh, self.axis,
+                                     self.kernel)
         return {i: out_arr[j][:b] for j, i in enumerate(missing)}
 
     def reconstruct_stacked(self, present_ids, stacked,
@@ -219,8 +369,9 @@ class ShardedCoder:
         # correctly-sharded array must keep its fast path (np.asarray
         # here would be a device->host->device round trip)
         arr, b = self._shard(stacked)
-        out_arr = _apply_sharded(jnp.asarray(op_np), arr, self.mesh,
-                                 self.axis, self.kernel)
+        with _SUBMIT_MU:
+            out_arr = _apply_sharded(jnp.asarray(op_np), arr, self.mesh,
+                                     self.axis, self.kernel)
         return missing, out_arr[:, :b]
 
     def verify(self, shards) -> bool:
@@ -237,10 +388,11 @@ class ShardedCoder:
         shards = np.asarray(shards, dtype=np.uint8)
         assert shards.shape[0] == self.total_shards, shards.shape
         arr, _ = self._shard(shards)
-        return _parity_probe(
-            self._parity_op, arr, self.mesh, self.axis, self.data_shards,
-            self.kernel
-        )
+        with _SUBMIT_MU:
+            return _parity_probe(
+                self._parity_op, arr, self.mesh, self.axis,
+                self.data_shards, self.kernel
+            )
 
     # kept as the historical name used by the dry-run driver
     parity_checksum = parity_probe

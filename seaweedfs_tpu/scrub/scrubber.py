@@ -164,6 +164,12 @@ class _Cursor:
     revision is stored alongside — a vacuum shifts every offset, so a
     revision mismatch resets the cursor instead of verifying garbage."""
 
+    # serializes every save() in this process: the anti-clobber guard in
+    # save() is read-check-then-replace, and the vacuum publication races
+    # a sweep's periodic save within ONE process (the server owning the
+    # volume's files), so a lock closes the window completely
+    _save_mu = threading.Lock()
+
     def __init__(self, base: str):
         self.path = base + ".scb"
         self.offset = 0
@@ -183,13 +189,77 @@ class _Cursor:
     def save(self) -> None:
         tmp = self.path + ".tmp"
         try:
-            with open(tmp, "w") as f:
-                json.dump({"offset": self.offset, "ecOffset": self.ec_offset,
-                           "sweeps": self.sweeps, "revision": self.revision,
-                           "updated": time.time()}, f)
-            os.replace(tmp, self.path)
+            with _Cursor._save_mu:
+                # never clobber a publication from a NEWER compaction
+                # revision: a vacuum committing mid-sweep publishes
+                # revision N while this sweep still holds N-1 — its
+                # periodic saves must lose, so the next pass ADOPTS the
+                # published cursor (_sweep_volume) instead of resetting
+                try:
+                    with open(self.path) as f:
+                        if int(json.load(f).get("revision", -1)) \
+                                > self.revision:
+                            return
+                except (OSError, ValueError):
+                    pass
+                with open(tmp, "w") as f:
+                    json.dump({"offset": self.offset,
+                               "ecOffset": self.ec_offset,
+                               "sweeps": self.sweeps,
+                               "revision": self.revision,
+                               "updated": time.time()}, f)
+                os.replace(tmp, self.path)
         except OSError:
             pass  # cursor persistence is best-effort
+
+
+def _publish_completed_pass(v, cur: "_Cursor", verified_end: int,
+                            refresh_digest: bool = True) -> None:
+    """THE completed-needle-pass publication — the background sweep and
+    the scrub-aware vacuum both end here, so the sequence (cursor at the
+    end of the verified extent, sweep counted, digest manifest refreshed
+    for anti-entropy peers) can never drift between the two paths."""
+    cur.offset = verified_end
+    cur.sweeps += 1
+    SCRUB_SWEEPS.inc(kind="volume")
+    if refresh_digest:
+        try:
+            entries = digest_mod.volume_digest_entries(v)
+            digest_mod.write_manifest(v.file_name(), entries)
+            SCRUB_BYTES.inc(len(entries) * digest_mod.ENTRY_SIZE,
+                            kind="digest")
+        except OSError:
+            pass  # manifest refresh is best-effort; the next pass retries
+    cur.save()
+
+
+def record_vacuum_pass(v, needles: int, nbytes: int,
+                       verified_end: int | None = None) -> None:
+    """Publish a CRC-verified vacuum as a completed scrub pass
+    (scrub-aware vacuum, ROADMAP item c).
+
+    Volume.compact() re-verified every live record it copied, so the
+    fresh .dat is byte-proven at its NEW compaction revision up to
+    `verified_end` (captured under the volume lock at commit — appends
+    racing the publication are NOT claimed as verified): bump the
+    persistent cursor (`.scb`) to that extent at the new revision,
+    refresh the digest manifest (`.dig`), and credit the counters. A
+    running Scrubber adopts the published cursor instead of resetting
+    to zero on the revision bump (_sweep_volume), so a vacuum never
+    costs a redundant full re-scrub."""
+    cur = _Cursor(v.file_name())
+    cur.revision = v.super_block.compaction_revision
+    SCRUB_NEEDLES.inc(needles)
+    SCRUB_BYTES.inc(nbytes, kind="needle")
+    # the inline digest refresh costs one CRC-tail pread per live needle
+    # — fine for ordinary volumes, but the vacuum COMMIT reply must stay
+    # bounded on huge ones; past the threshold the manifest is left to
+    # the next paced background sweep (anti-entropy reads entries live,
+    # so only check.disk's manifest freshness waits)
+    limit = int(_env_float("SWFS_VACUUM_DIGEST_MAX_NEEDLES", 250_000))
+    _publish_completed_pass(
+        v, cur, v.data_size() if verified_end is None else verified_end,
+        refresh_digest=v.file_count() <= limit)
 
 
 class Scrubber:
@@ -378,8 +448,18 @@ class Scrubber:
             v.sync_native()
         revision = v.super_block.compaction_revision
         if cur.revision != revision:
-            cur.offset = 0  # compaction rewrote every offset
-            cur.revision = revision
+            # compaction rewrote every offset — but a scrub-aware vacuum
+            # (record_vacuum_pass) publishes a cursor AT the new revision
+            # covering the bytes it verified; adopt that instead of
+            # re-scrubbing a volume the vacuum just proved clean
+            disk = _Cursor(base)
+            if disk.revision == revision:
+                with self._mu:
+                    self._cursors[base] = disk
+                cur = disk
+            else:
+                cur.offset = 0
+                cur.revision = revision
         dat_size = v.data_size()
         start = cur.offset
         if full or start >= dat_size:
@@ -450,19 +530,12 @@ class Scrubber:
                 cur.save()
                 since_persist = 0
         if completed:
-            cur.offset = dat_size  # next pass wraps to the beginning
-            cur.sweeps += 1
-            SCRUB_SWEEPS.inc(kind="volume")
-            # refresh the digest manifest at each completed sweep so
-            # anti-entropy peers can compare without a full rebuild
-            try:
-                d_entries = digest_mod.volume_digest_entries(v)
-                digest_mod.write_manifest(base, d_entries)
-                SCRUB_BYTES.inc(len(d_entries) * digest_mod.ENTRY_SIZE,
-                                kind="digest")
-            except OSError:
-                pass
-        cur.save()
+            # cursor at the snapshot extent: the next pass wraps to the
+            # beginning (and appends landing mid-publication are not
+            # claimed as verified)
+            _publish_completed_pass(v, cur, dat_size)
+        else:
+            cur.save()
 
     def _repair_needle(self, v, needle_id: int, finding: Finding) -> bool:
         """Quarantine -> fetch a CRC-verified copy from a healthy replica

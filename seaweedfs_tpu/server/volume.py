@@ -168,6 +168,15 @@ class VolumeServer:
     def address(self) -> str:
         return f"{self.ip}:{self.port}"
 
+    def ec_dispatch_depths(self) -> dict[str, int]:
+        """Live queued-slab depth per chip lane of this store's EC
+        dispatch scheduler ({} until EC work has attached one) — the
+        /status signal that shows which chips' queues are filling."""
+        sched = getattr(self.store.coder, "_ec_dispatch_sched", None)
+        if sched is None or sched.closed:
+            return {}
+        return sched.chip_depths()
+
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
@@ -717,8 +726,18 @@ class VolumeGrpc:
         return vs.VacuumVolumeCheckResponse(garbage_ratio=v.garbage_level())
 
     def VacuumVolumeCompact(self, request, context):
+        from ..storage.errors import VacuumCrcError
+
         v = self._volume(request.volume_id, context)
-        v.compact()
+        try:
+            v.compact()
+        except VacuumCrcError:
+            # the scrub-aware vacuum found ROT while copying (not some
+            # environmental IOError): abort is already done (compact
+            # never commits bad bytes) — queue the repair ladder so the
+            # NEXT vacuum finds a healed volume
+            self.srv.scrubber.report_suspect(request.volume_id)
+            raise
         yield vs.VacuumVolumeCompactResponse(processed_bytes=v.data_size())
 
     def VacuumVolumeCommit(self, request, context):
@@ -1528,9 +1547,13 @@ def _make_http_handler(srv: VolumeServer):
                     # (ISSUE 2 group commit); the native plane writes
                     # through unbuffered pwrite and does not batch
                     "GroupCommit": group_commit_stats(),
-                    # EC dispatch plane (ISSUE 3): stacked-dispatch batch
-                    # factors + reconstructed-interval cache ratios
-                    "EcDispatch": ec_dispatch_stats(),
+                    # EC dispatch plane (ISSUE 3/5): stacked-dispatch
+                    # batch factors, reconstructed-interval cache ratios,
+                    # per-chip dispatch spread + live per-chip queue depth
+                    "EcDispatch": {
+                        **ec_dispatch_stats(),
+                        "chipDepth": srv.ec_dispatch_depths(),
+                    },
                     # integrity plane (ISSUE 4): sweep cursors, findings
                     # lifecycle, repair outcomes, pacing
                     "Scrub": {**srv.scrubber.status(),

@@ -216,6 +216,16 @@ class Needle:
             n.append_at_ns = int.from_bytes(blob[ts : ts + 8], "big")
         return n
 
+    def crc_ok(self) -> bool:
+        """Does the held checksum match the held data? Meaningful after a
+        check_crc=False parse (where `checksum` is the stored-on-disk
+        value verbatim): the scrub-aware vacuum re-verifies every record
+        it copies through exactly the check from_bytes would apply."""
+        if self.size <= 0 or not self.data:
+            return True
+        actual = crc32c(self.data)
+        return self.checksum in (actual, crc_value_legacy(actual))
+
     def _parse_body_v2(self, b: bytes) -> None:
         i, ln = 0, len(b)
         if i < ln:

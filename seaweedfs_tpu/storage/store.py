@@ -340,6 +340,13 @@ class Store:
         return hb
 
     def close(self) -> None:
+        # idempotent: tests (and belt-and-braces teardown paths) close a
+        # store twice — the second call must not re-close volumes or
+        # re-join the dispatch flusher thread (a double-join against an
+        # already-dead flusher used to be able to hang the teardown)
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
         for loc in self.locations:
             for v in loc.volumes.values():
                 v.close()
@@ -349,7 +356,8 @@ class Store:
             loc.ec_volumes.clear()
         # the EC dispatch scheduler attached to this store's coder (if any
         # EC work ran) owns a flusher thread — flush + join it so tests
-        # and restarts never leak one
+        # and restarts never leak one (close() itself is idempotent too,
+        # so atexit's shutdown_all and this call compose in any order)
         sched = getattr(self.coder, "_ec_dispatch_sched", None)
         if sched is not None:
             sched.close()

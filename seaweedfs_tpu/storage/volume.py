@@ -305,6 +305,9 @@ class Volume:
         self.last_append_at_ns = 0
         self.last_modified_ts_seconds = 0
         self.is_compacting = False
+        # (needles, bytes) CRC re-verified by the last compact(); consumed
+        # by commit_compact's scrub-pass publication
+        self._vacuum_verified: tuple[int, int] | None = None
         self._lock = threading.RLock()
         # scrub plane: needle ids whose on-disk record failed verification
         # and is being repaired — read_needle refuses them (the server
@@ -916,7 +919,19 @@ class Volume:
     # -- vacuum (volume_vacuum.go) -----------------------------------------
 
     def compact(self) -> None:
-        """Compact2 (volume_vacuum.go:67): copy live needles into .cpd/.cpx."""
+        """Compact2 (volume_vacuum.go:67): copy live needles into .cpd/.cpx.
+
+        Scrub-aware (ISSUE 5 / ROADMAP item c): compaction reads every
+        live record anyway, so each one is CRC re-verified as it is
+        copied — for free, byte-wise. A mismatch ABORTS the vacuum (a
+        compacted volume must never launder rot into a freshly-written
+        .dat where the scrubber would re-find it with no healthy replica
+        journal behind it) and surfaces the needle id for the repair
+        ladder; after a clean commit the vacuum is published as a
+        completed scrub pass (scrub.scrubber.record_vacuum_pass).
+        SWFS_VACUUM_VERIFY=0 restores the old unverified copy."""
+        verify = os.environ.get("SWFS_VACUUM_VERIFY", "1").lower() \
+            not in ("0", "false", "off")
         with self._lock:
             if self._dat is None:
                 raise IOError(
@@ -925,9 +940,11 @@ class Volume:
             self._sync_buffers()  # the snapshot must cover buffered writes
             self.nm.catchup_from_idx()  # native plane may have appended
             self._compact_idx_snapshot = os.path.getsize(self.nm.idx_path)
+        self._vacuum_verified = None
         try:
             base = self.file_name()
             new_sb = self.super_block.bump_compaction()
+            checked_needles = checked_bytes = 0
             with open(base + ".cpd", "wb") as dst:
                 dst.write(new_sb.to_bytes())
                 from .needle_map import MemDb
@@ -941,11 +958,20 @@ class Volume:
                         continue  # superseded by a later rewrite
                     if n.has_expired():
                         continue
+                    if verify:
+                        if not n.crc_ok():
+                            from .errors import VacuumCrcError
+
+                            raise VacuumCrcError(self.id, n.id, _off)
+                        checked_needles += 1
+                        checked_bytes += len(n.data)
                     new_off = dst.tell()
                     dst.write(n.to_bytes(self.version))
                     newdb.set(n.id, types.offset_to_stored(new_off), n.size)
             with open(base + ".cpx", "wb") as f:
                 f.write(newdb.to_sorted_bytes())
+            if verify:
+                self._vacuum_verified = (checked_needles, checked_bytes)
         except BaseException:
             self.is_compacting = False
             raise
@@ -984,6 +1010,29 @@ class Volume:
                     # detach so the python engine serves it — stale
                     # plane state must never answer for it again
                     self.native = None
+            # extent of the freshly-committed, CRC-verified .dat — read
+            # under the lock so appends racing the publication below are
+            # never claimed as verified
+            verified_end = self.data_size()
+        # scrub-aware vacuum: every live record was CRC re-verified on
+        # the way into the new .dat, so publish the vacuum as a completed
+        # scrub pass — cursor (.scb) at the new revision, fresh digest
+        # manifest (.dig), sweep counters credited. Outside the volume
+        # lock (the digest pass re-reads every CRC tail) and best-effort:
+        # a failed publication must never fail the committed vacuum.
+        verified, self._vacuum_verified = self._vacuum_verified, None
+        if verified is not None:
+            try:
+                from ..scrub.scrubber import record_vacuum_pass
+
+                record_vacuum_pass(self, *verified,
+                                   verified_end=verified_end)
+            except Exception as e:  # noqa: BLE001
+                from ..utils import glog
+
+                glog.warning(
+                    f"volume {self.id}: vacuum scrub-pass publication "
+                    f"failed: {e}")
 
     def _makeup_diff(self, cpd: str, cpx: str) -> None:
         """Replay .idx entries appended after the compaction snapshot onto

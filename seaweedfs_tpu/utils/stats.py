@@ -44,9 +44,29 @@ class Counter(_Metric):
             self._values[key] = self._values.get(key, 0) + n
 
     def value(self, **labels) -> float:
-        key = tuple(sorted(labels.items()))
+        """Sum over every entry whose labels INCLUDE `labels` (subset
+        match, Prometheus-aggregation style). Exact reads behave as
+        before; families that later grow a finer label (e.g. the EC
+        dispatch counters' per-chip `chip`) keep answering their old
+        coarse queries with the aggregate."""
+        want = set(labels.items())
         with self._lock:
-            return self._values.get(key, 0)
+            return sum(v for k, v in self._values.items()
+                       if want <= set(k))
+
+    def split_by(self, label: str, **labels) -> dict[str, float]:
+        """Per-`label`-value sums among entries matching `labels` — e.g.
+        split_by("chip", lane="encode") -> {chip: batches}."""
+        want = set(labels.items())
+        out: dict[str, float] = {}
+        with self._lock:
+            for k, v in self._values.items():
+                if not want <= set(k):
+                    continue
+                d = dict(k)
+                if label in d:
+                    out[str(d[label])] = out.get(str(d[label]), 0) + v
+        return out
 
     def render(self) -> str:
         out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
@@ -195,13 +215,15 @@ VOLUME_GROUP_COMMIT_FLUSHES = Counter(
 EC_DISPATCH_SLABS = Counter(
     "SeaweedFS_ec_dispatch_slabs",
     "Slabs submitted to the EC dispatch scheduler by lane "
-    "(encode/reconstruct).")
+    "(encode/reconstruct) and chip ('-' = single-chip lanes).")
 EC_DISPATCH_BATCHES = Counter(
     "SeaweedFS_ec_dispatch_batches",
-    "Stacked dispatches issued by lane; slabs/batches is the batch factor.")
+    "Stacked dispatches issued by lane and chip; slabs/batches is the "
+    "batch factor.")
 EC_DISPATCH_WINDOW_WAIT = Histogram(
     "SeaweedFS_ec_dispatch_window_wait_seconds",
-    "Time a slab waited in the scheduler before its dispatch launched.")
+    "Time a slab waited in the scheduler before its dispatch launched, "
+    "by lane and chip.")
 EC_DISPATCH_STACK_SLABS = Histogram(
     "SeaweedFS_ec_dispatch_stacked_slabs",
     "Slabs per stacked dispatch (the realized batch size).",
@@ -271,7 +293,9 @@ def scrub_stats() -> dict:
 
 
 def ec_dispatch_stats() -> dict:
-    """Snapshot for /status pages: per-lane batch factor + cache ratios."""
+    """Snapshot for /status pages: per-lane batch factor + cache ratios
+    + the per-chip dispatch spread (ISSUE 5 V-axis lanes: every chip's
+    counter non-zero under concurrent load is the distribution proof)."""
     out: dict = {}
     for lane in ("encode", "reconstruct"):
         slabs = EC_DISPATCH_SLABS.value(lane=lane)
@@ -281,6 +305,12 @@ def ec_dispatch_stats() -> dict:
             "batches": int(batches),
             "batchFactor": round(slabs / batches, 3) if batches else 0.0,
         }
+    per_chip: dict = {}
+    for chip, n in EC_DISPATCH_BATCHES.split_by("chip").items():
+        per_chip[chip] = {"batches": int(n)}
+    for chip, n in EC_DISPATCH_SLABS.split_by("chip").items():
+        per_chip.setdefault(chip, {})["slabs"] = int(n)
+    out["perChip"] = per_chip
     hits = EC_RECON_CACHE_COUNTER.value(result="hit")
     misses = EC_RECON_CACHE_COUNTER.value(result="miss")
     total = hits + misses

@@ -18,17 +18,22 @@ def test_lint_gate_is_clean():
         f"lint findings:\n{proc.stdout}\n{proc.stderr}"
 
 
-def test_lint_catches_syntax_error(tmp_path):
-    """The gate actually gates: a file that cannot compile fails it."""
-    bad = tmp_path / "pkg"
-    bad.mkdir()
-    (bad / "broken.py").write_text("def f(:\n    pass\n")
+def _load_lint():
     import importlib.util
 
     spec = importlib.util.spec_from_file_location(
         "swfs_lint", os.path.join(REPO, "tools", "lint.py"))
     lint = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(lint)
+    return lint
+
+
+def test_lint_catches_syntax_error(tmp_path):
+    """The gate actually gates: a file that cannot compile fails it."""
+    bad = tmp_path / "pkg"
+    bad.mkdir()
+    (bad / "broken.py").write_text("def f(:\n    pass\n")
+    lint = _load_lint()
 
     files = [str(bad / "broken.py")]
     orig = lint._python_files
@@ -37,3 +42,26 @@ def test_lint_catches_syntax_error(tmp_path):
         assert lint.run_fallback() == 1
     finally:
         lint._python_files = orig
+
+
+def test_lint_catches_bare_device_enumeration(tmp_path):
+    """SWFS001 (ISSUE 5 satellite): bare jax.devices() outside the mesh
+    helpers is an error — device placement must go through
+    parallel/mesh.py — while the allow-listed files stay exempt."""
+    lint = _load_lint()
+    bad = tmp_path / "stray.py"
+    bad.write_text(
+        "import jax\n"
+        "def pick():\n"
+        "    return jax.local_devices()[0] or jax.devices()\n")
+    findings = lint.run_device_rule([str(bad)])
+    assert len(findings) == 2 and all("SWFS001" in f for f in findings), \
+        findings
+
+    # the sanctioned enumeration point itself must stay exempt
+    mesh_path = os.path.join(REPO, "seaweedfs_tpu", "parallel", "mesh.py")
+    assert lint.run_device_rule([mesh_path]) == []
+
+    # and the rule runs as part of the gate regardless of ruff presence:
+    # the repo itself is clean under it
+    assert lint.run_device_rule() == []

@@ -562,3 +562,117 @@ def test_scrub_metrics_exported(scrub_cluster):
                         timeout=10).text
     assert "SeaweedFS_scrub_bytes" in text
     assert "SeaweedFS_scrub_findings" in text
+
+
+# -- scrub-aware vacuum (ISSUE 5 satellite: ROADMAP item c) ------------------
+
+def test_vacuum_counts_as_completed_scrub_pass(tmp_path):
+    """Compaction CRC-verifies every live record it copies, so a clean
+    vacuum publishes itself as a finished sweep: `.scb` cursor at the
+    NEW compaction revision covering the compacted volume, `.dig`
+    manifest refreshed, sweep counters credited — and a running
+    Scrubber ADOPTS that cursor instead of resetting to zero."""
+    import json
+
+    from seaweedfs_tpu.utils.stats import SCRUB_NEEDLES, SCRUB_SWEEPS
+
+    st = Store([str(tmp_path)])
+    v, _ = _fill_volume(st, 1, n_needles=12, seed=21)
+    base = v.file_name()
+    v.delete_needle(4)
+    sweeps0 = SCRUB_SWEEPS.value(kind="volume")
+    needles0 = SCRUB_NEEDLES.value()
+    v.compact()
+    v.commit_compact()
+    assert SCRUB_SWEEPS.value(kind="volume") == sweeps0 + 1
+    assert SCRUB_NEEDLES.value() == needles0 + 11
+    with open(base + ".scb") as f:
+        cur = json.load(f)
+    assert cur["revision"] == v.super_block.compaction_revision
+    assert cur["offset"] == v.data_size()
+    assert cur["sweeps"] >= 1
+    # the digest manifest reflects POST-vacuum reality
+    entries = digest_mod.read_manifest(base + ".dig")
+    assert entries == digest_mod.volume_digest_entries(v)
+    assert all(e.needle_id != 4 for e in entries if e.size >= 0)
+    # a scrubber holding a stale in-memory cursor adopts the published
+    # one (revision matches) rather than resetting — and still verifies
+    # the volume clean on its wrapped pass
+    sc = Scrubber(st, None, interval_s=0, max_mbps=0)
+    stale = sc._cursor_for(base)
+    stale.revision = -123  # pre-vacuum memory
+    stale.offset = 7
+    report = sc.run_once()
+    assert report.findings == []
+    adopted = sc._cursor_for(base)
+    assert adopted.revision == v.super_block.compaction_revision
+    assert adopted.sweeps >= 2  # vacuum's pass + the sweep's own
+    st.close()
+
+
+def test_vacuum_catches_planted_corruption_and_aborts(tmp_path):
+    """Chaos acceptance: a needle whose bytes rotted ON DISK (planted via
+    the volume.dat.write.corrupt failpoint at append time) is CAUGHT by
+    the vacuum's CRC re-verify — compaction aborts instead of laundering
+    the rot into a fresh .dat, the original volume keeps serving, and
+    SWFS_VACUUM_VERIFY=0 restores the old blind copy."""
+    from seaweedfs_tpu.utils import failpoint
+
+    st = Store([str(tmp_path)])
+    v, blobs = _fill_volume(st, 1, n_needles=6, seed=22)
+    with failpoint.active("volume.dat.write.corrupt", mode="corrupt",
+                          p=1.0, match="vol=1,") as fp:
+        v.write_needle(Needle.create(7, 0xABC, b"rotten payload " * 50))
+        assert fp.hits > 0, "corruption never landed — test is vacuous"
+    v.delete_needle(2)  # some garbage so the vacuum has work
+    from seaweedfs_tpu.storage.errors import VacuumCrcError
+
+    with pytest.raises(VacuumCrcError, match="CRC re-verify during vacuum"):
+        v.compact()
+    assert not v.is_compacting
+    assert v._vacuum_verified is None
+    # nothing was committed: the good needles still serve
+    assert v.read_needle(1).data == blobs[1]
+    # the old, unverified behavior stays reachable behind the env gate
+    os.environ["SWFS_VACUUM_VERIFY"] = "0"
+    try:
+        v.compact()
+        v.commit_compact()
+    finally:
+        os.environ.pop("SWFS_VACUUM_VERIFY", None)
+    assert v.read_needle(1).data == blobs[1]
+    st.close()
+
+
+def test_midsweep_cursor_save_cannot_clobber_vacuum_publication(tmp_path):
+    """A sweep in flight across a vacuum holds a cursor at the OLD
+    compaction revision; its periodic save() must lose against the
+    vacuum-published .scb (newer revision), or the adoption path would
+    silently reset to a full re-scrub in exactly its target scenario."""
+    import json
+
+    from seaweedfs_tpu.scrub.scrubber import _Cursor
+
+    st = Store([str(tmp_path)])
+    v, _ = _fill_volume(st, 1, n_needles=8, seed=23)
+    base = v.file_name()
+    sc = Scrubber(st, None, interval_s=0, max_mbps=0)
+    sc.run_once()  # in-memory cursor now at revision 0
+    stale = sc._cursor_for(base)
+    assert stale.revision == v.super_block.compaction_revision
+    v.delete_needle(1)
+    v.compact()
+    v.commit_compact()  # publishes .scb at revision 1
+    new_rev = v.super_block.compaction_revision
+    assert stale.revision < new_rev
+    stale.offset = 123
+    stale.save()  # the "mid-sweep periodic save" — must be a no-op
+    with open(base + ".scb") as f:
+        cur = json.load(f)
+    assert cur["revision"] == new_rev, "stale save clobbered the vacuum pass"
+    assert cur["offset"] == v.data_size()
+    # and the next sweep adopts the published cursor rather than resetting
+    report = sc.run_once()
+    assert report.findings == []
+    assert sc._cursor_for(base).revision == new_rev
+    st.close()

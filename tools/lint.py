@@ -35,6 +35,16 @@ LINT_TARGETS = ("seaweedfs_tpu", "tests", "tools", "bench.py",
 # machine-generated wire code (protoc output style) is not hand-lintable
 EXCLUDE_SUFFIX = "_pb2.py"
 
+# SWFS001 (ISSUE 5): bare jax.devices()/jax.local_devices() enumeration is
+# allowed ONLY here — device placement must go through the mesh helpers
+# (parallel/mesh.local_devices / device_count / make_mesh) so mesh policy
+# lives in one file; bench.py is exempt (it probes the backend on purpose).
+# Runs under BOTH the ruff path and the fallback (ruff has no such rule).
+DEVICE_ENUM_ALLOWED = (
+    os.path.join("seaweedfs_tpu", "parallel", "mesh.py"),
+    "bench.py",
+)
+
 
 def _python_files() -> list[str]:
     out = []
@@ -86,6 +96,45 @@ class _CompareVisitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _DeviceEnumVisitor(ast.NodeVisitor):
+    """SWFS001: `jax.devices()` / `jax.local_devices()` outside the mesh
+    helpers (see DEVICE_ENUM_ALLOWED)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list[str] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) \
+                and f.attr in ("devices", "local_devices") \
+                and isinstance(f.value, ast.Name) and f.value.id == "jax":
+            self.findings.append(
+                f"{self.path}:{node.lineno}: SWFS001 bare jax.{f.attr}() "
+                f"— device placement must go through "
+                f"seaweedfs_tpu/parallel/mesh.py helpers")
+        self.generic_visit(node)
+
+
+def run_device_rule(files: list[str] | None = None) -> list[str]:
+    """The in-repo device-enumeration rule; returns findings (files that
+    fail to parse are the syntax gate's business, not this rule's)."""
+    findings: list[str] = []
+    for path in (files if files is not None else _python_files()):
+        rel = os.path.relpath(path, REPO)
+        if rel in DEVICE_ENUM_ALLOWED:
+            continue
+        try:
+            with open(path, "rb") as f:
+                tree = ast.parse(f.read(), filename=rel)
+        except SyntaxError:
+            continue
+        v = _DeviceEnumVisitor(rel)
+        v.visit(tree)
+        findings.extend(v.findings)
+    return findings
+
+
 def run_fallback() -> int:
     findings: list[str] = []
     for path in _python_files():
@@ -110,9 +159,13 @@ def run_fallback() -> int:
 
 
 def main() -> int:
-    if shutil.which("ruff"):
-        return run_ruff()
-    return run_fallback()
+    rc = run_ruff() if shutil.which("ruff") else run_fallback()
+    dev = run_device_rule()
+    for finding in dev:
+        print(finding)
+    if dev and rc == 0:
+        rc = 1
+    return rc
 
 
 if __name__ == "__main__":
