@@ -20,6 +20,7 @@ from concurrent import futures
 import grpc
 
 from . import (
+    ec_stream_pb2,
     filer_pb2,
     master_pb2,
     mount_pb2,
@@ -131,6 +132,17 @@ VOLUME_SERVICE = ("volume_server_pb.VolumeServer", [
        scrub_pb2.VolumeScrubResponse),
     _m("ScrubStatus", scrub_pb2.ScrubStatusRequest,
        scrub_pb2.ScrubStatusResponse),
+    # streaming replica->EC conversion (ec_stream.proto; messages in
+    # pb/ec_stream_pb2.py): the source pushes shard slabs to their
+    # destinations WHILE the encode runs (storage/ec_stream.py)
+    _m("VolumeEcShardsStream", ec_stream_pb2.VolumeEcShardsStreamRequest,
+       ec_stream_pb2.VolumeEcShardsStreamResponse, cs=True),
+    _m("VolumeEcShardsStreamStatus",
+       ec_stream_pb2.VolumeEcShardsStreamStatusRequest,
+       ec_stream_pb2.VolumeEcShardsStreamStatusResponse),
+    _m("VolumeEcShardsGenerateStreamed",
+       ec_stream_pb2.VolumeEcShardsGenerateStreamedRequest,
+       ec_stream_pb2.VolumeEcShardsGenerateStreamedResponse),
 ])
 
 FILER_SERVICE = ("filer_pb.SeaweedFiler", [

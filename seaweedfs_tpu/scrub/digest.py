@@ -175,3 +175,55 @@ class ShardCrc:
     shard_id: int
     crc: int
     size: int
+
+
+# EC shard-digest manifest (<base>.dig for an EC'd volume — a plain
+# volume never coexists with shards under the same base once encoded,
+# and the magic disambiguates). Written by the streaming-EC destination
+# at commit time from digests it chained WHILE writing (no second read,
+# ISSUE 6) and refreshed by syndrome sweeps; read back by
+# Scrubber.cached_ec_digest so VolumeDigest answers from it.
+#
+# Format (golden-pinned by tests/test_ec_stream.py):
+#     magic   8B  b"SWFSDGE\n"
+#     count   8B  big-endian entry count
+#     entries 16B each, ascending shard id:
+#             shard_id(4, BE) crc(4, BE) size(8, BE)
+
+EC_MAGIC = b"SWFSDGE\n"
+EC_ENTRY_SIZE = 16
+
+
+def write_ec_manifest(base_file_name: str,
+                      shard_crcs: dict[int, ShardCrc]) -> str:
+    """Persist `<base>.dig` (EC form) atomically; returns the path."""
+    path = base_file_name + ".dig"
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(EC_MAGIC)
+        f.write(len(shard_crcs).to_bytes(8, "big"))
+        for sid in sorted(shard_crcs):
+            sc = shard_crcs[sid]
+            f.write(sid.to_bytes(4, "big")
+                    + (sc.crc & 0xFFFFFFFF).to_bytes(4, "big")
+                    + sc.size.to_bytes(8, "big"))
+    os.replace(tmp, path)
+    return path
+
+
+def read_ec_manifest(path: str) -> dict[int, ShardCrc]:
+    with open(path, "rb") as f:
+        blob = f.read()
+    if blob[:8] != EC_MAGIC:
+        raise IOError(f"{path}: not an EC shard-digest manifest")
+    count = int.from_bytes(blob[8:16], "big")
+    body = blob[16:]
+    if len(body) != count * EC_ENTRY_SIZE:
+        raise IOError(f"{path}: truncated EC manifest")
+    out: dict[int, ShardCrc] = {}
+    for i in range(count):
+        e = body[i * EC_ENTRY_SIZE:(i + 1) * EC_ENTRY_SIZE]
+        sid = int.from_bytes(e[0:4], "big")
+        out[sid] = ShardCrc(sid, int.from_bytes(e[4:8], "big"),
+                            int.from_bytes(e[8:16], "big"))
+    return out

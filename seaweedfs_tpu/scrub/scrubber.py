@@ -342,16 +342,49 @@ class Scrubber:
             except Exception as e:  # noqa: BLE001 — keep the daemon alive
                 glog.warning(f"scrub sweep failed: {e}")
 
-    def invalidate_ec_digest(self, vid: int) -> None:
-        """Shard files changed (mount/unmount/delete/rebuild): drop the
-        cached per-shard CRCs so VolumeDigest never serves stale ones."""
+    def invalidate_ec_digest(self, vid: int,
+                             remove_manifest: bool = False) -> None:
+        """Shard files changed: drop the cached per-shard CRCs so
+        VolumeDigest never serves stale ones. `remove_manifest` also
+        unlinks the on-disk `.dig` EC manifest — pass it from handlers
+        that change shard BYTES (copy/rebuild/delete); plain
+        mount/unmount only reopen the same files, and the manifest
+        fallback below revalidates the shard set + sizes anyway."""
         self._ec_digests.pop(vid, None)
+        if remove_manifest:
+            for loc in self.store.locations:
+                _vols, ecs = loc.scan()
+                col = ecs.get(vid, ("",))[0] if vid in ecs else ""
+                for base in {loc.base_name(col, vid),
+                             loc.base_name("", vid)}:
+                    try:
+                        os.remove(base + ".dig")
+                    except OSError:
+                        pass
 
     def cached_ec_digest(self, vid: int) -> dict | None:
-        """Per-shard CRCs folded by the last clean syndrome sweep (None
-        when uncached) — the read half of invalidate_ec_digest's
-        contract, so callers never touch the dict directly."""
-        return self._ec_digests.get(vid)
+        """Per-shard CRCs folded by the last clean syndrome sweep, or —
+        when memory has nothing — read back from the `.dig` manifest the
+        streaming-EC destination persisted at commit (ISSUE 6), validated
+        against the mounted shard set and file sizes. None when neither
+        source can answer; callers never touch the dict directly."""
+        got = self._ec_digests.get(vid)
+        if got is not None:
+            return got
+        ev = self.store.find_ec_volume(vid)
+        if ev is None:
+            return None
+        try:
+            manifest = digest_mod.read_ec_manifest(ev.base + ".dig")
+        except (IOError, OSError):
+            return None
+        out: dict[int, digest_mod.ShardCrc] = {}
+        for sid, f in ev.shard_files.items():
+            sc = manifest.get(sid)
+            if sc is None or sc.size != f.size():
+                return None  # manifest describes other shard files
+            out[sid] = sc
+        return out or None
 
     def report_suspect(self, vid: int) -> None:
         """Serving-path hook: a read smelled corruption in `vid` — queue a
@@ -728,7 +761,7 @@ class Scrubber:
             coder = self._geo_coder(geo)
             rebuilt = rebuild_ec_files(base, coder, geo)
             self.store.mount_ec_shards(vid, collection, rebuilt)
-            self.invalidate_ec_digest(vid)
+            self.invalidate_ec_digest(vid, remove_manifest=True)
             srv = self.server
             if srv is not None:
                 srv.ec_recon_cache.invalidate(vid)

@@ -1005,9 +1005,9 @@ class VolumeGrpc:
 
     # ---- erasure coding (volume_grpc_erasure_coding.go) ------------------
 
-    def VolumeEcShardsGenerate(self, request, context):
-        """.dat -> .ec00.. + .ecx + .vif (handler :38-81). The stripe math
-        runs through the store's (TPU) coder."""
+    def _generate_prologue(self, request, context):
+        """Shared head of the plain and streamed generate handlers:
+        -> (volume, geometry, coder)."""
         v = self.store.find_volume(request.volume_id)
         if v is None:
             context.abort(grpc.StatusCode.NOT_FOUND,
@@ -1020,15 +1020,9 @@ class VolumeGrpc:
                            parity_shards=request.parity_shards or 4,
                            large_block=geo.large_block,
                            small_block=geo.small_block)
-        coder = self.store.coder
-        if (coder.data_shards, coder.parity_shards) != (geo.data_shards,
-                                                        geo.parity_shards):
-            from ..models.coder import new_coder
+        return v, geo, self._geo_coder(geo)
 
-            coder = new_coder(geo.data_shards, geo.parity_shards)
-        base = v.file_name()
-        t0 = time.perf_counter()
-        enc_stats = write_ec_files(base, coder, geo)
+    def _generate_epilogue(self, v, geo, base, t0, enc_stats) -> None:
         write_sorted_file_from_idx(base)
         from ..storage.ec_volume import save_volume_info
 
@@ -1043,7 +1037,236 @@ class VolumeGrpc:
                   f"(read {enc_stats.read_s:.2f}s, device-wait "
                   f"{enc_stats.device_wait_s:.2f}s, write {enc_stats.write_s:.2f}s, "
                   f"overlap x{enc_stats.overlap_ratio:.2f})")
+
+    def VolumeEcShardsGenerate(self, request, context):
+        """.dat -> .ec00.. + .ecx + .vif (handler :38-81). The stripe math
+        runs through the store's (TPU) coder."""
+        v, geo, coder = self._generate_prologue(request, context)
+        base = v.file_name()
+        t0 = time.perf_counter()
+        enc_stats = write_ec_files(base, coder, geo)
+        self._generate_epilogue(v, geo, base, t0, enc_stats)
         return vs.VolumeEcShardsGenerateResponse()
+
+    def VolumeEcShardsGenerateStreamed(self, request, context):
+        """ISSUE 6 tentpole: generate shards AND push each remote
+        destination's shards to it while the encode is still running —
+        network transfer, GF matmul and destination shard I/O all in
+        flight concurrently (storage/ec_stream.py). Local shard files
+        are still written (the source keeps its own shards; they are
+        also the resume source after a destination flap)."""
+        from ..pb import ec_stream_pb2 as es
+        from ..storage.ec_stream import EcStreamDestination, EcStreamSinkSet
+        from ..utils.stats import EC_STREAM_OVERLAP_RATIO
+
+        v, geo, coder = self._generate_prologue(request, context)
+        base = v.file_name()
+        shard_size = geo.shard_size(v.data_size())
+        dests = [
+            EcStreamDestination(
+                t.address, request.volume_id, request.collection,
+                list(t.shard_ids), base, geo, shard_size,
+                source=self.srv.address)
+            for t in request.targets if t.shard_ids
+        ]
+        t0 = time.perf_counter()
+        sinks = EcStreamSinkSet(dests)
+        try:
+            enc_stats = write_ec_files(base, coder, geo, sinks=sinks)
+        except BaseException:
+            sinks.abort()
+            raise
+        resp = es.VolumeEcShardsGenerateStreamedResponse()
+
+        def finish_one(d):
+            # per-destination verdict; finish() may run a full
+            # missing-range resume with retries, so destinations must
+            # not serialize behind each other's catch-up
+            try:
+                d.finish()
+                return d, None
+            except BaseException as e:  # noqa: BLE001
+                return d, (d.error or f"{type(e).__name__}: {e}")
+
+        results = []
+        if dests:
+            with ThreadPoolExecutor(max_workers=len(dests)) as ex:
+                results = list(ex.map(finish_one, dests))
+        for d, err in results:
+            r = resp.targets.add(address=d.address)
+            if err is None:
+                r.ok = True
+            else:
+                r.ok = False
+                r.error = err
+                glog.warning(f"ec stream vol {v.id} -> {d.address} "
+                             f"failed after retries: {err}; caller "
+                             f"falls back to VolumeEcShardsCopy")
+            r.bytes_streamed = d.bytes_streamed
+            r.resumes = d.resumes
+            r.resumed_bytes = d.resumed_bytes
+            resp.bytes_streamed += d.bytes_streamed
+            resp.resumes += d.resumes
+        wall = time.perf_counter() - t0
+        self._generate_epilogue(v, geo, base, t0, enc_stats)
+        resp.encode_seconds = enc_stats.wall_s
+        resp.wall_seconds = wall
+        resp.overlap_ratio = enc_stats.wall_s / wall if wall > 0 else 0.0
+        if dests:
+            EC_STREAM_OVERLAP_RATIO.set(resp.overlap_ratio)
+        return resp
+
+    # ---- streaming shard receive (ec_stream.proto; ISSUE 6) --------------
+
+    def VolumeEcShardsStream(self, request_iterator, context):
+        """Destination side of the pipelined archival encode: append
+        shard slabs as they arrive (in offset order per shard), verify
+        each slab's crc32c in transit, chain per-shard digests while
+        writing, and at commit check them against the source's
+        crc32c_combine-folded expectation — then persist the `.dig`
+        manifest without re-reading a byte. `resume=True` continues
+        after this server's on-disk prefix (the prefix digest is
+        re-chained from disk, the only re-read on the resume path)."""
+        from ..scrub.digest import ShardCrc, write_ec_manifest
+        from ..storage.crc import crc32c, crc32c_combine
+        from ..utils.stats import EC_STREAM_BYTES, EC_STREAM_SLABS
+
+        it = iter(request_iterator)
+        first = next(it, None)
+        if first is None or not first.HasField("header"):
+            context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                          "first stream message must be the header")
+        h = first.header
+        loc = self.store.locations[0]
+        base = loc.base_name(h.collection, h.volume_id)
+        geo = self.srv.ec_geometry
+        files: dict[int, object] = {}
+        digests: dict[int, int] = {}
+        sizes: dict[int, int] = {}
+        phase = "resume" if h.resume else "live"
+        received = 0
+        commit = None
+        try:
+            for sid in h.shard_ids:
+                path = geo.shard_file_name(base, sid)
+                if h.resume and os.path.exists(path):
+                    f = open(path, "r+b")
+                    crc = 0
+                    n = 0
+                    while True:  # re-chain the digest over the prefix
+                        chunk = f.read(1 << 20)
+                        if not chunk:
+                            break
+                        crc = crc32c(chunk, crc)
+                        n += len(chunk)
+                    digests[sid], sizes[sid] = crc, n
+                else:
+                    f = open(path, "wb")
+                    digests[sid], sizes[sid] = 0, 0
+                files[sid] = f
+            for msg in it:
+                if msg.HasField("slab"):
+                    s = msg.slab
+                    # chaos hook (ISSUE 6): a targeted destination drops
+                    # mid-stream; the source resumes from this server's
+                    # reported on-disk prefix. Matchable per shard AND
+                    # per slab range (comma-terminated ctx convention).
+                    try:
+                        failpoint.fail(
+                            "ec.stream.slab",
+                            ctx=f"{self.srv.address}, "
+                                f"shard={s.shard_id}, off={s.offset},")
+                    except failpoint.FailpointError as e:
+                        context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+                    f = files.get(s.shard_id)
+                    if f is None:
+                        context.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                      f"shard {s.shard_id} not in header")
+                    slab_crc = crc32c(s.data)
+                    if slab_crc != s.crc:
+                        context.abort(grpc.StatusCode.DATA_LOSS,
+                                      f"slab crc mismatch in transit "
+                                      f"(shard {s.shard_id} @ {s.offset})")
+                    if s.offset != sizes[s.shard_id]:
+                        context.abort(
+                            grpc.StatusCode.FAILED_PRECONDITION,
+                            f"non-contiguous slab for shard {s.shard_id}:"
+                            f" offset {s.offset}, have {sizes[s.shard_id]}")
+                    if f.tell() != s.offset:  # interleaved shards only
+                        f.seek(s.offset)
+                    f.write(s.data)
+                    sizes[s.shard_id] += len(s.data)
+                    # chain via the O(32^2) combine fold instead of a
+                    # second full crc pass over the slab bytes
+                    digests[s.shard_id] = crc32c_combine(
+                        digests[s.shard_id], slab_crc, len(s.data))
+                    received += len(s.data)
+                    EC_STREAM_BYTES.inc(len(s.data), role="dest",
+                                        phase=phase)
+                    EC_STREAM_SLABS.inc(role="dest", phase=phase)
+                elif msg.HasField("commit"):
+                    commit = msg.commit
+                    break
+            if commit is None:
+                context.abort(grpc.StatusCode.ABORTED,
+                              "stream ended without commit")
+            for f in files.values():
+                f.flush()
+                if os.environ.get("SWFS_EC_STREAM_FSYNC", "0").lower() \
+                        in ("1", "true", "on"):
+                    # off by default: the VolumeEcShardsCopy path the
+                    # stream replaces never fsyncs either (the source
+                    # holds every shard until the shell's delete step,
+                    # so a crashed destination is simply re-streamed)
+                    os.fsync(f.fileno())
+            for d in commit.digests:
+                if d.shard_id not in files:
+                    continue
+                if (sizes[d.shard_id], digests[d.shard_id]) != (d.size,
+                                                                d.crc):
+                    context.abort(
+                        grpc.StatusCode.DATA_LOSS,
+                        f"shard {d.shard_id} digest mismatch at commit: "
+                        f"wrote size={sizes[d.shard_id]} "
+                        f"crc={digests[d.shard_id]:#x}, source expects "
+                        f"size={d.size} crc={d.crc:#x}")
+            # the PR-4 digest manifest falls out of the digests chained
+            # while writing — no second read (cached_ec_digest serves
+            # VolumeDigest from it once the shards mount)
+            write_ec_manifest(base, {
+                sid: ShardCrc(sid, digests[sid], sizes[sid])
+                for sid in files})
+            from ..pb import ec_stream_pb2 as es
+
+            resp = es.VolumeEcShardsStreamResponse(bytes_received=received)
+            for sid in sorted(files):
+                resp.shards.add(shard_id=sid, crc=digests[sid],
+                                size=sizes[sid])
+            return resp
+        finally:
+            for f in files.values():
+                try:
+                    f.close()
+                except OSError:
+                    pass
+
+    def VolumeEcShardsStreamStatus(self, request, context):
+        """Resume probe: contiguous bytes of each requested shard durably
+        on this server's disk (slabs arrive in offset order, so file
+        size IS the complete prefix length)."""
+        from ..pb import ec_stream_pb2 as es
+
+        loc = self.store.locations[0]
+        base = loc.base_name(request.collection, request.volume_id)
+        geo = self.srv.ec_geometry
+        resp = es.VolumeEcShardsStreamStatusResponse()
+        for sid in request.shard_ids:
+            try:
+                size = os.path.getsize(geo.shard_file_name(base, sid))
+            except OSError:
+                size = 0
+            resp.shards.add(shard_id=sid, size=size)
+        return resp
 
     def VolumeEcShardsRebuild(self, request, context):
         """Regenerate missing .ecXX from survivors (handler :84-123)."""
@@ -1054,12 +1277,20 @@ class VolumeGrpc:
         from ..storage.ec_volume import rebuild_ecx_file
 
         rebuild_ecx_file(base)
-        self.srv.scrubber.invalidate_ec_digest(request.volume_id)
+        self.srv.scrubber.invalidate_ec_digest(request.volume_id,
+                                               remove_manifest=True)
         self.srv.trigger_heartbeat()
         return vs.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
 
     def VolumeEcShardsCopy(self, request, context):
-        """Pull shard files from source_data_node (handler :126-177)."""
+        """Pull shard files from source_data_node (handler :126-177).
+        Instrumented with byte/throughput counters so A/Bs against the
+        ISSUE-6 streaming path compare like for like."""
+        from ..utils.stats import (
+            EC_COPY_FALLBACK_BYTES,
+            EC_COPY_FALLBACK_SECONDS,
+        )
+
         loc = self.store.locations[0]
         base = loc.base_name(request.collection, request.volume_id)
         src = rpc.volume_stub(rpc.grpc_address(request.source_data_node))
@@ -1070,14 +1301,24 @@ class VolumeGrpc:
             exts.append(".ecj")
         if request.copy_vif_file:
             exts.append(".vif")
+        t0 = time.perf_counter()
         for ext in exts:
+            kind = "shard" if ext.startswith(".ec") and ext[3:].isdigit() \
+                else "index"
             with open(base + ext, "wb") as f:
                 for chunk in src.CopyFile(vs.CopyFileRequest(
                         volume_id=request.volume_id, ext=ext,
                         collection=request.collection, is_ec_volume=True,
                         ignore_source_file_not_found=(ext == ".ecj")),
                         timeout=3600):
+                    # simulated-WAN hook, mirror of ec.stream.slab's
+                    # delay mode: the stream-vs-copy A/B arms BOTH so a
+                    # per-chunk wire latency hits the paths symmetrically
+                    failpoint.delay("ec.copy.chunk",
+                                    ctx=f"{self.srv.address},")
                     f.write(chunk.file_content)
+                    EC_COPY_FALLBACK_BYTES.inc(len(chunk.file_content),
+                                               kind=kind)
             if ext == ".ecj" and os.path.getsize(base + ext) == 0:
                 os.remove(base + ext)
             if ext == ".ecx":
@@ -1088,7 +1329,12 @@ class VolumeGrpc:
                 sync_stride_marker(src, request.volume_id,
                                    request.collection, base,
                                    ext=".ecx.lrg", is_ec=True)
-        self.srv.scrubber.invalidate_ec_digest(request.volume_id)
+        EC_COPY_FALLBACK_SECONDS.inc(time.perf_counter() - t0)
+        # an index-only copy (the streaming path ships shard bytes itself
+        # and pulls just .ecx/.ecj/.vif here) leaves shard bytes — and
+        # therefore the streamed `.dig` manifest — intact
+        self.srv.scrubber.invalidate_ec_digest(
+            request.volume_id, remove_manifest=bool(request.shard_ids))
         return vs.VolumeEcShardsCopyResponse()
 
     def VolumeEcShardsDelete(self, request, context):
@@ -1096,7 +1342,12 @@ class VolumeGrpc:
         (handler :181-264)."""
         for loc in self.store.locations:
             base = loc.base_name(request.collection, request.volume_id)
-            if not os.path.exists(base + ".ecx"):
+            if not os.path.exists(base + ".ecx") and not any(
+                    os.path.exists(base + f".ec{sid:02d}")
+                    for sid in request.shard_ids):
+                # (streamed shard files can exist before any .ecx does —
+                # a rollback after a failed streamed encode must still
+                # be able to clean them up)
                 continue
             for sid in request.shard_ids:
                 try:
@@ -1108,7 +1359,7 @@ class VolumeGrpc:
                        for i in range(geo.total_shards)):
                 # the per-index marker goes with its .ecx — a stale one
                 # would falsely refuse a later re-encode in the other mode
-                for ext in (".ecx", ".ecj", ".vif", ".ecx.lrg"):
+                for ext in (".ecx", ".ecj", ".vif", ".ecx.lrg", ".dig"):
                     try:
                         os.remove(base + ext)
                     except FileNotFoundError:
@@ -1121,7 +1372,8 @@ class VolumeGrpc:
                     self.store.mount_ec_shards(
                         request.volume_id, request.collection, [])
         self.srv.ec_recon_cache.invalidate(request.volume_id)
-        self.srv.scrubber.invalidate_ec_digest(request.volume_id)
+        self.srv.scrubber.invalidate_ec_digest(request.volume_id,
+                                               remove_manifest=True)
         self.srv.trigger_heartbeat()
         return vs.VolumeEcShardsDeleteResponse()
 
@@ -1533,6 +1785,7 @@ def _make_http_handler(srv: VolumeServer):
                                      or v._gc_frozen}
                 from ..utils.stats import (
                     ec_dispatch_stats,
+                    ec_stream_stats,
                     group_commit_stats,
                     scrub_stats,
                 )
@@ -1554,6 +1807,10 @@ def _make_http_handler(srv: VolumeServer):
                         **ec_dispatch_stats(),
                         "chipDepth": srv.ec_dispatch_depths(),
                     },
+                    # streaming replica->EC conversion (ISSUE 6):
+                    # live/resume byte flow, in-flight depth, overlap
+                    # ratio, and the copy-fallback comparands
+                    "EcStream": ec_stream_stats(),
                     # integrity plane (ISSUE 4): sweep cursors, findings
                     # lifecycle, repair outcomes, pacing
                     "Scrub": {**srv.scrubber.status(),

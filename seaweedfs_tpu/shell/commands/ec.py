@@ -13,10 +13,11 @@ hard-coded to 10+4 in the reference, SURVEY.md §2.2).
 from __future__ import annotations
 
 import argparse
+import os
 from collections import defaultdict
 from concurrent.futures import ThreadPoolExecutor
 
-from ...pb import master_pb2, volume_server_pb2 as vs
+from ...pb import ec_stream_pb2 as es, master_pb2, volume_server_pb2 as vs
 from ..registry import command
 
 
@@ -64,6 +65,13 @@ def ec_encode(env, args, out):
                         "VolumeEcShardsGenerate pipelines on one server "
                         "coalesce into stacked device dispatches "
                         "(ops/dispatch.py)")
+    p.add_argument("-stream", type=int, default=None, choices=(0, 1),
+                   help="pipelined encode+distribute (ISSUE 6): placement "
+                        "is computed BEFORE encoding and each "
+                        "destination's shards stream to it while the GF "
+                        "matmul runs (default on; env escape hatch "
+                        "SWFS_EC_STREAM=0). 0 = classic "
+                        "generate-then-copy")
     opts = p.parse_args(args)
     env.confirm_is_locked()
 
@@ -132,31 +140,21 @@ class _SharedPlacement:
         self.rack_load: dict[tuple[str, str], int] = defaultdict(int)
 
 
-def _do_ec_encode(env, vid: int, opts, out, shared=None) -> None:
-    locations = _volume_locations(env, vid)
-    if not locations:
-        raise ValueError(f"volume {vid} not found in topology")
-    source = locations[0]
-    collection = opts.collection or _find_collection(env, vid)
+def _stream_enabled(opts) -> bool:
+    """-stream flag wins; else SWFS_EC_STREAM env (default on)."""
+    if getattr(opts, "stream", None) is not None:
+        return bool(opts.stream)
+    return os.environ.get("SWFS_EC_STREAM", "1").lower() not in (
+        "0", "false", "off")
 
-    # 1. freeze writes on every replica (markVolumeReplicasWritable false)
-    for addr in locations:
-        env.volume_stub(addr).VolumeMarkReadonly(
-            vs.VolumeMarkReadonlyRequest(volume_id=vid), timeout=30)
 
-    # 2. generate shards on the source server (TPU-side hot loop)
-    env.volume_stub(source).VolumeEcShardsGenerate(
-        vs.VolumeEcShardsGenerateRequest(
-            volume_id=vid, collection=collection,
-            data_shards=opts.dataShards, parity_shards=opts.parityShards),
-        timeout=24 * 3600)
-    total_shards = ((opts.dataShards or 10) + (opts.parityShards or 4))
-    print(f"volume {vid}: generated {total_shards} shards on {source}", file=out)
-
-    # 3. spread shards across servers (balancedEcDistribution + parallel
-    # copy), rack-aware: losing one rack must cost as few shards of this
-    # volume as possible (the reference README's "rack-aware placement";
-    # pickRackToBalanceShardsInto in command_ec_balance.go)
+def _plan_placement(env, total_shards: int, shared) -> dict[str, list[int]]:
+    """Spread shards across servers (balancedEcDistribution), rack-aware:
+    losing one rack must cost as few shards of this volume as possible
+    (the reference README's "rack-aware placement";
+    pickRackToBalanceShardsInto in command_ec_balance.go). In streaming
+    mode this runs BEFORE the encode so shard bytes go straight to their
+    destinations."""
     topo = env.volume_list().topology_info  # one snapshot for both views
     nodes = _collect_ec_nodes(env, topo)
     if not nodes:
@@ -164,8 +162,6 @@ def _do_ec_encode(env, vid: int, opts, out, shared=None) -> None:
     racks = env.node_racks(topo)
     alloc: dict[str, list[int]] = defaultdict(list)
     rack_load: dict[tuple[str, str], int] = defaultdict(int)
-    if shared is None:
-        shared = _SharedPlacement()  # serial path: ledger is a no-op
     with shared.lock:
         for sid in range(total_shards):
             nodes.sort(key=lambda n: (
@@ -179,6 +175,95 @@ def _do_ec_encode(env, vid: int, opts, out, shared=None) -> None:
         for node, sids in alloc.items():
             shared.node_load[node] += len(sids)
             shared.rack_load[racks.get(node, ("", node))] += len(sids)
+    return alloc
+
+
+def _do_ec_encode(env, vid: int, opts, out, shared=None) -> None:
+    locations = _volume_locations(env, vid)
+    if not locations:
+        raise ValueError(f"volume {vid} not found in topology")
+    source = locations[0]
+    collection = opts.collection or _find_collection(env, vid)
+    total_shards = ((opts.dataShards or 10) + (opts.parityShards or 4))
+    if shared is None:
+        shared = _SharedPlacement()  # serial path: ledger is a no-op
+    stream = _stream_enabled(opts)
+
+    # 1. freeze writes on every replica (markVolumeReplicasWritable false)
+    frozen: list[str] = []
+    for addr in locations:
+        env.volume_stub(addr).VolumeMarkReadonly(
+            vs.VolumeMarkReadonlyRequest(volume_id=vid), timeout=30)
+        frozen.append(addr)
+
+    # 2+3. generate + distribute + mount. Any failure BEFORE the plain
+    # volume is deleted rolls the replicas back to writable — the
+    # conversion never happened, so the volume must not stay frozen
+    # (pre-ISSUE-6 bug: every failed encode left read-only replicas).
+    try:
+        if stream:
+            alloc = _do_stream_encode(env, vid, collection, source,
+                                      total_shards, opts, shared, out)
+        else:
+            alloc = _do_copy_encode(env, vid, collection, source,
+                                    total_shards, opts, shared, out)
+    except BaseException:
+        for addr in frozen:
+            try:
+                env.volume_stub(addr).VolumeMarkWritable(
+                    vs.VolumeMarkWritableRequest(volume_id=vid),
+                    timeout=30)
+            except Exception:  # noqa: BLE001 — best-effort rollback
+                pass
+        raise
+
+    # 4. retire moved shards from source + delete the plain volume
+    moved = [sid for t, sids in alloc.items() if t != source for sid in sids]
+    if moved:
+        env.volume_stub(source).VolumeEcShardsDelete(
+            vs.VolumeEcShardsDeleteRequest(
+                volume_id=vid, collection=collection, shard_ids=moved),
+            timeout=60)
+    for addr in locations:
+        env.volume_stub(addr).VolumeDelete(
+            vs.VolumeDeleteRequest(volume_id=vid), timeout=60)
+    spread = {t: sids for t, sids in alloc.items() if sids}
+    print(f"volume {vid}: shards spread {dict(spread)}", file=out)
+
+
+def _cleanup_targets(env, vid, collection, targets) -> None:
+    """Best-effort unwind of a failed distribute: unmount + delete this
+    volume's shards at every target so no destination keeps serving (or
+    advertising) EC shards of a volume whose conversion is being rolled
+    back to plain replicas."""
+    for target in targets:
+        try:
+            env.volume_stub(target).VolumeEcShardsUnmount(
+                vs.VolumeEcShardsUnmountRequest(
+                    volume_id=vid, shard_ids=list(range(32))), timeout=60)
+        except Exception:  # noqa: BLE001 — nothing may be mounted yet
+            pass
+        try:
+            env.volume_stub(target).VolumeEcShardsDelete(
+                vs.VolumeEcShardsDeleteRequest(
+                    volume_id=vid, collection=collection,
+                    shard_ids=list(range(32))), timeout=60)
+        except Exception:  # noqa: BLE001 — best-effort cleanup
+            pass
+
+
+def _do_copy_encode(env, vid, collection, source, total_shards, opts,
+                    shared, out) -> dict[str, list[int]]:
+    """Classic three-phase path: generate all shards on the source, THEN
+    copy them to their destinations, then mount."""
+    env.volume_stub(source).VolumeEcShardsGenerate(
+        vs.VolumeEcShardsGenerateRequest(
+            volume_id=vid, collection=collection,
+            data_shards=opts.dataShards, parity_shards=opts.parityShards),
+        timeout=24 * 3600)
+    print(f"volume {vid}: generated {total_shards} shards on {source}",
+          file=out)
+    alloc = _plan_placement(env, total_shards, shared)
 
     def copy_to(target_and_sids):
         target, sids = target_and_sids
@@ -193,21 +278,82 @@ def _do_ec_encode(env, vid: int, opts, out, shared=None) -> None:
                 volume_id=vid, collection=collection, shard_ids=sids),
             timeout=60)
 
-    with ThreadPoolExecutor(max_workers=max(1, opts.parallelCopy)) as ex:
-        list(ex.map(copy_to, alloc.items()))
+    try:
+        with ThreadPoolExecutor(max_workers=max(1, opts.parallelCopy)) as ex:
+            list(ex.map(copy_to, alloc.items()))
+    except BaseException:
+        # one target's copy/mount failed AFTER others may have mounted:
+        # un-advertise everything before the caller restores the plain
+        # replicas to writable, or stale EC locations would shadow them
+        _cleanup_targets(env, vid, collection,
+                         [t for t in alloc if t != source])
+        raise
+    return alloc
 
-    # 4. retire moved shards from source + delete the plain volume
-    moved = [sid for t, sids in alloc.items() if t != source for sid in sids]
-    if moved:
-        env.volume_stub(source).VolumeEcShardsDelete(
-            vs.VolumeEcShardsDeleteRequest(
-                volume_id=vid, collection=collection, shard_ids=moved),
+
+def _do_stream_encode(env, vid, collection, source, total_shards, opts,
+                      shared, out) -> dict[str, list[int]]:
+    """ISSUE-6 pipelined path: placement FIRST, then one
+    VolumeEcShardsGenerateStreamed that encodes and pushes each remote
+    destination's shards to it while the GF matmul is still running. A
+    destination the stream could not finish (even after slab-range
+    resume) falls back to the classic copy — the source holds all shard
+    files either way, so the conversion still completes."""
+    alloc = _plan_placement(env, total_shards, shared)
+    req = es.VolumeEcShardsGenerateStreamedRequest(
+        volume_id=vid, collection=collection,
+        data_shards=opts.dataShards, parity_shards=opts.parityShards)
+    for target, sids in alloc.items():
+        if target != source and sids:
+            req.targets.add(address=target, shard_ids=sids)
+    try:
+        resp = env.volume_stub(source).VolumeEcShardsGenerateStreamed(
+            req, timeout=24 * 3600)
+    except BaseException:
+        # destinations may hold partially streamed .ecXX files with no
+        # .ecx — clean them best-effort so a failed encode leaks
+        # neither disk nor a stale shard set (the outer handler still
+        # restores replica writability)
+        _cleanup_targets(env, vid, collection,
+                         [t.address for t in req.targets])
+        raise
+    failed = {r.address for r in resp.targets if not r.ok}
+    resumed = sum(r.resumes for r in resp.targets)
+    print(f"volume {vid}: streamed {total_shards} shards from {source} "
+          f"({resp.bytes_streamed} bytes overlapped, overlap ratio "
+          f"{resp.overlap_ratio:.2f}"
+          + (f", {resumed} resume(s)" if resumed else "")
+          + (f", fallback copy for {sorted(failed)}" if failed else "")
+          + ")", file=out)
+
+    def finish_target(target_and_sids):
+        target, sids = target_and_sids
+        if target != source:
+            # streamed destinations only need the index files; failed
+            # ones pull their shard bytes too (generate-then-copy)
+            env.volume_stub(target).VolumeEcShardsCopy(
+                vs.VolumeEcShardsCopyRequest(
+                    volume_id=vid, collection=collection,
+                    shard_ids=sids if target in failed else [],
+                    copy_ecx_file=True, copy_ecj_file=True,
+                    copy_vif_file=True, source_data_node=source),
+                timeout=3600)
+        env.volume_stub(target).VolumeEcShardsMount(
+            vs.VolumeEcShardsMountRequest(
+                volume_id=vid, collection=collection, shard_ids=sids),
             timeout=60)
-    for addr in locations:
-        env.volume_stub(addr).VolumeDelete(
-            vs.VolumeDeleteRequest(volume_id=vid), timeout=60)
-    spread = {t: sids for t, sids in alloc.items() if sids}
-    print(f"volume {vid}: shards spread {dict(spread)}", file=out)
+
+    try:
+        with ThreadPoolExecutor(max_workers=max(1, opts.parallelCopy)) as ex:
+            list(ex.map(finish_target, alloc.items()))
+    except BaseException:
+        # mirror of _do_copy_encode: a failed mount/index-copy must not
+        # leave other destinations' already-mounted shards advertised
+        # while the plain replicas come back writable
+        _cleanup_targets(env, vid, collection,
+                         [t for t in alloc if t != source])
+        raise
+    return alloc
 
 
 def _find_collection(env, vid: int) -> str:

@@ -19,6 +19,8 @@ out-of-order or parallel verification folds (scrub/digest.py
 
 from __future__ import annotations
 
+import threading
+
 _POLY = 0x82F63B78  # reversed 0x1EDC6F41 (Castagnoli)
 
 
@@ -102,34 +104,77 @@ def _gf2_matrix_square(mat: list[int]) -> list[int]:
     return [_gf2_matrix_times(mat, mat[n]) for n in range(32)]
 
 
+# byte-granular zero operators: _BYTE_POWS[k] advances a CRC through
+# 2^k zero BYTES; extended lazily, shared by every combine call. On top,
+# _SHIFT_CACHE memoizes the composed operator per len2 — the streaming
+# EC plane folds thousands of same-sized slab CRCs (1MB slabs, 64KB
+# small rows), so after the first fold each combine is one 32-row
+# matrix-vector apply instead of ~log(len2) matrix squarings.
+_BYTE_POWS: list[list[int]] = []
+_SHIFT_CACHE: dict[int, list[int]] = {}
+_SHIFT_CACHE_MAX = 1024  # distinct slab lengths in flight is tiny
+# cache builds are guarded: concurrent folders (per-destination stream
+# threads, the scrub daemon) racing a cold _BYTE_POWS append could land
+# a power matrix at the wrong index and corrupt every later fold
+_COMBINE_MU = threading.Lock()
+
+
+def _matrix_mult(a: list[int], b: list[int]) -> list[int]:
+    """Composition a∘b (apply b, then a) over GF(2) column vectors."""
+    return [_gf2_matrix_times(a, col) for col in b]
+
+
+def _byte_pow_locked(k: int) -> list[int]:
+    """_COMBINE_MU must be held."""
+    while len(_BYTE_POWS) <= k:
+        if not _BYTE_POWS:
+            # one zero BYTE = the one-zero-bit operator squared 3 times
+            m = [_POLY] + [1 << (n - 1) for n in range(1, 32)]
+            for _ in range(3):
+                m = _gf2_matrix_square(m)
+            _BYTE_POWS.append(m)
+        else:
+            _BYTE_POWS.append(_gf2_matrix_square(_BYTE_POWS[-1]))
+    return _BYTE_POWS[k]
+
+
+def _zero_shift_matrix(len2: int) -> list[int]:
+    """Operator advancing a CRC through len2 zero bytes, memoized."""
+    m = _SHIFT_CACHE.get(len2)  # atomic dict read; values are immutable
+    if m is not None:
+        return m
+    with _COMBINE_MU:
+        m = _SHIFT_CACHE.get(len2)
+        if m is not None:
+            return m
+        out: list[int] | None = None
+        k = 0
+        rest = len2
+        while rest:
+            if rest & 1:
+                p = _byte_pow_locked(k)
+                out = p if out is None else _matrix_mult(p, out)
+            rest >>= 1
+            k += 1
+        assert out is not None
+        if len(_SHIFT_CACHE) >= _SHIFT_CACHE_MAX:
+            _SHIFT_CACHE.clear()  # pathological length spread: start over
+        _SHIFT_CACHE[len2] = out
+        return out
+
+
 def crc32c_combine(crc1: int, crc2: int, len2: int) -> int:
     """crc(A || B) from crc1=crc(A), crc2=crc(B), len2=len(B).
 
     Lets the scrubber checksum slabs independently (even out of order)
-    and fold them into a whole-file digest in O(32^2 log len2) — no
-    re-read. Identity: combine(c, crc(b""), 0) == c."""
+    and fold them into a whole-file digest with no re-read — O(32^2)
+    per fold once len2's zero-shift operator is cached (first fold of a
+    new length pays O(32^2 log len2) to build it). Identity:
+    combine(c, crc(b""), 0) == c."""
     if len2 <= 0:
         return crc1 & 0xFFFFFFFF
-    # operator matrix for one zero bit
-    odd = [_POLY] + [1 << (n - 1) for n in range(1, 32)]
-    even = _gf2_matrix_square(odd)   # two zero bits
-    odd = _gf2_matrix_square(even)   # four zero bits
-    crc1 &= 0xFFFFFFFF
-    while True:
-        # apply len2 zero BYTES to crc1, squaring through each bit of len2
-        even = _gf2_matrix_square(odd)
-        if len2 & 1:
-            crc1 = _gf2_matrix_times(even, crc1)
-        len2 >>= 1
-        if not len2:
-            break
-        odd = _gf2_matrix_square(even)
-        if len2 & 1:
-            crc1 = _gf2_matrix_times(odd, crc1)
-        len2 >>= 1
-        if not len2:
-            break
-    return (crc1 ^ crc2) & 0xFFFFFFFF
+    m = _zero_shift_matrix(len2)
+    return (_gf2_matrix_times(m, crc1 & 0xFFFFFFFF) ^ crc2) & 0xFFFFFFFF
 
 
 def crc_value_legacy(crc: int) -> int:

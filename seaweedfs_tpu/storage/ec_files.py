@@ -222,6 +222,7 @@ def generate_ec_files(
     geo: Geometry = Geometry(),
     batch_size: int = DEFAULT_BATCH_SIZE,
     pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
+    sinks=None,
 ) -> EncodeStats:
     """<base>.dat -> <base>.ec00..ecNN (WriteEcFiles / generateEcFiles /
     encodeDatFile, ec_encoder.go:56-87,194-231).
@@ -243,6 +244,16 @@ def generate_ec_files(
     #4) each run their own pipeline; their encode launches interleave on
     the shared device queue, so host I/O of one volume overlaps device
     math of another.
+
+    `sinks` (ISSUE 6, storage/ec_stream.py EcStreamSinkSet) is the
+    pluggable shard-sink hook: an object whose
+    put(shard_id, shard_offset, row, nbytes) receives every slab row the
+    moment it exists — data rows before the parity dispatch resolves,
+    parity rows right after — so network transfer to the shards'
+    destination servers overlaps the encode itself. Sinks copy the bytes
+    synchronously (the pipeline recycles its buffers); local shard files
+    are written regardless (they are the resume source and keep bytes
+    bit-identical to the generate-then-copy path by construction).
     """
     k, m = geo.data_shards, geo.parity_shards
     dat_path = base_file_name + ".dat"
@@ -315,6 +326,7 @@ def generate_ec_files(
     t = threading.Thread(target=reader, name="ec-encode-reader", daemon=True)
     t.start()
     ok = False
+    shard_off = 0  # every shard advances by the same nbytes per slab
     try:
         while True:
             item = work_q.get()
@@ -324,6 +336,13 @@ def generate_ec_files(
                 raise item
             buf, data, parity_fut, nbytes = item
             release = _Countdown(k, lambda b=buf: free_q.put(b))
+            if sinks is not None:
+                # data rows stream BEFORE the writers get the buffer:
+                # sinks copy synchronously here, and once writers.put
+                # hands rows to the writer threads the countdown can
+                # recycle the buffer under a concurrent reader refill
+                for i in range(k):
+                    sinks.put(i, shard_off, data[i], nbytes)
             for i in range(k):
                 writers.put(i, data[i], nbytes, release)
             t1 = time.perf_counter()
@@ -333,6 +352,9 @@ def generate_ec_files(
                 # parity rows are views of one fresh array; numpy refcounts
                 # keep it alive until the last writer drops its view
                 writers.put(k + j, parity[j], nbytes)
+                if sinks is not None:
+                    sinks.put(k + j, shard_off, parity[j], nbytes)
+            shard_off += nbytes
             stats.batches += 1
             stats.bytes += k * nbytes
         writers.close()
@@ -367,10 +389,10 @@ def _row_schedule(geo: Geometry, dat_size: int):
 
 
 def write_ec_files(
-    base_file_name: str, coder, geo: Geometry = Geometry()
+    base_file_name: str, coder, geo: Geometry = Geometry(), sinks=None
 ) -> EncodeStats:
     """WriteEcFiles equivalent (ec_encoder.go:56-59)."""
-    return generate_ec_files(base_file_name, coder, geo)
+    return generate_ec_files(base_file_name, coder, geo, sinks=sinks)
 
 
 def write_ecx_stride_marker(base_file_name: str) -> None:

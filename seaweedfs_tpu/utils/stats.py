@@ -238,6 +238,79 @@ EC_RECON_CACHE_COUNTER = Counter(
     "(hit/miss/put/invalidate/evict).")
 
 
+# -- streaming replica->EC conversion (ISSUE 6): the pipelined archival
+#    encode that pushes shard slabs to their destinations while the GF
+#    matmul is still running (storage/ec_stream.py), plus like-for-like
+#    counters on the VolumeEcShardsCopy generate-then-copy fallback ------
+
+EC_STREAM_BYTES = Counter(
+    "SeaweedFS_ec_stream_bytes",
+    "Shard-slab bytes streamed by role (source/dest) and phase "
+    "(live = overlapped with the encode, resume = re-sent after a "
+    "destination flap).")
+EC_STREAM_SLABS = Counter(
+    "SeaweedFS_ec_stream_slabs",
+    "Shard slabs streamed by role (source/dest) and phase (live/resume).")
+EC_STREAM_INFLIGHT_BYTES = Gauge(
+    "SeaweedFS_ec_stream_inflight_bytes",
+    "Slab bytes queued for a destination but not yet on its wire.")
+EC_STREAM_RESUMES = Counter(
+    "SeaweedFS_ec_stream_resumes",
+    "Resume streams issued after a destination flap, by peer.")
+EC_STREAM_SECONDS = Counter(
+    "SeaweedFS_ec_stream_seconds",
+    "Wall seconds spent inside shard-stream sends, by peer "
+    "(bytes/seconds = per-destination throughput).")
+EC_STREAM_STREAMS = Counter(
+    "SeaweedFS_ec_stream_streams",
+    "Shard streams completed by outcome (ok/failed).")
+EC_STREAM_OVERLAP_RATIO = Gauge(
+    "SeaweedFS_ec_stream_overlap_ratio",
+    "encode-time / wall-time of the last streamed generate "
+    "(1.0 = transfer fully hidden under the encode).")
+EC_COPY_FALLBACK_BYTES = Counter(
+    "SeaweedFS_ec_shards_copy_bytes",
+    "Bytes pulled through the VolumeEcShardsCopy (generate-then-copy) "
+    "path, by file kind (shard/index).")
+EC_COPY_FALLBACK_SECONDS = Counter(
+    "SeaweedFS_ec_shards_copy_seconds",
+    "Wall seconds inside VolumeEcShardsCopy pulls "
+    "(bytes/seconds = copy-path throughput, the A/B comparand).")
+
+
+def ec_stream_stats() -> dict:
+    """Snapshot for /status pages: streamed bytes by phase, in-flight
+    depth, resume counts, overlap ratio, and the copy-fallback
+    byte/throughput counters so A/Bs compare like for like."""
+    src_s = EC_STREAM_SECONDS.value()
+    src_b = EC_STREAM_BYTES.value(role="source")
+    copy_b = EC_COPY_FALLBACK_BYTES.value()
+    copy_s = EC_COPY_FALLBACK_SECONDS.value()
+    return {
+        "streamedBytes": {
+            "live": int(EC_STREAM_BYTES.value(role="source", phase="live")),
+            "resume": int(EC_STREAM_BYTES.value(role="source",
+                                                phase="resume")),
+            "received": int(EC_STREAM_BYTES.value(role="dest")),
+        },
+        "slabs": int(EC_STREAM_SLABS.value(role="source")),
+        "inflightBytes": int(EC_STREAM_INFLIGHT_BYTES.value()),
+        "resumes": int(EC_STREAM_RESUMES.value()),
+        "streams": {
+            "ok": int(EC_STREAM_STREAMS.value(outcome="ok")),
+            "failed": int(EC_STREAM_STREAMS.value(outcome="failed")),
+        },
+        "overlapRatio": round(EC_STREAM_OVERLAP_RATIO.value(), 4),
+        "throughputMBps": round(src_b / src_s / 1e6, 3) if src_s else 0.0,
+        "copyFallback": {
+            "bytes": int(copy_b),
+            "seconds": round(copy_s, 3),
+            "throughputMBps": round(copy_b / copy_s / 1e6, 3)
+            if copy_s else 0.0,
+        },
+    }
+
+
 # -- continuous integrity plane (ISSUE 4): the background scrubber, the
 #    digest/anti-entropy comparisons, and the self-healing repair ladder ---
 
