@@ -17,7 +17,7 @@ import grpc
 import requests
 
 from ..pb import master_pb2, rpc
-from ..utils import glog
+from ..utils import glog, trace
 from ..utils.retry import Backoff, guarded_attempt
 from ..utils.stats import (
     CLIENT_ASSIGN_COUNTER,
@@ -72,17 +72,26 @@ def assign(master: str, *, count: int = 1, collection: str = "",
            data_center: str = "") -> AssignResult:
     """Instrumented wrapper over the failover assign loop: latency and
     outcome counters make the bench's per-PUT master cost attributable
-    (fid-lease batching shows up as fewer assign ops per 1k writes)."""
-    with CLIENT_ASSIGN_SECONDS.time():
-        result = _assign(master, count=count, collection=collection,
-                         replication=replication, ttl=ttl,
-                         data_center=data_center)
-    if result.error:
-        CLIENT_ASSIGN_COUNTER.inc(outcome="error")
-    else:
-        CLIENT_ASSIGN_COUNTER.inc(outcome="ok")
-        CLIENT_ASSIGN_COUNTER.inc(max(1, int(result.count or 1)),
-                                  outcome="fids")
+    (fid-lease batching shows up as fewer assign ops per 1k writes);
+    inside a trace the whole master round-trip is a `client.assign`
+    child span."""
+    with trace.span("client.assign", child_only=True, count=count) as tsp:
+        with CLIENT_ASSIGN_SECONDS.time():
+            result = _assign(master, count=count, collection=collection,
+                             replication=replication, ttl=ttl,
+                             data_center=data_center)
+        if result.error:
+            CLIENT_ASSIGN_COUNTER.inc(outcome="error")
+            # attr, not set_error: a cluster-full burst hits every traced
+            # write's lease refill, and keep-if-error retention on each
+            # would flush the bounded retained set (the master's
+            # /dir/assign handler makes the same call)
+            tsp.set_attr(assignError=str(result.error)[:120])
+        else:
+            CLIENT_ASSIGN_COUNTER.inc(outcome="ok")
+            CLIENT_ASSIGN_COUNTER.inc(max(1, int(result.count or 1)),
+                                      outcome="fids")
+            tsp.set_attr(fid=result.fid, leased=int(result.count or 1))
     return result
 
 
@@ -187,7 +196,8 @@ def upload_data(url: str, data: bytes, *, filename: str = "",
     upload_content.go:85,134). Pass a requests.Session to reuse keepalive
     connections on hot paths (one session per thread — Session is not
     safe for concurrent use)."""
-    headers = {"Content-Type": mime or "application/octet-stream"}
+    headers = trace.inject_headers(
+        {"Content-Type": mime or "application/octet-stream"})
     if auth:
         headers["Authorization"] = f"Bearer {auth}"
     body = data
@@ -203,7 +213,9 @@ def upload_data(url: str, data: bytes, *, filename: str = "",
     bo = Backoff(wait_init=0.1)
     for attempt in range(retries):
         try:
-            with CLIENT_UPLOAD_SECONDS.time():
+            with trace.span("client.upload", child_only=True,
+                            bytes=len(body)), \
+                    CLIENT_UPLOAD_SECONDS.time():
                 r = http.put(url, data=body, headers=headers, timeout=60)
             if r.status_code < 300:
                 j = r.json()

@@ -49,6 +49,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from ..utils import trace
 from ..utils.stats import (
     EC_DISPATCH_BATCHES,
     EC_DISPATCH_SLABS,
@@ -89,9 +90,16 @@ def window_s() -> float:
 
 class EcFuture:
     """Result handle for a submitted slab. `np.asarray(fut)` works as a
-    drop-in for the lazy device array the direct coder call returns."""
+    drop-in for the lazy device array the direct coder call returns.
 
-    __slots__ = ("_event", "_value", "_error", "_sched", "_key")
+    After resolution the future carries the dispatch attribution the
+    tracing plane surfaces (ISSUE 7): how long the slab queued in its
+    lane, how many slabs shared its stacked dispatch, which chip ran
+    it, and the dispatch submission wall. Stamped BEFORE the result is
+    set so a woken consumer never reads half-stamped attribution."""
+
+    __slots__ = ("_event", "_value", "_error", "_sched", "_key",
+                 "queue_wait_s", "batch_slabs", "chip", "dispatch_wall_s")
 
     def __init__(self, sched: "EcDispatchScheduler", key: tuple):
         self._event = threading.Event()
@@ -99,6 +107,10 @@ class EcFuture:
         self._error = None
         self._sched = sched
         self._key = key
+        self.queue_wait_s = None
+        self.batch_slabs = None
+        self.chip = None
+        self.dispatch_wall_s = None
 
     def _set(self, value) -> None:
         self._value = value
@@ -213,12 +225,27 @@ def reconstruct_now(coder, present_ids, stacked,
     the shared scheduler when the dispatch plane is on (micro-batches
     with every concurrent caller), the coder's native stacked kernel
     otherwise, the dict form as a last resort. One cascade for every
-    serving call site -> (missing_ids, rows)."""
+    serving call site -> (missing_ids, rows).
+
+    When the caller is inside a trace span (a degraded S3 GET), the
+    scheduler's per-slab attribution — queue wait, realized batch
+    factor, chip, dispatch wall — lands on that span: the per-request
+    answer to "was this read slow because of the device or the queue"."""
     present_ids = tuple(present_ids)
     sched = maybe_scheduler(coder)
     if sched is not None:
-        return sched.reconstruct_stacked(
-            present_ids, stacked, data_only=data_only).result()
+        fut = sched.reconstruct_stacked(
+            present_ids, stacked, data_only=data_only)
+        out = fut.result()
+        sp = trace.current()
+        if sp is not None and fut.batch_slabs is not None:
+            sp.set_attr(
+                dispatchQueueWaitMs=round((fut.queue_wait_s or 0) * 1e3,
+                                          3),
+                dispatchBatchSlabs=fut.batch_slabs,
+                dispatchChip=fut.chip,
+                dispatchWallMs=round((fut.dispatch_wall_s or 0) * 1e3, 3))
+        return out
     fn = getattr(coder, "reconstruct_stacked", None)
     if fn is not None:
         return fn(present_ids, stacked, data_only=data_only)
@@ -477,6 +504,10 @@ class EcDispatchScheduler:
         for s in slabs:
             EC_DISPATCH_WINDOW_WAIT.observe(now - s.t, lane=kind,
                                             chip=label)
+            # trace attribution, readable off the future after result()
+            s.fut.queue_wait_s = now - s.t
+            s.fut.batch_slabs = len(slabs)
+            s.fut.chip = label
         # caller holds _dispatch_mu: coder submission is single-threaded
         # (concurrent shard_map submissions deadlock XLA's cross-module
         # rendezvous on the multi-device CPU mesh), and in-flight
@@ -495,20 +526,36 @@ class EcDispatchScheduler:
                 if not s.fut.done():
                     s.fut._set_error(e)
 
+    @staticmethod
+    def _stamp_wall(slabs: list[_Slab], t0: float) -> None:
+        """Dispatch submission wall onto every future BEFORE any _set —
+        a consumer wakes on _set and must find the attribution whole.
+        (On async jax backends this is submission+transfer wall, not
+        device execution; on the CPU coder it is the real wall.)"""
+        wall = time.perf_counter() - t0
+        for s in slabs:
+            s.fut.dispatch_wall_s = wall
+
     def _dispatch_encode(self, slabs: list[_Slab], device=None) -> None:
         fn_on = (getattr(self.coder, "encode_parity_stacked_on", None)
                  if device is not None else None)
+        t0 = time.perf_counter()
         if len(slabs) == 1:
             s = slabs[0]
             if fn_on is not None:
                 # lone slab on a chip lane: [None] view, no zero-pad copy
-                s.fut._set(fn_on(s.data[None], device)[0])
+                out0 = fn_on(s.data[None], device)[0]
             else:
-                s.fut._set(self.coder.encode_parity(s.data))
+                out0 = self.coder.encode_parity(s.data)
+            self._stamp_wall(slabs, t0)
+            s.fut._set(out0)
             return
         if not hasattr(self.coder, "encode_parity_stacked"):
             for s in slabs:  # exotic coder: amortization off, bytes same
-                s.fut._set(self.coder.encode_parity(s.data))
+                t_s = time.perf_counter()  # per-slab wall, not cumulative
+                out0 = self.coder.encode_parity(s.data)
+                self._stamp_wall([s], t_s)
+                s.fut._set(out0)
             return
         k = slabs[0].data.shape[0]
         bmax = max(s.width for s in slabs)
@@ -521,6 +568,7 @@ class EcDispatchScheduler:
             out = fn_on(stack, device)
         else:
             out = self.coder.encode_parity_stacked(stack)
+        self._stamp_wall(slabs, t0)
         # ragged tails ride zero-padded columns; zero columns encode to
         # zero parity and are sliced away, so per-slab bytes are identical
         # to a lone dispatch (pinned by tests/test_ec_dispatch.py)
@@ -530,10 +578,14 @@ class EcDispatchScheduler:
     def _dispatch_reconstruct(self, key: tuple, slabs: list[_Slab],
                               device=None) -> None:
         _, present_ids, data_only = key
+        t0 = time.perf_counter()
         if not hasattr(self.coder, "reconstruct_stacked"):
             for s in slabs:  # exotic coder: per-slab dict reconstruct
-                s.fut._set(reconstruct_stacked_via_dict(
-                    self.coder, present_ids, s.data, data_only))
+                t_s = time.perf_counter()  # per-slab wall, not cumulative
+                out0 = reconstruct_stacked_via_dict(
+                    self.coder, present_ids, s.data, data_only)
+                self._stamp_wall([s], t_s)
+                s.fut._set(out0)
             return
         chips = self._chip_list()
         fn_v = getattr(self.coder, "reconstruct_stacked_vsharded", None)
@@ -546,6 +598,7 @@ class EcDispatchScheduler:
             # survivor-set chip placement below)
             vstack = np.stack([s.data for s in slabs])
             missing, rows = fn_v(present_ids, vstack, data_only=data_only)
+            self._stamp_wall(slabs, t0)
             for i, s in enumerate(slabs):
                 s.fut._set((missing, rows[i]))
             return
@@ -562,10 +615,13 @@ class EcDispatchScheduler:
                 present_ids, stk, data_only=data_only)
 
         if len(slabs) == 1:
-            slabs[0].fut._set(recon(slabs[0].data))
+            out0 = recon(slabs[0].data)
+            self._stamp_wall(slabs, t0)
+            slabs[0].fut._set(out0)
             return
         cat = np.concatenate([s.data for s in slabs], axis=1)
         missing, rows = recon(cat)
+        self._stamp_wall(slabs, t0)
         off = 0
         for s in slabs:
             s.fut._set((missing, rows[:, off: off + s.width]))

@@ -29,7 +29,7 @@ from . import (
     scrub_pb2,
     volume_server_pb2,
 )
-from ..utils import failpoint
+from ..utils import failpoint, trace
 
 MAX_MESSAGE_SIZE = 1 << 30  # grpc_client_server.go:27
 GRPC_PORT_DELTA = 10000
@@ -247,12 +247,17 @@ class InjectedRpcError(grpc.RpcError):
 
 
 def _failpoint_guard(fn, method_name: str, address: str):
-    """Per-call chaos hook: an armed failpoint named `pb.<Method>`
-    (optionally @-matched against the dialed address) surfaces as gRPC
-    UNAVAILABLE before the wire is touched. One dict probe when the
-    registry is empty — negligible against marshalling costs. The ctx
-    comma-terminates the address (failpoint ctx convention) so a match
-    for port 1234 cannot substring-hit port 12345."""
+    """Per-call chaos hook + trace-context injection. An armed failpoint
+    named `pb.<Method>` (optionally @-matched against the dialed
+    address) surfaces as gRPC UNAVAILABLE before the wire is touched.
+    One dict probe when the registry is empty — negligible against
+    marshalling costs. The ctx comma-terminates the address (failpoint
+    ctx convention) so a match for port 1234 cannot substring-hit port
+    12345.
+
+    Tracing (ISSUE 7): when the calling thread is inside a span, its
+    W3C `traceparent` rides the call as gRPC metadata — every stub in
+    the process propagates context with zero per-callsite wiring."""
     name = f"pb.{method_name}"
     ctx = f"{address},"
 
@@ -261,6 +266,11 @@ def _failpoint_guard(fn, method_name: str, address: str):
             failpoint.fail(name, ctx=ctx)
         except failpoint.FailpointError as e:
             raise InjectedRpcError(grpc.StatusCode.UNAVAILABLE, str(e))
+        tp = trace.traceparent()
+        if tp:
+            md = list(kwargs.get("metadata") or ())
+            md.append((trace.TRACEPARENT, tp))
+            kwargs["metadata"] = md
         return fn(*args, **kwargs)
 
     return call
@@ -285,7 +295,7 @@ class Stub:
 
 
 def add_servicer(server: grpc.Server, service, servicer,
-                 component: str | None = None):
+                 component: str | None = None, address: str = ""):
     """Register `servicer` (an object with one method per RPC name) for the
     given descriptor on a grpc.Server. With `component`, and ONLY when
     that component's server TLS actually loads (the reference returns
@@ -328,8 +338,44 @@ def add_servicer(server: grpc.Server, service, servicer,
             return behavior(request, context)
         return unary_wrap
 
+    def traced(behavior, method_name: str, streaming: bool):
+        """Server-side trace extraction (ISSUE 7): a handler runs under
+        a span ONLY when the caller sent `traceparent` metadata — roots
+        belong to the ingress planes, not to heartbeat/background RPC
+        chatter. Streaming handlers use non-activating spans: their
+        generator bodies suspend mid-`with`, and an activated span
+        would leak this worker thread's TLS between resumptions."""
+        name = f"grpc.{method_name}"
+
+        def metadata_of(context):
+            try:
+                return context.invocation_metadata()
+            except Exception:  # noqa: BLE001 — tracing must never fail a call
+                return None
+
+        if streaming:
+            def stream_wrap(request, context):
+                md = metadata_of(context)
+                if not trace.carrier_has_context(md):
+                    yield from behavior(request, context)
+                    return
+                with trace.span(name, carrier=md, component=component or "",
+                                server=address, activate=False):
+                    yield from behavior(request, context)
+            return stream_wrap
+
+        def unary_wrap(request, context):
+            md = metadata_of(context)
+            if not trace.carrier_has_context(md):
+                return behavior(request, context)
+            with trace.span(name, carrier=md, component=component or "",
+                            server=address):
+                return behavior(request, context)
+        return unary_wrap
+
     for m in methods:
-        behavior = guarded(getattr(servicer, m["name"]), m["ss"])
+        behavior = traced(guarded(getattr(servicer, m["name"]), m["ss"]),
+                          m["name"], m["ss"])
         kw = dict(request_deserializer=m["req"].FromString,
                   response_serializer=m["resp"].SerializeToString)
         if m["cs"] and m["ss"]:

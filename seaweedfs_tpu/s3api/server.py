@@ -27,8 +27,13 @@ from ..utils.httpd import TunedThreadingHTTPServer
 import grpc
 
 from ..pb import filer_pb2, rpc
-from ..utils import glog
-from ..utils.stats import S3_REQUEST_HISTOGRAM
+from ..utils import glog, trace
+from ..utils.stats import (
+    S3_REQUEST_HISTOGRAM,
+    gather,
+    metrics_content_type,
+    status_base,
+)
 from .auth import AuthError, Identity, IdentityAccessManagement
 from .circuit_breaker import CircuitBreaker, TooManyRequests, load_filer_config
 from .policy import BucketPolicy, PolicyError
@@ -62,8 +67,14 @@ class S3Server:
         self.circuit_breaker = CircuitBreaker()
         self._cb_loaded_at = 0.0
         self._http_server = None
+        self._started_at = time.time()
+
+    @property
+    def address(self) -> str:
+        return f"localhost:{self.port}"
 
     def start(self) -> None:
+        trace.set_identity("s3", self.address)
         self._http_server = TunedThreadingHTTPServer(
             ("", self.port), _make_handler(self))
         threading.Thread(target=self._http_server.serve_forever,
@@ -77,7 +88,8 @@ class S3Server:
         # unauthenticated.
         self._grpc_server = rpc.new_server()
         creds = rpc.add_servicer(self._grpc_server, rpc.S3_SERVICE,
-                                 _S3Control(self), component="s3")
+                                 _S3Control(self), component="s3",
+                                 address=self.address)
         bind_ip = "[::]" if creds is not None else "127.0.0.1"
         rpc.serve_port(self._grpc_server,
                        f"{bind_ip}:{rpc.derived_grpc_port(self.port)}",
@@ -180,7 +192,9 @@ class S3Server:
             data = _tee()
         r = _session().put(
             url, data=data,
-            headers={"Content-Type": content_type or "application/octet-stream"},
+            headers=trace.inject_headers(
+                {"Content-Type":
+                 content_type or "application/octet-stream"}),
             timeout=600)
         if r.status_code >= 300:
             raise S3Error(500, "InternalError", f"filer PUT: {r.status_code}")
@@ -190,7 +204,8 @@ class S3Server:
                    stream: bool = False):
         url = (f"http://{self.filer}{BUCKETS_DIR}/{bucket}/"
                + urllib.parse.quote(key))
-        headers = {"Range": range_header} if range_header else {}
+        headers = trace.inject_headers(
+            {"Range": range_header} if range_header else {})
         r = _session().get(url, headers=headers, timeout=600,
                               stream=stream)
         if r.status_code == 404:
@@ -301,6 +316,9 @@ def _make_handler(srv: S3Server):
             if "Content-Length" not in headers:
                 headers["Content-Length"] = str(len(body))
             self.send_header("x-amz-request-id", uuid.uuid4().hex[:16])
+            tid = getattr(self, "_trace_id", "")
+            if tid:
+                self.send_header("X-Trace-Id", tid)
             for k, v in headers.items():
                 self.send_header(k, v)
             self.end_headers()
@@ -406,21 +424,72 @@ def _make_handler(srv: S3Server):
         def do_DELETE(self):
             self._dispatch("DELETE")
 
-        def _dispatch(self, verb: str):
-            if verb == "GET" and self.path == "/metrics":
-                from ..utils.stats import gather
+        def _admin_plane_ok(self, u) -> bool:
+            # /debug/traces and /status expose request-level data (object
+            # keys, internal server addresses, error strings) — unlike the
+            # aggregate-only /metrics, they must not be anonymous-readable
+            # on the public gateway when IAM is on
+            if not srv.iam.enabled:
+                return True
+            try:
+                ident = self._auth(u)
+            except S3Error:
+                return False
+            return ident is not None and ident.allows("Admin")
 
-                body = gather().encode()
+        def _dispatch(self, verb: str):
+            self._trace_id = ""  # never leak across keep-alive requests
+            # admin endpoints match the exact PATH and admit ONLY their
+            # own query params — a bucket literally named "metrics" or
+            # "status" keeps its S3 query routes (GET /metrics with no
+            # query was always the admin endpoint, ?list-type=2 etc.
+            # must still reach bucket listing)
+            admin_u = urllib.parse.urlparse(self.path)
+            admin_q = {k: v[0] for k, v in
+                       urllib.parse.parse_qs(admin_u.query).items()}
+            if verb == "GET" and admin_u.path == "/metrics" \
+                    and set(admin_q) <= {"exemplars"}:
+                exemplars = "exemplars" in admin_q
+                body = gather(exemplars=exemplars).encode()
                 self.send_response(200)
                 self.send_header("Content-Type",
-                                 "text/plain; version=0.0.4")
+                                 metrics_content_type(exemplars))
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
                 return
+            if verb == "GET" and admin_u.path == "/debug/traces" \
+                    and set(admin_q) <= {"trace"}:
+                if not self._admin_plane_ok(admin_u):
+                    return self._send(403, b'{"error": "AccessDenied"}',
+                                      "application/json")
+                body = json.dumps(
+                    trace.debug_traces_payload(admin_q)).encode()
+                return self._send(200, body, "application/json")
+            if verb == "GET" and admin_u.path == "/status" \
+                    and not admin_q:
+                if not self._admin_plane_ok(admin_u):
+                    return self._send(403, b'{"error": "AccessDenied"}',
+                                      "application/json")
+                body = json.dumps({
+                    **status_base(srv._started_at),
+                    "Filer": srv.filer,
+                    "Trace": trace.STORE.stats(),
+                }).encode()
+                return self._send(200, body, "application/json")
             bucket, key, q, u = self._route()
             action = _action_for(verb, bucket, key, q)
             release = lambda: None  # noqa: E731
+            with trace.span("s3.request", carrier=self.headers,
+                            component="s3", server=srv.address,
+                            action=f"{verb.lower()}", bucket=bucket,
+                            key=key) as tsp:
+                self._trace_id = tsp.trace_id
+                self._dispatch_traced(verb, bucket, key, q, u, action,
+                                      release, tsp)
+
+        def _dispatch_traced(self, verb, bucket, key, q, u, action,
+                             release, tsp):
             try:
                 with S3_REQUEST_HISTOGRAM.time(action=f"{verb.lower()}"):
                     # admission first: a tripped breaker must shed load
@@ -441,8 +510,16 @@ def _make_handler(srv: S3Server):
                         return self._bucket(verb, bucket, q, bucket_entry)
                     return self._object(verb, bucket, key, q, bucket_entry)
             except S3Error as e:
+                if e.status >= 500:
+                    # 5xx pins the trace (keep-if-error); expected 4xx
+                    # (404 polls, auth rejections) must not churn the
+                    # retained set
+                    tsp.set_error(f"{e.code}: {e}")
+                else:
+                    tsp.set_attr(s3Error=e.code, status=e.status)
                 self._error(e)
             except Exception as e:  # noqa: BLE001
+                tsp.set_error(f"{type(e).__name__}: {e}")
                 glog.error(f"s3 {verb} {self.path}: {e}")
                 self._error(S3Error(500, "InternalError", str(e)))
             finally:
@@ -761,6 +838,9 @@ def _make_handler(srv: S3Server):
                 try:
                     self.send_response(r.status_code)
                     self.send_header("x-amz-request-id", uuid.uuid4().hex[:16])
+                    tid = getattr(self, "_trace_id", "")
+                    if tid:
+                        self.send_header("X-Trace-Id", tid)
                     self.send_header(
                         "Content-Type",
                         r.headers.get("Content-Type",

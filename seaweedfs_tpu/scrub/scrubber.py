@@ -430,9 +430,18 @@ class Scrubber:
         """One pass over this server's volumes (or just `vid`): needle CRC
         sweep + EC syndrome verify + (when replicated and a server is
         attached) digest anti-entropy. Serialized: concurrent callers
-        queue behind the running pass."""
+        queue behind the running pass.
+
+        Traced (ISSUE 7): each pass is a span — a root when the daemon
+        runs it, a child when `volume.scrub` / VolumeScrub drives it —
+        so background integrity work shows up in the same plane as the
+        foreground requests it competes with."""
+        from ..utils import trace
+
         report = ScrubReport()
-        with self._run_lock:
+        with self._run_lock, \
+                trace.span("scrub.run", component="volume",
+                           vid=vid or 0, full=full) as tsp:
             self.running = True
             try:
                 for loc in self.store.locations:
@@ -453,6 +462,11 @@ class Scrubber:
                                           report=report)
                 self.sweeps_completed += 1
                 self.last_sweep_unix = time.time()
+                tsp.set_attr(volumes=report.volumes,
+                             needles=report.needles,
+                             bytes=report.bytes,
+                             findings=len(report.findings),
+                             repaired=report.repaired)
             finally:
                 self.running = False
         return report

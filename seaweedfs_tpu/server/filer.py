@@ -30,7 +30,7 @@ from ..filer.filer import NotEmpty, NotFound, normalize
 from ..filer.filerstore import RetryingStore, get_store
 from ..operation import assign, delete_files, thread_session, upload_data
 from ..pb import filer_pb2, master_pb2, rpc
-from ..utils import glog
+from ..utils import glog, trace
 from ..utils.chunk_cache import TieredChunkCache
 from ..utils.http import not_modified
 from ..utils.stats import (
@@ -39,6 +39,8 @@ from ..utils.stats import (
     chunk_cache_stats,
     fid_lease_stats,
     gather,
+    metrics_content_type,
+    status_base,
 )
 from ..wdclient import MasterClient
 from ..wdclient.lease import FidLeasePool
@@ -166,6 +168,7 @@ class FilerServer:
         self._hot_log_corrupt = False
         self._hot_stop = threading.Event()
         self._hot_threads: list[threading.Thread] = []
+        self._started_at = time.time()
 
     def _start_aggregator(self) -> None:
         if not self._peers and not self.filer_group:
@@ -225,9 +228,11 @@ class FilerServer:
         return f"{self.ip}:{self.port}"
 
     def start(self) -> None:
+        trace.set_identity("filer", self.address)
         self._grpc_server = rpc.new_server()
         creds = rpc.add_servicer(self._grpc_server, rpc.FILER_SERVICE,
-                                 FilerGrpc(self), component="filer")
+                                 FilerGrpc(self), component="filer",
+                                 address=self.address)
         rpc.serve_port(self._grpc_server, f"[::]:{self.grpc_port}",
                        "filer", creds=creds)
         self._grpc_server.start()
@@ -630,19 +635,32 @@ class FilerServer:
         servers holding ANY EC shard of the volume — which reconstruct
         from any k shards server-side (the LookupFileIdWithFallback read
         ladder this rebuild previously lacked: first dead replica was
-        fatal)."""
+        fatal).
+
+        Traced (ISSUE 7): inside a request span each rung becomes
+        attributable — the `filer.chunk_read` child carries the
+        cache hit/miss verdict, and the volume-server fetches below
+        propagate the trace over their HTTP headers."""
+        with trace.span("filer.chunk_read", child_only=True,
+                        fid=view.file_id, size=view.size) as tsp:
+            return self._read_chunk_view_traced(view, cacheable, tsp)
+
+    def _read_chunk_view_traced(self, view, cacheable: bool, tsp) -> bytes:
         cache = self.chunk_cache
         if cache is not None and cacheable:
             cached = cache.get(view.file_id)
             if cached is not None and \
                     len(cached) >= view.chunk_offset + view.size:
                 FILER_CHUNK_CACHE_COUNTER.inc(result="hit")
+                tsp.set_attr(cache="hit")
                 return bytes(cached[view.chunk_offset:
                                     view.chunk_offset + view.size])
             FILER_CHUNK_CACHE_COUNTER.inc(result="miss")
+            tsp.set_attr(cache="miss")
         headers = {"Range": f"bytes={view.chunk_offset}-"
                             f"{view.chunk_offset + view.size - 1}"} \
             if not view.is_full_chunk else {}
+        trace.inject_headers(headers)
         last_err: Exception | None = None
 
         def filled(data: bytes) -> bytes:
@@ -1109,6 +1127,9 @@ def _make_http_handler(srv: FilerServer):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            tid = getattr(self, "_trace_id", "")
+            if tid:
+                self.send_header("X-Trace-Id", tid)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -1139,6 +1160,9 @@ def _make_http_handler(srv: FilerServer):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(length))
+            tid = getattr(self, "_trace_id", "")
+            if tid:
+                self.send_header("X-Trace-Id", tid)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -1160,15 +1184,20 @@ def _make_http_handler(srv: FilerServer):
                                      parse_qs(u.query).items()}
 
         def do_GET(self):
+            self._trace_id = ""  # never leak across keep-alive requests
             path, q = self._path_q()
             if path == "/metrics":
-                return self._reply(200, gather().encode(),
-                                   "text/plain; version=0.0.4")
+                ex = "exemplars" in q
+                return self._reply(200, gather(exemplars=ex).encode(),
+                                   metrics_content_type(ex))
+            if path == "/debug/traces":
+                return self._json(trace.debug_traces_payload(q))
             if path == "/healthz":
                 return self._json({"ok": True})
             if path == "/status":
                 hot = srv.hot_plane.stats() if srv.hot_plane else None
                 return self._json({
+                    **status_base(srv._started_at),
                     "Version": "seaweedfs-tpu",
                     "ChunkCache": chunk_cache_stats(),
                     "ChunkCacheEnabled": srv.chunk_cache is not None,
@@ -1178,8 +1207,16 @@ def _make_http_handler(srv: FilerServer):
                         "batch": srv.fid_pool.batch,
                     },
                     "NativeHotPlane": hot,
+                    "Trace": trace.STORE.stats(),
                 })
             srv.hot_sync()  # see native PUTs not yet absorbed
+            with trace.span("filer.read", carrier=self.headers,
+                            component="filer", server=srv.address,
+                            path=path) as tsp:
+                self._trace_id = tsp.trace_id
+                return self._do_get(path, q)
+
+        def _do_get(self, path, q):
             with FILER_REQUEST_HISTOGRAM.time(type="read"):
                 try:
                     entry = srv.filer.find_entry(path)
@@ -1245,8 +1282,16 @@ def _make_http_handler(srv: FilerServer):
         do_HEAD = do_GET
 
         def do_PUT(self):
+            self._trace_id = ""
             path, q = self._path_q()
             srv.hot_sync()  # ordering: older hot records absorb first
+            with trace.span("filer.write", carrier=self.headers,
+                            component="filer", server=srv.address,
+                            path=path) as tsp:
+                self._trace_id = tsp.trace_id
+                return self._do_put(path, q)
+
+        def _do_put(self, path, q):
             with FILER_REQUEST_HISTOGRAM.time(type="write"):
                 chunked = "chunked" in (
                     self.headers.get("Transfer-Encoding") or "").lower()
@@ -1287,8 +1332,16 @@ def _make_http_handler(srv: FilerServer):
         do_POST = do_PUT
 
         def do_DELETE(self):
+            self._trace_id = ""
             path, q = self._path_q()
             srv.hot_sync()
+            with trace.span("filer.delete", carrier=self.headers,
+                            component="filer", server=srv.address,
+                            path=path) as tsp:
+                self._trace_id = tsp.trace_id
+                return self._do_delete(path, q)
+
+        def _do_delete(self, path, q):
             recursive = q.get("recursive") == "true"
             try:
                 fids = srv.filer.delete_entry(path, recursive=recursive)

@@ -37,8 +37,13 @@ from ..storage.super_block import ReplicaPlacement
 from ..storage.ttl import EMPTY_TTL, TTL
 from ..topology import Topology, VolumeGrowth
 from ..topology.topology import EcShardInfo, VolumeInfo
-from ..utils import glog
-from ..utils.stats import MASTER_RECEIVED_HEARTBEATS, master_metrics_text
+from ..utils import glog, trace
+from ..utils.stats import (
+    MASTER_RECEIVED_HEARTBEATS,
+    gather,
+    metrics_content_type,
+    status_base,
+)
 
 
 class MasterServer:
@@ -92,6 +97,7 @@ class MasterServer:
         self._http_server = None
         self._vacuum_thread = None
         self._stop = threading.Event()
+        self._started_at = time.time()
         # multi-master: Raft-replicated MaxVolumeId + leader election
         # (raft_server.go / cluster_commands.go)
         self.raft = None
@@ -148,9 +154,11 @@ class MasterServer:
 
     def start(self, *, vacuum_interval: float = 60.0,
               scrub_interval: float | None = None) -> None:
+        trace.set_identity("master", self.address)
         self._grpc_server = rpc.new_server()
         creds = rpc.add_servicer(self._grpc_server, rpc.MASTER_SERVICE,
-                                 MasterGrpc(self), component="master")
+                                 MasterGrpc(self), component="master",
+                                 address=self.address)
         rpc.serve_port(self._grpc_server, f"[::]:{self.grpc_port}",
                        "master", creds=creds)
         self._grpc_server.start()
@@ -737,10 +745,14 @@ def _make_http_handler(ms: MasterServer):
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            tid = getattr(self, "_trace_id", "")
+            if tid:
+                self.send_header("X-Trace-Id", tid)
             self.end_headers()
             self.wfile.write(body)
 
         def do_GET(self):  # noqa: C901 - flat route table
+            self._trace_id = ""  # never leak across keep-alive requests
             if urlparse(self.path).path in ("/", "/ui"):
                 from .ui import master_ui
 
@@ -754,24 +766,33 @@ def _make_http_handler(ms: MasterServer):
             u = urlparse(self.path)
             q = {k: v[0] for k, v in parse_qs(u.query).items()}
             if u.path == "/dir/assign":
-                r = ms.assign(
-                    count=int(q.get("count", 1)),
-                    replication=q.get("replication", ""),
-                    collection=q.get("collection", ""),
-                    ttl=q.get("ttl", ""),
-                    data_center=q.get("dataCenter", ""),
-                    rack=q.get("rack", ""),
-                )
-                if "error" in r:
-                    return self._json(r, 404)
-                out = {
-                    "fid": r["fid"], "count": r["count"],
-                    "url": r["url"], "publicUrl": r["publicUrl"],
-                }
-                auth = ms.mint_write_jwt(r["fid"])
-                if auth:
-                    out["auth"] = auth
-                return self._json(out)
+                with trace.span("master.assign", carrier=self.headers,
+                                component="master",
+                                server=ms.address) as tsp:
+                    self._trace_id = tsp.trace_id
+                    r = ms.assign(
+                        count=int(q.get("count", 1)),
+                        replication=q.get("replication", ""),
+                        collection=q.get("collection", ""),
+                        ttl=q.get("ttl", ""),
+                        data_center=q.get("dataCenter", ""),
+                        rack=q.get("rack", ""),
+                    )
+                    if "error" in r:
+                        # an attribute, not keep-if-error: a cluster-full
+                        # burst answers hundreds of these per second and
+                        # must not flush the bounded retained set (same
+                        # policy as expected S3 4xx)
+                        tsp.set_attr(assignError=r["error"][:120])
+                        return self._json(r, 404)
+                    out = {
+                        "fid": r["fid"], "count": r["count"],
+                        "url": r["url"], "publicUrl": r["publicUrl"],
+                    }
+                    auth = ms.mint_write_jwt(r["fid"])
+                    if auth:
+                        out["auth"] = auth
+                    return self._json(out)
             if u.path == "/dir/lookup":
                 if not ms.is_leader() and ms.leader_address() != ms.address:
                     import requests as _rq
@@ -803,16 +824,20 @@ def _make_http_handler(ms: MasterServer):
                     return self._json({"mode": "single-master",
                                        "leader": ms.address})
                 return self._json(ms.raft.status())
-            if u.path in ("/dir/status", "/cluster/status"):
+            if u.path in ("/status", "/dir/status", "/cluster/status"):
                 total, used, files = ms.topo.statistics()
                 return self._json({
+                    **status_base(ms._started_at),
                     "IsLeader": ms.is_leader(),
                     "Leader": ms.leader_address(),
                     "Topology": {
                         "Max": total, "Size": used, "FileCount": files,
                         "DataNodes": sorted(ms.topo.nodes),
                     },
+                    "Trace": trace.STORE.stats(),
                 })
+            if u.path == "/debug/traces":
+                return self._json(trace.debug_traces_payload(q))
             if u.path == "/vol/grow":
                 if not ms.is_leader():
                     return self._json(
@@ -840,9 +865,10 @@ def _make_http_handler(ms: MasterServer):
             if u.path == "/col/delete":
                 return self._json({"error": "use gRPC CollectionDelete"}, 400)
             if u.path == "/metrics":
-                body = master_metrics_text().encode()
+                ex = "exemplars" in q
+                body = gather(exemplars=ex).encode()
                 self.send_response(200)
-                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Type", metrics_content_type(ex))
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)

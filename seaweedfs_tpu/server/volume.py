@@ -49,7 +49,7 @@ from ..storage.file_id import parse_file_id
 from ..storage.needle import CrcError, Needle
 from ..storage.store import Store
 from ..storage.ttl import TTL
-from ..utils import failpoint, glog
+from ..utils import failpoint, glog, trace
 from ..utils.http import not_modified
 from ..utils.stats import (
     VOLUME_SERVER_EC_ENCODE_BYTES,
@@ -57,6 +57,8 @@ from ..utils.stats import (
     VOLUME_SERVER_REQUEST_HISTOGRAM,
     VOLUME_SERVER_VOLUME_COUNTER,
     gather,
+    metrics_content_type,
+    status_base,
 )
 
 BUFFER_SIZE_LIMIT = 2 * 1024 * 1024  # streaming chunk (volume_grpc_copy.go:25)
@@ -163,6 +165,7 @@ class VolumeServer:
         # foreground rate meter is what it backs off on.
         self._fg_rate = _RateMeter()
         self.scrubber = Scrubber(self.store, self)
+        self._started_at = time.time()
 
     @property
     def address(self) -> str:
@@ -180,9 +183,11 @@ class VolumeServer:
     # -- lifecycle ---------------------------------------------------------
 
     def start(self) -> None:
+        trace.set_identity("volume", self.address)
         self._grpc_server = rpc.new_server()
         creds = rpc.add_servicer(self._grpc_server, rpc.VOLUME_SERVICE,
-                                 VolumeGrpc(self), component="volume")
+                                 VolumeGrpc(self), component="volume",
+                                 address=self.address)
         rpc.serve_port(self._grpc_server, f"[::]:{self.grpc_port}",
                        "volume", creds=creds)
         self._grpc_server.start()
@@ -557,6 +562,15 @@ class VolumeServer:
         survivor set coalesce into one device dispatch. Shards in
         `exclude` are never used as survivors (scrub self-heal: their
         bytes exist locally but are suspected rotten)."""
+        with trace.span("volume.ec.reconstruct", child_only=True,
+                        server=self.address, vid=vid, shard=sid,
+                        size=size) as tsp:
+            out = self._reconstruct_range_traced(
+                ev, vid, sid, soff, size, locs, exclude, tsp)
+        return out
+
+    def _reconstruct_range_traced(self, ev, vid, sid, soff, size, locs,
+                                  exclude, tsp) -> bytes:
         geo = ev.geo
         exclude = exclude or set()
         bufs: dict[int, np.ndarray] = {}
@@ -602,6 +616,7 @@ class VolumeServer:
         if sid in bufs:  # a flaky local read healed mid-gather
             return bufs[sid].tobytes()
         pres = tuple(sorted(bufs))  # canonical order -> shared lane
+        tsp.set_attr(survivors=len(pres))
         mids, rows = dispatch.reconstruct_now(
             self.store.coder, pres, np.stack([bufs[i] for i in pres]))
         return np.asarray(rows[mids.index(sid)], np.uint8).tobytes()
@@ -637,7 +652,7 @@ class VolumeServer:
         # too: without Content-Encoding the replica stores compressed
         # bytes with is_compressed unset and later serves raw gzip to
         # readers (silent corruption on replica failover)
-        headers = {}
+        headers = trace.inject_headers({})  # replicas join the trace
         if content_type:
             headers["Content-Type"] = content_type
         if content_encoding:
@@ -1750,6 +1765,9 @@ def _make_http_handler(srv: VolumeServer):
             self.send_response(code)
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
+            tid = getattr(self, "_trace_id", "")
+            if tid:
+                self.send_header("X-Trace-Id", tid)
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()
@@ -1771,6 +1789,7 @@ def _make_http_handler(srv: VolumeServer):
         # -- GET/HEAD (volume_server_handlers_read.go:31)
 
         def do_GET(self):
+            self._trace_id = ""  # never leak across keep-alive requests
             if self._guard_denied():
                 return
             u = urlparse(self.path)
@@ -1792,10 +1811,15 @@ def _make_http_handler(srv: VolumeServer):
 
                 plane = srv.native_plane
                 return self._json({
+                    # unified /status schema (ISSUE 7 satellite):
+                    # version/startedAt/uptimeSeconds at top level on
+                    # every server
+                    **status_base(srv._started_at),
                     "Version": "seaweedfs-tpu", "Volumes": vols,
                     "NativeDataPlane": plane is not None,
                     "NativeRequests":
                         plane.request_count() if plane else 0,
+                    "Trace": trace.STORE.stats(),
                     # flush-batching factor of the python write engine
                     # (ISSUE 2 group commit); the native plane writes
                     # through unbuffered pwrite and does not batch
@@ -1817,8 +1841,14 @@ def _make_http_handler(srv: VolumeServer):
                               "counters": scrub_stats()},
                 })
             if u.path == "/metrics":
-                return self._reply(200, gather().encode(),
-                                   "text/plain; version=0.0.4")
+                q = parse_qs(u.query)
+                ex = "exemplars" in q
+                return self._reply(
+                    200, gather(exemplars=ex).encode(),
+                    metrics_content_type(ex))
+            if u.path == "/debug/traces":
+                q = {k: v[0] for k, v in parse_qs(u.query).items()}
+                return self._json(trace.debug_traces_payload(q))
             if u.path == "/healthz":
                 return self._json({"ok": True})
             if u.path in ("/", "/ui"):
@@ -1827,8 +1857,12 @@ def _make_http_handler(srv: VolumeServer):
                 return self._reply(200, volume_ui(srv),
                                    "text/html; charset=utf-8")
             srv._fg_rate.note()  # scrub pacing backs off on this rate
-            with VOLUME_SERVER_REQUEST_HISTOGRAM.time(type="read"):
-                self._serve_needle(u)
+            with trace.span("volume.read", carrier=self.headers,
+                            component="volume", server=srv.address,
+                            path=u.path) as tsp:
+                self._trace_id = tsp.trace_id
+                with VOLUME_SERVER_REQUEST_HISTOGRAM.time(type="read"):
+                    self._serve_needle(u)
 
         do_HEAD = do_GET
 
@@ -1896,9 +1930,15 @@ def _make_http_handler(srv: VolumeServer):
         # -- PUT/POST (volume_server_handlers_write.go:18)
 
         def do_PUT(self):
+            self._trace_id = ""
             srv._fg_rate.note()
-            with VOLUME_SERVER_REQUEST_HISTOGRAM.time(type="write"):
-                self._handle_write()
+            u = urlparse(self.path)
+            with trace.span("volume.write", carrier=self.headers,
+                            component="volume", server=srv.address,
+                            path=u.path) as tsp:
+                self._trace_id = tsp.trace_id
+                with VOLUME_SERVER_REQUEST_HISTOGRAM.time(type="write"):
+                    self._handle_write()
 
         do_POST = do_PUT
 
@@ -1971,9 +2011,17 @@ def _make_http_handler(srv: VolumeServer):
         # -- DELETE
 
         def do_DELETE(self):
+            self._trace_id = ""
             if self._guard_denied():
                 return
             u = urlparse(self.path)
+            with trace.span("volume.delete", carrier=self.headers,
+                            component="volume", server=srv.address,
+                            path=u.path) as tsp:
+                self._trace_id = tsp.trace_id
+                self._do_delete(u)
+
+        def _do_delete(self, u):
             q = {k: v[0] for k, v in parse_qs(u.query).items()}
             try:
                 fid = parse_file_id(u.path.lstrip("/"))

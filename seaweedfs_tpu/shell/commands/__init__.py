@@ -6,5 +6,6 @@ from . import (  # noqa: F401
     lock,
     remote,
     s3_mq,
+    trace_cmd,
     volume,
 )

@@ -75,6 +75,8 @@ def ec_encode(env, args, out):
     opts = p.parse_args(args)
     env.confirm_is_locked()
 
+    from ...utils import trace
+
     vids = ([opts.volumeId] if opts.volumeId
             else _collect_full_volume_ids(env, opts.collection, opts.fullPercent))
     if not vids:
@@ -82,7 +84,14 @@ def ec_encode(env, args, out):
         return
     if opts.parallelEncode <= 1 or len(vids) == 1:
         for vid in vids:
-            _do_ec_encode(env, vid, opts, out)
+            # root a trace per conversion: the generate/stream RPCs and
+            # every destination's sink work become one dumpable tree
+            with trace.span("shell.ec.encode", component="shell",
+                            vid=vid) as tsp:
+                _do_ec_encode(env, vid, opts, out)
+            if tsp.trace_id:
+                print(f"trace {tsp.trace_id} "
+                      f"(trace.dump -trace={tsp.trace_id})", file=out)
         return
     # encode volumes concurrently: the per-volume shard lifecycle is
     # independent, and overlapping the servers' encode pipelines is what
@@ -97,7 +106,9 @@ def ec_encode(env, args, out):
 
     def one(vid):
         try:
-            _do_ec_encode(env, vid, opts, out, shared=shared)
+            with trace.span("shell.ec.encode", component="shell",
+                            vid=vid):
+                _do_ec_encode(env, vid, opts, out, shared=shared)
         except Exception as e:  # KeyboardInterrupt/SystemExit still abort
             errors.append((vid, e))
 

@@ -125,6 +125,12 @@ class EcStreamDestination:
         self.resumed_bytes = 0
         self.resumes = 0
         self.error = ""
+        # trace parent captured at CONSTRUCTION (the generate handler's
+        # span): finish() runs in a thread-pool worker with no TLS
+        # context, but its sink work still belongs to that trace
+        from ..utils import trace as _trace
+
+        self._trace_parent = _trace.current_context()
 
     # -- producer side (encode coordinator) --------------------------------
 
@@ -273,6 +279,14 @@ class EcStreamDestination:
         missing (only the missing byte ranges, read back from the local
         shard files). Raises on unrecoverable failure; the caller turns
         that into a per-target fallback."""
+        from ..utils import trace as _trace
+
+        with _trace.span("ec.stream.finish", parent=self._trace_parent,
+                         child_only=True, peer=self.address,
+                         vid=self.vid) as tsp:
+            self._finish_traced(tsp)
+
+    def _finish_traced(self, tsp) -> None:
         t = self._thread
         if t is not None:
             self._flush_pending()  # tail chunks below the wire size
@@ -286,11 +300,16 @@ class EcStreamDestination:
             t.join(timeout=24 * 3600)
         if self._committed:
             EC_STREAM_STREAMS.inc(outcome="ok")
+            tsp.set_attr(bytesStreamed=self.bytes_streamed,
+                         resumes=self.resumes)
             return
         self._drain()
         try:
             self._catch_up()
             EC_STREAM_STREAMS.inc(outcome="ok")
+            tsp.set_attr(bytesStreamed=self.bytes_streamed,
+                         resumes=self.resumes,
+                         resumedBytes=self.resumed_bytes)
         except BaseException as e:
             self.error = f"{type(e).__name__}: {e}"
             EC_STREAM_STREAMS.inc(outcome="failed")

@@ -593,19 +593,42 @@ class Volume:
         """Block until a flush covering `seq` completed. The first waiter
         with no flush in flight becomes the leader: it flushes dat THEN
         idx under _lock (no concurrent appends), covering every write
-        registered so far — followers just wait for that flush."""
+        registered so far — followers just wait for that flush.
+
+        Traced (ISSUE 7): inside a request span the wait lands on the
+        PARENT span as `gcWaitMs` + `gcRole` attributes — the
+        per-request split between "I flushed" (leader) and "I waited
+        behind someone else's flush" (follower, the buffer wait the
+        batching trades latency for). Attributes, not a child span: a
+        span per write on the group-commit path would sit on the
+        volume's serialization point, and attribution must not tax the
+        very wait it measures."""
         if not self._gc_enabled or seq == 0:
             return
+        from ..utils import trace
+
+        sp = trace.current()
+        if sp is None:
+            self._commit_wait_inner(seq)
+            return
+        t0 = time.perf_counter()
+        role = self._commit_wait_inner(seq)
+        sp.set_attr(gcWaitMs=round((time.perf_counter() - t0) * 1e3, 3),
+                    gcRole=role)
+
+    def _commit_wait_inner(self, seq: int) -> str:
+        role = "follower"
         window = _group_commit_window_s()
         while True:
             with self._gc_cond:
                 if self._gc_flushed >= seq:
-                    return
+                    return role
                 if self._gc_leader:
                     self._gc_cond.wait(1.0)
                     continue
                 self._gc_leader = True
                 prev = self._gc_flushed
+            role = "leader"
             err: Exception | None = None
             flushed_ok = False
             target = 0

@@ -16,6 +16,20 @@ _REG_MU = threading.Lock()
 
 _BUCKETS = [0.0001, 0.0003, 0.001, 0.003, 0.01, 0.03, 0.1, 0.3, 1, 3, 10]
 
+# lazy handle on utils.trace (histogram exemplars read the active span);
+# lazy because trace imports nothing from here but callers may import
+# stats first, and the hot observe() path must not re-resolve the module
+_TRACE = None
+
+
+def _trace_mod():
+    global _TRACE
+    if _TRACE is None:
+        from . import trace
+
+        _TRACE = trace
+    return _TRACE
+
 
 class _Metric:
     kind = "untyped"
@@ -27,7 +41,7 @@ class _Metric:
         with _REG_MU:
             _REGISTRY.append(self)
 
-    def render(self) -> str:
+    def render(self, exemplars: bool = False) -> str:
         raise NotImplementedError
 
 
@@ -68,8 +82,9 @@ class Counter(_Metric):
                     out[str(d[label])] = out.get(str(d[label]), 0) + v
         return out
 
-    def render(self) -> str:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+    def render(self, exemplars: bool = False) -> str:
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
+               f"# TYPE {self.name} {self.kind}"]
         with self._lock:
             if not self._values:
                 out.append(f"{self.name} 0")
@@ -99,9 +114,33 @@ class Histogram(_Metric):
         self._counts: dict[tuple, list[int]] = {}
         self._sums: dict[tuple, float] = {}
         self._totals: dict[tuple, int] = {}
+        # (label key, bucket index) -> (trace_id, value, unix_ts): the
+        # most recent traced observation landing in that bucket. Lets a
+        # p99 bucket in /metrics name an actual retained trace id
+        # (ISSUE 7; rendered in the OpenMetrics exemplar syntax when the
+        # scrape asks for it).
+        self._exemplars: dict[tuple, tuple[str, float, float]] = {}
+
+    def _bucket_index(self, v: float) -> int:
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                return i
+        return len(self.buckets)  # +Inf
+
+    # exemplars exist to explain the TAIL — only LATENCY observations
+    # (families named *_seconds; slab-count/byte histograms have no
+    # meaningful duration exemplar) at least this slow pay the capture
+    # cost; the hot sub-millisecond path never does
+    EXEMPLAR_MIN = 0.025
 
     def observe(self, v: float, **labels) -> None:
         key = tuple(sorted(labels.items()))
+        exemplar = None
+        if v >= self.EXEMPLAR_MIN and self.name.endswith("_seconds"):
+            tr = _trace_mod()
+            sp = tr.current()
+            if sp is not None and sp.sampled:
+                exemplar = (sp.trace_id, v, tr.now_unix())
         with self._lock:
             counts = self._counts.setdefault(key, [0] * len(self.buckets))
             for i, b in enumerate(self.buckets):
@@ -109,24 +148,62 @@ class Histogram(_Metric):
                     counts[i] += 1
             self._sums[key] = self._sums.get(key, 0) + v
             self._totals[key] = self._totals.get(key, 0) + 1
+            if exemplar is not None:
+                self._exemplars[key + (self._bucket_index(v),)] = exemplar
 
     def time(self, **labels):
         return _Timer(self, labels)
 
-    def render(self) -> str:
-        out = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+    def exemplars(self, **labels) -> dict[str, dict]:
+        """bucket upper bound -> {traceId, value, ts} for one label set
+        (the /status and /debug surfaces; render() emits the same in
+        OpenMetrics syntax)."""
+        key = tuple(sorted(labels.items()))
+        out: dict[str, dict] = {}
+        with self._lock:
+            for k, (tid, v, ts) in self._exemplars.items():
+                if k[:-1] != key:
+                    continue
+                idx = k[-1]
+                le = str(self.buckets[idx]) if idx < len(self.buckets) \
+                    else "+Inf"
+                out[le] = {"traceId": tid, "value": v, "ts": ts}
+        return out
+
+    def render(self, exemplars: bool = False) -> str:
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
+               f"# TYPE {self.name} {self.kind}"]
         with self._lock:
             for key in sorted(self._counts):
                 cum = 0
                 for i, b in enumerate(self.buckets):
                     cum = self._counts[key][i]
                     lk = key + (("le", str(b)),)
-                    out.append(f"{self.name}_bucket{_fmt_labels(lk)} {cum}")
+                    line = f"{self.name}_bucket{_fmt_labels(lk)} {cum}"
+                    out.append(line + self._exemplar_suffix(
+                        key, i, exemplars))
                 lk = key + (("le", "+Inf"),)
-                out.append(f"{self.name}_bucket{_fmt_labels(lk)} {self._totals[key]}")
+                out.append(
+                    f"{self.name}_bucket{_fmt_labels(lk)} "
+                    f"{self._totals[key]}"
+                    + self._exemplar_suffix(key, len(self.buckets),
+                                            exemplars))
                 out.append(f"{self.name}_sum{_fmt_labels(key)} {self._sums[key]}")
                 out.append(f"{self.name}_count{_fmt_labels(key)} {self._totals[key]}")
         return "\n".join(out)
+
+    def _exemplar_suffix(self, key: tuple, idx: int,
+                         exemplars: bool) -> str:
+        """OpenMetrics exemplar (` # {trace_id="..."} v ts`) for one
+        bucket line; "" without an exemplar or when not requested
+        (plain 0.0.4 scrapers must keep parsing)."""
+        if not exemplars:
+            return ""
+        ex = self._exemplars.get(key + (idx,))
+        if ex is None:
+            return ""
+        tid, v, ts = ex
+        return f' # {{trace_id="{tid}"}} {v:.6g} {ts:.3f}'
 
 
 class _Timer:
@@ -142,18 +219,36 @@ class _Timer:
         self.hist.observe(time.perf_counter() - self.t0, **self.labels)
 
 
+def _escape_label_value(v) -> str:
+    """Prometheus text exposition escaping for label VALUES: backslash,
+    double-quote and newline must be escaped or a hostile value (e.g. a
+    collection named `a"b` or one holding a newline) corrupts the whole
+    scrape — every sample after it fails to parse."""
+    return (str(v).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP lines escape backslash + newline (exposition format §HELP)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
 def _fmt_labels(key: tuple) -> str:
     if not key:
         return ""
-    inner = ",".join(f'{k}="{v}"' for k, v in key)
+    inner = ",".join(f'{k}="{_escape_label_value(v)}"' for k, v in key)
     return "{" + inner + "}"
 
 
-def gather() -> str:
-    """Render every registered metric (stats.Gather equivalent)."""
+def gather(exemplars: bool = False) -> str:
+    """Render every registered metric (stats.Gather equivalent). With
+    `exemplars`, histogram bucket lines carry OpenMetrics exemplars
+    linking to retained trace ids (serve it when the scraper opts in —
+    `/metrics?exemplars=1` — so plain 0.0.4 parsers stay safe)."""
     with _REG_MU:
         metrics = list(_REGISTRY)
-    return "\n".join(m.render() for m in metrics) + "\n"
+    return "\n".join(m.render(exemplars=exemplars)
+                     for m in metrics) + "\n"
 
 
 # -- the metric families the reference defines (metrics_names.go) ----------
@@ -341,11 +436,92 @@ SCRUB_BACKOFFS = Counter(
     "Times the scrubber backed off because foreground QPS was high.")
 
 
+# -- tracing plane (ISSUE 7): span recording volume + tail retention,
+#    and the hardened metrics-push loop's outcome counter ------------------
+
+class _PullCounter(Counter):
+    """Counter whose values are PULLED from a provider at read time —
+    for hot-path producers (the span store) that must not pay a metric
+    lock per event. The provider returns {label_key_tuple: value}."""
+
+    def __init__(self, name: str, help_: str, provider):
+        super().__init__(name, help_)
+        self._provider = provider
+
+    def _snap(self) -> dict:
+        try:
+            return self._provider()
+        except Exception:  # noqa: BLE001 — a scrape must never fail
+            return {}
+
+    def inc(self, n: float = 1, **labels) -> None:
+        raise TypeError(f"{self.name} is pull-based; its producer "
+                        f"counts internally")
+
+    def value(self, **labels) -> float:
+        want = set(labels.items())
+        return sum(v for k, v in self._snap().items() if want <= set(k))
+
+    def split_by(self, label: str, **labels) -> dict[str, float]:
+        want = set(labels.items())
+        out: dict[str, float] = {}
+        for k, v in self._snap().items():
+            if not want <= set(k):
+                continue
+            d = dict(k)
+            if label in d:
+                out[str(d[label])] = out.get(str(d[label]), 0) + v
+        return out
+
+    def render(self, exemplars: bool = False) -> str:
+        out = [f"# HELP {self.name} {_escape_help(self.help)}",
+               f"# TYPE {self.name} {self.kind}"]
+        vals = self._snap()
+        if not vals:
+            out.append(f"{self.name} 0")
+        for key, val in sorted(vals.items()):
+            out.append(f"{self.name}{_fmt_labels(key)} {val}")
+        return "\n".join(out)
+
+
+def _trace_span_provider() -> dict:
+    return {(("component", c),): n
+            for c, n in _trace_mod().STORE.span_counts().items()}
+
+
+def _trace_retained_provider() -> dict:
+    return {(("reason", r),): n
+            for r, n in _trace_mod().STORE.retained_counts().items()}
+
+
+TRACE_SPANS = _PullCounter(
+    "SeaweedFS_trace_spans",
+    "Spans recorded by the tracing plane, by component "
+    "(s3/filer/volume/master/shell).", _trace_span_provider)
+TRACE_RETAINED_TRACES = _PullCounter(
+    "SeaweedFS_trace_retained_traces",
+    "Traces pinned by tail-based retention, by reason (slow/error).",
+    _trace_retained_provider)
+METRICS_PUSH_OPS = Counter(
+    "SeaweedFS_metrics_push_ops",
+    "Push-gateway delivery attempts by outcome (ok/error); the push "
+    "loop retries with backoff and never dies on a refused connection.")
+
+
+# snake_case metric LABEL VALUES -> camelCase /status JSON keys (labels
+# keep their wire names; the unified /status schema test pins that every
+# section key is camelCase)
+_CAMEL = {"ec_syndrome": "ecSyndrome", "needle_crc": "needleCrc",
+          "ec_parity": "ecParity", "replica_divergence":
+          "replicaDivergence", "re_replicate": "reReplicate",
+          "ec_rebuild": "ecRebuild", "anti_entropy": "antiEntropy"}
+
+
 def scrub_stats() -> dict:
     """Snapshot for /status pages: find->repair->clean lifecycle counters."""
     out = {
         "bytesVerified": {
-            k: int(SCRUB_BYTES.value(kind=k))
+            _CAMEL.get(k, k): int(SCRUB_BYTES.value(kind=k))
             for k in ("needle", "ec_syndrome", "digest")},
         "needlesChecked": int(SCRUB_NEEDLES.value()),
         "sweeps": {k: int(SCRUB_SWEEPS.value(kind=k))
@@ -355,11 +531,11 @@ def scrub_stats() -> dict:
         "backoffs": int(SCRUB_BACKOFFS.value()),
     }
     for kind in ("needle_crc", "ec_parity", "replica_divergence"):
-        out["findings"][kind] = {
+        out["findings"][_CAMEL[kind]] = {
             s: int(SCRUB_FINDINGS.value(kind=kind, state=s))
             for s in ("found", "repaired", "failed")}
     for method in ("re_replicate", "ec_rebuild", "anti_entropy"):
-        out["repairs"][method] = {
+        out["repairs"][_CAMEL[method]] = {
             o: int(SCRUB_REPAIRS.value(method=method, outcome=o))
             for o in ("ok", "failed")}
     return out
@@ -437,26 +613,70 @@ def fid_lease_stats() -> dict:
     }
 
 
-def master_metrics_text() -> str:
-    return gather()
+VERSION_STRING = "seaweedfs-tpu 0.1"
+
+
+def metrics_content_type(exemplars: bool) -> str:
+    """Exemplar-annotated bodies are only legal under the OpenMetrics
+    media type — a scraper told 0.0.4 would fail the whole scrape at
+    the first mid-line `#`; plain scrapes keep the classic type."""
+    return ("application/openmetrics-text; version=1.0.0; charset=utf-8"
+            if exemplars else "text/plain; version=0.0.4")
+
+
+def status_base(started_at_unix: float) -> dict:
+    """The top-level keys every server's /status shares (ISSUE 7
+    satellite: one schema — `version`/`startedAt`/`uptimeSeconds` at top
+    level on master, filer, volume and s3 alike; pinned by
+    tests/test_observability.py)."""
+    return {
+        "version": VERSION_STRING,
+        "startedAt": int(started_at_unix),
+        "uptimeSeconds": round(max(time.time() - started_at_unix, 0.0), 1),
+    }
 
 
 def start_push(gateway_url: str, job: str, interval_sec: int = 15):
     """Push the registry to a Prometheus push gateway on an interval
-    (stats.StartPushingMetric / LoopPushingMetric). Returns a stop()."""
+    (stats.StartPushingMetric / LoopPushingMetric). Returns a stop().
+
+    Hardened (ISSUE 7 satellite): each delivery rides utils/retry with
+    backoff — a refused connection (sink not up yet, flapping, mid-
+    restart) is a retryable transport error, never the end of the loop.
+    After exhausted retries the tick is dropped (counted in
+    SeaweedFS_metrics_push_ops{outcome="error"}) and the next interval
+    tries fresh; consecutive failures stretch the interval up to 4x so
+    a long-dead sink is not hammered every tick."""
     import requests
+
+    from . import retry as _retry
 
     stop = threading.Event()
 
+    def push_once(url: str) -> None:
+        r = requests.put(url, data=gather().encode(),
+                         headers={"Content-Type": "text/plain"},
+                         timeout=10)
+        if r.status_code >= 300:
+            # gateway answered but refused: surface as retryable — a
+            # mid-restart sink often 503s before it refuses connections
+            raise ConnectionError(f"push gateway {r.status_code}")
+
     def loop():
         url = f"{gateway_url.rstrip('/')}/metrics/job/{job}"
-        while not stop.wait(interval_sec):
+        consecutive_failures = 0
+        while True:
+            wait = interval_sec * min(1 + consecutive_failures, 4)
+            if stop.wait(wait):
+                return
             try:
-                requests.put(url, data=gather().encode(),
-                             headers={"Content-Type": "text/plain"},
-                             timeout=10)
-            except requests.RequestException:
-                pass
+                _retry.retry("metrics.push", lambda: push_once(url),
+                             attempts=3, wait_init=0.2, wait_max=2.0)
+                METRICS_PUSH_OPS.inc(outcome="ok")
+                consecutive_failures = 0
+            except Exception:  # noqa: BLE001 — the loop must survive
+                METRICS_PUSH_OPS.inc(outcome="error")
+                consecutive_failures += 1
 
     threading.Thread(target=loop, daemon=True).start()
     return stop.set

@@ -23,7 +23,7 @@ import grpc
 
 from ..pb import master_pb2, rpc
 from ..storage.file_id import parse_file_id
-from ..utils import glog
+from ..utils import glog, trace
 from ..utils.retry import multi_retry
 
 
@@ -137,9 +137,16 @@ class MasterClient:
                 entry = self._vid_cache.get(vid)
                 if entry and entry[0] > now and entry[1]:
                     return list(entry[1])
-        resp = self._with_master("LookupVolume", lambda stub: stub.LookupVolume(
-            master_pb2.LookupVolumeRequest(volume_or_file_ids=[str(vid)]),
-            timeout=10))
+        # the cache miss is the attributable part: inside a request
+        # span the master round-trip becomes a `wdclient.lookup` child
+        # (hits return above without a span — they cost nothing)
+        with trace.span("wdclient.lookup", child_only=True, vid=vid,
+                        refresh=refresh):
+            resp = self._with_master(
+                "LookupVolume", lambda stub: stub.LookupVolume(
+                    master_pb2.LookupVolumeRequest(
+                        volume_or_file_ids=[str(vid)]),
+                    timeout=10))
         locs = []
         for e in resp.volume_id_locations:
             if e.error:
@@ -185,9 +192,11 @@ class MasterClient:
             entry = self._ec_vid_cache.get(vid)
             if entry and entry[0] > now:
                 return dict(entry[1])
-        resp = self._with_master(
-            "LookupEcVolume", lambda stub: stub.LookupEcVolume(
-                master_pb2.LookupEcVolumeRequest(volume_id=vid), timeout=10))
+        with trace.span("wdclient.lookup_ec", child_only=True, vid=vid):
+            resp = self._with_master(
+                "LookupEcVolume", lambda stub: stub.LookupEcVolume(
+                    master_pb2.LookupEcVolumeRequest(volume_id=vid),
+                    timeout=10))
         out = {
             sl.shard_id: [Location(l.url, l.public_url, l.grpc_port)
                           for l in sl.locations]

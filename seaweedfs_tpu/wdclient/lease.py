@@ -31,6 +31,7 @@ from collections import deque
 from dataclasses import replace
 
 from ..operation import AssignResult, assign
+from ..utils import trace
 from ..utils.stats import CLIENT_FID_LEASE_COUNTER
 
 DEFAULT_BATCH = 128
@@ -93,6 +94,11 @@ class FidLeasePool:
                     blocks.popleft()
                     continue
                 CLIENT_FID_LEASE_COUNTER.inc(result="hit")
+                sp = trace.current()
+                if sp is not None:
+                    # a lease hit is the absence of a master RPC — worth
+                    # an attribute, not a span of its own
+                    sp.set_attr(fidLease="hit")
                 return b.take()
         # pool dry for this key: one batched Assign restocks it. The RPC
         # runs outside the lock — a slow master must not stall every
@@ -100,9 +106,11 @@ class FidLeasePool:
         with self._lock:
             count = 1 if key in self._jwt_keys else self.batch
             gen = self._gens.get(key, 0)
-        a = assign(self.master, count=count, collection=collection,
-                   replication=replication, ttl=ttl,
-                   data_center=data_center)
+        with trace.span("wdclient.lease.refill", child_only=True,
+                        count=count):
+            a = assign(self.master, count=count, collection=collection,
+                       replication=replication, ttl=ttl,
+                       data_center=data_center)
         if a.error:
             return a
         CLIENT_FID_LEASE_COUNTER.inc(result="refill")

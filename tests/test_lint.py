@@ -65,3 +65,27 @@ def test_lint_catches_bare_device_enumeration(tmp_path):
     # and the rule runs as part of the gate regardless of ruff presence:
     # the repo itself is clean under it
     assert lint.run_device_rule() == []
+
+
+def test_lint_catches_wall_clock_in_trace_plane(tmp_path):
+    """SWFS002 (ISSUE 7 satellite): `time.time()` / `time.time_ns()`
+    inside the tracing plane is an error — span timing must be
+    monotonic — while the marked module-level anchor stays exempt."""
+    lint = _load_lint()
+    bad = tmp_path / "trace.py"
+    bad.write_text(
+        "import time\n"
+        "ANCHOR = time.time_ns() / 1e9  # lint: allow-wall-clock-anchor\n"
+        "def span_start():\n"
+        "    return time.time()\n"
+        "def span_stamp():\n"
+        "    return time.time_ns()\n"
+        "def fine():\n"
+        "    return time.perf_counter() + time.monotonic()\n")
+    findings = lint.run_span_timing_rule([str(bad)])
+    assert len(findings) == 2 and all("SWFS002" in f for f in findings), \
+        findings
+
+    # the real tracing module is clean under the rule (its single
+    # wall-clock read is the marked anchor)
+    assert lint.run_span_timing_rule() == []

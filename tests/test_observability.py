@@ -85,6 +85,60 @@ def test_metrics_exposition_format():
     assert "SeaweedFS_filerStore_ops" in text
 
 
+def test_exposition_escapes_hostile_label_values():
+    """ISSUE 7 satellite regression: a collection (or any label value)
+    containing `\"`, `\\` or a newline must be escaped per the text
+    exposition format — unescaped, every sample after it fails to
+    parse and the whole scrape is lost."""
+    import re
+
+    from seaweedfs_tpu.utils import stats
+
+    c = stats.Counter("SeaweedFS_test_hostile_ops", "test only")
+    try:
+        hostile = 'evil"col\\with\nnewline'
+        c.inc(collection=hostile, op="put")
+        out = c.render()
+        lines = out.splitlines()
+        # the render stays line-oriented: exactly HELP + TYPE + 1 sample
+        assert len(lines) == 3, lines
+        sample = lines[2]
+        assert '\\"' in sample and "\\\\" in sample and "\\n" in sample
+        # the escaped line round-trips through the exposition grammar
+        m = re.fullmatch(
+            r'SeaweedFS_test_hostile_ops\{(?P<labels>(?:[a-zA-Z_]\w*='
+            r'"(?:[^"\\\n]|\\.)*",?)+)\} (?P<v>[0-9.e+-]+)', sample)
+        assert m, sample
+        # and unescaping recovers the original value
+        esc = re.search(r'collection="((?:[^"\\\n]|\\.)*)"', sample)
+        unescaped = (esc.group(1).replace("\\n", "\n")
+                     .replace('\\"', '"').replace("\\\\", "\\"))
+        assert unescaped == hostile
+    finally:
+        with stats._REG_MU:
+            stats._REGISTRY.remove(c)
+
+
+def test_every_metric_family_is_in_readme_table():
+    """ISSUE 7 satellite: the README metrics table is the contract —
+    every SeaweedFS_* family registered in utils/stats.py must appear
+    in it (a new family without docs fails CI)."""
+    import re
+
+    from seaweedfs_tpu.utils import stats
+
+    readme = open(os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "README.md")).read()
+    documented = set(re.findall(r"`(SeaweedFS_\w+)`", readme))
+    with stats._REG_MU:
+        registered = {m.name for m in stats._REGISTRY
+                      if m.name.startswith("SeaweedFS_")}
+    missing = registered - documented
+    assert not missing, \
+        f"metric families missing from README's metrics table: {missing}"
+
+
 def test_metrics_push_and_master_broadcast(tmp_path):
     # a fake push gateway capturing PUTs
     from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -129,6 +183,148 @@ def test_metrics_push_and_master_broadcast(tmp_path):
         vsrv.stop()
         master.stop()
         gw.shutdown()
+        rpc.reset_channels()
+
+
+def test_metrics_push_survives_flapping_sink(tmp_path):
+    """ISSUE 7 satellite chaos: the push loop must survive a sink that
+    is down when pushing starts, recover when it comes up, keep going
+    when it flaps to 503s, and count every outcome — a refused
+    connection must never kill the thread."""
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from seaweedfs_tpu.utils import stats
+
+    received = []
+    fail_mode = {"on": False}
+
+    class GW(BaseHTTPRequestHandler):
+        def log_message(self, *a):
+            pass
+
+        def do_PUT(self):
+            n = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(n)
+            if fail_mode["on"]:
+                self.send_response(503)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+                return
+            received.append(body)
+            self.send_response(200)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+
+    gw_port = _free_port()
+    err0 = stats.METRICS_PUSH_OPS.value(outcome="error")
+    ok0 = stats.METRICS_PUSH_OPS.value(outcome="ok")
+    # the sink does NOT exist yet: first pushes hit connection refused
+    stop = stats.start_push(f"http://localhost:{gw_port}", "flaptest",
+                            interval_sec=1)
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                stats.METRICS_PUSH_OPS.value(outcome="error") <= err0:
+            time.sleep(0.1)
+        assert stats.METRICS_PUSH_OPS.value(outcome="error") > err0, \
+            "refused connections were never counted"
+        # sink comes up: the SAME loop must recover and deliver
+        gw = ThreadingHTTPServer(("", gw_port), GW)
+        threading.Thread(target=gw.serve_forever, daemon=True).start()
+        try:
+            deadline = time.time() + 15
+            while time.time() < deadline and not received:
+                time.sleep(0.1)
+            assert received, "push loop never recovered after the sink " \
+                             "came up"
+            assert b"SeaweedFS_" in received[0]
+            assert stats.METRICS_PUSH_OPS.value(outcome="ok") > ok0
+            # flap to 503s: deliveries fail (counted), loop survives
+            fail_mode["on"] = True
+            errs = stats.METRICS_PUSH_OPS.value(outcome="error")
+            deadline = time.time() + 10
+            while time.time() < deadline and \
+                    stats.METRICS_PUSH_OPS.value(outcome="error") <= errs:
+                time.sleep(0.1)
+            assert stats.METRICS_PUSH_OPS.value(outcome="error") > errs
+            # and heals again
+            fail_mode["on"] = False
+            n = len(received)
+            deadline = time.time() + 15
+            while time.time() < deadline and len(received) <= n:
+                time.sleep(0.1)
+            assert len(received) > n, "loop did not heal after the flap"
+        finally:
+            gw.shutdown()
+    finally:
+        stop()
+
+
+_CAMEL_KEY = __import__("re").compile(r"^[a-z][a-zA-Z0-9]*$")
+
+
+def _assert_camel_keys(obj, path=""):
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            # schema keys must be camelCase; DATA keys (chip labels
+            # like "-"/"0", addresses with ":") are not identifiers
+            # and are exempt
+            if __import__("re").match(r"^[A-Za-z]", k):
+                assert _CAMEL_KEY.match(k), \
+                    f"non-camelCase key {k!r} at {path or '<root>'}"
+            _assert_camel_keys(v, f"{path}.{k}")
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            _assert_camel_keys(v, f"{path}[{i}]")
+
+
+def test_status_schema_unified_across_servers(tmp_path):
+    """ISSUE 7 satellite: every server's /status reports version/
+    startedAt/uptimeSeconds at top level, and the per-plane sections
+    (EcDispatch, Scrub, EcStream, GroupCommit, ChunkCache, Trace) use
+    consistent camelCase keys all the way down."""
+    from seaweedfs_tpu.s3api.server import S3Server
+    from seaweedfs_tpu.server.filer import FilerServer
+
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "v")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), pulse_seconds=1)
+    vsrv.start()
+    fsrv = FilerServer(ip="localhost", port=_free_port(),
+                       master=f"localhost:{mport}")
+    fsrv.start()
+    s3 = S3Server(port=_free_port(), filer=fsrv.address)
+    s3.start()
+    try:
+        addrs = [master.address, vsrv.address, fsrv.address,
+                 f"localhost:{s3.port}"]
+        for addr in addrs:
+            st = requests.get(f"http://{addr}/status", timeout=10).json()
+            assert st["version"].startswith("seaweedfs-tpu"), (addr, st)
+            assert isinstance(st["startedAt"], int)
+            assert st["uptimeSeconds"] >= 0
+            assert "Trace" in st
+        vol = requests.get(f"http://{vsrv.address}/status",
+                           timeout=10).json()
+        for section in ("GroupCommit", "EcDispatch", "EcStream",
+                        "Scrub", "Trace"):
+            assert section in vol, section
+            _assert_camel_keys(vol[section], section)
+        fil = requests.get(f"http://{fsrv.address}/status",
+                           timeout=10).json()
+        for section in ("ChunkCache", "FidLease", "Trace"):
+            assert section in fil, section
+            _assert_camel_keys(fil[section], section)
+    finally:
+        s3.stop()
+        fsrv.stop()
+        vsrv.stop()
+        master.stop()
         rpc.reset_channels()
 
 

@@ -241,6 +241,25 @@ def test_sigv4_auth(stack):
     assert r.status_code == 200 and r.content == body
 
 
+def test_admin_plane_requires_admin_when_iam_on(stack):
+    """/debug/traces and /status carry request-level data (object keys,
+    internal addresses) — on an IAM-enabled gateway they must reject
+    anonymous callers; the aggregate-only /metrics stays open."""
+    *_, s3, s3_auth = stack
+    base = f"http://localhost:{s3_auth.port}"
+    for path in ("/debug/traces", "/status"):
+        assert requests.get(base + path, timeout=30).status_code == 403
+        h = _sign_v4("GET", base + path, "AKID123", "SECRET456")
+        assert requests.get(base + path, headers=h,
+                            timeout=30).status_code == 200
+    assert requests.get(f"{base}/metrics", timeout=30).status_code == 200
+    # IAM off (dev mode): admin plane stays open
+    open_base = f"http://localhost:{s3.port}"
+    assert requests.get(f"{open_base}/debug/traces",
+                        timeout=30).status_code == 200
+    assert requests.get(f"{open_base}/status", timeout=30).status_code == 200
+
+
 def test_upload_part_copy(stack):
     """UploadPartCopy: parts sourced from an existing object with and
     without x-amz-copy-source-range (CopyObjectPartHandler parity)."""

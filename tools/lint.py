@@ -45,6 +45,15 @@ DEVICE_ENUM_ALLOWED = (
     "bench.py",
 )
 
+# SWFS002 (ISSUE 7): span timing inside the tracing plane must come from
+# the monotonic clocks (time.monotonic()/time.perf_counter(), or the
+# module's own monotonic-anchored now_unix()). A bare time.time() there
+# would make span durations and ordering lie across an NTP step — the
+# exact corruption the trace plane exists to rule out.
+SPAN_TIMING_FILES = (
+    os.path.join("seaweedfs_tpu", "utils", "trace.py"),
+)
+
 
 def _python_files() -> list[str]:
     out = []
@@ -116,6 +125,52 @@ class _DeviceEnumVisitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
+class _SpanTimingVisitor(ast.NodeVisitor):
+    """SWFS002: `time.time()` (and `time.time_ns()`) calls inside the
+    tracing module — span timing must be monotonic."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list[str] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in ("time", "time_ns") \
+                and isinstance(f.value, ast.Name) and f.value.id == "time":
+            self.findings.append(
+                f"{self.path}:{node.lineno}: SWFS002 time.{f.attr}() in "
+                f"the tracing plane — span timing must use "
+                f"time.monotonic()/time.perf_counter() (wall-clock "
+                f"anchoring goes through the module's _EPOCH_ANCHOR)")
+        self.generic_visit(node)
+
+
+def run_span_timing_rule(files: list[str] | None = None) -> list[str]:
+    """The SWFS002 rule over SPAN_TIMING_FILES (or an explicit list);
+    the module-level anchor assignment is exempted by line: only the
+    FIRST wall-clock read (the anchor) is legal, and it is marked with
+    a `# lint: allow-wall-clock-anchor` comment."""
+    findings: list[str] = []
+    for path in (files if files is not None
+                 else [os.path.join(REPO, p) for p in SPAN_TIMING_FILES]):
+        rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+        try:
+            with open(path, "rb") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=rel)
+        except (OSError, SyntaxError):
+            continue
+        allowed_lines = {
+            i + 1 for i, line in enumerate(src.decode(errors="replace")
+                                           .splitlines())
+            if "lint: allow-wall-clock-anchor" in line}
+        v = _SpanTimingVisitor(rel)
+        v.visit(tree)
+        findings.extend(f for f in v.findings
+                        if int(f.split(":")[1]) not in allowed_lines)
+    return findings
+
+
 def run_device_rule(files: list[str] | None = None) -> list[str]:
     """The in-repo device-enumeration rule; returns findings (files that
     fail to parse are the syntax gate's business, not this rule's)."""
@@ -160,10 +215,10 @@ def run_fallback() -> int:
 
 def main() -> int:
     rc = run_ruff() if shutil.which("ruff") else run_fallback()
-    dev = run_device_rule()
-    for finding in dev:
+    extra = run_device_rule() + run_span_timing_rule()
+    for finding in extra:
         print(finding)
-    if dev and rc == 0:
+    if extra and rc == 0:
         rc = 1
     return rc
 
