@@ -64,7 +64,11 @@ class AssignResult:
 _TRANSIENT_ASSIGN = ("no writable volumes", "no free volume slot",
                      "not enough servers",
                      "no data center with enough free slots",
-                     "volume growth rpc failed")
+                     "volume growth rpc failed",
+                     # QoS pressure shed (ISSUE 8): an explicit
+                     # early rejection with a retry hint — pressure
+                     # drains in seconds, exactly what backoff is for
+                     "overloaded")
 
 
 def assign(master: str, *, count: int = 1, collection: str = "",

@@ -25,6 +25,7 @@ from . import (
     master_pb2,
     mount_pb2,
     mq_pb2,
+    qos_pb2,
     s3_pb2,
     scrub_pb2,
     volume_server_pb2,
@@ -75,6 +76,9 @@ MASTER_SERVICE = ("master_pb.Seaweed", [
     _m("ReleaseAdminToken", M.ReleaseAdminTokenRequest, M.ReleaseAdminTokenResponse),
     _m("ListClusterNodes", M.ListClusterNodesRequest, M.ListClusterNodesResponse),
     _m("Ping", M.PingRequest, M.PingResponse),
+    # QoS plane (qos.proto; messages in pb/qos_pb2.py): volume servers
+    # lease cluster-wide background byte budgets and report pressure
+    _m("QosGrant", qos_pb2.QosGrantRequest, qos_pb2.QosGrantResponse),
     _m("RaftListClusterServers", M.RaftListClusterServersRequest, M.RaftListClusterServersResponse),
     _m("RaftAddServer", M.RaftAddServerRequest, M.RaftAddServerResponse),
     _m("RaftRemoveServer", M.RaftRemoveServerRequest, M.RaftRemoveServerResponse),

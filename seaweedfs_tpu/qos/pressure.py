@@ -1,0 +1,54 @@
+"""Backpressure scoring: one number per volume server (ISSUE 8).
+
+Two queues already measured per-request by the PR-7 tracing plane are
+the earliest honest overload signals a volume server has:
+
+  * **group-commit buffer depth** — writes registered for flush but not
+    yet covered by one (`Volume._gc_seq - Volume._gc_flushed`, summed
+    over volumes). A deep buffer means the leader flush is falling
+    behind the ingest rate (the `gcWaitMs` span attribute, aggregated).
+  * **EC dispatch queue depth** — slabs queued in the scheduler's
+    per-chip lanes (`EcDispatchScheduler.chip_depths()`, summed). Deep
+    lanes mean device dispatches are the bottleneck (the
+    `dispatchQueueWaitMs` span attribute, aggregated).
+
+`pressure_score` folds them into [0, 1]: 0 = idle, ->1 = both queues at
+their caps. The fold is `1 - (1-a)(1-b)` over the clamped per-queue
+loads — STRICTLY MONOTONE in each input (pinned by tests/test_qos.py),
+so the master can compare servers and a rising queue can never lower a
+score. Caps are knobs: `SWFS_QOS_GC_CAP` pending writes (default 256)
+and `SWFS_QOS_DISPATCH_CAP` queued slabs (default 64).
+
+The score rides every `QosGrant` lease refresh to the master, which
+folds it into `assign` placement (prefer calm replicas) and — above
+`SWFS_QOS_SHED_PRESSURE` — sheds assigns outright, so admission fails
+fast instead of the data plane timing out late.
+"""
+
+from __future__ import annotations
+
+import os
+
+DEFAULT_GC_CAP = 256
+DEFAULT_DISPATCH_CAP = 64
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, str(default)))
+    except ValueError:
+        return default
+
+
+def pressure_score(gc_depth: float, dispatch_depth: float, *,
+                   gc_cap: float | None = None,
+                   dispatch_cap: float | None = None) -> float:
+    """[0, 1] overload score, monotone in both queue depths."""
+    if gc_cap is None:
+        gc_cap = _env_int("SWFS_QOS_GC_CAP", DEFAULT_GC_CAP)
+    if dispatch_cap is None:
+        dispatch_cap = _env_int("SWFS_QOS_DISPATCH_CAP",
+                                DEFAULT_DISPATCH_CAP)
+    a = min(max(gc_depth, 0.0) / max(gc_cap, 1.0), 1.0)
+    b = min(max(dispatch_depth, 0.0) / max(dispatch_cap, 1.0), 1.0)
+    return round(1.0 - (1.0 - a) * (1.0 - b), 4)

@@ -51,10 +51,14 @@ S3_NS = "http://s3.amazonaws.com/doc/2006-03-01/"
 
 
 class S3Error(Exception):
-    def __init__(self, status: int, code: str, message: str):
+    def __init__(self, status: int, code: str, message: str,
+                 retry_after_s: float = 0.0):
         super().__init__(message)
         self.status = status
         self.code = code
+        # 503s carry an honest Retry-After so SDK clients back off with
+        # jitter instead of hammering or failing hard (ISSUE 8)
+        self.retry_after_s = retry_after_s
 
 
 class S3Server:
@@ -65,6 +69,12 @@ class S3Server:
         self.filer_grpc = rpc.grpc_address(filer)
         self.iam = IdentityAccessManagement(identities)
         self.circuit_breaker = CircuitBreaker()
+        # QoS plane (ISSUE 8): per-tenant (access key / bucket /
+        # anonymous) token-bucket admission ahead of every other check;
+        # unconfigured env = observe-only, never rejects
+        from ..qos import TenantAdmission
+
+        self.qos_admission = TenantAdmission("s3")
         self._cb_loaded_at = 0.0
         self._http_server = None
         self._started_at = time.time()
@@ -194,8 +204,15 @@ class S3Server:
             url, data=data,
             headers=trace.inject_headers(
                 {"Content-Type":
-                 content_type or "application/octet-stream"}),
+                 content_type or "application/octet-stream",
+                 # tenant budget already charged at the S3 ingress —
+                 # the filer must not bill this internal leg twice
+                 "X-Swfs-Qos-Charged": "1"}),
             timeout=600)
+        if r.status_code in (429, 503):
+            # the backend throttled anyway (direct-traffic budget,
+            # pressure shed): surface it as throttling, not a bug
+            raise _backend_throttled(r, "filer PUT")
         if r.status_code >= 300:
             raise S3Error(500, "InternalError", f"filer PUT: {r.status_code}")
         return md5.hexdigest()
@@ -205,7 +222,8 @@ class S3Server:
         url = (f"http://{self.filer}{BUCKETS_DIR}/{bucket}/"
                + urllib.parse.quote(key))
         headers = trace.inject_headers(
-            {"Range": range_header} if range_header else {})
+            {**({"Range": range_header} if range_header else {}),
+             "X-Swfs-Qos-Charged": "1"})
         r = _session().get(url, headers=headers, timeout=600,
                               stream=stream)
         if r.status_code == 404:
@@ -215,6 +233,9 @@ class S3Server:
             r.close()
             raise S3Error(416, "InvalidRange",
                           "The requested range is not satisfiable")
+        if r.status_code in (429, 503):
+            r.close()
+            raise _backend_throttled(r, "filer GET")
         if r.status_code >= 300:
             r.close()
             raise S3Error(500, "InternalError", f"filer GET: {r.status_code}")
@@ -261,6 +282,40 @@ class _S3Control:
         except Exception as e:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, f"bad config: {e}")
         return s3_pb2.S3ConfigureResponse()
+
+
+def _backend_throttled(r, what: str) -> S3Error:
+    """A 429/503 from the backing filer IS throttling (its own ingress
+    budget or a pressure shed): pass it through as spec-shaped SlowDown
+    with the backend's retry hint — never a 500 InternalError that SDKs
+    classify as a server fault and fail hard on."""
+    try:
+        ra = float(r.headers.get("Retry-After") or 1.0)
+    except (TypeError, ValueError):
+        ra = 1.0
+    return S3Error(503, "SlowDown",
+                   f"Please reduce your request rate. ({what} throttled)",
+                   retry_after_s=ra)
+
+
+def _backend_unavailable(e: Exception) -> S3Error | None:
+    """Map backend-transport failures to a spec-shaped 503
+    ServiceUnavailable (ISSUE 8 satellite); None for everything else
+    (those stay 500 InternalError)."""
+    import requests as _rq
+
+    if isinstance(e, grpc.RpcError):
+        code = e.code() if callable(getattr(e, "code", None)) else None
+        if code in (grpc.StatusCode.UNAVAILABLE,
+                    grpc.StatusCode.DEADLINE_EXCEEDED):
+            return S3Error(503, "ServiceUnavailable",
+                           f"backend filer unavailable ({code})",
+                           retry_after_s=1.0)
+        return None
+    if isinstance(e, (_rq.ConnectionError, _rq.Timeout)):
+        return S3Error(503, "ServiceUnavailable",
+                       "backend filer unreachable", retry_after_s=1.0)
+    return None
 
 
 def _iter_exact(rfile, length: int):
@@ -315,8 +370,12 @@ def _make_handler(srv: S3Server):
             self.send_header("Content-Type", ctype)
             if "Content-Length" not in headers:
                 headers["Content-Length"] = str(len(body))
-            self.send_header("x-amz-request-id", uuid.uuid4().hex[:16])
             tid = getattr(self, "_trace_id", "")
+            # the request id IS the trace id when one exists — header
+            # and error-body RequestId agree, and both resolve through
+            # /debug/traces (ISSUE 8)
+            self.send_header("x-amz-request-id",
+                             tid or uuid.uuid4().hex[:16])
             if tid:
                 self.send_header("X-Trace-Id", tid)
             for k, v in headers.items():
@@ -326,10 +385,31 @@ def _make_handler(srv: S3Server):
                 self.wfile.write(body)
 
         def _error(self, err: S3Error):
+            # spec-shaped error body (ISSUE 8 satellite): Code, Message,
+            # Resource, RequestId — the fields AWS SDK error parsers
+            # read to classify and back off. RequestId is the TRACE id
+            # when the request has one, so an error in a client log is
+            # one `trace.dump` away from its per-plane breakdown.
             root = ET.Element("Error")
             _el(root, "Code", err.code)
             _el(root, "Message", str(err))
-            self._send(err.status, _xml_bytes(root))
+            _el(root, "Resource",
+                urllib.parse.urlparse(self.path).path)
+            _el(root, "RequestId",
+                getattr(self, "_trace_id", "") or uuid.uuid4().hex[:16])
+            headers = {}
+            if err.status == 503:
+                headers["Retry-After"] = str(
+                    max(int(err.retry_after_s + 0.999), 1))
+                if int(self.headers.get("Content-Length") or 0):
+                    # shed before the body was read (QoS admission /
+                    # breaker fire ahead of any body consumption): the
+                    # unread bytes would desync keep-alive parsing for
+                    # the NEXT request on this connection — same guard
+                    # as the filer's 429 path. Costs the throttled
+                    # client one reconnect, which is the point.
+                    self.close_connection = True
+            self._send(err.status, _xml_bytes(root), headers=headers)
 
         def _route(self):
             u = urllib.parse.urlparse(self.path)
@@ -471,10 +551,17 @@ def _make_handler(srv: S3Server):
                 if not self._admin_plane_ok(admin_u):
                     return self._send(403, b'{"error": "AccessDenied"}',
                                       "application/json")
+                from ..utils.stats import qos_stats
+
                 body = json.dumps({
                     **status_base(srv._started_at),
                     "Filer": srv.filer,
                     "Trace": trace.STORE.stats(),
+                    # QoS plane (ISSUE 8): tenant buckets + rejections
+                    "Qos": {
+                        **qos_stats(),
+                        "tenantAdmission": srv.qos_admission.status(),
+                    },
                 }).encode()
                 return self._send(200, body, "application/json")
             bucket, key, q, u = self._route()
@@ -492,15 +579,35 @@ def _make_handler(srv: S3Server):
                              release, tsp):
             try:
                 with S3_REQUEST_HISTOGRAM.time(action=f"{verb.lower()}"):
-                    # admission first: a tripped breaker must shed load
-                    # before any filer lookups (authz reads bucket state)
+                    # admission first: a tenant over budget (or a
+                    # tripped breaker) must shed load before any filer
+                    # lookups (authz reads bucket state). 503 SlowDown
+                    # is the spec code SDKs back off on.
+                    from ..qos import s3_tenant
+
+                    d = srv.qos_admission.admit(
+                        s3_tenant(self.headers, u.query, bucket),
+                        trace_id=tsp.trace_id,
+                        detail=f"{verb} {u.path}")
+                    if not d.admitted:
+                        tsp.set_attr(qosRejected=d.reason,
+                                     tenant=d.tenant)
+                        raise S3Error(
+                            503, "SlowDown",
+                            "Please reduce your request rate.",
+                            retry_after_s=d.retry_after_s)
                     srv.maybe_reload_circuit_breaker()
                     try:
                         release = srv.circuit_breaker.acquire(
                             action, bucket,
                             int(self.headers.get("Content-Length") or 0))
                     except TooManyRequests as e:
-                        raise S3Error(503, "TooManyRequests", str(e))
+                        # SlowDown, not a bare 500/TooManyRequests: the
+                        # spec-shaped code is what SDK retry policies
+                        # classify as throttling (ISSUE 8 satellite)
+                        raise S3Error(503, "SlowDown",
+                                      f"Please reduce your request "
+                                      f"rate. ({e})", retry_after_s=1.0)
                     bucket_entry = srv.bucket_entry(bucket) if bucket else None
                     ident = self._auth(u)
                     self._authorize(ident, action, bucket, key, bucket_entry)
@@ -510,10 +617,12 @@ def _make_handler(srv: S3Server):
                         return self._bucket(verb, bucket, q, bucket_entry)
                     return self._object(verb, bucket, key, q, bucket_entry)
             except S3Error as e:
-                if e.status >= 500:
+                if e.status >= 500 and e.status != 503:
                     # 5xx pins the trace (keep-if-error); expected 4xx
-                    # (404 polls, auth rejections) must not churn the
-                    # retained set
+                    # (404 polls, auth rejections) and 503 shedding
+                    # (SlowDown floods at hundreds/s are the QoS plane
+                    # WORKING — the filer/master overload policy) must
+                    # not churn the retained set
                     tsp.set_error(f"{e.code}: {e}")
                 else:
                     tsp.set_attr(s3Error=e.code, status=e.status)
@@ -521,7 +630,12 @@ def _make_handler(srv: S3Server):
             except Exception as e:  # noqa: BLE001
                 tsp.set_error(f"{type(e).__name__}: {e}")
                 glog.error(f"s3 {verb} {self.path}: {e}")
-                self._error(S3Error(500, "InternalError", str(e)))
+                # transport failures to the backend filer are OUTAGES,
+                # not internal bugs: answer 503 ServiceUnavailable with
+                # a retry hint so SDK clients back off instead of
+                # failing hard on a generic 500 (ISSUE 8 satellite)
+                self._error(_backend_unavailable(e)
+                            or S3Error(500, "InternalError", str(e)))
             finally:
                 release()
 

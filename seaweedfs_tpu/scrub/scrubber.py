@@ -422,6 +422,21 @@ class Scrubber:
             SCRUB_BACKOFFS.inc()
             time.sleep(self.backoff_s)
 
+    def _governor(self):
+        """The server's QoS BackgroundGovernor, when one is attached
+        (ISSUE 8): scrub bytes then draw from the CLUSTER background
+        budget on top of the local SWFS_SCRUB_MAX_MBPS bucket."""
+        return getattr(self.server, "qos_governor", None)
+
+    def _pace(self, nbytes: int, work_class: str = "scrub") -> None:
+        """Local pacing + cluster-token admission for `nbytes` of sweep
+        work. QosUnavailable propagates (fail closed): run_once turns it
+        into a paused pass, never an error to any client."""
+        self.bucket.take(nbytes)
+        gov = self._governor()
+        if gov is not None:
+            gov.acquire(work_class, nbytes)
+
     # -- the sweep ---------------------------------------------------------
 
     def run_once(self, vid: int | None = None, full: bool = False,
@@ -437,6 +452,8 @@ class Scrubber:
         so background integrity work shows up in the same plane as the
         foreground requests it competes with."""
         from ..utils import trace
+
+        from ..qos import QosUnavailable
 
         report = ScrubReport()
         with self._run_lock, \
@@ -467,6 +484,13 @@ class Scrubber:
                              bytes=report.bytes,
                              findings=len(report.findings),
                              repaired=report.repaired)
+            except QosUnavailable as e:
+                # fail closed (ISSUE 8): the cluster withheld background
+                # tokens — master unreachable mid-lease or higher-
+                # priority demand holds the budget. The pass PAUSES;
+                # persisted cursors resume exactly where it stopped.
+                glog.warning(f"scrub pass paused by the qos plane: {e}")
+                tsp.set_attr(qosPaused=str(e)[:120])
             finally:
                 self.running = False
         return report
@@ -536,7 +560,7 @@ class Scrubber:
                 break
             self._maybe_backoff()
             length = types.actual_size(size, v.version)
-            self.bucket.take(length)
+            self._pace(length)
             blob = v._pread_durable(off, length)
             SCRUB_BYTES.inc(len(blob), kind="needle")
             SCRUB_NEEDLES.inc()
@@ -598,6 +622,10 @@ class Scrubber:
                 finding.set_state("failed")
                 SCRUB_REPAIRS.inc(method="re_replicate", outcome="failed")
                 return False
+            # repair-class cluster tokens (ISSUE 8): outranks scrub and
+            # archival in the ledger, so a repair backlog drains first.
+            # QosUnavailable propagates to run_once (pass pauses).
+            self._pace(len(n.data), work_class="repair")
             try:
                 v.write_needle(n, check_cookie=False)
                 nv = v.nm.get(needle_id)
@@ -662,7 +690,7 @@ class Scrubber:
                 return
             self._maybe_backoff()
             n = min(slab, shard_size - off)
-            self.bucket.take(n * len(present))
+            self._pace(n * len(present))
             rows: dict[int, np.ndarray] = {}
             for i in sorted(present):
                 data = ev.shard_files[i].read_at(off, n)
@@ -753,6 +781,8 @@ class Scrubber:
         """Quarantine the shard (reads degrade-reconstruct around it),
         delete its file, EC-rebuild from the survivors, remount, and let
         the caller re-verify the fresh bytes."""
+        from ..qos import QosUnavailable
+
         ev = loc.ec_volumes.get(vid)
         if ev is None:
             return False
@@ -773,13 +803,23 @@ class Scrubber:
             from ..storage.ec_files import rebuild_ec_files
 
             coder = self._geo_coder(geo)
-            rebuilt = rebuild_ec_files(base, coder, geo)
+            # repair-class pacing (ISSUE 8): the survivor reads are the
+            # heaviest I/O burst the scrubber can emit — each slab draws
+            # from the local MBPS bucket AND the cluster repair budget
+            rebuilt = rebuild_ec_files(
+                base, coder, geo,
+                pace=lambda n: self._pace(n, work_class="repair"))
             self.store.mount_ec_shards(vid, collection, rebuilt)
             self.invalidate_ec_digest(vid, remove_manifest=True)
             srv = self.server
             if srv is not None:
                 srv.ec_recon_cache.invalidate(vid)
                 srv.trigger_heartbeat()
+        except QosUnavailable:
+            # not a failed repair: the cluster withheld tokens — pass
+            # pauses (run_once), the quarantined shard reconstructs on
+            # read and the next sweep retries the rebuild
+            raise
         except (IOError, ValueError, OSError) as e:
             finding.detail += f"; rebuild failed: {e}"
             finding.set_state("failed")

@@ -119,6 +119,14 @@ class FilerServer:
         self.fid_pool = FidLeasePool(
             master,
             batch=int(_os.environ.get("SWFS_FID_LEASE_BATCH", "128") or 1))
+        # QoS plane (ISSUE 8): per-tenant (collection / bucket /
+        # anonymous) token-bucket admission at the HTTP ingress;
+        # over-budget requests answer 429 + Retry-After EARLY instead of
+        # timing out deep in the chunk planes. Unconfigured env =
+        # observe-only, never rejects.
+        from ..qos import TenantAdmission
+
+        self.qos_admission = TenantAdmission("filer")
         # filer-side chunk cache (ISSUE 2): the mount-only
         # TieredChunkCache promoted to the filer's chunk-read ladder
         # (and thereby the S3 gateway GET path, which streams through
@@ -1195,6 +1203,8 @@ def _make_http_handler(srv: FilerServer):
             if path == "/healthz":
                 return self._json({"ok": True})
             if path == "/status":
+                from ..utils.stats import qos_stats
+
                 hot = srv.hot_plane.stats() if srv.hot_plane else None
                 return self._json({
                     **status_base(srv._started_at),
@@ -1208,13 +1218,57 @@ def _make_http_handler(srv: FilerServer):
                     },
                     "NativeHotPlane": hot,
                     "Trace": trace.STORE.stats(),
+                    # QoS plane (ISSUE 8): tenant buckets + rejections
+                    "Qos": {
+                        **qos_stats(),
+                        "tenantAdmission": srv.qos_admission.status(),
+                    },
                 })
             srv.hot_sync()  # see native PUTs not yet absorbed
             with trace.span("filer.read", carrier=self.headers,
                             component="filer", server=srv.address,
                             path=path) as tsp:
                 self._trace_id = tsp.trace_id
+                if self._qos_rejected(path, q, tsp, "GET"):
+                    return
                 return self._do_get(path, q)
+
+        def _qos_rejected(self, path, q, tsp, verb: str) -> bool:
+            """Per-tenant ingress admission (ISSUE 8): True = the 429
+            was already sent. The rejection is attributable — the span
+            carries the verdict and the X-Trace-Id header rides the 429
+            (the client's `trace.dump` handle)."""
+            from ..qos import filer_tenant
+
+            if self.headers.get("X-Swfs-Qos-Charged"):
+                # internal leg from the S3 gateway: the tenant's budget
+                # was already charged at the S3 ingress — billing the
+                # same request twice halves every tenant's effective
+                # rate and surfaces the second 429 mid-request. A
+                # direct-to-filer client spoofing the header skips this
+                # plane's budget; the filer is the cluster-internal
+                # surface (the S3 gateway is the authenticated public
+                # ingress), matching the admission module's declared
+                # unverified-at-admission trust model.
+                return False
+
+            d = srv.qos_admission.admit(
+                filer_tenant(path, q.get("collection", "")),
+                trace_id=tsp.trace_id, detail=f"{verb} {path}")
+            if d.admitted:
+                return False
+            # an attribute, not set_error: a flood sheds hundreds of
+            # these per second and must not flush keep-if-error
+            # retention (the master assignError policy)
+            tsp.set_attr(qosRejected=d.reason, tenant=d.tenant)
+            self._reply(
+                429, json.dumps({
+                    "error": "rate limited", "tenant": d.tenant,
+                    "retryAfterSeconds": round(d.retry_after_s, 3),
+                }).encode(),
+                headers={"Retry-After":
+                         str(max(int(d.retry_after_s + 0.999), 1))})
+            return True
 
         def _do_get(self, path, q):
             with FILER_REQUEST_HISTOGRAM.time(type="read"):
@@ -1289,6 +1343,10 @@ def _make_http_handler(srv: FilerServer):
                             component="filer", server=srv.address,
                             path=path) as tsp:
                 self._trace_id = tsp.trace_id
+                if self._qos_rejected(path, q, tsp, "PUT"):
+                    # the unread body would desync keep-alive parsing
+                    self.close_connection = True
+                    return
                 return self._do_put(path, q)
 
         def _do_put(self, path, q):
@@ -1339,6 +1397,8 @@ def _make_http_handler(srv: FilerServer):
                             component="filer", server=srv.address,
                             path=path) as tsp:
                 self._trace_id = tsp.trace_id
+                if self._qos_rejected(path, q, tsp, "DELETE"):
+                    return
                 return self._do_delete(path, q)
 
         def _do_delete(self, path, q):

@@ -42,6 +42,7 @@ from ..utils.stats import (
     MASTER_RECEIVED_HEARTBEATS,
     gather,
     metrics_content_type,
+    qos_stats,
     status_base,
 )
 
@@ -86,6 +87,13 @@ class MasterServer:
             sequencer=new_sequencer(sequencer_type),
         )
         self.growth = VolumeGrowth(self.topo, allocate_fn=allocate_fn)
+        # QoS plane (ISSUE 8): cluster-wide background byte budget leased
+        # to volume servers over QosGrant (strict priority: repair >
+        # scrub/archival), plus the per-node pressure reports assign
+        # placement consults. Unconfigured env = observe-only.
+        from ..qos import GrantLedger
+
+        self.qos_ledger = GrantLedger()
         self._grow_lock = threading.Lock()
         self._admin_locks: dict[str, tuple[int, int, str]] = {}  # name -> (token, ts, client)
         self._admin_lock_mu = threading.Lock()
@@ -239,6 +247,28 @@ class MasterServer:
             if grow_err is not None:
                 return {"error": f"volume growth rpc failed: {grow_err}"}
             return {"error": str(e)}
+        # QoS shed (ISSUE 8): above SWFS_QOS_SHED_PRESSURE (0 = off)
+        # refuse the assign OUTRIGHT instead of handing out a target
+        # whose write would queue behind a saturated group-commit /
+        # dispatch plane and time out late. Clients see an explicit
+        # overload marker with a retry hint (HTTP maps it to 429).
+        import os as _os
+
+        try:
+            shed_at = float(_os.environ.get("SWFS_QOS_SHED_PRESSURE", "0"))
+        except ValueError:
+            shed_at = 0.0
+        if shed_at > 0:
+            from ..utils.stats import QOS_ADMISSION_OPS
+
+            worst = max((dn.effective_pressure() for dn in locations),
+                        default=0.0)
+            if worst >= shed_at:
+                QOS_ADMISSION_OPS.inc(plane="master", result="reject")
+                return {"error": f"overloaded: volume server pressure "
+                                 f"{worst:.2f} >= {shed_at:.2f}",
+                        "overloaded": True, "retryAfterS": 1.0}
+            QOS_ADMISSION_OPS.inc(plane="master", result="admit")
         primary = locations[0]
         return {
             "fid": fid,
@@ -732,6 +762,26 @@ class MasterGrpc:
             start_time_ns=now, remote_time_ns=now, stop_time_ns=time.time_ns()
         )
 
+    def QosGrant(self, request, context):
+        """QoS plane (ISSUE 8): lease background byte budget to a volume
+        server (strict priority by reservation in the GrantLedger) and
+        absorb its pressure report into the topology so assign placement
+        prefers calm servers."""
+        from ..pb import qos_pb2
+
+        ms = self.ms
+        granted, ttl = ms.qos_ledger.grant(
+            request.address, request.work_class,
+            request.requested_bytes, request.pressure)
+        dn = ms.topo.nodes.get(request.address)
+        if dn is not None:
+            dn.qos_pressure = float(request.pressure)
+            dn.qos_pressure_at = time.time()
+        rate = ms.qos_ledger.rate_bytes()
+        return qos_pb2.QosGrantResponse(
+            granted_bytes=granted, lease_ttl_seconds=ttl,
+            cluster_rate_bytes=int(max(rate, 0.0)))
+
 
 # -- HTTP plane ------------------------------------------------------------
 
@@ -740,7 +790,7 @@ def _make_http_handler(ms: MasterServer):
         def log_message(self, fmt, *args):  # route to glog, not stderr
             glog.v(2, f"master http: {fmt % args}")
 
-        def _json(self, obj, code: int = 200) -> None:
+        def _json(self, obj, code: int = 200, headers=None) -> None:
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
@@ -748,6 +798,8 @@ def _make_http_handler(ms: MasterServer):
             tid = getattr(self, "_trace_id", "")
             if tid:
                 self.send_header("X-Trace-Id", tid)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
@@ -784,6 +836,13 @@ def _make_http_handler(ms: MasterServer):
                         # must not flush the bounded retained set (same
                         # policy as expected S3 4xx)
                         tsp.set_attr(assignError=r["error"][:120])
+                        if r.get("overloaded"):
+                            # QoS shed (ISSUE 8): explicit early
+                            # rejection with a retry hint, not a 404
+                            return self._json(
+                                r, 429, headers={"Retry-After": str(
+                                    int(r.get("retryAfterS", 1) + 0.5)
+                                    or 1)})
                         return self._json(r, 404)
                     out = {
                         "fid": r["fid"], "count": r["count"],
@@ -835,6 +894,12 @@ def _make_http_handler(ms: MasterServer):
                         "DataNodes": sorted(ms.topo.nodes),
                     },
                     "Trace": trace.STORE.stats(),
+                    # QoS plane (ISSUE 8): grant ledger + per-node
+                    # pressure + admission counters
+                    "Qos": {
+                        **qos_stats(),
+                        "ledger": ms.qos_ledger.status(),
+                    },
                 })
             if u.path == "/debug/traces":
                 return self._json(trace.debug_traces_payload(q))

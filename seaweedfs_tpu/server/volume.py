@@ -165,6 +165,14 @@ class VolumeServer:
         # foreground rate meter is what it backs off on.
         self._fg_rate = _RateMeter()
         self.scrubber = Scrubber(self.store, self)
+        # QoS plane (ISSUE 8): every background byte (repair > scrub /
+        # archival, strict priority) passes through the governor, which
+        # leases cluster-wide budgets from the master over QosGrant and
+        # reports this server's pressure score on each refresh.
+        # Unconfigured env = no-op gate.
+        from ..qos import BackgroundGovernor
+
+        self.qos_governor = BackgroundGovernor(self)
         self._started_at = time.time()
 
     @property
@@ -212,6 +220,14 @@ class VolumeServer:
             self._sync_native_registry()
         threading.Thread(target=self._heartbeat_loop, daemon=True).start()
         threading.Thread(target=self._check_with_master, daemon=True).start()
+        report_s = float(os.environ.get("SWFS_QOS_REPORT_S", "0") or 0)
+        if report_s <= 0 and (self.qos_governor.enabled()
+                              or float(os.environ.get(
+                                  "SWFS_QOS_SHED_PRESSURE", "0") or 0) > 0):
+            report_s = 1.0  # QoS plane active: default 1s pressure feed
+        if report_s > 0:
+            threading.Thread(target=self._qos_report_loop,
+                             args=(report_s,), daemon=True).start()
         self.scrubber.start()
         glog.info(f"volume server started on {self.address} "
                   f"(grpc :{self.grpc_port}"
@@ -364,6 +380,66 @@ class VolumeServer:
     def foreground_qps(self) -> float:
         """Client data-plane request rate; the scrubber backs off on it."""
         return self._fg_rate.qps()
+
+    # -- QoS plane (ISSUE 8) -----------------------------------------------
+
+    def qos_group_commit_depth(self) -> int:
+        """Writes registered for a group-commit flush but not yet covered
+        by one, summed over volumes — the write-plane half of the
+        pressure score (the aggregate view of PR-7's gcWaitMs spans)."""
+        total = 0
+        for loc in self.store.locations:
+            for v in list(loc.volumes.values()):
+                total += max(0, v._gc_seq - v._gc_flushed)
+        return total
+
+    def qos_pressure(self, gc_depth: int | None = None,
+                     dispatch_depth: int | None = None) -> float:
+        """This server's [0,1] backpressure score: group-commit buffer
+        depth folded with EC-dispatch queue depth (qos/pressure.py).
+        Rides every QosGrant refresh to the master, which folds it into
+        assign placement and early shedding. Callers that already
+        sampled the depths pass them in (one volume walk, one score)."""
+        from ..qos import pressure_score
+        from ..utils.stats import QOS_PRESSURE
+
+        if gc_depth is None:
+            gc_depth = self.qos_group_commit_depth()
+        if dispatch_depth is None:
+            dispatch_depth = sum(self.ec_dispatch_depths().values())
+        p = pressure_score(gc_depth, dispatch_depth)
+        QOS_PRESSURE.set(p)
+        return p
+
+    def qos_acquire(self, work_class: str, nbytes: int) -> float:
+        """Background-work admission: delegate to the governor (no-op
+        when the cluster budget is unconfigured). QosUnavailable
+        propagates — callers pause their background work (fail closed),
+        never surface it to a foreground client."""
+        return self.qos_governor.acquire(work_class, nbytes)
+
+    def _qos_report_loop(self, interval: float) -> None:
+        """Periodic pressure-only QosGrant (work_class "") so the master
+        sees THIS server's pressure even while no background work is
+        drawing tokens — foreground-induced pressure must reach assign
+        placement too."""
+        from ..pb import qos_pb2, rpc as _rpc
+
+        while not self._stop.wait(interval):
+            try:
+                gc_depth = self.qos_group_commit_depth()
+                dispatch_depth = sum(self.ec_dispatch_depths().values())
+                _rpc.master_stub(self.master_grpc).QosGrant(
+                    qos_pb2.QosGrantRequest(
+                        address=self.address, work_class="",
+                        requested_bytes=0,
+                        pressure=self.qos_pressure(gc_depth,
+                                                   dispatch_depth),
+                        gc_depth=gc_depth,
+                        dispatch_depth=dispatch_depth),
+                    timeout=5)
+            except Exception:  # noqa: BLE001 — best-effort; next tick retries
+                continue
 
     def read_needle(self, vid: int, needle_id: int, cookie: int | None):
         v = self.store.find_volume(vid)
@@ -1022,7 +1098,7 @@ class VolumeGrpc:
 
     def _generate_prologue(self, request, context):
         """Shared head of the plain and streamed generate handlers:
-        -> (volume, geometry, coder)."""
+        -> (volume, geometry, coder, pace)."""
         v = self.store.find_volume(request.volume_id)
         if v is None:
             context.abort(grpc.StatusCode.NOT_FOUND,
@@ -1035,7 +1111,21 @@ class VolumeGrpc:
                            parity_shards=request.parity_shards or 4,
                            large_block=geo.large_block,
                            small_block=geo.small_block)
-        return v, geo, self._geo_coder(geo)
+        # QoS plane (ISSUE 8): archival encodes are the lowest priority
+        # class. Admission-probe a BOUNDED first chunk before touching
+        # data (fail closed: an unreachable master pauses archival
+        # instead of letting it contend with foreground I/O); the rest
+        # of the volume is drawn slab by slab through `pace` so volumes
+        # larger than the wait cap's worth of budget still encode.
+        from ..qos import DEFAULT_MAX_GRANT_BYTES, QosUnavailable
+
+        probe = max(min(v.data_size(), DEFAULT_MAX_GRANT_BYTES), 1)
+        try:
+            self.srv.qos_acquire("archival", probe)
+        except QosUnavailable as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        pace = self.srv.qos_governor.pacer("archival", prepaid=probe)
+        return v, geo, self._geo_coder(geo), pace
 
     def _generate_epilogue(self, v, geo, base, t0, enc_stats) -> None:
         write_sorted_file_from_idx(base)
@@ -1056,10 +1146,18 @@ class VolumeGrpc:
     def VolumeEcShardsGenerate(self, request, context):
         """.dat -> .ec00.. + .ecx + .vif (handler :38-81). The stripe math
         runs through the store's (TPU) coder."""
-        v, geo, coder = self._generate_prologue(request, context)
+        from ..qos import QosUnavailable
+
+        v, geo, coder, pace = self._generate_prologue(request, context)
         base = v.file_name()
         t0 = time.perf_counter()
-        enc_stats = write_ec_files(base, coder, geo)
+        try:
+            enc_stats = write_ec_files(base, coder, geo, pace=pace)
+        except QosUnavailable as e:
+            # starved mid-encode (budget reserved for higher classes or
+            # master lost): same abort surface as the admission probe —
+            # the shell's failure path rolls the replica back writable
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         self._generate_epilogue(v, geo, base, t0, enc_stats)
         return vs.VolumeEcShardsGenerateResponse()
 
@@ -1074,7 +1172,7 @@ class VolumeGrpc:
         from ..storage.ec_stream import EcStreamDestination, EcStreamSinkSet
         from ..utils.stats import EC_STREAM_OVERLAP_RATIO
 
-        v, geo, coder = self._generate_prologue(request, context)
+        v, geo, coder, pace = self._generate_prologue(request, context)
         base = v.file_name()
         shard_size = geo.shard_size(v.data_size())
         dests = [
@@ -1087,9 +1185,14 @@ class VolumeGrpc:
         t0 = time.perf_counter()
         sinks = EcStreamSinkSet(dests)
         try:
-            enc_stats = write_ec_files(base, coder, geo, sinks=sinks)
-        except BaseException:
+            enc_stats = write_ec_files(base, coder, geo, sinks=sinks,
+                                       pace=pace)
+        except BaseException as e:
             sinks.abort()
+            from ..qos import QosUnavailable
+
+            if isinstance(e, QosUnavailable):
+                context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
             raise
         resp = es.VolumeEcShardsGenerateStreamedResponse()
 
@@ -1288,7 +1391,28 @@ class VolumeGrpc:
         base = self._ec_base(request.volume_id, request.collection, context)
         geo = self._ec_geo(base)
         coder = self._geo_coder(geo)
-        rebuilt = rebuild_ec_files(base, coder, geo)
+        # rebuilds are REPAIR-class work: they outrank scrub/archival in
+        # the grant ledger (a repair storm must never starve behind an
+        # archival backlog), and fail closed like every background class.
+        # Probe a BOUNDED first chunk, then draw the rest slab by slab —
+        # a lump acquire of the whole survivor set could exceed what the
+        # budget can ever accumulate inside one wait cap, making large
+        # rebuilds permanently impossible.
+        from ..qos import DEFAULT_MAX_GRANT_BYTES, QosUnavailable
+
+        est = sum(os.path.getsize(geo.shard_file_name(base, i))
+                  for i in range(geo.total_shards)
+                  if os.path.exists(geo.shard_file_name(base, i)))
+        probe = max(min(est, DEFAULT_MAX_GRANT_BYTES), 1)
+        try:
+            self.srv.qos_acquire("repair", probe)
+        except QosUnavailable as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        pace = self.srv.qos_governor.pacer("repair", prepaid=probe)
+        try:
+            rebuilt = rebuild_ec_files(base, coder, geo, pace=pace)
+        except QosUnavailable as e:
+            context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         from ..storage.ec_volume import rebuild_ecx_file
 
         rebuild_ecx_file(base)
@@ -1806,6 +1930,7 @@ def _make_http_handler(srv: VolumeServer):
                     ec_dispatch_stats,
                     ec_stream_stats,
                     group_commit_stats,
+                    qos_stats,
                     scrub_stats,
                 )
 
@@ -1839,6 +1964,17 @@ def _make_http_handler(srv: VolumeServer):
                     # lifecycle, repair outcomes, pacing
                     "Scrub": {**srv.scrubber.status(),
                               "counters": scrub_stats()},
+                    # QoS plane (ISSUE 8): live pressure score, the
+                    # governor's leased class budgets, admission/grant
+                    # counters
+                    "Qos": {
+                        **qos_stats(),
+                        "pressure": srv.qos_pressure(),
+                        "groupCommitDepth": srv.qos_group_commit_depth(),
+                        "dispatchDepth": sum(
+                            srv.ec_dispatch_depths().values()),
+                        "governor": srv.qos_governor.status(),
+                    },
                 })
             if u.path == "/metrics":
                 q = parse_qs(u.query)

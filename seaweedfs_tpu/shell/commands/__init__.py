@@ -4,6 +4,7 @@ from . import (  # noqa: F401
     ec,
     fs,
     lock,
+    qos_cmd,
     remote,
     s3_mq,
     trace_cmd,

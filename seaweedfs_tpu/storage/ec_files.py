@@ -223,6 +223,7 @@ def generate_ec_files(
     batch_size: int = DEFAULT_BATCH_SIZE,
     pipeline_depth: int = DEFAULT_PIPELINE_DEPTH,
     sinks=None,
+    pace=None,
 ) -> EncodeStats:
     """<base>.dat -> <base>.ec00..ecNN (WriteEcFiles / generateEcFiles /
     encodeDatFile, ec_encoder.go:56-87,194-231).
@@ -254,6 +255,11 @@ def generate_ec_files(
     synchronously (the pipeline recycles its buffers); local shard files
     are written regardless (they are the resume source and keep bytes
     bit-identical to the generate-then-copy path by construction).
+
+    `pace` (ISSUE 8, qos/priority.py BackgroundGovernor.pacer) is called
+    with each slab's data-byte count before the slab's rows are routed
+    to writers/sinks; it may block (waiting on cluster tokens) or raise
+    (QosUnavailable mid-job) — the pipeline aborts cleanly either way.
     """
     k, m = geo.data_shards, geo.parity_shards
     dat_path = base_file_name + ".dat"
@@ -335,6 +341,12 @@ def generate_ec_files(
             if isinstance(item, BaseException):
                 raise item
             buf, data, parity_fut, nbytes = item
+            if pace is not None:
+                # cluster-budget draw, one slab at a time: may block on
+                # higher-priority demand (the reader works ahead only to
+                # the bounded queue depth); a raise aborts the pipeline
+                # through the normal failure path below
+                pace(k * nbytes)
             release = _Countdown(k, lambda b=buf: free_q.put(b))
             if sinks is not None:
                 # data rows stream BEFORE the writers get the buffer:
@@ -389,10 +401,12 @@ def _row_schedule(geo: Geometry, dat_size: int):
 
 
 def write_ec_files(
-    base_file_name: str, coder, geo: Geometry = Geometry(), sinks=None
+    base_file_name: str, coder, geo: Geometry = Geometry(), sinks=None,
+    pace=None,
 ) -> EncodeStats:
     """WriteEcFiles equivalent (ec_encoder.go:56-59)."""
-    return generate_ec_files(base_file_name, coder, geo, sinks=sinks)
+    return generate_ec_files(base_file_name, coder, geo, sinks=sinks,
+                             pace=pace)
 
 
 def write_ecx_stride_marker(base_file_name: str) -> None:
@@ -440,10 +454,14 @@ def rebuild_ec_files(
     coder,
     geo: Geometry = Geometry(),
     batch_size: int = DEFAULT_BATCH_SIZE,
+    pace=None,
 ) -> list[int]:
     """Regenerate missing .ecNN files from the survivors
     (RebuildEcFiles / generateMissingEcFiles / rebuildEcFiles,
-    ec_encoder.go:61-63,89-118,233-287). Returns the rebuilt shard ids."""
+    ec_encoder.go:61-63,89-118,233-287). Returns the rebuilt shard ids.
+    `pace`, as in generate_ec_files, draws each slab's survivor-read
+    bytes from the cluster background budget before the slab is
+    written."""
     total = geo.total_shards
     have = [os.path.exists(geo.shard_file_name(base_file_name, i)) for i in range(total)]
     missing = [i for i in range(total) if not have[i]]
@@ -531,6 +549,9 @@ def rebuild_ec_files(
             if isinstance(rebuilt, dispatch.EcFuture):
                 mids, rows = rebuilt.result()
                 rebuilt = dict(zip(mids, rows))
+            if pace is not None:
+                # repair-class budget draw: survivors read this slab
+                pace(len(present) * len(next(iter(rebuilt.values()))))
             for i in missing:
                 row = np.ascontiguousarray(
                     np.asarray(rebuilt[i], dtype=np.uint8))

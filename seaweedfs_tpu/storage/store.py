@@ -307,28 +307,40 @@ class Store:
             data_center=self.data_center, rack=self.rack,
         )
         max_file_key = 0
+        # snapshot the volume maps: this runs on the heartbeat stream's
+        # request generator while AllocateVolume / unmount / EC mounts
+        # mutate them from gRPC handler threads. Iterating live dicts
+        # raised "dictionary changed size during iteration" under volume
+        # churn, which killed the heartbeat STREAM — and a broken stream
+        # unregisters the whole node, flapping the master topology
+        # (found by tools/cluster_harness.py's archival shape, ISSUE 8).
         for loc in self.locations:
             hb.max_volume_counts[loc.disk_type or ""] = (
                 hb.max_volume_counts.get(loc.disk_type or "", 0)
                 + loc.max_volume_count
             )
-            for vid, v in loc.volumes.items():
+            for vid, v in list(loc.volumes.items()):
+                try:
+                    hb.volumes.append(master_pb2.VolumeInformationMessage(
+                        id=vid, size=v.data_size(), collection=v.collection,
+                        file_count=v.file_count(),
+                        delete_count=v.deleted_count(),
+                        deleted_byte_count=v.deleted_size(),
+                        # a flush-frozen volume must leave the master's
+                        # writable set like a read-only one
+                        read_only=v.read_only or v._gc_frozen,
+                        replica_placement=v.super_block
+                        .replica_placement.to_byte(),
+                        version=v.version, ttl=v.ttl.to_uint32(),
+                        compact_revision=v.super_block.compaction_revision,
+                        modified_at_second=int(v.last_modified_ts_seconds),
+                    ))
+                except (OSError, ValueError, AttributeError):
+                    continue  # mid-unmount; the next pulse reconciles
                 max_file_key = max(max_file_key, v.nm.max_file_key)
-                hb.volumes.append(master_pb2.VolumeInformationMessage(
-                    id=vid, size=v.data_size(), collection=v.collection,
-                    file_count=v.file_count(), delete_count=v.deleted_count(),
-                    deleted_byte_count=v.deleted_size(),
-                    # a flush-frozen volume must leave the master's
-                    # writable set like a read-only one
-                    read_only=v.read_only or v._gc_frozen,
-                    replica_placement=v.super_block.replica_placement.to_byte(),
-                    version=v.version, ttl=v.ttl.to_uint32(),
-                    compact_revision=v.super_block.compaction_revision,
-                    modified_at_second=int(v.last_modified_ts_seconds),
-                ))
-            for vid, ev in loc.ec_volumes.items():
+            for vid, ev in list(loc.ec_volumes.items()):
                 bits = 0
-                for sid in ev.shard_files:
+                for sid in list(ev.shard_files):
                     bits |= 1 << sid
                 hb.ec_shards.append(master_pb2.VolumeEcShardInformationMessage(
                     id=vid, collection=getattr(ev, "collection", ""),

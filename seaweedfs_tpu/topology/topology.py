@@ -78,6 +78,16 @@ class DataNode:
         # integrity plane: when the master last asked this node to run a
         # scrub pass (next_scrub_targets round-robins on it)
         self.last_scrub = 0.0
+        # QoS plane (ISSUE 8): last backpressure score this node reported
+        # on a QosGrant lease refresh, and when — stale reports decay to
+        # 0 in effective_pressure so a silent node can't repel placement
+        self.qos_pressure = 0.0
+        self.qos_pressure_at = 0.0
+
+    def effective_pressure(self, max_age_s: float = 15.0) -> float:
+        if time.time() - self.qos_pressure_at > max_age_s:
+            return 0.0
+        return self.qos_pressure
 
     @property
     def url(self) -> str:
@@ -170,8 +180,22 @@ class VolumeLayout:
                 return None
             vids = sorted(self.writables)
             self._rr = (self._rr + 1) % len(vids)
-            vid = vids[self._rr]
-            return vid, list(self.locations[vid])
+            # QoS plane (ISSUE 8): among a few round-robin candidates,
+            # prefer the volume whose replica set is calmest. With no
+            # pressure reports every score is 0.0 and this degrades to
+            # the plain round-robin pick (ties keep rotation order).
+            k = min(4, len(vids))
+            best = None
+            for i in range(k):
+                vid = vids[(self._rr + i) % len(vids)]
+                locs = self.locations[vid]
+                score = max((dn.effective_pressure() for dn in locs),
+                            default=0.0)
+                if best is None or score < best[0]:
+                    best = (score, vid, list(locs))
+                if score <= 0.0:
+                    break  # calm replica set: no need to scan further
+            return best[1], best[2]
 
     def set_volume_unavailable(self, vid: int) -> None:
         with self._lock:

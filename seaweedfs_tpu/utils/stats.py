@@ -436,6 +436,55 @@ SCRUB_BACKOFFS = Counter(
     "Times the scrubber backed off because foreground QPS was high.")
 
 
+# -- QoS / admission plane (ISSUE 8): per-tenant ingress admission,
+#    cluster-wide background token grants, and the backpressure score
+#    the master folds into placement ------------------------------------
+
+QOS_ADMISSION_OPS = Counter(
+    "SeaweedFS_qos_admission_ops",
+    "Ingress admission decisions by plane (s3/filer/master) and result "
+    "(admit/reject).")
+QOS_GRANT_OPS = Counter(
+    "SeaweedFS_qos_grant_ops",
+    "QosGrant outcomes by work_class (repair/scrub/archival) and outcome "
+    "(ok/denied/error).")
+QOS_GRANTED_BYTES = Counter(
+    "SeaweedFS_qos_granted_bytes",
+    "Background bytes granted by the cluster ledger, by work_class.")
+QOS_BG_WAIT_SECONDS = Counter(
+    "SeaweedFS_qos_background_wait_seconds",
+    "Seconds background work waited on the QoS plane (foreground-QPS "
+    "yield + cluster-token waits), by work_class.")
+QOS_PRESSURE = Gauge(
+    "SeaweedFS_qos_pressure",
+    "This volume server's backpressure score in [0,1] (group-commit "
+    "buffer depth folded with EC-dispatch queue depth).")
+
+
+def qos_stats() -> dict:
+    """Snapshot for /status pages: admission outcomes + grant flow."""
+    out = {
+        "admission": {}, "grants": {}, "pressure":
+        round(QOS_PRESSURE.value(), 4),
+    }
+    for plane in ("s3", "filer", "master"):
+        out["admission"][plane] = {
+            r: int(QOS_ADMISSION_OPS.value(plane=plane, result=r))
+            for r in ("admit", "reject")}
+    for klass in ("repair", "scrub", "archival"):
+        out["grants"][klass] = {
+            "grantedBytes": int(QOS_GRANTED_BYTES.value(work_class=klass)),
+            "ok": int(QOS_GRANT_OPS.value(work_class=klass, outcome="ok")),
+            "denied": int(QOS_GRANT_OPS.value(work_class=klass,
+                                              outcome="denied")),
+            "errors": int(QOS_GRANT_OPS.value(work_class=klass,
+                                              outcome="error")),
+            "waitSeconds": round(
+                QOS_BG_WAIT_SECONDS.value(work_class=klass), 3),
+        }
+    return out
+
+
 # -- tracing plane (ISSUE 7): span recording volume + tail retention,
 #    and the hardened metrics-push loop's outcome counter ------------------
 

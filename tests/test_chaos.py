@@ -90,7 +90,11 @@ def chaos_cluster(tmp_path_factory):
         vsrv = VolumeServer(
             directories=[str(tmp / f"vol{i}")],
             master=f"localhost:{mport}", ip="localhost",
-            port=_free_port(), pulse_seconds=1, ec_geometry=TEST_GEO)
+            port=_free_port(), pulse_seconds=1, ec_geometry=TEST_GEO,
+            # every test in this module grows volumes (replication 001
+            # doubles them) and mounted EC shards count against slots
+            # too — the default 8 per store runs out before the end
+            max_volume_counts=[64])
         vsrv.start()
         volumes.append(vsrv)
     fsrv = FilerServer(ip="localhost", port=_free_port(),
@@ -959,3 +963,88 @@ def test_env_failpoint_drives_subprocess_server(tmp_path):
             proc.wait(timeout=10)
         except subprocess.TimeoutExpired:
             proc.kill()
+
+
+# -- QoS plane (ISSUE 8): qos.grant outage — open for foreground, ----------
+#    closed for background
+
+def test_qos_grant_outage_foreground_open_background_closed(
+        chaos_cluster, monkeypatch):
+    """The `qos.grant` failpoint severs the volume servers' lease plane
+    (master unreachable mid-lease). Invariants the QoS plane promises:
+
+      * foreground I/O FAILS OPEN — filer writes and reads never touch
+        the grant plane, so a dead QoS master cannot deadlock a client
+        (zero client-visible errors while the outage lasts);
+      * background FAILS CLOSED — a scrub token acquire raises
+        QosUnavailable, the real scrub pass pauses WITHOUT surfacing an
+        error anywhere, and an archival `VolumeEcShardsGenerate` aborts
+        RESOURCE_EXHAUSTED before touching bytes;
+      * recovery — once the plane heals, the same background calls are
+        served again.
+    """
+    import grpc
+
+    from seaweedfs_tpu.qos import QosUnavailable
+
+    master, volumes, fsrv = chaos_cluster
+    # activate the cluster budget: background must now hold a lease
+    monkeypatch.setenv("SWFS_QOS_BG_MBPS", "4")
+    base = f"http://{fsrv.address}"
+
+    # the preceding subprocess test's rpc.reset_channels() severs this
+    # cluster's heartbeat streams; the master defer-unregisters both
+    # nodes for ~1s until the next pulse — assign would see an empty
+    # topology ("no free volume slot"), so wait for re-registration
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topo.nodes) < 2:
+        time.sleep(0.05)
+    assert len(master.topo.nodes) == 2, master.topo.nodes
+
+    # stage a volume with real needles on a known server (the scrub
+    # sweep and the archival encode both need bytes to pace)
+    rng = np.random.default_rng(8)
+    res = submit(master.address, rng.integers(
+        0, 256, size=5000, dtype=np.uint8).tobytes(),
+        filename="q.bin", collection="qoschaos")
+    assert "fid" in res, res
+    vid = parse_file_id(res["fid"]).volume_id
+    vsrv = next(v for v in volumes if v.store.has_volume(vid))
+    stub = rpc.volume_stub(rpc.grpc_address(vsrv.address))
+
+    with failpoint.active("qos.grant", mode="error", p=1.0) as fp:
+        # background fails CLOSED: the direct token path raises...
+        with pytest.raises(QosUnavailable):
+            vsrv.qos_governor.acquire("scrub", 1 << 20, max_wait_s=2.0)
+        # ...the real sweep turns that into a paused pass, not an error
+        report = vsrv.scrubber.run_once(vid=vid, full=True)
+        assert not report.findings  # paused, nothing half-scanned
+
+        # archival aborts before touching data
+        stub.VolumeMarkReadonly(
+            vs.VolumeMarkReadonlyRequest(volume_id=vid), timeout=30)
+        with pytest.raises(grpc.RpcError) as ei:
+            stub.VolumeEcShardsGenerate(
+                vs.VolumeEcShardsGenerateRequest(
+                    volume_id=vid, collection="qoschaos"), timeout=120)
+        assert ei.value.code() == grpc.StatusCode.RESOURCE_EXHAUSTED
+
+        # meanwhile foreground I/O sails through the same outage:
+        # zero client-visible errors on writes OR reads
+        for i in range(15):
+            w = requests.put(f"{base}/qoschaos/fg{i}.bin",
+                             data=b"fail-open " * 50, timeout=30)
+            assert w.status_code in (200, 201), w.text
+            g = requests.get(f"{base}/qoschaos/fg{i}.bin", timeout=30)
+            assert g.status_code == 200
+            assert g.content == b"fail-open " * 50
+        assert fp.hits > 0, "qos.grant chaos never fired — vacuous"
+
+    # plane healed: the in-process master serves the lease again and
+    # the SAME background calls are admitted
+    assert vsrv.qos_governor.acquire("scrub", 1024, max_wait_s=10.0) \
+        >= 0.0
+    stub.VolumeEcShardsGenerate(
+        vs.VolumeEcShardsGenerateRequest(volume_id=vid,
+                                         collection="qoschaos"),
+        timeout=120)

@@ -1,0 +1,65 @@
+"""Tier-1 smoke for the fleet traffic harness (ISSUE 8 satellite): a
+tiny 2-volume-server cluster under ~5s of the full mixed workload —
+zipfian S3 reads, small-file PUT flood, archival ec.encode churn and a
+degraded-read storm — asserting nonzero goodput per shape and a clean
+shutdown. The harness is the instrument every BENCH_CLUSTER_* A/B
+depends on; without this test it rots silently between bench runs.
+
+Runs the harness as a SUBPROCESS (its own JAX_PLATFORMS=cpu, its own
+port space, guaranteed teardown via its own signal handling) — the same
+way bench.py --cluster-qos drives it.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_HARNESS = os.path.join(_REPO, "tools", "cluster_harness.py")
+
+
+def _last_json_line(text: str) -> dict | None:
+    for line in reversed((text or "").strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except ValueError:
+                continue
+    return None
+
+
+def test_harness_smoke_all_shapes_and_clean_shutdown():
+    # subprocess timeout is the watchdog here (no pytest-timeout in the
+    # container); the conftest 300s faulthandler backstops the backstop
+    proc = subprocess.run(
+        [sys.executable, _HARNESS, "--smoke", "--servers", "2",
+         "--duration", "5", "--vol-mb", "1"],
+        cwd=_REPO, capture_output=True, text=True, timeout=270,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "SEAWEEDFS_TPU_NATIVE": "0"})
+    out = _last_json_line(proc.stdout)
+    assert out is not None, (proc.stdout[-500:], proc.stderr[-500:])
+    assert "error" not in out, out["error"]
+    assert out["clean_shutdown"] is True, \
+        "a server had to be SIGKILLed at teardown"
+    shapes = out["shapes"]
+    assert set(shapes) == {"zipf_read", "put_flood", "archival",
+                           "degraded_read"}
+    for name, s in shapes.items():
+        assert s["ok"] > 0, f"shape {name} produced zero goodput: {s}"
+        assert s["offered"] >= s["ok"]
+        # foreground + degraded shapes report latency percentiles
+        if name != "archival":
+            assert s.get("p50_ms", 0) > 0 and s.get("p99_ms", 0) > 0
+    # the open-loop shapes must not silently collapse into errors:
+    # transient churn is tolerated, an error-dominated run is not
+    for name in ("zipf_read", "put_flood", "degraded_read"):
+        s = shapes[name]
+        assert s["errors"] <= max(2, 0.1 * s["offered"]), \
+            f"shape {name} error-dominated: {s}"

@@ -282,8 +282,9 @@ def _assert_camel_keys(obj, path=""):
 def test_status_schema_unified_across_servers(tmp_path):
     """ISSUE 7 satellite: every server's /status reports version/
     startedAt/uptimeSeconds at top level, and the per-plane sections
-    (EcDispatch, Scrub, EcStream, GroupCommit, ChunkCache, Trace) use
-    consistent camelCase keys all the way down."""
+    (EcDispatch, Scrub, EcStream, GroupCommit, ChunkCache, Trace, and
+    the ISSUE-8 Qos section) use consistent camelCase keys all the way
+    down."""
     from seaweedfs_tpu.s3api.server import S3Server
     from seaweedfs_tpu.server.filer import FilerServer
 
@@ -309,17 +310,27 @@ def test_status_schema_unified_across_servers(tmp_path):
             assert isinstance(st["startedAt"], int)
             assert st["uptimeSeconds"] >= 0
             assert "Trace" in st
+            # QoS plane (ISSUE 8): every server exposes its admission /
+            # grant / pressure view even while the plane is observe-only
+            assert "Qos" in st, addr
         vol = requests.get(f"http://{vsrv.address}/status",
                            timeout=10).json()
         for section in ("GroupCommit", "EcDispatch", "EcStream",
-                        "Scrub", "Trace"):
+                        "Scrub", "Trace", "Qos"):
             assert section in vol, section
             _assert_camel_keys(vol[section], section)
+        assert 0.0 <= vol["Qos"]["pressure"] <= 1.0
+        assert vol["Qos"]["governor"]["enabled"] is False  # env unset
         fil = requests.get(f"http://{fsrv.address}/status",
                            timeout=10).json()
-        for section in ("ChunkCache", "FidLease", "Trace"):
+        for section in ("ChunkCache", "FidLease", "Trace", "Qos"):
             assert section in fil, section
             _assert_camel_keys(fil[section], section)
+        assert fil["Qos"]["tenantAdmission"]["plane"] == "filer"
+        mst = requests.get(f"http://{master.address}/status",
+                           timeout=10).json()
+        assert "ledger" in mst["Qos"]
+        _assert_camel_keys(mst["Qos"], "Qos")
     finally:
         s3.stop()
         fsrv.stop()

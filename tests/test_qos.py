@@ -1,0 +1,461 @@
+"""QoS / admission plane unit coverage (ISSUE 8): token refill
+arithmetic under clock-free fake time, tenant-key extraction at both
+ingress planes, strict grant priority (background never starves a
+blocked foreground writer — and repair never starves behind archival),
+and pressure-score monotonicity against synthetic group-commit /
+dispatch queue depths.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from seaweedfs_tpu.qos import (
+    BackgroundGovernor,
+    Decision,
+    GrantLedger,
+    QosUnavailable,
+    TenantAdmission,
+    TokenBucket,
+    filer_tenant,
+    pressure_score,
+    s3_access_key_hint,
+    s3_tenant,
+)
+
+
+class FakeClock:
+    """Injectable monotonic time: refill arithmetic is tested with zero
+    sleeps (no wall-clock flakes)."""
+
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+# -- token-bucket refill arithmetic -----------------------------------------
+
+def test_bucket_starts_full_and_deducts():
+    clk = FakeClock()
+    b = TokenBucket(rate=10, burst=5, now=clk)
+    for _ in range(5):
+        assert b.try_take(1) == 0.0
+    # empty now: the wait hint is the exact refill time for 1 token
+    assert b.try_take(1) == pytest.approx(0.1)
+
+
+def test_bucket_refills_at_rate_capped_at_burst():
+    clk = FakeClock()
+    b = TokenBucket(rate=10, burst=5, now=clk)
+    assert b.try_take(5) == 0.0
+    clk.advance(0.25)  # 2.5 tokens back
+    assert b.available() == pytest.approx(2.5)
+    clk.advance(100.0)  # refill far past burst: capped
+    assert b.available() == pytest.approx(5.0)
+
+
+def test_bucket_wait_hint_scales_with_deficit():
+    clk = FakeClock()
+    b = TokenBucket(rate=2, burst=4, now=clk)
+    assert b.try_take(4) == 0.0
+    # 3 tokens wanted, 0 held, rate 2/s -> 1.5s
+    assert b.try_take(3) == pytest.approx(1.5)
+    clk.advance(0.5)  # 1 token back -> deficit 2 -> 1.0s
+    assert b.try_take(3) == pytest.approx(1.0)
+    # a failed take deducts nothing
+    assert b.available() == pytest.approx(1.0)
+
+
+def test_bucket_unlimited_when_rate_nonpositive():
+    b = TokenBucket(rate=0, now=FakeClock())
+    for _ in range(10_000):
+        assert b.try_take(100) == 0.0
+    assert b.available() == float("inf")
+
+
+def test_bucket_fractional_rate_accumulates():
+    clk = FakeClock()
+    b = TokenBucket(rate=0.5, burst=1, now=clk)
+    assert b.try_take(1) == 0.0
+    assert b.try_take(1) == pytest.approx(2.0)
+    clk.advance(1.0)
+    assert b.try_take(1) == pytest.approx(1.0)
+    clk.advance(1.0)
+    assert b.try_take(1) == 0.0
+
+
+# -- tenant-key extraction ---------------------------------------------------
+
+def test_s3_tenant_sigv4_access_key():
+    headers = {"Authorization":
+               "AWS4-HMAC-SHA256 Credential=AKIDEXAMPLE/20260803/"
+               "us-east-1/s3/aws4_request, SignedHeaders=host, "
+               "Signature=abc"}
+    assert s3_access_key_hint(headers, "") == "AKIDEXAMPLE"
+    assert s3_tenant(headers, "", "mybucket") == "ak:AKIDEXAMPLE"
+
+
+def test_s3_tenant_presigned_query_forms():
+    # SigV4 presigned (URL-encoded credential scope)
+    q = "X-Amz-Algorithm=AWS4-HMAC-SHA256&X-Amz-Credential=AKpre%2F2026"
+    assert s3_access_key_hint({}, q) == "AKpre"
+    # v2 presigned
+    assert s3_access_key_hint({}, "AWSAccessKeyId=AKv2&Expires=1") == \
+        "AKv2"
+
+
+def test_s3_tenant_falls_back_to_bucket_then_anonymous():
+    assert s3_tenant({}, "", "photos") == "col:photos"
+    assert s3_tenant({}, "", "") == "anonymous"
+
+
+def test_filer_tenant_collection_param_wins():
+    assert filer_tenant("/any/path", "geo") == "col:geo"
+
+
+def test_filer_tenant_bucket_path_fallback():
+    assert filer_tenant("/buckets/media/a/b.jpg", "") == "col:media"
+    # dot-prefixed system dirs are not tenants
+    assert filer_tenant("/buckets/.uploads/x", "") == "anonymous"
+    assert filer_tenant("/topics/chat/p0", "") == "anonymous"
+    assert filer_tenant("/buckets/", "") == "anonymous"
+
+
+# -- TenantAdmission ---------------------------------------------------------
+
+def _admission(monkeypatch, clk, **env):
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    adm = TenantAdmission("test", now=clk)
+    adm.refresh_config()
+    return adm
+
+
+def test_admission_defaults_to_observe_only(monkeypatch):
+    monkeypatch.delenv("SWFS_QOS_TENANT_RPS", raising=False)
+    monkeypatch.delenv("SWFS_QOS_TENANT_OVERRIDES", raising=False)
+    adm = TenantAdmission("test", now=FakeClock())
+    for _ in range(1000):
+        assert adm.admit("col:x").admitted
+    assert adm.rejected == 0
+
+
+def test_admission_rejects_over_rate_with_retry_hint(monkeypatch):
+    clk = FakeClock()
+    adm = _admission(monkeypatch, clk, SWFS_QOS_TENANT_RPS="10",
+                     SWFS_QOS_TENANT_BURST="3")
+    for _ in range(3):
+        assert adm.admit("col:x", trace_id="t1").admitted
+    d = adm.admit("col:x", trace_id="feedbead" * 4, detail="PUT /x")
+    assert isinstance(d, Decision) and not d.admitted
+    assert d.retry_after_s >= 0.05
+    # the rejection log carries the trace id — the explainability handle
+    rej = adm.recent_rejections()[-1]
+    assert rej["traceId"] == "feedbead" * 4
+    assert rej["tenant"] == "col:x"
+    # refill under fake time re-admits
+    clk.advance(1.0)
+    assert adm.admit("col:x").admitted
+
+
+def test_admission_per_tenant_override_and_isolation(monkeypatch):
+    clk = FakeClock()
+    adm = _admission(
+        monkeypatch, clk, SWFS_QOS_TENANT_RPS="0",
+        SWFS_QOS_TENANT_OVERRIDES='{"col:noisy": {"rps": 2, "burst": 2}}')
+    # the noisy tenant is capped...
+    assert adm.admit("col:noisy").admitted
+    assert adm.admit("col:noisy").admitted
+    assert not adm.admit("col:noisy").admitted
+    # ...while other tenants ride the unlimited default
+    for _ in range(100):
+        assert adm.admit("col:quiet").admitted
+
+
+def test_admission_tenant_lru_is_bounded(monkeypatch):
+    from seaweedfs_tpu.qos import admission as adm_mod
+
+    clk = FakeClock()
+    adm = _admission(monkeypatch, clk, SWFS_QOS_TENANT_RPS="1000")
+    old_cap, adm_mod.MAX_TENANTS = adm_mod.MAX_TENANTS, 8
+    try:
+        for i in range(100):  # hostile key spray
+            adm.admit(f"ak:spray{i}")
+        assert len(adm._buckets) <= 8
+    finally:
+        adm_mod.MAX_TENANTS = old_cap
+
+
+def test_admission_status_snapshot(monkeypatch):
+    adm = _admission(monkeypatch, FakeClock(), SWFS_QOS_TENANT_RPS="5",
+                     SWFS_QOS_TENANT_BURST="5")
+    for _ in range(7):
+        adm.admit("col:x", trace_id="tid1")
+    st = adm.status()
+    assert st["plane"] == "test"
+    assert st["admitted"] == 5 and st["rejected"] == 2
+    assert "col:x" in st["tenants"]
+    assert len(st["recentRejections"]) == 2
+
+
+# -- GrantLedger: strict priority by reservation ----------------------------
+
+def _ledger(monkeypatch, clk, mbps: float):
+    monkeypatch.setenv("SWFS_QOS_BG_MBPS", str(mbps))
+    led = GrantLedger(now=clk)
+    led._rate_read_at = -1e9  # drop the TTL cache
+    return led
+
+
+def test_ledger_unconfigured_grants_everything(monkeypatch):
+    monkeypatch.delenv("SWFS_QOS_BG_MBPS", raising=False)
+    led = GrantLedger(now=FakeClock())
+    granted, ttl = led.grant("v1:8080", "scrub", 1 << 20, 0.0)
+    assert granted == 1 << 20 and ttl > 0
+
+
+def test_ledger_budget_caps_grants(monkeypatch):
+    clk = FakeClock()
+    led = _ledger(monkeypatch, clk, 1.0)  # 1 MB/s cluster budget
+    clk.advance(10)  # burst caps at 1s of budget = 1e6 bytes
+    granted, _ = led.grant("v1:8080", "scrub", 10_000_000, 0.0)
+    assert 0 < granted <= 1_000_000
+    # drained: an immediate second ask gets (nearly) nothing
+    granted2, _ = led.grant("v1:8080", "scrub", 10_000_000, 0.0)
+    assert granted2 <= 1_000
+
+
+def test_ledger_strict_priority_repair_over_scrub(monkeypatch):
+    clk = FakeClock()
+    led = _ledger(monkeypatch, clk, 1.0)
+    clk.advance(10)
+    # repair expresses demand for the WHOLE budget
+    g_repair, _ = led.grant("v1:8080", "repair", 2_000_000, 0.0)
+    assert g_repair > 0
+    # scrub sees nothing while repair demand is in the window —
+    # the budget it could take is reserved for the higher class
+    clk.advance(1.0)  # 1e6 bytes refilled
+    g_scrub, _ = led.grant("v2:8080", "scrub", 1_000_000, 0.0)
+    assert g_scrub == 0
+    # repair itself still drains the refill
+    g_repair2, _ = led.grant("v1:8080", "repair", 2_000_000, 0.0)
+    assert g_repair2 > 0
+    # once repair demand ages out of the window, scrub is served again
+    clk.advance(GrantLedger.DEMAND_WINDOW_S + 1.0)
+    g_scrub2, _ = led.grant("v2:8080", "scrub", 500_000, 0.0)
+    assert g_scrub2 > 0
+
+
+def test_ledger_equal_rank_classes_share(monkeypatch):
+    clk = FakeClock()
+    led = _ledger(monkeypatch, clk, 1.0)
+    clk.advance(10)
+    # scrub and archival are the same rank: neither reserves against
+    # the other, first-come-first-served from the shared bucket
+    g1, _ = led.grant("v1:8080", "scrub", 400_000, 0.0)
+    g2, _ = led.grant("v2:8080", "archival", 400_000, 0.0)
+    assert g1 == 400_000 and g2 == 400_000
+
+
+def test_ledger_unknown_class_and_pressure_report(monkeypatch):
+    clk = FakeClock()
+    led = _ledger(monkeypatch, clk, 1.0)
+    granted, ttl = led.grant("v1:8080", "", 0, 0.73)
+    assert granted == 0 and ttl > 0
+    assert led.node_pressure("v1:8080") == pytest.approx(0.73)
+    assert led.node_pressure("v9:8080") == 0.0
+    st = led.status()
+    assert st["servers"]["v1:8080"]["pressure"] == pytest.approx(0.73)
+
+
+def test_ledger_stale_pressure_decays_to_zero(monkeypatch):
+    led = _ledger(monkeypatch, FakeClock(), 0.0)
+    led.grant("v1:8080", "", 0, 0.9)
+    led.servers["v1:8080"]["unix"] = time.time() - 60
+    assert led.node_pressure("v1:8080") == 0.0
+
+
+# -- BackgroundGovernor: fail-open foreground / fail-closed background ------
+
+class FakeVolumeServer:
+    def __init__(self, qps: float = 0.0,
+                 master: str = "localhost:1"):
+        self.address = "fake:8080"
+        self.master_grpc = master
+        self._qps = qps
+        self.pressure = 0.1
+
+    def foreground_qps(self) -> float:
+        return self._qps
+
+    def qos_pressure(self) -> float:
+        return self.pressure
+
+
+def test_governor_noop_when_unconfigured(monkeypatch):
+    monkeypatch.delenv("SWFS_QOS_BG_MBPS", raising=False)
+    monkeypatch.delenv("SWFS_QOS_FG_QPS", raising=False)
+    gov = BackgroundGovernor(FakeVolumeServer())
+    assert not gov.enabled()
+    # no master running anywhere — and none is needed
+    assert gov.acquire("scrub", 1 << 30) == 0.0
+
+
+def test_governor_fails_closed_on_unreachable_master(monkeypatch):
+    monkeypatch.setenv("SWFS_QOS_BG_MBPS", "1")
+    # nothing listens on port 1: the lease refresh must raise, not hang
+    # and not silently grant
+    gov = BackgroundGovernor(FakeVolumeServer(master="localhost:1"))
+    with pytest.raises(QosUnavailable):
+        gov.acquire("scrub", 1024, max_wait_s=0.1)
+
+
+def test_governor_failpoint_fails_closed(monkeypatch):
+    from seaweedfs_tpu.utils import failpoint
+
+    monkeypatch.setenv("SWFS_QOS_BG_MBPS", "1")
+    gov = BackgroundGovernor(FakeVolumeServer())
+    with failpoint.active("qos.grant", mode="error", p=1.0):
+        with pytest.raises(QosUnavailable):
+            gov.acquire("archival", 1024, max_wait_s=0.1)
+
+
+def test_background_never_starves_blocked_foreground(monkeypatch):
+    """The inversion test: a background class stuck WAITING on the QoS
+    plane must not block foreground writes. Foreground never calls into
+    the governor (fail-open by construction), so while a scrub acquire
+    is blocked mid-wait the foreground path must keep completing."""
+    monkeypatch.setenv("SWFS_QOS_BG_MBPS", "1")
+    srv = FakeVolumeServer()
+    gov = BackgroundGovernor(srv)
+    # a refresh that never grants: background waits its full budget
+    gov._refresh = lambda klass, want: None
+    done = threading.Event()
+    err: list = []
+
+    def background():
+        try:
+            gov.acquire("scrub", 1 << 20, max_wait_s=1.5)
+        except QosUnavailable:
+            pass
+        except Exception as e:  # noqa: BLE001
+            err.append(e)
+        finally:
+            done.set()
+
+    t = threading.Thread(target=background, daemon=True)
+    t.start()
+    # while background is blocked, "foreground writes" (anything NOT
+    # routed through the governor) proceed at full speed
+    fg_completed = 0
+    t0 = time.monotonic()
+    while not done.is_set() and time.monotonic() - t0 < 10:
+        srv.foreground_qps()  # the foreground path: no QoS gate at all
+        fg_completed += 1
+        if fg_completed > 50_000:
+            break
+    assert fg_completed > 10_000  # foreground never blocked
+    t.join(timeout=10)
+    assert done.is_set() and not err
+    # and the starved background attempt was counted
+    assert gov.denials >= 1
+
+
+def test_governor_fg_qps_yield(monkeypatch):
+    """The PR-4 backoff generalized: background yields while local
+    foreground QPS exceeds the gate, resumes when it drops."""
+    monkeypatch.delenv("SWFS_QOS_BG_MBPS", raising=False)
+    monkeypatch.setenv("SWFS_QOS_FG_QPS", "10")
+    monkeypatch.setenv("SWFS_QOS_FG_BACKOFF_MS", "10")
+    srv = FakeVolumeServer(qps=100.0)
+    gov = BackgroundGovernor(srv)
+
+    def drop_soon():
+        time.sleep(0.15)
+        srv._qps = 0.0
+
+    threading.Thread(target=drop_soon, daemon=True).start()
+    waited = gov.acquire("scrub", 1024)
+    assert waited >= 0.1  # yielded while foreground was hot
+
+
+# -- pressure score ----------------------------------------------------------
+
+def test_pressure_score_bounds_and_caps():
+    assert pressure_score(0, 0) == 0.0
+    assert pressure_score(10**9, 10**9) == 1.0
+    # half-load on one axis only
+    assert pressure_score(128, 0, gc_cap=256, dispatch_cap=64) == \
+        pytest.approx(0.5)
+    assert pressure_score(0, 32, gc_cap=256, dispatch_cap=64) == \
+        pytest.approx(0.5)
+    # negative depths clamp to idle
+    assert pressure_score(-5, -5) == 0.0
+
+
+def test_pressure_score_monotone_in_each_queue():
+    """A rising queue can never LOWER the score — the property assign
+    placement relies on to compare servers."""
+    gc_grid = [0, 1, 8, 64, 128, 256, 300, 10_000]
+    dp_grid = [0, 1, 4, 16, 32, 64, 100, 10_000]
+    for dp in dp_grid:
+        scores = [pressure_score(gc, dp, gc_cap=256, dispatch_cap=64)
+                  for gc in gc_grid]
+        assert scores == sorted(scores), f"non-monotone in gc at dp={dp}"
+    for gc in gc_grid:
+        scores = [pressure_score(gc, dp, gc_cap=256, dispatch_cap=64)
+                  for dp in dp_grid]
+        assert scores == sorted(scores), f"non-monotone in dp at gc={gc}"
+    # strictly monotone while below both caps
+    assert pressure_score(10, 10) < pressure_score(11, 10) \
+        < pressure_score(11, 11)
+
+
+def test_pressure_score_env_caps(monkeypatch):
+    monkeypatch.setenv("SWFS_QOS_GC_CAP", "10")
+    monkeypatch.setenv("SWFS_QOS_DISPATCH_CAP", "10")
+    assert pressure_score(5, 0) == pytest.approx(0.5)
+    assert pressure_score(10, 10) == 1.0
+
+
+# -- placement folds pressure (topology-level) ------------------------------
+
+def test_layout_pick_prefers_calm_replicas():
+    from seaweedfs_tpu.storage.needle import TTL
+    from seaweedfs_tpu.topology.topology import (
+        DataNode,
+        ReplicaPlacement,
+        VolumeInfo,
+        VolumeLayout,
+    )
+
+    rp = ReplicaPlacement.from_byte(0)
+    vl = VolumeLayout(rp, TTL(), 1 << 30)
+    nodes = []
+    for i in range(3):
+        dn = DataNode(ip="h", port=8080 + i, public_url=f"h:{8080+i}",
+                      grpc_port=18080 + i, data_center="dc", rack="r")
+        vi = VolumeInfo(id=i + 1, collection="", replica_placement=rp,
+                        ttl=TTL(), version=3)
+        vl.register(vi, dn)
+        nodes.append(dn)
+    # node 0 saturated, node 1 calm, node 2 middling — all fresh
+    now = time.time()
+    for dn, p in zip(nodes, (0.9, 0.0, 0.5)):
+        dn.qos_pressure = p
+        dn.qos_pressure_at = now
+    picks = {vl.pick_for_write()[0] for _ in range(8)}
+    assert picks == {2}  # volume 2 lives on the calm node
+    # stale reports decay: with everyone stale it degrades to round-robin
+    for dn in nodes:
+        dn.qos_pressure_at = now - 3600
+    picks = {vl.pick_for_write()[0] for _ in range(8)}
+    assert picks == {1, 2, 3}

@@ -233,7 +233,11 @@ def test_circuit_breaker(stack):
                               "actions": {"Read:Count": 0}}}})
     try:
         r = _req("PUT", f"{base}/cbbkt/y.txt", ADMIN, b"blocked")
-        assert r.status_code == 503 and "TooManyRequests" in r.text
+        # ISSUE 8 satellite: breaker overload answers the spec-shaped
+        # SlowDown (what SDK retry policies classify as throttling),
+        # with a Retry-After hint and a resolvable RequestId
+        assert r.status_code == 503 and "SlowDown" in r.text
+        assert int(r.headers["Retry-After"]) >= 1
         assert _req("GET", f"{base}/cbbkt/x.txt", ADMIN).status_code == 503
         # other buckets only hit the global Write limit, reads still fine
         assert _req("GET", f"{base}/authz/a.txt", ADMIN).status_code == 200
@@ -452,3 +456,138 @@ def test_s3_chunked_te_put_roundtrip(stack):
         assert r.status_code == 200 and r.content == payload
     finally:
         s3_open.stop()
+
+
+# -- QoS / spec-shaped errors (ISSUE 8) -------------------------------------
+
+def _parse_error_xml(body: bytes) -> dict:
+    """Parse an S3 error body the way botocore's RestXMLParser does:
+    <Error> root, Code/Message/Resource/RequestId children. A body this
+    parse rejects is a body real SDKs fail hard on instead of backing
+    off."""
+    import xml.etree.ElementTree as ET
+
+    root = ET.fromstring(body)
+    assert root.tag == "Error", root.tag
+    return {el.tag: (el.text or "") for el in root}
+
+
+def test_error_xml_spec_shaped_and_trace_resolvable(stack):
+    """ISSUE 8 satellite: overload answers carry the full spec shape —
+    Code, Message, Resource, RequestId — and the RequestId IS the trace
+    id, resolvable through /debug/traces to the per-plane breakdown."""
+    *_, s3 = stack
+    base = f"http://localhost:{s3.port}"
+    assert _req("PUT", f"{base}/xmlbkt", ADMIN).status_code == 200
+    s3.circuit_breaker.load({
+        "global": {"enabled": True, "actions": {"Write:Count": 0}}})
+    try:
+        r = _req("PUT", f"{base}/xmlbkt/z.txt", ADMIN, b"shed")
+        assert r.status_code == 503
+        err = _parse_error_xml(r.content)
+        assert err["Code"] == "SlowDown"
+        assert "reduce" in err["Message"].lower()
+        assert err["Resource"] == "/xmlbkt/z.txt"
+        assert err["RequestId"]
+        assert int(r.headers["Retry-After"]) >= 1
+        # the RequestId is the trace handle: the gateway's own span for
+        # this rejected request is one /debug/traces lookup away
+        assert err["RequestId"] == r.headers.get("X-Trace-Id")
+        # the debug plane needs an Admin identity while IAM is on
+        dbg = _req("GET",
+                   f"{base}/debug/traces?trace={err['RequestId']}", ADMIN)
+        assert dbg.status_code == 200
+        assert dbg.json().get("spans"), "rejection trace not resolvable"
+    finally:
+        s3.circuit_breaker.load({"global": {"enabled": False}})
+    # a plain data-plane error parses with the same shape (NoSuchKey
+    # class errors ride _error too)
+    r = _req("GET", f"{base}/xmlbkt/never-was.txt", ADMIN)
+    assert r.status_code == 404
+    err = _parse_error_xml(r.content)
+    assert err["Code"] and err["RequestId"] and \
+        err["Resource"] == "/xmlbkt/never-was.txt"
+
+
+def test_s3_tenant_admission_slowdown(stack, monkeypatch):
+    """ISSUE 8: per-tenant token-bucket admission at the S3 ingress —
+    the tenant keyed by its ACCESS KEY is capped; the excess sheds as
+    503 SlowDown with an honest Retry-After; other tenants and the
+    anonymous bucket budget are untouched."""
+    *_, s3 = stack
+    base = f"http://localhost:{s3.port}"
+    assert _req("PUT", f"{base}/qosbkt", ADMIN).status_code == 200
+    assert _req("PUT", f"{base}/qosbkt/a.txt", ADMIN,
+                b"x").status_code == 200
+    monkeypatch.setenv(
+        "SWFS_QOS_TENANT_OVERRIDES",
+        '{"ak:AKREAD": {"rps": 1, "burst": 2}}')
+    s3.qos_admission.refresh_config()
+    try:
+        codes = [_req("GET", f"{base}/qosbkt/a.txt", READER).status_code
+                 for _ in range(6)]
+        assert codes.count(503) >= 3, codes
+        assert 200 in codes  # burst admitted before the cap bit
+        r = _req("GET", f"{base}/qosbkt/a.txt", READER)
+        assert r.status_code == 503
+        err = _parse_error_xml(r.content)
+        assert err["Code"] == "SlowDown" and err["RequestId"]
+        assert int(r.headers["Retry-After"]) >= 1
+        # the rejection is on the admission record with its trace id
+        rej = s3.qos_admission.recent_rejections()[-1]
+        assert rej["tenant"] == "ak:AKREAD"
+        assert rej["traceId"] == err["RequestId"]
+        # a different identity (different tenant bucket) is unaffected
+        assert _req("GET", f"{base}/qosbkt/a.txt",
+                    ADMIN).status_code == 200
+    finally:
+        monkeypatch.delenv("SWFS_QOS_TENANT_OVERRIDES")
+        s3.qos_admission.refresh_config()
+
+
+def test_s3_internal_leg_not_double_charged(stack, monkeypatch):
+    """ISSUE 8 review fix: the gateway's filer legs carry
+    X-Swfs-Qos-Charged, so a tenant's budget is billed ONCE (at the S3
+    ingress) — previously the internal filer hop charged the same
+    col:<bucket> budget again, halving every tenant's effective rate
+    and surfacing the second 429 as a 500. Direct filer traffic on the
+    same collection still sheds."""
+    _, fsrv, s3 = stack
+    base = f"http://localhost:{s3.port}"
+    assert _req("PUT", f"{base}/chgbkt", ADMIN).status_code == 200
+    assert _req("PUT", f"{base}/chgbkt/a.txt", ADMIN,
+                b"x").status_code == 200
+    monkeypatch.setenv("SWFS_QOS_TENANT_OVERRIDES",
+                       '{"col:chgbkt": {"rps": 0.001, "burst": 2}}')
+    fsrv.qos_admission.refresh_config()
+    try:
+        # the collection's filer budget is 2 requests then dry — but
+        # gateway reads are not billed on the internal leg, so every
+        # one of these succeeds
+        codes = [_req("GET", f"{base}/chgbkt/a.txt", ADMIN).status_code
+                 for _ in range(6)]
+        assert codes == [200] * 6, codes
+        # a direct filer client drains that same budget and sheds 429
+        direct = [requests.get(
+            f"http://{fsrv.address}/buckets/chgbkt/a.txt",
+            timeout=10).status_code for _ in range(4)]
+        assert 429 in direct and 200 in direct, direct
+    finally:
+        monkeypatch.delenv("SWFS_QOS_TENANT_OVERRIDES")
+        fsrv.qos_admission.refresh_config()
+
+
+def test_backend_throttle_maps_to_slowdown():
+    """A 429/503 from the backing filer is throttling, not a server
+    fault: it must surface as spec-shaped SlowDown carrying the
+    backend's Retry-After, never InternalError."""
+    from seaweedfs_tpu.s3api.server import _backend_throttled
+
+    class _Resp:
+        headers = {"Retry-After": "7"}
+
+    err = _backend_throttled(_Resp(), "filer GET")
+    assert err.status == 503 and err.code == "SlowDown"
+    assert err.retry_after_s == 7.0
+    _Resp.headers = {}
+    assert _backend_throttled(_Resp(), "filer PUT").retry_after_s == 1.0

@@ -227,3 +227,37 @@ def test_ec_encode_rack_aware_spread(tmp_path_factory):
             s.stop()
         master.stop()
         rpc.reset_channels()
+
+
+def test_qos_status_command(cluster):
+    """`qos.status` (ISSUE 8): one view of the QoS plane across the
+    fleet — the master's grant ledger, each volume server's pressure +
+    governor state — plain and -json forms."""
+    import json
+
+    master, volumes = cluster
+    # the preceding test tears down its own cluster and calls
+    # rpc.reset_channels(), which severs THIS cluster's heartbeat
+    # streams too — the master defer-unregisters the nodes for ~1s
+    # until the next pulse re-registers them; qos.status walks the
+    # topology, so wait for the fleet to be whole again
+    deadline = time.time() + 10
+    while time.time() < deadline and len(master.topo.nodes) < len(volumes):
+        time.sleep(0.05)
+    assert len(master.topo.nodes) == len(volumes), master.topo.nodes
+    # put some grant flow + a pressure report on record first
+    master.qos_ledger.grant(volumes[0].address, "scrub", 1 << 20, 0.42)
+    env = CommandEnv(master.address)
+    text = _sh(env, "qos.status")
+    assert "ledger" in text and "clusterBudgetMBps" in text
+    assert volumes[0].address in text  # the reporting server is listed
+    assert "pressure" in text and "governor" in text
+    j = json.loads(_sh(env, "qos.status -json"))
+    assert master.address in j and "ledger" in j[master.address]["qos"]
+    led = j[master.address]["qos"]["ledger"]
+    assert led["servers"][volumes[0].address]["pressure"] == 0.42
+    # every volume server answers with its own governor section
+    vols = [a for a, e in j.items() if e["kind"] == "volume"]
+    assert len(vols) == len(volumes)
+    for addr in vols:
+        assert j[addr]["qos"]["governor"]["enabled"] is False

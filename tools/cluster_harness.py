@@ -1,0 +1,719 @@
+"""Fleet-scale traffic harness (ISSUE 8): a multi-process cluster under
+named, mixed traffic shapes, with per-shape latency/goodput accounting
+and attributable rejections.
+
+Every A/B before this PR was same-box and single-workload; the north
+star is "heavy traffic from millions of users", and under real online-EC
+load the contention between foreground I/O and background coding work —
+not raw encode throughput — dominates tail latency (arXiv:1709.05365).
+This harness is the instrument that measures exactly that:
+
+  * spawns a REAL cluster — 1 master + N volume servers + filer + S3
+    gateway, each its own process (the PR-6 bench-child `wait_nodes`
+    pattern: fresh gRPC channel per poll);
+  * drives four named traffic shapes concurrently, each generator
+    pacing to a fixed offered rate so QoS-on/off arms compare at EQUAL
+    offered load:
+      - `zipf_read`     zipfian hot-object GETs through the S3 gateway
+      - `put_flood`     small-file PUT flood through the filer
+      - `archival`      bulk `ec.encode` streams via the admin shell
+      - `degraded_read` reconstruct storms (EC reads with data shards
+                        failpointed away)
+  * roots W3C trace context on every generated request, so every
+    rejected or queued request is attributable end-to-end: a 429/503
+    carries X-Trace-Id, and the harness RESOLVES a sample of rejection
+    trace ids through `/debug/traces` before teardown;
+  * emits the `BENCH_CLUSTER_ISSUE8.json` artifact — per-shape
+    p50/p99, goodput, rejection counts, and the QoS-on vs QoS-off
+    foreground-p99 delta — starting the `BENCH_CLUSTER_*` trajectory
+    the next PRs move.
+
+Modes:
+    python tools/cluster_harness.py --ab            # the full A/B (default)
+    python tools/cluster_harness.py --smoke         # tier-1 smoke (~5s load)
+    python tools/cluster_harness.py --phase on|off  # one arm, no A/B
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_REPO = os.path.dirname(_HERE)
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["SEAWEEDFS_TPU_NATIVE"] = "0"  # spans + failpoints live in python
+
+import requests  # noqa: E402
+
+from seaweedfs_tpu.pb import master_pb2, rpc  # noqa: E402
+from seaweedfs_tpu.storage.file_id import parse_file_id  # noqa: E402
+from seaweedfs_tpu.utils import trace  # noqa: E402
+
+# -- cluster plumbing (PR-6 bench-child pattern) ----------------------------
+
+
+def free_port() -> int:
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("", 0))
+            p = s.getsockname()[1]
+        if p + 11000 > 65535:
+            continue
+        with socket.socket() as s2:
+            try:
+                s2.bind(("", p + 10000))
+            except OSError:
+                continue
+        return p
+    raise RuntimeError("no free port pair")
+
+
+def spawn(args: list[str], log_path: str, extra_env: dict | None = None):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", SEAWEEDFS_TPU_NATIVE="0")
+    env.update(extra_env or {})
+    logf = open(log_path, "wb")
+    return subprocess.Popen(
+        [sys.executable, "-m", "seaweedfs_tpu", *args],
+        cwd=_REPO, stdout=logf, stderr=subprocess.STDOUT, env=env)
+
+
+def wait_nodes(master_addr: str, n: int, timeout: float = 240) -> None:
+    """Poll with a FRESH channel per attempt: a channel dialed before the
+    master subprocess finished importing sticks in TRANSIENT_FAILURE in
+    this sandbox and never recovers (PR-6 finding)."""
+    deadline = time.time() + timeout
+    last = "no response"
+    while time.time() < deadline:
+        try:
+            stub = rpc.master_stub(rpc.grpc_address(master_addr))
+            resp = stub.VolumeList(master_pb2.VolumeListRequest(),
+                                   timeout=5)
+            nodes = [dn for dc in resp.topology_info.data_center_infos
+                     for rack in dc.rack_infos
+                     for dn in rack.data_node_infos]
+            if len(nodes) >= n:
+                return
+            last = f"{len(nodes)} nodes"
+        except Exception as e:  # noqa: BLE001
+            last = f"{type(e).__name__}"
+            rpc.reset_channels()
+        time.sleep(1.0)
+    raise RuntimeError(f"{n} volume servers never registered ({last})")
+
+
+def wait_http(addr: str, timeout: float = 120) -> None:
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            requests.get(f"http://{addr}/status", timeout=3)
+            return
+        except requests.RequestException:
+            time.sleep(0.5)
+    raise RuntimeError(f"{addr} never answered /status")
+
+
+class Cluster:
+    """One spawned master + N volume servers + filer + S3 gateway."""
+
+    def __init__(self, servers: int, extra_env: dict | None = None,
+                 volume_env: dict | None = None):
+        self.tmp = tempfile.mkdtemp(prefix="swfs-harness-")
+        self.procs: list = []
+        self.extra_env = dict(extra_env or {})
+        self.mport = free_port()
+        self.master = f"localhost:{self.mport}"
+        self.vol_addrs: list[str] = []
+        self.procs.append(spawn(
+            ["master", "-port", str(self.mport),
+             "-volumeSizeLimitMB", "512"],
+            os.path.join(self.tmp, "master.log"), self.extra_env))
+        for i in range(servers):
+            d = os.path.join(self.tmp, f"v{i}")
+            os.makedirs(d)
+            p = free_port()
+            self.vol_addrs.append(f"localhost:{p}")
+            env = dict(self.extra_env)
+            env.update(volume_env or {})
+            self.procs.append(spawn(
+                ["volume", "-dir", d, "-max", "200", "-port", str(p),
+                 "-mserver", self.master, "-coder", "cpu",
+                 "-nativeDataPlane", "off"],
+                os.path.join(self.tmp, f"v{i}.log"), env))
+        fport = free_port()
+        self.filer = f"localhost:{fport}"
+        self.procs.append(spawn(
+            ["filer", "-port", str(fport), "-master", self.master,
+             "-dir", os.path.join(self.tmp, "filer"), "-store", "memory"],
+            os.path.join(self.tmp, "filer.log"), self.extra_env))
+        s3port = free_port()
+        self.s3 = f"localhost:{s3port}"
+        self.procs.append(spawn(
+            ["s3", "-port", str(s3port), "-filer", self.filer],
+            os.path.join(self.tmp, "s3.log"), self.extra_env))
+
+    def wait(self, servers: int) -> None:
+        wait_nodes(self.master, servers)
+        wait_http(self.filer)
+        wait_http(self.s3)
+
+    def all_addrs(self) -> list[str]:
+        return [self.master, *self.vol_addrs, self.filer, self.s3]
+
+    def stop(self) -> None:
+        for p in self.procs:
+            try:
+                p.send_signal(signal.SIGTERM)
+            except OSError:
+                pass
+        clean = True
+        for p in self.procs:
+            try:
+                p.wait(timeout=15)
+            except subprocess.TimeoutExpired:
+                clean = False
+                p.kill()
+        rpc.reset_channels()
+        self.clean_shutdown = clean
+
+
+# -- per-shape accounting ----------------------------------------------------
+
+
+class ShapeStats:
+    def __init__(self, name: str):
+        self.name = name
+        self.lock = threading.Lock()
+        self.lats_ms: list[float] = []
+        self.ok = 0
+        self.errors = 0
+        self.rejected = 0
+        self.offered = 0
+        self.rejection_traces: list[str] = []
+        self.error_samples: list[str] = []
+
+    def record(self, ms: float, status: int, trace_id: str = "",
+               err: str = "") -> None:
+        with self.lock:
+            self.offered += 1
+            if status in (429, 503):
+                self.rejected += 1
+                if trace_id and len(self.rejection_traces) < 200:
+                    self.rejection_traces.append(trace_id)
+            elif 200 <= status < 300:
+                self.ok += 1
+                self.lats_ms.append(ms)
+            else:
+                self.errors += 1
+                if err and len(self.error_samples) < 5:
+                    self.error_samples.append(err[:160])
+
+    def summary(self, wall_s: float) -> dict:
+        with self.lock:
+            lats = sorted(self.lats_ms)
+            out = {
+                "offered": self.offered,
+                "ok": self.ok,
+                "rejected": self.rejected,
+                "errors": self.errors,
+                "goodput_per_sec": round(self.ok / wall_s, 2)
+                if wall_s else 0.0,
+            }
+            if lats:
+                out["p50_ms"] = round(lats[len(lats) // 2], 2)
+                out["p99_ms"] = round(lats[min(int(len(lats) * 0.99),
+                                               len(lats) - 1)], 2)
+            if self.error_samples:
+                out["error_samples"] = list(self.error_samples)
+            return out
+
+
+def _zipf_index(rng, n: int) -> int:
+    # bounded zipf-ish skew via a power transform of one uniform draw:
+    # most mass lands on the lowest indices (the "hot" objects)
+    u = rng.random()
+    return min(int(n * (u ** 2.5)), n - 1)
+
+
+def _paced_loop(stats: ShapeStats, rps: float, deadline: float, fn,
+                workers: int = 1):
+    """Fixed-rate open loop: attempts are scheduled at `rps` regardless
+    of response latency (bounded backlog), so QoS-on/off arms see EQUAL
+    offered load. `workers` threads split the rate — one serial
+    connection tops out near 1/latency and could never exceed an
+    admission cap, hiding the very shedding the A/B measures."""
+
+    def one_worker(worker_rps: float):
+        next_t = time.monotonic()
+        period = 1.0 / max(worker_rps, 0.1)
+        while time.monotonic() < deadline:
+            now = time.monotonic()
+            if now < next_t:
+                time.sleep(min(next_t - now, 0.2))
+                continue
+            next_t = max(next_t + period, now - 5 * period)  # cap backlog
+            t0 = time.perf_counter()
+            status, tid, err = 0, "", ""
+            try:
+                status, tid = fn()
+            except requests.RequestException as e:
+                err = f"{type(e).__name__}: {e}"
+            except Exception as e:  # noqa: BLE001 — never dies
+                err = f"{type(e).__name__}: {e}"
+            stats.record((time.perf_counter() - t0) * 1e3, status, tid,
+                         err)
+
+    if workers <= 1:
+        return one_worker(rps)
+    ts = [threading.Thread(target=one_worker, args=(rps / workers,),
+                           daemon=True) for _ in range(workers)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=max(deadline - time.monotonic(), 0) + 120)
+
+
+# -- the traffic shapes ------------------------------------------------------
+
+
+class _Local(threading.local):
+    """Per-worker-thread session + rng (a shared requests.Session
+    serializes on its connection; a shared Random races)."""
+
+    def __init__(self):
+        self.session = requests.Session()
+        self.rng = __import__("random").Random(
+            hash(threading.current_thread().name) & 0xFFFF)
+
+
+def shape_zipf_read(cluster: Cluster, keys: list[str], stats: ShapeStats,
+                    rps: float, deadline: float, workers: int = 2):
+    tl = _Local()
+
+    def one():
+        key = keys[_zipf_index(tl.rng, len(keys))]
+        with trace.span(f"harness.{stats.name}", component="harness",
+                        server="harness") as sp:
+            r = tl.session.get(
+                f"http://{cluster.s3}/hot/{key}",
+                headers=trace.inject_headers({}), timeout=30)
+            return r.status_code, r.headers.get("X-Trace-Id",
+                                                sp.trace_id)
+
+    _paced_loop(stats, rps, deadline, one, workers=workers)
+
+
+def shape_put_flood(cluster: Cluster, stats: ShapeStats, rps: float,
+                    deadline: float, workers: int = 4,
+                    body_bytes: int = 1024):
+    import itertools
+
+    tl = _Local()
+    seq = itertools.count()  # thread-safe under the GIL
+    body = os.urandom(body_bytes)
+
+    def one():
+        with trace.span(f"harness.{stats.name}", component="harness",
+                        server="harness") as sp:
+            r = tl.session.put(
+                f"http://{cluster.filer}/buckets/flood/o{next(seq)}",
+                data=body, headers=trace.inject_headers({}), timeout=30)
+            return r.status_code, r.headers.get("X-Trace-Id",
+                                                sp.trace_id)
+
+    _paced_loop(stats, rps, deadline, one, workers=workers)
+
+
+def shape_degraded_read(vol_addr: str, fids: list[str],
+                        stats: ShapeStats, rps: float, deadline: float,
+                        workers: int = 2):
+    tl = _Local()
+
+    def one():
+        fid = fids[tl.rng.randrange(len(fids))]
+        with trace.span(f"harness.{stats.name}", component="harness",
+                        server="harness") as sp:
+            r = tl.session.get(f"http://{vol_addr}/{fid}",
+                               headers=trace.inject_headers({}),
+                               timeout=60)
+            return r.status_code, r.headers.get("X-Trace-Id",
+                                                sp.trace_id)
+
+    _paced_loop(stats, rps, deadline, one, workers=workers)
+
+
+def shape_archival(env, cluster: Cluster, stats: ShapeStats,
+                   deadline: float, vol_mb: float):
+    """Back-to-back replica->EC conversions: fill a small volume, then
+    `ec.encode` it through the admin shell (which roots its own trace
+    and prints the id). Closed-loop by nature — the offered load is
+    'as fast as conversions complete', identical across arms."""
+    import io
+
+    from seaweedfs_tpu.shell.registry import run_command
+
+    seq = [0]
+    while time.monotonic() < deadline:
+        seq[0] += 1
+        t0 = time.perf_counter()
+        status, err = 0, ""
+        try:
+            vid = _fill_volume(cluster, f"arch{seq[0]}",
+                               seed=1000 + seq[0], vol_mb=vol_mb)
+            out = io.StringIO()
+            code = run_command(env, f"ec.encode -volumeId {vid}", out)
+            status = 200 if code == 0 else 500
+            if code != 0:
+                err = out.getvalue()[-160:]
+        except Exception as e:  # noqa: BLE001
+            err = f"{type(e).__name__}: {e}"
+        stats.record((time.perf_counter() - t0) * 1e3, status, "", err)
+
+
+# -- staging -----------------------------------------------------------------
+
+
+def _fill_volume(cluster: Cluster, collection: str, seed: int,
+                 vol_mb: float) -> int:
+    """Direct volume-plane fill (the PR-6 bench make_volume pattern):
+    deterministic keys, ~1MB needles. -> volume id."""
+    from seaweedfs_tpu.operation import submit
+
+    res = submit(cluster.master, b"seed", filename="s.bin",
+                 collection=collection)
+    if "fid" not in res:
+        raise RuntimeError(f"submit failed: {res}")
+    vid = parse_file_id(res["fid"]).volume_id
+    src = res["url"]
+    key = (0x7F - (seed % 0x70)) << 24
+    blob = os.urandom(1 << 20)
+    total = 0
+    with requests.Session() as s:
+        while total < vol_mb * (1 << 20):
+            data = key.to_bytes(8, "big") + blob[8:]
+            r = s.put(f"http://{src}/{vid},{key:x}00002026", data=data,
+                      timeout=60)
+            if r.status_code not in (200, 201):
+                raise RuntimeError(f"fill PUT {r.status_code}: {r.text}")
+            total += len(data)
+            key += 1
+    return vid
+
+
+def stage_hot_objects(cluster: Cluster, n: int = 32) -> list[str]:
+    with requests.Session() as s:
+        r = s.put(f"http://{cluster.s3}/hot", timeout=30)
+        if r.status_code >= 300:
+            raise RuntimeError(f"bucket create: {r.status_code}")
+        keys = []
+        for i in range(n):
+            key = f"obj-{i:04d}"
+            body = os.urandom(2048 + (i % 7) * 1024)
+            r = s.put(f"http://{cluster.s3}/hot/{key}", data=body,
+                      timeout=30)
+            if r.status_code >= 300:
+                raise RuntimeError(f"hot PUT: {r.status_code}")
+            keys.append(key)
+    return keys
+
+
+def stage_degraded_volume(cluster: Cluster, env,
+                          vol_mb: float) -> tuple[str, list[str]]:
+    """Fill + EC-encode one volume; -> (holder address, needle fids).
+    The holder's `ec.shard.read` failpoint (armed via its spawn env)
+    then makes every read of shards 0-2 a reconstruct."""
+    from seaweedfs_tpu.pb import volume_server_pb2 as vs
+
+    vid = _fill_volume(cluster, "deg", seed=555, vol_mb=vol_mb)
+    # locate the holder
+    stub = rpc.master_stub(rpc.grpc_address(cluster.master))
+    resp = stub.LookupVolume(master_pb2.LookupVolumeRequest(
+        volume_or_file_ids=[str(vid)]), timeout=10)
+    holder = resp.volume_id_locations[0].locations[0].url
+    vstub = rpc.volume_stub(rpc.grpc_address(holder))
+    vstub.VolumeMarkReadonly(
+        vs.VolumeMarkReadonlyRequest(volume_id=vid), timeout=30)
+    vstub.VolumeEcShardsGenerate(
+        vs.VolumeEcShardsGenerateRequest(volume_id=vid,
+                                         collection="deg"), timeout=600)
+    vstub.VolumeUnmount(vs.VolumeUnmountRequest(volume_id=vid),
+                        timeout=30)
+    vstub.VolumeEcShardsMount(
+        vs.VolumeEcShardsMountRequest(volume_id=vid, collection="deg",
+                                      shard_ids=list(range(14))),
+        timeout=60)
+    key0 = (0x7F - (555 % 0x70)) << 24
+    nfids = max(1, int(vol_mb))
+    fids = [f"{vid},{key0 + i:x}00002026" for i in range(nfids)]
+    return holder, fids
+
+
+# -- one measured phase ------------------------------------------------------
+
+DEGRADED_FP = ("ec.shard.read=error(1.0)"
+               "@shard=0,|shard=1,|shard=2,")
+
+
+def run_phase(tag: str, *, servers: int, duration: float,
+              qos_env: dict | None, rates: dict,
+              vol_mb: float) -> dict:
+    """Spawn a fresh cluster, stage, drive the 4 shapes for `duration`
+    seconds, resolve rejection traces, snapshot /status.Qos, tear down."""
+    from seaweedfs_tpu.shell.env import CommandEnv
+    from seaweedfs_tpu.shell.registry import run_command
+
+    volume_env = dict(qos_env or {})
+    volume_env["SWFS_FAILPOINTS"] = DEGRADED_FP
+    cluster = Cluster(servers, extra_env=qos_env, volume_env=volume_env)
+    shapes = {name: ShapeStats(name)
+              for name in ("zipf_read", "put_flood", "archival",
+                           "degraded_read")}
+    out: dict = {"tag": tag, "servers": servers,
+                 "duration_s": duration, "qos_env": qos_env or {}}
+    try:
+        cluster.wait(servers)
+        env = CommandEnv(cluster.master, filer=cluster.filer)
+        import io
+
+        assert run_command(env, "lock", io.StringIO()) == 0
+        keys = stage_hot_objects(cluster)
+        holder, deg_fids = stage_degraded_volume(cluster, env, vol_mb)
+        t_start = time.monotonic()
+        deadline = t_start + duration
+        threads = [
+            threading.Thread(target=shape_zipf_read, args=(
+                cluster, keys, shapes["zipf_read"], rates["zipf_read"],
+                deadline), daemon=True),
+            threading.Thread(target=shape_put_flood, args=(
+                cluster, shapes["put_flood"], rates["put_flood"],
+                deadline), daemon=True),
+            threading.Thread(target=shape_degraded_read, args=(
+                holder, deg_fids, shapes["degraded_read"],
+                rates["degraded_read"], deadline), daemon=True),
+            threading.Thread(target=shape_archival, args=(
+                env, cluster, shapes["archival"], deadline, vol_mb),
+                daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=duration + 240)
+        wall = time.monotonic() - t_start
+        out["shapes"] = {n: s.summary(wall) for n, s in shapes.items()}
+        # attributability: every rejection's trace id must resolve via
+        # /debug/traces somewhere in the cluster
+        rejections = []
+        for s in shapes.values():
+            rejections.extend(s.rejection_traces)
+        resolved = 0
+        sample = rejections[:40]
+        for tid in sample:
+            for addr in cluster.all_addrs():
+                try:
+                    r = requests.get(f"http://{addr}/debug/traces",
+                                     params={"trace": tid}, timeout=10)
+                    if r.status_code == 200 and r.json().get("spans"):
+                        resolved += 1
+                        break
+                except requests.RequestException:
+                    continue
+        out["rejections"] = {
+            "total": sum(s.rejected for s in shapes.values()),
+            "traceIdsSampled": len(sample),
+            "traceIdsResolved": resolved,
+            "sample": sample[:8],
+        }
+        # /status.Qos snapshots (grant flow + tenant buckets on record)
+        snaps = {}
+        for addr in (cluster.master, cluster.vol_addrs[0],
+                     cluster.filer, cluster.s3):
+            try:
+                snaps[addr] = requests.get(
+                    f"http://{addr}/status",
+                    timeout=10).json().get("Qos", {})
+            except (requests.RequestException, ValueError):
+                snaps[addr] = {}
+        out["qos_status"] = snaps
+    finally:
+        cluster.stop()
+        out["clean_shutdown"] = getattr(cluster, "clean_shutdown", False)
+    return out
+
+
+def foreground_p99(phase: dict) -> float | None:
+    """Pooled foreground tail: the worse of the two foreground shapes'
+    p99s (reads and writes are both 'the user is waiting')."""
+    vals = [phase["shapes"][s].get("p99_ms")
+            for s in ("zipf_read", "put_flood")
+            if phase["shapes"][s].get("p99_ms") is not None]
+    return max(vals) if vals else None
+
+
+# -- entry points ------------------------------------------------------------
+
+QOS_ON_ENV = {
+    # cluster-wide background budget: scrub + archival must share 6MB/s
+    "SWFS_QOS_BG_MBPS": "6",
+    # strict local priority: background yields while foreground > 30 qps
+    "SWFS_QOS_FG_QPS": "30",
+    # flood tenant capped well under its offered rate: excess sheds
+    # EARLY as 429/SlowDown instead of queueing into the tail. The cap
+    # must sit below what the generators can actually push on this box
+    # (~20-40 rps/worker under contention) or nothing ever sheds.
+    "SWFS_QOS_TENANT_OVERRIDES":
+        '{"col:flood": {"rps": 20, "burst": 25}}',
+    "SWFS_QOS_SHED_PRESSURE": "0.97",
+    # aggressive background cadence — same in both arms
+    "SWFS_SCRUB_INTERVAL_S": "2",
+    "SWFS_SCRUB_MAX_MBPS": "0",
+    "SWFS_SCRUB_FG_QPS": "0",
+}
+
+QOS_OFF_ENV = {
+    # same background cadence, no QoS plane: scrub unpaced (local MBPS
+    # cap off, PR-4 FG backoff off) and archival unthrottled — the
+    # contention the QoS arm is allowed to fix
+    "SWFS_SCRUB_INTERVAL_S": "2",
+    "SWFS_SCRUB_MAX_MBPS": "0",
+    "SWFS_SCRUB_FG_QPS": "0",
+}
+
+DEFAULT_RATES = {"zipf_read": 30.0, "put_flood": 50.0,
+                 "degraded_read": 15.0}
+
+
+def run_ab(servers: int, duration: float, vol_mb: float,
+           rounds: int = 3) -> dict:
+    """INTERLEAVED A/B: `rounds` adjacent (off, on) phase pairs, each a
+    fresh cluster at identical offered rates. Adjacent pairing is the
+    BENCH_AB_ISSUE7 lesson applied at cluster scale — the 2-core box
+    drifts by tens of percent over minutes, so a single off-then-on
+    pass measures the drift, not the plane; paired deltas with a
+    median cancel it."""
+    pairs: list[dict] = []
+    for r in range(rounds):
+        pair = {}
+        for tag, env in (("qos_off", QOS_OFF_ENV),
+                         ("qos_on", QOS_ON_ENV)):
+            pair[tag] = run_phase(
+                f"{tag}_r{r}", servers=servers, duration=duration,
+                qos_env=env, rates=DEFAULT_RATES, vol_mb=vol_mb)
+        pair["p99_off_ms"] = foreground_p99(pair["qos_off"])
+        pair["p99_on_ms"] = foreground_p99(pair["qos_on"])
+        if pair["p99_off_ms"] and pair["p99_on_ms"]:
+            pair["delta_pct"] = round(
+                100.0 * (pair["p99_off_ms"] - pair["p99_on_ms"])
+                / pair["p99_off_ms"], 1)
+        pairs.append(pair)
+    deltas = sorted(p["delta_pct"] for p in pairs if "delta_pct" in p)
+    out = {
+        "metric": "cluster_qos_foreground_p99_ms",
+        "what": ("ISSUE 8 fleet harness A/B: combined small-file flood "
+                 "+ zipfian S3 reads + unpaced scrub + archival "
+                 "ec.encode + degraded-read storm on a real multi-"
+                 "process cluster, at equal offered load, as "
+                 f"{rounds} INTERLEAVED adjacent (off, on) phase "
+                 "pairs. qos_off = no admission / no cluster grants / "
+                 "scrub+archival unthrottled; qos_on = tenant "
+                 "admission (flood capped under offered), cluster "
+                 "background budget (SWFS_QOS_BG_MBPS) with strict "
+                 "priority, FG-QPS yield, pressure-fed placement."),
+        "servers": servers, "duration_s": duration,
+        "rounds": rounds, "offered_rates_per_sec": DEFAULT_RATES,
+        "round_deltas_pct": [p.get("delta_pct") for p in pairs],
+        # last round's full phase dumps carry the qos_status evidence;
+        # earlier rounds keep shapes + rejections (bounded artifact)
+        "qos_off": pairs[-1]["qos_off"],
+        "qos_on": pairs[-1]["qos_on"],
+        "earlier_rounds": [
+            {tag: {k: p[tag][k] for k in ("tag", "shapes", "rejections",
+                                          "clean_shutdown")}
+             for tag in ("qos_off", "qos_on")} for p in pairs[:-1]],
+    }
+    if deltas:
+        out["foreground_p99_off_ms"] = [p["p99_off_ms"] for p in pairs]
+        out["foreground_p99_on_ms"] = [p["p99_on_ms"] for p in pairs]
+        out["foreground_p99_median_delta_pct"] = \
+            deltas[len(deltas) // 2]
+        out["target_delta_pct"] = 25.0
+    out["box_note"] = (
+        "2-core shared sandbox: master + N volume servers + filer + s3 "
+        "+ the load generators all share the 2 cores, so absolute "
+        "latencies are dominated by CPU oversubscription and run-to-"
+        "run noise is +/-15-30% per phase even with adjacent pairing "
+        "(the BENCH_AB_ISSUE6 class of limitation). The A/B signal "
+        "that IS valid here: with QoS on, background scrub/archival "
+        "genuinely yields CPU+IO to the foreground (grant waits + "
+        "FG-QPS backoff visible in qos_status) and the flood's excess "
+        "sheds as fast 429/SlowDown instead of queueing into the tail "
+        "— both arms at identical offered rates, every rejection "
+        "trace-resolvable.")
+    return out
+
+
+def run_smoke(servers: int = 2, duration: float = 5.0,
+              vol_mb: float = 1.0) -> dict:
+    """Tier-1 smoke: tiny cluster, short mixed workload, assert-friendly
+    output (nonzero goodput per shape + clean shutdown)."""
+    phase = run_phase("smoke", servers=servers, duration=duration,
+                      qos_env=None, rates=DEFAULT_RATES, vol_mb=vol_mb)
+    phase["metric"] = "cluster_harness_smoke"
+    return phase
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--phase", choices=["on", "off"], default=None)
+    ap.add_argument("--ab", action="store_true")
+    ap.add_argument("--servers", type=int,
+                    default=int(os.environ.get("SWFS_HARNESS_SERVERS",
+                                               "2")))
+    ap.add_argument("--duration", type=float,
+                    default=float(os.environ.get("SWFS_HARNESS_DURATION",
+                                                 "30")))
+    ap.add_argument("--rounds", type=int,
+                    default=int(os.environ.get("SWFS_HARNESS_ROUNDS",
+                                               "3")))
+    ap.add_argument("--vol-mb", type=float,
+                    default=float(os.environ.get("SWFS_HARNESS_VOL_MB",
+                                                 "4")))
+    ap.add_argument("--out", default="")
+    opts = ap.parse_args()
+    try:
+        if opts.smoke:
+            out = run_smoke(opts.servers, min(opts.duration, 10.0),
+                            min(opts.vol_mb, 1.0))
+        elif opts.phase:
+            env = QOS_ON_ENV if opts.phase == "on" else QOS_OFF_ENV
+            out = run_phase(f"qos_{opts.phase}", servers=opts.servers,
+                            duration=opts.duration, qos_env=env,
+                            rates=DEFAULT_RATES, vol_mb=opts.vol_mb)
+        else:
+            out = run_ab(opts.servers, opts.duration, opts.vol_mb,
+                         rounds=max(opts.rounds, 1))
+    except Exception as e:  # noqa: BLE001 — one JSON line, always
+        import traceback
+
+        traceback.print_exc()
+        out = {"error": f"{type(e).__name__}: {e}"[:500]}
+    if opts.out:
+        with open(opts.out, "w") as fh:
+            json.dump(out, fh, indent=1)
+    print(json.dumps(out))
+    return 0 if "error" not in out else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
