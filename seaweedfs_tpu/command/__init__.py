@@ -583,8 +583,10 @@ def _run(opts) -> int:
         import requests
 
         path = opts.path if opts.path.startswith("/") else "/" + opts.path
-        r = requests.get(f"http://{opts.filer}{path}", timeout=300,
-                         stream=True)
+        from ..utils.http import requests_verify, url_for
+
+        r = requests.get(url_for(opts.filer, path), timeout=300,
+                         stream=True, verify=requests_verify())
         if r.status_code != 200:
             print(f"{path}: HTTP {r.status_code}", file=sys.stderr)
             return 1
@@ -616,8 +618,11 @@ def _run(opts) -> int:
             for full, rel in paths:
                 target = dest.rstrip("/") + "/" + rel
                 with open(full, "rb") as f:  # streamed, not slurped
-                    r = requests.put(f"http://{opts.filer}{target}",
-                                     data=f, timeout=300)
+                    from ..utils.http import requests_verify, url_for
+
+                    r = requests.put(url_for(opts.filer, target),
+                                     data=f, timeout=300,
+                                     verify=requests_verify())
                 if r.status_code >= 300:
                     print(f"{target}: HTTP {r.status_code}",
                           file=sys.stderr)

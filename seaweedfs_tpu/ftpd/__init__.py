@@ -61,7 +61,9 @@ class _Session(threading.Thread):
         self.conn.sendall((line + "\r\n").encode())
 
     def filer_url(self, path: str) -> str:
-        return (f"http://{self.srv.options.filer}"
+        from ..utils.http import url_for
+
+        return (url_for(self.srv.options.filer)
                 + urllib.parse.quote(path))
 
     def resolve(self, arg: str) -> str:

@@ -99,8 +99,11 @@ class IamServer:
         self._httpd: TunedThreadingHTTPServer | None = None
 
     def start(self) -> None:
-        self._httpd = TunedThreadingHTTPServer(("", self.port),
-                                          _make_handler(self))
+        from ..security.tls import load_http_server_context
+
+        self._httpd = TunedThreadingHTTPServer(
+            ("", self.port), _make_handler(self),
+            ssl_context=load_http_server_context("iam"))
         threading.Thread(target=self._httpd.serve_forever,
                          daemon=True).start()
         glog.info(f"iam api server on :{self.port}")

@@ -74,10 +74,13 @@ class HttpTransport:
     def call(self, target: str, method: str, payload: dict) -> dict | None:
         import requests
 
+        from ..utils.http import requests_verify, url_for
+
         try:
-            r = requests.post(f"http://{target}/cluster/raft",
+            r = requests.post(url_for(target, "/cluster/raft"),
                               json={"method": method, "payload": payload},
-                              timeout=self.TIMEOUT)
+                              timeout=self.TIMEOUT,
+                              verify=requests_verify())
             if r.status_code == 200:
                 return r.json()
         except requests.RequestException:

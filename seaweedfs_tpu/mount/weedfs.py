@@ -510,10 +510,13 @@ class WFS:
             disk_type=self.disk_type, path=path), timeout=30)
         if resp.error:
             raise FuseError(errno.EIO, resp.error)
-        url = f"http://{resp.location.url}/{resp.file_id}"
+        from ..utils.http import requests_verify, url_for
+
+        url = url_for(resp.location.url, resp.file_id)
         headers = {"Authorization": f"Bearer {resp.auth}"} if resp.auth \
             else {}
-        r = requests.put(url, data=data, headers=headers, timeout=60)
+        r = requests.put(url, data=data, headers=headers, timeout=60,
+                         verify=requests_verify())
         if r.status_code >= 300:
             raise FuseError(errno.EIO, f"upload {url}: {r.status_code}")
         j = r.json()
@@ -531,10 +534,13 @@ class WFS:
         locs = resp.locations_map.get(vid)
         if locs is None or not locs.locations:
             raise FuseError(errno.EIO, f"no locations for {vid}")
+        from ..utils.http import requests_verify, url_for
+
         last: Exception | None = None
         for loc in locs.locations:
             try:
-                r = requests.get(f"http://{loc.url}/{file_id}", timeout=60)
+                r = requests.get(url_for(loc.url, file_id), timeout=60,
+                                 verify=requests_verify())
                 if r.status_code == 200:
                     self.chunk_cache.put(file_id, r.content)
                     return r.content

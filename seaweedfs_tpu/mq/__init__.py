@@ -309,7 +309,11 @@ class MqHttpServer:
                                       200 if ok else 404)
                 self._json({"error": "not found"}, 404)
 
-        self._httpd = TunedThreadingHTTPServer(("", self.port), Handler)
+        from ..security.tls import load_http_server_context
+
+        self._httpd = TunedThreadingHTTPServer(
+            ("", self.port), Handler,
+            ssl_context=load_http_server_context("mq"))
         threading.Thread(target=self._httpd.serve_forever,
                          daemon=True).start()
 

@@ -36,6 +36,7 @@
 #include <fcntl.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
+#include <sys/sendfile.h>
 #include <sys/socket.h>
 #include <sys/stat.h>
 #include <sys/types.h>
@@ -386,6 +387,10 @@ struct Plane {
   int id = 0;
   Registry reg;
   std::atomic<uint64_t> requests{0};
+  // zero-copy GET serving (ISSUE 9): bodies at least zerocopy_min bytes
+  // go disk->socket via sendfile(2); -1 disables the path entirely
+  std::atomic<uint64_t> sendfiles{0};
+  std::atomic<int64_t> zerocopy_min{4096};
   int listen_fd = -1;
   int port = 0;
   int redirect_port = 0;
@@ -776,7 +781,194 @@ std::string http_date(uint64_t unix_secs) {
   return std::string(b);
 }
 
+// RFC 7232 §3.2 If-None-Match for GET/HEAD: WEAK comparison over the
+// entity-tag list ("*" matches any representation) — mirrors
+// utils/http.py parse_etag_list/weak_etag_match so both planes answer
+// conditionals identically.
+bool inm_matches(const std::string& inm, const std::string& etag) {
+  std::string target = etag;
+  if (target.rfind("W/", 0) == 0 || target.rfind("w/", 0) == 0)
+    target = target.substr(2);
+  size_t i = 0;
+  while (i < inm.size()) {
+    while (i < inm.size() &&
+           (inm[i] == ',' || inm[i] == ' ' || inm[i] == '\t'))
+      i++;
+    if (i >= inm.size()) break;
+    if (inm[i] == '*') return true;
+    if (inm.compare(i, 2, "W/") == 0 || inm.compare(i, 2, "w/") == 0)
+      i += 2;
+    if (i < inm.size() && inm[i] == '"') {
+      size_t end = inm.find('"', i + 1);
+      if (end == std::string::npos) return false;
+      if (inm.compare(i, end - i + 1, target) == 0) return true;
+      i = end + 1;
+    } else {  // lenient: bare token (some clients send unquoted md5s)
+      size_t end = inm.find(',', i);
+      if (end == std::string::npos) end = inm.size();
+      std::string tok = inm.substr(i, end - i);
+      while (!tok.empty() && (tok.back() == ' ' || tok.back() == '\t'))
+        tok.pop_back();
+      if (tok == target) return true;
+      i = end;
+    }
+  }
+  return false;
+}
+
 // ------------------------------------------------------------- handlers --
+
+// Zero-copy GET (ISSUE 9 tentpole): serve the needle body straight off
+// the .dat fd with sendfile(2) — the payload never crosses user space.
+// Two bounded preads fetch the record ENVELOPE only (the prefix locating
+// the data span; the post-data tail carrying flags/mime/mtime and the
+// stored checksum), then the kernel moves data_len bytes disk->socket.
+// The stored checksum becomes the ETag WITHOUT a verify pass — skipping
+// the per-GET CRC is exactly the copy this path deletes; at-rest
+// integrity is owned by the scrub plane (ISSUE 4), and every buffered
+// read (python, small needles, compressed/TTL records) still verifies.
+// Returns true when the response (or a deliberate redirect) was fully
+// handled; false falls through to the buffered path.
+bool try_sendfile_get(Plane& pl, int fd, const Request& req, Volume& vol,
+                      const NeedleValue& nv,
+                      const std::shared_ptr<FdOwner>& ref,
+                      uint32_t cookie) {
+  int64_t zmin = pl.zerocopy_min.load(std::memory_order_relaxed);
+  if (zmin < 0 || req.method != "GET" || nv.size <= 0) return false;
+  int64_t base = (int64_t)nv.stored_offset * kPad;
+  int32_t size = nv.size;
+  int64_t data_off, data_len;
+  uint8_t prefix[kHeaderSize + 4];
+  if (vol.version == 1) {
+    if (pread(ref->fd, prefix, kHeaderSize, base) != kHeaderSize)
+      return false;
+    data_off = kHeaderSize;
+    data_len = size;
+  } else {
+    if (pread(ref->fd, prefix, sizeof prefix, base) !=
+        (ssize_t)sizeof prefix)
+      return false;
+    data_off = kHeaderSize + 4;
+    data_len = get_u32(prefix + kHeaderSize);
+  }
+  if (get_u32(prefix) != cookie) return false;  // buffered path 404s
+  if (data_len < zmin) return false;  // small body: one pread is cheaper
+  // the envelope after the data: flags/name/mime/lm + stored checksum
+  int64_t tail_off = base + data_off + data_len;
+  int64_t tail_len = base + kHeaderSize + size + kChecksumSize - tail_off;
+  if (tail_len < kChecksumSize || tail_len > 4096)
+    return false;  // structurally off / huge meta: buffered path decides
+  uint8_t tail[4096 + kChecksumSize];
+  if (pread(ref->fd, tail, tail_len, tail_off) != (ssize_t)tail_len)
+    return false;
+  uint8_t flags = 0;
+  const uint8_t* mime = nullptr;
+  uint8_t mime_len = 0;
+  uint64_t last_modified = 0;
+  const uint8_t* p = tail;
+  const uint8_t* end = tail + (tail_len - kChecksumSize);
+  if (vol.version != 1) {
+    if (p < end) flags = *p++;
+    if (p < end && (flags & kFlagHasName)) {
+      uint8_t nl = *p++;
+      p += nl;
+    }
+    if (p < end && (flags & kFlagHasMime)) {
+      mime_len = *p++;
+      if (p + mime_len > end) return false;
+      mime = p;
+      p += mime_len;
+    }
+    if (p + 5 <= end && (flags & kFlagHasLastModified)) {
+      for (int i = 0; i < 5; i++)
+        last_modified = (last_modified << 8) | p[i];
+      p += 5;
+    }
+    if (p > end) return false;
+  }
+  if (flags & (kFlagHasTtl | kFlagHasPairs | kFlagCompressed))
+    return false;  // py semantics / AE negotiation: buffered path
+  uint32_t checksum = get_u32(tail + (tail_len - kChecksumSize));
+  std::string etag = "\"" + etag_hex(checksum) + "\"";
+  std::string extra = "ETag: " + etag + "\r\n";
+  if (last_modified)
+    extra += "Last-Modified: " + http_date(last_modified) + "\r\n";
+  std::string inm = req.header("if-none-match");
+  if (!inm.empty() && inm_matches(inm, etag)) {
+    respond(fd, req, 304, "text/plain", extra, nullptr, 0);
+    return true;
+  }
+  std::string ctype = mime_len
+                          ? std::string((const char*)mime, mime_len)
+                          : "application/octet-stream";
+  uint64_t start = 0, stop = (uint64_t)data_len;
+  int code = 200;
+  std::string rng = req.header("range");
+  if (!rng.empty()) {
+    uint64_t lo = 0, hi = 0;
+    bool has_hi = false;
+    // inverted/past-EOF spans redirect too: python's shared
+    // parse_range answers them with a spec-shaped 416
+    if (!parse_clean_range(rng, &lo, &hi, &has_hi) ||
+        lo >= (uint64_t)data_len || (has_hi && hi < lo)) {
+      redirect(fd, req, pl.redirect_port);
+      return true;
+    }
+    start = lo;
+    stop = has_hi ? hi + 1 : (uint64_t)data_len;
+    if (stop > (uint64_t)data_len) stop = (uint64_t)data_len;
+    extra += "Content-Range: bytes " + std::to_string(start) + "-" +
+             std::to_string(stop - 1) + "/" +
+             std::to_string(data_len) + "\r\n";
+    code = 206;
+  }
+  uint64_t body_len = stop > start ? stop - start : 0;
+  std::string head;
+  head.reserve(256 + extra.size());
+  head += "HTTP/1.1 ";
+  head += std::to_string(code);
+  head += ' ';
+  head += status_text(code);
+  head += "\r\nContent-Type: ";
+  head += ctype;
+  head += "\r\nContent-Length: ";
+  head += std::to_string(body_len);
+  head += "\r\n";
+  head += extra;
+  if (!req.keepalive) head += "Connection: close\r\n";
+  head += "\r\n";
+  send_all(fd, head.data(), head.size());
+  off_t off = (off_t)(base + data_off + (int64_t)start);
+  uint64_t remaining = body_len;
+  bool zero_copy = true;
+  while (remaining > 0) {
+    ssize_t s = sendfile(fd, ref->fd, &off, remaining);
+    if (s > 0) {
+      remaining -= (uint64_t)s;
+      continue;
+    }
+    if (s < 0 && errno == EINTR) continue;
+    if (s < 0 && (errno == EINVAL || errno == ENOSYS)) {
+      // fs without sendfile support: finish buffered — the status line
+      // is already on the wire, so this must complete the same body
+      zero_copy = false;
+      std::vector<uint8_t> buf(64 * 1024);
+      while (remaining > 0) {
+        ssize_t got = pread(
+            ref->fd, buf.data(),
+            remaining < buf.size() ? remaining : buf.size(), off);
+        if (got <= 0) break;
+        send_all(fd, buf.data(), (size_t)got);
+        off += got;
+        remaining -= (uint64_t)got;
+      }
+    }
+    break;  // client gone / hard error: Content-Length exposes the gap
+  }
+  if (remaining == 0 && zero_copy)
+    pl.sendfiles.fetch_add(1, std::memory_order_relaxed);
+  return true;
+}
 
 void handle_get(Plane& pl, int fd, const Request& req, uint32_t vid,
                 uint64_t key, uint32_t cookie) {
@@ -810,6 +1002,16 @@ void handle_get(Plane& pl, int fd, const Request& req, uint32_t vid,
     return respond(fd, req, 404, "text/plain", "", nullptr, 0);
   if (!ref || ref->fd < 0)
     return respond_json(fd, req, 500, "{\"error\":\"no dat file\"}");
+  // conditional-request conformance (ISSUE 9): If-None-Match both fast
+  // paths evaluate natively (weak list comparison); every OTHER
+  // validator header (If-Range, If-(Un)Modified-Since, If-Match) is
+  // decided in exactly one place — the python handler
+  if (!req.header("if-range").empty() ||
+      !req.header("if-modified-since").empty() ||
+      !req.header("if-match").empty() ||
+      !req.header("if-unmodified-since").empty())
+    return redirect(fd, req, pl.redirect_port);
+  if (try_sendfile_get(pl, fd, req, *vol, nv, ref, cookie)) return;
   int64_t total = actual_size(nv.size, vol->version);
   std::vector<uint8_t> blob(total);
   int64_t got = pread(ref->fd, blob.data(), total,
@@ -832,7 +1034,7 @@ void handle_get(Plane& pl, int fd, const Request& req, uint32_t vid,
   std::string extra = "ETag: " + etag + "\r\n";
   if (n.last_modified)
     extra += "Last-Modified: " + http_date(n.last_modified) + "\r\n";
-  if (!inm.empty() && inm == etag)
+  if (!inm.empty() && inm_matches(inm, etag))
     return respond(fd, req, 304, "text/plain", extra, nullptr, 0);
   std::string ctype = n.mime_len
                           ? std::string((const char*)n.mime, n.mime_len)
@@ -852,17 +1054,17 @@ void handle_get(Plane& pl, int fd, const Request& req, uint32_t vid,
     uint64_t start = 0, hi = 0;
     bool has_hi = false;
     bool clean = parse_clean_range(rng, &start, &hi, &has_hi);
-    if (!clean || start >= n.data_len)
+    // inverted spans redirect like suffix/past-EOF ones: python's
+    // shared parse_range answers them with a spec-shaped 416
+    if (!clean || start >= n.data_len || (has_hi && hi < start))
       return redirect(fd, req, pl.redirect_port);
     uint64_t stop = has_hi ? hi + 1 : n.data_len;
     if (stop > n.data_len) stop = n.data_len;
-    // inverted ranges keep the raw start in Content-Range and serve an
-    // empty body, byte-for-byte like volume.py's data[start:stop]
-    uint64_t body_len = stop > start ? stop - start : 0;
     extra += "Content-Range: bytes " + std::to_string(start) + "-" +
-             std::to_string(stop ? stop - 1 : 0) + "/" +
+             std::to_string(stop - 1) + "/" +
              std::to_string(n.data_len) + "\r\n";
-    return respond(fd, req, 206, ctype, extra, n.data + start, body_len);
+    return respond(fd, req, 206, ctype, extra, n.data + start,
+                   stop - start);
   }
   respond(fd, req, 200, ctype, extra, n.data, n.data_len);
 }
@@ -1283,6 +1485,13 @@ void handle_filer_put(FilerPlane& fp, int fd, const Request& req,
                       const std::string& path) {
   if (!req.query.empty() || req.body.size() > fp.max_body)
     return fp.redirects++, redirect(fd, req, fp.redirect_port);
+  // the caller wants the whole-body md5 recorded as the entity-tag
+  // (the S3 gateway's ETag contract) — only the python PUT path
+  // computes it, so the absorbed entry would serve a different ETag
+  // than the PUT returned
+  if (!req.header("x-swfs-want-md5").empty() ||
+      !req.header("content-md5").empty())
+    return fp.redirects++, redirect(fd, req, fp.redirect_port);
   std::string ct = req.header("content-type");
   if (ct.size() >= 256 || !req.header("content-encoding").empty())
     return fp.redirects++, redirect(fd, req, fp.redirect_port);
@@ -1417,7 +1626,13 @@ void handle_filer_put(FilerPlane& fp, int fd, const Request& req,
 
 void handle_filer_get(FilerPlane& fp, int fd, const Request& req,
                       const std::string& path) {
-  if (!req.query.empty() || !req.header("if-modified-since").empty())
+  // every validator except If-None-Match defers to python (the volume
+  // plane's one-decision-point rule): If-Range especially — serving a
+  // 206 against a stale validator would let a client splice new bytes
+  // onto an old partial download
+  if (!req.query.empty() || !req.header("if-modified-since").empty() ||
+      !req.header("if-range").empty() || !req.header("if-match").empty() ||
+      !req.header("if-unmodified-since").empty())
     return fp.redirects++, redirect(fd, req, fp.redirect_port);
   HotEntry e;
   {
@@ -1458,7 +1673,7 @@ void handle_filer_get(FilerPlane& fp, int fd, const Request& req,
   extra += "Last-Modified: " + http_date(e.mtime_ns / 1000000000ull) +
            "\r\n";
   std::string inm = req.header("if-none-match");
-  if (!inm.empty() && inm == etag) {
+  if (!inm.empty() && inm_matches(inm, etag)) {
     fp.native_gets++;
     return respond(fd, req, 304, "text/plain", extra, nullptr, 0);
   }
@@ -1911,6 +2126,21 @@ extern "C" int64_t swdp_bench(const char* host, int port, int is_put,
 uint64_t swdp_request_count(int plane_id) {
   auto pl = plane_of(plane_id);
   return pl ? pl->requests.load() : 0;
+}
+
+// GETs served zero-copy via sendfile(2) since plane start (ISSUE 9).
+uint64_t swdp_sendfile_count(int plane_id) {
+  auto pl = plane_of(plane_id);
+  return pl ? pl->sendfiles.load() : 0;
+}
+
+// Minimum body size for the sendfile path; -1 disables it (the A/B OFF
+// arm / SWFS_ZEROCOPY=0). Returns 0 on success.
+int swdp_set_zerocopy_min(int plane_id, int64_t min_bytes) {
+  auto pl = plane_of(plane_id);
+  if (!pl) return -1;
+  pl->zerocopy_min.store(min_bytes);
+  return 0;
 }
 
 // ------------------------------------------------- filer hot plane ABI --

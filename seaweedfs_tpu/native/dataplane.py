@@ -72,6 +72,11 @@ def load_library() -> ctypes.CDLL:
         lib.swdp_volume_stats.restype = ctypes.c_int
         lib.swdp_request_count.argtypes = [ctypes.c_int]
         lib.swdp_request_count.restype = ctypes.c_uint64
+        lib.swdp_sendfile_count.argtypes = [ctypes.c_int]
+        lib.swdp_sendfile_count.restype = ctypes.c_uint64
+        lib.swdp_set_zerocopy_min.argtypes = [ctypes.c_int,
+                                              ctypes.c_int64]
+        lib.swdp_set_zerocopy_min.restype = ctypes.c_int
         lib.swdp_bench.argtypes = [ctypes.c_char_p, ctypes.c_int,
                                    ctypes.c_int,
                                    ctypes.POINTER(ctypes.c_char_p),
@@ -146,6 +151,15 @@ class NativeDataPlane:
         if self.plane_id <= 0:
             raise OSError(
                 f"native data plane failed to start: {self.plane_id}")
+        # zero-copy serving gate (ISSUE 9): SWFS_ZEROCOPY=0 disables the
+        # sendfile path (A/B OFF arm); any other integer is the minimum
+        # body size that rides it (default 4096 — below that, one pread
+        # beats two preads + sendfile)
+        zc = os.environ.get("SWFS_ZEROCOPY", "")
+        if zc.lower() in ("0", "false", "off"):
+            self.lib.swdp_set_zerocopy_min(self.plane_id, -1)
+        elif zc.isdigit() and int(zc) > 1:
+            self.lib.swdp_set_zerocopy_min(self.plane_id, int(zc))
 
     def stop(self) -> None:
         if self.plane_id > 0:
@@ -226,6 +240,14 @@ class NativeDataPlane:
 
     def request_count(self) -> int:
         return int(self.lib.swdp_request_count(self.plane_id))
+
+    def sendfile_count(self) -> int:
+        """GETs served zero-copy via sendfile(2) since plane start."""
+        return int(self.lib.swdp_sendfile_count(self.plane_id))
+
+    def set_zerocopy_min(self, min_bytes: int) -> None:
+        """Minimum body size for the sendfile path; -1 disables it."""
+        self.lib.swdp_set_zerocopy_min(self.plane_id, min_bytes)
 
 
 class NativeFilerPlane:

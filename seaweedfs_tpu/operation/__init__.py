@@ -37,6 +37,11 @@ def thread_session() -> requests.Session:
     if s is None:
         s = _tl.session = requests.Session()
         s.trust_env = False  # skip per-request proxy-env scans
+    # refreshed per call: under SWFS_HTTPS every internal leg verifies
+    # the cluster CA (or skips verification on self-signed dev setups)
+    from ..utils.http import requests_verify
+
+    s.verify = requests_verify()
     return s
 
 COMPRESS_MIN = 128  # don't bother gzipping tiny payloads
@@ -197,9 +202,11 @@ def upload_data(url: str, data: bytes, *, filename: str = "",
                 compress: bool = True, retries: int = 3,
                 auth: str = "", session=None) -> UploadResult:
     """PUT needle bytes to a volume server (UploadData w/ retry,
-    upload_content.go:85,134). Pass a requests.Session to reuse keepalive
-    connections on hot paths (one session per thread — Session is not
-    safe for concurrent use)."""
+    upload_content.go:85,134). Rides the wdclient keep-alive pool
+    (ISSUE 9) so the filer-autochunker/replication upload legs reuse
+    connections — and, under SWFS_HTTPS, amortize TLS handshakes —
+    instead of dialing per chunk. Pass a requests.Session to pin a
+    specific keepalive session instead (legacy callers)."""
     headers = trace.inject_headers(
         {"Content-Type": mime or "application/octet-stream"})
     if auth:
@@ -213,24 +220,36 @@ def upload_data(url: str, data: bytes, *, filename: str = "",
     if ttl:
         url += ("&" if "?" in url else "?") + f"ttl={ttl}"
     last: Exception | None = None
-    http = session or thread_session()
     bo = Backoff(wait_init=0.1)
     for attempt in range(retries):
         try:
             with trace.span("client.upload", child_only=True,
                             bytes=len(body)), \
                     CLIENT_UPLOAD_SECONDS.time():
-                r = http.put(url, data=body, headers=headers, timeout=60)
-            if r.status_code < 300:
-                j = r.json()
+                if session is not None:
+                    rr = session.put(url, data=body, headers=headers,
+                                     timeout=60)
+                    status, text, jload = rr.status_code, rr.text, rr.json
+                else:
+                    from ..wdclient import pool
+
+                    rr = pool.put(url, body=body, headers=headers,
+                                  timeout=60)
+                    status, text, jload = rr.status, rr.text, rr.json
+            if status < 300:
+                j = jload()
                 return UploadResult(name=j.get("name", filename),
                                     size=j.get("size", len(data)),
                                     etag=j.get("eTag", ""))
-            last = IOError(f"{r.status_code}: {r.text[:200]}")
-            if r.status_code < 500:
+            last = IOError(f"{status}: {text[:200]}")
+            if status < 500:
                 break  # 4xx (bad request, auth) won't improve on retry
-        except requests.RequestException as e:
+        except (OSError, requests.RequestException) as e:
             last = e
+            from ..utils.retry import is_retryable
+
+            if not is_retryable(e):
+                break  # e.g. a certificate rejection: fail fast
         if attempt < retries - 1:
             bo.sleep()
     return UploadResult(error=str(last))
@@ -308,7 +327,9 @@ def submit(master: str, data: bytes, *, filename: str = "",
     a = assign(master, collection=collection, replication=replication, ttl=ttl)
     if a.error:
         return {"error": a.error}
-    r = upload_data(f"http://{a.url}/{a.fid}", data, filename=filename,
+    from ..utils.http import url_for
+
+    r = upload_data(url_for(a.url, a.fid), data, filename=filename,
                     mime=mime, ttl=ttl, auth=a.auth)
     if r.error:
         return {"error": r.error}

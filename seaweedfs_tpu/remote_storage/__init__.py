@@ -510,8 +510,10 @@ class RemoteGateway:
             raise KeyError(f"{path} is not a remote entry")
         client, rpath = self._remote_location(path)
         data = client.read_file(rpath)
-        r = requests.put(f"http://{self.filer}{path}", data=data,
-                         timeout=300)
+        from ..utils.http import requests_verify, url_for
+
+        r = requests.put(url_for(self.filer, path), data=data,
+                         timeout=300, verify=requests_verify())
         if r.status_code >= 300:
             raise IOError(f"cache PUT {path}: {r.status_code}")
         # re-attach the remote marker lost by the overwrite

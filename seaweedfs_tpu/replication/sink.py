@@ -17,6 +17,8 @@ import requests
 
 from ..pb import filer_pb2, rpc
 from ..utils import failpoint
+from ..utils.http import url_for
+from ..wdclient import pool
 
 
 class SinkUnavailable(IOError):
@@ -75,16 +77,30 @@ class FilerSink(ReplicationSink):
                 directory=target.rsplit("/", 1)[0] or "/", entry=e,
                 is_from_other_cluster=True), timeout=30)
             return
-        r = requests.put(
-            f"http://{self.filer}{target}", data=data or b"",
-            headers={"Content-Type": entry.attributes.mime or
-                     "application/octet-stream",
-                     # loop-prevention: target filer marks the event so a
-                     # reverse sync loop skips it (filer_sync.go signatures)
-                     "X-From-Other-Cluster": "1"}, timeout=300)
-        if r.status_code >= 300:
-            cls = SinkUnavailable if r.status_code >= 500 else IOError
-            raise cls(f"filer sink PUT {target}: {r.status_code}")
+        try:
+            # pooled keep-alive leg (ISSUE 9): a sync run applies many
+            # entries against one target filer
+            r = pool.put(
+                url_for(self.filer, target), body=data or b"",
+                headers={"Content-Type": entry.attributes.mime or
+                         "application/octet-stream",
+                         # loop-prevention: target filer marks the event
+                         # so a reverse sync loop skips it
+                         # (filer_sync.go signatures)
+                         "X-From-Other-Cluster": "1"}, timeout=300)
+        except OSError as e:
+            from ..utils.retry import _ssl_error_of, ssl_error_is_retryable
+
+            sslerr = _ssl_error_of(e)
+            if sslerr is not None and not ssl_error_is_retryable(sslerr):
+                # a certificate rejection is a trust decision — wrapping
+                # it as SinkUnavailable would force-retry what the ssl
+                # classification fails fast everywhere else
+                raise
+            raise SinkUnavailable(f"filer sink PUT {target}: {e}") from e
+        if r.status >= 300:
+            cls = SinkUnavailable if r.status >= 500 else IOError
+            raise cls(f"filer sink PUT {target}: {r.status}")
 
     def delete_entry(self, path, is_directory):
         self._chaos("delete", path)

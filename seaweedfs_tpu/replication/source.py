@@ -6,9 +6,9 @@ LookupFileId via the source filer, then HTTP GET from its volume servers.
 
 from __future__ import annotations
 
-import requests
-
 from ..pb import filer_pb2, rpc
+from ..utils.http import url_for
+from ..wdclient import pool
 
 
 class FilerSource:
@@ -26,17 +26,32 @@ class FilerSource:
         locs = resp.locations_map.get(vid)
         if locs is None or not locs.locations:
             raise LookupError(f"no locations for volume {vid}")
-        return [f"http://{l.url}/{file_id}" for l in locs.locations]
+        return [url_for(l.url, file_id) for l in locs.locations]
 
     def read_chunk(self, file_id: str) -> bytes:
         last: Exception | None = None
         for url in self.lookup_urls(file_id):
             try:
-                r = requests.get(url, timeout=60)
-                if r.status_code == 200:
-                    return r.content
-                last = IOError(f"{url}: {r.status_code}")
-            except requests.RequestException as e:
+                # pooled keep-alive leg (ISSUE 9): a sync run reads many
+                # chunks from few volume servers — one warm connection
+                # each instead of a dial per chunk
+                r = pool.get(url, timeout=60)
+                if r.status == 200:
+                    return r.data
+                last = IOError(f"{url}: {r.status}")
+            except OSError as e:
+                from ..utils.retry import (
+                    _ssl_error_of,
+                    ssl_error_is_retryable,
+                )
+
+                sslerr = _ssl_error_of(e)
+                if sslerr is not None \
+                        and not ssl_error_is_retryable(sslerr):
+                    # a certificate rejection is a trust decision, not a
+                    # down replica — don't walk the rest of the same
+                    # misconfigured cluster (the filer read ladder's rule)
+                    raise
                 last = e
         raise IOError(f"read {file_id}: {last}")
 

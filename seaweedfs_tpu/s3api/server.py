@@ -28,6 +28,7 @@ import grpc
 
 from ..pb import filer_pb2, rpc
 from ..utils import glog, trace
+from ..utils.http import url_for
 from ..utils.stats import (
     S3_REQUEST_HISTOGRAM,
     gather,
@@ -85,8 +86,13 @@ class S3Server:
 
     def start(self) -> None:
         trace.set_identity("s3", self.address)
+        # HTTPS public ingress (ISSUE 9): same gate as the volume/filer
+        # planes, so SWFS_HTTPS moves all four harness shapes onto TLS
+        from ..security.tls import load_http_server_context
+
+        https_ctx = load_http_server_context("s3")
         self._http_server = TunedThreadingHTTPServer(
-            ("", self.port), _make_handler(self))
+            ("", self.port), _make_handler(self), ssl_context=https_ctx)
         threading.Thread(target=self._http_server.serve_forever,
                          daemon=True).start()
         # control plane (s3.proto SeaweedS3.Configure; s3api_server.go
@@ -187,28 +193,35 @@ class S3Server:
                    content_type: str = "") -> str:
         """-> etag. `body` is bytes or a chunk iterator; either way the
         bytes stream straight through the filer HTTP autochunker."""
-        url = (f"http://{self.filer}{BUCKETS_DIR}/{bucket}/"
+        url = (url_for(self.filer, f"{BUCKETS_DIR}/{bucket}/")
                + urllib.parse.quote(key))
         md5 = hashlib.md5()
         if isinstance(body, (bytes, bytearray)):
             md5.update(body)
             data = body
         else:
-            def _tee():
-                for piece in body:
-                    md5.update(piece)
-                    yield piece
-
-            data = _tee()
-        r = _session().put(
-            url, data=data,
-            headers=trace.inject_headers(
-                {"Content-Type":
-                 content_type or "application/octet-stream",
-                 # tenant budget already charged at the S3 ingress —
-                 # the filer must not bill this internal leg twice
-                 "X-Swfs-Qos-Charged": "1"}),
-            timeout=600)
+            # spooled (mem <= 8MB, disk beyond), never a raw generator:
+            # the native filer hot plane 307s md5-wanting PUTs to the
+            # python listener and requests can only replay a SEEKABLE
+            # body across that redirect
+            data = _spool(body, md5)
+        try:
+            r = _session().put(
+                url, data=data,
+                headers=trace.inject_headers(
+                    {"Content-Type":
+                     content_type or "application/octet-stream",
+                     # tenant budget already charged at the S3 ingress —
+                     # the filer must not bill this internal leg twice
+                     "X-Swfs-Qos-Charged": "1",
+                     # the S3 ETag contract is the whole-body md5: only
+                     # the python PUT path records it (the C++ hot plane
+                     # defers these), so PUT/GET/HEAD/If-None-Match agree
+                     "X-Swfs-Want-Md5": "1"}),
+                timeout=600)
+        finally:
+            if hasattr(data, "close"):
+                data.close()  # reclaim a disk-rolled spool promptly
         if r.status_code in (429, 503):
             # the backend throttled anyway (direct-traffic budget,
             # pressure shed): surface it as throttling, not a bug
@@ -218,14 +231,23 @@ class S3Server:
         return md5.hexdigest()
 
     def get_object(self, bucket: str, key: str, range_header: str = "",
-                   stream: bool = False):
-        url = (f"http://{self.filer}{BUCKETS_DIR}/{bucket}/"
+                   stream: bool = False,
+                   conditional: dict | None = None):
+        """`conditional` forwards the caller's validator headers
+        (If-None-Match / If-Modified-Since / If-Range) to the filer,
+        whose RFC 7232/7233 evaluation (utils.http) then answers the
+        S3 conditional GET — a 304 passes back through untouched
+        (ISSUE 9 conformance satellite)."""
+        url = (url_for(self.filer, f"{BUCKETS_DIR}/{bucket}/")
                + urllib.parse.quote(key))
         headers = trace.inject_headers(
             {**({"Range": range_header} if range_header else {}),
+             **(conditional or {}),
              "X-Swfs-Qos-Charged": "1"})
         r = _session().get(url, headers=headers, timeout=600,
                               stream=stream)
+        if r.status_code == 304:
+            return r
         if r.status_code == 404:
             r.close()
             raise S3Error(404, "NoSuchKey", "The specified key does not exist.")
@@ -249,6 +271,28 @@ class S3Server:
 
 
 # -- XML helpers -----------------------------------------------------------
+
+def _spool(chunks, md5):
+    """Drain a chunk iterator into a rewindable file (memory up to 8MB,
+    disk beyond), updating `md5` along the way. The filer PUT legs need
+    a SEEKABLE body: the native hot plane 307s md5-wanting (and
+    over-max-body) PUTs to the python listener, and requests can only
+    replay a body across that redirect if it can seek back to 0."""
+    import tempfile
+
+    spool = tempfile.SpooledTemporaryFile(max_size=8 << 20)
+    total = 0
+    for piece in chunks:
+        md5.update(piece)
+        spool.write(piece)
+        total += len(piece)
+    spool.seek(0)
+    # requests' super_len() consults this BEFORE fileno() — without it,
+    # fileno() forces the spool to roll over to disk for every body,
+    # making the in-memory tier dead weight
+    spool.len = total
+    return spool
+
 
 def _el(parent, tag, text=None):
     e = ET.SubElement(parent, tag)
@@ -551,11 +595,14 @@ def _make_handler(srv: S3Server):
                 if not self._admin_plane_ok(admin_u):
                     return self._send(403, b'{"error": "AccessDenied"}',
                                       "application/json")
-                from ..utils.stats import qos_stats
+                from ..utils.stats import http_pool_stats, qos_stats
 
                 body = json.dumps({
                     **status_base(srv._started_at),
                     "Filer": srv.filer,
+                    # TLS handshakes accepted on the public ingress +
+                    # this process's pooled client legs (ISSUE 9)
+                    "HttpPool": http_pool_stats(),
                     "Trace": trace.STORE.stats(),
                     # QoS plane (ISSUE 8): tenant buckets + rejections
                     "Qos": {
@@ -940,13 +987,22 @@ def _make_handler(srv: S3Server):
                             "%a, %d %b %Y %H:%M:%S GMT",
                             time.gmtime(entry.attributes.mtime)),
                     })
+                conditional = {
+                    h: self.headers[h]
+                    for h in ("If-None-Match", "If-Modified-Since",
+                              "If-Range")
+                    if self.headers.get(h) is not None}
                 r = srv.get_object(bucket, key,
                                    self.headers.get("Range", ""),
-                                   stream=True)
+                                   stream=True,
+                                   conditional=conditional or None)
                 headers = {}
                 for h in ("Content-Range", "ETag", "Last-Modified"):
                     if h in r.headers:
                         headers[h] = r.headers[h]
+                if r.status_code == 304:
+                    r.close()
+                    return self._send(304, headers=headers)
                 # pass the filer's stream straight through: gateway memory
                 # stays one chunk deep for any object size
                 try:
@@ -1059,9 +1115,10 @@ def _make_handler(srv: S3Server):
             if srv.find_entry(UPLOADS_DIR, upload_id) is None:
                 raise S3Error(404, "NoSuchUpload", "upload not found")
             body = self._body()
-            url = (f"http://{srv.filer}{UPLOADS_DIR}/{upload_id}/"
-                   f"{part_number:04d}.part")
-            r = _session().put(url, data=body, timeout=600)
+            url = url_for(srv.filer, f"{UPLOADS_DIR}/{upload_id}/"
+                          f"{part_number:04d}.part")
+            r = _session().put(url, data=body, timeout=600,
+                               headers={"X-Swfs-Want-Md5": "1"})
             if r.status_code >= 300:
                 raise S3Error(500, "InternalError", "part upload failed")
             self._send(200, headers={
@@ -1101,16 +1158,15 @@ def _make_handler(srv: S3Server):
                 range_header = f"bytes={start}-{stop - 1}"
             r = srv.get_object(sbucket, skey, range_header=range_header,
                                stream=True)
-            url = (f"http://{srv.filer}{UPLOADS_DIR}/{upload_id}/"
-                   f"{part_number:04d}.part")
+            url = url_for(srv.filer, f"{UPLOADS_DIR}/{upload_id}/"
+                          f"{part_number:04d}.part")
             md5 = hashlib.md5()
-
-            def _tee():
-                for piece in r.iter_content(1 << 20):
-                    md5.update(piece)
-                    yield piece
-
-            pr = _session().put(url, data=_tee(), timeout=600)
+            spool = _spool(r.iter_content(1 << 20), md5)
+            try:
+                pr = _session().put(url, data=spool, timeout=600,
+                                    headers={"X-Swfs-Want-Md5": "1"})
+            finally:
+                spool.close()
             if pr.status_code >= 300:
                 raise S3Error(500, "InternalError", "part copy failed")
             root = ET.Element("CopyPartResult", xmlns=S3_NS)

@@ -28,11 +28,11 @@ from ..filer import Attr, Entry, Filer
 from ..filer.filechunks import etag as chunks_etag, total_size, view_from_chunks
 from ..filer.filer import NotEmpty, NotFound, normalize
 from ..filer.filerstore import RetryingStore, get_store
-from ..operation import assign, delete_files, thread_session, upload_data
+from ..operation import assign, delete_files, upload_data
 from ..pb import filer_pb2, master_pb2, rpc
 from ..utils import glog, trace
 from ..utils.chunk_cache import TieredChunkCache
-from ..utils.http import not_modified
+from ..utils.http import not_modified, parse_range, range_applies, url_for
 from ..utils.stats import (
     FILER_CHUNK_CACHE_COUNTER,
     FILER_REQUEST_HISTOGRAM,
@@ -148,8 +148,6 @@ class FilerServer:
             self.chunk_cache = None
         self._http_server = None
         self._grpc_server = None
-        # per-thread keepalive sessions: handler threads must not share
-        # one Session (operation.thread_session docstring)
         # multi-filer peer aggregation (meta_aggregator.go)
         self.meta_aggregator = None
         self._peers = [p for p in (peers or []) if p]
@@ -245,7 +243,13 @@ class FilerServer:
                        "filer", creds=creds)
         self._grpc_server.start()
         http_port = self.port
-        if self._vol_plane is not None:
+        # HTTPS (ISSUE 9): the C++ hot plane speaks plain HTTP only — with
+        # TLS configured the python listener owns the encrypted public
+        # port and whole-object serving uses the buffered path
+        from ..security.tls import load_http_server_context
+
+        https_ctx = load_http_server_context("filer")
+        if self._vol_plane is not None and https_ctx is None:
             try:
                 http_port = self._start_hot_plane()
             except Exception as e:
@@ -256,12 +260,14 @@ class FilerServer:
             # know the REAL admin port before the C++ plane learns its
             # redirect target); this path is hot-plane-off / fallback
             self._http_server = TunedThreadingHTTPServer(
-                ("", http_port), _make_http_handler(self))
+                ("", http_port), _make_http_handler(self),
+                ssl_context=https_ctx)
         threading.Thread(target=self._http_server.serve_forever,
                          daemon=True).start()
         self._start_aggregator()
         self._start_announce()
         glog.info(f"filer started on {self.address} (grpc :{self.grpc_port})"
+                  + (" (https)" if https_ctx is not None else "")
                   + (f" (native hot plane, admin :{self.admin_port})"
                      if self.hot_plane else ""))
 
@@ -498,7 +504,7 @@ class FilerServer:
                                       replication=self.replication, ttl=ttl)
             if a.error:
                 raise IOError(f"assign: {a.error}")
-            r = upload_data(f"http://{a.url}/{a.fid}", data, ttl=ttl,
+            r = upload_data(url_for(a.url, a.fid), data, ttl=ttl,
                             auth=a.auth)
             if not r.error:
                 break
@@ -609,7 +615,7 @@ class FilerServer:
         stream.go:69) — a multi-GB file never materializes in filer RAM."""
         if entry.content:
             end = len(entry.content) if size is None else offset + size
-            yield bytes(entry.content[offset:end])
+            yield memoryview(entry.content)[offset:end]
             return
         from ..remote_storage import REMOTE_ENTRY_KEY
 
@@ -661,8 +667,13 @@ class FilerServer:
                     len(cached) >= view.chunk_offset + view.size:
                 FILER_CHUNK_CACHE_COUNTER.inc(result="hit")
                 tsp.set_attr(cache="hit")
-                return bytes(cached[view.chunk_offset:
-                                    view.chunk_offset + view.size])
+                # zero-copy hot path (ISSUE 9): a memoryview SLICE of
+                # the immutable cached bytes — the payload is never
+                # copied between the cache and the response socket
+                # (eviction only drops the dict reference; the view
+                # keeps the buffer alive)
+                return memoryview(cached)[view.chunk_offset:
+                                          view.chunk_offset + view.size]
             FILER_CHUNK_CACHE_COUNTER.inc(result="miss")
             tsp.set_attr(cache="miss")
         headers = {"Range": f"bytes={view.chunk_offset}-"
@@ -685,16 +696,24 @@ class FilerServer:
             was ONLY definitive 404s means the needle is absent, not
             that replicas are down — distinguishing the two keeps a
             deleted-file poll from escalating into master re-lookups
-            and EC sweeps on every read."""
+            and EC sweeps on every read.
+
+            The volume fetch rides the wdclient keep-alive pool
+            (ISSUE 9): no per-chunk TCP/TLS setup on the filer→volume
+            leg. Pool/transport failures are OSErrors classified by
+            utils.retry exactly like the requests paths — including
+            fail-fast certificate rejections under SWFS_HTTPS."""
             nonlocal last_err
+            from ..utils.retry import _ssl_error_of, ssl_error_is_retryable
+            from ..wdclient import pool
+
             all_notfound = bool(urls)
             for url in urls:
                 try:
-                    r = thread_session().get(url, timeout=60,
-                                             headers=headers)
-                    if r.status_code in (200, 206):
-                        data = r.content
-                        if r.status_code == 200 and not view.is_full_chunk:
+                    r = pool.get(url, timeout=60, headers=headers)
+                    if r.status in (200, 206):
+                        data = r.data
+                        if r.status == 200 and not view.is_full_chunk:
                             data = data[view.chunk_offset:
                                         view.chunk_offset + view.size]
                         if len(data) == view.size:
@@ -707,14 +726,21 @@ class FilerServer:
                         last_err = IOError(
                             f"{url}: wrong chunk size "
                             f"{len(data)} != {view.size}")
-                    elif r.status_code == 404:
+                    elif r.status == 404:
                         last_err = IOError(f"{url}: 404")
                     else:
                         all_notfound = False
-                        last_err = IOError(f"{url}: {r.status_code}")
-                except rq.RequestException as e:
+                        last_err = IOError(f"{url}: {r.status}")
+                except (OSError, rq.RequestException) as e:
                     all_notfound = False
                     last_err = e
+                    sslerr = _ssl_error_of(e)
+                    if sslerr is not None \
+                            and not ssl_error_is_retryable(sslerr):
+                        # a certificate rejection is a trust decision,
+                        # not a down replica: walking more replicas of
+                        # the same misconfigured cluster hides it
+                        raise
             return None, all_notfound
 
         notfound = False
@@ -829,24 +855,9 @@ class _ChunkedReader:
         return bytes(out)
 
 
-def _parse_range(rng_h: str, size: int):
-    """'bytes=a-b' -> clamped (start, stop) half-open span; 'bytes=-N' is a
-    suffix range; unsatisfiable -> "invalid" (416); malformed -> None
-    (serve the full body, like Go's http.ServeContent leniency)."""
-    lo, _, hi = rng_h[len("bytes="):].partition("-")
-    try:
-        if lo == "" and hi:  # suffix: last N bytes
-            n = int(hi)
-            if n <= 0:
-                return "invalid"
-            return max(0, size - n), size
-        start = int(lo)
-        stop = int(hi) + 1 if hi else size
-    except ValueError:
-        return None
-    if start >= size or stop <= start:
-        return "invalid"
-    return start, min(stop, size)
+# RFC 7233 span parsing now lives in utils.http (ISSUE 9: the volume
+# handler shares it so both planes answer ranges identically)
+_parse_range = parse_range
 
 
 def _ttl_seconds(ttl: str) -> int:
@@ -1203,12 +1214,15 @@ def _make_http_handler(srv: FilerServer):
             if path == "/healthz":
                 return self._json({"ok": True})
             if path == "/status":
-                from ..utils.stats import qos_stats
+                from ..utils.stats import http_pool_stats, qos_stats
 
                 hot = srv.hot_plane.stats() if srv.hot_plane else None
                 return self._json({
                     **status_base(srv._started_at),
                     "Version": "seaweedfs-tpu",
+                    # filer→volume keep-alive pool economics (ISSUE 9):
+                    # hit rate + client TLS handshake amortization
+                    "HttpPool": http_pool_stats(),
                     "ChunkCache": chunk_cache_stats(),
                     "ChunkCacheEnabled": srv.chunk_cache is not None,
                     "FidLease": {
@@ -1299,7 +1313,13 @@ def _make_http_handler(srv: FilerServer):
                         "Path": path, "Entries": entries,
                         "ShouldDisplayLoadMore": len(entries) >= limit,
                     })
-                etag = f'"{chunks_etag(entry.chunks)}"'
+                # the stored whole-body md5 is THE entity-tag when the
+                # upload recorded one (it is what S3 PUT/HEAD advertise
+                # and what Content-MD5 carries — a client revalidating
+                # with its PUT-returned ETag must get the 304); chunk-
+                # combined CRC etags cover md5-less gRPC-created entries
+                etag = f'"{entry.attr.md5.hex()}"' if entry.attr.md5 \
+                    else f'"{chunks_etag(entry.chunks)}"'
                 headers = {"ETag": etag}
                 if entry.attr.mtime:
                     headers["Last-Modified"] = time.strftime(
@@ -1307,12 +1327,26 @@ def _make_http_handler(srv: FilerServer):
                         time.gmtime(entry.attr.mtime))
                 # conditional GETs before Range (filer_server_handlers_read
                 # .go:65-80); RFC 7232 §3.3: If-Modified-Since is consulted
-                # only when no If-None-Match was sent
+                # only when no If-None-Match was sent — and If-None-Match
+                # is a weak-compared entity-tag LIST (utils.http)
                 if not_modified(self.headers, etag, entry.attr.mtime):
+                    from ..utils.stats import HTTP_CONDITIONAL_OPS
+
+                    HTTP_CONDITIONAL_OPS.inc(plane="filer", result="304")
                     return self._reply(304, b"", headers=headers)
                 rng_h = self.headers.get("Range")
                 size = entry.size()
                 ctype = entry.attr.mime or "application/octet-stream"
+                if rng_h and not range_applies(self.headers, etag,
+                                               entry.attr.mtime):
+                    # If-Range with a stale validator (RFC 7233 §3.2):
+                    # the Range header is IGNORED, the full current
+                    # representation is served
+                    from ..utils.stats import HTTP_CONDITIONAL_OPS
+
+                    HTTP_CONDITIONAL_OPS.inc(plane="filer",
+                                             result="if_range_stale")
+                    rng_h = None
                 if rng_h and rng_h.startswith("bytes="):
                     span = _parse_range(rng_h, size)
                     if span == "invalid":

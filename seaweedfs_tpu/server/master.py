@@ -170,8 +170,15 @@ class MasterServer:
         rpc.serve_port(self._grpc_server, f"[::]:{self.grpc_port}",
                        "master", creds=creds)
         self._grpc_server.start()
+        # HTTPS (ISSUE 9): the master's HTTP plane (assign/lookup/
+        # status/debug) rides the same gate as the data planes — one
+        # SWFS_HTTPS switch moves the whole fleet, and harness /status
+        # probes keep working under --https
+        from ..security.tls import load_http_server_context
+
         self._http_server = TunedThreadingHTTPServer(
-            ("", self.port), _make_http_handler(self)
+            ("", self.port), _make_http_handler(self),
+            ssl_context=load_http_server_context("master")
         )
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
         self._vacuum_thread = threading.Thread(
@@ -856,10 +863,12 @@ def _make_http_handler(ms: MasterServer):
                 if not ms.is_leader() and ms.leader_address() != ms.address:
                     import requests as _rq
 
+                    from ..utils.http import requests_verify, url_for
+
                     try:
                         r = _rq.get(
-                            f"http://{ms.leader_address()}{self.path}",
-                            timeout=10)
+                            url_for(ms.leader_address(), self.path),
+                            timeout=10, verify=requests_verify())
                         return self._json(r.json(), r.status_code)
                     except _rq.RequestException:
                         pass  # fall through to local (stale) view

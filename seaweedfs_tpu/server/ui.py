@@ -13,6 +13,8 @@ from __future__ import annotations
 import html
 import time
 
+from ..utils.http import url_for
+
 _STYLE = """
 body{font-family:system-ui,sans-serif;margin:2em;color:#222}
 h1{font-size:1.4em} h2{font-size:1.1em;margin-top:1.4em}
@@ -77,7 +79,7 @@ def master_ui(ms) -> bytes:
     for dn in sorted(ms.topo.nodes.values(), key=lambda n: n.url):
         ec = sum(bin(e.bits).count("1") for e in dn.ec_shards.values())
         rows.append([dn.data_center, dn.rack,
-                     link(f"http://{dn.url}/ui", dn.url),
+                     link(url_for(dn.url, "/ui"), dn.url),
                      len(dn.volumes), dn.max_volume_count, ec])
     body += "<h2>Topology</h2>" + table(
         ["DataCenter", "Rack", "Node", "Volumes", "Max", "EC shards"], rows)

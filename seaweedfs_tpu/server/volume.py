@@ -50,7 +50,7 @@ from ..storage.needle import CrcError, Needle
 from ..storage.store import Store
 from ..storage.ttl import TTL
 from ..utils import failpoint, glog, trace
-from ..utils.http import not_modified
+from ..utils.http import not_modified, parse_range, range_applies, url_for
 from ..utils.stats import (
     VOLUME_SERVER_EC_ENCODE_BYTES,
     VOLUME_SERVER_NATIVE_REQUESTS,
@@ -119,9 +119,15 @@ class VolumeServer:
             native = False
         # the C++ plane speaks 16-byte idx entries only; in large-disk
         # (5-byte offset) mode it could never serve a volume, so don't
-        # bind it at all — clients keep the direct python port
+        # bind it at all — clients keep the direct python port. Under
+        # SWFS_HTTPS the public port must speak TLS, which the C++ plane
+        # does not: the python listener owns the (encrypted) data plane
+        # and serving falls back to the buffered path (ISSUE 9).
+        from ..utils.http import https_on
+
         self.native_enabled = (bool(native) and not write_jwt_key
-                               and guard is None and types.OFFSET_SIZE == 4)
+                               and guard is None and types.OFFSET_SIZE == 4
+                               and not https_on())
         self.native_plane = None
         if self.native_enabled:
             self.admin_port = rpc.derived_admin_port(port)
@@ -200,16 +206,22 @@ class VolumeServer:
                        "volume", creds=creds)
         self._grpc_server.start()
         handler = _make_http_handler(self)
+        # HTTPS data plane (ISSUE 9): TLS on the public listener when
+        # SWFS_HTTPS / security.toml [https.volume] configure it
+        from ..security.tls import load_http_server_context
+
+        https_ctx = load_http_server_context("volume")
         try:
             self._http_server = TunedThreadingHTTPServer(
-                ("", self.admin_port), handler)
+                ("", self.admin_port), handler, ssl_context=https_ctx)
         except OSError:
             if not self.native_enabled:
                 raise
             # deterministic admin port (public+11000) taken by another
             # process: fall back to an ephemeral one — only redirects
             # reference it, via the Location header
-            self._http_server = TunedThreadingHTTPServer(("", 0), handler)
+            self._http_server = TunedThreadingHTTPServer(
+                ("", 0), handler, ssl_context=https_ctx)
             self.admin_port = self._http_server.server_address[1]
         threading.Thread(target=self._http_server.serve_forever, daemon=True).start()
         if self.native_enabled:
@@ -231,6 +243,7 @@ class VolumeServer:
         self.scrubber.start()
         glog.info(f"volume server started on {self.address} "
                   f"(grpc :{self.grpc_port}"
+                  + (", https" if https_ctx is not None else "")
                   + (f", native data plane, admin :{self.admin_port})"
                      if self.native_plane else ")"))
 
@@ -368,7 +381,10 @@ class VolumeServer:
             )
             plane = self.native_plane  # stop() may null it concurrently
             if plane is not None:
+                from ..utils.stats import HTTP_NATIVE_SENDFILE
+
                 VOLUME_SERVER_NATIVE_REQUESTS.set(plane.request_count())
+                HTTP_NATIVE_SENDFILE.set(plane.sendfile_count())
             if self._stop.is_set():
                 return
 
@@ -721,7 +737,7 @@ class VolumeServer:
                         locations: list[str],
                         content_type: str = "",
                         content_encoding: str = "") -> None:
-        import requests as rq
+        from ..wdclient import pool
 
         # the body is forwarded VERBATIM (possibly gzipped, possibly a
         # multipart envelope), so the headers describing it must travel
@@ -742,12 +758,18 @@ class VolumeServer:
                 f"Bearer {gen_write_jwt(self.write_jwt_key, fid)}"
 
         def send(addr):
-            url = f"http://{addr}/{fid}?type=replicate"
+            # the replica leg rides the keep-alive pool (ISSUE 9): the
+            # primary holds one warm connection per replica instead of a
+            # TCP(+TLS) dial per replicated write
+            url = url_for(addr, f"{fid}?type=replicate")
             for k, v in params.items():
                 url += f"&{k}={v}"
-            r = rq.put(url, data=body, headers=headers, timeout=30)
-            if r.status_code >= 300:
-                raise IOError(f"replica write to {addr}: {r.status_code}")
+            try:
+                r = pool.put(url, body=body, headers=headers, timeout=30)
+            except OSError as e:
+                raise IOError(f"replica write to {addr}: {e}") from e
+            if r.status >= 300:
+                raise IOError(f"replica write to {addr}: {r.status}")
 
         with ThreadPoolExecutor(max_workers=4) as ex:
             list(ex.map(send, [a for a in locations if a != self.address]))
@@ -1886,7 +1908,17 @@ def _make_http_handler(srv: VolumeServer):
 
         def _reply(self, code: int, body: bytes = b"",
                    content_type: str = "application/json", headers=None) -> None:
+            # an error reply to a body-carrying request may leave the
+            # body unread on the socket (failpoint/guard/JWT rejections
+            # answer before draining) — a keep-alive client's NEXT
+            # request would be parsed against those stale bytes and
+            # poisoned with a stock HTML 400. Close instead of letting
+            # the connection pool recycle a desynced connection.
+            if code >= 400 and self.command in ("PUT", "POST"):
+                self.close_connection = True
             self.send_response(code)
+            if self.close_connection:
+                self.send_header("Connection", "close")
             self.send_header("Content-Type", content_type)
             self.send_header("Content-Length", str(len(body)))
             tid = getattr(self, "_trace_id", "")
@@ -1930,6 +1962,7 @@ def _make_http_handler(srv: VolumeServer):
                     ec_dispatch_stats,
                     ec_stream_stats,
                     group_commit_stats,
+                    http_pool_stats,
                     qos_stats,
                     scrub_stats,
                 )
@@ -1944,6 +1977,12 @@ def _make_http_handler(srv: VolumeServer):
                     "NativeDataPlane": plane is not None,
                     "NativeRequests":
                         plane.request_count() if plane else 0,
+                    # zero-copy GETs served via sendfile(2) (ISSUE 9)
+                    "NativeSendfile":
+                        plane.sendfile_count() if plane else 0,
+                    # wdclient pool economics + TLS handshake counters
+                    # (this process's client legs: replication fan-out)
+                    "HttpPool": http_pool_stats(),
                     "Trace": trace.STORE.stats(),
                     # flush-batching factor of the python write engine
                     # (ISSUE 2 group commit); the native plane writes
@@ -2021,20 +2060,35 @@ def _make_http_handler(srv: VolumeServer):
                 return self._json({"error": str(e)}, 500)
             data = failpoint.corrupt("volume.http.read.corrupt", n.data,
                                      ctx=f"{srv.address},")
-            headers = {"ETag": f'"{n.etag()}"'}
+            etag = f'"{n.etag()}"'
+            headers = {"ETag": etag}
             if n.last_modified:
                 headers["Last-Modified"] = time.strftime(
                     "%a, %d %b %Y %H:%M:%S GMT", time.gmtime(n.last_modified))
             # conditional GETs (volume_server_handlers_read.go:163-176;
-            # RFC 7232 §3.3 precedence via utils.http.not_modified)
-            if not_modified(self.headers, f'"{n.etag()}"', n.last_modified):
+            # RFC 7232 §3.3 precedence + weak entity-tag lists via
+            # utils.http.not_modified) — short-circuits BEFORE any
+            # decompress/transform/copy work below
+            if not_modified(self.headers, etag, n.last_modified):
+                from ..utils.stats import HTTP_CONDITIONAL_OPS
+
+                HTTP_CONDITIONAL_OPS.inc(plane="volume", result="304")
                 return self._reply(304, b"", headers=headers)
+            rng = self.headers.get("Range")
+            if rng and not range_applies(self.headers, etag,
+                                         n.last_modified):
+                # If-Range with a stale validator (RFC 7233 §3.2): the
+                # Range header is ignored, the full body is served
+                from ..utils.stats import HTTP_CONDITIONAL_OPS
+
+                HTTP_CONDITIONAL_OPS.inc(plane="volume",
+                                         result="if_range_stale")
+                rng = None
             stored_mime = n.mime.decode() if n.mime else ""
             ctype = stored_mime or "application/octet-stream"
             if n.is_compressed:
                 import gzip as _gz
 
-                rng = self.headers.get("Range")
                 if "gzip" in (self.headers.get("Accept-Encoding") or "") and not rng:
                     headers["Content-Encoding"] = "gzip"
                 else:
@@ -2048,19 +2102,24 @@ def _make_http_handler(srv: VolumeServer):
                 data, _, _ = resized(
                     data, int(q.get("width", 0)), int(q.get("height", 0)),
                     q.get("mode", ""))
-            rng = self.headers.get("Range")
             if rng and rng.startswith("bytes="):
-                try:
-                    lo, _, hi = rng[6:].partition("-")
-                    start = int(lo or 0)
-                    stop = int(hi) + 1 if hi else len(data)
-                except ValueError:
-                    # unparseable spec: ignore the header, serve the full
-                    # object (Go http.ServeContent's lenient behavior)
+                # shared RFC 7233 span parsing (utils.http): suffix
+                # ranges serve the LAST N bytes, unsatisfiable/inverted
+                # spans 416, malformed specs serve the full body —
+                # identical to the filer plane
+                span = parse_range(rng, len(data))
+                if span == "invalid":
+                    return self._reply(416, b"", headers={
+                        **headers,
+                        "Content-Range": f"bytes */{len(data)}"})
+                if span is None:
                     return self._reply(200, data, ctype, headers)
-                stop = min(stop, len(data))
+                start, stop = span
                 headers["Content-Range"] = f"bytes {start}-{stop - 1}/{len(data)}"
-                return self._reply(206, data[start:stop], ctype, headers)
+                # memoryview slice (ISSUE 9): the range body is a view
+                # over the needle bytes, not a copy
+                return self._reply(206, memoryview(data)[start:stop],
+                                   ctype, headers)
             self._reply(200, data, ctype, headers)
 
         # -- PUT/POST (volume_server_handlers_write.go:18)
@@ -2197,10 +2256,11 @@ def _make_http_handler(srv: VolumeServer):
                     if addr == srv.address:
                         continue
                     try:
-                        import requests as rq
+                        from ..wdclient import pool
 
-                        rq.delete(f"http://{addr}{u.path}?type=replicate",
-                                  headers=del_headers, timeout=30)
+                        pool.delete(
+                            url_for(addr, f"{u.path}?type=replicate"),
+                            headers=del_headers, timeout=30)
                     except Exception:  # noqa: BLE001
                         pass
             self._json({"size": size}, 202)

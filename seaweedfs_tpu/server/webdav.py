@@ -24,6 +24,8 @@ from ..utils.httpd import TunedThreadingHTTPServer
 
 import requests
 
+from ..utils.http import requests_verify
+
 from ..pb import filer_pb2, rpc
 from ..utils import glog
 
@@ -242,8 +244,12 @@ class WebDavServer:
         return rpc.filer_stub(rpc.grpc_address(self.filer))
 
     def start(self) -> None:
+        from ..security.tls import load_http_server_context
+
         handler = _make_handler(self)
-        self._httpd = TunedThreadingHTTPServer(("0.0.0.0", self.port), handler)
+        self._httpd = TunedThreadingHTTPServer(
+            ("0.0.0.0", self.port), handler,
+            ssl_context=load_http_server_context("webdav"))
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -284,7 +290,9 @@ class WebDavServer:
         return out
 
     def filer_url(self, path: str) -> str:
-        return f"http://{self.filer}{urllib.parse.quote(path)}"
+        from ..utils.http import url_for
+
+        return url_for(self.filer, urllib.parse.quote(path))
 
 
 def _prop_response(href: str, entry: filer_pb2.Entry) -> ET.Element:
@@ -402,7 +410,8 @@ def _make_handler(srv: WebDavServer):
                 return self._send(405)
             rng = self.headers.get("Range")
             r = requests.get(srv.filer_url(path), timeout=300, stream=True,
-                             headers={"Range": rng} if rng else {})
+                             headers={"Range": rng} if rng else {},
+                             verify=requests_verify())
             if r.status_code >= 300:
                 return self._send(r.status_code)
             self.send_response(r.status_code)
@@ -437,7 +446,8 @@ def _make_handler(srv: WebDavServer):
             r = requests.put(srv.filer_url(path), data=body, timeout=300,
                              headers={"Content-Type":
                                       self.headers.get("Content-Type") or
-                                      "application/octet-stream"})
+                                      "application/octet-stream"},
+                             verify=requests_verify())
             self._send(201 if r.status_code < 300 else r.status_code)
 
         def do_DELETE(self):
@@ -500,11 +510,12 @@ def _make_handler(srv: WebDavServer):
                 return self._send(404)
             if entry.is_directory:
                 return self._send(501)  # directory COPY: not supported
-            r = requests.get(srv.filer_url(src), timeout=300)
+            r = requests.get(srv.filer_url(src), timeout=300,
+                             verify=requests_verify())
             if r.status_code >= 300:
                 return self._send(502)
             pr = requests.put(srv.filer_url(dst), data=r.content,
-                              timeout=300)
+                              timeout=300, verify=requests_verify())
             self._send(201 if pr.status_code < 300 else pr.status_code)
 
         def _check_lock(self, path: str, recursive: bool = False) -> bool:

@@ -101,7 +101,10 @@ def fs_cat(env, args, out):
     import requests
 
     path = _resolve(env, args[0])
-    r = requests.get(f"http://{env.require_filer()}{path}", timeout=60)
+    from ...utils.http import requests_verify, url_for
+
+    r = requests.get(url_for(env.require_filer(), path), timeout=60,
+                     verify=requests_verify())
     if r.status_code != 200:
         raise RuntimeError(f"{path}: {r.status_code}")
     out.write(r.content.decode(errors="replace"))

@@ -15,12 +15,14 @@ import json
 
 import requests
 
+from ...utils.http import requests_verify, url_for
 from ..registry import command
 
 
 def _status(addr: str) -> dict:
     try:
-        r = requests.get(f"http://{addr}/status", timeout=10)
+        r = requests.get(url_for(addr, "/status"), timeout=10,
+                         verify=requests_verify())
         if r.status_code != 200:
             return {}
         return r.json()

@@ -17,13 +17,15 @@ import json
 
 import requests
 
+from ...utils.http import requests_verify, url_for
 from ..registry import command
 
 
 def _fetch(addr: str, trace_id: str) -> list[dict]:
     try:
-        r = requests.get(f"http://{addr}/debug/traces",
-                         params={"trace": trace_id}, timeout=10)
+        r = requests.get(url_for(addr, "/debug/traces"),
+                         params={"trace": trace_id}, timeout=10,
+                         verify=requests_verify())
         if r.status_code != 200:
             return []
         return r.json().get("spans", [])

@@ -487,16 +487,19 @@ def volume_grow(env, args, out):
     """command_volume_grow semantics via the master's grow endpoint."""
     import requests
 
+    from ...utils.http import requests_verify, url_for
+
     p = argparse.ArgumentParser(prog="volume.grow")
     p.add_argument("-collection", default="")
     p.add_argument("-replication", default="")
     p.add_argument("-count", type=int, default=1)
     opts = p.parse_args(args)
     r = requests.get(
-        f"http://{env.master}/vol/grow",
+        url_for(env.master, "/vol/grow"),
         params={"collection": opts.collection,
                 "replication": opts.replication,
-                "count": opts.count}, timeout=60).json()
+                "count": opts.count}, timeout=60,
+        verify=requests_verify()).json()
     if "error" in r:
         raise RuntimeError(r["error"])
     print(f"grew {r.get('count', 0)} volumes", file=out)

@@ -461,6 +461,48 @@ QOS_PRESSURE = Gauge(
     "buffer depth folded with EC-dispatch queue depth).")
 
 
+# -- HTTPS data plane + zero-copy read path (ISSUE 9): connection-pool
+#    economics, TLS handshake amortization, conditional/zero-copy serve
+#    outcomes ---------------------------------------------------------------
+
+HTTP_POOL_OPS = Counter(
+    "SeaweedFS_http_pool_ops",
+    "Keep-alive pool activity on the wdclient HTTP pool by result "
+    "(hit/miss/expired/evict/stale_retry/disabled).")
+HTTP_POOL_OPEN = Gauge(
+    "SeaweedFS_http_pool_open_connections",
+    "Idle pooled connections currently held by the wdclient HTTP pool.")
+HTTP_CONDITIONAL_OPS = Counter(
+    "SeaweedFS_http_conditional_ops",
+    "Conditional-GET short circuits on the data planes by plane "
+    "(volume/filer) and result (304/if_range_stale).")
+HTTP_NATIVE_SENDFILE = Gauge(
+    "SeaweedFS_http_native_sendfile",
+    "GETs the C++ data plane served zero-copy via sendfile(2) "
+    "(cumulative, refreshed from the plane each heartbeat).")
+TLS_HANDSHAKES = Counter(
+    "SeaweedFS_tls_handshakes",
+    "Completed TLS handshakes on the HTTP data planes by role "
+    "(server = accepted listener wraps, client = pool dials).")
+
+
+def http_pool_stats() -> dict:
+    """Snapshot for /status pages: pool economics + handshake counts."""
+    ops = {r: int(HTTP_POOL_OPS.value(result=r))
+           for r in ("hit", "miss", "expired", "evict", "stale_retry",
+                     "disabled")}
+    total = ops["hit"] + ops["miss"] + ops["disabled"]
+    return {
+        **ops,
+        "openConnections": int(HTTP_POOL_OPEN.value()),
+        "hitRate": round(ops["hit"] / total, 4) if total else 0.0,
+        "tlsHandshakes": {
+            "client": int(TLS_HANDSHAKES.value(role="client")),
+            "server": int(TLS_HANDSHAKES.value(role="server")),
+        },
+    }
+
+
 def qos_stats() -> dict:
     """Snapshot for /status pages: admission outcomes + grant flow."""
     out = {

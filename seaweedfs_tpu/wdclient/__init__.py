@@ -24,6 +24,7 @@ import grpc
 from ..pb import master_pb2, rpc
 from ..storage.file_id import parse_file_id
 from ..utils import glog, trace
+from ..utils.http import url_for
 from ..utils.retry import multi_retry
 
 
@@ -166,7 +167,7 @@ class MasterClient:
             self.invalidate(f.volume_id)
             raise LookupError(f"volume {f.volume_id} has no locations")
         random.shuffle(locs)
-        return [f"http://{l.url}/{fid}" for l in locs]
+        return [url_for(l.url, fid) for l in locs]
 
     def ec_fallback_urls(self, fid: str) -> list[str]:
         """Last-resort read targets: HTTP URLs of servers holding ANY EC
@@ -184,7 +185,7 @@ class MasterClient:
                 if l.url not in servers:
                     servers.append(l.url)
         random.shuffle(servers)
-        return [f"http://{url}/{fid}" for url in servers]
+        return [url_for(url, fid) for url in servers]
 
     def lookup_ec_volume(self, vid: int) -> dict[int, list[Location]]:
         now = time.time()

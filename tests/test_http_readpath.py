@@ -1,0 +1,707 @@
+"""HTTPS data plane + zero-copy hot read path (ISSUE 9).
+
+Three layers of coverage:
+
+  * conditional-request conformance units — the RFC 7232/7233 decision
+    functions in utils/http.py (entity-tag list parsing, weak-vs-strong
+    comparison, If-None-Match precedence, If-Range validators);
+  * wdclient keep-alive pool units — hit/miss/evict/expired accounting,
+    LIFO reuse, the stale-reuse retry (a server reaping an idle pooled
+    connection must cost one transparent redial, never an error), and
+    the SWFS_HTTP_POOL=0 escape hatch;
+  * the read-path IDENTITY suite — the acceptance criterion that bytes
+    served over plain HTTP (native sendfile AND native buffered AND the
+    python fallback), over HTTPS, via range-reassembly, and for needles
+    still inside the group-commit buffer window are hash-identical.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import socket
+import threading
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.utils.http import (
+    not_modified,
+    parse_etag_list,
+    range_applies,
+    strong_etag_match,
+    url_for,
+    weak_etag_match,
+)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _sha(b) -> str:
+    return hashlib.sha256(bytes(b)).hexdigest()
+
+
+# -- RFC 7232/7233 conformance units ----------------------------------------
+
+
+def test_parse_etag_list_forms():
+    assert parse_etag_list('"abc"') == ['"abc"']
+    assert parse_etag_list('"a", "b" , "c"') == ['"a"', '"b"', '"c"']
+    assert parse_etag_list('W/"a", "b"') == ['W/"a"', '"b"']
+    assert parse_etag_list("*") == ["*"]
+    assert parse_etag_list('"a", *') == ["*"]
+    # lenient bare tokens (clients that send unquoted md5s)
+    assert parse_etag_list("deadbeef") == ["deadbeef"]
+    assert parse_etag_list("a, b") == ["a", "b"]
+    # unterminated quote: taken verbatim, never raises
+    assert parse_etag_list('"abc') == ['"abc']
+    assert parse_etag_list("") == []
+
+
+def test_weak_vs_strong_comparison():
+    assert weak_etag_match('W/"x"', '"x"')
+    assert weak_etag_match('"x"', 'W/"x"')
+    assert weak_etag_match('"x"', '"x"')
+    assert not weak_etag_match('"x"', '"y"')
+    assert strong_etag_match('"x"', '"x"')
+    assert not strong_etag_match('W/"x"', '"x"')
+    assert not strong_etag_match('"x"', 'W/"x"')
+    assert not strong_etag_match('W/"x"', 'W/"x"')
+
+
+def test_not_modified_precedence_and_weak_list():
+    etag, mtime = '"abc"', 1700000000
+    fresh = time.strftime("%a, %d %b %Y %H:%M:%S GMT",
+                          time.gmtime(mtime + 100))
+    # If-None-Match list, weak comparison
+    assert not_modified({"If-None-Match": 'W/"abc"'}, etag, mtime)
+    assert not_modified({"If-None-Match": '"zzz", "abc"'}, etag, mtime)
+    assert not_modified({"If-None-Match": "*"}, etag, mtime)
+    # §3.3 precedence: a MISSING If-None-Match falls to If-Modified-Since;
+    # a PRESENT non-matching one wins over a fresh date
+    assert not not_modified(
+        {"If-None-Match": '"zzz"', "If-Modified-Since": fresh},
+        etag, mtime)
+    assert not_modified({"If-Modified-Since": fresh}, etag, mtime)
+    assert not not_modified({"If-Modified-Since": "not a date"},
+                            etag, mtime)
+
+
+def test_range_applies_validators():
+    etag, mtime = '"abc"', 1700000000
+    lm = time.strftime("%a, %d %b %Y %H:%M:%S GMT", time.gmtime(mtime))
+    later = time.strftime("%a, %d %b %Y %H:%M:%S GMT",
+                          time.gmtime(mtime + 60))
+    assert range_applies({}, etag, mtime)  # no If-Range -> honor Range
+    assert range_applies({"If-Range": '"abc"'}, etag, mtime)
+    # a weak entity-tag NEVER matches If-Range (strong-only comparison)
+    assert not range_applies({"If-Range": 'W/"abc"'}, etag, mtime)
+    assert not range_applies({"If-Range": '"old"'}, etag, mtime)
+    # date validator: exact Last-Modified equality only
+    assert range_applies({"If-Range": lm}, etag, mtime)
+    assert not range_applies({"If-Range": later}, etag, mtime)
+    assert not range_applies({"If-Range": "garbage"}, etag, mtime)
+
+
+def test_parse_range_zero_length_representation():
+    """Review regression: every range against a zero-length body is
+    unsatisfiable (416) — a suffix form must not produce the empty
+    (0, 0) span, whose Content-Range would render 'bytes 0--1/0'."""
+    from seaweedfs_tpu.utils.http import parse_range
+
+    assert parse_range("bytes=-5", 0) == "invalid"
+    assert parse_range("bytes=0-", 0) == "invalid"
+    assert parse_range("bytes=0-4", 0) == "invalid"
+    # non-empty bodies keep the normal suffix clamp
+    assert parse_range("bytes=-5", 3) == (0, 3)
+    assert parse_range("bytes=-2", 10) == (8, 10)
+
+
+def test_url_for_scheme_follows_gate(monkeypatch):
+    monkeypatch.delenv("SWFS_HTTPS", raising=False)
+    assert url_for("h:1", "a/b") == "http://h:1/a/b"
+    monkeypatch.setenv("SWFS_HTTPS", "1")
+    assert url_for("h:1", "/a") == "https://h:1/a"
+    monkeypatch.setenv("SWFS_HTTPS", "0")
+    assert url_for("h:1") == "http://h:1"
+
+
+# -- wdclient keep-alive pool -----------------------------------------------
+
+
+class _Echo:
+    """Tiny threaded HTTP server: /n -> body 'resp-<n>'; remembers the
+    client ports it served (distinct port == distinct connection)."""
+
+    def __init__(self, port=None):
+        from http.server import BaseHTTPRequestHandler
+
+        from seaweedfs_tpu.utils.httpd import TunedThreadingHTTPServer
+
+        seen = self.client_ports = []
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"  # like every real swfs plane
+
+            def do_GET(self):
+                seen.append(self.client_address[1])
+                body = f"resp-{self.path[1:]}".encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        self.srv = TunedThreadingHTTPServer(("", port or 0), H)
+        self.port = self.srv.server_address[1]
+        threading.Thread(target=self.srv.serve_forever,
+                         daemon=True).start()
+
+    def stop(self):
+        self.srv.shutdown()
+        self.srv.server_close()
+
+
+@pytest.fixture
+def fresh_pool(monkeypatch):
+    from seaweedfs_tpu.wdclient.pool import HttpPool
+
+    monkeypatch.delenv("SWFS_HTTP_POOL", raising=False)
+    monkeypatch.delenv("SWFS_HTTPS", raising=False)
+    return HttpPool()
+
+
+def test_pool_reuses_connection(fresh_pool):
+    srv = _Echo()
+    try:
+        for i in range(5):
+            r = fresh_pool.get(f"http://localhost:{srv.port}/{i}")
+            assert r.status == 200 and r.data == f"resp-{i}".encode()
+        # one TCP connection end to end
+        assert len(set(srv.client_ports)) == 1
+    finally:
+        srv.stop()
+
+
+def test_pool_disabled_dials_fresh(fresh_pool, monkeypatch):
+    monkeypatch.setenv("SWFS_HTTP_POOL", "0")
+    srv = _Echo()
+    try:
+        for i in range(3):
+            assert fresh_pool.get(
+                f"http://localhost:{srv.port}/{i}").status == 200
+        assert len(set(srv.client_ports)) == 3
+    finally:
+        srv.stop()
+
+
+def test_pool_idle_expiry_and_bound(fresh_pool, monkeypatch):
+    monkeypatch.setenv("SWFS_HTTP_POOL_IDLE_S", "0.05")
+    srv = _Echo()
+    try:
+        assert fresh_pool.get(f"http://localhost:{srv.port}/a").status \
+            == 200
+        time.sleep(0.1)  # idle past the TTL: reaped at next checkout
+        assert fresh_pool.get(f"http://localhost:{srv.port}/b").status \
+            == 200
+        assert len(set(srv.client_ports)) == 2
+        # bound: the idle set never exceeds SWFS_HTTP_POOL_SIZE
+        monkeypatch.setenv("SWFS_HTTP_POOL_SIZE", "1")
+        key = ("http", "localhost", srv.port)
+        c1, _ = fresh_pool._checkout(key, 5)
+        c2, _ = fresh_pool._checkout(key, 5)
+        fresh_pool._checkin(key, c1)
+        fresh_pool._checkin(key, c2)  # over the bound: evicted (closed)
+        assert len(fresh_pool._idle[key]) == 1
+    finally:
+        srv.stop()
+
+
+def test_pool_stale_reuse_retries_once(fresh_pool):
+    """A pooled connection the server reaped while idle must redial
+    transparently; the caller never sees the dead socket — even with
+    SEVERAL stale connections pooled to the same host (the retry dials
+    fresh instead of drawing another reaped socket)."""
+    srv = _Echo()
+    port = srv.port
+    key = ("http", "localhost", port)
+    # pool TWO live connections to the same server
+    c1, _ = fresh_pool._checkout(key, 5)
+    c1.request("GET", "/a")
+    c1.getresponse().read()
+    c2, _ = fresh_pool._checkout(key, 5)
+    c2.request("GET", "/b")
+    c2.getresponse().read()
+    fresh_pool._checkin(key, c1)
+    fresh_pool._checkin(key, c2)
+    srv.stop()  # kills BOTH pooled connections server-side
+    srv2 = _Echo(port=port)  # same address, fresh listener
+    try:
+        r = fresh_pool.get(f"http://localhost:{port}/y", timeout=10)
+        assert r.status == 200 and r.data == b"resp-y"
+    finally:
+        srv2.stop()
+
+
+def test_pool_fresh_connection_failure_propagates(fresh_pool):
+    port = _free_port()  # nothing listening
+    with pytest.raises(OSError):
+        fresh_pool.get(f"http://localhost:{port}/x", timeout=2)
+
+
+def test_error_reply_never_desyncs_pooled_keepalive(fresh_pool, tmp_path):
+    """Review regression (found by the chaos suite): a volume error
+    reply sent BEFORE the request body is drained (failpoint/guard/JWT
+    rejections) must close the connection — otherwise the pool recycles
+    a socket whose server side still holds the unread body, and the
+    NEXT request on it is parsed against those stale bytes (a stock
+    HTML 400 poisoning an innocent request)."""
+    from seaweedfs_tpu.pb import rpc
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.utils import failpoint
+
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path)],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), native=False)
+    vsrv.start()
+    try:
+        from seaweedfs_tpu import operation
+
+        deadline = time.time() + 10
+        res = None
+        while time.time() < deadline:
+            res = operation.submit(f"localhost:{mport}", b"seed-needle",
+                                   filename="seed.bin")
+            if "fid" in res:
+                break
+            time.sleep(0.2)
+        assert res and "fid" in res, res
+        vol_url = f"http://localhost:{vsrv.port}"
+        body = os.urandom(64 * 1024)  # large enough to sit unread
+        # prime a healthy pooled connection first
+        assert fresh_pool.get(f"{vol_url}/{res['fid']}",
+                              timeout=10).status == 200
+        with failpoint.active("volume.http.write", p=1.0):
+            r = fresh_pool.put(f"{vol_url}/{res['fid']}", body=body,
+                               timeout=10)
+            assert r.status == 500  # the failpoint rejection
+        # the fix is the SERVER advertising Connection: close on the
+        # error reply, so the pool provably does not retain the
+        # desynced connection (without it, whether the next request
+        # reads poisoned bytes is a scheduling race — the chaos suite
+        # lost it 2 runs out of 3)
+        key = ("http", "localhost", vsrv.port)
+        assert not fresh_pool._idle.get(key), \
+            "desynced connection was returned to the pool"
+        # and the next request round-trips cleanly on a fresh dial
+        g = fresh_pool.get(f"{vol_url}/{res['fid']}", timeout=10)
+        assert g.status == 200 and g.data == b"seed-needle", \
+            (g.status, g.data[:80])
+    finally:
+        vsrv.stop()
+        master.stop()
+        rpc.reset_channels()
+
+
+def test_pool_timeout_is_not_replayed(fresh_pool):
+    """Review regression: a timeout on a POOLED connection must raise,
+    never redial-and-replay — the server may have already received and
+    processed the request (a replayed non-idempotent op would apply
+    twice and the caller would block for two full timeout windows)."""
+    from http.server import BaseHTTPRequestHandler
+
+    from seaweedfs_tpu.utils.httpd import TunedThreadingHTTPServer
+
+    hits = []
+
+    class H(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def do_GET(self):
+            hits.append(self.path)
+            if self.path == "/slow":
+                time.sleep(2.0)  # past the client timeout
+            body = b"ok"
+            self.send_response(200)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = TunedThreadingHTTPServer(("", 0), H)
+    port = srv.server_address[1]
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        # prime the pool with a live connection
+        assert fresh_pool.get(f"http://localhost:{port}/fast",
+                              timeout=5).status == 200
+        with pytest.raises(OSError):
+            fresh_pool.get(f"http://localhost:{port}/slow", timeout=0.3)
+        time.sleep(2.2)  # let the slow handler finish and log
+        assert hits.count("/slow") == 1, "timed-out request was replayed"
+    finally:
+        srv.shutdown()
+        srv.server_close()
+
+
+# -- read-path identity suite ------------------------------------------------
+
+BIG = os.urandom(64 * 1024)       # > zerocopy_min: native sendfile
+SMALL = os.urandom(1024)          # < zerocopy_min: native buffered pread
+
+
+@pytest.fixture(scope="module")
+def native_stack(tmp_path_factory):
+    from seaweedfs_tpu.native import native_available
+    from seaweedfs_tpu.pb import rpc
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("zc"))],
+        master=f"localhost:{mport}", ip="localhost", port=_free_port(),
+        native=True)
+    vsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    assert vsrv.native_plane is not None, "native plane must be up"
+    yield master, vsrv
+    vsrv.stop()
+    master.stop()
+    rpc.reset_channels()
+
+
+def _put(master, body) -> tuple[str, str]:
+    """-> (public url, fid) after uploading `body`."""
+    from seaweedfs_tpu.operation import assign
+
+    a = assign(master.address)
+    assert not a.error, a.error
+    r = requests.put(f"http://{a.url}/{a.fid}", data=body, timeout=30)
+    assert r.status_code in (200, 201), r.text
+    return a.url, a.fid
+
+
+def test_sendfile_buffered_python_identity(native_stack):
+    """The acceptance hash pin: one object's bytes via native sendfile,
+    native buffered, and the python fallback are identical."""
+    master, vsrv = native_stack
+    want_big, want_small = _sha(BIG), _sha(SMALL)
+    url, fid = _put(master, BIG)
+    surl, sfid = _put(master, SMALL)
+    s = requests.Session()
+
+    sf0 = vsrv.native_plane.sendfile_count()
+    r = s.get(f"http://{url}/{fid}", timeout=30)
+    assert r.status_code == 200 and _sha(r.content) == want_big
+    assert vsrv.native_plane.sendfile_count() == sf0 + 1, \
+        "64KB GET must ride the sendfile path"
+
+    r = s.get(f"http://{surl}/{sfid}", timeout=30)
+    assert r.status_code == 200 and _sha(r.content) == want_small
+    # small bodies take the single-pread buffered path, not sendfile
+    assert vsrv.native_plane.sendfile_count() == sf0 + 1
+
+    # python fallback (the admin listener) serves identical bytes
+    py = s.get(f"http://localhost:{vsrv.admin_port}/{fid}", timeout=30)
+    assert py.status_code == 200 and _sha(py.content) == want_big
+
+    # zero-copy OFF (the A/B arm): same bytes, no sendfile increment
+    vsrv.native_plane.set_zerocopy_min(-1)
+    try:
+        r = s.get(f"http://{url}/{fid}", timeout=30)
+        assert _sha(r.content) == want_big
+        assert vsrv.native_plane.sendfile_count() == sf0 + 1
+    finally:
+        vsrv.native_plane.set_zerocopy_min(4096)
+
+
+def test_range_reassembly_identity(native_stack):
+    """Whole == reassembled ranges, on BOTH the native port (sendfile
+    206s) and the python fallback port."""
+    master, vsrv = native_stack
+    url, fid = _put(master, BIG)
+    n = len(BIG)
+    cuts = [0, n // 3, 2 * n // 3, n]
+    for base in (f"http://{url}/{fid}",
+                 f"http://localhost:{vsrv.admin_port}/{fid}"):
+        parts = []
+        for lo, hi in zip(cuts, cuts[1:]):
+            r = requests.get(base, timeout=30,
+                             headers={"Range": f"bytes={lo}-{hi - 1}"})
+            assert r.status_code == 206, (base, r.status_code)
+            assert r.headers["Content-Range"] == \
+                f"bytes {lo}-{hi - 1}/{n}"
+            parts.append(r.content)
+        assert _sha(b"".join(parts)) == _sha(BIG)
+    # open-ended / over-long / suffix / inverted forms answer
+    # identically on both ports (suffix + inverted resolve via the
+    # shared utils.http.parse_range — the C++ plane redirects them)
+    for base in (f"http://{url}/{fid}",
+                 f"http://localhost:{vsrv.admin_port}/{fid}"):
+        r = requests.get(base, timeout=30,
+                         headers={"Range": f"bytes={n - 100}-"})
+        assert r.status_code == 206 and r.content == BIG[-100:]
+        r = requests.get(base, timeout=30,
+                         headers={"Range": f"bytes=0-{n + 500}"})
+        assert r.status_code == 206 and _sha(r.content) == _sha(BIG)
+        # suffix: the LAST N bytes (RFC 7233 §2.1)
+        r = requests.get(base, timeout=30,
+                         headers={"Range": "bytes=-64"})
+        assert r.status_code == 206 and r.content == BIG[-64:]
+        assert r.headers["Content-Range"] == f"bytes {n - 64}-{n - 1}/{n}"
+        # inverted and past-EOF spans: spec-shaped 416
+        for bad in ("bytes=500-100", f"bytes={n + 5}-"):
+            r = requests.get(base, timeout=30, headers={"Range": bad})
+            assert r.status_code == 416, (base, bad, r.status_code)
+            assert r.headers["Content-Range"] == f"bytes */{n}"
+
+
+def test_conditional_get_volume_conformance(native_stack):
+    """The conformance matrix on a live volume plane: weak If-None-Match
+    lists 304 on BOTH the native and python paths; If-Range validators
+    are strong-only; stale validators serve the full 200."""
+    master, vsrv = native_stack
+    url, fid = _put(master, BIG)
+    s = requests.Session()
+    g = s.get(f"http://{url}/{fid}", timeout=30)
+    etag = g.headers["ETag"]
+    lm = g.headers.get("Last-Modified", "")
+    assert etag.startswith('"') and etag.endswith('"')
+    for base in (f"http://{url}/{fid}",
+                 f"http://localhost:{vsrv.admin_port}/{fid}"):
+        # weak comparison over a list, native and python alike
+        assert s.get(base, timeout=30, headers={
+            "If-None-Match": etag}).status_code == 304
+        assert s.get(base, timeout=30, headers={
+            "If-None-Match": f'W/{etag}'}).status_code == 304
+        assert s.get(base, timeout=30, headers={
+            "If-None-Match": f'"nope", {etag}'}).status_code == 304
+        assert s.get(base, timeout=30, headers={
+            "If-None-Match": "*"}).status_code == 304
+        assert s.get(base, timeout=30, headers={
+            "If-None-Match": '"nope"'}).status_code == 200
+        # If-Range: strong etag honors the Range...
+        r = s.get(base, timeout=30, headers={
+            "Range": "bytes=0-9", "If-Range": etag})
+        assert r.status_code == 206 and r.content == BIG[:10]
+        # ...a weak tag or a mismatch serves the full 200
+        for stale in (f"W/{etag}", '"other"'):
+            r = s.get(base, timeout=30, headers={
+                "Range": "bytes=0-9", "If-Range": stale})
+            assert r.status_code == 200 and _sha(r.content) == _sha(BIG)
+        if lm:
+            r = s.get(base, timeout=30, headers={
+                "Range": "bytes=0-9", "If-Range": lm})
+            assert r.status_code == 206 and r.content == BIG[:10]
+            later = time.strftime(
+                "%a, %d %b %Y %H:%M:%S GMT",
+                time.gmtime(time.time() + 3600))
+            r = s.get(base, timeout=30, headers={
+                "Range": "bytes=0-9", "If-Range": later})
+            assert r.status_code == 200 and _sha(r.content) == _sha(BIG)
+
+
+def test_conditional_get_filer_conformance(native_stack, tmp_path):
+    from seaweedfs_tpu.pb import rpc
+    from seaweedfs_tpu.server.filer import FilerServer
+
+    master, _ = native_stack
+    fsrv = FilerServer(ip="localhost", port=_free_port(),
+                       master=master.address, chunk_size=8 * 1024)
+    fsrv.start()
+    try:
+        body = os.urandom(20 * 1024)  # 3 chunks
+        base = f"http://{fsrv.address}/cond/obj.bin"
+        assert requests.put(base, data=body,
+                            timeout=30).status_code < 300
+        g = requests.get(base, timeout=30)
+        assert g.status_code == 200 and _sha(g.content) == _sha(body)
+        etag, lm = g.headers["ETag"], g.headers.get("Last-Modified", "")
+        assert requests.get(base, timeout=30, headers={
+            "If-None-Match": f'"x", W/{etag}'}).status_code == 304
+        assert requests.get(base, timeout=30, headers={
+            "If-None-Match": "*"}).status_code == 304
+        assert requests.get(base, timeout=30, headers={
+            "If-None-Match": '"x"'}).status_code == 200
+        r = requests.get(base, timeout=30, headers={
+            "Range": "bytes=100-199", "If-Range": etag})
+        assert r.status_code == 206 and r.content == body[100:200]
+        r = requests.get(base, timeout=30, headers={
+            "Range": "bytes=100-199", "If-Range": f'W/{etag}'})
+        assert r.status_code == 200 and _sha(r.content) == _sha(body)
+        if lm:
+            r = requests.get(base, timeout=30, headers={
+                "Range": "bytes=0-0", "If-Range": lm})
+            assert r.status_code == 206 and r.content == body[:1]
+        # filer range-reassembly identity across chunk boundaries
+        parts = [requests.get(base, timeout=30, headers={
+            "Range": f"bytes={lo}-{lo + 4095}"}).content
+            for lo in range(0, len(body), 4096)]
+        assert _sha(b"".join(parts)) == _sha(body)
+    finally:
+        fsrv.stop()
+        rpc.reset_channels()
+
+
+def test_group_commit_window_read_identity(tmp_path, monkeypatch):
+    """A needle still inside the group-commit buffer window serves
+    hash-identical bytes (the _pread_durable read-retry over the buffer
+    window, now reachable over HTTP)."""
+    from seaweedfs_tpu.pb import rpc
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+
+    monkeypatch.setenv("SWFS_GROUP_COMMIT", "1")
+    monkeypatch.setenv("SWFS_GROUP_COMMIT_WINDOW_MS", "700")
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path)],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), native=False)
+    vsrv.start()
+    try:
+        from seaweedfs_tpu.operation import assign
+
+        deadline = time.time() + 10
+        while time.time() < deadline and not master.topo.nodes:
+            time.sleep(0.05)
+        a = assign(master.address)
+        assert not a.error, a.error
+        body = os.urandom(32 * 1024)
+        done = []
+
+        def put():
+            # acked only after the covering flush: blocks ~window
+            r = requests.put(f"http://{a.url}/{a.fid}", data=body,
+                             timeout=30)
+            done.append(r.status_code)
+
+        t = threading.Thread(target=put, daemon=True)
+        t.start()
+        got, writer_was_alive = None, False
+        poll_deadline = time.time() + 10
+        while time.time() < poll_deadline:
+            r = requests.get(f"http://{a.url}/{a.fid}", timeout=10)
+            if r.status_code == 200:
+                got = r.content
+                writer_was_alive = t.is_alive()
+                break
+            time.sleep(0.005)
+        t.join(timeout=30)
+        assert done == [201], f"PUT failed: {done}"
+        assert got is not None, "GET never saw the needle"
+        # identity INSIDE the window (the writer was still blocked on
+        # its flush when the read completed)
+        assert _sha(got) == _sha(body)
+        assert writer_was_alive, \
+            "read completed only after the flush window - widen WINDOW_MS"
+        # and identity after the flush lands
+        r = requests.get(f"http://{a.url}/{a.fid}", timeout=10)
+        assert _sha(r.content) == _sha(body)
+    finally:
+        vsrv.stop()
+        master.stop()
+        rpc.reset_channels()
+
+
+def test_https_identity_and_handshake_counters(tmp_path, monkeypatch):
+    """The encrypted plane serves hash-identical bytes for whole + range
+    reads; server/client handshake counters move; the native plane
+    stands down under TLS; the wdclient pool dials https and verifies
+    the cluster CA."""
+    from seaweedfs_tpu.pb import rpc
+    from seaweedfs_tpu.security.tls import ensure_self_signed, https_env
+    from seaweedfs_tpu.server.master import MasterServer
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.utils.stats import TLS_HANDSHAKES
+    from seaweedfs_tpu.wdclient.pool import POOL
+
+    paths = ensure_self_signed(str(tmp_path / "pki"))
+    for k, v in https_env(paths).items():
+        monkeypatch.setenv(k, v)
+    POOL.clear()
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "vol")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), native=True)
+    vsrv.start()
+    try:
+        # TLS configured: the C++ plane (plain HTTP only) must stand down
+        assert vsrv.native_plane is None
+        deadline = time.time() + 10
+        while time.time() < deadline and not master.topo.nodes:
+            time.sleep(0.05)
+        from seaweedfs_tpu.operation import assign
+
+        a = assign(master.address)
+        assert not a.error, a.error
+        hs_srv0 = TLS_HANDSHAKES.value(role="server")
+        hs_cli0 = TLS_HANDSHAKES.value(role="client")
+        url = f"https://{a.url}/{a.fid}"
+        r = requests.put(url, data=BIG, timeout=30, verify=paths["ca"])
+        assert r.status_code in (200, 201), r.text
+        g = requests.get(url, timeout=30, verify=paths["ca"])
+        assert g.status_code == 200 and _sha(g.content) == _sha(BIG)
+        rng = requests.get(url, timeout=30, verify=paths["ca"],
+                           headers={"Range": "bytes=100-299"})
+        assert rng.status_code == 206 and rng.content == BIG[100:300]
+        assert requests.get(url, timeout=30, verify=paths["ca"],
+                            headers={"If-None-Match": g.headers["ETag"]}
+                            ).status_code == 304
+        assert TLS_HANDSHAKES.value(role="server") > hs_srv0
+        # the pooled client leg: https + CA verification + handshake
+        # accounting, connection reused across requests
+        r1 = POOL.get(url, timeout=30)
+        r2 = POOL.get(url, timeout=30)
+        assert r1.status == 200 and _sha(r1.data) == _sha(BIG)
+        assert r2.status == 200 and _sha(r2.data) == _sha(BIG)
+        cli_hs = TLS_HANDSHAKES.value(role="client") - hs_cli0
+        assert cli_hs == 1, \
+            f"pool must amortize the TLS handshake (saw {cli_hs})"
+        # a wrong trust root fails FAST (the PR-2 classification):
+        # certificate rejection is not retryable
+        import ssl
+
+        from seaweedfs_tpu.utils.retry import (
+            is_retryable,
+            ssl_error_is_retryable,
+        )
+
+        other = ensure_self_signed(str(tmp_path / "otherpki"))
+        with pytest.raises(requests.exceptions.SSLError) as ei:
+            requests.get(url, timeout=10, verify=other["ca"])
+        assert not is_retryable(ei.value)
+        assert not ssl_error_is_retryable(
+            ssl.SSLCertVerificationError("bad cert"))
+    finally:
+        vsrv.stop()
+        master.stop()
+        POOL.clear()
+        rpc.reset_channels()

@@ -539,3 +539,73 @@ def test_high_filer_port_admin_shadow_stays_in_range(tmp_path):
         vsrv.stop()
         master.stop()
         rpc.reset_channels()
+
+
+def test_hot_plane_conditional_semantics(hot_cluster):
+    """ISSUE-9 review regressions: the hot plane answers If-None-Match
+    with the SAME weak entity-tag-list comparison as python, and defers
+    every other validator — If-Range above all: a stale validator must
+    serve the full 200 (a native 206 would let a client splice new
+    bytes onto an old partial download)."""
+    _, _, fs = hot_cluster
+    payload = b"conditional hot payload" * 64
+    path = "/buckets/cond/hot.bin"
+    r = requests.put(_native_url(fs, path), data=payload, timeout=10)
+    assert r.status_code == 201, r.text
+    g = requests.get(_native_url(fs, path), timeout=10)
+    assert g.status_code == 200 and g.content == payload
+    etag = g.headers["ETag"]
+
+    before = fs.hot_plane.stats()
+    # weak + list INM forms 304 natively (not just the exact string)
+    for inm in (etag, f"W/{etag}", f'"x", {etag}', "*"):
+        g = requests.get(_native_url(fs, path), timeout=10,
+                         headers={"If-None-Match": inm})
+        assert g.status_code == 304, (inm, g.status_code)
+    g = requests.get(_native_url(fs, path), timeout=10,
+                     headers={"If-None-Match": '"nope"'})
+    assert g.status_code == 200 and g.content == payload
+    after = fs.hot_plane.stats()
+    assert after["native_gets"] >= before["native_gets"] + 5
+    assert after["redirects"] == before["redirects"]
+
+    # If-Range: python owns the decision on BOTH the match and the
+    # stale side (the hot plane redirects instead of guessing)
+    g = requests.get(_native_url(fs, path), timeout=10,
+                     headers={"Range": "bytes=5-9", "If-Range": etag})
+    assert g.status_code == 206 and g.content == payload[5:10]
+    g = requests.get(_native_url(fs, path), timeout=10,
+                     headers={"Range": "bytes=5-9", "If-Range": f"W/{etag}"})
+    assert g.status_code == 200 and g.content == payload  # weak: full 200
+    g = requests.get(_native_url(fs, path), timeout=10,
+                     headers={"Range": "bytes=5-9", "If-Range": '"stale"'})
+    assert g.status_code == 200 and g.content == payload
+    final = fs.hot_plane.stats()
+    assert final["redirects"] >= after["redirects"] + 3
+
+
+def test_md5_wanting_put_defers_to_python(hot_cluster):
+    """ISSUE-9 review regression: a PUT carrying X-Swfs-Want-Md5 (the
+    S3 gateway's ETag contract) or Content-MD5 must take the python
+    path, which records the whole-body md5 — the hot plane can't, and
+    an absorbed crc-etag entry would break PUT-etag revalidation."""
+    _, _, fs = hot_cluster
+    payload = b"md5 etag contract" * 32
+    before = fs.hot_plane.stats()
+    r = requests.put(_native_url(fs, "/buckets/md5/want.bin"),
+                     data=payload, headers={"X-Swfs-Want-Md5": "1"},
+                     timeout=10)
+    assert r.status_code in (200, 201), r.text
+    after = fs.hot_plane.stats()
+    assert after["redirects"] > before["redirects"]
+    assert after["native_puts"] == before["native_puts"]
+    # the python path recorded the md5: the served ETag is the 32-hex
+    # whole-body digest, which a PUT-returned etag revalidates against
+    import hashlib
+    g = requests.get(_native_url(fs, "/buckets/md5/want.bin"), timeout=10)
+    assert g.status_code == 200 and g.content == payload
+    md5_etag = f'"{hashlib.md5(payload).hexdigest()}"'
+    assert g.headers["ETag"] == md5_etag
+    assert requests.get(_native_url(fs, "/buckets/md5/want.bin"),
+                        headers={"If-None-Match": md5_etag},
+                        timeout=10).status_code == 304

@@ -404,6 +404,64 @@ def test_s3_range_416_and_request_id(stack):
     assert r.headers.get("x-amz-request-id")
 
 
+def test_s3_conditional_get_roundtrip(stack):
+    """ISSUE 9 conformance satellite: the S3 gateway forwards the
+    caller's validators to the filer and passes the RFC 7232/7233
+    verdict back — a requests/boto-style round trip sees spec-shaped
+    304/206/200 with quoted ETags and weak-vs-strong comparison."""
+    *_, s3 = stack
+    base = f"http://localhost:{s3.port}"
+    body = b"conditional get body " * 64
+    assert _req("PUT", f"{base}/condbkt", ADMIN).status_code == 200
+    assert _req("PUT", f"{base}/condbkt/o.bin", ADMIN,
+                body).status_code == 200
+    put_etag = _req("PUT", f"{base}/condbkt/o2.bin", ADMIN,
+                    body).headers["ETag"]
+    g = _req("GET", f"{base}/condbkt/o.bin", ADMIN)
+    assert g.status_code == 200 and g.content == body
+    etag = g.headers["ETag"]
+    assert etag.startswith('"') and etag.endswith('"'), etag
+    # one entity-tag across the whole surface: a client revalidating
+    # with its PUT-returned ETag gets the 304 (GET/HEAD/PUT agree on
+    # the stored whole-body md5)
+    assert etag == _req("HEAD", f"{base}/condbkt/o.bin",
+                        ADMIN).headers["ETag"]
+    r = _req("GET", f"{base}/condbkt/o2.bin", ADMIN,
+             headers={"If-None-Match": put_etag})
+    assert r.status_code == 304, (put_etag, r.status_code)
+    # If-None-Match: exact, weak, list and * all 304 (weak comparison);
+    # the 304 carries the ETag and an empty body
+    for inm in (etag, f"W/{etag}", f'"zz", {etag}', "*"):
+        r = _req("GET", f"{base}/condbkt/o.bin", ADMIN,
+                 headers={"If-None-Match": inm})
+        assert r.status_code == 304, (inm, r.status_code)
+        assert r.headers.get("ETag") == etag
+        assert r.content == b""
+    r = _req("GET", f"{base}/condbkt/o.bin", ADMIN,
+             headers={"If-None-Match": '"zz"'})
+    assert r.status_code == 200 and r.content == body
+    # If-Range: a strong match honors the Range (206), a weak tag or a
+    # mismatch serves the full 200 (never an error)
+    r = _req("GET", f"{base}/condbkt/o.bin", ADMIN,
+             headers={"Range": "bytes=0-9", "If-Range": etag})
+    assert r.status_code == 206 and r.content == body[:10]
+    assert r.headers["Content-Range"] == f"bytes 0-9/{len(body)}"
+    for stale in (f"W/{etag}", '"zz"'):
+        r = _req("GET", f"{base}/condbkt/o.bin", ADMIN,
+                 headers={"Range": "bytes=0-9", "If-Range": stale})
+        assert r.status_code == 200 and r.content == body
+    # If-Modified-Since consulted only without If-None-Match
+    fresh = time.strftime("%a, %d %b %Y %H:%M:%S GMT",
+                          time.gmtime(time.time() + 3600))
+    r = _req("GET", f"{base}/condbkt/o.bin", ADMIN,
+             headers={"If-Modified-Since": fresh})
+    assert r.status_code == 304
+    r = _req("GET", f"{base}/condbkt/o.bin", ADMIN,
+             headers={"If-None-Match": '"zz"',
+                      "If-Modified-Since": fresh})
+    assert r.status_code == 200 and r.content == body
+
+
 def test_s3_streamed_put_incomplete_body(stack):
     """A body shorter than Content-Length must 400 (IncompleteBody), not
     store a truncated object (open-mode gateway streams unsigned PUTs)."""

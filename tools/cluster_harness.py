@@ -32,6 +32,17 @@ Modes:
     python tools/cluster_harness.py --ab            # the full A/B (default)
     python tools/cluster_harness.py --smoke         # tier-1 smoke (~5s load)
     python tools/cluster_harness.py --phase on|off  # one arm, no A/B
+    python tools/cluster_harness.py --tls-flap      # cert-rotation chaos
+
+HTTPS (ISSUE 9): every mode takes `--https` — the harness mints one
+self-signed CA + localhost server cert (security.tls.ensure_self_signed)
+and exports the SWFS_HTTPS* env, which moves ALL FOUR traffic shapes,
+every spawned server, and every internal cluster leg onto TLS in one
+switch; the artifact then carries per-process handshake counts so
+keep-alive amortization is visible. `--tls-flap` is the chaos arm: a
+volume server is restarted with a ROTATED server cert (same CA)
+mid-read-storm — handshake/EOF flakes retry, certificate-verification
+failures fail fast, and the run asserts zero client-visible errors.
 """
 
 from __future__ import annotations
@@ -60,6 +71,40 @@ import requests  # noqa: E402
 from seaweedfs_tpu.pb import master_pb2, rpc  # noqa: E402
 from seaweedfs_tpu.storage.file_id import parse_file_id  # noqa: E402
 from seaweedfs_tpu.utils import trace  # noqa: E402
+
+# -- HTTPS plumbing (ISSUE 9) -----------------------------------------------
+
+#: set by enable_https(): {"cert", "key", "ca"} paths. When set, the
+#: harness's own generators dial https and verify the minted CA, and
+#: every spawned server inherits the SWFS_HTTPS* env via spawn().
+HTTPS_PATHS: dict | None = None
+
+
+def enable_https(directory: str) -> dict:
+    """Mint (or reuse) the test CA + server cert in `directory` and flip
+    the whole harness process — and every child it spawns — onto TLS."""
+    global HTTPS_PATHS
+    from seaweedfs_tpu.security.tls import ensure_self_signed, https_env
+
+    HTTPS_PATHS = ensure_self_signed(directory)
+    os.environ.update(https_env(HTTPS_PATHS))
+    return HTTPS_PATHS
+
+
+# the harness reads scheme/trust through the SAME env gate the spawned
+# servers use (enable_https exported SWFS_HTTPS*), so generator traffic
+# can never test a different TLS configuration than the cluster runs
+def _verify():
+    from seaweedfs_tpu.utils.http import requests_verify
+
+    return requests_verify()
+
+
+def _u(addr: str, path: str = "") -> str:
+    from seaweedfs_tpu.utils.http import url_for
+
+    return url_for(addr, path)
+
 
 # -- cluster plumbing (PR-6 bench-child pattern) ----------------------------
 
@@ -117,7 +162,8 @@ def wait_http(addr: str, timeout: float = 120) -> None:
     deadline = time.time() + timeout
     while time.time() < deadline:
         try:
-            requests.get(f"http://{addr}/status", timeout=3)
+            requests.get(_u(addr, "/status"), timeout=3,
+                         verify=_verify())
             return
         except requests.RequestException:
             time.sleep(0.5)
@@ -139,6 +185,7 @@ class Cluster:
             ["master", "-port", str(self.mport),
              "-volumeSizeLimitMB", "512"],
             os.path.join(self.tmp, "master.log"), self.extra_env))
+        self._vol_specs: list[tuple[list, str, dict]] = []
         for i in range(servers):
             d = os.path.join(self.tmp, f"v{i}")
             os.makedirs(d)
@@ -146,11 +193,12 @@ class Cluster:
             self.vol_addrs.append(f"localhost:{p}")
             env = dict(self.extra_env)
             env.update(volume_env or {})
-            self.procs.append(spawn(
-                ["volume", "-dir", d, "-max", "200", "-port", str(p),
-                 "-mserver", self.master, "-coder", "cpu",
-                 "-nativeDataPlane", "off"],
-                os.path.join(self.tmp, f"v{i}.log"), env))
+            args = ["volume", "-dir", d, "-max", "200", "-port", str(p),
+                    "-mserver", self.master, "-coder", "cpu",
+                    "-nativeDataPlane", "off"]
+            log = os.path.join(self.tmp, f"v{i}.log")
+            self._vol_specs.append((args, log, env))
+            self.procs.append(spawn(args, log, env))
         fport = free_port()
         self.filer = f"localhost:{fport}"
         self.procs.append(spawn(
@@ -170,6 +218,22 @@ class Cluster:
 
     def all_addrs(self) -> list[str]:
         return [self.master, *self.vol_addrs, self.filer, self.s3]
+
+    def restart_volume(self, i: int, timeout: float = 120) -> None:
+        """Kill volume server `i` and respawn it on the same port/dir
+        with its CURRENT env — certs re-read from disk, so a
+        tls-rotation restart serves the new certificate. Returns once
+        its /status answers again."""
+        args, log, env = self._vol_specs[i]
+        proc = self.procs[1 + i]  # procs[0] is the master
+        try:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=15)
+        except (OSError, subprocess.TimeoutExpired):
+            proc.kill()
+            proc.wait(timeout=15)
+        self.procs[1 + i] = spawn(args, log + ".restart", env)
+        wait_http(self.vol_addrs[i], timeout=timeout)
 
     def stop(self) -> None:
         for p in self.procs:
@@ -306,7 +370,7 @@ def shape_zipf_read(cluster: Cluster, keys: list[str], stats: ShapeStats,
         with trace.span(f"harness.{stats.name}", component="harness",
                         server="harness") as sp:
             r = tl.session.get(
-                f"http://{cluster.s3}/hot/{key}",
+                _u(cluster.s3, f"/hot/{key}"), verify=_verify(),
                 headers=trace.inject_headers({}), timeout=30)
             return r.status_code, r.headers.get("X-Trace-Id",
                                                 sp.trace_id)
@@ -327,7 +391,8 @@ def shape_put_flood(cluster: Cluster, stats: ShapeStats, rps: float,
         with trace.span(f"harness.{stats.name}", component="harness",
                         server="harness") as sp:
             r = tl.session.put(
-                f"http://{cluster.filer}/buckets/flood/o{next(seq)}",
+                _u(cluster.filer, f"/buckets/flood/o{next(seq)}"),
+                verify=_verify(),
                 data=body, headers=trace.inject_headers({}), timeout=30)
             return r.status_code, r.headers.get("X-Trace-Id",
                                                 sp.trace_id)
@@ -344,7 +409,8 @@ def shape_degraded_read(vol_addr: str, fids: list[str],
         fid = fids[tl.rng.randrange(len(fids))]
         with trace.span(f"harness.{stats.name}", component="harness",
                         server="harness") as sp:
-            r = tl.session.get(f"http://{vol_addr}/{fid}",
+            r = tl.session.get(_u(vol_addr, f"/{fid}"),
+                               verify=_verify(),
                                headers=trace.inject_headers({}),
                                timeout=60)
             return r.status_code, r.headers.get("X-Trace-Id",
@@ -402,8 +468,8 @@ def _fill_volume(cluster: Cluster, collection: str, seed: int,
     with requests.Session() as s:
         while total < vol_mb * (1 << 20):
             data = key.to_bytes(8, "big") + blob[8:]
-            r = s.put(f"http://{src}/{vid},{key:x}00002026", data=data,
-                      timeout=60)
+            r = s.put(_u(src, f"/{vid},{key:x}00002026"), data=data,
+                      verify=_verify(), timeout=60)
             if r.status_code not in (200, 201):
                 raise RuntimeError(f"fill PUT {r.status_code}: {r.text}")
             total += len(data)
@@ -413,15 +479,16 @@ def _fill_volume(cluster: Cluster, collection: str, seed: int,
 
 def stage_hot_objects(cluster: Cluster, n: int = 32) -> list[str]:
     with requests.Session() as s:
-        r = s.put(f"http://{cluster.s3}/hot", timeout=30)
+        r = s.put(_u(cluster.s3, "/hot"), timeout=30,
+                  verify=_verify())
         if r.status_code >= 300:
             raise RuntimeError(f"bucket create: {r.status_code}")
         keys = []
         for i in range(n):
             key = f"obj-{i:04d}"
             body = os.urandom(2048 + (i % 7) * 1024)
-            r = s.put(f"http://{cluster.s3}/hot/{key}", data=body,
-                      timeout=30)
+            r = s.put(_u(cluster.s3, f"/hot/{key}"), data=body,
+                      verify=_verify(), timeout=30)
             if r.status_code >= 300:
                 raise RuntimeError(f"hot PUT: {r.status_code}")
             keys.append(key)
@@ -521,8 +588,9 @@ def run_phase(tag: str, *, servers: int, duration: float,
         for tid in sample:
             for addr in cluster.all_addrs():
                 try:
-                    r = requests.get(f"http://{addr}/debug/traces",
-                                     params={"trace": tid}, timeout=10)
+                    r = requests.get(_u(addr, "/debug/traces"),
+                                     params={"trace": tid}, timeout=10,
+                                     verify=_verify())
                     if r.status_code == 200 and r.json().get("spans"):
                         resolved += 1
                         break
@@ -540,11 +608,36 @@ def run_phase(tag: str, *, servers: int, duration: float,
                      cluster.filer, cluster.s3):
             try:
                 snaps[addr] = requests.get(
-                    f"http://{addr}/status",
+                    _u(addr, "/status"), verify=_verify(),
                     timeout=10).json().get("Qos", {})
             except (requests.RequestException, ValueError):
                 snaps[addr] = {}
         out["qos_status"] = snaps
+        out["https"] = bool(HTTPS_PATHS)
+        if HTTPS_PATHS:
+            # handshake economics (ISSUE 9): the harness's own client
+            # side (generators + staging + the pooled internal legs it
+            # runs in-process) and every server's /status.HttpPool —
+            # the keep-alive A/B reads amortization straight off these
+            from seaweedfs_tpu.utils.stats import (
+                TLS_HANDSHAKES,
+                http_pool_stats,
+            )
+
+            per_server = {}
+            for addr in cluster.all_addrs():
+                try:
+                    st = requests.get(_u(addr, "/status"), timeout=10,
+                                      verify=_verify()).json()
+                    per_server[addr] = st.get("HttpPool", {}).get(
+                        "tlsHandshakes", {})
+                except (requests.RequestException, ValueError):
+                    per_server[addr] = {}
+            out["handshakes"] = {
+                "harness_client": int(TLS_HANDSHAKES.value(role="client")),
+                "harness_pool": http_pool_stats(),
+                "per_server": per_server,
+            }
     finally:
         cluster.stop()
         out["clean_shutdown"] = getattr(cluster, "clean_shutdown", False)
@@ -672,11 +765,137 @@ def run_smoke(servers: int = 2, duration: float = 5.0,
     return phase
 
 
+def run_tls_flap(servers: int = 1, vol_mb: float = 2.0) -> dict:
+    """TLS-flap chaos (ISSUE 9 satellite): a volume server is restarted
+    with a ROTATED server certificate (same CA) in the middle of a
+    hot-read storm. Handshake/EOF/connection flakes retry (the PR-2
+    ssl.SSLError classification, finally exercised end-to-end);
+    certificate-VERIFICATION failures fail fast; the client sees zero
+    errors. Requires enable_https() — plain HTTP has nothing to flap."""
+    import random
+
+    from seaweedfs_tpu.utils.retry import Backoff, is_retryable
+
+    assert HTTPS_PATHS, "run_tls_flap requires --https"
+    out: dict = {"metric": "tls_flap", "https": True, "servers": servers}
+    cluster = Cluster(servers)
+    try:
+        cluster.wait(servers)
+        vid = _fill_volume(cluster, "hot", seed=77, vol_mb=vol_mb)
+        stub = rpc.master_stub(rpc.grpc_address(cluster.master))
+        resp = stub.LookupVolume(master_pb2.LookupVolumeRequest(
+            volume_or_file_ids=[str(vid)]), timeout=10)
+        holder = resp.volume_id_locations[0].locations[0].url
+        holder_i = cluster.vol_addrs.index(holder)
+        key0 = (0x7F - (77 % 0x70)) << 24
+        fids = [f"{vid},{key0 + i:x}00002026"
+                for i in range(max(1, int(vol_mb)))]
+        stats = {"ok": 0, "errors": 0, "flakes_retried": 0,
+                 "ssl_flakes": 0, "error_samples": []}
+        rng = random.Random(7)
+        restart_done = threading.Event()
+        restart_err: list[str] = []
+
+        def one_read() -> None:
+            fid = fids[_zipf_index(rng, len(fids))]
+            url = _u(holder, f"/{fid}")
+            bo = Backoff(wait_init=0.2, wait_max=2.0)
+            # generous attempt budget: the restart's down-window on this
+            # box is dominated by the child's cold import (~10-20s)
+            for _ in range(90):
+                try:
+                    r = requests.get(url, timeout=10, verify=_verify())
+                    if r.status_code == 200 and len(r.content) == 1 << 20:
+                        stats["ok"] += 1
+                        return
+                    raise IOError(f"status {r.status_code}")
+                except Exception as e:  # noqa: BLE001
+                    if isinstance(e, requests.exceptions.SSLError):
+                        if not is_retryable(e):
+                            # a trust decision: NEVER retried
+                            stats["errors"] += 1
+                            stats["error_samples"].append(
+                                f"fail-fast: {e}"[:160])
+                            return
+                        stats["ssl_flakes"] += 1
+                    stats["flakes_retried"] += 1
+                    bo.sleep()
+            stats["errors"] += 1
+            stats["error_samples"].append("retry budget exhausted")
+
+        def flap() -> None:
+            try:
+                # re-issue ONLY the server cert under the existing CA:
+                # clients keep verifying, live connections break
+                from seaweedfs_tpu.security.tls import ensure_self_signed
+
+                ensure_self_signed(
+                    os.path.dirname(HTTPS_PATHS["cert"]), rotate=True)
+                cluster.restart_volume(holder_i)
+            except Exception as e:  # noqa: BLE001
+                restart_err.append(f"{type(e).__name__}: {e}"[:300])
+            finally:
+                restart_done.set()
+
+        # warmup reads against the original cert
+        for _ in range(10):
+            one_read()
+        warm_ok = stats["ok"]
+        flapper = threading.Thread(target=flap, daemon=True)
+        flapper.start()
+        # read THROUGH the flap, then long enough after it to prove the
+        # rotated cert serves (hard 180s ceiling, not load-dependent)
+        post = 0
+        hard_deadline = time.monotonic() + 180
+        while time.monotonic() < hard_deadline:
+            one_read()
+            if restart_done.is_set():
+                post += 1
+                if post >= 15:
+                    break
+        flapper.join(timeout=60)
+        out["reads_ok"] = stats["ok"]
+        out["reads_before_flap"] = warm_ok
+        out["reads_after_restart"] = post
+        out["client_errors"] = stats["errors"]
+        out["flakes_retried"] = stats["flakes_retried"]
+        out["ssl_classified_flakes"] = stats["ssl_flakes"]
+        out["rotated"] = restart_done.is_set() and not restart_err
+        if restart_err:
+            out["restart_error"] = restart_err[0]
+        if stats["error_samples"]:
+            out["error_samples"] = stats["error_samples"][:5]
+        # fail-fast pin: a client with the WRONG trust root must get a
+        # certificate-verification error classified NON-retryable —
+        # walking replicas/retries would only hide the misconfiguration
+        other = os.path.join(cluster.tmp, "wrong-pki")
+        from seaweedfs_tpu.security.tls import ensure_self_signed
+
+        wrong = ensure_self_signed(other)
+        t0 = time.monotonic()
+        try:
+            requests.get(_u(holder, f"/{fids[0]}"), timeout=10,
+                         verify=wrong["ca"])
+            out["fail_fast_verified"] = False
+        except requests.exceptions.SSLError as e:
+            out["fail_fast_verified"] = not is_retryable(e)
+        out["fail_fast_seconds"] = round(time.monotonic() - t0, 3)
+        if out["client_errors"] or not out["rotated"] \
+                or not out.get("fail_fast_verified"):
+            out["error"] = "tls flap scenario failed assertions"
+    finally:
+        cluster.stop()
+        out["clean_shutdown"] = getattr(cluster, "clean_shutdown", False)
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--phase", choices=["on", "off"], default=None)
     ap.add_argument("--ab", action="store_true")
+    ap.add_argument("--tls-flap", action="store_true")
+    ap.add_argument("--https", action="store_true")
     ap.add_argument("--servers", type=int,
                     default=int(os.environ.get("SWFS_HARNESS_SERVERS",
                                                "2")))
@@ -692,7 +911,12 @@ def main() -> int:
     ap.add_argument("--out", default="")
     opts = ap.parse_args()
     try:
-        if opts.smoke:
+        if opts.https or opts.tls_flap:
+            enable_https(tempfile.mkdtemp(prefix="swfs-harness-pki-"))
+        if opts.tls_flap:
+            out = run_tls_flap(max(1, min(opts.servers, 2)),
+                               vol_mb=min(opts.vol_mb, 2.0))
+        elif opts.smoke:
             out = run_smoke(opts.servers, min(opts.duration, 10.0),
                             min(opts.vol_mb, 1.0))
         elif opts.phase:
