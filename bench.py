@@ -1999,6 +1999,104 @@ def _await_device_probe() -> dict:
     return probe
 
 
+def _bench_repair_ab() -> dict:
+    """ISSUE 11 A/B: single-shard repair bandwidth under rs_10_4 vs
+    lrc_10_2_2 (interleaved arms, same bytes). For every single-shard
+    loss pattern: survivor bytes READ by the minimal-read rebuild, the
+    repair wall, and the encode overhead of the LRC arm. The acceptance
+    gate is the read ratio: lrc must read <= 60% of what rs reads across
+    the 14 single-loss patterns (12 group losses read 5 survivors, 2
+    global-parity losses read 10 — 80/140 = 57.1% by construction; the
+    bench PROVES the plumbing delivers it end to end)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from seaweedfs_tpu.models.coder import new_coder
+    from seaweedfs_tpu.storage.ec_files import (
+        rebuild_ec_files,
+        write_ec_files,
+    )
+    from seaweedfs_tpu.storage.ec_locate import Geometry
+
+    rounds = int(os.environ.get("SEAWEEDFS_TPU_REPAIRAB_ROUNDS", "3"))
+    nbytes = int(os.environ.get("SEAWEEDFS_TPU_REPAIRAB_MB", "24")) << 20
+    geo_kw = dict(large_block=4 << 20, small_block=64 << 10)
+    arms = {
+        "rs_10_4": Geometry(**geo_kw),
+        "lrc_10_2_2": Geometry(code="lrc_10_2_2", **geo_kw),
+    }
+    out: dict = {
+        "bench": "repair_ab", "issue": 11, "rounds": rounds,
+        "dat_bytes": nbytes,
+        "arms": {n: {"encode_wall_s": [], "repair_wall_s": [],
+                     "repair_bytes_read": [], "per_loss_reads": {}}
+                 for n in arms},
+    }
+    root = tempfile.mkdtemp(prefix="swfs-repair-ab-")
+    try:
+        rng = np.random.default_rng(0x11)
+        blob = rng.integers(0, 256, nbytes, np.uint8).tobytes()
+        for r in range(rounds):
+            for name, geo in arms.items():  # interleaved arms
+                base = os.path.join(root, f"{name}-{r}")
+                with open(base + ".dat", "wb") as f:
+                    f.write(blob)
+                coder = new_coder(10, 4, backend="cpu",
+                                  geometry=geo.code_geometry())
+                t0 = time.perf_counter()
+                write_ec_files(base, coder, geo)
+                arm = out["arms"][name]
+                arm["encode_wall_s"].append(
+                    round(time.perf_counter() - t0, 4))
+                total_bytes = 0
+                t_rep = 0.0
+                for lost in range(geo.total_shards):
+                    shard = geo.shard_file_name(base, lost)
+                    keep = shard + ".orig"
+                    os.replace(shard, keep)
+                    stats: dict = {}
+                    t1 = time.perf_counter()
+                    rebuilt = rebuild_ec_files(base, coder, geo,
+                                               stats=stats)
+                    t_rep += time.perf_counter() - t1
+                    assert rebuilt == [lost]
+                    with open(shard, "rb") as fa, open(keep, "rb") as fb:
+                        assert fa.read() == fb.read(), \
+                            f"{name} shard {lost} rebuild changed bytes"
+                    os.remove(keep)
+                    total_bytes += stats["survivor_bytes_read"]
+                    arm["per_loss_reads"].setdefault(
+                        str(lost), stats["survivor_shards"])
+                arm["repair_bytes_read"].append(total_bytes)
+                arm["repair_wall_s"].append(round(t_rep, 4))
+                for p in [base + ".dat"] + [
+                        geo.shard_file_name(base, i)
+                        for i in range(geo.total_shards)]:
+                    try:
+                        os.remove(p)
+                    except OSError:
+                        pass
+        rs_b = _med(out["arms"]["rs_10_4"]["repair_bytes_read"])
+        lrc_b = _med(out["arms"]["lrc_10_2_2"]["repair_bytes_read"])
+        out["single_shard_repair_read_ratio"] = round(lrc_b / rs_b, 4)
+        out["target_ratio"] = 0.60
+        out["ratio_ok"] = out["single_shard_repair_read_ratio"] <= 0.60
+        rs_e = _med(out["arms"]["rs_10_4"]["encode_wall_s"])
+        lrc_e = _med(out["arms"]["lrc_10_2_2"]["encode_wall_s"])
+        out["encode_overhead_pct"] = round((lrc_e / rs_e - 1) * 100, 2)
+        rs_w = _med(out["arms"]["rs_10_4"]["repair_wall_s"])
+        lrc_w = _med(out["arms"]["lrc_10_2_2"]["repair_wall_s"])
+        out["repair_wall_delta_pct"] = round((lrc_w / rs_w - 1) * 100, 2)
+        out["box_note"] = (
+            "bytes-read ratio is deterministic (plan-driven); walls are "
+            "same-box interleaved medians on a small shared sandbox")
+    finally:
+        shutil.rmtree(root, ignore_errors=True)
+    return out
+
+
 def main() -> int:
     if "--ec-ab" in sys.argv:
         # standalone EC-dispatch A/B (writes the BENCH_AB_ISSUE3.json
@@ -2052,6 +2150,17 @@ def main() -> int:
             json.dump(out, f, indent=1)
         print(json.dumps(out))
         return 0 if "qos_on" in out else 1
+    if "--repair-ab" in sys.argv:
+        # standalone repair-bandwidth A/B (ISSUE 11): rs_10_4 vs
+        # lrc_10_2_2 single-shard repair bytes read / repair wall /
+        # encode overhead; prints the BENCH_AB_ISSUE11.json artifact
+        # content and writes the artifact
+        out = _bench_repair_ab()
+        with open(os.path.join(_HERE, "BENCH_AB_ISSUE11.json"),
+                  "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps(out))
+        return 0 if out.get("ratio_ok") else 1
     if "--scrub-ab" in sys.argv:
         # standalone integrity-plane A/B (ISSUE 4): syndrome GB/s device
         # vs CPU byte-compare, scheduler on/off batch factor, pacing
@@ -2127,6 +2236,14 @@ def main() -> int:
             result["scrub"] = sab
         else:
             result["scrub_error"] = sab.get("error", "?")[:200]
+    if os.environ.get("SEAWEEDFS_TPU_REPAIRAB", "1").lower() not in (
+            "0", "false", "off"):
+        try:
+            # repair-bandwidth A/B (ISSUE 11): rs_10_4 vs lrc_10_2_2
+            # single-shard repair bytes; deterministic (plan-driven)
+            result["repair_geometry"] = _bench_repair_ab()
+        except Exception as e:  # noqa: BLE001 — headline must survive
+            result["repair_geometry_error"] = f"{e}"[:200]
     if os.environ.get("SEAWEEDFS_TPU_HTTPSAB", "0").lower() in (
             "1", "true", "on"):
         # HTTPS + zero-copy read-path A/B (ISSUE 9): OFF by default in

@@ -49,16 +49,29 @@ class AutoMeshCoder:
     a wedged tunnel is allowed to surface.
     """
 
-    def __init__(self, data_shards: int, parity_shards: int):
+    def __init__(self, data_shards: int, parity_shards: int,
+                 geometry=None):
         if data_shards <= 0 or parity_shards < 0:
             raise ValueError("bad geometry")
         if data_shards + parity_shards > 256:
             raise ValueError("at most 256 total shards in GF(256)")
+        from . import geometry as geom_mod
+
         self.data_shards = data_shards
         self.parity_shards = parity_shards
         self.total_shards = data_shards + parity_shards
+        # ISSUE 11: the code geometry (models/geometry.py) travels with
+        # the coder — backends receive its generator matrix, and the EC
+        # dispatch scheduler keys its lanes on geometry_id so
+        # mixed-geometry slabs never share a stacked dispatch
+        self.geometry = geom_mod.as_geometry(data_shards, parity_shards,
+                                             geometry)
         self._impl = None
         self._lock = threading.Lock()
+
+    @property
+    def geometry_id(self) -> str:
+        return self.geometry.name
 
     def _resolve(self):
         # shared across gRPC handler threads: single construction
@@ -71,12 +84,14 @@ class AutoMeshCoder:
 
                     if mesh.device_count() > 1:
                         self._impl = mesh.ShardedCoder(
-                            self.data_shards, self.parity_shards)
+                            self.data_shards, self.parity_shards,
+                            geometry=self.geometry)
                     else:
                         from ..ops.rs_jax import RSCodecJax
 
                         self._impl = RSCodecJax(
-                            self.data_shards, self.parity_shards)
+                            self.data_shards, self.parity_shards,
+                            geometry=self.geometry)
         return self._impl
 
     # The full ErasureCoder surface is spelled out (rather than proxied via
@@ -107,15 +122,25 @@ class AutoMeshCoder:
     def reconstruct_data(self, shards):
         return self._resolve().reconstruct_data(shards)
 
-    def reconstruct_stacked(self, present_ids, stacked, data_only=False):
+    def reconstruct_stacked(self, present_ids, stacked, data_only=False,
+                            want=None):
         """Pre-stacked survivor form; falls back to the dict path on
-        backends without a native stacked kernel."""
+        backends without a native stacked kernel. `want` (ISSUE 11) is
+        the minimal-read repair form — both device backends implement
+        it natively."""
         impl = self._resolve()
         fn = getattr(impl, "reconstruct_stacked", None)
         if fn is not None:
+            if want is not None:
+                return fn(present_ids, stacked, data_only=data_only,
+                          want=want)
             return fn(present_ids, stacked, data_only=data_only)
         from ..ops.dispatch import reconstruct_stacked_via_dict
 
+        if want is not None:
+            raise TypeError(
+                f"{type(impl).__name__} does not support minimal-read "
+                f"(want=) reconstruction")
         return reconstruct_stacked_via_dict(impl, present_ids, stacked,
                                             data_only)
 
@@ -144,34 +169,41 @@ class AutoMeshCoder:
         return self.encode_parity_stacked(stack)
 
     def reconstruct_stacked_on(self, present_ids, stacked,
-                               data_only=False, device=None):
+                               data_only=False, device=None, want=None):
         impl = self._resolve()
         fn = getattr(impl, "reconstruct_stacked_on", None)
         if fn is not None:
+            if want is not None:
+                return fn(present_ids, stacked, data_only=data_only,
+                          device=device, want=want)
             return fn(present_ids, stacked, data_only=data_only,
                       device=device)
         return self.reconstruct_stacked(present_ids, stacked,
-                                        data_only=data_only)
+                                        data_only=data_only, want=want)
 
     def reconstruct_stacked_vsharded(self, present_ids, stack,
-                                     data_only=False):
+                                     data_only=False, want=None):
         """Uniform survivor stacks [V, P, B] with the V axis sharded over
         the mesh; per-slab fallback on backends without the variant."""
         impl = self._resolve()
         fn = getattr(impl, "reconstruct_stacked_vsharded", None)
         if fn is not None:
+            if want is not None:
+                return fn(present_ids, stack, data_only=data_only,
+                          want=want)
             return fn(present_ids, stack, data_only=data_only)
         import numpy as _np
 
         stack = _np.asarray(stack, _np.uint8)
         outs = [self.reconstruct_stacked(present_ids, s,
-                                         data_only=data_only)
+                                         data_only=data_only, want=want)
                 for s in stack]
         if not outs:  # V=0: match the mesh variant's shape contract
             limit = (self.data_shards if data_only
                      else self.total_shards)
-            missing = tuple(i for i in range(limit)
-                            if i not in set(present_ids))
+            missing = (tuple(want) if want is not None
+                       else tuple(i for i in range(limit)
+                                  if i not in set(present_ids)))
             return missing, _np.zeros(
                 (0, len(missing), stack.shape[2] if stack.ndim == 3
                  else 0), _np.uint8)
@@ -188,7 +220,8 @@ class AutoMeshCoder:
 
 
 def new_coder(
-    data_shards: int = 10, parity_shards: int = 4, backend: str | None = None
+    data_shards: int = 10, parity_shards: int = 4,
+    backend: str | None = None, geometry=None,
 ) -> ErasureCoder:
     """reedsolomon.New(data, parity) equivalent with a backend switch.
 
@@ -199,6 +232,10 @@ def new_coder(
     per-process with SEAWEEDFS_TPU_CODER (e.g. "native" to force the C++
     host path where no accelerator helps, as in CPU-only CI; "single" to
     pin one device; "mesh" to require the mesh).
+
+    `geometry` (ISSUE 11): a models.geometry.CodeGeometry (or registered
+    name) whose generator matrix the backend multiplies — rs_10_4 when
+    omitted, bit-identical to the pre-registry coder.
     """
     import os
 
@@ -207,19 +244,19 @@ def new_coder(
     if backend == "native":
         from ..ops.rs_native import RSCodecNative
 
-        return RSCodecNative(data_shards, parity_shards)
+        return RSCodecNative(data_shards, parity_shards, geometry=geometry)
     if backend in ("tpu", "jax"):
-        return AutoMeshCoder(data_shards, parity_shards)
+        return AutoMeshCoder(data_shards, parity_shards, geometry=geometry)
     if backend == "single":
         from ..ops.rs_jax import RSCodecJax
 
-        return RSCodecJax(data_shards, parity_shards)
+        return RSCodecJax(data_shards, parity_shards, geometry=geometry)
     if backend in ("mesh", "sharded"):
         from ..parallel.mesh import ShardedCoder
 
-        return ShardedCoder(data_shards, parity_shards)
+        return ShardedCoder(data_shards, parity_shards, geometry=geometry)
     if backend in ("cpu", "numpy"):
         from ..ops.rs_cpu import RSCodecCPU
 
-        return RSCodecCPU(data_shards, parity_shards)
+        return RSCodecCPU(data_shards, parity_shards, geometry=geometry)
     raise ValueError(f"unknown erasure coder backend {backend!r}")

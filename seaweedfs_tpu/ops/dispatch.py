@@ -220,22 +220,27 @@ def reconstruct_stacked_via_dict(coder, present_ids, stacked,
 
 
 def reconstruct_now(coder, present_ids, stacked,
-                    data_only: bool = False):
+                    data_only: bool = False, want=None):
     """Synchronous stacked reconstruct through the best available path:
     the shared scheduler when the dispatch plane is on (micro-batches
     with every concurrent caller), the coder's native stacked kernel
     otherwise, the dict form as a last resort. One cascade for every
     serving call site -> (missing_ids, rows).
 
+    `want` (ISSUE 11) restricts the solve to those shard ids — the
+    minimal-read repair form, where the survivor set may be smaller
+    than k (an LRC local group) as long as it spans the wanted rows.
+
     When the caller is inside a trace span (a degraded S3 GET), the
     scheduler's per-slab attribution — queue wait, realized batch
     factor, chip, dispatch wall — lands on that span: the per-request
     answer to "was this read slow because of the device or the queue"."""
     present_ids = tuple(present_ids)
+    want = tuple(want) if want is not None else None
     sched = maybe_scheduler(coder)
     if sched is not None:
         fut = sched.reconstruct_stacked(
-            present_ids, stacked, data_only=data_only)
+            present_ids, stacked, data_only=data_only, want=want)
         out = fut.result()
         sp = trace.current()
         if sp is not None and fut.batch_slabs is not None:
@@ -248,7 +253,13 @@ def reconstruct_now(coder, present_ids, stacked,
         return out
     fn = getattr(coder, "reconstruct_stacked", None)
     if fn is not None:
+        if want is not None:
+            return fn(present_ids, stacked, data_only=data_only,
+                      want=want)
         return fn(present_ids, stacked, data_only=data_only)
+    if want is not None:
+        raise TypeError(f"{type(coder).__name__} does not support "
+                        f"minimal-read (want=) reconstruction")
     return reconstruct_stacked_via_dict(coder, present_ids, stacked,
                                         data_only)
 
@@ -256,14 +267,19 @@ def reconstruct_now(coder, present_ids, stacked,
 class EcDispatchScheduler:
     """Window-batched stacked dispatch over one coder.
 
-    Lanes:
-      ("enc",)                          — encode slabs [k, B] (single chip)
-      ("enc", chip)                     — per-chip encode lane on a mesh
+    Lanes (every key carries the coder's GEOMETRY id — ISSUE 11: stacked
+    dispatches concatenate slabs along the byte axis and multiply ONE
+    generator matrix, so slabs from different code geometries must never
+    share a lane even if a coder is ever shared across geometries):
+      ("enc", geom)                     — encode slabs [k, B] (single chip)
+      ("enc", geom, chip)               — per-chip encode lane on a mesh
                                           coder: slabs round-robin across
                                           chips, each lane flushes as ONE
                                           device-affine stacked dispatch
-      ("rec", present_ids, data_only)   — reconstruct slabs [P, B] sharing
-                                          one survivor set / fused matrix;
+      ("rec", geom, present_ids, data_only, want)
+                                        — reconstruct slabs [P, B] sharing
+                                          one survivor set / fused matrix
+                                          (want = minimal-read targets);
                                           on a mesh the whole lane is
                                           pinned to the chip holding that
                                           set's decode matrix (LRU)
@@ -279,6 +295,11 @@ class EcDispatchScheduler:
     def __init__(self, coder, window: float | None = None,
                  max_slabs: int | None = None):
         self.coder = coder
+        # geometry id baked into every lane key (ISSUE 11) — two coders
+        # with identical (k, m) but different generator matrices (rs_10_4
+        # vs lrc_10_2_2) must never stack into one device dispatch
+        self.geom_id = getattr(coder, "geometry_id", None) or \
+            f"rs_{coder.data_shards}_{coder.parity_shards}"
         self.window = window_s() if window is None else window
         self.max_slabs = max_slabs or int(
             os.environ.get("SWFS_EC_DISPATCH_MAX_SLABS",
@@ -363,7 +384,7 @@ class EcDispatchScheduler:
     def _lane_chip(self, key: tuple) -> int | None:
         """Chip index a lane is pinned to (None = single-chip path)."""
         if key[0] == "enc":
-            return key[1] if len(key) > 1 else None
+            return key[2] if len(key) > 2 else None
         with self._cv:
             return self._rec_chips.get(key)
 
@@ -391,22 +412,29 @@ class EcDispatchScheduler:
             data = data.copy()
         chips = self._chip_list()
         if chips:
-            key = ("enc", next(self._enc_rr) % len(chips))
+            key = ("enc", self.geom_id, next(self._enc_rr) % len(chips))
         else:
-            key = ("enc",)
+            key = ("enc", self.geom_id)
         return self._submit(key, data, chip=self._lane_chip(key))
 
     def reconstruct_stacked(self, present_ids, stacked: np.ndarray,
                             data_only: bool = False,
-                            copy: bool = False) -> EcFuture:
+                            copy: bool = False, want=None) -> EcFuture:
         """Submit survivors [P, B] (caller row order); the future resolves
         to (missing_ids, rows[len(missing), B]). Slabs sharing a survivor
-        set share one column-concatenated `reconstruct_stacked` dispatch,
-        pinned to the set's assigned chip on a mesh coder."""
+        set (and minimal-read target set `want`) share one
+        column-concatenated `reconstruct_stacked` dispatch, pinned to the
+        set's assigned chip on a mesh coder."""
         stacked = np.asarray(stacked, dtype=np.uint8)
         if copy:
             stacked = stacked.copy()
-        key = ("rec", tuple(present_ids), bool(data_only))
+        if want is not None and not hasattr(self.coder,
+                                            "reconstruct_stacked"):
+            raise TypeError(
+                f"{type(self.coder).__name__} does not support "
+                f"minimal-read (want=) reconstruction")
+        key = ("rec", self.geom_id, tuple(present_ids), bool(data_only),
+               tuple(want) if want is not None else None)
         chips = self._chip_list()
         chip = self._assign_rec_chip(key, len(chips)) if chips else None
         return self._submit(key, stacked, chip=chip)
@@ -577,7 +605,7 @@ class EcDispatchScheduler:
 
     def _dispatch_reconstruct(self, key: tuple, slabs: list[_Slab],
                               device=None) -> None:
-        _, present_ids, data_only = key
+        _, _geom, present_ids, data_only, want = key
         t0 = time.perf_counter()
         if not hasattr(self.coder, "reconstruct_stacked"):
             for s in slabs:  # exotic coder: per-slab dict reconstruct
@@ -595,9 +623,13 @@ class EcDispatchScheduler:
             # backlog) outgrows its single assigned chip: shard the V
             # axis over the whole mesh instead, so a lone rebuild uses
             # every chip (small serving micro-batches keep the
-            # survivor-set chip placement below)
+            # survivor-set chip placement below). `want` (the rebuild's
+            # minimal-read form) rides through — it must not demote the
+            # rebuild workload to a single chip.
             vstack = np.stack([s.data for s in slabs])
-            missing, rows = fn_v(present_ids, vstack, data_only=data_only)
+            missing, rows = fn_v(present_ids, vstack, data_only=data_only,
+                                 **({} if want is None
+                                    else {"want": want}))
             self._stamp_wall(slabs, t0)
             for i, s in enumerate(slabs):
                 s.fut._set((missing, rows[i]))
@@ -606,13 +638,14 @@ class EcDispatchScheduler:
                  if device is not None else None)
 
         def recon(stk):
+            kw = {} if want is None else {"want": want}
             if fn_on is not None:
                 # survivor-set chip placement: the fused decode matrix is
                 # resident on this lane's chip; its slabs dispatch there
                 return fn_on(present_ids, stk, data_only=data_only,
-                             device=device)
+                             device=device, **kw)
             return self.coder.reconstruct_stacked(
-                present_ids, stk, data_only=data_only)
+                present_ids, stk, data_only=data_only, **kw)
 
         if len(slabs) == 1:
             out0 = recon(slabs[0].data)
@@ -639,8 +672,8 @@ class EcDispatchScheduler:
         with self._cv:
             out: dict[str, int] = {}
             for key, lane in self._lanes.items():
-                if key[0] == "enc" and len(key) > 1:
-                    c = str(key[1])
+                if key[0] == "enc" and len(key) > 2:
+                    c = str(key[2])
                 elif key[0] == "rec":
                     idx = self._rec_chips.get(key)
                     c = "-" if idx is None else str(idx)

@@ -16,15 +16,29 @@ from . import gf256
 
 
 class RSCodecCPU:
-    def __init__(self, data_shards: int = 10, parity_shards: int = 4):
+    def __init__(self, data_shards: int = 10, parity_shards: int = 4,
+                 geometry=None):
         if data_shards <= 0 or parity_shards < 0:
             raise ValueError("bad geometry")
         if data_shards + parity_shards > 256:
             raise ValueError("at most 256 total shards in GF(256)")
+        from ..models import geometry as geom_mod
+
         self.data_shards = data_shards
         self.parity_shards = parity_shards
         self.total_shards = data_shards + parity_shards
-        self._gp = gf256.parity_matrix(data_shards, parity_shards)
+        # pluggable code geometry (ISSUE 11): the codec is a generic GF
+        # matrix engine — the CODE is the generator matrix. None keeps
+        # the legacy RS path (and its exact matrices) byte-for-byte.
+        self.geometry = geom_mod.as_geometry(data_shards, parity_shards,
+                                             geometry)
+        self._gp = (gf256.parity_matrix(data_shards, parity_shards)
+                    if self.geometry.is_rs
+                    else self.geometry.parity_matrix())
+
+    @property
+    def geometry_id(self) -> str:
+        return self.geometry.name
 
     def _matmul(self, matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
         """GF(256) matmul hook — overridden by the native C++ backend.
@@ -104,6 +118,16 @@ class RSCodecCPU:
         missing = [i for i in range(self.total_shards) if i not in present]
         if not missing:
             return {}
+        if not self.geometry.is_rs:
+            # geometry-general path: one solved [missing, P] matrix (same
+            # mechanism the repair planner uses — for RS the legacy path
+            # below produces identical bytes and stays untouched)
+            pres = tuple(sorted(present))
+            x = self.geometry.repair_matrix(pres, tuple(missing))
+            rows = self._matmul(
+                x, np.stack([np.asarray(present[i], np.uint8)
+                             for i in pres]))
+            return {i: rows[j] for j, i in enumerate(missing)}
         dec, used = gf256.decode_matrix_for(
             self.data_shards, self.parity_shards, sorted(present.keys())
         )
@@ -121,18 +145,33 @@ class RSCodecCPU:
         return out
 
     def reconstruct_stacked(
-        self, present_ids, stacked: np.ndarray, data_only: bool = False
+        self, present_ids, stacked: np.ndarray, data_only: bool = False,
+        want: tuple[int, ...] | None = None,
     ) -> tuple[tuple[int, ...], np.ndarray]:
         """Pre-stacked survivors [P, B] in caller row order ->
         (missing_ids, [len(missing), B]) — CPU mirror of
         RSCodecJax.reconstruct_stacked so the EC dispatch scheduler's
         column-concatenated reconstruct batches run identically off
         device. Same survivor-subset choice (sorted ids, first k) as the
-        fused device matrix, so bytes match bit-for-bit."""
-        from .dispatch import reconstruct_stacked_via_dict
+        fused device matrix, so bytes match bit-for-bit.
 
+        `want` (ISSUE 11) restricts the solve to those shard ids — the
+        minimal-read repair form: the survivor set may then be SMALLER
+        than k (an LRC local group) as long as it spans the wanted rows."""
+        present_ids = tuple(present_ids)
         stacked = np.asarray(stacked, dtype=np.uint8)
         assert stacked.shape[0] == len(present_ids), stacked.shape
+        if want is not None or not self.geometry.is_rs:
+            targets = tuple(want) if want is not None else tuple(
+                i for i in range((self.data_shards if data_only
+                                  else self.total_shards))
+                if i not in set(present_ids))
+            if not targets:
+                return (), np.zeros((0, stacked.shape[1]), np.uint8)
+            x = self.geometry.repair_matrix(present_ids, targets)
+            return targets, self._matmul(x, stacked)
+        from .dispatch import reconstruct_stacked_via_dict
+
         return reconstruct_stacked_via_dict(self, present_ids, stacked,
                                             data_only)
 

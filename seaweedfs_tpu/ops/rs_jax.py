@@ -264,6 +264,50 @@ def parity_matrix_op(data_shards: int, parity_shards: int,
     return _derived(form, ("parity", data_shards, parity_shards), gp)
 
 
+# -- geometry-general operands (ISSUE 11) ------------------------------------
+#
+# Non-RS code geometries (models/geometry.py) ride the exact same kernel
+# machinery with their own generator matrices; cache keys carry the
+# geometry NAME so rs_10_4's keys — and therefore its bytes and its
+# compiled kernels — are untouched. The RS paths above stay the oracle.
+
+
+def geom_parity_key(geom) -> tuple:
+    return ("gparity", geom.name)
+
+
+def geom_parity_op(geom, form: str) -> np.ndarray:
+    """Derived-form parity operand for an arbitrary code geometry."""
+    return _derived(form, geom_parity_key(geom), geom.parity_matrix())
+
+
+@functools.lru_cache(maxsize=2048)
+def geom_stacked_matrix(geom, present_ids: tuple[int, ...],
+                        targets: tuple[int, ...]) -> np.ndarray:
+    """Byte-form [len(targets), len(present_ids)] repair matrix in the
+    CALLER's survivor row order (models.geometry.repair_matrix is
+    already column-ordered by its `present_ids` argument)."""
+    return geom.repair_matrix(present_ids, targets)
+
+
+def geom_stacked_op(geom, present_ids: tuple[int, ...],
+                    targets: tuple[int, ...],
+                    form: str) -> np.ndarray:
+    pm = geom_stacked_matrix(geom, present_ids, targets)
+    op = _derived(form, ("gdecs", geom.name, present_ids, targets), pm)
+    return op
+
+
+def geom_targets_for(geom, present_ids: tuple[int, ...],
+                     data_only: bool, want) -> tuple[int, ...]:
+    """The rows a stacked reconstruct solves: `want` verbatim, else the
+    complement of the survivor set under the data/total limit."""
+    if want is not None:
+        return tuple(want)
+    limit = geom.data_shards if data_only else geom.total_shards
+    return tuple(i for i in range(limit) if i not in set(present_ids))
+
+
 @functools.partial(jax.jit, static_argnums=(1, 2))
 def _encode_jit(data: jax.Array, data_shards: int, parity_shards: int) -> jax.Array:
     gp = gf256.parity_matrix(data_shards, parity_shards)
@@ -340,7 +384,7 @@ def _dispatch_matmul(matrix: np.ndarray, data: jax.Array, out_rows: int,
         # don't plumb — and bytes are identical across all formulations
         kind = kind.replace("-pallas", "-xla")
         data = jax.device_put(data, device)
-    if kind.startswith("sel-") and key[0] in ("fdec", "fdecs"):
+    if kind.startswith("sel-") and key[0] in ("fdec", "fdecs", "gdecs"):
         # sel kernels specialize on the static matrix; fused reconstruct
         # matrices (one per survivor+missing set, up to C(n,k) of them)
         # would recompile per failure pattern — route those to the
@@ -392,14 +436,23 @@ class RSCodecJax:
     [total, B] or [k, B] uint8 arrays rather than Go byte-slice lists.
     """
 
-    def __init__(self, data_shards: int = 10, parity_shards: int = 4):
+    def __init__(self, data_shards: int = 10, parity_shards: int = 4,
+                 geometry=None):
         if data_shards <= 0 or parity_shards < 0:
             raise ValueError("bad geometry")
         if data_shards + parity_shards > 256:
             raise ValueError("at most 256 total shards in GF(256)")
+        from ..models import geometry as geom_mod
+
         self.data_shards = data_shards
         self.parity_shards = parity_shards
         self.total_shards = data_shards + parity_shards
+        self.geometry = geom_mod.as_geometry(data_shards, parity_shards,
+                                             geometry)
+
+    @property
+    def geometry_id(self) -> str:
+        return self.geometry.name
 
     # -- Encode ------------------------------------------------------------
 
@@ -415,6 +468,12 @@ class RSCodecJax:
         data = jnp.asarray(data, dtype=jnp.uint8)
         assert data.shape[0] == self.data_shards, data.shape
         b = data.shape[1]
+        if not self.geometry.is_rs:
+            # non-RS geometry: same kernels, its own generator matrix
+            # (cache keys carry the geometry name, never (k, m))
+            return _dispatch_matmul(
+                self.geometry.parity_matrix(), data, self.parity_shards,
+                key=geom_parity_key(self.geometry), device=device)
         if device is not None or _kernel_choice(b) != "mxu-xla":
             gp = gf256.parity_matrix(self.data_shards, self.parity_shards)
             key = ("parity", self.data_shards, self.parity_shards)
@@ -483,6 +542,13 @@ class RSCodecJax:
         if not missing:
             return {}
         pres = tuple(sorted(present.keys()))
+        if not self.geometry.is_rs:
+            pm = geom_stacked_matrix(self.geometry, pres, missing)
+            key = ("gdecs", self.geometry.name, pres, missing)
+            stacked = jnp.stack([jnp.asarray(present[i], jnp.uint8)
+                                 for i in pres])
+            out = _dispatch_matmul(pm, stacked, len(missing), key=key)
+            return {i: out[j] for j, i in enumerate(missing)}
         fmat, used = fused_reconstruct_matrix(
             self.data_shards, self.parity_shards, pres, missing)
         key = ("fdec", self.data_shards, self.parity_shards, pres, missing)
@@ -493,7 +559,7 @@ class RSCodecJax:
     def reconstruct_stacked(
         self, present_ids: tuple[int, ...],
         stacked: np.ndarray | jax.Array, data_only: bool = False,
-        device=None,
+        device=None, want: tuple[int, ...] | None = None,
     ) -> tuple[tuple[int, ...], jax.Array]:
         """Reconstruct from survivors already stacked [P, B] in caller
         row order -> (missing_ids, [len(missing), B]).
@@ -516,6 +582,19 @@ class RSCodecJax:
             stacked = jax.device_put(np.asarray(stacked, np.uint8), device)
         stacked = jnp.asarray(stacked, jnp.uint8)
         assert stacked.shape[0] == len(present_ids), stacked.shape
+        if want is not None or not self.geometry.is_rs:
+            # geometry-general / minimal-read form (ISSUE 11): solve only
+            # the wanted rows — the survivor set may be smaller than k
+            # (an LRC local group) as long as it spans them
+            targets = geom_targets_for(self.geometry, present_ids,
+                                       data_only, want)
+            if not targets:
+                return (), jnp.zeros((0, stacked.shape[1]), jnp.uint8)
+            pm = geom_stacked_matrix(self.geometry, present_ids, targets)
+            key = ("gdecs", self.geometry.name, present_ids, targets)
+            out = _dispatch_matmul(pm, stacked, len(targets), key=key,
+                                   device=device)
+            return targets, out
         missing, pm = fused_reconstruct_stacked_matrix(
             self.data_shards, self.parity_shards, present_ids, limit)
         if not missing:
@@ -552,11 +631,13 @@ class RSCodecJax:
         return {i: s for i, s in enumerate(shards) if s is not None}
 
     def __hash__(self):  # for lru_cache on methods
-        return hash((self.data_shards, self.parity_shards))
+        return hash((self.data_shards, self.parity_shards,
+                     self.geometry.name))
 
     def __eq__(self, other):
         return (
             isinstance(other, RSCodecJax)
             and self.data_shards == other.data_shards
             and self.parity_shards == other.parity_shards
+            and self.geometry.name == other.geometry.name
         )

@@ -87,9 +87,10 @@ def crc32c_native(data: bytes | np.ndarray, seed: int = 0) -> int:
 class RSCodecNative(RSCodecCPU):
     """RSCodecCPU with the GF matmul routed through the C++ kernel."""
 
-    def __init__(self, data_shards: int = 10, parity_shards: int = 4):
+    def __init__(self, data_shards: int = 10, parity_shards: int = 4,
+                 geometry=None):
         load_library()  # fail fast if the toolchain is missing
-        super().__init__(data_shards, parity_shards)
+        super().__init__(data_shards, parity_shards, geometry=geometry)
 
     def _matmul(self, matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
         return gf_matmul_native(matrix, data)

@@ -47,6 +47,9 @@ except AttributeError:  # pragma: no cover - depends on jax version
 from ..ops.rs_jax import (
     fused_reconstruct_op,
     fused_reconstruct_stacked_op,
+    geom_parity_op,
+    geom_stacked_op,
+    geom_targets_for,
     gf_matmul_bits,
     parity_matrix_op,
 )
@@ -153,16 +156,21 @@ class ShardedCoder:
     """
 
     def __init__(self, data_shards: int = 10, parity_shards: int = 4,
-                 mesh: Mesh | None = None, kernel: str = "xor"):
+                 mesh: Mesh | None = None, kernel: str = "xor",
+                 geometry=None):
         if data_shards <= 0 or parity_shards < 0:
             raise ValueError("bad geometry")
         if data_shards + parity_shards > 256:
             raise ValueError("at most 256 total shards in GF(256)")
         if kernel not in ("xor", "bits"):
             raise ValueError(f"kernel must be 'xor' or 'bits', got {kernel!r}")
+        from ..models import geometry as geom_mod
+
         self.data_shards = data_shards
         self.parity_shards = parity_shards
         self.total_shards = data_shards + parity_shards
+        self.geometry = geom_mod.as_geometry(data_shards, parity_shards,
+                                             geometry)
         self.mesh = mesh if mesh is not None else make_mesh()
         self.axis = self.mesh.axis_names[0]
         self._n = self.mesh.devices.size
@@ -171,7 +179,13 @@ class ShardedCoder:
         self.kernel = kernel
         self._parity_op = jnp.asarray(
             parity_matrix_op(data_shards, parity_shards, kernel)
+            if self.geometry.is_rs
+            else geom_parity_op(self.geometry, kernel)
         )
+
+    @property
+    def geometry_id(self) -> str:
+        return self.geometry.name
 
     # -- sharding helpers --------------------------------------------------
 
@@ -247,20 +261,27 @@ class ShardedCoder:
             parity.reshape(self.parity_shards, v, b), 0, 1)
 
     def reconstruct_stacked_vsharded(self, present_ids, stack,
-                                     data_only: bool = False):
+                                     data_only: bool = False, want=None):
         """Uniform-width survivor stacks [V, P, B] -> (missing_ids,
         [V, len(missing), B]) with the V axis sharded across chips —
         every chip reconstructs whole slabs through the same fused
-        column-permuted matrix (same GF math as reconstruct_stacked, so
-        bytes are identical slab for slab)."""
+        column-permuted matrix (same GF math as reconstruct_stacked,
+        including the `want` minimal-read form, so bytes are identical
+        slab for slab)."""
         present_ids = tuple(present_ids)
         stack = np.asarray(stack, dtype=np.uint8)
         assert stack.ndim == 3 and stack.shape[1] == len(present_ids), \
             stack.shape
         limit = self.data_shards if data_only else self.total_shards
-        missing, op_np = fused_reconstruct_stacked_op(
-            self.data_shards, self.parity_shards, present_ids, limit,
-            self.kernel)
+        if want is not None or not self.geometry.is_rs:
+            missing = geom_targets_for(self.geometry, present_ids,
+                                       data_only, want)
+            op_np = (geom_stacked_op(self.geometry, present_ids, missing,
+                                     self.kernel) if missing else None)
+        else:
+            missing, op_np = fused_reconstruct_stacked_op(
+                self.data_shards, self.parity_shards, present_ids, limit,
+                self.kernel)
         if not missing:
             return (), jnp.zeros(
                 (stack.shape[0], 0, stack.shape[2]), jnp.uint8)
@@ -293,7 +314,8 @@ class ShardedCoder:
             from ..ops.rs_jax import RSCodecJax
 
             impl = self.__dict__["_chip_impl"] = RSCodecJax(
-                self.data_shards, self.parity_shards)
+                self.data_shards, self.parity_shards,
+                geometry=self.geometry)
         return impl
 
     def encode_parity_stacked_on(self, stack, device) -> jax.Array:
@@ -304,12 +326,14 @@ class ShardedCoder:
                                                         device=device)
 
     def reconstruct_stacked_on(self, present_ids, stacked,
-                               data_only: bool = False, device=None):
+                               data_only: bool = False, device=None,
+                               want=None):
         """Pre-stacked survivors [P, B] reconstructed on `device`; the
         survivor set's fused decode matrix is cached device-resident
         (ops/rs_jax._op_on_device, LRU)."""
         return self._chip_codec().reconstruct_stacked(
-            present_ids, stacked, data_only=data_only, device=device)
+            present_ids, stacked, data_only=data_only, device=device,
+            want=want)
 
     def encode(self, shards) -> jax.Array:
         """[k, B] data or [total, B] shards -> all [total, B] shards with
@@ -337,12 +361,19 @@ class ShardedCoder:
         missing = tuple(i for i in range(limit) if i not in present)
         if not missing:
             return {}
-        # one fused [missing, k] matmul — parity rows are folded through
-        # the decode matrix host-side (rs_jax.fused_reconstruct_matrix),
-        # so no second mesh-wide encode dispatch
-        op_np, used = fused_reconstruct_op(
-            self.data_shards, self.parity_shards,
-            tuple(sorted(present.keys())), missing, self.kernel)
+        if not self.geometry.is_rs:
+            pres = tuple(sorted(present.keys()))
+            op_np = geom_stacked_op(self.geometry, pres, missing,
+                                    self.kernel)
+            used = pres
+        else:
+            # one fused [missing, k] matmul — parity rows are folded
+            # through the decode matrix host-side
+            # (rs_jax.fused_reconstruct_matrix), so no second mesh-wide
+            # encode dispatch
+            op_np, used = fused_reconstruct_op(
+                self.data_shards, self.parity_shards,
+                tuple(sorted(present.keys())), missing, self.kernel)
         fused_op = jnp.asarray(op_np)
         stacked = np.stack([np.asarray(present[i], np.uint8) for i in used])
         arr, b = self._shard(stacked)
@@ -352,17 +383,24 @@ class ShardedCoder:
         return {i: out_arr[j][:b] for j, i in enumerate(missing)}
 
     def reconstruct_stacked(self, present_ids, stacked,
-                            data_only: bool = False):
+                            data_only: bool = False, want=None):
         """Pre-stacked survivors [P, B] in caller row order ->
         (missing_ids, [missing, B]) — the column-permuted fused matmul
         sharded over the mesh, no re-stack/gather (same contract as
-        RSCodecJax.reconstruct_stacked)."""
+        RSCodecJax.reconstruct_stacked, including the ISSUE-11 `want`
+        minimal-read form)."""
         present_ids = tuple(present_ids)
         assert stacked.shape[0] == len(present_ids), stacked.shape
         limit = self.data_shards if data_only else self.total_shards
-        missing, op_np = fused_reconstruct_stacked_op(
-            self.data_shards, self.parity_shards, present_ids, limit,
-            self.kernel)
+        if want is not None or not self.geometry.is_rs:
+            missing = geom_targets_for(self.geometry, present_ids,
+                                       data_only, want)
+            op_np = (geom_stacked_op(self.geometry, present_ids, missing,
+                                     self.kernel) if missing else None)
+        else:
+            missing, op_np = fused_reconstruct_stacked_op(
+                self.data_shards, self.parity_shards, present_ids, limit,
+                self.kernel)
         if not missing:
             return (), jnp.zeros((0, stacked.shape[1]), jnp.uint8)
         # hand the buffer to _shard untouched: a device-resident,

@@ -98,7 +98,8 @@ def _build() -> descriptor_pb2.FileDescriptorProto:
         (2, "collection", "string"),
         (3, "data_shards", "uint32"),
         (4, "parity_shards", "uint32"),
-        (5, "targets", "EcStreamTarget", "repeated"))
+        (5, "targets", "EcStreamTarget", "repeated"),
+        (6, "geometry", "string"))     # code-geometry name (ISSUE 11)
     msg("EcStreamTargetResult",
         (1, "address", "string"),
         (2, "ok", "bool"),

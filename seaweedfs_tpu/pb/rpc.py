@@ -20,6 +20,7 @@ from concurrent import futures
 import grpc
 
 from . import (
+    ec_geometry_pb2,
     ec_stream_pb2,
     filer_pb2,
     master_pb2,
@@ -109,8 +110,14 @@ VOLUME_SERVICE = ("volume_server_pb.VolumeServer", [
     _m("ReadAllNeedles", V.ReadAllNeedlesRequest, V.ReadAllNeedlesResponse, ss=True),
     _m("VolumeTailSender", V.VolumeTailSenderRequest, V.VolumeTailSenderResponse, ss=True),
     _m("VolumeTailReceiver", V.VolumeTailReceiverRequest, V.VolumeTailReceiverResponse),
-    _m("VolumeEcShardsGenerate", V.VolumeEcShardsGenerateRequest, V.VolumeEcShardsGenerateResponse),
-    _m("VolumeEcShardsRebuild", V.VolumeEcShardsRebuildRequest, V.VolumeEcShardsRebuildResponse),
+    # geometry-aware forms (ec_geometry.proto; messages in
+    # pb/ec_geometry_pb2.py): wire-compatible supersets of the original
+    # volume_server_pb2 request/response types — field numbers coincide,
+    # so old-style messages serialize through them unchanged
+    _m("VolumeEcShardsGenerate", ec_geometry_pb2.EcGenerateRequest,
+       V.VolumeEcShardsGenerateResponse),
+    _m("VolumeEcShardsRebuild", ec_geometry_pb2.EcRebuildRequest,
+       ec_geometry_pb2.EcRebuildResponse),
     _m("VolumeEcShardsCopy", V.VolumeEcShardsCopyRequest, V.VolumeEcShardsCopyResponse),
     _m("VolumeEcShardsDelete", V.VolumeEcShardsDeleteRequest, V.VolumeEcShardsDeleteResponse),
     _m("VolumeEcShardsMount", V.VolumeEcShardsMountRequest, V.VolumeEcShardsMountResponse),

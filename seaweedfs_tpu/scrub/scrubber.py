@@ -648,13 +648,11 @@ class Scrubber:
     # ---- EC volumes: syndrome verify through the dispatch scheduler
 
     def _geo_coder(self, geo):
-        coder = self.store.coder
-        if (coder.data_shards, coder.parity_shards) == (geo.data_shards,
-                                                        geo.parity_shards):
-            return coder
-        from ..models.coder import new_coder
-
-        return new_coder(geo.data_shards, geo.parity_shards)
+        # per-code-geometry coders cached on the store (ISSUE 11): the
+        # syndrome re-encode must multiply THIS volume's generator
+        # matrix — local and global parity rows alike — and its slabs
+        # must never stack into another geometry's dispatch lane
+        return self.store.coder_for(geo)
 
     def _verify_ec_volume(self, loc, vid: int, full: bool, repair: bool,
                           report: ScrubReport, _depth: int = 0) -> None:

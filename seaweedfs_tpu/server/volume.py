@@ -665,6 +665,10 @@ class VolumeServer:
                                   exclude, tsp) -> bytes:
         geo = ev.geo
         exclude = exclude or set()
+        out = self._reconstruct_range_planned(ev, vid, sid, soff, size,
+                                              locs, exclude, tsp)
+        if out is not None:
+            return out
         bufs: dict[int, np.ndarray] = {}
         for i, f in ev.shard_files.items():
             if i in exclude:
@@ -694,24 +698,150 @@ class VolumeServer:
                     continue
             return i, None
 
-        if len(bufs) < geo.data_shards and missing:
+        from ..models.geometry import UnsolvableError
+
+        try:
+            geom = geo.code_geometry()
+        except ValueError:
+            geom = None
+
+        def solvable() -> bool:
+            # RS: any k survivors decode (the historical count check).
+            # Non-RS: k survivors may be rank-deficient for THIS shard
+            # (e.g. a local parity among them) — ask the solver, so the
+            # remote fetch keeps going until sid is actually spanned.
+            if geom is None or geom.is_rs:
+                return len(bufs) >= geo.data_shards
+            if sid in bufs:
+                return True
+            try:
+                geom.repair_matrix(tuple(sorted(bufs)), (sid,))
+                return True
+            except (UnsolvableError, ValueError):
+                return False
+
+        if not solvable() and missing:
             with ThreadPoolExecutor(max_workers=8) as ex:
                 for i, arr in ex.map(fetch, missing):
                     if arr is not None:
                         bufs[i] = arr
-                    if len(bufs) >= geo.data_shards:
+                    if solvable():
                         break
-        if len(bufs) < geo.data_shards:
+        if not solvable():
             raise IOError(
-                f"ec volume {vid}: only {len(bufs)} shards reachable, "
-                f"need {geo.data_shards}")
+                f"ec volume {vid}: {len(bufs)} reachable shards "
+                f"({geo.code_name}) cannot reconstruct shard {sid}")
         if sid in bufs:  # a flaky local read healed mid-gather
             return bufs[sid].tobytes()
         pres = tuple(sorted(bufs))  # canonical order -> shared lane
         tsp.set_attr(survivors=len(pres))
-        mids, rows = dispatch.reconstruct_now(
-            self.store.coder, pres, np.stack([bufs[i] for i in pres]))
-        return np.asarray(rows[mids.index(sid)], np.uint8).tobytes()
+        # RS keeps want=None: concurrent readers of DIFFERENT lost
+        # shards sharing a survivor set coalesce into one fused dispatch
+        # (the ISSUE-3 micro-batch); non-RS solves just sid — the full
+        # complement may be unsolvable even when sid is.
+        want = None if (geom is None or geom.is_rs) else (sid,)
+        try:
+            mids, rows = dispatch.reconstruct_now(
+                ev.coder, pres, np.stack([bufs[i] for i in pres]),
+                want=want)
+        except (UnsolvableError, ValueError) as e:
+            raise IOError(
+                f"ec volume {vid}: survivors {pres} do not span "
+                f"shard {sid}") from e
+        return np.asarray(rows[list(mids).index(sid)],
+                          np.uint8).tobytes()
+
+    def _reconstruct_range_planned(self, ev, vid, sid, soff, size, locs,
+                                   exclude, tsp) -> bytes | None:
+        """Minimal-read degraded reconstruct (ISSUE 11): the geometry's
+        repair plan names the survivors — a lost shard inside an LRC
+        local group gathers its 5 group peers (local reads preferred)
+        instead of any k=10. Returns None when a planned read fails or
+        the plan is unsolvable; the caller then runs the generic any-k
+        gather, which remains the correctness backstop."""
+        from ..models.geometry import UnsolvableError
+        from ..utils.stats import EC_REPAIR_BYTES, EC_REPAIR_PLANS
+
+        geo = ev.geo
+        try:
+            geom = geo.code_geometry()
+        except ValueError:
+            return None
+        local = set(ev.shard_files) - exclude - {sid}
+        remote = {i for i, addrs in locs.items()
+                  if addrs and i not in exclude and i != sid} - local
+        plan = None
+        for cand in (tuple(sorted(local)),
+                     tuple(sorted(local | remote))):
+            try:
+                plan = geom.repair_plan((sid,), cand)
+                break
+            except (UnsolvableError, ValueError):
+                continue
+        if plan is None:
+            return None
+        bufs: dict[int, np.ndarray] = {}
+        need_remote: list[int] = []
+        for i in plan.reads:
+            f = ev.shard_files.get(i)
+            if f is not None and i not in exclude:
+                try:
+                    failpoint.fail("ec.shard.read",
+                                   ctx=f"{self.address}, shard={i},")
+                    data = f.read_at(soff, size)
+                    bufs[i] = np.frombuffer(
+                        data + b"\0" * (size - len(data)), np.uint8)
+                    continue
+                except OSError:
+                    pass  # fall through to a remote copy, if any
+            need_remote.append(i)
+        n_local = len(bufs)
+
+        def fetch_planned(i):
+            for addr in locs.get(i, []):
+                if addr == self.address:
+                    continue
+                try:
+                    return i, np.frombuffer(self._remote_shard_read(
+                        addr, vid, i, soff, size), np.uint8)
+                except grpc.RpcError:
+                    continue
+            return i, None
+
+        if need_remote:
+            # gather the plan's remote survivors CONCURRENTLY — the
+            # minimal-read path must pay max(RTT), not sum(RTT), or it
+            # loses to the parallel any-k backstop it exists to beat
+            with ThreadPoolExecutor(
+                    max_workers=min(8, len(need_remote))) as ex:
+                for i, arr in ex.map(fetch_planned, need_remote):
+                    if arr is None:
+                        return None  # planned survivor unreachable:
+                        #              generic path takes over
+                    bufs[i] = arr
+        n_remote = len(need_remote)
+        pres = tuple(sorted(bufs))  # canonical order -> shared lane
+        tsp.set_attr(survivors=len(pres), repairPlan=geo.code_name)
+        # RS: want=None so concurrent readers of different lost shards
+        # sharing a survivor set keep coalescing into ONE fused dispatch
+        # (ISSUE 3); non-RS solves exactly sid (the plan's survivor set
+        # may not span the full complement)
+        want = None if geom.is_rs else (sid,)
+        try:
+            mids, rows = dispatch.reconstruct_now(
+                ev.coder, pres, np.stack([bufs[i] for i in pres]),
+                want=want)
+        except (UnsolvableError, ValueError, TypeError):
+            return None
+        if n_local:
+            EC_REPAIR_BYTES.inc(n_local * size, geometry=geo.code_name,
+                                kind="degraded_read", source="local")
+        if n_remote:
+            EC_REPAIR_BYTES.inc(n_remote * size, geometry=geo.code_name,
+                                kind="degraded_read", source="remote")
+        EC_REPAIR_PLANS.inc(geometry=geo.code_name, kind="degraded_read")
+        return np.asarray(rows[list(mids).index(sid)],
+                          np.uint8).tobytes()
 
     def _lookup_ec_shards(self, vid: int) -> dict[int, list[str]]:
         """cachedLookupEcShardLocations (store_ec.go:238), 10s TTL."""
@@ -1128,7 +1258,36 @@ class VolumeGrpc:
         if request.collection and v.collection != request.collection:
             context.abort(grpc.StatusCode.INVALID_ARGUMENT, "collection mismatch")
         geo = self.srv.ec_geometry
-        if request.data_shards:
+        code = getattr(request, "geometry", "")
+        if code:
+            # registry-backed validation (ISSUE 11): an unknown geometry
+            # name fails fast, listing what IS registered
+            from ..models import geometry as geom_mod
+
+            try:
+                cg = geom_mod.get(code)
+            except ValueError as e:
+                context.abort(grpc.StatusCode.INVALID_ARGUMENT, str(e))
+            if not cg.volume_capable:
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"geometry {code!r} is not volume-capable "
+                    f"(stripe-level codec only)")
+            if (request.data_shards
+                    and request.data_shards != cg.data_shards) or \
+                    (request.parity_shards
+                     and request.parity_shards != cg.parity_shards):
+                context.abort(
+                    grpc.StatusCode.INVALID_ARGUMENT,
+                    f"geometry {code!r} is {cg.data_shards}+"
+                    f"{cg.parity_shards}; -dataShards/-parityShards "
+                    f"disagree")
+            geo = Geometry(data_shards=cg.data_shards,
+                           parity_shards=cg.parity_shards,
+                           large_block=geo.large_block,
+                           small_block=geo.small_block,
+                           code=cg.name)
+        elif request.data_shards:
             geo = Geometry(data_shards=request.data_shards,
                            parity_shards=request.parity_shards or 4,
                            large_block=geo.large_block,
@@ -1157,6 +1316,10 @@ class VolumeGrpc:
             "version": v.version,
             "dataShards": geo.data_shards, "parityShards": geo.parity_shards,
             "largeBlock": geo.large_block, "smallBlock": geo.small_block,
+            # the code geometry travels WITH the shard set: readable at
+            # mount, so mixed-geometry clusters decode every volume with
+            # the right generator matrix (ISSUE 11)
+            "geometry": geo.code_name,
         })
         VOLUME_SERVER_EC_ENCODE_BYTES.inc(v.data_size())
         glog.v(0, f"ec encode vol {v.id}: {v.data_size()} bytes in "
@@ -1431,17 +1594,28 @@ class VolumeGrpc:
         except QosUnavailable as e:
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
         pace = self.srv.qos_governor.pacer("repair", prepaid=probe)
+        # `shard_ids` (geometry-aware request form): the genuinely-
+        # missing set cluster-wide — locally-absent shards that exist on
+        # peers need no rebuild, and the minimal-read plan only covers
+        # the asked-for shards
+        want = list(getattr(request, "shard_ids", [])) or None
+        rstats: dict = {}
         try:
-            rebuilt = rebuild_ec_files(base, coder, geo, pace=pace)
+            rebuilt = rebuild_ec_files(base, coder, geo, pace=pace,
+                                       want=want, stats=rstats)
         except QosUnavailable as e:
             context.abort(grpc.StatusCode.RESOURCE_EXHAUSTED, str(e))
+        from ..pb import ec_geometry_pb2 as eg
         from ..storage.ec_volume import rebuild_ecx_file
 
         rebuild_ecx_file(base)
         self.srv.scrubber.invalidate_ec_digest(request.volume_id,
                                                remove_manifest=True)
         self.srv.trigger_heartbeat()
-        return vs.VolumeEcShardsRebuildResponse(rebuilt_shard_ids=rebuilt)
+        return eg.EcRebuildResponse(
+            rebuilt_shard_ids=rebuilt, geometry=geo.code_name,
+            survivor_bytes_read=rstats.get("survivor_bytes_read", 0),
+            survivor_shards=rstats.get("survivor_shards", 0))
 
     def VolumeEcShardsCopy(self, request, context):
         """Pull shard files from source_data_node (handler :126-177).
@@ -1885,16 +2059,14 @@ class VolumeGrpc:
             parity_shards=info.get("parityShards", d.parity_shards),
             large_block=info.get("largeBlock", d.large_block),
             small_block=info.get("smallBlock", d.small_block),
+            code=info.get("geometry", ""),
         )
 
     def _geo_coder(self, geo: Geometry):
-        coder = self.store.coder
-        if (coder.data_shards, coder.parity_shards) == (geo.data_shards,
-                                                        geo.parity_shards):
-            return coder
-        from ..models.coder import new_coder
-
-        return new_coder(geo.data_shards, geo.parity_shards)
+        # per-geometry coders are cached on the store (ISSUE 11) — each
+        # owns its own dispatch scheduler, keeping mixed-geometry slabs
+        # out of one stacked dispatch
+        return self.store.coder_for(geo)
 
 
 # -- HTTP data plane -------------------------------------------------------

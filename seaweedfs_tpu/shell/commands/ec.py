@@ -17,7 +17,12 @@ import os
 from collections import defaultdict
 from concurrent.futures import ThreadPoolExecutor
 
-from ...pb import ec_stream_pb2 as es, master_pb2, volume_server_pb2 as vs
+from ...pb import (
+    ec_geometry_pb2 as eg,
+    ec_stream_pb2 as es,
+    master_pb2,
+    volume_server_pb2 as vs,
+)
 from ..registry import command
 
 
@@ -59,6 +64,11 @@ def ec_encode(env, args, out):
     p.add_argument("-fullPercent", type=float, default=95.0)
     p.add_argument("-dataShards", type=int, default=0)
     p.add_argument("-parityShards", type=int, default=0)
+    p.add_argument("-geometry", default="",
+                   help="code geometry name from the registry "
+                        "(models/geometry.py), e.g. rs_10_4 (default) or "
+                        "lrc_10_2_2 — locally-repairable: single-shard "
+                        "repair reads 5 survivors instead of 10")
     p.add_argument("-parallelCopy", type=int, default=10)
     p.add_argument("-parallelEncode", type=int, default=4,
                    help="volumes erasure-coded concurrently; concurrent "
@@ -74,6 +84,7 @@ def ec_encode(env, args, out):
                         "generate-then-copy")
     opts = p.parse_args(args)
     env.confirm_is_locked()
+    _validate_geometry_opt(opts, out)
 
     from ...utils import trace
 
@@ -151,6 +162,41 @@ class _SharedPlacement:
         self.rack_load: dict[tuple[str, str], int] = defaultdict(int)
 
 
+def _validate_geometry_opt(opts, out) -> None:
+    """Registry-backed -geometry validation (ISSUE 11): fail fast in the
+    shell, before any replica is frozen, with the registered names in
+    the error."""
+    if not getattr(opts, "geometry", ""):
+        return
+    from ...models import geometry as geom_mod
+
+    try:
+        cg = geom_mod.get(opts.geometry)
+    except ValueError as e:
+        print(str(e), file=out)
+        raise
+    if not cg.volume_capable:
+        msg = (f"geometry {opts.geometry!r} is not volume-capable "
+               f"(stripe-level codec only); volume-capable: "
+               f"{[n for n in geom_mod.names() if geom_mod.get(n).volume_capable]}")
+        print(msg, file=out)
+        raise ValueError(msg)
+    if (opts.dataShards and opts.dataShards != cg.data_shards) or \
+            (opts.parityShards and opts.parityShards != cg.parity_shards):
+        msg = (f"geometry {opts.geometry!r} is {cg.data_shards}+"
+               f"{cg.parity_shards}; -dataShards/-parityShards disagree")
+        print(msg, file=out)
+        raise ValueError(msg)
+
+
+def _geometry_total_shards(opts) -> int:
+    if getattr(opts, "geometry", ""):
+        from ...models import geometry as geom_mod
+
+        return geom_mod.get(opts.geometry).total_shards
+    return (opts.dataShards or 10) + (opts.parityShards or 4)
+
+
 def _stream_enabled(opts) -> bool:
     """-stream flag wins; else SWFS_EC_STREAM env (default on)."""
     if getattr(opts, "stream", None) is not None:
@@ -195,7 +241,7 @@ def _do_ec_encode(env, vid: int, opts, out, shared=None) -> None:
         raise ValueError(f"volume {vid} not found in topology")
     source = locations[0]
     collection = opts.collection or _find_collection(env, vid)
-    total_shards = ((opts.dataShards or 10) + (opts.parityShards or 4))
+    total_shards = _geometry_total_shards(opts)
     if shared is None:
         shared = _SharedPlacement()  # serial path: ledger is a no-op
     stream = _stream_enabled(opts)
@@ -268,11 +314,14 @@ def _do_copy_encode(env, vid, collection, source, total_shards, opts,
     """Classic three-phase path: generate all shards on the source, THEN
     copy them to their destinations, then mount."""
     env.volume_stub(source).VolumeEcShardsGenerate(
-        vs.VolumeEcShardsGenerateRequest(
+        eg.EcGenerateRequest(
             volume_id=vid, collection=collection,
-            data_shards=opts.dataShards, parity_shards=opts.parityShards),
+            data_shards=opts.dataShards, parity_shards=opts.parityShards,
+            geometry=getattr(opts, "geometry", "")),
         timeout=24 * 3600)
-    print(f"volume {vid}: generated {total_shards} shards on {source}",
+    print(f"volume {vid}: generated {total_shards} shards on {source}"
+          + (f" ({opts.geometry})" if getattr(opts, "geometry", "")
+             else ""),
           file=out)
     alloc = _plan_placement(env, total_shards, shared)
 
@@ -313,7 +362,8 @@ def _do_stream_encode(env, vid, collection, source, total_shards, opts,
     alloc = _plan_placement(env, total_shards, shared)
     req = es.VolumeEcShardsGenerateStreamedRequest(
         volume_id=vid, collection=collection,
-        data_shards=opts.dataShards, parity_shards=opts.parityShards)
+        data_shards=opts.dataShards, parity_shards=opts.parityShards,
+        geometry=getattr(opts, "geometry", ""))
     for target, sids in alloc.items():
         if target != source and sids:
             req.targets.add(address=target, shard_ids=sids)
@@ -384,20 +434,34 @@ def ec_rebuild(env, args, out):
     opts = p.parse_args(args)
     env.confirm_is_locked()
 
+    from ...models import geometry as geom_mod
+
     vols = _all_ec_volumes(env, opts.collection)
     for vid, holders in sorted(vols.items()):
         if opts.volumeId and vid != opts.volumeId:
             continue
-        total = _ec_total_shards(env, vid)
+        collection = _find_ec_collection(env, vid)
+        d, p, code = _ec_geometry(env, vid, holders, collection)
+        if not code:
+            # no holder's .vif was readable: planning blind would copy a
+            # survivor set the rebuilder may not be able to solve from
+            print(f"volume {vid}: cannot read volume geometry (.vif) "
+                  f"from any shard holder — skipping rebuild", file=out)
+            continue
+        total = d + p
         present = set(holders)
         if len(present) >= total:
             continue
-        k = total - _ec_parity_shards(env, vid)
-        if len(present) < k:
-            print(f"volume {vid}: only {len(present)} shards left, "
-                  f"cannot rebuild", file=out)
+        geom = geom_mod.get(code)
+        missing = tuple(i for i in range(total) if i not in present)
+        try:
+            plan = geom.repair_plan(missing, tuple(sorted(present)))
+        except (geom_mod.UnsolvableError, ValueError):
+            print(f"volume {vid} ({code}): only {len(present)} shards "
+                  f"left, cannot rebuild {list(missing)}", file=out)
             continue
-        _rebuild_one(env, vid, holders, total, out)
+        _rebuild_one(env, vid, holders, missing, plan, code, collection,
+                     out)
 
 
 def _all_ec_volumes(env, collection: str = "",
@@ -415,28 +479,60 @@ def _all_ec_volumes(env, collection: str = "",
     return {vid: dict(m) for vid, m in vols.items()}
 
 
-def _ec_geometry(env, vid: int) -> tuple[int, int]:
-    """(data, parity) from any holder's .vif via the master EC map; default 10+4."""
-    return 10, 4
+def _ec_vif(env, vid: int, holders: dict[int, list[str]],
+            collection: str) -> dict:
+    """Read the volume's .vif sidecar from any shard holder over the
+    CopyFile RPC — the shard-set metadata (shard counts AND code
+    geometry, ISSUE 11) is readable without mounting anything."""
+    import json
+
+    addrs = sorted({a for hs in holders.values() for a in hs})
+    for addr in addrs:
+        buf = bytearray()
+        try:
+            for chunk in env.volume_stub(addr).CopyFile(
+                    vs.CopyFileRequest(
+                        volume_id=vid, ext=".vif", collection=collection,
+                        is_ec_volume=True,
+                        ignore_source_file_not_found=True), timeout=30):
+                buf += chunk.file_content
+        except Exception:  # noqa: BLE001 — try the next holder
+            continue
+        if buf:
+            try:
+                return json.loads(bytes(buf))
+            except ValueError:
+                continue
+    return {}
 
 
-def _ec_total_shards(env, vid: int) -> int:
-    d, p = _ec_geometry(env, vid)
-    return d + p
+def _ec_geometry(env, vid: int, holders=None, collection="") -> tuple:
+    """(data, parity, code_name) from a holder's .vif.
 
-
-def _ec_parity_shards(env, vid: int) -> int:
-    return _ec_geometry(env, vid)[1]
+    code_name is "" when NO holder's .vif could be read — callers that
+    PLAN from the geometry (ec.rebuild) must treat that as an error
+    rather than assume RS: mis-planning an lrc volume as rs copies a
+    survivor set the rebuilder cannot solve from. (A .vif that parses
+    but predates the geometry field is legitimately RS.)"""
+    vif = _ec_vif(env, vid, holders or {}, collection) if holders else {}
+    if not vif:
+        return 10, 4, ""
+    d = vif.get("dataShards", 10)
+    p = vif.get("parityShards", 4)
+    return d, p, vif.get("geometry", "") or f"rs_{d}_{p}"
 
 
 def _rebuild_one(env, vid: int, holders: dict[int, list[str]],
-                 total: int, out) -> None:
-    collection = _find_ec_collection(env, vid)
+                 missing: tuple[int, ...], plan, code: str,
+                 collection: str, out) -> None:
     # rebuilder: node with most free slots (command_ec_rebuild.go:132)
     rebuilder = _collect_ec_nodes(env)[0][0]
     local = {sid for sid, hs in holders.items() if rebuilder in hs}
-    to_copy = [sid for sid, hs in holders.items()
-               if rebuilder not in hs and hs]
+    # minimal-read copy set (ISSUE 11): only the survivors the repair
+    # plan actually reads travel to the rebuilder — under lrc_10_2_2 a
+    # single lost group shard moves 5 shards' bytes, not 10-13
+    to_copy = [sid for sid in plan.reads
+               if sid not in local and holders.get(sid)]
     copied = []
     for sid in to_copy:
         env.volume_stub(rebuilder).VolumeEcShardsCopy(
@@ -448,7 +544,8 @@ def _rebuild_one(env, vid: int, holders: dict[int, list[str]],
                 source_data_node=holders[sid][0]), timeout=3600)
         copied.append(sid)
     resp = env.volume_stub(rebuilder).VolumeEcShardsRebuild(
-        vs.VolumeEcShardsRebuildRequest(volume_id=vid, collection=collection),
+        eg.EcRebuildRequest(volume_id=vid, collection=collection,
+                            shard_ids=list(missing)),
         timeout=24 * 3600)
     rebuilt = list(resp.rebuilt_shard_ids)
     env.volume_stub(rebuilder).VolumeEcShardsMount(
@@ -460,7 +557,10 @@ def _rebuild_one(env, vid: int, holders: dict[int, list[str]],
         env.volume_stub(rebuilder).VolumeEcShardsDelete(
             vs.VolumeEcShardsDeleteRequest(volume_id=vid, collection=collection,
                                            shard_ids=drop), timeout=60)
-    print(f"volume {vid}: rebuilt shards {rebuilt} on {rebuilder}", file=out)
+    geom_used = getattr(resp, "geometry", "") or code
+    print(f"volume {vid}: rebuilt shards {rebuilt} on {rebuilder} "
+          f"(geometry {geom_used}, read {len(plan.reads)} survivors, "
+          f"{resp.survivor_bytes_read} bytes)", file=out)
 
 
 def _find_ec_collection(env, vid: int) -> str:

@@ -270,11 +270,13 @@ def volume_check_disk(env, args, out):
                 print(f"  ... and {len(diverging) - 20} more", file=out)
     # EC volumes: shard-integrity coverage (the old check skipped them)
     ec_holders: dict[int, dict[str, dict[int, tuple[int, int]]]] = {}
+    ec_cols: dict[int, str] = {}
     for dn in env.collect_data_nodes():
         for disk in dn.disk_infos.values():
             for e in disk.ec_shard_infos:
                 if opts.volumeId and e.id != opts.volumeId:
                     continue
+                ec_cols.setdefault(e.id, e.collection)
                 try:
                     d = env.volume_stub(dn.id).VolumeDigest(
                         scrub_pb2.VolumeDigestRequest(volume_id=e.id),
@@ -290,6 +292,18 @@ def volume_check_disk(env, args, out):
         for server, shards in holders.items():
             for sid, cs in shards.items():
                 by_shard.setdefault(sid, {})[server] = cs
+        # report the code geometry the check operates on (ISSUE 11):
+        # readable from any holder's .vif — mixed-geometry clusters name
+        # each volume's layout explicitly
+        from .ec import _ec_geometry
+
+        hmap = {sid: sorted(copies) for sid, copies in by_shard.items()}
+        d, pshards, code = _ec_geometry(env, vid, hmap,
+                                        ec_cols.get(vid, ""))
+        print(f"ec volume {vid}: geometry "
+              f"{code or 'unknown (.vif unreadable)'} "
+              f"({d}+{pshards}), {len(by_shard)} shard ids on "
+              f"{len(holders)} holder(s)", file=out)
         for sid, copies in sorted(by_shard.items()):
             if len(copies) > 1 and len(set(copies.values())) > 1:
                 issues += 1

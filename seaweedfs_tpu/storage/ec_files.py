@@ -455,27 +455,48 @@ def rebuild_ec_files(
     geo: Geometry = Geometry(),
     batch_size: int = DEFAULT_BATCH_SIZE,
     pace=None,
+    want: list[int] | None = None,
+    stats: dict | None = None,
 ) -> list[int]:
     """Regenerate missing .ecNN files from the survivors
     (RebuildEcFiles / generateMissingEcFiles / rebuildEcFiles,
     ec_encoder.go:61-63,89-118,233-287). Returns the rebuilt shard ids.
     `pace`, as in generate_ec_files, draws each slab's survivor-read
     bytes from the cluster background budget before the slab is
-    written."""
+    written.
+
+    ISSUE 11: the rebuild reads only the geometry's MINIMAL-READ repair
+    plan (models/geometry.py) — a single lost shard inside an
+    lrc_10_2_2 local group reads its 5 group peers instead of 10
+    survivors (RS reads exactly its first-k decode set, never the
+    surplus). `want` restricts the rebuild to those shard ids (the
+    genuinely-missing set cluster-wide — locally-absent shards that
+    exist on peers need no rebuild here); `stats`, when given, receives
+    survivor_bytes_read / survivor_shards / geometry."""
     total = geo.total_shards
     have = [os.path.exists(geo.shard_file_name(base_file_name, i)) for i in range(total)]
     missing = [i for i in range(total) if not have[i]]
+    if want is not None:
+        missing = [i for i in missing if i in set(want)]
     if not missing:
         return []
     present = [i for i in range(total) if have[i]]
-    if len(present) < geo.data_shards:
-        raise ValueError(
-            f"too many shards missing: have {len(present)}, need {geo.data_shards}"
-        )
 
-    ins = {i: open(geo.shard_file_name(base_file_name, i), "rb") for i in present}
+    from ..models.geometry import UnsolvableError
+    from ..utils.stats import EC_REPAIR_BYTES, EC_REPAIR_PLANS
+
+    geom = geo.code_geometry()
+    try:
+        plan = geom.repair_plan(tuple(missing), tuple(present))
+    except (UnsolvableError, ValueError):
+        raise ValueError(
+            f"too many shards missing: have {len(present)} "
+            f"({geo.code_name}), cannot rebuild {missing}"
+        )
+    reads = list(plan.reads)
+    ins = {i: open(geo.shard_file_name(base_file_name, i), "rb") for i in reads}
     outs = {i: open(geo.shard_file_name(base_file_name, i), "wb") for i in missing}
-    shard_size = os.path.getsize(geo.shard_file_name(base_file_name, present[0]))
+    shard_size = os.path.getsize(geo.shard_file_name(base_file_name, reads[0]))
     fallocate = getattr(os, "posix_fallocate", None)  # absent off-Linux
     if shard_size and fallocate:
         for f in outs.values():
@@ -490,7 +511,21 @@ def rebuild_ec_files(
     stop = threading.Event()
 
     use_stacked = hasattr(coder, "reconstruct_stacked")
-    pres_tuple = tuple(present)
+    if not use_stacked and set(reads) != set(present):
+        # exotic coder without the want= stacked form: no minimal-read —
+        # fall back to the full survivor set and the dict path
+        for i in present:
+            if i not in ins:
+                ins[i] = open(geo.shard_file_name(base_file_name, i), "rb")
+        reads = list(present)
+    if stats is not None:
+        # recorded AFTER any fallback widening, so shard/byte accounting
+        # always describes the survivor set actually read
+        stats["geometry"] = geo.code_name
+        stats["survivor_shards"] = len(reads)
+        stats.setdefault("survivor_bytes_read", 0)
+    reads_tuple = tuple(reads)
+    want_tuple = tuple(missing)
     # share stacked reconstruct dispatches with any concurrent rebuild of
     # the same survivor set (and keep the pipeline depth working ahead:
     # futures resolve in the coordinator, not the reader)
@@ -503,10 +538,10 @@ def rebuild_ec_files(
                 # survivors land in ONE contiguous [P, batch] buffer via
                 # readinto — the stacked reconstruct then runs a single
                 # column-permuted matmul with no device-side re-stack
-                stacked = np.empty((len(present), batch_size),
+                stacked = np.empty((len(reads), batch_size),
                                    dtype=np.uint8)
                 n = None
-                for j, i in enumerate(present):
+                for j, i in enumerate(reads):
                     ins[i].seek(offset)
                     got = ins[i].readinto(memoryview(stacked[j]))
                     if n is None:
@@ -521,14 +556,14 @@ def rebuild_ec_files(
                     # fresh buffer each loop: the slab may reference it
                     # without a defensive copy
                     work_q.put(sched.reconstruct_stacked(
-                        pres_tuple, stacked[:, :n]))
+                        reads_tuple, stacked[:, :n], want=want_tuple))
                 elif use_stacked:
                     mids, rows = coder.reconstruct_stacked(
-                        pres_tuple, stacked[:, :n])
+                        reads_tuple, stacked[:, :n], want=want_tuple)
                     work_q.put(dict(zip(mids, rows)))
                 else:
                     bufs = {i: stacked[j, :n]
-                            for j, i in enumerate(present)}
+                            for j, i in enumerate(reads)}
                     work_q.put(coder.reconstruct(bufs))
                 offset += n
             work_q.put(None)
@@ -549,15 +584,22 @@ def rebuild_ec_files(
             if isinstance(rebuilt, dispatch.EcFuture):
                 mids, rows = rebuilt.result()
                 rebuilt = dict(zip(mids, rows))
+            slab_bytes = len(reads) * len(next(iter(rebuilt.values())))
             if pace is not None:
-                # repair-class budget draw: survivors read this slab
-                pace(len(present) * len(next(iter(rebuilt.values()))))
+                # repair-class budget draw: survivors read this slab —
+                # the minimal-read plan draws proportionally less
+                pace(slab_bytes)
+            EC_REPAIR_BYTES.inc(slab_bytes, geometry=geo.code_name,
+                                kind="rebuild", source="local")
+            if stats is not None:
+                stats["survivor_bytes_read"] += slab_bytes
             for i in missing:
                 row = np.ascontiguousarray(
                     np.asarray(rebuilt[i], dtype=np.uint8))
                 writers.put(i, row, len(row))
         writers.close()
         ok = True
+        EC_REPAIR_PLANS.inc(geometry=geo.code_name, kind="rebuild")
     finally:
         stop.set()
         if not ok:

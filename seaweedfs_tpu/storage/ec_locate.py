@@ -24,16 +24,37 @@ PARITY_SHARDS_DEFAULT = 4
 
 @dataclass(frozen=True)
 class Geometry:
-    """Shard-count + block-size geometry of one EC'd volume."""
+    """Shard-count + block-size geometry of one EC'd volume.
+
+    `code` (ISSUE 11) names the CODE geometry — the GF(256) generator
+    matrix layout from models/geometry.py's registry (e.g. "lrc_10_2_2").
+    Empty means plain Reed-Solomon over (data_shards, parity_shards),
+    exactly the pre-registry behavior; `code_name` canonicalizes that to
+    "rs_{k}_{m}". Persisted per EC volume in the .vif sidecar, so mixed
+    code geometries coexist on one server/cluster."""
 
     data_shards: int = DATA_SHARDS_DEFAULT
     parity_shards: int = PARITY_SHARDS_DEFAULT
     large_block: int = LARGE_BLOCK_SIZE
     small_block: int = SMALL_BLOCK_SIZE
+    code: str = ""
 
     @property
     def total_shards(self) -> int:
         return self.data_shards + self.parity_shards
+
+    @property
+    def code_name(self) -> str:
+        return self.code or f"rs_{self.data_shards}_{self.parity_shards}"
+
+    def code_geometry(self):
+        """The models.geometry.CodeGeometry this volume's bytes follow.
+        Raises ValueError for an unregistered name or a shard-count
+        mismatch — the mount-time validation surface."""
+        from ..models import geometry as geom_mod
+
+        return geom_mod.resolve(self.data_shards, self.parity_shards,
+                                self.code or None)
 
     def shard_file_name(self, base: str, shard_id: int) -> str:
         return f"{base}.ec{shard_id:02d}"  # ToExt, ec_encoder.go:65-67

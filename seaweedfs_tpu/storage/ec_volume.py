@@ -153,11 +153,13 @@ class EcVolume:
         coder,
         geo: Geometry | None = None,
         version: int | None = None,
+        coder_for=None,
     ):
         self.base = base_file_name
-        self.coder = coder
         # .vif records geometry + needle version (the reference stores a
-        # VolumeInfo protobuf there, ec_volume.go:66-71; ours is JSON)
+        # VolumeInfo protobuf there, ec_volume.go:66-71; ours is JSON).
+        # ISSUE 11: it also names the CODE geometry, so a shard set is
+        # self-describing at mount — mixed-geometry servers work.
         vif = load_volume_info(base_file_name)
         if geo is None:
             geo = Geometry(
@@ -165,10 +167,18 @@ class EcVolume:
                 parity_shards=vif.get("parityShards", Geometry.parity_shards),
                 large_block=vif.get("largeBlock", Geometry.large_block),
                 small_block=vif.get("smallBlock", Geometry.small_block),
+                code=vif.get("geometry", ""),
             )
         if version is None:
             version = vif.get("version", types.CURRENT_VERSION)
         self.geo = geo
+        # validate at mount: an unregistered geometry name (or a shard
+        # count mismatch) must refuse to serve, not decode garbage
+        geo.code_geometry()
+        # `coder_for` (Store.coder_for) picks a coder matching THIS
+        # volume's code geometry; a bare coder is trusted as matching
+        # (tests, offline tools)
+        self.coder = coder_for(geo) if coder_for is not None else coder
         self.version = version
         self.ecx_path = base_file_name + ".ecx"
         # Offset-width (stride) guard, mirroring Volume.__init__: the
@@ -242,32 +252,86 @@ class EcVolume:
                 return data
             data += b"\0" * (size - len(data))
             return data
-        # degraded: rebuild this interval from any k surviving shards
-        # (recoverOneRemoteEcShardInterval, store_ec.go:339-393)
-        pres: list[int] = []
-        rows: list[np.ndarray] = []
-        for i, sf in self.shard_files.items():
-            if len(pres) == self.geo.data_shards:
-                break
-            try:
-                chunk = sf.read_at(shard_off, size)
-            except OSError:  # bad sector / stale handle: any k suffice,
-                continue  # same tolerance as the server-side gather
-            chunk += b"\0" * (size - len(chunk))
-            pres.append(i)
-            rows.append(np.frombuffer(chunk, dtype=np.uint8))
-        if len(pres) < self.geo.data_shards:
-            raise IOError(
-                f"cannot reconstruct shard {shard_id}: only {len(pres)} shards available"
-            )
+        # degraded: rebuild this interval from surviving shards
+        # (recoverOneRemoteEcShardInterval, store_ec.go:339-393).
+        # ISSUE 11: the geometry's minimal-read plan decides WHICH
+        # survivors — a lost shard inside an LRC local group reads its 5
+        # group peers instead of any k=10 — falling back to the generic
+        # any-k gather when a planned read fails mid-flight.
+        from ..models.geometry import UnsolvableError
         from ..ops import dispatch
+        from ..utils.stats import EC_REPAIR_BYTES, EC_REPAIR_PLANS
 
-        # concurrent degraded reads sharing this survivor set ride ONE
-        # stacked reconstruct dispatch (micro-batched by the window)
-        missing, out = dispatch.reconstruct_now(
-            self.coder, pres, np.stack(rows), data_only=True)
-        return np.asarray(
-            out[missing.index(shard_id)], dtype=np.uint8).tobytes()
+        geom = self.geo.code_geometry()
+        avail = tuple(sorted(i for i in self.shard_files
+                             if i != shard_id))
+        for attempt in ("planned", "generic"):
+            if attempt == "planned":
+                try:
+                    reads = geom.repair_plan((shard_id,), avail).reads
+                except (UnsolvableError, ValueError):
+                    continue
+            else:
+                reads = avail
+            pres: list[int] = []
+            rows: list[np.ndarray] = []
+            for i in reads:
+                sf = self.shard_files.get(i)
+                if sf is None:
+                    continue
+                try:
+                    chunk = sf.read_at(shard_off, size)
+                except OSError:  # bad sector / stale handle
+                    continue  # planned attempt degrades to generic
+                chunk += b"\0" * (size - len(chunk))
+                pres.append(i)
+                rows.append(np.frombuffer(chunk, dtype=np.uint8))
+                if attempt == "generic" and geom.is_rs and \
+                        len(pres) == self.geo.data_shards:
+                    break  # any k suffice under RS; non-RS gathers all
+                    #        and lets the solve pick
+            if attempt == "planned" and len(pres) < len(reads):
+                continue  # a planned survivor failed: try the wide net
+            if attempt == "generic" and \
+                    len(pres) < self.geo.data_shards:
+                # sub-k survivor sets can still solve under non-RS
+                # geometries; let the solve decide instead of counting
+                try:
+                    geom.repair_matrix(tuple(pres), (shard_id,))
+                except (UnsolvableError, ValueError):
+                    raise IOError(
+                        f"cannot reconstruct shard {shard_id}: only "
+                        f"{len(pres)} shards available")
+            # concurrent degraded reads sharing this survivor set ride
+            # ONE stacked reconstruct dispatch (micro-batched). RS keeps
+            # want=None so readers of DIFFERENT lost shards share the
+            # lane too (the fused matrix solves every missing row at
+            # once); non-RS solves exactly this shard — the survivor set
+            # may not span the full complement.
+            want = (None if geom.is_rs else (shard_id,))
+            try:
+                missing, out = dispatch.reconstruct_now(
+                    self.coder, pres, np.stack(rows), data_only=True,
+                    want=want)
+            except (UnsolvableError, ValueError) as e:
+                if attempt == "planned":
+                    continue
+                # callers (the serving paths) catch IOError — keep the
+                # pre-geometry failure contract
+                raise IOError(
+                    f"cannot reconstruct shard {shard_id}: survivors "
+                    f"{pres} do not span it") from e
+            EC_REPAIR_BYTES.inc(len(pres) * size,
+                                geometry=self.geo.code_name,
+                                kind="degraded_read", source="local")
+            EC_REPAIR_PLANS.inc(geometry=self.geo.code_name,
+                                kind="degraded_read")
+            return np.asarray(
+                out[list(missing).index(shard_id)],
+                dtype=np.uint8).tobytes()
+        raise IOError(
+            f"cannot reconstruct shard {shard_id}: survivors "
+            f"{list(avail)} do not span it")
 
     def delete_needle(self, needle_id: int) -> None:
         delete_needle_from_ecx(self.base, needle_id)

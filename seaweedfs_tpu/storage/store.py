@@ -91,6 +91,11 @@ class Store:
         self.coder = coder or new_coder()
         self.needle_map_kind = needle_map_kind
         self._lock = threading.RLock()
+        # per-code-geometry coder cache (ISSUE 11): volumes carrying a
+        # different generator matrix (or shard counts) than the default
+        # coder get their own — each owns its own dispatch scheduler, so
+        # mixed-geometry slabs can never share a stacked device dispatch
+        self._geo_coders: dict[str, object] = {}
         self.locations: list[DiskLocation] = []
         counts = max_volume_counts or [8] * len(directories)
         for d, c in zip(directories, counts):
@@ -121,11 +126,16 @@ class Store:
                 if vid not in loc.ec_volumes:
                     try:
                         loc.ec_volumes[vid] = EcVolume(
-                            loc.base_name(col, vid), self.coder
+                            loc.base_name(col, vid), self.coder,
+                            coder_for=self.coder_for,
                         )
                         loc.ec_volumes[vid].collection = col
                     except FileNotFoundError:
                         pass  # .ecx without local shards
+                    except ValueError as e:
+                        # unregistered code geometry in the .vif: refuse
+                        # to serve bytes we cannot decode, keep the rest
+                        glog.error(f"skip loading ec volume {vid}: {e}")
 
     # -- volume lifecycle --------------------------------------------------
 
@@ -138,6 +148,26 @@ class Store:
             if v is not None:
                 return v
         return None
+
+    def coder_for(self, geo: Geometry):
+        """The coder matching a volume's code geometry — the store's own
+        when it already speaks it (the common all-default case), else a
+        cached per-geometry coder built through the registry."""
+        name = geo.code_name
+        mine = getattr(self.coder, "geometry_id",
+                       f"rs_{self.coder.data_shards}_"
+                       f"{self.coder.parity_shards}")
+        if name == mine:
+            return self.coder
+        with self._lock:
+            got = self._geo_coders.get(name)
+            if got is None:
+                from ..models.coder import new_coder as _new
+
+                got = self._geo_coders[name] = _new(
+                    geo.data_shards, geo.parity_shards,
+                    geometry=geo.code_geometry())
+            return got
 
     def find_ec_volume(self, vid: int) -> EcVolume | None:
         for loc in self.locations:
@@ -277,7 +307,8 @@ class Store:
             for loc in self.locations:
                 base = loc.base_name(collection, vid)
                 if os.path.exists(base + ".ecx"):
-                    ev = EcVolume(base, self.coder)
+                    ev = EcVolume(base, self.coder,
+                                  coder_for=self.coder_for)
                     ev.collection = collection
                     # single dict assignment: concurrent readers see the
                     # old runtime or the new one, never a gap (a pop
@@ -370,9 +401,10 @@ class Store:
         # EC work ran) owns a flusher thread — flush + join it so tests
         # and restarts never leak one (close() itself is idempotent too,
         # so atexit's shutdown_all and this call compose in any order)
-        sched = getattr(self.coder, "_ec_dispatch_sched", None)
-        if sched is not None:
-            sched.close()
+        for coder in (self.coder, *self._geo_coders.values()):
+            sched = getattr(coder, "_ec_dispatch_sched", None)
+            if sched is not None:
+                sched.close()
 
 
 def l_free(loc: DiskLocation) -> int:

@@ -373,6 +373,23 @@ EC_COPY_FALLBACK_SECONDS = Counter(
     "(bytes/seconds = copy-path throughput, the A/B comparand).")
 
 
+# -- code-geometry plane (ISSUE 11): repair-bandwidth accounting — the
+#    number the pluggable geometries (models/geometry.py) exist to
+#    shrink. Every survivor byte read to recover lost shard bytes is
+#    counted here, labeled by the volume's code geometry -----------------
+
+EC_REPAIR_BYTES = Counter(
+    "SeaweedFS_ec_repair_bytes",
+    "Survivor bytes read to recover lost EC shard bytes, by code "
+    "geometry (rs_10_4/lrc_10_2_2/...), kind (rebuild/degraded_read) "
+    "and source (local/remote). Under lrc_10_2_2 a single-shard repair "
+    "inside a local group reads 5 survivors where rs_10_4 reads 10.")
+EC_REPAIR_PLANS = Counter(
+    "SeaweedFS_ec_repair_plans",
+    "Minimal-read repair plans executed, by geometry and kind; "
+    "repair_bytes/plans tracks the realized per-repair read cost.")
+
+
 def ec_stream_stats() -> dict:
     """Snapshot for /status pages: streamed bytes by phase, in-flight
     depth, resume counts, overlap ratio, and the copy-fallback

@@ -1048,3 +1048,144 @@ def test_qos_grant_outage_foreground_open_background_closed(
         vs.VolumeEcShardsGenerateRequest(volume_id=vid,
                                          collection="qoschaos"),
         timeout=120)
+
+
+# -- code-geometry plane (ISSUE 11): LRC degraded reads + scrub heal --------
+
+def test_lrc_degraded_reads_and_scrub_heals_group_and_global_loss(
+        chaos_cluster):
+    """Acceptance: an lrc_10_2_2 volume (a) serves correct bytes under a
+    lost LOCAL-GROUP shard via the minimal-read plan (5 survivors, not
+    10 — pinned by the per-geometry repair counters), and (b) the scrub
+    repair ladder heals BOTH a local-group shard and a GLOBAL parity
+    shard rot to convergence, with concurrent readers seeing zero
+    errors throughout."""
+    import threading as _threading
+
+    import grpc
+
+    from seaweedfs_tpu.pb import ec_geometry_pb2 as eg
+    from seaweedfs_tpu.utils.stats import (
+        EC_REPAIR_BYTES,
+        EC_REPAIR_PLANS,
+        SCRUB_REPAIRS,
+    )
+
+    master, volumes, _ = chaos_cluster
+    rng = np.random.default_rng(61)
+    blobs, fids = {}, []
+    for i in range(14):
+        data = rng.integers(0, 256, size=int(rng.integers(300, 3000)),
+                            dtype=np.uint8).tobytes()
+        res = submit(master.address, data, filename=f"lrc{i}.bin",
+                     collection="chaosec")
+        assert "fid" in res, res
+        fids.append(res["fid"])
+        blobs[res["fid"]] = data
+    by_vid: dict[int, int] = {}
+    for f in fids:
+        vv = parse_file_id(f).volume_id
+        by_vid[vv] = by_vid.get(vv, 0) + 1
+    vid = max(by_vid, key=by_vid.get)
+    vsrv = next(v for v in volumes if v.store.has_volume(vid))
+    stub = rpc.volume_stub(rpc.grpc_address(vsrv.address))
+    stub.VolumeMarkReadonly(vs.VolumeMarkReadonlyRequest(volume_id=vid),
+                            timeout=30)
+    # an unknown geometry name is refused with the registered list
+    with pytest.raises(grpc.RpcError) as ei:
+        stub.VolumeEcShardsGenerate(
+            eg.EcGenerateRequest(volume_id=vid, collection="chaosec",
+                                 geometry="fountain_42"),
+            timeout=30)
+    assert ei.value.code() == grpc.StatusCode.INVALID_ARGUMENT
+    assert "lrc_10_2_2" in ei.value.details()
+    # geometry-aware generate: the registry name rides the RPC
+    stub.VolumeEcShardsGenerate(
+        eg.EcGenerateRequest(volume_id=vid, collection="chaosec",
+                             geometry="lrc_10_2_2"),
+        timeout=120)
+    stub.VolumeUnmount(vs.VolumeUnmountRequest(volume_id=vid), timeout=30)
+    stub.VolumeEcShardsMount(
+        vs.VolumeEcShardsMountRequest(volume_id=vid, collection="chaosec",
+                                      shard_ids=list(range(14))),
+        timeout=30)
+    ev = vsrv.store.find_ec_volume(vid)
+    assert ev is not None and ev.geo.code_name == "lrc_10_2_2"
+    assert ev.coder.geometry_id == "lrc_10_2_2"
+    same_fid = [f for f in fids if parse_file_id(f).volume_id == vid]
+    assert same_fid
+
+    # phase 1 — degraded reads with shard 0 (group A) failpoint-lost:
+    # every read serves the right bytes through the 5-survivor plan
+    plans0 = EC_REPAIR_PLANS.value(geometry="lrc_10_2_2",
+                                   kind="degraded_read")
+    bytes0 = EC_REPAIR_BYTES.value(geometry="lrc_10_2_2",
+                                   kind="degraded_read")
+    with failpoint.active("ec.shard.read", p=1.0, match="shard=0,") as fp:
+        for fid in same_fid:
+            got = requests.get(f"http://{vsrv.address}/{fid}", timeout=60)
+            assert got.status_code == 200, (fid, got.status_code)
+            assert got.content == blobs[fid]
+        assert fp.hits > 0, "no shard read was ever injected"
+    plans = EC_REPAIR_PLANS.value(geometry="lrc_10_2_2",
+                                  kind="degraded_read") - plans0
+    moved = EC_REPAIR_BYTES.value(geometry="lrc_10_2_2",
+                                  kind="degraded_read") - bytes0
+    assert plans > 0, "no lrc repair plan executed"
+    assert moved > 0
+    # the headline: every group-shard plan read exactly 5 survivor rows
+    # of its interval size (RS reads 10) — so the moved total is 5x the
+    # reconstructed extent, never 10x
+    assert moved % 5 == 0, moved
+
+    # phase 2 — scrub heals a LOCAL-GROUP shard rot (shard 0) and then
+    # a GLOBAL parity rot (shard 13), each under concurrent readers
+    repaired0 = SCRUB_REPAIRS.value(method="ec_rebuild", outcome="ok")
+    for bad in (0, 13):
+        path = ev.geo.shard_file_name(ev.base, bad)
+        with open(path, "r+b") as fh:
+            fh.seek(29)
+            b = fh.read(1)
+            fh.seek(-1, 1)
+            fh.write(bytes([b[0] ^ 0x77]))
+        # bounded concurrent readers against the rotten shard first
+        # (unbounded readers would hold the scrubber in FG-QPS backoff
+        # for minutes): every read serves the right bytes
+        errs = []
+        barrier = _threading.Barrier(3)
+
+        def reader():
+            try:
+                barrier.wait()
+                for _ in range(2):
+                    for fid in same_fid[:4]:
+                        got = requests.get(
+                            f"http://{vsrv.address}/{fid}", timeout=60)
+                        assert got.status_code == 200
+                        assert got.content == blobs[fid]
+            except BaseException:
+                import traceback
+
+                errs.append(traceback.format_exc())
+
+        ths = [_threading.Thread(target=reader) for _ in range(3)]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        assert not errs, errs[0]
+        vsrv.scrubber.run_once(vid=vid, full=True)
+        culprits = [(f.shard_id, f.state)
+                    for f in vsrv.scrubber.snapshot_findings()
+                    if f.kind == "ec_parity" and f.volume_id == vid]
+        assert (bad, "repaired") in culprits, (bad, culprits)
+    assert SCRUB_REPAIRS.value(method="ec_rebuild",
+                               outcome="ok") >= repaired0 + 2
+
+    # converged: clean sweep, correct bytes everywhere
+    r2 = vsrv.scrubber.run_once(vid=vid, full=True)
+    assert not [f for f in r2.findings if f.kind == "ec_parity"], \
+        r2.findings
+    for fid in same_fid:
+        got = requests.get(f"http://{vsrv.address}/{fid}", timeout=60)
+        assert got.status_code == 200 and got.content == blobs[fid]

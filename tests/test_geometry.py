@@ -1,0 +1,671 @@
+"""ISSUE 11 — pluggable code-geometry plane.
+
+Covers: the registry; the LRC(10,2,2) construction (distance, minimal-read
+plans, bit-identity across backends); the pinned RS(10,4) default (byte-
+unchanged through the geometry plumbing); minimal-read rebuild + degraded
+reads; geometry persistence round-trip with MIXED geometries on one
+server; dispatch lane keys carrying the geometry id; the product-matrix
+regenerating variant; and the registry-introspection consistency tests
+(every registered geometry gets a CPU-oracle bit-identity test and a
+repair-plan test, parametrized from the registry itself — registering a
+new geometry auto-enrolls it).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.models import geometry as gm
+from seaweedfs_tpu.models.coder import new_coder
+from seaweedfs_tpu.ops import dispatch, gf256
+from seaweedfs_tpu.storage.ec_files import (
+    rebuild_ec_files,
+    write_ec_files,
+    write_sorted_file_from_idx,
+)
+from seaweedfs_tpu.storage.ec_locate import Geometry
+from seaweedfs_tpu.storage.ec_volume import (
+    EcVolume,
+    load_volume_info,
+    save_volume_info,
+)
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.store import Store
+
+TEST_GEO_RS = Geometry(large_block=10000, small_block=100)
+TEST_GEO_LRC = Geometry(large_block=10000, small_block=100,
+                        code="lrc_10_2_2")
+
+LRC = gm.get("lrc_10_2_2")
+RS = gm.get("rs_10_4")
+
+# sha256 of the lrc_10_2_2 encode matrix — freezes the construction
+# (local XOR rows + g1[i]=2^i / g2[i]=4^i): shard bytes on disk depend
+# on it, so any change is a data-format break, not a refactor.
+LRC_MATRIX_SHA256 = (
+    "6e0c3b091906feff52d8dfcd390f70d6d2fe1b87f920ba65baf79c0375b2feb0")
+
+
+def _shards_for(geom, data):
+    return np.concatenate(
+        [data, gf256.gf_matmul(geom.parity_matrix(), data)])
+
+
+# -- registry ---------------------------------------------------------------
+
+
+def test_registry_builtins_present():
+    got = gm.names()
+    assert "rs_10_4" in got and "lrc_10_2_2" in got
+    assert any(n.startswith("pm_mbr_") for n in got)
+
+
+def test_registry_unknown_name_lists_registered():
+    with pytest.raises(ValueError) as ei:
+        gm.get("raptor_9000")
+    msg = str(ei.value)
+    assert "raptor_9000" in msg and "lrc_10_2_2" in msg \
+        and "rs_10_4" in msg
+
+
+def test_rs_names_resolve_on_demand():
+    g = gm.get("rs_6_3")
+    assert (g.data_shards, g.parity_shards) == (6, 3) and g.is_rs
+    # and the (k, m) consistency check bites
+    with pytest.raises(ValueError):
+        gm.resolve(10, 4, "rs_6_3")
+
+
+def test_duplicate_registration_rejected():
+    with pytest.raises(ValueError):
+        gm.register(gm.CodeGeometry(
+            "rs_10_4", 10, 4, gf256.parity_matrix(10, 4)))
+    # re-registering the SAME object is a no-op
+    gm.register(gm.get("rs_10_4"))
+
+
+def test_geometry_dataclass_code_name_and_validation():
+    assert TEST_GEO_RS.code_name == "rs_10_4"
+    assert TEST_GEO_LRC.code_name == "lrc_10_2_2"
+    assert TEST_GEO_LRC.code_geometry() is LRC
+    bogus = Geometry(code="nope_1_2")
+    with pytest.raises(ValueError):
+        bogus.code_geometry()
+    # shard-count mismatch between layout and code is refused
+    with pytest.raises(ValueError):
+        Geometry(data_shards=6, parity_shards=3,
+                 code="lrc_10_2_2").code_geometry()
+
+
+# -- the LRC construction ---------------------------------------------------
+
+
+def test_lrc_matrix_frozen():
+    got = hashlib.sha256(LRC.encode_matrix().tobytes()).hexdigest()
+    assert got == LRC_MATRIX_SHA256, (
+        "lrc_10_2_2 generator changed — that breaks every LRC volume "
+        "on disk")
+
+
+def test_lrc_distance_and_four_loss_coverage():
+    """Brute force over every erasure pattern: all <=3-shard losses
+    decode (distance 4 — same as RS(10,4) up to 3), and exactly
+    861/1001 4-loss patterns do (the tail RS keeps is the price of
+    halving single-shard repair)."""
+    g = LRC.encode_matrix()
+    for e in (1, 2, 3):
+        for lost in itertools.combinations(range(14), e):
+            surv = [i for i in range(14) if i not in lost]
+            assert gm.gf_rank(g[surv]) == 10, f"pattern {lost} lost data"
+    rec4 = sum(
+        1 for lost in itertools.combinations(range(14), 4)
+        if gm.gf_rank(g[[i for i in range(14) if i not in lost]]) == 10)
+    assert rec4 == 861
+
+
+def test_lrc_local_groups():
+    assert LRC.local_groups == (((0, 1, 2, 3, 4), 10),
+                                ((5, 6, 7, 8, 9), 11))
+    assert LRC.group_of(3) == ((0, 1, 2, 3, 4), 10)
+    assert LRC.group_of(11) == ((5, 6, 7, 8, 9), 11)
+    assert LRC.group_of(13) is None
+
+
+def test_lrc_minimal_read_plan_every_single_loss():
+    """THE repair-bandwidth claim, pattern by pattern: a loss inside a
+    local group reads its 5 group peers; a global parity reads the 10
+    data shards. Each plan's matrix must reproduce the lost bytes."""
+    rng = np.random.default_rng(3)
+    data = rng.integers(0, 256, (10, 257), np.uint8)
+    shards = _shards_for(LRC, data)
+    total_reads = 0
+    for lost in range(14):
+        plan = LRC.repair_plan(
+            (lost,), tuple(i for i in range(14) if i != lost))
+        grp = LRC.group_of(lost)
+        if grp is not None:
+            data_ids, psid = grp
+            expect = tuple(sorted((set(data_ids) | {psid}) - {lost}))
+            assert plan.reads == expect, (lost, plan.reads)
+            assert len(plan.reads) == 5
+        else:  # global parity: needs the k data shards
+            assert plan.reads == tuple(range(10)), (lost, plan.reads)
+        rec = gf256.gf_matmul(plan.matrix, shards[list(plan.reads)])
+        assert np.array_equal(rec[0], shards[lost]), lost
+        total_reads += len(plan.reads)
+    # fleet-average single-shard repair cost: 80/140 vs RS's 140/140
+    assert total_reads == 12 * 5 + 2 * 10 == 80
+    rs_reads = sum(len(RS.single_loss_reads(i)) for i in range(14))
+    assert total_reads / rs_reads <= 0.60
+
+
+def test_lrc_double_loss_cross_group_plans_stay_local():
+    plan = LRC.repair_plan((0, 7), tuple(i for i in range(14)
+                                         if i not in (0, 7)))
+    # one loss per group: the union of two local plans, no globals
+    assert set(plan.reads) == {1, 2, 3, 4, 10, 5, 6, 8, 9, 11}
+
+
+def test_lrc_unsolvable_patterns_raise():
+    # four losses inside one group exceed its local+global budget
+    with pytest.raises(gm.UnsolvableError):
+        LRC.repair_plan((0, 1, 2, 3), (4, 5, 6, 7, 8, 9, 11))
+
+
+# -- RS stays bit-identical through the geometry plumbing -------------------
+
+
+def test_rs_repair_matrix_equals_legacy_fused_matrix():
+    from seaweedfs_tpu.ops.rs_jax import fused_reconstruct_stacked_matrix
+
+    for lost in [(0,), (1, 12), (0, 5, 10, 13)]:
+        pres = tuple(i for i in range(14) if i not in lost)
+        missing, pm = fused_reconstruct_stacked_matrix(10, 4, pres, 14)
+        assert missing == lost
+        assert np.array_equal(RS.repair_matrix(pres, missing), pm)
+
+
+def test_rs_single_loss_always_reads_k():
+    for lost in range(14):
+        assert len(RS.single_loss_reads(lost)) == 10
+
+
+def test_rs_golden_shards_unchanged_through_geometry_coder():
+    """The pinned RS(10,4) fixture hashes from test_golden_identity must
+    hold when the coder is built THROUGH the registry — the default
+    path is byte-unchanged."""
+    from tests.test_golden_identity import GOLDEN_SHARD_SHA256, _fixture
+
+    data = _fixture()
+    coder = new_coder(10, 4, backend="cpu", geometry=RS)
+    parity = np.asarray(coder.encode_parity(data), np.uint8)
+    shards = np.concatenate([data, parity], axis=0)
+    got = [hashlib.sha256(s.tobytes()).hexdigest() for s in shards]
+    assert got == GOLDEN_SHARD_SHA256
+
+
+def test_rs_want_path_bytes_match_legacy_stacked():
+    """want= (the minimal-read form) on an RS coder is a different code
+    path (geometry solve) — bytes must equal the legacy fused path."""
+    rng = np.random.default_rng(11)
+    data = rng.integers(0, 256, (10, 512), np.uint8)
+    cpu = new_coder(10, 4, backend="cpu")
+    shards = np.concatenate(
+        [data, np.asarray(cpu.encode_parity(data), np.uint8)])
+    pres = tuple(i for i in range(14) if i not in (2, 13))
+    stk = np.stack([shards[i] for i in pres])
+    m_old, rows_old = cpu.reconstruct_stacked(pres, stk)
+    m_new, rows_new = cpu.reconstruct_stacked(pres, stk, want=(2, 13))
+    assert tuple(m_old) == tuple(m_new) == (2, 13)
+    assert np.array_equal(np.asarray(rows_old), np.asarray(rows_new))
+
+
+# -- registry-introspection consistency (CI satellite) ----------------------
+#
+# Parametrized FROM the registry: registering a new geometry makes these
+# tests cover it automatically — the "every registered geometry has a
+# CPU-oracle bit-identity test and a repair-plan test" guarantee.
+
+
+@pytest.mark.parametrize("name", gm.names())
+def test_every_registered_geometry_bit_identity(name):
+    geom = gm.get(name)
+    rng = np.random.default_rng(hash(name) % 2**32)
+    if isinstance(geom, gm.ProductMatrixMBR):
+        # non-systematic: structured product-matrix encode must equal
+        # the plain generator-matrix realization (the CPU oracle)
+        w = rng.integers(0, 256, (geom.message_symbols, 64), np.uint8)
+        structured = geom.encode_stripe(w)
+        via_matrix = gf256.gf_matmul(geom.generator_matrix(), w).reshape(
+            geom.n_nodes, geom.sub_symbols, -1)
+        assert np.array_equal(structured, via_matrix)
+        return
+    data = rng.integers(0, 256, (geom.data_shards, 512), np.uint8)
+    cpu = new_coder(geom.data_shards, geom.parity_shards, backend="cpu",
+                    geometry=geom)
+    jx = new_coder(geom.data_shards, geom.parity_shards, backend="single",
+                   geometry=geom)
+    p_cpu = np.asarray(cpu.encode_parity(data), np.uint8)
+    p_jax = np.asarray(jx.encode_parity(data), np.uint8)
+    assert np.array_equal(p_cpu, p_jax), f"{name}: cpu != jax parity"
+    assert np.array_equal(
+        p_cpu, gf256.gf_matmul(geom.parity_matrix(), data))
+
+
+@pytest.mark.parametrize("name", gm.names())
+def test_every_registered_geometry_repair_plan(name):
+    geom = gm.get(name)
+    rng = np.random.default_rng(1 + hash(name) % 2**32)
+    if isinstance(geom, gm.ProductMatrixMBR):
+        w = rng.integers(0, 256, (geom.message_symbols, 48), np.uint8)
+        nodes = geom.encode_stripe(w)
+        failed = 1
+        helpers = [i for i in range(geom.n_nodes) if i != failed][
+            : geom.d_helpers]
+        recv = {j: geom.helper_symbol(nodes[j], failed) for j in helpers}
+        # repair bandwidth: d sub-symbols = ONE node's worth, < k nodes'
+        moved = sum(len(v) for v in recv.values())
+        assert moved == geom.sub_symbols * 48
+        assert moved < geom.k_nodes * geom.sub_symbols * 48
+        assert np.array_equal(geom.repair_node(failed, recv),
+                              nodes[failed])
+        # data survives: decode from any k nodes
+        dec = geom.decode_stripe(
+            {i: nodes[i] for i in range(geom.k_nodes)})
+        assert np.array_equal(dec, w)
+        return
+    data = rng.integers(0, 256, (geom.data_shards, 128), np.uint8)
+    shards = _shards_for(geom, data)
+    for lost in range(geom.total_shards):
+        plan = geom.repair_plan(
+            (lost,),
+            tuple(i for i in range(geom.total_shards) if i != lost))
+        assert len(plan.reads) <= geom.data_shards
+        rec = gf256.gf_matmul(plan.matrix, shards[list(plan.reads)])
+        assert np.array_equal(rec[0], shards[lost]), (name, lost)
+
+
+# -- LRC bit-identity across device backends --------------------------------
+
+
+def test_lrc_identity_cpu_jax_stacked_and_want():
+    rng = np.random.default_rng(23)
+    data = rng.integers(0, 256, (10, 1024), np.uint8)
+    cpu = new_coder(10, 4, backend="cpu", geometry=LRC)
+    jx = new_coder(10, 4, backend="single", geometry=LRC)
+    shards = np.concatenate(
+        [data, np.asarray(cpu.encode_parity(data), np.uint8)])
+    assert np.array_equal(
+        np.asarray(jx.encode(data), np.uint8), shards)
+    # stacked encode
+    stack = rng.integers(0, 256, (3, 10, 200), np.uint8)
+    assert np.array_equal(
+        np.asarray(cpu.encode_parity_stacked(stack), np.uint8),
+        np.asarray(jx.encode_parity_stacked(stack), np.uint8))
+    # want-restricted local repair, both backends, sub-k survivor set
+    plan = LRC.repair_plan((7,), tuple(i for i in range(14) if i != 7))
+    stk = np.stack([shards[i] for i in plan.reads])
+    for coder in (cpu, jx):
+        mids, rows = coder.reconstruct_stacked(plan.reads, stk,
+                                               want=(7,))
+        assert tuple(mids) == (7,)
+        assert np.array_equal(np.asarray(rows, np.uint8)[0], shards[7])
+    # dict-surface reconstruct (complement form) agrees too
+    rec = cpu.reconstruct({i: shards[i] for i in range(14)
+                           if i not in (3, 12)})
+    assert np.array_equal(np.asarray(rec[3], np.uint8), shards[3])
+    assert np.array_equal(np.asarray(rec[12], np.uint8), shards[12])
+
+
+def test_lrc_identity_mesh_backend():
+    from seaweedfs_tpu.parallel import mesh
+
+    if mesh.device_count() < 2:
+        pytest.skip("single-device process: mesh equals RSCodecJax here")
+    rng = np.random.default_rng(29)
+    data = rng.integers(0, 256, (10, 4096), np.uint8)
+    cpu = new_coder(10, 4, backend="cpu", geometry=LRC)
+    msh = mesh.ShardedCoder(10, 4, geometry=LRC)
+    assert np.array_equal(
+        np.asarray(cpu.encode_parity(data), np.uint8),
+        np.asarray(msh.encode_parity(data), np.uint8))
+    shards = np.concatenate(
+        [data, np.asarray(cpu.encode_parity(data), np.uint8)])
+    pres = tuple(i for i in range(14) if i not in (1, 6))
+    stk = np.stack([shards[i] for i in pres])
+    m1, r1 = cpu.reconstruct_stacked(pres, stk)
+    m2, r2 = msh.reconstruct_stacked(pres, stk)
+    assert tuple(m1) == tuple(m2)
+    assert np.array_equal(np.asarray(r1, np.uint8),
+                          np.asarray(r2, np.uint8))
+
+
+def test_stripe_level_geometry_rejected_by_coders():
+    """A volume_capable=False geometry (non-systematic product-matrix)
+    has NO parity block — a coder built over it would silently encode
+    zero parity. Every constructor path must refuse."""
+    pm = next(n for n in gm.names() if n.startswith("pm_mbr_"))
+    g = gm.get(pm)
+    with pytest.raises(ValueError, match="volume_capable"):
+        new_coder(g.data_shards, g.parity_shards, backend="cpu",
+                  geometry=pm)
+    with pytest.raises(ValueError, match="volume_capable"):
+        gm.as_geometry(g.data_shards, g.parity_shards, g)
+    # and the systematic accessors themselves refuse
+    with pytest.raises(TypeError):
+        g.parity_matrix()
+    with pytest.raises(TypeError):
+        g.encode_matrix()
+
+
+def test_vsharded_reconstruct_accepts_want():
+    """The mesh-wide V-sharded reconstruct (the rebuild backlog fast
+    path) must honor `want` — a rebuild's minimal-read form must not
+    demote its batch to a single chip."""
+    from seaweedfs_tpu.parallel import mesh
+
+    if mesh.device_count() < 2:
+        pytest.skip("single-device process")
+    rng = np.random.default_rng(53)
+    data = rng.integers(0, 256, (10, 256), np.uint8)
+    shards = _shards_for(RS, data)
+    msh = mesh.ShardedCoder(10, 4)
+    pres = tuple(i for i in range(14) if i != 3)
+    vstack = np.stack([np.stack([shards[i] for i in pres])] * 4)
+    m1, r1 = msh.reconstruct_stacked_vsharded(pres, vstack, want=(3,))
+    assert tuple(m1) == (3,)
+    for v in range(4):
+        assert np.array_equal(np.asarray(r1, np.uint8)[v, 0], shards[3])
+    # lrc variant through the same path
+    lshards = _shards_for(LRC, data)
+    lmsh = mesh.ShardedCoder(10, 4, geometry=LRC)
+    plan = LRC.repair_plan((2,), tuple(i for i in range(14) if i != 2))
+    lstack = np.stack([np.stack([lshards[i] for i in plan.reads])] * 3)
+    m2, r2 = lmsh.reconstruct_stacked_vsharded(plan.reads, lstack,
+                                               want=(2,))
+    assert tuple(m2) == (2,)
+    for v in range(3):
+        assert np.array_equal(np.asarray(r2, np.uint8)[v, 0],
+                              lshards[2])
+
+
+def test_lrc_identity_native_backend():
+    from seaweedfs_tpu.ops import rs_native
+
+    if not rs_native.available():
+        pytest.skip("native toolchain unavailable")
+    rng = np.random.default_rng(31)
+    data = rng.integers(0, 256, (10, 2048), np.uint8)
+    cpu = new_coder(10, 4, backend="cpu", geometry=LRC)
+    nat = new_coder(10, 4, backend="native", geometry=LRC)
+    assert np.array_equal(np.asarray(cpu.encode_parity(data)),
+                          np.asarray(nat.encode_parity(data)))
+
+
+# -- dispatch lane keys carry the geometry id (satellite 1) -----------------
+
+
+def test_dispatch_lanes_keyed_by_geometry():
+    """Two coders with IDENTICAL (k, m) but different generator matrices
+    must never share a stacked dispatch: the store hands out distinct
+    coders (each with its own scheduler), and even within one scheduler
+    every lane key carries the geometry id."""
+    rng = np.random.default_rng(41)
+    data = rng.integers(0, 256, (10, 64), np.uint8)
+    lrc_coder = new_coder(10, 4, backend="cpu", geometry=LRC)
+    rs_coder = new_coder(10, 4, backend="cpu")
+    s_lrc = dispatch.EcDispatchScheduler(lrc_coder, window=60.0)
+    s_rs = dispatch.EcDispatchScheduler(rs_coder, window=60.0)
+    try:
+        assert s_lrc.geom_id == "lrc_10_2_2"
+        assert s_rs.geom_id == "rs_10_4"
+        f1 = s_lrc.encode_parity(data)
+        with s_lrc._cv:
+            keys = list(s_lrc._lanes)
+        assert keys and all("lrc_10_2_2" in k for k in keys), keys
+        pres = tuple(range(10))
+        f2 = s_lrc.reconstruct_stacked(pres, data, want=(10,))
+        with s_lrc._cv:
+            rec_keys = [k for k in s_lrc._lanes if k[0] == "rec"]
+        assert rec_keys == [("rec", "lrc_10_2_2", pres, False, (10,))]
+        # results still correct after demand flush
+        parity = np.asarray(f1.result(), np.uint8)
+        assert np.array_equal(
+            parity, gf256.gf_matmul(LRC.parity_matrix(), data))
+        mids, rows = f2.result()
+        assert tuple(mids) == (10,)
+        assert np.array_equal(np.asarray(rows)[0], parity[0])
+    finally:
+        s_lrc.close()
+        s_rs.close()
+
+
+def test_store_coder_for_separates_geometries(tmp_path):
+    st = Store([str(tmp_path)])
+    c_rs = st.coder_for(TEST_GEO_RS)
+    c_lrc = st.coder_for(TEST_GEO_LRC)
+    assert c_rs is st.coder  # default geometry reuses the store coder
+    assert c_lrc is not c_rs
+    assert c_lrc.geometry_id == "lrc_10_2_2"
+    assert st.coder_for(TEST_GEO_LRC) is c_lrc  # cached
+    st.close()
+
+
+# -- storage plane: files, rebuild, persistence -----------------------------
+
+
+def _make_dat(path, nbytes, seed):
+    rng = np.random.default_rng(seed)
+    blob = rng.integers(0, 256, nbytes, np.uint8).tobytes()
+    with open(path, "wb") as f:
+        f.write(blob)
+    return blob
+
+
+def test_lrc_generate_and_minimal_rebuild(tmp_path):
+    """write_ec_files under lrc_10_2_2, then single-shard rebuilds:
+    a group shard reads 5 survivors, a global parity reads 10 — and the
+    rebuilt files are byte-identical to the originals."""
+    base = str(tmp_path / "v1")
+    _make_dat(base + ".dat", 3210, 5)
+    coder = new_coder(10, 4, backend="cpu", geometry=LRC)
+    write_ec_files(base, coder, TEST_GEO_LRC)
+    originals = {}
+    for i in range(14):
+        with open(TEST_GEO_LRC.shard_file_name(base, i), "rb") as f:
+            originals[i] = f.read()
+    for lost, expect_reads in ((2, 5), (11, 5), (13, 10)):
+        os.remove(TEST_GEO_LRC.shard_file_name(base, lost))
+        stats: dict = {}
+        rebuilt = rebuild_ec_files(base, coder, TEST_GEO_LRC,
+                                   stats=stats)
+        assert rebuilt == [lost]
+        assert stats["survivor_shards"] == expect_reads
+        assert stats["geometry"] == "lrc_10_2_2"
+        assert stats["survivor_bytes_read"] == \
+            expect_reads * len(originals[lost])
+        with open(TEST_GEO_LRC.shard_file_name(base, lost), "rb") as f:
+            assert f.read() == originals[lost], f"shard {lost} changed"
+
+
+def test_rs_rebuild_reads_exactly_k_not_all_survivors(tmp_path):
+    """Even RS gains from the plan: the rebuild used to OPEN/READ every
+    survivor (up to 13) while the decode used only the first k — now it
+    reads exactly its decode set."""
+    base = str(tmp_path / "v2")
+    _make_dat(base + ".dat", 2048, 6)
+    coder = new_coder(10, 4, backend="cpu")
+    write_ec_files(base, coder, TEST_GEO_RS)
+    with open(TEST_GEO_RS.shard_file_name(base, 0), "rb") as f:
+        original = f.read()
+    os.remove(TEST_GEO_RS.shard_file_name(base, 0))
+    stats: dict = {}
+    assert rebuild_ec_files(base, coder, TEST_GEO_RS,
+                            stats=stats) == [0]
+    assert stats["survivor_shards"] == 10
+    with open(TEST_GEO_RS.shard_file_name(base, 0), "rb") as f:
+        assert f.read() == original
+
+
+def test_rebuild_want_limits_targets(tmp_path):
+    """`want` rebuilds only the asked-for shards — the ec.rebuild flow
+    where locally-absent shards exist on peers and need no rebuild."""
+    base = str(tmp_path / "v3")
+    _make_dat(base + ".dat", 1500, 7)
+    coder = new_coder(10, 4, backend="cpu", geometry=LRC)
+    write_ec_files(base, coder, TEST_GEO_LRC)
+    os.remove(TEST_GEO_LRC.shard_file_name(base, 1))
+    os.remove(TEST_GEO_LRC.shard_file_name(base, 8))
+    rebuilt = rebuild_ec_files(base, coder, TEST_GEO_LRC, want=[8])
+    assert rebuilt == [8]
+    assert not os.path.exists(TEST_GEO_LRC.shard_file_name(base, 1))
+
+
+def test_mixed_geometry_persistence_roundtrip_one_server(tmp_path):
+    """Acceptance path: encode (rs + lrc on ONE store) -> unmount ->
+    remount -> degraded read -> rebuild. The .vif names the geometry,
+    the mount reads it back, and every consumer picks the right coder."""
+    st = Store([str(tmp_path)])
+    blobs: dict[int, dict[int, bytes]] = {}
+    for vid, geo in ((1, TEST_GEO_RS), (2, TEST_GEO_LRC)):
+        v = st.add_volume(vid)
+        rng = np.random.default_rng(vid)
+        blobs[vid] = {}
+        for i in range(1, 15):
+            data = rng.integers(
+                0, 256, int(rng.integers(100, 900)), np.uint8).tobytes()
+            v.write_needle(Needle.create(i, 0xABC, data))
+            blobs[vid][i] = data
+        base = v.file_name()
+        with v._lock:
+            v._sync_buffers()
+        write_ec_files(base, st.coder_for(geo), geo)
+        write_sorted_file_from_idx(base)
+        save_volume_info(base, {
+            "version": v.version, "dataShards": geo.data_shards,
+            "parityShards": geo.parity_shards,
+            "largeBlock": geo.large_block,
+            "smallBlock": geo.small_block, "geometry": geo.code_name})
+        st.unmount_volume(vid)
+        st.mount_ec_shards(vid, "", list(range(geo.total_shards)))
+    # geometry survives the mount
+    assert st.find_ec_volume(1).geo.code_name == "rs_10_4"
+    ev2 = st.find_ec_volume(2)
+    assert ev2.geo.code_name == "lrc_10_2_2"
+    assert ev2.coder.geometry_id == "lrc_10_2_2"
+    # remount cycle (a restart): scan-driven load keeps the geometry
+    st.unmount_ec_shards(2)
+    st.mount_ec_shards(2, "", list(range(14)))
+    ev2 = st.find_ec_volume(2)
+    assert ev2.geo.code_name == "lrc_10_2_2"
+    # degraded read: drop shard 2's mmap from the runtime (group loss)
+    ev2.shard_files = {i: f for i, f in ev2.shard_files.items()
+                       if i != 2}
+    from seaweedfs_tpu.utils.stats import EC_REPAIR_BYTES
+
+    before = EC_REPAIR_BYTES.value(geometry="lrc_10_2_2",
+                                   kind="degraded_read")
+    for i, data in blobs[2].items():
+        n = Needle.from_bytes(ev2.read_needle_blob(i), ev2.version)
+        assert n.data == data
+    assert EC_REPAIR_BYTES.value(geometry="lrc_10_2_2",
+                                 kind="degraded_read") > before
+    # rs volume still reads (its own coder, its own lanes)
+    ev1 = st.find_ec_volume(1)
+    ev1.shard_files = {i: f for i, f in ev1.shard_files.items()
+                      if i != 0}
+    for i, data in blobs[1].items():
+        n = Needle.from_bytes(ev1.read_needle_blob(i), ev1.version)
+        assert n.data == data
+    # rebuild the lost lrc shard from disk survivors and re-read
+    base2 = (str(tmp_path) + "/2")
+    os.remove(TEST_GEO_LRC.shard_file_name(base2, 2))
+    stats: dict = {}
+    assert rebuild_ec_files(base2, st.coder_for(TEST_GEO_LRC),
+                            TEST_GEO_LRC, stats=stats) == [2]
+    assert stats["survivor_shards"] == 5
+    st.mount_ec_shards(2, "", list(range(14)))
+    ev2 = st.find_ec_volume(2)
+    assert 2 in ev2.shard_files
+    for i, data in blobs[2].items():
+        n = Needle.from_bytes(ev2.read_needle_blob(i), ev2.version)
+        assert n.data == data
+    st.close()
+
+
+def test_unregistered_geometry_refused_at_mount(tmp_path):
+    base = str(tmp_path / "v9")
+    _make_dat(base + ".dat", 1000, 9)
+    coder = new_coder(10, 4, backend="cpu")
+    write_ec_files(base, coder, TEST_GEO_RS)
+    # a needle map is required for EcVolume; fake a minimal one
+    with open(base + ".idx", "wb") as f:
+        f.write(b"")
+    write_sorted_file_from_idx(base)
+    save_volume_info(base, {"version": 3, "dataShards": 10,
+                            "parityShards": 4,
+                            "largeBlock": TEST_GEO_RS.large_block,
+                            "smallBlock": TEST_GEO_RS.small_block,
+                            "geometry": "mystery_code_1"})
+    with pytest.raises(ValueError) as ei:
+        EcVolume(base, coder)
+    assert "mystery_code_1" in str(ei.value)
+    # the vif itself still parses (the error is the registry's)
+    assert load_volume_info(base)["geometry"] == "mystery_code_1"
+
+
+# -- scrub: syndrome verify covers local AND global parity rows -------------
+
+
+def test_scrub_syndrome_checks_local_and_global_parities(tmp_path):
+    """Corrupt a LOCAL parity shard (10) and then a GLOBAL one (13) of
+    an lrc volume: the syndrome sweep must flag and repair both — the
+    re-encode multiplies the full generator, so every parity row is
+    checked."""
+    from seaweedfs_tpu.scrub.scrubber import Scrubber
+
+    st = Store([str(tmp_path)])
+    v = st.add_volume(7)
+    rng = np.random.default_rng(77)
+    blobs = {}
+    for i in range(1, 20):
+        data = rng.integers(0, 256,
+                            int(rng.integers(100, 900)), np.uint8).tobytes()
+        v.write_needle(Needle.create(i, 0xABC, data))
+        blobs[i] = data
+    base = v.file_name()
+    with v._lock:
+        v._sync_buffers()
+    write_ec_files(base, st.coder_for(TEST_GEO_LRC), TEST_GEO_LRC)
+    write_sorted_file_from_idx(base)
+    save_volume_info(base, {
+        "version": v.version, "dataShards": 10, "parityShards": 4,
+        "largeBlock": TEST_GEO_LRC.large_block,
+        "smallBlock": TEST_GEO_LRC.small_block,
+        "geometry": "lrc_10_2_2"})
+    st.unmount_volume(7)
+    st.mount_ec_shards(7, "", list(range(14)))
+    sc = Scrubber(st, None, interval_s=0, max_mbps=0)
+    for bad in (10, 13):
+        with open(TEST_GEO_LRC.shard_file_name(base, bad), "r+b") as f:
+            f.seek(17)
+            b = f.read(1)
+            f.seek(-1, 1)
+            f.write(bytes([b[0] ^ 0x3C]))
+        report = sc.run_once(vid=7, full=True)
+        culprits = [(f.shard_id, f.state) for f in report.findings
+                    if f.kind == "ec_parity"]
+        assert (bad, "repaired") in culprits, (bad, report.findings)
+    # converged: clean sweep, correct reads
+    r2 = sc.run_once(vid=7, full=True)
+    assert not [f for f in r2.findings if f.kind == "ec_parity"]
+    ev = st.find_ec_volume(7)
+    for i, data in blobs.items():
+        assert Needle.from_bytes(ev.read_needle_blob(i),
+                                 ev.version).data == data
+    st.close()

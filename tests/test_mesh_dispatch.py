@@ -248,7 +248,7 @@ def test_reconstruct_survivor_set_chip_placement_lru():
             m2, r2 = cpu.reconstruct_stacked(pres, stk)
             assert tuple(m) == tuple(m2)
             assert np.array_equal(np.asarray(rows), np.asarray(r2))
-            key = ("rec", pres, False)
+            key = ("rec", sched.geom_id, pres, False, None)
             keys.append(key)
             with sched._cv:
                 chip = sched._rec_chips.get(key)
@@ -259,7 +259,7 @@ def test_reconstruct_survivor_set_chip_placement_lru():
             assert len(sched._rec_chips) <= 4, "rec-chip map not LRU-bounded"
             assert keys[0] not in sched._rec_chips, "oldest set not evicted"
         # a re-used (re-assigned) set still reconstructs bit-identically
-        pres = keys[0][1]
+        pres = keys[0][2]
         stk = np.stack([shards[i] for i in pres])
         m, rows = sched.reconstruct_stacked(pres, stk).result()
         m2, r2 = cpu.reconstruct_stacked(pres, stk)
