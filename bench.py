@@ -2097,7 +2097,455 @@ def _bench_repair_ab() -> dict:
     return out
 
 
+# ISSUE 12 A/B: the host memory plane. Interleaved arena-on/off arms
+# over IDENTICAL bytes measure (1) the dispatch batch path — host CPU
+# with the pure GF matmul cost calibrated out, so the delta is exactly
+# the allocate/memset/transpose traffic the arena removes; (2) the
+# concurrent multi-volume encode pipeline wall (must not regress);
+# (3) steady-state allocation behavior (tracemalloc peak + arena miss
+# counters: O(1) new staging blocks per batch on, O(V*k*B) bytes off);
+# (4) golden hashes across arena-on / arena-off / all coder backends;
+# (5) the scrub-fadvise satellite's page-cache note (mincore residency
+# after a paced sweep window with the hint on vs off).
+_MEMAB_PROG = r"""
+import ctypes, hashlib, json, mmap, os, sys, tempfile, threading, time
+import tracemalloc
+
+os.environ["SEAWEEDFS_TPU_NATIVE"] = "0"
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")  # never touch the chip here
+
+from seaweedfs_tpu.models.coder import new_coder
+from seaweedfs_tpu.ops import dispatch
+from seaweedfs_tpu.ops.rs_cpu import RSCodecCPU
+from seaweedfs_tpu.storage import ec_files
+from seaweedfs_tpu.storage.backend import DiskFile
+from seaweedfs_tpu.storage.ec_locate import Geometry
+from seaweedfs_tpu.utils import stats
+
+V = int(os.environ.get("SWFS_MEMAB_V", "24"))          # slabs per batch
+B = int(os.environ.get("SWFS_MEMAB_B", str(128 << 10)))  # bytes per slab
+NBATCH = int(os.environ.get("SWFS_MEMAB_NBATCH", "6"))
+ROUNDS = int(os.environ.get("SWFS_MEMAB_ROUNDS", "5"))
+K, M = 10, 4
+
+
+def pick_coder():
+    try:
+        from seaweedfs_tpu.ops.rs_native import RSCodecNative
+
+        c = RSCodecNative(K, M)
+        c.encode_parity(np.zeros((K, 64), np.uint8))
+        return c, "native"
+    except Exception:
+        return RSCodecCPU(K, M), "cpu"
+
+
+def med(xs):
+    return sorted(xs)[len(xs) // 2]
+
+
+CODER, CODER_KIND = pick_coder()
+RNG = np.random.default_rng(12)
+SLABS = [np.ascontiguousarray(RNG.integers(0, 256, (K, B), dtype=np.uint8))
+         for _ in range(V)]
+# survivors for the reconstruct lane: shards 0..2 lost, 3..13 present
+_full = np.asarray(RSCodecCPU(K, M).encode(
+    np.vstack([SLABS[0], np.zeros((M, B), np.uint8)])))
+PRES = tuple(range(3, 14))
+SURV = np.ascontiguousarray(np.stack([_full[p] for p in PRES]))
+
+
+def run_batches(sched, n, hasher=None):
+    # explicit flush: the whole submitted lane rides ONE dispatch with
+    # no window wait (the rec-lane result() path deliberately sleeps a
+    # window beat to coalesce concurrent readers — a bench with a long
+    # anti-fragmentation window must not pay that as latency)
+    for _ in range(n):
+        futs = [sched.encode_parity(s, copy=False) for s in SLABS]
+        sched.flush()
+        outs = [np.asarray(f) for f in futs]
+        if hasher is not None:
+            for o in outs:
+                hasher.update(o.tobytes())
+            hasher = None  # hash one batch per round: bytes repeat
+
+
+def run_recon_batches(sched, n, hasher=None):
+    for _ in range(n):
+        futs = [sched.reconstruct_stacked(PRES, SURV) for _ in range(8)]
+        sched.flush()
+        for f in futs:
+            missing, rows = f.result(timeout=120)
+            if hasher is not None:
+                hasher.update(np.asarray(rows).tobytes())
+        hasher = None
+
+
+def calibrate_matmul_cpu():
+    # the SAME bytes as one dispatch-path round, as bare wide matmuls:
+    # this is the irreducible GF cost; round_cpu - this = batch path
+    wide = np.ascontiguousarray(np.concatenate(SLABS, axis=1))
+    np.asarray(CODER.encode_parity(wide))  # warm tables
+    c0 = time.process_time()
+    for _ in range(NBATCH):
+        np.asarray(CODER.encode_parity(wide))
+    enc = time.process_time() - c0
+    c0 = time.process_time()
+    wide_s = np.ascontiguousarray(np.concatenate([SURV] * 8, axis=1))
+    for _ in range(NBATCH):
+        CODER.reconstruct_stacked(PRES, wide_s)
+    rec = time.process_time() - c0
+    return enc, rec
+
+
+def arm(arena_on, hasher=None):
+    os.environ["SWFS_EC_DISPATCH_ARENA"] = "1" if arena_on else "0"
+    sched = dispatch.EcDispatchScheduler(CODER, window=120.0)
+    try:
+        run_batches(sched, 1)  # warmup (arena sizes its buckets)
+        run_recon_batches(sched, 1)
+        t0, c0 = time.perf_counter(), time.process_time()
+        run_batches(sched, NBATCH, hasher=hasher)
+        run_recon_batches(sched, NBATCH, hasher=hasher)
+        return time.perf_counter() - t0, time.process_time() - c0
+    finally:
+        sched.close()
+
+
+def alloc_probe(arena_on):
+    os.environ["SWFS_EC_DISPATCH_ARENA"] = "1" if arena_on else "0"
+    sched = dispatch.EcDispatchScheduler(CODER, window=120.0)
+    try:
+        run_batches(sched, 2)  # warmup
+        miss0 = (stats.EC_DISPATCH_ARENA_OPS.value(result="miss")
+                 + stats.EC_DISPATCH_ARENA_OPS.value(result="resize"))
+        tracemalloc.start()
+        try:
+            run_batches(sched, 1)  # settle tracemalloc itself
+            tracemalloc.reset_peak()
+            base = tracemalloc.get_traced_memory()[0]
+            run_batches(sched, 3)
+            peak = tracemalloc.get_traced_memory()[1] - base
+        finally:
+            tracemalloc.stop()
+        miss1 = (stats.EC_DISPATCH_ARENA_OPS.value(result="miss")
+                 + stats.EC_DISPATCH_ARENA_OPS.value(result="resize"))
+        return peak, int(miss1 - miss0)
+    finally:
+        sched.close()
+
+
+def backend_hash(kind):
+    # one fixed ragged batch through each backend's scheduler, arena on
+    os.environ["SWFS_EC_DISPATCH_ARENA"] = "1"
+    try:
+        coder = (CODER if kind == CODER_KIND else new_coder(K, M, kind))
+        sched = dispatch.EcDispatchScheduler(coder, window=120.0)
+    except Exception as e:
+        return f"unavailable: {e}"[:80]
+    try:
+        h = hashlib.sha256()
+        widths = [B, B // 2, 1000, B, 37]
+        futs = [sched.encode_parity(s[:, :w], copy=False)
+                for s, w in zip(SLABS, widths)]
+        sched.flush()
+        for f in futs:
+            h.update(np.ascontiguousarray(np.asarray(f)).tobytes())
+        rfut = sched.reconstruct_stacked(PRES, SURV)
+        sched.flush()
+        _, rows = rfut.result(timeout=120)
+        h.update(np.ascontiguousarray(np.asarray(rows)).tobytes())
+        return h.hexdigest()
+    finally:
+        sched.close()
+
+
+def encode_pipeline_ab():
+    # concurrent multi-volume encode wall (must be no worse arena-on)
+    geo = Geometry(large_block=64 * 1024, small_block=4 * 1024)
+    tmp = tempfile.mkdtemp()
+    bases = []
+    for i in range(3):
+        base = os.path.join(tmp, f"v{i}")
+        with open(base + ".dat", "wb") as f:
+            f.write(RNG.integers(0, 256, 4 << 20, np.uint8).tobytes())
+        bases.append(base)
+
+    def round_(mode):
+        os.environ["SWFS_EC_DISPATCH_ARENA"] = mode
+        t0 = time.perf_counter()
+        errs = []
+
+        def one(b):
+            try:
+                ec_files.generate_ec_files(b, CODER, geo, batch_size=4096)
+            except BaseException as e:
+                errs.append(e)
+
+        ths = [threading.Thread(target=one, args=(b,)) for b in bases]
+        for t in ths:
+            t.start()
+        for t in ths:
+            t.join()
+        if errs:
+            raise errs[0]
+        return time.perf_counter() - t0
+
+    round_("0")  # warm page cache
+    h = {}
+    for mode in ("0", "1"):
+        os.environ["SWFS_EC_DISPATCH_ARENA"] = mode
+        round_(mode)
+        hh = hashlib.sha256()
+        for b in bases:
+            for i in range(14):
+                hh.update(open(geo.shard_file_name(b, i), "rb").read())
+        h[mode] = hh.hexdigest()
+    on, off = [], []
+    for _ in range(3):
+        off.append(round_("0"))
+        on.append(round_("1"))
+    return {
+        "volumes": 3, "vol_mb": 4,
+        "off_median_s": round(med(off), 3),
+        "on_median_s": round(med(on), 3),
+        "wall_delta_pct": round(100 * (med(on) - med(off)) / med(off), 1),
+        "shard_hash_identical": h["0"] == h["1"],
+    }
+
+
+def resident_bytes(path):
+    size = os.path.getsize(path)
+    if size == 0:
+        return 0
+    # ACCESS_WRITE (never written) only so ctypes.from_buffer can take
+    # the mapping's address — a read-only mmap exports a read-only
+    # buffer, which from_buffer refuses
+    with open(path, "r+b") as f:
+        mm = mmap.mmap(f.fileno(), size, access=mmap.ACCESS_WRITE)
+        try:
+            libc = ctypes.CDLL(None, use_errno=True)
+            pages = (size + 4095) // 4096
+            vec = (ctypes.c_ubyte * pages)()
+            addr = ctypes.addressof(ctypes.c_char.from_buffer(mm))
+            if libc.mincore(ctypes.c_void_p(addr), ctypes.c_size_t(size),
+                            vec) != 0:
+                return -1
+            return sum(v & 1 for v in vec) * 4096
+        finally:
+            mm.close()
+
+
+def scrub_fadvise_note():
+    # the satellite's before/after page-cache note: a paced sweep window
+    # over a cold file, SWFS_SCRUB_FADVISE off vs on
+    from seaweedfs_tpu.scrub import scrubber as scrub_mod
+
+    size = 8 << 20
+    out = {"file_mb": size >> 20}
+    calls = {"n": 0}
+    real = os.posix_fadvise
+
+    def counting(fd, off, ln, advice):
+        calls["n"] += 1
+        return real(fd, off, ln, advice)
+
+    os.posix_fadvise = counting
+    try:
+        for mode in ("0", "1"):
+            os.environ["SWFS_SCRUB_FADVISE"] = mode
+            path = os.path.join(tempfile.mkdtemp(), "sweep.dat")
+            with open(path, "wb") as f:
+                f.write(RNG.integers(0, 256, size, np.uint8).tobytes())
+                f.flush()
+                os.fsync(f.fileno())
+            df = DiskFile(path)
+            df.drop_page_cache()  # start cold either way
+            if mode == "1":
+                calls["n"] = 0
+            win = 1 << 20
+            for off in range(0, size, win):  # scrubber's windowed walk
+                df.read_at(off, win)
+                scrub_mod._drop_swept_range(df, off, win)
+            out["resident_after_%s" % ("on" if mode == "1" else "off")] \
+                = resident_bytes(path)
+            df.close()
+    finally:
+        os.posix_fadvise = real
+    out["fadvise_calls_on"] = calls["n"]
+    if out["resident_after_on"] >= out["resident_after_off"]:
+        # the DONTNEED hints WERE issued (fadvise_calls_on counts them)
+        # but this filesystem ignored them — the sandbox's 9p mount
+        # cannot evict page cache on request (and drop_caches is not
+        # permitted in the container), so the residency delta is only
+        # expressible on a real volume server's ext4/xfs disks
+        out["box_note"] = (
+            "fadvise hints issued but not honored by this sandbox's "
+            "9p filesystem; residency delta requires a real disk fs")
+    return out
+
+
+def main():
+    enc_cal, rec_cal = calibrate_matmul_cpu()
+    matmul_cpu = enc_cal + rec_cal
+    hashes = {"on": hashlib.sha256(), "off": hashlib.sha256()}
+    on_w, off_w, on_c, off_c = [], [], [], []
+    for r in range(ROUNDS):  # interleaved: same-box load fairness
+        w, c = arm(False, hasher=hashes["off"] if r == 0 else None)
+        off_w.append(w)
+        off_c.append(c)
+        w, c = arm(True, hasher=hashes["on"] if r == 0 else None)
+        on_w.append(w)
+        on_c.append(c)
+    bp_off = [c - matmul_cpu for c in off_c]
+    bp_on = [c - matmul_cpu for c in on_c]
+    peak_on, miss_on = alloc_probe(True)
+    peak_off, _ = alloc_probe(False)
+    backends = {k: backend_hash(k) for k in ("cpu", "native", "tpu")}
+    real = [v for v in backends.values() if not v.startswith("unavailable")]
+    out = {
+        "coder": CODER_KIND,
+        "slabs_per_batch": V, "slab_bytes": B, "batches": NBATCH,
+        "rounds": ROUNDS,
+        "batch_bytes_total": V * K * B,
+        "matmul_calibration_cpu_s": round(matmul_cpu, 3),
+        "off_cpu_s": [round(x, 3) for x in off_c],
+        "on_cpu_s": [round(x, 3) for x in on_c],
+        "off_batch_path_cpu_median_s": round(med(bp_off), 4),
+        "on_batch_path_cpu_median_s": round(med(bp_on), 4),
+        "batch_path_cpu_delta_pct": round(
+            100 * (med(bp_off) - med(bp_on)) / max(med(bp_off), 1e-9), 1),
+        "off_wall_median_s": round(med(off_w), 3),
+        "on_wall_median_s": round(med(on_w), 3),
+        "wall_delta_pct": round(
+            100 * (med(on_w) - med(off_w)) / med(off_w), 1),
+        "dispatch_hash_identical": (
+            hashes["on"].hexdigest() == hashes["off"].hexdigest()),
+        "alloc": {
+            # staging bytes the arena removes from every batch's peak
+            "tracemalloc_peak_on": int(peak_on),
+            "tracemalloc_peak_off": int(peak_off),
+            "staging_bytes_per_batch": V * K * B,
+            # O(1) claim: zero new arena allocations after warmup
+            "arena_misses_after_warmup": miss_on,
+        },
+        "golden_hash_backends": backends,
+        "backends_identical": len(set(real)) == 1 and len(real) >= 2,
+        "encode_pipeline": encode_pipeline_ab(),
+        "scrub_fadvise": scrub_fadvise_note(),
+        "arena": stats.ec_dispatch_stats()["arena"],
+    }
+    dispatch.shutdown_all()
+    print(json.dumps(out))
+
+
+main()
+"""
+
+
+def _bench_memplane_ab() -> dict:
+    """Run the host-memory-plane A/B child (hard timeout, last-JSON
+    salvage — the standard wedged-tunnel guard pattern, though the child
+    pins JAX_PLATFORMS=cpu and never touches the chip)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _MEMAB_PROG], cwd=_HERE,
+            capture_output=True, text=True,
+            timeout=float(os.environ.get("SEAWEEDFS_TPU_MEMAB_TIMEOUT",
+                                         "900")))
+        out = _last_json_line(proc.stdout)
+        if out is None:
+            return {"error": f"rc={proc.returncode}: {proc.stderr[-300:]}"}
+    except subprocess.TimeoutExpired:
+        return {"error": "memplane A/B timed out"}
+    except Exception as e:  # never let the secondary hurt the headline
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+    return out
+
+
+# device-side capture for ISSUE 12: a tiny arena-on stacked-encode
+# throughput probe on the REAL chip. Only runs when the tunnel answers
+# the cheap probe first (tools/await_tpu.py's guard pattern: the wedged
+# tunnel HANGS rather than erring, so everything rides a subprocess
+# with a hard timeout — skip cleanly, never hang).
+_MEMDEV_PROG = r"""
+import json, os, time
+import numpy as np
+os.environ["SWFS_EC_DISPATCH_ARENA"] = "1"
+import jax
+from seaweedfs_tpu.ops import dispatch
+from seaweedfs_tpu.ops.rs_jax import RSCodecJax
+from seaweedfs_tpu.utils import stats
+
+coder = RSCodecJax(10, 4)
+sched = dispatch.EcDispatchScheduler(coder, window=120.0)
+rng = np.random.default_rng(3)
+V, B = 8, 1 << 20
+slabs = [rng.integers(0, 256, (10, B), dtype=np.uint8) for _ in range(V)]
+futs = [sched.encode_parity(s) for s in slabs]  # compile + warm
+[np.asarray(f) for f in futs]
+t0 = time.perf_counter()
+ROUNDS = 4
+for _ in range(ROUNDS):
+    futs = [sched.encode_parity(s, copy=False) for s in slabs]
+    futs[-1].result(timeout=300)
+    [np.asarray(f) for f in futs]
+wall = time.perf_counter() - t0
+sched.close()
+print(json.dumps({
+    "backend": jax.default_backend(),
+    "arena": stats.ec_dispatch_stats()["arena"],
+    "stacked_encode_gbps": round(ROUNDS * V * 10 * B / wall / 1e9, 3),
+    "slabs_per_batch": V, "slab_bytes": B, "rounds": ROUNDS,
+}))
+"""
+
+
+def _bench_memplane_device() -> dict:
+    """Best-effort real-device arena capture (BENCH_DEVICE_ISSUE12):
+    probe first, then the capture child — both under hard timeouts."""
+    probe = _await_device_probe()
+    if "timeout" in probe:
+        return {"skipped": f"device probe timed out after "
+                           f"{probe['timeout']:.0f}s (tunnel wedged)"}
+    if probe.get("backend") != "tpu":
+        return {"skipped": f"no tpu backend "
+                           f"({probe.get('backend') or probe.get('error', '?')})"}
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", _MEMDEV_PROG], cwd=_HERE,
+            capture_output=True, text=True,
+            timeout=float(os.environ.get("SEAWEEDFS_TPU_MEMDEV_TIMEOUT",
+                                         "540")))
+        out = _last_json_line(proc.stdout)
+        if out is None:
+            return {"skipped": f"rc={proc.returncode}: "
+                               f"{proc.stderr[-300:]}"}
+        return out
+    except subprocess.TimeoutExpired:
+        return {"skipped": "device capture timed out (tunnel re-wedged)"}
+    except Exception as e:  # noqa: BLE001
+        return {"skipped": f"{type(e).__name__}: {e}"[:200]}
+
+
 def main() -> int:
+    if "--memplane-ab" in sys.argv:
+        # standalone host-memory-plane A/B (ISSUE 12): arena on/off over
+        # identical bytes + best-effort real-device capture; prints the
+        # BENCH_AB_ISSUE12.json artifact content and writes the artifact
+        out = _bench_memplane_ab()
+        dev = _bench_memplane_device()
+        if "skipped" not in dev:
+            with open(os.path.join(_HERE, "BENCH_DEVICE_ISSUE12.json"),
+                      "w") as f:
+                json.dump(dev, f, indent=1)
+        out["device_capture"] = dev
+        with open(os.path.join(_HERE, "BENCH_AB_ISSUE12.json"), "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps(out))
+        return 0 if "batch_path_cpu_delta_pct" in out else 1
     if "--ec-ab" in sys.argv:
         # standalone EC-dispatch A/B (writes the BENCH_AB_ISSUE3.json
         # artifact content to stdout)

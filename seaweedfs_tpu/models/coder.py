@@ -168,6 +168,24 @@ class AutoMeshCoder:
             return fn(stack, device)
         return self.encode_parity_stacked(stack)
 
+    def encode_parity_on(self, data, device):
+        """Wide [k, W] encode pinned to one chip — the arena-packed
+        chip-lane form (ISSUE 12); placement-only fallback as above."""
+        impl = self._resolve()
+        fn = getattr(impl, "encode_parity_on", None)
+        if fn is not None:
+            return fn(data, device)
+        return impl.encode_parity(data)
+
+    @property
+    def prefers_vstack(self) -> bool:
+        """True on a resolved multi-chip mesh: the dispatch scheduler
+        then keeps [V, k, B] stacks for non-chip lanes (V-axis mesh
+        sharding, ISSUE 5) instead of the wide packing. Property access
+        resolves the backend — only the scheduler reads it, and only
+        from a flush, which is already device work."""
+        return bool(getattr(self._resolve(), "prefers_vstack", False))
+
     def reconstruct_stacked_on(self, present_ids, stacked,
                                data_only=False, device=None, want=None):
         impl = self._resolve()

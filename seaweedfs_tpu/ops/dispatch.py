@@ -31,6 +31,21 @@ arxiv 1207.6744). The scheduler applies both without changing a single
 output byte: tests pin .ec00-.ec13 bit-identity with the scheduler on
 and off.
 
+The HOST side of a flush is its own optimization target (ISSUE 12): once
+the GF arithmetic is fast, software-EC throughput lives in memory
+traffic, not ALU work (arXiv:2108.02692). A flush therefore packs its
+slabs into a recycled page-aligned `StackArena` buffer instead of
+allocating a fresh zero-filled stack per batch: encode/reconstruct
+batches pack COLUMN-COMPACTLY (`[rows, sum(widths)]`, zero-fill fully
+elided — every byte is payload) and mesh V-axis batches pack `[V, rows,
+B]` with only ragged tails memset. Arena buffers are recycled only after
+the dispatch has provably consumed the bytes (synchronous backends:
+immediately; async jax dispatches: once the output `is_ready()`, which
+also covers the CPU client's zero-copy aliasing of page-aligned host
+buffers) — never while an `EcFuture` could still read them. The flusher
+thread can optionally be NUMA-pinned (`SWFS_EC_DISPATCH_PIN`,
+utils/numa.py).
+
 Also here: `ReconstructIntervalCache`, the bounded LRU of reconstructed
 shard blocks serving repeated degraded reads of a hot lost shard
 (server/volume.py keys it by (vid, shard_id, block) and invalidates on
@@ -49,13 +64,17 @@ from collections import OrderedDict
 
 import numpy as np
 
-from ..utils import trace
+from ..utils import numa, trace
 from ..utils.stats import (
+    EC_DISPATCH_ARENA_INUSE,
+    EC_DISPATCH_ARENA_OPS,
+    EC_DISPATCH_ARENA_POOLED,
     EC_DISPATCH_BATCHES,
     EC_DISPATCH_SLABS,
     EC_DISPATCH_STACK_BYTES,
     EC_DISPATCH_STACK_SLABS,
     EC_DISPATCH_WINDOW_WAIT,
+    EC_DISPATCH_ZEROFILL_ELIDED,
     EC_RECON_CACHE_COUNTER,
 )
 
@@ -86,6 +105,204 @@ def vshard_enabled() -> bool:
 def window_s() -> float:
     return float(os.environ.get("SWFS_EC_DISPATCH_WINDOW_MS",
                                 str(DEFAULT_WINDOW_MS))) / 1000.0
+
+
+def arena_enabled() -> bool:
+    """SWFS_EC_DISPATCH_ARENA gates the host memory plane (ISSUE 12):
+    recycled flush buffers instead of a fresh zero-filled stack per
+    batch (default on; 0 restores the allocate-per-flush path)."""
+    return os.environ.get("SWFS_EC_DISPATCH_ARENA", "1").lower() not in (
+        "0", "false", "off")
+
+
+# -- stack arena (ISSUE 12): the host memory plane ---------------------------
+
+_PAGE = 4096
+DEFAULT_ARENA_POOL_MB = 256
+DEFAULT_ARENA_POOL_BUFS = 8
+
+
+def _aligned_empty(nbytes: int) -> np.ndarray:
+    """Page-aligned uint8 buffer of `nbytes` (a view into a slightly
+    larger allocation; the view keeps the backing array alive). Page
+    alignment matters twice: jax's CPU client zero-copies page-aligned
+    host buffers into device arrays (no memcpy on commit), and the
+    native plane's ctypes kernels read the buffer in aligned streams."""
+    raw = np.empty(nbytes + _PAGE, dtype=np.uint8)
+    off = (-raw.ctypes.data) % _PAGE
+    return raw[off:off + nbytes]
+
+
+def _consumed(out_ref) -> bool:
+    """True iff the dispatch that read an arena buffer has provably
+    consumed its bytes. Synchronous backends (rs_cpu / rs_native) return
+    realized numpy arrays — consumed by construction. Async jax arrays
+    expose is_ready(): once the FINAL output of a dispatch is ready,
+    every producing computation (including the host->device transfer or
+    zero-copy read of the input) has executed, so the input buffer is
+    free. Anything unprobeable is treated as never-consumed (the arena
+    then drops the buffer rather than risk recycling live bytes)."""
+    if out_ref is None or isinstance(out_ref, np.ndarray):
+        return True
+    fn = getattr(out_ref, "is_ready", None)
+    if fn is None:
+        return not hasattr(out_ref, "block_until_ready")  # non-jax: sync
+    try:
+        return bool(fn())
+    except Exception:  # noqa: BLE001 — deleted/donated buffer etc.
+        return True
+
+
+class _ArenaBuf:
+    __slots__ = ("flat", "cap")
+
+    def __init__(self, cap: int):
+        self.flat = _aligned_empty(cap)
+        self.cap = cap
+
+
+class StackArena:
+    """Bounded pool of reusable page-aligned host buffers for stacked
+    flushes — the allocation/memset/copy diet of ISSUE 12.
+
+    A flush checks a buffer out (`get`), packs its slabs into a view of
+    it, dispatches, and hands the buffer back with the dispatch's output
+    handle (`release`). The buffer returns to the free pool ONLY once
+    that output proves the bytes were consumed (`_consumed`): numpy
+    outputs immediately, lazy jax outputs when `is_ready()` — never
+    while an in-flight async dispatch (or a zero-copy-committed device
+    array) could still read the host bytes. Buffers whose dispatch never
+    proves consumption are dropped, not recycled: bit-identity beats a
+    pool hit, always.
+
+    Capacities are rounded to power-of-two pages so steady-state lanes
+    (same shape flush after flush) hit the same bucket every time; the
+    pool is bounded by buffer count and total bytes (lane-cap sized:
+    SWFS_EC_DISPATCH_ARENA_MB / _BUFS)."""
+
+    def __init__(self, max_bufs: int | None = None,
+                 max_bytes: int | None = None):
+        if max_bufs is None:
+            max_bufs = int(os.environ.get("SWFS_EC_DISPATCH_ARENA_BUFS",
+                                          str(DEFAULT_ARENA_POOL_BUFS)))
+        if max_bytes is None:
+            max_bytes = int(float(os.environ.get(
+                "SWFS_EC_DISPATCH_ARENA_MB",
+                str(DEFAULT_ARENA_POOL_MB))) * 1024 * 1024)
+        self.max_bufs = max(1, max_bufs)
+        self.max_bytes = max(_PAGE, max_bytes)
+        self._pool: dict[int, list[_ArenaBuf]] = {}
+        self._pooled_bytes = 0
+        self._inuse_bytes = 0
+        self._quarantine: list[tuple[_ArenaBuf, object]] = []
+        self._largest = 0
+        self._mu = threading.Lock()
+
+    @staticmethod
+    def _bucket(nbytes: int) -> int:
+        cap = _PAGE
+        while cap < nbytes:
+            cap *= 2
+        return cap
+
+    def _sweep_locked(self) -> None:
+        """Move quarantined buffers whose dispatch completed back to the
+        pool (opportunistic — called from get/release, never blocks).
+        The quarantine itself is bounded: a backend whose outputs never
+        prove consumption sheds its oldest buffers to the GC (counted
+        as drops) instead of accumulating them forever."""
+        still = []
+        for buf, out_ref in self._quarantine:
+            if _consumed(out_ref):
+                self._pool_locked(buf)
+            else:
+                still.append((buf, out_ref))
+        while len(still) > max(8, 2 * self.max_bufs):
+            buf, _ = still.pop(0)
+            self._inuse_bytes -= buf.cap
+            EC_DISPATCH_ARENA_INUSE.set(self._inuse_bytes)
+            EC_DISPATCH_ARENA_OPS.inc(result="drop")
+        self._quarantine = still
+
+    def _pool_locked(self, buf: _ArenaBuf) -> None:
+        self._inuse_bytes -= buf.cap
+        bucket = self._pool.setdefault(buf.cap, [])
+        n_pooled = sum(len(v) for v in self._pool.values())
+        if (n_pooled >= self.max_bufs
+                or self._pooled_bytes + buf.cap > self.max_bytes):
+            EC_DISPATCH_ARENA_OPS.inc(result="drop")
+        else:
+            bucket.append(buf)
+            self._pooled_bytes += buf.cap
+            EC_DISPATCH_ARENA_OPS.inc(result="recycle")
+        EC_DISPATCH_ARENA_INUSE.set(self._inuse_bytes)
+        EC_DISPATCH_ARENA_POOLED.set(self._pooled_bytes)
+
+    def get(self, nbytes: int) -> _ArenaBuf:
+        """Smallest pooled buffer with capacity >= nbytes, else a fresh
+        page-aligned allocation (miss; resize when the request outgrew
+        every capacity this arena has ever served)."""
+        want = self._bucket(max(1, nbytes))
+        with self._mu:
+            self._sweep_locked()
+            for cap in sorted(self._pool):
+                if cap >= want and self._pool[cap]:
+                    buf = self._pool[cap].pop()
+                    self._pooled_bytes -= cap
+                    self._inuse_bytes += cap
+                    EC_DISPATCH_ARENA_OPS.inc(result="hit")
+                    EC_DISPATCH_ARENA_INUSE.set(self._inuse_bytes)
+                    EC_DISPATCH_ARENA_POOLED.set(self._pooled_bytes)
+                    return buf
+            grew = want > self._largest
+            self._largest = max(self._largest, want)
+            self._inuse_bytes += want
+            EC_DISPATCH_ARENA_INUSE.set(self._inuse_bytes)
+        EC_DISPATCH_ARENA_OPS.inc(result="resize" if grew else "miss")
+        return _ArenaBuf(want)
+
+    def release(self, buf: _ArenaBuf, out_ref) -> None:
+        """Hand a checked-out buffer back, tied to the dispatch output
+        that consumed it. Recycles now when consumption is proven,
+        quarantines otherwise (re-checked on later get/release)."""
+        with self._mu:
+            if _consumed(out_ref):
+                self._pool_locked(buf)
+            else:
+                self._quarantine.append((buf, out_ref))
+            self._sweep_locked()
+
+    def drop(self, buf: _ArenaBuf) -> None:
+        """Abandon a checked-out buffer (a dispatch that raised may have
+        half-submitted async work; recycling would risk live bytes)."""
+        with self._mu:
+            self._inuse_bytes -= buf.cap
+            EC_DISPATCH_ARENA_INUSE.set(self._inuse_bytes)
+        EC_DISPATCH_ARENA_OPS.inc(result="drop")
+
+    def stats(self) -> dict:
+        with self._mu:
+            return {
+                "pooled": sum(len(v) for v in self._pool.values()),
+                "pooledBytes": self._pooled_bytes,
+                "inUseBytes": self._inuse_bytes,
+                "quarantined": len(self._quarantine),
+            }
+
+    def close(self) -> None:
+        """Drop everything (quarantined buffers are abandoned to the GC
+        — their dispatches keep them alive exactly as long as needed)."""
+        with self._mu:
+            dropped = sum(len(v) for v in self._pool.values()) \
+                + len(self._quarantine)
+            self._pool.clear()
+            self._quarantine.clear()
+            self._pooled_bytes = 0
+            self._inuse_bytes = 0
+            EC_DISPATCH_ARENA_INUSE.set(0)
+            EC_DISPATCH_ARENA_POOLED.set(0)
+        if dropped:
+            EC_DISPATCH_ARENA_OPS.inc(dropped, result="drop")
 
 
 class EcFuture:
@@ -325,8 +542,77 @@ class EcDispatchScheduler:
         # cross-module rendezvous and deadlock XLA (caught by
         # tests/test_ec_pipeline.py under the 8-device test mesh).
         self._dispatch_mu = threading.Lock()
+        # host memory plane (ISSUE 12): lazily built so the env gate can
+        # flip between A/B arms without rebuilding schedulers
+        self._arena: StackArena | None = None
         self.closed = False
         _schedulers.add(self)
+
+    # -- arena plumbing ----------------------------------------------------
+
+    def _arena_for(self) -> StackArena | None:
+        if not arena_enabled():
+            return None
+        arena = self._arena
+        if arena is None:
+            arena = self._arena = StackArena()
+        return arena
+
+    def _arena_release(self, buf, out_ref) -> None:
+        if buf is not None and self._arena is not None:
+            self._arena.release(buf, out_ref)
+
+    def _arena_drop(self, buf) -> None:
+        if buf is not None and self._arena is not None:
+            self._arena.drop(buf)
+
+    def _pack_wide(self, slabs: "list[_Slab]"):
+        """Pack slabs column-compactly into ONE [rows, sum(widths)]
+        buffer — an arena view when the plane is on, a fresh (never
+        zero-filled) array otherwise. Columns are independent under
+        every GF matmul this scheduler dispatches, so packing needs no
+        inter-slab padding and therefore no memset at all: every byte
+        of the packed region is slab payload."""
+        rows = slabs[0].data.shape[0]
+        total = sum(s.width for s in slabs)
+        arena = self._arena_for()
+        if arena is not None:
+            buf = arena.get(rows * total)
+            wide = buf.flat[: rows * total].reshape(rows, total)
+        else:
+            buf = None
+            wide = np.empty((rows, total), np.uint8)
+        off = 0
+        for s in slabs:
+            wide[:, off: off + s.width] = s.data
+            off += s.width
+        EC_DISPATCH_ZEROFILL_ELIDED.inc(rows * total)
+        return wide, buf
+
+    def _pack_vstack(self, slabs: "list[_Slab]"):
+        """Pack slabs into ONE [V, rows, bmax] stack (the V-axis form
+        mesh coders shard whole slabs across chips). Zero-fill is
+        elided for the payload region — only ragged tails (width <
+        bmax) are memset, and uniform-width batches memset nothing."""
+        v = len(slabs)
+        rows = slabs[0].data.shape[0]
+        bmax = max(s.width for s in slabs)
+        region = v * rows * bmax
+        arena = self._arena_for()
+        if arena is not None:
+            buf = arena.get(region)
+            stack = buf.flat[:region].reshape(v, rows, bmax)
+        else:
+            buf = None
+            stack = np.empty((v, rows, bmax), np.uint8)
+        tails = 0
+        for i, s in enumerate(slabs):
+            stack[i, :, : s.width] = s.data
+            if s.width < bmax:
+                stack[i, :, s.width:] = 0
+                tails += rows * (bmax - s.width)
+        EC_DISPATCH_ZEROFILL_ELIDED.inc(region - tails)
+        return stack, buf
 
     # -- per-chip lane plumbing --------------------------------------------
 
@@ -469,6 +755,12 @@ class EcDispatchScheduler:
     # -- flushing ----------------------------------------------------------
 
     def _run(self) -> None:
+        # NUMA-affine flush path (ISSUE 12): the flusher packs arenas
+        # and feeds the device driver — pin it to one node's CPUs so
+        # every pack/commit pass stays on local memory. No-op unless
+        # SWFS_EC_DISPATCH_PIN=1 (utils/numa.py; fails soft on hosts
+        # without /sys topology or sched_setaffinity).
+        numa.pin_thread()
         idle_since: float | None = None
         while True:
             with self._cv:
@@ -567,11 +859,18 @@ class EcDispatchScheduler:
     def _dispatch_encode(self, slabs: list[_Slab], device=None) -> None:
         fn_on = (getattr(self.coder, "encode_parity_stacked_on", None)
                  if device is not None else None)
+        fn_wide_on = (getattr(self.coder, "encode_parity_on", None)
+                      if device is not None else None)
         t0 = time.perf_counter()
         if len(slabs) == 1:
+            # lone slab: NO stack copy on ANY lane (ISSUE 12 satellite —
+            # PR 5 gave chip lanes the [None] view; non-chip lanes now
+            # share the same direct 2-D dispatch, and chip lanes with
+            # the wide entry skip even the [None] wrapper)
             s = slabs[0]
-            if fn_on is not None:
-                # lone slab on a chip lane: [None] view, no zero-pad copy
+            if fn_wide_on is not None:
+                out0 = fn_wide_on(s.data, device)
+            elif fn_on is not None:
                 out0 = fn_on(s.data[None], device)[0]
             else:
                 out0 = self.coder.encode_parity(s.data)
@@ -585,23 +884,50 @@ class EcDispatchScheduler:
                 self._stamp_wall([s], t_s)
                 s.fut._set(out0)
             return
-        k = slabs[0].data.shape[0]
-        bmax = max(s.width for s in slabs)
-        stack = np.zeros((len(slabs), k, bmax), dtype=np.uint8)
-        for i, s in enumerate(slabs):
-            stack[i, :, : s.width] = s.data
-        if fn_on is not None:
-            # device-affine sub-dispatch: this chip lane's slabs ride one
-            # stacked launch pinned to the lane's chip
-            out = fn_on(stack, device)
-        else:
-            out = self.coder.encode_parity_stacked(stack)
+        if getattr(self.coder, "prefers_vstack", False) and device is None:
+            # mesh coder, non-chip lane: keep the [V, k, B] form so the
+            # backend can shard WHOLE slabs across chips (ISSUE 5) —
+            # packed into a recycled arena buffer, ragged tails only
+            stack, buf = self._pack_vstack(slabs)
+            try:
+                out = self.coder.encode_parity_stacked(stack)
+            except BaseException:
+                self._arena_drop(buf)
+                raise
+            self._stamp_wall(slabs, t0)
+            # ragged tails ride zero-padded columns; zero columns encode
+            # to zero parity and are sliced away, so per-slab bytes are
+            # identical to a lone dispatch (tests/test_ec_dispatch.py)
+            for i, s in enumerate(slabs):
+                s.fut._set(out[i][:, : s.width])
+            self._arena_release(buf, out)
+            return
+        # wide (column-compact) packing: the V slabs lie side by side in
+        # ONE [k, sum(widths)] arena view — no [V, k, B] allocation, no
+        # zero-fill, and no transpose/reshape copy inside the backend
+        # (parity is a per-byte-column GF matmul, so the wide form IS
+        # what every stacked kernel reduces to internally)
+        wide, buf = self._pack_wide(slabs)
+        try:
+            if fn_wide_on is not None:
+                # device-affine sub-dispatch: this chip lane's slabs
+                # ride one wide launch pinned to the lane's chip
+                out = fn_wide_on(wide, device)
+            elif fn_on is not None:
+                # older device-affine coder without the wide entry: the
+                # [None] stacked view (V=1), still no extra copy
+                out = fn_on(wide[None], device)[0]
+            else:
+                out = self.coder.encode_parity(wide)
+        except BaseException:
+            self._arena_drop(buf)
+            raise
         self._stamp_wall(slabs, t0)
-        # ragged tails ride zero-padded columns; zero columns encode to
-        # zero parity and are sliced away, so per-slab bytes are identical
-        # to a lone dispatch (pinned by tests/test_ec_dispatch.py)
-        for i, s in enumerate(slabs):
-            s.fut._set(out[i][:, : s.width])
+        off = 0
+        for s in slabs:
+            s.fut._set(out[:, off: off + s.width])
+            off += s.width
+        self._arena_release(buf, out)
 
     def _dispatch_reconstruct(self, key: tuple, slabs: list[_Slab],
                               device=None) -> None:
@@ -625,14 +951,21 @@ class EcDispatchScheduler:
             # every chip (small serving micro-batches keep the
             # survivor-set chip placement below). `want` (the rebuild's
             # minimal-read form) rides through — it must not demote the
-            # rebuild workload to a single chip.
-            vstack = np.stack([s.data for s in slabs])
-            missing, rows = fn_v(present_ids, vstack, data_only=data_only,
-                                 **({} if want is None
-                                    else {"want": want}))
+            # rebuild workload to a single chip. Uniform widths mean the
+            # arena pack memsets NOTHING (every byte is payload).
+            vstack, buf = self._pack_vstack(slabs)
+            try:
+                missing, rows = fn_v(present_ids, vstack,
+                                     data_only=data_only,
+                                     **({} if want is None
+                                        else {"want": want}))
+            except BaseException:
+                self._arena_drop(buf)
+                raise
             self._stamp_wall(slabs, t0)
             for i, s in enumerate(slabs):
                 s.fut._set((missing, rows[i]))
+            self._arena_release(buf, rows)
             return
         fn_on = (getattr(self.coder, "reconstruct_stacked_on", None)
                  if device is not None else None)
@@ -652,19 +985,34 @@ class EcDispatchScheduler:
             self._stamp_wall(slabs, t0)
             slabs[0].fut._set(out0)
             return
-        cat = np.concatenate([s.data for s in slabs], axis=1)
-        missing, rows = recon(cat)
+        # column-concatenation into a recycled arena view (the old
+        # np.concatenate allocated a fresh buffer per flush)
+        wide, buf = self._pack_wide(slabs)
+        try:
+            missing, rows = recon(wide)
+        except BaseException:
+            self._arena_drop(buf)
+            raise
         self._stamp_wall(slabs, t0)
         off = 0
         for s in slabs:
             s.fut._set((missing, rows[:, off: off + s.width]))
             off += s.width
+        self._arena_release(buf, rows)
 
     # -- lifecycle / introspection ----------------------------------------
 
     def pending(self) -> int:
         with self._cv:
             return sum(len(l) for l in self._lanes.values())
+
+    def arena_stats(self) -> dict:
+        """Live arena snapshot for /status (zeros when the plane is off
+        or this scheduler has never flushed a multi-slab batch)."""
+        arena = self._arena
+        return arena.stats() if arena is not None else {
+            "pooled": 0, "pooledBytes": 0, "inUseBytes": 0,
+            "quarantined": 0}
 
     def chip_depths(self) -> dict[str, int]:
         """Queued slabs per chip lane ("-" = single-chip lanes) — the
@@ -700,6 +1048,9 @@ class EcDispatchScheduler:
         if t is not None and t is not threading.current_thread() \
                 and t.is_alive():
             t.join(timeout=5)
+        arena = self._arena
+        if arena is not None:
+            arena.close()
 
 
 # -- reconstructed-interval cache (degraded-read serving side) --------------

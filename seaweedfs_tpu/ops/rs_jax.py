@@ -25,6 +25,7 @@ from __future__ import annotations
 
 import collections
 import functools
+import os
 import threading
 
 import jax
@@ -320,6 +321,45 @@ def _apply_matrix_jit(matrix_bits: jax.Array, data: jax.Array) -> jax.Array:
     return gf_matmul_bits(matrix_bits, data)
 
 
+@functools.partial(jax.jit, donate_argnums=(1,))
+def _apply_matrix_jit_donated(matrix_bits: jax.Array,
+                              data: jax.Array) -> jax.Array:
+    """`_apply_matrix_jit` with the data buffer DONATED — see
+    rs_xor._matmul_xor_jit_donated for the contract. Used only on the
+    dispatch scheduler's committed-input (device-pinned) path."""
+    return gf_matmul_bits(matrix_bits, data)
+
+
+_donation_quiet = False
+
+
+def _donate_wanted() -> bool:
+    """Donation of committed flush inputs (ISSUE 12), gated
+    SWFS_EC_DISPATCH_DONATE (default on) and restricted to accelerator
+    backends: the CPU client zero-copies page-aligned host buffers into
+    device arrays, so a donated CPU "buffer" could be the dispatch
+    scheduler's arena memory itself — never hand XLA a buffer the arena
+    may recycle. XLA treats a donated input whose size matches no output
+    as a deallocate-eagerly hint (parity rows != data rows here), which
+    is exactly the point: retire the transfer buffer at execution."""
+    global _donation_quiet
+    if os.environ.get("SWFS_EC_DISPATCH_DONATE", "1").lower() in (
+            "0", "false", "off"):
+        return False
+    if jax.default_backend() == "cpu":
+        return False
+    if not _donation_quiet:
+        import warnings
+
+        # expected by design: no output aliases the donated input's
+        # size, so XLA notes it cannot reuse the buffer for outputs —
+        # the eager deallocation still happens, the warning is noise
+        warnings.filterwarnings(
+            "ignore", message="Some donated buffers were not usable")
+        _donation_quiet = True
+    return True
+
+
 # Device kernel selection. Six formulations, all bit-identical:
 #   xor-pallas : packed-word mask*coef XOR scheme, hand-tiled (rs_xor)
 #   xor-xla    : same math, XLA-fused (any backend, any size)
@@ -378,12 +418,16 @@ def _dispatch_matmul(matrix: np.ndarray, data: jax.Array, out_rows: int,
         key = ("raw", matrix.shape, matrix.tobytes())
     b = data.shape[1]
     kind = _kernel_choice(b)
+    donate = False
     if device is not None:
         # pinned dispatches stay on the XLA formulations: placement is
         # driven by committed inputs, which the hand-tiled pallas paths
         # don't plumb — and bytes are identical across all formulations
         kind = kind.replace("-pallas", "-xla")
         data = jax.device_put(data, device)
+        # the committed copy is ours alone — donate it so XLA retires
+        # the transfer buffer at execution (device residency, ISSUE 12)
+        donate = _donate_wanted()
     if kind.startswith("sel-") and key[0] in ("fdec", "fdecs", "gdecs"):
         # sel kernels specialize on the static matrix; fused reconstruct
         # matrices (one per survivor+missing set, up to C(n,k) of them)
@@ -408,12 +452,13 @@ def _dispatch_matmul(matrix: np.ndarray, data: jax.Array, out_rows: int,
         )
         return apply_matrix_xor_pallas(matrix, data, coeffs=coeffs)
     if kind == "xor-xla":
-        from .rs_xor import _matmul_xor_jit
+        from .rs_xor import _matmul_xor_jit, _matmul_xor_jit_donated
 
         coeffs_np = _derived("xor", key, matrix)
         coeffs = (_op_on_device(("xor", *key), coeffs_np, device)
                   if device is not None else jnp.asarray(coeffs_np))
-        return _matmul_xor_jit(coeffs, _pad_bytes(data, b))[:, :b]
+        fn = _matmul_xor_jit_donated if donate else _matmul_xor_jit
+        return fn(coeffs, _pad_bytes(data, b))[:, :b]
     bits_np = _derived("bits", key, matrix)
     matrix_bits = (_op_on_device(("bits", *key), bits_np, device)
                    if device is not None else jnp.asarray(bits_np))
@@ -424,7 +469,8 @@ def _dispatch_matmul(matrix: np.ndarray, data: jax.Array, out_rows: int,
         if padded != b:
             data = jnp.pad(data, ((0, 0), (0, padded - b)))
         return gf_matmul_bits_pallas(matrix_bits, data, out_rows)[:, :b]
-    return _apply_matrix_jit(matrix_bits, _pad_bytes(data, b))[:, :b]
+    fn = _apply_matrix_jit_donated if donate else _apply_matrix_jit
+    return fn(matrix_bits, _pad_bytes(data, b))[:, :b]
 
 
 class RSCodecJax:

@@ -66,7 +66,14 @@ def _ptr(a: np.ndarray):
 
 
 def gf_matmul_native(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
-    """out[m, B] = matrix[m, k] (*) data[k, B] over GF(256), in C++."""
+    """out[m, B] = matrix[m, k] (*) data[k, B] over GF(256), in C++.
+
+    ISSUE 12 (host memory plane): `data` is passed to the kernel BY
+    POINTER — when the EC dispatch scheduler packs a flush into its
+    recycled page-aligned arena view, that view is contiguous and the
+    `ascontiguousarray` below is a no-op, so the arena buffer IS the
+    native plane's reusable ctypes staging buffer (the old path staged
+    a fresh stack copy per call)."""
     lib = load_library()
     matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
     data = np.ascontiguousarray(data, dtype=np.uint8)

@@ -90,6 +90,18 @@ def _matmul_xor_jit(coeffs: jax.Array, data: jax.Array) -> jax.Array:
     return gf_matmul_xor(coeffs, data)
 
 
+@functools.partial(jax.jit, donate_argnums=(1,))
+def _matmul_xor_jit_donated(coeffs: jax.Array, data: jax.Array) -> jax.Array:
+    """`_matmul_xor_jit` with the data buffer DONATED (ISSUE 12): the EC
+    dispatch scheduler commits a flush's payload to its chip and hands
+    the committed buffer over for good, letting XLA retire it at
+    execution instead of holding it until python GC — steady-state
+    device scratch per flush is the payload bytes, nothing else. Only
+    the scheduler's committed-input path calls this; direct users keep
+    the non-donating form (their arrays stay valid)."""
+    return gf_matmul_xor(coeffs, data)
+
+
 # ---------------------------------------------------------------------------
 # Pallas kernel: same math, explicitly tiled so the whole chain stays in VMEM.
 # Rank-3 blocks [rows, 8, LANE] keep every slice a whole (8, 128k) vreg set.

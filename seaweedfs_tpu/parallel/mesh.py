@@ -306,6 +306,14 @@ class ShardedCoder:
         scheduler round-robins encode slabs (and pins survivor sets) to."""
         return list(self.mesh.devices.flat)
 
+    @property
+    def prefers_vstack(self) -> bool:
+        """Tells the dispatch scheduler (ISSUE 12) to keep [V, k, B]
+        stacks for this coder's non-chip lanes: a multi-device mesh
+        shards WHOLE slabs across chips (V-axis, ISSUE 5), which the
+        column-compact wide packing would flatten away."""
+        return self._n > 1
+
     def _chip_codec(self):
         # lazily-built single-device codec reused for every chip: jit
         # caches per (shape, device), so chips don't trample each other
@@ -324,6 +332,15 @@ class ShardedCoder:
         independent of where they're computed)."""
         return self._chip_codec().encode_parity_stacked(stack,
                                                         device=device)
+
+    def encode_parity_on(self, data, device) -> jax.Array:
+        """Wide/2-D [k, W] encode pinned to `device` — the arena-packed
+        chip-lane form (ISSUE 12): the scheduler lays a whole flush's
+        slabs side by side along the column axis and this dispatches
+        them as ONE launch with no stacked [V, k, B] copy at all. The
+        committed input buffer is donated to XLA (rs_jax donation
+        plumbing), so per-flush device scratch is the payload bytes."""
+        return self._chip_codec().encode_parity(data, device=device)
 
     def reconstruct_stacked_on(self, present_ids, stacked,
                                data_only: bool = False, device=None,

@@ -32,7 +32,9 @@ Pacing: a token bucket (`SWFS_SCRUB_MAX_MBPS`, 0 = unpaced) bounds bytes
 read per second, and the sweep backs off whenever the server's
 foreground QPS exceeds `SWFS_SCRUB_FG_QPS`. The daemon period is
 `SWFS_SCRUB_INTERVAL_S` (0 disables the thread; `run_once` still serves
-the on-demand RPC / shell paths).
+the on-demand RPC / shell paths). After each paced window the swept
+byte range is dropped from the page cache (`SWFS_SCRUB_FADVISE`,
+default on) so a cold sweep never evicts the hot working set.
 """
 
 from __future__ import annotations
@@ -71,6 +73,28 @@ def _env_float(name: str, default: float) -> float:
         return float(os.environ.get(name, str(default)))
     except ValueError:
         return default
+
+
+def fadvise_enabled() -> bool:
+    """SWFS_SCRUB_FADVISE (default ON, ISSUE 12 satellite): after each
+    paced sweep window the scrubber POSIX_FADV_DONTNEEDs the byte range
+    it just read. The sweep touches every cold byte of every volume
+    exactly once — without the hint that single pass evicts the serving
+    working set from the page cache (python AND native-plane reads: the
+    hint acts on the inode, not the descriptor)."""
+    return os.environ.get("SWFS_SCRUB_FADVISE", "1").lower() not in (
+        "0", "false", "off")
+
+
+def _drop_swept_range(backing, offset: int, length: int) -> None:
+    """Best-effort page-cache drop of [offset, offset+length) on a
+    volume/shard backing file; only the RANGE the window read is dropped
+    so hot pages outside it keep serving reads."""
+    if not fadvise_enabled() or backing is None or length <= 0:
+        return
+    fn = getattr(backing, "drop_page_cache", None)
+    if fn is not None:
+        fn(offset, length)
 
 
 def fetch_verified_needle(stub, vid: int, needle_id: int,
@@ -549,6 +573,12 @@ class Scrubber:
         since_persist = 0
         verified_this_pass = 0
         completed = True
+        # page-cache drop window (ISSUE 12): swept_end advances ONLY as
+        # entries are actually read this pass — cur.offset alone can
+        # hold a PREVIOUS pass's cursor when the loop exits early (stop,
+        # empty entry list, wrapped full pass), and dropping [0, stale
+        # cursor) would evict hot bytes this pass never touched
+        window_start = swept_end = start
         for off, size, key in entries:
             if off < start or off >= dat_size:
                 continue  # behind the cursor, or appended mid-sweep
@@ -596,10 +626,18 @@ class Scrubber:
                     if self._repair_needle(v, key, f):
                         report.repaired += 1
             cur.offset = off + length
+            swept_end = cur.offset
             since_persist += length
             if since_persist >= persist_every:
                 cur.save()
                 since_persist = 0
+                # paced window complete: evict exactly the cold bytes
+                # this window read, before they push hot pages out
+                _drop_swept_range(v._dat, window_start,
+                                  swept_end - window_start)
+                window_start = swept_end
+        _drop_swept_range(v._dat, window_start,
+                          swept_end - window_start)
         if completed:
             # cursor at the snapshot extent: the next pass wraps to the
             # beginning (and appends landing mid-publication are not
@@ -683,6 +721,7 @@ class Scrubber:
         running: dict[int, int] = ({i: 0 for i in sorted(present)}
                                    if start == 0 else {})
         clean = True
+        win_start = start  # page-cache drop window (ISSUE 12)
         while off < shard_size:
             if self._stop.is_set():
                 return
@@ -729,6 +768,14 @@ class Scrubber:
                     break  # one finding per slab is enough
             off += n
             cur.ec_offset = off
+            if off - win_start >= 8 << 20:
+                # paced window complete: evict the swept range on every
+                # shard file before it displaces the hot working set
+                for sf in ev.shard_files.values():
+                    _drop_swept_range(sf, win_start, off - win_start)
+                win_start = off
+        for sf in ev.shard_files.values():
+            _drop_swept_range(sf, win_start, off - win_start)
         cur.ec_offset = off if off < shard_size else shard_size
         if off >= shard_size and clean:
             cur.sweeps += 1

@@ -49,7 +49,7 @@ from ..storage.file_id import parse_file_id
 from ..storage.needle import CrcError, Needle
 from ..storage.store import Store
 from ..storage.ttl import TTL
-from ..utils import failpoint, glog, trace
+from ..utils import failpoint, glog, numa, trace
 from ..utils.http import not_modified, parse_range, range_applies, url_for
 from ..utils.stats import (
     VOLUME_SERVER_EC_ENCODE_BYTES,
@@ -193,6 +193,16 @@ class VolumeServer:
         if sched is None or sched.closed:
             return {}
         return sched.chip_depths()
+
+    def ec_dispatch_arena(self) -> dict:
+        """Live stack-arena snapshot of this store's scheduler (ISSUE
+        12): pooled/in-use/quarantined buffers — the host memory plane's
+        working-set view, complementing the cumulative counters in
+        ec_dispatch_stats()."""
+        sched = getattr(self.store.coder, "_ec_dispatch_sched", None)
+        if sched is None or sched.closed:
+            return {}
+        return sched.arena_stats()
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -2160,12 +2170,16 @@ def _make_http_handler(srv: VolumeServer):
                     # (ISSUE 2 group commit); the native plane writes
                     # through unbuffered pwrite and does not batch
                     "GroupCommit": group_commit_stats(),
-                    # EC dispatch plane (ISSUE 3/5): stacked-dispatch
+                    # EC dispatch plane (ISSUE 3/5/12): stacked-dispatch
                     # batch factors, reconstructed-interval cache ratios,
-                    # per-chip dispatch spread + live per-chip queue depth
+                    # per-chip dispatch spread + live per-chip queue
+                    # depth, host-memory-plane arena health and the
+                    # NUMA pinning state of its flush threads
                     "EcDispatch": {
                         **ec_dispatch_stats(),
                         "chipDepth": srv.ec_dispatch_depths(),
+                        "arenaLive": srv.ec_dispatch_arena(),
+                        "pinning": numa.pinning_stats(),
                     },
                     # streaming replica->EC conversion (ISSUE 6):
                     # live/resume byte flow, in-flight depth, overlap

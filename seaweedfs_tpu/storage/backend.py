@@ -43,6 +43,12 @@ class BackendStorageFile:
     def flush(self) -> None:
         pass
 
+    def drop_page_cache(self, offset: int = 0, length: int = 0) -> None:
+        """Hint the kernel to evict this file's cached pages (ISSUE 12
+        scrub satellite): a cold CRC sweep reads every byte exactly once
+        and must not evict the serving working set. `length` 0 = to EOF.
+        Default no-op — remote/tier backends have no local pages."""
+
     def close(self) -> None:
         pass
 
@@ -96,6 +102,22 @@ class DiskFile(BackendStorageFile):
     def flush(self):
         self._f.flush()
 
+    def drop_page_cache(self, offset=0, length=0):
+        # DONTNEED acts on the inode's page cache, so this also drops
+        # pages faulted in through OTHER descriptors on the same file —
+        # including the native (C++) data plane's own fd
+        fadvise = getattr(os, "posix_fadvise", None)
+        if fadvise is None:
+            return  # non-POSIX host: graceful no-op
+        try:
+            fadvise(self._f.fileno(), offset, length,
+                    os.POSIX_FADV_DONTNEED)
+        except (OSError, ValueError):
+            # best-effort hint, never an error — ValueError covers
+            # fileno() on a file another thread already closed
+            # (vacuum/compaction swap, server shutdown)
+            pass
+
     def close(self):
         self._f.close()
 
@@ -126,6 +148,19 @@ class MmapFile(BackendStorageFile):
 
     def size(self):
         return self._size
+
+    def fileno(self) -> int:
+        return self._f.fileno()
+
+    def drop_page_cache(self, offset=0, length=0):
+        fadvise = getattr(os, "posix_fadvise", None)
+        if fadvise is None:
+            return
+        try:
+            fadvise(self._f.fileno(), offset, length,
+                    os.POSIX_FADV_DONTNEED)
+        except (OSError, ValueError):
+            pass  # see DiskFile.drop_page_cache
 
     def close(self):
         if self._mm is not None:

@@ -27,6 +27,7 @@ from dataclasses import dataclass, field
 import numpy as np
 
 from ..ops import dispatch
+from ..utils import numa
 from . import idx as idx_mod
 from . import needle_map, types
 from .ec_locate import Geometry
@@ -94,10 +95,12 @@ class _ShardWriters:
     disk."""
 
     def __init__(self, files: dict[int, object], stats: EncodeStats,
-                 depth: int, n_threads: int | None = None):
+                 depth: int, n_threads: int | None = None,
+                 numa_node: int | None = None):
         self._files = files
         self._stats = stats
         self._stats_lock = threading.Lock()
+        self._numa_node = numa_node
         n = n_threads or _writer_thread_count(len(files))
         self._lanes: list[queue.Queue] = [
             queue.Queue(maxsize=max(2, depth) * max(1, len(files) // n))
@@ -117,6 +120,12 @@ class _ShardWriters:
             t.start()
 
     def _run(self, q: queue.Queue) -> None:
+        # NUMA-affine writers (ISSUE 12, SWFS_EC_DISPATCH_PIN): every
+        # writer pins to the SAME node as its pipeline's reader (the
+        # shared numa_node draw) — the rows a writer drains were packed
+        # by that reader, so splitting the pair across nodes would turn
+        # each drain into remote traffic; no-op when the gate is closed
+        numa.pin_thread(self._numa_node)
         while True:
             item = q.get()
             if item is None:
@@ -294,8 +303,13 @@ def generate_ec_files(
     # shard bytes stay identical (zero-padded ragged columns slice away).
     sched = dispatch.maybe_scheduler(coder)
     encode = coder.encode_parity if sched is None else sched.encode_parity
+    # one node per PIPELINE (ISSUE 12): reader and writers share it, so
+    # the recycled read buffers stay node-local end to end; separate
+    # concurrent pipelines round-robin across nodes via their own draws
+    pipe_node = numa.next_node()
 
     def reader() -> None:
+        numa.pin_thread(pipe_node)  # reads + encode launches node-local
         try:
             with open(dat_path, "rb") as f:
                 processed = 0
@@ -328,7 +342,8 @@ def generate_ec_files(
         except BaseException as e:  # surface in the coordinator/caller
             work_q.put(e)
 
-    writers = _ShardWriters(dict(enumerate(outs)), stats, depth)
+    writers = _ShardWriters(dict(enumerate(outs)), stats, depth,
+                            numa_node=pipe_node)
     t = threading.Thread(target=reader, name="ec-encode-reader", daemon=True)
     t.start()
     ok = False
@@ -531,7 +546,10 @@ def rebuild_ec_files(
     # futures resolve in the coordinator, not the reader)
     sched = dispatch.maybe_scheduler(coder) if use_stacked else None
 
+    pipe_node = numa.next_node()  # shared by reader + writers (ISSUE 12)
+
     def reader() -> None:
+        numa.pin_thread(pipe_node)  # survivor reads stay node-local
         try:
             offset = 0
             while not stop.is_set():
@@ -570,7 +588,8 @@ def rebuild_ec_files(
         except BaseException as e:
             work_q.put(e)
 
-    writers = _ShardWriters(outs, EncodeStats(), DEFAULT_PIPELINE_DEPTH)
+    writers = _ShardWriters(outs, EncodeStats(), DEFAULT_PIPELINE_DEPTH,
+                            numa_node=pipe_node)
     t = threading.Thread(target=reader, name="ec-rebuild-reader", daemon=True)
     t.start()
     ok = False

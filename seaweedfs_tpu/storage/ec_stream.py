@@ -234,7 +234,13 @@ class EcStreamDestination:
 
     def _run_live(self) -> None:
         from ..pb import rpc
+        from ..utils import numa
 
+        # feeder thread of the streamed-encode plane: NUMA-pin alongside
+        # the encode pipeline's reader/writers (ISSUE 12, gated
+        # SWFS_EC_DISPATCH_PIN) so wire-chunk assembly reads slab bytes
+        # from local memory; no-op when the gate is closed
+        numa.pin_thread()
         t0 = time.perf_counter()
         try:
             stub = rpc.volume_stub(rpc.grpc_address(self.address))

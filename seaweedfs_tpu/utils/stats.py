@@ -333,6 +333,35 @@ EC_RECON_CACHE_COUNTER = Counter(
     "(hit/miss/put/invalidate/evict).")
 
 
+# -- host memory plane (ISSUE 12): the stack arena that recycles the
+#    scheduler's flush buffers instead of allocating + zero-filling a
+#    fresh [V, k, B] stack per batch, plus its quarantine (buffers held
+#    until an async device dispatch has provably consumed the bytes) ----
+
+EC_DISPATCH_ARENA_OPS = Counter(
+    "SeaweedFS_ec_dispatch_arena_ops",
+    "Stack-arena buffer events by result: hit (flush packed into a "
+    "recycled buffer), miss (fresh allocation), resize (request "
+    "outgrew every pooled buffer), recycle (buffer returned to the "
+    "pool), drop (buffer abandoned — pool full or still quarantined at "
+    "close). hits/(hits+misses) is the recycling rate the host memory "
+    "plane exists to maximize.")
+EC_DISPATCH_ARENA_INUSE = Gauge(
+    "SeaweedFS_ec_dispatch_arena_inuse_bytes",
+    "Arena bytes currently checked out to in-flight flushes (including "
+    "quarantined buffers an async dispatch may still be reading).")
+EC_DISPATCH_ARENA_POOLED = Gauge(
+    "SeaweedFS_ec_dispatch_arena_pooled_bytes",
+    "Arena bytes sitting in the free pool, ready to absorb the next "
+    "flush without an allocation.")
+EC_DISPATCH_ZEROFILL_ELIDED = Counter(
+    "SeaweedFS_ec_dispatch_zerofill_elided_bytes",
+    "Stack bytes whose zero-fill was elided because every byte of the "
+    "packed region is overwritten by slab payload (uniform widths / "
+    "column-compact wide packing); ragged tails still memset and are "
+    "NOT counted here.")
+
+
 # -- streaming replica->EC conversion (ISSUE 6): the pipelined archival
 #    encode that pushes shard slabs to their destinations while the GF
 #    matmul is still running (storage/ec_stream.py), plus like-for-like
@@ -679,6 +708,23 @@ def ec_dispatch_stats() -> dict:
             EC_RECON_CACHE_COUNTER.value(result="invalidate")),
         "evictions": int(EC_RECON_CACHE_COUNTER.value(result="evict")),
         "hitRate": round(hits / total, 4) if total else 0.0,
+    }
+    # host memory plane (ISSUE 12): arena recycling health — a steady
+    # workload should converge on hitRate ~1.0 with inuse bouncing
+    # between 0 and a few lane-cap buffers
+    a_hits = EC_DISPATCH_ARENA_OPS.value(result="hit")
+    a_miss = EC_DISPATCH_ARENA_OPS.value(result="miss")
+    a_total = a_hits + a_miss
+    out["arena"] = {
+        "hits": int(a_hits),
+        "misses": int(a_miss),
+        "resizes": int(EC_DISPATCH_ARENA_OPS.value(result="resize")),
+        "recycles": int(EC_DISPATCH_ARENA_OPS.value(result="recycle")),
+        "drops": int(EC_DISPATCH_ARENA_OPS.value(result="drop")),
+        "hitRate": round(a_hits / a_total, 4) if a_total else 0.0,
+        "inUseBytes": int(EC_DISPATCH_ARENA_INUSE.value()),
+        "pooledBytes": int(EC_DISPATCH_ARENA_POOLED.value()),
+        "zeroFillElidedBytes": int(EC_DISPATCH_ZEROFILL_ELIDED.value()),
     }
     return out
 

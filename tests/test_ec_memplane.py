@@ -1,0 +1,427 @@
+"""Host memory plane suite (ISSUE 12): stack-arena recycling
+correctness under concurrent pipelines, zero-fill elision, quarantined
+release on async (jax) backends, O(1) steady-state dispatch-path
+allocations, NUMA pinning plumbing, and the scrub fadvise satellite.
+
+The load-bearing property is the same as ISSUE 3's: the arena may change
+WHERE a flush's bytes are staged, never what they compute — shard bytes
+are pinned identical arena-on / arena-off / all backends, including
+while buffers are being recycled under concurrent encode + reconstruct
+pipelines.
+"""
+
+import os
+import threading
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.models.coder import new_coder
+from seaweedfs_tpu.ops import dispatch
+from seaweedfs_tpu.ops.rs_cpu import RSCodecCPU
+from seaweedfs_tpu.storage import ec_files
+from seaweedfs_tpu.storage.ec_locate import Geometry
+from seaweedfs_tpu.utils import numa, stats
+
+TEST_GEO = Geometry(large_block=10000, small_block=100)
+
+
+@pytest.fixture(autouse=True)
+def _clean_schedulers():
+    yield
+    dispatch.shutdown_all()
+
+
+def _arena_count(result: str) -> int:
+    return int(stats.EC_DISPATCH_ARENA_OPS.value(result=result))
+
+
+def _make_volume(base, seed=0, n_needles=40):
+    import sys
+
+    sys.path.insert(0, os.path.dirname(__file__))
+    from test_ec_pipeline import _make_synthetic_volume
+
+    _make_synthetic_volume(base, seed=seed, n_needles=n_needles)
+
+
+# -- arena unit behavior -----------------------------------------------------
+
+
+def test_arena_pool_recycles_and_bounds():
+    arena = dispatch.StackArena(max_bufs=2, max_bytes=1 << 20)
+    b1 = arena.get(10_000)
+    assert b1.cap >= 10_000 and b1.cap % 4096 == 0
+    assert b1.flat.ctypes.data % 4096 == 0, "arena buffers are page-aligned"
+    arena.release(b1, np.zeros(1, np.uint8))  # numpy out = consumed
+    b2 = arena.get(9_000)
+    assert b2 is b1, "same-bucket request must reuse the pooled buffer"
+    arena.release(b2, None)
+    # pool bound: a third distinct buffer over max_bufs is dropped
+    b3, b4, b5 = arena.get(1 << 14), arena.get(1 << 14), arena.get(1 << 14)
+    for b in (b3, b4, b5):
+        arena.release(b, None)
+    assert arena.stats()["pooled"] <= 2
+    arena.close()
+    assert arena.stats()["pooled"] == 0
+
+
+def test_arena_quarantines_unready_outputs():
+    class FakeLazy:
+        """Mimics an in-flight jax array: is_ready flips when the
+        'device' finishes."""
+
+        def __init__(self):
+            self.ready = False
+
+        def is_ready(self):
+            return self.ready
+
+        def block_until_ready(self):
+            self.ready = True
+
+    arena = dispatch.StackArena(max_bufs=4, max_bytes=1 << 20)
+    buf = arena.get(4096)
+    lazy = FakeLazy()
+    arena.release(buf, lazy)
+    st = arena.stats()
+    assert st["quarantined"] == 1 and st["pooled"] == 0, \
+        "an unconsumed buffer must never re-enter the pool"
+    fresh = arena.get(4096)
+    assert fresh is not buf, "quarantined buffer handed out while in flight"
+    arena.release(fresh, None)
+    lazy.ready = True
+    again = arena.get(4096)  # sweep reclaims the quarantined buffer now
+    back = arena.get(4096)
+    assert buf in (again, back), "consumed quarantined buffer never recycled"
+    arena.close()
+
+
+def test_consumed_probe_contract():
+    assert dispatch._consumed(None)
+    assert dispatch._consumed(np.zeros(3, np.uint8))
+    import jax.numpy as jnp
+
+    arr = jnp.zeros(8, jnp.uint8)
+    arr.block_until_ready()
+    assert dispatch._consumed(arr)
+
+
+# -- golden safety: arena on/off, concurrent pipelines, all backends ---------
+
+
+@pytest.mark.parametrize("backend", ["cpu", "tpu"])
+def test_generate_ec_files_bit_identical_arena_on_off(tmp_path, monkeypatch,
+                                                      backend):
+    """The acceptance pin: .ec00-.ec13 bytes identical with the arena on
+    and off, per backend (and the on/off pair hashes equal across
+    backends by transitivity with the ISSUE-3 scheduler pins)."""
+    monkeypatch.setenv("SWFS_EC_DISPATCH", "1")
+    outs = {}
+    for mode in ("0", "1"):
+        monkeypatch.setenv("SWFS_EC_DISPATCH_ARENA", mode)
+        base = str(tmp_path / f"a{backend}{mode}")
+        _make_volume(base, seed=21)
+        coder = new_coder(10, 4, backend)
+        ec_files.generate_ec_files(base, coder, TEST_GEO, batch_size=50)
+        outs[mode] = [
+            open(TEST_GEO.shard_file_name(base, i), "rb").read()
+            for i in range(14)
+        ]
+        dispatch.shutdown_all()
+    for i in range(14):
+        assert outs["0"][i] == outs["1"][i], f"shard {i} differs"
+
+
+def test_concurrent_encode_reconstruct_recycled_arena_golden(monkeypatch):
+    """Concurrent encode + reconstruct pipelines over ONE scheduler's
+    recycled arena: every slab's bytes must match the direct per-slab
+    oracle, and the arena must have provably recycled (hit > 0)."""
+    monkeypatch.setenv("SWFS_EC_DISPATCH_ARENA", "1")
+    coder = RSCodecCPU(10, 4)
+    oracle = RSCodecCPU(10, 4)
+    sched = dispatch.EcDispatchScheduler(coder, window=0.005)
+    rng = np.random.default_rng(7)
+    shards_pool = []
+    for _ in range(4):
+        data = rng.integers(0, 256, (10, 777), dtype=np.uint8)
+        shards_pool.append(np.asarray(oracle.encode(
+            np.vstack([data, np.zeros((4, 777), np.uint8)]))))
+    pres = tuple(range(3, 14))  # 0..2 lost
+    errs = []
+    hits0 = _arena_count("hit")
+
+    def encoder(tid):
+        try:
+            r = np.random.default_rng(100 + tid)
+            for i in range(12):
+                slab = r.integers(0, 256, (10, 64 + 8 * (i % 5)),
+                                  dtype=np.uint8)
+                fut = sched.encode_parity(slab)
+                want = np.asarray(oracle.encode_parity(slab))
+                got = np.asarray(fut)
+                if not np.array_equal(got, want):
+                    raise AssertionError(f"encode bytes diverged (t{tid}/{i})")
+        except BaseException as e:
+            errs.append(e)
+
+    def reconstructor(tid):
+        try:
+            for i in range(12):
+                shards = shards_pool[(tid + i) % len(shards_pool)]
+                stk = np.stack([shards[p] for p in pres])
+                fut = sched.reconstruct_stacked(pres, stk)
+                missing, rows = fut.result(timeout=30)
+                for j, mid in enumerate(missing):
+                    if not np.array_equal(np.asarray(rows[j]), shards[mid]):
+                        raise AssertionError(
+                            f"reconstruct bytes diverged (t{tid}/{i})")
+        except BaseException as e:
+            errs.append(e)
+
+    ths = [threading.Thread(target=encoder, args=(t,)) for t in range(3)] \
+        + [threading.Thread(target=reconstructor, args=(t,))
+           for t in range(2)]
+    for t in ths:
+        t.start()
+    for t in ths:
+        t.join()
+    sched.close()
+    assert not errs, errs[0]
+    assert _arena_count("hit") > hits0, \
+        "arena never recycled a buffer under concurrent pipelines"
+
+
+def test_jax_backend_recycling_is_safe(monkeypatch):
+    """The aliasing trap the quarantine exists for: jax's CPU client
+    zero-copies page-aligned host buffers, so a recycled arena buffer
+    could be the backing store of an in-flight device array. Repeated
+    batches through the jax coder must stay bit-identical while buffers
+    recycle."""
+    monkeypatch.setenv("SWFS_EC_DISPATCH_ARENA", "1")
+    coder = new_coder(10, 4, "tpu")
+    oracle = RSCodecCPU(10, 4)
+    sched = dispatch.EcDispatchScheduler(coder, window=30.0)
+    rng = np.random.default_rng(9)
+    try:
+        for round_ in range(6):
+            slabs = [rng.integers(0, 256, (10, 512), dtype=np.uint8)
+                     for _ in range(6)]
+            futs = [sched.encode_parity(s) for s in slabs]
+            outs = [np.asarray(f) for f in futs]  # forces every result
+            for s, got in zip(slabs, outs):
+                assert np.array_equal(
+                    got, np.asarray(oracle.encode_parity(s))), \
+                    f"round {round_}: recycled arena corrupted a dispatch"
+    finally:
+        sched.close()
+
+
+# -- steady-state allocation guard -------------------------------------------
+
+
+def test_dispatch_hot_loop_allocations_steady_state(monkeypatch):
+    """tracemalloc guard: after warmup the dispatch hot loop's packing
+    allocates O(1) new blocks per batch with the arena on (misses stop;
+    peak excludes the [V*k*B] staging buffer) vs O(V) off (a fresh
+    V-proportional staging allocation every batch)."""
+    coder = RSCodecCPU(10, 4)
+    rng = np.random.default_rng(11)
+    v, b = 16, 2048
+    slabs = [rng.integers(0, 256, (10, b), dtype=np.uint8)
+             for _ in range(v)]
+
+    def run_batches(n, sched):
+        for _ in range(n):
+            futs = [sched.encode_parity(s) for s in slabs]
+            futs[-1].result(timeout=30)  # demand flush batches the lane
+            for f in futs:
+                f.result(timeout=30)
+
+    peaks = {}
+    for mode in ("1", "0"):
+        monkeypatch.setenv("SWFS_EC_DISPATCH_ARENA", mode)
+        sched = dispatch.EcDispatchScheduler(coder, window=30.0)
+        try:
+            run_batches(3, sched)  # warmup: arena sizes its buckets
+            miss0 = _arena_count("miss") + _arena_count("resize")
+            tracemalloc.start()
+            try:
+                run_batches(1, sched)  # settle tracemalloc itself
+                tracemalloc.reset_peak()
+                base = tracemalloc.get_traced_memory()[0]
+                run_batches(4, sched)
+                peaks[mode] = tracemalloc.get_traced_memory()[1] - base
+            finally:
+                tracemalloc.stop()
+            if mode == "1":
+                assert _arena_count("miss") + _arena_count("resize") \
+                    == miss0, "arena still allocating after warmup (not O(1))"
+        finally:
+            sched.close()
+    staging = v * 10 * b  # the [k, V*B] wide buffer the arena recycles
+    assert peaks["0"] - peaks["1"] > staging // 2, \
+        (f"arena did not remove the per-batch staging allocation: "
+         f"on={peaks['1']} off={peaks['0']} staging={staging}")
+
+
+# -- zero-fill elision --------------------------------------------------------
+
+
+def test_zero_fill_elided_and_ragged_tails_correct(monkeypatch):
+    """Wide packing memsets nothing (every byte is payload) and ragged
+    batches still produce exactly the per-slab oracle bytes."""
+    monkeypatch.setenv("SWFS_EC_DISPATCH_ARENA", "1")
+    coder = RSCodecCPU(10, 4)
+    sched = dispatch.EcDispatchScheduler(coder, window=30.0)
+    rng = np.random.default_rng(13)
+    widths = [512, 100, 37, 512, 9]
+    slabs = [rng.integers(0, 256, (10, w), dtype=np.uint8) for w in widths]
+    z0 = int(stats.EC_DISPATCH_ZEROFILL_ELIDED.value())
+    try:
+        futs = [sched.encode_parity(s) for s in slabs]
+        futs[-1].result(timeout=30)
+        for s, f in zip(slabs, futs):
+            assert np.array_equal(np.asarray(f),
+                                  np.asarray(coder.encode_parity(s)))
+    finally:
+        sched.close()
+    elided = int(stats.EC_DISPATCH_ZEROFILL_ELIDED.value()) - z0
+    assert elided >= 10 * sum(widths), \
+        "wide packing must elide the whole packed region's zero-fill"
+
+
+# -- NUMA pinning plane -------------------------------------------------------
+
+
+def test_numa_cpulist_parser():
+    assert numa._parse_cpulist("0-3,8,10-11\n") == [0, 1, 2, 3, 8, 10, 11]
+    assert numa._parse_cpulist("0\n") == [0]
+    assert numa._parse_cpulist("") == []
+
+
+def test_numa_topology_fallback_and_fake_sysfs(tmp_path):
+    # absent sysfs tree -> one pseudo-node spanning the process CPUs
+    nodes = numa.node_cpus(sys_root=str(tmp_path / "nope"))
+    assert len(nodes) == 1 and nodes[0], nodes
+    # fake two-node tree
+    for i, lst in enumerate(("0-1", "2-3")):
+        d = tmp_path / f"node{i}"
+        d.mkdir()
+        (d / "cpulist").write_text(lst + "\n")
+    nodes = numa.node_cpus(sys_root=str(tmp_path))
+    assert nodes == [[0, 1], [2, 3]]
+
+
+def test_numa_pin_gate_off_is_noop(monkeypatch):
+    monkeypatch.delenv("SWFS_EC_DISPATCH_PIN", raising=False)
+    numa._reset_for_tests()
+    assert numa.pin_thread() is None
+    assert numa.pinning_stats()["threadsPinned"] == 0
+
+
+def test_numa_pin_gate_on_pins_or_degrades(monkeypatch):
+    monkeypatch.setenv("SWFS_EC_DISPATCH_PIN", "1")
+    numa._reset_for_tests()
+    before = None
+    if hasattr(os, "sched_getaffinity"):
+        before = os.sched_getaffinity(0)
+    try:
+        got = numa.pin_thread(node_hint=0)
+        st = numa.pinning_stats()
+        if got is None:
+            assert st["noops"] >= 1  # degraded softly, never raised
+        else:
+            assert set(got) <= (before or set(got))
+            assert st["threadsPinned"] == 1
+    finally:
+        if before is not None:
+            os.sched_setaffinity(0, before)
+        numa._reset_for_tests()
+
+
+# -- scrub fadvise satellite --------------------------------------------------
+
+
+def test_drop_page_cache_calls_fadvise(tmp_path, monkeypatch):
+    if not hasattr(os, "posix_fadvise"):
+        pytest.skip("no posix_fadvise on this platform")
+    from seaweedfs_tpu.storage.backend import DiskFile, MmapFile
+
+    p = tmp_path / "f.dat"
+    p.write_bytes(b"x" * 8192)
+    calls = []
+    real = os.posix_fadvise
+
+    def spy(fd, off, ln, advice):
+        calls.append((off, ln, advice))
+        return real(fd, off, ln, advice)
+
+    monkeypatch.setattr(os, "posix_fadvise", spy)
+    df = DiskFile(str(p))
+    df.drop_page_cache(0, 4096)
+    df.close()
+    mf = MmapFile(str(p))
+    mf.drop_page_cache()
+    mf.close()
+    assert calls == [(0, 4096, os.POSIX_FADV_DONTNEED),
+                     (0, 0, os.POSIX_FADV_DONTNEED)]
+
+
+def test_scrub_sweep_fadvises_swept_range(tmp_path, monkeypatch):
+    """The paced CRC sweep must DONTNEED exactly the windows it read —
+    and must not when SWFS_SCRUB_FADVISE=0."""
+    if not hasattr(os, "posix_fadvise"):
+        pytest.skip("no posix_fadvise on this platform")
+    from seaweedfs_tpu.scrub import scrubber as scrub_mod
+
+    class Backing:
+        def __init__(self):
+            self.calls = []
+
+        def drop_page_cache(self, off, ln):
+            self.calls.append((off, ln))
+
+    b = Backing()
+    monkeypatch.setenv("SWFS_SCRUB_FADVISE", "1")
+    scrub_mod._drop_swept_range(b, 0, 1000)
+    scrub_mod._drop_swept_range(b, 1000, 0)  # empty window: skipped
+    monkeypatch.setenv("SWFS_SCRUB_FADVISE", "0")
+    scrub_mod._drop_swept_range(b, 2000, 1000)
+    assert b.calls == [(0, 1000)]
+
+
+def test_scrub_volume_sweep_emits_fadvise(tmp_path, monkeypatch):
+    """End to end: a real needle sweep over a real volume drops its
+    swept .dat range from the page cache (and keeps zero findings)."""
+    if not hasattr(os, "posix_fadvise"):
+        pytest.skip("no posix_fadvise on this platform")
+    from seaweedfs_tpu.scrub import Scrubber
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+
+    calls = []
+    real = os.posix_fadvise
+
+    def spy(fd, off, ln, advice):
+        calls.append((off, ln, advice))
+        return real(fd, off, ln, advice)
+
+    monkeypatch.setenv("SWFS_SCRUB_FADVISE", "1")
+    monkeypatch.setattr(os, "posix_fadvise", spy)
+    st = Store([str(tmp_path)], coder=RSCodecCPU(10, 4))
+    try:
+        v = st.add_volume(1)
+        rng = np.random.default_rng(5)
+        for i in range(1, 11):
+            v.write_needle(Needle.create(
+                i, 0xABC, rng.integers(0, 256, 500, np.uint8).tobytes()))
+        sc = Scrubber(st, None, interval_s=0, max_mbps=0)
+        report = sc.run_once(anti_entropy=False)
+        assert report.needles == 10
+        assert report.findings == []
+    finally:
+        st.close()
+    dontneed = [c for c in calls if c[2] == os.POSIX_FADV_DONTNEED]
+    assert dontneed, "sweep finished without dropping its swept range"

@@ -4,12 +4,17 @@ Each probe runs in a subprocess with a hard timeout (the wedged tunnel
 HANGS rather than erring). On the first healthy probe this runs
 tools/tune_kernels.py --quick and appends everything to TUNE_RESULT.txt.
 
-Usage: python tools/await_tpu.py [--minutes 9] [--bench]
+Usage: python tools/await_tpu.py [--minutes 9] [--bench] [--memplane]
 
 --bench runs `python bench.py` (single device attempt, generous budget)
 instead of the kernel tune on the first healthy probe, appending the
 JSON line to BENCH_WATCH.txt — the round-5 "capture a device number the
 moment the tunnel recovers" loop in one command.
+
+--memplane runs `python bench.py --memplane-ab` on the first healthy
+probe (ISSUE 12): the A/B itself is CPU-pinned, but the run's device
+capture arm then finds a live tunnel and writes
+BENCH_DEVICE_ISSUE12.json alongside BENCH_AB_ISSUE12.json.
 """
 
 from __future__ import annotations
@@ -73,13 +78,23 @@ def main() -> int:
     ap.add_argument("--minutes", type=float, default=9.0)
     ap.add_argument("--bench", action="store_true",
                     help="run bench.py instead of the kernel tune")
+    ap.add_argument("--memplane", action="store_true",
+                    help="run bench.py --memplane-ab (ISSUE 12 device "
+                         "capture) instead of the kernel tune")
     args = ap.parse_args()
     deadline = time.time() + args.minutes * 60
     while time.time() < deadline:
         if probe():
             stamp = time.strftime("%H:%M:%S")
-            action = "benching" if args.bench else "tuning"
+            action = ("memplane A/B" if args.memplane
+                      else "benching" if args.bench else "tuning")
             print(f"[{stamp}] tunnel healthy — {action}", flush=True)
+            if args.memplane:
+                return run_and_log(
+                    [sys.executable, os.path.join(REPO, "bench.py"),
+                     "--memplane-ab"],
+                    os.path.join(REPO, "BENCH_WATCH.txt"), 1800,
+                    "memplane-ab")
             if args.bench:
                 return run_and_log(
                     [sys.executable, os.path.join(REPO, "bench.py")],
