@@ -20,6 +20,7 @@ from concurrent import futures
 import grpc
 
 from . import (
+    ec_gather_pb2,
     ec_geometry_pb2,
     ec_stream_pb2,
     filer_pb2,
@@ -154,6 +155,12 @@ VOLUME_SERVICE = ("volume_server_pb.VolumeServer", [
     _m("VolumeEcShardsGenerateStreamed",
        ec_stream_pb2.VolumeEcShardsGenerateStreamedRequest,
        ec_stream_pb2.VolumeEcShardsGenerateStreamedResponse),
+    # cross-server syndrome-verify gather (ec_gather.proto; messages in
+    # pb/ec_gather_pb2.py): the VolumeEcShardsStream slab transport run
+    # in reverse — a scrubbing holder pulls chunked, CRC-verified,
+    # offset-addressed survivor ranges from their holders (ISSUE 13)
+    _m("VolumeEcShardsRead", ec_gather_pb2.VolumeEcShardsReadRequest,
+       ec_gather_pb2.VolumeEcShardsReadResponse, ss=True),
 ])
 
 FILER_SERVICE = ("filer_pb.SeaweedFiler", [

@@ -49,7 +49,13 @@ def _build() -> descriptor_pb2.FileDescriptorProto:
     msg("NeedleDigestEntry",
         (1, "needle_id", "uint64"),
         (2, "crc", "uint32"),
-        (3, "size", "int32"))  # negative = tombstone
+        (3, "size", "int32"),   # negative = tombstone
+        # replica-epoch causality tag (ISSUE 13; storage/epoch.py) —
+        # all-zero for pre-epoch records; excluded from divergence
+        # comparison, used to order same-timestamp conflicts
+        (4, "epoch_incarnation", "uint64"),
+        (5, "epoch_seq", "uint64"),
+        (6, "epoch_server", "uint32"))
     msg("ShardDigest",
         (1, "shard_id", "uint32"),
         (2, "crc", "uint32"),
@@ -83,7 +89,10 @@ def _build() -> descriptor_pb2.FileDescriptorProto:
         (2, "needles_checked", "uint64"),
         (3, "bytes_verified", "uint64"),
         (4, "findings", "ScrubFinding", "repeated"),
-        (5, "repaired", "uint64"))
+        (5, "repaired", "uint64"),
+        # anti-entropy peer pairs whose VolumeDigest probe failed even
+        # after retry — partial sweep coverage made visible (ISSUE 13)
+        (6, "skipped_pairs", "uint64"))
     msg("ScrubStatusRequest")
     # master-side fleet-scrub pause toggle (mirrors Disable/EnableVacuum)
     msg("DisableScrubRequest")

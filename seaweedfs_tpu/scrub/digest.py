@@ -15,15 +15,25 @@ stored CRCs still match the data bytes is the scrubber's CRC sweep's job
 (scrubber.py); the two passes together separate "replicas diverged"
 (digests differ) from "disk rotted" (sweep finding).
 
-Manifest file format (golden-pinned by tests/test_scrub.py):
+Manifest file format, rev 2 (golden-pinned by tests/test_scrub.py;
+rev-1 manifests still parse — read_manifest dispatches on the magic):
 
-    magic   8B  b"SWFSDG1\\n"
+    magic   8B  b"SWFSDG2\\n"
     count   8B  big-endian entry count
-    entries 16B each, ascending needle id:
+    entries 36B each, ascending needle id:
             id(8, BE) crc(4, BE) size(4, BE two's-complement)
+            epoch_incarnation(8, BE) epoch_seq(8, BE) epoch_server(4, BE)
 
-rolling_crc = crc32c over the concatenated entry bytes (magic and count
-excluded, so the digest of an empty volume is crc32c(b"") == 0).
+The epoch triple is the ISSUE-13 replica-epoch causality tag
+(storage/epoch.py), all-zero for pre-epoch records. It is metadata for
+CONFLICT RESOLUTION only: replicas stamp the same logical write with
+different tags, so both the rolling digest and the divergence diff
+exclude it (they fold/compare the 16-byte rev-1 projection) — otherwise
+every converged pair would look divergent forever.
+
+rolling_crc = crc32c over the concatenated rev-1 entry projections of
+the LIVE entries (magic and count excluded, so the digest of an empty
+volume is crc32c(b"") == 0).
 """
 
 from __future__ import annotations
@@ -33,9 +43,12 @@ from dataclasses import dataclass
 
 from ..storage import types
 from ..storage.crc import crc32c, crc32c_combine
+from ..storage.epoch import TAG_LEN, decode_tag_block
 
-MAGIC = b"SWFSDG1\n"
-ENTRY_SIZE = 16
+MAGIC_V1 = b"SWFSDG1\n"
+MAGIC = b"SWFSDG2\n"
+ENTRY_SIZE_V1 = 16
+ENTRY_SIZE = 36
 TOMBSTONE_SIZE = -1
 
 
@@ -44,24 +57,43 @@ class DigestEntry:
     needle_id: int
     crc: int
     size: int  # negative = tombstone
+    epoch: tuple[int, int, int] | None = None  # (incarnation, seq, server)
 
     def to_bytes(self) -> bytes:
+        """Rev-1 16-byte projection — the comparison/rolling-CRC form
+        (epoch excluded by design, see module docstring)."""
         return (self.needle_id.to_bytes(8, "big")
                 + (self.crc & 0xFFFFFFFF).to_bytes(4, "big")
                 + (self.size & 0xFFFFFFFF).to_bytes(4, "big"))
+
+    def to_bytes_v2(self) -> bytes:
+        inc, seq, srv = self.epoch or (0, 0, 0)
+        return (self.to_bytes()
+                + (inc & (1 << 64) - 1).to_bytes(8, "big")
+                + (seq & (1 << 64) - 1).to_bytes(8, "big")
+                + (srv & 0xFFFFFFFF).to_bytes(4, "big"))
 
     @classmethod
     def from_bytes(cls, b: bytes) -> "DigestEntry":
         size = int.from_bytes(b[12:16], "big")
         if size >= 1 << 31:
             size -= 1 << 32
+        epoch = None
+        if len(b) >= ENTRY_SIZE:
+            inc = int.from_bytes(b[16:24], "big")
+            seq = int.from_bytes(b[24:32], "big")
+            srv = int.from_bytes(b[32:36], "big")
+            if inc or seq or srv:
+                epoch = (inc, seq, srv)
         return cls(int.from_bytes(b[0:8], "big"),
-                   int.from_bytes(b[8:12], "big"), size)
+                   int.from_bytes(b[8:12], "big"), size, epoch)
 
 
 def volume_digest_entries(v) -> list[DigestEntry]:
     """Build the sorted entry list for a plain volume: live needles carry
-    the stored CRC read from disk; tombstoned ids carry (0, -1)."""
+    the stored CRC read from disk plus their replica-epoch tag (one
+    bounded pread recovers both — the tag is the fixed-width suffix of
+    the body, immediately before the CRC); tombstoned ids carry (0, -1)."""
     if v.native is not None:
         v.sync_native()  # absorb C++-plane appends first
     entries: list[DigestEntry] = []
@@ -69,11 +101,19 @@ def volume_digest_entries(v) -> list[DigestEntry]:
         if nv.offset == 0 or types.size_is_deleted(nv.size):
             continue
         off = types.stored_to_actual_offset(nv.offset)
-        crc_bytes = v._pread_durable(
-            off + types.NEEDLE_HEADER_SIZE + nv.size,
-            types.NEEDLE_CHECKSUM_SIZE)
+        tail_off = off + types.NEEDLE_HEADER_SIZE + nv.size
+        epoch = None
+        if nv.size >= TAG_LEN:
+            blob = v._pread_durable(tail_off - TAG_LEN,
+                                    TAG_LEN + types.NEEDLE_CHECKSUM_SIZE)
+            epoch = decode_tag_block(blob[:TAG_LEN]) \
+                if len(blob) >= TAG_LEN else None
+            crc_bytes = blob[TAG_LEN:TAG_LEN + 4]
+        else:
+            crc_bytes = v._pread_durable(tail_off,
+                                         types.NEEDLE_CHECKSUM_SIZE)
         crc = int.from_bytes(crc_bytes, "big") if len(crc_bytes) == 4 else 0
-        entries.append(DigestEntry(key, crc, nv.size))
+        entries.append(DigestEntry(key, crc, nv.size, epoch))
     for key in set(v.nm.tombstones):
         entries.append(DigestEntry(key, 0, TOMBSTONE_SIZE))
     entries.sort(key=lambda e: e.needle_id)
@@ -99,7 +139,7 @@ def manifest_bytes(entries: list[DigestEntry]) -> bytes:
     out = bytearray(MAGIC)
     out += len(entries).to_bytes(8, "big")
     for e in entries:
-        out += e.to_bytes()
+        out += e.to_bytes_v2()
     return bytes(out)
 
 
@@ -114,15 +154,21 @@ def write_manifest(base_file_name: str, entries: list[DigestEntry]) -> str:
 
 
 def read_manifest(path: str) -> list[DigestEntry]:
+    """Parse a rev-2 manifest — or a rev-1 one (pre-ISSUE-13 `.dig`
+    files keep parsing after an upgrade; their entries carry no epoch)."""
     with open(path, "rb") as f:
         blob = f.read()
-    if blob[:8] != MAGIC:
+    if blob[:8] == MAGIC:
+        stride = ENTRY_SIZE
+    elif blob[:8] == MAGIC_V1:
+        stride = ENTRY_SIZE_V1
+    else:
         raise IOError(f"{path}: not a digest manifest")
     count = int.from_bytes(blob[8:16], "big")
     body = blob[16:]
-    if len(body) != count * ENTRY_SIZE:
+    if len(body) != count * stride:
         raise IOError(f"{path}: truncated manifest")
-    return [DigestEntry.from_bytes(body[i * ENTRY_SIZE:(i + 1) * ENTRY_SIZE])
+    return [DigestEntry.from_bytes(body[i * stride:(i + 1) * stride])
             for i in range(count)]
 
 

@@ -60,9 +60,11 @@ from ..utils.stats import (
     SCRUB_NEEDLES,
     SCRUB_PACE_WAIT_SECONDS,
     SCRUB_REPAIRS,
+    SCRUB_SKIPPED_PAIRS,
     SCRUB_SWEEPS,
 )
 from . import digest as digest_mod
+from . import gather as gather_mod
 
 MAX_FINDINGS_KEPT = 256
 DEFAULT_EC_SLAB = 1 << 20
@@ -95,6 +97,15 @@ def _drop_swept_range(backing, offset: int, length: int) -> None:
     fn = getattr(backing, "drop_page_cache", None)
     if fn is not None:
         fn(offset, length)
+
+
+def cross_verify_enabled() -> bool:
+    """SWFS_SCRUB_CROSS (default ON, ISSUE 13): EC volumes whose shards
+    are split across servers get a cross-server syndrome verify — the
+    scrubbing holder gathers a repair-plan's worth of survivor ranges
+    from peers instead of skipping the volume."""
+    return os.environ.get("SWFS_SCRUB_CROSS", "1").lower() not in (
+        "0", "false", "off")
 
 
 def fetch_verified_needle(stub, vid: int, needle_id: int,
@@ -179,6 +190,9 @@ class ScrubReport:
     needles: int = 0
     bytes: int = 0
     repaired: int = 0
+    # anti-entropy peer pairs whose digest probe failed after retry —
+    # partial sweep coverage, surfaced instead of silently swallowed
+    skipped_pairs: int = 0
     findings: list[Finding] = field(default_factory=list)
 
 
@@ -665,7 +679,9 @@ class Scrubber:
             # QosUnavailable propagates to run_once (pass pauses).
             self._pace(len(n.data), work_class="repair")
             try:
-                v.write_needle(n, check_cookie=False)
+                # verbatim replica copy: keep the ORIGINATING write's
+                # epoch tag (stamping here would forge causality)
+                v.write_needle(n, check_cookie=False, stamp=False)
                 nv = v.nm.get(needle_id)
                 if nv is None:
                     raise IOError("repair write vanished from the map")
@@ -700,11 +716,15 @@ class Scrubber:
         geo = ev.geo
         k = geo.data_shards
         present = set(ev.shard_files)
-        if not all(i in present for i in range(k)):
-            return  # data shards elsewhere: the holder of each verifies
         parity_present = [k + j for j in range(geo.parity_shards)
                           if k + j in present]
-        if not parity_present:
+        if not all(i in present for i in range(k)) or not parity_present:
+            # shards split across servers: no local re-encode possible.
+            # PR-4 reported these volumes "skipped"; now the holder
+            # gathers exactly a repair-plan's worth of survivor ranges
+            # from peers and verifies its own shards (ISSUE 13).
+            self._verify_ec_volume_cross(loc, vid, full, repair, report,
+                                         _depth)
             return
         coder = self._geo_coder(geo)
         sched = dispatch.maybe_scheduler(coder)
@@ -791,16 +811,22 @@ class Scrubber:
 
     def _identify_bad_shard(self, ev, coder, off: int,
                             size: int) -> int | None:
-        """Leave-one-out: the corrupt shard is the one whose replacement
-        by a reconstruction from the others makes every parity equation
-        hold again. Exact for single-shard corruption under RS(k, m)."""
-        geo = ev.geo
-        total = geo.total_shards
+        """Leave-one-out over local shard files (the all-local sweep)."""
         rows: dict[int, np.ndarray] = {}
         for i, f in ev.shard_files.items():
             data = f.read_at(off, size)
             rows[i] = np.frombuffer(data + b"\0" * (size - len(data)),
                                     np.uint8)
+        return self._pin_culprit_from_rows(ev.geo, coder, rows)
+
+    def _pin_culprit_from_rows(self, geo, coder,
+                               rows: dict[int, np.ndarray]) -> int | None:
+        """Leave-one-out: the corrupt shard is the one whose replacement
+        by a reconstruction from the others makes every parity equation
+        hold again. Exact for single-shard corruption under RS(k, m);
+        needs every shard's bytes for the window — rows short of the
+        full set return None (ambiguous)."""
+        total = geo.total_shards
         if len(rows) < total:
             return None  # missing shards are the rebuild path's business
         k = geo.data_shards
@@ -875,6 +901,354 @@ class Scrubber:
         glog.info(f"scrub: ec vol {vid} shard {sid} rebuilt from survivors")
         return True
 
+    # ---- cross-server syndrome verify (ISSUE 13 tentpole a)
+
+    def _cross_plan(self, ev, vid: int, geom, srv):
+        """-> (shard_addrs, all_present, plans) for a split EC volume:
+        which peers hold which shards, and — per locally-held shard —
+        the geometry's minimal-read verify plan (an LRC local-parity
+        holder plans its 5-shard group, never k=10). Targets whose plan
+        needs a shard no reachable peer holds are dropped; an empty
+        plans dict means nothing is verifiable from here."""
+        from ..models.geometry import UnsolvableError
+
+        locs = srv._lookup_ec_shards(vid)
+        shard_addrs = {
+            sid: [a for a in addrs if a != srv.address]
+            for sid, addrs in locs.items()}
+        shard_addrs = {s: a for s, a in shard_addrs.items() if a}
+        local = set(ev.shard_files)
+        all_present = tuple(sorted(local | set(shard_addrs)))
+        plans = {}
+        for sid in sorted(local):
+            try:
+                plan = geom.repair_plan(
+                    (sid,), tuple(i for i in all_present if i != sid))
+            except (UnsolvableError, ValueError):
+                continue
+            if all(i in local or i in shard_addrs for i in plan.reads):
+                plans[sid] = plan
+        return shard_addrs, all_present, plans
+
+    def _verify_ec_volume_cross(self, loc, vid: int, full: bool,
+                                repair: bool, report: ScrubReport,
+                                _depth: int = 0) -> None:
+        """Syndrome verify of a split EC volume: every locally-held
+        shard is recomputed from its repair plan's survivor ranges —
+        local reads where possible, peer ranges gathered through the
+        chunked VolumeEcShardsRead transport (slab-resume after a flap),
+        recompute riding the volume's own coder dispatch lanes while
+        the next window's gather is in flight. Fetch volume is bounded
+        by the PLAN, not k: an LRC local-parity verify moves 5 shards'
+        ranges. All paced as scrub-class bytes (ISSUE 8)."""
+        ev = loc.ec_volumes.get(vid)
+        srv = self.server
+        if ev is None or srv is None or not cross_verify_enabled():
+            return
+        geo = ev.geo
+        try:
+            geom = geo.code_geometry()
+        except ValueError:
+            return  # unregistered geometry never serves, never verifies
+        shard_addrs, all_present, plans = self._cross_plan(ev, vid, geom,
+                                                           srv)
+        if not plans:
+            return  # peers own their shards; nothing verifiable here
+        coder = self._geo_coder(geo)
+        collection = getattr(ev, "collection", "")
+        needed = set()
+        for plan in plans.values():
+            needed.update(plan.reads)
+        local = set(ev.shard_files)
+        remote_needed = sorted(needed - local)
+        local_read = sorted((needed | set(plans)) & local)
+        cur = self._cursor_for(ev.base)
+        shard_size = ev.shard_size
+        # window stride == wire slab stride: the server clamps slabs to
+        # its 2MB streaming chunk, so the consumer must too, or windows
+        # would pop at a coarser stride than slabs arrive
+        slab = min(max(4096, self.ec_slab), gather_mod.MAX_SLAB)
+        start = 0 if full or cur.ec_offset >= shard_size else cur.ec_offset
+        running: dict[int, int] = ({i: 0 for i in local_read}
+                                   if start == 0 else {})
+        g = None
+        if remote_needed:
+            g = gather_mod.ShardRangeGatherer(
+                vid, collection,
+                {s: shard_addrs[s] for s in remote_needed},
+                shard_size, slab, start=start)
+        clean = covered = True
+        off = start
+        try:
+            while off < shard_size:
+                if self._stop.is_set():
+                    covered = False
+                    return
+                self._maybe_backoff()
+                n = min(slab, shard_size - off)
+                rows: dict[int, np.ndarray] = {}
+                for i in local_read:
+                    data = ev.shard_files[i].read_at(off, n)
+                    rows[i] = np.frombuffer(
+                        data + b"\0" * (n - len(data)), np.uint8)
+                    if i in running:
+                        running[i] = crc32c(rows[i].tobytes(), running[i])
+                try:
+                    remote_rows = g.window(off, n) if g else {}
+                except gather_mod.GatherError as e:
+                    glog.warning(f"scrub: cross-server verify of ec vol "
+                                 f"{vid} degraded: {e}")
+                    covered = False
+                    break
+                for i, b in remote_rows.items():
+                    rows[i] = np.frombuffer(b, np.uint8)
+                # scrub-class pacing covers local AND gathered bytes —
+                # a fleet-wide sweep draws the cluster budget, it can't
+                # stampede the network (ISSUE 8)
+                self._pace(n * len(rows))
+                SCRUB_BYTES.inc(n * len(rows), kind="ec_syndrome")
+                report.bytes += n * len(rows)
+                for sid, plan in plans.items():
+                    try:
+                        # the recompute rides the shared reconstruct
+                        # lanes of THIS volume's coder — scrub slabs
+                        # stack with foreground dispatches, overlapped
+                        # with the gather threads prefetching off+n
+                        missing, out = dispatch.reconstruct_now(
+                            coder, plan.reads,
+                            np.stack([rows[i] for i in plan.reads]),
+                            want=(sid,))
+                        rec = np.asarray(out[list(missing).index(sid)],
+                                         np.uint8)
+                    except (IOError, ValueError):
+                        covered = False
+                        continue
+                    if np.array_equal(rec, rows[sid]):
+                        continue
+                    clean = False
+                    culprit = self._pin_culprit_cross(
+                        ev, coder, geom, vid, off, n, rows, shard_addrs)
+                    f = self._add_finding(Finding(
+                        vid, "ec_parity",
+                        shard_id=culprit if culprit is not None else 255,
+                        detail=f"cross-server syndrome mismatch against "
+                               f"shard {sid} in byte range "
+                               f"[{off}, {off + n})"
+                               + ("" if culprit is not None
+                                  else " (culprit ambiguous)")))
+                    report.findings.append(f)
+                    if repair and culprit is not None and \
+                            self._repair_ec_shard_cross(
+                                loc, vid, culprit, f, shard_addrs,
+                                all_present):
+                        report.repaired += 1
+                        if _depth < 2:
+                            # shards changed: re-verify the whole volume
+                            # against the fresh files
+                            self._verify_ec_volume(loc, vid, True,
+                                                   repair, report,
+                                                   _depth + 1)
+                        return
+                    # detect-only / ambiguous culprit / failed repair:
+                    # one finding per window is enough — keep scanning
+                    # the rest of the volume (the local path's contract;
+                    # an early return would pin the cursor on the rot
+                    # and leave everything past it unverified forever)
+                    break
+                off += n
+                cur.ec_offset = off
+        finally:
+            if g is not None:
+                g.close()
+            for i in local_read:
+                _drop_swept_range(ev.shard_files.get(i), start,
+                                  max(0, off - start))
+            cur.ec_offset = min(cur.ec_offset, shard_size)
+            cur.save()
+        if off >= shard_size and clean and covered:
+            cur.sweeps += 1
+            SCRUB_SWEEPS.inc(kind="ec")
+            if start == 0 and running:
+                # whole-shard digests of the LOCAL shards fall out of
+                # the slabs already read — VolumeDigest serves them
+                self._ec_digests[vid] = {
+                    i: digest_mod.ShardCrc(i, running[i],
+                                           ev.shard_files[i].size())
+                    for i in running if i in ev.shard_files}
+
+    def _pin_culprit_cross(self, ev, coder, geom, vid: int, off: int,
+                           n: int, rows: dict, shard_addrs) -> int | None:
+        """Leave-one-out culprit pinning needs EVERY shard's bytes for
+        the mismatching window — top up the verify rows with one-shot
+        fetches of the shards the plan didn't need (local file first,
+        any peer holder next). The culprit may be local OR remote."""
+        full_rows = dict(rows)
+        extra = 0
+        for sid in range(geom.total_shards):
+            if sid in full_rows:
+                continue
+            f = ev.shard_files.get(sid)
+            if f is not None:
+                data = f.read_at(off, n)
+                full_rows[sid] = np.frombuffer(
+                    data + b"\0" * (n - len(data)), np.uint8)
+                continue
+            if sid in shard_addrs:
+                b = gather_mod.fetch_range_once(
+                    shard_addrs[sid], vid,
+                    getattr(ev, "collection", ""), sid, off, n)
+                if b is not None:
+                    full_rows[sid] = np.frombuffer(b, np.uint8)
+                    extra += n
+                    continue
+            return None  # a shard is missing cluster-wide: ambiguous
+        if extra:
+            self._pace(extra)
+        return self._pin_culprit_from_rows(ev.geo, coder, full_rows)
+
+    def _repair_ec_shard_cross(self, loc, vid: int, sid: int,
+                               finding: Finding, shard_addrs,
+                               all_present) -> bool:
+        """Repair a rotten shard when the survivors are split across
+        servers: reconstruct the whole shard from its repair plan
+        (local reads + gathered peer ranges, repair-class paced), land
+        it as a LOCAL shard file on this holder, remount, and — when
+        the rotten copy lives on a peer — delete it there (the shard
+        migrates to the verifier; topology follows the heartbeats).
+        Readers never see a gap: the rotten copy self-heals via
+        reconstruct-around until the fresh one is mounted."""
+        import grpc
+
+        from ..models.geometry import UnsolvableError
+        from ..pb import rpc
+        from ..pb import volume_server_pb2 as vs
+        from ..qos import QosUnavailable
+        from ..utils.stats import EC_REPAIR_BYTES, EC_REPAIR_PLANS
+
+        ev = loc.ec_volumes.get(vid)
+        srv = self.server
+        if ev is None or srv is None:
+            return False
+        geo = ev.geo
+        geom = geo.code_geometry()
+        collection = getattr(ev, "collection", "")
+        base = ev.base
+        try:
+            plan = geom.repair_plan(
+                (sid,), tuple(i for i in all_present if i != sid))
+        except (UnsolvableError, ValueError) as e:
+            finding.detail += f"; unrecoverable: {e}"
+            finding.set_state("failed")
+            SCRUB_REPAIRS.inc(method="ec_rebuild", outcome="failed")
+            return False
+        local = set(ev.shard_files) - {sid}
+        remote_reads = [i for i in plan.reads if i not in local]
+        if any(i not in shard_addrs for i in remote_reads):
+            finding.detail += "; a planned survivor has no holder"
+            finding.set_state("failed")
+            SCRUB_REPAIRS.inc(method="ec_rebuild", outcome="failed")
+            return False
+        coder = self._geo_coder(geo)
+        was_local = sid in ev.shard_files
+        if was_local:
+            # quarantine: atomic replace (no close) — in-flight readers
+            # keep a valid mmap, new reads degrade-reconstruct instead
+            # of serving rotten bytes (the PR-4 repair-ladder contract)
+            ev.shard_files = {i: f for i, f in ev.shard_files.items()
+                              if i != sid}
+        shard_size = ev.shard_size
+        slab = min(max(4096, self.ec_slab), gather_mod.MAX_SLAB)
+        g = None
+        if remote_reads:
+            g = gather_mod.ShardRangeGatherer(
+                vid, collection,
+                {i: shard_addrs[i] for i in remote_reads},
+                shard_size, slab)
+        tmp = geo.shard_file_name(base, sid) + ".repair"
+        try:
+            local_b = remote_b = 0
+            with open(tmp, "wb") as out_f:
+                off = 0
+                while off < shard_size:
+                    n = min(slab, shard_size - off)
+                    # repair-class tokens outrank scrub in the ledger;
+                    # QosUnavailable pauses the pass (run_once)
+                    self._pace(n * len(plan.reads), work_class="repair")
+                    rows: dict[int, np.ndarray] = {}
+                    for i in plan.reads:
+                        if i in local:
+                            data = ev.shard_files[i].read_at(off, n)
+                            rows[i] = np.frombuffer(
+                                data + b"\0" * (n - len(data)), np.uint8)
+                            local_b += n
+                    if g is not None:
+                        for i, b in g.window(off, n).items():
+                            rows[i] = np.frombuffer(b, np.uint8)
+                            remote_b += n
+                    missing, out = dispatch.reconstruct_now(
+                        coder, plan.reads,
+                        np.stack([rows[i] for i in plan.reads]),
+                        want=(sid,))
+                    out_f.write(np.asarray(
+                        out[list(missing).index(sid)],
+                        np.uint8).tobytes())
+                    off += n
+            os.replace(tmp, geo.shard_file_name(base, sid))
+            self.store.mount_ec_shards(vid, collection, [sid])
+            self.invalidate_ec_digest(vid, remove_manifest=True)
+            srv.ec_recon_cache.invalidate(vid)
+            if not was_local:
+                # migrate: this holder now serves the verified rebuild;
+                # the peer's rotten copy is deleted — ONLY on the first
+                # holder, the one whose bytes the gather/pinning
+                # actually examined. Other holders of the same shard id
+                # (duplicates are a legal state) were never inspected:
+                # their copies may be healthy, and a later sweep judges
+                # whichever copy it reads on its own evidence.
+                for addr in shard_addrs.get(sid, [])[:1]:
+                    try:
+                        rpc.volume_stub(rpc.grpc_address(addr)) \
+                            .VolumeEcShardsDelete(
+                                vs.VolumeEcShardsDeleteRequest(
+                                    volume_id=vid, collection=collection,
+                                    shard_ids=[sid]), timeout=60)
+                    except grpc.RpcError as e:
+                        glog.warning(
+                            f"scrub: could not delete rotten shard "
+                            f"{sid} of vol {vid} on {addr}: {e}")
+                srv._ec_loc_cache.pop(vid, None)
+            srv.trigger_heartbeat()
+            EC_REPAIR_PLANS.inc(geometry=geo.code_name,
+                                kind="scrub_cross")
+            if local_b:
+                EC_REPAIR_BYTES.inc(local_b, geometry=geo.code_name,
+                                    kind="scrub_cross", source="local")
+            if remote_b:
+                EC_REPAIR_BYTES.inc(remote_b, geometry=geo.code_name,
+                                    kind="scrub_cross", source="remote")
+        except QosUnavailable:
+            raise  # pass pauses; the quarantined shard reconstructs on
+            #        read and the next sweep retries the rebuild
+        except (IOError, OSError, ValueError,
+                gather_mod.GatherError) as e:
+            finding.detail += f"; cross-server rebuild failed: {e}"
+            finding.set_state("failed")
+            SCRUB_REPAIRS.inc(method="ec_rebuild", outcome="failed")
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            return False
+        finally:
+            if g is not None:
+                g.close()
+        finding.set_state("repaired")
+        SCRUB_REPAIRS.inc(method="ec_rebuild", outcome="ok")
+        glog.info(f"scrub: ec vol {vid} shard {sid} rebuilt from "
+                  f"cross-server survivors "
+                  f"({'local' if was_local else 'migrated'} copy)")
+        return True
+
     # ---- anti-entropy: digest comparison across replicas
 
     def run_anti_entropy(self, vid: int | None = None, repair: bool = True,
@@ -901,6 +1275,8 @@ class Scrubber:
 
         from ..pb import rpc, scrub_pb2
 
+        from ..utils import retry as retry_mod
+
         srv = self.server
         mine = digest_mod.volume_digest_entries(v)
         my_rolling = digest_mod.rolling_digest(mine)
@@ -908,18 +1284,42 @@ class Scrubber:
         for addr in srv.lookup_volume_locations(v.id):
             if addr == srv.address:
                 continue
+            stub = rpc.volume_stub(rpc.grpc_address(addr))
             try:
-                stub = rpc.volume_stub(rpc.grpc_address(addr))
-                resp = stub.VolumeDigest(scrub_pb2.VolumeDigestRequest(
-                    volume_id=v.id), timeout=30)
+                # one retry through the unified ladder before skipping:
+                # a single dropped RPC must not silently shrink sweep
+                # coverage (the old bare `continue` hid it entirely)
+                resp = retry_mod.retry(
+                    "scrub.digest_probe",
+                    lambda: stub.VolumeDigest(
+                        scrub_pb2.VolumeDigestRequest(volume_id=v.id),
+                        timeout=30),
+                    attempts=2)
                 if resp.rolling_crc == my_rolling \
                         and resp.needle_count == my_live:
                     continue  # replicas agree — ~20 bytes settled it
-                resp = stub.VolumeDigest(scrub_pb2.VolumeDigestRequest(
-                    volume_id=v.id, include_entries=True), timeout=60)
-            except grpc.RpcError:
+                resp = retry_mod.retry(
+                    "scrub.digest_entries",
+                    lambda: stub.VolumeDigest(
+                        scrub_pb2.VolumeDigestRequest(
+                            volume_id=v.id, include_entries=True),
+                        timeout=60),
+                    attempts=2)
+            except grpc.RpcError as e:
+                # counted, never swallowed: the sweep report and the
+                # SeaweedFS_scrub_skipped_pairs counter make partial
+                # anti-entropy coverage visible
+                report.skipped_pairs += 1
+                SCRUB_SKIPPED_PAIRS.inc()
+                glog.warning(f"anti-entropy vol {v.id}: digest probe to "
+                             f"{addr} failed after retry: {e}")
                 continue
-            theirs = [digest_mod.DigestEntry(e.needle_id, e.crc, e.size)
+            theirs = [digest_mod.DigestEntry(
+                          e.needle_id, e.crc, e.size,
+                          (e.epoch_incarnation, e.epoch_seq,
+                           e.epoch_server)
+                          if (e.epoch_incarnation or e.epoch_seq
+                              or e.epoch_server) else None)
                       for e in resp.entries]
             only_mine, only_theirs, differing = digest_mod.diff_entries(
                 mine, theirs)
@@ -939,56 +1339,102 @@ class Scrubber:
             report.findings.append(f)
             if not repair:
                 continue
-            ok = self._heal_divergence(v, addr, only_mine, only_theirs,
-                                       differing)
+            misses = self._heal_divergence(v, addr, only_mine,
+                                           only_theirs, differing)
+            if misses:
+                f.detail += (f"; {misses} needle(s) had no fetchable "
+                             f"verified copy on any replica")
             # "repaired" is only claimed on PROVEN convergence: recompute
-            # the local digest and re-fetch the peer's rolling CRC. An
-            # unorderable live-vs-live conflict (equal append_at_ns) —
-            # or any silent non-heal — leaves the digests apart and the
-            # finding honestly failed, instead of an endlessly
-            # "repairing" counter that never converges.
+            # the local digest and re-fetch the peer's rolling CRC — the
+            # verdict is the digests', not the heal loop's (a miss that
+            # another replica pair already healed must not poison this
+            # pass). A genuinely unorderable live-vs-live conflict (two
+            # pre-epoch records with equal append_at_ns) — or any silent
+            # non-heal — leaves the digests apart and the finding
+            # honestly failed, instead of an endlessly "repairing"
+            # counter that never converges.
             mine = digest_mod.volume_digest_entries(v)
             my_rolling = digest_mod.rolling_digest(mine)
             my_live = sum(1 for e in mine if e.size >= 0)
-            if ok:
-                try:
-                    resp = stub.VolumeDigest(scrub_pb2.VolumeDigestRequest(
-                        volume_id=v.id), timeout=30)
-                    ok = (resp.rolling_crc == my_rolling
-                          and resp.needle_count == my_live)
-                except grpc.RpcError:
-                    ok = False
+            try:
+                resp = stub.VolumeDigest(scrub_pb2.VolumeDigestRequest(
+                    volume_id=v.id), timeout=30)
+                ok = (resp.rolling_crc == my_rolling
+                      and resp.needle_count == my_live)
+            except grpc.RpcError:
+                ok = False
             f.set_state("repaired" if ok else "failed")
             SCRUB_REPAIRS.inc(method="anti_entropy",
                               outcome="ok" if ok else "failed")
             if ok:
                 report.repaired += 1
 
+    def _fetch_verified_needle_multi(self, v, peer_addr: str,
+                                     needle_id: int) -> Needle | None:
+        """A CRC-verified copy of a needle: the diffing peer first, then
+        every OTHER replica holder via multi_retry — a peer flapping
+        mid-heal must not strand a needle the rest of the replica set
+        can still supply. With replica-epoch tags, resolution orders by
+        the FETCHED record's own stored tag, so any verified copy
+        advances convergence."""
+        from ..pb import rpc
+        from ..utils import retry as retry_mod
+
+        srv = self.server
+        targets = [peer_addr]
+        if srv is not None:
+            targets += [a for a in srv.lookup_volume_locations(v.id)
+                        if a not in (peer_addr, srv.address)]
+
+        def attempt(addr):
+            n = fetch_verified_needle(
+                rpc.volume_stub(rpc.grpc_address(addr)), v.id, needle_id,
+                v.version)
+            if n is None:
+                raise ConnectionError(
+                    f"no verified copy of needle {needle_id:x} on {addr}")
+            return n
+
+        try:
+            return retry_mod.multi_retry("scrub.fetch_needle", targets,
+                                         attempt, cycles=2)
+        except Exception:  # noqa: BLE001 — every holder failed/declined
+            return None
+
     def _heal_divergence(self, v, addr: str, only_mine, only_theirs,
-                         differing) -> bool:
-        """Converge one (local, peer) replica pair. Rules: tombstones win
-        over live entries (deletes propagate — without vector clocks the
-        alternative resurrects deleted data); live-vs-live conflicts go
-        to the newest append_at_ns; missing entries are copied toward
-        the replica that lacks them."""
+                         differing) -> int:
+        """Converge one (local, peer) replica pair; -> the number of
+        needles left UNHEALED (0 = full heal). Rules: tombstones win
+        over live entries (deletes propagate — the alternative
+        resurrects deleted data); live-vs-live conflicts go to the
+        newest append_at_ns; EQUAL timestamps resolve by the
+        replica-epoch total order (ISSUE 13 — both sides compare the
+        same two stored tags, so both pick the same winner); missing
+        entries are copied toward the replica that lacks them. Only two
+        pre-epoch records with equal timestamps remain unorderable.
+
+        A single unfetchable needle no longer aborts the pass verdict:
+        the rest of the diff still heals, the miss is counted, and the
+        caller's digest re-probe decides repaired/failed."""
         import grpc
 
         from ..pb import rpc
         from ..pb import volume_server_pb2 as vs
+        from ..storage.epoch import order_key
         from ..storage.file_id import format_needle_id_cookie
 
         stub = rpc.volume_stub(rpc.grpc_address(addr))
-        ok = True
+        misses = 0
         try:
             for e in only_theirs:
                 if e.size < 0:
                     continue  # their tombstone for an id we never had
-                theirs_n = fetch_verified_needle(stub, v.id, e.needle_id,
-                                                 v.version)
+                theirs_n = self._fetch_verified_needle_multi(
+                    v, addr, e.needle_id)
                 if theirs_n is None:
-                    ok = False
+                    misses += 1
                     continue
-                v.write_needle(theirs_n, check_cookie=False)
+                v.write_needle(theirs_n, check_cookie=False, stamp=False)
             for e in only_mine:
                 if e.size < 0:
                     continue
@@ -1001,7 +1447,7 @@ class Scrubber:
                     # onto the healthy peer (never heal FROM rot)
                     v._read_record(nv)
                 except (IOError, ValueError):
-                    ok = False  # the needle sweep owns this finding
+                    misses += 1  # the needle sweep owns this finding
                     continue
                 blob = v.read_needle_blob(
                     types.stored_to_actual_offset(nv.offset), nv.size)
@@ -1021,10 +1467,10 @@ class Scrubber:
                     except (NotFoundError, DeletedError):
                         pass
                     continue
-                theirs_n = fetch_verified_needle(stub, v.id, me.needle_id,
-                                                 v.version)
+                theirs_n = self._fetch_verified_needle_multi(
+                    v, addr, me.needle_id)
                 if theirs_n is None:
-                    ok = False
+                    misses += 1
                     continue
                 nv = v.nm.get(me.needle_id)
                 mine_n = None
@@ -1033,21 +1479,38 @@ class Scrubber:
                         mine_n = v._read_record(nv)
                     except (IOError, ValueError):
                         mine_n = None  # local copy rotten: theirs wins
-                if mine_n is None or \
-                        theirs_n.append_at_ns > mine_n.append_at_ns:
-                    v.write_needle(theirs_n, check_cookie=False)
-                elif mine_n.append_at_ns > theirs_n.append_at_ns:
+
+                def push_mine():
                     blob = v.read_needle_blob(
                         types.stored_to_actual_offset(nv.offset), nv.size)
                     stub.WriteNeedleBlob(vs.WriteNeedleBlobRequest(
                         volume_id=v.id, needle_id=me.needle_id,
                         size=nv.size, needle_blob=blob), timeout=30)
-                # equal timestamps with differing bytes cannot be ordered
-                # — leave both and let the finding surface to operators
+
+                if mine_n is None or \
+                        theirs_n.append_at_ns > mine_n.append_at_ns:
+                    v.write_needle(theirs_n, check_cookie=False,
+                                   stamp=False)
+                elif mine_n.append_at_ns > theirs_n.append_at_ns:
+                    push_mine()
+                else:
+                    # equal timestamps: the replica-epoch total order
+                    # decides — deterministically, on BOTH sides. Only
+                    # two pre-epoch (untagged) records stay unorderable
+                    # and surface, honestly, as a failed finding.
+                    mk = order_key(mine_n.replica_epoch())
+                    tk = order_key(theirs_n.replica_epoch())
+                    if tk > mk:
+                        v.write_needle(theirs_n, check_cookie=False,
+                                       stamp=False)
+                    elif mk > tk:
+                        push_mine()
+                    else:
+                        misses += 1
         except (grpc.RpcError, IOError, ValueError) as e:
             glog.warning(f"anti-entropy heal vol {v.id} vs {addr}: {e}")
-            return False
-        return ok
+            return misses + 1
+        return misses
 
     # -- introspection -----------------------------------------------------
 

@@ -1200,7 +1200,10 @@ class VolumeGrpc:
     def WriteNeedleBlob(self, request, context):
         v = self._volume(request.volume_id, context)
         n = Needle.from_bytes(request.needle_blob, v.version, check_crc=False)
-        v.write_needle(n, check_cookie=False)
+        # verbatim record transfer (anti-entropy heal, scrub repair):
+        # the blob carries the ORIGINATING write's epoch tag — stamping
+        # a fresh one here would forge causality for a copy
+        v.write_needle(n, check_cookie=False, stamp=False)
         return vs.WriteNeedleBlobResponse()
 
     def ReadAllNeedles(self, request, context):
@@ -1253,7 +1256,7 @@ class VolumeGrpc:
                 break
             n = Needle.from_bytes(resp.needle_header + resp.needle_body,
                                   v.version, check_crc=False)
-            v.write_needle(n, check_cookie=False)
+            v.write_needle(n, check_cookie=False, stamp=False)
         return vs.VolumeTailReceiverResponse()
 
     # ---- erasure coding (volume_grpc_erasure_coding.go) ------------------
@@ -1581,6 +1584,56 @@ class VolumeGrpc:
             resp.shards.add(shard_id=sid, size=size)
         return resp
 
+    def VolumeEcShardsRead(self, request, context):
+        """Cross-server syndrome-verify gather source (ISSUE 13): stream
+        the requested shard RANGES as chunked, CRC-stamped,
+        offset-addressed slabs — the VolumeEcShardsStream wire shape in
+        reverse. Ranges advance in lockstep (offset-major) so a consumer
+        assembling verify windows across shards never has to buffer a
+        whole shard of one range while another lags."""
+        from ..pb import ec_gather_pb2 as eg
+        from ..storage.crc import crc32c
+
+        ev = self.store.find_ec_volume(request.volume_id)
+        if ev is None:
+            context.abort(grpc.StatusCode.NOT_FOUND,
+                          f"ec volume {request.volume_id} not mounted")
+        slab = min(request.slab or BUFFER_SIZE_LIMIT, BUFFER_SIZE_LIMIT)
+        cursors = []
+        for r in request.ranges:
+            f = ev.shard_files.get(r.shard_id)
+            if f is None:
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              f"shard {r.shard_id} not on this server")
+            end = f.size() if not r.size else min(r.offset + r.size,
+                                                  f.size())
+            cursors.append([r.shard_id, f, r.offset, end])
+        progressed = True
+        while progressed:
+            progressed = False
+            for cur in cursors:
+                sid, f, off, end = cur
+                if off >= end:
+                    continue
+                n = min(slab, end - off)
+                try:
+                    # chaos hook: a targeted peer drops mid-gather; the
+                    # scrubber resumes only the missing ranges.
+                    # Matchable per peer AND per (shard, offset).
+                    failpoint.fail(
+                        "scrub.gather.range",
+                        ctx=f"{self.srv.address}, shard={sid}, "
+                            f"off={off},")
+                except failpoint.FailpointError as e:
+                    context.abort(grpc.StatusCode.UNAVAILABLE, str(e))
+                data = f.read_at(off, n)
+                data += b"\0" * (n - len(data))
+                yield eg.VolumeEcShardsReadResponse(
+                    shard_id=sid, offset=off, data=data,
+                    crc=crc32c(data))
+                cur[2] = off + n
+                progressed = True
+
     def VolumeEcShardsRebuild(self, request, context):
         """Regenerate missing .ecXX from survivors (handler :84-123)."""
         base = self._ec_base(request.volume_id, request.collection, context)
@@ -1873,8 +1926,10 @@ class VolumeGrpc:
                 rolling_crc=scrub_digest.rolling_digest(entries))
             if request.include_entries:
                 for e in entries:
+                    inc, seq, srv = e.epoch or (0, 0, 0)
                     resp.entries.add(needle_id=e.needle_id, crc=e.crc,
-                                     size=e.size)
+                                     size=e.size, epoch_incarnation=inc,
+                                     epoch_seq=seq, epoch_server=srv)
             return resp
         ev = self.store.find_ec_volume(vid)
         if ev is None:
@@ -1901,7 +1956,8 @@ class VolumeGrpc:
             volumes_scrubbed=report.volumes,
             needles_checked=report.needles,
             bytes_verified=report.bytes,
-            repaired=report.repaired)
+            repaired=report.repaired,
+            skipped_pairs=report.skipped_pairs)
         for f in report.findings:
             resp.findings.add(
                 volume_id=f.volume_id, kind=f.kind, needle_id=f.needle_id,

@@ -310,16 +310,31 @@ def volume_check_disk(env, args, out):
                 print(f"ec volume {vid} shard {sid} copies diverge: "
                       f"{copies}", file=out)
         if opts.slow:
-            # a holder with every shard can run the full parity syndrome
+            # the holder with the most shards runs the syndrome verify;
+            # when no holder has a full local set, the scrub plane's
+            # cross-server gather (ISSUE 13) fetches a repair-plan's
+            # worth of survivor ranges from peers — a split volume is
+            # VERIFIED, never skipped (the pre-ISSUE-13 gap)
             best = max(holders, key=lambda s: len(holders[s]))
+            split = len(holders[best]) < len(by_shard)
             r = env.volume_stub(best).VolumeScrub(
-                scrub_pb2.VolumeScrubRequest(volume_id=vid), timeout=3600)
+                scrub_pb2.VolumeScrubRequest(volume_id=vid, full=True),
+                timeout=3600)
             bad = [f for f in r.findings if f.kind == "ec_parity"]
             if bad:
                 issues += len(bad)
                 for f in bad:
                     print(f"ec volume {vid}: {f.detail} "
                           f"(shard {f.shard_id}, {f.state})", file=out)
+            elif r.bytes_verified:
+                print(f"ec volume {vid}: syndrome verified clean via "
+                      f"{best}"
+                      + (" (cross-server gather)" if split else ""),
+                      file=out)
+            else:
+                issues += 1
+                print(f"ec volume {vid}: syndrome verify could not "
+                      f"cover the volume from {best}", file=out)
     print(f"{issues} integrity issue(s) found", file=out)
 
 
@@ -360,7 +375,9 @@ def volume_scrub(env, args, out):
         repair=not opts.detectOnly), timeout=3600)
     print(f"scrubbed {r.volumes_scrubbed} volume(s): "
           f"{r.needles_checked} needles, {r.bytes_verified} bytes, "
-          f"{len(r.findings)} finding(s), {r.repaired} repaired", file=out)
+          f"{len(r.findings)} finding(s), {r.repaired} repaired"
+          + (f", {r.skipped_pairs} peer pair(s) skipped"
+             if r.skipped_pairs else ""), file=out)
     for f in r.findings:
         print(f"  vol {f.volume_id} {f.kind} needle={f.needle_id:x} "
               f"shard={f.shard_id} [{f.state}] {f.detail}", file=out)

@@ -260,6 +260,27 @@ class Needle:
             self.pairs = b[i : i + psz]
             i += psz
 
+    # -- replica-epoch causality tag (ISSUE 13) ----------------------------
+
+    def replica_epoch(self) -> tuple[int, int, int] | None:
+        """(incarnation, sequence, server_crc) stamped at write time, or
+        None for a pre-epoch record. Rides the END of the pairs
+        extension (storage/epoch.py) so it survives vacuum, replication
+        and EC conversion with zero format changes."""
+        from .epoch import decode_pairs
+
+        return decode_pairs(self.pairs)
+
+    def set_replica_epoch_tag(self, tag: bytes) -> None:
+        """Attach (or replace) the epoch tag. Only meaningful for
+        records with data — v2/v3 serialization emits no body sections
+        for empty needles, so deletion markers stay untagged (tombstone-
+        wins needs no causality)."""
+        from .epoch import strip_pairs
+
+        self.pairs = strip_pairs(self.pairs) + tag
+        self.set_flag(FLAG_HAS_PAIRS)
+
     # -- timestamps --------------------------------------------------------
 
     def update_append_at_ns(self, last_append_at_ns: int) -> None:

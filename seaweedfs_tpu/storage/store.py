@@ -100,6 +100,13 @@ class Store:
         counts = max_volume_counts or [8] * len(directories)
         for d, c in zip(directories, counts):
             self.locations.append(DiskLocation(d, c))
+        # replica-epoch causality mint (ISSUE 13): one incarnation bump
+        # per store start, attached to every volume this store serves
+        from .epoch import EpochStamper
+
+        self.epoch_stamper = EpochStamper(
+            self.locations[0].directory,
+            f"{ip}:{port}" if ip or port else "")
         self.load_existing_volumes()
         # deltas accumulated for incremental heartbeats
         self.new_volumes: list[master_pb2.VolumeShortInformationMessage] = []
@@ -116,8 +123,10 @@ class Store:
             for vid, (col, _path) in vols.items():
                 if vid not in loc.volumes:
                     try:
-                        loc.volumes[vid] = Volume(loc.directory, col, vid,
+                        v = Volume(loc.directory, col, vid,
                             needle_map_kind=self.needle_map_kind)
+                        v.epoch_stamper = self.epoch_stamper
+                        loc.volumes[vid] = v
                     except Exception as e:
                         # one unloadable volume (e.g. a .tier sidecar whose
                         # backend isn't configured) must not down the server
@@ -213,6 +222,7 @@ class Store:
             t = TTL.parse(ttl) if ttl else EMPTY_TTL
             v = Volume(loc.directory, collection, vid, replica_placement=rp,
                        ttl=t, needle_map_kind=self.needle_map_kind)
+            v.epoch_stamper = self.epoch_stamper
             loc.volumes[vid] = v
             self.new_volumes.append(master_pb2.VolumeShortInformationMessage(
                 id=vid, collection=collection,
@@ -245,8 +255,10 @@ class Store:
             vols, _ = loc.scan()
             if vid in vols:
                 col, _ = vols[vid]
-                loc.volumes[vid] = Volume(loc.directory, col, vid,
-                            needle_map_kind=self.needle_map_kind)
+                v = Volume(loc.directory, col, vid,
+                           needle_map_kind=self.needle_map_kind)
+                v.epoch_stamper = self.epoch_stamper
+                loc.volumes[vid] = v
                 return
         raise NotFoundError(f"volume {vid} not found on disk")
 

@@ -304,6 +304,12 @@ class Volume:
         self.read_only = False
         self.last_append_at_ns = 0
         self.last_modified_ts_seconds = 0
+        # replica-epoch causality plane (ISSUE 13): the owning Store
+        # attaches its EpochStamper; a bare Volume (tests, offline
+        # tools) stays unstamped (pre-epoch behavior). The per-volume
+        # write sequence advances under _lock.
+        self.epoch_stamper = None
+        self.epoch_seq = 0
         self.is_compacting = False
         # (needles, bytes) CRC re-verified by the last compact(); consumed
         # by commit_compact's scrub-pass publication
@@ -530,7 +536,22 @@ class Volume:
             self.last_modified_ts_seconds = n.last_modified
         return off, n.size, False
 
-    def write_needle(self, n: Needle, check_cookie: bool = True) -> tuple[int, int, bool]:
+    def _maybe_stamp_epoch(self, n: Needle, stamp: bool) -> None:
+        """Attach a replica-epoch tag to a write this server ORIGINATES
+        (HTTP PUT, remote fetch). Writes that carry a record verbatim
+        (replica heal, tail receive — stamp=False) or already tagged
+        records keep their original causality; empty bodies can't carry
+        pairs and tombstone-wins needs no tag anyway. _lock held."""
+        if not stamp or not n.data or self.epoch_stamper is None:
+            return
+        from .epoch import tags_enabled
+
+        if not tags_enabled() or n.replica_epoch() is not None:
+            return
+        n.set_replica_epoch_tag(self.epoch_stamper.tag_for(self))
+
+    def write_needle(self, n: Needle, check_cookie: bool = True,
+                     stamp: bool = True) -> tuple[int, int, bool]:
         """Append a needle (doWriteRequest, volume_write.go:127-176).
         -> (offset_bytes, size, is_unchanged). Acknowledged only after
         the record's bytes reached the OS (group-commit flush)."""
@@ -541,6 +562,7 @@ class Volume:
                 raise IOError(f"volume {self.id} is frozen: a previous "
                               f"group-commit flush failed")
             if self.native is not None:
+                self._maybe_stamp_epoch(n, stamp)
                 return self._native_write(n, check_cookie)
             unchanged = self._is_file_unchanged(n)
             if unchanged:
@@ -564,6 +586,7 @@ class Volume:
                     if existing.cookie != n.cookie:
                         raise CookieMismatch(
                             f"mismatching cookie {n.cookie:x}")
+                self._maybe_stamp_epoch(n, stamp)
                 n.update_append_at_ns(self.last_append_at_ns)
                 offset = self._append_record(n)
                 self.last_append_at_ns = n.append_at_ns

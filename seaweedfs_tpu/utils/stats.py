@@ -480,6 +480,18 @@ SCRUB_PACE_WAIT_SECONDS = Counter(
 SCRUB_BACKOFFS = Counter(
     "SeaweedFS_scrub_backoffs",
     "Times the scrubber backed off because foreground QPS was high.")
+SCRUB_SKIPPED_PAIRS = Counter(
+    "SeaweedFS_scrub_skipped_pairs",
+    "Anti-entropy replica pairs skipped because the peer's VolumeDigest "
+    "probe failed after retry — partial sweep coverage made visible.")
+SCRUB_GATHER_BYTES = Counter(
+    "SeaweedFS_scrub_gather_bytes",
+    "Remote survivor-range bytes fetched by cross-server syndrome verify "
+    "by phase (live/resume) — bounded by the geometry's repair plan.")
+SCRUB_GATHER_RESUMES = Counter(
+    "SeaweedFS_scrub_gather_resumes",
+    "Peer-flap resumes during cross-server syndrome gathers (only the "
+    "missing ranges are re-fetched).")
 
 
 # -- QoS / admission plane (ISSUE 8): per-tenant ingress admission,
@@ -666,6 +678,12 @@ def scrub_stats() -> dict:
         "findings": {}, "repairs": {},
         "paceWaitSeconds": round(SCRUB_PACE_WAIT_SECONDS.value(), 3),
         "backoffs": int(SCRUB_BACKOFFS.value()),
+        "skippedPairs": int(SCRUB_SKIPPED_PAIRS.value()),
+        "gather": {
+            "bytes": {p: int(SCRUB_GATHER_BYTES.value(phase=p))
+                      for p in ("live", "resume")},
+            "resumes": int(SCRUB_GATHER_RESUMES.value()),
+        },
     }
     for kind in ("needle_crc", "ec_parity", "replica_divergence"):
         out["findings"][_CAMEL[kind]] = {

@@ -1189,3 +1189,296 @@ def test_lrc_degraded_reads_and_scrub_heals_group_and_global_loss(
     for fid in same_fid:
         got = requests.get(f"http://{vsrv.address}/{fid}", timeout=60)
         assert got.status_code == 200 and got.content == blobs[fid]
+
+
+# -- cluster integrity fabric (ISSUE 13): cross-server syndrome verify ------
+#    + per-needle causality
+
+def test_read_corrupt_failpoint_injects_on_the_wire(chaos_cluster):
+    """`volume.http.read.corrupt` flips a served needle's first data
+    byte AFTER storage verification — wire/NIC rot the storage CRCs
+    cannot see. Pin that the hook actually fires (and stops when
+    disarmed) so the chaos registry never carries a dead site."""
+    master, volumes, fsrv = chaos_cluster
+    payload = b"wire-rot " * 200
+    fid = _assign_put_both(master, volumes, payload)
+    target = next(v for v in volumes
+                  if v.store.has_volume(parse_file_id(fid).volume_id))
+    with failpoint.active("volume.http.read.corrupt", mode="corrupt",
+                          p=1.0, match=target.address + ",") as fp:
+        got = requests.get(f"http://{target.address}/{fid}", timeout=30)
+        assert got.status_code == 200
+        assert got.content != payload, "corruption never injected"
+        assert got.content[1:] == payload[1:]  # exactly one byte flipped
+        assert fp.hits > 0
+    got = requests.get(f"http://{target.address}/{fid}", timeout=30)
+    assert got.content == payload  # disarmed: clean bytes again
+
+
+def test_cross_server_scrub_flap_resume_and_remote_rot_heal(
+        chaos_cluster, tmp_path):
+    """ISSUE-13 acceptance: an EC volume whose shards are split THREE
+    ways (no holder has k data shards) is cross-server
+    syndrome-verified, not skipped. One peer flaps mid-gather — the
+    resume re-fetches ONLY the missing ranges (exact byte accounting).
+    Then rot planted on a REMOTE shard is detected, pinned, rebuilt
+    from cross-server survivors and re-verified to convergence, with
+    concurrent readers seeing zero errors throughout."""
+    import threading as _threading
+
+    from seaweedfs_tpu.pb import ec_geometry_pb2 as eg
+    from seaweedfs_tpu.server.volume import VolumeServer
+    from seaweedfs_tpu.storage.file_id import format_needle_id_cookie
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.utils.stats import (
+        SCRUB_GATHER_BYTES,
+        SCRUB_GATHER_RESUMES,
+        SCRUB_REPAIRS,
+    )
+
+    master, volumes, _ = chaos_cluster
+    a, b = volumes
+    c = VolumeServer(directories=[str(tmp_path / "volC")],
+                     master=master.address, ip="localhost",
+                     port=_free_port(), pulse_seconds=1,
+                     ec_geometry=TEST_GEO)
+    c.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and len(master.topo.nodes) < 3:
+            time.sleep(0.05)
+        assert len(master.topo.nodes) == 3
+
+        # --- stage: volume on A, EC'd, shards split A:0-4 B:5-9 C:10-13
+        vid = 7801
+        v = a.store.add_volume(vid)
+        rng = np.random.default_rng(42)
+        blobs = {}
+        for i in range(1, 31):
+            # enough bytes that each shard spans several 4KB gather
+            # slabs — the mid-stream flap needs a window boundary to
+            # kill and a tail for the resume to re-fetch
+            data = rng.integers(0, 256, size=int(rng.integers(2000, 6000)),
+                                dtype=np.uint8).tobytes()
+            v.write_needle(Needle.create(i, 0xABC, data))
+            blobs[i] = data
+        a.trigger_heartbeat()
+        stub_a = rpc.volume_stub(rpc.grpc_address(a.address))
+        stub_b = rpc.volume_stub(rpc.grpc_address(b.address))
+        stub_c = rpc.volume_stub(rpc.grpc_address(c.address))
+        stub_a.VolumeMarkReadonly(
+            vs.VolumeMarkReadonlyRequest(volume_id=vid), timeout=30)
+        stub_a.VolumeEcShardsGenerate(
+            eg.EcGenerateRequest(volume_id=vid), timeout=120)
+        for stub, sids in ((stub_b, list(range(5, 10))),
+                          (stub_c, list(range(10, 14)))):
+            stub.VolumeEcShardsCopy(
+                vs.VolumeEcShardsCopyRequest(
+                    volume_id=vid, shard_ids=sids, copy_ecx_file=True,
+                    copy_vif_file=True, source_data_node=a.address),
+                timeout=120)
+        stub_a.VolumeUnmount(vs.VolumeUnmountRequest(volume_id=vid),
+                             timeout=30)
+        stub_a.VolumeEcShardsDelete(
+            vs.VolumeEcShardsDeleteRequest(volume_id=vid,
+                                           shard_ids=list(range(5, 14))),
+            timeout=30)
+        stub_a.VolumeEcShardsMount(
+            vs.VolumeEcShardsMountRequest(volume_id=vid,
+                                          shard_ids=list(range(5))),
+            timeout=30)
+        stub_b.VolumeEcShardsMount(
+            vs.VolumeEcShardsMountRequest(volume_id=vid,
+                                          shard_ids=list(range(5, 10))),
+            timeout=30)
+        stub_c.VolumeEcShardsMount(
+            vs.VolumeEcShardsMountRequest(volume_id=vid,
+                                          shard_ids=list(range(10, 14))),
+            timeout=30)
+        deadline = time.time() + 15
+        while time.time() < deadline and \
+                len(master.topo.lookup_ec_shards(vid) or {}) != 14:
+            time.sleep(0.2)
+        assert len(master.topo.lookup_ec_shards(vid) or {}) == 14
+        ev_c = c.store.find_ec_volume(vid)
+        assert sorted(ev_c.shard_files) == [10, 11, 12, 13]
+        shard_size = ev_c.shard_size
+        c.scrubber.ec_slab = 4096  # several gather windows per shard
+
+        def read_all(server):
+            for i, data in blobs.items():
+                fid = f"{vid},{format_needle_id_cookie(i, 0xABC)}"
+                got = requests.get(f"http://{server.address}/{fid}",
+                                   timeout=60)
+                assert got.status_code == 200, (fid, got.status_code)
+                assert got.content == data, fid
+
+        # --- phase 1: clean cross-server verify with a mid-gather flap.
+        # C's 4 parity targets plan k=10 reads -> shards 0..9 gathered.
+        flap_off = 4096  # one gather-slab boundary into each stream
+        assert shard_size > flap_off + 4096, shard_size
+        live0 = SCRUB_GATHER_BYTES.value(phase="live")
+        res0 = SCRUB_GATHER_BYTES.value(phase="resume")
+        n_res0 = SCRUB_GATHER_RESUMES.value()
+        with failpoint.active("scrub.gather.range", p=1.0, count=1,
+                              match=f"off={flap_off},") as fp:
+            report = c.scrubber.run_once(vid=vid, full=True)
+            assert fp.hits == 1, "gather flap never fired — vacuous"
+        assert [f.detail for f in report.findings] == []
+        live_d = SCRUB_GATHER_BYTES.value(phase="live") - live0
+        res_d = SCRUB_GATHER_BYTES.value(phase="resume") - res0
+        assert SCRUB_GATHER_RESUMES.value() - n_res0 == 1
+        # resume re-fetched ONLY the missing tail of the flapped stream
+        assert res_d == shard_size - flap_off, (res_d, shard_size)
+        # and nothing was moved twice: live + resume == exactly the
+        # 10-shard plan's worth of ranges
+        assert live_d + res_d == 10 * shard_size, (live_d, res_d)
+
+        # --- phase 2: rot on a shard REMOTE from the scrubbing holder
+        ev_a = a.store.find_ec_volume(vid)
+        rot_path = ev_a.geo.shard_file_name(ev_a.base, 3)
+        with open(rot_path, "r+b") as fh:
+            fh.seek(57)
+            orig = fh.read(1)
+            fh.seek(-1, 1)
+            fh.write(bytes([orig[0] ^ 0x5A]))
+
+        errs = []
+        stop_readers = _threading.Event()
+
+        def reader():
+            while not stop_readers.is_set():
+                try:
+                    read_all(c)
+                except BaseException:
+                    import traceback
+
+                    errs.append(traceback.format_exc())
+                    return
+
+        ths = [_threading.Thread(target=reader) for _ in range(2)]
+        for t in ths:
+            t.start()
+        try:
+            rep0 = SCRUB_REPAIRS.value(method="ec_rebuild", outcome="ok")
+            report = c.scrubber.run_once(vid=vid, full=True)
+        finally:
+            stop_readers.set()
+            for t in ths:
+                t.join()
+        assert not errs, errs[0]
+        culprits = [(f.shard_id, f.state) for f in report.findings
+                    if f.kind == "ec_parity"]
+        assert (3, "repaired") in culprits, culprits
+        assert SCRUB_REPAIRS.value(method="ec_rebuild",
+                                   outcome="ok") > rep0
+        # the verified rebuild MIGRATED to the scrubbing holder and the
+        # rotten remote copy is gone
+        assert 3 in c.store.find_ec_volume(vid).shard_files
+        assert not os.path.exists(rot_path)
+
+        # --- converged: a fresh cross-server sweep is clean, reads are
+        # correct from every holder
+        r2 = c.scrubber.run_once(vid=vid, full=True)
+        assert not [f for f in r2.findings if f.kind == "ec_parity"], \
+            r2.findings
+        read_all(c)
+        read_all(b)
+    finally:
+        c.stop()
+
+
+def test_same_timestamp_conflict_autoresolves_via_epoch_tags(
+        chaos_cluster):
+    """ISSUE-13 acceptance (tentpole b): a same-`append_at_ns` dual
+    write — the one divergence class PR-4 surfaced to operators —
+    converges with NO failed finding: the replica-epoch total order
+    picks the same winner on both sides, readers see zero errors, and
+    the digests land identical."""
+    import threading as _threading
+
+    from seaweedfs_tpu.pb import scrub_pb2
+    from seaweedfs_tpu.storage import types as _types
+
+    master, volumes, _ = chaos_cluster
+    base_payload = b"conflict base " * 300
+    fid = _assign_put_both(master, volumes, base_payload)
+    f = parse_file_id(fid)
+    vid = f.volume_id
+    primary = next(v for v in volumes if v.store.has_volume(vid))
+    other = next(v for v in volumes if v is not primary)
+
+    # dual write: each replica accepts a DIFFERENT body with no fan-out
+    v2a = b"conflict wins A " * 300
+    v2b = b"conflict wins B " * 300
+    for srv, body in ((primary, v2a), (other, v2b)):
+        r = requests.put(f"http://{srv.address}/{fid}?type=replicate",
+                         data=body, timeout=30)
+        assert r.status_code in (200, 201), r.text
+
+    # force the unorderable case: patch both records' append_at_ns to
+    # the SAME value on disk (the v3 tail: crc(4) then ns(8))
+    same_ns = 7_000_000_000_000_000_000
+    tags = []
+    for srv in (primary, other):
+        v = srv.store.find_volume(vid)
+        with v._lock:
+            v._sync_buffers()
+        nv = v.nm.get(f.key)
+        off = _types.stored_to_actual_offset(nv.offset)
+        with open(v.file_name() + ".dat", "r+b") as fh:
+            fh.seek(off + _types.NEEDLE_HEADER_SIZE + nv.size
+                    + _types.NEEDLE_CHECKSUM_SIZE)
+            fh.write(same_ns.to_bytes(8, "big"))
+        n = v.read_needle(f.key)
+        assert n.append_at_ns == same_ns
+        assert n.replica_epoch() is not None, \
+            "conflicting write carries no causality tag"
+        tags.append(n.replica_epoch())
+    assert tags[0] != tags[1]
+
+    # readers during the heal: zero errors, always one of the variants
+    errs, stop_readers = [], _threading.Event()
+
+    def reader(addr):
+        while not stop_readers.is_set():
+            try:
+                got = requests.get(f"http://{addr}/{fid}", timeout=30)
+                assert got.status_code == 200
+                assert got.content in (v2a, v2b)
+            except BaseException:
+                import traceback
+
+                errs.append(traceback.format_exc())
+                return
+
+    ths = [_threading.Thread(target=reader, args=(v.address,))
+           for v in volumes]
+    for t in ths:
+        t.start()
+    try:
+        report = primary.scrubber.run_once(vid=vid)
+    finally:
+        stop_readers.set()
+        for t in ths:
+            t.join()
+    assert not errs, errs[0]
+
+    # the conflict resolved WITHOUT an operator-facing failure
+    div = [x for x in report.findings if x.kind == "replica_divergence"]
+    assert div, "divergence never detected"
+    assert all(x.state == "repaired" for x in div), \
+        [(x.state, x.detail) for x in div]
+
+    # both replicas converged on the SAME winner, deterministically
+    got_a = requests.get(f"http://{primary.address}/{fid}", timeout=30)
+    got_b = requests.get(f"http://{other.address}/{fid}", timeout=30)
+    assert got_a.content == got_b.content
+    assert got_a.content in (v2a, v2b)
+    digests = set()
+    for srv in volumes:
+        stub = rpc.volume_stub(rpc.grpc_address(srv.address))
+        d = stub.VolumeDigest(scrub_pb2.VolumeDigestRequest(volume_id=vid),
+                              timeout=30)
+        digests.add((d.rolling_crc, d.needle_count))
+    assert len(digests) == 1, f"replicas still diverge: {digests}"

@@ -89,30 +89,62 @@ def test_combine_folds_chunked_shard_digest():
 # -- digest manifests -------------------------------------------------------
 
 def test_digest_manifest_format_golden():
-    """The on-disk manifest format is an anti-entropy wire contract —
-    pin it byte-for-byte so a silent format change cannot make every
+    """The on-disk manifest format (rev 2, ISSUE 13: entries carry the
+    replica-epoch causality tag) is an anti-entropy wire contract — pin
+    it byte-for-byte so a silent format change cannot make every
     replica pair look divergent (or worse, identical)."""
     entries = [
-        digest_mod.DigestEntry(1, 0x11223344, 100),
-        digest_mod.DigestEntry(0xDEADBEEF, 0x55667788, 2049),
+        digest_mod.DigestEntry(1, 0x11223344, 100,
+                               epoch=(2, 7, 0xCAFEBABE)),
+        digest_mod.DigestEntry(0xDEADBEEF, 0x55667788, 2049),  # pre-epoch
         digest_mod.DigestEntry(0x1_0000_0001, 0, -1),  # tombstone
     ]
     blob = digest_mod.manifest_bytes(entries)
     assert blob.hex() == (
-        "535746534447310a"              # magic "SWFSDG1\n"
+        "535746534447320a"              # magic "SWFSDG2\n"
         "0000000000000003"              # count
-        "00000000000000011122334400000064"
+        "00000000000000011122334400000064"      # id crc size
+        "0000000000000002" "0000000000000007" "cafebabe"  # epoch
         "00000000deadbeef5566778800000801"
-        "00000001000000010000000" "0ffffffff")
-    # rolling digest covers LIVE entries only: deletion history may
-    # differ between converged replicas (vacuum, delete of a never-held
-    # id), so tombstones stay in the manifest for resurrection-prevention
-    # but out of the cheap equality check
-    live = blob[16:16 + 2 * digest_mod.ENTRY_SIZE]
+        "0000000000000000" "0000000000000000" "00000000"  # pre-epoch
+        "00000001000000010000000" "0ffffffff"
+        "0000000000000000" "0000000000000000" "00000000")
+    # rolling digest covers the 16-byte rev-1 PROJECTION of LIVE entries
+    # only — the epoch is excluded by design (replicas stamp the same
+    # logical write with different tags; folding them in would flag
+    # every converged pair as divergent forever), and deletion history
+    # may differ between converged replicas (vacuum, delete of a
+    # never-held id), so tombstones stay in the manifest for
+    # resurrection-prevention but out of the cheap equality check
+    live = (blob[16:16 + 16]
+            + blob[16 + digest_mod.ENTRY_SIZE:16 + digest_mod.ENTRY_SIZE
+                   + 16])
     assert digest_mod.rolling_digest(entries) == crc32c(live)
     assert digest_mod.rolling_digest([]) == 0
     assert digest_mod.rolling_digest(
         [digest_mod.DigestEntry(7, 0, -1)]) == 0  # tombstone-only == empty
+
+
+def test_digest_manifest_v1_still_parses(tmp_path):
+    """Pre-ISSUE-13 `.dig` files (rev 1, 16-byte entries) must keep
+    parsing after an upgrade — their entries simply carry no epoch."""
+    v1 = bytes.fromhex(
+        "535746534447310a"              # magic "SWFSDG1\n"
+        "0000000000000002"              # count
+        "00000000000000011122334400000064"
+        "00000001000000010000000" "0ffffffff")
+    path = str(tmp_path / "old.dig")
+    with open(path, "wb") as f:
+        f.write(v1)
+    got = digest_mod.read_manifest(path)
+    assert got == [
+        digest_mod.DigestEntry(1, 0x11223344, 100),
+        digest_mod.DigestEntry(0x1_0000_0001, 0, -1),
+    ]
+    assert all(e.epoch is None for e in got)
+    # and the rolling digest of the parsed entries matches what a rev-1
+    # reader would have computed (the projection is the rev-1 entry)
+    assert digest_mod.rolling_digest(got) == crc32c(v1[16:32])
 
 
 def test_digest_manifest_roundtrip(tmp_path):
@@ -642,6 +674,281 @@ def test_vacuum_catches_planted_corruption_and_aborts(tmp_path):
         os.environ.pop("SWFS_VACUUM_VERIFY", None)
     assert v.read_needle(1).data == blobs[1]
     st.close()
+
+
+# -- replica-epoch causality tags (ISSUE 13 tentpole b) ----------------------
+
+def test_epoch_tag_roundtrip_restart_and_vacuum(tmp_path):
+    """Replica-epoch tags are stamped at store-write time and survive a
+    server restart AND a vacuum's compaction-revision bump byte-for-byte
+    (the tag rides the pairs extension, which compaction copies)."""
+    from seaweedfs_tpu.storage import epoch as epoch_mod
+
+    st = Store([str(tmp_path)])
+    v = st.add_volume(1)
+    v.write_needle(Needle.create(1, 0xA, b"causality " * 40))
+    v.write_needle(Needle.create(2, 0xB, b"second"))
+    tag = v.read_needle(1).replica_epoch()
+    assert tag is not None
+    inc, seq, srv = tag
+    assert inc == st.epoch_stamper.incarnation
+    assert srv == st.epoch_stamper.server_crc
+    # sequence advances per write within the volume
+    assert v.read_needle(2).replica_epoch()[1] > seq
+    # the digest entries carry the tag (one bounded pread recovers it)
+    by_id = {e.needle_id: e for e in digest_mod.volume_digest_entries(v)}
+    assert by_id[1].epoch == tag
+    st.close()
+
+    # restart: the incarnation bumps, but STORED tags are immutable
+    st2 = Store([str(tmp_path)])
+    assert st2.epoch_stamper.incarnation == inc + 1
+    v2 = st2.find_volume(1)
+    assert v2.read_needle(1).replica_epoch() == tag
+    # a write in the new incarnation outranks every old-incarnation one
+    v2.write_needle(Needle.create(3, 0xC, b"new era"))
+    newer = v2.read_needle(3).replica_epoch()
+    assert epoch_mod.order_key(newer) > epoch_mod.order_key(tag)
+    assert epoch_mod.order_key(tag) > epoch_mod.order_key(None)  # pre-epoch
+
+    # vacuum: revision bump, offsets rewritten — tags intact
+    v2.delete_needle(2)
+    v2.compact()
+    v2.commit_compact()
+    assert v2.super_block.compaction_revision == 1
+    assert v2.read_needle(1).replica_epoch() == tag
+    assert v2.read_needle(3).replica_epoch() == newer
+    st2.close()
+
+
+def test_epoch_tag_codec_and_strip():
+    from seaweedfs_tpu.storage import epoch as epoch_mod
+
+    tag = epoch_mod.encode_tag(3, 99, 0xDEADBEEF)
+    assert len(tag) == epoch_mod.TAG_LEN
+    assert epoch_mod.decode_tag_block(tag) == (3, 99, 0xDEADBEEF)
+    assert epoch_mod.decode_tag_block(b"x" * epoch_mod.TAG_LEN) is None
+    assert epoch_mod.decode_pairs(b"user-pairs" + tag) == (3, 99, 0xDEADBEEF)
+    assert epoch_mod.strip_pairs(b"user-pairs" + tag) == b"user-pairs"
+    assert epoch_mod.strip_pairs(b"user-pairs") == b"user-pairs"
+    # re-stamping replaces, never accumulates
+    n = Needle.create(1, 0xA, b"data")
+    n.set_replica_epoch_tag(tag)
+    n.set_replica_epoch_tag(epoch_mod.encode_tag(4, 1, 2))
+    assert n.replica_epoch() == (4, 1, 2)
+    assert n.pairs.count(epoch_mod.MAGIC) == 1
+
+
+def test_epoch_tags_ride_replication_fanout(scrub_cluster):
+    """Each replica stamps its OWN tag on the fanned-out write, with a
+    fixed width — record sizes stay equal across replicas, so the
+    digest plane sees a converged pair (rolling CRCs agree) while every
+    copy still carries a valid causality tag."""
+    master, volumes = scrub_cluster
+    fid = _put_replicated(master, volumes, b"epoch-fanout " * 200)
+    f = parse_file_id(fid)
+    tags = []
+    sizes = []
+    for vsrv in volumes:
+        v = vsrv.store.find_volume(f.volume_id)
+        n = v.read_needle(f.key)
+        assert n.replica_epoch() is not None, vsrv.address
+        tags.append(n.replica_epoch())
+        sizes.append(v.nm.get(f.key).size)
+    assert len(set(sizes)) == 1, f"replica record sizes diverge: {sizes}"
+    assert tags[0][2] != tags[1][2], "server identity must differ"
+    digests = set()
+    for vsrv in volumes:
+        stub = rpc.volume_stub(rpc.grpc_address(vsrv.address))
+        d = stub.VolumeDigest(
+            scrub_pb2.VolumeDigestRequest(volume_id=f.volume_id),
+            timeout=30)
+        digests.add((d.rolling_crc, d.needle_count))
+    assert len(digests) == 1, f"tags made replicas look divergent: {digests}"
+    # entries expose the epoch over the RPC
+    stub = rpc.volume_stub(rpc.grpc_address(volumes[0].address))
+    d = stub.VolumeDigest(scrub_pb2.VolumeDigestRequest(
+        volume_id=f.volume_id, include_entries=True), timeout=30)
+    e = next(e for e in d.entries if e.needle_id == f.key)
+    assert (e.epoch_incarnation, e.epoch_seq, e.epoch_server) == tags[0]
+
+
+# -- cross-server syndrome verify (ISSUE 13 tentpole a) ----------------------
+
+def _stage_split_lrc_volume(master, volumes, vid):
+    """An lrc_10_2_2 EC volume with shard 10 (a LOCAL parity) alone on
+    volumes[1] and everything else on volumes[0] — the shape where the
+    cross-server verify's plan budget shows: verifying shard 10 needs
+    its 5-shard local group, never k=10."""
+    from seaweedfs_tpu.pb import ec_geometry_pb2 as eg
+    from seaweedfs_tpu.storage.needle import Needle as _N
+
+    src, dst = volumes
+    v = src.store.add_volume(vid)
+    rng = np.random.default_rng(vid)
+    for i in range(1, 25):
+        data = rng.integers(0, 256, size=int(rng.integers(200, 2000)),
+                            dtype=np.uint8).tobytes()
+        v.write_needle(_N.create(i, 0xABC, data))
+    src.trigger_heartbeat()
+    stub_src = rpc.volume_stub(rpc.grpc_address(src.address))
+    stub_dst = rpc.volume_stub(rpc.grpc_address(dst.address))
+    stub_src.VolumeMarkReadonly(
+        vs.VolumeMarkReadonlyRequest(volume_id=vid), timeout=30)
+    stub_src.VolumeEcShardsGenerate(
+        eg.EcGenerateRequest(volume_id=vid, geometry="lrc_10_2_2"),
+        timeout=120)
+    stub_dst.VolumeEcShardsCopy(
+        vs.VolumeEcShardsCopyRequest(
+            volume_id=vid, shard_ids=[10], copy_ecx_file=True,
+            copy_vif_file=True, source_data_node=src.address),
+        timeout=120)
+    stub_src.VolumeUnmount(vs.VolumeUnmountRequest(volume_id=vid),
+                           timeout=30)
+    stub_src.VolumeEcShardsDelete(
+        vs.VolumeEcShardsDeleteRequest(volume_id=vid, shard_ids=[10]),
+        timeout=30)
+    stub_src.VolumeEcShardsMount(
+        vs.VolumeEcShardsMountRequest(
+            volume_id=vid, shard_ids=[i for i in range(14) if i != 10]),
+        timeout=30)
+    stub_dst.VolumeEcShardsMount(
+        vs.VolumeEcShardsMountRequest(volume_id=vid, shard_ids=[10]),
+        timeout=30)
+    deadline = time.time() + 15
+    while time.time() < deadline:
+        if len(master.topo.lookup_ec_shards(vid) or {}) == 14:
+            break
+        time.sleep(0.2)
+    assert len(master.topo.lookup_ec_shards(vid) or {}) == 14
+
+
+def test_cross_server_syndrome_verify_fetches_plan_not_k(scrub_cluster):
+    """Acceptance: a split EC volume is syndrome-verified, never
+    skipped — and the holder of LRC local parity 10 gathers exactly its
+    5-shard local group's ranges (5x shard size), not k=10."""
+    from seaweedfs_tpu.utils.stats import (
+        SCRUB_GATHER_BYTES,
+        SCRUB_SWEEPS,
+    )
+
+    master, volumes = scrub_cluster
+    vid = 7701
+    _stage_split_lrc_volume(master, volumes, vid)
+    dst = volumes[1]
+    ev = dst.store.find_ec_volume(vid)
+    assert ev is not None and sorted(ev.shard_files) == [10]
+    shard_size = ev.shard_size
+    g0 = SCRUB_GATHER_BYTES.value(phase="live")
+    s0 = SCRUB_SWEEPS.value(kind="ec")
+    report = dst.scrubber.run_once(vid=vid, full=True)
+    assert [f.detail for f in report.findings] == []
+    fetched = SCRUB_GATHER_BYTES.value(phase="live") - g0
+    # the plan budget: shard 10 = XOR of data 0..4 — five shards'
+    # ranges cross the wire, not ten (the acceptance assertion)
+    assert fetched == 5 * shard_size, (fetched, shard_size)
+    assert SCRUB_SWEEPS.value(kind="ec") == s0 + 1
+    # verified bytes cover gathered + local rows
+    assert report.bytes == 6 * shard_size
+    # the clean sweep folded whole-shard digests for the LOCAL shard —
+    # VolumeDigest answers from them
+    stub = rpc.volume_stub(rpc.grpc_address(dst.address))
+    d = stub.VolumeDigest(scrub_pb2.VolumeDigestRequest(volume_id=vid),
+                          timeout=30)
+    assert d.is_ec
+    assert [s.shard_id for s in d.shard_digests] == [10]
+
+
+def test_ec_shards_read_rpc_streams_verified_slabs(scrub_cluster):
+    """The VolumeEcShardsRead gather transport: chunked, CRC-stamped,
+    offset-addressed slabs that reassemble to the exact shard bytes."""
+    from seaweedfs_tpu.pb import ec_gather_pb2 as eg
+
+    master, volumes = scrub_cluster
+    vid = 7702
+    _stage_split_lrc_volume(master, volumes, vid)
+    src = volumes[0]
+    ev = src.store.find_ec_volume(vid)
+    want = ev.shard_files[3].read_at(0, ev.shard_size)
+    want += b"\0" * (ev.shard_size - len(want))
+    stub = rpc.volume_stub(rpc.grpc_address(src.address))
+    req = eg.VolumeEcShardsReadRequest(volume_id=vid, slab=512)
+    req.ranges.add(shard_id=3, offset=0, size=0)  # 0 = whole shard
+    buf = bytearray()
+    offsets = []
+    for resp in stub.VolumeEcShardsRead(req, timeout=60):
+        assert resp.shard_id == 3
+        assert crc32c(resp.data) == resp.crc  # transit CRC holds
+        assert len(resp.data) <= 512
+        offsets.append(resp.offset)
+        buf += resp.data
+    assert bytes(buf) == want
+    assert offsets == sorted(offsets)
+    # offset-addressed resume: a mid-shard start returns the suffix
+    req2 = eg.VolumeEcShardsReadRequest(volume_id=vid, slab=512)
+    req2.ranges.add(shard_id=3, offset=1024, size=0)
+    tail = b"".join(bytes(r.data)
+                    for r in stub.VolumeEcShardsRead(req2, timeout=60))
+    assert tail == want[1024:]
+
+
+# -- anti-entropy hardening satellites (ISSUE 13) ----------------------------
+
+def test_anti_entropy_counts_skipped_pairs_and_retries_probe(scrub_cluster):
+    """A peer whose VolumeDigest probe dies is retried once through
+    utils/retry; a persistent failure is COUNTED as a skipped pair (the
+    old code swallowed it with a bare `continue`), while a one-shot
+    flap is absorbed by the retry and skips nothing."""
+    from seaweedfs_tpu.utils import failpoint
+    from seaweedfs_tpu.utils.stats import SCRUB_SKIPPED_PAIRS
+
+    master, volumes = scrub_cluster
+    fid = _put_replicated(master, volumes, b"skip-pair " * 300)
+    vid = parse_file_id(fid).volume_id
+    primary = next(v for v in volumes if v.store.has_volume(vid))
+    other = next(v for v in volumes if v is not primary)
+    peer_grpc = rpc.grpc_address(other.address)
+    c0 = SCRUB_SKIPPED_PAIRS.value()
+    # persistent probe death -> the pair is skipped AND counted
+    with failpoint.active("pb.VolumeDigest", p=1.0,
+                          match=peer_grpc + ","):
+        report = primary.scrubber.run_anti_entropy(vid=vid)
+    assert report.skipped_pairs >= 1
+    assert SCRUB_SKIPPED_PAIRS.value() > c0
+    # a single flap is absorbed by the retry: nothing skipped
+    with failpoint.active("pb.VolumeDigest", p=1.0, count=1,
+                          match=peer_grpc + ",") as fp:
+        report = primary.scrubber.run_anti_entropy(vid=vid)
+        assert fp.hits == 1, "flap never fired — retry test is vacuous"
+    assert report.skipped_pairs == 0
+
+
+def test_heal_rides_retry_when_needle_fetch_flaps(scrub_cluster):
+    """`_heal_divergence` no longer gives up on the first failed
+    fetch_verified_needle: the fetch rides multi_retry, so a one-shot
+    peer flap mid-heal still converges the pair."""
+    import requests as _rq
+
+    from seaweedfs_tpu.utils import failpoint
+
+    master, volumes = scrub_cluster
+    payload = b"heal-retry v1 " * 300
+    fid = _put_replicated(master, volumes, payload)
+    vid = parse_file_id(fid).volume_id
+    primary = next(v for v in volumes if v.store.has_volume(vid))
+    other = next(v for v in volumes if v is not primary)
+    # diverge: rewrite the fid on the primary only (no fan-out)
+    r = _rq.put(f"http://{primary.address}/{fid}?type=replicate",
+                data=b"heal-retry V2 " * 300, timeout=30)
+    assert r.status_code in (200, 201)
+    peer_grpc = rpc.grpc_address(other.address)
+    with failpoint.active("pb.ReadNeedleBlob", p=1.0, count=1,
+                          match=peer_grpc + ",") as fp:
+        report = primary.scrubber.run_once(vid=vid)
+        assert fp.hits == 1, "fetch flap never fired — test is vacuous"
+    div = [f for f in report.findings if f.kind == "replica_divergence"]
+    assert div and all(f.state == "repaired" for f in div), \
+        [(f.state, f.detail) for f in div]
 
 
 def test_midsweep_cursor_save_cannot_clobber_vacuum_publication(tmp_path):
