@@ -1719,6 +1719,41 @@ def _bench_cluster_qos_ab() -> dict:
         return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def _bench_bigfile_ab() -> dict:
+    """ISSUE-14 pipelined chunk path A/B (tools/cluster_harness.py
+    --bigfile-ab): >=8-chunk GET/PUT through a real multi-process
+    cluster with symmetric per-chunk wire latency, chunk pipeline off
+    vs on at identical offered rates, plus the PR-2-shape small-file
+    no-regression segment. Subprocess with a hard timeout and last-JSON
+    salvage (the wedged-child guard pattern)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(_HERE, "tools", "cluster_harness.py"),
+             "--bigfile-ab", "--duration",
+             os.environ.get("SEAWEEDFS_TPU_BIGFILEAB_DURATION", "10"),
+             "--rounds",
+             os.environ.get("SEAWEEDFS_TPU_BIGFILEAB_ROUNDS", "2")],
+            cwd=_HERE, capture_output=True, text=True,
+            timeout=float(os.environ.get(
+                "SEAWEEDFS_TPU_BIGFILEAB_TIMEOUT", "1200")))
+        out = _last_json_line(proc.stdout)
+        if out is not None:
+            return out
+        return {"error": f"rc={proc.returncode}: {proc.stderr[-300:]}"}
+    except subprocess.TimeoutExpired as e:
+        so = e.stdout
+        if isinstance(so, bytes):
+            so = so.decode(errors="replace")
+        out = _last_json_line(so or "")
+        if out is not None:
+            out["note"] = "harness timed out after printing results"
+            return out
+        return {"error": "bigfile A/B timed out"}
+    except Exception as e:  # never let the secondary hurt the headline
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 # Tracing-overhead A/B (ISSUE 7): the tracing plane must be cheap
 # enough to leave ON. One live cluster, MANY short segments alternating
 # SWFS_TRACE=1/0 IN-PROCESS (trace.enabled() re-reads the env per
@@ -2598,6 +2633,17 @@ def main() -> int:
             json.dump(out, f, indent=1)
         print(json.dumps(out))
         return 0 if "qos_on" in out else 1
+    if "--bigfile-ab" in sys.argv:
+        # standalone pipelined-chunk-path A/B (ISSUE 14): large-object
+        # GET/PUT wall with readahead/overlap off vs on under symmetric
+        # per-chunk wire latency; prints the BENCH_AB_ISSUE14.json
+        # artifact content and writes the artifact
+        out = _bench_bigfile_ab()
+        with open(os.path.join(_HERE, "BENCH_AB_ISSUE14.json"),
+                  "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps(out))
+        return 0 if out.get("get_median_delta_pct") is not None else 1
     if "--repair-ab" in sys.argv:
         # standalone repair-bandwidth A/B (ISSUE 11): rs_10_4 vs
         # lrc_10_2_2 single-shard repair bytes read / repair wall /
@@ -2712,6 +2758,16 @@ def main() -> int:
             result["cluster_qos"] = qab
         else:
             result["cluster_qos_error"] = qab.get("error", "?")[:200]
+    if os.environ.get("SEAWEEDFS_TPU_BIGFILEAB", "0").lower() in (
+            "1", "true", "on"):
+        # pipelined chunk-path A/B (ISSUE 14): OFF by default — it
+        # spawns a multi-process cluster per arm (~3-4 min); enable
+        # explicitly or run `bench.py --bigfile-ab` standalone
+        bab = _bench_bigfile_ab()
+        if bab.get("get_median_delta_pct") is not None:
+            result["bigfile_pipeline"] = bab
+        else:
+            result["bigfile_pipeline_error"] = bab.get("error", "?")[:200]
     probe = _await_device_probe()
     if "timeout" in probe:
         # the tunnel is wedged RIGHT NOW: attempting the device bench

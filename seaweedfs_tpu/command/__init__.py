@@ -79,6 +79,8 @@ def main(argv=None) -> int:
                     help="metadata store kind (memory|sqlite|leveldb|...)")
     fp.add_argument("-peers", default="",
                     help="comma-separated peer filers for HA aggregation")
+    fp.add_argument("-maxMB", type=int, default=4,
+                    help="split files into chunks of this many MB")
 
     s3p = sub.add_parser("s3", help="run an S3 gateway")
     s3p.add_argument("-port", type=int, default=8333)
@@ -402,6 +404,7 @@ def _run(opts) -> int:
         fs = FilerServer(ip=opts.ip, port=opts.port, master=opts.master,
                          store_dir=opts.dir, collection=opts.collection,
                          store=opts.store,
+                         chunk_size=max(1, opts.maxMB) * 1024 * 1024,
                          peers=[p.strip() for p in opts.peers.split(",")
                                 if p.strip()])
         fs.start()

@@ -69,9 +69,12 @@ def non_overlapping_visible_intervals(chunks) -> list[tuple[int, int, object]]:
 
 
 def view_from_chunks(chunks, offset: int = 0, size: int | None = None) -> list[ChunkView]:
-    """Resolve a read range into per-chunk views (ViewFromChunks)."""
+    """Resolve a read range into per-chunk views (ViewFromChunks).
+    size=None means "to end-of-file from `offset`" — callers streaming a
+    whole entry (filer GET, replication materialize, the ISSUE-14
+    pipelined readers) pass None instead of re-deriving total_size."""
     if size is None:
-        size = total_size(chunks)
+        size = max(total_size(chunks) - offset, 0)
     stop = offset + size
     views = []
     for vs, ve, c in non_overlapping_visible_intervals(chunks):

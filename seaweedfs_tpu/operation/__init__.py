@@ -242,6 +242,13 @@ def upload_data(url: str, data: bytes, *, filename: str = "",
                                     size=j.get("size", len(data)),
                                     etag=j.get("eTag", ""))
             last = IOError(f"{status}: {text[:200]}")
+            if status in (429, 503):
+                # a throttled upload leg marks the process hot: the
+                # pipelined PUT window (ISSUE 14) collapses to
+                # sequential instead of fanning more load out
+                from ..qos.pressure import SIGNAL
+
+                SIGNAL.report_shed()
             if status < 500:
                 break  # 4xx (bad request, auth) won't improve on retry
         except (OSError, requests.RequestException) as e:

@@ -36,7 +36,7 @@ from .admission import (  # noqa: F401
     s3_access_key_hint,
     s3_tenant,
 )
-from .pressure import pressure_score  # noqa: F401
+from .pressure import SIGNAL, PressureSignal, pressure_score  # noqa: F401
 from .priority import (  # noqa: F401
     BACKGROUND_CLASSES,
     DEFAULT_MAX_GRANT_BYTES,

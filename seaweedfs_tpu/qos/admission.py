@@ -200,6 +200,12 @@ class TenantAdmission:
             return Decision(True, tenant)
         self.rejected += 1
         QOS_ADMISSION_OPS.inc(plane=self.plane, result="reject")
+        # a local shed is the earliest "this process is hot" evidence
+        # there is: the pipelined chunk engine (ISSUE 14) collapses its
+        # readahead/overlap windows to 1 while the signal holds
+        from .pressure import SIGNAL
+
+        SIGNAL.report_shed()
         retry_after = max(wait, 0.05)
         self._rejections.append({
             "tenant": tenant,

@@ -28,6 +28,8 @@ fast instead of the data plane timing out late.
 from __future__ import annotations
 
 import os
+import threading
+import time
 
 DEFAULT_GC_CAP = 256
 DEFAULT_DISPATCH_CAP = 64
@@ -52,3 +54,96 @@ def pressure_score(gc_depth: float, dispatch_depth: float, *,
     a = min(max(gc_depth, 0.0) / max(gc_cap, 1.0), 1.0)
     b = min(max(dispatch_depth, 0.0) / max(dispatch_cap, 1.0), 1.0)
     return round(1.0 - (1.0 - a) * (1.0 - b), 4)
+
+
+# -- process-local "the cluster is hot" signal (ISSUE 14) -------------------
+
+DEFAULT_HOT_HOLD_S = 3.0
+
+
+def _shed_threshold() -> float:
+    """The same knob the master sheds assigns on; unset = never hot by
+    score alone (matching the plane's observe-only default)."""
+    try:
+        v = float(os.environ.get("SWFS_QOS_SHED_PRESSURE", "") or 0.0)
+    except ValueError:
+        v = 0.0
+    return v if v > 0 else 2.0  # scores are [0,1]: 2.0 = unreachable
+
+
+class PressureSignal:
+    """Recency-tracked overload signal consumed by the pipelined chunk
+    engine (filer/chunk_pipeline.py): when the process has RECENTLY
+    observed shedding (a tenant admission rejection, a 429/503 from a
+    volume server) or strain (a chunk read forced onto the failover
+    ladder), or the last reported pressure score crossed the shed
+    threshold, readahead/overlap windows collapse to 1 — prefetch
+    fan-out must not multiply load on a cluster that is already
+    shedding. The signal decays on its own: `SWFS_QOS_HOT_HOLD_S`
+    (default 3s) after the last report, windows re-open.
+
+    Injectable clock for tests (the admission TokenBucket pattern)."""
+
+    def __init__(self, now=time.monotonic):
+        self._now = now
+        self._lock = threading.Lock()
+        self._hot_until = 0.0
+        self._score = 0.0
+        self.sheds = 0
+        self.strains = 0
+
+    def _hold(self) -> float:
+        try:
+            return float(os.environ.get("SWFS_QOS_HOT_HOLD_S",
+                                        str(DEFAULT_HOT_HOLD_S)))
+        except ValueError:
+            return DEFAULT_HOT_HOLD_S
+
+    def report_shed(self) -> None:
+        """A request was rejected/throttled (429/503, admission)."""
+        with self._lock:
+            self.sheds += 1
+            self._hot_until = max(self._hot_until,
+                                  self._now() + self._hold())
+
+    def report_strain(self) -> None:
+        """The data plane needed its failover machinery (e.g. every
+        cached replica of a chunk failed) — not a shed, but fan-out on
+        top of a struggling cluster only deepens the hole."""
+        with self._lock:
+            self.strains += 1
+            self._hot_until = max(self._hot_until,
+                                  self._now() + self._hold())
+
+    def report_score(self, score: float) -> None:
+        """Latest local pressure score (volume servers feed their own)."""
+        with self._lock:
+            self._score = float(score)
+
+    def is_hot(self) -> bool:
+        with self._lock:
+            return self._now() < self._hot_until \
+                or self._score >= _shed_threshold()
+
+    def reset(self) -> None:
+        with self._lock:
+            self._hot_until = 0.0
+            self._score = 0.0
+            self.sheds = 0
+            self.strains = 0
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "hot": self._now() < self._hot_until
+                or self._score >= _shed_threshold(),
+                "sheds": self.sheds,
+                "strains": self.strains,
+                "score": self._score,
+                "holdSeconds": self._hold(),
+            }
+
+
+#: Process-wide signal: admission planes and data-plane clients report,
+#: the chunk pipeline consults.
+SIGNAL = PressureSignal()

@@ -56,12 +56,29 @@ class FilerSource:
         raise IOError(f"read {file_id}: {last}")
 
     def read_entry_content(self, entry: filer_pb2.Entry) -> bytes:
-        """Materialize a full entry body (content or chunks)."""
+        """Materialize a full entry body (content or chunks).
+
+        Chunk fetches ride the pipelined chunk engine (ISSUE 14): a
+        sync run materializing a multi-chunk entry overlaps its volume
+        round-trips instead of paying Σ(RTT) — and assembles through
+        the filer's visible-interval resolution (filechunks), so an
+        entry with overwritten extents replicates exactly the bytes a
+        filer GET would serve (offset-order paste-over could not)."""
         if entry.content:
             return entry.content
-        size = max((c.offset + c.size for c in entry.chunks), default=0)
-        buf = bytearray(size)
-        for c in sorted(entry.chunks, key=lambda c: c.modified_ts_ns):
-            data = self.read_chunk(c.file_id)[:c.size]
-            buf[c.offset:c.offset + len(data)] = data
+        from ..filer import chunk_pipeline
+        from ..filer.filechunks import total_size, view_from_chunks
+
+        views = view_from_chunks(entry.chunks)
+        buf = bytearray(total_size(entry.chunks))
+
+        def fetch(v):
+            return self.read_chunk(v.file_id)[
+                v.chunk_offset:v.chunk_offset + v.size]
+
+        # generator first in the zip: it then runs to completion (clean
+        # StopIteration) instead of being left suspended for the GC
+        for data, v in zip(chunk_pipeline.readahead(views, fetch),
+                           views):
+            buf[v.logical_offset:v.logical_offset + len(data)] = data
         return bytes(buf)

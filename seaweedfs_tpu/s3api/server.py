@@ -226,6 +226,13 @@ class S3Server:
             # the backend throttled anyway (direct-traffic budget,
             # pressure shed): surface it as throttling, not a bug
             raise _backend_throttled(r, "filer PUT")
+        if r.status_code == 400:
+            # the filer refused a short body (ISSUE 14 ShortBodyError):
+            # the client died mid-upload — S3's IncompleteBody, not an
+            # InternalError (nothing was committed; chunks were GC'd)
+            raise S3Error(400, "IncompleteBody",
+                          "You did not provide the number of bytes "
+                          "specified by the Content-Length HTTP header")
         if r.status_code >= 300:
             raise S3Error(500, "InternalError", f"filer PUT: {r.status_code}")
         return md5.hexdigest()
@@ -467,8 +474,18 @@ def _make_handler(srv: S3Server):
         def _raw_body(self) -> bytes:
             if not hasattr(self, "_raw_body_cache"):
                 length = int(self.headers.get("Content-Length") or 0)
-                self._raw_body_cache = self.rfile.read(length) if length \
-                    else b""
+                body = self.rfile.read(length) if length else b""
+                if len(body) < length:
+                    # the client died mid-body (ISSUE 14): committing
+                    # the short read would store a silently TRUNCATED
+                    # object — the filer-side ShortBodyError's gateway
+                    # analogue. The socket is desynced; close it.
+                    self.close_connection = True
+                    raise S3Error(
+                        400, "IncompleteBody",
+                        "You did not provide the number of bytes "
+                        "specified by the Content-Length HTTP header")
+                self._raw_body_cache = body
             return self._raw_body_cache
 
         def _body(self) -> bytes:
@@ -1046,9 +1063,18 @@ def _make_handler(srv: S3Server):
 
         def _copy_object(self, bucket: str, key: str, src: str):
             sbucket, skey = self._parse_copy_source(src)
-            r = srv.get_object(sbucket, skey)
-            etag = srv.put_object(bucket, key, r.content,
-                                  r.headers.get("Content-Type", ""))
+            # STREAMED copy (ISSUE 14): the filer serves the source GET
+            # through its pipelined readahead and the PUT leg re-chunks
+            # through the overlapped autochunker — the gateway spools
+            # (mem <= 8MB, disk beyond) instead of materializing the
+            # whole object in RAM as r.content did
+            r = srv.get_object(sbucket, skey, stream=True)
+            try:
+                etag = srv.put_object(bucket, key,
+                                      r.iter_content(1 << 20),
+                                      r.headers.get("Content-Type", ""))
+            finally:
+                r.close()
             root = ET.Element("CopyObjectResult", xmlns=S3_NS)
             _el(root, "ETag", f'"{etag}"')
             _el(root, "LastModified", _iso(int(time.time())))

@@ -24,12 +24,13 @@ from urllib.parse import parse_qs, unquote, urlparse
 import grpc
 import requests as rq
 
-from ..filer import Attr, Entry, Filer
+from ..filer import Attr, Entry, Filer, chunk_pipeline
 from ..filer.filechunks import etag as chunks_etag, total_size, view_from_chunks
 from ..filer.filer import NotEmpty, NotFound, normalize
 from ..filer.filerstore import RetryingStore, get_store
 from ..operation import assign, delete_files, upload_data
 from ..pb import filer_pb2, master_pb2, rpc
+from ..qos.pressure import SIGNAL as PRESSURE_SIGNAL
 from ..utils import glog, trace
 from ..utils.chunk_cache import TieredChunkCache
 from ..utils.http import not_modified, parse_range, range_applies, url_for
@@ -37,6 +38,7 @@ from ..utils.stats import (
     FILER_CHUNK_CACHE_COUNTER,
     FILER_REQUEST_HISTOGRAM,
     chunk_cache_stats,
+    chunk_pipeline_stats,
     fid_lease_stats,
     gather,
     metrics_content_type,
@@ -553,8 +555,21 @@ class FilerServer:
         from `reader` one chunk at a time (uploadReaderToChunks in
         filer_server_handlers_write_autochunk.go): a multi-GB PUT never
         materializes in filer RAM. On failure the chunks saved so far are
-        garbage-collected before the error surfaces."""
-        chunks = []
+        garbage-collected before the error surfaces.
+
+        Pipelined (ISSUE 14): multi-chunk bodies overlap the client-body
+        read of chunk N+1 with the assign+upload of chunk N — up to W
+        `save_chunk` calls in flight on the shared executor (the
+        reference's `uploadReaderToChunks` concurrency). md5/offset
+        accounting stays strictly ordered (the body is still read
+        sequentially on this thread); single-chunk bodies keep the
+        direct path (no executor hop on the small-file hot path).
+
+        A known `length` whose body ends short raises ShortBodyError
+        (mapped to 4xx at the HTTP/S3 handlers) instead of silently
+        committing a TRUNCATED entry — the saved chunks are GC'd."""
+        chunks: list = []
+        win = None
         md5 = hashlib.md5()
         off = 0
         try:
@@ -567,14 +582,33 @@ class FilerServer:
                 if off and not piece:
                     break
                 md5.update(piece)
-                c = self.save_chunk(piece, ttl=ttl)
-                c.offset = off
-                chunks.append(c)
+                final = len(piece) < want or want <= 0 or (
+                    length is not None and off + len(piece) >= length)
+                if final and win is None and not chunks:
+                    # single-chunk body: save inline, no executor hop
+                    c = self.save_chunk(piece, ttl=ttl)
+                    c.offset = off
+                    chunks.append(c)
+                else:
+                    if win is None:
+                        win = chunk_pipeline.UploadWindow(
+                            lambda data: self.save_chunk(data, ttl=ttl))
+                    win.add(piece, off)
                 off += len(piece)
                 if len(piece) < want or want <= 0:
                     break
+            if length is not None and off < length:
+                # reader.read() returned short of the declared
+                # Content-Length: the client died mid-body. Committing
+                # would truncate silently (the pre-ISSUE-14 bug).
+                raise chunk_pipeline.ShortBodyError(off, length)
+            if win is not None:
+                chunks.extend(win.finish())
         except Exception:
-            self._gc_chunks([c.file_id for c in chunks])
+            fids = [c.file_id for c in chunks]
+            if win is not None:
+                fids.extend(win.saved_fids())
+            self._gc_chunks(fids)
             raise
         return self._finish_entry(path, chunks, md5, mime=mime, ttl=ttl,
                                   mode=mode,
@@ -636,12 +670,32 @@ class FilerServer:
         # volume-side and nothing would ever invalidate the cached copy
         # (TTL expiry doesn't pass through _gc_chunks)
         cacheable = not entry.attr.ttl_sec
-        for view in view_from_chunks(entry.chunks, offset,
-                                     size if size is not None
-                                     else total_size(entry.chunks) - offset):
-            yield self._read_chunk_view(view, cacheable=cacheable)
+        views = view_from_chunks(entry.chunks, offset, size)
+        window = chunk_pipeline.get_window(len(views))
+        if window <= 1:
+            for view in views:
+                yield self._read_chunk_view(view, cacheable=cacheable)
+            return
+        # pipelined readahead (ISSUE 14): prefetch upcoming views on the
+        # shared executor while the current one streams to the client.
+        # Large-object prefetches BYPASS read-through cache population
+        # (populate=False) — a streaming read must not evict the
+        # small-file working set — but still consult the cache for hits.
+        # Chunk-read spans keep their trace via the captured parent ctx
+        # (executor threads have no span TLS).
+        sp = trace.current()
+        parent_ctx = sp.context() if sp is not None else None
 
-    def _read_chunk_view(self, view, cacheable: bool = True) -> bytes:
+        def fetch(v):
+            return self._read_chunk_view(v, cacheable=cacheable,
+                                         populate=False,
+                                         parent_ctx=parent_ctx)
+
+        yield from chunk_pipeline.readahead(views, fetch, span=sp)
+
+    def _read_chunk_view(self, view, cacheable: bool = True,
+                         populate: bool = True,
+                         parent_ctx=None) -> bytes:
         """One chunk view's bytes: the filer chunk cache first (rung 0 —
         zero volume-server round-trips on a hit), then full failover:
         every replica in the cached location map, a cache-invalidating
@@ -651,15 +705,24 @@ class FilerServer:
         ladder this rebuild previously lacked: first dead replica was
         fatal).
 
+        `populate=False` (pipelined large-object reads, ISSUE 14) still
+        CONSULTS the cache but never populates it on a miss — streaming
+        a big object must not evict the small-file working set.
+        `parent_ctx` is the request span's `.context()` when this runs
+        on a prefetch executor thread (no span TLS there).
+
         Traced (ISSUE 7): inside a request span each rung becomes
         attributable — the `filer.chunk_read` child carries the
         cache hit/miss verdict, and the volume-server fetches below
         propagate the trace over their HTTP headers."""
         with trace.span("filer.chunk_read", child_only=True,
+                        parent=parent_ctx,
                         fid=view.file_id, size=view.size) as tsp:
-            return self._read_chunk_view_traced(view, cacheable, tsp)
+            return self._read_chunk_view_traced(view, cacheable, tsp,
+                                                populate)
 
-    def _read_chunk_view_traced(self, view, cacheable: bool, tsp) -> bytes:
+    def _read_chunk_view_traced(self, view, cacheable: bool, tsp,
+                                populate: bool = True) -> bytes:
         cache = self.chunk_cache
         if cache is not None and cacheable:
             cached = cache.get(view.file_id)
@@ -685,8 +748,12 @@ class FilerServer:
         def filled(data: bytes) -> bytes:
             # read-through population: only whole chunks of non-TTL'd
             # entries (a ranged fetch can't serve later full-chunk
-            # reads; expired needles would linger in cache forever)
-            if cache is not None and cacheable and view.is_full_chunk:
+            # reads; expired needles would linger in cache forever).
+            # Pipelined large-object reads pass populate=False: one
+            # streaming GET's chunks must not evict the whole
+            # small-file working set (ISSUE 14).
+            if cache is not None and cacheable and populate \
+                    and view.is_full_chunk:
                 cache.put(view.file_id, data)
                 FILER_CHUNK_CACHE_COUNTER.inc(result="put")
             return data
@@ -731,9 +798,20 @@ class FilerServer:
                     else:
                         all_notfound = False
                         last_err = IOError(f"{url}: {r.status}")
+                        if r.status in (429, 503):
+                            # a throttling volume server is the hot
+                            # signal the pipelined readahead collapses
+                            # on (ISSUE 14)
+                            PRESSURE_SIGNAL.report_shed()
+                        elif r.status >= 500:
+                            # a flapping/erroring replica: prefetch
+                            # fan-out must degrade to sequential while
+                            # the ladder absorbs the failures
+                            PRESSURE_SIGNAL.report_strain()
                 except (OSError, rq.RequestException) as e:
                     all_notfound = False
                     last_err = e
+                    PRESSURE_SIGNAL.report_strain()
                     sslerr = _ssl_error_of(e)
                     if sslerr is not None \
                             and not ssl_error_is_retryable(sslerr):
@@ -756,6 +834,10 @@ class FilerServer:
             vid = view.file_id.split(",")[0]
             glog.v(1, f"chunk {view.file_id}: cached replicas failed "
                       f"({last_err}); refreshing volume {vid} locations")
+            # needing the failover ladder at all means the cluster is
+            # struggling: degrade prefetch fan-out to sequential for a
+            # few seconds rather than multiplying the error load
+            PRESSURE_SIGNAL.report_strain()
             data, notfound = try_urls(self.master_client.lookup_file_id(
                 view.file_id, refresh=True))
             if data is not None:
@@ -1225,6 +1307,9 @@ def _make_http_handler(srv: FilerServer):
                     "HttpPool": http_pool_stats(),
                     "ChunkCache": chunk_cache_stats(),
                     "ChunkCacheEnabled": srv.chunk_cache is not None,
+                    # pipelined chunk data path (ISSUE 14): window
+                    # activity + the hot signal that collapses it
+                    "ChunkPipeline": chunk_pipeline_stats(),
                     "FidLease": {
                         **fid_lease_stats(),
                         "remaining": srv.fid_pool.remaining(),
@@ -1411,6 +1496,13 @@ def _make_http_handler(srv: FilerServer):
                         # raw bodies stream straight into the autochunker
                         entry = srv.write_stream(path, reader, length,
                                                  mime=ctype, **kwargs)
+                except chunk_pipeline.ShortBodyError as e:
+                    # the CLIENT sent fewer bytes than it declared: a
+                    # 4xx, not a server error (the saved chunks were
+                    # already GC'd by write_stream). The socket is
+                    # desynced by definition — close it.
+                    self.close_connection = True
+                    return self._json({"error": str(e)}, 400)
                 except Exception as e:
                     # any failure (assign errors incl. "no writable
                     # volumes", mid-body IO) must answer 500 JSON, never

@@ -49,7 +49,7 @@ from ..storage.file_id import parse_file_id
 from ..storage.needle import CrcError, Needle
 from ..storage.store import Store
 from ..storage.ttl import TTL
-from ..utils import failpoint, glog, numa, trace
+from ..utils import failpoint, fanout, glog, numa, trace
 from ..utils.http import not_modified, parse_range, range_applies, url_for
 from ..utils.stats import (
     VOLUME_SERVER_EC_ENCODE_BYTES,
@@ -427,6 +427,7 @@ class VolumeServer:
         assign placement and early shedding. Callers that already
         sampled the depths pass them in (one volume walk, one score)."""
         from ..qos import pressure_score
+        from ..qos.pressure import SIGNAL
         from ..utils.stats import QOS_PRESSURE
 
         if gc_depth is None:
@@ -435,6 +436,12 @@ class VolumeServer:
             dispatch_depth = sum(self.ec_dispatch_depths().values())
         p = pressure_score(gc_depth, dispatch_depth)
         QOS_PRESSURE.set(p)
+        # feed the process-local hot signal (ISSUE 14): in combined
+        # topologies (`weed server -filer` — filer + volume in one
+        # process) the pipelined chunk engine collapses its windows
+        # when this server's own queues cross the shed threshold,
+        # BEFORE the first 429/503 is ever emitted
+        SIGNAL.report_score(p)
         return p
 
     def qos_acquire(self, work_class: str, nbytes: int) -> float:
@@ -731,6 +738,9 @@ class VolumeServer:
                 return False
 
         if not solvable() and missing:
+            # lint: allow-executor — lazy ex.map + early break once the
+            # solver is satisfied needs a scoped pool whose exit joins
+            # the stragglers; bounded by the shard count (<= 13 tasks)
             with ThreadPoolExecutor(max_workers=8) as ex:
                 for i, arr in ex.map(fetch, missing):
                     if arr is not None:
@@ -822,6 +832,8 @@ class VolumeServer:
             # gather the plan's remote survivors CONCURRENTLY — the
             # minimal-read path must pay max(RTT), not sum(RTT), or it
             # loses to the parallel any-k backstop it exists to beat
+            # lint: allow-executor — scoped pool: the all-or-nothing
+            # early return (None -> generic path) must join every fetch
             with ThreadPoolExecutor(
                     max_workers=min(8, len(need_remote))) as ex:
                 for i, arr in ex.map(fetch_planned, need_remote):
@@ -911,8 +923,16 @@ class VolumeServer:
             if r.status >= 300:
                 raise IOError(f"replica write to {addr}: {r.status}")
 
-        with ThreadPoolExecutor(max_workers=4) as ex:
-            list(ex.map(send, [a for a in locations if a != self.address]))
+        # shared bounded fan-out executor (ISSUE 14): the old code built
+        # and tore down a 4-thread ThreadPoolExecutor PER replicated
+        # write — thread spawn on the hottest write path. run_all waits
+        # for every send to settle before raising the first failure
+        # (same semantics as the old `list(ex.map(...))` + `with` exit).
+        # The "replicate" tier, NOT the pipeline tier: in a combined
+        # filer+volume process, pipeline-tier uploads block on this
+        # very handler — sharing their pool would be a circular wait.
+        fanout.run_all(send, [a for a in locations if a != self.address],
+                       pool="replicate")
 
     def lookup_volume_locations(self, vid: int) -> list[str]:
         """Replica locations for a volume, cached ~10s (the write hot path
@@ -1406,6 +1426,10 @@ class VolumeGrpc:
 
         results = []
         if dests:
+            # lint: allow-executor — per-conversion admin path (one
+            # pool per ec.encode stream, not per request); finish() can
+            # block minutes on resume retries, which would starve the
+            # shared fan-out budget
             with ThreadPoolExecutor(max_workers=len(dests)) as ex:
                 results = list(ex.map(finish_one, dests))
         for d, err in results:

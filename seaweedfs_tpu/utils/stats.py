@@ -561,6 +561,37 @@ def http_pool_stats() -> dict:
     }
 
 
+# -- pipelined chunk data path (ISSUE 14): bounded-window GET readahead
+#    + overlapped PUT upload fan-out on the filer data legs ------------------
+
+CHUNK_PIPELINE_OPS = Counter(
+    "SeaweedFS_chunk_pipeline_ops",
+    "Pipelined chunk engine events by direction (get/put) and result "
+    "(prefetch_hit/prefetch_wait/launched/collapsed/cancelled/aborted).")
+CHUNK_PIPELINE_INFLIGHT = Gauge(
+    "SeaweedFS_chunk_pipeline_inflight",
+    "Chunk fetches/uploads currently in flight in the pipelined chunk "
+    "engine, by direction.")
+CHUNK_PIPELINE_BYTES = Counter(
+    "SeaweedFS_chunk_pipeline_bytes",
+    "Bytes moved through the pipelined chunk engine by direction.")
+
+
+def chunk_pipeline_stats() -> dict:
+    """Snapshot for /status pages: window activity + hot-signal state."""
+    from ..qos.pressure import SIGNAL
+
+    out: dict = {"pressureSignal": SIGNAL.status()}
+    for d in ("get", "put"):
+        out[d] = {
+            r: int(CHUNK_PIPELINE_OPS.value(direction=d, result=r))
+            for r in ("prefetch_hit", "prefetch_wait", "launched",
+                      "collapsed", "cancelled", "aborted")}
+        out[d]["inflight"] = int(CHUNK_PIPELINE_INFLIGHT.value(direction=d))
+        out[d]["bytes"] = int(CHUNK_PIPELINE_BYTES.value(direction=d))
+    return out
+
+
 def qos_stats() -> dict:
     """Snapshot for /status pages: admission outcomes + grant flow."""
     out = {

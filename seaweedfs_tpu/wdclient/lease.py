@@ -65,6 +65,11 @@ class FidLeasePool:
         self.max_age = max_age
         self._lock = threading.Lock()
         self._blocks: dict[tuple, deque[_Block]] = {}
+        # single-flight refill (ISSUE 14): the overlapped PUT window
+        # means W writer threads can drain a key together; only ONE
+        # should pay (and reserve) a batched Assign while the others
+        # wait for its block instead of each minting their own
+        self._refills: dict[tuple, threading.Event] = {}
         # per-key invalidation generation: a refill Assign runs OUTSIDE
         # the lock, so a block obtained before an invalidate() must not
         # be stocked after it (it likely points at the very volume whose
@@ -76,11 +81,8 @@ class FidLeasePool:
         # wasting batch-1 needle ids per PUT
         self._jwt_keys: set[tuple] = set()
 
-    def acquire(self, *, collection: str = "", replication: str = "",
-                ttl: str = "", data_center: str = "") -> AssignResult:
-        """-> one leased fid (AssignResult with fid/url/auth), or an
-        AssignResult carrying `.error` when every master refused."""
-        key = (collection, replication, ttl, data_center)
+    def _take_pooled(self, key: tuple) -> AssignResult | None:
+        """One fid from the key's live blocks, or None when dry."""
         now = time.monotonic()
         with self._lock:
             blocks = self._blocks.get(key)
@@ -100,39 +102,72 @@ class FidLeasePool:
                     # an attribute, not a span of its own
                     sp.set_attr(fidLease="hit")
                 return b.take()
+        return None
+
+    def acquire(self, *, collection: str = "", replication: str = "",
+                ttl: str = "", data_center: str = "") -> AssignResult:
+        """-> one leased fid (AssignResult with fid/url/auth), or an
+        AssignResult carrying `.error` when every master refused."""
+        key = (collection, replication, ttl, data_center)
+        a = self._take_pooled(key)
+        if a is not None:
+            return a
         # pool dry for this key: one batched Assign restocks it. The RPC
         # runs outside the lock — a slow master must not stall every
-        # writer thread; concurrent fillers just stock extra blocks.
+        # writer thread. Refills are SINGLE-FLIGHT per key (ISSUE 14):
+        # the overlapped PUT window drains a key with W threads at once,
+        # and W concurrent Assigns would reserve (and then mostly waste)
+        # W whole blocks of needle ids. Followers wait for the leader's
+        # block; if the leader failed or its block was consumed, they
+        # fall through to their own Assign (correctness never depends
+        # on the leader).
         with self._lock:
-            count = 1 if key in self._jwt_keys else self.batch
-            gen = self._gens.get(key, 0)
-        with trace.span("wdclient.lease.refill", child_only=True,
-                        count=count):
-            a = assign(self.master, count=count, collection=collection,
-                       replication=replication, ttl=ttl,
-                       data_center=data_center)
-        if a.error:
-            return a
-        CLIENT_FID_LEASE_COUNTER.inc(result="refill")
-        granted = max(1, int(a.count or 1))
-        if a.auth:
-            # JWT is bound to the base fid; "_delta" fids would 401 —
-            # remember so the NEXT assign doesn't reserve (and waste) a
-            # whole block of needle ids it can never hand out
+            ev = self._refills.get(key)
+            leader = ev is None
+            if leader:
+                ev = self._refills[key] = threading.Event()
+        if not leader:
+            ev.wait(timeout=15.0)
+            a = self._take_pooled(key)
+            if a is not None:
+                return a
+        try:
             with self._lock:
-                self._jwt_keys.add(key)
-            return a
-        block = _Block(a, granted, time.monotonic() + self.max_age)
-        first = block.take()
-        if block.next < block.count:
-            with self._lock:
-                if self._gens.get(key, 0) == gen:
-                    self._blocks.setdefault(key, deque()).append(block)
-                # else: invalidate() ran while our Assign was in flight
-                # — this block targets a suspect volume; hand out only
-                # the first fid (its upload failing is what retries are
-                # for) and let the next acquire re-ask the master
-        return first
+                count = 1 if key in self._jwt_keys else self.batch
+                gen = self._gens.get(key, 0)
+            with trace.span("wdclient.lease.refill", child_only=True,
+                            count=count):
+                a = assign(self.master, count=count, collection=collection,
+                           replication=replication, ttl=ttl,
+                           data_center=data_center)
+            if a.error:
+                return a
+            CLIENT_FID_LEASE_COUNTER.inc(result="refill")
+            granted = max(1, int(a.count or 1))
+            if a.auth:
+                # JWT is bound to the base fid; "_delta" fids would 401 —
+                # remember so the NEXT assign doesn't reserve (and waste)
+                # a whole block of needle ids it can never hand out
+                with self._lock:
+                    self._jwt_keys.add(key)
+                return a
+            block = _Block(a, granted, time.monotonic() + self.max_age)
+            first = block.take()
+            if block.next < block.count:
+                with self._lock:
+                    if self._gens.get(key, 0) == gen:
+                        self._blocks.setdefault(key, deque()).append(block)
+                    # else: invalidate() ran while our Assign was in
+                    # flight — this block targets a suspect volume; hand
+                    # out only the first fid (its upload failing is what
+                    # retries are for) and let the next acquire re-ask
+                    # the master
+            return first
+        finally:
+            if leader:
+                with self._lock:
+                    self._refills.pop(key, None)
+                ev.set()
 
     def invalidate(self, *, collection: str = "", replication: str = "",
                    ttl: str = "", data_center: str = "",

@@ -82,6 +82,14 @@ def pooling_enabled() -> bool:
         not in ("0", "false", "off")
 
 
+def max_per_host() -> int:
+    """Warm connections the pool will keep per (scheme, host, port) —
+    the bound the pipelined chunk engine (ISSUE 14) clamps its fan-out
+    windows to, so one streaming request can never sweep every warm
+    connection to a volume server."""
+    return max(1, _pool_size())
+
+
 class HttpPool:
     def __init__(self):
         self._idle: dict[tuple, deque] = {}

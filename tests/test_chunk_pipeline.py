@@ -1,0 +1,698 @@
+"""Pipelined chunk data path (ISSUE 14): bounded-window GET readahead +
+overlapped PUT upload fan-out.
+
+Unit half: the engine's contracts in isolation — strict in-order yield,
+window/byte-cap bounds, cancellation on close, in-order error surface,
+hot-signal collapse, the upload window's ordered accounting and
+failure/GC contract, and the lease pool's single-flight refill.
+
+Integration half: hash-identity of large multi-chunk GET/PUT bodies
+across readahead on/off × python/native volume plane × HTTP/HTTPS,
+ranged reads starting mid-window, client-disconnect mid-stream (GET
+prefetches cancelled; PUT short body -> 4xx with every saved chunk
+GC'd), and the S3 gateway's IncompleteBody mapping.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import socket
+import threading
+import time
+
+import pytest
+import requests
+
+from seaweedfs_tpu.filer import chunk_pipeline
+from seaweedfs_tpu.pb import rpc
+from seaweedfs_tpu.qos.pressure import SIGNAL, PressureSignal
+from seaweedfs_tpu.server.filer import FilerServer
+from seaweedfs_tpu.server.master import MasterServer
+from seaweedfs_tpu.server.volume import VolumeServer
+from seaweedfs_tpu.utils import failpoint
+from seaweedfs_tpu.utils.stats import CHUNK_PIPELINE_OPS
+
+CHUNK = 64 * 1024
+
+
+def _free_port() -> int:
+    for _ in range(50):
+        with socket.socket() as s:
+            s.bind(("", 0))
+            port = s.getsockname()[1]
+        if port + 10000 > 65535:
+            continue
+        with socket.socket() as s2:
+            try:
+                s2.bind(("", port + 10000))
+            except OSError:
+                continue
+        return port
+    raise RuntimeError("no free port pair found")
+
+
+def _sha(b) -> str:
+    return hashlib.sha256(bytes(b)).hexdigest()
+
+
+@pytest.fixture(autouse=True)
+def _clean_signal():
+    SIGNAL.reset()
+    chunk_pipeline.refresh_config()
+    yield
+    SIGNAL.reset()
+    chunk_pipeline.refresh_config()
+
+
+# -- engine units -----------------------------------------------------------
+
+
+class _Item:
+    def __init__(self, i, size=1000):
+        self.i = i
+        self.size = size
+
+
+def test_readahead_yields_strictly_in_order(monkeypatch):
+    monkeypatch.setenv("SWFS_CHUNK_READAHEAD", "4")
+    chunk_pipeline.refresh_config()
+    items = [_Item(i) for i in range(10)]
+
+    def fetch(it):
+        # later items finish FIRST: order must still hold
+        time.sleep(0.002 * (10 - it.i))
+        return bytes([it.i])
+
+    out = list(chunk_pipeline.readahead(items, fetch))
+    assert out == [bytes([i]) for i in range(10)]
+
+
+def test_readahead_window_bounds_concurrency(monkeypatch):
+    monkeypatch.setenv("SWFS_CHUNK_READAHEAD", "3")
+    chunk_pipeline.refresh_config()
+    lock = threading.Lock()
+    live = [0]
+    peak = [0]
+
+    def fetch(it):
+        with lock:
+            live[0] += 1
+            peak[0] = max(peak[0], live[0])
+        time.sleep(0.02)
+        with lock:
+            live[0] -= 1
+        return b"x"
+
+    assert len(list(chunk_pipeline.readahead(
+        [_Item(i) for i in range(12)], fetch))) == 12
+    assert peak[0] <= 3, f"window must bound fan-out (peak {peak[0]})"
+    assert peak[0] >= 2, "no overlap at all — the window never opened"
+
+
+def test_readahead_respects_inflight_byte_cap(monkeypatch):
+    monkeypatch.setenv("SWFS_CHUNK_READAHEAD", "8")
+    monkeypatch.setenv("SWFS_CHUNK_READAHEAD_MB", "1")
+    chunk_pipeline.refresh_config()
+    lock = threading.Lock()
+    live = [0]
+    peak = [0]
+
+    def fetch(it):
+        with lock:
+            live[0] += 1
+            peak[0] = max(peak[0], live[0])
+        time.sleep(0.02)
+        with lock:
+            live[0] -= 1
+        return b"y" * 10
+
+    # 400KB items under a 1MB cap: at most 2 in flight despite window 8
+    items = [_Item(i, size=400 * 1024) for i in range(8)]
+    assert len(list(chunk_pipeline.readahead(items, fetch))) == 8
+    assert peak[0] <= 2, f"byte cap must bound fan-out (peak {peak[0]})"
+
+
+def test_readahead_cancels_pending_on_close(monkeypatch):
+    monkeypatch.setenv("SWFS_CHUNK_READAHEAD", "4")
+    chunk_pipeline.refresh_config()
+    started = [0]
+
+    def fetch(it):
+        started[0] += 1
+        time.sleep(0.05)
+        return b"z"
+
+    cancelled0 = CHUNK_PIPELINE_OPS.value(direction="get",
+                                          result="cancelled")
+    gen = chunk_pipeline.readahead([_Item(i) for i in range(40)], fetch)
+    assert next(gen) == b"z"
+    gen.close()  # the client disconnected
+    time.sleep(0.3)  # let any stragglers settle
+    assert started[0] <= 8, \
+        f"disconnect must not fetch the rest of the object ({started[0]})"
+    assert CHUNK_PIPELINE_OPS.value(direction="get",
+                                    result="cancelled") > cancelled0
+
+
+def test_readahead_error_surfaces_in_order(monkeypatch):
+    monkeypatch.setenv("SWFS_CHUNK_READAHEAD", "4")
+    chunk_pipeline.refresh_config()
+
+    def fetch(it):
+        if it.i == 2:
+            raise IOError("chunk unreadable")
+        return bytes([it.i])
+
+    gen = chunk_pipeline.readahead([_Item(i) for i in range(8)], fetch)
+    assert next(gen) == b"\x00"
+    assert next(gen) == b"\x01"
+    with pytest.raises(IOError, match="unreadable"):
+        next(gen)
+
+
+def test_hot_signal_collapses_window_and_decays(monkeypatch):
+    monkeypatch.setenv("SWFS_CHUNK_READAHEAD", "4")
+    chunk_pipeline.refresh_config()
+    assert chunk_pipeline.get_window(8) == 4
+    SIGNAL.report_shed()
+    collapsed0 = CHUNK_PIPELINE_OPS.value(direction="get",
+                                          result="collapsed")
+    assert chunk_pipeline.get_window(8) == 1
+    assert chunk_pipeline.put_window() == 1
+    assert CHUNK_PIPELINE_OPS.value(direction="get",
+                                    result="collapsed") > collapsed0
+    SIGNAL.reset()
+    assert chunk_pipeline.get_window(8) == 4
+
+    # decay arithmetic under a fake clock (no sleeps)
+    t = [0.0]
+    sig = PressureSignal(now=lambda: t[0])
+    monkeypatch.setenv("SWFS_QOS_HOT_HOLD_S", "3")
+    sig.report_strain()
+    assert sig.is_hot()
+    t[0] = 2.9
+    assert sig.is_hot()
+    t[0] = 3.1
+    assert not sig.is_hot(), "the signal must decay on its own"
+    assert sig.status()["strains"] == 1
+
+
+def test_window_never_exceeds_http_pool(monkeypatch):
+    """Pool-awareness: the fan-out can never sweep every warm
+    connection to a host (SWFS_HTTP_POOL_SIZE clamp)."""
+    monkeypatch.setenv("SWFS_CHUNK_READAHEAD", "32")
+    monkeypatch.setenv("SWFS_HTTP_POOL_SIZE", "5")
+    chunk_pipeline.refresh_config()
+    assert chunk_pipeline.get_window(64) == 5
+    assert chunk_pipeline.put_window() == 5
+
+
+class _FakeChunk:
+    def __init__(self, fid):
+        self.file_id = fid
+        self.offset = -1
+
+
+def test_upload_window_ordered_offsets(monkeypatch):
+    monkeypatch.setenv("SWFS_CHUNK_UPLOAD_OVERLAP", "4")
+    chunk_pipeline.refresh_config()
+    seq = []
+
+    def save(data):
+        time.sleep(0.002 * (5 - len(data)))  # later chunks finish first
+        seq.append(data)
+        return _FakeChunk(f"f{len(data)}")
+
+    win = chunk_pipeline.UploadWindow(save)
+    win.add(b"a" * 5, 0)
+    win.add(b"b" * 3, 5)
+    win.add(b"c" * 1, 8)
+    chunks = win.finish()
+    assert [(c.file_id, c.offset) for c in chunks] == \
+        [("f5", 0), ("f3", 5), ("f1", 8)], \
+        "chunk list must be submit-ordered with stamped offsets"
+
+
+def test_upload_window_failure_cancels_and_reports_saved(monkeypatch):
+    monkeypatch.setenv("SWFS_CHUNK_UPLOAD_OVERLAP", "2")
+    chunk_pipeline.refresh_config()
+    saved = []
+
+    def save(data):
+        if data == b"BAD":
+            raise IOError("volume refused")
+        c = _FakeChunk(f"fid-{data.decode()}")
+        saved.append(c.file_id)
+        return c
+
+    win = chunk_pipeline.UploadWindow(save)
+    win.add(b"one", 0)
+    win.add(b"two", 3)
+    win.add(b"BAD", 6)
+    with pytest.raises(IOError, match="volume refused"):
+        # the failure surfaces on a later add() or at finish()
+        win.add(b"three", 9)
+        win.finish()
+    fids = win.saved_fids()
+    assert set(fids) == set(saved), \
+        "every chunk that landed must be offered for GC — no leaks"
+    assert "fid-one" in fids and "fid-two" in fids
+
+
+def test_upload_window_bounds_concurrency(monkeypatch):
+    monkeypatch.setenv("SWFS_CHUNK_UPLOAD_OVERLAP", "2")
+    chunk_pipeline.refresh_config()
+    lock = threading.Lock()
+    live, peak = [0], [0]
+
+    def save(data):
+        with lock:
+            live[0] += 1
+            peak[0] = max(peak[0], live[0])
+        time.sleep(0.02)
+        with lock:
+            live[0] -= 1
+        return _FakeChunk(f"f{len(data)}")
+
+    win = chunk_pipeline.UploadWindow(save)
+    for i in range(8):
+        win.add(bytes(i + 1), i)
+    assert len(win.finish()) == 8
+    assert peak[0] <= 2, f"upload window must bound fan-out ({peak[0]})"
+
+
+def test_lease_pool_refill_is_single_flight(monkeypatch):
+    """W overlapped uploads draining a key together must trigger ONE
+    batched Assign, not W (each reserving a whole block)."""
+    import seaweedfs_tpu.wdclient.lease as lease_mod
+    from seaweedfs_tpu.wdclient.lease import FidLeasePool
+
+    calls = []
+    call_lock = threading.Lock()
+
+    def fake_assign(master, *, count=1, collection="", replication="",
+                    ttl="", data_center=""):
+        from seaweedfs_tpu.operation import AssignResult
+
+        with call_lock:
+            calls.append(count)
+        time.sleep(0.05)  # a real master RPC takes a while
+        return AssignResult(fid=f"7,{len(calls):x}00000000", url="u",
+                            public_url="u", count=count, auth="")
+
+    monkeypatch.setattr(lease_mod, "assign", fake_assign)
+    pool = FidLeasePool("m", batch=64)
+    got = []
+
+    def worker():
+        got.append(pool.acquire())
+
+    ts = [threading.Thread(target=worker) for _ in range(6)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10)
+    assert len(got) == 6 and all(not a.error for a in got)
+    assert len(calls) == 1, \
+        f"concurrent drain must single-flight the refill (saw {calls})"
+    # and the fids handed out are distinct
+    assert len({a.fid for a in got}) == 6
+
+
+def test_fanout_tiers_are_isolated_pools():
+    """Deadlock guard: pipeline-tier tasks block on volume handlers
+    whose replica fan-out runs in the `replicate` tier — a saturated
+    pipeline pool must never starve replicate sends (combined
+    filer+volume processes would otherwise circular-wait)."""
+    from seaweedfs_tpu.utils import fanout
+
+    assert fanout.executor("pipeline") is not fanout.executor("replicate")
+    gate = threading.Event()
+    blockers = [fanout.submit(gate.wait, 10) for _ in range(32)]
+    try:
+        # every pipeline thread is now blocked (32 > the 16-thread
+        # pool); the replicate tier must still make progress
+        t0 = time.monotonic()
+        out = fanout.run_all(lambda x: x * 2, [1, 2, 3],
+                             pool="replicate")
+        assert out == [2, 4, 6]
+        assert time.monotonic() - t0 < 5.0, \
+            "replicate tier starved behind a saturated pipeline tier"
+    finally:
+        gate.set()
+        for f in blockers:
+            f.result(timeout=10)
+
+
+# -- live-cluster identity suite --------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def cluster(tmp_path_factory):
+    old_native = os.environ.get("SEAWEEDFS_TPU_NATIVE")
+    os.environ["SEAWEEDFS_TPU_NATIVE"] = "0"
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(
+        directories=[str(tmp_path_factory.mktemp("vol"))],
+        master=f"localhost:{mport}", ip="localhost", port=_free_port(),
+        pulse_seconds=1)
+    vsrv.start()
+    fsrv = FilerServer(ip="localhost", port=_free_port(),
+                       master=f"localhost:{mport}",
+                       store_dir=str(tmp_path_factory.mktemp("filer")),
+                       chunk_size=CHUNK)
+    fsrv.start()
+    deadline = time.time() + 10
+    while time.time() < deadline and not master.topo.nodes:
+        time.sleep(0.05)
+    yield master, vsrv, fsrv
+    fsrv.stop()
+    vsrv.stop()
+    master.stop()
+    rpc.reset_channels()
+    if old_native is None:
+        os.environ.pop("SEAWEEDFS_TPU_NATIVE", None)
+    else:
+        os.environ["SEAWEEDFS_TPU_NATIVE"] = old_native
+
+
+@pytest.fixture()
+def _pipeline_off(monkeypatch):
+    monkeypatch.setenv("SWFS_CHUNK_PIPELINE", "0")
+    chunk_pipeline.refresh_config()
+    yield
+    chunk_pipeline.refresh_config()
+
+
+def test_get_put_identity_readahead_on_off(cluster):
+    """The acceptance hash pin: a 12-chunk body PUT with overlap ON is
+    byte-identical when GET with readahead ON and OFF; a body PUT with
+    overlap OFF reads back identically through the windowed path."""
+    _, _, fsrv = cluster
+    base = f"http://{fsrv.address}"
+    body = os.urandom(12 * CHUNK + 777)
+    want = _sha(body)
+
+    r = requests.put(f"{base}/pipe/on.bin", data=body, timeout=60)
+    assert r.status_code == 201, r.text
+    launched0 = CHUNK_PIPELINE_OPS.value(direction="get",
+                                         result="launched")
+    g = requests.get(f"{base}/pipe/on.bin", timeout=60)
+    assert g.status_code == 200 and _sha(g.content) == want
+    assert CHUNK_PIPELINE_OPS.value(direction="get",
+                                    result="launched") > launched0, \
+        "the windowed path must actually engage on a 13-view GET"
+
+    os.environ["SWFS_CHUNK_PIPELINE"] = "0"
+    chunk_pipeline.refresh_config()
+    try:
+        g = requests.get(f"{base}/pipe/on.bin", timeout=60)
+        assert g.status_code == 200 and _sha(g.content) == want
+        r = requests.put(f"{base}/pipe/off.bin", data=body, timeout=60)
+        assert r.status_code == 201, r.text
+    finally:
+        os.environ.pop("SWFS_CHUNK_PIPELINE", None)
+        chunk_pipeline.refresh_config()
+    g = requests.get(f"{base}/pipe/off.bin", timeout=60)
+    assert g.status_code == 200 and _sha(g.content) == want
+
+
+def test_ranged_reads_start_mid_window(cluster):
+    """Ranged reads whose start lands mid-object (so the window opens
+    on a partial first view) are identical across both arms."""
+    _, _, fsrv = cluster
+    base = f"http://{fsrv.address}"
+    body = os.urandom(10 * CHUNK)
+    r = requests.put(f"{base}/pipe/rng.bin", data=body, timeout=60)
+    assert r.status_code == 201, r.text
+    spans = [(CHUNK + 17, 7 * CHUNK + 23),     # mid-chunk -> mid-chunk
+             (3 * CHUNK, 10 * CHUNK - 1),      # aligned start, tail
+             (5 * CHUNK - 1, 5 * CHUNK + 1)]   # straddles one boundary
+    for lo, hi in spans:
+        hdr = {"Range": f"bytes={lo}-{hi}"}
+        on = requests.get(f"{base}/pipe/rng.bin", headers=hdr, timeout=60)
+        assert on.status_code == 206
+        assert on.content == body[lo:hi + 1], f"range {lo}-{hi} (on)"
+        os.environ["SWFS_CHUNK_PIPELINE"] = "0"
+        chunk_pipeline.refresh_config()
+        try:
+            off = requests.get(f"{base}/pipe/rng.bin", headers=hdr,
+                               timeout=60)
+        finally:
+            os.environ.pop("SWFS_CHUNK_PIPELINE", None)
+            chunk_pipeline.refresh_config()
+        assert off.status_code == 206 and off.content == on.content
+
+
+def test_get_disconnect_cancels_prefetch(cluster):
+    """A client vanishing mid-stream must not make the filer fetch the
+    rest of a large object: queued prefetches are cancelled."""
+    _, vsrv, fsrv = cluster
+    base = f"http://{fsrv.address}"
+    body = os.urandom(64 * CHUNK)  # 4MB, 64 views
+    r = requests.put(f"{base}/pipe/dc.bin", data=body, timeout=120)
+    assert r.status_code == 201, r.text
+    launched0 = CHUNK_PIPELINE_OPS.value(direction="get",
+                                         result="launched")
+    cancelled0 = CHUNK_PIPELINE_OPS.value(direction="get",
+                                          result="cancelled")
+    # slow every volume read a little so the window stays populated
+    with failpoint.active("volume.http.read", mode="delay", p=0.03):
+        s = socket.create_connection(("localhost", fsrv.port), timeout=30)
+        s.sendall(b"GET /pipe/dc.bin HTTP/1.1\r\n"
+                  b"Host: localhost\r\n\r\n")
+        s.recv(CHUNK)  # headers + the first bytes
+        # hard close with unread data -> RST -> the filer's next write
+        # fails and the stream generator is closed
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                     __import__("struct").pack("ii", 1, 0))
+        s.close()
+        time.sleep(1.5)  # let the abort propagate + stragglers settle
+    launched = CHUNK_PIPELINE_OPS.value(direction="get",
+                                        result="launched") - launched0
+    cancelled = CHUNK_PIPELINE_OPS.value(direction="get",
+                                         result="cancelled") - cancelled0
+    assert launched < 64, \
+        f"disconnect must not fetch the whole object ({launched}/64)"
+    assert cancelled >= 1, "pending prefetches must be cancelled"
+
+
+class _ShortReader:
+    """A body that ends after `avail` bytes despite a larger declared
+    Content-Length — a client dying mid-PUT."""
+
+    def __init__(self, avail: int):
+        self._left = avail
+
+    def read(self, n: int) -> bytes:
+        take = min(n, self._left)
+        self._left -= take
+        return b"s" * take
+
+
+def test_short_body_put_raises_and_gcs_chunks(cluster):
+    """Satellite bugfix pin: a known-length PUT whose body ends short
+    must NOT commit a truncated entry — it raises, and every chunk
+    that was already saved is GC'd (verified needle-level)."""
+    master, _, fsrv = cluster
+    gc_calls = []
+    orig_gc = fsrv._gc_chunks
+
+    def spy_gc(fids):
+        gc_calls.append(list(fids))
+        return orig_gc(fids)
+
+    fsrv._gc_chunks = spy_gc
+    try:
+        with pytest.raises(chunk_pipeline.ShortBodyError):
+            fsrv.write_stream("/pipe/short.bin",
+                              _ShortReader(5 * CHUNK + 100), 9 * CHUNK)
+    finally:
+        fsrv._gc_chunks = orig_gc
+    from seaweedfs_tpu.filer.filer import NotFound
+
+    with pytest.raises(NotFound):
+        fsrv.filer.find_entry("/pipe/short.bin")
+    saved = [f for call in gc_calls for f in call]
+    assert saved, "the partially-uploaded chunks must be offered to GC"
+    for fid in saved:
+        for url in fsrv.master_client.lookup_file_id(fid):
+            assert requests.get(url, timeout=30).status_code == 404, \
+                f"leaked needle {fid}"
+
+
+def test_short_body_http_answers_400(cluster):
+    """The HTTP mapping: a short-body PUT gets a 4xx (client error),
+    not a 500, and no entry is committed."""
+    _, _, fsrv = cluster
+    s = socket.create_connection(("localhost", fsrv.port), timeout=30)
+    s.sendall(b"PUT /pipe/short-http.bin HTTP/1.1\r\n"
+              b"Host: localhost\r\n"
+              b"Content-Length: 400000\r\n\r\n")
+    s.sendall(b"x" * 90000)
+    s.shutdown(socket.SHUT_WR)  # EOF the body, keep reading the reply
+    reply = b""
+    s.settimeout(30)
+    try:
+        while b"\r\n\r\n" not in reply:
+            piece = s.recv(4096)
+            if not piece:
+                break
+            reply += piece
+    finally:
+        s.close()
+    assert reply.startswith(b"HTTP/1.1 400"), reply[:120]
+    assert requests.get(
+        f"http://{fsrv.address}/pipe/short-http.bin",
+        timeout=30).status_code == 404, "truncated entry committed"
+
+
+def test_s3_incomplete_body_maps_to_400(cluster, tmp_path):
+    """The S3 gateway analogue: a short body at the gateway answers
+    400 IncompleteBody (spec-shaped XML), and nothing is committed."""
+    from seaweedfs_tpu.s3api.server import S3Server
+
+    _, _, fsrv = cluster
+    s3 = S3Server(port=_free_port(), filer=fsrv.address)
+    s3.start()
+    try:
+        base = f"http://localhost:{s3.port}"
+        assert requests.put(f"{base}/sbb", timeout=30).status_code == 200
+        s = socket.create_connection(("localhost", s3.port), timeout=30)
+        s.sendall(b"PUT /sbb/short.obj HTTP/1.1\r\n"
+                  b"Host: localhost\r\n"
+                  b"Content-Length: 300000\r\n\r\n")
+        s.sendall(b"y" * 12345)
+        s.shutdown(socket.SHUT_WR)
+        reply = b""
+        s.settimeout(30)
+        try:
+            while True:
+                piece = s.recv(4096)
+                if not piece:
+                    break
+                reply += piece
+        finally:
+            s.close()
+        assert reply.startswith(b"HTTP/1.1 400"), reply[:120]
+        assert b"IncompleteBody" in reply, reply[-400:]
+        assert requests.get(f"{base}/sbb/short.obj",
+                            timeout=30).status_code == 404
+    finally:
+        s3.stop()
+
+
+# -- native volume plane + HTTPS arms ---------------------------------------
+
+
+def test_identity_native_volume_plane(tmp_path, monkeypatch):
+    """readahead on/off identity with the C++ volume data plane serving
+    the chunk fetches (the filer←volume leg the windows fan over)."""
+    from seaweedfs_tpu.native import native_available
+
+    if not native_available():
+        pytest.skip("native toolchain unavailable")
+    # the module cluster fixture forces the python plane process-wide;
+    # this test explicitly wants the C++ plane
+    monkeypatch.setenv("SEAWEEDFS_TPU_NATIVE", "1")
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "vol")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), native=True)
+    vsrv.start()
+    fsrv = FilerServer(ip="localhost", port=_free_port(),
+                       master=f"localhost:{mport}",
+                       store_dir=str(tmp_path / "filer"),
+                       chunk_size=CHUNK)
+    fsrv.start()
+    try:
+        assert vsrv.native_plane is not None
+        deadline = time.time() + 10
+        while time.time() < deadline and not master.topo.nodes:
+            time.sleep(0.05)
+        base = f"http://{fsrv.address}"
+        body = os.urandom(10 * CHUNK + 99)
+        want = _sha(body)
+        r = requests.put(f"{base}/nat/big.bin", data=body, timeout=60)
+        assert r.status_code == 201, r.text
+        g = requests.get(f"{base}/nat/big.bin", timeout=60)
+        assert g.status_code == 200 and _sha(g.content) == want
+        os.environ["SWFS_CHUNK_PIPELINE"] = "0"
+        chunk_pipeline.refresh_config()
+        try:
+            g = requests.get(f"{base}/nat/big.bin", timeout=60)
+            assert g.status_code == 200 and _sha(g.content) == want
+        finally:
+            os.environ.pop("SWFS_CHUNK_PIPELINE", None)
+            chunk_pipeline.refresh_config()
+    finally:
+        fsrv.stop()
+        vsrv.stop()
+        master.stop()
+        rpc.reset_channels()
+
+
+def test_identity_https_data_plane(tmp_path, monkeypatch):
+    """readahead on/off identity with TLS on both the filer listener and
+    the filer←volume pooled leg (the window fans over encrypted
+    connections and must stay inside the pool's warm-set bound)."""
+    from seaweedfs_tpu.security.tls import ensure_self_signed, https_env
+    from seaweedfs_tpu.wdclient.pool import POOL
+
+    paths = ensure_self_signed(str(tmp_path / "pki"))
+    for k, v in https_env(paths).items():
+        monkeypatch.setenv(k, v)
+    POOL.clear()
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    vsrv = VolumeServer(directories=[str(tmp_path / "vol")],
+                        master=f"localhost:{mport}", ip="localhost",
+                        port=_free_port(), pulse_seconds=1)
+    vsrv.start()
+    fsrv = FilerServer(ip="localhost", port=_free_port(),
+                       master=f"localhost:{mport}",
+                       store_dir=str(tmp_path / "filer"),
+                       chunk_size=CHUNK)
+    fsrv.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and not master.topo.nodes:
+            time.sleep(0.05)
+        base = f"https://{fsrv.address}"
+        body = os.urandom(9 * CHUNK + 5)
+        want = _sha(body)
+        r = requests.put(f"{base}/tls/big.bin", data=body, timeout=60,
+                         verify=paths["ca"])
+        assert r.status_code == 201, r.text
+        g = requests.get(f"{base}/tls/big.bin", timeout=60,
+                         verify=paths["ca"])
+        assert g.status_code == 200 and _sha(g.content) == want
+        lo, hi = CHUNK + 3, 6 * CHUNK + 50
+        rng = requests.get(f"{base}/tls/big.bin", timeout=60,
+                           verify=paths["ca"],
+                           headers={"Range": f"bytes={lo}-{hi}"})
+        assert rng.status_code == 206 and rng.content == body[lo:hi + 1]
+        os.environ["SWFS_CHUNK_PIPELINE"] = "0"
+        chunk_pipeline.refresh_config()
+        try:
+            g = requests.get(f"{base}/tls/big.bin", timeout=60,
+                             verify=paths["ca"])
+            assert g.status_code == 200 and _sha(g.content) == want
+        finally:
+            os.environ.pop("SWFS_CHUNK_PIPELINE", None)
+            chunk_pipeline.refresh_config()
+    finally:
+        fsrv.stop()
+        vsrv.stop()
+        master.stop()
+        POOL.clear()
+        rpc.reset_channels()

@@ -102,7 +102,7 @@ def test_harness_smoke_all_shapes_and_clean_shutdown():
         "a server had to be SIGKILLed at teardown"
     shapes = out["shapes"]
     assert set(shapes) == {"zipf_read", "put_flood", "archival",
-                           "degraded_read"}
+                           "degraded_read", "bigfile"}
     for name, s in shapes.items():
         assert s["ok"] > 0, f"shape {name} produced zero goodput: {s}"
         assert s["offered"] >= s["ok"]
@@ -111,7 +111,9 @@ def test_harness_smoke_all_shapes_and_clean_shutdown():
             assert s.get("p50_ms", 0) > 0 and s.get("p99_ms", 0) > 0
     # the open-loop shapes must not silently collapse into errors:
     # transient churn is tolerated, an error-dominated run is not
-    for name in ("zipf_read", "put_flood", "degraded_read"):
+    # (bigfile errors include sha mismatches — the ISSUE-14 pipelined
+    # path's identity contract rides the same bound)
+    for name in ("zipf_read", "put_flood", "degraded_read", "bigfile"):
         s = shapes[name]
         assert s["errors"] <= max(2, 0.1 * s["offered"]), \
             f"shape {name} error-dominated: {s}"

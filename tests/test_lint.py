@@ -89,3 +89,32 @@ def test_lint_catches_wall_clock_in_trace_plane(tmp_path):
     # the real tracing module is clean under the rule (its single
     # wall-clock read is the marked anchor)
     assert lint.run_span_timing_rule() == []
+
+
+def test_lint_catches_bare_executor_on_serving_paths(tmp_path):
+    """SWFS003 (ISSUE 14 satellite): bare ThreadPoolExecutor
+    construction inside server/ + filer/ is an error — fan-out belongs
+    on the shared bounded executor (utils/fanout.py) — while sites
+    carrying the `lint: allow-executor` justification stay exempt."""
+    lint = _load_lint()
+    bad = tmp_path / "hotpath.py"
+    bad.write_text(
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "import concurrent.futures as cf\n"
+        "def fan(items):\n"
+        "    with ThreadPoolExecutor(max_workers=4) as ex:\n"
+        "        return list(ex.map(str, items))\n"
+        "def fan2(items):\n"
+        "    with cf.ThreadPoolExecutor(max_workers=4) as ex:\n"
+        "        return list(ex.map(str, items))\n"
+        "def blessed(items):\n"
+        "    # lint: allow-executor — startup-only, joined at exit\n"
+        "    with ThreadPoolExecutor(max_workers=4) as ex:\n"
+        "        return list(ex.map(str, items))\n")
+    findings = lint.run_executor_rule([str(bad)])
+    assert len(findings) == 2 and all("SWFS003" in f for f in findings), \
+        findings
+
+    # the serving packages themselves are clean under the rule (every
+    # remaining scoped pool carries its justification marker)
+    assert lint.run_executor_rule() == []

@@ -174,7 +174,8 @@ class Cluster:
     """One spawned master + N volume servers + filer + S3 gateway."""
 
     def __init__(self, servers: int, extra_env: dict | None = None,
-                 volume_env: dict | None = None):
+                 volume_env: dict | None = None,
+                 filer_env: dict | None = None):
         self.tmp = tempfile.mkdtemp(prefix="swfs-harness-")
         self.procs: list = []
         self.extra_env = dict(extra_env or {})
@@ -201,10 +202,16 @@ class Cluster:
             self.procs.append(spawn(args, log, env))
         fport = free_port()
         self.filer = f"localhost:{fport}"
+        fenv = dict(self.extra_env)
+        fenv.update(filer_env or {})
+        # 1MB chunks: the bigfile shape's multi-chunk objects stay cheap
+        # on this box (small-file shapes are unaffected — their bodies
+        # are far below either chunk size)
         self.procs.append(spawn(
             ["filer", "-port", str(fport), "-master", self.master,
-             "-dir", os.path.join(self.tmp, "filer"), "-store", "memory"],
-            os.path.join(self.tmp, "filer.log"), self.extra_env))
+             "-dir", os.path.join(self.tmp, "filer"), "-store", "memory",
+             "-maxMB", "1"],
+            os.path.join(self.tmp, "filer.log"), fenv))
         s3port = free_port()
         self.s3 = f"localhost:{s3port}"
         self.procs.append(spawn(
@@ -419,6 +426,51 @@ def shape_degraded_read(vol_addr: str, fids: list[str],
     _paced_loop(stats, rps, deadline, one, workers=workers)
 
 
+def shape_bigfile(cluster: Cluster, stats: ShapeStats, rps: float,
+                  deadline: float, workers: int = 2,
+                  body_bytes: int = 3 << 20):
+    """Large multi-chunk objects through the filer data path (ISSUE 14):
+    alternating PUT of a fresh big object / sha-verified GET of a staged
+    one — the leg the pipelined chunk engine (readahead + upload
+    overlap) exists for. A sha mismatch records as an error: identity
+    across the windowed path is part of the shape's contract."""
+    import hashlib
+    import itertools
+
+    tl = _Local()
+    seq = itertools.count()
+    body = os.urandom(body_bytes)
+    want = hashlib.sha256(body).hexdigest()
+    staged: list[str] = []
+
+    def one():
+        i = next(seq)
+        pool = staged[-4:]  # snapshot: other workers mutate the list
+        with trace.span(f"harness.{stats.name}", component="harness",
+                        server="harness") as sp:
+            if i % 3 == 0 or not pool:
+                path = f"/buckets/bigf/o{i}"
+                r = tl.session.put(
+                    _u(cluster.filer, path), data=body,
+                    verify=_verify(),
+                    headers=trace.inject_headers({}), timeout=120)
+                if r.status_code < 300:
+                    staged.append(path)
+                return r.status_code, r.headers.get("X-Trace-Id",
+                                                    sp.trace_id)
+            path = pool[tl.rng.randrange(len(pool))]
+            r = tl.session.get(_u(cluster.filer, path), verify=_verify(),
+                               headers=trace.inject_headers({}),
+                               timeout=120)
+            status = r.status_code
+            if status == 200 and \
+                    hashlib.sha256(r.content).hexdigest() != want:
+                status = 599  # sha mismatch counts as an error
+            return status, r.headers.get("X-Trace-Id", sp.trace_id)
+
+    _paced_loop(stats, rps, deadline, one, workers=workers)
+
+
 def shape_archival(env, cluster: Cluster, stats: ShapeStats,
                    deadline: float, vol_mb: float):
     """Back-to-back replica->EC conversions: fill a small volume, then
@@ -545,7 +597,7 @@ def run_phase(tag: str, *, servers: int, duration: float,
     cluster = Cluster(servers, extra_env=qos_env, volume_env=volume_env)
     shapes = {name: ShapeStats(name)
               for name in ("zipf_read", "put_flood", "archival",
-                           "degraded_read")}
+                           "degraded_read", "bigfile")}
     out: dict = {"tag": tag, "servers": servers,
                  "duration_s": duration, "qos_env": qos_env or {}}
     try:
@@ -571,6 +623,9 @@ def run_phase(tag: str, *, servers: int, duration: float,
             threading.Thread(target=shape_archival, args=(
                 env, cluster, shapes["archival"], deadline, vol_mb),
                 daemon=True),
+            threading.Thread(target=shape_bigfile, args=(
+                cluster, shapes["bigfile"], rates["bigfile"],
+                deadline), daemon=True),
         ]
         for t in threads:
             t.start()
@@ -683,7 +738,7 @@ QOS_OFF_ENV = {
 }
 
 DEFAULT_RATES = {"zipf_read": 30.0, "put_flood": 50.0,
-                 "degraded_read": 15.0}
+                 "degraded_read": 15.0, "bigfile": 1.5}
 
 
 def run_ab(servers: int, duration: float, vol_mb: float,
@@ -752,6 +807,299 @@ def run_ab(servers: int, duration: float, vol_mb: float,
         "sheds as fast 429/SlowDown instead of queueing into the tail "
         "— both arms at identical offered rates, every rejection "
         "trace-resolvable.")
+    return out
+
+
+# -- pipelined chunk path A/B (ISSUE 14) -------------------------------------
+
+BIGFILE_CHUNKS = 8        # >= 8-chunk objects (the acceptance gate's floor)
+BIGFILE_CHUNK_BYTES = 1 << 20   # the harness filer runs -maxMB 1
+BIGFILE_SET = 12          # 12 x 8MB > the filer's 64MB chunk cache
+SMALL_N = 24
+
+
+def _pct(lats: list[float], q: float):
+    if not lats:
+        return None
+    lats = sorted(lats)
+    return round(lats[min(int(len(lats) * q), len(lats) - 1)], 2)
+
+
+def _filer_status(cluster: Cluster) -> dict:
+    try:
+        return requests.get(_u(cluster.filer, "/status"),
+                            verify=_verify(), timeout=10).json()
+    except (requests.RequestException, ValueError):
+        return {}
+
+
+def _bigfile_phase(tag: str, *, servers: int, duration: float,
+                   wire_ms: float, pipeline_on: bool) -> dict:
+    """One arm: fresh cluster, symmetric per-chunk wire latency injected
+    at the volume HTTP read AND write sites (delay failpoints — the
+    PR-6 netem pattern), the filer's chunk pipeline ON or OFF via env.
+    Drives paced big PUTs + sha-verified big GETs + a PR-2-shape
+    small-file segment at IDENTICAL offered rates in both arms, then an
+    8-reader windowed burst, and snapshots the chunk-cache / pool /
+    pipeline counters that prove the no-eviction and no-pool-exhaustion
+    acceptance clauses."""
+    import hashlib
+    import random as _random
+
+    filer_env = {"SWFS_CHUNK_PIPELINE": "1" if pipeline_on else "0",
+                 "SWFS_CHUNK_READAHEAD": "4"}
+    volume_env = {}
+    if wire_ms > 0:
+        d = round(wire_ms / 1000.0, 4)
+        volume_env["SWFS_FAILPOINTS"] = (
+            f"volume.http.read=delay({d});volume.http.write=delay({d})")
+    cluster = Cluster(servers, volume_env=volume_env, filer_env=filer_env)
+    out: dict = {"tag": tag, "pipeline_on": pipeline_on,
+                 "wire_ms_per_chunk_leg": wire_ms}
+    nbytes = BIGFILE_CHUNKS * BIGFILE_CHUNK_BYTES
+    body = _random.Random(1402).randbytes(nbytes)
+    sha_ok = True
+    try:
+        cluster.wait(servers)
+        s = requests.Session()
+
+        # -- stage: a big-object working set LARGER than the filer's
+        #    chunk cache (default 64MB), so GETs measure the actual
+        #    filer→volume data path in both arms — large-object traffic
+        #    that fit in filer RAM would not need a pipeline. (It also
+        #    surfaces the cache story: the OFF arm's read-through
+        #    population thrashes the cache with big chunks, the ON
+        #    arm's populate-bypass leaves the small working set alone.)
+        #    Plus the small working set whose residency is the probe.
+        big_shas = []
+        for i in range(BIGFILE_SET):
+            b = _random.Random(1402 + i).randbytes(nbytes)
+            big_shas.append(hashlib.sha256(b).hexdigest())
+            r = s.put(_u(cluster.filer, f"/buckets/bigf/seed{i}"),
+                      data=b, verify=_verify(), timeout=300)
+            assert r.status_code < 300, f"stage big PUT {r.status_code}"
+        small_bodies = {}
+        for i in range(SMALL_N):
+            sb = _random.Random(2000 + i).randbytes(2048)
+            small_bodies[i] = sb
+            r = s.put(_u(cluster.filer, f"/buckets/smallws/o{i}"),
+                      data=sb, verify=_verify(), timeout=30)
+            assert r.status_code < 300, f"stage small PUT {r.status_code}"
+        for i in range(SMALL_N):  # populate the read-through cache
+            s.get(_u(cluster.filer, f"/buckets/smallws/o{i}"),
+                  verify=_verify(), timeout=30)
+        cc0 = _filer_status(cluster).get("ChunkCache", {})
+
+        # -- measured segment: paced big GET + big PUT + smallfile loops
+        #    at identical offered rates across arms
+        get_lats: list[float] = []
+        put_lats: list[float] = []
+        small_lats: list[float] = []
+        errors = {"get": 0, "put": 0, "small": 0}
+        deadline = time.monotonic() + duration
+
+        def loop(rate, fn, lats, ekey):
+            period = 1.0 / rate
+            next_t = time.monotonic()
+            while time.monotonic() < deadline:
+                now = time.monotonic()
+                if now < next_t:
+                    time.sleep(min(next_t - now, 0.1))
+                    continue
+                next_t = max(next_t + period, now - 3 * period)
+                t0 = time.perf_counter()
+                try:
+                    fn()
+                    lats.append((time.perf_counter() - t0) * 1e3)
+                except Exception:  # noqa: BLE001
+                    errors[ekey] += 1
+
+        import itertools
+        pseq = itertools.count()
+        gseq = itertools.count()
+
+        def big_get():
+            nonlocal sha_ok
+            i = next(gseq) % BIGFILE_SET
+            r = s.get(_u(cluster.filer, f"/buckets/bigf/seed{i}"),
+                      verify=_verify(), timeout=300)
+            if r.status_code != 200:
+                raise IOError(f"GET {r.status_code}")
+            if hashlib.sha256(r.content).hexdigest() != big_shas[i]:
+                sha_ok = False
+                raise IOError("sha mismatch")
+
+        ps = requests.Session()
+
+        def big_put():
+            r = ps.put(_u(cluster.filer, f"/buckets/bigf/p{next(pseq)}"),
+                       data=body, verify=_verify(), timeout=300)
+            if r.status_code >= 300:
+                raise IOError(f"PUT {r.status_code}")
+
+        ss = requests.Session()
+        sseq = itertools.count()
+
+        def small_op():
+            i = next(sseq)
+            if i % 2 == 0:
+                r = ss.put(_u(cluster.filer, f"/buckets/smallfl/n{i}"),
+                           data=small_bodies[i % SMALL_N],
+                           verify=_verify(), timeout=30)
+            else:
+                r = ss.get(_u(cluster.filer,
+                              f"/buckets/smallws/o{i % SMALL_N}"),
+                           verify=_verify(), timeout=30)
+            if r.status_code >= 300:
+                raise IOError(f"small {r.status_code}")
+
+        threads = [
+            threading.Thread(target=loop,
+                             args=(3.0, big_get, get_lats, "get"),
+                             daemon=True),
+            threading.Thread(target=loop,
+                             args=(1.5, big_put, put_lats, "put"),
+                             daemon=True),
+            threading.Thread(target=loop,
+                             args=(20.0, small_op, small_lats, "small"),
+                             daemon=True),
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=duration + 300)
+
+        # -- 8 concurrent windowed readers: the pool-exhaustion probe
+        burst_errors = [0]
+
+        def burst_reader(k: int):
+            sess = requests.Session()
+            for j in range(2):
+                i = (k * 2 + j) % BIGFILE_SET
+                try:
+                    r = sess.get(
+                        _u(cluster.filer, f"/buckets/bigf/seed{i}"),
+                        verify=_verify(), timeout=300)
+                    if r.status_code != 200 or hashlib.sha256(
+                            r.content).hexdigest() != big_shas[i]:
+                        burst_errors[0] += 1
+                except Exception:  # noqa: BLE001
+                    burst_errors[0] += 1
+
+        readers = [threading.Thread(target=burst_reader, args=(k,),
+                                    daemon=True)
+                   for k in range(8)]
+        for t in readers:
+            t.start()
+        for t in readers:
+            t.join(timeout=300)
+
+        # -- small working set re-read: every one a cache hit unless the
+        #    big storm evicted it
+        cc_mid = _filer_status(cluster).get("ChunkCache", {})
+        for i in range(SMALL_N):
+            r = s.get(_u(cluster.filer, f"/buckets/smallws/o{i}"),
+                      verify=_verify(), timeout=30)
+            if r.status_code != 200 or r.content != small_bodies[i]:
+                errors["small"] += 1
+        st = _filer_status(cluster)
+        cc1 = st.get("ChunkCache", {})
+        hits_gained = int(cc1.get("hits", 0)) - int(cc_mid.get("hits", 0))
+        out.update({
+            "get": {"ops": len(get_lats), "errors": errors["get"],
+                    "p50_ms": _pct(get_lats, 0.5),
+                    "p90_ms": _pct(get_lats, 0.9)},
+            "put": {"ops": len(put_lats), "errors": errors["put"],
+                    "p50_ms": _pct(put_lats, 0.5),
+                    "p90_ms": _pct(put_lats, 0.9)},
+            "smallfile": {"ops": len(small_lats),
+                          "errors": errors["small"],
+                          "p50_ms": _pct(small_lats, 0.5)},
+            "sha_identical": sha_ok,
+            "burst_readers": 8, "burst_errors": burst_errors[0],
+            "small_rereads": SMALL_N,
+            "small_reread_cache_hits": hits_gained,
+            "small_working_set_resident": hits_gained >= SMALL_N,
+            "chunk_cache": {"staged": cc0, "after_storm": cc1},
+            "http_pool": st.get("HttpPool", {}),
+            "chunk_pipeline": st.get("ChunkPipeline", {}),
+        })
+    finally:
+        cluster.stop()
+        out["clean_shutdown"] = getattr(cluster, "clean_shutdown", False)
+    return out
+
+
+def run_bigfile_ab(servers: int = 1, duration: float = 10.0,
+                   rounds: int = 2, wire_ms: float = 15.0) -> dict:
+    """ISSUE 14 A/B: interleaved adjacent (off, on) phases — fresh
+    cluster each, identical offered rates and bodies, symmetric
+    per-chunk wire latency — measuring large-object GET/PUT wall with
+    the pipelined chunk engine off vs on, plus the PR-2-shape
+    small-file segment that must stay within noise."""
+    pairs = []
+    for r in range(rounds):
+        pair = {}
+        for tag, on in (("off", False), ("on", True)):
+            pair[tag] = _bigfile_phase(
+                f"{tag}_r{r}", servers=servers, duration=duration,
+                wire_ms=wire_ms, pipeline_on=on)
+        for leg in ("get", "put"):
+            off_p50 = pair["off"][leg].get("p50_ms")
+            on_p50 = pair["on"][leg].get("p50_ms")
+            if off_p50 and on_p50:
+                pair[f"{leg}_delta_pct"] = round(
+                    100.0 * (off_p50 - on_p50) / off_p50, 1)
+        so, sn = (pair[a]["smallfile"].get("p50_ms") for a in ("off", "on"))
+        if so and sn:
+            pair["smallfile_delta_pct"] = round(
+                100.0 * (so - sn) / so, 1)
+        pairs.append(pair)
+    out = {
+        "metric": "bigfile_pipeline_wall_ms",
+        "what": (
+            "ISSUE 14 A/B: >=8-chunk (8x1MB) objects PUT and GET "
+            "through the filer data path on a real multi-process "
+            "cluster, as interleaved adjacent (off, on) phases at "
+            "identical offered rates with identical bodies. "
+            f"{wire_ms}ms symmetric per-chunk wire latency is injected "
+            "at the volume HTTP read AND write sites (delay "
+            "failpoints, the PR-6 netem pattern) so the serialized "
+            "Σ(RTT+transfer) vs overlapped max() difference is visible "
+            "on a 2-core box. off = SWFS_CHUNK_PIPELINE=0 (sequential "
+            "chunk loop), on = bounded-window readahead (W=4) + "
+            "overlapped PUT upload fan-out. The smallfile segment is "
+            "the PR-2 shape (1KB single-chunk ops) and must stay "
+            "within noise; burst = 8 concurrent windowed readers "
+            "(pool-exhaustion probe); small_working_set_resident "
+            "proves the big storm did not evict the small-file cache "
+            "working set."),
+        "servers": servers, "duration_s": duration, "rounds": rounds,
+        "wire_ms_per_chunk_leg": wire_ms,
+        "chunks_per_object": BIGFILE_CHUNKS,
+        "pairs": pairs,
+    }
+    for leg in ("get", "put", "smallfile"):
+        deltas = sorted(p[f"{leg}_delta_pct"] for p in pairs
+                        if f"{leg}_delta_pct" in p)
+        out[f"{leg}_deltas_pct"] = deltas
+        out[f"{leg}_median_delta_pct"] = (
+            deltas[len(deltas) // 2] if deltas else None)
+    out["target_delta_pct"] = 25.0
+    out["sha_identical"] = all(
+        p[a].get("sha_identical") for p in pairs for a in ("off", "on"))
+    out["pool_exhaustion"] = any(
+        p[a].get("burst_errors", 1) > 0 for p in pairs
+        for a in ("off", "on"))
+    out["small_working_set_resident_on"] = all(
+        p["on"].get("small_working_set_resident") for p in pairs)
+    out["box_note"] = (
+        "2-core shared sandbox: the wire-latency phase is what makes "
+        "the overlap measurable here — per-chunk delay failpoints "
+        "sleep without burning CPU, so the A/B compares Σ(delay) "
+        "against max(delay) shapes rather than CPU contention. "
+        "Absolute walls are inflated by oversubscription; the paired "
+        "deltas at equal offered load are the signal.")
     return out
 
 
@@ -894,6 +1242,10 @@ def main() -> int:
     ap.add_argument("--smoke", action="store_true")
     ap.add_argument("--phase", choices=["on", "off"], default=None)
     ap.add_argument("--ab", action="store_true")
+    ap.add_argument("--bigfile-ab", action="store_true")
+    ap.add_argument("--wire-ms", type=float,
+                    default=float(os.environ.get("SWFS_HARNESS_WIRE_MS",
+                                                 "15")))
     ap.add_argument("--tls-flap", action="store_true")
     ap.add_argument("--https", action="store_true")
     ap.add_argument("--servers", type=int,
@@ -916,6 +1268,11 @@ def main() -> int:
         if opts.tls_flap:
             out = run_tls_flap(max(1, min(opts.servers, 2)),
                                vol_mb=min(opts.vol_mb, 2.0))
+        elif opts.bigfile_ab:
+            out = run_bigfile_ab(max(1, min(opts.servers, 2)),
+                                 duration=min(opts.duration, 20.0),
+                                 rounds=max(opts.rounds, 1),
+                                 wire_ms=opts.wire_ms)
         elif opts.smoke:
             out = run_smoke(opts.servers, min(opts.duration, 10.0),
                             min(opts.vol_mb, 1.0))

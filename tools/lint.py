@@ -54,6 +54,20 @@ SPAN_TIMING_FILES = (
     os.path.join("seaweedfs_tpu", "utils", "trace.py"),
 )
 
+# SWFS003 (ISSUE 14): bare ThreadPoolExecutor construction inside the
+# request-serving packages is a lint error — per-call pools pay thread
+# spawn/teardown on hot paths (the replicate_write bug) and mint
+# unbounded concurrency that stampedes the keep-alive pool. Fan-out
+# belongs on the shared bounded executor (seaweedfs_tpu/utils/fanout.py).
+# Startup/admin/scoped-join sites opt out with an explicit
+# `# lint: allow-executor` comment (same line or the line above)
+# carrying the justification.
+EXECUTOR_RULE_DIRS = (
+    os.path.join("seaweedfs_tpu", "server"),
+    os.path.join("seaweedfs_tpu", "filer"),
+)
+EXECUTOR_ALLOW_MARK = "lint: allow-executor"
+
 
 def _python_files() -> list[str]:
     out = []
@@ -171,6 +185,60 @@ def run_span_timing_rule(files: list[str] | None = None) -> list[str]:
     return findings
 
 
+class _ExecutorVisitor(ast.NodeVisitor):
+    """SWFS003: `ThreadPoolExecutor(...)` (bare name or attribute form)
+    construction inside the request-serving packages."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.findings: list[str] = []
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else "")
+        if name == "ThreadPoolExecutor":
+            self.findings.append(
+                f"{self.path}:{node.lineno}: SWFS003 bare "
+                f"ThreadPoolExecutor() on a serving path — use the "
+                f"shared bounded executor (seaweedfs_tpu/utils/"
+                f"fanout.py), or justify with `# {EXECUTOR_ALLOW_MARK}`")
+        self.generic_visit(node)
+
+
+def run_executor_rule(files: list[str] | None = None) -> list[str]:
+    """The SWFS003 rule over EXECUTOR_RULE_DIRS (or an explicit list);
+    a site is exempt when its line OR the line above carries the
+    `lint: allow-executor` justification marker."""
+    if files is None:
+        files = [p for p in _python_files()
+                 if any(os.sep + d + os.sep in p or
+                        p.startswith(os.path.join(REPO, d) + os.sep)
+                        for d in EXECUTOR_RULE_DIRS)]
+    findings: list[str] = []
+    for path in files:
+        rel = os.path.relpath(path, REPO) if os.path.isabs(path) else path
+        try:
+            with open(path, "rb") as f:
+                src = f.read()
+            tree = ast.parse(src, filename=rel)
+        except (OSError, SyntaxError):
+            continue
+        lines = src.decode(errors="replace").splitlines()
+        allowed = set()
+        for i, line in enumerate(lines):
+            if EXECUTOR_ALLOW_MARK in line:
+                # the marker blesses its own line and the next few: the
+                # justification is a short comment block above a
+                # possibly multi-line `with ThreadPoolExecutor(` stmt
+                allowed.update(range(i + 1, i + 6))
+        v = _ExecutorVisitor(rel)
+        v.visit(tree)
+        findings.extend(f for f in v.findings
+                        if int(f.split(":")[1]) not in allowed)
+    return findings
+
+
 def run_device_rule(files: list[str] | None = None) -> list[str]:
     """The in-repo device-enumeration rule; returns findings (files that
     fail to parse are the syntax gate's business, not this rule's)."""
@@ -215,7 +283,8 @@ def run_fallback() -> int:
 
 def main() -> int:
     rc = run_ruff() if shutil.which("ruff") else run_fallback()
-    extra = run_device_rule() + run_span_timing_rule()
+    extra = run_device_rule() + run_span_timing_rule() \
+        + run_executor_rule()
     for finding in extra:
         print(finding)
     if extra and rc == 0:
