@@ -64,7 +64,7 @@ from collections import OrderedDict
 
 import numpy as np
 
-from ..utils import numa, trace
+from ..utils import locks, numa, trace
 from ..utils.stats import (
     EC_DISPATCH_ARENA_INUSE,
     EC_DISPATCH_ARENA_OPS,
@@ -149,7 +149,9 @@ def _consumed(out_ref) -> bool:
         return not hasattr(out_ref, "block_until_ready")  # non-jax: sync
     try:
         return bool(fn())
-    except Exception:  # noqa: BLE001 — deleted/donated buffer etc.
+    # lint: allow-broad-except(a deleted/donated device buffer raising
+    # from is_ready() IS the proof its bytes were consumed)
+    except Exception:  # noqa: BLE001
         return True
 
 
@@ -196,7 +198,9 @@ class StackArena:
         self._inuse_bytes = 0
         self._quarantine: list[tuple[_ArenaBuf, object]] = []
         self._largest = 0
-        self._mu = threading.Lock()
+        # witnessed leaf lock (ISSUE 15): held briefly for pool
+        # bookkeeping, ranked after every dispatch-plane lock
+        self._mu = locks.wlock("dispatch.arena", rank=800)
 
     @staticmethod
     def _bucket(nbytes: int) -> int:
@@ -378,7 +382,7 @@ class _Slab:
 
 
 _schedulers: "weakref.WeakSet[EcDispatchScheduler]" = weakref.WeakSet()
-_attach_lock = threading.Lock()
+_attach_lock = locks.wlock("dispatch.attach")
 
 
 def scheduler_for(coder) -> "EcDispatchScheduler":
@@ -411,7 +415,9 @@ def shutdown_all() -> None:
     for sched in list(_schedulers):
         try:
             sched.close()
-        except Exception:  # noqa: BLE001 — teardown must visit every one
+        # lint: allow-broad-except(atexit teardown must visit every
+        # scheduler; one failed close must not strand the rest)
+        except Exception:  # noqa: BLE001
             pass
 
 
@@ -521,7 +527,9 @@ class EcDispatchScheduler:
         self.max_slabs = max_slabs or int(
             os.environ.get("SWFS_EC_DISPATCH_MAX_SLABS",
                            str(DEFAULT_MAX_SLABS)))
-        self._cv = threading.Condition()
+        # lane state condition — witnessed (ISSUE 15): always acquired
+        # AFTER _dispatch_mu on the flush path, never before it
+        self._cv = locks.wcondition("dispatch.lane_cv", rank=200)
         self._lanes: "OrderedDict[tuple, list[_Slab]]" = OrderedDict()
         # per-chip lane state — `_chips` resolves LAZILY on first submit:
         # asking a coder for its devices may instantiate the backend, and
@@ -541,7 +549,7 @@ class EcDispatchScheduler:
         # concurrently-submitted shard_map modules interleave their
         # cross-module rendezvous and deadlock XLA (caught by
         # tests/test_ec_pipeline.py under the 8-device test mesh).
-        self._dispatch_mu = threading.Lock()
+        self._dispatch_mu = locks.wlock("dispatch.mu", rank=100)
         # host memory plane (ISSUE 12): lazily built so the env gate can
         # flip between A/B arms without rebuilding schedulers
         self._arena: StackArena | None = None
@@ -634,10 +642,10 @@ class EcDispatchScheduler:
                     if devs and len(devs) > 1:
                         chips = list(devs)
                     self._chips = chips
-                except Exception:  # noqa: BLE001 — transiently
-                    # unreachable backend: DON'T cache, so the next
-                    # submit re-probes instead of silently pinning the
-                    # scheduler to the single-chip path forever
+                # lint: allow-broad-except(transiently unreachable
+                # backend: DON'T cache, so the next submit re-probes
+                # instead of pinning the single-chip path forever)
+                except Exception:  # noqa: BLE001
                     return []
             else:
                 self._chips = chips
@@ -1086,7 +1094,7 @@ class ReconstructIntervalCache:
         # state observed BEFORE an invalidate must not repopulate the
         # cache after it (reconstruct-vs-remount TOCTOU)
         self._gens: dict[int, int] = {}
-        self._lock = threading.Lock()
+        self._lock = locks.wlock("dispatch.recon_cache", rank=810)
 
     def enabled(self) -> bool:
         return self.max_bytes > 0

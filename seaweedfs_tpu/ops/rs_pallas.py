@@ -79,5 +79,7 @@ def gf_matmul_bits_pallas(matrix_bits: jax.Array, data: jax.Array,
 def pallas_available() -> bool:
     try:
         return jax.default_backend() == "tpu"
+    # lint: allow-broad-except(capability probe: a backend that cannot
+    # even report itself has no pallas plane — that is the answer)
     except Exception:
         return False

@@ -32,6 +32,7 @@ import threading
 
 from ..storage.crc import crc32c
 from ..utils import glog
+from ..utils.locks import wcondition
 from ..utils.retry import Backoff, is_retryable
 from ..utils.stats import SCRUB_GATHER_BYTES, SCRUB_GATHER_RESUMES
 
@@ -124,7 +125,7 @@ class ShardRangeGatherer:
         self.bytes_fetched = 0
         self.resumed_bytes = 0
         self.resumes = 0
-        self._cond = threading.Condition()
+        self._cond = wcondition("gather.cv", rank=420)
         self._cursor = start
         self._slabs: dict[tuple[int, int], bytes] = {}
         self._failed: dict[int, str] = {}

@@ -53,6 +53,7 @@ from ..storage.crc import crc32c
 from ..storage.errors import DeletedError, NotFoundError
 from ..storage.needle import CrcError, Needle
 from ..utils import glog
+from ..utils.locks import wcondition, wlock
 from ..utils.stats import (
     SCRUB_BACKOFFS,
     SCRUB_BYTES,
@@ -151,7 +152,7 @@ class TokenBucket:
         self.capacity = max(rate_bytes_per_s, 1.0)
         self._tokens = self.capacity
         self._last = time.monotonic()
-        self._lock = threading.Lock()
+        self._lock = wlock("scrub.pacer", rank=830)
 
     def take(self, n: int) -> float:
         if self.rate <= 0 or n <= 0:
@@ -206,7 +207,7 @@ class _Cursor:
     # save() is read-check-then-replace, and the vacuum publication races
     # a sweep's periodic save within ONE process (the server owning the
     # volume's files), so a lock closes the window completely
-    _save_mu = threading.Lock()
+    _save_mu = wlock("scrub.cursor_save", rank=840)
 
     def __init__(self, base: str):
         self.path = base + ".scb"
@@ -336,8 +337,14 @@ class Scrubber:
         self.last_sweep_unix = 0.0
         self.running = False
         self._cursors: dict[str, _Cursor] = {}
-        self._run_lock = threading.Lock()
-        self._mu = threading.Lock()
+        # witnessed (ISSUE 15): _run_lock is the OUTERMOST lock of a
+        # whole scrub pass — sweeps acquire volume.mu (300) and the
+        # dispatch plane (100+) under it, so its rank sits below both.
+        # _mu is bookkeeping reached from several planes (report_suspect
+        # off read paths, status snapshots) and stays unranked: the
+        # order witness still convicts any real inversion through it.
+        self._run_lock = wlock("scrub.run", rank=20)
+        self._mu = wlock("scrub.mu")
         self._stop = threading.Event()
         self._wake = threading.Event()
         self._suspects: set[int] = set()
@@ -1398,7 +1405,10 @@ class Scrubber:
         try:
             return retry_mod.multi_retry("scrub.fetch_needle", targets,
                                          attempt, cycles=2)
-        except Exception:  # noqa: BLE001 — every holder failed/declined
+        # lint: allow-broad-except(every holder failed/declined after
+        # retry cycles; the caller counts the miss per needle and the
+        # digest re-probe decides repaired/failed)
+        except Exception:  # noqa: BLE001
             return None
 
     def _heal_divergence(self, v, addr: str, only_mine, only_theirs,

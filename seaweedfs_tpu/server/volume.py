@@ -52,6 +52,7 @@ from ..storage.ttl import TTL
 from ..utils import failpoint, fanout, glog, numa, trace
 from ..utils.http import not_modified, parse_range, range_applies, url_for
 from ..utils.stats import (
+    VOLUME_REPLICA_DELETE_FAILURES,
     VOLUME_SERVER_EC_ENCODE_BYTES,
     VOLUME_SERVER_NATIVE_REQUESTS,
     VOLUME_SERVER_REQUEST_HISTOGRAM,
@@ -471,7 +472,10 @@ class VolumeServer:
                         gc_depth=gc_depth,
                         dispatch_depth=dispatch_depth),
                     timeout=5)
-            except Exception:  # noqa: BLE001 — best-effort; next tick retries
+            # lint: allow-broad-except(best-effort pressure telemetry;
+            # the next 1s tick retries and a down master is routine —
+            # real token draws fail closed through the governor)
+            except Exception:  # noqa: BLE001
                 continue
 
     def read_needle(self, vid: int, needle_id: int, cookie: int | None):
@@ -2522,13 +2526,46 @@ def _make_http_handler(srv: VolumeServer):
                     if addr == srv.address:
                         continue
                     try:
+                        from ..utils import retry as retry_mod
                         from ..wdclient import pool
 
-                        pool.delete(
-                            url_for(addr, f"{u.path}?type=replicate"),
-                            headers=del_headers, timeout=30)
-                    except Exception:  # noqa: BLE001
-                        pass
+                        def _leg(a=addr):
+                            r = pool.delete(
+                                url_for(a, f"{u.path}?type=replicate"),
+                                headers=del_headers, timeout=10)
+                            # the peer answering an error IS a failed
+                            # leg (store OSError -> 500, jwt -> 401):
+                            # pool.delete never raises on status, so
+                            # without this check a server-side failure
+                            # would count as success — the same silent
+                            # divergence the transport arm closes (the
+                            # replicate WRITE path has the same guard)
+                            if r.status >= 300 and r.status != 404:
+                                err = (f"replica delete on {a}: "
+                                       f"{r.status} {r.text[:200]}")
+                                if r.status >= 500:
+                                    # transient (peer restarting):
+                                    # ConnectionError classifies as
+                                    # retryable, so attempts=2 is real
+                                    raise ConnectionError(err)
+                                raise IOError(err)  # auth/shape: fast
+
+                        # attempts=2 with a 10s leg timeout: this runs
+                        # synchronously before the client's 202, and
+                        # anti-entropy converges a peer that stays down
+                        # — loudness is the goal here, not durability
+                        retry_mod.retry("volume.replicate_delete", _leg,
+                                        attempts=2)
+                    except Exception as e:  # noqa: BLE001
+                        # the local tombstone is durable and
+                        # anti-entropy's tombstone-wins pass converges
+                        # the peer, so the delete still acks — but a
+                        # diverged replica is never silent (ISSUE 15:
+                        # this was a bare swallow found by SWFS004)
+                        glog.warning(
+                            f"replicate delete {u.path.lstrip('/')} "
+                            f"to {addr} failed after retries: {e}")
+                        VOLUME_REPLICA_DELETE_FAILURES.inc(peer=addr)
             self._json({"size": size}, 202)
 
     return Handler

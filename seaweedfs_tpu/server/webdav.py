@@ -275,6 +275,8 @@ class WebDavServer:
             resp = self.stub.LookupDirectoryEntry(
                 filer_pb2.LookupDirectoryEntryRequest(
                     directory=directory or "/", name=name), timeout=30)
+        # lint: allow-broad-except(WebDAV lookup maps any filer failure
+        # to not-found; PROPFIND callers answer 404, never 500)
         except Exception:
             return None
         if not resp.entry.name:

@@ -20,6 +20,7 @@ import time
 from dataclasses import dataclass
 
 from . import types
+from ..utils import locks
 from ..utils.stats import (
     VOLUME_GROUP_COMMIT_FLUSHES,
     VOLUME_GROUP_COMMIT_WRITES,
@@ -314,7 +315,9 @@ class Volume:
         # (needles, bytes) CRC re-verified by the last compact(); consumed
         # by commit_compact's scrub-pass publication
         self._vacuum_verified: tuple[int, int] | None = None
-        self._lock = threading.RLock()
+        # witnessed (ISSUE 15): the group-commit flush takes volume.mu
+        # THEN volume.gc_cv (see _gc_flush); nothing may reverse that
+        self._lock = locks.wrlock("volume.mu", rank=300)
         # scrub plane: needle ids whose on-disk record failed verification
         # and is being repaired — read_needle refuses them (the server
         # layer answers from a healthy replica instead of corrupt bytes)
@@ -326,7 +329,7 @@ class Volume:
         # dat-before-idx flush order (with appends excluded by _lock
         # during the flush) keeps the on-disk idx never ahead of dat.
         self._gc_enabled = _group_commit_enabled()
-        self._gc_cond = threading.Condition()
+        self._gc_cond = locks.wcondition("volume.gc_cv", rank=320)
         self._gc_seq = 0        # writes appended (registered for flush)
         self._gc_flushed = 0    # writes covered by a completed flush
         self._gc_leader = False

@@ -38,7 +38,9 @@ import os
 import threading
 from concurrent.futures import Future, ThreadPoolExecutor
 
-_lock = threading.Lock()
+from .locks import wlock
+
+_lock = wlock("fanout.mu", rank=860)
 _executors: dict[str, ThreadPoolExecutor] = {}
 
 

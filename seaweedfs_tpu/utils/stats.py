@@ -255,6 +255,10 @@ def gather(exemplars: bool = False) -> str:
 
 MASTER_RECEIVED_HEARTBEATS = Counter(
     "SeaweedFS_master_received_heartbeats", "Number of heartbeats received.")
+VOLUME_REPLICA_DELETE_FAILURES = Counter(
+    "SeaweedFS_volume_replica_delete_failures",
+    "Replica delete fan-out legs that exhausted retries — the peer "
+    "still holds the needle until anti-entropy's tombstone-wins heal.")
 MASTER_VOLUME_LAYOUT_WRITABLE = Gauge(
     "SeaweedFS_master_volume_layout_writable", "Writable volumes per layout.")
 VOLUME_SERVER_REQUEST_HISTOGRAM = Histogram(

@@ -42,6 +42,7 @@ import time
 from collections import deque
 from urllib.parse import urlsplit
 
+from ..utils.locks import wlock
 from ..utils.stats import HTTP_POOL_OPEN, HTTP_POOL_OPS, TLS_HANDSHAKES
 
 
@@ -94,7 +95,9 @@ class HttpPool:
     def __init__(self):
         self._idle: dict[tuple, deque] = {}
         self._open = 0  # idle connections currently pooled
-        self._lock = threading.Lock()
+        # witnessed leaf lock (ISSUE 15): guards the idle map only —
+        # no request IO ever runs under it
+        self._lock = wlock("pool.mu", rank=850)
         self._ctx: ssl.SSLContext | None = None
         self._ctx_key: tuple | None = None
 

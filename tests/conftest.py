@@ -1,5 +1,12 @@
 import os
 
+# Lock-order witness (ISSUE 15): armed for the whole tier-1 run BEFORE
+# any seaweedfs_tpu import — the utils/locks.py factories read the gate
+# at construction time, so this line is what turns every chaos/dispatch/
+# group-commit/pool scenario into a deadlock detector. Production keeps
+# the default (off ⇒ the factories return plain threading primitives).
+os.environ.setdefault("SWFS_LOCK_WITNESS", "1")
+
 # Tests run on a virtual 8-device CPU mesh; the real TPU is reserved for
 # bench.py. The container's sitecustomize registers the remote "axon" TPU
 # plugin at interpreter start (and pins JAX_PLATFORMS=axon), so plain env
@@ -34,6 +41,35 @@ import pytest  # noqa: E402
 # legitimate test is ~70s), dump every thread's stack and kill the run —
 # a diagnosable failure beats an infinitely hung CI/driver session.
 _WATCHDOG_SECONDS = 300
+
+
+@pytest.fixture(autouse=True)
+def _lock_witness_guard():
+    """Fail the test that (first) observed a lock-order violation. The
+    witness records instead of raising (a daemon thread's raise would
+    be swallowed), so this guard is what turns a recorded inversion
+    into a red run.
+
+    Deliberately NO locks.reset() between tests: lock order is a
+    program-wide invariant (FreeBSD witness accumulates for the system
+    lifetime), so an A->B established by one test legitimately
+    convicts a B->A in a later one — that cross-test pairing is most
+    of the detector's power. The cost is attribution: the failing test
+    may only be the OBSERVER of an inversion another test's surviving
+    daemon thread completed; the violation detail (lock names, thread
+    names, first-seen site) is what localizes it."""
+    from seaweedfs_tpu.utils import locks
+
+    if not locks.witness_enabled():
+        yield
+        return
+    before = len(locks.violations())
+    yield
+    after = locks.violations()
+    assert len(after) <= before, (
+        "lock-order witness recorded violations during this test "
+        "(cross-thread acquisition-order inversion or rank breach):\n"
+        + "\n".join(repr(v) for v in after[before:]))
 
 
 @pytest.fixture(autouse=True)

@@ -1541,3 +1541,86 @@ def test_same_timestamp_conflict_autoresolves_via_epoch_tags(
                               timeout=30)
         digests.add((d.rolling_crc, d.needle_count))
     assert len(digests) == 1, f"replicas still diverge: {digests}"
+
+
+# -- ISSUE 15: replica-delete divergence is loud ----------------------------
+
+def test_replica_delete_failure_is_counted_not_swallowed(tmp_path):
+    """Regression for a real SWFS004 finding: the replica delete
+    fan-out swallowed every failure bare (`except Exception: pass`), so
+    a peer that missed the delete silently kept serving the live needle
+    until anti-entropy noticed. The leg now retries through utils.retry
+    and a final failure logs + counts
+    `SeaweedFS_volume_replica_delete_failures` — while the delete still
+    acks 202 (the local tombstone is durable; tombstone-wins anti-
+    entropy converges the peer when it returns)."""
+    from seaweedfs_tpu.utils.stats import VOLUME_REPLICA_DELETE_FAILURES
+
+    old_native = os.environ.get("SEAWEEDFS_TPU_NATIVE")
+    os.environ["SEAWEEDFS_TPU_NATIVE"] = "0"
+    mport = _free_port()
+    master = MasterServer(ip="localhost", port=mport,
+                          volume_size_limit_mb=64)
+    master.start(vacuum_interval=3600)
+    volumes = []
+    for i in range(2):
+        vsrv = VolumeServer(directories=[str(tmp_path / f"dvol{i}")],
+                            master=f"localhost:{mport}", ip="localhost",
+                            port=_free_port(), pulse_seconds=1,
+                            max_volume_counts=[16])
+        vsrv.start()
+        volumes.append(vsrv)
+    fsrv = FilerServer(ip="localhost", port=_free_port(),
+                       master=f"localhost:{mport}",
+                       store_dir=str(tmp_path / "dfiler"),
+                       chunk_size=32 * 1024, replication="001")
+    fsrv.start()
+    try:
+        deadline = time.time() + 10
+        while time.time() < deadline and len(master.topo.nodes) < 2:
+            time.sleep(0.05)
+        base = f"http://localhost:{fsrv.port}"
+        _put_replicated(fsrv, base, "/chaos/deleted.bin",
+                        os.urandom(2048))
+        fid = fsrv.filer.find_entry("/chaos/deleted.bin") \
+            .chunks[0].file_id
+        vid = parse_file_id(fid).volume_id
+        # the server that HOLDS the volume fans the delete out to its
+        # peer; kill the peer so every retry of that leg fails
+        primary = next(v for v in volumes
+                       if v.store.find_volume(vid) is not None)
+        peer = next(v for v in volumes if v is not primary)
+        before = VOLUME_REPLICA_DELETE_FAILURES.value()
+        # kill ONLY the peer's HTTP plane: a graceful stop() would
+        # unregister it from the master and the fan-out would simply
+        # skip it — the hazard is a peer that is REGISTERED but not
+        # answering, which is what a crashed process looks like.
+        # server_close() drops the listener (refused dials) and the
+        # shared keep-alive pool is cleared so a warm connection from
+        # the PUT can't keep the "dead" peer reachable
+        from seaweedfs_tpu.wdclient import pool as _pool
+
+        peer._http_server.shutdown()
+        peer._http_server.server_close()
+        _pool.POOL.clear()
+        r = requests.delete(f"http://{primary.address}/{fid}",
+                            timeout=60)
+        assert r.status_code == 202, r.text
+        # the failure was COUNTED (and logged), not swallowed
+        assert VOLUME_REPLICA_DELETE_FAILURES.value() >= before + 1
+        # and the local tombstone really landed
+        assert requests.get(f"http://{primary.address}/{fid}",
+                            timeout=10).status_code == 404
+    finally:
+        fsrv.stop()
+        for v in volumes:
+            try:
+                v.stop()
+            except Exception:
+                pass
+        master.stop()
+        rpc.reset_channels()
+        if old_native is None:
+            os.environ.pop("SEAWEEDFS_TPU_NATIVE", None)
+        else:
+            os.environ["SEAWEEDFS_TPU_NATIVE"] = old_native

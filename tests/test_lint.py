@@ -91,6 +91,276 @@ def test_lint_catches_wall_clock_in_trace_plane(tmp_path):
     assert lint.run_span_timing_rule() == []
 
 
+def test_executor_marker_cannot_bless_adjacent_unrelated_call(tmp_path):
+    """ISSUE 15 satellite: the old allow-marker blessed `range(i+1,
+    i+6)` — five arbitrary lines — so a marker above a short `with`
+    also exempted whatever statement followed it. The span now comes
+    from the AST: a second, unmarked ThreadPoolExecutor immediately
+    after a marked one must still be reported."""
+    lint = _load_lint()
+    bad = tmp_path / "adjacent.py"
+    bad.write_text(
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "def blessed_then_not(items):\n"
+        "    # lint: allow-executor(startup-only, joined at exit)\n"
+        "    ex1 = ThreadPoolExecutor(max_workers=2)\n"
+        "    ex2 = ThreadPoolExecutor(max_workers=2)\n"
+        "    return ex1, ex2\n")
+    findings = lint.run_executor_rule([str(bad)])
+    assert len(findings) == 1 and ":5:" in findings[0], findings
+
+    # a marker TRAILING a code line blesses that statement only — it
+    # must not open a "comment block" that exempts the next line too
+    trail = tmp_path / "trailing.py"
+    trail.write_text(
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "def t(items):\n"
+        "    ex1 = ThreadPoolExecutor(2)  "
+        "# lint: allow-executor(startup pool)\n"
+        "    ex2 = ThreadPoolExecutor(2)\n"
+        "    return ex1, ex2\n")
+    findings = lint.run_executor_rule([str(trail)])
+    assert len(findings) == 1 and ":4:" in findings[0], findings
+
+    # a marker whose justification comment block runs down TO the
+    # statement still blesses it (the shipped multi-line form) …
+    multi = tmp_path / "multiline.py"
+    multi.write_text(
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "def blessed(items):\n"
+        "    # lint: allow-executor — scoped pool whose exit joins\n"
+        "    # the stragglers; bounded by the shard count\n"
+        "    with ThreadPoolExecutor(max_workers=2) as ex:\n"
+        "        return list(ex.map(str, items))\n")
+    assert lint.run_executor_rule([str(multi)]) == []
+
+    # … and a marker with NO reason at all still gates
+    bare = tmp_path / "bare.py"
+    bare.write_text(
+        "from concurrent.futures import ThreadPoolExecutor\n"
+        "def marked_but_unjustified(items):\n"
+        "    # lint: allow-executor\n"
+        "    with ThreadPoolExecutor(max_workers=2) as ex:\n"
+        "        return list(ex.map(str, items))\n")
+    findings = lint.run_executor_rule([str(bare)])
+    assert len(findings) == 1 and "no reason" in findings[0], findings
+
+
+def test_lint_catches_silent_broad_except(tmp_path):
+    """SWFS004 (ISSUE 15): `except Exception` that neither logs,
+    counts, re-raises, nor uses the bound exception is a silent
+    swallow; observing handlers and justified markers stay exempt."""
+    lint = _load_lint()
+    bad = tmp_path / "swallow.py"
+    bad.write_text(
+        "import glog\n"
+        "def silent():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        return None\n"
+        "def bare():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except:\n"
+        "        pass\n"
+        "def logs():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception as e:\n"
+        "        glog.warning(f'failed: {e}')\n"
+        "def reraises():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception:\n"
+        "        raise\n"
+        "def uses_bound():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except Exception as e:\n"
+        "        return {'error': str(e)}\n"
+        "def justified():\n"
+        "    try:\n"
+        "        work()\n"
+        "    # lint: allow-broad-except(capability probe; absence is\n"
+        "    # the answer)\n"
+        "    except Exception:\n"
+        "        return False\n"
+        "def narrow():\n"
+        "    try:\n"
+        "        work()\n"
+        "    except ValueError:\n"
+        "        return None\n")
+    findings = lint.run_broad_except_rule([str(bad)])
+    assert len(findings) == 2 and all("SWFS004" in f for f in findings), \
+        findings
+    assert ":5:" in findings[0] and ":10:" in findings[1], findings
+
+    # the gated packages themselves are clean (every surviving broad
+    # except observes the failure or carries a written justification)
+    assert lint.run_broad_except_rule() == []
+
+
+def test_lint_catches_blocking_call_under_named_lock(tmp_path):
+    """SWFS005 (ISSUE 15): sleeps, HTTP legs, RPC stubs, untimed
+    queue.get()/Event.wait() and future.result() reached while a named
+    lock is held are errors; timeouts and justified sites pass."""
+    lint = _load_lint()
+    bad = tmp_path / "stall.py"
+    bad.write_text(
+        "import queue\n"
+        "import threading\n"
+        "import time\n"
+        "import requests\n"
+        "class Srv:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "        self._q = queue.Queue()\n"
+        "        self._ev = threading.Event()\n"
+        "    def stalls(self, stub, fut):\n"
+        "        with self._mu:\n"
+        "            time.sleep(1)\n"
+        "            requests.get('http://peer/ping')\n"
+        "            stub.VolumeDigest(None)\n"
+        "            self._q.get()\n"
+        "            self._ev.wait()\n"
+        "            fut.result()\n"
+        "    def fine(self, fut):\n"
+        "        with self._mu:\n"
+        "            self._q.get(timeout=1.0)\n"
+        "            self._ev.wait(0.5)\n"
+        "            fut.result(timeout=2)\n"
+        "            self._q.get_nowait()\n"
+        "        time.sleep(1)\n"
+        "    def justified(self):\n"
+        "        with self._mu:\n"
+        "            # lint: allow-blocking-under-lock(bounded 10ms\n"
+        "            # settle; callers tolerate it)\n"
+        "            time.sleep(0.01)\n"
+        "    def one_level_deep(self):\n"
+        "        with self._mu:\n"
+        "            self._helper()\n"
+        "    def _helper(self):\n"
+        "        time.sleep(5)\n")
+    findings = lint.run_blocking_rule([str(bad)])
+    assert len(findings) == 7 and all("SWFS005" in f for f in findings), \
+        findings
+    lines = sorted(int(f.split(":")[1]) for f in findings)
+    assert lines == [12, 13, 14, 15, 16, 17, 32], findings
+    assert any("_helper" in f and "callee blocks" in f for f in findings)
+
+    # Condition(self._mu): waiting on the cv RELEASES _mu even though
+    # the held stack carries it under the wrapped lock's canonical
+    # name — no finding; holding a DIFFERENT lock across the wait is
+    wrapped = tmp_path / "wrapped_cv.py"
+    wrapped.write_text(
+        "import threading\n"
+        "class C:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "        self._cond = threading.Condition(self._mu)\n"
+        "        self._other = threading.Lock()\n"
+        "    def fine(self):\n"
+        "        with self._cond:\n"
+        "            self._cond.wait()\n"
+        "    def stalls(self):\n"
+        "        with self._other:\n"
+        "            with self._cond:\n"
+        "                self._cond.wait()\n")
+    findings = lint.run_blocking_rule([str(wrapped)])
+    assert len(findings) == 1 and ":13:" in findings[0] \
+        and "_other" in findings[0], findings
+
+    # the product tree is clean under the rule today — a regression
+    # here means a new blocking call crept under a named lock
+    assert lint.run_blocking_rule() == []
+
+
+def test_lint_catches_lock_order_cycle(tmp_path):
+    """LOCKGRAPH (ISSUE 15 tentpole): an ABBA pair — including one arm
+    hidden behind a method call one level deep — is a cycle; consistent
+    ordering and per-instance same-name nesting are not."""
+    lint = _load_lint()
+    bad = tmp_path / "abba.py"
+    bad.write_text(
+        "import threading\n"
+        "A = threading.Lock()\n"
+        "class Gc:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.RLock()\n"
+        "    def forward(self):\n"
+        "        with self._mu:\n"
+        "            with A:\n"
+        "                pass\n"
+        "    def backward(self):\n"
+        "        with A:\n"
+        "            self._take_mu()\n"
+        "    def _take_mu(self):\n"
+        "        with self._mu:\n"
+        "            pass\n")
+    findings = lint.run_lockgraph_rule([str(bad)])
+    assert len(findings) == 1 and "LOCKGRAPH" in findings[0] \
+        and "cycle" in findings[0], findings
+    assert "Gc._mu" in findings[0] and ":A" in findings[0]
+
+    ok = tmp_path / "ordered.py"
+    ok.write_text(
+        "import threading\n"
+        "A = threading.Lock()\n"
+        "class Gc:\n"
+        "    def __init__(self):\n"
+        "        self._mu = threading.Lock()\n"
+        "    def f(self):\n"
+        "        with self._mu:\n"
+        "            with A:\n"
+        "                pass\n"
+        "    def g(self):\n"
+        "        with self._mu:\n"
+        "            with A:\n"
+        "                pass\n")
+    assert lint.run_lockgraph_rule([str(ok)]) == []
+
+    # the repo's own whole-program graph is acyclic
+    assert lint.run_lockgraph_rule() == []
+
+
+def test_lint_json_output_is_machine_readable():
+    """ISSUE 15 satellite: `tools/lint.py --json` emits rule id, path,
+    line, message and marker status for every finding (blessed ones
+    included, so CI can diff both counts across PRs); exit code
+    matches the text mode."""
+    import json
+
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "lint.py"),
+         "--json"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout)
+    assert set(out) >= {"findings", "active", "allowed", "by_rule"}
+    assert out["active"] == 0  # text mode exits 0 ⇒ no active findings
+    assert out["allowed"] >= 10  # the triaged justification markers
+    for f in out["findings"]:
+        assert set(f) == {"rule", "path", "line", "message", "marker",
+                          "reason"}
+        assert f["marker"] == "allowed" and f["reason"], f
+
+
+def test_every_swfs_knob_is_documented_in_readme():
+    """ISSUE 15 satellite (mirror of the metrics-table test): every
+    SWFS_* env knob the package reads must appear in README.md; the
+    failure message carries the generated inventory lines to paste."""
+    lint = _load_lint()
+    knobs = lint.knob_inventory()
+    assert len(knobs) >= 40  # the inventory actually walked the tree
+    assert "SWFS_LOCK_WITNESS" in knobs
+    readme = open(os.path.join(REPO, "README.md")).read()
+    missing = {k: v for k, v in knobs.items() if k not in readme}
+    assert not missing, (
+        "undocumented SWFS_* knobs — seed README from this inventory:\n"
+        + "\n".join(lint._knobs.inventory_lines(missing)))
+
+
 def test_lint_catches_bare_executor_on_serving_paths(tmp_path):
     """SWFS003 (ISSUE 14 satellite): bare ThreadPoolExecutor
     construction inside server/ + filer/ is an error — fan-out belongs

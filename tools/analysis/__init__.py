@@ -1,0 +1,19 @@
+"""Whole-program static analysis for the concurrency discipline plane
+(ISSUE 15). `tools/lint.py` grew past single-file AST visitors: the
+passes here need to see every file at once (a lock acquired in
+storage/volume.py and released under a call into ops/dispatch.py is one
+edge in one graph). Layout:
+
+    common.py      shared file loading, marker-span blessing, lock naming
+    lockgraph.py   nested-acquisition graph + cycle detection (tentpole)
+    blocking.py    SWFS005 blocking calls under a named lock
+    broadexcept.py SWFS004 silent `except Exception` swallows
+    knobs.py       SWFS_* env-knob inventory (README consistency)
+
+Every pass returns `common.Finding` objects so `tools/lint.py` can
+render them as text or `--json` without re-parsing anything.
+"""
+
+from . import blocking, broadexcept, common, knobs, lockgraph  # noqa: F401
+
+__all__ = ["common", "lockgraph", "blocking", "broadexcept", "knobs"]

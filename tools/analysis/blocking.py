@@ -1,0 +1,273 @@
+"""SWFS005: blocking calls reached while a named lock is held.
+
+Every real stall found so far in this tree had the same shape: a hot
+lock held across something whose latency is unbounded — an RPC, an
+HTTP leg, an untimed `queue.get()` / `Event.wait()`, a `sleep`, an
+executor `.result()`. Under fleet traffic that converts one slow peer
+into a pile-up behind the lock (and, combined with a second lock, into
+the ABBA deadlocks the lock-graph pass hunts).
+
+Matched blocking shapes (held-lock tracking shares the lock-naming and
+`with`-nesting machinery with lockgraph.py):
+
+* `time.sleep(...)` / bare `sleep(...)`
+* `requests.<verb>(...)` and the keep-alive pool's `pool.<verb>(...)` /
+  `POOL.request(...)` HTTP legs
+* RPC stubs: `<stub>.<CamelCaseMethod>(...)` where the receiver is a
+  name containing "stub" or a direct `*_stub(...)` call result
+* `<queue>.get(...)` with no `timeout=` (receiver must resolve to a
+  known `queue.Queue`/`SimpleQueue` attribute; `get_nowait`/
+  `block=False` are fine)
+* `<event>.wait()` with no timeout (known `threading.Event` attrs)
+* `<condition>.wait()` with no timeout while OTHER locks are held —
+  the wait releases its own lock but keeps every outer one
+* `<future>.result()` with no timeout
+
+One level of call depth: `with lock: self.f()` reports when `f`'s own
+body directly contains an unmarked blocking call.
+
+Escape: `# lint: allow-blocking-under-lock(<reason>)` on the blocking
+statement (or the line above). The reason is mandatory.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+
+from .common import (Finding, LockTable, MarkerIndex, SourceFile,
+                     apply_marker, collect_locks)
+from .lockgraph import _callee_key, _canon, _resolve_lock
+
+MARKER = "blocking-under-lock"
+RULE = "SWFS005"
+
+_CAMEL = re.compile(r"^[A-Z][A-Za-z0-9]*$")
+_HTTP_VERBS = {"get", "put", "post", "delete", "head", "request",
+               "patch", "options"}
+
+
+@dataclass
+class _Waitables:
+    """Per-program table of attributes/names known to be Queues and
+    Events (collected exactly like locks are)."""
+
+    queues: set[str] = field(default_factory=set)  # attr or bare names
+    events: set[str] = field(default_factory=set)
+
+
+def collect_waitables(program: list[SourceFile]) -> _Waitables:
+    w = _Waitables()
+
+    def ctor(call: ast.Call) -> str | None:
+        f = call.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name):
+            if f.value.id == "queue" and f.attr in ("Queue", "SimpleQueue",
+                                                    "LifoQueue",
+                                                    "PriorityQueue"):
+                return "queue"
+            if f.value.id == "threading" and f.attr == "Event":
+                return "event"
+        elif isinstance(f, ast.Name) and f.id in ("Queue", "SimpleQueue",
+                                                  "Event"):
+            return "queue" if "Queue" in f.id else "event"
+        return None
+
+    for sf in program:
+        for node in ast.walk(sf.tree):
+            if not (isinstance(node, (ast.Assign, ast.AnnAssign))
+                    and isinstance(node.value, ast.Call)):
+                continue
+            kind = ctor(node.value)
+            if kind is None:
+                continue
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for t in targets:
+                name = None
+                if isinstance(t, ast.Attribute):
+                    name = t.attr
+                elif isinstance(t, ast.Name):
+                    name = t.id
+                if name:
+                    (w.queues if kind == "queue" else w.events).add(name)
+    return w
+
+
+def _recv_name(expr: ast.expr) -> str | None:
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _has_timeout(call: ast.Call) -> bool:
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    # q.get(False) / q.get(True, 5): a second positional is the timeout;
+    # a single falsy positional is block=False (non-blocking)
+    if len(call.args) >= 2:
+        return True
+    if len(call.args) == 1 and isinstance(call.args[0], ast.Constant) \
+            and not call.args[0].value:
+        return True
+    if any(kw.arg == "block" and isinstance(kw.value, ast.Constant)
+           and not kw.value.value for kw in call.keywords):
+        return True
+    return False
+
+
+def _classify_blocking(call: ast.Call, w: _Waitables,
+                       held: list[str],
+                       cv_names: set[str],
+                       cv_canon: dict[str, set[str]] | None = None) \
+        -> str | None:
+    """-> short description of the blocking shape, or None."""
+    f = call.func
+    if isinstance(f, ast.Name) and f.id == "sleep":
+        return "sleep()"
+    if not isinstance(f, ast.Attribute):
+        return None
+    base, attr = f.value, f.attr
+    base_name = base.id if isinstance(base, ast.Name) else None
+    if base_name == "time" and attr == "sleep":
+        return "time.sleep()"
+    if base_name == "requests" and attr in _HTTP_VERBS:
+        return f"requests.{attr}() HTTP leg"
+    if base_name in ("pool", "POOL") and attr in _HTTP_VERBS:
+        return f"{base_name}.{attr}() pooled HTTP leg"
+    # RPC stubs: stub.VolumeDigest(...) / volume_stub(addr).Method(...)
+    if _CAMEL.match(attr):
+        if base_name is not None and "stub" in base_name.lower():
+            return f"RPC {base_name}.{attr}()"
+        if isinstance(base, ast.Call) and isinstance(base.func, ast.Name) \
+                and base.func.id.endswith("_stub"):
+            return f"RPC {base.func.id}().{attr}()"
+    recv = _recv_name(base)
+    if attr == "get" and recv in w.queues and not _has_timeout(call):
+        return f"{recv}.get() with no timeout"
+    if attr == "wait" and recv in w.events and not _has_timeout(call) \
+            and not call.args:
+        return f"{recv}.wait() with no timeout"
+    if attr == "wait" and recv in cv_names and not call.args \
+            and not _has_timeout(call):
+        # cv.wait() releases ITS lock; only outer locks make it a
+        # stall. "Its lock" may appear on the held stack under the
+        # CANONICAL name of the lock a Condition(self._mu) wraps, not
+        # the condition's own attr — exempt both forms
+        own = (cv_canon or {}).get(recv, set())
+        outer = [h for h in held if h not in own
+                 and not h.endswith(f".{recv}")
+                 and not h.endswith(f":{recv}")]
+        if outer:
+            return f"{recv}.wait() with no timeout (releases only its " \
+                   f"own lock, still holds {outer[0]})"
+        return None
+    if attr == "result" and not call.args and not _has_timeout(call):
+        return "future.result() with no timeout"
+    return None
+
+
+def analyze(program: list[SourceFile],
+            locks: LockTable | None = None) -> list[Finding]:
+    if locks is None:
+        locks = collect_locks(program)
+    waitables = collect_waitables(program)
+    cv_names = {d.attr for d in locks.defs if d.kind == "Condition"}
+    cv_canon: dict[str, set[str]] = {}
+    for d in locks.defs:
+        if d.kind == "Condition":
+            cv_canon.setdefault(d.attr, set()).add(_canon(locks, d))
+
+    # pass 1: per-function facts — blocking calls at any depth (for the
+    # one-level propagation) keyed like lockgraph's functions
+    direct_blocking: dict[str, list[tuple[ast.Call, str, bool]]] = {}
+
+    findings: list[Finding] = []
+    # (caller-held snapshot, callee key, call node, sf, marker idx)
+    deferred: list[tuple[list[str], str, ast.Call, SourceFile,
+                         MarkerIndex]] = []
+
+    for sf in program:
+        markers = MarkerIndex(sf, MARKER)
+
+        def scan_fn(fn: ast.AST, cls: str | None, key: str) -> None:
+            blocks = direct_blocking.setdefault(key, [])
+
+            def walk(node: ast.AST, held: list[str]) -> None:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)) and node is not fn:
+                    return
+                if isinstance(node, ast.With):
+                    acquired = []
+                    for item in node.items:
+                        # the with-items themselves evaluate under the
+                        # outer held set
+                        walk(item.context_expr, held)
+                        ln = _resolve_lock(locks, sf, cls,
+                                           item.context_expr)
+                        if ln is not None:
+                            acquired.append(ln)
+                    for stmt in node.body:
+                        walk(stmt, held + acquired)
+                    return
+                if isinstance(node, ast.Call):
+                    desc = _classify_blocking(node, waitables, held,
+                                              cv_names, cv_canon)
+                    if desc is not None:
+                        blessed = markers.check(node)[0] == "allowed"
+                        blocks.append((node, desc, blessed))
+                        if held:
+                            f = Finding(
+                                rule=RULE, path=sf.rel,
+                                line=node.lineno,
+                                message=(f"{desc} while holding "
+                                         f"{held[-1]} — unbounded "
+                                         f"stall serializes behind "
+                                         f"the lock"))
+                            findings.append(
+                                apply_marker(f, markers, node))
+                    elif held:
+                        ck = _callee_key(sf, cls, node)
+                        if ck is not None:
+                            deferred.append((list(held), ck, node,
+                                             sf, markers))
+                for child in ast.iter_child_nodes(node):
+                    walk(child, held)
+
+            walk(fn, [])
+
+        def visit(node: ast.AST, cls: str | None) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    key = f"{sf.module}|{cls or ''}|{child.name}"
+                    scan_fn(child, cls, key)
+                    visit(child, cls)
+                else:
+                    visit(child, cls)
+
+        visit(sf.tree, None)
+
+    # one level deep: a lock held at a call whose callee blocks directly
+    for held, callee, call, sf, markers in deferred:
+        for _node, desc, blessed in direct_blocking.get(callee, []):
+            if blessed:
+                continue
+            fname = callee.rsplit("|", 1)[1]
+            f = Finding(
+                rule=RULE, path=sf.rel, line=call.lineno,
+                message=(f"call to {fname}() while holding {held[-1]} "
+                         f"— callee blocks: {desc}"))
+            findings.append(apply_marker(f, markers, call))
+            break  # one report per call site
+    return findings
+
+
+def run(program: list[SourceFile]) -> list[Finding]:
+    return analyze(program)

@@ -1,0 +1,94 @@
+"""SWFS004: silent `except Exception` swallows on the serving planes.
+
+The reference CI would surface these as test-race noise or panics; a
+Python rebuild just eats them. Inside `server/`, `storage/`, `ops/`
+and `scrub/` an `except Exception:` (or bare `except:`) handler must
+do at least one observable thing with the failure:
+
+* re-raise (any `raise` in the handler body),
+* log it (glog/logger/logging/print call),
+* count it (a metric `.inc()` / `.observe()` / span `.set_error()`),
+* or USE the bound exception (`except Exception as e` where `e` is
+  read — mapping a failure into an error reply is not a swallow).
+
+A handler that does none of those makes a serving-path failure
+invisible — the unlocked-idx-flush class of bug survives exactly in
+that shadow. Escape: `# lint: allow-broad-except(<reason>)` on the
+`except` line (or the line above); the reason is mandatory.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import Finding, MarkerIndex, SourceFile, apply_marker
+
+MARKER = "broad-except"
+RULE = "SWFS004"
+
+#: packages the rule gates (repo-relative path prefixes) — applied by
+#: tools/lint.py when it builds the default file list; an explicit file
+#: list (tests, editors) is analyzed as given
+RULE_DIRS = ("seaweedfs_tpu/server/", "seaweedfs_tpu/storage/",
+             "seaweedfs_tpu/ops/", "seaweedfs_tpu/scrub/")
+
+_LOG_FUNCS = {"warning", "warn", "error", "exception", "info", "debug",
+              "fatal", "print", "log", "write_line"}
+_METRIC_FUNCS = {"inc", "observe", "set_error", "count", "record",
+                 "add_event"}
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True  # bare except:
+    if isinstance(t, ast.Name) and t.id in ("Exception", "BaseException"):
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name)
+                   and e.id in ("Exception", "BaseException")
+                   for e in t.elts)
+    return False
+
+
+def _handler_observes(handler: ast.ExceptHandler) -> bool:
+    bound = handler.name  # `as e` name, or None
+    for node in ast.walk(handler):
+        if node is handler.type:
+            continue
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            f = node.func
+            attr = f.attr if isinstance(f, ast.Attribute) else (
+                f.id if isinstance(f, ast.Name) else "")
+            if attr in _LOG_FUNCS or attr in _METRIC_FUNCS:
+                return True
+        if bound and isinstance(node, ast.Name) and node.id == bound \
+                and isinstance(node.ctx, ast.Load):
+            return True
+    return False
+
+
+def analyze(program: list[SourceFile]) -> list[Finding]:
+    findings: list[Finding] = []
+    for sf in program:
+        markers = MarkerIndex(sf, MARKER)
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if not _is_broad(node) or _handler_observes(node):
+                continue
+            what = "bare except:" if node.type is None \
+                else "except Exception"
+            f = Finding(
+                rule=RULE, path=sf.rel, line=node.lineno,
+                message=(f"{what} swallows the failure silently on a "
+                         f"serving path — log it, count a metric, "
+                         f"re-raise, or use the bound exception"))
+            findings.append(apply_marker(f, markers, node))
+    return findings
+
+
+def run(program: list[SourceFile]) -> list[Finding]:
+    return analyze(program)

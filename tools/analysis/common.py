@@ -1,0 +1,303 @@
+"""Shared infrastructure for the static-analysis passes.
+
+Three things live here because every pass needs them:
+
+* `SourceFile` / `load_program` — parse the tree once, hand every pass
+  the same ASTs (the lock-graph and blocking passes are whole-program).
+* `Finding` — one reported defect, structured enough for `--json`.
+* `MarkerIndex` — justification-marker blessing computed from the AST
+  statement span, not a fixed line window. The old
+  `run_executor_rule` blessed `range(i+1, i+6)`: five arbitrary lines
+  after the marker, so a marker above a short `with` also exempted
+  whatever statement happened to follow it. Here a marker blesses
+  exactly the innermost statement that starts on the marker's line or
+  the line below it — an adjacent unrelated call is a different
+  statement and stays reportable.
+* lock naming — `threading.Lock/RLock/Condition` (and the
+  `utils/locks.py` witness factories) assignments resolved to an
+  owning `module:Class.attr` name, the vocabulary both concurrency
+  passes and their diagnostics share.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+
+# ---------------------------------------------------------------------------
+# findings
+
+@dataclass
+class Finding:
+    rule: str          # "SWFS004", "SWFS005", "LOCKGRAPH", ...
+    path: str          # repo-relative
+    line: int
+    message: str
+    marker: str = "none"   # "none" | "allowed" | "missing-reason"
+    reason: str = ""
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def as_json(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message, "marker": self.marker,
+                "reason": self.reason}
+
+
+def active(findings: list[Finding]) -> list[Finding]:
+    """The findings that gate (marker-blessed ones don't; a marker
+    missing its written reason still does — the acceptance bar is
+    'every surviving justification marker carrying a reason')."""
+    return [f for f in findings if f.marker != "allowed"]
+
+
+# ---------------------------------------------------------------------------
+# source files
+
+@dataclass
+class SourceFile:
+    path: str                  # absolute
+    rel: str                   # repo-relative (what findings report)
+    lines: list[str]
+    tree: ast.Module
+    module: str                # dotted-ish module key, e.g. "storage/volume"
+
+
+def load_source(path: str, repo: str) -> SourceFile | None:
+    rel = os.path.relpath(path, repo) if os.path.isabs(path) else path
+    try:
+        with open(path, "rb") as f:
+            src = f.read()
+        tree = ast.parse(src, filename=rel)
+    except (OSError, SyntaxError):
+        return None  # unreadable/broken files are the syntax gate's job
+    text = src.decode(errors="replace")
+    module = rel[:-3] if rel.endswith(".py") else rel
+    for prefix in ("seaweedfs_tpu" + os.sep, "tools" + os.sep):
+        if module.startswith(prefix):
+            module = module[len(prefix):]
+            break
+    return SourceFile(path=path, rel=rel, lines=text.splitlines(),
+                      tree=tree, module=module.replace(os.sep, "/"))
+
+
+def load_program(paths: list[str], repo: str) -> list[SourceFile]:
+    out = []
+    for p in paths:
+        sf = load_source(p, repo)
+        if sf is not None:
+            out.append(sf)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# marker blessing
+
+# grammar: `# lint: allow-<rule>(<reason>)`; the pre-ISSUE-15 free-text
+# form `# lint: allow-executor — reason` keeps working (the reason is
+# whatever trails the marker token).
+_MARKER_RE_TMPL = r"lint:\s*allow-%s(?:\(([^)]*)\))?(.*)"
+
+
+class MarkerIndex:
+    """Marker blessing for one SourceFile + one marker name.
+
+    `check(node)` -> (status, reason): "allowed" when the innermost
+    statement containing `node` carries the marker on its first line or
+    the line above; "missing-reason" when that marker has no written
+    justification; "none" otherwise.
+    """
+
+    def __init__(self, sf: SourceFile, marker: str):
+        self._re = re.compile(_MARKER_RE_TMPL % re.escape(marker))
+        self.markers: dict[int, str] = {}
+        # marker line -> first CODE line after its comment block: a
+        # justification is often a multi-line comment above the
+        # statement; the block blesses exactly the statement it abuts
+        self.blesses: dict[int, int] = {}
+        for i, line in enumerate(sf.lines):
+            m = self._re.search(line)
+            if m:
+                # a parenthesized reason may continue on the next
+                # comment line; the open paren is grammar, not content
+                reason = (m.group(1) or m.group(2)
+                          or "").strip(" \t#—–-:.()")
+                self.markers[i + 1] = reason
+                # only a COMMENT-ONLY marker line opens a block that
+                # blesses the statement below it; a marker trailing
+                # code blesses that statement alone (check() start
+                # match) — else a trailing marker would also exempt
+                # the unrelated next statement, the exact adjacency
+                # hole the AST-span rewrite exists to close
+                if line.lstrip().startswith("#"):
+                    j = i + 1
+                    while j < len(sf.lines) and (
+                            not sf.lines[j].strip()
+                            or sf.lines[j].lstrip().startswith("#")):
+                        j += 1
+                    self.blesses[i + 1] = j + 1
+        # every statement's span, innermost-resolvable (ExceptHandler
+        # counts: an `except` clause takes its own marker line)
+        self._stmts: list[tuple[int, int]] = []
+        for n in ast.walk(sf.tree):
+            if isinstance(n, (ast.stmt, ast.ExceptHandler)):
+                self._stmts.append((n.lineno,
+                                    getattr(n, "end_lineno", n.lineno)))
+
+    def _innermost(self, line: int) -> tuple[int, int] | None:
+        best = None
+        for lo, hi in self._stmts:
+            if lo <= line <= hi and (
+                    best is None or (hi - lo) < (best[1] - best[0])):
+                best = (lo, hi)
+        return best
+
+    def check(self, node: ast.AST) -> tuple[str, str]:
+        span = self._innermost(node.lineno)
+        if span is None:
+            return "none", ""
+        start = span[0]
+        hits = [m for m, code in self.blesses.items()
+                if code == start] + \
+            ([start] if start in self.markers else [])
+        if not hits:
+            return "none", ""
+        reason = self.markers[hits[0]]
+        return ("allowed", reason) if reason else ("missing-reason", "")
+
+
+def apply_marker(finding: Finding, idx: MarkerIndex, node: ast.AST) -> Finding:
+    finding.marker, finding.reason = idx.check(node)
+    if finding.marker == "missing-reason":
+        finding.message += " [justification marker present but carries " \
+            "no reason — write one: `# lint: allow-...(<why>)`]"
+    return finding
+
+
+# ---------------------------------------------------------------------------
+# lock naming
+
+# constructors that mint a lock-shaped object. The witness factories
+# (utils/locks.py) resolve to the same graph vocabulary so adopting the
+# runtime witness never hides a lock from the static passes.
+LOCK_CTORS = {
+    "Lock": "Lock", "RLock": "RLock", "Condition": "Condition",
+    "wlock": "Lock", "wrlock": "RLock", "wcondition": "Condition",
+    "WitnessLock": "Lock", "WitnessRLock": "RLock",
+    "WitnessCondition": "Condition",
+}
+
+
+@dataclass
+class LockDef:
+    name: str        # canonical: "<module>:<Class>.<attr>" / "<module>:<attr>"
+    kind: str        # Lock | RLock | Condition
+    rel: str
+    line: int
+    attr: str        # the bare attribute/variable name
+    owner: str | None  # owning class name, None for module level
+    module: str = ""   # SourceFile.module key of the defining file
+    wraps_attr: str | None = None  # Condition(self._mu) -> "_mu"
+
+
+def _ctor_kind(call: ast.Call) -> str | None:
+    f = call.func
+    name = f.attr if isinstance(f, ast.Attribute) else (
+        f.id if isinstance(f, ast.Name) else None)
+    if name not in LOCK_CTORS:
+        return None
+    if isinstance(f, ast.Attribute):
+        base = f.value
+        if not (isinstance(base, ast.Name)
+                and base.id in ("threading", "locks")):
+            return None
+    return LOCK_CTORS[name]
+
+
+def _cond_wrapped_attr(call: ast.Call) -> str | None:
+    """Condition(self._mu) (or wcondition(..., lock=self._mu)) aliases
+    the condition to the wrapped lock: entering one IS acquiring the
+    other."""
+    cands = list(call.args) + [kw.value for kw in call.keywords
+                               if kw.arg == "lock"]
+    for a in cands:
+        if isinstance(a, ast.Attribute) and isinstance(a.value, ast.Name) \
+                and a.value.id == "self":
+            return a.attr
+    return None
+
+
+@dataclass
+class LockTable:
+    """Every named lock in the program, indexed for the passes."""
+
+    defs: list[LockDef] = field(default_factory=list)
+    # (module, owner_class or "", attr) -> LockDef
+    by_scope: dict[tuple[str, str, str], LockDef] = field(
+        default_factory=dict)
+    # attr -> defs (for cross-object `obj._lock` resolution when unique)
+    by_attr: dict[str, list[LockDef]] = field(default_factory=dict)
+
+    def add(self, d: LockDef, module: str) -> None:
+        self.defs.append(d)
+        self.by_scope[(module, d.owner or "", d.attr)] = d
+        self.by_attr.setdefault(d.attr, []).append(d)
+
+    def resolve_self(self, module: str, owner: str, attr: str) \
+            -> LockDef | None:
+        return self.by_scope.get((module, owner, attr))
+
+    def resolve_module(self, module: str, name: str) -> LockDef | None:
+        return self.by_scope.get((module, "", name))
+
+    def resolve_unique_attr(self, attr: str) -> LockDef | None:
+        ds = self.by_attr.get(attr) or []
+        return ds[0] if len(ds) == 1 else None
+
+
+def collect_locks(program: list[SourceFile]) -> LockTable:
+    table = LockTable()
+
+    def record(sf: SourceFile, target: ast.expr, call: ast.Call,
+               cls: ast.ClassDef | None) -> None:
+        kind = _ctor_kind(call)
+        if kind is None:
+            return
+        wraps = _cond_wrapped_attr(call) if kind == "Condition" else None
+        if isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == "self" and cls is not None:
+            attr, owner = target.attr, cls.name
+        elif isinstance(target, ast.Name):
+            attr, owner = target.id, (cls.name if cls is not None else None)
+        else:
+            return
+        name = f"{sf.module}:{owner}.{attr}" if owner \
+            else f"{sf.module}:{attr}"
+        d = LockDef(name=name, kind=kind, rel=sf.rel, line=call.lineno,
+                    attr=attr, owner=owner, module=sf.module,
+                    wraps_attr=wraps)
+        table.add(d, sf.module)
+
+    for sf in program:
+        # walk with class context (one level of nesting is all the tree
+        # uses; nested classes keep the innermost owner)
+        def visit(node: ast.AST, cls: ast.ClassDef | None) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child)
+                    continue
+                if isinstance(child, ast.Assign) \
+                        and isinstance(child.value, ast.Call):
+                    for t in child.targets:
+                        record(sf, t, child.value, cls)
+                elif isinstance(child, ast.AnnAssign) \
+                        and isinstance(child.value, ast.Call):
+                    record(sf, child.target, child.value, cls)
+                visit(child, cls)
+
+        visit(sf.tree, None)
+    return table

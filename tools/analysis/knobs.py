@@ -1,0 +1,58 @@
+"""SWFS_* env-knob inventory (ISSUE 15 satellite).
+
+Mirror of the metrics-table consistency test: every `SWFS_*` knob the
+tree actually READS (`os.environ.get` / `os.getenv` / `os.environ[...]`
+/ `.setdefault`) must appear in README.md, or a new knob ships
+undocumented. `tools/lint.py --knobs` prints the generated inventory
+(markdown bullet lines with defining sites) to seed missing entries.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .common import SourceFile
+
+_READ_FUNCS = {"get", "getenv", "setdefault", "pop"}
+
+
+def _env_read_key(node: ast.AST) -> str | None:
+    """The string key of an environment read, if this node is one."""
+    if isinstance(node, ast.Call):
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in _READ_FUNCS \
+                and node.args and isinstance(node.args[0], ast.Constant) \
+                and isinstance(node.args[0].value, str):
+            base = f.value
+            # os.environ.get(...) / environ.get(...) / os.getenv(...)
+            if isinstance(base, ast.Attribute) and base.attr == "environ":
+                return node.args[0].value
+            if isinstance(base, ast.Name) \
+                    and base.id in ("environ", "os"):
+                return node.args[0].value
+    elif isinstance(node, ast.Subscript):
+        v = node.value
+        is_env = (isinstance(v, ast.Attribute) and v.attr == "environ") \
+            or (isinstance(v, ast.Name) and v.id == "environ")
+        if is_env and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str):
+            return node.slice.value
+    return None
+
+
+def collect_knobs(program: list[SourceFile],
+                  prefix: str = "SWFS_") -> dict[str, list[str]]:
+    """knob -> sorted ["path:line", ...] reading sites."""
+    out: dict[str, list[str]] = {}
+    for sf in program:
+        for node in ast.walk(sf.tree):
+            key = _env_read_key(node)
+            if key and key.startswith(prefix):
+                out.setdefault(key, []).append(f"{sf.rel}:{node.lineno}")
+    return {k: sorted(v) for k, v in sorted(out.items())}
+
+
+def inventory_lines(knobs: dict[str, list[str]]) -> list[str]:
+    return [f"- `{knob}` — read at {', '.join(sites[:3])}"
+            + (f" (+{len(sites) - 3} more)" if len(sites) > 3 else "")
+            for knob, sites in knobs.items()]

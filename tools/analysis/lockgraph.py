@@ -1,0 +1,289 @@
+"""Whole-program lock-acquisition graph + cycle detection (ISSUE 15).
+
+The reference Go codebase leans on `go test -race`; this rebuild's
+equivalent discipline is structural: every `threading.Lock/RLock/
+Condition` is named by its owning class/module attribute
+(`common.collect_locks`), every `with <lock>:` nesting contributes a
+directed edge `outer -> inner`, and a CYCLE in that graph is exactly a
+potential ABBA deadlock — the same argument the fanout-tier dependency
+DAG (SWFS003) makes for executor tiers, applied to locks.
+
+Edges come from two places:
+
+* lexical nesting — `with a: ... with b:` inside one function body
+  (including `with a, b:` multi-item forms, ordered);
+* calls one level deep — `with a: self.f()` where `f` (same class, or
+  a module-level function of the same module) itself acquires `b`.
+  Deeper chains compose through the graph: if `f` holding `b` calls
+  `g` which takes `c`, the `b -> c` edge is recorded when `f` is
+  analyzed, so `a -> b -> c` needs no transitive call resolution.
+
+Precision rules (these are what keep the pass quiet enough to gate):
+
+* `self.X` resolves within the defining class; bare names within the
+  defining module; `obj.X` resolves only when exactly ONE class in the
+  whole program defines a lock attribute named `X` (e.g. `_gc_cond`) —
+  ambiguous attrs like `_lock` are never cross-resolved.
+* A `Condition(self._mu)` is the same node as `_mu` (entering one IS
+  acquiring the other).
+* Same-name edges (`Volume._lock -> Volume._lock` across two
+  instances) are recorded for diagnostics but excluded from cycle
+  detection: per-instance nesting is usually key-ordered and RLock
+  re-entry is legal — the runtime witness (utils/locks.py), which sees
+  object identity, owns that half of the problem.
+
+Escape: `# lint: allow-lock-edge(<reason>)` on the acquiring `with`
+statement drops the edges that originate at that site.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .common import (Finding, LockTable, MarkerIndex, SourceFile,
+                     collect_locks)
+
+MARKER = "lock-edge"
+RULE = "LOCKGRAPH"
+
+
+@dataclass
+class Site:
+    rel: str
+    line: int
+
+    def __str__(self) -> str:
+        return f"{self.rel}:{self.line}"
+
+
+@dataclass
+class Graph:
+    # edge (outer, inner) -> witness sites (the acquiring `with` lines)
+    edges: dict[tuple[str, str], list[Site]] = field(default_factory=dict)
+    locks: LockTable | None = None
+
+    def add(self, outer: str, inner: str, site: Site) -> None:
+        self.edges.setdefault((outer, inner), []).append(site)
+
+    def cycles(self) -> list[list[str]]:
+        """Strongly-connected components with >1 node (same-name
+        self-edges are excluded at build time), smallest first."""
+        adj: dict[str, set[str]] = {}
+        for (a, b) in self.edges:
+            if a != b:
+                adj.setdefault(a, set()).add(b)
+                adj.setdefault(b, set())
+        index: dict[str, int] = {}
+        low: dict[str, int] = {}
+        on: set[str] = set()
+        stack: list[str] = []
+        out: list[list[str]] = []
+        counter = [0]
+
+        def strong(v: str) -> None:
+            # iterative Tarjan (the graph is small, but recursion depth
+            # must not depend on program shape)
+            work = [(v, iter(sorted(adj.get(v, ()))))]
+            index[v] = low[v] = counter[0]
+            counter[0] += 1
+            stack.append(v)
+            on.add(v)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for w in it:
+                    if w not in index:
+                        index[w] = low[w] = counter[0]
+                        counter[0] += 1
+                        stack.append(w)
+                        on.add(w)
+                        work.append((w, iter(sorted(adj.get(w, ())))))
+                        advanced = True
+                        break
+                    if w in on:
+                        low[node] = min(low[node], index[w])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    comp = []
+                    while True:
+                        w = stack.pop()
+                        on.discard(w)
+                        comp.append(w)
+                        if w == node:
+                            break
+                    if len(comp) > 1:
+                        out.append(sorted(comp))
+
+        for v in sorted(adj):
+            if v not in index:
+                strong(v)
+        return sorted(out, key=lambda c: (len(c), c))
+
+    def cycle_sites(self, cycle: list[str]) -> list[str]:
+        names = set(cycle)
+        sites = []
+        for (a, b), ss in sorted(self.edges.items()):
+            if a in names and b in names and a != b:
+                sites.append(f"{a} -> {b} at {ss[0]}")
+        return sites
+
+
+class _FnInfo:
+    """Per-function facts gathered on the first walk."""
+
+    def __init__(self) -> None:
+        self.acquires: list[tuple[str, ast.With]] = []  # any depth
+        # (held-stack snapshot, callee key, call node)
+        self.calls_under: list[tuple[tuple[str, ...], str, ast.Call]] = []
+
+
+def _canon(locks: LockTable, d) -> str:
+    """Collapse a Condition onto the lock it wraps."""
+    if d.kind == "Condition" and d.wraps_attr and d.owner:
+        wrapped = locks.resolve_self(d.module, d.owner, d.wraps_attr)
+        if wrapped is not None:
+            return wrapped.name
+    return d.name
+
+
+def _resolve_lock(locks: LockTable, sf: SourceFile, cls: str | None,
+                  expr: ast.expr) -> str | None:
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        if expr.value.id == "self" and cls is not None:
+            d = locks.resolve_self(sf.module, cls, expr.attr)
+            if d is not None:
+                return _canon(locks, d)
+        # fall through: unique-attr cross-object resolution (covers
+        # both `v._gc_cond` and self-attrs of classes whose lock was
+        # minted by a helper rather than in this class's __init__)
+        d = locks.resolve_unique_attr(expr.attr)
+        if d is not None:
+            return _canon(locks, d)
+    elif isinstance(expr, ast.Name):
+        d = locks.resolve_module(sf.module, expr.id)
+        if d is not None:
+            return _canon(locks, d)
+        d = locks.resolve_unique_attr(expr.id)
+        if d is not None and d.owner is None:
+            return _canon(locks, d)
+    return None
+
+
+def _callee_key(sf: SourceFile, cls: str | None, call: ast.Call) \
+        -> str | None:
+    f = call.func
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "self" and cls is not None:
+        return f"{sf.module}|{cls}|{f.attr}"
+    if isinstance(f, ast.Name):
+        return f"{sf.module}||{f.id}"
+    return None
+
+
+def analyze(program: list[SourceFile],
+            locks: LockTable | None = None) -> tuple[Graph, list[Finding]]:
+    """Build the acquisition graph; returns (graph, cycle findings)."""
+    if locks is None:
+        locks = collect_locks(program)
+    graph = Graph(locks=locks)
+    graph.locks = locks
+    fn_infos: dict[str, _FnInfo] = {}
+    # deferred one-level call edges: (held lock, callee key, site)
+    deferred: list[tuple[str, str, Site]] = []
+
+    for sf in program:
+        markers = MarkerIndex(sf, MARKER)
+
+        def walk_fn(fn: ast.AST, cls: str | None, key: str) -> None:
+            info = fn_infos.setdefault(key, _FnInfo())
+
+            def walk(node: ast.AST, held: list[str]) -> None:
+                if isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef,
+                                     ast.ClassDef)) and node is not fn:
+                    return  # nested defs analyzed separately
+                if isinstance(node, ast.With):
+                    acquired: list[str] = []
+                    blessed = markers.check(node)[0] == "allowed"
+                    for item in node.items:
+                        walk(item.context_expr, held)
+                        ln = _resolve_lock(locks, sf, cls,
+                                           item.context_expr)
+                        if ln is None:
+                            continue
+                        info.acquires.append((ln, node))
+                        if not blessed:
+                            site = Site(sf.rel, node.lineno)
+                            for h in held + acquired:
+                                if h != ln:
+                                    graph.add(h, ln, site)
+                        acquired.append(ln)
+                    for stmt in node.body:
+                        walk(stmt, held + acquired)
+                    return
+                if isinstance(node, ast.Call) and held:
+                    ck = _callee_key(sf, cls, node)
+                    if ck is not None:
+                        info.calls_under.append(
+                            (tuple(held), ck, node))
+                        if markers.check(node)[0] != "allowed":
+                            site = Site(sf.rel, node.lineno)
+                            for h in held:
+                                deferred.append((h, ck, site))
+                for child in ast.iter_child_nodes(node):
+                    walk(child, held)
+
+            walk(fn, [])
+
+        def visit(node: ast.AST, cls: str | None) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    visit(child, child.name)
+                elif isinstance(child, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                    key = f"{sf.module}|{cls or ''}|{child.name}"
+                    walk_fn(child, cls, key)
+                    visit(child, cls)
+                else:
+                    visit(child, cls)
+
+        visit(sf.tree, None)
+
+    # one-level call resolution: lock held at a call site -> every lock
+    # the (uniquely-resolved) callee acquires anywhere in its body
+    for held, callee, site in deferred:
+        info = fn_infos.get(callee)
+        if info is None:
+            continue  # unresolved callees stay unresolved (precision rule)
+        for inner, _node in info.acquires:
+            if inner != held:
+                graph.add(held, inner, site)
+
+    findings: list[Finding] = []
+    for cyc in graph.cycles():
+        sites = graph.cycle_sites(cyc)
+        first = sites[0] if sites else ""
+        rel, line = "", 0
+        if " at " in first:
+            loc = first.rsplit(" at ", 1)[1]
+            rel, _, ln = loc.rpartition(":")
+            line = int(ln or 0)
+        findings.append(Finding(
+            rule=RULE, path=rel or (program[0].rel if program else ""),
+            line=line,
+            message=("lock-order cycle { " + " , ".join(cyc) + " } — "
+                     "potential ABBA deadlock; edges: "
+                     + "; ".join(sites)
+                     + ". Break the cycle or justify the acquiring "
+                     "site with `# lint: allow-lock-edge(<reason>)`")))
+    return graph, findings
+
+
+def run(program: list[SourceFile]) -> list[Finding]:
+    return analyze(program)[1]
