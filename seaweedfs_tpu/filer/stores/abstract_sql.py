@@ -21,6 +21,7 @@ import threading
 from typing import Iterator
 
 from ...pb import filer_pb2
+from ...utils import locks
 from ..entry import Entry
 from ..filerstore import register_store
 
@@ -262,7 +263,7 @@ class AbstractSqlStore:
         self.support_bucket_table = support_bucket_table
         self._bucket_tables: set[str] = set()
         self._local = threading.local()
-        self._lock = threading.Lock()
+        self._lock = locks.wlock("filer.store.mu", rank=500)
         # anchor connection: creates the schema and, for shared-cache
         # in-memory sqlite, pins the database alive
         self._anchor = dialect.connect()

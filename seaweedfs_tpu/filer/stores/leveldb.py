@@ -13,9 +13,9 @@ from __future__ import annotations
 import bisect
 import os
 import struct
-import threading
 
 from ...pb import filer_pb2
+from ...utils import locks
 from ..entry import Entry
 from ..filerstore import register_store
 
@@ -28,7 +28,7 @@ class LevelDbStore:
     def __init__(self, directory: str = "./filerldb", **_ignored):
         self.dir = directory
         os.makedirs(directory, exist_ok=True)
-        self._lock = threading.RLock()
+        self._lock = locks.wrlock("filer.store.mu", rank=500)
         self._path = os.path.join(directory, "filer.log")
         # dir -> sorted [names]; (dir, name) -> log offset of latest record
         self._dirs: dict[str, list[str]] = {}

@@ -3,9 +3,9 @@ leveldb default for tests — weed/filer/leveldb/leveldb_store.go shape)."""
 
 from __future__ import annotations
 
-import threading
 from typing import Iterator
 
+from ...utils import locks
 from ..entry import Entry
 from ..filerstore import register_store
 
@@ -17,7 +17,9 @@ class MemoryStore:
         self._entries: dict[str, Entry] = {}
         self._children: dict[str, set[str]] = {}
         self._kv: dict[bytes, bytes] = {}
-        self._lock = threading.RLock()
+        # leaf rank 500: a filer store never calls back out under its
+        # mutate lock (all stores share the name — same order contract)
+        self._lock = locks.wrlock("filer.store.mu", rank=500)
 
     def insert_entry(self, entry: Entry) -> None:
         with self._lock:

@@ -22,7 +22,7 @@ import threading
 import time
 from dataclasses import dataclass, field
 
-from ..utils import glog
+from ..utils import glog, locks
 
 FOLLOWER, CANDIDATE, LEADER = "follower", "candidate", "leader"
 
@@ -118,8 +118,11 @@ class RaftNode:
         self._match_index: dict[str, int] = {}
         self._snap_cache: tuple[int, dict] | None = None  # (index, state)
         self._snap_sent_at: dict[str, float] = {}  # peer -> last send time
-        self._mu = threading.RLock()
-        self._commit_cv = threading.Condition(self._mu)
+        # raft state lock on the PR-15 witness: rank 50 sits between
+        # master.vid_propose (40, which proposes INTO raft) and the
+        # admin/keepalive planes — commit waiters share the same lock
+        self._mu = locks.wrlock("raft.mu", rank=50)
+        self._commit_cv = locks.wcondition("raft.mu", lock=self._mu)
         self._election_deadline = 0.0
         self._stop = threading.Event()
         self._threads: list[threading.Thread] = []

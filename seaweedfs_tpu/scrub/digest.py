@@ -38,10 +38,10 @@ volume is crc32c(b"") == 0).
 
 from __future__ import annotations
 
-import os
 from dataclasses import dataclass
 
 from ..storage import types
+from ..utils import atomic_write
 from ..storage.crc import crc32c, crc32c_combine
 from ..storage.epoch import TAG_LEN, decode_tag_block
 
@@ -146,10 +146,7 @@ def manifest_bytes(entries: list[DigestEntry]) -> bytes:
 def write_manifest(base_file_name: str, entries: list[DigestEntry]) -> str:
     """Persist `<base>.dig` atomically; returns the path."""
     path = base_file_name + ".dig"
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(manifest_bytes(entries))
-    os.replace(tmp, path)
+    atomic_write.write_file_atomic(path, manifest_bytes(entries))
     return path
 
 
@@ -244,16 +241,14 @@ def write_ec_manifest(base_file_name: str,
                       shard_crcs: dict[int, ShardCrc]) -> str:
     """Persist `<base>.dig` (EC form) atomically; returns the path."""
     path = base_file_name + ".dig"
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(EC_MAGIC)
-        f.write(len(shard_crcs).to_bytes(8, "big"))
-        for sid in sorted(shard_crcs):
-            sc = shard_crcs[sid]
-            f.write(sid.to_bytes(4, "big")
-                    + (sc.crc & 0xFFFFFFFF).to_bytes(4, "big")
-                    + sc.size.to_bytes(8, "big"))
-    os.replace(tmp, path)
+    blob = bytearray(EC_MAGIC)
+    blob += len(shard_crcs).to_bytes(8, "big")
+    for sid in sorted(shard_crcs):
+        sc = shard_crcs[sid]
+        blob += (sid.to_bytes(4, "big")
+                 + (sc.crc & 0xFFFFFFFF).to_bytes(4, "big")
+                 + sc.size.to_bytes(8, "big"))
+    atomic_write.write_file_atomic(path, bytes(blob))
     return path
 
 

@@ -52,7 +52,7 @@ from ..storage import types
 from ..storage.crc import crc32c
 from ..storage.errors import DeletedError, NotFoundError
 from ..storage.needle import CrcError, Needle
-from ..utils import glog
+from ..utils import atomic_write, glog
 from ..utils.locks import wcondition, wlock
 from ..utils.stats import (
     SCRUB_BACKOFFS,
@@ -226,7 +226,6 @@ class _Cursor:
             pass
 
     def save(self) -> None:
-        tmp = self.path + ".tmp"
         try:
             with _Cursor._save_mu:
                 # never clobber a publication from a NEWER compaction
@@ -241,13 +240,12 @@ class _Cursor:
                             return
                 except (OSError, ValueError):
                     pass
-                with open(tmp, "w") as f:
-                    json.dump({"offset": self.offset,
-                               "ecOffset": self.ec_offset,
-                               "sweeps": self.sweeps,
-                               "revision": self.revision,
-                               "updated": time.time()}, f)
-                os.replace(tmp, self.path)
+                atomic_write.write_json_atomic(
+                    self.path, {"offset": self.offset,
+                                "ecOffset": self.ec_offset,
+                                "sweeps": self.sweeps,
+                                "revision": self.revision,
+                                "updated": time.time()})
         except OSError:
             pass  # cursor persistence is best-effort
 

@@ -37,7 +37,7 @@ from ..storage.super_block import ReplicaPlacement
 from ..storage.ttl import EMPTY_TTL, TTL
 from ..topology import Topology, VolumeGrowth
 from ..topology.topology import EcShardInfo, VolumeInfo
-from ..utils import glog, trace
+from ..utils import glog, locks, trace
 from ..utils.stats import (
     MASTER_RECEIVED_HEARTBEATS,
     gather,
@@ -94,11 +94,13 @@ class MasterServer:
         from ..qos import GrantLedger
 
         self.qos_ledger = GrantLedger()
-        self._grow_lock = threading.Lock()
+        # master-plane locks on the PR-15 witness (ranks 30-70, above
+        # the rank-20 run locks, below the volume plane at 300)
+        self._grow_lock = locks.wlock("master.grow", rank=30)
         self._admin_locks: dict[str, tuple[int, int, str]] = {}  # name -> (token, ts, client)
-        self._admin_lock_mu = threading.Lock()
+        self._admin_lock_mu = locks.wlock("master.admin_locks", rank=60)
         self._keepalive_clients: dict[str, queue.Queue] = {}
-        self._keepalive_mu = threading.Lock()
+        self._keepalive_mu = locks.wlock("master.keepalive", rank=70)
         # filer/broker group membership + leader hinting (weed/cluster)
         self.cluster = Cluster()
         self._grpc_server = None
@@ -109,7 +111,7 @@ class MasterServer:
         # multi-master: Raft-replicated MaxVolumeId + leader election
         # (raft_server.go / cluster_commands.go)
         self.raft = None
-        self._vid_propose_lock = threading.Lock()
+        self._vid_propose_lock = locks.wlock("master.vid_propose", rank=40)
         if peers:
             from ..master.raft import RaftNode
 

@@ -252,6 +252,12 @@ class VolumeServer:
             threading.Thread(target=self._qos_report_loop,
                              args=(report_s,), daemon=True).start()
         self.scrubber.start()
+        # crash-consistency handoff (ISSUE 16): every volume the mount
+        # ladder repaired gets a targeted verify — the fabric re-checks
+        # it against replicas and re-replicates acked-but-local-lost
+        # needles, closing the zero-acked-loss contract cluster-wide
+        for vid in getattr(self.store.recovery_report, "suspects", []):
+            self.scrubber.report_suspect(vid)
         glog.info(f"volume server started on {self.address} "
                   f"(grpc :{self.grpc_port}"
                   + (", https" if https_ctx is not None else "")
@@ -2230,6 +2236,7 @@ def _make_http_handler(srv: VolumeServer):
                     group_commit_stats,
                     http_pool_stats,
                     qos_stats,
+                    recovery_stats,
                     scrub_stats,
                 )
 
@@ -2273,6 +2280,12 @@ def _make_http_handler(srv: VolumeServer):
                     # lifecycle, repair outcomes, pacing
                     "Scrub": {**srv.scrubber.status(),
                               "counters": scrub_stats()},
+                    # crash-consistency plane (ISSUE 16): what the mount
+                    # ladder detected/repaired after an unclean shutdown
+                    "Recovery": {
+                        **srv.store.recovery_report.status(),
+                        "counters": recovery_stats(),
+                    },
                     # QoS plane (ISSUE 8): live pressure score, the
                     # governor's leased class budgets, admission/grant
                     # counters

@@ -20,6 +20,8 @@ import json
 import mmap
 import os
 
+from ..utils import failpoint
+
 
 class BackendStorageFile:
     """SPI (backend.go BackendStorageFile)."""
@@ -71,9 +73,26 @@ class DiskFile(BackendStorageFile):
     def write_at(self, offset, data):
         return os.pwrite(self._f.fileno(), data, offset)
 
+    def _torn_guard(self, data: bytes) -> None:
+        # ISSUE 16 torn-write site: every sequential write — .dat needle
+        # records (via write()), .ec*/log appends (via append()) —
+        # funnels through here, so one armed point can tear any of
+        # them. The tear is fsync'd FIRST — a prefix still sitting in
+        # the page cache would vanish with the process and the "crash"
+        # would look clean — then the process dies (or, in in-process
+        # test stacks, raises; see failpoint.crash_self).
+        cut = failpoint.torn("backend.append", data,
+                             ctx=self.path + ",")
+        if cut is not None:
+            self._f.write(data[:cut])
+            self._f.flush()
+            os.fsync(self._f.fileno())
+            failpoint.crash_self("backend.append")
+
     def append(self, data):
         self._f.seek(0, 2)
         offset = self._f.tell()
+        self._torn_guard(data)
         self._f.write(data)
         return offset
 
@@ -91,6 +110,8 @@ class DiskFile(BackendStorageFile):
         return self._f.read(n)
 
     def write(self, data: bytes) -> int:
+        if failpoint.is_armed("backend.append"):
+            self._torn_guard(data)
         return self._f.write(data)
 
     def size(self):

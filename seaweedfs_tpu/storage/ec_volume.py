@@ -31,10 +31,12 @@ def load_volume_info(base_file_name: str) -> dict:
 
 
 def save_volume_info(base_file_name: str, info: dict) -> None:
-    import json
+    # atomic + fsync'd (ISSUE 16): the .vif names the volume's code
+    # geometry — a crash mid-rewrite leaving a truncated file would
+    # refuse the whole volume at next mount
+    from ..utils import atomic_write
 
-    with open(base_file_name + ".vif", "w") as f:
-        json.dump(info, f)
+    atomic_write.write_json_atomic(base_file_name + ".vif", info)
 
 
 def _read_at(f, offset: int, length: int) -> bytes:

@@ -48,6 +48,7 @@ from __future__ import annotations
 import os
 import threading
 
+from ..utils import atomic_write
 from .crc import crc32c
 
 MAGIC = b"\x00SWFSEP1"
@@ -120,10 +121,12 @@ class EpochStamper:
             pass
         self.incarnation = prev + 1
         try:
-            tmp = self.path + ".tmp"
-            with open(tmp, "w") as f:
-                f.write(str(self.incarnation))
-            os.replace(tmp, self.path)
+            # atomic + fsync'd (ISSUE 16): a torn incarnation file would
+            # reset the counter and let post-restart tags collide with
+            # pre-crash ones — the exact ambiguity the counter exists
+            # to remove
+            atomic_write.write_text_atomic(
+                self.path, str(self.incarnation))
         except OSError:
             pass  # best effort: a read-only disk still gets in-memory tags
         # fixed-width server identity; fall back to the directory path so

@@ -100,6 +100,17 @@ class Store:
         counts = max_volume_counts or [8] * len(directories)
         for d, c in zip(directories, counts):
             self.locations.append(DiskLocation(d, c))
+        # crash-consistency ladder (ISSUE 16): if the previous process
+        # died with the dirty marker still down, repair every location
+        # FILE-LEVEL before any Volume/EcVolume runtime opens the files
+        # (and before the epoch stamper reads the incarnation sidecar);
+        # recover_store also re-arms the markers for THIS incarnation —
+        # close() lifts them, so an unlifted marker at the next mount is
+        # the unclean-shutdown signal
+        from . import recovery as recovery_mod
+
+        self.recovery_report = recovery_mod.recover_store(
+            [loc.directory for loc in self.locations])
         # replica-epoch causality mint (ISSUE 13): one incarnation bump
         # per store start, attached to every volume this store serves
         from .epoch import EpochStamper
@@ -417,6 +428,12 @@ class Store:
             sched = getattr(coder, "_ec_dispatch_sched", None)
             if sched is not None:
                 sched.close()
+        # clean shutdown: lift the dirty markers LAST — everything above
+        # flushed and closed, so the next mount can trust the disk
+        from . import recovery as recovery_mod
+
+        for loc in self.locations:
+            recovery_mod.clear_dirty(loc.directory)
 
 
 def l_free(loc: DiskLocation) -> int:

@@ -668,6 +668,13 @@ class Volume:
                     with self._gc_cond:
                         target = self._gc_seq
                     try:
+                        from ..utils import failpoint
+
+                        # chaos seam: error -> the frozen-volume path below;
+                        # crash -> SIGKILL mid-group-commit, before any
+                        # buffered byte of this batch reaches the OS
+                        failpoint.fail("volume.commit.flush",
+                                       ctx=f"vol={self.id},")
                         # dat first: an idx entry must never hit the OS
                         # before the record bytes it points at
                         if self._dat is not None:
@@ -1040,6 +1047,12 @@ class Volume:
             self._dat.close()
             self.nm.close()
             os.replace(base + ".cpd", base + ".dat")
+            from ..utils import failpoint
+
+            # chaos seam between the two renames: a crash here leaves
+            # .cpx without .cpd, the one state the recovery ladder must
+            # roll FORWARD (the new .dat is already live)
+            failpoint.fail("volume.vacuum.commit", ctx=base + ",")
             os.replace(base + ".cpx", base + ".idx")
             from .backend import DiskFile
 

@@ -26,7 +26,10 @@ or via the environment for subprocess stacks (parsed once at import):
 
 Spec grammar: `<name>=<mode>(<p>[x<count>])[@<match>]` joined by `;`.
 Modes: `error` (raise FailpointError), `delay` (sleep p seconds),
-`corrupt` (XOR 0xFF into the payload's first byte). `x<count>` bounds
+`corrupt` (XOR 0xFF into the payload's first byte), `crash` (die at
+the site: SIGKILL-self, subprocess-only — see below), `torn` (write a
+random prefix of the buffer durably, then crash; honored only by the
+`storage/backend.py` append site). `x<count>` bounds
 how many times the point triggers (default unlimited); `@<match>`
 requires the substring to appear in the site-supplied ctx, so one
 replica out of many can be targeted inside a shared process. A match
@@ -38,12 +41,26 @@ never rely on either character: sites comma-terminate both addresses
 (`localhost:1234,`) and shard ids (`shard=7,`) precisely so a match
 for port 1234 or shard 1 cannot substring-hit port 12345 or shard 10,
 while staying expressible through the env.
+
+Crash semantics (ISSUE 16 kill-anywhere injection): a `crash`-mode
+point turns ANY armed site — volume.dat.write, ec.stream.slab,
+filer.store.mutate, every pb.<Method> — into a process-death site.
+Because SIGKILL-ing the pytest process would take the whole suite
+down, actual self-kill is gated on SWFS_CRASH_OK=1 (set only by
+harness-spawned server subprocesses); everywhere else the point
+degrades to raising FailpointError, which emulates "the process never
+got past this instruction" for in-process unit tests while keeping
+the anti-vacuous-pass convention. `torn` is crash's evil twin for the
+append path: the site writes a random strict prefix of the buffer,
+fsyncs it (a tear that isn't durable isn't observable), then crashes.
 """
 
 from __future__ import annotations
 
 import os
 import random
+import signal
+import sys
 import threading
 import time
 
@@ -62,7 +79,7 @@ class _Failpoint:
 
     def __init__(self, name: str, mode: str, p: float, count: int,
                  match: str, seed: int | None):
-        if mode not in ("error", "delay", "corrupt"):
+        if mode not in ("error", "delay", "corrupt", "crash", "torn"):
             raise ValueError(f"unknown failpoint mode {mode!r}")
         self.name = name
         self.mode = mode
@@ -137,11 +154,39 @@ class active:
         return False
 
 
+def crash_allowed() -> bool:
+    """True only when this process has opted into actual self-kill
+    (harness-spawned server subprocesses export SWFS_CRASH_OK=1)."""
+    return os.environ.get("SWFS_CRASH_OK", "").lower() in (
+        "1", "true", "on")
+
+
+def crash_self(name: str) -> None:
+    """Die at an armed crash site — SIGKILL-self so no atexit handler,
+    finally block, or flush runs (the whole point: model the kernel
+    yanking the process at this exact instruction). In-process test
+    stacks (no SWFS_CRASH_OK) degrade to FailpointError: "the process
+    never executed past here" without killing the test runner."""
+    if not crash_allowed():
+        raise FailpointError(name)
+    try:
+        sys.stderr.write(f"swfs.failpoint.crash: {name}\n")
+        sys.stderr.flush()
+    except Exception:
+        pass
+    try:
+        os.kill(os.getpid(), signal.SIGKILL)
+    except OSError:
+        pass
+    os._exit(137)  # unreachable after SIGKILL; belt-and-braces
+
+
 # -- injection-site verbs --------------------------------------------------
 
 def fail(name: str, *, ctx: str = "") -> None:
     """Raise FailpointError when an `error`-mode point triggers; also
-    honors delay-mode sleeps so a single site serves both."""
+    honors delay-mode sleeps and crash-mode death so a single site
+    serves error, delay and crash arms."""
     fp = _registry.get(name)
     if fp is None:
         return
@@ -152,34 +197,61 @@ def fail(name: str, *, ctx: str = "") -> None:
     if fp.mode == "delay":
         time.sleep(fp.p)
         return
+    if fp.mode == "crash":
+        crash_self(name)
     if fp.mode == "error":
         raise FailpointError(name)
-    # corrupt-mode points armed on a fail-only site degrade to errors:
-    # silently ignoring the arm would make a typo'd test vacuously pass
+    # corrupt/torn-mode points armed on a fail-only site degrade to
+    # errors: silently ignoring the arm would make a typo'd test
+    # vacuously pass
     raise FailpointError(name)
 
 
 def delay(name: str, *, ctx: str = "") -> None:
     fp = _registry.get(name)
-    if fp is None or fp.mode != "delay":
+    if fp is None or fp.mode not in ("delay", "crash"):
         return
     with _lock:
         triggered = fp.should_trigger(ctx)
-    if triggered:
-        time.sleep(fp.p)
+    if not triggered:
+        return
+    if fp.mode == "crash":
+        crash_self(name)
+    time.sleep(fp.p)
 
 
 def corrupt(name: str, data: bytes, *, ctx: str = "") -> bytes:
     """Flip the first byte when a `corrupt`-mode point triggers (enough
-    to break any CRC/tag without hiding length bugs)."""
+    to break any CRC/tag without hiding length bugs). Crash-mode arms
+    die here instead — every corrupt site is also a kill site."""
     fp = _registry.get(name)
-    if fp is None or fp.mode != "corrupt" or not data:
+    if fp is None or not data \
+            or fp.mode not in ("corrupt", "crash"):
         return data
     with _lock:
         triggered = fp.should_trigger(ctx)
     if not triggered:
         return data
+    if fp.mode == "crash":
+        crash_self(name)
     return bytes([data[0] ^ 0xFF]) + data[1:]
+
+
+def torn(name: str, data: bytes, *, ctx: str = "") -> int | None:
+    """Torn-write probe for append sites: when a `torn`-mode point
+    triggers, return the number of prefix bytes the site should write
+    before crashing (0 <= cut < len(data) — possibly nothing at all);
+    None means proceed normally. The SITE owns the mechanics (write
+    prefix, fsync, then call crash_self) because only it holds the
+    file descriptor; see DiskFile.append."""
+    fp = _registry.get(name)
+    if fp is None or fp.mode != "torn" or not data:
+        return None
+    with _lock:
+        triggered = fp.should_trigger(ctx)
+    if not triggered:
+        return None
+    return fp.rng.randrange(0, len(data))
 
 
 # -- SWFS_FAILPOINTS env bootstrap (subprocess server stacks) --------------

@@ -498,6 +498,60 @@ SCRUB_GATHER_RESUMES = Counter(
     "missing ranges are re-fetched).")
 
 
+# -- crash-consistency plane (ISSUE 16): mount-time recovery ladder ---------
+
+RECOVERY_RUNS = Counter(
+    "SeaweedFS_recovery_runs",
+    "Store startups by outcome (clean/unclean/disabled) — unclean means "
+    "the dirty marker survived the previous process and the ladder ran.")
+RECOVERY_TRUNCATED_BYTES = Counter(
+    "SeaweedFS_recovery_dat_truncated_bytes",
+    "Torn .dat tail bytes truncated to the last CRC-valid record "
+    "boundary by the recovery ladder.")
+RECOVERY_IDX_DROPPED = Counter(
+    "SeaweedFS_recovery_idx_entries_dropped",
+    "Index suffix entries dropped because their records extend past the "
+    "durable .dat prefix (idx-never-ahead-of-dat reconcile).")
+RECOVERY_EC_QUARANTINED = Counter(
+    "SeaweedFS_recovery_ec_files_quarantined",
+    "Half-streamed EC shard/journal files moved to .swfs_quarantine "
+    "because their base never saw its .ecx commit.")
+RECOVERY_SIDECARS_DISCARDED = Counter(
+    "SeaweedFS_recovery_sidecars_discarded",
+    "Corrupt sidecars discarded at mount by kind "
+    "(vif/dig/scb/tier/incarnation) — each rebuilds on the next pass.")
+RECOVERY_TMP_SWEPT = Counter(
+    "SeaweedFS_recovery_tmp_files_swept",
+    "Orphaned atomic-write *.tmp files swept by the recovery ladder.")
+RECOVERY_VACUUM_RESOLVED = Counter(
+    "SeaweedFS_recovery_vacuum_resolved",
+    "Interrupted vacuum commits resolved at mount by action "
+    "(rollback/rollforward).")
+RECOVERY_SUSPECTS = Counter(
+    "SeaweedFS_recovery_suspects_queued",
+    "Volumes handed to Scrubber.report_suspect after the ladder touched "
+    "them — the fabric re-verifies and re-replicates from peers.")
+
+
+def recovery_stats() -> dict:
+    """Snapshot for /status pages: what the last mount(s) repaired."""
+    return {
+        "runs": {o: int(RECOVERY_RUNS.value(outcome=o))
+                 for o in ("clean", "unclean", "disabled")},
+        "datTruncatedBytes": int(RECOVERY_TRUNCATED_BYTES.value()),
+        "idxEntriesDropped": int(RECOVERY_IDX_DROPPED.value()),
+        "ecFilesQuarantined": int(RECOVERY_EC_QUARANTINED.value()),
+        "sidecarsDiscarded": {
+            k: int(RECOVERY_SIDECARS_DISCARDED.value(kind=k))
+            for k in ("vif", "dig", "scb", "tier", "incarnation")},
+        "tmpSwept": int(RECOVERY_TMP_SWEPT.value()),
+        "vacuumResolved": {
+            a: int(RECOVERY_VACUUM_RESOLVED.value(action=a))
+            for a in ("rollback", "rollforward")},
+        "suspectsQueued": int(RECOVERY_SUSPECTS.value()),
+    }
+
+
 # -- QoS / admission plane (ISSUE 8): per-tenant ingress admission,
 #    cluster-wide background token grants, and the backpressure score
 #    the master folds into placement ------------------------------------

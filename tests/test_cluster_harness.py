@@ -86,6 +86,35 @@ def test_harness_tls_flap_zero_client_errors():
     assert out["clean_shutdown"] is True, out
 
 
+def test_harness_crash_drill_smoke():
+    """ISSUE 16 tentpole: two kill-anywhere rounds (torn dat append +
+    SIGKILL mid-group-commit) against a live 2-server cluster. Contract:
+    every ACKED write reads back byte-identical after the crashed
+    server restarts, unacked in-flight writes are all-or-nothing, and
+    the victim reports the unclean startup via /status.Recovery."""
+    proc = subprocess.run(
+        [sys.executable, _HARNESS, "--crash-drill", "--smoke",
+         "--servers", "2"],
+        cwd=_REPO, capture_output=True, text=True, timeout=400,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "SEAWEEDFS_TPU_NATIVE": "0"})
+    out = _last_json_line(proc.stdout)
+    assert out is not None, (proc.stdout[-500:], proc.stderr[-500:])
+    assert "error" not in out, out
+    assert out["ackedTotal"] > 0
+    assert out["ackedLost"] == 0 and out["partialVisible"] == 0
+    assert out["corruptReads"] == 0
+    # both armed sites actually SIGKILLed the victim mid-operation...
+    assert len(out["sitesHit"]) == 2, out["sitesHit"]
+    for rd in out["rounds"]:
+        assert rd.get("exit") == -9, rd
+        assert rd.get("crashMarker") is True, rd
+    # ...and both restarts detected the unclean shutdown and ran the
+    # recovery ladder before serving
+    assert out["uncleanRecoveries"] == 2, out
+    assert out["clean_shutdown"] is True, out
+
+
 def test_harness_smoke_all_shapes_and_clean_shutdown():
     # subprocess timeout is the watchdog here (no pytest-timeout in the
     # container); the conftest 300s faulthandler backstops the backstop

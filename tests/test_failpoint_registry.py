@@ -19,14 +19,16 @@ from pathlib import Path
 REPO = Path(__file__).resolve().parent.parent
 PKG = REPO / "seaweedfs_tpu"
 
-# injection-site verbs, as called at sites (possibly split over lines)
+# injection-site verbs, as called at sites (possibly split over lines);
+# `torn` is the ISSUE-16 partial-write verb, `is_armed` gates hot paths
 _SITE_RE = re.compile(
-    r'failpoint\.(?:fail|delay|corrupt|is_armed)\(\s*"([a-z0-9._]+)"')
+    r'failpoint\.(?:fail|delay|corrupt|torn|is_armed)\(\s*"([a-z0-9._]+)"')
 # programmatic arming in tests/tools
 _ARM_RE = re.compile(
     r'failpoint\.(?:active|configure)\(\s*"([a-zA-Z0-9._]+)"')
 # SWFS_FAILPOINTS / load_env spec items: <name>=<mode>(
-_SPEC_RE = re.compile(r'([a-zA-Z][a-zA-Z0-9._]*)=(?:error|delay|corrupt)\(')
+_SPEC_RE = re.compile(
+    r'([a-zA-Z][a-zA-Z0-9._]*)=(?:error|delay|corrupt|crash|torn)\(')
 
 
 def _scan(paths, regexes):

@@ -346,6 +346,30 @@ def test_lint_json_output_is_machine_readable():
         assert f["marker"] == "allowed" and f["reason"], f
 
 
+def test_lint_findings_never_exceed_baseline():
+    """ISSUE 16 satellite: a RATCHET on the marker-blessed debt. The
+    active-findings gate above keeps un-blessed findings at zero, but
+    nothing stopped a PR from quietly growing the *allowed* pile by
+    pasting justification markers. LINT_BASELINE.json pins the per-rule
+    ceiling; exceeding it fails, shrinking it should lower the baseline
+    in the same PR (asymmetric on purpose — improvements are free)."""
+    import json
+
+    with open(os.path.join(REPO, "LINT_BASELINE.json")) as f:
+        baseline = json.load(f)["by_rule"]
+    lint = _load_lint()
+    counts: dict[str, int] = {}
+    for finding in lint.custom_findings():
+        counts[finding.rule] = counts.get(finding.rule, 0) + 1
+    over = {rule: (n, baseline.get(rule, 0))
+            for rule, n in counts.items() if n > baseline.get(rule, 0)}
+    assert not over, (
+        "lint debt grew past LINT_BASELINE.json (rule: found > ceiling) "
+        f"{ {r: f'{n} > {b}' for r, (n, b) in over.items()} } — fix the "
+        "new finding or, if genuinely justified, raise the baseline "
+        "with an explanation in the PR")
+
+
 def test_every_swfs_knob_is_documented_in_readme():
     """ISSUE 15 satellite (mirror of the metrics-table test): every
     SWFS_* env knob the package reads must appear in README.md; the

@@ -1,0 +1,360 @@
+"""Unclean-shutdown recovery ladder (ISSUE 16): torn-tail goldens cut at
+every byte offset, idx reconcile, vacuum commit resolution, EC-orphan
+quarantine, sidecar validation, and the in-process chaos seams (the
+SIGKILL versions run in tools/cluster_harness.py --crash-drill)."""
+
+import os
+import shutil
+
+import pytest
+
+from seaweedfs_tpu.storage import recovery, types
+from seaweedfs_tpu.storage.needle import Needle
+from seaweedfs_tpu.storage.volume import NotFoundError, Volume
+from seaweedfs_tpu.utils import atomic_write, failpoint
+
+
+def make_needle(nid, data, cookie=0xABC):
+    return Needle.create(nid, cookie, data, last_modified=1_700_000_000)
+
+
+def build_volume(directory, vid=1, count=3, collection=""):
+    """A closed, on-disk volume with `count` needles; -> list of record
+    boundaries ([superblock_end, end_of_rec1, ...])."""
+    v = Volume(str(directory), collection, vid)
+    for i in range(count):
+        v.write_needle(make_needle(i + 1, bytes([i + 1]) * (50 + 13 * i)))
+    v.close()
+    return dat_boundaries(v.file_name() + ".dat")
+
+
+def dat_boundaries(dat_path):
+    """Parse record boundaries straight off the wire format."""
+    size = os.path.getsize(dat_path)
+    with open(dat_path, "rb") as f:
+        version = f.read(1)[0]
+        f.seek(6)
+        extra = int.from_bytes(f.read(2), "big")
+        bounds = [8 + extra]
+        off = bounds[0]
+        fd = f.fileno()
+        while off + types.NEEDLE_HEADER_SIZE <= size:
+            head = os.pread(fd, types.NEEDLE_HEADER_SIZE, off)
+            nsize = int.from_bytes(head[12:16], "big")
+            off += types.actual_size(nsize, version)
+            bounds.append(off)
+    assert bounds[-1] == size, "helper parsed a boundary past EOF"
+    return bounds
+
+
+# -- torn-tail goldens: a cut at EVERY byte offset across a boundary --------
+
+
+def test_torn_tail_golden_every_byte_offset(tmp_path):
+    """Cut the .dat at every byte offset across the last record and pin
+    the repaired size byte-exactly: any cut inside a record truncates to
+    the previous boundary; a cut exactly ON a boundary truncates
+    nothing."""
+    bounds = build_volume(tmp_path, vid=1)
+    dat = os.path.join(str(tmp_path), "1.dat")
+    pristine = os.path.join(str(tmp_path), "pristine.bin")
+    shutil.copy(dat, pristine)
+    prev_end, full_end = bounds[-2], bounds[-1]
+    for cut in range(prev_end, full_end + 1):
+        shutil.copy(pristine, dat)
+        with open(dat, "r+b") as f:
+            f.truncate(cut)
+        truncated, new_size = recovery.repair_dat_tail(dat)
+        want = full_end if cut == full_end else prev_end
+        assert new_size == want, f"cut at {cut}: repaired to {new_size}"
+        assert truncated == cut - want
+        assert os.path.getsize(dat) == want
+
+
+def test_torn_tail_corrupt_byte_not_just_short(tmp_path):
+    """A tail record with full length but a flipped DATA byte is just as
+    torn — the CRC walk must cut it."""
+    bounds = build_volume(tmp_path, vid=2)
+    dat = os.path.join(str(tmp_path), "2.dat")
+    with open(dat, "r+b") as f:
+        f.seek(bounds[-2] + types.NEEDLE_HEADER_SIZE + 4 + 2)
+        b = f.read(1)
+        f.seek(-1, 1)
+        f.write(bytes([b[0] ^ 0xFF]))
+    truncated, new_size = recovery.repair_dat_tail(dat)
+    assert new_size == bounds[-2]
+    assert truncated == bounds[-1] - bounds[-2]
+
+
+def test_scan_valid_prefix_counts_records(tmp_path):
+    bounds = build_volume(tmp_path, vid=3, count=4)
+    dat = os.path.join(str(tmp_path), "3.dat")
+    good_end, count = recovery.scan_valid_prefix(dat)
+    assert (good_end, count) == (bounds[-1], 4)
+    # sub-superblock file: report as-is, never "repair" it
+    with open(dat, "r+b") as f:
+        f.truncate(5)
+    assert recovery.scan_valid_prefix(dat) == (5, 0)
+    assert recovery.repair_dat_tail(dat) == (0, 5)
+
+
+def test_reconcile_idx_drops_exact_suffix(tmp_path):
+    bounds = build_volume(tmp_path, vid=4, count=3)
+    idx = os.path.join(str(tmp_path), "4.idx")
+    entries = os.path.getsize(idx) // types.NEEDLE_MAP_ENTRY_SIZE
+    assert entries == 3
+    # dat now ends after record 1: entries 2 and 3 point past the tail
+    dropped = recovery.reconcile_idx(idx, bounds[1])
+    assert dropped == 2
+    assert os.path.getsize(idx) == types.NEEDLE_MAP_ENTRY_SIZE
+    assert recovery.reconcile_idx(idx, bounds[1]) == 0
+
+
+def test_reconcile_idx_trusts_tombstones(tmp_path):
+    v = Volume(str(tmp_path), "", 5)
+    v.write_needle(make_needle(1, b"a" * 40))
+    v.write_needle(make_needle(2, b"b" * 40))
+    v.delete_needle(1, cookie=0xABC)
+    v.close()
+    idx = os.path.join(str(tmp_path), "5.idx")
+    dat_end = os.path.getsize(os.path.join(str(tmp_path), "5.dat"))
+    # nothing extends past the real tail; the tombstone must not trip
+    assert recovery.reconcile_idx(idx, dat_end) == 0
+
+
+# -- dirty-marker protocol ---------------------------------------------------
+
+
+def test_dirty_marker_roundtrip(tmp_path):
+    d = str(tmp_path)
+    assert not recovery.was_unclean(d)
+    recovery.mark_dirty(d)
+    assert recovery.was_unclean(d)
+    recovery.clear_dirty(d)
+    assert not recovery.was_unclean(d)
+
+
+def test_recover_store_clean_mount_skips_ladder(tmp_path):
+    d = str(tmp_path)
+    report = recovery.recover_store([d])
+    assert not report.unclean and not report.ran
+    # marker re-armed for THIS incarnation
+    assert recovery.was_unclean(d)
+
+
+def test_recover_store_disabled_by_knob(tmp_path, monkeypatch):
+    d = str(tmp_path)
+    build_volume(tmp_path, vid=6)
+    dat = os.path.join(d, "6.dat")
+    with open(dat, "r+b") as f:
+        f.truncate(os.path.getsize(dat) - 3)
+    recovery.mark_dirty(d)
+    monkeypatch.setenv("SWFS_RECOVERY", "0")
+    report = recovery.recover_store([d])
+    assert report.unclean and not report.ran
+    assert report.dat_truncated_bytes == 0
+
+
+# -- the full ladder over a crashed location ---------------------------------
+
+
+def test_ladder_torn_volume_end_to_end(tmp_path):
+    d = str(tmp_path)
+    bounds = build_volume(tmp_path, vid=7)
+    dat = os.path.join(d, "7.dat")
+    with open(dat, "r+b") as f:
+        f.truncate(bounds[-1] - 3)  # tear the last record
+    recovery.mark_dirty(d)
+    report = recovery.recover_store([d])
+    assert report.unclean and report.ran
+    assert report.dat_truncated_bytes == bounds[-1] - 3 - bounds[-2]
+    assert report.idx_entries_dropped == 1
+    assert report.suspects == [7]
+    v = Volume(d, "", 7)
+    assert v.read_needle(1).data == b"\x01" * 50
+    assert v.read_needle(2).data == b"\x02" * 63
+    with pytest.raises(NotFoundError):
+        v.read_needle(3)
+    v.close()
+
+
+def test_ladder_vacuum_rollback_and_rollforward(tmp_path):
+    d = str(tmp_path)
+    build_volume(tmp_path, vid=8)
+    base = os.path.join(d, "8")
+    # both .cpd and .cpx present: commit never started -> roll back
+    for ext in (".cpd", ".cpx"):
+        with open(base + ext, "wb") as f:
+            f.write(b"x")
+    recovery.mark_dirty(d)
+    report = recovery.recover_store([d])
+    assert report.vacuum_rolled_back == 1
+    assert not os.path.exists(base + ".cpd")
+    assert not os.path.exists(base + ".cpx")
+    # .cpx alone: the .dat rename already happened -> roll FORWARD
+    old_idx = open(base + ".idx", "rb").read()
+    with open(base + ".cpx", "wb") as f:
+        f.write(old_idx)
+    os.remove(base + ".idx")
+    report2 = recovery.recover_store([d])
+    assert report2.vacuum_rolled_forward == 1
+    assert not os.path.exists(base + ".cpx")
+    assert open(base + ".idx", "rb").read() == old_idx
+
+
+def test_ladder_quarantines_uncommitted_ec_shards(tmp_path):
+    d = str(tmp_path)
+    for name in ("9.ec00", "9.ec01", "9.ecj"):
+        with open(os.path.join(d, name), "wb") as f:
+            f.write(b"half-streamed")
+    # a COMMITTED set (has .ecx) must be left alone
+    for name in ("10.ec00", "10.ecx"):
+        with open(os.path.join(d, name), "wb") as f:
+            f.write(b"committed")
+    recovery.mark_dirty(d)
+    report = recovery.recover_store([d])
+    assert report.ec_shards_quarantined == 3
+    qdir = os.path.join(d, recovery.QUARANTINE_DIR)
+    assert sorted(os.listdir(qdir)) == ["9.ec00", "9.ec01", "9.ecj"]
+    assert os.path.exists(os.path.join(d, "10.ec00"))
+    assert 9 in report.suspects
+
+
+def test_ladder_discards_corrupt_sidecars(tmp_path):
+    d = str(tmp_path)
+    with open(os.path.join(d, "1.vif"), "w") as f:
+        f.write('{"version": 3')  # truncated JSON
+    with open(os.path.join(d, "2.vif"), "w") as f:
+        f.write('{"version": 3}')
+    with open(os.path.join(d, "1.dig"), "wb") as f:
+        f.write(b"BADMAGIC" + b"\x00" * 16)
+    with open(os.path.join(d, ".swfs_incarnation"), "w") as f:
+        f.write("not-a-number")
+    recovery.mark_dirty(d)
+    report = recovery.recover_store([d])
+    assert report.sidecars_discarded == {"vif": 1, "dig": 1,
+                                         "incarnation": 1}
+    assert not os.path.exists(os.path.join(d, "1.vif"))
+    assert os.path.exists(os.path.join(d, "2.vif"))
+    assert not os.path.exists(os.path.join(d, ".swfs_incarnation"))
+
+
+def test_ladder_sweeps_orphan_tmp(tmp_path):
+    d = str(tmp_path)
+    with open(os.path.join(d, "3.vif.tmp"), "wb") as f:
+        f.write(b"{}")
+    recovery.mark_dirty(d)
+    report = recovery.recover_store([d])
+    assert report.tmp_swept == 1
+    assert os.listdir(d) == [recovery.DIRTY_MARKER]
+
+
+# -- in-process chaos seams (crash mode degrades to FailpointError) ----------
+
+
+def _abandon(v):
+    """Simulate process death for an open Volume: close the underlying
+    fds WITHOUT flushing, so buffered (= never-acked) bytes die with
+    "the process" exactly as a SIGKILL would lose them."""
+    for f in (v._dat._f, v.nm._idx_file):
+        try:
+            os.close(f.fileno())
+        except OSError:
+            pass
+
+
+def test_seam_sidecar_write_leaves_sweepable_tmp(tmp_path):
+    """Die between tmp-fsync and rename: the final file never changes,
+    the orphan tmp is swept on the next mount."""
+    path = os.path.join(str(tmp_path), "11.vif")
+    atomic_write.write_json_atomic(path, {"version": 3})
+    with failpoint.active("sidecar.write", mode="error", match=".vif,"):
+        with pytest.raises(failpoint.FailpointError):
+            atomic_write.write_json_atomic(path, {"version": 99})
+    assert os.path.exists(path + ".tmp")
+    import json
+
+    assert json.load(open(path)) == {"version": 3}
+    recovery.mark_dirty(str(tmp_path))
+    report = recovery.recover_store([str(tmp_path)])
+    assert report.tmp_swept == 1
+
+
+def test_seam_group_commit_flush_crash(tmp_path):
+    """Kill the leader inside the flush: nothing of the batch was acked,
+    so the reopened volume owes the writer nothing — and serves the
+    earlier acked needle."""
+    d = str(tmp_path)
+    v = Volume(d, "", 12)
+    v.write_needle(make_needle(1, b"durable" * 10))
+    with failpoint.active("volume.commit.flush", mode="error", count=1):
+        with pytest.raises(IOError):
+            v.write_needle(make_needle(2, b"doomed" * 10))
+    _abandon(v)  # buffered needle-2 bytes die with "the process"
+    recovery.mark_dirty(d)
+    recovery.recover_store([d])
+    v2 = Volume(d, "", 12)
+    assert v2.read_needle(1).data == b"durable" * 10
+    with pytest.raises(NotFoundError):
+        v2.read_needle(2)
+    v2.close()
+
+
+def test_seam_vacuum_commit_crash_rolls_forward(tmp_path):
+    """Die between commit_compact's two renames: the new .dat is live,
+    the .idx rename is lost — recovery must finish the commit and the
+    reopened volume serves every pre-vacuum needle."""
+    d = str(tmp_path)
+    v = Volume(d, "", 13)
+    for i in range(3):
+        v.write_needle(make_needle(i + 1, bytes([0x40 + i]) * 64))
+    v.delete_needle(2, cookie=0xABC)
+    v.compact()
+    with failpoint.active("volume.vacuum.commit", mode="error", count=1):
+        with pytest.raises(failpoint.FailpointError):
+            v.commit_compact()
+    base = os.path.join(d, "13")
+    assert os.path.exists(base + ".cpx")
+    assert not os.path.exists(base + ".cpd")
+    recovery.mark_dirty(d)
+    report = recovery.recover_store([d])
+    assert report.vacuum_rolled_forward == 1
+    v2 = Volume(d, "", 13)
+    assert v2.read_needle(1).data == b"\x40" * 64
+    assert v2.read_needle(3).data == b"\x42" * 64
+    with pytest.raises(NotFoundError):
+        v2.read_needle(2)  # the delete must NOT resurrect
+    v2.close()
+
+
+def test_seam_torn_backend_write_then_recover(tmp_path):
+    """The tentpole torn action end-to-end in one process, at the
+    backend layer (the Volume write path converts the degraded 'crash'
+    into its own OSError cleanup): the armed write tears mid-record —
+    a random prefix is fsync'd, then the 'crash' — and the ladder
+    truncates the file back to the last valid boundary."""
+    from seaweedfs_tpu.storage.backend import DiskFile
+
+    d = str(tmp_path)
+    v = Volume(d, "", 14)
+    v.write_needle(make_needle(1, b"acked" * 20))
+    v.close()
+    dat = os.path.join(d, "14.dat")
+    good = os.path.getsize(dat)
+    f = DiskFile(dat)
+    with failpoint.active("backend.append", mode="torn", count=1,
+                          match=".dat,"):
+        with pytest.raises(failpoint.FailpointError):
+            f.append(b"\xab" * 500)  # garbage record, torn mid-write
+    f.close()
+    torn_size = os.path.getsize(dat)
+    assert good <= torn_size < good + 500
+    recovery.mark_dirty(d)
+    report = recovery.recover_store([d])
+    assert report.dat_truncated_bytes == torn_size - good
+    assert os.path.getsize(dat) == good
+    v2 = Volume(d, "", 14)
+    assert v2.read_needle(1).data == b"acked" * 20
+    with pytest.raises(NotFoundError):
+        v2.read_needle(2)
+    v2.close()

@@ -175,7 +175,8 @@ class Cluster:
 
     def __init__(self, servers: int, extra_env: dict | None = None,
                  volume_env: dict | None = None,
-                 filer_env: dict | None = None):
+                 filer_env: dict | None = None,
+                 filer_store: str = "memory"):
         self.tmp = tempfile.mkdtemp(prefix="swfs-harness-")
         self.procs: list = []
         self.extra_env = dict(extra_env or {})
@@ -207,11 +208,13 @@ class Cluster:
         # 1MB chunks: the bigfile shape's multi-chunk objects stay cheap
         # on this box (small-file shapes are unaffected — their bodies
         # are far below either chunk size)
-        self.procs.append(spawn(
+        self.filer_index = 1 + servers  # procs[] slot of the filer
+        self._filer_spec = (
             ["filer", "-port", str(fport), "-master", self.master,
-             "-dir", os.path.join(self.tmp, "filer"), "-store", "memory",
-             "-maxMB", "1"],
-            os.path.join(self.tmp, "filer.log"), fenv))
+             "-dir", os.path.join(self.tmp, "filer"),
+             "-store", filer_store, "-maxMB", "1"],
+            os.path.join(self.tmp, "filer-server.log"), fenv)
+        self.procs.append(spawn(*self._filer_spec))
         s3port = free_port()
         self.s3 = f"localhost:{s3port}"
         self.procs.append(spawn(
@@ -226,11 +229,14 @@ class Cluster:
     def all_addrs(self) -> list[str]:
         return [self.master, *self.vol_addrs, self.filer, self.s3]
 
-    def restart_volume(self, i: int, timeout: float = 120) -> None:
+    def restart_volume(self, i: int, timeout: float = 120,
+                       extra_env: dict | None = None) -> None:
         """Kill volume server `i` and respawn it on the same port/dir
         with its CURRENT env — certs re-read from disk, so a
         tls-rotation restart serves the new certificate. Returns once
-        its /status answers again."""
+        its /status answers again. `extra_env` applies to THIS respawn
+        only (the stored spec is untouched), which is how the crash
+        drill arms one-shot failpoints in a single incarnation."""
         args, log, env = self._vol_specs[i]
         proc = self.procs[1 + i]  # procs[0] is the master
         try:
@@ -239,8 +245,24 @@ class Cluster:
         except (OSError, subprocess.TimeoutExpired):
             proc.kill()
             proc.wait(timeout=15)
+        env = dict(env, **(extra_env or {}))
         self.procs[1 + i] = spawn(args, log + ".restart", env)
         wait_http(self.vol_addrs[i], timeout=timeout)
+
+    def restart_filer(self, timeout: float = 120,
+                      extra_env: dict | None = None) -> None:
+        """Same as restart_volume, for the filer (crash-drill target)."""
+        args, log, env = self._filer_spec
+        proc = self.procs[self.filer_index]
+        try:
+            proc.send_signal(signal.SIGTERM)
+            proc.wait(timeout=15)
+        except (OSError, subprocess.TimeoutExpired):
+            proc.kill()
+            proc.wait(timeout=15)
+        env = dict(env, **(extra_env or {}))
+        self.procs[self.filer_index] = spawn(args, log + ".restart", env)
+        wait_http(self.filer, timeout=timeout)
 
     def stop(self) -> None:
         for p in self.procs:
@@ -1237,6 +1259,384 @@ def run_tls_flap(servers: int = 1, vol_mb: float = 2.0) -> dict:
     return out
 
 
+# -- crash drill (ISSUE 16): kill-anywhere + unclean-restart contract --------
+
+
+# (victim, trigger, SWFS_FAILPOINTS spec, plane). Every spec is one-shot
+# (x1) so the victim dies exactly once per round; SWFS_CRASH_OK gates the
+# SIGKILL to these armed children only.
+CRASH_SITES: list = [
+    ("volume", "put", "backend.append=torn(1.0x1)@.dat,", "volume-write"),
+    ("volume", "put", "volume.http.write=crash(1.0x1)", "volume-write"),
+    ("volume", "put", "volume.commit.flush=crash(1.0x1)", "group-commit"),
+    ("volume", "ec", "ec.shard.write.corrupt=crash(1.0x1)", "ec-encode"),
+    ("volume", "ec", "sidecar.write=crash(1.0x1)@.vif,", "sidecar"),
+    ("volume", "vacuum", "volume.vacuum.commit=crash(1.0x1)", "vacuum"),
+    ("filer", "put", "filer.store.mutate=crash(1.0x1)", "filer-meta"),
+]
+
+
+def _wait_dead(proc, timeout: float = 60.0) -> bool:
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if proc.poll() is not None:
+            return True
+        time.sleep(0.2)
+    return False
+
+
+def _log_tail(path: str, n: int = 8000) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, 2)
+            size = f.tell()
+            f.seek(max(0, size - n))
+            return f.read().decode("utf-8", "replace")
+    except OSError:
+        return ""
+
+
+def _get_retry(url: str, tries: int, sleep_s: float = 1.5):
+    """GET until a definitive answer (200/404) or the budget runs out.
+    5xx and connection errors retry: the restarted server re-registers
+    with the master inside this window. Returns the last response (or
+    the last exception if nothing ever connected)."""
+    last = None
+    for k in range(tries):
+        try:
+            r = requests.get(url, timeout=10, verify=_verify())
+            last = r
+            if r.status_code in (200, 404):
+                return r
+        except requests.RequestException as e:
+            last = e
+        if k + 1 < tries:
+            time.sleep(sleep_s)
+    return last
+
+
+def _recovery_status(addr: str) -> dict:
+    try:
+        r = requests.get(_u(addr, "/status"), timeout=10, verify=_verify())
+        return r.json().get("Recovery", {})
+    except Exception:  # noqa: BLE001 — absence is itself reported
+        return {}
+
+
+def _drill_put_storm(cluster: Cluster, victim, base_url: str, paths, rng,
+                     deadline_s: float = 60.0):
+    """PUT small objects (drawn from the `paths` generator) until the
+    armed victim dies. -> (acked, unacked): path -> sha256, partitioned
+    by whether the client saw a 2xx before the crash."""
+    import hashlib
+
+    acked: dict = {}
+    unacked: dict = {}
+    deadline = time.monotonic() + deadline_s
+    with requests.Session() as s:
+        for path in paths:
+            if victim.poll() is not None or time.monotonic() > deadline:
+                break
+            body = os.urandom(rng.randrange(1, 48 << 10))
+            sha = hashlib.sha256(body).hexdigest()
+            try:
+                r = s.put(_u(base_url, path), data=body, timeout=15,
+                          verify=_verify())
+                if 200 <= r.status_code < 300:
+                    acked[path] = sha
+                else:
+                    unacked[path] = sha
+            except requests.RequestException:
+                unacked[path] = sha
+    return acked, unacked
+
+
+def _drill_verify_filer(cluster: Cluster, acked: dict, unacked: dict,
+                        rd: dict) -> None:
+    """The crash-consistency contract, read back through the filer."""
+    import hashlib
+
+    for path, sha in acked.items():
+        r = _get_retry(_u(cluster.filer, path), tries=20)
+        if not (hasattr(r, "status_code") and r.status_code == 200):
+            rd["ackedLost"].append(path)
+        elif hashlib.sha256(r.content).hexdigest() != sha:
+            rd["corruptReads"].append(path)
+    for path, sha in unacked.items():
+        r = _get_retry(_u(cluster.filer, path), tries=6)
+        if hasattr(r, "status_code") and r.status_code == 200:
+            # the ack was lost in flight but the write landed whole:
+            # allowed — only a PARTIAL or mangled body violates the
+            # contract
+            if hashlib.sha256(r.content).hexdigest() != sha:
+                rd["partialVisible"].append(path)
+        elif hasattr(r, "status_code") and r.status_code == 404:
+            pass
+        else:
+            # persistent 5xx on an unacked write: a partial made it far
+            # enough to poison the read path (the acked sweep above
+            # already proved the cluster is serving)
+            rd["partialVisible"].append(path)
+
+
+def _drill_filer_round(cluster: Cluster, k: int, spec: str, rd: dict,
+                       rng) -> None:
+    """Kill the filer mid-metadata-mutation, then hold the contract
+    through its (persistent leveldb-store) log replay."""
+    import hashlib
+
+    # seed acked entries pre-arm (see _drill_put_round): the filer dies
+    # on its very first post-arm mutation
+    seeded: dict = {}
+    with requests.Session() as s:
+        for n in range(16):
+            path = f"/drill/r{k}/seed{n:03d}"
+            body = os.urandom(rng.randrange(1, 48 << 10))
+            r = s.put(_u(cluster.filer, path), data=body, timeout=15,
+                      verify=_verify())
+            if not 200 <= r.status_code < 300:
+                raise RuntimeError(f"seed PUT {r.status_code}: {r.text}")
+            seeded[path] = hashlib.sha256(body).hexdigest()
+    arm = {"SWFS_FAILPOINTS": spec, "SWFS_CRASH_OK": "1"}
+    cluster.restart_filer(extra_env=arm)
+    victim = cluster.procs[cluster.filer_index]
+    paths = (f"/drill/r{k}/o{n:05d}" for n in range(100000))
+    acked, unacked = _drill_put_storm(cluster, victim, cluster.filer,
+                                      paths, rng)
+    acked.update(seeded)
+    rd["acked"], rd["unacked"] = len(acked), len(unacked)
+    if victim.poll() is None:
+        rd["error"] = "armed site never tripped"
+        cluster.restart_filer()
+        return
+    rd["exit"] = victim.returncode
+    rd["crashMarker"] = "swfs.failpoint.crash" in _log_tail(
+        cluster._filer_spec[1] + ".restart")
+    cluster.restart_filer()
+    _drill_verify_filer(cluster, acked, unacked, rd)
+
+
+def _drill_put_round(cluster: Cluster, k: int, spec: str, rd: dict,
+                     rng) -> None:
+    """Kill one volume server mid-write. The storm goes DIRECT to the
+    victim's own volume (the bench fill pattern): the master's assign
+    spreads filer traffic across every writable volume, so after earlier
+    rounds the armed server might otherwise never see a write."""
+    from seaweedfs_tpu.operation import submit
+
+    col = f"drillp{k}"
+    res = submit(cluster.master, b"seed", filename="s.bin",
+                 collection=col)
+    if "fid" not in res:
+        raise RuntimeError(f"submit failed: {res}")
+    vid = parse_file_id(res["fid"]).volume_id
+    holder = res["url"]
+    i = cluster.vol_addrs.index(holder)
+    rd["victimIndex"] = i
+    import hashlib
+
+    # seed ACKED writes before arming: the one-shot sites kill the
+    # victim on its first post-arm write, and the contract needs a
+    # populated acked set for the tail-truncation sweep to threaten
+    key0 = (0x60 + k) << 24
+    seeded: dict = {}
+    with requests.Session() as s:
+        for n in range(16):
+            path = f"/{vid},{key0 + n:x}00002026"
+            body = os.urandom(rng.randrange(1, 48 << 10))
+            r = s.put(_u(holder, path), data=body, timeout=15,
+                      verify=_verify())
+            if r.status_code not in (200, 201):
+                raise RuntimeError(f"seed PUT {r.status_code}: {r.text}")
+            seeded[path] = hashlib.sha256(body).hexdigest()
+    cluster.restart_volume(i, extra_env={"SWFS_FAILPOINTS": spec,
+                                         "SWFS_CRASH_OK": "1"})
+    victim = cluster.procs[1 + i]
+    paths = (f"/{vid},{key0 + n:x}00002026"
+             for n in range(16, 100000))
+    acked, unacked = _drill_put_storm(cluster, victim, holder, paths, rng)
+    acked.update(seeded)
+    rd["acked"], rd["unacked"] = len(acked), len(unacked)
+    if victim.poll() is None:
+        rd["error"] = "armed site never tripped"
+        cluster.restart_volume(i)
+        return
+    rd["exit"] = victim.returncode
+    rd["crashMarker"] = "swfs.failpoint.crash" in _log_tail(
+        cluster._vol_specs[i][1] + ".restart")
+    rpc.reset_channels()
+    cluster.restart_volume(i)
+    rd["recovery"] = _recovery_status(cluster.vol_addrs[i])
+    import hashlib
+
+    for path, sha in acked.items():
+        r = _get_retry(_u(holder, path), tries=20)
+        if not (hasattr(r, "status_code") and r.status_code == 200):
+            rd["ackedLost"].append(path)
+        elif hashlib.sha256(r.content).hexdigest() != sha:
+            rd["corruptReads"].append(path)
+    for path, sha in unacked.items():
+        r = _get_retry(_u(holder, path), tries=6)
+        if hasattr(r, "status_code") and r.status_code == 200:
+            if hashlib.sha256(r.content).hexdigest() != sha:
+                rd["partialVisible"].append(path)
+        elif hasattr(r, "status_code") and r.status_code == 404:
+            pass
+        else:
+            rd["partialVisible"].append(path)
+
+
+def _drill_rpc_round(cluster: Cluster, k: int, spec: str, rd: dict,
+                     vol_mb: float, trigger: str) -> None:
+    """Fill a volume clean, re-arm its holder, then drive the one RPC
+    whose handler crosses the armed seam (ec.encode / vacuum commit)."""
+    import hashlib
+
+    from seaweedfs_tpu.pb import volume_server_pb2 as vs2
+
+    col = f"drill{k}"
+    seed = 100 + k
+    mb = max(1.0, min(vol_mb, 4.0))
+    vid = _fill_volume(cluster, col, seed, mb)
+    stub = rpc.master_stub(rpc.grpc_address(cluster.master))
+    resp = stub.LookupVolume(master_pb2.LookupVolumeRequest(
+        volume_or_file_ids=[str(vid)]), timeout=10)
+    holder = resp.volume_id_locations[0].locations[0].url
+    i = cluster.vol_addrs.index(holder)
+    rd["victimIndex"] = i
+    key0 = (0x7F - (seed % 0x70)) << 24
+    fids = [f"{vid},{key0 + n:x}00002026" for n in range(max(1, int(mb)))]
+    shas = {}
+    for fid in fids:
+        r = requests.get(_u(holder, f"/{fid}"), timeout=30,
+                         verify=_verify())
+        if r.status_code != 200:
+            raise RuntimeError(f"pre-crash read {fid}: {r.status_code}")
+        shas[fid] = hashlib.sha256(r.content).hexdigest()
+    deleted = None
+    if trigger == "vacuum" and len(fids) > 1:
+        # a tombstone gives the compaction real garbage to drop, and a
+        # resurrected delete after the roll-forward would be corruption
+        deleted = fids.pop()
+        shas.pop(deleted)
+        requests.delete(_u(holder, f"/{deleted}"), timeout=30,
+                        verify=_verify())
+    cluster.restart_volume(i, extra_env={"SWFS_FAILPOINTS": spec,
+                                         "SWFS_CRASH_OK": "1"})
+    victim = cluster.procs[1 + i]
+    vstub = rpc.volume_stub(rpc.grpc_address(holder))
+    try:
+        if trigger == "ec":
+            vstub.VolumeEcShardsGenerate(
+                vs2.VolumeEcShardsGenerateRequest(volume_id=vid,
+                                                  collection=col),
+                timeout=180)
+        else:
+            for _ in vstub.VacuumVolumeCompact(
+                    vs2.VacuumVolumeCompactRequest(volume_id=vid),
+                    timeout=180):
+                pass
+            vstub.VacuumVolumeCommit(
+                vs2.VacuumVolumeCommitRequest(volume_id=vid), timeout=60)
+    except Exception as e:  # noqa: BLE001 — the point is the child dies
+        rd["rpcError"] = type(e).__name__
+    if not _wait_dead(victim):
+        rd["error"] = "armed site never tripped"
+        rpc.reset_channels()
+        cluster.restart_volume(i)
+        return
+    rd["exit"] = victim.returncode
+    rd["crashMarker"] = "swfs.failpoint.crash" in _log_tail(
+        cluster._vol_specs[i][1] + ".restart")
+    rpc.reset_channels()
+    cluster.restart_volume(i)
+    rd["recovery"] = _recovery_status(holder)
+    rd["acked"], rd["unacked"] = len(shas), 0
+    for fid, sha in shas.items():
+        r = _get_retry(_u(holder, f"/{fid}"), tries=20)
+        if not (hasattr(r, "status_code") and r.status_code == 200):
+            rd["ackedLost"].append(fid)
+        elif hashlib.sha256(r.content).hexdigest() != sha:
+            rd["corruptReads"].append(fid)
+    if deleted is not None:
+        r = _get_retry(_u(holder, f"/{deleted}"), tries=3)
+        if hasattr(r, "status_code") and r.status_code == 200:
+            rd["partialVisible"].append(deleted)  # resurrected delete
+
+
+def run_crash_drill(servers: int, rounds: int = 0, vol_mb: float = 2.0,
+                    smoke: bool = False, seed: int = 16) -> dict:
+    """Kill-anywhere drill (ISSUE 16). Per round: re-arm ONE server with
+    a one-shot crash/torn failpoint, drive the matching load until the
+    process SIGKILLs itself mid-operation, restart it, and hold the
+    crash-consistency contract:
+
+      * every ACKED write reads back byte-identical afterwards;
+      * every unacked in-flight write is all-or-nothing — 404 or the
+        exact bytes, never a partial or mangled body;
+      * the restarted server reports the unclean startup (and what the
+        recovery ladder repaired) in /status.Recovery.
+    """
+    import random
+
+    rng = random.Random(seed)
+    if smoke:
+        # torn dat append + mid-group-commit kill: the two volume-plane
+        # seams, cheap enough for tier-1 (no filer/ec/vacuum rounds)
+        sites = [CRASH_SITES[0], CRASH_SITES[2]]
+    else:
+        sites = list(CRASH_SITES)
+        rng.shuffle(sites)
+    if rounds and rounds > 0:
+        sites = [sites[k % len(sites)] for k in range(rounds)]
+    out: dict = {"metric": "crash_drill", "servers": servers,
+                 "smoke": smoke, "rounds": []}
+    cluster = Cluster(servers, filer_store="leveldb")
+    try:
+        cluster.wait(servers)
+        for k, (victim_kind, trigger, spec, plane) in enumerate(sites):
+            rd: dict = {"site": spec, "plane": plane,
+                        "victim": victim_kind, "ackedLost": [],
+                        "partialVisible": [], "corruptReads": []}
+            try:
+                if victim_kind == "filer":
+                    _drill_filer_round(cluster, k, spec, rd, rng)
+                elif trigger == "put":
+                    _drill_put_round(cluster, k, spec, rd, rng)
+                else:
+                    _drill_rpc_round(cluster, k, spec, rd, vol_mb,
+                                     trigger)
+            except Exception as e:  # noqa: BLE001 — keep other rounds
+                rd["error"] = f"{type(e).__name__}: {e}"[:300]
+            out["rounds"].append(rd)
+        out["sitesHit"] = sorted({r["site"] for r in out["rounds"]
+                                  if r.get("crashMarker")})
+        out["planesHit"] = sorted({r["plane"] for r in out["rounds"]
+                                   if r.get("crashMarker")})
+        out["ackedTotal"] = sum(r.get("acked", 0) for r in out["rounds"])
+        out["ackedLost"] = sum(len(r["ackedLost"]) for r in out["rounds"])
+        out["partialVisible"] = sum(len(r["partialVisible"])
+                                    for r in out["rounds"])
+        out["corruptReads"] = sum(len(r["corruptReads"])
+                                  for r in out["rounds"])
+        out["uncleanRecoveries"] = sum(
+            1 for r in out["rounds"]
+            if r.get("recovery", {}).get("uncleanShutdown"))
+        bad = [r for r in out["rounds"] if r.get("error")]
+        missing_recovery = [
+            r for r in out["rounds"]
+            if r["victim"] == "volume" and not r.get("error")
+            and not r.get("recovery", {}).get("uncleanShutdown")]
+        if (bad or missing_recovery or out["ackedLost"]
+                or out["partialVisible"] or out["corruptReads"]
+                or out["ackedTotal"] == 0):
+            out["error"] = "crash drill failed assertions"
+    finally:
+        cluster.stop()
+        out["clean_shutdown"] = getattr(cluster, "clean_shutdown", False)
+    return out
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--smoke", action="store_true")
@@ -1247,6 +1647,7 @@ def main() -> int:
                     default=float(os.environ.get("SWFS_HARNESS_WIRE_MS",
                                                  "15")))
     ap.add_argument("--tls-flap", action="store_true")
+    ap.add_argument("--crash-drill", action="store_true")
     ap.add_argument("--https", action="store_true")
     ap.add_argument("--servers", type=int,
                     default=int(os.environ.get("SWFS_HARNESS_SERVERS",
@@ -1265,7 +1666,13 @@ def main() -> int:
     try:
         if opts.https or opts.tls_flap:
             enable_https(tempfile.mkdtemp(prefix="swfs-harness-pki-"))
-        if opts.tls_flap:
+        if opts.crash_drill:
+            # rounds=0 -> every site in CRASH_SITES exactly once (the
+            # full drill covers all planes; --smoke trims to two)
+            out = run_crash_drill(max(2, min(opts.servers, 3)),
+                                  vol_mb=min(opts.vol_mb, 4.0),
+                                  smoke=opts.smoke)
+        elif opts.tls_flap:
             out = run_tls_flap(max(1, min(opts.servers, 2)),
                                vol_mb=min(opts.vol_mb, 2.0))
         elif opts.bigfile_ab:
