@@ -2034,6 +2034,131 @@ def _await_device_probe() -> dict:
     return probe
 
 
+def _bench_xor_sched_ab() -> dict:
+    """ISSUE 17 A/B: compiled XOR-schedule codec plane vs the dense
+    rs_cpu GF path, interleaved arms over IDENTICAL bytes. Targets the
+    acceptance gates directly: LRC(10,2,2) parity encode (the local
+    parities compile to near-memcpy XOR streams) must gain >= +30%
+    median, RS(10,4) fallback encode >= +15% median; single-loss repair
+    (LRC 5-survivor group plan + RS sorted-first-k) rides along for the
+    record. Shard sha256 equality across sched-on / sched-off / oracle
+    is asserted IN-RUN — a speedup that changed one byte is a failure,
+    not a result."""
+    import hashlib
+
+    import numpy as np
+
+    from seaweedfs_tpu.models import geometry as gm
+    from seaweedfs_tpu.ops import rs_sched
+    from seaweedfs_tpu.ops.rs_cpu import RSCodecCPU
+
+    rounds = int(os.environ.get("SEAWEEDFS_TPU_XORSCHED_ROUNDS", "5"))
+    mb = float(os.environ.get("SEAWEEDFS_TPU_XORSCHED_MB", "4"))
+    width = int(mb * (1 << 20))
+    rng = np.random.default_rng(0x17)
+    data = rng.integers(0, 256, size=(10, width), dtype=np.uint8)
+    coders = {
+        "lrc_10_2_2": RSCodecCPU(10, 4, geometry="lrc_10_2_2"),
+        "rs_10_4": RSCodecCPU(10, 4),
+    }
+    out: dict = {
+        "bench": "xor_sched_ab", "issue": 17, "rounds": rounds,
+        "shard_bytes": width, "backend": "numpy (rs_cpu host plane)",
+        "encode": {}, "repair": {},
+    }
+    try:
+        from seaweedfs_tpu.ops import rs_native
+
+        out["native_simd_level"] = rs_native.simd_level()
+    except Exception:  # noqa: BLE001
+        out["native_simd_level"] = -1
+
+    def _ab(label, dense_fn, sched_fn, section):
+        walls = {"dense": [], "sched": []}
+        ref = dense_fn()
+        ref_hash = hashlib.sha256(np.ascontiguousarray(ref)).hexdigest()
+        for r in range(rounds):
+            # interleaved, order alternating per round: neither arm
+            # systematically inherits a warm cache or a busy box
+            order = (("dense", dense_fn), ("sched", sched_fn))
+            if r % 2:
+                order = order[::-1]
+            for arm, fn in order:
+                t0 = time.perf_counter()
+                got = fn()
+                walls[arm].append(time.perf_counter() - t0)
+                h = hashlib.sha256(
+                    np.ascontiguousarray(got)).hexdigest()
+                assert h == ref_hash, \
+                    f"{label}/{arm} changed bytes vs the oracle"
+        dense_med, sched_med = _med(walls["dense"]), _med(walls["sched"])
+        out[section][label] = {
+            "dense_wall_s": [round(w, 5) for w in walls["dense"]],
+            "sched_wall_s": [round(w, 5) for w in walls["sched"]],
+            "dense_median_s": round(dense_med, 5),
+            "sched_median_s": round(sched_med, 5),
+            "dense_mb_s": round(mb * 10 / dense_med, 1),
+            "sched_mb_s": round(mb * 10 / sched_med, 1),
+            "speedup_pct": round(100 * (dense_med / sched_med - 1), 1),
+            "shards_sha256_identical": True,
+        }
+        return out[section][label]["speedup_pct"]
+
+    # -- encode arms (the acceptance gates) --------------------------------
+    for name, coder in coders.items():
+        sched = gm.encode_schedule(coder.geometry)
+        assert sched.prefer("numpy"), name  # cost model must pick it
+
+        def _sched_enc(c=coder):
+            got = rs_sched.maybe_encode(c, data)
+            assert got is not None, "schedule path declined the lane"
+            return got
+
+        _ab(name, lambda c=coder: c.encode_parity(data), _sched_enc,
+            "encode")
+    # the pure local-parity stream, for the near-memcpy record
+    locals_sched = rs_sched.compile_matrix(
+        gm.lrc_10_2_2().parity_matrix()[:2])
+    out["lrc_local_rows_xtime_ops"] = locals_sched.op_counts["xtime"]
+
+    # -- single-loss repair arms (ride-along, no gate) ---------------------
+    for name, coder in coders.items():
+        geom = coder.geometry
+        full = np.vstack([data, coder.encode_parity(data)])
+        lost = 2
+        plan = geom.repair_plan(
+            (lost,), tuple(i for i in range(geom.total_shards)
+                           if i != lost))
+        stacked = np.ascontiguousarray(full[list(plan.reads)])
+        out["repair"].setdefault("reads", {})[name] = list(plan.reads)
+
+        def _dense_rep(c=coder, p=plan, s=stacked):
+            return c.reconstruct_stacked(p.reads, s, want=p.want)[1]
+
+        def _sched_rep(c=coder, p=plan, s=stacked):
+            got = rs_sched.maybe_reconstruct(c, p.reads, s, want=p.want)
+            assert got is not None, "schedule path declined the repair"
+            return got[1]
+
+        _ab(f"{name}_single_loss", _dense_rep, _sched_rep, "repair")
+        assert np.array_equal(_sched_rep()[0], full[lost])
+
+    out["gates"] = {
+        "lrc_encode_speedup_pct": out["encode"]["lrc_10_2_2"]
+                                     ["speedup_pct"],
+        "lrc_floor_pct": 30.0,
+        "rs_encode_speedup_pct": out["encode"]["rs_10_4"]["speedup_pct"],
+        "rs_floor_pct": 15.0,
+    }
+    out["pass"] = (out["gates"]["lrc_encode_speedup_pct"] >= 30.0
+                   and out["gates"]["rs_encode_speedup_pct"] >= 15.0)
+    # best-effort device context through the standing wedge-guard: the
+    # schedule plane is host-side, so this records what the accelerator
+    # was doing (or that the tunnel stayed wedged) during the capture
+    out["device_capture"] = _await_device_probe()
+    return out
+
+
 def _bench_repair_ab() -> dict:
     """ISSUE 11 A/B: single-shard repair bandwidth under rs_10_4 vs
     lrc_10_2_2 (interleaved arms, same bytes). For every single-shard
@@ -2581,6 +2706,17 @@ def main() -> int:
             json.dump(out, f, indent=1)
         print(json.dumps(out))
         return 0 if "batch_path_cpu_delta_pct" in out else 1
+    if "--xor-sched-ab" in sys.argv:
+        # standalone compiled-XOR-schedule A/B (ISSUE 17): schedule vs
+        # dense rs_cpu over identical bytes, encode + single-loss
+        # repair, hash-identity asserted in-run; prints the
+        # BENCH_AB_ISSUE17.json artifact content and writes the artifact
+        out = _bench_xor_sched_ab()
+        with open(os.path.join(_HERE, "BENCH_AB_ISSUE17.json"),
+                  "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps(out))
+        return 0 if out.get("pass") else 1
     if "--ec-ab" in sys.argv:
         # standalone EC-dispatch A/B (writes the BENCH_AB_ISSUE3.json
         # artifact content to stdout)

@@ -257,12 +257,20 @@ def new_coder(
     """
     import os
 
+    # Host coders carry WHY they are on the CPU (ISSUE 17 satellite):
+    # "cpu_env" = the whole process was pinned by SEAWEEDFS_TPU_CODER,
+    # "cpu_explicit" = this call site asked for a host coder — the
+    # device-busy/wedged-tunnel fallback shape. The dispatch scheduler
+    # surfaces it as the `reason` label on its batch counter.
+    host_reason = "cpu_env" if backend is None else "cpu_explicit"
     if backend is None:
         backend = os.environ.get("SEAWEEDFS_TPU_CODER", "tpu")
     if backend == "native":
         from ..ops.rs_native import RSCodecNative
 
-        return RSCodecNative(data_shards, parity_shards, geometry=geometry)
+        coder = RSCodecNative(data_shards, parity_shards, geometry=geometry)
+        coder.backend_reason = host_reason
+        return coder
     if backend in ("tpu", "jax"):
         return AutoMeshCoder(data_shards, parity_shards, geometry=geometry)
     if backend == "single":
@@ -276,5 +284,7 @@ def new_coder(
     if backend in ("cpu", "numpy"):
         from ..ops.rs_cpu import RSCodecCPU
 
-        return RSCodecCPU(data_shards, parity_shards, geometry=geometry)
+        coder = RSCodecCPU(data_shards, parity_shards, geometry=geometry)
+        coder.backend_reason = host_reason
+        return coder
     raise ValueError(f"unknown erasure coder backend {backend!r}")

@@ -45,16 +45,21 @@ work because nothing below the registry assumes one global code.
 from __future__ import annotations
 
 import functools
+import os
 import re
 import threading
+from collections import OrderedDict
 
 import numpy as np
 
 from ..ops import gf256
+from ..utils import locks
+from ..utils.stats import EC_SCHED_CACHE_OPS
 
 __all__ = [
     "CodeGeometry", "RepairPlan", "UnsolvableError", "register", "get",
     "names", "rs", "lrc_10_2_2", "pm_mbr", "resolve",
+    "encode_schedule", "repair_schedule",
 ]
 
 
@@ -295,6 +300,92 @@ def _repair_matrix_cached(geom: CodeGeometry, present: tuple[int, ...],
             out[:, j] = x_used[:, c]
     out.setflags(write=False)
     return out
+
+
+# -- compiled XOR-schedule cache (ISSUE 17) ----------------------------------
+#
+# Sits beside the operand caches above: one compiled XorSchedule per
+# (geometry, role, survivors/want) key, LRU-bounded by SWFS_EC_SCHED_CACHE.
+# Compile-once: the first thread to miss a key compiles OUTSIDE the lock
+# while later arrivals wait on the condition instead of duplicating the
+# (CSE-heavy) compile; rank 820 slots between the reconstruct-plan cache
+# (810) and the buffer pool (850) in the witness lock order, above
+# dispatch.mu (100) which holds it during lane selection.
+
+_sched_cv = locks.wcondition("geometry.sched_cache", rank=820)
+_sched_cache: OrderedDict[tuple, object] = OrderedDict()
+_sched_inflight: set[tuple] = set()
+
+
+def _sched_cache_cap() -> int:
+    try:
+        return max(1, int(os.environ.get("SWFS_EC_SCHED_CACHE", "256")))
+    except ValueError:
+        return 256
+
+
+def _sched_cache_clear() -> None:
+    """Test hook: drop every cached schedule (compiles are idempotent)."""
+    with _sched_cv:
+        _sched_cache.clear()
+
+
+def sched_cache_len() -> int:
+    with _sched_cv:
+        return len(_sched_cache)
+
+
+def _schedule_for(key: tuple, matrix_fn):
+    from ..ops import rs_sched
+
+    with _sched_cv:
+        while True:
+            got = _sched_cache.get(key)
+            if got is not None:
+                _sched_cache.move_to_end(key)
+                EC_SCHED_CACHE_OPS.inc(result="hit")
+                return got
+            if key not in _sched_inflight:
+                _sched_inflight.add(key)
+                break
+            EC_SCHED_CACHE_OPS.inc(result="wait")
+            _sched_cv.wait()
+    try:
+        sched = rs_sched.compile_matrix(matrix_fn())
+    except BaseException:
+        with _sched_cv:
+            _sched_inflight.discard(key)
+            _sched_cv.notify_all()
+        raise
+    with _sched_cv:
+        _sched_inflight.discard(key)
+        _sched_cache[key] = sched
+        _sched_cache.move_to_end(key)
+        EC_SCHED_CACHE_OPS.inc(result="compile")
+        cap = _sched_cache_cap()
+        while len(_sched_cache) > cap:
+            _sched_cache.popitem(last=False)
+            EC_SCHED_CACHE_OPS.inc(result="evict")
+        _sched_cv.notify_all()
+    return sched
+
+
+def encode_schedule(geom: CodeGeometry):
+    """Compiled XOR schedule of `geom`'s parity block (role=encode).
+    Raises TypeError for non-systematic geometries, like parity_matrix."""
+    return _schedule_for(("encode", geom.name), geom.parity_matrix)
+
+
+def repair_schedule(geom: CodeGeometry, present_ids, want):
+    """Compiled XOR schedule of the fused repair matrix solving `want`
+    from survivors stacked in `present_ids` order (role=reconstruct).
+    Byte-identical to the dense repair_matrix path — it IS that matrix,
+    lowered. Raises UnsolvableError exactly when repair_matrix does."""
+    present_ids = tuple(present_ids)
+    want = tuple(want)
+    return _schedule_for(
+        ("repair", geom.name, present_ids, want),
+        lambda: geom.repair_matrix(present_ids, want))
 
 
 # -- constructions -----------------------------------------------------------

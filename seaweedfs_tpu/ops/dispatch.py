@@ -64,6 +64,7 @@ from collections import OrderedDict
 
 import numpy as np
 
+from . import rs_sched
 from ..utils import locks, numa, trace
 from ..utils.stats import (
     EC_DISPATCH_ARENA_INUSE,
@@ -825,7 +826,9 @@ class EcDispatchScheduler:
         chip = self._lane_chip(key)
         label = "-" if chip is None else str(chip)
         now = time.perf_counter()
-        EC_DISPATCH_BATCHES.inc(lane=kind, chip=label)
+        device = self._chip_device(key)
+        EC_DISPATCH_BATCHES.inc(lane=kind, chip=label,
+                                reason=self._lane_reason(device))
         EC_DISPATCH_STACK_SLABS.observe(len(slabs), lane=kind)
         EC_DISPATCH_STACK_BYTES.observe(
             sum(s.data.nbytes for s in slabs), lane=kind)
@@ -844,7 +847,6 @@ class EcDispatchScheduler:
         # still serializes here (it's cheap), but EXECUTION proceeds on
         # every chip's own queue concurrently.
         try:
-            device = self._chip_device(key)
             if key[0] == "enc":
                 self._dispatch_encode(slabs, device)
             else:
@@ -853,6 +855,34 @@ class EcDispatchScheduler:
             for s in slabs:
                 if not s.fut.done():
                     s.fut._set_error(e)
+
+    def _lane_reason(self, device) -> str:
+        """WHY this lane dispatched where it did — the `reason` label on
+        EC_DISPATCH_BATCHES (ISSUE 17 satellite): chip_affine = pinned to
+        a placement device; cpu_env / cpu_explicit = host coder (pinned
+        by SEAWEEDFS_TPU_CODER vs constructed by the call site — the
+        device-busy/wedged-tunnel fallback shape, models/coder.py stamps
+        which); vshard_off = per-chip lanes gated off by env; otherwise
+        single_device (one accelerator — no chip lanes to pin)."""
+        if device is not None:
+            return "chip_affine"
+        reason = getattr(self.coder, "backend_reason", None)
+        if reason:
+            return reason
+        if not vshard_enabled():
+            return "vshard_off"
+        return "single_device"
+
+    def _host_encode(self, wide: np.ndarray) -> np.ndarray:
+        """Host-CPU encode of a column-compact [k, W] view: compiled
+        XOR-schedule path (ops/rs_sched.py) when the gate is on and the
+        schedule's predicted cost beats the dense matmul, else the dense
+        coder path. Bit-identical either way — rs_cpu is the oracle the
+        schedule tests pin against."""
+        out = rs_sched.maybe_encode(self.coder, wide)
+        if out is not None:
+            return out
+        return self.coder.encode_parity(wide)
 
     @staticmethod
     def _stamp_wall(slabs: list[_Slab], t0: float) -> None:
@@ -881,7 +911,7 @@ class EcDispatchScheduler:
             elif fn_on is not None:
                 out0 = fn_on(s.data[None], device)[0]
             else:
-                out0 = self.coder.encode_parity(s.data)
+                out0 = self._host_encode(s.data)
             self._stamp_wall(slabs, t0)
             s.fut._set(out0)
             return
@@ -926,7 +956,7 @@ class EcDispatchScheduler:
                 # [None] stacked view (V=1), still no extra copy
                 out = fn_on(wide[None], device)[0]
             else:
-                out = self.coder.encode_parity(wide)
+                out = self._host_encode(wide)
         except BaseException:
             self._arena_drop(buf)
             raise
@@ -985,6 +1015,14 @@ class EcDispatchScheduler:
                 # resident on this lane's chip; its slabs dispatch there
                 return fn_on(present_ids, stk, data_only=data_only,
                              device=device, **kw)
+            # host lane: compiled XOR schedule of the fused repair
+            # matrix when it beats the dense solve (ops/rs_sched.py) —
+            # same survivor-subset choice, bit-identical rows
+            got = rs_sched.maybe_reconstruct(
+                self.coder, present_ids, stk, data_only=data_only,
+                want=want)
+            if got is not None:
+                return got
             return self.coder.reconstruct_stacked(
                 present_ids, stk, data_only=data_only, **kw)
 

@@ -116,6 +116,41 @@ inline void xor_avx2(const uint8_t* __restrict src,
 }
 #endif
 
+inline void xor_rows(const uint8_t* __restrict src, uint8_t* __restrict dst,
+                     int64_t n) {
+#if defined(__AVX2__)
+    xor_avx2(src, dst, n);
+#else
+    for (int64_t j = 0; j < n; ++j) dst[j] ^= src[j];
+#endif
+}
+
+// In-place multiply by alpha (= 2) over GF(256)/0x11D: shift left, then
+// fold the dropped high bit back as 0x1D. The vector form materializes
+// the high-bit mask with a signed compare (byte < 0 <=> bit 7 set) —
+// three cheap ops, no table, which is why a Horner schedule's xtime
+// passes cost ~1 XOR pass each (ops/rs_sched.py cost model).
+inline void xtime_row(uint8_t* __restrict dst, int64_t n) {
+    int64_t j = 0;
+#if defined(__AVX2__)
+    const __m256i zero = _mm256_setzero_si256();
+    const __m256i red = _mm256_set1_epi8(0x1D);
+    for (; j + 32 <= n; j += 32) {
+        const __m256i x = _mm256_loadu_si256(
+            reinterpret_cast<const __m256i*>(dst + j));
+        const __m256i hi = _mm256_cmpgt_epi8(zero, x);
+        const __m256i sh = _mm256_add_epi8(x, x);
+        _mm256_storeu_si256(
+            reinterpret_cast<__m256i*>(dst + j),
+            _mm256_xor_si256(sh, _mm256_and_si256(hi, red)));
+    }
+#endif
+    for (; j < n; ++j) {
+        const uint8_t v = dst[j];
+        dst[j] = static_cast<uint8_t>((v << 1) ^ ((v >> 7) * 0x1Du));
+    }
+}
+
 inline void axpy(uint8_t c, const uint8_t* __restrict src, uint8_t* __restrict dst,
                  int64_t n) {
     if (c == 0) return;
@@ -159,6 +194,58 @@ void swfs_gf_matmul_xor(const uint8_t* matrix, int m, int k, const uint8_t* data
             axpy(matrix[r * k + c], data + static_cast<int64_t>(c) * b, dst, b);
         }
     }
+}
+
+// Compiled XOR-schedule executor (ISSUE 17) — runs the flat (op, dst, src)
+// int32 program emitted by ops/rs_sched.py over the arena rows BY POINTER,
+// same contract as swfs_gf_matmul. Ops: 0 SET, 1 XOR, 2 XTIME (in place,
+// src unused), 3 ZERO. Registers 0..n_out-1 are the output rows, the rest
+// are CSE scratch; a src operand < n_in names an input row, >= n_in names
+// register (src - n_in). The slab is processed in 16 KiB tiles so every
+// live register stays cache-resident across the whole program instead of
+// streaming each op over the full row.
+void swfs_xor_sched_exec(const int32_t* prog, int64_t n_ops,
+                         const uint8_t* data, int n_in, int64_t b,
+                         uint8_t* out, int n_out, int n_tmp) {
+    constexpr int64_t kTile = 16384;
+    uint8_t stack_tmp[4 * kTile];
+    uint8_t* tmp = stack_tmp;
+    uint8_t* heap_tmp = nullptr;
+    if (n_tmp > 4) {
+        heap_tmp = new uint8_t[static_cast<size_t>(n_tmp) * kTile];
+        tmp = heap_tmp;
+    }
+    for (int64_t off = 0; off < b; off += kTile) {
+        const int64_t n = (b - off) < kTile ? (b - off) : kTile;
+        for (int64_t p = 0; p < n_ops; ++p) {
+            const int32_t op = prog[p * 3];
+            const int32_t dst = prog[p * 3 + 1];
+            const int32_t src = prog[p * 3 + 2];
+            uint8_t* d = dst < n_out
+                ? out + static_cast<int64_t>(dst) * b + off
+                : tmp + static_cast<int64_t>(dst - n_out) * kTile;
+            if (op == 2) {
+                xtime_row(d, n);
+                continue;
+            }
+            if (op == 3) {
+                std::memset(d, 0, static_cast<size_t>(n));
+                continue;
+            }
+            const int32_t reg = src - n_in;
+            const uint8_t* s = src < n_in
+                ? data + static_cast<int64_t>(src) * b + off
+                : (reg < n_out
+                       ? out + static_cast<int64_t>(reg) * b + off
+                       : tmp + static_cast<int64_t>(reg - n_out) * kTile);
+            if (op == 0) {
+                std::memcpy(d, s, static_cast<size_t>(n));
+            } else {
+                xor_rows(s, d, n);
+            }
+        }
+    }
+    delete[] heap_tmp;
 }
 
 // CRC-32C (Castagnoli), slice-by-8 — needle checksum (storage/crc.py) hot path.

@@ -57,6 +57,11 @@ def load_library() -> ctypes.CDLL:
         lib.swfs_gf_matmul_xor.restype = None
         lib.swfs_crc32c.argtypes = [u8p, ctypes.c_int64, ctypes.c_uint32]
         lib.swfs_crc32c.restype = ctypes.c_uint32
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        lib.swfs_xor_sched_exec.argtypes = [
+            i32p, ctypes.c_int64, u8p, ctypes.c_int, ctypes.c_int64,
+            u8p, ctypes.c_int, ctypes.c_int]
+        lib.swfs_xor_sched_exec.restype = None
         _lib = lib
         return lib
 
@@ -83,6 +88,24 @@ def gf_matmul_native(matrix: np.ndarray, data: np.ndarray) -> np.ndarray:
     out = np.empty((m, b), dtype=np.uint8)
     lib.swfs_gf_matmul(_ptr(matrix), m, k, _ptr(data), b, _ptr(out))
     return out
+
+
+def xor_sched_exec(prog: np.ndarray, data: np.ndarray, out: np.ndarray,
+                   n_in: int, n_out: int, n_tmp: int) -> None:
+    """Run a compiled XOR schedule (ops/rs_sched.py) in C++: prog is the
+    flat [N, 3] int32 (op, dst, src) program, `data` the [n_in, B] input
+    rows, `out` the preallocated [n_out, B] result. Like gf_matmul_native
+    the rows are taken BY POINTER — the dispatch scheduler's arena view
+    is read in place, no staging copy."""
+    lib = load_library()
+    prog = np.ascontiguousarray(prog, np.int32)
+    assert data.dtype == np.uint8 and data.flags.c_contiguous, data.shape
+    assert out.dtype == np.uint8 and out.flags.c_contiguous, out.shape
+    assert data.shape == (n_in, out.shape[1]) and out.shape[0] == n_out
+    lib.swfs_xor_sched_exec(
+        prog.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        prog.shape[0], _ptr(data), n_in, data.shape[1],
+        _ptr(out), n_out, n_tmp)
 
 
 def crc32c_native(data: bytes | np.ndarray, seed: int = 0) -> int:

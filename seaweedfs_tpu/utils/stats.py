@@ -317,8 +317,12 @@ EC_DISPATCH_SLABS = Counter(
     "(encode/reconstruct) and chip ('-' = single-chip lanes).")
 EC_DISPATCH_BATCHES = Counter(
     "SeaweedFS_ec_dispatch_batches",
-    "Stacked dispatches issued by lane and chip; slabs/batches is the "
-    "batch factor.")
+    "Stacked dispatches issued by lane, chip and reason — WHY the lane "
+    "ran where it did (chip_affine = device-pinned dispatch; cpu_env = "
+    "host coder pinned by SEAWEEDFS_TPU_CODER; cpu_explicit = call site "
+    "constructed a host coder, the device-busy/wedged-tunnel fallback "
+    "shape; vshard_off = per-chip lanes gated off; single_device = one "
+    "accelerator, no chip lanes); slabs/batches is the batch factor.")
 EC_DISPATCH_WINDOW_WAIT = Histogram(
     "SeaweedFS_ec_dispatch_window_wait_seconds",
     "Time a slab waited in the scheduler before its dispatch launched, "
@@ -335,6 +339,25 @@ EC_RECON_CACHE_COUNTER = Counter(
     "SeaweedFS_ec_dispatch_recon_cache_ops",
     "Reconstructed-interval cache activity by result "
     "(hit/miss/put/invalidate/evict).")
+
+# -- compiled XOR-schedule codec plane (ISSUE 17): generator matrices
+#    lowered to cached bit-plane XOR programs for the host CPU path ------
+
+EC_SCHED_BATCHES = Counter(
+    "SeaweedFS_ec_sched_batches",
+    "Compiled XOR-schedule executions by role (encode/reconstruct) and "
+    "backend (numpy/native).")
+EC_SCHED_BYTES = Counter(
+    "SeaweedFS_ec_sched_bytes",
+    "Output bytes produced through the compiled-schedule path by role.")
+EC_SCHED_SKIPPED = Counter(
+    "SeaweedFS_ec_sched_skipped",
+    "Host-CPU lanes that stayed on the dense GF path by role and reason "
+    "(gate_off / dense_cheaper / unsupported).")
+EC_SCHED_CACHE_OPS = Counter(
+    "SeaweedFS_ec_sched_cache_ops",
+    "Schedule cache activity (hit/compile/evict/wait — wait counts "
+    "threads that blocked on another thread's in-flight compile).")
 
 
 # -- host memory plane (ISSUE 12): the stack arena that recycles the
@@ -804,6 +827,34 @@ def ec_dispatch_stats() -> dict:
     for chip, n in EC_DISPATCH_SLABS.split_by("chip").items():
         per_chip.setdefault(chip, {})["slabs"] = int(n)
     out["perChip"] = per_chip
+    # ISSUE 17 satellite: WHY lanes ran where they did (the A/B and
+    # /status attribution of schedule-path coverage), plus the compiled
+    # XOR-schedule plane's own selection/coverage counters. Metric
+    # label values stay snake_case (Prometheus idiom); the /status
+    # schema is camelCase all the way down, so reason keys are
+    # re-spelled at this presentation boundary.
+    def _camel(label: str) -> str:
+        head, *rest = label.split("_")
+        return head + "".join(p.capitalize() for p in rest)
+
+    out["reasons"] = {_camel(r): int(n) for r, n in
+                      EC_DISPATCH_BATCHES.split_by("reason").items()}
+    sched: dict = {}
+    for role in ("encode", "reconstruct"):
+        ran = EC_SCHED_BATCHES.value(role=role)
+        skipped = EC_SCHED_SKIPPED.value(role=role)
+        eligible = ran + skipped
+        sched[role] = {
+            "batches": int(ran),
+            "bytes": int(EC_SCHED_BYTES.value(role=role)),
+            "skipped": {_camel(r): int(n) for r, n in
+                        EC_SCHED_SKIPPED.split_by("reason",
+                                                  role=role).items()},
+            "coverage": round(ran / eligible, 4) if eligible else 0.0,
+        }
+    sched["cache"] = {r: int(EC_SCHED_CACHE_OPS.value(result=r))
+                      for r in ("hit", "compile", "evict", "wait")}
+    out["sched"] = sched
     hits = EC_RECON_CACHE_COUNTER.value(result="hit")
     misses = EC_RECON_CACHE_COUNTER.value(result="miss")
     total = hits + misses

@@ -370,6 +370,41 @@ def test_lint_findings_never_exceed_baseline():
         "with an explanation in the PR")
 
 
+def test_lint_baseline_history_archives_per_pr_counts(tmp_path):
+    """ISSUE 17 satellite (ROADMAP 7c): `--archive-baseline <label>`
+    appends the tree's per-rule counts to LINT_BASELINE.json `history`
+    so CI can diff the series per PR instead of only ceiling-checking.
+    The committed history must be well-formed, and the archiver must be
+    idempotent per label (CI retries re-archive the same PR)."""
+    import json
+    import shutil
+
+    lint = _load_lint()
+    with open(os.path.join(REPO, "LINT_BASELINE.json")) as f:
+        base = json.load(f)
+    assert base["history"], "LINT_BASELINE.json history must be seeded"
+    for e in base["history"]:
+        assert set(e) == {"label", "by_rule"}, e
+        assert all(isinstance(n, int) and n >= 0
+                   for n in e["by_rule"].values()), e
+    # mechanism, against a scratch copy: append, overwrite-in-place on a
+    # repeated label, preserve order — counts exactly custom_findings()
+    path = tmp_path / "LINT_BASELINE.json"
+    shutil.copy(os.path.join(REPO, "LINT_BASELINE.json"), path)
+    counts: dict[str, int] = {}
+    for finding in lint.custom_findings():
+        counts[finding.rule] = counts.get(finding.rule, 0) + 1
+    before = len(base["history"])
+    entry = lint.archive_baseline("PRTEST", str(path))
+    assert entry["by_rule"] == dict(sorted(counts.items()))
+    lint.archive_baseline("PRTEST", str(path))  # idempotent re-archive
+    with open(path) as f:
+        hist = json.load(f)["history"]
+    assert len(hist) == before + 1
+    assert hist[-1] == {"label": "PRTEST",
+                        "by_rule": dict(sorted(counts.items()))}
+
+
 def test_every_swfs_knob_is_documented_in_readme():
     """ISSUE 15 satellite (mirror of the metrics-table test): every
     SWFS_* env knob the package reads must appear in README.md; the

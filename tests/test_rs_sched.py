@@ -1,0 +1,363 @@
+"""Compiled XOR-schedule codec plane (ISSUE 17) — correctness pins.
+
+The schedule compiler (ops/rs_sched.py) lowers generator and repair
+matrices to bit-plane Horner XOR programs; nothing about the BYTES may
+change. rs_cpu stays the oracle:
+
+- bit-identity vs the dense GF matmul for EVERY registered geometry,
+  parametrized from gm.names() so future registrations auto-enroll
+- the frozen RS(10,4) golden shard hashes reproduce THROUGH the
+  schedule path (numpy interpreter AND the native C++ executor)
+- CSE-fuzz: random matrices, compiled vs dense byte equality
+- repair-plan schedule identity: LRC 5-survivor local-group plans and
+  the RS sorted-first-k decode, against rs_cpu.reconstruct_stacked
+- schedule cache: LRU eviction at SWFS_EC_SCHED_CACHE, compile-once
+  under concurrency (waiters block instead of duplicating the compile)
+- SWFS_EC_SCHED=0 gate: dense path everywhere, skip counter attributes
+- dispatch integration: host lanes ride the schedule path and the
+  batch counter's `reason` label attributes why the lane was on CPU
+- scrub acceptance: a syndrome sweep over an lrc_10_2_2 volume rides
+  the schedule path (counter moves) with zero false positives
+"""
+
+import hashlib
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from seaweedfs_tpu.models import geometry as gm
+from seaweedfs_tpu.models.coder import new_coder
+from seaweedfs_tpu.ops import dispatch, gf256, rs_sched
+from seaweedfs_tpu.ops.rs_cpu import RSCodecCPU
+from seaweedfs_tpu.utils import stats
+from tests.test_golden_identity import GOLDEN_SHARD_SHA256, _fixture
+
+
+def _native_coder_or_none():
+    try:
+        from seaweedfs_tpu.ops.rs_native import RSCodecNative
+
+        return RSCodecNative(10, 4)
+    except Exception:  # pragma: no cover - stripped container
+        return None
+
+
+def _geometry_matrix(g):
+    try:
+        return g.parity_matrix()
+    except TypeError:  # non-systematic (pm_mbr): pin the full generator
+        return g.generator_matrix()
+
+
+# -- compiler bit-identity ---------------------------------------------------
+
+@pytest.mark.parametrize("name", gm.names())
+def test_schedule_bit_identity_every_geometry(name):
+    """Every registered geometry's matrix, compiled, must reproduce the
+    dense GF(256) matmul byte-for-byte — auto-enrolls future names."""
+    m = _geometry_matrix(gm.get(name))
+    sched = rs_sched.compile_matrix(m)
+    rng = np.random.default_rng(hash(name) & 0xFFFF)
+    data = rng.integers(0, 256, size=(m.shape[1], 4096), dtype=np.uint8)
+    ref = gf256.gf_matmul(m, data)
+    assert np.array_equal(sched.execute(data, "numpy"), ref), name
+    if _native_coder_or_none() is not None:
+        assert np.array_equal(sched.execute(data, "native"), ref), name
+
+
+def test_lrc_local_parities_compile_without_xtime():
+    """The LRC local-parity rows are pure {0,1} — their schedule rows
+    must be straight XOR streams, zero field multiplies (the near-memcpy
+    claim the plane's LRC speedup rests on)."""
+    locals_only = gm.lrc_10_2_2().parity_matrix()[:2]
+    sched = rs_sched.compile_matrix(locals_only)
+    assert sched.op_counts["xtime"] == 0
+    assert sched.op_counts["xor"] + sched.op_counts["set"] == 10
+
+
+def test_golden_shard_hashes_through_schedule_path():
+    """The frozen klauspost-identity fixture hashes must reproduce with
+    parity computed BY THE SCHEDULE, both executors."""
+    data = _fixture()
+    coder = RSCodecCPU(10, 4)
+    out = rs_sched.maybe_encode(coder, data)
+    assert out is not None  # numpy cost model must pick the schedule
+    shards = np.concatenate([data, out], axis=0)
+    got = [hashlib.sha256(s.tobytes()).hexdigest() for s in shards]
+    assert got == GOLDEN_SHARD_SHA256
+    if _native_coder_or_none() is not None:
+        sched = gm.encode_schedule(gm.rs(10, 4))
+        nat = np.concatenate([data, sched.execute(data, "native")], axis=0)
+        got_n = [hashlib.sha256(s.tobytes()).hexdigest() for s in nat]
+        assert got_n == GOLDEN_SHARD_SHA256
+
+
+def test_cse_fuzz_random_matrices():
+    """Random dense/sparse/binary matrices: the CSE rewrite may reshape
+    the program arbitrarily, the bytes may not move."""
+    rng = np.random.default_rng(0x17)
+    native = _native_coder_or_none() is not None
+    for trial in range(25):
+        n_out = int(rng.integers(1, 8))
+        n_in = int(rng.integers(1, 16))
+        m = rng.integers(0, 256, size=(n_out, n_in), dtype=np.uint8)
+        if trial % 3 == 0:
+            m = (m & 1).astype(np.uint8)  # pure-XOR planes, heavy CSE
+        if trial % 5 == 0:
+            m[int(rng.integers(0, n_out))] = 0  # all-zero output row
+        b = int(rng.integers(1, 40000))  # crosses native tile boundary
+        data = rng.integers(0, 256, size=(n_in, b), dtype=np.uint8)
+        sched = rs_sched.compile_matrix(m)
+        ref = gf256.gf_matmul(m, data)
+        assert np.array_equal(sched.execute(data, "numpy"), ref), trial
+        if native:
+            assert np.array_equal(sched.execute(data, "native"), ref), trial
+
+
+# -- repair-plan schedules ---------------------------------------------------
+
+def test_repair_schedule_lrc_local_group_plan():
+    """An LRC single loss inside a local group repairs from the 5-read
+    plan; its compiled schedule must equal rs_cpu's want= solve."""
+    geom = gm.lrc_10_2_2()
+    coder = RSCodecCPU(10, 4, geometry=geom)
+    rng = np.random.default_rng(21)
+    data = rng.integers(0, 256, size=(10, 2048), dtype=np.uint8)
+    full = np.vstack([data, coder.encode_parity(data)])
+    for lost in (2, 7):
+        plan = geom.repair_plan(
+            (lost,), tuple(i for i in range(14) if i != lost))
+        assert len(plan.reads) == 5  # the local-group read set
+        stacked = full[list(plan.reads)]
+        got = rs_sched.maybe_reconstruct(coder, plan.reads, stacked,
+                                         want=(lost,))
+        assert got is not None
+        targets, rows = got
+        t_ref, r_ref = coder.reconstruct_stacked(plan.reads, stacked,
+                                                 want=(lost,))
+        assert targets == tuple(t_ref)
+        assert np.array_equal(rows, r_ref)
+        assert np.array_equal(rows[0], full[lost])
+
+
+def test_repair_schedule_rs_first_k_identity():
+    """RS full decode (want=None rides rs_cpu's dict path) and explicit
+    want= must both match the schedule path — same sorted-first-k
+    survivor subset, so associativity makes the bytes identical."""
+    coder = RSCodecCPU(10, 4)
+    rng = np.random.default_rng(22)
+    data = rng.integers(0, 256, size=(10, 2048), dtype=np.uint8)
+    full = np.vstack([data, coder.encode_parity(data)])
+    present = tuple(i for i in range(14) if i not in (1, 5, 12))
+    stacked = full[list(present)]
+    for kw in ({}, {"want": (1, 5)}, {"data_only": True}):
+        got = rs_sched.maybe_reconstruct(coder, present, stacked, **kw)
+        assert got is not None, kw
+        targets, rows = got
+        t_ref, r_ref = coder.reconstruct_stacked(present, stacked, **kw)
+        assert targets == tuple(t_ref), kw
+        assert np.array_equal(rows, r_ref), kw
+
+
+def test_repair_schedule_unsolvable_falls_back_dense():
+    """Too-few survivors: the schedule path steps aside (skip counter,
+    reason=unsupported) so the dense path raises the canonical error."""
+    coder = RSCodecCPU(10, 4)
+    present = tuple(range(5))
+    stacked = np.zeros((5, 64), np.uint8)
+    before = stats.EC_SCHED_SKIPPED.value(role="reconstruct",
+                                          reason="unsupported")
+    assert rs_sched.maybe_reconstruct(coder, present, stacked) is None
+    assert stats.EC_SCHED_SKIPPED.value(
+        role="reconstruct", reason="unsupported") == before + 1
+    # the dense path raises its canonical error (the RS want=None dict
+    # path raises the legacy ValueError; UnsolvableError subclasses it)
+    with pytest.raises(ValueError):
+        coder.reconstruct_stacked(present, stacked)
+
+
+# -- schedule cache ----------------------------------------------------------
+
+def test_sched_cache_hit_and_lru_eviction(monkeypatch):
+    monkeypatch.setenv("SWFS_EC_SCHED_CACHE", "2")
+    gm._sched_cache_clear()
+    geoms = [gm.CodeGeometry(f"sched_lru_{i}", 4, 1,
+                             np.full((1, 4), i + 1, np.uint8))
+             for i in range(3)]
+    c0 = stats.EC_SCHED_CACHE_OPS.value(result="compile")
+    h0 = stats.EC_SCHED_CACHE_OPS.value(result="hit")
+    e0 = stats.EC_SCHED_CACHE_OPS.value(result="evict")
+    first = gm.encode_schedule(geoms[0])
+    assert gm.encode_schedule(geoms[0]) is first  # cached object
+    assert stats.EC_SCHED_CACHE_OPS.value(result="hit") == h0 + 1
+    gm.encode_schedule(geoms[1])
+    gm.encode_schedule(geoms[2])  # capacity 2: evicts geoms[0]'s entry
+    assert gm.sched_cache_len() == 2
+    assert stats.EC_SCHED_CACHE_OPS.value(result="evict") == e0 + 1
+    assert gm.encode_schedule(geoms[0]) is not first  # recompiled
+    assert stats.EC_SCHED_CACHE_OPS.value(result="compile") == c0 + 4
+
+
+def test_sched_cache_compile_once_under_concurrency(monkeypatch):
+    """Eight threads miss the same key at once: ONE compiles (slowly),
+    the rest wait on the condition and share the same object."""
+    geom = gm.CodeGeometry(
+        "sched_once", 4, 2,
+        np.array([[1, 1, 1, 1], [1, 2, 3, 4]], np.uint8))
+    calls: list[int] = []
+    real = rs_sched.compile_matrix
+
+    def slow_compile(m):
+        calls.append(1)
+        time.sleep(0.05)
+        return real(m)
+
+    monkeypatch.setattr(rs_sched, "compile_matrix", slow_compile)
+    gm._sched_cache_clear()
+    w0 = stats.EC_SCHED_CACHE_OPS.value(result="wait")
+    results: list = []
+    barrier = threading.Barrier(8)
+
+    def worker():
+        barrier.wait()
+        results.append(gm.encode_schedule(geom))
+
+    threads = [threading.Thread(target=worker) for _ in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(calls) == 1, "compile must run exactly once"
+    assert all(r is results[0] for r in results)
+    assert stats.EC_SCHED_CACHE_OPS.value(result="wait") > w0
+
+
+def test_sched_cache_compile_failure_releases_waiters():
+    """A failing compile (unsolvable repair) must not wedge the key:
+    the in-flight marker clears and the next caller re-raises."""
+    coder = RSCodecCPU(10, 4)
+    geom = coder.geometry
+    for _ in range(2):  # second call must not deadlock on the marker
+        with pytest.raises(gm.UnsolvableError):
+            gm.repair_schedule(geom, tuple(range(5)), (9,))
+
+
+# -- the SWFS_EC_SCHED gate --------------------------------------------------
+
+def test_sched_gate_off_restores_dense_path(monkeypatch):
+    monkeypatch.setenv("SWFS_EC_SCHED", "0")
+    coder = RSCodecCPU(10, 4)
+    rng = np.random.default_rng(23)
+    data = rng.integers(0, 256, size=(10, 1024), dtype=np.uint8)
+    s0 = stats.EC_SCHED_SKIPPED.value(role="encode", reason="gate_off")
+    assert rs_sched.maybe_encode(coder, data) is None
+    assert stats.EC_SCHED_SKIPPED.value(
+        role="encode", reason="gate_off") == s0 + 1
+    full = np.vstack([data, coder.encode_parity(data)])
+    present = tuple(range(10))
+    assert rs_sched.maybe_reconstruct(
+        coder, present, full[:10], want=(12,)) is None
+    # and the dispatch scheduler still produces identical bytes densely
+    sch = dispatch.EcDispatchScheduler(coder)
+    try:
+        assert np.array_equal(sch.encode_parity(data).result(),
+                              full[10:])
+    finally:
+        sch.close()
+
+
+# -- dispatch integration ----------------------------------------------------
+
+def test_dispatch_host_lanes_ride_schedule_and_attribute_reason():
+    """A host-CPU coder's encode AND reconstruct lanes use the compiled
+    schedule (bit-identically), and the dispatch batch counter carries
+    the `reason` attribution for why the lane ran on the CPU."""
+    coder = new_coder(10, 4, backend="cpu", geometry="lrc_10_2_2")
+    assert coder.backend_reason == "cpu_explicit"
+    sch = dispatch.EcDispatchScheduler(coder)
+    rng = np.random.default_rng(24)
+    data = rng.integers(0, 256, size=(10, 3000), dtype=np.uint8)
+    e0 = stats.EC_SCHED_BATCHES.value(role="encode")
+    r0 = stats.EC_SCHED_BATCHES.value(role="reconstruct")
+    d0 = stats.EC_DISPATCH_BATCHES.value(reason="cpu_explicit")
+    try:
+        parity = sch.encode_parity(data).result()
+        assert np.array_equal(parity, coder.encode_parity(data))
+        full = np.vstack([data, parity])
+        present = tuple(i for i in range(14) if i not in (3, 11))
+        missing, rows = sch.reconstruct_stacked(
+            present, full[list(present)]).result()
+        t_ref, r_ref = coder.reconstruct_stacked(present,
+                                                 full[list(present)])
+        assert tuple(missing) == tuple(t_ref)
+        assert np.array_equal(rows, r_ref)
+    finally:
+        sch.close()
+    assert stats.EC_SCHED_BATCHES.value(role="encode") > e0
+    assert stats.EC_SCHED_BATCHES.value(role="reconstruct") > r0
+    assert stats.EC_DISPATCH_BATCHES.value(reason="cpu_explicit") >= d0 + 2
+
+
+def test_env_pinned_coder_attributes_cpu_env(monkeypatch):
+    monkeypatch.setenv("SEAWEEDFS_TPU_CODER", "cpu")
+    coder = new_coder(10, 4)
+    assert coder.backend_reason == "cpu_env"
+
+
+def test_status_surfaces_sched_and_reason_sections():
+    out = stats.ec_dispatch_stats()
+    assert set(out["sched"]) == {"encode", "reconstruct", "cache"}
+    for role in ("encode", "reconstruct"):
+        assert {"batches", "bytes", "skipped",
+                "coverage"} <= set(out["sched"][role])
+    assert {"hit", "compile", "evict", "wait"} == set(out["sched"]["cache"])
+    assert isinstance(out["reasons"], dict)
+
+
+# -- scrub acceptance: lrc syndrome sweep rides the schedule path ------------
+
+def test_scrub_lrc_volume_rides_schedule_path_zero_findings(tmp_path):
+    """Acceptance pin: a syndrome sweep over an lrc_10_2_2 EC volume
+    goes through the compiled-schedule encode (counter moves) and a
+    clean volume stays clean — zero false positives."""
+    from seaweedfs_tpu.scrub.scrubber import Scrubber
+    from seaweedfs_tpu.storage.ec_files import (
+        write_ec_files,
+        write_sorted_file_from_idx,
+    )
+    from seaweedfs_tpu.storage.ec_locate import Geometry
+    from seaweedfs_tpu.storage.ec_volume import save_volume_info
+    from seaweedfs_tpu.storage.needle import Needle
+    from seaweedfs_tpu.storage.store import Store
+
+    geo = Geometry(large_block=10000, small_block=100,
+                   code="lrc_10_2_2")
+    coder = new_coder(10, 4, backend="cpu", geometry="lrc_10_2_2")
+    st = Store([str(tmp_path)], coder=coder)
+    v = st.add_volume(1)
+    rng = np.random.default_rng(25)
+    for i in range(1, 21):
+        blob = rng.integers(0, 256, size=int(rng.integers(100, 900)),
+                            dtype=np.uint8).tobytes()
+        v.write_needle(Needle.create(i, 0xABC, blob))
+    base = v.file_name()
+    with v._lock:
+        v._sync_buffers()
+    write_ec_files(base, coder, geo)
+    write_sorted_file_from_idx(base)
+    save_volume_info(base, {
+        "version": v.version, "dataShards": geo.data_shards,
+        "parityShards": geo.parity_shards,
+        "largeBlock": geo.large_block, "smallBlock": geo.small_block,
+        "geometry": "lrc_10_2_2"})
+    st.unmount_volume(v.id)
+    st.mount_ec_shards(v.id, "", list(range(geo.total_shards)))
+    before = stats.EC_SCHED_BATCHES.value(role="encode")
+    sc = Scrubber(st, None, interval_s=0, max_mbps=0)
+    report = sc.run_once(full=True)
+    assert report.findings == [], [f.detail for f in report.findings]
+    assert stats.EC_SCHED_BATCHES.value(role="encode") > before, \
+        "lrc syndrome sweep did not ride the compiled-schedule path"
+    st.close()

@@ -27,8 +27,10 @@ the whole-program ones):
 Flags: `--json` emits every finding (including marker-blessed ones,
 with their marker status) as one JSON object so CI can diff counts
 across PRs; `--knobs` prints the SWFS_* env-knob inventory that the
-README consistency test enforces. Exit codes are identical in every
-mode.
+README consistency test enforces; `--archive-baseline <label>` appends
+this tree's per-rule counts to LINT_BASELINE.json's `history` (ROADMAP
+7c — the per-PR series the ratchet can diff, not just ceiling-check).
+Exit codes are identical in every mode.
 """
 
 from __future__ import annotations
@@ -479,10 +481,48 @@ def main_knobs() -> int:
     return 0
 
 
+def archive_baseline(label: str, path: str | None = None) -> dict:
+    """Append this tree's per-rule finding counts to LINT_BASELINE.json's
+    `history` (ROADMAP 7c): one {label, by_rule} entry per PR, so CI can
+    DIFF counts across PRs instead of only enforcing the ceiling. Counts
+    come from custom_findings() — marker-blessed included — exactly the
+    population the ratchet test compares against `by_rule`. Re-archiving
+    an existing label overwrites its entry (idempotent under CI retries);
+    entries keep insertion order, one per PR."""
+    path = path or os.path.join(REPO, "LINT_BASELINE.json")
+    by_rule: dict[str, int] = {}
+    for f in custom_findings():
+        by_rule[f.rule] = by_rule.get(f.rule, 0) + 1
+    with open(path) as fh:
+        base = json.load(fh)
+    hist = base.setdefault("history", [])
+    entry = {"label": label, "by_rule": dict(sorted(by_rule.items()))}
+    for i, e in enumerate(hist):
+        if e.get("label") == label:
+            hist[i] = entry
+            break
+    else:
+        hist.append(entry)
+    with open(path, "w") as fh:
+        json.dump(base, fh, indent=1)
+        fh.write("\n")
+    return entry
+
+
+def main_archive(argv: list[str]) -> int:
+    i = argv.index("--archive-baseline")
+    label = argv[i + 1] if len(argv) > i + 1 else "HEAD"
+    json.dump(archive_baseline(label), sys.stdout, indent=1)
+    print()
+    return 0
+
+
 def main(argv: list[str] | None = None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if "--knobs" in argv:
         return main_knobs()
+    if "--archive-baseline" in argv:
+        return main_archive(argv)
     if "--json" in argv:
         return main_json()
     rc = run_ruff() if shutil.which("ruff") else run_fallback()
