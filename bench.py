@@ -1754,6 +1754,39 @@ def _bench_bigfile_ab() -> dict:
         return {"error": f"{type(e).__name__}: {e}"[:200]}
 
 
+def _bench_filer_shard_ab() -> dict:
+    """ISSUE-19 partitioned-metadata A/B (tools/cluster_harness.py
+    --filer-shard-ab): the deep-path create/list/stat + rename-churn
+    storm against 1 -> 2 -> 4 filer shards behind the master-published
+    ring, equal offered load per arm, plus the meta.rename.commit crash
+    round. Subprocess with a hard timeout and last-JSON salvage (the
+    wedged-child guard pattern)."""
+    try:
+        proc = subprocess.run(
+            [sys.executable,
+             os.path.join(_HERE, "tools", "cluster_harness.py"),
+             "--filer-shard-ab", "--duration",
+             os.environ.get("SEAWEEDFS_TPU_SHARDAB_DURATION", "12")],
+            cwd=_HERE, capture_output=True, text=True,
+            timeout=float(os.environ.get(
+                "SEAWEEDFS_TPU_SHARDAB_TIMEOUT", "1500")))
+        out = _last_json_line(proc.stdout)
+        if out is not None:
+            return out
+        return {"error": f"rc={proc.returncode}: {proc.stderr[-300:]}"}
+    except subprocess.TimeoutExpired as e:
+        so = e.stdout
+        if isinstance(so, bytes):
+            so = so.decode(errors="replace")
+        out = _last_json_line(so or "")
+        if out is not None:
+            out["note"] = "harness timed out after printing results"
+            return out
+        return {"error": "filer-shard A/B timed out"}
+    except Exception as e:  # never let the secondary hurt the headline
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
 # Tracing-overhead A/B (ISSUE 7): the tracing plane must be cheap
 # enough to leave ON. One live cluster, MANY short segments alternating
 # SWFS_TRACE=1/0 IN-PROCESS (trace.enabled() re-reads the env per
@@ -2780,6 +2813,17 @@ def main() -> int:
             json.dump(out, f, indent=1)
         print(json.dumps(out))
         return 0 if out.get("get_median_delta_pct") is not None else 1
+    if "--filer-shard-ab" in sys.argv:
+        # standalone partitioned-metadata A/B (ISSUE 19): metadata
+        # goodput at 1 -> 2 -> 4 filer shards behind the master-
+        # published ring + the rename crash round; prints the
+        # BENCH_CLUSTER_ISSUE19.json artifact content and writes it
+        out = _bench_filer_shard_ab()
+        with open(os.path.join(_HERE, "BENCH_CLUSTER_ISSUE19.json"),
+                  "w") as f:
+            json.dump(out, f, indent=1)
+        print(json.dumps(out))
+        return 0 if "metadata_goodput_per_sec" in out else 1
     if "--repair-ab" in sys.argv:
         # standalone repair-bandwidth A/B (ISSUE 11): rs_10_4 vs
         # lrc_10_2_2 single-shard repair bytes read / repair wall /

@@ -16,6 +16,8 @@ import threading
 
 from ..pb import filer_pb2, rpc
 from ..utils import glog
+from ..utils.retry import Backoff
+from ..utils.stats import META_AGGREGATOR_RECONNECTS
 
 
 class MetaAggregator:
@@ -34,6 +36,13 @@ class MetaAggregator:
 
         def run():
             cursor = since_ns
+            # a down peer answers every dial attempt instantly with
+            # UNAVAILABLE, so a fixed 0.5s pause was a 2 Hz reconnect
+            # hammer per peer; exponential backoff with jitter (the
+            # utils/retry discipline every other reconnect loop rides)
+            # caps the retry rate while the counted metric keeps the
+            # flapping visible
+            bo = Backoff()
             while not self._stop.is_set():
                 try:
                     stub = rpc.filer_stub(peer_grpc_address)
@@ -45,6 +54,7 @@ class MetaAggregator:
                         if self._stop.is_set():
                             return
                         cursor = max(cursor, resp.ts_ns)
+                        bo = Backoff()  # events flowing = peer healthy
                         if self.signature in \
                                 resp.event_notification.signatures:
                             continue  # our own event echoed back
@@ -52,7 +62,8 @@ class MetaAggregator:
                         self.peer_counts[peer_grpc_address] += 1
                 except Exception as e:
                     glog.v(2, f"meta aggregator {peer_grpc_address}: {e}")
-                    if self._stop.wait(0.5):
+                    META_AGGREGATOR_RECONNECTS.inc(peer=peer_grpc_address)
+                    if self._stop.wait(bo.next_wait()):
                         return
 
         t = threading.Thread(target=run, daemon=True)

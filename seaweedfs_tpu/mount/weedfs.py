@@ -23,6 +23,7 @@ import time
 
 import requests
 
+from ..cluster.metaring import wrong_shard_of
 from ..filer.entry import Attr, Entry
 from ..filer.filechunks import total_size, view_from_chunks
 from ..filer.filer import normalize, parent_of
@@ -84,6 +85,12 @@ class WFS:
                  subscribe: bool = True):
         self.filer_address = filer_grpc_address
         self.stub = rpc.filer_stub(filer_grpc_address)
+        # metadata ring (ISSUE 19): namespace ops route to the filer
+        # shard owning the path; volume ops (AssignVolume/LookupVolume/
+        # Statistics) stay on the seed filer — any filer answers those
+        from ..wdclient import MetaRingClient
+
+        self.ring_client = MetaRingClient(filer_grpc=filer_grpc_address)
         self.chunk_size = chunk_size
         self.replication = replication
         self.collection = collection
@@ -113,6 +120,26 @@ class WFS:
 
     # -- entry fetch/store -------------------------------------------------
 
+    def _meta_call(self, path: str, fn, *, directory: bool = False):
+        """fn(stub) on the shard owning `path`, one stale-ring retry
+        (the same ladder the S3/WebDAV gateways ride)."""
+        import grpc as _grpc
+
+        def leg(addr):
+            g = rpc.grpc_address(addr) if addr else self.filer_address
+            stub = self.stub if g == self.filer_address \
+                else rpc.filer_stub(g)
+            try:
+                return fn(stub)
+            except _grpc.RpcError as e:
+                ws = wrong_shard_of(e)
+                if ws is not None:
+                    raise ws from e
+                raise
+
+        return self.ring_client.call_routed(
+            path, leg, directory=directory, default="")
+
     def _fetch_entry(self, path: str) -> Entry | None:
         path = normalize(path)
         if path == "/":
@@ -122,10 +149,12 @@ class WFS:
         if cached is not None:
             return cached
         try:
-            resp = self.stub.LookupDirectoryEntry(
-                filer_pb2.LookupDirectoryEntryRequest(
-                    directory=parent_of(path),
-                    name=path.rsplit("/", 1)[-1]), timeout=30)
+            resp = self._meta_call(
+                path,
+                lambda stub: stub.LookupDirectoryEntry(
+                    filer_pb2.LookupDirectoryEntryRequest(
+                        directory=parent_of(path),
+                        name=path.rsplit("/", 1)[-1]), timeout=30))
         except Exception:
             return None
         if not resp.entry.name and not resp.entry.is_directory:
@@ -133,17 +162,21 @@ class WFS:
         return Entry.from_pb(parent_of(path), resp.entry)
 
     def _create_remote(self, entry: Entry, o_excl: bool = False) -> None:
-        resp = self.stub.CreateEntry(filer_pb2.CreateEntryRequest(
-            directory=entry.parent, entry=entry.to_pb(), o_excl=o_excl),
-            timeout=30)
+        resp = self._meta_call(
+            entry.full_path,
+            lambda stub: stub.CreateEntry(filer_pb2.CreateEntryRequest(
+                directory=entry.parent, entry=entry.to_pb(),
+                o_excl=o_excl), timeout=30))
         if resp.error:
             raise FuseError(errno.EEXIST if "exist" in resp.error
                             else errno.EIO, resp.error)
         self.meta.update(entry)
 
     def _update_remote(self, entry: Entry) -> None:
-        self.stub.UpdateEntry(filer_pb2.UpdateEntryRequest(
-            directory=entry.parent, entry=entry.to_pb()), timeout=30)
+        self._meta_call(
+            entry.full_path,
+            lambda stub: stub.UpdateEntry(filer_pb2.UpdateEntryRequest(
+                directory=entry.parent, entry=entry.to_pb()), timeout=30))
         self.meta.update(entry)
 
     # -- kernel ops: lookup / attrs ---------------------------------------
@@ -219,12 +252,14 @@ class WFS:
         dir_path = self.inodes.get_path(inode)
         if self.meta.is_visited(dir_path):
             return self.meta.list_dir(dir_path)
-        out: list[Entry] = []
+        def listing(stub):
+            return [Entry.from_pb(dir_path, resp.entry) for resp in
+                    stub.ListEntries(filer_pb2.ListEntriesRequest(
+                        directory=dir_path, limit=1 << 20))]
+
         try:
-            for resp in self.stub.ListEntries(filer_pb2.ListEntriesRequest(
-                    directory=dir_path, limit=1 << 20)):
-                e = Entry.from_pb(dir_path, resp.entry)
-                out.append(e)
+            out = self._meta_call(dir_path, listing, directory=True)
+            for e in out:
                 self.meta.update(e)
             self.meta.mark_visited(dir_path)
         except Exception as e:
@@ -393,9 +428,11 @@ class WFS:
             raise FuseError(errno.EISDIR, path)
         # POSIX rmdir must fail ENOTEMPTY on a non-empty directory, so the
         # delete is never recursive from the kernel's point of view
-        resp = self.stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
-            directory=dir_path, name=name, is_delete_data=True,
-            is_recursive=False), timeout=30)
+        resp = self._meta_call(
+            path,
+            lambda stub: stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
+                directory=dir_path, name=name, is_delete_data=True,
+                is_recursive=False), timeout=30))
         if resp.error:
             raise FuseError(errno.ENOTEMPTY if "empty" in resp.error
                             else errno.EIO, resp.error)
@@ -406,9 +443,14 @@ class WFS:
                new_parent: int, new_name: str) -> None:
         old_dir = self.inodes.get_path(old_parent)
         new_dir = self.inodes.get_path(new_parent)
-        self.stub.AtomicRenameEntry(filer_pb2.AtomicRenameEntryRequest(
-            old_directory=old_dir, old_name=old_name,
-            new_directory=new_dir, new_name=new_name), timeout=60)
+        # routed by SOURCE entry: the shard owning the old parent drives
+        # the (possibly two-phase cross-shard) rename
+        self._meta_call(
+            normalize(old_dir + "/" + old_name),
+            lambda stub: stub.AtomicRenameEntry(
+                filer_pb2.AtomicRenameEntryRequest(
+                    old_directory=old_dir, old_name=old_name,
+                    new_directory=new_dir, new_name=new_name), timeout=60))
         old_path = normalize(old_dir + "/" + old_name)
         new_path = normalize(new_dir + "/" + new_name)
         self.meta.delete(old_path)

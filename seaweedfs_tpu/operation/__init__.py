@@ -236,6 +236,17 @@ def upload_data(url: str, data: bytes, *, filename: str = "",
                     rr = pool.put(url, body=body, headers=headers,
                                   timeout=60)
                     status, text, jload = rr.status, rr.text, rr.json
+            # ordinary write replies stamp the volume server's live
+            # backpressure score (ROADMAP 5(b)): feed it into the hot
+            # signal so upload windows collapse BEFORE the first 429
+            try:
+                _p = (rr.headers or {}).get("X-Swfs-Pressure")
+                if _p:
+                    from ..qos.pressure import SIGNAL
+
+                    SIGNAL.report_score(float(_p))
+            except (TypeError, ValueError, AttributeError):
+                pass
             if status < 300:
                 j = jload()
                 return UploadResult(name=j.get("name", filename),

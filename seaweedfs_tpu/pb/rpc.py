@@ -25,6 +25,7 @@ from . import (
     ec_stream_pb2,
     filer_pb2,
     master_pb2,
+    meta_ring_pb2,
     mount_pb2,
     mq_pb2,
     qos_pb2,
@@ -81,6 +82,13 @@ MASTER_SERVICE = ("master_pb.Seaweed", [
     # QoS plane (qos.proto; messages in pb/qos_pb2.py): volume servers
     # lease cluster-wide background byte budgets and report pressure
     _m("QosGrant", qos_pb2.QosGrantRequest, qos_pb2.QosGrantResponse),
+    # metadata ring plane (meta_ring.proto; messages in
+    # pb/meta_ring_pb2.py): filer shards join/renew over their
+    # heartbeat loop, every client plane fetches the published ring
+    _m("GetMetaRing", meta_ring_pb2.GetMetaRingRequest,
+       meta_ring_pb2.MetaRingResponse),
+    _m("JoinMetaRing", meta_ring_pb2.JoinMetaRingRequest,
+       meta_ring_pb2.MetaRingResponse),
     _m("RaftListClusterServers", M.RaftListClusterServersRequest, M.RaftListClusterServersResponse),
     _m("RaftAddServer", M.RaftAddServerRequest, M.RaftAddServerResponse),
     _m("RaftRemoveServer", M.RaftRemoveServerRequest, M.RaftRemoveServerResponse),
@@ -185,6 +193,11 @@ FILER_SERVICE = ("filer_pb.SeaweedFiler", [
     _m("CacheRemoteObjectToLocalCluster", F.CacheRemoteObjectToLocalClusterRequest,
        F.CacheRemoteObjectToLocalClusterResponse),
     _m("Ping", F.PingRequest, F.PingResponse),
+    # metadata ring proxy (ISSUE 19): a shard serves the ring it is
+    # routing under, so S3/mount/WebDAV bootstrap from their seed filer
+    # without ever holding a master address
+    _m("GetMetaRing", meta_ring_pb2.GetMetaRingRequest,
+       meta_ring_pb2.MetaRingResponse),
 ])
 
 

@@ -26,6 +26,11 @@ from ..utils.httpd import TunedThreadingHTTPServer
 
 import grpc
 
+from ..cluster.metaring import (
+    EPOCH_HEADER,
+    WRONG_SHARD_STATUS,
+    wrong_shard_of,
+)
 from ..pb import filer_pb2, rpc
 from ..utils import glog, trace
 from ..utils.http import url_for
@@ -76,6 +81,13 @@ class S3Server:
         from ..qos import TenantAdmission
 
         self.qos_admission = TenantAdmission("s3")
+        # metadata ring (ISSUE 19): when the filer namespace is sharded,
+        # route every metadata op to the shard owning its parent
+        # directory; unsharded deployments see a 1-entry ring and the
+        # seed filer answers everything (zero behavior change)
+        from ..wdclient import MetaRingClient
+
+        self.ring_client = MetaRingClient(filer_grpc=self.filer_grpc)
         self._cb_loaded_at = 0.0
         self._http_server = None
         self._started_at = time.time()
@@ -147,6 +159,26 @@ class S3Server:
     def stub(self):
         return rpc.filer_stub(self.filer_grpc)
 
+    def meta_call(self, path: str, fn, *, directory: bool = False):
+        """Run `fn(stub)` against the filer shard owning `path` (the
+        entry's parent dir, or the dir itself when directory=True), with
+        the ring client's one stale-ring retry: a shard answering
+        FAILED_PRECONDITION "wrong metadata shard" refreshes the cached
+        ring exactly once and the call re-routes."""
+        def leg(addr):
+            stub = (self.stub() if not addr or addr == self.filer
+                    else rpc.filer_stub(rpc.grpc_address(addr)))
+            try:
+                return fn(stub)
+            except grpc.RpcError as e:
+                ws = wrong_shard_of(e)
+                if ws is not None:
+                    raise ws from e
+                raise
+
+        return self.ring_client.call_routed(
+            path, leg, directory=directory, default=self.filer)
+
     def maybe_reload_circuit_breaker(self) -> None:
         """Refresh limits from /etc/s3/circuit_breaker.json (10s TTL — the
         reference reloads on filer metadata events; a short poll keeps the
@@ -166,35 +198,60 @@ class S3Server:
         return self.find_entry(BUCKETS_DIR, bucket)
 
     def find_entry(self, directory: str, name: str) -> filer_pb2.Entry | None:
-        try:
-            return self.stub().LookupDirectoryEntry(
-                filer_pb2.LookupDirectoryEntryRequest(
-                    directory=directory, name=name), timeout=10).entry
-        except grpc.RpcError as e:
-            if e.code() == grpc.StatusCode.NOT_FOUND:
-                return None
-            raise
+        def lookup(stub):
+            try:
+                return stub.LookupDirectoryEntry(
+                    filer_pb2.LookupDirectoryEntryRequest(
+                        directory=directory, name=name), timeout=10).entry
+            except grpc.RpcError as e:
+                if e.code() == grpc.StatusCode.NOT_FOUND:
+                    return None
+                raise
+
+        return self.meta_call(f"{directory}/{name}", lookup)
 
     def list_dir(self, directory: str, start: str = "", limit: int = 1000,
                  prefix: str = "", include_start=False):
-        try:
-            for resp in self.stub().ListEntries(
+        def listing(stub):
+            # materialized inside the routed leg: a generator escaping
+            # meta_call would stream from the wrong shard after a retry
+            try:
+                return [resp.entry for resp in stub.ListEntries(
                     filer_pb2.ListEntriesRequest(
                         directory=directory, prefix=prefix,
                         start_from_file_name=start,
                         inclusive_start_from=include_start,
-                        limit=limit), timeout=30):
-                yield resp.entry
-        except grpc.RpcError as e:
-            if e.code() != grpc.StatusCode.NOT_FOUND:
-                raise
+                        limit=limit), timeout=30)]
+            except grpc.RpcError as e:
+                if e.code() != grpc.StatusCode.NOT_FOUND:
+                    raise
+                return []
+
+        yield from self.meta_call(directory, listing, directory=True)
+
+    def _meta_url(self, full_path: str, refresh: bool = False) -> str:
+        """Filer-HTTP URL for `full_path`, aimed at the shard owning its
+        parent directory (the seed filer on a 1-entry/unreachable ring)."""
+        if refresh:
+            self.ring_client.ring(refresh=True, trigger="stale")
+        shard = self.ring_client.route_entry(full_path, self.filer)
+        dir_, _, name = full_path.rpartition("/")
+        return url_for(shard, dir_ + "/") + urllib.parse.quote(name)
+
+    def _note_stale_ring(self, resp) -> None:
+        """Absorb the epoch a 410 wrong-shard answer carries so the next
+        `_meta_url` re-resolves against a fresh ring."""
+        try:
+            self.ring_client.note_epoch(int(resp.headers.get(
+                EPOCH_HEADER, "0")))
+        except (TypeError, ValueError):
+            pass
 
     def put_object(self, bucket: str, key: str, body,
                    content_type: str = "") -> str:
         """-> etag. `body` is bytes or a chunk iterator; either way the
         bytes stream straight through the filer HTTP autochunker."""
-        url = (url_for(self.filer, f"{BUCKETS_DIR}/{bucket}/")
-               + urllib.parse.quote(key))
+        full_path = f"{BUCKETS_DIR}/{bucket}/{key}"
         md5 = hashlib.md5()
         if isinstance(body, (bytes, bytearray)):
             md5.update(body)
@@ -205,20 +262,28 @@ class S3Server:
             # python listener and requests can only replay a SEEKABLE
             # body across that redirect
             data = _spool(body, md5)
+        headers = trace.inject_headers(
+            {"Content-Type":
+             content_type or "application/octet-stream",
+             # tenant budget already charged at the S3 ingress —
+             # the filer must not bill this internal leg twice
+             "X-Swfs-Qos-Charged": "1",
+             # the S3 ETag contract is the whole-body md5: only
+             # the python PUT path records it (the C++ hot plane
+             # defers these), so PUT/GET/HEAD/If-None-Match agree
+             "X-Swfs-Want-Md5": "1"})
         try:
-            r = _session().put(
-                url, data=data,
-                headers=trace.inject_headers(
-                    {"Content-Type":
-                     content_type or "application/octet-stream",
-                     # tenant budget already charged at the S3 ingress —
-                     # the filer must not bill this internal leg twice
-                     "X-Swfs-Qos-Charged": "1",
-                     # the S3 ETag contract is the whole-body md5: only
-                     # the python PUT path records it (the C++ hot plane
-                     # defers these), so PUT/GET/HEAD/If-None-Match agree
-                     "X-Swfs-Want-Md5": "1"}),
-                timeout=600)
+            r = _session().put(self._meta_url(full_path), data=data,
+                               headers=headers, timeout=600)
+            if r.status_code == WRONG_SHARD_STATUS:
+                # stale ring: absorb the shard's epoch, refresh once,
+                # rewind the body and retry against the real owner
+                self._note_stale_ring(r)
+                if hasattr(data, "seek"):
+                    data.seek(0)
+                r = _session().put(
+                    self._meta_url(full_path, refresh=True), data=data,
+                    headers=headers, timeout=600)
         finally:
             if hasattr(data, "close"):
                 data.close()  # reclaim a disk-rolled spool promptly
@@ -245,14 +310,18 @@ class S3Server:
         whose RFC 7232/7233 evaluation (utils.http) then answers the
         S3 conditional GET — a 304 passes back through untouched
         (ISSUE 9 conformance satellite)."""
-        url = (url_for(self.filer, f"{BUCKETS_DIR}/{bucket}/")
-               + urllib.parse.quote(key))
+        full_path = f"{BUCKETS_DIR}/{bucket}/{key}"
         headers = trace.inject_headers(
             {**({"Range": range_header} if range_header else {}),
              **(conditional or {}),
              "X-Swfs-Qos-Charged": "1"})
-        r = _session().get(url, headers=headers, timeout=600,
-                              stream=stream)
+        r = _session().get(self._meta_url(full_path), headers=headers,
+                           timeout=600, stream=stream)
+        if r.status_code == WRONG_SHARD_STATUS:
+            self._note_stale_ring(r)
+            r.close()
+            r = _session().get(self._meta_url(full_path, refresh=True),
+                               headers=headers, timeout=600, stream=stream)
         if r.status_code == 304:
             return r
         if r.status_code == 404:
@@ -272,9 +341,33 @@ class S3Server:
 
     def delete_object(self, bucket: str, key: str) -> None:
         dir_, _, name = f"{BUCKETS_DIR}/{bucket}/{key}".rpartition("/")
-        self.stub().DeleteEntry(filer_pb2.DeleteEntryRequest(
-            directory=dir_, name=name, is_delete_data=True,
-            is_recursive=True), timeout=60)
+        self.delete_entry(dir_, name, is_delete_data=True,
+                          is_recursive=True)
+
+    # routed single-entry mutations: every handler path funnels through
+    # these so the whole gateway speaks to the owning shard
+
+    def create_entry(self, directory: str, entry, timeout: int = 10):
+        return self.meta_call(
+            f"{directory}/{entry.name}",
+            lambda stub: stub.CreateEntry(filer_pb2.CreateEntryRequest(
+                directory=directory, entry=entry), timeout=timeout))
+
+    def update_entry(self, directory: str, entry, timeout: int = 10):
+        return self.meta_call(
+            f"{directory}/{entry.name}",
+            lambda stub: stub.UpdateEntry(filer_pb2.UpdateEntryRequest(
+                directory=directory, entry=entry), timeout=timeout))
+
+    def delete_entry(self, directory: str, name: str, *,
+                     is_delete_data: bool, is_recursive: bool,
+                     timeout: int = 60):
+        return self.meta_call(
+            f"{directory}/{name}",
+            lambda stub: stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
+                directory=directory, name=name,
+                is_delete_data=is_delete_data,
+                is_recursive=is_recursive), timeout=timeout))
 
 
 # -- XML helpers -----------------------------------------------------------
@@ -741,8 +834,7 @@ def _make_handler(srv: S3Server):
                         raise S3Error(400, "InvalidArgument",
                                       f"unsupported canned acl {acl}")
                     entry.extended[ACL_KEY] = acl.encode()
-                srv.stub().CreateEntry(filer_pb2.CreateEntryRequest(
-                    directory=BUCKETS_DIR, entry=entry), timeout=10)
+                srv.create_entry(BUCKETS_DIR, entry)
                 return self._send(200, headers={"Location": f"/{bucket}"})
             if verb in ("GET", "HEAD"):
                 entry = bucket_entry
@@ -755,9 +847,9 @@ def _make_handler(srv: S3Server):
                     return self._list_multipart_uploads(bucket)
                 return self._list_objects(bucket, q)
             if verb == "DELETE":
-                resp = srv.stub().DeleteEntry(filer_pb2.DeleteEntryRequest(
-                    directory=BUCKETS_DIR, name=bucket,
-                    is_delete_data=True, is_recursive=True), timeout=60)
+                resp = srv.delete_entry(BUCKETS_DIR, bucket,
+                                        is_delete_data=True,
+                                        is_recursive=True)
                 if resp.error:
                     raise S3Error(409, "BucketNotEmpty", resp.error)
                 return self._send(204)
@@ -871,8 +963,7 @@ def _make_handler(srv: S3Server):
                     raise S3Error(400, "InvalidArgument",
                                   f"unsupported canned acl {acl}")
                 entry.extended[ACL_KEY] = acl.encode()
-                srv.stub().UpdateEntry(filer_pb2.UpdateEntryRequest(
-                    directory=dir_, entry=entry), timeout=10)
+                srv.update_entry(dir_, entry)
                 return self._send(200)
             raise S3Error(405, "MethodNotAllowed", "unsupported acl op")
 
@@ -894,14 +985,12 @@ def _make_handler(srv: S3Server):
                 except PolicyError as e:
                     raise S3Error(400, "MalformedPolicy", str(e))
                 entry.extended[POLICY_KEY] = pol.to_bytes()
-                srv.stub().UpdateEntry(filer_pb2.UpdateEntryRequest(
-                    directory=BUCKETS_DIR, entry=entry), timeout=10)
+                srv.update_entry(BUCKETS_DIR, entry)
                 return self._send(204)
             if verb == "DELETE":
                 if POLICY_KEY in entry.extended:
                     del entry.extended[POLICY_KEY]
-                    srv.stub().UpdateEntry(filer_pb2.UpdateEntryRequest(
-                        directory=BUCKETS_DIR, entry=entry), timeout=10)
+                    srv.update_entry(BUCKETS_DIR, entry)
                 return self._send(204)
             raise S3Error(405, "MethodNotAllowed", "unsupported policy op")
 
@@ -988,8 +1077,7 @@ def _make_handler(srv: S3Server):
                     entry = srv.find_entry(dir_, name)
                     if entry is not None:
                         entry.extended[ACL_KEY] = acl.encode()
-                        srv.stub().UpdateEntry(filer_pb2.UpdateEntryRequest(
-                            directory=dir_, entry=entry), timeout=10)
+                        srv.update_entry(dir_, entry)
                 return self._send(200, headers={"ETag": f'"{etag}"'})
             if verb in ("GET", "HEAD"):
                 if verb == "HEAD":
@@ -1107,15 +1195,13 @@ def _make_handler(srv: S3Server):
                     k = tag.find(f"{ns}Key").text
                     v = tag.find(f"{ns}Value").text or ""
                     entry.extended[f"x-amz-tag-{k}"] = v.encode()
-                srv.stub().UpdateEntry(filer_pb2.UpdateEntryRequest(
-                    directory=dir_, entry=entry), timeout=10)
+                srv.update_entry(dir_, entry)
                 return self._send(200)
             if verb == "DELETE":
                 for k in [k for k in entry.extended
                           if k.startswith("x-amz-tag-")]:
                     del entry.extended[k]
-                srv.stub().UpdateEntry(filer_pb2.UpdateEntryRequest(
-                    directory=dir_, entry=entry), timeout=10)
+                srv.update_entry(dir_, entry)
                 return self._send(204)
             raise S3Error(405, "MethodNotAllowed", "unsupported tagging op")
 
@@ -1128,8 +1214,7 @@ def _make_handler(srv: S3Server):
                                self.headers.get("Content-Type", "")}).encode()
             e = _dir_entry(upload_id)
             e.extended["upload-meta"] = meta
-            srv.stub().CreateEntry(filer_pb2.CreateEntryRequest(
-                directory=UPLOADS_DIR, entry=e), timeout=10)
+            srv.create_entry(UPLOADS_DIR, e)
             root = ET.Element("InitiateMultipartUploadResult", xmlns=S3_NS)
             _el(root, "Bucket", bucket)
             _el(root, "Key", key)
@@ -1141,10 +1226,16 @@ def _make_handler(srv: S3Server):
             if srv.find_entry(UPLOADS_DIR, upload_id) is None:
                 raise S3Error(404, "NoSuchUpload", "upload not found")
             body = self._body()
-            url = url_for(srv.filer, f"{UPLOADS_DIR}/{upload_id}/"
-                          f"{part_number:04d}.part")
-            r = _session().put(url, data=body, timeout=600,
+            part_path = (f"{UPLOADS_DIR}/{upload_id}/"
+                         f"{part_number:04d}.part")
+            r = _session().put(srv._meta_url(part_path), data=body,
+                               timeout=600,
                                headers={"X-Swfs-Want-Md5": "1"})
+            if r.status_code == WRONG_SHARD_STATUS:
+                srv._note_stale_ring(r)
+                r = _session().put(srv._meta_url(part_path, refresh=True),
+                                   data=body, timeout=600,
+                                   headers={"X-Swfs-Want-Md5": "1"})
             if r.status_code >= 300:
                 raise S3Error(500, "InternalError", "part upload failed")
             self._send(200, headers={
@@ -1184,13 +1275,20 @@ def _make_handler(srv: S3Server):
                 range_header = f"bytes={start}-{stop - 1}"
             r = srv.get_object(sbucket, skey, range_header=range_header,
                                stream=True)
-            url = url_for(srv.filer, f"{UPLOADS_DIR}/{upload_id}/"
-                          f"{part_number:04d}.part")
+            part_path = (f"{UPLOADS_DIR}/{upload_id}/"
+                         f"{part_number:04d}.part")
             md5 = hashlib.md5()
             spool = _spool(r.iter_content(1 << 20), md5)
             try:
-                pr = _session().put(url, data=spool, timeout=600,
+                pr = _session().put(srv._meta_url(part_path), data=spool,
+                                    timeout=600,
                                     headers={"X-Swfs-Want-Md5": "1"})
+                if pr.status_code == WRONG_SHARD_STATUS:
+                    srv._note_stale_ring(pr)
+                    spool.seek(0)
+                    pr = _session().put(
+                        srv._meta_url(part_path, refresh=True), data=spool,
+                        timeout=600, headers={"X-Swfs-Want-Md5": "1"})
             finally:
                 spool.close()
             if pr.status_code >= 300:
@@ -1226,14 +1324,12 @@ def _make_handler(srv: S3Server):
             final.attributes.file_size = offset
             final.attributes.mime = meta.get("content_type", "")
             dir_ = f"{BUCKETS_DIR}/{bucket}/{key}".rpartition("/")[0]
-            resp = srv.stub().CreateEntry(filer_pb2.CreateEntryRequest(
-                directory=dir_, entry=final), timeout=30)
+            resp = srv.create_entry(dir_, final, timeout=30)
             if resp.error:
                 raise S3Error(500, "InternalError", resp.error)
             # drop the staging dir but keep the chunks (owned by the object now)
-            srv.stub().DeleteEntry(filer_pb2.DeleteEntryRequest(
-                directory=UPLOADS_DIR, name=upload_id,
-                is_delete_data=False, is_recursive=True), timeout=60)
+            srv.delete_entry(UPLOADS_DIR, upload_id,
+                             is_delete_data=False, is_recursive=True)
             root = ET.Element("CompleteMultipartUploadResult", xmlns=S3_NS)
             _el(root, "Location", f"/{bucket}/{key}")
             _el(root, "Bucket", bucket)
@@ -1242,9 +1338,8 @@ def _make_handler(srv: S3Server):
             self._send(200, _xml_bytes(root))
 
         def _abort_multipart(self, bucket: str, key: str, upload_id: str):
-            srv.stub().DeleteEntry(filer_pb2.DeleteEntryRequest(
-                directory=UPLOADS_DIR, name=upload_id,
-                is_delete_data=True, is_recursive=True), timeout=60)
+            srv.delete_entry(UPLOADS_DIR, upload_id,
+                             is_delete_data=True, is_recursive=True)
             self._send(204)
 
         def _list_parts(self, bucket: str, key: str, upload_id: str):

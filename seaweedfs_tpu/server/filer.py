@@ -24,9 +24,21 @@ from urllib.parse import parse_qs, unquote, urlparse
 import grpc
 import requests as rq
 
+from ..cluster.metaring import (
+    EPOCH_HEADER,
+    WRONG_SHARD_STATUS,
+    MetaRing,
+    WrongShardError,
+)
 from ..filer import Attr, Entry, Filer, chunk_pipeline
 from ..filer.filechunks import etag as chunks_etag, total_size, view_from_chunks
-from ..filer.filer import NotEmpty, NotFound, normalize
+from ..filer.filer import (
+    NotEmpty,
+    NotFound,
+    new_directory_entry,
+    normalize,
+    parent_of,
+)
 from ..filer.filerstore import RetryingStore, get_store
 from ..operation import assign, delete_files, upload_data
 from ..pb import filer_pb2, master_pb2, rpc
@@ -37,6 +49,9 @@ from ..utils.http import not_modified, parse_range, range_applies, url_for
 from ..utils.stats import (
     FILER_CHUNK_CACHE_COUNTER,
     FILER_REQUEST_HISTOGRAM,
+    FILER_SHARD_QOS_OPS,
+    META_RING_RENAMES,
+    META_RING_WRONG_SHARD,
     chunk_cache_stats,
     chunk_pipeline_stats,
     fid_lease_stats,
@@ -129,6 +144,23 @@ class FilerServer:
         from ..qos import TenantAdmission
 
         self.qos_admission = TenantAdmission("filer")
+        # fleet-scale metadata plane (ISSUE 19): with SWFS_META_SHARD=1
+        # this filer serves ONE PARTITION of the namespace — it joins the
+        # master-published consistent-hash ring and answers 410 for
+        # routing keys that hash elsewhere. Deliberately explicit (never
+        # implied by having peers): classic multi-filer deployments have
+        # EVERY filer serving the full namespace via peer aggregation.
+        self.meta_shard = _os.environ.get("SWFS_META_SHARD", "") == "1"
+        self.meta_ring: MetaRing | None = None
+        self._ring_mu = threading.Lock()
+        self._ring_wake = threading.Event()
+        # directories already materialized on their owning shards — the
+        # deep-path storm re-walks the same ancestor chains per file
+        self._ensured_dirs: set[str] = set()
+        self._ensured_mu = threading.Lock()
+        self._rename_mu = threading.Lock()
+        self._rename_recovered = False
+        self.rename_recovery: dict | None = None
         # filer-side chunk cache (ISSUE 2): the mount-only
         # TieredChunkCache promoted to the filer's chunk-read ladder
         # (and thereby the S3 gateway GET path, which streams through
@@ -196,6 +228,12 @@ class FilerServer:
 
     def _on_keepalive_update(self, resp) -> None:
         u = resp.cluster_node_update
+        if u.node_type == "metaRingShard":
+            # ring membership changed: renew NOW (the join RPC's answer
+            # carries the bumped epoch + layout) instead of waiting out
+            # a renewal period while routing on a stale picture
+            self._ring_wake.set()
+            return
         if (u.address and u.node_type == "filer"
                 and u.filer_group == self.filer_group
                 and u.is_add and self.meta_aggregator is not None):
@@ -268,6 +306,9 @@ class FilerServer:
                          daemon=True).start()
         self._start_aggregator()
         self._start_announce()
+        if self.meta_shard:
+            threading.Thread(target=self._meta_ring_loop, daemon=True,
+                             name="filer-meta-ring").start()
         glog.info(f"filer started on {self.address} (grpc :{self.grpc_port})"
                   + (" (https)" if https_ctx is not None else "")
                   + (f" (native hot plane, admin :{self.admin_port})"
@@ -275,6 +316,20 @@ class FilerServer:
 
     def stop(self) -> None:
         self._announce_stop.set()
+        self._ring_wake.set()  # unblock the renew loop's wait
+        if self.meta_shard:
+            try:  # polite leave: clients stop routing here immediately.
+                # A crash skips this — rejoin is idempotent (no epoch
+                # bump), so a restarted shard resumes its ring position.
+                from ..pb import meta_ring_pb2
+
+                rpc.master_stub(rpc.grpc_address(
+                    self.master_client.current_master)).JoinMetaRing(
+                    meta_ring_pb2.JoinMetaRingRequest(
+                        address=self.address, leave=True), timeout=2)
+            except Exception as err:
+                # master already gone: epoch churn, not correctness
+                glog.v(1, f"meta ring polite leave: {err}")
         self._hot_stop.set()
         if self.hot_plane is not None:
             self.hot_plane.stop()
@@ -291,6 +346,313 @@ class FilerServer:
         if self.filer.meta_log is not None:
             self.filer.meta_log.close()
         self.filer.store.close()
+
+    # -- fleet-scale metadata plane (ISSUE 19) -----------------------------
+
+    META_RING_RENEW_S = 2.0
+    _INTENT_KEY = b"meta.rename.intents"
+
+    def _meta_ring_loop(self) -> None:
+        """Join the master's metadata ring and keep renewing on the
+        shard heartbeat cadence — every answer carries the current
+        epoch + membership, so ring updates ride the same loop. A
+        `metaRingShard` KeepConnected push wakes the loop early so a
+        membership change propagates in one RTT, not one period."""
+        from ..pb import meta_ring_pb2
+        from ..utils.stats import META_RING_EPOCH, META_RING_SHARDS
+
+        while not self._announce_stop.is_set():
+            try:
+                stub = rpc.master_stub(rpc.grpc_address(
+                    self.master_client.current_master))
+                resp = stub.JoinMetaRing(
+                    meta_ring_pb2.JoinMetaRingRequest(address=self.address),
+                    timeout=10)
+                ring = MetaRing.from_response(resp)
+                with self._ring_mu:
+                    old = self.meta_ring
+                    if old is None or ring.epoch >= old.epoch:
+                        self.meta_ring = ring
+                if old is None or ring.epoch != old.epoch:
+                    META_RING_EPOCH.set(ring.epoch)
+                    META_RING_SHARDS.set(len(ring))
+                    # ownership may have shifted: cached already-
+                    # materialized ancestors are now suspect
+                    with self._ensured_mu:
+                        self._ensured_dirs.clear()
+                    glog.v(1, f"meta ring epoch {ring.epoch}: "
+                              f"{list(ring.shards)}")
+                if not self._rename_recovered:
+                    # first successful join after (re)start: resolve the
+                    # rename intents an unclean shutdown left stranded
+                    self._rename_recovered = True
+                    self._resolve_rename_intents()
+            except Exception as e:
+                glog.v(1, f"meta ring join: {e}")
+            self._ring_wake.wait(self.META_RING_RENEW_S)
+            self._ring_wake.clear()
+
+    def ring_snapshot(self) -> MetaRing | None:
+        with self._ring_mu:
+            return self.meta_ring
+
+    def shard_check_entry(self, full_path: str, *,
+                          lenient: bool = False) -> "WrongShardError | None":
+        """None when this shard may serve an ENTRY operation on
+        full_path (routing key = its parent directory). `lenient` (HTTP
+        GET, where one verb serves both stats and listings) also
+        accepts the directory-key owner."""
+        if not self.meta_shard:
+            return None
+        ring = self.ring_snapshot()
+        if ring is None or len(ring) <= 1:
+            return None
+        p = normalize(full_path)
+        owner = ring.shard_for_entry(p)
+        if owner == self.address:
+            return None
+        if lenient and ring.shard_for_directory(p) == self.address:
+            return None
+        META_RING_WRONG_SHARD.inc(shard=self.address)
+        return WrongShardError(ring.epoch, owner)
+
+    def shard_check_dir(self, directory: str) -> "WrongShardError | None":
+        """None when this shard owns a directory LISTING key — the same
+        key its children were created under, so one shard answers the
+        whole listing."""
+        if not self.meta_shard:
+            return None
+        ring = self.ring_snapshot()
+        if ring is None or len(ring) <= 1:
+            return None
+        owner = ring.shard_for_directory(directory)
+        if owner == self.address:
+            return None
+        META_RING_WRONG_SHARD.inc(shard=self.address)
+        return WrongShardError(ring.epoch, owner)
+
+    def ensure_parent_dirs(self, full_path: str) -> None:
+        """A directory's ENTRY lives on the shard owning ITS parent —
+        generally not the shard that just stored a child deep below it.
+        Materialize each ancestor on its owning shard so stats and
+        listings of intermediate directories resolve from anywhere
+        (create_entry's _ensure_parents already covers THIS shard's
+        local store). Memoized: deep-path storms re-walk one chain per
+        file; steady state costs zero RPCs."""
+        if not self.meta_shard:
+            return
+        ring = self.ring_snapshot()
+        if ring is None or len(ring) <= 1:
+            return
+        chain: list[str] = []
+        d = parent_of(normalize(full_path))
+        with self._ensured_mu:
+            while d != "/" and d not in self._ensured_dirs:
+                chain.append(d)
+                d = parent_of(d)
+        for a in reversed(chain):  # shallowest first: parents land first
+            owner = ring.shard_for_entry(a)
+            try:
+                if owner and owner != self.address:
+                    e = new_directory_entry(a)
+                    r = rpc.filer_stub(rpc.grpc_address(owner)).CreateEntry(
+                        filer_pb2.CreateEntryRequest(
+                            directory=e.parent, entry=e.to_pb()),
+                        timeout=10)
+                    if r.error:
+                        raise IOError(r.error)
+                with self._ensured_mu:
+                    self._ensured_dirs.add(a)
+            except Exception as err:  # best-effort: a miss costs a stat
+                glog.v(1, f"ensure parent {a} on {owner}: {err}")
+                return
+
+    # -- cross-shard two-phase rename --------------------------------------
+
+    def _load_intents(self) -> dict:
+        try:
+            raw = self.filer.store.kv_get(self._INTENT_KEY)
+            return json.loads(raw) if raw else {}
+        except Exception as err:  # fresh store: no intents yet
+            glog.v(1, f"rename intents load: {err}")
+            return {}
+
+    def _store_intents(self, intents: dict) -> None:
+        self.filer.store.kv_put(self._INTENT_KEY,
+                                json.dumps(intents).encode())
+
+    def shard_rename(self, old: str, new: str) -> None:
+        """THE single two-phase cross-shard operation (ISSUE 19),
+        executed on the shard owning the SOURCE entry: durable intent
+        record locally, apply on the destination shard, then retire the
+        source. An interruption between apply and retire (the
+        `meta.rename.commit` crash seam) is resolved by the startup
+        recovery sweep — destination exists -> roll forward, else roll
+        back — so a kill leaves neither a lost nor a doubled entry."""
+        from ..utils import failpoint
+
+        old, new = normalize(old), normalize(new)
+        ring = self.ring_snapshot() if self.meta_shard else None
+        if ring is None or len(ring) <= 1:
+            self.filer.rename(old, new)
+            return
+        src_owner = ring.shard_for_entry(old)
+        if src_owner and src_owner != self.address:
+            META_RING_WRONG_SHARD.inc(shard=self.address)
+            raise WrongShardError(ring.epoch, src_owner)
+        entry = self.filer.find_entry(old)  # NotFound surfaces upstream
+        if entry.is_directory:
+            self._shard_rename_dir(old, new, ring)
+            return
+        dest = ring.shard_for_entry(new)
+        if (not dest) or dest == self.address:
+            self.filer.rename(old, new)  # both ends live here
+            return
+        # phase 1: durable intent on the source shard
+        with self._rename_mu:
+            intents = self._load_intents()
+            intents[old] = {"old": old, "new": new}
+            self._store_intents(intents)
+        try:
+            # phase 2: apply on the destination shard
+            pb_entry = entry.to_pb()
+            pb_entry.name = new.rsplit("/", 1)[-1]
+            resp = rpc.filer_stub(rpc.grpc_address(dest)).CreateEntry(
+                filer_pb2.CreateEntryRequest(
+                    directory=parent_of(new), entry=pb_entry), timeout=30)
+            if resp.error:
+                raise IOError(f"rename apply on {dest}: {resp.error}")
+        except Exception:
+            with self._rename_mu:  # roll back: destination never saw it
+                intents = self._load_intents()
+                intents.pop(old, None)
+                self._store_intents(intents)
+            META_RING_RENAMES.inc(outcome="error")
+            raise
+        # the commit seam: a crash HERE leaves both copies + the intent;
+        # recovery rolls forward (destination wins, source retired)
+        failpoint.fail("meta.rename.commit")
+        # phase 3: retire the source — chunks now belong to the moved
+        # entry, so the data is NOT garbage-collected
+        try:
+            self.filer.delete_entry(old, is_delete_data=False)
+        except NotFound:
+            pass
+        with self._rename_mu:
+            intents = self._load_intents()
+            intents.pop(old, None)
+            self._store_intents(intents)
+        META_RING_RENAMES.inc(outcome="commit")
+
+    def _shard_rename_dir(self, old: str, new: str, ring) -> None:
+        """Directory move on a sharded namespace: the destination dir
+        entry lands first (so moved children have a parent), then every
+        direct child — all living on shard(old) — moves via its own
+        routed two-phase (subdirectories recurse shard-by-shard), then
+        the emptied source retires. Leftover LOCAL parent scaffolding
+        under old on non-owner shards is invisible garbage: listings
+        only ever route to owners."""
+        dnew = new_directory_entry(new)
+        dest = ring.shard_for_entry(new)
+        if (not dest) or dest == self.address:
+            self.filer.create_entry(dnew)
+        else:
+            r = rpc.filer_stub(rpc.grpc_address(dest)).CreateEntry(
+                filer_pb2.CreateEntryRequest(
+                    directory=dnew.parent, entry=dnew.to_pb()), timeout=30)
+            if r.error:
+                raise IOError(f"rename mkdir on {dest}: {r.error}")
+        home = ring.shard_for_directory(old)  # the children's shard
+        if (not home) or home == self.address:
+            names = [e.name for e in self.filer.list_entries(
+                old, limit=1_000_000)]
+            for n in names:
+                self.shard_rename(f"{old}/{n}", f"{new}/{n}")
+        else:
+            stub = rpc.filer_stub(rpc.grpc_address(home))
+            names = [r.entry.name for r in stub.ListEntries(
+                filer_pb2.ListEntriesRequest(
+                    directory=old, limit=1_000_000), timeout=60)]
+            for n in names:
+                stub.AtomicRenameEntry(filer_pb2.AtomicRenameEntryRequest(
+                    old_directory=old, old_name=n,
+                    new_directory=new, new_name=n), timeout=60)
+        try:  # recursive clears only local scaffolding: real children
+            # moved above, and nothing is garbage-collected here
+            self.filer.delete_entry(old, recursive=True,
+                                    is_delete_data=False)
+        except NotFound:
+            pass
+
+    def _resolve_rename_intents(self) -> None:
+        """The PR-16 recovery ladder applied to the metadata plane: an
+        unclean shutdown can strand two-phase rename intents. Rungs:
+        load the intent set, probe the destination shard for each, roll
+        forward (destination has the entry — the crash seam sits after
+        apply, so destination wins and the source retires) or roll back
+        (apply never landed; the source is intact and the intent is
+        simply forgotten)."""
+        with self._rename_mu:
+            intents = self._load_intents()
+        report = {"intents": len(intents), "rolledForward": 0,
+                  "rolledBack": 0, "errors": 0}
+        if intents:
+            ring = self.ring_snapshot()
+            for old, it in list(intents.items()):
+                new = it.get("new", "")
+                try:
+                    if self._entry_exists_routed(ring, new):
+                        try:
+                            self.filer.delete_entry(old,
+                                                    is_delete_data=False)
+                        except NotFound:
+                            pass
+                        report["rolledForward"] += 1
+                        META_RING_RENAMES.inc(outcome="rollforward")
+                    else:
+                        report["rolledBack"] += 1
+                        META_RING_RENAMES.inc(outcome="rollback")
+                    intents.pop(old)
+                except Exception as e:  # shard down: keep the intent —
+                    # the next restart's sweep gets another chance
+                    report["errors"] += 1
+                    glog.warning(f"rename intent {old} -> {new}: {e}")
+            with self._rename_mu:
+                self._store_intents(intents)
+            glog.info(f"rename intent recovery: {report}")
+        self.rename_recovery = report
+
+    def _entry_exists_routed(self, ring, full_path: str) -> bool:
+        owner = ring.shard_for_entry(full_path) \
+            if ring is not None and len(ring) > 1 else ""
+        if not owner or owner == self.address:
+            try:
+                self.filer.find_entry(full_path)
+                return True
+            except NotFound:
+                return False
+        try:
+            rpc.filer_stub(rpc.grpc_address(owner)).LookupDirectoryEntry(
+                filer_pb2.LookupDirectoryEntryRequest(
+                    directory=parent_of(full_path),
+                    name=full_path.rsplit("/", 1)[-1]), timeout=10)
+            return True
+        except grpc.RpcError as e:
+            if e.code() == grpc.StatusCode.NOT_FOUND:
+                return False
+            raise
+
+    def meta_shard_status(self) -> dict | None:
+        if not self.meta_shard:
+            return None
+        ring = self.ring_snapshot()
+        return {
+            "address": self.address,
+            "ring": ring.describe() if ring is not None else None,
+            "renameRecovery": self.rename_recovery,
+            "pendingRenameIntents": len(self._load_intents()),
+            "ensuredParentDirs": len(self._ensured_dirs),
+        }
 
     # -- native hot plane --------------------------------------------------
 
@@ -641,6 +1003,7 @@ class FilerServer:
             raise
         if old_fids:
             self._gc_chunks(old_fids)
+        self.ensure_parent_dirs(entry.full_path)
         return entry
 
     def stream_file(self, entry: Entry, offset: int = 0,
@@ -778,6 +1141,7 @@ class FilerServer:
             for url in urls:
                 try:
                     r = pool.get(url, timeout=60, headers=headers)
+                    _note_pressure_header(r.headers)
                     if r.status in (200, 206):
                         data = r.data
                         if r.status == 200 and not view.is_full_chunk:
@@ -877,6 +1241,20 @@ class FilerServer:
             glog.warning(f"chunk gc failed: {e}")
 
 
+def _note_pressure_header(resp_headers) -> None:
+    """Feed a volume server's X-Swfs-Pressure response stamp (ROADMAP
+    5(b)) into the process-local hot signal: the pipelined chunk engine
+    collapses its readahead/overlap windows when the score crosses the
+    shed threshold — BEFORE the first 429 arrives. Per-process signal =
+    per-shard independence on the partitioned metadata plane."""
+    try:
+        v = resp_headers.get("X-Swfs-Pressure")
+        if v:
+            PRESSURE_SIGNAL.report_score(float(v))
+    except (TypeError, ValueError, AttributeError):
+        pass
+
+
 def _read_all(reader, cap: int = 1 << 30) -> bytes:
     out = bytearray()
     while True:
@@ -957,17 +1335,27 @@ class FilerGrpc:
         self.srv = srv
         self.filer = srv.filer
 
+    def _shard_gate(self, context, err) -> None:
+        """Abort FAILED_PRECONDITION with WrongShardError-parseable
+        details (the gRPC face of the HTTP 410, ISSUE 19)."""
+        if err is not None:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(err))
+
     def LookupDirectoryEntry(self, request, context):
         self.srv.hot_sync()
+        path = request.directory.rstrip("/") + "/" + request.name
+        self._shard_gate(context,
+                         self.srv.shard_check_entry(path, lenient=True))
         try:
-            e = self.filer.find_entry(
-                request.directory.rstrip("/") + "/" + request.name)
+            e = self.filer.find_entry(path)
         except NotFound:
             context.abort(grpc.StatusCode.NOT_FOUND, "not found")
         return filer_pb2.LookupDirectoryEntryResponse(entry=e.to_pb())
 
     def ListEntries(self, request, context):
         self.srv.hot_sync()
+        self._shard_gate(context,
+                         self.srv.shard_check_dir(request.directory))
         limit = request.limit or 1024
         for e in self.filer.list_entries(
                 request.directory, request.start_from_file_name,
@@ -977,6 +1365,7 @@ class FilerGrpc:
     def CreateEntry(self, request, context):
         self.srv.hot_sync()
         e = Entry.from_pb(request.directory, request.entry)
+        self._shard_gate(context, self.srv.shard_check_entry(e.full_path))
         try:
             self.filer.create_entry(
                 e, o_excl=request.o_excl,
@@ -984,11 +1373,17 @@ class FilerGrpc:
                 from_other_cluster=request.is_from_other_cluster)
         except Exception as err:  # noqa: BLE001
             return filer_pb2.CreateEntryResponse(error=str(err))
+        if not request.entry.is_directory:
+            # files trigger the cross-shard ancestor walk; directory
+            # creates are themselves that walk's building blocks (a
+            # recursion guard as much as an optimization)
+            self.srv.ensure_parent_dirs(e.full_path)
         return filer_pb2.CreateEntryResponse()
 
     def UpdateEntry(self, request, context):
         self.srv.hot_sync()
         e = Entry.from_pb(request.directory, request.entry)
+        self._shard_gate(context, self.srv.shard_check_entry(e.full_path))
         try:
             self.filer.update_entry(
                 e, from_other_cluster=request.is_from_other_cluster)
@@ -999,6 +1394,7 @@ class FilerGrpc:
     def AppendToEntry(self, request, context):
         self.srv.hot_sync()
         path = request.directory.rstrip("/") + "/" + request.entry_name
+        self._shard_gate(context, self.srv.shard_check_entry(path))
         try:
             e = self.filer.find_entry(path)
         except NotFound:
@@ -1017,6 +1413,7 @@ class FilerGrpc:
     def DeleteEntry(self, request, context):
         self.srv.hot_sync()
         path = request.directory.rstrip("/") + "/" + request.name
+        self._shard_gate(context, self.srv.shard_check_entry(path))
         try:
             fids = self.filer.delete_entry(
                 path, recursive=request.is_recursive,
@@ -1032,10 +1429,15 @@ class FilerGrpc:
 
     def AtomicRenameEntry(self, request, context):
         self.srv.hot_sync()
+        old = request.old_directory.rstrip("/") + "/" + request.old_name
+        new = request.new_directory.rstrip("/") + "/" + request.new_name
         try:
-            self.filer.rename(
-                request.old_directory.rstrip("/") + "/" + request.old_name,
-                request.new_directory.rstrip("/") + "/" + request.new_name)
+            # the one two-phase cross-shard operation (ISSUE 19):
+            # executed on the shard owning the SOURCE entry; local
+            # renames fall straight through to filer.rename
+            self.srv.shard_rename(old, new)
+        except WrongShardError as e:
+            context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
         except NotFound:
             context.abort(grpc.StatusCode.NOT_FOUND, "source not found")
         return filer_pb2.AtomicRenameEntryResponse()
@@ -1048,6 +1450,19 @@ class FilerGrpc:
         self.srv.hot_sync()
         old = request.old_directory.rstrip("/") + "/" + request.old_name
         new = request.new_directory.rstrip("/") + "/" + request.new_name
+        ring = self.srv.ring_snapshot() if self.srv.meta_shard else None
+        if ring is not None and len(ring) > 1:
+            # sharded namespace: delegate to the routed two-phase move —
+            # per-entry events reach subscribers from each shard's own
+            # mutation log rather than this stream
+            try:
+                self.srv.shard_rename(old, new)
+            except WrongShardError as e:
+                context.abort(grpc.StatusCode.FAILED_PRECONDITION, str(e))
+            except NotFound:
+                context.abort(grpc.StatusCode.NOT_FOUND,
+                              "source not found")
+            return
         try:
             # complete the WHOLE move before streaming: the generator is
             # only advanced as the client reads, so a cancel/deadline
@@ -1208,6 +1623,24 @@ class FilerGrpc:
         except NotFound:
             return None
 
+    def GetMetaRing(self, request, context):
+        """Ring proxy (ISSUE 19): any filer serves the ring it routes
+        under, so S3/mount/WebDAV gateways bootstrap from their seed
+        filer without ever holding a master address."""
+        from ..pb import meta_ring_pb2
+
+        resp = meta_ring_pb2.MetaRingResponse()
+        ring = self.srv.ring_snapshot()
+        if ring is None:
+            try:  # non-shard filer: relay the master's published ring
+                return rpc.master_stub(rpc.grpc_address(
+                    self.srv.master_client.current_master)).GetMetaRing(
+                    request, timeout=10)
+            except grpc.RpcError:
+                ring = MetaRing([])  # empty = unsharded to callers
+        ring.fill_response(resp)
+        return resp
+
     def Ping(self, request, context):
         now = time.time_ns()
         return filer_pb2.PingResponse(start_time_ns=now, remote_time_ns=now,
@@ -1322,15 +1755,36 @@ def _make_http_handler(srv: FilerServer):
                         **qos_stats(),
                         "tenantAdmission": srv.qos_admission.status(),
                     },
+                    # partitioned-namespace mode (ISSUE 19): this
+                    # shard's ring picture + rename-intent recovery
+                    "MetaShard": srv.meta_shard_status(),
                 })
             srv.hot_sync()  # see native PUTs not yet absorbed
             with trace.span("filer.read", carrier=self.headers,
                             component="filer", server=srv.address,
                             path=path) as tsp:
                 self._trace_id = tsp.trace_id
+                # lenient: one verb serves both stats (entry key) and
+                # listings (directory key) — either owner may answer
+                if self._wrong_shard_rejected(
+                        srv.shard_check_entry(path, lenient=True)):
+                    return
                 if self._qos_rejected(path, q, tsp, "GET"):
                     return
                 return self._do_get(path, q)
+
+        def _wrong_shard_rejected(self, err) -> bool:
+            """Answer 410 + the current ring epoch when the routing key
+            belongs to another shard (ISSUE 19) — the client drops its
+            cached ring, refetches, and retries once (the vid-cache
+            invalidation ladder, PR 1). True = reply already sent."""
+            if err is None:
+                return False
+            self._reply(WRONG_SHARD_STATUS, json.dumps({
+                "error": str(err), "ringEpoch": err.epoch,
+                "owner": err.owner,
+            }).encode(), headers={EPOCH_HEADER: str(err.epoch)})
+            return True
 
         def _qos_rejected(self, path, q, tsp, verb: str) -> bool:
             """Per-tenant ingress admission (ISSUE 8): True = the 429
@@ -1354,6 +1808,13 @@ def _make_http_handler(srv: FilerServer):
             d = srv.qos_admission.admit(
                 filer_tenant(path, q.get("collection", "")),
                 trace_id=tsp.trace_id, detail=f"{verb} {path}")
+            if srv.meta_shard:
+                # per-shard accounting (ISSUE 19): buckets are already
+                # per-process, so shards shed independently — the
+                # counter makes that isolation observable per shard
+                FILER_SHARD_QOS_OPS.inc(
+                    shard=srv.address,
+                    result="admit" if d.admitted else "reject")
             if d.admitted:
                 return False
             # an attribute, not set_error: a flood sheds hundreds of
@@ -1462,6 +1923,10 @@ def _make_http_handler(srv: FilerServer):
                             component="filer", server=srv.address,
                             path=path) as tsp:
                 self._trace_id = tsp.trace_id
+                if self._wrong_shard_rejected(srv.shard_check_entry(path)):
+                    # the unread body would desync keep-alive parsing
+                    self.close_connection = True
+                    return
                 if self._qos_rejected(path, q, tsp, "PUT"):
                     # the unread body would desync keep-alive parsing
                     self.close_connection = True
@@ -1523,6 +1988,8 @@ def _make_http_handler(srv: FilerServer):
                             component="filer", server=srv.address,
                             path=path) as tsp:
                 self._trace_id = tsp.trace_id
+                if self._wrong_shard_rejected(srv.shard_check_entry(path)):
+                    return
                 if self._qos_rejected(path, q, tsp, "DELETE"):
                     return
                 return self._do_delete(path, q)

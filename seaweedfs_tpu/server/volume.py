@@ -180,6 +180,12 @@ class VolumeServer:
         from ..qos import BackgroundGovernor
 
         self.qos_governor = BackgroundGovernor(self)
+        # response-stamped pressure (ROADMAP 5(b) / ISSUE 19): ordinary
+        # read/write replies carry the live score so clients learn about
+        # building backpressure from traffic they already have in
+        # flight, BEFORE the first 429
+        self._pressure_stamp = "0.0"
+        self._pressure_stamp_at = 0.0
         self._started_at = time.time()
 
     @property
@@ -425,6 +431,18 @@ class VolumeServer:
             for v in list(loc.volumes.values()):
                 total += max(0, v._gc_seq - v._gc_flushed)
         return total
+
+    def pressure_header_value(self) -> str:
+        """Cached [0,1] score for per-reply stamping: recomputed at most
+        every 0.25s, so the per-request cost is one field read instead
+        of a full volume walk."""
+        import time as _time
+
+        now = _time.monotonic()
+        if now >= self._pressure_stamp_at:
+            self._pressure_stamp = f"{self.qos_pressure():.4f}"
+            self._pressure_stamp_at = now + 0.25
+        return self._pressure_stamp
 
     def qos_pressure(self, gc_depth: int | None = None,
                      dispatch_depth: int | None = None) -> float:
@@ -2196,6 +2214,12 @@ def _make_http_handler(srv: VolumeServer):
             tid = getattr(self, "_trace_id", "")
             if tid:
                 self.send_header("X-Trace-Id", tid)
+            # every ordinary reply advertises this server's current
+            # backpressure score (ROADMAP 5(b)): the filer's chunk
+            # pipeline feeds it into the hot signal, collapsing its
+            # readahead/overlap windows BEFORE the first 429
+            self.send_header("X-Swfs-Pressure",
+                             srv.pressure_header_value())
             for k, v in (headers or {}).items():
                 self.send_header(k, v)
             self.end_headers()

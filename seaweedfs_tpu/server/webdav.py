@@ -26,6 +26,7 @@ import requests
 
 from ..utils.http import requests_verify
 
+from ..cluster.metaring import WRONG_SHARD_STATUS, wrong_shard_of
 from ..pb import filer_pb2, rpc
 from ..utils import glog
 
@@ -236,12 +237,37 @@ class WebDavServer:
         self.filer = filer
         self.base_dir = base_dir.rstrip("/") or ""
         self.locks = LockManager()
+        # metadata ring (ISSUE 19): route every filer op to the shard
+        # owning the path; 1-entry ring = the seed filer, unchanged
+        from ..wdclient import MetaRingClient
+
+        self.ring_client = MetaRingClient(
+            filer_grpc=rpc.grpc_address(filer))
         self._httpd: TunedThreadingHTTPServer | None = None
         self._thread: threading.Thread | None = None
 
     @property
     def stub(self):
         return rpc.filer_stub(rpc.grpc_address(self.filer))
+
+    def meta_call(self, path: str, fn, *, directory: bool = False):
+        """fn(stub) against the shard owning `path`, one stale-ring
+        retry (same ladder as the S3 gateway's meta_call)."""
+        import grpc as _grpc
+
+        def leg(addr):
+            stub = (self.stub if not addr or addr == self.filer
+                    else rpc.filer_stub(rpc.grpc_address(addr)))
+            try:
+                return fn(stub)
+            except _grpc.RpcError as e:
+                ws = wrong_shard_of(e)
+                if ws is not None:
+                    raise ws from e
+                raise
+
+        return self.ring_client.call_routed(
+            path, leg, directory=directory, default=self.filer)
 
     def start(self) -> None:
         from ..security.tls import load_http_server_context
@@ -272,9 +298,11 @@ class WebDavServer:
             return filer_pb2.Entry(name="", is_directory=True)
         directory, name = path.rsplit("/", 1)
         try:
-            resp = self.stub.LookupDirectoryEntry(
-                filer_pb2.LookupDirectoryEntryRequest(
-                    directory=directory or "/", name=name), timeout=30)
+            resp = self.meta_call(
+                path,
+                lambda stub: stub.LookupDirectoryEntry(
+                    filer_pb2.LookupDirectoryEntryRequest(
+                        directory=directory or "/", name=name), timeout=30))
         # lint: allow-broad-except(WebDAV lookup maps any filer failure
         # to not-found; PROPFIND callers answer 404, never 500)
         except Exception:
@@ -284,17 +312,31 @@ class WebDavServer:
         return resp.entry
 
     def list_dir(self, path: str) -> list[filer_pb2.Entry]:
-        out = []
-        for resp in self.stub.ListEntries(filer_pb2.ListEntriesRequest(
-                directory=path, limit=1 << 20)):
-            out.append(filer_pb2.Entry.FromString(
-                resp.entry.SerializeToString()))
-        return out
+        def listing(stub):
+            return [filer_pb2.Entry.FromString(
+                        resp.entry.SerializeToString())
+                    for resp in stub.ListEntries(filer_pb2.ListEntriesRequest(
+                        directory=path, limit=1 << 20))]
 
-    def filer_url(self, path: str) -> str:
+        return self.meta_call(path, listing, directory=True)
+
+    def filer_url(self, path: str, refresh: bool = False) -> str:
         from ..utils.http import url_for
 
-        return url_for(self.filer, urllib.parse.quote(path))
+        if refresh:
+            self.ring_client.ring(refresh=True, trigger="stale")
+        shard = self.ring_client.route_entry(path, self.filer)
+        return url_for(shard, urllib.parse.quote(path))
+
+    def note_stale_ring(self, resp) -> None:
+        """Absorb the epoch from a 410 wrong-shard HTTP answer."""
+        from ..cluster.metaring import EPOCH_HEADER
+
+        try:
+            self.ring_client.note_epoch(
+                int(resp.headers.get(EPOCH_HEADER, "0")))
+        except (TypeError, ValueError):
+            pass
 
 
 def _prop_response(href: str, entry: filer_pb2.Entry) -> ET.Element:
@@ -399,8 +441,10 @@ def _make_handler(srv: WebDavServer):
             entry = filer_pb2.Entry(name=name, is_directory=True)
             entry.attributes.file_mode = 0o40770
             entry.attributes.mtime = int(time.time())
-            srv.stub.CreateEntry(filer_pb2.CreateEntryRequest(
-                directory=directory or "/", entry=entry), timeout=30)
+            srv.meta_call(
+                path,
+                lambda stub: stub.CreateEntry(filer_pb2.CreateEntryRequest(
+                    directory=directory or "/", entry=entry), timeout=30))
             self._send(201)
 
         def do_GET(self):
@@ -414,6 +458,13 @@ def _make_handler(srv: WebDavServer):
             r = requests.get(srv.filer_url(path), timeout=300, stream=True,
                              headers={"Range": rng} if rng else {},
                              verify=requests_verify())
+            if r.status_code == WRONG_SHARD_STATUS:
+                srv.note_stale_ring(r)
+                r.close()
+                r = requests.get(srv.filer_url(path, refresh=True),
+                                 timeout=300, stream=True,
+                                 headers={"Range": rng} if rng else {},
+                                 verify=requests_verify())
             if r.status_code >= 300:
                 return self._send(r.status_code)
             self.send_response(r.status_code)
@@ -445,11 +496,16 @@ def _make_handler(srv: WebDavServer):
             if not self._check_lock(path):
                 return
             body = self._read_body()
+            headers = {"Content-Type":
+                       self.headers.get("Content-Type") or
+                       "application/octet-stream"}
             r = requests.put(srv.filer_url(path), data=body, timeout=300,
-                             headers={"Content-Type":
-                                      self.headers.get("Content-Type") or
-                                      "application/octet-stream"},
-                             verify=requests_verify())
+                             headers=headers, verify=requests_verify())
+            if r.status_code == WRONG_SHARD_STATUS:
+                srv.note_stale_ring(r)
+                r = requests.put(srv.filer_url(path, refresh=True),
+                                 data=body, timeout=300, headers=headers,
+                                 verify=requests_verify())
             self._send(201 if r.status_code < 300 else r.status_code)
 
         def do_DELETE(self):
@@ -460,9 +516,11 @@ def _make_handler(srv: WebDavServer):
             if entry is None:
                 return self._send(404)
             directory, name = path.rsplit("/", 1)
-            resp = srv.stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
-                directory=directory or "/", name=name, is_delete_data=True,
-                is_recursive=True), timeout=60)
+            resp = srv.meta_call(
+                path,
+                lambda stub: stub.DeleteEntry(filer_pb2.DeleteEntryRequest(
+                    directory=directory or "/", name=name,
+                    is_delete_data=True, is_recursive=True), timeout=60))
             if not resp.error:
                 srv.locks.release_subtree(path)  # resources gone (§9.6.1)
             self._send(204 if not resp.error else 409)
@@ -489,10 +547,15 @@ def _make_handler(srv: WebDavServer):
             od, on = src.rsplit("/", 1)
             nd, nn = dst.rsplit("/", 1)
             try:
-                srv.stub.AtomicRenameEntry(
-                    filer_pb2.AtomicRenameEntryRequest(
-                        old_directory=od or "/", old_name=on,
-                        new_directory=nd or "/", new_name=nn), timeout=60)
+                # routed by SOURCE entry: the shard owning the old parent
+                # runs the (possibly two-phase cross-shard) rename
+                srv.meta_call(
+                    src,
+                    lambda stub: stub.AtomicRenameEntry(
+                        filer_pb2.AtomicRenameEntryRequest(
+                            old_directory=od or "/", old_name=on,
+                            new_directory=nd or "/", new_name=nn),
+                        timeout=60))
             except grpc.RpcError as e:
                 code = e.code()
                 return self._send(
@@ -514,10 +577,19 @@ def _make_handler(srv: WebDavServer):
                 return self._send(501)  # directory COPY: not supported
             r = requests.get(srv.filer_url(src), timeout=300,
                              verify=requests_verify())
+            if r.status_code == WRONG_SHARD_STATUS:
+                srv.note_stale_ring(r)
+                r = requests.get(srv.filer_url(src, refresh=True),
+                                 timeout=300, verify=requests_verify())
             if r.status_code >= 300:
                 return self._send(502)
             pr = requests.put(srv.filer_url(dst), data=r.content,
                               timeout=300, verify=requests_verify())
+            if pr.status_code == WRONG_SHARD_STATUS:
+                srv.note_stale_ring(pr)
+                pr = requests.put(srv.filer_url(dst, refresh=True),
+                                  data=r.content, timeout=300,
+                                  verify=requests_verify())
             self._send(201 if pr.status_code < 300 else pr.status_code)
 
         def _check_lock(self, path: str, recursive: bool = False) -> bool:

@@ -600,6 +600,41 @@ QOS_PRESSURE = Gauge(
     "buffer depth folded with EC-dispatch queue depth).")
 
 
+# -- fleet-scale metadata plane (ISSUE 19): the filer namespace sharded
+#    behind a master-published consistent-hash ring --------------------------
+
+META_RING_EPOCH = Gauge(
+    "SeaweedFS_meta_ring_epoch",
+    "Metadata-ring epoch this process routes under (master: published "
+    "epoch; filer shard / client plane: last fetched).")
+META_RING_SHARDS = Gauge(
+    "SeaweedFS_meta_ring_shards",
+    "Filer shards in the metadata ring this process routes under.")
+META_RING_FETCHES = Counter(
+    "SeaweedFS_meta_ring_fetches",
+    "Ring fetches by trigger (ttl/stale/join/bootstrap) and result "
+    "(ok/error).")
+META_RING_WRONG_SHARD = Counter(
+    "SeaweedFS_meta_ring_wrong_shard",
+    "Requests this shard refused with 410 because the routing key "
+    "belongs to another shard — a stale client ring refreshes and "
+    "retries once, mirroring the vid-cache invalidation ladder.")
+META_RING_RENAMES = Counter(
+    "SeaweedFS_meta_ring_renames",
+    "Cross-shard two-phase renames by outcome (commit/rollforward/"
+    "rollback/error) — rollforward/rollback count recovery-ladder "
+    "resolutions of interrupted intents.")
+FILER_SHARD_QOS_OPS = Counter(
+    "SeaweedFS_filer_shard_qos_ops",
+    "Per-shard admission outcomes on the partitioned metadata plane "
+    "(admit/reject) — shards shed independently, so one hot directory "
+    "cannot melt its neighbors.")
+META_AGGREGATOR_RECONNECTS = Counter(
+    "SeaweedFS_filer_meta_aggregator_reconnects",
+    "Peer metadata-subscription stream drops that entered the backoff "
+    "reconnect loop (one count per reconnect attempt, by peer).")
+
+
 # -- HTTPS data plane + zero-copy read path (ISSUE 9): connection-pool
 #    economics, TLS handshake amortization, conditional/zero-copy serve
 #    outcomes ---------------------------------------------------------------

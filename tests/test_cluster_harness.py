@@ -115,6 +115,40 @@ def test_harness_crash_drill_smoke():
     assert out["clean_shutdown"] is True, out
 
 
+def test_harness_metadata_smoke_two_shards():
+    """ISSUE 19 tentpole: a 2-shard partitioned filer namespace under
+    the deep-path create/list/stat + rename-churn storm, every leg
+    routed by the master-published metadata ring. Contract: nonzero
+    goodput, zero errors (every read sha-verified), ops actually served
+    by BOTH shards, and zero client-visible wrong-shard answers after
+    the one-stale-retry 410+epoch ladder."""
+    proc = subprocess.run(
+        [sys.executable, _HARNESS, "--metadata", "--smoke",
+         "--servers", "1", "--duration", "5"],
+        cwd=_REPO, capture_output=True, text=True, timeout=270,
+        env={**os.environ, "JAX_PLATFORMS": "cpu",
+             "SEAWEEDFS_TPU_NATIVE": "0"})
+    out = _last_json_line(proc.stdout)
+    assert out is not None, (proc.stdout[-500:], proc.stderr[-500:])
+    assert "error" not in out, out["error"]
+    assert out["filerShards"] == 2
+    md = out["shapes"]["metadata"]
+    assert md["ok"] > 0 and md["errors"] == 0, md
+    # the data-plane shapes ride the partitioned namespace unharmed
+    for name in ("put_flood", "zipf_read"):
+        s = out["shapes"][name]
+        assert s["ok"] > 0 and s["errors"] == 0, (name, s)
+    # traffic genuinely spread across the ring
+    assert len(out["okByShard"]) >= 2, out["okByShard"]
+    assert out["wrongShardClientErrors"] == 0, out
+    # both shards published the same ring picture at the same epoch
+    rings = [v["MetaShard"]["ring"]
+             for v in out["shardStatus"].values() if v.get("MetaShard")]
+    assert len(rings) == 2 and rings[0] == rings[1], rings
+    assert len(rings[0]["shards"]) == 2, rings[0]
+    assert out["clean_shutdown"] is True, out
+
+
 def test_harness_smoke_all_shapes_and_clean_shutdown():
     # subprocess timeout is the watchdog here (no pytest-timeout in the
     # container); the conftest 300s faulthandler backstops the backstop

@@ -1,0 +1,253 @@
+"""Metadata-ring math (ISSUE 19): bounded churn, cross-process
+stability, and a golden layout pin.
+
+The whole point of deriving virtual-node positions from BLAKE2b instead
+of carrying them on the wire is that every process, every epoch, every
+release computes the IDENTICAL layout from (shards, replicas). These
+tests make that contract load-bearing:
+
+  * adding/removing one shard moves only a bounded key fraction, and
+    every moved key moves to/from the changed shard (consistent
+    hashing's defining property — no full reshuffle);
+  * a subprocess derives the same routing table (Python hash() is
+    salted per process; blake2b is not);
+  * a golden layout pins partition assignment so it can never silently
+    change between releases.
+"""
+
+from __future__ import annotations
+
+import json
+import subprocess
+import sys
+
+import pytest
+
+from seaweedfs_tpu.cluster.metaring import (
+    EPOCH_HEADER,
+    WRONG_SHARD_STATUS,
+    MetaRing,
+    WrongShardError,
+    hash64,
+    normalize,
+    parent_of,
+)
+
+SHARDS = [f"localhost:888{i}" for i in range(1, 5)]
+KEYS = [f"/dir{i // 16}/sub{i % 16}" for i in range(4096)]
+
+
+# -- golden pins ------------------------------------------------------------
+
+def test_hash64_golden():
+    # BLAKE2b first-8-bytes big-endian: pinned so the ring position of
+    # every key is a release-stable fact, not an implementation detail
+    assert hash64("/") == 13778807214825741712
+    assert hash64("/buckets") == 12148721251896476896
+    assert hash64("/a/b") == 15240591694024102120
+    assert hash64("/deep/path/x") == 17595502606140747828
+
+
+def test_golden_ring_layout():
+    ring = MetaRing(SHARDS, epoch=7, replicas=8)
+    golden = {
+        "/": "localhost:8882",
+        "/a": "localhost:8884",
+        "/a/b": "localhost:8881",
+        "/a/b/c": "localhost:8883",
+        "/buckets/b1": "localhost:8883",
+        "/buckets/b2": "localhost:8883",
+        "/deep/p0": "localhost:8884",
+        "/deep/p1": "localhost:8884",
+        "/deep/p2": "localhost:8881",
+        "/deep/p3": "localhost:8881",
+        "/deep/p4": "localhost:8883",
+        "/x": "localhost:8881",
+        "/y": "localhost:8883",
+        "/z": "localhost:8881",
+        "/tmp/scratch": "localhost:8881",
+        "/logs/2026/08/07": "localhost:8882",
+    }
+    assert {k: ring.shard_for_key(k) for k in golden} == golden
+
+
+def test_routing_stable_across_processes():
+    """A fresh interpreter derives the identical routing table — the
+    property Python's salted hash() would silently break."""
+    keys = KEYS[:64]
+    prog = (
+        "import json,sys\n"
+        "from seaweedfs_tpu.cluster.metaring import MetaRing\n"
+        f"ring = MetaRing({SHARDS!r}, epoch=1, replicas=16)\n"
+        f"print(json.dumps([ring.shard_for_key(k) for k in {keys!r}]))\n"
+    )
+    out = subprocess.run([sys.executable, "-c", prog], check=True,
+                         capture_output=True, text=True).stdout
+    ring = MetaRing(SHARDS, epoch=1, replicas=16)
+    assert json.loads(out) == [ring.shard_for_key(k) for k in keys]
+
+
+# -- bounded churn ----------------------------------------------------------
+
+def test_add_shard_moves_only_to_new_shard():
+    before = MetaRing(SHARDS, epoch=1, replicas=64)
+    after = before.with_shard("localhost:8885")
+    assert after.epoch == 2
+    moved = 0
+    for k in KEYS:
+        a, b = before.shard_for_key(k), after.shard_for_key(k)
+        if a != b:
+            moved += 1
+            # every moved key lands ON the new shard — an old shard
+            # never inherits keys from another old shard
+            assert b == "localhost:8885", (k, a, b)
+    # expected move fraction is 1/5; anything near a full reshuffle
+    # (4/5) means the hash/ring layout broke
+    assert 0 < moved / len(KEYS) < 0.40
+
+
+def test_remove_shard_moves_only_from_removed_shard():
+    before = MetaRing(SHARDS, epoch=3, replicas=64)
+    gone = SHARDS[2]
+    after = before.without_shard(gone)
+    assert after.epoch == 4
+    assert gone not in after.shards
+    moved = 0
+    for k in KEYS:
+        a, b = before.shard_for_key(k), after.shard_for_key(k)
+        if a != b:
+            moved += 1
+            # only the removed shard's keys move; everyone else's
+            # assignment is untouched
+            assert a == gone, (k, a, b)
+    assert 0 < moved / len(KEYS) < 0.45
+
+
+def test_membership_not_construction_order_defines_layout():
+    a = MetaRing(SHARDS, epoch=5)
+    b = MetaRing(list(reversed(SHARDS)), epoch=5)
+    assert a == b
+    assert all(a.shard_for_key(k) == b.shard_for_key(k)
+               for k in KEYS[:256])
+
+
+def test_rejoin_restores_identical_positions():
+    """A crashed shard that rejoins resumes the SAME ring position —
+    the property that lets the crash drill route consistently across a
+    kill/restart without reshuffling the namespace."""
+    ring = MetaRing(SHARDS, epoch=1)
+    bounced = ring.without_shard(SHARDS[0]).with_shard(SHARDS[0])
+    assert bounced.shards == ring.shards
+    assert all(ring.shard_for_key(k) == bounced.shard_for_key(k)
+               for k in KEYS[:256])
+
+
+# -- routing keys -----------------------------------------------------------
+
+def test_entry_routes_by_parent_directory():
+    ring = MetaRing(SHARDS, replicas=32)
+    for d in ("/a/b", "/deep/x/y/z"):
+        owner = ring.shard_for_directory(d)
+        # every child entry of d routes with d's key: one shard serves
+        # the whole listing, children can never straddle a boundary
+        for name in ("f1", "f2", "sub", "weird name.txt"):
+            assert ring.shard_for_entry(f"{d}/{name}") == owner
+
+
+def test_single_and_empty_ring_degenerate():
+    assert MetaRing([]).shard_for_key("/x") == ""
+    one = MetaRing(["localhost:8888"])
+    assert one.shard_for_key("/anything") == "localhost:8888"
+    # <=1 shard: everyone owns everything (zero behavior change for
+    # unsharded deployments)
+    assert one.owns_entry("localhost:8888", "/a/b")
+    assert one.owns_entry("some-other-filer", "/a/b")
+    assert MetaRing([]).owns_directory("anyone", "/d")
+
+
+def test_normalize_and_parent():
+    assert normalize("a//b/") == "/a/b"
+    assert normalize("/") == "/"
+    assert parent_of("/a/b/c") == "/a/b"
+    assert parent_of("/a") == "/"
+    assert parent_of("/") == "/"
+
+
+# -- pb bridge + wrong-shard protocol ---------------------------------------
+
+def test_pb_roundtrip():
+    from seaweedfs_tpu.pb import meta_ring_pb2
+
+    ring = MetaRing(SHARDS, epoch=9, replicas=16)
+    resp = meta_ring_pb2.MetaRingResponse()
+    ring.fill_response(resp)
+    back = MetaRing.from_response(resp)
+    assert back == ring
+    assert back.shard_for_key("/a/b") == ring.shard_for_key("/a/b")
+
+
+def test_wrong_shard_error_details_roundtrip():
+    e = WrongShardError(12, "localhost:8883")
+    parsed = WrongShardError.from_details(str(e))
+    assert parsed is not None
+    assert (parsed.epoch, parsed.owner) == (12, "localhost:8883")
+    # unrelated gRPC details parse to None, not a bogus wrong-shard
+    assert WrongShardError.from_details("deadline exceeded") is None
+    assert WrongShardError.from_details("") is None
+    assert WRONG_SHARD_STATUS == 410
+    assert EPOCH_HEADER == "X-Swfs-Ring-Epoch"
+
+
+# -- MetaRingClient ---------------------------------------------------------
+
+def _client(ring, ttl=60.0):
+    from seaweedfs_tpu.wdclient import MetaRingClient
+
+    c = MetaRingClient(filer_grpc="unused:0", ttl=ttl)
+    c._ring = ring
+    c._expires = 1e18  # cache pinned: tests drive invalidation by hand
+    return c
+
+
+def test_client_note_epoch_invalidates_only_forward():
+    ring = MetaRing(SHARDS, epoch=5)
+    c = _client(ring)
+    assert not c.note_epoch(4)  # lagging 410: cache stays
+    assert not c.note_epoch(5)
+    assert c.note_epoch(6)      # newer epoch observed: cache dropped
+    assert c._expires == 0.0
+
+
+def test_client_call_routed_stale_retry(monkeypatch):
+    old = MetaRing(SHARDS, epoch=1)
+    new = old.with_shard("localhost:8885")
+    c = _client(old)
+    key = next(k for k in KEYS
+               if new.shard_for_key(k) != old.shard_for_key(k))
+    fetched = []
+    monkeypatch.setattr(
+        c, "_fetch", lambda trigger: fetched.append(trigger) or new)
+    calls = []
+
+    def fn(addr):
+        calls.append(addr)
+        if len(calls) == 1:  # the shard answers 410 + its newer epoch
+            raise WrongShardError(new.epoch, new.shard_for_key(key))
+        return addr
+
+    assert c.call_routed(key, fn, directory=True) \
+        == new.shard_for_key(key)
+    assert calls == [old.shard_for_key(key), new.shard_for_key(key)]
+    assert fetched == ["stale"]  # exactly one refresh, exactly one retry
+
+
+def test_client_call_routed_gives_up_after_one_retry(monkeypatch):
+    ring = MetaRing(SHARDS, epoch=3)
+    c = _client(ring)
+    monkeypatch.setattr(c, "_fetch", lambda trigger: ring)
+
+    def always_wrong(addr):
+        raise WrongShardError(3, "localhost:9999")
+
+    with pytest.raises(WrongShardError):
+        c.call_routed("/a/b/c", always_wrong)
